@@ -1,0 +1,27 @@
+"""armada-tpu: a TPU-native batch-scheduling framework.
+
+A from-scratch re-architecture of the capabilities of Armada
+(github.com/armadaproject/armada, mounted read-only at /root/reference): queueing of
+millions of jobs across many clusters, dominant-resource-fair scheduling, urgency- and
+fair-share preemption, all-or-nothing gang scheduling, an event-sourced control plane,
+executor reconciliation, a discrete-event simulator, CLI and observability.
+
+The per-round job->node assignment -- the reference's `SchedulingAlgo.Schedule`
+(internal/scheduler/scheduling/scheduling_algo.go:36-41) -- is reformulated as dense
+(queues x jobs x nodes x resources) tensor computation compiled with jax.jit/pjit and
+executed on TPU.  See SURVEY.md section 7 for the blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from armada_tpu.core.resources import ResourceListFactory, ResourceList
+from armada_tpu.core.config import SchedulingConfig, PriorityClass, default_scheduling_config
+
+__all__ = [
+    "ResourceListFactory",
+    "ResourceList",
+    "SchedulingConfig",
+    "PriorityClass",
+    "default_scheduling_config",
+    "__version__",
+]

@@ -1,0 +1,5 @@
+"""Core host-side types: exact resource arithmetic, configuration, job/node/queue specs.
+
+Equivalent surface to the reference's `internal/scheduler/internaltypes` and
+`internal/scheduler/configuration` packages.
+"""

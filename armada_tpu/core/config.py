@@ -1,0 +1,190 @@
+"""Scheduling configuration.
+
+Equivalent of the reference's `internal/scheduler/configuration/types.go`
+(SchedulingConfig) with defaults mirroring /root/reference/config/scheduler/config.yaml:70-127.
+Loaded from YAML; every knob that shapes the scheduling round is here so that the round
+kernel can be specialised (config values are static under jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+from armada_tpu.core.resources import ResourceListFactory
+
+
+@dataclasses.dataclass(frozen=True)
+class PriorityClass:
+    """A priority class (configuration/types.go PriorityClass).
+
+    `priority` is the Kubernetes-style integer priority at which the job's pods
+    contend for node resources; `preemptible` gates fair-share eviction
+    (preempting_queue_scheduler.go:143-157).
+    """
+
+    name: str
+    priority: int
+    preemptible: bool = False
+    # Per-queue cap on the fraction of pool resources jobs of this PC may take
+    # (constraints.go; config.yaml:91-95).  Missing resources are uncapped.
+    maximum_resource_fraction_per_queue: Mapping[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolConfig:
+    name: str
+    # Pools this pool may schedule "away" jobs onto (scheduling_algo.go:216-283).
+    away_pools: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulingConfig:
+    """The scheduler's static configuration (configuration/types.go SchedulingConfig)."""
+
+    # Fixed resource axis registry: (name, resolution) pairs
+    # (config.yaml supportedResourceTypes:73-82).
+    supported_resource_types: tuple[tuple[str, str], ...] = (
+        ("memory", "1"),
+        ("cpu", "1m"),
+        ("ephemeral-storage", "1"),
+        ("nvidia.com/gpu", "1"),
+    )
+    pools: tuple[PoolConfig, ...] = (PoolConfig("default"),)
+    priority_classes: Mapping[str, PriorityClass] = dataclasses.field(
+        default_factory=lambda: {
+            "armada-default": PriorityClass(
+                "armada-default",
+                priority=1000,
+                preemptible=False,
+                maximum_resource_fraction_per_queue={"memory": 1.0, "cpu": 1.0},
+            ),
+            "armada-preemptible": PriorityClass(
+                "armada-preemptible", priority=1000, preemptible=True
+            ),
+        }
+    )
+    default_priority_class: str = "armada-default"
+    # DRF resources to consider, all multiplier 1.0 (config.yaml:108-113).
+    dominant_resource_fairness_resources: tuple[str, ...] = (
+        "cpu",
+        "memory",
+        "nvidia.com/gpu",
+        "ephemeral-storage",
+    )
+    # Fraction of its fair share below which a queue's jobs are protected from
+    # fair-share eviction (config.yaml protectedFractionOfFairShare, default 1.0).
+    protected_fraction_of_fair_share: float = 1.0
+    max_queue_lookback: int = 100_000
+    maximum_scheduling_burst: int = 1_000
+    maximum_per_queue_scheduling_burst: int = 1_000
+    maximum_scheduling_rate: float = 100.0
+    maximum_per_queue_scheduling_rate: float = 50.0
+    # Cap on fraction of pool resources schedulable in one round (config.yaml:100-102).
+    maximum_resource_fraction_to_schedule: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: {"memory": 1.0, "cpu": 1.0}
+    )
+    max_retries: int = 3
+    # Node labels whose values are folded into the NodeType id; selectors on other
+    # labels fall back to per-node host-side filtering (nodedb.go:84-108).
+    indexed_node_labels: tuple[str, ...] = ()
+    indexed_taints: tuple[str, ...] = ()
+    node_id_label: str = "kubernetes.io/hostname"
+    executor_timeout_s: float = 600.0
+    max_unacknowledged_jobs_per_executor: int = 2500
+    enable_assertions: bool = False
+    # Device-shape bucketing: round padded axis sizes up to the next multiple to
+    # bound jit recompilation (ours; no reference equivalent -- Go has no shapes).
+    shape_bucket: int = 256
+
+    def resource_list_factory(self) -> ResourceListFactory:
+        return ResourceListFactory.from_config(self.supported_resource_types)
+
+    def priority_class(self, name: Optional[str]) -> PriorityClass:
+        if not name:
+            name = self.default_priority_class
+        try:
+            return self.priority_classes[name]
+        except KeyError:
+            raise ValueError(f"unknown priority class {name!r}") from None
+
+    def drf_multipliers(self) -> dict[str, float]:
+        return {name: 1.0 for name in self.dominant_resource_fairness_resources}
+
+    def priority_ladder(self) -> tuple[int, ...]:
+        """Sorted distinct PC priorities: the P axis of node allocatable tensors
+        (internaltypes/node.go AllocatableByPriority)."""
+        return tuple(sorted({pc.priority for pc in self.priority_classes.values()}))
+
+
+def default_scheduling_config() -> SchedulingConfig:
+    return SchedulingConfig()
+
+
+def _parse_priority_classes(d: Mapping) -> dict[str, PriorityClass]:
+    out = {}
+    for name, spec in d.items():
+        out[name] = PriorityClass(
+            name=name,
+            priority=int(spec["priority"]),
+            preemptible=bool(spec.get("preemptible", False)),
+            maximum_resource_fraction_per_queue=dict(
+                spec.get("maximumResourceFractionPerQueue", {})
+            ),
+        )
+    return out
+
+
+def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
+    """Build a SchedulingConfig from a parsed YAML mapping using the reference's
+    key names (config/scheduler/config.yaml `scheduling:` block)."""
+    kw: dict = {}
+    if "supportedResourceTypes" in d:
+        kw["supported_resource_types"] = tuple(
+            (r["name"], str(r.get("resolution", "1"))) for r in d["supportedResourceTypes"]
+        )
+    if "pools" in d:
+        kw["pools"] = tuple(
+            PoolConfig(p["name"], tuple(p.get("awayPools", []))) for p in d["pools"]
+        )
+    if "priorityClasses" in d:
+        kw["priority_classes"] = _parse_priority_classes(d["priorityClasses"])
+    for yaml_key, attr in [
+        ("defaultPriorityClassName", "default_priority_class"),
+        ("protectedFractionOfFairShare", "protected_fraction_of_fair_share"),
+        ("maxQueueLookback", "max_queue_lookback"),
+        ("maximumSchedulingBurst", "maximum_scheduling_burst"),
+        ("maximumPerQueueSchedulingBurst", "maximum_per_queue_scheduling_burst"),
+        ("maximumSchedulingRate", "maximum_scheduling_rate"),
+        ("maximumPerQueueSchedulingRate", "maximum_per_queue_scheduling_rate"),
+        ("maxRetries", "max_retries"),
+        ("nodeIdLabel", "node_id_label"),
+        ("enableAssertions", "enable_assertions"),
+    ]:
+        if yaml_key in d:
+            kw[attr] = d[yaml_key]
+    if "dominantResourceFairnessResourcesToConsider" in d:
+        kw["dominant_resource_fairness_resources"] = tuple(
+            d["dominantResourceFairnessResourcesToConsider"]
+        )
+    if "maximumResourceFractionToSchedule" in d:
+        kw["maximum_resource_fraction_to_schedule"] = dict(
+            d["maximumResourceFractionToSchedule"]
+        )
+    if "indexedNodeLabels" in d:
+        kw["indexed_node_labels"] = tuple(d["indexedNodeLabels"])
+    if "indexedTaints" in d:
+        kw["indexed_taints"] = tuple(d["indexedTaints"])
+    return SchedulingConfig(**kw)
+
+
+def scheduling_config_from_yaml(path: str) -> SchedulingConfig:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if "scheduling" in doc:
+        doc = doc["scheduling"]
+    return scheduling_config_from_dict(doc)

@@ -1,0 +1,146 @@
+"""Categorical compression: node types and scheduling keys.
+
+The reference collapses nodes into `NodeType`s -- the hash of (taints, indexed
+labels) -- so that taint/label fit is checked once per (job, nodeType) instead of per
+(job, node) (internaltypes/node_type.go; nodedb/nodematching.go:127-145), and
+collapses jobs into `SchedulingKey`s -- the hash of everything that affects where a
+job can run (internaltypes/podutils.go SchedulingKeyGenerator) -- used both to skip
+identical unfeasible jobs (gang_scheduler.go:64-98) and to cache submit checks
+(submitcheck.go:243).
+
+Here the same idea becomes the device-side representation: the (key x type) static
+fit matrix is precomputed on host with exact string matching, and on device fit is a
+single gather `compat[job_key, node_type]` -- no string ever reaches the TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from armada_tpu.core.types import (
+    JobSpec,
+    NodeSpec,
+    Taint,
+    Toleration,
+    selector_matches,
+    taints_tolerated,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeType:
+    """Identity of a class of nodes indistinguishable to static fit checks."""
+
+    taints: tuple[Taint, ...]
+    indexed_labels: tuple[tuple[str, str], ...]  # sorted (label, value) pairs
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulingKey:
+    """Identity of a class of jobs indistinguishable to the scheduler."""
+
+    resources: tuple[int, ...]  # atoms, fixed axis order
+    node_selector: tuple[tuple[str, str], ...]
+    tolerations: tuple[Toleration, ...]
+    priority_class: str
+    priority: int
+
+
+class NodeTypeIndex:
+    """Assigns each node a dense node-type id; built per round on host."""
+
+    def __init__(self, indexed_labels: Sequence[str]):
+        self.indexed_labels = tuple(sorted(set(indexed_labels)))
+        self.types: list[NodeType] = []
+        self._ids: dict[NodeType, int] = {}
+
+    def type_of(self, node: NodeSpec) -> int:
+        labels = tuple(
+            (k, node.labels[k]) for k in self.indexed_labels if k in node.labels
+        )
+        nt = NodeType(tuple(node.taints), labels)
+        tid = self._ids.get(nt)
+        if tid is None:
+            tid = len(self.types)
+            self.types.append(nt)
+            self._ids[nt] = tid
+        return tid
+
+    def __len__(self) -> int:
+        return len(self.types)
+
+
+class SchedulingKeyIndex:
+    """Assigns each job a dense scheduling-key id; built per round on host."""
+
+    def __init__(self):
+        self.keys: list[SchedulingKey] = []
+        self._ids: dict[SchedulingKey, int] = {}
+
+    def key_of(self, job: JobSpec, node_id_label: str = "kubernetes.io/hostname") -> int:
+        # The node-id pinning label is excluded: pinning is handled positionally via
+        # the pinned-node tensor, the way the reference injects node-id selectors
+        # for evicted jobs (internal/scheduler/api.go addNodeIdSelector:278).
+        selector = tuple(
+            sorted((k, v) for k, v in job.node_selector.items() if k != node_id_label)
+        )
+        key = SchedulingKey(
+            resources=tuple(int(a) for a in job.resources.atoms) if job.resources else (),
+            node_selector=selector,
+            tolerations=tuple(job.tolerations),
+            priority_class=job.priority_class,
+            priority=job.priority,
+        )
+        kid = self._ids.get(key)
+        if kid is None:
+            kid = len(self.keys)
+            self.keys.append(key)
+            self._ids[key] = kid
+        return kid
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+
+def static_fit_matrix(
+    keys: Sequence[SchedulingKey],
+    types: Sequence[NodeType],
+    unindexed_ok: bool = False,
+) -> np.ndarray:
+    """bool[K, T]: does job-class k statically fit node-class t?
+
+    Static fit = tolerations cover the type's blocking taints AND the selector is
+    satisfied by the type's indexed labels (nodematching.go NodeTypeJobRequirementsMet
+    :127 + StaticJobRequirementsMet:161).  A selector naming a label that is not
+    indexed can never match unless `unindexed_ok` (callers should index every label
+    referenced by a selector; the builder does).
+    """
+    out = np.zeros((len(keys), len(types)), dtype=bool)
+    for ki, key in enumerate(keys):
+        sel = dict(key.node_selector)
+        for ti, nt in enumerate(types):
+            if not taints_tolerated(nt.taints, key.tolerations):
+                continue
+            labels = dict(nt.indexed_labels)
+            if unindexed_ok:
+                ok = all(labels.get(k, v) == v for k, v in sel.items())
+            else:
+                ok = selector_matches(sel, labels)
+            if ok:
+                out[ki, ti] = True
+    return out
+
+
+def labels_referenced_by_selectors(
+    jobs: Sequence[JobSpec], node_id_label: str
+) -> set[str]:
+    """Labels that must be folded into node types for exact static fit."""
+    out: set[str] = set()
+    for job in jobs:
+        for k in job.node_selector:
+            if k != node_id_label:
+                out.add(k)
+    return out

@@ -1,0 +1,220 @@
+"""Exact host-side resource vectors and their quantization to device units.
+
+Plays the role of the reference's `internaltypes.ResourceList`
+(/root/reference/internal/scheduler/internaltypes/resource_list.go:22-33): a fixed-order
+vector of int64 quantities interpreted through a shared factory, with arithmetic
+(Add/Subtract/Cap/Multiply), dominant-resource comparison, and floor/ceil quantization to
+per-resource *resolution units* (resource_list.go:225-310; resolution rounding as in
+nodedb.go:91-103).
+
+Design difference from the reference: quantization is not just an indexing trick here --
+it is the bridge onto the TPU.  Device tensors hold resolution units as float32 (kept
+integral and small enough to be exact in a 24-bit mantissa), so fit comparisons on the
+VPU are exact while DRF cost math stays in fast float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+# Kubernetes-style quantity suffixes -> multiplier, expressed such that parsing
+# "100m" cpu yields exact milli-units.  We canonicalise every resource to an int64
+# "atom" count where one atom is 1/1000 of the base unit (so cpu "1" = 1000 atoms,
+# memory "1" = 1000 atoms); this makes "m" exact and keeps Ki/Mi/Gi exact too.
+_ATOMS_PER_UNIT = 1000
+_SUFFIX = {
+    "": 1.0,
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "Ki": 2.0**10,
+    "Mi": 2.0**20,
+    "Gi": 2.0**30,
+    "Ti": 2.0**40,
+    "Pi": 2.0**50,
+}
+
+_QUANTITY_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([A-Za-z]*)\s*$")
+
+
+def parse_quantity(q: "str | int | float") -> int:
+    """Parse a Kubernetes-style quantity into int64 atoms (1 atom = 1/1000 base unit)."""
+    if isinstance(q, bool):
+        raise ValueError(f"invalid quantity: {q!r}")
+    if isinstance(q, (int, np.integer)):
+        return int(q) * _ATOMS_PER_UNIT
+    if isinstance(q, float):
+        return round(q * _ATOMS_PER_UNIT)
+    m = _QUANTITY_RE.match(q)
+    if not m:
+        raise ValueError(f"invalid quantity: {q!r}")
+    value, suffix = m.groups()
+    if suffix not in _SUFFIX:
+        raise ValueError(f"invalid quantity suffix: {q!r}")
+    return round(float(value) * _SUFFIX[suffix] * _ATOMS_PER_UNIT)
+
+
+def format_quantity(atoms: int) -> str:
+    if atoms % _ATOMS_PER_UNIT == 0:
+        return str(atoms // _ATOMS_PER_UNIT)
+    return f"{atoms / _ATOMS_PER_UNIT:g}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceListFactory:
+    """Shared registry fixing the order, names and resolutions of resources.
+
+    Mirrors `internaltypes.ResourceListFactory` (resource_list_factory.go): every
+    ResourceList produced by one factory shares the same axis order, so vectors add
+    positionally.  `resolutions` holds atoms-per-resolution-unit for each resource
+    (from config `supportedResourceTypes[].resolution`,
+    /root/reference/config/scheduler/config.yaml:73-82).
+    """
+
+    names: tuple[str, ...]
+    resolutions: tuple[int, ...]  # atoms per device resolution unit
+
+    def __post_init__(self):
+        if len(self.names) != len(set(self.names)):
+            raise ValueError(f"duplicate resource names: {self.names}")
+        if len(self.resolutions) != len(self.names):
+            raise ValueError("resolutions must match names")
+        if any(r <= 0 for r in self.resolutions):
+            raise ValueError(f"resolutions must be positive: {self.resolutions}")
+
+    @staticmethod
+    def from_config(resource_types: Sequence[tuple[str, "str | int"]]) -> "ResourceListFactory":
+        names = tuple(name for name, _ in resource_types)
+        resolutions = tuple(parse_quantity(res) for _, res in resource_types)
+        return ResourceListFactory(names, resolutions)
+
+    @property
+    def num_resources(self) -> int:
+        return len(self.names)
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    def from_mapping(self, quantities: Mapping[str, "str | int | float"]) -> "ResourceList":
+        vec = np.zeros(len(self.names), dtype=np.int64)
+        for name, q in quantities.items():
+            if name not in self.names:
+                # Unsupported resources are dropped, as in the reference factory
+                # (resource_list_factory.go FromJobResourceListIgnoreUnknown).
+                continue
+            vec[self.index_of(name)] = parse_quantity(q)
+        return ResourceList(self, vec)
+
+    def zero(self) -> "ResourceList":
+        return ResourceList(self, np.zeros(len(self.names), dtype=np.int64))
+
+    def from_atoms(self, atoms: np.ndarray) -> "ResourceList":
+        atoms = np.asarray(atoms, dtype=np.int64)
+        if atoms.shape != (len(self.names),):
+            raise ValueError(f"bad shape {atoms.shape}")
+        return ResourceList(self, atoms.copy())
+
+    # --- quantization to device resolution units -------------------------------
+    def floor_units(self, atoms: np.ndarray) -> np.ndarray:
+        """Round down to resolution units (node allocatable: conservative)."""
+        res = np.asarray(self.resolutions, dtype=np.int64)
+        return (np.asarray(atoms, dtype=np.int64) // res).astype(np.int64)
+
+    def ceil_units(self, atoms: np.ndarray) -> np.ndarray:
+        """Round up to resolution units (job requests: conservative)."""
+        res = np.asarray(self.resolutions, dtype=np.int64)
+        a = np.asarray(atoms, dtype=np.int64)
+        return -((-a) // res)
+
+    def multipliers_for(self, names_to_mult: Mapping[str, float]) -> np.ndarray:
+        """Per-resource DRF multipliers in *unit* space.
+
+        DRF cost divides allocation by total per-resource, so the resolution scale
+        cancels; multipliers map straight through (fairness.go:99-103).
+        """
+        out = np.zeros(len(self.names), dtype=np.float64)
+        for name, mult in names_to_mult.items():
+            if name in self.names:
+                out[self.index_of(name)] = mult
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceList:
+    """Immutable exact resource vector (int64 atoms) bound to a factory.
+
+    Mirrors `internaltypes.ResourceList` semantics: arithmetic, dominance checks.
+    """
+
+    factory: ResourceListFactory
+    atoms: np.ndarray  # int64[R]
+
+    def _check(self, other: "ResourceList"):
+        if other.factory is not self.factory and other.factory != self.factory:
+            raise ValueError("resource lists from different factories")
+
+    def add(self, other: "ResourceList") -> "ResourceList":
+        self._check(other)
+        return ResourceList(self.factory, self.atoms + other.atoms)
+
+    def subtract(self, other: "ResourceList") -> "ResourceList":
+        self._check(other)
+        return ResourceList(self.factory, self.atoms - other.atoms)
+
+    def multiply_scalar(self, k: int) -> "ResourceList":
+        return ResourceList(self.factory, self.atoms * int(k))
+
+    def cap(self, other: "ResourceList") -> "ResourceList":
+        self._check(other)
+        return ResourceList(self.factory, np.minimum(self.atoms, other.atoms))
+
+    def exceeds(self, other: "ResourceList") -> bool:
+        """True if any component of self > other (resource_list.go Exceeds:172)."""
+        self._check(other)
+        return bool(np.any(self.atoms > other.atoms))
+
+    def fits_within(self, other: "ResourceList") -> bool:
+        return not self.exceeds(other)
+
+    def all_zero(self) -> bool:
+        return bool(np.all(self.atoms == 0))
+
+    def is_empty(self) -> bool:
+        return self.all_zero()
+
+    def has_negative(self) -> bool:
+        return bool(np.any(self.atoms < 0))
+
+    def get(self, name: str) -> int:
+        return int(self.atoms[self.factory.index_of(name)])
+
+    def to_dict(self) -> dict[str, str]:
+        return {
+            name: format_quantity(int(a))
+            for name, a in zip(self.factory.names, self.atoms)
+            if a != 0
+        }
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ResourceList)
+            and self.factory == other.factory
+            and bool(np.array_equal(self.atoms, other.atoms))
+        )
+
+    def __repr__(self) -> str:
+        return f"ResourceList({self.to_dict()})"
+
+
+def sum_resource_lists(factory: ResourceListFactory, rls: Iterable[ResourceList]) -> ResourceList:
+    total = np.zeros(factory.num_resources, dtype=np.int64)
+    for rl in rls:
+        total += rl.atoms
+    return ResourceList(factory, total)

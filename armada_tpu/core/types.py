@@ -1,0 +1,121 @@
+"""Host-side domain objects: nodes, jobs, queues, taints/tolerations.
+
+Equivalent surface to the reference's `internaltypes.Node` (internaltypes/node.go),
+`jobdb.Job` (jobdb/job.go) scheduling-relevant fields, and `api.Queue`.  These are
+plain frozen dataclasses; the scheduler never mutates them -- mirroring the
+reference's immutability discipline (jobdb/jobdb.go:67, resource_list.go:23-24).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+from armada_tpu.core.resources import ResourceList
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    """Kubernetes node taint (only NoSchedule/NoExecute block scheduling)."""
+
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | NoExecute | PreferNoSchedule
+
+
+@dataclasses.dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty tolerates all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+def taints_tolerated(taints: Sequence[Taint], tolerations: Sequence[Toleration]) -> bool:
+    """True if every blocking taint is tolerated (nodematching.go:127-145)."""
+    for taint in taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return False
+    return True
+
+
+def selector_matches(selector: Mapping[str, str], labels: Mapping[str, str]) -> bool:
+    """Node-selector match: every selector entry must equal the node label
+    (nodematching.go StaticJobRequirementsMet:161-194)."""
+    for k, v in selector.items():
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeSpec:
+    """A schedulable node (internaltypes/node.go).
+
+    `running` / allocation state lives in the scheduler's state tensors, not here.
+    """
+
+    id: str
+    pool: str = "default"
+    executor: str = ""
+    total_resources: Optional[ResourceList] = None
+    taints: tuple[Taint, ...] = ()
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    unschedulable: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """A job as the scheduler sees it (jobdb/job.go scheduling-relevant subset).
+
+    `priority` is the user-settable queue priority (smaller schedules first, like the
+    reference's job priority); `priority_class` determines the node-contention
+    priority and preemptibility.  Gang semantics via gang_id/gang_cardinality
+    annotations (docs/scheduling_and_preempting_jobs.md:101-107).
+    """
+
+    id: str
+    queue: str
+    jobset: str = ""
+    priority_class: str = ""
+    priority: int = 0
+    submit_time: float = 0.0
+    resources: Optional[ResourceList] = None
+    node_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: tuple[Toleration, ...] = ()
+    gang_id: str = ""
+    gang_cardinality: int = 1
+    gang_node_uniformity_label: str = ""
+    pools: tuple[str, ...] = ()  # pools the job may schedule in; empty = all
+
+
+@dataclasses.dataclass(frozen=True)
+class Queue:
+    """A queue with a fair-share weight (pkg/api Queue; fairness.go Queue iface)."""
+
+    name: str
+    weight: float = 1.0
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"queue {self.name}: weight must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class RunningJob:
+    """A job currently bound to a node, as input to a scheduling round
+    (the reference reconstructs this from jobdb runs, scheduling_algo.go:331-465)."""
+
+    job: JobSpec
+    node_id: str
+    # Priority at which its resources are held (normally its PC priority).
+    priority: int = 0

@@ -1,0 +1,14 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax is imported.
+
+Sharding/collective paths are validated on virtual CPU devices, mirroring how the
+driver dry-runs the multi-chip path (xla_force_host_platform_device_count); real-TPU
+execution is covered by bench.py on hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

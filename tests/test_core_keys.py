@@ -1,0 +1,98 @@
+from armada_tpu.core.config import default_scheduling_config
+from armada_tpu.core.keys import (
+    NodeTypeIndex,
+    SchedulingKeyIndex,
+    labels_referenced_by_selectors,
+    static_fit_matrix,
+)
+from armada_tpu.core.types import (
+    JobSpec,
+    NodeSpec,
+    Taint,
+    Toleration,
+    selector_matches,
+    taints_tolerated,
+)
+
+
+def _factory():
+    return default_scheduling_config().resource_list_factory()
+
+
+def test_toleration_matching():
+    taint = Taint("gpu", "true", "NoSchedule")
+    assert Toleration("gpu", "Equal", "true").tolerates(taint)
+    assert Toleration("gpu", "Exists").tolerates(taint)
+    assert Toleration(operator="Exists").tolerates(taint)
+    assert not Toleration("gpu", "Equal", "false").tolerates(taint)
+    assert not Toleration("gpu", "Equal", "true", effect="NoExecute").tolerates(taint)
+    # PreferNoSchedule never blocks.
+    assert taints_tolerated([Taint("x", "y", "PreferNoSchedule")], [])
+    assert not taints_tolerated([taint], [])
+
+
+def test_selector_matching():
+    assert selector_matches({"zone": "a"}, {"zone": "a", "arch": "amd64"})
+    assert not selector_matches({"zone": "b"}, {"zone": "a"})
+    assert not selector_matches({"missing": ""}, {"zone": "a"})
+
+
+def test_node_type_dedup():
+    idx = NodeTypeIndex(indexed_labels=["zone"])
+    n1 = NodeSpec("n1", labels={"zone": "a", "ignored": "x"})
+    n2 = NodeSpec("n2", labels={"zone": "a", "ignored": "y"})
+    n3 = NodeSpec("n3", labels={"zone": "b"})
+    n4 = NodeSpec("n4", labels={"zone": "a"}, taints=(Taint("gpu", "t", "NoSchedule"),))
+    assert idx.type_of(n1) == idx.type_of(n2)
+    assert idx.type_of(n3) != idx.type_of(n1)
+    assert idx.type_of(n4) != idx.type_of(n1)
+    assert len(idx) == 3
+
+
+def test_scheduling_key_dedup_and_pinning_exclusion():
+    f = _factory()
+    idx = SchedulingKeyIndex()
+    j1 = JobSpec("a", "q", resources=f.from_mapping({"cpu": "1"}))
+    j2 = JobSpec("b", "q", resources=f.from_mapping({"cpu": "1"}))
+    j3 = JobSpec("c", "q", resources=f.from_mapping({"cpu": "2"}))
+    # Same as j1 but pinned to a node: pinning label must not split the key.
+    j4 = JobSpec(
+        "d",
+        "q",
+        resources=f.from_mapping({"cpu": "1"}),
+        node_selector={"kubernetes.io/hostname": "n1"},
+    )
+    assert idx.key_of(j1) == idx.key_of(j2) == idx.key_of(j4)
+    assert idx.key_of(j3) != idx.key_of(j1)
+
+
+def test_static_fit_matrix():
+    f = _factory()
+    jobs = [
+        JobSpec("plain", "q", resources=f.from_mapping({"cpu": "1"})),
+        JobSpec(
+            "gpu",
+            "q",
+            resources=f.from_mapping({"cpu": "1"}),
+            tolerations=(Toleration("gpu", "Exists"),),
+            node_selector={"zone": "a"},
+        ),
+    ]
+    nodes = [
+        NodeSpec("cpu-a", labels={"zone": "a"}),
+        NodeSpec("gpu-a", labels={"zone": "a"}, taints=(Taint("gpu", "t", "NoSchedule"),)),
+        NodeSpec("gpu-b", labels={"zone": "b"}, taints=(Taint("gpu", "t", "NoSchedule"),)),
+    ]
+    labels = {"zone"} | labels_referenced_by_selectors(jobs, "kubernetes.io/hostname")
+    ntidx = NodeTypeIndex(labels)
+    types = [ntidx.type_of(n) for n in nodes]
+    kidx = SchedulingKeyIndex()
+    keys = [kidx.key_of(j) for j in jobs]
+    compat = static_fit_matrix(kidx.keys, ntidx.types)
+    # plain job fits everywhere untainted
+    assert compat[keys[0], types[0]]
+    assert not compat[keys[0], types[1]]  # untolerated taint
+    # gpu job needs zone=a and tolerates the taint
+    assert compat[keys[1], types[1]]
+    assert not compat[keys[1], types[2]]  # wrong zone
+    assert compat[keys[1], types[0]]  # tolerating is not requiring

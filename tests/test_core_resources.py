@@ -1,0 +1,65 @@
+import numpy as np
+import pytest
+
+from armada_tpu.core.resources import (
+    ResourceListFactory,
+    parse_quantity,
+    format_quantity,
+)
+
+
+@pytest.fixture
+def factory():
+    return ResourceListFactory.from_config(
+        [("memory", "1"), ("cpu", "1m"), ("nvidia.com/gpu", "1")]
+    )
+
+
+def test_parse_quantity():
+    assert parse_quantity("1") == 1000
+    assert parse_quantity("100m") == 100
+    assert parse_quantity("1Ki") == 1024 * 1000
+    assert parse_quantity("2Gi") == 2 * 2**30 * 1000
+    assert parse_quantity(4) == 4000
+    assert parse_quantity("1.5") == 1500
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+def test_format_roundtrip():
+    assert format_quantity(parse_quantity("16")) == "16"
+    assert format_quantity(parse_quantity("100m")) == "0.1"
+
+
+def test_arithmetic(factory):
+    a = factory.from_mapping({"cpu": "2", "memory": "4Gi"})
+    b = factory.from_mapping({"cpu": "500m", "memory": "1Gi"})
+    c = a.subtract(b)
+    assert c.get("cpu") == parse_quantity("1500m")
+    assert a.add(b).get("memory") == parse_quantity("5Gi")
+    assert not b.exceeds(a)
+    assert a.exceeds(b)
+    assert b.fits_within(a)
+
+
+def test_unknown_resources_dropped(factory):
+    rl = factory.from_mapping({"cpu": "1", "fancy-fpga": "3"})
+    assert rl.get("cpu") == 1000
+    assert "fancy-fpga" not in rl.to_dict()
+
+
+def test_quantization_floor_ceil(factory):
+    # cpu resolution 1m -> atoms per unit 1; memory resolution "1" -> 1000 atoms.
+    rl = factory.from_mapping({"cpu": "1500m", "memory": "1.5"})
+    floor = factory.floor_units(rl.atoms)
+    ceil = factory.ceil_units(rl.atoms)
+    mem_i, cpu_i = factory.index_of("memory"), factory.index_of("cpu")
+    assert floor[cpu_i] == 1500 and ceil[cpu_i] == 1500
+    assert floor[mem_i] == 1 and ceil[mem_i] == 2
+
+
+def test_multipliers(factory):
+    m = factory.multipliers_for({"cpu": 1.0, "nvidia.com/gpu": 2.0})
+    assert m[factory.index_of("cpu")] == 1.0
+    assert m[factory.index_of("nvidia.com/gpu")] == 2.0
+    assert m[factory.index_of("memory")] == 0.0
