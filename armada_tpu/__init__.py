@@ -15,7 +15,12 @@ executed on TPU.  See SURVEY.md section 7 for the blueprint.
 __version__ = "0.1.0"
 
 from armada_tpu.core.resources import ResourceListFactory, ResourceList
-from armada_tpu.core.config import SchedulingConfig, PriorityClass, default_scheduling_config
+from armada_tpu.core.config import (
+    SchedulingConfig,
+    PriorityClass,
+    default_scheduling_config,
+    scheduling_config_from_yaml,
+)
 
 __all__ = [
     "ResourceListFactory",
@@ -23,5 +28,6 @@ __all__ = [
     "SchedulingConfig",
     "PriorityClass",
     "default_scheduling_config",
+    "scheduling_config_from_yaml",
     "__version__",
 ]

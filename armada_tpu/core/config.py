@@ -99,6 +99,33 @@ class SchedulingConfig:
     # bound jit recompilation (ours; no reference equivalent -- Go has no shapes).
     shape_bucket: int = 256
 
+    def __hash__(self):
+        # Mapping-typed fields are canonicalised so the config can key jit caches.
+        return hash(
+            (
+                self.supported_resource_types,
+                self.pools,
+                tuple(sorted(self.priority_classes)),
+                tuple(
+                    (pc.name, pc.priority, pc.preemptible,
+                     tuple(sorted(pc.maximum_resource_fraction_per_queue.items())))
+                    for pc in (self.priority_classes[k] for k in sorted(self.priority_classes))
+                ),
+                self.default_priority_class,
+                self.dominant_resource_fairness_resources,
+                self.protected_fraction_of_fair_share,
+                self.max_queue_lookback,
+                self.maximum_scheduling_burst,
+                self.maximum_per_queue_scheduling_burst,
+                tuple(sorted(self.maximum_resource_fraction_to_schedule.items())),
+                self.max_retries,
+                self.indexed_node_labels,
+                self.indexed_taints,
+                self.node_id_label,
+                self.shape_bucket,
+            )
+        )
+
     def resource_list_factory(self) -> ResourceListFactory:
         return ResourceListFactory.from_config(self.supported_resource_types)
 

@@ -108,28 +108,23 @@ class SchedulingKeyIndex:
 def static_fit_matrix(
     keys: Sequence[SchedulingKey],
     types: Sequence[NodeType],
-    unindexed_ok: bool = False,
 ) -> np.ndarray:
     """bool[K, T]: does job-class k statically fit node-class t?
 
     Static fit = tolerations cover the type's blocking taints AND the selector is
     satisfied by the type's indexed labels (nodematching.go NodeTypeJobRequirementsMet
-    :127 + StaticJobRequirementsMet:161).  A selector naming a label that is not
-    indexed can never match unless `unindexed_ok` (callers should index every label
-    referenced by a selector; the builder does).
+    :127 + StaticJobRequirementsMet:161).  Callers must index every label referenced
+    by a selector (the problem builder does, via labels_referenced_by_selectors);
+    a selector naming an unindexed label never matches.
     """
     out = np.zeros((len(keys), len(types)), dtype=bool)
+    type_labels = [dict(nt.indexed_labels) for nt in types]
     for ki, key in enumerate(keys):
         sel = dict(key.node_selector)
         for ti, nt in enumerate(types):
             if not taints_tolerated(nt.taints, key.tolerations):
                 continue
-            labels = dict(nt.indexed_labels)
-            if unindexed_ok:
-                ok = all(labels.get(k, v) == v for k, v in sel.items())
-            else:
-                ok = selector_matches(sel, labels)
-            if ok:
+            if selector_matches(sel, type_labels[ti]):
                 out[ki, ti] = True
     return out
 

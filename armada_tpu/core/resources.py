@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+from fractions import Fraction
 from typing import Iterable, Mapping, Sequence
 
 import numpy as np
@@ -27,25 +28,32 @@ import numpy as np
 # memory "1" = 1000 atoms); this makes "m" exact and keeps Ki/Mi/Gi exact too.
 _ATOMS_PER_UNIT = 1000
 _SUFFIX = {
-    "": 1.0,
-    "m": 1e-3,
-    "k": 1e3,
-    "M": 1e6,
-    "G": 1e9,
-    "T": 1e12,
-    "P": 1e15,
-    "Ki": 2.0**10,
-    "Mi": 2.0**20,
-    "Gi": 2.0**30,
-    "Ti": 2.0**40,
-    "Pi": 2.0**50,
+    "": Fraction(1),
+    "m": Fraction(1, 1000),
+    "k": Fraction(10**3),
+    "M": Fraction(10**6),
+    "G": Fraction(10**9),
+    "T": Fraction(10**12),
+    "P": Fraction(10**15),
+    "E": Fraction(10**18),
+    "Ki": Fraction(2**10),
+    "Mi": Fraction(2**20),
+    "Gi": Fraction(2**30),
+    "Ti": Fraction(2**40),
+    "Pi": Fraction(2**50),
+    "Ei": Fraction(2**60),
 }
 
-_QUANTITY_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([A-Za-z]*)\s*$")
+_QUANTITY_RE = re.compile(
+    r"^\s*([0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)\s*([A-Za-z]{0,2})\s*$"
+)
 
 
 def parse_quantity(q: "str | int | float") -> int:
-    """Parse a Kubernetes-style quantity into int64 atoms (1 atom = 1/1000 base unit)."""
+    """Parse a Kubernetes-style quantity into int64 atoms (1 atom = 1/1000 base
+    unit).  Exact: the string path goes through Fraction, never float, so every
+    spelling of a quantity yields identical atoms.  Supports decimal (k/M/G/...),
+    binary (Ki/Mi/...) suffixes and scientific notation ('1e3')."""
     if isinstance(q, bool):
         raise ValueError(f"invalid quantity: {q!r}")
     if isinstance(q, (int, np.integer)):
@@ -56,15 +64,21 @@ def parse_quantity(q: "str | int | float") -> int:
     if not m:
         raise ValueError(f"invalid quantity: {q!r}")
     value, suffix = m.groups()
+    # 'e'/'E' in the number part is scientific notation, not a suffix; the regex
+    # keeps it with the value.
     if suffix not in _SUFFIX:
         raise ValueError(f"invalid quantity suffix: {q!r}")
-    return round(float(value) * _SUFFIX[suffix] * _ATOMS_PER_UNIT)
+    frac = Fraction(value) * _SUFFIX[suffix] * _ATOMS_PER_UNIT
+    return round(frac)
 
 
 def format_quantity(atoms: int) -> str:
-    if atoms % _ATOMS_PER_UNIT == 0:
-        return str(atoms // _ATOMS_PER_UNIT)
-    return f"{atoms / _ATOMS_PER_UNIT:g}"
+    """Exact decimal rendering of atoms, re-parseable by parse_quantity."""
+    sign = "-" if atoms < 0 else ""
+    whole, rem = divmod(abs(atoms), _ATOMS_PER_UNIT)
+    if rem == 0:
+        return f"{sign}{whole}"
+    return f"{sign}{whole}.{rem:03d}".rstrip("0")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,60 @@
+"""Scheduling models: the tensorised scheduling round.
+
+`problem` builds dense device tensors from host job/node/queue objects;
+`fair_scheduler` is the jitted round kernel -- the TPU-native replacement for the
+reference's PreemptingQueueScheduler -> QueueScheduler -> GangScheduler -> NodeDb
+pipeline (internal/scheduler/scheduling/*.go).
+"""
+
+from armada_tpu.models.problem import (
+    SchedulingProblem,
+    HostContext,
+    build_problem,
+    decode_result,
+    RoundOutcome,
+)
+from armada_tpu.models.fair_scheduler import schedule_round, RoundResult
+
+
+def run_scheduling_round(
+    config,
+    *,
+    pool,
+    nodes,
+    queues,
+    queued_jobs,
+    running=(),
+):
+    """Convenience host API: build the dense problem, run the jitted round on
+    device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
+    one pool (scheduling_algo.go SchedulePool:574)."""
+    import jax.numpy as jnp
+
+    problem, ctx = build_problem(
+        config,
+        pool=pool,
+        nodes=nodes,
+        queues=queues,
+        queued_jobs=queued_jobs,
+        running=running,
+    )
+    device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    result = schedule_round(
+        device_problem,
+        num_levels=len(ctx.ladder) + 1,
+        max_slots=ctx.max_slots,
+        slot_width=ctx.slot_width,
+    )
+    return decode_result(result, ctx)
+
+
+__all__ = [
+    "run_scheduling_round",
+    "SchedulingProblem",
+    "HostContext",
+    "build_problem",
+    "decode_result",
+    "RoundOutcome",
+    "schedule_round",
+    "RoundResult",
+]

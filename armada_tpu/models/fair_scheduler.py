@@ -1,0 +1,478 @@
+"""The tensorised scheduling round: fair-share eviction + greedy placement +
+oversubscription repair, compiled as one XLA program.
+
+This kernel is the TPU-native replacement for the reference call chain
+PreemptingQueueScheduler.Schedule (preempting_queue_scheduler.go:108-300)
+-> QueueScheduler.Schedule (queue_scheduler.go:87) -> GangScheduler.Schedule
+(gang_scheduler.go:100) -> NodeDb.SelectNodeForJobWithTxn (nodedb.go:392).
+
+Structure (matching the reference's phases):
+  1. *Fair-share eviction*: queues whose DRF cost exceeds `protected_fraction` of
+     their fair share have their preemptible running jobs evicted -- usage moves to
+     the reserved evicted level 0, and their pinned re-scheduling candidates are
+     activated (pqs.go:117-160).
+  2. *Placement loop* (`lax.while_loop`): each iteration picks the queue whose
+     next gang yields the lowest proposed DRF cost (CostBasedCandidateGangIterator
+     Less, queue_scheduler.go:589-636, default ordering), then places that gang
+     all-or-nothing: clean fit first (at the evicted level, where evicted markers
+     still count -- nodedb.go:506-514), else urgency preemption at the gang's own
+     priority.  Failures of single-job gangs register a globally unfeasible
+     scheduling key, immediately retiring every identical pending job
+     (gang_scheduler.go:85-96).  Queue/global burst and resource caps mirror
+     constraints.go, except that exhausted caps block only *new* jobs here --
+     evicted jobs always keep their chance to re-schedule (strictly safer than the
+     reference's round termination).
+  3. *Oversubscription repair*: nodes driven negative at some priority by urgency
+     preemption evict their preemptible jobs at oversubscribed levels
+     (NewOversubscribedEvictor, eviction.go:130-180), which then re-schedule onto
+     their pinned nodes via a vectorised fixed-point (the reference's second
+     schedule pass over evicted jobs only, pqs.go:222-247).
+  4. Evicted jobs that did not make it back are preempted; their markers are
+     removed (the unbind step, pqs.go:286-296).
+
+Control flow is sequential-greedy to preserve the reference's ordering semantics,
+but every step inside an iteration is a dense vector op (fit masks over all nodes,
+segment-min over all gangs), so one iteration costs microseconds regardless of
+problem size, and the iteration count is bounded by gangs *attempted* (scheduled +
+distinct unfeasible keys + queue deactivations), not by queue length.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from armada_tpu.models.problem import SchedulingProblem
+from armada_tpu.ops.fairness import fair_shares, unweighted_drf_cost, weighted_drf_cost
+from armada_tpu.ops.fit import allocatable_from_used
+from armada_tpu.ops.packing import (
+    member_capacity,
+    node_packing_score,
+    select_best_node,
+    select_gang_nodes_compact,
+)
+
+_BIGI = jnp.int32(2**31 - 1)
+_INF = jnp.float32(3.0e38)
+
+TERM_EXHAUSTED = 0
+TERM_GLOBAL_BURST = 1
+TERM_ROUND_CAP = 2
+TERM_MAX_ITER = 3
+
+
+class RoundResult(NamedTuple):
+    g_state: jax.Array  # i32[G]: 0 not attempted, 1 scheduled, 2 failed/skipped
+    slot_gang: jax.Array  # i32[S]
+    slot_nodes: jax.Array  # i32[S, W]
+    slot_counts: jax.Array  # i32[S, W]
+    n_slots: jax.Array  # i32
+    run_evicted: jax.Array  # bool[RJ]
+    run_rescheduled: jax.Array  # bool[RJ]
+    alloc: jax.Array  # f32[P1, N, R] final allocatable-by-level
+    q_alloc: jax.Array  # f32[Q, R]
+    iterations: jax.Array  # i32
+    termination: jax.Array  # i32
+    scheduled_count: jax.Array  # i32 newly scheduled members
+
+
+class _Carry(NamedTuple):
+    alloc: jax.Array
+    q_alloc: jax.Array
+    q_alloc_pc: jax.Array
+    q_killed: jax.Array
+    q_sched: jax.Array
+    g_state: jax.Array
+    key_bad: jax.Array
+    run_rescheduled: jax.Array
+    slot_gang: jax.Array
+    slot_nodes: jax.Array
+    slot_counts: jax.Array
+    cursor: jax.Array
+    sched_count: jax.Array
+    sched_res: jax.Array
+    new_blocked: jax.Array
+    iterations: jax.Array
+    done: jax.Array
+    termination: jax.Array
+
+
+def _level_mask(num_levels: int, level, lo):
+    """bool[P1]: levels lo..level inclusive (the allocatable levels a binding at
+    `level` consumes; lo=1 when moving an evicted marker up, else 0)."""
+    lv = jnp.arange(num_levels, dtype=jnp.int32)
+    return (lv >= lo) & (lv <= level)
+
+
+def _move_runs_to_evicted(alloc, q_alloc, q_alloc_pc, p: SchedulingProblem, move, num_levels):
+    """Move usage of runs in `move` from their level to the evicted level 0.
+
+    Allocatable at levels 1..run_level gains the freed capacity; level 0 is
+    unchanged (the marker still counts against clean fit).  Queue allocation drops
+    (context eviction accounting, context/queue.go EvictJob).
+    """
+    delta = p.run_req * move[:, None]
+    lv = jnp.arange(num_levels, dtype=jnp.int32)
+    mask = ((lv[:, None] >= 1) & (lv[:, None] <= p.run_level[None, :])).astype(
+        jnp.float32
+    )  # [P1, RJ]
+    alloc = alloc.at[:, p.run_node, :].add(mask[:, :, None] * delta[None, :, :])
+    q_alloc = q_alloc.at[p.run_queue].add(-delta)
+    q_alloc_pc = q_alloc_pc.at[p.run_queue, p.run_pc].add(-delta)
+    return alloc, q_alloc, q_alloc_pc
+
+
+def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int, check_keys: bool):
+    G = p.g_req.shape[0]
+    N, R = p.node_total.shape
+    Q = p.q_weight.shape[0]
+    RJ = p.run_req.shape[0]
+
+    def body(c: _Carry) -> _Carry:
+        pending = (c.g_state == 0) & p.g_valid
+        is_new = p.g_run < 0
+        blocked = (c.new_blocked | c.q_killed[p.g_queue]) & is_new
+        eligible = pending & ~blocked
+
+        # --- per-queue candidate: lowest in-queue order among eligible gangs ----
+        order_masked = jnp.where(eligible, p.g_order, _BIGI)
+        qmin = jax.ops.segment_min(order_masked, p.g_queue, num_segments=Q)
+        has = qmin < _BIGI
+        is_cand = eligible & (p.g_order == qmin[p.g_queue])
+        cand = jax.ops.segment_min(
+            jnp.where(is_cand, jnp.arange(G, dtype=jnp.int32), _BIGI),
+            p.g_queue,
+            num_segments=Q,
+        )
+        cand = jnp.where(has, cand, 0)
+
+        # --- queue order: min proposed DRF cost (queue_scheduler.go Less:589) ---
+        req_tot_q = p.g_req[cand] * p.g_card[cand][:, None].astype(jnp.float32)
+        proposed = weighted_drf_cost(
+            c.q_alloc + req_tot_q, p.total_pool, p.drf_mult, p.q_weight
+        )
+        proposed = jnp.where(has, proposed, _INF)
+        qstar = jnp.argmin(proposed).astype(jnp.int32)
+        any_q = jnp.any(has)
+
+        g = cand[qstar]
+        req = p.g_req[g]
+        card = p.g_card[g]
+        cardf = card.astype(jnp.float32)
+        level = p.g_level[g]
+        key = p.g_key[g]
+        pc = p.g_pc[g]
+        run = p.g_run[g]
+        is_evictee = run >= 0
+        run_safe = jnp.where(is_evictee, run, RJ - 1)
+        pinned = jnp.where(is_evictee, p.run_node[run_safe], -1)
+        req_tot = req * cardf
+
+        # --- constraint gates (constraints.go:97-159); all gated on any_q so the
+        # --- dummy candidate of an exhausted round has no side effects ----------
+        unfeasible = any_q & check_keys & (key >= 0) & c.key_bad[jnp.maximum(key, 0)]
+        hit_burst = (~is_evictee) & (c.sched_count + card > p.global_burst)
+        hit_round_cap = (~is_evictee) & jnp.any(c.sched_res + req_tot > p.round_cap)
+        hit_q_burst = (~is_evictee) & (c.q_sched[qstar] + card > p.perq_burst)
+        hit_q_cap = (~is_evictee) & jnp.any(
+            c.q_alloc_pc[qstar, pc] + req_tot > p.pc_queue_cap[pc]
+        )
+        gate_global = (hit_burst | hit_round_cap) & any_q
+        gate_queue = (hit_q_burst | hit_q_cap) & ~gate_global & any_q
+        attempt = any_q & ~unfeasible & ~gate_global & ~gate_queue
+
+        # --- fit masks ----------------------------------------------------------
+        static_ok = jnp.where(key >= 0, p.compat[jnp.maximum(key, 0)][p.node_type], True)
+        pin_ok = jnp.where(
+            pinned >= 0, jnp.arange(N, dtype=jnp.int32) == pinned, True
+        )
+        ok_base = static_ok & p.node_ok & pin_ok
+        alloc_clean = c.alloc[0]
+        alloc_lvl = c.alloc[level]
+        # Capacity clipped to the gang cardinality: keeps int32 sums/cumsums exact
+        # (the builder rejects cardinalities large enough to overflow N * card).
+        cap_clean = jnp.where(ok_base, jnp.minimum(member_capacity(alloc_clean, req), card), 0)
+        cap_lvl = jnp.where(ok_base, jnp.minimum(member_capacity(alloc_lvl, req), card), 0)
+        use_clean = (~is_evictee) & (jnp.sum(cap_clean) >= card)
+        cap_sel = jnp.where(use_clean, cap_clean, cap_lvl)
+        alloc_sel = jnp.where(use_clean, alloc_clean, alloc_lvl)
+        score = node_packing_score(alloc_sel, p.inv_scale)
+        feasible = jnp.sum(cap_sel) >= card
+
+        def single_branch(_):
+            # Cheap path: one argmin, no sort (select_best_node semantics).
+            found, node = select_best_node(cap_sel >= 1, score)
+            nodes = jnp.full((slot_width,), N, jnp.int32).at[0].set(
+                jnp.where(found, node, N)
+            )
+            counts = jnp.zeros((slot_width,), jnp.int32).at[0].set(
+                found.astype(jnp.int32)
+            )
+            return nodes, counts
+
+        def gang_branch(_):
+            _, nodes, counts = select_gang_nodes_compact(
+                cap_sel >= 1, cap_sel, card, score, slot_width
+            )
+            return nodes, counts
+
+        nodes_w, counts_w = jax.lax.cond(card == 1, single_branch, gang_branch, None)
+
+        placed = attempt & feasible
+        place_f = placed.astype(jnp.float32)
+
+        # --- commit (all updates masked by `placed`) ----------------------------
+        lvl_lo = jnp.where(is_evictee, 1, 0)
+        lmask = _level_mask(num_levels, level, lvl_lo).astype(jnp.float32)
+        sub = counts_w[:, None].astype(jnp.float32) * req[None, :]  # [W, R]
+        delta = lmask[:, None, None] * sub[None, :, :] * place_f  # [P1, W, R]
+        alloc = c.alloc.at[:, nodes_w, :].add(-delta, mode="drop")
+        q_alloc = c.q_alloc.at[qstar].add(req_tot * place_f)
+        q_alloc_pc = c.q_alloc_pc.at[qstar, pc].add(req_tot * place_f)
+
+        new_sched = placed & ~is_evictee
+        sched_count = c.sched_count + jnp.where(new_sched, card, 0)
+        sched_res = c.sched_res + jnp.where(new_sched, req_tot, 0.0)
+        q_sched = c.q_sched.at[qstar].add(jnp.where(new_sched, card, 0))
+        run_rescheduled = c.run_rescheduled.at[run_safe].set(
+            jnp.where(is_evictee & placed, True, c.run_rescheduled[run_safe])
+        )
+
+        # slot recording for newly scheduled gangs (evictee placement is implied
+        # by run_rescheduled + its pinned node)
+        rec = new_sched
+        cur = c.cursor
+        slot_gang = c.slot_gang.at[cur].set(jnp.where(rec, g, c.slot_gang[cur]), mode="drop")
+        slot_nodes = c.slot_nodes.at[cur].set(
+            jnp.where(rec, nodes_w, c.slot_nodes[cur]), mode="drop"
+        )
+        slot_counts = c.slot_counts.at[cur].set(
+            jnp.where(rec, counts_w, c.slot_counts[cur]), mode="drop"
+        )
+        cursor = cur + rec.astype(jnp.int32)
+
+        # --- gang state + unfeasible-key registration ---------------------------
+        failed_fit = attempt & ~feasible
+        g_state = c.g_state.at[g].set(
+            jnp.where(placed, 1, jnp.where(failed_fit | unfeasible, 2, c.g_state[g]))
+        )
+        register = failed_fit & (card == 1) & (key >= 0) & jnp.bool_(check_keys)
+        key_bad = c.key_bad.at[jnp.maximum(key, 0)].set(
+            jnp.where(register, True, c.key_bad[jnp.maximum(key, 0)])
+        )
+        # retire every pending gang with the now-unfeasible key in one sweep
+        g_state = jnp.where(
+            register & (c.g_state == 0) & (p.g_key == key), 2, g_state
+        )
+
+        q_killed = c.q_killed.at[qstar].set(c.q_killed[qstar] | gate_queue)
+        new_blocked = c.new_blocked | gate_global
+        termination = jnp.where(
+            gate_global & (c.termination == TERM_EXHAUSTED),
+            jnp.where(hit_burst, TERM_GLOBAL_BURST, TERM_ROUND_CAP),
+            c.termination,
+        )
+        done = ~any_q
+
+        return _Carry(
+            alloc=alloc,
+            q_alloc=q_alloc,
+            q_alloc_pc=q_alloc_pc,
+            q_killed=q_killed,
+            q_sched=q_sched,
+            g_state=g_state,
+            key_bad=key_bad,
+            run_rescheduled=run_rescheduled,
+            slot_gang=slot_gang,
+            slot_nodes=slot_nodes,
+            slot_counts=slot_counts,
+            cursor=cursor,
+            sched_count=sched_count,
+            sched_res=sched_res,
+            new_blocked=new_blocked,
+            iterations=c.iterations + 1,
+            done=done,
+            termination=termination,
+        )
+
+    return body
+
+
+def _phase_b(p: SchedulingProblem, alloc, q_alloc, q_alloc_pc, run_evicted,
+             run_rescheduled, num_levels: int, max_fixpoint_iters: int = 128):
+    """Oversubscription repair + pinned re-scheduling fixed point."""
+    RJ, R = p.run_req.shape
+    N = p.node_total.shape[0]
+
+    # Oversubscribed levels per node: allocatable negative at a real level
+    # (eviction.go:146-156; level 0 = evicted priority is exempt).
+    over_lvl = jnp.any(alloc < 0, axis=-1)  # [P1, N]
+    over_lvl = over_lvl.at[0].set(False)
+    holds_slot = p.run_valid & (~run_evicted | run_rescheduled)
+    evict2 = (
+        holds_slot
+        & p.run_preemptible
+        & (p.run_gang >= 0)
+        & over_lvl[p.run_level, p.run_node]
+    )
+    alloc, q_alloc, q_alloc_pc = _move_runs_to_evicted(
+        alloc, q_alloc, q_alloc_pc, p, evict2.astype(jnp.float32), num_levels
+    )
+    run_evicted = run_evicted | evict2
+    run_rescheduled = run_rescheduled & ~evict2
+
+    # Pinned re-schedule fixed point: per iteration, each node admits its
+    # cheapest-queue evictee that fits (the second schedule pass, pqs.go:222-247).
+    def cond(state):
+        i, pending, _, _, _, progress = state
+        return (i < max_fixpoint_iters) & progress
+
+    def body(state):
+        i, pending, alloc, q_alloc, run_rescheduled, _ = state
+        alloc_at = alloc[p.run_level, p.run_node]  # [RJ, R]
+        fits = jnp.all(alloc_at >= p.run_req, axis=-1) & pending
+        cost = weighted_drf_cost(
+            q_alloc[p.run_queue] + p.run_req,
+            p.total_pool,
+            p.drf_mult,
+            p.q_weight[p.run_queue],
+        )
+        cost = jnp.where(fits, cost, _INF)
+        nmin = jax.ops.segment_min(cost, p.run_node, num_segments=N)
+        win = fits & (cost <= nmin[p.run_node])
+        ridx = jnp.where(win, jnp.arange(RJ, dtype=jnp.int32), _BIGI)
+        rmin = jax.ops.segment_min(ridx, p.run_node, num_segments=N)
+        win = win & (jnp.arange(RJ, dtype=jnp.int32) == rmin[p.run_node])
+
+        winf = win.astype(jnp.float32)
+        delta = p.run_req * winf[:, None]
+        lv = jnp.arange(num_levels, dtype=jnp.int32)
+        mask = ((lv[:, None] >= 1) & (lv[:, None] <= p.run_level[None, :])).astype(
+            jnp.float32
+        )
+        alloc = alloc.at[:, p.run_node, :].add(-mask[:, :, None] * delta[None, :, :])
+        q_alloc = q_alloc.at[p.run_queue].add(delta)
+        run_rescheduled = run_rescheduled | win
+        pending = pending & ~win
+        return (i + 1, pending, alloc, q_alloc, run_rescheduled, jnp.any(win))
+
+    state = (jnp.int32(0), evict2, alloc, q_alloc, run_rescheduled, jnp.any(evict2))
+    _, _, alloc, q_alloc, run_rescheduled, _ = jax.lax.while_loop(cond, body, state)
+    return alloc, q_alloc, run_evicted, run_rescheduled
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_levels", "max_slots", "slot_width", "max_iterations")
+)
+def schedule_round(
+    p: SchedulingProblem,
+    *,
+    num_levels: int,
+    max_slots: int,
+    slot_width: int,
+    max_iterations: int = 0,
+) -> RoundResult:
+    """Run one full scheduling round on device.
+
+    num_levels = priority-ladder length + 1 (level 0 = evicted marker level).
+    max_slots/slot_width size the placement record buffer (HostContext.max_slots /
+    .slot_width).  max_iterations=0 derives the safe bound #gangs + #queues + 8.
+    """
+    G = p.g_req.shape[0]
+    N, R = p.node_total.shape
+    Q = p.q_weight.shape[0]
+    C = p.pc_queue_cap.shape[0]
+    if max_iterations <= 0:
+        max_iterations = G + Q + 8
+
+    runf = p.run_valid.astype(jnp.float32)
+    used = jnp.zeros((num_levels, N, R), jnp.float32)
+    used = used.at[p.run_level, p.run_node].add(p.run_req * runf[:, None])
+    alloc = allocatable_from_used(p.node_total, used)
+    q_alloc = jnp.zeros((Q, R), jnp.float32).at[p.run_queue].add(p.run_req * runf[:, None])
+    q_alloc_pc = (
+        jnp.zeros((Q, C, R), jnp.float32)
+        .at[p.run_queue, p.run_pc]
+        .add(p.run_req * runf[:, None])
+    )
+
+    # --- fair-share eviction (pqs.go:117-160) ----------------------------------
+    shares = fair_shares(p.q_weight, p.q_cds)
+    actual = unweighted_drf_cost(q_alloc, p.total_pool, p.drf_mult)
+    fairsh = jnp.maximum(shares.demand_capped_adjusted_fair_share, shares.fair_share)
+    frac = jnp.where(fairsh > 0, actual / jnp.where(fairsh > 0, fairsh, 1.0), _INF)
+    over = (frac > p.protected_fraction) & (p.q_weight > 0)
+    run_evicted = p.run_valid & p.run_preemptible & over[p.run_queue] & (p.run_gang >= 0)
+    alloc, q_alloc, q_alloc_pc = _move_runs_to_evicted(
+        alloc, q_alloc, q_alloc_pc, p, run_evicted.astype(jnp.float32), num_levels
+    )
+
+    # --- gang activation: queued gangs pending; evictee slots pending iff evicted
+    evictee_active = jnp.where(
+        p.g_run >= 0, run_evicted[jnp.maximum(p.g_run, 0)], False
+    )
+    pending0 = p.g_valid & ((p.g_run < 0) | evictee_active)
+    g_state = jnp.where(pending0, 0, 2).astype(jnp.int32)
+    g_state = jnp.where(p.g_valid, g_state, 2)
+
+    carry = _Carry(
+        alloc=alloc,
+        q_alloc=q_alloc,
+        q_alloc_pc=q_alloc_pc,
+        q_killed=~(p.q_weight > 0),
+        q_sched=jnp.zeros((Q,), jnp.int32),
+        g_state=g_state,
+        key_bad=jnp.zeros((p.compat.shape[0],), bool),
+        run_rescheduled=jnp.zeros_like(run_evicted),
+        slot_gang=jnp.zeros((max_slots,), jnp.int32),
+        slot_nodes=jnp.full((max_slots, slot_width), N, jnp.int32),
+        slot_counts=jnp.zeros((max_slots, slot_width), jnp.int32),
+        cursor=jnp.int32(0),
+        sched_count=jnp.int32(0),
+        sched_res=jnp.zeros((R,), jnp.float32),
+        new_blocked=jnp.bool_(False),
+        iterations=jnp.int32(0),
+        done=jnp.bool_(False),
+        termination=jnp.int32(TERM_EXHAUSTED),
+    )
+
+    body = _make_place_iteration(p, num_levels, slot_width, check_keys=True)
+    carry = jax.lax.while_loop(
+        lambda c: (~c.done) & (c.iterations < max_iterations), body, carry
+    )
+    termination = jnp.where(
+        (~carry.done) & (carry.iterations >= max_iterations), TERM_MAX_ITER, carry.termination
+    )
+
+    # --- oversubscription repair + second pass ---------------------------------
+    alloc, q_alloc, run_evicted, run_rescheduled = _phase_b(
+        p,
+        carry.alloc,
+        carry.q_alloc,
+        carry.q_alloc_pc,
+        run_evicted,
+        carry.run_rescheduled,
+        num_levels,
+    )
+
+    # --- unbind preempted jobs: drop their evicted markers (pqs.go:286-296) ----
+    gone = (run_evicted & ~run_rescheduled).astype(jnp.float32)
+    alloc = alloc.at[0, p.run_node, :].add(p.run_req * gone[:, None])
+
+    return RoundResult(
+        g_state=carry.g_state,
+        slot_gang=carry.slot_gang,
+        slot_nodes=carry.slot_nodes,
+        slot_counts=carry.slot_counts,
+        n_slots=carry.cursor,
+        run_evicted=run_evicted,
+        run_rescheduled=run_rescheduled,
+        alloc=alloc,
+        q_alloc=q_alloc,
+        iterations=carry.iterations,
+        termination=termination,
+        scheduled_count=carry.sched_count,
+    )

@@ -1,0 +1,469 @@
+"""Building the dense scheduling problem and decoding round results.
+
+This is the host<->device boundary of the scheduling round: the equivalent of the
+reference's per-pool context construction (scheduling_algo.go
+newFairSchedulingAlgoContext:201, constructNodeDb:467, constructSchedulingContext:486)
+-- except the output is a pytree of padded tensors instead of a NodeDb + context tree.
+
+Layout conventions (see SURVEY.md section 7 "Tensor reformulation"):
+- R: fixed resource axis (resolution units, integral float32).
+- P levels: priority ladder index; level 0 is reserved for the *evicted* marker
+  priority (the reference's internaltypes.EvictedPriority = -1): resources of evicted
+  jobs stay counted at level 0 so clean fit ("schedule without preemption",
+  nodedb.go:506-514) sees them, while fit at a real priority does not.
+- Gangs are the unit of scheduling; a plain job is a gang of cardinality 1.  Every
+  *preemptible running job* also gets a gang slot (its "evictee" re-scheduling
+  candidate, pinned to its node), activated in-kernel only if the job is actually
+  evicted -- mirroring how evicted jobs re-enter the queue scheduler ahead of queued
+  jobs (preempting_queue_scheduler.go evict -> InMemoryJobRepository; jobs pinned
+  via node-id selector).
+- All axes are padded to `config.shape_bucket` multiples so jit recompiles only when
+  a bucket boundary is crossed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.keys import (
+    NodeTypeIndex,
+    SchedulingKeyIndex,
+    labels_referenced_by_selectors,
+    static_fit_matrix,
+)
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+
+_INF = np.float32(3.0e38)
+
+
+class SchedulingProblem(NamedTuple):
+    """Dense per-round problem; every field is a device-ready array."""
+
+    # nodes
+    node_total: np.ndarray  # f32[N, R] allocatable units
+    node_type: np.ndarray  # i32[N]
+    node_ok: np.ndarray  # bool[N] real & schedulable
+    # running jobs
+    run_req: np.ndarray  # f32[RJ, R]
+    run_node: np.ndarray  # i32[RJ]
+    run_level: np.ndarray  # i32[RJ] ladder level (>= 1)
+    run_queue: np.ndarray  # i32[RJ]
+    run_pc: np.ndarray  # i32[RJ] priority-class index
+    run_preemptible: np.ndarray  # bool[RJ]
+    run_gang: np.ndarray  # i32[RJ] evictee gang slot (-1 if not preemptible)
+    run_valid: np.ndarray  # bool[RJ]
+    # gangs (queued jobs + evictee slots)
+    g_req: np.ndarray  # f32[G, R] per-member request
+    g_card: np.ndarray  # i32[G]
+    g_level: np.ndarray  # i32[G] ladder level (>= 1)
+    g_queue: np.ndarray  # i32[G]
+    g_key: np.ndarray  # i32[G] scheduling key (-1 for evictee slots)
+    g_pc: np.ndarray  # i32[G]
+    g_order: np.ndarray  # i32[G] rank within its queue (evictees first)
+    g_run: np.ndarray  # i32[G] backing run for evictee slots, else -1
+    g_valid: np.ndarray  # bool[G]
+    # queues
+    q_weight: np.ndarray  # f32[Q] (0 = padding)
+    q_cds: np.ndarray  # f32[Q] constrained demand share
+    # static fit
+    compat: np.ndarray  # bool[K, T]
+    # pool-level scalars/vectors
+    total_pool: np.ndarray  # f32[R]
+    drf_mult: np.ndarray  # f32[R]
+    inv_scale: np.ndarray  # f32[R] packing-score weights
+    round_cap: np.ndarray  # f32[R] max schedulable this round (absolute units)
+    pc_queue_cap: np.ndarray  # f32[C, R] per-queue cap by priority class (absolute)
+    protected_fraction: np.ndarray  # f32 scalar
+    global_burst: np.ndarray  # i32 scalar
+    perq_burst: np.ndarray  # i32 scalar
+
+
+@dataclasses.dataclass
+class HostContext:
+    """Everything needed to decode a RoundResult back to ids."""
+
+    config: SchedulingConfig
+    pool: str
+    queue_names: list  # index -> queue name
+    node_ids: list  # index -> node id
+    gang_members: list  # gang index -> list of member job ids ([] for evictee slots)
+    run_job_ids: list  # run index -> job id
+    num_real_nodes: int
+    num_real_queues: int
+    num_real_gangs: int
+    num_real_runs: int
+    ladder: tuple  # priority ladder (ladder[level-1] = priority of level)
+    pc_names: list  # priority-class index -> name
+    max_slots: int
+    slot_width: int
+
+
+@dataclasses.dataclass
+class RoundOutcome:
+    """Host-side decoded result of a scheduling round (the reference's
+    SchedulerResult: scheduled jobs with nodes, preempted jobs)."""
+
+    scheduled: dict  # job id -> node id
+    preempted: list  # job ids preempted (evicted and not rescheduled)
+    failed: list  # job ids attempted and unschedulable this round
+    num_iterations: int
+    termination: str
+
+
+def _pad(n: int, bucket: int) -> int:
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+def _job_sort_key(pc_priority: int, job: JobSpec):
+    """Queue-internal scheduling order (jobdb/comparison.go JobPriorityComparer):
+    higher PC priority first, then lower job priority, then earlier submit time."""
+    return (-pc_priority, job.priority, job.submit_time, job.id)
+
+
+def build_problem(
+    config: SchedulingConfig,
+    *,
+    pool: str,
+    nodes: Sequence[NodeSpec],
+    queues: Sequence[Queue],
+    queued_jobs: Sequence[JobSpec],
+    running: Sequence[RunningJob] = (),
+) -> tuple[SchedulingProblem, HostContext]:
+    factory = config.resource_list_factory()
+    R = factory.num_resources
+    bucket = config.shape_bucket
+
+    pool_nodes = [n for n in nodes if n.pool == pool]
+    queue_by_name = {q.name: i for i, q in enumerate(sorted(queues, key=lambda q: q.name))}
+    sorted_queues = sorted(queues, key=lambda q: q.name)
+
+    # --- priority ladder: level 0 = evicted marker, 1..P = distinct PC priorities.
+    ladder = config.priority_ladder()
+    level_of_priority = {p: i + 1 for i, p in enumerate(ladder)}
+    pc_names = sorted(config.priority_classes)
+    pc_index = {name: i for i, name in enumerate(pc_names)}
+
+    def job_level(job: JobSpec) -> int:
+        return level_of_priority[config.priority_class(job.priority_class).priority]
+
+    # --- node tensors -----------------------------------------------------------
+    all_jobs = list(queued_jobs) + [r.job for r in running]
+    indexed = set(config.indexed_node_labels) | labels_referenced_by_selectors(
+        all_jobs, config.node_id_label
+    )
+    ntidx = NodeTypeIndex(indexed)
+    N = _pad(len(pool_nodes), bucket)
+    node_total = np.zeros((N, R), np.float32)
+    node_type = np.zeros((N,), np.int32)
+    node_ok = np.zeros((N,), bool)
+    node_index = {}
+    for i, node in enumerate(pool_nodes):
+        node_index[node.id] = i
+        if node.total_resources is not None:
+            node_total[i] = factory.floor_units(node.total_resources.atoms)
+        node_type[i] = ntidx.type_of(node)
+        node_ok[i] = not node.unschedulable
+
+    # --- scheduling keys for queued jobs ---------------------------------------
+    kidx = SchedulingKeyIndex()
+
+    # --- running jobs + evictee gang slots --------------------------------------
+    run_list = [r for r in running if r.node_id in node_index]
+    RJ = _pad(len(run_list), bucket)
+    run_req = np.zeros((RJ, R), np.float32)
+    run_node = np.zeros((RJ,), np.int32)
+    run_level = np.ones((RJ,), np.int32)
+    run_queue = np.zeros((RJ,), np.int32)
+    run_pc = np.zeros((RJ,), np.int32)
+    run_preemptible = np.zeros((RJ,), bool)
+    run_valid = np.zeros((RJ,), bool)
+    run_job_ids = []
+
+    # --- gangs: group queued jobs ----------------------------------------------
+    class _Gang:
+        __slots__ = ("jobs", "queue", "key", "level", "pc", "req", "card", "order", "run")
+
+    gangs: list[_Gang] = []
+    per_queue_jobs: dict[int, list] = {qi: [] for qi in range(len(sorted_queues))}
+    for job in queued_jobs:
+        qi = queue_by_name.get(job.queue)
+        if qi is None:
+            continue
+        if job.pools and pool not in job.pools:
+            continue
+        per_queue_jobs[qi].append(job)
+
+    gang_members_out: list[list] = []
+
+    def _new_gang() -> _Gang:
+        g = _Gang()
+        gangs.append(g)
+        return g
+
+    # evictee slots first (order ranks below queued gangs per queue)
+    evictee_by_queue: dict[int, list] = {qi: [] for qi in range(len(sorted_queues))}
+    for ri, r in enumerate(run_list):
+        run_job_ids.append(r.job.id)
+        run_req[ri] = factory.ceil_units(r.job.resources.atoms) if r.job.resources else 0
+        run_node[ri] = node_index[r.node_id]
+        pc = config.priority_class(r.job.priority_class)
+        run_level[ri] = level_of_priority[pc.priority]
+        qi = queue_by_name.get(r.job.queue, -1)
+        if qi < 0:
+            continue  # unknown queue: cannot be evicted (pqs.go:129-131)
+        run_queue[ri] = qi
+        run_pc[ri] = pc_index[pc.name]
+        run_preemptible[ri] = pc.preemptible
+        run_valid[ri] = True
+        if pc.preemptible:
+            evictee_by_queue[qi].append(ri)
+
+    run_gang = np.full((RJ,), -1, np.int32)
+    for qi, ris in evictee_by_queue.items():
+        # evictees ordered among themselves by the same comparator
+        ris.sort(
+            key=lambda ri: _job_sort_key(
+                ladder[run_level[ri] - 1], run_list[ri].job
+            )
+        )
+        for order, ri in enumerate(ris):
+            g = _new_gang()
+            g.jobs = []
+            g.queue = qi
+            g.key = -1
+            g.level = int(run_level[ri])
+            g.pc = int(run_pc[ri])
+            g.req = run_req[ri].copy()
+            g.card = 1
+            g.order = order
+            g.run = ri
+            run_gang[ri] = len(gangs) - 1
+            gang_members_out.append([])
+
+    # queued gangs, per queue, lookback-capped
+    for qi in range(len(sorted_queues)):
+        jobs = per_queue_jobs[qi]
+        # group by gang id; singletons stay singletons
+        by_gang: dict[str, list] = {}
+        singles = []
+        for job in jobs:
+            if job.gang_id:
+                by_gang.setdefault(job.gang_id, []).append(job)
+            else:
+                singles.append(job)
+        units: list[tuple[tuple, list]] = []
+        for job in singles:
+            pc = config.priority_class(job.priority_class)
+            units.append((_job_sort_key(pc.priority, job), [job]))
+        for gang_id, members in by_gang.items():
+            keys = {kidx.key_of(m, config.node_id_label) for m in members}
+            if len(keys) > 1:
+                # Heterogeneous gangs are split per key class; each sub-gang stays
+                # all-or-nothing but cross-class atomicity is not yet enforced.
+                # (Gap vs gang_scheduler.go; tracked for a later round.)
+                by_key: dict[int, list] = {}
+                for m in members:
+                    by_key.setdefault(kidx.key_of(m, config.node_id_label), []).append(m)
+                groups = list(by_key.values())
+            else:
+                groups = [members]
+            for grp in groups:
+                lead = min(
+                    grp,
+                    key=lambda m: _job_sort_key(
+                        config.priority_class(m.priority_class).priority, m
+                    ),
+                )
+                pc = config.priority_class(lead.priority_class)
+                units.append((_job_sort_key(pc.priority, lead), grp))
+        units.sort(key=lambda u: u[0])
+        base = len(evictee_by_queue[qi])
+        for order, (_, members) in enumerate(units[: config.max_queue_lookback]):
+            lead = members[0]
+            pc = config.priority_class(lead.priority_class)
+            g = _new_gang()
+            g.jobs = [m.id for m in members]
+            g.queue = qi
+            g.key = kidx.key_of(lead, config.node_id_label)
+            g.level = job_level(lead)
+            g.pc = pc_index[pc.name]
+            g.req = factory.ceil_units(lead.resources.atoms).astype(np.float32) if lead.resources else np.zeros(R, np.float32)
+            g.card = len(members)
+            g.order = base + order
+            g.run = -1
+            gang_members_out.append(g.jobs)
+
+    G = _pad(len(gangs), bucket)
+    g_req = np.zeros((G, R), np.float32)
+    g_card = np.zeros((G,), np.int32)
+    g_level = np.ones((G,), np.int32)
+    g_queue = np.zeros((G,), np.int32)
+    g_key = np.full((G,), -1, np.int32)
+    g_pc = np.zeros((G,), np.int32)
+    g_order = np.zeros((G,), np.int32)
+    g_run = np.full((G,), -1, np.int32)
+    g_valid = np.zeros((G,), bool)
+    for i, g in enumerate(gangs):
+        g_req[i] = g.req
+        g_card[i] = g.card
+        g_level[i] = g.level
+        g_queue[i] = g.queue
+        g_key[i] = g.key
+        g_pc[i] = g.pc
+        g_order[i] = g.order
+        g_run[i] = g.run
+        g_valid[i] = True
+
+    # --- pinned node for evictee slots is derived in-kernel from run_node -------
+
+    # --- static fit matrix ------------------------------------------------------
+    K = max(1, len(kidx))
+    T = max(1, len(ntidx))
+    compat = np.zeros((K, T), bool)
+    if len(kidx) and len(ntidx):
+        compat[: len(kidx), : len(ntidx)] = static_fit_matrix(kidx.keys, ntidx.types)
+
+    # --- pool totals, DRF, caps -------------------------------------------------
+    total_pool = node_total[: len(pool_nodes)].sum(axis=0, dtype=np.float64).astype(np.float32)
+    drf_mult = factory.multipliers_for(config.drf_multipliers()).astype(np.float32)
+    scale = node_total.max(axis=0) if len(pool_nodes) else np.zeros(R, np.float32)
+    inv_scale = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-9), 0.0).astype(np.float32)
+
+    round_cap = np.full((R,), _INF, np.float32)
+    for name, frac in config.maximum_resource_fraction_to_schedule.items():
+        if name in factory.names:
+            round_cap[factory.index_of(name)] = frac * total_pool[factory.index_of(name)]
+
+    C = len(pc_names)
+    pc_queue_cap = np.full((C, R), _INF, np.float32)
+    for ci, pc_name in enumerate(pc_names):
+        for name, frac in config.priority_classes[pc_name].maximum_resource_fraction_per_queue.items():
+            if name in factory.names:
+                ri = factory.index_of(name)
+                pc_queue_cap[ci, ri] = frac * total_pool[ri]
+
+    # --- queues: weights + constrained demand share ----------------------------
+    Q = _pad(len(sorted_queues), bucket)
+    q_weight = np.zeros((Q,), np.float32)
+    q_cds = np.zeros((Q,), np.float32)
+    demand_by_pc = np.zeros((len(sorted_queues), C, R), np.float64)
+    for g in gangs:
+        if g.run < 0:
+            demand_by_pc[g.queue, g.pc] += g.req.astype(np.float64) * g.card
+    for ri in range(len(run_list)):
+        if run_valid[ri]:
+            demand_by_pc[run_queue[ri], run_pc[ri]] += run_req[ri].astype(np.float64)
+    for qi, q in enumerate(sorted_queues):
+        q_weight[qi] = q.weight
+        capped = np.minimum(demand_by_pc[qi], pc_queue_cap).sum(axis=0)
+        capped = np.minimum(capped, total_pool.astype(np.float64))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(total_pool > 0, capped / np.maximum(total_pool, 1e-9), 0.0)
+        q_cds[qi] = max(0.0, float((frac * drf_mult).max())) if R else 0.0
+
+    max_card = int(g_card.max()) if len(gangs) else 1
+    if max_card > 10_000:
+        raise ValueError(f"gang cardinality {max_card} exceeds the supported 10k")
+    W = max(1, min(max_card, N))
+    # burst 0 means unlimited (like the per-queue knob below)
+    burst = config.maximum_scheduling_burst if config.maximum_scheduling_burst else 2**31 - 1
+    S = max(1, min(len(gangs), burst))
+
+    problem = SchedulingProblem(
+        node_total=node_total,
+        node_type=node_type,
+        node_ok=node_ok,
+        run_req=run_req,
+        run_node=run_node,
+        run_level=run_level,
+        run_queue=run_queue,
+        run_pc=run_pc,
+        run_preemptible=run_preemptible,
+        run_gang=run_gang,
+        run_valid=run_valid,
+        g_req=g_req,
+        g_card=g_card,
+        g_level=g_level,
+        g_queue=g_queue,
+        g_key=g_key,
+        g_pc=g_pc,
+        g_order=g_order,
+        g_run=g_run,
+        g_valid=g_valid,
+        q_weight=q_weight,
+        q_cds=q_cds,
+        compat=compat,
+        total_pool=total_pool,
+        drf_mult=drf_mult,
+        inv_scale=inv_scale,
+        round_cap=round_cap,
+        pc_queue_cap=pc_queue_cap.astype(np.float32),
+        protected_fraction=np.float32(config.protected_fraction_of_fair_share),
+        global_burst=np.int32(min(burst, 2**31 - 1)),
+        perq_burst=np.int32(config.maximum_per_queue_scheduling_burst or 2**31 - 1),
+    )
+    ctx = HostContext(
+        config=config,
+        pool=pool,
+        queue_names=[q.name for q in sorted_queues],
+        node_ids=[n.id for n in pool_nodes],
+        gang_members=gang_members_out,
+        run_job_ids=run_job_ids,
+        num_real_nodes=len(pool_nodes),
+        num_real_queues=len(sorted_queues),
+        num_real_gangs=len(gangs),
+        num_real_runs=len(run_list),
+        ladder=ladder,
+        pc_names=pc_names,
+        max_slots=S,
+        slot_width=W,
+    )
+    return problem, ctx
+
+
+_TERMINATIONS = ["exhausted", "global_burst", "round_resource_cap", "max_iterations"]
+
+
+def decode_result(result, ctx: HostContext) -> RoundOutcome:
+    """Map device tensors back to job/node ids (the reference's SchedulerResult)."""
+    g_state = np.asarray(result.g_state)
+    slot_gang = np.asarray(result.slot_gang)
+    slot_nodes = np.asarray(result.slot_nodes)
+    slot_counts = np.asarray(result.slot_counts)
+    n_slots = int(result.n_slots)
+    run_resched = np.asarray(result.run_rescheduled)
+    run_evicted = np.asarray(result.run_evicted)
+
+    scheduled: dict = {}
+    for s in range(n_slots):
+        gi = int(slot_gang[s])
+        members = ctx.gang_members[gi]
+        mi = 0
+        for w in range(ctx.slot_width):
+            node = int(slot_nodes[s, w])
+            for _ in range(int(slot_counts[s, w])):
+                if mi < len(members):
+                    scheduled[members[mi]] = ctx.node_ids[node]
+                    mi += 1
+
+    preempted = []
+    for ri in range(ctx.num_real_runs):
+        if run_evicted[ri] and not run_resched[ri]:
+            preempted.append(ctx.run_job_ids[ri])
+
+    failed = []
+    for gi in range(ctx.num_real_gangs):
+        if g_state[gi] == 2 and ctx.gang_members[gi]:
+            failed.extend(ctx.gang_members[gi])
+
+    return RoundOutcome(
+        scheduled=scheduled,
+        preempted=preempted,
+        failed=failed,
+        num_iterations=int(result.iterations),
+        termination=_TERMINATIONS[int(result.termination)],
+    )

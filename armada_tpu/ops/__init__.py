@@ -1,0 +1,46 @@
+"""Device-side scheduling primitives (pure jittable functions).
+
+These are the TPU-native equivalents of the reference's hot computational kernels:
+DRF cost/share computation (scheduling/fairness/fairness.go), fair-share
+water-filling (scheduling/context/scheduling.go:188-300), NodeDb fit predicates
+(nodedb/nodematching.go) and bin-packing node selection (nodedb/nodedb.go:615-800).
+Everything operates on dense tensors in resolution units; no Python objects.
+"""
+
+from armada_tpu.ops.fairness import (
+    unweighted_drf_cost,
+    weighted_drf_cost,
+    fair_shares,
+)
+from armada_tpu.ops.fit import (
+    dynamic_fit,
+    static_fit,
+    job_fit,
+    allocatable_from_used,
+)
+from armada_tpu.ops.packing import (
+    member_capacity,
+    node_packing_score,
+    select_best_node,
+    select_gang_nodes,
+    select_gang_nodes_compact,
+    bind_to_node,
+    unbind_from_node,
+)
+
+__all__ = [
+    "unweighted_drf_cost",
+    "weighted_drf_cost",
+    "fair_shares",
+    "dynamic_fit",
+    "static_fit",
+    "job_fit",
+    "allocatable_from_used",
+    "member_capacity",
+    "node_packing_score",
+    "select_best_node",
+    "select_gang_nodes",
+    "select_gang_nodes_compact",
+    "bind_to_node",
+    "unbind_from_node",
+]

@@ -1,0 +1,70 @@
+"""Node-fit predicates on device.
+
+The reference decomposes fit into a static part -- taints/labels/selectors, checked
+at NodeType granularity (nodedb/nodematching.go NodeTypeJobRequirementsMet:127,
+StaticJobRequirementsMet:161) -- and a dynamic part -- allocatable-at-priority >=
+request (DynamicJobRequirementsMet:194).  Static fit was precomputed host-side into a
+(scheduling-key x node-type) matrix (core/keys.py); on device it is one gather.
+
+Priority semantics (internaltypes/node.go AllocatableByPriority): a job bound at
+priority p consumes allocatable at every priority <= p; equivalently allocatable at
+priority p = total - sum of usage by jobs with priority >= p.  We keep per-level
+usage `used[P, N, R]` (exact priority level) and derive allocatable via a reversed
+cumulative sum, so binding/unbinding is a single-level scatter.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def allocatable_from_used(total, used):
+    """allocatable[P, N, R] from total[N, R] and per-level usage used[P, N, R].
+
+    allocatable[p] = total - sum_{p' >= p} used[p'] (suffix sum over the priority
+    ladder, lowest priority at index 0).
+    """
+    suffix = jnp.cumsum(used[::-1], axis=0)[::-1]
+    return total[None, :, :] - suffix
+
+
+def static_fit(compat, key, node_type):
+    """bool[N]: static fit of scheduling-key `key` against per-node type ids.
+
+    compat: bool[K, T] from core.keys.static_fit_matrix; one row gather + one
+    per-node gather (nodematching.go:127-145 collapsed to memory lookups).
+    """
+    return compat[key][node_type]
+
+
+def dynamic_fit(alloc_at_p, req):
+    """bool[N]: request fits in allocatable-at-priority (nodematching.go:194-214).
+
+    alloc_at_p: [N, R] allocatable at the job's priority level; req: [R].
+    """
+    return jnp.all(alloc_at_p >= req[None, :], axis=-1)
+
+
+def job_fit(
+    compat,
+    key,
+    node_type,
+    alloc_at_p,
+    req,
+    node_ok,
+    pinned_node,
+):
+    """Full per-node fit mask for one job (nodedb.go SelectNodeForJobWithTxn:392).
+
+    node_ok: bool[N] -- node is in the right pool, schedulable, not padding.
+    pinned_node: int32 scalar; >= 0 restricts fit to that node (the evicted-job
+    node-id selector path, api.go addNodeIdSelector:278 / nodedb.go:426).
+    """
+    mask = static_fit(compat, key, node_type) & dynamic_fit(alloc_at_p, req) & node_ok
+    n = alloc_at_p.shape[0]
+    pin_mask = jnp.where(
+        pinned_node >= 0,
+        jnp.arange(n, dtype=pinned_node.dtype) == pinned_node,
+        jnp.ones((n,), bool),
+    )
+    return mask & pin_mask

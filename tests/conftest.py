@@ -7,8 +7,19 @@ execution is covered by bench.py on hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even though the session presets JAX_PLATFORMS=axon (the real TPU):
+# unit tests validate logic + sharding on the virtual 8-device mesh; bench.py is
+# what runs on hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon plugin's registration force-sets jax_platforms="axon,cpu", overriding
+# the env var, which would make even CPU tests initialize the remote TPU tunnel
+# (and block whenever the chip is busy or the tunnel is down).  Re-pin to cpu at
+# the config level after import, before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

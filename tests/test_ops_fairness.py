@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from armada_tpu.ops.fairness import (
+    fair_shares,
+    unweighted_drf_cost,
+    weighted_drf_cost,
+)
+
+
+def reference_water_fill(weights, cds, max_iterations=10):
+    """Direct transcription of the reference loop semantics
+    (context/scheduling.go:220-300) in plain Python, used as the oracle."""
+    q = len(weights)
+    achieved = [False] * q
+    spare = [0.0] * q
+    dcafs = [0.0] * q
+    ucafs = [0.0] * q
+    unallocated = 1.0
+    for _ in range(max_iterations):
+        if unallocated <= 0.01:
+            break
+        total_weight = sum(w for w, a in zip(weights, achieved) if not a)
+        for i in range(q):
+            denom = total_weight + (weights[i] if achieved[i] else 0.0)
+            if denom > 0:
+                ucafs[i] += (weights[i] / denom) * (unallocated - spare[i])
+        if total_weight <= 0:
+            break
+        for i in range(q):
+            if not achieved[i]:
+                dcafs[i] += (weights[i] / total_weight) * unallocated
+        unallocated = 0.0
+        for i in range(q):
+            s = dcafs[i] - cds[i]
+            if s > 0:
+                dcafs[i] = cds[i]
+                achieved[i] = True
+                spare[i] = s
+                unallocated += s
+            else:
+                spare[i] = 0.0
+    return dcafs, ucafs
+
+
+def test_drf_cost_basics():
+    total = np.array([100.0, 10.0, 0.0], np.float32)
+    mult = np.array([1.0, 1.0, 1.0], np.float32)
+    alloc = np.array([50.0, 1.0, 5.0], np.float32)
+    # dominant resource: 50/100 = 0.5; zero-total resource contributes 0.
+    assert float(unweighted_drf_cost(alloc, total, mult)) == pytest.approx(0.5)
+    assert float(weighted_drf_cost(alloc, total, mult, 2.0)) == pytest.approx(0.25)
+    # multiplier scales a resource's contribution
+    mult2 = np.array([0.0, 1.0, 1.0], np.float32)
+    assert float(unweighted_drf_cost(alloc, total, mult2)) == pytest.approx(0.1)
+    # negative allocations clamp to zero cost
+    assert float(unweighted_drf_cost(-alloc, total, mult)) == 0.0
+
+
+@pytest.mark.parametrize(
+    "weights,cds",
+    [
+        ([1.0, 1.0], [1.0, 1.0]),  # both saturated: 50/50
+        ([1.0, 1.0], [0.1, 1.0]),  # q0 undemanding: spare reshared to q1
+        ([3.0, 1.0], [1.0, 1.0]),  # weighted split
+        ([1.0, 2.0, 1.0], [0.05, 0.3, 1.0]),  # cascade of reshares
+        ([1.0, 1.0, 0.0], [1.0, 1.0, 0.0]),  # padding queue with zero weight
+        ([2.0], [0.5]),  # single queue, capped by demand
+        ([1.0, 1.0], [0.0, 0.0]),  # nobody demands anything
+    ],
+)
+def test_water_filling_matches_reference_semantics(weights, cds):
+    got = fair_shares(np.array(weights, np.float32), np.array(cds, np.float32))
+    want_dcafs, want_ucafs = reference_water_fill(weights, cds)
+    np.testing.assert_allclose(
+        np.asarray(got.demand_capped_adjusted_fair_share), want_dcafs, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.uncapped_adjusted_fair_share), want_ucafs, atol=1e-5
+    )
+    wsum = sum(weights)
+    want_fs = [w / wsum if wsum else 0.0 for w in weights]
+    np.testing.assert_allclose(np.asarray(got.fair_share), want_fs, atol=1e-6)
+
+
+def test_water_filling_reshare_direction():
+    # An undemanding queue's unused share flows to the demanding one.
+    got = fair_shares(
+        np.array([1.0, 1.0], np.float32), np.array([0.1, 1.0], np.float32)
+    )
+    dcafs = np.asarray(got.demand_capped_adjusted_fair_share)
+    assert dcafs[0] == pytest.approx(0.1, abs=1e-5)
+    assert dcafs[1] == pytest.approx(0.9, abs=1e-5)
+    # Uncapped share is not punished for low demand.
+    ucafs = np.asarray(got.uncapped_adjusted_fair_share)
+    assert ucafs[0] >= 0.5 - 1e-5

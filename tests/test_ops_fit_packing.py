@@ -1,0 +1,109 @@
+import numpy as np
+import jax.numpy as jnp
+
+from armada_tpu.ops.fit import (
+    allocatable_from_used,
+    dynamic_fit,
+    job_fit,
+    static_fit,
+)
+from armada_tpu.ops.packing import (
+    bind_counts,
+    bind_to_node,
+    member_capacity,
+    node_packing_score,
+    select_best_node,
+    select_gang_nodes,
+    unbind_from_node,
+)
+
+
+def test_allocatable_suffix_sum():
+    # 2 priority levels, 1 node, 1 resource; total 10.
+    total = np.array([[10.0]], np.float32)
+    used = np.zeros((2, 1, 1), np.float32)
+    used[0, 0, 0] = 3.0  # low-priority job uses 3
+    used[1, 0, 0] = 2.0  # high-priority job uses 2
+    alloc = np.asarray(allocatable_from_used(total, used))
+    # At low priority you see both users: 10-5. At high priority only the
+    # high-priority usage blocks you: 10-2.
+    assert alloc[0, 0, 0] == 5.0
+    assert alloc[1, 0, 0] == 8.0
+
+
+def test_dynamic_and_static_fit():
+    alloc = np.array([[4.0, 8.0], [1.0, 8.0]], np.float32)  # 2 nodes x 2 res
+    req = np.array([2.0, 8.0], np.float32)
+    fit = np.asarray(dynamic_fit(alloc, req))
+    assert fit.tolist() == [True, False]
+
+    compat = np.array([[True, False]])  # 1 key x 2 types
+    node_type = np.array([0, 1, 0])
+    s = np.asarray(static_fit(jnp.asarray(compat), 0, jnp.asarray(node_type)))
+    assert s.tolist() == [True, False, True]
+
+
+def test_job_fit_pinning():
+    alloc = np.ones((3, 1), np.float32)
+    req = np.zeros((1,), np.float32)
+    compat = jnp.ones((1, 1), bool)
+    node_type = jnp.zeros((3,), jnp.int32)
+    ok = jnp.ones((3,), bool)
+    free = np.asarray(
+        job_fit(compat, 0, node_type, jnp.asarray(alloc), jnp.asarray(req), ok, jnp.int32(-1))
+    )
+    pinned = np.asarray(
+        job_fit(compat, 0, node_type, jnp.asarray(alloc), jnp.asarray(req), ok, jnp.int32(1))
+    )
+    assert free.tolist() == [True, True, True]
+    assert pinned.tolist() == [False, True, False]
+
+
+def test_select_best_node_is_best_fit():
+    # Fuller node (lower score) wins; unfit nodes ignored; ties -> lowest index.
+    alloc = np.array([[8.0], [2.0], [2.0], [1.0]], np.float32)
+    inv = np.array([1.0 / 8.0], np.float32)
+    score = node_packing_score(jnp.asarray(alloc), jnp.asarray(inv))
+    mask = jnp.asarray(np.array([True, True, True, False]))
+    found, node = select_best_node(mask, score)
+    assert bool(found) and int(node) == 1  # fullest fitting; tie 1 vs 2 -> 1
+
+    found, node = select_best_node(jnp.zeros((4,), bool), score)
+    assert not bool(found) and int(node) == -1
+
+
+def test_member_capacity_and_gang_select():
+    alloc = np.array([[4.0, 2.0], [10.0, 0.5], [6.0, 9.0]], np.float32)
+    req = np.array([2.0, 1.0], np.float32)
+    cap = np.asarray(member_capacity(jnp.asarray(alloc), jnp.asarray(req)))
+    assert cap.tolist() == [2, 0, 3]
+
+    score = jnp.asarray(np.array([0.1, 0.2, 0.3], np.float32))
+    mask = jnp.ones((3,), bool)
+    feasible, counts = select_gang_nodes(mask, jnp.asarray(cap), 4, score)
+    assert bool(feasible)
+    # Fills fullest (node 0, cap 2) then node 2 for the remaining 2 members.
+    assert np.asarray(counts).tolist() == [2, 0, 2]
+
+    feasible, counts = select_gang_nodes(mask, jnp.asarray(cap), 6, score)
+    assert not bool(feasible)
+    assert np.asarray(counts).sum() == 0  # all-or-nothing
+
+    # zero-resource request: capacity clamps, doesn't overflow
+    cap0 = np.asarray(member_capacity(jnp.asarray(alloc), jnp.zeros((2,), np.float32)))
+    assert (cap0 > 0).all()
+
+
+def test_bind_unbind_roundtrip():
+    used = jnp.zeros((2, 3, 2), jnp.float32)
+    req = jnp.asarray(np.array([2.0, 1.0], np.float32))
+    u1 = bind_to_node(used, 1, req, 1, count=2)
+    assert np.asarray(u1)[1, 1].tolist() == [4.0, 2.0]
+    u2 = unbind_from_node(u1, 1, req, 1, count=2)
+    assert np.asarray(u2).sum() == 0.0
+
+    counts = jnp.asarray(np.array([1, 0, 3], np.int32))
+    u3 = bind_counts(used, counts, req, 0)
+    got = np.asarray(u3)
+    assert got[0, 0].tolist() == [2.0, 1.0]
+    assert got[0, 2].tolist() == [6.0, 3.0]

@@ -1,0 +1,291 @@
+"""Whole-round scenarios for the tensorised scheduling round.
+
+Modeled on the reference's table-driven scheduler tests
+(internal/scheduler/scheduling/preempting_queue_scheduler_test.go,
+queue_scheduler_test.go, gang_scheduler_test.go): small clusters, explicit
+expectations about which jobs schedule, fail, or get preempted.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob, Taint, Toleration
+from armada_tpu.models import run_scheduling_round
+
+
+def make_config(**overrides) -> SchedulingConfig:
+    base = dict(
+        supported_resource_types=(("memory", "1Mi"), ("cpu", "1m"), ("nvidia.com/gpu", "1")),
+        priority_classes={
+            "p0": PriorityClass("p0", priority=0, preemptible=True),
+            "p1": PriorityClass("p1", priority=1, preemptible=True),
+            "p2": PriorityClass("p2", priority=2, preemptible=False),
+        },
+        default_priority_class="p1",
+        dominant_resource_fairness_resources=("cpu", "memory", "nvidia.com/gpu"),
+        shape_bucket=8,
+        maximum_scheduling_burst=1_000_000,
+        maximum_per_queue_scheduling_burst=1_000_000,
+        maximum_resource_fraction_to_schedule={},
+    )
+    base.update(overrides)
+    return SchedulingConfig(**base)
+
+
+_factory_cache = {}
+
+
+def rl(config, **q):
+    key = config.supported_resource_types
+    f = _factory_cache.get(key)
+    if f is None:
+        f = config.resource_list_factory()
+        _factory_cache[key] = f
+    return f.from_mapping({k.replace("gpu", "nvidia.com/gpu") if k == "gpu" else k: v for k, v in q.items()})
+
+
+def node(config, nid, cpu="1", memory="1Gi", **kw):
+    return NodeSpec(nid, total_resources=rl(config, cpu=cpu, memory=memory, **kw.pop("extra", {})), **kw)
+
+
+def job(config, jid, queue, cpu="1", memory="128Mi", pc="p1", **kw):
+    return JobSpec(jid, queue, priority_class=pc, resources=rl(config, cpu=cpu, memory=memory), **kw)
+
+
+def run_round(config, nodes, queues, jobs, running=()):
+    return run_scheduling_round(
+        config, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs, running=running
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_single_queue_fifo_capacity():
+    cfg = make_config()
+    nodes = [node(cfg, "n0", cpu="2", memory="4Gi")]
+    jobs = [job(cfg, f"j{i}", "A", cpu="1") for i in range(3)]
+    out = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert len(out.scheduled) == 2
+    # third identical job retired via the unfeasible scheduling key
+    assert set(out.failed) == {"j2"} or len(out.failed) == 1
+    assert out.preempted == []
+    assert all(v == "n0" for v in out.scheduled.values())
+
+
+def test_two_queue_fair_split():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(10)]
+    jobs = [job(cfg, f"a{i}", "A", cpu="1") for i in range(10)] + [
+        job(cfg, f"b{i}", "B", cpu="1") for i in range(10)
+    ]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], jobs)
+    a = sum(1 for j in out.scheduled if j.startswith("a"))
+    b = sum(1 for j in out.scheduled if j.startswith("b"))
+    assert a == 5 and b == 5
+
+
+def test_weighted_fair_split():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(12)]
+    jobs = [job(cfg, f"a{i}", "A", cpu="1") for i in range(12)] + [
+        job(cfg, f"b{i}", "B", cpu="1") for i in range(12)
+    ]
+    out = run_round(cfg, nodes, [Queue("A", weight=3.0), Queue("B", weight=1.0)], jobs)
+    a = sum(1 for j in out.scheduled if j.startswith("a"))
+    b = sum(1 for j in out.scheduled if j.startswith("b"))
+    assert a == 9 and b == 3
+
+
+def test_priority_class_order_within_queue():
+    cfg = make_config()
+    nodes = [node(cfg, "n0", cpu="1", memory="2Gi")]
+    jobs = [
+        job(cfg, "low", "A", cpu="1", pc="p0", submit_time=0.0),
+        job(cfg, "high", "A", cpu="1", pc="p2", submit_time=1.0),
+    ]
+    out = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert "high" in out.scheduled and "low" not in out.scheduled
+
+
+def test_job_priority_and_submit_time_order():
+    cfg = make_config()
+    nodes = [node(cfg, "n0", cpu="1", memory="2Gi")]
+    jobs = [
+        job(cfg, "later", "A", cpu="1", submit_time=5.0),
+        job(cfg, "earlier", "A", cpu="1", submit_time=1.0),
+        job(cfg, "urgent", "A", cpu="1", submit_time=9.0, priority=-5),
+    ]
+    out = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert list(out.scheduled) == ["urgent"]
+
+
+def test_unfeasible_key_mass_skip():
+    cfg = make_config()
+    nodes = [node(cfg, "n0", cpu="4", memory="4Gi")]
+    sel = {"zone": "mars"}
+    jobs = [
+        JobSpec(f"m{i}", "A", priority_class="p1", resources=rl(cfg, cpu="1", memory="128Mi"), node_selector=sel)
+        for i in range(50)
+    ] + [job(cfg, "ok", "A", cpu="1")]
+    out = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert list(out.scheduled) == ["ok"]
+    assert len(out.failed) == 50
+    # one fit attempt retired all 50 identical jobs: far fewer iterations than jobs
+    assert out.num_iterations <= 10
+
+
+def test_gang_all_or_nothing():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(2)]
+    too_big = [
+        job(cfg, f"g3-{i}", "A", cpu="1", gang_id="g3", gang_cardinality=3) for i in range(3)
+    ]
+    out = run_round(cfg, nodes, [Queue("A")], too_big)
+    assert out.scheduled == {}
+    fits = [job(cfg, f"g2-{i}", "A", cpu="1", gang_id="g2", gang_cardinality=2) for i in range(2)]
+    out = run_round(cfg, nodes, [Queue("A")], fits)
+    assert set(out.scheduled) == {"g2-0", "g2-1"}
+    assert set(out.scheduled.values()) == {"n0", "n1"}
+
+
+def test_gang_packs_multiple_members_per_node():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="2", memory="4Gi") for i in range(2)]
+    gang = [job(cfg, f"g-{i}", "A", cpu="1", gang_id="g", gang_cardinality=4) for i in range(4)]
+    out = run_round(cfg, nodes, [Queue("A")], gang)
+    assert len(out.scheduled) == 4
+    from collections import Counter
+
+    counts = Counter(out.scheduled.values())
+    assert counts["n0"] == 2 and counts["n1"] == 2
+
+
+def test_fair_share_preemption_rebalances():
+    cfg = make_config(protected_fraction_of_fair_share=0.5)
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(4)]
+    running = [
+        RunningJob(job(cfg, f"a{i}", "A", cpu="1", pc="p0"), node_id=f"n{i}") for i in range(4)
+    ]
+    newjobs = [job(cfg, f"b{i}", "B", cpu="1", pc="p0") for i in range(4)]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], newjobs, running)
+    b = [j for j in out.scheduled if j.startswith("b")]
+    assert len(b) == 2
+    assert len(out.preempted) == 2
+    assert all(p.startswith("a") for p in out.preempted)
+
+
+def test_protected_fair_share_blocks_eviction():
+    cfg = make_config(protected_fraction_of_fair_share=100.0)
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(4)]
+    running = [
+        RunningJob(job(cfg, f"a{i}", "A", cpu="1", pc="p0"), node_id=f"n{i}") for i in range(4)
+    ]
+    newjobs = [job(cfg, f"b{i}", "B", cpu="1", pc="p0") for i in range(2)]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], newjobs, running)
+    assert out.scheduled == {}
+    assert out.preempted == []
+
+
+def test_urgency_preemption_displaces_lower_priority():
+    cfg = make_config(protected_fraction_of_fair_share=100.0)
+    nodes = [node(cfg, "n0", cpu="1", memory="2Gi")]
+    running = [RunningJob(job(cfg, "victim", "A", cpu="1", pc="p0"), node_id="n0")]
+    newjobs = [job(cfg, "urgent", "B", cpu="1", pc="p2")]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], newjobs, running)
+    assert out.scheduled == {"urgent": "n0"}
+    assert out.preempted == ["victim"]
+
+
+def test_urgency_preemption_prefers_clean_node():
+    cfg = make_config(protected_fraction_of_fair_share=100.0)
+    nodes = [node(cfg, "busy", cpu="1", memory="2Gi"), node(cfg, "free", cpu="1", memory="2Gi")]
+    running = [RunningJob(job(cfg, "victim", "A", cpu="1", pc="p0"), node_id="busy")]
+    newjobs = [job(cfg, "urgent", "B", cpu="1", pc="p2")]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], newjobs, running)
+    assert out.scheduled == {"urgent": "free"}
+    assert out.preempted == []
+
+
+def test_non_preemptible_running_job_survives():
+    cfg = make_config(protected_fraction_of_fair_share=0.0)
+    nodes = [node(cfg, "n0", cpu="1", memory="2Gi")]
+    running = [RunningJob(job(cfg, "rock", "A", cpu="1", pc="p2"), node_id="n0")]
+    newjobs = [job(cfg, "wish", "B", cpu="1", pc="p2")]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], newjobs, running)
+    assert out.scheduled == {}
+    assert out.preempted == []
+
+
+def test_node_selector_and_taints():
+    cfg = make_config()
+    tainted = NodeSpec(
+        "gpu0",
+        total_resources=rl(cfg, cpu="4", memory="8Gi"),
+        taints=(Taint("gpu", "true", "NoSchedule"),),
+        labels={"zone": "a"},
+    )
+    plain = NodeSpec("cpu0", total_resources=rl(cfg, cpu="4", memory="8Gi"), labels={"zone": "b"})
+    jobs = [
+        JobSpec(
+            "gpu-job",
+            "A",
+            priority_class="p1",
+            resources=rl(cfg, cpu="1", memory="128Mi"),
+            tolerations=(Toleration("gpu", "Exists"),),
+            node_selector={"zone": "a"},
+        ),
+        job(cfg, "cpu-job", "A", cpu="1"),
+    ]
+    out = run_round(cfg, [tainted, plain], [Queue("A")], jobs)
+    assert out.scheduled["gpu-job"] == "gpu0"
+    assert out.scheduled["cpu-job"] == "cpu0"  # taint repels the plain job
+
+
+def test_global_burst_cap():
+    cfg = make_config(maximum_scheduling_burst=2)
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(5)]
+    jobs = [job(cfg, f"j{i}", "A", cpu="1") for i in range(5)]
+    out = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert len(out.scheduled) == 2
+    assert out.termination == "global_burst"
+    assert out.failed == []  # remaining jobs were not attempted, not failed
+
+
+def test_per_queue_resource_fraction_cap():
+    pcs = {
+        "p1": PriorityClass(
+            "p1", priority=1, preemptible=True, maximum_resource_fraction_per_queue={"cpu": 0.5}
+        )
+    }
+    cfg = make_config(priority_classes=pcs, default_priority_class="p1")
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(4)]
+    jobs = [job(cfg, f"a{i}", "A", cpu="1", pc="p1") for i in range(4)] + [
+        job(cfg, f"b{i}", "B", cpu="1", pc="p1") for i in range(4)
+    ]
+    out = run_round(cfg, nodes, [Queue("A"), Queue("B")], jobs)
+    a = sum(1 for j in out.scheduled if j.startswith("a"))
+    b = sum(1 for j in out.scheduled if j.startswith("b"))
+    assert a == 2 and b == 2
+
+
+def test_round_resource_fraction_cap():
+    cfg = make_config(maximum_resource_fraction_to_schedule={"cpu": 0.25})
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(8)]
+    jobs = [job(cfg, f"j{i}", "A", cpu="1") for i in range(8)]
+    out = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert len(out.scheduled) == 2
+    assert out.termination == "round_resource_cap"
+
+
+def test_round_is_pure_and_repeatable():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="2", memory="4Gi") for i in range(3)]
+    jobs = [job(cfg, f"j{i}", "A", cpu="1") for i in range(5)]
+    out1 = run_round(cfg, nodes, [Queue("A")], jobs)
+    out2 = run_round(cfg, nodes, [Queue("A")], jobs)
+    assert out1.scheduled == out2.scheduled
+    assert out1.preempted == out2.preempted
