@@ -1,0 +1,178 @@
+"""Synthetic dense scheduling problems, built straight as tensors.
+
+For benchmarks and compile checks at reference scale (1M queued jobs x 50k
+nodes, BASELINE.json) the host-object path (core.types -> models.problem
+build_problem) would spend minutes materialising Python dataclasses; production
+rounds keep state device-resident between cycles anyway (the reference's jobDb
+cache, scheduler.go:240-246), so scale testing goes straight to the dense form.
+Shapes/semantics are identical to build_problem's output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from armada_tpu.models.problem import SchedulingProblem
+
+_INF = np.float32(3.0e38)
+
+
+def synthetic_problem(
+    *,
+    num_nodes: int,
+    num_gangs: int,
+    num_queues: int,
+    num_runs: int = 0,
+    num_resources: int = 4,
+    num_keys: int = 16,
+    num_node_types: int = 8,
+    max_gang_cardinality: int = 1,
+    global_burst: int = 1_000,
+    perq_burst: int = 1_000,
+    node_pad_to: int = 1,
+    gang_pad_to: int = 1,
+    seed: int = 0,
+) -> tuple[SchedulingProblem, dict]:
+    """A realistic mixed workload: heterogeneous nodes, skewed queue demand.
+
+    Returns (problem, meta) where meta carries the kernel's static shape args
+    (num_levels, max_slots, slot_width).
+    """
+    rng = np.random.default_rng(seed)
+    R = num_resources
+
+    def pad(n, to):
+        return max(to, ((n + to - 1) // to) * to)
+
+    N = pad(num_nodes, node_pad_to)
+    G = pad(num_gangs, gang_pad_to)
+    RJ = pad(max(num_runs, 1), gang_pad_to)
+    Q = num_queues
+
+    # Nodes: capacity vectors like (cpu cores*1000m, memory GiB, gpu, storage).
+    base = np.array([16_000, 64, 0, 500], np.float32)[:R]
+    node_total = np.zeros((N, R), np.float32)
+    mult = rng.choice([1.0, 2.0, 4.0, 6.0], size=(num_nodes, 1)).astype(np.float32)
+    node_total[:num_nodes] = base[None, :] * mult
+    has_gpu = rng.random(num_nodes) < 0.2
+    if R >= 3:
+        node_total[:num_nodes, 2] = np.where(has_gpu, 8.0, 0.0)
+    node_type = np.zeros((N,), np.int32)
+    node_type[:num_nodes] = rng.integers(0, num_node_types, num_nodes)
+    node_ok = np.zeros((N,), bool)
+    node_ok[:num_nodes] = True
+
+    # Static fit: most keys fit most types; a few restrictive keys.
+    compat = rng.random((num_keys, num_node_types)) < 0.9
+    compat[0] = True  # the common key
+
+    # Gangs: skewed queue popularity (zipf-ish), small requests.
+    g_queue = np.zeros((G,), np.int32)
+    probs = 1.0 / np.arange(1, Q + 1)
+    probs /= probs.sum()
+    g_queue[:num_gangs] = rng.choice(Q, size=num_gangs, p=probs)
+    g_req = np.zeros((G, R), np.float32)
+    cpu = rng.choice([500, 1000, 2000, 4000], size=num_gangs).astype(np.float32)
+    memf = cpu / 1000.0 * rng.choice([2, 4, 8], size=num_gangs)
+    g_req[:num_gangs, 0] = cpu
+    if R >= 2:
+        g_req[:num_gangs, 1] = memf
+    if R >= 3:
+        g_req[:num_gangs, 2] = (rng.random(num_gangs) < 0.05).astype(np.float32)
+    g_card = np.zeros((G,), np.int32)
+    g_card[:num_gangs] = (
+        rng.integers(1, max_gang_cardinality + 1, num_gangs)
+        if max_gang_cardinality > 1
+        else 1
+    )
+    g_level = np.zeros((G,), np.int32)
+    g_level[:num_gangs] = rng.integers(1, 3, num_gangs)
+    g_key = np.full((G,), -1, np.int32)
+    g_key[:num_gangs] = rng.integers(0, num_keys, num_gangs)
+    g_pc = np.zeros((G,), np.int32)
+    g_pc[:num_gangs] = g_level[:num_gangs] - 1
+    # per-queue FIFO order
+    g_order = np.zeros((G,), np.int32)
+    order_all = np.argsort(g_queue[:num_gangs], kind="stable")
+    rank = np.empty(num_gangs, np.int64)
+    counts = np.bincount(g_queue[:num_gangs], minlength=Q)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    rank[order_all] = np.arange(num_gangs) - starts[g_queue[:num_gangs][order_all]]
+    g_order[:num_gangs] = rank
+    g_run = np.full((G,), -1, np.int32)
+    g_valid = np.zeros((G,), bool)
+    g_valid[:num_gangs] = True
+
+    # Running jobs (optional): bound to random nodes at level >= 1.
+    run_req = np.zeros((RJ, R), np.float32)
+    run_node = np.zeros((RJ,), np.int32)
+    run_level = np.ones((RJ,), np.int32)
+    run_queue = np.zeros((RJ,), np.int32)
+    run_pc = np.zeros((RJ,), np.int32)
+    run_preemptible = np.zeros((RJ,), bool)
+    run_gang = np.full((RJ,), -1, np.int32)
+    run_valid = np.zeros((RJ,), bool)
+    if num_runs:
+        run_req[:num_runs, 0] = rng.choice([500, 1000, 2000], size=num_runs)
+        if R >= 2:
+            run_req[:num_runs, 1] = run_req[:num_runs, 0] / 250.0
+        run_node[:num_runs] = rng.integers(0, num_nodes, num_runs)
+        run_level[:num_runs] = rng.integers(1, 3, num_runs)
+        run_queue[:num_runs] = rng.integers(0, Q, num_runs)
+        run_pc[:num_runs] = run_level[:num_runs] - 1
+        run_preemptible[:num_runs] = rng.random(num_runs) < 0.5
+        run_valid[:num_runs] = True
+
+    total_pool = node_total[:num_nodes].sum(axis=0, dtype=np.float64).astype(np.float32)
+    drf_mult = np.ones((R,), np.float32)
+    scale = node_total.max(axis=0)
+    inv_scale = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-9), 0.0).astype(np.float32)
+
+    C = 2
+    q_weight = np.ones((Q,), np.float32)
+    # constrained demand share ~ demand / total (uncapped)
+    demand = np.zeros((Q, R), np.float64)
+    np.add.at(demand, g_queue[:num_gangs], (g_req[:num_gangs] * g_card[:num_gangs, None]).astype(np.float64))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frac = np.where(total_pool > 0, demand / np.maximum(total_pool, 1e-9), 0.0)
+    q_cds = np.clip(frac.max(axis=1), 0.0, None).astype(np.float32)
+
+    problem = SchedulingProblem(
+        node_total=node_total,
+        node_type=node_type,
+        node_ok=node_ok,
+        run_req=run_req,
+        run_node=run_node,
+        run_level=run_level,
+        run_queue=run_queue,
+        run_pc=run_pc,
+        run_preemptible=run_preemptible,
+        run_gang=run_gang,
+        run_valid=run_valid,
+        g_req=g_req,
+        g_card=g_card,
+        g_level=g_level,
+        g_queue=g_queue,
+        g_key=g_key,
+        g_pc=g_pc,
+        g_order=g_order,
+        g_run=g_run,
+        g_valid=g_valid,
+        q_weight=q_weight,
+        q_cds=q_cds,
+        compat=compat,
+        total_pool=total_pool,
+        drf_mult=drf_mult,
+        inv_scale=inv_scale,
+        round_cap=np.full((R,), _INF, np.float32),
+        pc_queue_cap=np.full((C, R), _INF, np.float32),
+        protected_fraction=np.float32(1.0),
+        global_burst=np.int32(global_burst),
+        perq_burst=np.int32(perq_burst),
+    )
+    meta = dict(
+        num_levels=3,
+        max_slots=max(1, min(num_gangs, global_burst)),
+        slot_width=max(1, min(max_gang_cardinality, N)),
+    )
+    return problem, meta

@@ -1,0 +1,29 @@
+"""Multi-chip execution of the scheduling round.
+
+The reference scales one scheduling cycle over *processes* (leader + executor
+fleet, SURVEY.md section 2.8); the TPU-native analog scales the round over a
+`jax.sharding.Mesh`: the nodes axis (a 50k-node pool) and the gangs axis (a
+1M-job queue backlog) of the dense problem are sharded across devices, XLA/GSPMD
+inserts the psum/all-gather collectives that realise the global argmin/argmax
+reductions over ICI.  This is the "pick a mesh, annotate shardings, let XLA
+insert collectives" recipe -- no hand-written pmap/collective code in the round
+kernel itself.
+"""
+
+from armada_tpu.parallel.mesh import (
+    AXIS_NODES,
+    AXIS_JOBS,
+    make_mesh,
+    problem_shardings,
+    shard_problem,
+    sharded_schedule_round,
+)
+
+__all__ = [
+    "AXIS_NODES",
+    "AXIS_JOBS",
+    "make_mesh",
+    "problem_shardings",
+    "shard_problem",
+    "sharded_schedule_round",
+]

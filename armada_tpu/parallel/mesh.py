@@ -1,0 +1,177 @@
+"""Mesh construction + sharding specs for the scheduling round.
+
+Sharding layout (SURVEY.md section 7 "Tensor reformulation" / section 2.8):
+
+- axis ``nodes``: node-dimension tensors (node_total[N,R], node_type[N],
+  node_ok[N], and the alloc[P1,N,R] carry) are sharded -- the 50k-node pool is
+  split across devices, so per-node fit masks, member capacities and packing
+  scores are computed locally and the best-fit argmin is a cross-device
+  reduction that XLA lowers onto ICI.
+- axis ``jobs``: gang- and run-dimension tensors (g_req[G,R], g_order[G], ...,
+  run_req[RJ,R], ...) are sharded -- the 1M-gang backlog is split, and the
+  per-queue segment-min candidate scan reduces across devices.
+- queue/pool tensors ([Q], [Q,R], [R], scalars) are replicated: Q is small
+  (thousands at most) and every device needs the full fairness state.
+
+The round kernel (models/fair_scheduler.py schedule_round) is reused unchanged:
+`sharded_schedule_round` jits it with these shardings; GSPMD partitions the
+while-loop body.  This mirrors how the reference runs ONE logical round over a
+whole executor fleet's nodes (scheduling_algo.go:126-186) -- the parallelism is
+inside the round, not across rounds.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from armada_tpu.models.fair_scheduler import schedule_round
+from armada_tpu.models.problem import SchedulingProblem
+
+AXIS_NODES = "nodes"
+AXIS_JOBS = "jobs"
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    *,
+    node_shards: Optional[int] = None,
+    job_shards: int = 1,
+) -> Mesh:
+    """A 2D (nodes x jobs) device mesh.
+
+    Defaults to all visible devices on the ``nodes`` axis: node count (50k)
+    dwarfs everything else in the fit/score inner product, so that is the axis
+    whose sharding buys HBM locality.  ``job_shards`` > 1 splits the backlog
+    scan as well (use for very deep queues).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    if node_shards is None:
+        node_shards = n // job_shards
+    if node_shards * job_shards != n:
+        raise ValueError(
+            f"mesh {node_shards}x{job_shards} != {n} devices"
+        )
+    return Mesh(devices.reshape(node_shards, job_shards), (AXIS_NODES, AXIS_JOBS))
+
+
+def problem_shardings(mesh: Mesh) -> SchedulingProblem:
+    """A SchedulingProblem pytree of NamedShardings matching its field layout."""
+
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    nodes = s(AXIS_NODES)
+    nodes_r = s(AXIS_NODES, None)
+    jobsax = s(AXIS_JOBS)
+    jobs_r = s(AXIS_JOBS, None)
+    repl = s()
+    return SchedulingProblem(
+        node_total=nodes_r,
+        node_type=nodes,
+        node_ok=nodes,
+        run_req=jobs_r,
+        run_node=jobsax,
+        run_level=jobsax,
+        run_queue=jobsax,
+        run_pc=jobsax,
+        run_preemptible=jobsax,
+        run_gang=jobsax,
+        run_valid=jobsax,
+        g_req=jobs_r,
+        g_card=jobsax,
+        g_level=jobsax,
+        g_queue=jobsax,
+        g_key=jobsax,
+        g_pc=jobsax,
+        g_order=jobsax,
+        g_run=jobsax,
+        g_valid=jobsax,
+        q_weight=repl,
+        q_cds=repl,
+        compat=repl,
+        total_pool=repl,
+        drf_mult=repl,
+        inv_scale=repl,
+        round_cap=repl,
+        pc_queue_cap=repl,
+        protected_fraction=repl,
+        global_burst=repl,
+        perq_burst=repl,
+    )
+
+
+def _check_divisible(problem: SchedulingProblem, mesh: Mesh) -> None:
+    n_shards = mesh.shape[AXIS_NODES]
+    j_shards = mesh.shape[AXIS_JOBS]
+    N = problem.node_total.shape[0]
+    G = problem.g_req.shape[0]
+    RJ = problem.run_req.shape[0]
+    for size, shards, name in ((N, n_shards, "nodes"), (G, j_shards, "gangs"), (RJ, j_shards, "runs")):
+        if size % shards:
+            raise ValueError(
+                f"{name} axis {size} not divisible by its {shards} mesh shards; "
+                f"raise SchedulingConfig.shape_bucket to a multiple of the mesh"
+            )
+
+
+def shard_problem(problem: SchedulingProblem, mesh: Mesh) -> SchedulingProblem:
+    """Place a (host or device) problem onto the mesh with the round shardings."""
+    _check_divisible(problem, mesh)
+    shardings = problem_shardings(mesh)
+    return SchedulingProblem(
+        *(jax.device_put(a, sh) for a, sh in zip(problem, shardings))
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "num_levels", "max_slots", "slot_width", "max_iterations"),
+)
+def _sharded_round(problem, *, mesh, num_levels, max_slots, slot_width, max_iterations):
+    # Inputs arrive pre-sharded (shard_problem); jit propagates their shardings
+    # through the while-loop and GSPMD inserts the collectives.  Outputs are
+    # pulled back replicated: everything the host decodes is small ([S,W] slots,
+    # [G] states, [RJ] flags) except alloc, which callers feeding the next round
+    # re-shard anyway.
+    return schedule_round(
+        problem,
+        num_levels=num_levels,
+        max_slots=max_slots,
+        slot_width=slot_width,
+        max_iterations=max_iterations,
+    )
+
+
+def sharded_schedule_round(
+    problem: SchedulingProblem,
+    mesh: Mesh,
+    *,
+    num_levels: int,
+    max_slots: int,
+    slot_width: int,
+    max_iterations: int = 0,
+):
+    """Run one scheduling round SPMD over the mesh.
+
+    Equivalent single-device call: models.schedule_round.  Results are
+    numerically identical (the kernel is deterministic and sharding only
+    distributes the reductions).
+    """
+    problem = shard_problem(problem, mesh)
+    with mesh:
+        return _sharded_round(
+            problem,
+            mesh=mesh,
+            num_levels=num_levels,
+            max_slots=max_slots,
+            slot_width=slot_width,
+            max_iterations=max_iterations,
+        )

@@ -1,0 +1,42 @@
+"""Discrete-event scheduler simulator (cmd/simulator +
+internal/scheduler/simulator equivalent): drives the production round kernel
+through virtual time from declarative cluster/workload YAML specs."""
+
+from armada_tpu.simulator.simulator import CycleStats, SimulationResult, Simulator
+from armada_tpu.simulator.spec import (
+    ClusterSpec,
+    ClusterTemplate,
+    JobTemplate,
+    NodeTemplate,
+    QueueSpec,
+    RepeatDetails,
+    ShiftedExponential,
+    WorkloadSpec,
+    cluster_spec_from_dict,
+    cluster_spec_from_yaml,
+    parse_duration,
+    workload_spec_from_dict,
+    workload_spec_from_yaml,
+)
+from armada_tpu.simulator.sink import JsonlSink, write_parquet
+
+__all__ = [
+    "Simulator",
+    "SimulationResult",
+    "CycleStats",
+    "ClusterSpec",
+    "ClusterTemplate",
+    "NodeTemplate",
+    "WorkloadSpec",
+    "QueueSpec",
+    "JobTemplate",
+    "RepeatDetails",
+    "ShiftedExponential",
+    "parse_duration",
+    "cluster_spec_from_dict",
+    "cluster_spec_from_yaml",
+    "workload_spec_from_dict",
+    "workload_spec_from_yaml",
+    "JsonlSink",
+    "write_parquet",
+]

@@ -1,0 +1,340 @@
+"""Discrete-event scheduler simulator: virtual time, real scheduling kernel.
+
+The TPU-native equivalent of the reference's simulator
+(internal/scheduler/simulator/simulator.go:70-118,212): a time-ordered event
+loop drives submission, scheduling rounds and job completion against the SAME
+round kernel production uses (models.run_scheduling_round == the reference
+running its production PreemptingQueueScheduler inside handleScheduleEvent:544).
+Virtual time fast-forwards between events; scheduling rounds are suppressed
+while the system is in steady state (simulator.go:716-721).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Callable, Optional
+
+import numpy as np
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+from armada_tpu.core.types import RunningJob as RunningJobSpec
+from armada_tpu.models import run_scheduling_round
+from armada_tpu.simulator.spec import ClusterSpec, JobTemplate, WorkloadSpec
+
+_SUBMIT = 0
+_FINISH = 1
+_SCHEDULE = 2
+
+
+@dataclasses.dataclass
+class _Running:
+    job: JobSpec
+    node_id: str
+    pool: str
+    finish_time: float
+
+
+@dataclasses.dataclass
+class _TemplateState:
+    template: JobTemplate
+    submitted: int = 0
+    succeeded: int = 0
+    dependents: list = dataclasses.field(default_factory=list)  # template ids
+
+
+@dataclasses.dataclass
+class CycleStats:
+    """One scheduling round's outcome (the reference's per-cycle parquet row,
+    simulator/sink/sink.go OnCycleEnd)."""
+
+    time: float
+    pool: str
+    scheduled: int
+    preempted: int
+    failed: int
+    queued_after: int
+    running_after: int
+    share_by_queue: dict
+
+
+@dataclasses.dataclass
+class SimulationResult:
+    makespan: float
+    total_scheduled: int
+    total_preempted: int
+    total_succeeded: int
+    total_failed: int
+    never_scheduled: list
+    cycles: list  # list[CycleStats]
+    events: list  # (time, kind, job_id) job lifecycle trace
+    success_time_by_job: dict
+
+
+class Simulator:
+    """Deterministic discrete-event simulation of the full scheduling stack."""
+
+    def __init__(
+        self,
+        cluster_spec: ClusterSpec,
+        workload_spec: WorkloadSpec,
+        config: Optional[SchedulingConfig] = None,
+        *,
+        schedule_interval_s: float = 10.0,
+        max_time_s: float = 30 * 86400.0,
+        sink: Optional[Callable[[CycleStats], None]] = None,
+    ):
+        self.config = config or SchedulingConfig()
+        self.cluster_spec = cluster_spec
+        self.workload_spec = workload_spec
+        self.schedule_interval = schedule_interval_s
+        self.max_time = max_time_s
+        self.sink = sink
+        self.rng = np.random.default_rng(workload_spec.random_seed or 0)
+
+        # --- clusters -> NodeSpecs per pool (simulator.go setupClusters:316)
+        self.nodes: list[NodeSpec] = []
+        self.pools: list[str] = []
+        for cluster in cluster_spec.clusters:
+            if cluster.pool not in self.pools:
+                self.pools.append(cluster.pool)
+            for ti, tmpl in enumerate(cluster.node_templates):
+                factory = self.config.resource_list_factory()
+                total = factory.from_mapping(tmpl.total_resources)
+                for k in range(tmpl.number):
+                    self.nodes.append(
+                        NodeSpec(
+                            id=f"{cluster.name}-{ti}-{k}",
+                            pool=cluster.pool,
+                            executor=cluster.name,
+                            total_resources=total,
+                            taints=tmpl.taints,
+                            labels=dict(tmpl.labels),
+                        )
+                    )
+
+        self.queues = [Queue(q.name, q.weight) for q in workload_spec.queues]
+
+        factory = self.config.resource_list_factory()
+        self._pool_total = {
+            pool: np.zeros(factory.num_resources, np.float64) for pool in self.pools
+        }
+        for n in self.nodes:
+            if n.total_resources is not None:
+                self._pool_total[n.pool] += n.total_resources.atoms
+
+        # --- template DAG (dependencies, simulator.go bootstrapWorkload:386)
+        self.templates: dict[str, _TemplateState] = {}
+        for q in workload_spec.queues:
+            for tmpl in q.job_templates:
+                self.templates[tmpl.id] = _TemplateState(tmpl)
+        for ts in self.templates.values():
+            for dep in ts.template.dependencies:
+                if dep not in self.templates:
+                    raise ValueError(f"unknown dependency template {dep!r}")
+                self.templates[dep].dependents.append(ts.template.id)
+
+        # --- state
+        self.now = 0.0
+        self.queued: dict[str, JobSpec] = {}
+        self.job_template: dict[str, str] = {}
+        self.job_attempts: dict[str, int] = {}
+        self.running: dict[str, _Running] = {}
+        self.succeeded: set = set()
+        self.failed: set = set()
+        self.success_time: dict[str, float] = {}
+        self.cycles: list[CycleStats] = []
+        self.trace: list = []
+        self._heap: list = []
+        self._seq = 0
+        self._schedule_pending = False
+        self._total_scheduled = 0
+        self._total_preempted = 0
+
+        # seed initial submissions
+        for ts in self.templates.values():
+            if not ts.template.dependencies:
+                self._push(ts.template.earliest_submit_time_s, _SUBMIT, ts.template.id)
+
+    # --- event plumbing ---------------------------------------------------------
+
+    def _push(self, t: float, kind: int, payload):
+        self._seq += 1
+        heapq.heappush(self._heap, (t, kind, self._seq, payload))
+
+    def _request_schedule(self, t: float):
+        """Coalesce schedule requests: at most one pending round event
+        (the fast-forward -- no standing schedule tick during steady state)."""
+        if not self._schedule_pending:
+            self._schedule_pending = True
+            self._push(t, _SCHEDULE, None)
+
+    # --- handlers ---------------------------------------------------------------
+
+    def _submit_template(self, template_id: str):
+        ts = self.templates[template_id]
+        tmpl = ts.template
+        factory = self.config.resource_list_factory()
+        resources = factory.from_mapping(tmpl.requests)
+        card = max(1, tmpl.gang_cardinality)
+        batch = ts.submitted
+        for i in range(tmpl.number):
+            jid = f"{tmpl.id}-{batch + i}"
+            gang = f"{tmpl.id}-b{batch}-g{i // card}" if tmpl.gang_cardinality else ""
+            job = JobSpec(
+                id=jid,
+                queue=tmpl.queue,
+                jobset=tmpl.job_set,
+                priority_class=tmpl.priority_class_name,
+                priority=tmpl.queue_priority,
+                submit_time=self.now,
+                resources=resources,
+                node_selector=dict(tmpl.node_selector),
+                gang_id=gang,
+                gang_cardinality=card if tmpl.gang_cardinality else 1,
+                gang_node_uniformity_label=tmpl.gang_node_uniformity_label,
+            )
+            self.queued[jid] = job
+            self.job_template[jid] = tmpl.id
+            self.job_attempts[jid] = 0
+            self.trace.append((self.now, "submitted", jid))
+        ts.submitted += tmpl.number
+        if tmpl.repeat and ts.submitted < tmpl.number * tmpl.repeat.num_times:
+            self._push(self.now + tmpl.repeat.period_s, _SUBMIT, template_id)
+        self._request_schedule(self.now)
+
+    def _template_target(self, ts: _TemplateState) -> int:
+        """Total jobs a template will ever produce (repeat-aware)."""
+        tmpl = ts.template
+        return tmpl.number * (tmpl.repeat.num_times if tmpl.repeat else 1)
+
+    def _finish_job(self, job_id: str, attempt: int):
+        run = self.running.get(job_id)
+        if run is None:
+            return  # preempted before completion
+        if self.job_attempts.get(job_id, 0) != attempt:
+            return  # stale finish from a lease that was preempted; a newer run exists
+        del self.running[job_id]
+        self.succeeded.add(job_id)
+        self.success_time[job_id] = self.now
+        self.trace.append((self.now, "succeeded", job_id))
+        tid = self.job_template.get(job_id)
+        if tid is not None:
+            ts = self.templates[tid]
+            ts.succeeded += 1
+            if ts.succeeded == self._template_target(ts):
+                for dep_id in ts.dependents:
+                    dep = self.templates[dep_id]
+                    if all(
+                        self.templates[d].succeeded >= self._template_target(self.templates[d])
+                        for d in dep.template.dependencies
+                    ):
+                        delay = dep.template.earliest_submit_time_from_dependency_completion_s
+                        at = max(
+                            self.now + delay, dep.template.earliest_submit_time_s
+                        )
+                        self._push(at, _SUBMIT, dep_id)
+        self._request_schedule(self.now)
+
+    def _run_rounds(self):
+        """One schedule event: a round per pool, like FairSchedulingAlgo
+        iterating pools (scheduling_algo.go:126-186)."""
+        self._schedule_pending = False
+        progress = False
+        for pool in self.pools:
+            pool_running = [
+                RunningJobSpec(job=r.job, node_id=r.node_id)
+                for r in self.running.values()
+                if r.pool == pool
+            ]
+            if not self.queued and not pool_running:
+                continue
+            outcome = run_scheduling_round(
+                self.config,
+                pool=pool,
+                nodes=self.nodes,
+                queues=self.queues,
+                queued_jobs=list(self.queued.values()),
+                running=pool_running,
+            )
+            wf_delay = self.cluster_spec.workflow_manager_delay
+            pend_delay = self.cluster_spec.pending_delay
+            for jid, node_id in outcome.scheduled.items():
+                job = self.queued.pop(jid)
+                tmpl = self.templates[self.job_template[jid]].template
+                runtime = tmpl.runtime.sample(self.rng)
+                start_delay = wf_delay.sample(self.rng) + pend_delay.sample(self.rng)
+                finish = self.now + start_delay + runtime
+                self.running[jid] = _Running(job, node_id, pool, finish)
+                self._push(finish, _FINISH, (jid, self.job_attempts.get(jid, 0)))
+                self.trace.append((self.now, "leased", jid))
+                progress = True
+            for jid in outcome.preempted:
+                run = self.running.pop(jid, None)
+                if run is None:
+                    continue
+                self.trace.append((self.now, "preempted", jid))
+                self._total_preempted += 1
+                attempts = self.job_attempts.get(jid, 0) + 1
+                self.job_attempts[jid] = attempts
+                if attempts > self.config.max_retries:
+                    self.failed.add(jid)
+                    self.trace.append((self.now, "failed", jid))
+                else:
+                    self.queued[jid] = run.job
+                progress = True
+            self._total_scheduled += len(outcome.scheduled)
+
+            # per-queue actual share for the sink
+            total = self._pool_total[pool]
+            share: dict = {}
+            for r in self.running.values():
+                if r.pool != pool or r.job.resources is None:
+                    continue
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    frac = np.where(total > 0, r.job.resources.atoms / np.maximum(total, 1), 0.0)
+                share[r.job.queue] = share.get(r.job.queue, 0.0) + float(frac.max())
+            stats = CycleStats(
+                time=self.now,
+                pool=pool,
+                scheduled=len(outcome.scheduled),
+                preempted=len(outcome.preempted),
+                failed=len(outcome.failed),
+                queued_after=len(self.queued),
+                running_after=len(self.running),
+                share_by_queue=share,
+            )
+            self.cycles.append(stats)
+            if self.sink:
+                self.sink(stats)
+        if progress and self.queued:
+            # capacity may free mid-round horizon; try again one interval later
+            self._request_schedule(self.now + self.schedule_interval)
+
+    # --- main loop --------------------------------------------------------------
+
+    def run(self) -> SimulationResult:
+        """simulator.go Run:212: pop events in time order until drained."""
+        while self._heap:
+            t, kind, _, payload = heapq.heappop(self._heap)
+            if t > self.max_time:
+                break
+            self.now = max(self.now, t)
+            if kind == _SUBMIT:
+                self._submit_template(payload)
+            elif kind == _FINISH:
+                self._finish_job(*payload)
+            else:
+                self._run_rounds()
+        return SimulationResult(
+            makespan=self.now,
+            total_scheduled=self._total_scheduled,
+            total_preempted=self._total_preempted,
+            total_succeeded=len(self.succeeded),
+            total_failed=len(self.failed),
+            never_scheduled=sorted(self.queued),
+            cycles=self.cycles,
+            events=self.trace,
+            success_time_by_job=self.success_time,
+        )

@@ -1,0 +1,232 @@
+"""Cluster + workload specifications for the simulator.
+
+Schema-compatible with the reference's simulator protos
+(internal/scheduler/simulator/simulator.proto:11-95): the same YAML documents
+(testdata/clusters/*.yaml, testdata/workloads/*.yaml) parse here, with k8s-style
+quantities and duration strings.  Dataclasses instead of protobuf -- the spec
+never crosses a process boundary in this framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Mapping, Optional, Sequence
+
+from armada_tpu.core.types import Taint
+
+_DURATION_RE = re.compile(r"([0-9]*\.?[0-9]+)\s*(ms|s|m|h|d|)")
+_DURATION_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0, "": 1.0}
+
+
+def parse_duration(d) -> float:
+    """'5m', '90s', '1h30m', '300ms', bare numbers (seconds) -> seconds."""
+    if d is None:
+        return 0.0
+    if isinstance(d, (int, float)):
+        return float(d)
+    s = str(d).strip()
+    if not s:
+        return 0.0
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration: {d!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration: {d!r}")
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class ShiftedExponential:
+    """Job-runtime / delay distribution: minimum + Exp(tail_mean)
+    (simulator.proto:93-95; the reference cites Severinson's thesis for why)."""
+
+    minimum_s: float = 0.0
+    tail_mean_s: float = 0.0
+
+    def sample(self, rng) -> float:
+        if self.tail_mean_s <= 0:
+            return self.minimum_s
+        return self.minimum_s + rng.exponential(self.tail_mean_s)
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping]) -> "ShiftedExponential":
+        if not d:
+            return ShiftedExponential()
+        return ShiftedExponential(
+            minimum_s=parse_duration(d.get("minimum")),
+            tail_mean_s=parse_duration(d.get("tailMean")),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeTemplate:
+    """number x identical nodes (simulator.proto NodeTemplate)."""
+
+    number: int
+    total_resources: Mapping[str, str]
+    taints: tuple[Taint, ...] = ()
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterTemplate:
+    name: str
+    pool: str
+    node_templates: tuple[NodeTemplate, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    name: str
+    clusters: tuple[ClusterTemplate, ...]
+    workflow_manager_delay: ShiftedExponential = ShiftedExponential()
+    pending_delay: ShiftedExponential = ShiftedExponential()
+
+
+@dataclasses.dataclass(frozen=True)
+class RepeatDetails:
+    num_times: int
+    period_s: float
+
+
+@dataclasses.dataclass(frozen=True)
+class JobTemplate:
+    """number x identical jobs (simulator.proto JobTemplate)."""
+
+    number: int
+    id: str = ""
+    queue: str = ""
+    job_set: str = ""
+    queue_priority: int = 0
+    priority_class_name: str = ""
+    requests: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    node_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    dependencies: tuple[str, ...] = ()
+    earliest_submit_time_s: float = 0.0
+    earliest_submit_time_from_dependency_completion_s: float = 0.0
+    runtime: ShiftedExponential = ShiftedExponential()
+    gang_cardinality: int = 0
+    gang_node_uniformity_label: str = ""
+    repeat: Optional[RepeatDetails] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueSpec:
+    name: str
+    weight: float
+    job_templates: tuple[JobTemplate, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    name: str
+    queues: tuple[QueueSpec, ...]
+    random_seed: int = 0
+
+
+# --- YAML loading (reference testdata key names) ------------------------------
+
+
+def _parse_taints(lst) -> tuple[Taint, ...]:
+    return tuple(
+        Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule")) for t in lst or ()
+    )
+
+
+def cluster_spec_from_dict(d: Mapping) -> ClusterSpec:
+    clusters = []
+    for c in d.get("clusters", ()):
+        templates = []
+        for nt in c.get("nodeTemplates", ()):
+            total = nt.get("totalResources", {})
+            resources = total.get("resources", total)  # both nestings seen in testdata
+            templates.append(
+                NodeTemplate(
+                    number=int(nt.get("number", 1)),
+                    total_resources=dict(resources),
+                    taints=_parse_taints(nt.get("taints")),
+                    labels=dict(nt.get("labels", {})),
+                )
+            )
+        clusters.append(
+            ClusterTemplate(
+                name=c.get("name", f"cluster-{len(clusters)}"),
+                pool=c.get("pool", "default"),
+                node_templates=tuple(templates),
+            )
+        )
+    return ClusterSpec(
+        name=d.get("name", ""),
+        clusters=tuple(clusters),
+        workflow_manager_delay=ShiftedExponential.from_dict(
+            d.get("workflowManagerDelayDistribution")
+        ),
+        pending_delay=ShiftedExponential.from_dict(d.get("pendingDelayDistribution")),
+    )
+
+
+def _job_template_from_dict(jt: Mapping, queue: str, index: int) -> JobTemplate:
+    reqs = jt.get("requirements", {})
+    rr = reqs.get("resourceRequirements", {})
+    requests = dict(rr.get("requests", {}))
+    selector = dict(reqs.get("nodeSelector", {}))
+    repeat = None
+    if jt.get("repeat"):
+        repeat = RepeatDetails(
+            num_times=int(jt["repeat"]["numTimes"]),
+            period_s=parse_duration(jt["repeat"].get("period")),
+        )
+    return JobTemplate(
+        number=int(jt.get("number", 1)),
+        id=jt.get("id") or f"{queue}-template-{index}",
+        queue=queue,
+        job_set=jt.get("jobSet", ""),
+        queue_priority=int(jt.get("queuePriority", 0)),
+        priority_class_name=jt.get("priorityClassName", ""),
+        requests=requests,
+        node_selector=selector,
+        dependencies=tuple(jt.get("dependencies", ())),
+        earliest_submit_time_s=parse_duration(jt.get("earliestSubmitTime")),
+        earliest_submit_time_from_dependency_completion_s=parse_duration(
+            jt.get("earliestSubmitTimeFromDependencyCompletion")
+        ),
+        runtime=ShiftedExponential.from_dict(jt.get("runtimeDistribution")),
+        gang_cardinality=int(jt.get("gangCardinality", 0)),
+        gang_node_uniformity_label=jt.get("gangNodeUniformityLabel", ""),
+        repeat=repeat,
+    )
+
+
+def workload_spec_from_dict(d: Mapping) -> WorkloadSpec:
+    queues = []
+    for q in d.get("queues", ()):
+        name = q["name"]
+        templates = tuple(
+            _job_template_from_dict(jt, name, i)
+            for i, jt in enumerate(q.get("jobTemplates", ()))
+        )
+        queues.append(QueueSpec(name=name, weight=float(q.get("weight", 1.0)), job_templates=templates))
+    return WorkloadSpec(
+        name=d.get("name", ""),
+        queues=tuple(queues),
+        random_seed=int(d.get("randomSeed", 0)),
+    )
+
+
+def cluster_spec_from_yaml(path: str) -> ClusterSpec:
+    import yaml
+
+    with open(path) as f:
+        return cluster_spec_from_dict(yaml.safe_load(f))
+
+
+def workload_spec_from_yaml(path: str) -> WorkloadSpec:
+    import yaml
+
+    with open(path) as f:
+        return workload_spec_from_dict(yaml.safe_load(f))

@@ -1,0 +1,80 @@
+"""Headline benchmark: one full scheduling round at reference scale.
+
+Metric (BASELINE.json): wall-clock of a scheduling round over 1M queued jobs x
+50k nodes, scheduling a full default burst (1,000 jobs, the reference's
+maximumSchedulingBurst, config/scheduler/config.yaml:104).  The reference
+budgets maxSchedulingDuration=5s per round (config.yaml:3) -- that is the
+baseline; the north star is <1s on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = 5.0 / value  (x times faster than the reference's round budget).
+
+Env knobs for local runs: ARMADA_BENCH_JOBS, ARMADA_BENCH_NODES,
+ARMADA_BENCH_QUEUES, ARMADA_BENCH_REPEATS.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from armada_tpu.models.fair_scheduler import schedule_round
+from armada_tpu.models.problem import SchedulingProblem
+from armada_tpu.models.synthetic import synthetic_problem
+
+BASELINE_ROUND_BUDGET_S = 5.0
+
+
+def main():
+    num_gangs = int(os.environ.get("ARMADA_BENCH_JOBS", 1_000_000))
+    num_nodes = int(os.environ.get("ARMADA_BENCH_NODES", 50_000))
+    num_queues = int(os.environ.get("ARMADA_BENCH_QUEUES", 64))
+    repeats = int(os.environ.get("ARMADA_BENCH_REPEATS", 3))
+
+    problem, meta = synthetic_problem(
+        num_nodes=num_nodes,
+        num_gangs=num_gangs,
+        num_queues=num_queues,
+        num_runs=num_nodes // 2,
+        global_burst=1_000,
+        perq_burst=1_000,
+        seed=7,
+    )
+    dev = jax.device_put(SchedulingProblem(*(jnp.asarray(a) for a in problem)))
+    kw = dict(
+        num_levels=meta["num_levels"],
+        max_slots=meta["max_slots"],
+        slot_width=meta["slot_width"],
+    )
+
+    # compile + warm up
+    result = schedule_round(dev, **kw)
+    jax.block_until_ready(result)
+    scheduled = int(result.scheduled_count)
+    iters = int(result.iterations)
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        r = schedule_round(dev, **kw)
+        jax.block_until_ready(r)
+        times.append(time.perf_counter() - t0)
+    value = min(times)
+
+    assert scheduled > 0, f"round scheduled nothing ({iters} iterations)"
+    print(
+        json.dumps(
+            {
+                "metric": f"scheduling_round_wall_clock_{num_gangs//1000}kjobs_x_{num_nodes//1000}knodes",
+                "value": round(value, 4),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_ROUND_BUDGET_S / value, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Sharded round == single-device round, on the 8-device virtual CPU mesh.
+
+The driver validates the multi-chip path the same way (__graft_entry__.py
+dryrun_multichip); here we additionally assert numerical equality with the
+unsharded kernel across scenario shapes (fairness split, gangs, preemption).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.models import build_problem, decode_result, schedule_round
+from armada_tpu.models.problem import SchedulingProblem
+from armada_tpu.parallel import make_mesh, shard_problem, sharded_schedule_round
+
+from tests.test_round_scheduler import job, make_config, node, rl
+
+
+def _both_rounds(cfg, nodes, queues, jobs, running=(), mesh=None):
+    problem, ctx = build_problem(
+        cfg, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs, running=running
+    )
+    kw = dict(
+        num_levels=len(ctx.ladder) + 1,
+        max_slots=ctx.max_slots,
+        slot_width=ctx.slot_width,
+    )
+    dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    single = schedule_round(dev, **kw)
+    if mesh is None:
+        mesh = make_mesh()
+    sharded = sharded_schedule_round(problem, mesh, **kw)
+    return decode_result(single, ctx), decode_result(sharded, ctx)
+
+
+def _assert_same(a, b):
+    assert a.scheduled == b.scheduled
+    assert a.preempted == b.preempted
+    assert sorted(a.failed) == sorted(b.failed)
+
+
+def test_mesh_uses_all_devices():
+    mesh = make_mesh()
+    assert mesh.devices.size == len(jax.devices()) == 8
+
+
+def test_sharded_fair_split_matches():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(10)]
+    jobs = [job(cfg, f"a{i}", "A", cpu="1") for i in range(10)] + [
+        job(cfg, f"b{i}", "B", cpu="1") for i in range(10)
+    ]
+    s, p = _both_rounds(cfg, nodes, [Queue("A"), Queue("B")], jobs)
+    _assert_same(s, p)
+    a = sum(1 for j in p.scheduled if j.startswith("a"))
+    assert a == 5
+
+
+def test_sharded_gang_matches():
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="2", memory="4Gi") for i in range(4)]
+    jobs = [job(cfg, f"g-{i}", "A", cpu="1", gang_id="g", gang_cardinality=6) for i in range(6)]
+    s, p = _both_rounds(cfg, nodes, [Queue("A")], jobs)
+    _assert_same(s, p)
+    assert len(p.scheduled) == 6
+
+
+def test_sharded_preemption_matches():
+    cfg = make_config(protected_fraction_of_fair_share=0.5)
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(4)]
+    running = [
+        RunningJob(job(cfg, f"a{i}", "A", cpu="1", pc="p0"), node_id=f"n{i}") for i in range(4)
+    ]
+    newjobs = [job(cfg, f"b{i}", "B", cpu="1", pc="p0") for i in range(4)]
+    s, p = _both_rounds(cfg, nodes, [Queue("A"), Queue("B")], newjobs, running)
+    _assert_same(s, p)
+    assert len(p.preempted) == 2
+
+
+def test_sharded_2d_mesh_matches():
+    cfg = make_config()
+    mesh = make_mesh(node_shards=4, job_shards=2)
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(12)]
+    jobs = [job(cfg, f"a{i}", "A", cpu="1") for i in range(8)] + [
+        job(cfg, f"b{i}", "B", cpu="1") for i in range(8)
+    ]
+    s, p = _both_rounds(cfg, nodes, [Queue("A"), Queue("B")], jobs, mesh=mesh)
+    _assert_same(s, p)
+
+
+def test_shard_problem_places_on_mesh():
+    cfg = make_config()
+    mesh = make_mesh()
+    nodes = [node(cfg, f"n{i}", cpu="1", memory="2Gi") for i in range(3)]
+    problem, _ = build_problem(
+        cfg, pool="default", nodes=nodes, queues=[Queue("A")],
+        queued_jobs=[job(cfg, "j0", "A")],
+    )
+    sharded = shard_problem(problem, mesh)
+    # node axis split 8 ways: each shard holds N/8 rows
+    n = sharded.node_total.shape[0]
+    shard_shapes = {s.data.shape for s in sharded.node_total.addressable_shards}
+    assert shard_shapes == {(n // 8, sharded.node_total.shape[1])}
+    # replicated tensors: every device holds the full array
+    assert all(
+        s.data.shape == sharded.q_weight.shape
+        for s in sharded.q_weight.addressable_shards
+    )

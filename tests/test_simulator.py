@@ -1,0 +1,287 @@
+"""Simulator macro-behavior tests, modeled on the reference's
+internal/scheduler/simulator/simulator_test.go: YAML-specified clusters +
+workloads, assertions about completion, fair shares and preemption counts."""
+
+import yaml
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.simulator import (
+    Simulator,
+    cluster_spec_from_dict,
+    parse_duration,
+    workload_spec_from_dict,
+)
+
+
+def sim_config(**overrides) -> SchedulingConfig:
+    base = dict(
+        supported_resource_types=(("memory", "1Mi"), ("cpu", "1m"), ("nvidia.com/gpu", "1")),
+        priority_classes={
+            "armada-default": PriorityClass("armada-default", priority=1000, preemptible=False),
+            "armada-preemptible": PriorityClass("armada-preemptible", priority=900, preemptible=True),
+        },
+        default_priority_class="armada-default",
+        dominant_resource_fairness_resources=("cpu", "memory", "nvidia.com/gpu"),
+        shape_bucket=8,
+        maximum_scheduling_burst=10_000,
+        maximum_per_queue_scheduling_burst=10_000,
+        maximum_resource_fraction_to_schedule={},
+    )
+    base.update(overrides)
+    return SchedulingConfig(**base)
+
+
+def cluster(yaml_text: str):
+    return cluster_spec_from_dict(yaml.safe_load(yaml_text))
+
+
+def workload(yaml_text: str):
+    return workload_spec_from_dict(yaml.safe_load(yaml_text))
+
+
+TINY_CLUSTER = """
+name: tiny
+clusters:
+  - name: c0
+    pool: cpu
+    nodeTemplates:
+      - number: 2
+        totalResources:
+          resources: {cpu: "16", memory: "64Gi"}
+"""
+
+BASIC_WORKLOAD = """
+name: basic
+randomSeed: 42
+queues:
+  - name: A
+    weight: 1
+    jobTemplates:
+      - id: tA
+        number: 10
+        priorityClassName: armada-default
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 1, memory: 2Gi}
+        runtimeDistribution: {minimum: "5m"}
+"""
+
+
+def test_parse_duration():
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("300ms") == 0.3
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration(42) == 42.0
+    assert parse_duration(None) == 0.0
+
+
+def test_basic_workload_all_succeed():
+    sim = Simulator(cluster(TINY_CLUSTER), workload(BASIC_WORKLOAD), sim_config())
+    result = sim.run()
+    assert result.total_succeeded == 10
+    assert result.never_scheduled == []
+    assert result.total_failed == 0
+    # 32 cpus, 10 1-cpu jobs: all fit at once; makespan ~ one runtime
+    assert result.makespan == pytest.approx(300.0, abs=1.0)
+
+
+def test_capacity_contention_serializes():
+    """40 jobs x 8 cpu on 32 cpus: 4 waves of ~10 -> makespan ~ 4 runtimes."""
+    wl = workload(
+        """
+name: waves
+randomSeed: 1
+queues:
+  - name: A
+    weight: 1
+    jobTemplates:
+      - id: tA
+        number: 16
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 8, memory: 1Gi}
+        runtimeDistribution: {minimum: "10m"}
+"""
+    )
+    sim = Simulator(cluster(TINY_CLUSTER), wl, sim_config())
+    result = sim.run()
+    assert result.total_succeeded == 16
+    # 4 jobs fit at a time (32/8) -> 4 waves x 600s
+    assert result.makespan == pytest.approx(4 * 600.0, rel=0.1)
+
+
+def test_two_queue_fair_share_over_time():
+    wl = workload(
+        """
+name: contention
+randomSeed: 7
+queues:
+  - name: A
+    weight: 1
+    jobTemplates:
+      - id: tA
+        number: 40
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 4, memory: 1Gi}
+        runtimeDistribution: {minimum: "10m"}
+  - name: B
+    weight: 1
+    jobTemplates:
+      - id: tB
+        number: 40
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 4, memory: 1Gi}
+        runtimeDistribution: {minimum: "10m"}
+"""
+    )
+    sim = Simulator(cluster(TINY_CLUSTER), wl, sim_config())
+    result = sim.run()
+    assert result.total_succeeded == 80
+    # while both queues are backlogged, each should hold ~half the cpus
+    mid = [c for c in result.cycles if c.queued_after > 8]
+    assert mid, "expected contended cycles"
+    for c in mid:
+        a = c.share_by_queue.get("A", 0.0)
+        b = c.share_by_queue.get("B", 0.0)
+        if a + b > 0.9:  # cluster saturated
+            assert abs(a - b) < 0.15
+
+
+def test_preemption_rebalances_late_queue():
+    cfg = sim_config(protected_fraction_of_fair_share=0.5)
+    wl = workload(
+        """
+name: preempt
+randomSeed: 3
+queues:
+  - name: hog
+    weight: 1
+    jobTemplates:
+      - id: th
+        number: 8
+        priorityClassName: armada-preemptible
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 4, memory: 1Gi}
+        runtimeDistribution: {minimum: "2h"}
+  - name: late
+    weight: 1
+    jobTemplates:
+      - id: tl
+        number: 8
+        priorityClassName: armada-preemptible
+        earliestSubmitTime: "15m"
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 4, memory: 1Gi}
+        runtimeDistribution: {minimum: "2h"}
+"""
+    )
+    sim = Simulator(cluster(TINY_CLUSTER), wl, cfg)
+    result = sim.run()
+    # hog fills the cluster; when late arrives, fair-share eviction frees half
+    assert result.total_preempted >= 2
+    late_start = min(
+        t for t, kind, jid in result.events if kind == "leased" and jid.startswith("tl")
+    )
+    assert late_start < parse_duration("30m") + 1
+    assert result.total_succeeded == 16  # preempted jobs retry and finish
+
+
+def test_gang_workload_schedules_atomically():
+    wl = workload(
+        """
+name: gangs
+randomSeed: 5
+queues:
+  - name: G
+    weight: 1
+    jobTemplates:
+      - id: tg
+        number: 8
+        gangCardinality: 4
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 8, memory: 1Gi}
+        runtimeDistribution: {minimum: "5m"}
+"""
+    )
+    sim = Simulator(cluster(TINY_CLUSTER), wl, sim_config())
+    result = sim.run()
+    assert result.total_succeeded == 8
+    # each gang of 4x8cpu = 32 cpus = whole cluster: gangs run one at a time,
+    # and each gang's 4 members lease at the same instant
+    gang_starts = {}
+    for t, kind, jid in result.events:
+        if kind == "leased":
+            idx = int(jid.rsplit("-", 1)[1])
+            gang_starts.setdefault(idx // 4, set()).add(t)
+    assert all(len(starts) == 1 for starts in gang_starts.values())
+
+
+def test_dependencies_run_in_order():
+    wl = workload(
+        """
+name: dag
+randomSeed: 9
+queues:
+  - name: D
+    weight: 1
+    jobTemplates:
+      - id: stage1
+        number: 4
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 1, memory: 1Gi}
+        runtimeDistribution: {minimum: "5m"}
+      - id: stage2
+        number: 4
+        dependencies: [stage1]
+        earliestSubmitTimeFromDependencyCompletion: "1m"
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 1, memory: 1Gi}
+        runtimeDistribution: {minimum: "5m"}
+"""
+    )
+    sim = Simulator(cluster(TINY_CLUSTER), wl, sim_config())
+    result = sim.run()
+    assert result.total_succeeded == 8
+    s1_done = max(t for t, k, j in result.events if k == "succeeded" and j.startswith("stage1"))
+    s2_start = min(t for t, k, j in result.events if k == "submitted" and j.startswith("stage2"))
+    assert s2_start == pytest.approx(s1_done + 60.0, abs=1.0)
+
+
+def test_repeat_template_resubmits():
+    wl = workload(
+        """
+name: repeat
+randomSeed: 11
+queues:
+  - name: R
+    weight: 1
+    jobTemplates:
+      - id: tr
+        number: 2
+        repeat: {numTimes: 3, period: "30m"}
+        requirements:
+          resourceRequirements:
+            requests: {cpu: 1, memory: 1Gi}
+        runtimeDistribution: {minimum: "1m"}
+"""
+    )
+    sim = Simulator(cluster(TINY_CLUSTER), wl, sim_config())
+    result = sim.run()
+    assert result.total_succeeded == 6  # 2 jobs x 3 submissions
+    submits = sorted(t for t, k, j in result.events if k == "submitted")
+    assert submits[0] == 0.0 and submits[-1] == pytest.approx(3600.0, abs=1.0)
+
+
+def test_determinism_same_seed():
+    a = Simulator(cluster(TINY_CLUSTER), workload(BASIC_WORKLOAD), sim_config()).run()
+    b = Simulator(cluster(TINY_CLUSTER), workload(BASIC_WORKLOAD), sim_config()).run()
+    assert a.makespan == b.makespan
+    assert a.events == b.events
