@@ -85,6 +85,7 @@ class _Carry(NamedTuple):
     q_alloc_pc: jax.Array
     q_killed: jax.Array
     q_sched: jax.Array
+    q_head: jax.Array  # i32[Q] cursor into the (queue, order)-sorted gang index
     g_state: jax.Array
     key_bad: jax.Array
     run_rescheduled: jax.Array
@@ -98,6 +99,12 @@ class _Carry(NamedTuple):
     iterations: jax.Array
     done: jax.Array
     termination: jax.Array
+
+
+# How many queue-head entries each queue can skip (retired gangs, unfeasible
+# scheduling keys) per iteration.  Skipping is the rare path -- the window just
+# bounds how fast a mass-retired run of identical jobs drains.
+_SKIP_WINDOW = 16
 
 
 def _level_mask(num_levels: int, level, lo):
@@ -132,22 +139,35 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
     RJ = p.run_req.shape[0]
 
     def body(c: _Carry) -> _Carry:
-        pending = (c.g_state == 0) & p.g_valid
-        is_new = p.g_run < 0
-        blocked = (c.new_blocked | c.q_killed[p.g_queue]) & is_new
-        eligible = pending & ~blocked
+        # --- advance per-queue cursors past retired/unfeasible heads ------------
+        # Window gather into the (queue, order)-sorted gang index: O(Q*W), never
+        # O(G).  An entry is skippable if its gang was already decided (state!=0)
+        # or its scheduling key is registered unfeasible (gang_scheduler.go:85-96
+        # -- the reference skips these through its iterator the same way).
+        W = _SKIP_WINDOW
+        offs = c.q_head[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [Q, W]
+        in_r = offs < p.q_len[:, None]
+        slot = jnp.clip(p.q_start[:, None] + offs, 0, G - 1)
+        wg = p.gq_gang[slot]  # [Q, W] gang ids
+        wkey = p.g_key[wg]
+        wbad = jnp.bool_(check_keys) & (wkey >= 0) & c.key_bad[jnp.maximum(wkey, 0)]
+        skippable = in_r & ((c.g_state[wg] != 0) | wbad)
+        lead = jnp.cumprod(skippable.astype(jnp.int32), axis=1)  # leading-True run
+        nskip = jnp.sum(lead, axis=1).astype(jnp.int32)  # [Q]
+        q_head = c.q_head + nskip
+        advanced = jnp.any(nskip > 0)
 
-        # --- per-queue candidate: lowest in-queue order among eligible gangs ----
-        order_masked = jnp.where(eligible, p.g_order, _BIGI)
-        qmin = jax.ops.segment_min(order_masked, p.g_queue, num_segments=Q)
-        has = qmin < _BIGI
-        is_cand = eligible & (p.g_order == qmin[p.g_queue])
-        cand = jax.ops.segment_min(
-            jnp.where(is_cand, jnp.arange(G, dtype=jnp.int32), _BIGI),
-            p.g_queue,
-            num_segments=Q,
+        # --- per-queue candidate: the head entry, if visible in the window ------
+        pos = jnp.minimum(nskip, W - 1)
+        head_visible = (nskip < W) & jnp.take_along_axis(in_r, pos[:, None], axis=1)[:, 0]
+        cand = jnp.take_along_axis(wg, pos[:, None], axis=1)[:, 0]  # [Q]
+        cand = jnp.where(head_visible, cand, 0)
+        cand_new = p.g_run[cand] < 0
+        has = (
+            head_visible
+            & ~(cand_new & (c.new_blocked | c.q_killed))
+            & (p.q_weight > 0)
         )
-        cand = jnp.where(has, cand, 0)
 
         # --- queue order: min proposed DRF cost (queue_scheduler.go Less:589) ---
         req_tot_q = p.g_req[cand] * p.g_card[cand][:, None].astype(jnp.float32)
@@ -173,7 +193,8 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
 
         # --- constraint gates (constraints.go:97-159); all gated on any_q so the
         # --- dummy candidate of an exhausted round has no side effects ----------
-        unfeasible = any_q & check_keys & (key >= 0) & c.key_bad[jnp.maximum(key, 0)]
+        # (unfeasible scheduling keys never reach here: the cursor skip above
+        # retires them before candidate selection)
         hit_burst = (~is_evictee) & (c.sched_count + card > p.global_burst)
         hit_round_cap = (~is_evictee) & jnp.any(c.sched_res + req_tot > p.round_cap)
         hit_q_burst = (~is_evictee) & (c.q_sched[qstar] + card > p.perq_burst)
@@ -182,7 +203,7 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         )
         gate_global = (hit_burst | hit_round_cap) & any_q
         gate_queue = (hit_q_burst | hit_q_cap) & ~gate_global & any_q
-        attempt = any_q & ~unfeasible & ~gate_global & ~gate_queue
+        attempt = any_q & ~gate_global & ~gate_queue
 
         # --- fit masks ----------------------------------------------------------
         static_ok = jnp.where(key >= 0, p.compat[jnp.maximum(key, 0)][p.node_type], True)
@@ -257,15 +278,14 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         # --- gang state + unfeasible-key registration ---------------------------
         failed_fit = attempt & ~feasible
         g_state = c.g_state.at[g].set(
-            jnp.where(placed, 1, jnp.where(failed_fit | unfeasible, 2, c.g_state[g]))
+            jnp.where(placed, 1, jnp.where(failed_fit, 2, c.g_state[g]))
         )
+        # Registering the key retires every identical pending gang lazily: the
+        # cursor skip drops them as they reach a queue head, and the post-loop
+        # sweep in schedule_round marks them failed for reporting.
         register = failed_fit & (card == 1) & (key >= 0) & jnp.bool_(check_keys)
         key_bad = c.key_bad.at[jnp.maximum(key, 0)].set(
             jnp.where(register, True, c.key_bad[jnp.maximum(key, 0)])
-        )
-        # retire every pending gang with the now-unfeasible key in one sweep
-        g_state = jnp.where(
-            register & (c.g_state == 0) & (p.g_key == key), 2, g_state
         )
 
         q_killed = c.q_killed.at[qstar].set(c.q_killed[qstar] | gate_queue)
@@ -275,7 +295,7 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
             jnp.where(hit_burst, TERM_GLOBAL_BURST, TERM_ROUND_CAP),
             c.termination,
         )
-        done = ~any_q
+        done = ~any_q & ~advanced
 
         return _Carry(
             alloc=alloc,
@@ -283,6 +303,7 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
             q_alloc_pc=q_alloc_pc,
             q_killed=q_killed,
             q_sched=q_sched,
+            q_head=q_head,
             g_state=g_state,
             key_bad=key_bad,
             run_rescheduled=run_rescheduled,
@@ -386,7 +407,9 @@ def schedule_round(
     Q = p.q_weight.shape[0]
     C = p.pc_queue_cap.shape[0]
     if max_iterations <= 0:
-        max_iterations = G + Q + 8
+        # every iteration either decides a gang (<= G), advances a cursor
+        # (<= G total across the round), or is the final no-op
+        max_iterations = 2 * G + Q + 8
 
     runf = p.run_valid.astype(jnp.float32)
     used = jnp.zeros((num_levels, N, R), jnp.float32)
@@ -424,6 +447,7 @@ def schedule_round(
         q_alloc_pc=q_alloc_pc,
         q_killed=~(p.q_weight > 0),
         q_sched=jnp.zeros((Q,), jnp.int32),
+        q_head=jnp.zeros((Q,), jnp.int32),
         g_state=g_state,
         key_bad=jnp.zeros((p.compat.shape[0],), bool),
         run_rescheduled=jnp.zeros_like(run_evicted),
@@ -446,6 +470,18 @@ def schedule_round(
     termination = jnp.where(
         (~carry.done) & (carry.iterations >= max_iterations), TERM_MAX_ITER, carry.termination
     )
+
+    # Retire gangs whose scheduling key was registered unfeasible but which the
+    # cursor never reached (one O(G) sweep per round, not per iteration).
+    g_state_final = jnp.where(
+        (carry.g_state == 0)
+        & p.g_valid
+        & (p.g_key >= 0)
+        & carry.key_bad[jnp.maximum(p.g_key, 0)],
+        2,
+        carry.g_state,
+    )
+    carry = carry._replace(g_state=g_state_final)
 
     # --- oversubscription repair + second pass ---------------------------------
     alloc, q_alloc, run_evicted, run_rescheduled = _phase_b(

@@ -66,6 +66,13 @@ class SchedulingProblem(NamedTuple):
     g_order: np.ndarray  # i32[G] rank within its queue (evictees first)
     g_run: np.ndarray  # i32[G] backing run for evictee slots, else -1
     g_valid: np.ndarray  # bool[G]
+    # queue-ordered gang index: gangs sorted by (queue, order); per-queue
+    # contiguous slices.  The kernel's candidate scan is O(Q) gathers into this
+    # instead of O(G) segment reductions (the analog of the reference keeping
+    # per-queue sorted job iterators, queue_scheduler.go QueuedGangIterator:273).
+    gq_gang: np.ndarray  # i32[G] gang ids, (queue, order)-sorted
+    q_start: np.ndarray  # i32[Q] slice offset into gq_gang
+    q_len: np.ndarray  # i32[Q] slice length
     # queues
     q_weight: np.ndarray  # f32[Q] (0 = padding)
     q_cds: np.ndarray  # f32[Q] constrained demand share
@@ -116,6 +123,24 @@ class RoundOutcome:
 
 def _pad(n: int, bucket: int) -> int:
     return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+def queue_ordered_gang_index(
+    g_queue: np.ndarray, g_order: np.ndarray, num_real: int, G: int, Q: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(gq_gang[G], q_start[Q], q_len[Q]): gang ids sorted by (queue, order)
+    into per-queue contiguous slices -- the kernel's O(Q) candidate index
+    (SchedulingProblem.gq_gang)."""
+    gq_gang = np.zeros((G,), np.int32)
+    q_start = np.zeros((Q,), np.int32)
+    q_len = np.zeros((Q,), np.int32)
+    if num_real:
+        order = np.lexsort((g_order[:num_real], g_queue[:num_real]))
+        gq_gang[:num_real] = order.astype(np.int32)
+        counts = np.bincount(g_queue[:num_real], minlength=Q)
+        q_len[:] = counts
+        q_start[1:] = np.cumsum(counts)[:-1]
+    return gq_gang, q_start, q_len
 
 
 def _job_sort_key(pc_priority: int, job: JobSpec):
@@ -346,8 +371,13 @@ def build_problem(
                 ri = factory.index_of(name)
                 pc_queue_cap[ci, ri] = frac * total_pool[ri]
 
-    # --- queues: weights + constrained demand share ----------------------------
+    # --- queue-ordered gang index ----------------------------------------------
     Q = _pad(len(sorted_queues), bucket)
+    gq_gang, q_start, q_len = queue_ordered_gang_index(
+        g_queue, g_order, len(gangs), G, Q
+    )
+
+    # --- queues: weights + constrained demand share ----------------------------
     q_weight = np.zeros((Q,), np.float32)
     q_cds = np.zeros((Q,), np.float32)
     demand_by_pc = np.zeros((len(sorted_queues), C, R), np.float64)
@@ -394,6 +424,9 @@ def build_problem(
         g_order=g_order,
         g_run=g_run,
         g_valid=g_valid,
+        gq_gang=gq_gang,
+        q_start=q_start,
+        q_len=q_len,
         q_weight=q_weight,
         q_cds=q_cds,
         compat=compat,

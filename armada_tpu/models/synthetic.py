@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from armada_tpu.models.problem import SchedulingProblem
+from armada_tpu.models.problem import SchedulingProblem, queue_ordered_gang_index
 
 _INF = np.float32(3.0e38)
 
@@ -123,6 +123,8 @@ def synthetic_problem(
         run_preemptible[:num_runs] = rng.random(num_runs) < 0.5
         run_valid[:num_runs] = True
 
+    gq_gang, q_start, q_len = queue_ordered_gang_index(g_queue, g_order, num_gangs, G, Q)
+
     total_pool = node_total[:num_nodes].sum(axis=0, dtype=np.float64).astype(np.float32)
     drf_mult = np.ones((R,), np.float32)
     scale = node_total.max(axis=0)
@@ -158,6 +160,9 @@ def synthetic_problem(
         g_order=g_order,
         g_run=g_run,
         g_valid=g_valid,
+        gq_gang=gq_gang,
+        q_start=q_start,
+        q_len=q_len,
         q_weight=q_weight,
         q_cds=q_cds,
         compat=compat,

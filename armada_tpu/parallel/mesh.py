@@ -94,6 +94,11 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         g_order=jobsax,
         g_run=jobsax,
         g_valid=jobsax,
+        # gq_gang is read-only index data gathered with [Q,W] indices every
+        # iteration; replicated so the gather never crosses devices.
+        gq_gang=repl,
+        q_start=repl,
+        q_len=repl,
         q_weight=repl,
         q_cds=repl,
         compat=repl,

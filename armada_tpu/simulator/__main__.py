@@ -44,13 +44,15 @@ def main(argv=None) -> int:
     def pair_path(base: str, tag: str) -> str:
         if len(args.clusters) == 1 and len(args.workloads) == 1:
             return base
-        root, dot, ext = base.rpartition(".")
-        return f"{root}-{tag}.{ext}" if dot else f"{base}-{tag}"
+        root, ext = os.path.splitext(base)
+        return f"{root}-{tag}{ext}"
 
+    clusters = {c: cluster_spec_from_yaml(c) for c in args.clusters}
+    workloads = {w: workload_spec_from_yaml(w) for w in args.workloads}
     for cpath in args.clusters:
         for wpath in args.workloads:
-            cluster = cluster_spec_from_yaml(cpath)
-            workload = workload_spec_from_yaml(wpath)
+            cluster = clusters[cpath]
+            workload = workloads[wpath]
             tag = (
                 f"{os.path.splitext(os.path.basename(cpath))[0]}"
                 f"-{os.path.splitext(os.path.basename(wpath))[0]}"

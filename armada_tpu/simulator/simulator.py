@@ -94,14 +94,14 @@ class Simulator:
         self.rng = np.random.default_rng(workload_spec.random_seed or 0)
 
         # --- clusters -> NodeSpecs per pool (simulator.go setupClusters:316)
+        node_factory = self.config.resource_list_factory()
         self.nodes: list[NodeSpec] = []
         self.pools: list[str] = []
         for cluster in cluster_spec.clusters:
             if cluster.pool not in self.pools:
                 self.pools.append(cluster.pool)
             for ti, tmpl in enumerate(cluster.node_templates):
-                factory = self.config.resource_list_factory()
-                total = factory.from_mapping(tmpl.total_resources)
+                total = node_factory.from_mapping(tmpl.total_resources)
                 for k in range(tmpl.number):
                     self.nodes.append(
                         NodeSpec(
@@ -116,7 +116,7 @@ class Simulator:
 
         self.queues = [Queue(q.name, q.weight) for q in workload_spec.queues]
 
-        factory = self.config.resource_list_factory()
+        factory = self._factory = node_factory
         self._pool_total = {
             pool: np.zeros(factory.num_resources, np.float64) for pool in self.pools
         }
@@ -175,8 +175,7 @@ class Simulator:
     def _submit_template(self, template_id: str):
         ts = self.templates[template_id]
         tmpl = ts.template
-        factory = self.config.resource_list_factory()
-        resources = factory.from_mapping(tmpl.requests)
+        resources = self._factory.from_mapping(tmpl.requests)
         card = max(1, tmpl.gang_cardinality)
         batch = ts.submitted
         for i in range(tmpl.number):
