@@ -1,0 +1,219 @@
+"""ctypes binding for the native partitioned event log (native/eventlog.cc).
+
+The log is the framework's Pulsar equivalent (internal/common/pulsarutils in
+the reference): ordered partitions, byte-offset message ids, replay from any
+consumer position.  The shared library is built lazily from source with g++ the
+first time it is needed, then cached next to this module.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator, NamedTuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_eventlog.so")
+_SRC = os.path.join(_HERE, os.pardir, os.pardir, "native", "eventlog.cc")
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH)
+        ):
+            # Single source of truth for compile flags: the native Makefile.
+            # A cross-process flock keeps concurrent first-importers (e.g.
+            # pytest-xdist workers) from racing the build output.
+            with open(_SO_PATH + ".lock", "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                subprocess.run(
+                    ["make", "-C", os.path.dirname(_SRC)], check=True,
+                    stdout=subprocess.DEVNULL,
+                )
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.el_open.restype = ctypes.c_void_p
+        lib.el_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.el_close.argtypes = [ctypes.c_void_p]
+        lib.el_num_partitions.restype = ctypes.c_int
+        lib.el_num_partitions.argtypes = [ctypes.c_void_p]
+        lib.el_append.restype = ctypes.c_int64
+        lib.el_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.el_end_offset.restype = ctypes.c_int64
+        lib.el_end_offset.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.el_read.restype = ctypes.c_int64
+        lib.el_read.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.el_flush.restype = ctypes.c_int
+        lib.el_flush.argtypes = [ctypes.c_void_p]
+        lib.el_reset.restype = ctypes.c_int
+        lib.el_reset.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class Message(NamedTuple):
+    """One log record: `offset` is its id; `next_offset` the resume position."""
+
+    partition: int
+    offset: int
+    next_offset: int
+    key: bytes
+    payload: bytes
+
+
+class EventLog:
+    """A durable partitioned append-only log (thread-safe appends)."""
+
+    def __init__(self, directory: str, num_partitions: int = 4):
+        self._lib = _load_lib()
+        os.makedirs(directory, exist_ok=True)
+        # The partition count is a permanent property of a log (it keys the
+        # jobset -> partition routing); persist it and reject mismatched opens
+        # rather than silently hiding partitions or re-routing keys.
+        meta_path = os.path.join(directory, "META")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                existing = int(f.read().strip())
+            if existing != num_partitions:
+                raise ValueError(
+                    f"event log at {directory} has {existing} partitions; "
+                    f"requested {num_partitions}"
+                )
+        else:
+            with open(meta_path, "w") as f:
+                f.write(str(num_partitions))
+        self._handle = self._lib.el_open(directory.encode(), num_partitions)
+        if not self._handle:
+            raise OSError(f"failed to open event log at {directory}")
+        self.directory = directory
+        self.num_partitions = num_partitions
+        self._closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.el_close(self._handle)
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _check_open(self) -> None:
+        # Guard every native call: the handle is freed memory after close().
+        if self._closed:
+            raise ValueError(f"event log at {self.directory} is closed")
+
+    def append(self, partition: int, key: bytes, payload: bytes) -> int:
+        """Append one record; returns its offset (the message id)."""
+        self._check_open()
+        off = self._lib.el_append(
+            self._handle, partition, key, len(key), payload, len(payload)
+        )
+        if off < 0:
+            raise OSError(f"append to partition {partition} failed")
+        return off
+
+    def end_offset(self, partition: int) -> int:
+        self._check_open()
+        return self._lib.el_end_offset(self._handle, partition)
+
+    def flush(self) -> None:
+        self._check_open()
+        if self._lib.el_flush(self._handle) != 0:
+            raise OSError("event log fsync failed")
+
+    def reset(self) -> None:
+        self._check_open()
+        if self._lib.el_reset(self._handle) != 0:
+            raise OSError("event log reset failed")
+
+    def read(
+        self,
+        partition: int,
+        offset: int,
+        max_bytes: int = 1 << 20,
+        max_msgs: int = 1 << 30,
+    ) -> list[Message]:
+        """Read whole records from `offset`; empty list means caught up."""
+        self._check_open()
+        end = self.end_offset(partition)
+        if offset >= end:
+            return []  # caught up: skip the buffer allocation entirely
+        max_bytes = min(max_bytes, end - offset)
+        while True:
+            buf = ctypes.create_string_buffer(max_bytes)
+            next_off = ctypes.c_int64(0)
+            n = self._lib.el_read(
+                self._handle,
+                partition,
+                offset,
+                buf,
+                max_bytes,
+                max_msgs,
+                ctypes.byref(next_off),
+            )
+            if n == -3:
+                # One record larger than the buffer: grow and retry rather
+                # than mis-reporting "caught up".
+                max_bytes *= 4
+                continue
+            if n == -2:
+                raise OSError(
+                    f"corrupt record in partition {partition} at/after offset {offset}"
+                )
+            if n < 0:
+                raise OSError(f"read from partition {partition} failed")
+            break
+        out: list[Message] = []
+        data = buf.raw[:n]
+        pos = 0
+        rec_off = offset
+        while pos < n:
+            paylen, keylen = struct.unpack_from("<II", data, pos)
+            key = bytes(data[pos + 8 : pos + 8 + keylen])
+            payload = bytes(data[pos + 8 + keylen : pos + 8 + keylen + paylen])
+            total = 8 + keylen + paylen + 4
+            out.append(Message(partition, rec_off, rec_off + total, key, payload))
+            pos += total
+            rec_off += total
+        assert rec_off == next_off.value
+        return out
+
+    def iter_from(self, partition: int, offset: int) -> Iterator[Message]:
+        """Iterate all records currently in the partition from `offset`."""
+        while True:
+            batch = self.read(partition, offset)
+            if not batch:
+                return
+            yield from batch
+            offset = batch[-1].next_offset

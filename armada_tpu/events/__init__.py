@@ -1,0 +1,46 @@
+"""Event schema (protobuf) + conversion helpers.
+
+`events_pb2` is regenerated from events.proto with protoc when the .proto is
+newer than the generated module (protoc is part of the baked toolchain).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PROTO = os.path.join(_HERE, "events.proto")
+_GEN = os.path.join(_HERE, "events_pb2.py")
+
+if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN):
+    # Generate into a temp dir and os.replace into place under a file lock, so
+    # concurrent first-importers never see a partially-written module.
+    with open(_GEN + ".lock", "w") as _lockf:
+        fcntl.flock(_lockf, fcntl.LOCK_EX)
+        if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN):
+            with tempfile.TemporaryDirectory(dir=_HERE) as _tmp:
+                subprocess.run(
+                    ["protoc", "-I", _HERE, f"--python_out={_tmp}", _PROTO],
+                    check=True,
+                )
+                os.replace(os.path.join(_tmp, "events_pb2.py"), _GEN)
+
+from armada_tpu.events import events_pb2  # noqa: E402
+
+from armada_tpu.events.convert import (  # noqa: E402
+    job_spec_from_proto,
+    job_spec_to_proto,
+    resources_from_proto,
+    resources_to_proto,
+)
+
+__all__ = [
+    "events_pb2",
+    "job_spec_from_proto",
+    "job_spec_to_proto",
+    "resources_from_proto",
+    "resources_to_proto",
+]

@@ -1,0 +1,79 @@
+"""Conversions between event protos and the host-side domain dataclasses.
+
+Plays the role of the reference's submit/conversion (api job -> SubmitJob event,
+internal/server/submit/conversion/conversions.go) and the scheduler-side
+adapters (internal/scheduler/adapters) in one place: our event JobSpec IS the
+scheduling shape, so conversion is direct.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from armada_tpu.core.resources import ResourceList, ResourceListFactory
+from armada_tpu.core.types import JobSpec, Toleration
+from armada_tpu.events import events_pb2 as pb
+
+
+def resources_to_proto(rl: Optional[ResourceList]) -> pb.Resources:
+    if rl is None:
+        return pb.Resources()
+    return pb.Resources(
+        milli={name: int(a) for name, a in zip(rl.factory.names, rl.atoms) if a}
+    )
+
+
+def resources_from_proto(
+    msg: pb.Resources, factory: ResourceListFactory
+) -> ResourceList:
+    rl = factory.zero()
+    atoms = rl.atoms
+    for name, milli in msg.milli.items():
+        if name in factory.names:
+            atoms[factory.index_of(name)] = milli
+    return rl
+
+
+def job_spec_to_proto(job: JobSpec) -> pb.JobSpec:
+    return pb.JobSpec(
+        priority_class=job.priority_class,
+        priority=job.priority,
+        resources=resources_to_proto(job.resources),
+        node_selector=dict(job.node_selector),
+        tolerations=[
+            pb.Toleration(key=t.key, operator=t.operator, value=t.value, effect=t.effect)
+            for t in job.tolerations
+        ],
+        gang_id=job.gang_id,
+        gang_cardinality=job.gang_cardinality,
+        gang_node_uniformity_label=job.gang_node_uniformity_label,
+        pools=list(job.pools),
+    )
+
+
+def job_spec_from_proto(
+    job_id: str,
+    queue: str,
+    jobset: str,
+    msg: pb.JobSpec,
+    factory: ResourceListFactory,
+    submit_time: float = 0.0,
+) -> JobSpec:
+    return JobSpec(
+        id=job_id,
+        queue=queue,
+        jobset=jobset,
+        priority_class=msg.priority_class,
+        priority=int(msg.priority),
+        submit_time=submit_time,
+        resources=resources_from_proto(msg.resources, factory),
+        node_selector=dict(msg.node_selector),
+        tolerations=tuple(
+            Toleration(key=t.key, operator=t.operator or "Equal", value=t.value, effect=t.effect)
+            for t in msg.tolerations
+        ),
+        gang_id=msg.gang_id,
+        gang_cardinality=int(msg.gang_cardinality) or 1,
+        gang_node_uniformity_label=msg.gang_node_uniformity_label,
+        pools=tuple(msg.pools),
+    )
