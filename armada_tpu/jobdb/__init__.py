@@ -1,0 +1,17 @@
+"""In-memory job store with single-writer transactions and invariant checks.
+
+Equivalent of the reference's internal/scheduler/jobdb (SURVEY.md section 2.2).
+"""
+
+from armada_tpu.jobdb.job import Job, JobRun
+from armada_tpu.jobdb.jobdb import JobDb, ReadTxn, WriteTxn, gang_key, market_order_key
+
+__all__ = [
+    "Job",
+    "JobRun",
+    "JobDb",
+    "ReadTxn",
+    "WriteTxn",
+    "gang_key",
+    "market_order_key",
+]

@@ -1,0 +1,238 @@
+"""JobDb: txn semantics, ordering, indexes, invariants.
+
+Models the reference's jobdb tests (internal/scheduler/jobdb/jobdb_test.go):
+upsert/get/delete through txns, queued-job ordering, run indexing, gang
+indexing, invariant assertions.
+"""
+
+import pytest
+
+from armada_tpu.core.config import default_scheduling_config
+from armada_tpu.core.types import JobSpec
+from armada_tpu.jobdb import Job, JobDb, JobRun
+
+
+def make_job(job_id, queue="q", priority=0, submitted_ns=0, pc="", gang_id=""):
+    return Job(
+        spec=JobSpec(
+            id=job_id, queue=queue, jobset="js", priority_class=pc,
+            gang_id=gang_id, gang_cardinality=2 if gang_id else 1,
+        ),
+        priority=priority,
+        requested_priority=priority,
+        submitted_ns=submitted_ns,
+    )
+
+
+@pytest.fixture
+def db():
+    return JobDb(default_scheduling_config())
+
+
+def test_upsert_get_delete(db):
+    job = make_job("j1")
+    with db.write_txn() as txn:
+        txn.upsert(job)
+        assert txn.get("j1") is job  # visible inside the txn
+    assert db.read_txn().get("j1") is job  # visible after commit
+    with db.write_txn() as txn:
+        txn.delete("j1")
+        assert txn.get("j1") is None
+    assert db.read_txn().get("j1") is None
+
+
+def test_abort_discards(db):
+    txn = db.write_txn()
+    txn.upsert(make_job("j1"))
+    txn.abort()
+    assert db.read_txn().get("j1") is None
+
+
+def test_uncommitted_invisible_to_readers(db):
+    txn = db.write_txn()
+    txn.upsert(make_job("j1"))
+    assert db.read_txn().get("j1") is None
+    txn.commit()
+    assert db.read_txn().get("j1") is not None
+
+
+def test_queued_order_pc_priority_submit_time():
+    import dataclasses
+
+    from armada_tpu.core.config import PriorityClass
+
+    base = default_scheduling_config()
+    config = dataclasses.replace(
+        base,
+        priority_classes={
+            "low": PriorityClass(name="low", priority=100, preemptible=True),
+            "high": PriorityClass(name="high", priority=900, preemptible=False),
+        },
+        default_priority_class="low",
+    )
+    db = JobDb(config)
+    low_pc, high_pc = "low", "high"
+    jobs = [
+        make_job("j-low-pc", pc=low_pc, submitted_ns=1),
+        make_job("j-high-pc", pc=high_pc, submitted_ns=2),
+        make_job("j-pri5", pc=high_pc, priority=5, submitted_ns=0),
+        make_job("j-late", pc=high_pc, submitted_ns=9),
+    ]
+    with db.write_txn() as txn:
+        txn.upsert(jobs)
+    got = [j.id for j in db.read_txn().queued_jobs("q")]
+    # Higher PC priority first; then lower job priority; then earlier submit.
+    assert got == ["j-high-pc", "j-late", "j-pri5", "j-low-pc"]
+
+
+def test_queued_iteration_merges_txn_overlay(db):
+    with db.write_txn() as txn:
+        txn.upsert([make_job("a", submitted_ns=1), make_job("b", submitted_ns=2)])
+    txn = db.write_txn()
+    txn.upsert(make_job("a2", submitted_ns=0))  # new job, earliest
+    txn.delete("b")
+    assert [j.id for j in txn.queued_jobs("q")] == ["a2", "a"]
+    txn.abort()
+    # Committed state unchanged by the aborted overlay.
+    assert [j.id for j in db.read_txn().queued_jobs("q")] == ["a", "b"]
+
+
+def test_leased_job_leaves_queued_index(db):
+    job = make_job("j1")
+    with db.write_txn() as txn:
+        txn.upsert(job)
+    run = JobRun(id="r1", job_id="j1", node_id="n1")
+    with db.write_txn() as txn:
+        txn.upsert(txn.get("j1").with_new_run(run))
+    txn = db.read_txn()
+    assert list(txn.queued_jobs("q")) == []
+    assert txn.get_by_run_id("r1").id == "j1"
+    assert txn.get("j1").queued_version == 1
+
+
+def test_run_index_inside_txn_overlay(db):
+    with db.write_txn() as txn:
+        txn.upsert(make_job("j1"))
+    txn = db.write_txn()
+    txn.upsert(txn.get("j1").with_new_run(JobRun(id="r9", job_id="j1")))
+    assert txn.get_by_run_id("r9").id == "j1"
+    txn.abort()
+    assert db.read_txn().get_by_run_id("r9") is None
+
+
+def test_gang_index(db):
+    with db.write_txn() as txn:
+        txn.upsert([
+            make_job("g1a", gang_id="g1"),
+            make_job("g1b", gang_id="g1"),
+            make_job("solo"),
+        ])
+    txn = db.read_txn()
+    assert [j.id for j in txn.gang_jobs("q", "g1")] == ["g1a", "g1b"]
+    assert txn.gang_jobs("q", "none") == []
+
+
+def test_unvalidated_tracking(db):
+    with db.write_txn() as txn:
+        txn.upsert(make_job("j1"))
+    assert [j.id for j in db.read_txn().unvalidated_jobs()] == ["j1"]
+    with db.write_txn() as txn:
+        txn.upsert(txn.get("j1").with_validated(pools=("default",)))
+    assert db.read_txn().unvalidated_jobs() == []
+
+
+def test_single_writer_enforced(db):
+    import threading
+
+    txn = db.write_txn()
+    acquired = threading.Event()
+
+    def second_writer():
+        t2 = db.write_txn()
+        acquired.set()
+        t2.abort()
+
+    t = threading.Thread(target=second_writer)
+    t.start()
+    assert not acquired.wait(0.1)  # blocked while txn open
+    txn.abort()
+    t.join(2)
+    assert acquired.is_set()
+
+
+def test_assert_invariants_catch_corruption(db):
+    # queued but terminal
+    bad = make_job("j1").with_succeeded()._with(queued=True)
+    txn = db.write_txn()
+    txn.upsert(bad)
+    with pytest.raises(AssertionError, match="terminal"):
+        txn.assert_invariants()
+    txn.abort()
+    # queued with an active run
+    bad2 = make_job("j2").with_new_run(JobRun(id="r1", job_id="j2"))._with(queued=True)
+    txn = db.write_txn()
+    txn.upsert(bad2)
+    with pytest.raises(AssertionError, match="active run"):
+        txn.assert_invariants()
+    txn.abort()
+    # healthy state passes
+    with db.write_txn() as txn:
+        txn.upsert(make_job("ok"))
+        txn.assert_invariants()
+
+
+def test_job_state_transitions():
+    job = make_job("j1")
+    run = JobRun(id="r1", job_id="j1", node_id="n1")
+    job = job.with_new_run(run)
+    assert not job.queued and job.has_active_run()
+    job = job.with_updated_run(job.latest_run.with_running("node-1"))
+    job = job.with_updated_run(job.latest_run.with_succeeded()).with_succeeded()
+    assert job.in_terminal_state() and not job.has_active_run()
+    # Failed runs on named nodes feed retry anti-affinity.
+    j2 = make_job("j2").with_new_run(
+        JobRun(id="r2", job_id="j2", node_name="bad-node")
+    )
+    j2 = j2.with_updated_run(j2.latest_run.with_returned(run_attempted=True)._with(failed=True))
+    assert j2.failed_nodes() == ("bad-node",)
+    assert j2.num_attempts() == 1
+
+
+def test_unknown_priority_class_rejected_without_corruption(db):
+    txn = db.write_txn()
+    txn.upsert(make_job("good"))
+    with pytest.raises(ValueError, match="priority class"):
+        txn.upsert(make_job("bad", pc="no-such-pc"))
+    txn.commit()
+    # The failed upsert neither corrupted state nor deadlocked the writer.
+    assert db.read_txn().get("good") is not None
+    assert db.read_txn().get("bad") is None
+    with db.write_txn() as txn2:
+        txn2.upsert(make_job("after"))
+    assert db.read_txn().get("after") is not None
+
+
+def test_job_fields_default_from_spec():
+    job = Job(spec=JobSpec(id="j", queue="q", priority=7, submit_time=1.5))
+    assert job.priority == 7
+    assert job.requested_priority == 7
+    assert job.submitted_ns == 1_500_000_000
+
+
+def test_reader_snapshot_survives_concurrent_commit(db):
+    with db.write_txn() as txn:
+        txn.upsert([make_job(f"j{i}", submitted_ns=i) for i in range(100)])
+    snapshot = db.read_txn().queued_jobs("q")
+    with db.write_txn() as txn:
+        txn.delete([f"j{i}" for i in range(50)])
+    assert len(snapshot) == 100  # materialized list unaffected by the commit
+    assert len(db.read_txn().queued_jobs("q")) == 50
+
+
+def test_queues_with_queued_jobs(db):
+    with db.write_txn() as txn:
+        txn.upsert([make_job("a", queue="qa"), make_job("b", queue="qb")])
+    assert db.read_txn().queues_with_queued_jobs() == ["qa", "qb"]
+    with db.write_txn() as txn:
+        txn.upsert(txn.get("a").with_cancelled())
+    assert db.read_txn().queues_with_queued_jobs() == ["qb"]
