@@ -1,0 +1,90 @@
+"""Generic ingestion pipeline: consume -> convert -> store -> ack.
+
+Equivalent of the reference's ingest.IngestionPipeline generics
+(internal/common/ingest/ingestion_pipeline.go:40-79), reused by all three
+ingesters there (scheduler PG / lookout PG / Redis events).  Here the sink
+stores data AND the consumer position in one transaction (see SchedulerDb),
+so a crash between store and ack cannot double-apply.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Protocol
+
+from armada_tpu.eventlog import Consumer, EventLog
+from armada_tpu.events import events_pb2 as pb
+
+
+class Sink(Protocol):
+    def store(self, batch_ops, consumer: str, next_positions: dict[int, int]) -> None:
+        ...
+
+
+class IngestionPipeline:
+    """Polls the event log, converts batches, stores them transactionally.
+
+    `converter(sequences) -> batch` produces whatever the sink stores (DbOps
+    for the scheduler DB, rows for lookout, stream entries for the event API).
+    """
+
+    def __init__(
+        self,
+        log: EventLog,
+        sink: Sink,
+        converter: Callable[[list[pb.EventSequence]], object],
+        consumer_name: str,
+        start_positions: dict[int, int] | None = None,
+        poll_interval: float = 0.05,
+    ):
+        self.consumer_name = consumer_name
+        self._consumer = Consumer(log, positions=start_positions)
+        self._sink = sink
+        self._converter = converter
+        self._poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def run_once(self) -> int:
+        """One consume->convert->store->ack round; returns #sequences applied."""
+        batch = self._consumer.poll()
+        if not batch.sequences:
+            return 0
+        converted = self._converter(batch.sequences)
+        self._sink.store(
+            converted,
+            consumer=self.consumer_name,
+            next_positions=batch.next_positions,
+        )
+        self._consumer.ack(batch.next_positions)
+        return len(batch.sequences)
+
+    def run_until_caught_up(self, max_rounds: int = 1_000_000) -> int:
+        total = 0
+        for _ in range(max_rounds):
+            n = self.run_once()
+            total += n
+            if n == 0 and self._consumer.caught_up():
+                return total
+        return total
+
+    # --- background service mode -------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("pipeline already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.run_once() == 0:
+                self._stop.wait(self._poll_interval)

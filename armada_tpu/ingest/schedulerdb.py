@@ -1,0 +1,379 @@
+"""The scheduler database: SQLite materialization of the event log.
+
+Equivalent of the reference's scheduler Postgres schema + access layer
+(internal/scheduler/database/migrations/001_initialize_schema.up.sql: tables
+jobs, runs, markers, job_run_errors; job_repository.go FetchJobUpdates): rows
+carry a monotonic `serial` bumped on every write, so the scheduler's syncState
+fetches increments with `serial > last_seen` (scheduler.go:386).
+
+Exactly-once materialization: `SchedulerDb.store` applies a batch of
+DbOperations AND the consumer's new log positions in one SQLite transaction --
+replaying after a crash resumes from the committed position, so no event is
+applied twice (the reference gets the same from Postgres txns keyed on Pulsar
+message ids, SURVEY.md section 5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterable, Optional
+
+from armada_tpu.ingest import dbops as ops
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+  job_id TEXT PRIMARY KEY,
+  queue TEXT NOT NULL,
+  jobset TEXT NOT NULL,
+  priority INTEGER NOT NULL DEFAULT 0,
+  submitted_ns INTEGER NOT NULL DEFAULT 0,
+  queued INTEGER NOT NULL DEFAULT 1,
+  queued_version INTEGER NOT NULL DEFAULT 0,
+  validated INTEGER NOT NULL DEFAULT 0,
+  pools TEXT NOT NULL DEFAULT '',
+  cancel_requested INTEGER NOT NULL DEFAULT 0,
+  cancel_by_jobset_requested INTEGER NOT NULL DEFAULT 0,
+  cancelled INTEGER NOT NULL DEFAULT 0,
+  succeeded INTEGER NOT NULL DEFAULT 0,
+  failed INTEGER NOT NULL DEFAULT 0,
+  spec BLOB NOT NULL,
+  serial INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_jobs_serial ON jobs(serial);
+CREATE INDEX IF NOT EXISTS idx_jobs_jobset ON jobs(queue, jobset);
+
+CREATE TABLE IF NOT EXISTS runs (
+  run_id TEXT PRIMARY KEY,
+  job_id TEXT NOT NULL,
+  created_ns INTEGER NOT NULL DEFAULT 0,
+  executor TEXT NOT NULL DEFAULT '',
+  node_id TEXT NOT NULL DEFAULT '',
+  node_name TEXT NOT NULL DEFAULT '',
+  pool TEXT NOT NULL DEFAULT '',
+  scheduled_at_priority INTEGER,
+  pool_scheduled_away INTEGER NOT NULL DEFAULT 0,
+  leased INTEGER NOT NULL DEFAULT 1,
+  pending INTEGER NOT NULL DEFAULT 0,
+  running INTEGER NOT NULL DEFAULT 0,
+  succeeded INTEGER NOT NULL DEFAULT 0,
+  failed INTEGER NOT NULL DEFAULT 0,
+  cancelled INTEGER NOT NULL DEFAULT 0,
+  preempted INTEGER NOT NULL DEFAULT 0,
+  returned INTEGER NOT NULL DEFAULT 0,
+  run_attempted INTEGER NOT NULL DEFAULT 0,
+  preempt_requested INTEGER NOT NULL DEFAULT 0,
+  serial INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_runs_serial ON runs(serial);
+CREATE INDEX IF NOT EXISTS idx_runs_job ON runs(job_id);
+
+CREATE TABLE IF NOT EXISTS job_run_errors (
+  run_id TEXT NOT NULL,
+  job_id TEXT NOT NULL,
+  reason TEXT NOT NULL,
+  message TEXT NOT NULL,
+  terminal INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE IF NOT EXISTS markers (
+  group_id TEXT NOT NULL,
+  partition INTEGER NOT NULL,
+  created_ns INTEGER NOT NULL DEFAULT 0,
+  PRIMARY KEY (group_id, partition)
+);
+
+CREATE TABLE IF NOT EXISTS executors (
+  executor_id TEXT PRIMARY KEY,
+  snapshot BLOB NOT NULL,
+  last_updated_ns INTEGER NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS consumer_positions (
+  consumer TEXT NOT NULL,
+  partition INTEGER NOT NULL,
+  position INTEGER NOT NULL,
+  PRIMARY KEY (consumer, partition)
+);
+
+CREATE TABLE IF NOT EXISTS serials (
+  name TEXT PRIMARY KEY,
+  value INTEGER NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS job_dedup (
+  dedup_key TEXT PRIMARY KEY,
+  job_id TEXT NOT NULL
+);
+"""
+
+JOBS_COLUMNS = (
+    "job_id", "queue", "jobset", "priority", "submitted_ns", "queued",
+    "queued_version", "validated", "pools", "cancel_requested",
+    "cancel_by_jobset_requested", "cancelled", "succeeded", "failed", "spec",
+)
+RUNS_COLUMNS = (
+    "run_id", "job_id", "created_ns", "executor", "node_id", "node_name",
+    "pool", "scheduled_at_priority", "pool_scheduled_away", "leased",
+)
+
+
+class SchedulerDb:
+    """SQLite-backed scheduler state store + ingestion sink."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.executescript(_SCHEMA)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # --- serials ------------------------------------------------------------
+
+    def _next_serial(self, cur: sqlite3.Cursor, name: str) -> int:
+        cur.execute(
+            "INSERT INTO serials(name, value) VALUES (?, 1) "
+            "ON CONFLICT(name) DO UPDATE SET value = value + 1",
+            (name,),
+        )
+        row = cur.execute("SELECT value FROM serials WHERE name = ?", (name,)).fetchone()
+        return int(row[0])
+
+    # --- ingestion sink -----------------------------------------------------
+
+    def store(
+        self,
+        batch_ops: Iterable[ops.DbOperation],
+        consumer: str = "scheduler",
+        next_positions: Optional[dict[int, int]] = None,
+    ) -> None:
+        """Apply ops + advance the consumer position in ONE transaction."""
+        with self._lock:
+            cur = self._conn.cursor()
+            try:
+                for op in batch_ops:
+                    self._apply(cur, op)
+                for part, pos in (next_positions or {}).items():
+                    cur.execute(
+                        "INSERT INTO consumer_positions(consumer, partition, position) "
+                        "VALUES (?, ?, ?) ON CONFLICT(consumer, partition) "
+                        "DO UPDATE SET position = excluded.position",
+                        (consumer, part, pos),
+                    )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
+    def positions(self, consumer: str = "scheduler") -> dict[int, int]:
+        rows = self._conn.execute(
+            "SELECT partition, position FROM consumer_positions WHERE consumer = ?",
+            (consumer,),
+        ).fetchall()
+        return {int(r["partition"]): int(r["position"]) for r in rows}
+
+    # --- op application -----------------------------------------------------
+
+    def _apply(self, cur: sqlite3.Cursor, op: ops.DbOperation) -> None:
+        if isinstance(op, ops.InsertJobs):
+            serial = self._next_serial(cur, "jobs")
+            cols = ", ".join(JOBS_COLUMNS)
+            qs = ", ".join("?" for _ in JOBS_COLUMNS)
+            cur.executemany(
+                f"INSERT OR IGNORE INTO jobs ({cols}, serial) VALUES ({qs}, {serial})",
+                [
+                    tuple(row.get(c, _job_default(c)) for c in JOBS_COLUMNS)
+                    for row in op.jobs.values()
+                ],
+            )
+        elif isinstance(op, ops.InsertRuns):
+            serial = self._next_serial(cur, "runs")
+            cols = ", ".join(RUNS_COLUMNS)
+            qs = ", ".join("?" for _ in RUNS_COLUMNS)
+            cur.executemany(
+                f"INSERT OR IGNORE INTO runs ({cols}, serial) VALUES ({qs}, {serial})",
+                [
+                    tuple(row.get(c, _run_default(c)) for c in RUNS_COLUMNS)
+                    for row in op.runs.values()
+                ],
+            )
+        elif isinstance(op, ops.MarkJobsCancelRequested):
+            self._mark_jobs(cur, "cancel_requested", op.job_ids)
+        elif isinstance(op, ops.MarkJobsCancelled):
+            self._mark_jobs(cur, "cancelled", op.job_ids, also="queued = 0")
+        elif isinstance(op, ops.MarkJobsSucceeded):
+            self._mark_jobs(cur, "succeeded", op.job_ids, also="queued = 0")
+        elif isinstance(op, ops.MarkJobsFailed):
+            self._mark_jobs(cur, "failed", op.job_ids, also="queued = 0")
+        elif isinstance(op, ops.MarkJobsValidated):
+            serial = self._next_serial(cur, "jobs")
+            cur.executemany(
+                f"UPDATE jobs SET validated = 1, pools = ?, serial = {serial} "
+                "WHERE job_id = ?",
+                [(",".join(pools), jid) for jid, pools in op.pools_by_job.items()],
+            )
+        elif isinstance(op, ops.UpdateJobPriorities):
+            serial = self._next_serial(cur, "jobs")
+            cur.executemany(
+                f"UPDATE jobs SET priority = ?, serial = {serial} WHERE job_id = ?",
+                [(p, jid) for jid, p in op.priority_by_job.items()],
+            )
+        elif isinstance(op, ops.UpdateJobQueuedState):
+            serial = self._next_serial(cur, "jobs")
+            cur.executemany(
+                f"UPDATE jobs SET queued = ?, queued_version = ?, serial = {serial} "
+                "WHERE job_id = ? AND queued_version < ?",
+                [
+                    (int(queued), version, jid, version)
+                    for jid, (queued, version) in op.state_by_job.items()
+                ],
+            )
+        elif isinstance(op, ops.MarkJobSetCancelRequested):
+            serial = self._next_serial(cur, "jobs")
+            conds = []
+            if op.cancel_queued:
+                conds.append("queued = 1")
+            if op.cancel_leased:
+                conds.append("queued = 0")
+            state_cond = f"({' OR '.join(conds)})" if conds else "0"
+            cur.execute(
+                "UPDATE jobs SET cancel_by_jobset_requested = 1, "
+                f"serial = {serial} WHERE queue = ? AND jobset = ? AND {state_cond} "
+                "AND cancelled = 0 AND succeeded = 0 AND failed = 0",
+                (op.queue, op.jobset),
+            )
+        elif isinstance(op, (ops.MarkRunsPending, ops.MarkRunsRunning,
+                             ops.MarkRunsSucceeded, ops.MarkRunsFailed,
+                             ops.MarkRunsPreempted, ops.MarkRunsPreemptRequested)):
+            flag = {
+                ops.MarkRunsPending: "pending",
+                ops.MarkRunsRunning: "running",
+                ops.MarkRunsSucceeded: "succeeded",
+                ops.MarkRunsFailed: "failed",
+                ops.MarkRunsPreempted: "preempted",
+                ops.MarkRunsPreemptRequested: "preempt_requested",
+            }[type(op)]
+            serial = self._next_serial(cur, "runs")
+            run_attempted = (
+                ", run_attempted = 1" if flag in ("running", "succeeded") else ""
+            )
+            cur.executemany(
+                f"UPDATE runs SET {flag} = 1{run_attempted}, serial = {serial} "
+                "WHERE run_id = ?",
+                [(rid,) for rid in op.runs],
+            )
+        elif isinstance(op, ops.InsertJobRunErrors):
+            cur.executemany(
+                "INSERT INTO job_run_errors (run_id, job_id, reason, message, terminal) "
+                "VALUES (?, ?, ?, ?, ?)",
+                [
+                    (rid, op.job_by_run.get(rid, ""), reason, message, int(terminal))
+                    for rid, errs in op.errors.items()
+                    for (reason, message, terminal) in errs
+                ],
+            )
+        elif isinstance(op, ops.InsertPartitionMarker):
+            cur.execute(
+                "INSERT OR IGNORE INTO markers (group_id, partition, created_ns) "
+                "VALUES (?, ?, ?)",
+                (op.group_id, op.partition, op.created_ns),
+            )
+        else:
+            raise TypeError(f"unknown DbOperation: {type(op).__name__}")
+
+    def _mark_jobs(
+        self, cur: sqlite3.Cursor, flag: str, job_ids: Iterable[str], also: str = ""
+    ) -> None:
+        serial = self._next_serial(cur, "jobs")
+        extra = f", {also}" if also else ""
+        cur.executemany(
+            f"UPDATE jobs SET {flag} = 1{extra}, serial = {serial} WHERE job_id = ?",
+            [(jid,) for jid in job_ids],
+        )
+
+    # --- scheduler-side reads (job_repository.go) ---------------------------
+
+    def fetch_job_updates(
+        self, jobs_serial: int, runs_serial: int
+    ) -> tuple[list[sqlite3.Row], list[sqlite3.Row]]:
+        """Incremental fetch: all rows whose serial advanced past the cursor
+        (job_repository.go FetchJobUpdates)."""
+        jobs = self._conn.execute(
+            "SELECT * FROM jobs WHERE serial > ? ORDER BY serial", (jobs_serial,)
+        ).fetchall()
+        runs = self._conn.execute(
+            "SELECT * FROM runs WHERE serial > ? ORDER BY serial", (runs_serial,)
+        ).fetchall()
+        return jobs, runs
+
+    def max_serials(self) -> tuple[int, int]:
+        rows = dict(
+            self._conn.execute("SELECT name, value FROM serials").fetchall()
+        )
+        return int(rows.get("jobs", 0)), int(rows.get("runs", 0))
+
+    def has_marker(self, group_id: str, num_partitions: int) -> bool:
+        n = self._conn.execute(
+            "SELECT COUNT(*) FROM markers WHERE group_id = ?", (group_id,)
+        ).fetchone()[0]
+        return int(n) >= num_partitions
+
+    def run_errors(self, run_id: str) -> list[sqlite3.Row]:
+        return self._conn.execute(
+            "SELECT * FROM job_run_errors WHERE run_id = ?", (run_id,)
+        ).fetchall()
+
+    # --- dedup kv (reference: server deduplication via PG kv) ---------------
+
+    def lookup_dedup(self, keys: list[str]) -> dict[str, str]:
+        if not keys:
+            return {}
+        qs = ",".join("?" for _ in keys)
+        rows = self._conn.execute(
+            f"SELECT dedup_key, job_id FROM job_dedup WHERE dedup_key IN ({qs})",
+            keys,
+        ).fetchall()
+        return {r["dedup_key"]: r["job_id"] for r in rows}
+
+    def store_dedup(self, mapping: dict[str, str]) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR IGNORE INTO job_dedup (dedup_key, job_id) VALUES (?, ?)",
+                list(mapping.items()),
+            )
+            self._conn.commit()
+
+    # --- executor snapshots (executor_repository.go) ------------------------
+
+    def upsert_executor(self, executor_id: str, snapshot: bytes, now_ns: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO executors (executor_id, snapshot, last_updated_ns) "
+                "VALUES (?, ?, ?) ON CONFLICT(executor_id) DO UPDATE SET "
+                "snapshot = excluded.snapshot, last_updated_ns = excluded.last_updated_ns",
+                (executor_id, snapshot, now_ns),
+            )
+            self._conn.commit()
+
+    def executors(self) -> list[sqlite3.Row]:
+        return self._conn.execute("SELECT * FROM executors").fetchall()
+
+
+def _job_default(col: str):
+    return {
+        "priority": 0, "submitted_ns": 0, "queued": 1, "queued_version": 0,
+        "validated": 0, "pools": "", "cancel_requested": 0,
+        "cancel_by_jobset_requested": 0, "cancelled": 0, "succeeded": 0,
+        "failed": 0, "spec": b"",
+    }.get(col, "")
+
+
+def _run_default(col: str):
+    return {
+        "created_ns": 0, "scheduled_at_priority": None,
+        "pool_scheduled_away": 0, "leased": 1,
+    }.get(col, "")

@@ -23,15 +23,13 @@ from typing import Callable, Iterable, Optional
 from sortedcontainers import SortedKeyList
 
 from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.ordering import scheduling_order_key
 from armada_tpu.jobdb.job import Job, JobRun
 
-# Scheduling order within a queue (jobdb/comparison.go JobPriorityComparer):
-# higher priority-class priority first, then lower job priority value, then
-# earlier submission, then id as the tiebreak.
 def _order_key(config: SchedulingConfig) -> Callable[[Job], tuple]:
     def key(job: Job) -> tuple:
         pc = job.priority_class(config)
-        return (-pc.priority, job.priority, job.submitted_ns, job.id)
+        return scheduling_order_key(pc.priority, job.priority, job.submitted_ns, job.id)
 
     return key
 
@@ -51,7 +49,13 @@ def gang_key(job: Job) -> Optional[tuple[str, str]]:
 
 
 class JobDb:
-    def __init__(self, config: Optional[SchedulingConfig] = None):
+    def __init__(
+        self,
+        config: Optional[SchedulingConfig] = None,
+        order_key: Optional[Callable[[Job], tuple]] = None,
+    ):
+        """`order_key` overrides the queued-job ordering (e.g. market_order_key
+        for price-ordered pools, jobdb/comparison.go MarketJobPriorityComparer)."""
         from armada_tpu.core.config import default_scheduling_config
 
         self.config = config or default_scheduling_config()
@@ -60,7 +64,7 @@ class JobDb:
         self._by_gang: dict[tuple[str, str], set[str]] = {}
         self._queued: dict[str, SortedKeyList] = {}
         self._unvalidated: set[str] = set()
-        self._order = _order_key(self.config)
+        self._order = order_key or _order_key(self.config)
         self._writer = threading.Lock()
         # Guards in-place index mutation during _apply against concurrent
         # reader iteration (readers snapshot under this lock).
@@ -142,8 +146,10 @@ class ReadTxn:
         return self._db._get(job_id)
 
     def get_by_run_id(self, run_id: str) -> Optional[Job]:
-        job_id = self._db._job_by_run.get(run_id)
-        return self._db._get(job_id) if job_id else None
+        # Two-step read: must not interleave with _apply's deindex/reindex.
+        with self._db._state:
+            job_id = self._db._job_by_run.get(run_id)
+            return self._db._get(job_id) if job_id else None
 
     def gang_jobs(self, queue: str, gang_id: str) -> list[Job]:
         with self._db._state:
@@ -182,6 +188,7 @@ class WriteTxn(ReadTxn):
         super().__init__(db)
         self._upserts: dict[str, Job] = {}
         self._deletes: set[str] = set()
+        self._touched_cache: Optional[set[str]] = None
         self._done = False
 
     # --- overlay reads ------------------------------------------------------
@@ -211,7 +218,10 @@ class WriteTxn(ReadTxn):
         return [j for i in sorted(ids) if (j := self.get(i)) is not None]
 
     def _touched_queues(self) -> set[str]:
-        """Queues whose committed queued-index the overlay could alter."""
+        """Queues whose committed queued-index the overlay could alter.
+        Cached; invalidated by upsert/delete."""
+        if self._touched_cache is not None:
+            return self._touched_cache
         queues: set[str] = set()
         for job_id, job in self._upserts.items():
             queues.add(job.queue)
@@ -222,6 +232,7 @@ class WriteTxn(ReadTxn):
             old = self._db._get(job_id)
             if old is not None:
                 queues.add(old.queue)
+        self._touched_cache = queues
         return queues
 
     def queued_jobs(self, queue: str) -> list[Job]:
@@ -238,6 +249,16 @@ class WriteTxn(ReadTxn):
                 merged.add(job)
         return list(merged)
 
+    def _queue_has_queued(self, queue: str) -> bool:
+        """Emptiness check without materializing the overlay merge."""
+        touched = set(self._upserts) | self._deletes
+        for job in self._upserts.values():
+            if job.queue == queue and job.queued:
+                return True
+        return any(
+            job.id not in touched for job in super().queued_jobs(queue)
+        )
+
     def queues_with_queued_jobs(self) -> list[str]:
         queues = set(super().queues_with_queued_jobs())
         for job in self._upserts.values():
@@ -247,7 +268,7 @@ class WriteTxn(ReadTxn):
         # Only queues the overlay touches can have become empty; others keep
         # their committed answer.
         return sorted(
-            q for q in queues if q not in touched or self.queued_jobs(q)
+            q for q in queues if q not in touched or self._queue_has_queued(q)
         )
 
     def unvalidated_jobs(self) -> list[Job]:
@@ -281,6 +302,7 @@ class WriteTxn(ReadTxn):
         self._check_active()
         if isinstance(jobs, Job):
             jobs = [jobs]
+        self._touched_cache = None
         for job in jobs:
             self._db._order(job)  # fail fast on unknown priority class
             self._deletes.discard(job.id)
@@ -290,6 +312,7 @@ class WriteTxn(ReadTxn):
         self._check_active()
         if isinstance(job_ids, str):
             job_ids = [job_ids]
+        self._touched_cache = None
         for job_id in job_ids:
             self._upserts.pop(job_id, None)
             self._deletes.add(job_id)

@@ -29,6 +29,7 @@ from typing import NamedTuple, Optional, Sequence
 import numpy as np
 
 from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.ordering import scheduling_order_key
 from armada_tpu.core.keys import (
     NodeTypeIndex,
     SchedulingKeyIndex,
@@ -144,9 +145,9 @@ def queue_ordered_gang_index(
 
 
 def _job_sort_key(pc_priority: int, job: JobSpec):
-    """Queue-internal scheduling order (jobdb/comparison.go JobPriorityComparer):
-    higher PC priority first, then lower job priority, then earlier submit time."""
-    return (-pc_priority, job.priority, job.submit_time, job.id)
+    """Queue-internal scheduling order; single source of truth in
+    core.ordering (shared with the JobDb queued index)."""
+    return scheduling_order_key(pc_priority, job.priority, job.submit_time, job.id)
 
 
 def build_problem(

@@ -1,0 +1,214 @@
+"""Scheduler ingestion: dbops merge/reorder, converter, pipeline exactly-once.
+
+Models the reference's scheduleringester tests (dbops merge + reorder
+legality, instructions.go conversion, schedulerdb storage with serials).
+"""
+
+import pytest
+
+from armada_tpu.eventlog import EventLog, Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.ingest import (
+    SchedulerDb,
+    convert_sequences,
+    scheduler_ingestion_pipeline,
+)
+from armada_tpu.ingest import dbops as ops
+
+
+def seq(queue="q", jobset="js", events=()):
+    return pb.EventSequence(queue=queue, jobset=jobset, events=list(events))
+
+
+def submit(job_id, priority=0):
+    return pb.Event(
+        created_ns=1,
+        submit_job=pb.SubmitJob(job_id=job_id, spec=pb.JobSpec(priority=priority)),
+    )
+
+
+# --- dbops ------------------------------------------------------------------
+
+
+def test_same_type_ops_merge():
+    merged = ops.merge_ops(
+        [
+            ops.MarkJobsSucceeded(job_ids={"a"}),
+            ops.MarkJobsSucceeded(job_ids={"b"}),
+        ]
+    )
+    assert len(merged) == 1
+    assert merged[0].job_ids == {"a", "b"}
+
+
+def test_independent_ops_hoist_past_each_other():
+    # succeeded(a), cancel(b), succeeded(c): the second succeeded op touches
+    # only c, commutes with cancel(b), and merges into the first.
+    merged = ops.merge_ops(
+        [
+            ops.MarkJobsSucceeded(job_ids={"a"}),
+            ops.MarkJobsCancelRequested(job_ids={"b"}),
+            ops.MarkJobsSucceeded(job_ids={"c"}),
+        ]
+    )
+    assert len(merged) == 2
+    assert merged[0].job_ids == {"a", "c"}
+
+
+def test_conflicting_ops_do_not_reorder():
+    # cancel(a) then succeeded(a) must stay ordered; a later succeeded(a)
+    # cannot hoist past cancel(a).
+    merged = ops.merge_ops(
+        [
+            ops.MarkJobsSucceeded(job_ids={"x"}),
+            ops.MarkJobsCancelRequested(job_ids={"a"}),
+            ops.MarkJobsSucceeded(job_ids={"a"}),
+        ]
+    )
+    assert len(merged) == 3
+    assert isinstance(merged[1], ops.MarkJobsCancelRequested)
+
+
+def test_jobset_wildcard_blocks_reordering():
+    merged = ops.merge_ops(
+        [
+            ops.MarkJobsSucceeded(job_ids={"a"}),
+            ops.MarkJobSetCancelRequested(queue="q", jobset="js"),
+            ops.MarkJobsSucceeded(job_ids={"b"}),
+        ]
+    )
+    assert len(merged) == 3  # nothing crosses the jobset-wide op
+
+
+def test_queued_state_merge_keeps_newest_version():
+    op1 = ops.UpdateJobQueuedState(state_by_job={"j": (False, 3)})
+    op1.merge(ops.UpdateJobQueuedState(state_by_job={"j": (True, 2)}))
+    assert op1.state_by_job["j"] == (False, 3)  # stale version ignored
+    op1.merge(ops.UpdateJobQueuedState(state_by_job={"j": (True, 4)}))
+    assert op1.state_by_job["j"] == (True, 4)
+
+
+# --- converter --------------------------------------------------------------
+
+
+def test_convert_submit_and_lifecycle():
+    events = [
+        submit("j1", priority=3),
+        pb.Event(job_validated=pb.JobValidated(job_id="j1", pools=["default"])),
+        pb.Event(
+            job_run_leased=pb.JobRunLeased(
+                job_id="j1", run_id="r1", executor_id="e1", node_id="n1",
+                pool="default", scheduled_at_priority=1000,
+            )
+        ),
+        pb.Event(job_run_running=pb.JobRunRunning(job_id="j1", run_id="r1")),
+        pb.Event(job_run_succeeded=pb.JobRunSucceeded(job_id="j1", run_id="r1")),
+        pb.Event(job_succeeded=pb.JobSucceeded(job_id="j1")),
+    ]
+    out = convert_sequences([seq(events=events)])
+    kinds = [type(o).__name__ for o in out]
+    assert "InsertJobs" in kinds and "InsertRuns" in kinds
+    assert "MarkRunsSucceeded" in kinds and "MarkJobsSucceeded" in kinds
+
+
+def test_convert_terminal_run_error_also_fails_run():
+    events = [
+        pb.Event(
+            job_run_errors=pb.JobRunErrors(
+                job_id="j1", run_id="r1",
+                errors=[pb.Error(reason="oom", message="killed", terminal=True)],
+            )
+        )
+    ]
+    out = convert_sequences([seq(events=events)])
+    kinds = {type(o).__name__ for o in out}
+    assert kinds == {"InsertJobRunErrors", "MarkRunsFailed"}
+
+
+# --- schedulerdb + pipeline -------------------------------------------------
+
+
+def test_store_and_fetch_updates():
+    db = SchedulerDb()
+    db.store(convert_sequences([seq(events=[submit("j1"), submit("j2")])]))
+    jobs, runs = db.fetch_job_updates(0, 0)
+    assert {r["job_id"] for r in jobs} == {"j1", "j2"}
+    assert runs == []
+    js, rs = db.max_serials()
+    # Incremental: no new rows past the cursor.
+    jobs2, _ = db.fetch_job_updates(js, rs)
+    assert jobs2 == []
+    # A lifecycle update bumps the serial past the cursor.
+    db.store(
+        convert_sequences(
+            [seq(events=[pb.Event(job_succeeded=pb.JobSucceeded(job_id="j1"))])]
+        )
+    )
+    jobs3, _ = db.fetch_job_updates(js, rs)
+    assert [r["job_id"] for r in jobs3] == ["j1"]
+    assert jobs3[0]["succeeded"] == 1 and jobs3[0]["queued"] == 0
+
+
+def test_jobset_cancel_only_touches_jobset():
+    db = SchedulerDb()
+    db.store(
+        convert_sequences(
+            [
+                seq(jobset="js-a", events=[submit("a1"), submit("a2")]),
+                seq(jobset="js-b", events=[submit("b1")]),
+            ]
+        )
+    )
+    db.store(
+        convert_sequences(
+            [seq(jobset="js-a", events=[pb.Event(cancel_job_set=pb.CancelJobSet())])]
+        )
+    )
+    jobs, _ = db.fetch_job_updates(0, 0)
+    flags = {r["job_id"]: r["cancel_by_jobset_requested"] for r in jobs}
+    assert flags == {"a1": 1, "a2": 1, "b1": 0}
+
+
+def test_pipeline_end_to_end_and_restart_resume(tmp_path):
+    log_dir = str(tmp_path / "log")
+    db_path = str(tmp_path / "scheduler.db")
+    with EventLog(log_dir, num_partitions=2) as log:
+        publisher = Publisher(log)
+        publisher.publish([seq(events=[submit("j1")])])
+        db = SchedulerDb(db_path)
+        pipeline = scheduler_ingestion_pipeline(log, db)
+        assert pipeline.run_until_caught_up() == 1
+        jobs, _ = db.fetch_job_updates(0, 0)
+        assert [r["job_id"] for r in jobs] == ["j1"]
+        # Re-running applies nothing new (positions persisted).
+        assert pipeline.run_until_caught_up() == 0
+        db.close()
+        # Simulate restart: fresh pipeline from stored positions must not
+        # re-apply j1 but must pick up a new event.
+        publisher.publish([seq(events=[submit("j2")])])
+        db2 = SchedulerDb(db_path)
+        pipeline2 = scheduler_ingestion_pipeline(log, db2)
+        assert pipeline2.run_until_caught_up() == 1
+        jobs, _ = db2.fetch_job_updates(0, 0)
+        assert {r["job_id"] for r in jobs} == {"j1", "j2"}
+        db2.close()
+
+
+def test_marker_roundtrip_through_pipeline(tmp_path):
+    with EventLog(str(tmp_path / "log"), num_partitions=3) as log:
+        publisher = Publisher(log)
+        group = publisher.publish_markers()
+        db = SchedulerDb()
+        pipeline = scheduler_ingestion_pipeline(log, db)
+        pipeline.run_until_caught_up()
+        assert db.has_marker(group, num_partitions=3)
+        assert not db.has_marker("other-group", num_partitions=3)
+
+
+def test_duplicate_submit_is_idempotent():
+    db = SchedulerDb()
+    batch = convert_sequences([seq(events=[submit("j1")])])
+    db.store(batch)
+    db.store(batch)  # replay (at-least-once delivery)
+    jobs, _ = db.fetch_job_updates(0, 0)
+    assert len(jobs) == 1
