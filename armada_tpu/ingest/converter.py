@@ -84,20 +84,29 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
         )
     if kind == "job_run_leased":
         e = ev.job_run_leased
-        return ops.InsertRuns(
-            runs={
-                e.run_id: {
-                    "run_id": e.run_id,
-                    "job_id": e.job_id,
-                    "created_ns": int(ev.created_ns),
-                    "executor": e.executor_id,
-                    "node_id": e.node_id,
-                    "pool": e.pool,
-                    "scheduled_at_priority": int(e.scheduled_at_priority),
-                    "pool_scheduled_away": int(e.pool_scheduled_away),
+        return [
+            ops.InsertRuns(
+                runs={
+                    e.run_id: {
+                        "run_id": e.run_id,
+                        "job_id": e.job_id,
+                        "created_ns": int(ev.created_ns),
+                        "executor": e.executor_id,
+                        "node_id": e.node_id,
+                        "pool": e.pool,
+                        "scheduled_at_priority": int(e.scheduled_at_priority),
+                        "pool_scheduled_away": int(e.pool_scheduled_away),
+                    }
                 }
-            }
-        )
+            ),
+            # The lease flips the job to not-queued at the event's sequence
+            # number (reference: instructions.go:225-228).
+            ops.UpdateJobQueuedState(
+                state_by_job={
+                    e.job_id: (False, int(e.update_sequence_number))
+                }
+            ),
+        ]
     if kind == "job_run_assigned":
         e = ev.job_run_assigned
         return ops.MarkRunsPending(runs={e.run_id: e.job_id})

@@ -1,0 +1,28 @@
+"""The scheduler service: the event-sourced main loop around the TPU round kernel.
+
+Equivalent of the reference's `internal/scheduler` application layer
+(scheduler.go Run:142 / cycle:246): sync state from the scheduler DB into the
+JobDb, check leadership, derive job state-transition events, expire lost
+executors, run the scheduling algorithm, publish decisions to the event log,
+and commit the JobDb transaction.
+"""
+
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+from armada_tpu.scheduler.leader import (
+    LeaderController,
+    StandaloneLeaderController,
+    FileLeaseLeaderController,
+)
+from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
+from armada_tpu.scheduler.scheduler import Scheduler, CycleResult
+
+__all__ = [
+    "ExecutorSnapshot",
+    "LeaderController",
+    "StandaloneLeaderController",
+    "FileLeaseLeaderController",
+    "FairSchedulingAlgo",
+    "SchedulerResult",
+    "Scheduler",
+    "CycleResult",
+]

@@ -1,0 +1,240 @@
+"""FairSchedulingAlgo: the per-cycle scheduling decision over all pools.
+
+Equivalent of the reference's SchedulingAlgo interface + FairSchedulingAlgo
+(internal/scheduler/scheduling/scheduling_algo.go:36-41,100-848): collect
+healthy executors' nodes, the queued and running jobs per pool, run one
+scheduling round per pool -- here the jitted TPU kernel
+(armada_tpu.models.run_scheduling_round) instead of the Go
+PreemptingQueueScheduler -- and apply the decisions to the JobDb transaction.
+
+Executor health filters mirror scheduling_algo.go:
+  * stale executors (heartbeat older than executor_timeout_s) are excluded
+    entirely (filterStaleExecutors:798);
+  * cordoned executors keep their nodes visible (running jobs still count for
+    fairness) but unschedulable (filterCordonedExecutors:780);
+  * lagging executors (too many unacknowledged leases) likewise stop receiving
+    new jobs but keep their allocation counted (filterLaggingExecutors:816).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Callable, Optional, Sequence
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.jobdb.job import Job, JobRun
+from armada_tpu.jobdb.jobdb import WriteTxn
+from armada_tpu.models import RoundOutcome, run_scheduling_round
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+
+@dataclasses.dataclass
+class PoolStats:
+    pool: str
+    outcome: RoundOutcome
+    num_nodes: int
+    num_queued: int
+    num_running: int
+
+
+@dataclasses.dataclass
+class SchedulerResult:
+    """The decisions of one cycle (the reference's SchedulerResult)."""
+
+    # (job AFTER lease applied, its new run)
+    scheduled: list = dataclasses.field(default_factory=list)
+    # (job AFTER preemption applied, the preempted run)
+    preempted: list = dataclasses.field(default_factory=list)
+    # job ids attempted but unplaceable this round
+    failed: list = dataclasses.field(default_factory=list)
+    pools: list = dataclasses.field(default_factory=list)  # list[PoolStats]
+
+
+def _new_run_id() -> str:
+    return uuid.uuid4().hex
+
+
+class FairSchedulingAlgo:
+    """Schedule(ctx, txn) over every pool; mutates the txn with the outcome."""
+
+    def __init__(
+        self,
+        config: SchedulingConfig,
+        queues: Callable[[], Sequence[Queue]],
+        clock_ns: Callable[[], int],
+        run_id_factory: Callable[[], str] = _new_run_id,
+    ):
+        self.config = config
+        self._queues = queues
+        self._clock_ns = clock_ns
+        self._run_id = run_id_factory
+
+    # --- executor health (scheduling_algo.go:780-830) -----------------------
+
+    def _healthy_executors(
+        self, executors: Sequence[ExecutorSnapshot], now_ns: int
+    ) -> list[ExecutorSnapshot]:
+        timeout_ns = int(self.config.executor_timeout_s * 1e9)
+        out = []
+        for ex in executors:
+            if now_ns - ex.last_update_ns > timeout_ns:
+                continue  # stale: invisible this round
+            lagging = (
+                len(ex.unacknowledged_runs)
+                > self.config.max_unacknowledged_jobs_per_executor
+            )
+            if ex.cordoned or lagging:
+                ex = dataclasses.replace(
+                    ex,
+                    nodes=tuple(
+                        dataclasses.replace(n, unschedulable=True) for n in ex.nodes
+                    ),
+                )
+            out.append(ex)
+        return out
+
+    # --- the per-cycle entry point ------------------------------------------
+
+    def schedule(
+        self,
+        txn: WriteTxn,
+        executors: Sequence[ExecutorSnapshot],
+        now_ns: Optional[int] = None,
+    ) -> SchedulerResult:
+        now_ns = self._clock_ns() if now_ns is None else now_ns
+        result = SchedulerResult()
+
+        healthy = self._healthy_executors(executors, now_ns)
+        nodes: list[NodeSpec] = []
+        executor_of_node: dict[str, str] = {}
+        for ex in healthy:
+            for n in ex.nodes:
+                nodes.append(n)
+                executor_of_node[n.id] = ex.id
+
+        queues = list(self._queues())
+        known_queues = {q.name for q in queues}
+
+        pools = [p.name for p in self.config.pools]
+        for n in nodes:
+            if n.pool not in pools:
+                pools.append(n.pool)
+
+        # Queued jobs: validated, in a known queue, with their CURRENT priority
+        # (reprioritisation updates Job.priority, not the immutable spec).
+        queued_jobs: list[JobSpec] = []
+        job_of_spec: dict[str, Job] = {}
+        for qname in txn.queues_with_queued_jobs():
+            if qname not in known_queues:
+                continue
+            for job in txn.queued_jobs(qname):
+                if not job.validated:
+                    continue
+                # Validated pools (Job.pools) override the requested ones.
+                queued_jobs.append(
+                    dataclasses.replace(
+                        job.spec,
+                        priority=job.priority,
+                        pools=job.pools or job.spec.pools,
+                    )
+                )
+                job_of_spec[job.id] = job
+
+        # Running jobs, grouped by pool of their run.
+        running_by_pool: dict[str, list[RunningJob]] = {p: [] for p in pools}
+        for job in txn.all_jobs():
+            run = job.latest_run
+            if run is None or run.in_terminal_state() or job.in_terminal_state():
+                continue
+            if job.queue not in known_queues:
+                continue
+            pool = run.pool or "default"
+            if pool not in running_by_pool:
+                running_by_pool[pool] = []
+            running_by_pool[pool].append(
+                RunningJob(
+                    job=dataclasses.replace(job.spec, priority=job.priority),
+                    node_id=run.node_id,
+                    priority=run.scheduled_at_priority or 0,
+                )
+            )
+
+        for pool in pools:
+            pool_nodes = [n for n in nodes if n.pool == pool]
+            running = running_by_pool.get(pool, [])
+            if not pool_nodes or (not queued_jobs and not running):
+                continue
+            outcome = run_scheduling_round(
+                self.config,
+                pool=pool,
+                nodes=pool_nodes,
+                queues=queues,
+                queued_jobs=queued_jobs,
+                running=running,
+            )
+            self._apply_outcome(
+                txn, outcome, pool, executor_of_node, now_ns, result
+            )
+            result.pools.append(
+                PoolStats(
+                    pool=pool,
+                    outcome=outcome,
+                    num_nodes=len(pool_nodes),
+                    num_queued=len(queued_jobs),
+                    num_running=len(running),
+                )
+            )
+            # Jobs scheduled in this pool are no longer queued for later pools.
+            scheduled_ids = set(outcome.scheduled)
+            if scheduled_ids:
+                queued_jobs = [
+                    j for j in queued_jobs if j.id not in scheduled_ids
+                ]
+
+        return result
+
+    # --- applying a pool outcome to the txn ---------------------------------
+
+    def _apply_outcome(
+        self,
+        txn: WriteTxn,
+        outcome: RoundOutcome,
+        pool: str,
+        executor_of_node: dict,
+        now_ns: int,
+        result: SchedulerResult,
+    ) -> None:
+        for job_id, node_id in outcome.scheduled.items():
+            job = txn.get(job_id)
+            if job is None:
+                continue
+            pc = job.priority_class(self.config)
+            run = JobRun(
+                id=self._run_id(),
+                job_id=job_id,
+                created_ns=now_ns,
+                executor=executor_of_node.get(node_id, ""),
+                node_id=node_id,
+                node_name=node_id,
+                pool=pool,
+                scheduled_at_priority=pc.priority,
+            )
+            job = job.with_new_run(run)
+            txn.upsert(job)
+            result.scheduled.append((job, run))
+
+        for job_id in outcome.preempted:
+            job = txn.get(job_id)
+            if job is None or job.in_terminal_state():
+                continue
+            run = job.latest_run
+            if run is None or run.in_terminal_state():
+                continue
+            run = run.with_preempted()
+            job = job.with_updated_run(run).with_failed()
+            txn.upsert(job)
+            result.preempted.append((job, run))
+
+        result.failed.extend(outcome.failed)

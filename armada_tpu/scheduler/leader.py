@@ -1,0 +1,133 @@
+"""Leader election: only the leader schedules; followers mirror state.
+
+Equivalent of the reference's `internal/scheduler/leader` (leader.go:19-190):
+a LeaderController hands out tokens and validates them, so a scheduler that
+loses leadership mid-cycle discards its work instead of publishing with stale
+authority (token fencing, scheduler.go:263).  Two implementations:
+
+* StandaloneLeaderController -- always leader (leader.go:64, dev/single-replica).
+* FileLeaseLeaderController -- a lease file on shared storage stands in for the
+  reference's Kubernetes coordination/v1 Lease (leader.go:112-186): holders
+  renew before expiry; on expiry any replica may take over, bumping the fencing
+  generation so stale holders fail validation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fcntl
+import json
+import os
+import time
+from typing import Callable, Optional, Protocol
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderToken:
+    leader: bool
+    generation: int = 0
+
+
+class LeaderController(Protocol):
+    def get_token(self) -> LeaderToken:
+        """Current leadership claim; cheap, called once per cycle."""
+
+    def validate_token(self, token: LeaderToken) -> bool:
+        """True iff `token` still confers leadership (fencing re-check before
+        publishing, scheduler.go:263,355)."""
+
+
+class StandaloneLeaderController:
+    """Always leader (leader.go StandaloneLeaderController:64)."""
+
+    def get_token(self) -> LeaderToken:
+        return LeaderToken(leader=True, generation=0)
+
+    def validate_token(self, token: LeaderToken) -> bool:
+        return token.leader
+
+
+class FileLeaseLeaderController:
+    """Lease-file election with fencing generations.
+
+    The lease file holds {holder, generation, expiry}.  acquire-or-renew runs
+    under an exclusive flock on a sidecar lock file, so exactly one replica
+    wins each expiry race.  Generations only grow; a token from generation g
+    is invalid once any replica has acquired generation > g -- the property the
+    reference gets from Lease resourceVersion fencing (leader.go:149-186).
+    """
+
+    def __init__(
+        self,
+        lease_path: str,
+        holder_id: str,
+        lease_duration_s: float = 15.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._path = lease_path
+        self._holder = holder_id
+        self._duration = lease_duration_s
+        self._clock = clock
+
+    # --- lease file access (always under flock) -----------------------------
+
+    def _locked(self, fn):
+        os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+        with open(self._path + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            return fn()
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self, lease: dict) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(lease, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    # --- LeaderController ---------------------------------------------------
+
+    def get_token(self) -> LeaderToken:
+        def attempt() -> LeaderToken:
+            now = self._clock()
+            lease = self._read()
+            if lease is None or now >= lease["expiry"]:
+                generation = (lease["generation"] + 1) if lease else 1
+                self._write(
+                    {
+                        "holder": self._holder,
+                        "generation": generation,
+                        "expiry": now + self._duration,
+                    }
+                )
+                return LeaderToken(leader=True, generation=generation)
+            if lease["holder"] == self._holder:
+                # renew
+                lease["expiry"] = now + self._duration
+                self._write(lease)
+                return LeaderToken(leader=True, generation=lease["generation"])
+            return LeaderToken(leader=False, generation=lease["generation"])
+
+        return self._locked(attempt)
+
+    def validate_token(self, token: LeaderToken) -> bool:
+        if not token.leader:
+            return False
+
+        def check() -> bool:
+            lease = self._read()
+            return (
+                lease is not None
+                and lease["holder"] == self._holder
+                and lease["generation"] == token.generation
+                and self._clock() < lease["expiry"]
+            )
+
+        return self._locked(check)

@@ -1,0 +1,506 @@
+"""The scheduler main loop: sync -> transition -> schedule -> publish -> commit.
+
+Equivalent of the reference's Scheduler (internal/scheduler/scheduler.go:33-41
+docstring, Run:142, cycle:246).  Each cycle:
+
+  1. syncState: incremental fetch from the scheduler DB (rows whose serial
+     advanced) reconciled into the JobDb txn (scheduler.go syncState:386).
+  2. Leader check: followers commit the synced state and stop (scheduler.go:263).
+  3. generateUpdateMessages: derive state-transition events from what the DB
+     told us -- cancellations, run success/failure, retries/requeues,
+     validation (scheduler.go:698, submitCheck:1011).
+  4. expireJobsIfNecessary: executors past their heartbeat timeout lose their
+     active runs; the jobs are returned and requeued (scheduler.go:929).
+  5. schedulingAlgo.Schedule: the TPU round over every pool (the replaceable
+     interface, scheduling_algo.go:36-41).
+  6. eventsFromSchedulerResult: leases + preemptions as events
+     (scheduler.go:570).
+  7. Re-validate leadership (token fencing), publish every event sequence to
+     the log, commit the JobDb txn (scheduler.go:355,375).
+
+If publish fails (or leadership was lost) the txn aborts: no decision leaks
+into local state that is not also in the log -- the log stays the source of
+truth, and the next cycle re-derives everything from the DB.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.eventlog.publisher import Publisher, wait_for_markers
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.jobdb.job import Job, JobRun
+from armada_tpu.jobdb.jobdb import JobDb, WriteTxn
+from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+from armada_tpu.scheduler.leader import LeaderController, LeaderToken
+from armada_tpu.scheduler.reconciliation import apply_rows
+
+MAX_RETRIES_EXCEEDED = "maxRetriesExceeded"
+PREEMPTED_REASON = "preempted"
+LEASE_EXPIRED = "leaseExpired"
+
+
+@dataclasses.dataclass
+class CycleResult:
+    """What one cycle did (inputs to metrics + tests)."""
+
+    leader: bool = False
+    scheduled: bool = False
+    synced_jobs: list = dataclasses.field(default_factory=list)
+    published: list = dataclasses.field(default_factory=list)  # EventSequences
+    scheduler_result: Optional[SchedulerResult] = None
+
+    def events_by_kind(self) -> dict:
+        out: dict = {}
+        for seq in self.published:
+            for ev in seq.events:
+                kind = ev.WhichOneof("event")
+                out[kind] = out.get(kind, 0) + 1
+        return out
+
+
+class _SequenceBuilder:
+    """Accumulates events grouped per (queue, jobset) EventSequence."""
+
+    def __init__(self):
+        self._seqs: dict[tuple[str, str], pb.EventSequence] = {}
+
+    def add(self, queue: str, jobset: str, event: pb.Event) -> None:
+        key = (queue, jobset)
+        seq = self._seqs.get(key)
+        if seq is None:
+            seq = pb.EventSequence(queue=queue, jobset=jobset)
+            self._seqs[key] = seq
+        seq.events.append(event)
+
+    def build(self) -> list[pb.EventSequence]:
+        return [s for s in self._seqs.values() if len(s.events)]
+
+
+class Scheduler:
+    """The scheduling service main loop (scheduler.go:142)."""
+
+    def __init__(
+        self,
+        db: SchedulerDb,
+        jobdb: JobDb,
+        algo: FairSchedulingAlgo,
+        publisher: Publisher,
+        leader: LeaderController,
+        config: Optional[SchedulingConfig] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.db = db
+        self.jobdb = jobdb
+        self.algo = algo
+        self.publisher = publisher
+        self.leader = leader
+        self.config = config or jobdb.config
+        self._clock = clock
+        # Incremental-fetch cursors (scheduler.go jobsSerial/runsSerial:79-81).
+        self._jobs_serial = 0
+        self._runs_serial = 0
+        self._was_leader = False
+
+    def now_ns(self) -> int:
+        return int(self._clock() * 1e9)
+
+    # --- state sync (scheduler.go syncState:386) ----------------------------
+
+    def sync_state(self, txn: WriteTxn) -> list[str]:
+        job_rows, run_rows = self.db.fetch_job_updates(
+            self._jobs_serial, self._runs_serial
+        )
+        touched = apply_rows(txn, job_rows, run_rows, self.config)
+        if job_rows:
+            self._jobs_serial = max(r["serial"] for r in job_rows)
+        if run_rows:
+            self._runs_serial = max(r["serial"] for r in run_rows)
+        return touched
+
+    # --- recovery fencing (scheduler.go ensureDbUpToDate:1120) --------------
+
+    def ensure_db_up_to_date(
+        self,
+        ingest_step: Optional[Callable[[], int]] = None,
+        timeout_s: float = 30.0,
+        poll_interval_s: float = 0.05,
+    ) -> None:
+        """Publish a marker to every partition and wait until the ingestion
+        path has materialized all of them: after this, the DB reflects every
+        event published before our leadership began.  `ingest_step` (if given)
+        drives an in-process ingestion pipeline between polls."""
+        group = self.publisher.publish_markers()
+        deadline = time.monotonic() + timeout_s
+        num_parts = self.publisher._log.num_partitions
+        while True:
+            if ingest_step is not None:
+                ingest_step()
+            if self.db.has_marker(group, num_parts):
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"marker group {group} not materialized within {timeout_s}s"
+                )
+            time.sleep(poll_interval_s)
+
+    # --- executors ----------------------------------------------------------
+
+    def _executors(self) -> list[ExecutorSnapshot]:
+        factory = self.config.resource_list_factory()
+        return [
+            ExecutorSnapshot.from_json(row["snapshot"], factory)
+            for row in self.db.executors()
+        ]
+
+    # --- the cycle (scheduler.go cycle:246) ---------------------------------
+
+    def cycle(self, schedule: bool = True) -> CycleResult:
+        result = CycleResult()
+        txn = self.jobdb.write_txn()
+        try:
+            touched = self.sync_state(txn)
+            result.synced_jobs = touched
+
+            token: LeaderToken = self.leader.get_token()
+            result.leader = token.leader
+            if not token.leader:
+                self._was_leader = False
+                txn.commit()
+                return result
+            if not self._was_leader:
+                # Fresh leadership: catch up with everything already published
+                # before taking decisions (scheduler.go:169-181).
+                self._was_leader = True
+
+            builder = _SequenceBuilder()
+            now_ns = self.now_ns()
+
+            self._generate_update_messages(txn, touched, builder, now_ns)
+            self._validate_jobs(txn, builder, now_ns)
+            self._expire_executor_jobs(txn, builder, now_ns)
+
+            if schedule:
+                sched = self.algo.schedule(txn, self._executors(), now_ns)
+                result.scheduler_result = sched
+                result.scheduled = True
+                self._events_from_scheduler_result(sched, builder, now_ns)
+
+            sequences = builder.build()
+            if sequences:
+                # Fencing: never publish with stale authority (scheduler.go:355).
+                if not self.leader.validate_token(token):
+                    txn.abort()
+                    result.leader = False
+                    return result
+                self.publisher.publish(sequences)
+            result.published = sequences
+
+            if self.config.enable_assertions:
+                txn.assert_invariants()
+            txn.commit()
+            return result
+        except BaseException:
+            txn.abort()
+            raise
+
+    # --- job state transitions (scheduler.go generateUpdateMessages:698) ----
+
+    def _generate_update_messages(
+        self,
+        txn: WriteTxn,
+        touched: Iterable[str],
+        builder: _SequenceBuilder,
+        now_ns: int,
+    ) -> None:
+        for job_id in touched:
+            job = txn.get(job_id)
+            if job is None or job.in_terminal_state():
+                continue
+
+            # Cancellation requested (by job or jobset).
+            if job.cancel_requested or job.cancel_by_jobset_requested:
+                run = job.latest_run
+                if run is not None and not run.in_terminal_state():
+                    builder.add(
+                        job.queue,
+                        job.jobset,
+                        pb.Event(
+                            created_ns=now_ns,
+                            job_run_cancelled=pb.JobRunCancelled(
+                                job_id=job.id, run_id=run.id
+                            ),
+                        ),
+                    )
+                    job = job.with_updated_run(run.with_cancelled())
+                builder.add(
+                    job.queue,
+                    job.jobset,
+                    pb.Event(
+                        created_ns=now_ns,
+                        cancelled_job=pb.CancelledJob(job_id=job.id),
+                    ),
+                )
+                txn.upsert(job.with_cancelled())
+                continue
+
+            run = job.latest_run
+            if run is None:
+                continue
+
+            if run.succeeded and not job.succeeded:
+                builder.add(
+                    job.queue,
+                    job.jobset,
+                    pb.Event(
+                        created_ns=now_ns,
+                        job_succeeded=pb.JobSucceeded(job_id=job.id),
+                    ),
+                )
+                txn.upsert(job.with_succeeded())
+            elif run.preempted:
+                # Executor-confirmed preemption terminates the job
+                # (scheduler.go: preempted runs fail their job).
+                builder.add(
+                    job.queue,
+                    job.jobset,
+                    pb.Event(
+                        created_ns=now_ns,
+                        job_errors=pb.JobErrors(
+                            job_id=job.id,
+                            errors=[
+                                pb.Error(
+                                    reason=PREEMPTED_REASON,
+                                    message=f"run {run.id} preempted",
+                                    terminal=True,
+                                    node=run.node_name,
+                                )
+                            ],
+                        ),
+                    ),
+                )
+                txn.upsert(job.with_failed())
+            elif run.failed and not run.returned:
+                # A failed run means a terminal error was reported
+                # (instructions.go handleJobRunErrors): the job fails with it.
+                builder.add(
+                    job.queue,
+                    job.jobset,
+                    pb.Event(
+                        created_ns=now_ns,
+                        job_errors=pb.JobErrors(
+                            job_id=job.id,
+                            errors=[
+                                pb.Error(
+                                    reason="runFailed",
+                                    message=f"run {run.id} failed on {run.node_name}",
+                                    terminal=True,
+                                    node=run.node_name,
+                                )
+                            ],
+                        ),
+                    ),
+                )
+                txn.upsert(job.with_failed())
+            elif run.returned and not job.queued:
+                self._fail_or_requeue(
+                    txn,
+                    job,
+                    builder,
+                    now_ns,
+                    reason="runReturned",
+                    message=f"run {run.id} returned by {run.executor}",
+                )
+
+    def _fail_or_requeue(
+        self,
+        txn: WriteTxn,
+        job: Job,
+        builder: _SequenceBuilder,
+        now_ns: int,
+        reason: str,
+        message: str,
+    ) -> None:
+        """Requeue up to max_retries attempted runs, else fail terminally
+        (scheduler.go:473-568 retry logic)."""
+        if job.num_attempts() <= self.config.max_retries and not (
+            job.cancel_requested or job.cancel_by_jobset_requested
+        ):
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_requeued=pb.JobRequeued(
+                        job_id=job.id,
+                        update_sequence_number=job.queued_version + 1,
+                    ),
+                ),
+            )
+            txn.upsert(job.with_queued(True))
+        else:
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_errors=pb.JobErrors(
+                        job_id=job.id,
+                        errors=[
+                            pb.Error(
+                                reason=MAX_RETRIES_EXCEEDED,
+                                message=message,
+                                terminal=True,
+                            )
+                        ],
+                    ),
+                ),
+            )
+            txn.upsert(job.with_failed())
+
+    # --- validation (scheduler.go submitCheck:1011; full SubmitChecker TBD) -
+
+    def _validate_jobs(
+        self, txn: WriteTxn, builder: _SequenceBuilder, now_ns: int
+    ) -> None:
+        all_pools = tuple(p.name for p in self.config.pools)
+        for job in txn.unvalidated_jobs():
+            pools = job.spec.pools or all_pools
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_validated=pb.JobValidated(job_id=job.id, pools=pools),
+                ),
+            )
+            txn.upsert(job.with_validated(tuple(pools)))
+
+    # --- executor expiry (scheduler.go expireJobsIfNecessary:929) -----------
+
+    def _expire_executor_jobs(
+        self, txn: WriteTxn, builder: _SequenceBuilder, now_ns: int
+    ) -> None:
+        timeout_ns = int(self.config.executor_timeout_s * 1e9)
+        stale = {
+            ex.id
+            for ex in self._executors()
+            if now_ns - ex.last_update_ns > timeout_ns
+        }
+        if not stale:
+            return
+        for job in txn.all_jobs():
+            run = job.latest_run
+            if (
+                job.in_terminal_state()
+                or run is None
+                or run.in_terminal_state()
+                or run.executor not in stale
+            ):
+                continue
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_run_errors=pb.JobRunErrors(
+                        job_id=job.id,
+                        run_id=run.id,
+                        errors=[
+                            pb.Error(
+                                reason=LEASE_EXPIRED,
+                                message=f"executor {run.executor} stopped heartbeating",
+                                terminal=False,
+                            )
+                        ],
+                    ),
+                ),
+            )
+            job = job.with_updated_run(run.with_returned(run_attempted=run.running))
+            txn.upsert(job)
+            self._fail_or_requeue(
+                txn,
+                job,
+                builder,
+                now_ns,
+                reason=LEASE_EXPIRED,
+                message=f"executor {run.executor} lost",
+            )
+
+    # --- decision events (scheduler.go eventsFromSchedulerResult:570) -------
+
+    def _events_from_scheduler_result(
+        self, sched: SchedulerResult, builder: _SequenceBuilder, now_ns: int
+    ) -> None:
+        for job, run in sched.scheduled:
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_run_leased=pb.JobRunLeased(
+                        job_id=job.id,
+                        run_id=run.id,
+                        executor_id=run.executor,
+                        node_id=run.node_id,
+                        pool=run.pool,
+                        scheduled_at_priority=run.scheduled_at_priority or 0,
+                        pool_scheduled_away=run.pool_scheduled_away,
+                        update_sequence_number=job.queued_version,
+                    ),
+                ),
+            )
+        for job, run in sched.preempted:
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_run_preempted=pb.JobRunPreempted(
+                        job_id=job.id, run_id=run.id, reason=PREEMPTED_REASON
+                    ),
+                ),
+            )
+            builder.add(
+                job.queue,
+                job.jobset,
+                pb.Event(
+                    created_ns=now_ns,
+                    job_errors=pb.JobErrors(
+                        job_id=job.id,
+                        errors=[
+                            pb.Error(
+                                reason=PREEMPTED_REASON,
+                                message=f"run {run.id} preempted by the scheduler",
+                                terminal=True,
+                            )
+                        ],
+                    ),
+                ),
+            )
+
+    # --- service loop (scheduler.go Run:142) --------------------------------
+
+    def run(
+        self,
+        stop,
+        cycle_interval_s: float = 1.0,
+        schedule_interval_s: float = 10.0,
+    ) -> None:
+        """Tick cycles until `stop` (a threading.Event) is set: a full
+        scheduling round every schedule_interval, cheap reconcile cycles in
+        between (cyclePeriod/schedulePeriod, config/scheduler/config.yaml:1-3)."""
+        last_schedule = 0.0
+        while not stop.is_set():
+            start = self._clock()
+            do_schedule = start - last_schedule >= schedule_interval_s
+            self.cycle(schedule=do_schedule)
+            if do_schedule:
+                last_schedule = start
+            elapsed = self._clock() - start
+            stop.wait(max(0.0, cycle_interval_s - elapsed))

@@ -1,0 +1,455 @@
+"""End-to-end scheduler service tests: the event-sourced main loop.
+
+Modeled on the reference's TestScheduler_TestCycle / TestCycleConsistency
+(internal/scheduler/scheduler_test.go:330,2119): drive events through
+publish -> ingest -> sync -> cycle -> publish and assert on both the JobDb
+state and the emitted events.
+"""
+
+import threading
+
+import pytest
+
+from armada_tpu.core.config import PoolConfig, SchedulingConfig
+from armada_tpu.core.types import NodeSpec, Queue
+from armada_tpu.eventlog import EventLog
+from armada_tpu.eventlog.publisher import Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.events.convert import job_spec_to_proto
+from armada_tpu.core.types import JobSpec
+from armada_tpu.ingest.converter import convert_sequences
+from armada_tpu.ingest.pipeline import IngestionPipeline
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.jobdb.jobdb import JobDb
+from armada_tpu.scheduler import (
+    ExecutorSnapshot,
+    FairSchedulingAlgo,
+    FileLeaseLeaderController,
+    Scheduler,
+    StandaloneLeaderController,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class World:
+    """One in-process control plane: log + db + ingester + scheduler."""
+
+    def __init__(self, tmp_path, config=None, leader=None):
+        self.config = config or SchedulingConfig(shape_bucket=32)
+        self.clock = FakeClock()
+        self.log = EventLog(str(tmp_path / "log"), num_partitions=2)
+        self.db = SchedulerDb(":memory:")
+        self.publisher = Publisher(self.log, clock=self.clock)
+        self.pipeline = IngestionPipeline(
+            self.log, self.db, convert_sequences, consumer_name="scheduler"
+        )
+        self.jobdb = JobDb(self.config)
+        self.factory = self.config.resource_list_factory()
+        algo = FairSchedulingAlgo(
+            self.config,
+            queues=lambda: [Queue("q1"), Queue("q2")],
+            clock_ns=lambda: int(self.clock() * 1e9),
+        )
+        self.scheduler = Scheduler(
+            self.db,
+            self.jobdb,
+            algo,
+            self.publisher,
+            leader or StandaloneLeaderController(),
+            self.config,
+            clock=self.clock,
+        )
+
+    def ingest(self):
+        return self.pipeline.run_until_caught_up()
+
+    def submit(self, job_id, queue="q1", jobset="js1", cpu="1", mem="1", **kw):
+        spec = JobSpec(
+            id=job_id,
+            queue=queue,
+            jobset=jobset,
+            resources=self.factory.from_mapping({"cpu": cpu, "memory": mem}),
+            **kw,
+        )
+        seq = pb.EventSequence(
+            queue=queue,
+            jobset=jobset,
+            events=[
+                pb.Event(
+                    created_ns=int(self.clock() * 1e9),
+                    submit_job=pb.SubmitJob(
+                        job_id=job_id, spec=job_spec_to_proto(spec)
+                    ),
+                )
+            ],
+        )
+        self.publisher.publish([seq])
+
+    def add_executor(self, ex_id="ex1", pool="default", num_nodes=2, cpu="8", mem="64"):
+        nodes = tuple(
+            NodeSpec(
+                id=f"{ex_id}-n{i}",
+                pool=pool,
+                executor=ex_id,
+                total_resources=self.factory.from_mapping({"cpu": cpu, "memory": mem}),
+            )
+            for i in range(num_nodes)
+        )
+        snap = ExecutorSnapshot(
+            id=ex_id,
+            pool=pool,
+            nodes=nodes,
+            last_update_ns=int(self.clock() * 1e9),
+        )
+        self.db.upsert_executor(ex_id, snap.to_json(), snap.last_update_ns)
+        return snap
+
+    def heartbeat(self, ex_id="ex1"):
+        # refresh last_update_ns keeping nodes
+        row = {r["executor_id"]: r for r in self.db.executors()}[ex_id]
+        snap = ExecutorSnapshot.from_json(row["snapshot"], self.factory)
+        import dataclasses
+
+        snap = dataclasses.replace(snap, last_update_ns=int(self.clock() * 1e9))
+        self.db.upsert_executor(ex_id, snap.to_json(), snap.last_update_ns)
+
+    def report_run(self, job_id, run_id, queue="q1", jobset="js1", kind="job_run_succeeded"):
+        ev = pb.Event(created_ns=int(self.clock() * 1e9))
+        getattr(ev, kind).job_id = job_id
+        getattr(ev, kind).run_id = run_id
+        self.publisher.publish(
+            [pb.EventSequence(queue=queue, jobset=jobset, events=[ev])]
+        )
+
+    def close(self):
+        self.db.close()
+        self.log.close()
+
+
+@pytest.fixture
+def world(tmp_path):
+    w = World(tmp_path)
+    yield w
+    w.close()
+
+
+def events_of_kind(sequences, kind):
+    return [
+        getattr(ev, kind)
+        for seq in sequences
+        for ev in seq.events
+        if ev.WhichOneof("event") == kind
+    ]
+
+
+def test_submit_validate_lease_succeed_lifecycle(world):
+    world.submit("job-1")
+    world.ingest()
+    world.add_executor()
+
+    # Cycle 1: job synced, validated, scheduled -> lease event.
+    res = world.scheduler.cycle()
+    assert res.leader and res.scheduled
+    assert "job-1" in res.synced_jobs
+    validated = events_of_kind(res.published, "job_validated")
+    leased = events_of_kind(res.published, "job_run_leased")
+    assert [v.job_id for v in validated] == ["job-1"]
+    assert len(leased) == 1 and leased[0].job_id == "job-1"
+    run_id = leased[0].run_id
+    assert leased[0].node_id.startswith("ex1-n")
+
+    job = world.jobdb.read_txn().get("job-1")
+    assert job is not None and not job.queued and job.latest_run is not None
+
+    # Round-trip the lease; job must NOT be rescheduled next cycle.
+    world.ingest()
+    res2 = world.scheduler.cycle()
+    assert events_of_kind(res2.published, "job_run_leased") == []
+
+    # Executor reports success.
+    world.report_run("job-1", run_id, kind="job_run_succeeded")
+    world.ingest()
+    res3 = world.scheduler.cycle()
+    succeeded = events_of_kind(res3.published, "job_succeeded")
+    assert [s.job_id for s in succeeded] == ["job-1"]
+
+    # Success round-trips -> DB row terminal -> job leaves the JobDb.
+    world.ingest()
+    world.scheduler.cycle()
+    assert world.jobdb.read_txn().get("job-1") is None
+
+
+def test_cancellation_of_queued_job(world):
+    world.submit("job-c")
+    world.ingest()
+    # no executor: job stays queued after validation
+    world.scheduler.cycle()
+    world.ingest()
+
+    world.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js1",
+                events=[
+                    pb.Event(
+                        created_ns=world.scheduler.now_ns(),
+                        cancel_job=pb.CancelJob(job_id="job-c", reason="user"),
+                    )
+                ],
+            )
+        ]
+    )
+    world.ingest()
+    res = world.scheduler.cycle()
+    cancelled = events_of_kind(res.published, "cancelled_job")
+    assert [c.job_id for c in cancelled] == ["job-c"]
+    job = world.jobdb.read_txn().get("job-c")
+    assert job is not None and job.cancelled
+    # Round-trip: terminal row deletes the job.
+    world.ingest()
+    world.scheduler.cycle()
+    assert world.jobdb.read_txn().get("job-c") is None
+
+
+def test_cancellation_of_leased_job_cancels_run(world):
+    world.submit("job-l")
+    world.ingest()
+    world.add_executor()
+    res = world.scheduler.cycle()
+    (lease,) = events_of_kind(res.published, "job_run_leased")
+    world.ingest()
+
+    world.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js1",
+                events=[
+                    pb.Event(
+                        created_ns=world.scheduler.now_ns(),
+                        cancel_job=pb.CancelJob(job_id="job-l"),
+                    )
+                ],
+            )
+        ]
+    )
+    world.ingest()
+    res2 = world.scheduler.cycle()
+    assert [c.job_id for c in events_of_kind(res2.published, "cancelled_job")] == ["job-l"]
+    run_cancelled = events_of_kind(res2.published, "job_run_cancelled")
+    assert [r.run_id for r in run_cancelled] == [lease.run_id]
+
+
+def test_jobset_cancellation(world):
+    for i in range(3):
+        world.submit(f"job-{i}", jobset="batch")
+    world.ingest()
+    world.scheduler.cycle()  # validate
+    world.ingest()
+
+    world.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="batch",
+                events=[
+                    pb.Event(
+                        created_ns=world.scheduler.now_ns(),
+                        cancel_job_set=pb.CancelJobSet(reason="all"),
+                    )
+                ],
+            )
+        ]
+    )
+    world.ingest()
+    res = world.scheduler.cycle()
+    cancelled = {c.job_id for c in events_of_kind(res.published, "cancelled_job")}
+    assert cancelled == {"job-0", "job-1", "job-2"}
+
+
+def test_executor_expiry_requeues_jobs(world):
+    world.submit("job-e")
+    world.ingest()
+    world.add_executor()
+    res = world.scheduler.cycle()
+    (lease,) = events_of_kind(res.published, "job_run_leased")
+    world.ingest()
+    world.scheduler.cycle()
+
+    # Executor goes silent past the timeout.
+    world.clock.advance(world.config.executor_timeout_s + 10)
+    res2 = world.scheduler.cycle()
+    requeued = events_of_kind(res2.published, "job_requeued")
+    assert [r.job_id for r in requeued] == ["job-e"]
+    errors = events_of_kind(res2.published, "job_run_errors")
+    assert errors and errors[0].errors[0].reason == "leaseExpired"
+    job = world.jobdb.read_txn().get("job-e")
+    assert job.queued and job.latest_run.returned
+
+    # The stale executor is filtered; nothing to lease onto.
+    assert events_of_kind(res2.published, "job_run_leased") == []
+
+    # Executor comes back: job leases again with a NEW run.
+    world.heartbeat()
+    world.ingest()
+    res3 = world.scheduler.cycle()
+    leased = events_of_kind(res3.published, "job_run_leased")
+    assert len(leased) == 1 and leased[0].run_id != lease.run_id
+
+
+def test_terminal_run_error_fails_job(world):
+    world.submit("job-f")
+    world.ingest()
+    world.add_executor()
+    res = world.scheduler.cycle()
+    (lease,) = events_of_kind(res.published, "job_run_leased")
+    world.ingest()
+
+    # Executor reports a terminal run error.
+    world.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js1",
+                events=[
+                    pb.Event(
+                        created_ns=world.scheduler.now_ns(),
+                        job_run_errors=pb.JobRunErrors(
+                            job_id="job-f",
+                            run_id=lease.run_id,
+                            errors=[
+                                pb.Error(
+                                    reason="oom", message="killed", terminal=True
+                                )
+                            ],
+                        ),
+                    )
+                ],
+            )
+        ]
+    )
+    world.ingest()
+    res2 = world.scheduler.cycle()
+    errs = events_of_kind(res2.published, "job_errors")
+    assert errs and errs[0].job_id == "job-f" and errs[0].errors[0].terminal
+    assert world.jobdb.read_txn().get("job-f").failed
+
+
+def test_follower_syncs_but_does_not_publish(world, tmp_path):
+    class Follower:
+        def get_token(self):
+            from armada_tpu.scheduler.leader import LeaderToken
+
+            return LeaderToken(leader=False)
+
+        def validate_token(self, token):
+            return False
+
+    world.scheduler.leader = Follower()
+    world.submit("job-x")
+    world.ingest()
+    res = world.scheduler.cycle()
+    assert not res.leader
+    assert res.published == []
+    # state still mirrored
+    assert world.jobdb.read_txn().get("job-x") is not None
+
+
+def test_scheduler_restart_resumes_from_db(world, tmp_path):
+    """A fresh scheduler instance rebuilt from the DB does not double-lease."""
+    world.submit("job-r")
+    world.ingest()
+    world.add_executor()
+    res = world.scheduler.cycle()
+    assert len(events_of_kind(res.published, "job_run_leased")) == 1
+    world.ingest()
+
+    # "Restart": new JobDb + scheduler over the same DB.
+    jobdb2 = JobDb(world.config)
+    algo2 = FairSchedulingAlgo(
+        world.config,
+        queues=lambda: [Queue("q1")],
+        clock_ns=world.scheduler.now_ns,
+    )
+    sched2 = Scheduler(
+        world.db,
+        jobdb2,
+        algo2,
+        world.publisher,
+        StandaloneLeaderController(),
+        world.config,
+        clock=world.clock,
+    )
+    res2 = sched2.cycle()
+    assert events_of_kind(res2.published, "job_run_leased") == []
+    job = jobdb2.read_txn().get("job-r")
+    assert job is not None and not job.queued and job.has_active_run()
+
+
+def test_gang_all_or_nothing_through_cycle(world):
+    # 3-member gang, each 4 cpu; two 8-cpu nodes fit only 2 members per node
+    # but 2 nodes x 8 cpu fit all 3 plus a singleton.
+    for i in range(3):
+        world.submit(
+            f"gang-{i}", gang_id="g1", gang_cardinality=3, cpu="4", mem="4"
+        )
+    world.ingest()
+    world.add_executor(num_nodes=2, cpu="8", mem="64")
+    res = world.scheduler.cycle()
+    leased = events_of_kind(res.published, "job_run_leased")
+    assert {l.job_id for l in leased} == {"gang-0", "gang-1", "gang-2"}
+
+
+def test_gang_too_big_is_not_partially_leased(world):
+    for i in range(5):
+        world.submit(
+            f"big-{i}", gang_id="g2", gang_cardinality=5, cpu="4", mem="4"
+        )
+    world.ingest()
+    world.add_executor(num_nodes=2, cpu="8", mem="64")  # only 4 members fit
+    res = world.scheduler.cycle()
+    assert events_of_kind(res.published, "job_run_leased") == []
+
+
+def test_file_lease_leader_election(tmp_path):
+    clock = FakeClock()
+    a = FileLeaseLeaderController(
+        str(tmp_path / "lease"), "a", lease_duration_s=10, clock=clock
+    )
+    b = FileLeaseLeaderController(
+        str(tmp_path / "lease"), "b", lease_duration_s=10, clock=clock
+    )
+    ta = a.get_token()
+    assert ta.leader
+    tb = b.get_token()
+    assert not tb.leader
+    assert a.validate_token(ta)
+    assert not b.validate_token(tb)
+
+    # a expires; b takes over with a higher generation; a's token is fenced.
+    clock.advance(11)
+    tb2 = b.get_token()
+    assert tb2.leader and tb2.generation > ta.generation
+    assert not a.validate_token(ta)
+    # a renews -> follower now
+    ta2 = a.get_token()
+    assert not ta2.leader
+
+
+def test_ensure_db_up_to_date(world):
+    world.submit("job-m")
+    world.scheduler.ensure_db_up_to_date(ingest_step=world.ingest)
+    # after fencing, the submit published before the marker is materialized
+    rows, _ = world.db.fetch_job_updates(0, 0)
+    assert [r["job_id"] for r in rows] == ["job-m"]
