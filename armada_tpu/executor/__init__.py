@@ -1,0 +1,23 @@
+"""The executor: the per-cluster agent reconciling scheduler decisions onto
+compute, and the fake cluster used to test multi-node behavior without one.
+
+Equivalent of the reference's `internal/executor` (application.go StartUp:42):
+a lease-request loop pulls newly assigned runs from the scheduler's
+ExecutorApi and submits them to the cluster; a state-reporting loop turns pod
+lifecycle changes into events.  `FakeClusterContext` mirrors
+internal/executor/fake/context/context.go: simulated nodes + pod lifecycle,
+the middle tier of the reference's three-tier no-real-cluster test strategy
+(SURVEY.md section 4).
+"""
+
+from armada_tpu.executor.cluster import ClusterContext, PodState, PodPhase
+from armada_tpu.executor.fake import FakeClusterContext
+from armada_tpu.executor.service import ExecutorService
+
+__all__ = [
+    "ClusterContext",
+    "PodState",
+    "PodPhase",
+    "FakeClusterContext",
+    "ExecutorService",
+]

@@ -1,0 +1,60 @@
+"""ClusterContext: the executor's only touchpoint with its cluster.
+
+Equivalent of the reference's `internal/executor/context/cluster_context.go`:
+everything the executor does to a cluster -- submit and delete pods, list
+nodes, observe pod state -- goes through this interface, so the same executor
+logic runs against Kubernetes, the fake in-memory cluster, or anything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Protocol, Sequence
+
+from armada_tpu.core.types import JobSpec, NodeSpec
+
+
+class PodPhase(enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class PodState:
+    """Observed state of one pod (run) in the cluster."""
+
+    run_id: str
+    job_id: str
+    queue: str
+    jobset: str
+    node_id: str
+    phase: PodPhase
+    message: str = ""
+
+
+class ClusterContext(Protocol):
+    def submit_pod(
+        self,
+        run_id: str,
+        job_id: str,
+        queue: str,
+        jobset: str,
+        spec: JobSpec,
+        node_id: str,
+    ) -> None:
+        """Bind the job's pod to `node_id`; raises on immediate rejection."""
+
+    def delete_pod(self, run_id: str) -> None:
+        """Remove the pod (cancellation/preemption); idempotent."""
+
+    def node_specs(self) -> Sequence[NodeSpec]:
+        """Current schedulable nodes."""
+
+    def pod_states(self) -> Sequence[PodState]:
+        """Snapshot of every pod the cluster still tracks."""
+
+    def get_pod(self, run_id: str) -> Optional[PodState]:
+        ...

@@ -1,0 +1,144 @@
+"""FakeClusterContext: an in-memory cluster with simulated pod lifecycle.
+
+Equivalent of the reference's fake executor cluster
+(internal/executor/fake/context/context.go:32-57,128): NodeSpec'd phantom
+nodes, capacity-checked pod binding, and a pod lifecycle that advances
+pending -> running -> succeeded.  Where the reference advances state with
+goroutines and wall-clock sleeps, this fake is driven by an explicit virtual
+clock (`tick`), so tests are deterministic and instant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from armada_tpu.core.resources import ResourceListFactory
+from armada_tpu.core.types import JobSpec, NodeSpec
+from armada_tpu.executor.cluster import PodPhase, PodState
+
+DEFAULT_RUNTIME_S = 1.0
+RUNTIME_ANNOTATION = "armada-tpu/runtime-s"
+
+
+@dataclasses.dataclass
+class _Pod:
+    state: PodState
+    requests: np.ndarray  # atoms
+    start_at: float
+    finish_at: float
+
+
+class FakeClusterContext:
+    """A simulated cluster: nodes + pods, advanced by tick(dt)."""
+
+    def __init__(
+        self,
+        nodes: Sequence[NodeSpec],
+        factory: ResourceListFactory,
+        start_delay_s: float = 0.0,
+        runtime_of: Optional[Callable[[JobSpec], float]] = None,
+    ):
+        self._nodes = {n.id: n for n in nodes}
+        self._factory = factory
+        self._start_delay = start_delay_s
+        self._runtime_of = runtime_of or self._default_runtime
+        self._pods: dict[str, _Pod] = {}
+        self._allocated: dict[str, np.ndarray] = {
+            n.id: np.zeros(factory.num_resources, np.int64) for n in nodes
+        }
+        self.now = 0.0
+
+    @staticmethod
+    def _default_runtime(spec: JobSpec) -> float:
+        ann = getattr(spec, "annotations", None) or {}
+        try:
+            return float(ann.get(RUNTIME_ANNOTATION, DEFAULT_RUNTIME_S))
+        except (TypeError, ValueError):
+            return DEFAULT_RUNTIME_S
+
+    # --- ClusterContext -----------------------------------------------------
+
+    def submit_pod(
+        self,
+        run_id: str,
+        job_id: str,
+        queue: str,
+        jobset: str,
+        spec: JobSpec,
+        node_id: str,
+    ) -> None:
+        if run_id in self._pods:
+            return  # idempotent resubmission
+        node = self._nodes.get(node_id)
+        if node is None:
+            raise ValueError(f"unknown node {node_id}")
+        req = (
+            spec.resources.atoms.astype(np.int64)
+            if spec.resources is not None
+            else np.zeros(self._factory.num_resources, np.int64)
+        )
+        total = (
+            node.total_resources.atoms
+            if node.total_resources is not None
+            else np.zeros_like(req)
+        )
+        if np.any(self._allocated[node_id] + req > total):
+            raise ValueError(
+                f"node {node_id} has insufficient capacity for {job_id}"
+            )
+        self._allocated[node_id] += req
+        runtime = self._runtime_of(spec)
+        self._pods[run_id] = _Pod(
+            state=PodState(
+                run_id=run_id,
+                job_id=job_id,
+                queue=queue,
+                jobset=jobset,
+                node_id=node_id,
+                phase=PodPhase.PENDING,
+            ),
+            requests=req,
+            start_at=self.now + self._start_delay,
+            finish_at=self.now + self._start_delay + runtime,
+        )
+
+    def delete_pod(self, run_id: str) -> None:
+        pod = self._pods.pop(run_id, None)
+        if pod is not None and pod.state.phase in (
+            PodPhase.PENDING,
+            PodPhase.RUNNING,
+        ):
+            self._allocated[pod.state.node_id] -= pod.requests
+
+    def node_specs(self) -> Sequence[NodeSpec]:
+        return list(self._nodes.values())
+
+    def pod_states(self) -> Sequence[PodState]:
+        return [p.state for p in self._pods.values()]
+
+    def get_pod(self, run_id: str) -> Optional[PodState]:
+        pod = self._pods.get(run_id)
+        return pod.state if pod else None
+
+    # --- simulation controls ------------------------------------------------
+
+    def tick(self, dt: float = 0.0) -> None:
+        """Advance virtual time; pods start and finish on schedule."""
+        self.now += dt
+        for pod in self._pods.values():
+            if pod.state.phase is PodPhase.PENDING and self.now >= pod.start_at:
+                pod.state.phase = PodPhase.RUNNING
+            if pod.state.phase is PodPhase.RUNNING and self.now >= pod.finish_at:
+                pod.state.phase = PodPhase.SUCCEEDED
+                self._allocated[pod.state.node_id] -= pod.requests
+
+    def fail_pod(self, run_id: str, message: str = "injected failure") -> None:
+        """Fault injection: flip a live pod to FAILED (pod_issue_handler tests)."""
+        pod = self._pods[run_id]
+        if pod.state.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+            self._allocated[pod.state.node_id] -= pod.requests
+        pod.state.phase = PodPhase.FAILED
+        pod.state.message = message

@@ -1,0 +1,234 @@
+"""ExecutorService: the agent's reconcile loops.
+
+Equivalent of the reference's executor task loops (internal/executor/
+application.go setupExecutorApiComponents + service/):
+  * lease_cycle  = job_requester.go RequestJobsRuns + lease_requester.go
+    LeaseJobRuns + cluster_allocation.go AllocateSpareClusterCapacity: report
+    the cluster snapshot, receive new runs / runs-to-stop, submit/delete pods.
+  * report_cycle = job_state_reporter.go: diff pod phases against what was
+    already reported and publish the transitions as events.
+  * cleanup      = resource_cleanup.go: forget reported terminal pods.
+
+The api handle is anything with lease_job_runs/report_events -- the in-process
+ExecutorApi or a gRPC client stub.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from armada_tpu.core.resources import ResourceListFactory
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.events.convert import job_spec_from_proto
+from armada_tpu.executor.cluster import ClusterContext, PodPhase
+from armada_tpu.scheduler.api import LeaseRequest, LeaseResponse
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+# Phase -> the one event kind it is reported as.
+_TERMINAL = (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+
+class ExecutorService:
+    def __init__(
+        self,
+        executor_id: str,
+        pool: str,
+        cluster: ClusterContext,
+        api,
+        factory: ResourceListFactory,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.id = executor_id
+        self.pool = pool
+        self.cluster = cluster
+        self.api = api
+        self._factory = factory
+        self._clock = clock
+        # run_id -> last phase reported to the scheduler
+        self._reported: dict[str, PodPhase] = {}
+        # runs leased to us that we could not start (reported as errors once)
+        self._rejected: set[str] = set()
+
+    # --- snapshot -----------------------------------------------------------
+
+    def snapshot(self) -> ExecutorSnapshot:
+        node_of_run = {
+            p.run_id: p.node_id
+            for p in self.cluster.pod_states()
+            if p.phase not in _TERMINAL
+        }
+        return ExecutorSnapshot(
+            id=self.id,
+            pool=self.pool,
+            nodes=tuple(self.cluster.node_specs()),
+            node_of_run=node_of_run,
+            last_update_ns=int(self._clock() * 1e9),
+        )
+
+    # --- lease loop (lease_requester.go:51) ---------------------------------
+
+    def lease_cycle(self) -> LeaseResponse:
+        active = tuple(p.run_id for p in self.cluster.pod_states())
+        request = LeaseRequest(snapshot=self.snapshot(), active_run_ids=active)
+        response = self.api.lease_job_runs(request)
+
+        errors: list[pb.EventSequence] = []
+        for lease in response.leases:
+            if lease.run_id in self._rejected:
+                continue
+            spec = job_spec_from_proto(
+                lease.job_id,
+                lease.queue,
+                lease.jobset,
+                pb.JobSpec.FromString(lease.spec),
+                self._factory,
+            )
+            try:
+                self.cluster.submit_pod(
+                    lease.run_id,
+                    lease.job_id,
+                    lease.queue,
+                    lease.jobset,
+                    spec,
+                    lease.node_id,
+                )
+            except Exception as e:  # noqa: BLE001 - any rejection fails the run
+                self._rejected.add(lease.run_id)
+                errors.append(
+                    _run_error_sequence(
+                        lease.queue,
+                        lease.jobset,
+                        lease.job_id,
+                        lease.run_id,
+                        reason="podSubmissionRejected",
+                        message=str(e),
+                        now_ns=int(self._clock() * 1e9),
+                    )
+                )
+
+        for run_id in response.runs_to_cancel:
+            self.cluster.delete_pod(run_id)
+            self._reported.pop(run_id, None)
+
+        preempted: list[pb.EventSequence] = []
+        for run_id in response.runs_to_preempt:
+            pod = self.cluster.get_pod(run_id)
+            self.cluster.delete_pod(run_id)
+            self._reported.pop(run_id, None)
+            if pod is not None:
+                ev = pb.Event(
+                    created_ns=int(self._clock() * 1e9),
+                    job_run_preempted=pb.JobRunPreempted(
+                        job_id=pod.job_id, run_id=run_id, reason="preemptRequested"
+                    ),
+                )
+                preempted.append(
+                    pb.EventSequence(
+                        queue=pod.queue, jobset=pod.jobset, events=[ev]
+                    )
+                )
+
+        if errors or preempted:
+            self.api.report_events(errors + preempted)
+        return response
+
+    # --- state reporting (job_state_reporter.go) ----------------------------
+
+    def report_cycle(self) -> int:
+        """Report phase transitions; returns the number of events sent."""
+        now_ns = int(self._clock() * 1e9)
+        sequences: list[pb.EventSequence] = []
+        for pod in self.cluster.pod_states():
+            last = self._reported.get(pod.run_id)
+            if pod.phase is last:
+                continue
+            ev = pb.Event(created_ns=now_ns)
+            if pod.phase is PodPhase.PENDING:
+                ev.job_run_assigned.job_id = pod.job_id
+                ev.job_run_assigned.run_id = pod.run_id
+            elif pod.phase is PodPhase.RUNNING:
+                ev.job_run_running.job_id = pod.job_id
+                ev.job_run_running.run_id = pod.run_id
+                ev.job_run_running.node_id = pod.node_id
+            elif pod.phase is PodPhase.SUCCEEDED:
+                ev.job_run_succeeded.job_id = pod.job_id
+                ev.job_run_succeeded.run_id = pod.run_id
+            elif pod.phase is PodPhase.FAILED:
+                sequences.append(
+                    _run_error_sequence(
+                        pod.queue,
+                        pod.jobset,
+                        pod.job_id,
+                        pod.run_id,
+                        reason="podFailed",
+                        message=pod.message or "pod failed",
+                        now_ns=now_ns,
+                        node=pod.node_id,
+                    )
+                )
+                self._reported[pod.run_id] = pod.phase
+                continue
+            else:
+                continue
+            self._reported[pod.run_id] = pod.phase
+            sequences.append(
+                pb.EventSequence(queue=pod.queue, jobset=pod.jobset, events=[ev])
+            )
+        if sequences:
+            self.api.report_events(sequences)
+        return len(sequences)
+
+    # --- cleanup (resource_cleanup.go) --------------------------------------
+
+    def cleanup(self) -> int:
+        """Delete pods whose terminal phase has been reported; returns count."""
+        n = 0
+        for pod in list(self.cluster.pod_states()):
+            if (
+                pod.phase in _TERMINAL
+                and self._reported.get(pod.run_id) is pod.phase
+            ):
+                self.cluster.delete_pod(pod.run_id)
+                self._reported.pop(pod.run_id, None)
+                n += 1
+        return n
+
+    def run_once(self) -> None:
+        """One full agent iteration: lease, report, clean."""
+        self.lease_cycle()
+        self.report_cycle()
+        self.cleanup()
+
+
+def _run_error_sequence(
+    queue: str,
+    jobset: str,
+    job_id: str,
+    run_id: str,
+    reason: str,
+    message: str,
+    now_ns: int,
+    node: str = "",
+) -> pb.EventSequence:
+    return pb.EventSequence(
+        queue=queue,
+        jobset=jobset,
+        events=[
+            pb.Event(
+                created_ns=now_ns,
+                job_run_errors=pb.JobRunErrors(
+                    job_id=job_id,
+                    run_id=run_id,
+                    errors=[
+                        pb.Error(
+                            reason=reason,
+                            message=message,
+                            terminal=True,
+                            node=node,
+                        )
+                    ],
+                ),
+            )
+        ],
+    )

@@ -130,6 +130,9 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
             # A terminal run error also fails the run (instructions.go
             # handleJobRunErrors).
             return [out, ops.MarkRunsFailed(runs={e.run_id: e.job_id})]
+        if any(err.lease_returned for err in e.errors):
+            # Lease returned: run over, job may retry (MarkRunsReturned).
+            return [out, ops.MarkRunsReturned(runs={e.run_id: e.job_id})]
         return out
     if kind == "job_run_preempted":
         e = ev.job_run_preempted

@@ -198,6 +198,10 @@ class MarkRunsPreempted(_RunIdSetOp):
     pass
 
 
+class MarkRunsReturned(_RunIdSetOp):
+    pass
+
+
 class MarkRunsPreemptRequested(_RunIdSetOp):
     pass
 

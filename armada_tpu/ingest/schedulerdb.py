@@ -248,13 +248,15 @@ class SchedulerDb:
             )
         elif isinstance(op, (ops.MarkRunsPending, ops.MarkRunsRunning,
                              ops.MarkRunsSucceeded, ops.MarkRunsFailed,
-                             ops.MarkRunsPreempted, ops.MarkRunsPreemptRequested)):
+                             ops.MarkRunsPreempted, ops.MarkRunsReturned,
+                             ops.MarkRunsPreemptRequested)):
             flag = {
                 ops.MarkRunsPending: "pending",
                 ops.MarkRunsRunning: "running",
                 ops.MarkRunsSucceeded: "succeeded",
                 ops.MarkRunsFailed: "failed",
                 ops.MarkRunsPreempted: "preempted",
+                ops.MarkRunsReturned: "returned",
                 ops.MarkRunsPreemptRequested: "preempt_requested",
             }[type(op)]
             serial = self._next_serial(cur, "runs")
@@ -326,6 +328,52 @@ class SchedulerDb:
         return self._conn.execute(
             "SELECT * FROM job_run_errors WHERE run_id = ?", (run_id,)
         ).fetchall()
+
+    # --- executor api reads (internal/scheduler/api.go:88-122) --------------
+
+    def leases_for_executor(self, executor_id: str, limit: int = 10_000) -> list[sqlite3.Row]:
+        """Non-terminal runs assigned to `executor_id`, with their job's spec
+        (FetchJobRunLeases, database/query/query.sql)."""
+        return self._conn.execute(
+            "SELECT r.run_id, r.job_id, r.node_id, r.node_name, r.pool, "
+            "       r.scheduled_at_priority, r.preempt_requested, "
+            "       j.queue, j.jobset, j.spec "
+            "FROM runs r JOIN jobs j ON j.job_id = r.job_id "
+            "WHERE r.executor = ? AND r.succeeded = 0 AND r.failed = 0 "
+            "  AND r.cancelled = 0 AND r.preempted = 0 AND r.returned = 0 "
+            "  AND j.cancelled = 0 AND j.succeeded = 0 AND j.failed = 0 "
+            "ORDER BY r.serial LIMIT ?",
+            (executor_id, limit),
+        ).fetchall()
+
+    def inactive_runs(self, run_ids: Iterable[str]) -> set[str]:
+        """Of `run_ids`, those the scheduler no longer considers active: the
+        run or its job is terminal, or the run is unknown (FindInactiveRuns)."""
+        run_ids = list(run_ids)
+        if not run_ids:
+            return set()
+        qs = ",".join("?" for _ in run_ids)
+        rows = self._conn.execute(
+            f"SELECT r.run_id FROM runs r JOIN jobs j ON j.job_id = r.job_id "
+            f"WHERE r.run_id IN ({qs}) "
+            "  AND r.succeeded = 0 AND r.failed = 0 AND r.cancelled = 0 "
+            "  AND r.preempted = 0 AND r.returned = 0 "
+            "  AND j.cancelled = 0 AND j.succeeded = 0 AND j.failed = 0",
+            run_ids,
+        ).fetchall()
+        active = {r["run_id"] for r in rows}
+        return set(run_ids) - active
+
+    def preempt_requested_runs(self, executor_id: str) -> list[str]:
+        """Runs of this executor with a pending preemption request
+        (api.go: runs to preempt are streamed to the executor)."""
+        rows = self._conn.execute(
+            "SELECT run_id FROM runs WHERE executor = ? AND preempt_requested = 1 "
+            "AND succeeded = 0 AND failed = 0 AND cancelled = 0 AND preempted = 0 "
+            "AND returned = 0",
+            (executor_id,),
+        ).fetchall()
+        return [r["run_id"] for r in rows]
 
     # --- dedup kv (reference: server deduplication via PG kv) ---------------
 

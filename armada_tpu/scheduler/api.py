@@ -1,0 +1,112 @@
+"""ExecutorApi: the scheduler-side endpoint executors reconcile against.
+
+Equivalent of the reference's ExecutorApi bidi-stream server
+(internal/scheduler/api.go:36,88-122): one LeaseJobRuns exchange = store the
+executor's snapshot -> compute runs it should stop -> stream the runs newly
+leased to it; ReportEvents forwards executor-observed lifecycle events to the
+event log.  Transport-agnostic: this module is plain objects + methods; the
+gRPC service (armada_tpu/rpc) wraps it 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from armada_tpu.core.resources import ResourceListFactory
+from armada_tpu.eventlog.publisher import Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+
+@dataclasses.dataclass(frozen=True)
+class JobRunLease:
+    """One run streamed to an executor (executorapi.proto JobRunLease)."""
+
+    run_id: str
+    job_id: str
+    queue: str
+    jobset: str
+    node_id: str
+    node_name: str
+    pool: str
+    scheduled_at_priority: Optional[int]
+    spec: bytes  # serialized events_pb2.JobSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseRequest:
+    """What the executor sends: its snapshot + the runs it believes it owns
+    (executorapi.proto LeaseRequest:  capacity, node infos, run ids)."""
+
+    snapshot: ExecutorSnapshot
+    active_run_ids: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseResponse:
+    leases: tuple[JobRunLease, ...]
+    runs_to_cancel: tuple[str, ...]
+    runs_to_preempt: tuple[str, ...]
+
+
+class ExecutorApi:
+    """The scheduler's executor-facing surface (api.go:36)."""
+
+    def __init__(
+        self,
+        db: SchedulerDb,
+        publisher: Publisher,
+        factory: ResourceListFactory,
+        max_leases_per_call: int = 10_000,
+    ):
+        self._db = db
+        self._publisher = publisher
+        self._factory = factory
+        self._max_leases = max_leases_per_call
+
+    def lease_job_runs(self, request: LeaseRequest) -> LeaseResponse:
+        snap = request.snapshot
+        self._db.upsert_executor(snap.id, snap.to_json(), snap.last_update_ns)
+
+        known = set(request.active_run_ids)
+        # Runs the executor owns but the scheduler considers dead: stop them
+        # (FindInactiveRuns -> runs to cancel, api.go:100-110).
+        to_cancel = tuple(sorted(self._db.inactive_runs(known)))
+        to_preempt = tuple(
+            rid
+            for rid in self._db.preempt_requested_runs(snap.id)
+            if rid in known
+        )
+
+        leases = []
+        for row in self._db.leases_for_executor(snap.id, self._max_leases):
+            if row["run_id"] in known:
+                continue
+            leases.append(
+                JobRunLease(
+                    run_id=row["run_id"],
+                    job_id=row["job_id"],
+                    queue=row["queue"],
+                    jobset=row["jobset"],
+                    node_id=row["node_id"],
+                    node_name=row["node_name"] or row["node_id"],
+                    pool=row["pool"],
+                    scheduled_at_priority=(
+                        int(row["scheduled_at_priority"])
+                        if row["scheduled_at_priority"] is not None
+                        else None
+                    ),
+                    spec=row["spec"],
+                )
+            )
+        return LeaseResponse(
+            leases=tuple(leases),
+            runs_to_cancel=to_cancel,
+            runs_to_preempt=to_preempt,
+        )
+
+    def report_events(self, sequences: Sequence[pb.EventSequence]) -> None:
+        """Executor-observed lifecycle events -> the log (api.go ReportEvents)."""
+        self._publisher.publish(sequences)

@@ -416,6 +416,7 @@ class Scheduler:
                                 reason=LEASE_EXPIRED,
                                 message=f"executor {run.executor} stopped heartbeating",
                                 terminal=False,
+                                lease_returned=True,
                             )
                         ],
                     ),

@@ -1,0 +1,310 @@
+"""Full control-loop integration: scheduler <-> ExecutorApi <-> fake executor.
+
+The middle tier of the reference's no-real-cluster test strategy (fake executor,
+internal/executor/fake + cmd/fakeexecutor): real scheduler + real executor
+logic, simulated pods.
+"""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+from armada_tpu.eventlog import EventLog
+from armada_tpu.eventlog.publisher import Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.events.convert import job_spec_to_proto
+from armada_tpu.executor import ExecutorService, FakeClusterContext, PodPhase
+from armada_tpu.ingest.converter import convert_sequences
+from armada_tpu.ingest.pipeline import IngestionPipeline
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.jobdb.jobdb import JobDb
+from armada_tpu.scheduler import (
+    FairSchedulingAlgo,
+    Scheduler,
+    StandaloneLeaderController,
+)
+from armada_tpu.scheduler.api import ExecutorApi
+
+
+class FakeClock:
+    def __init__(self, t=1_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class Stack:
+    """Scheduler + executor-api + one fake executor, all in-process."""
+
+    def __init__(self, tmp_path, num_nodes=2, cpu="8", mem="32"):
+        self.config = SchedulingConfig(shape_bucket=32)
+        self.factory = self.config.resource_list_factory()
+        self.clock = FakeClock()
+        self.log = EventLog(str(tmp_path / "log"), num_partitions=2)
+        self.db = SchedulerDb(":memory:")
+        self.publisher = Publisher(self.log, clock=self.clock)
+        self.pipeline = IngestionPipeline(
+            self.log, self.db, convert_sequences, consumer_name="scheduler"
+        )
+        self.jobdb = JobDb(self.config)
+        self.scheduler = Scheduler(
+            self.db,
+            self.jobdb,
+            FairSchedulingAlgo(
+                self.config,
+                queues=lambda: [Queue("q1")],
+                clock_ns=lambda: int(self.clock() * 1e9),
+            ),
+            self.publisher,
+            StandaloneLeaderController(),
+            self.config,
+            clock=self.clock,
+        )
+        self.api = ExecutorApi(self.db, self.publisher, self.factory)
+        nodes = [
+            NodeSpec(
+                id=f"n{i}",
+                pool="default",
+                executor="ex1",
+                total_resources=self.factory.from_mapping({"cpu": cpu, "memory": mem}),
+            )
+            for i in range(num_nodes)
+        ]
+        self.cluster = FakeClusterContext(nodes, self.factory, runtime_of=lambda s: 5.0)
+        self.executor = ExecutorService(
+            "ex1", "default", self.cluster, self.api, self.factory, clock=self.clock
+        )
+
+    def submit(self, job_id, cpu="2", mem="4", **kw):
+        spec = JobSpec(
+            id=job_id,
+            queue="q1",
+            jobset="js",
+            resources=self.factory.from_mapping({"cpu": cpu, "memory": mem}),
+            **kw,
+        )
+        self.publisher.publish(
+            [
+                pb.EventSequence(
+                    queue="q1",
+                    jobset="js",
+                    events=[
+                        pb.Event(
+                            created_ns=int(self.clock() * 1e9),
+                            submit_job=pb.SubmitJob(
+                                job_id=job_id, spec=job_spec_to_proto(spec)
+                            ),
+                        )
+                    ],
+                )
+            ]
+        )
+
+    def step(self):
+        """One full control-plane step: ingest -> schedule -> ingest ->
+        executor loop (the lease event must materialize in the DB before the
+        executor's lease call can see it, as in the reference)."""
+        self.pipeline.run_until_caught_up()
+        res = self.scheduler.cycle()
+        self.pipeline.run_until_caught_up()
+        self.executor.run_once()
+        return res
+
+    def close(self):
+        self.db.close()
+        self.log.close()
+
+
+@pytest.fixture
+def stack(tmp_path):
+    s = Stack(tmp_path)
+    yield s
+    s.close()
+
+
+def test_job_flows_submit_to_succeeded(stack):
+    stack.submit("j1")
+    # executor heartbeats once so the scheduler knows its nodes
+    stack.executor.run_once()
+    res = stack.step()
+    assert res.events_by_kind().get("job_run_leased") == 1
+    # the executor picked up the lease and submitted the pod
+    pods = stack.cluster.pod_states()
+    assert len(pods) == 1 and pods[0].job_id == "j1"
+
+    # pod starts and runs
+    stack.cluster.tick(0.1)
+    stack.executor.report_cycle()
+    stack.pipeline.run_until_caught_up()
+    job = stack.jobdb.read_txn().get("j1")
+
+    # pod finishes; executor reports success; scheduler marks job succeeded
+    stack.cluster.tick(10.0)
+    stack.executor.report_cycle()
+    stack.pipeline.run_until_caught_up()
+    res = stack.scheduler.cycle()
+    assert res.events_by_kind().get("job_succeeded") == 1
+
+    # cleanup forgets the pod; the DB eventually drops the job from the jobdb
+    stack.executor.cleanup()
+    assert stack.cluster.pod_states() == []
+    stack.pipeline.run_until_caught_up()
+    stack.scheduler.cycle()
+    assert stack.jobdb.read_txn().get("j1") is None
+
+
+def test_many_jobs_drain_through_cluster(stack):
+    # 16 jobs x 2cpu over 2 nodes x 8cpu: 8 run at a time, 2 waves of runtime
+    for i in range(16):
+        stack.submit(f"j{i}")
+    stack.executor.run_once()
+    done = set()
+    for _ in range(12):
+        stack.step()
+        stack.cluster.tick(6.0)  # runtime is 5s
+        stack.executor.report_cycle()
+        stack.executor.cleanup()
+        stack.pipeline.run_until_caught_up()
+        if len({r["job_id"] for r in stack.db.fetch_job_updates(0, 0)[0] if r["succeeded"]}) == 16:
+            done = {f"j{i}" for i in range(16)}
+            break
+    assert done == {f"j{i}" for i in range(16)}
+
+
+def test_pod_failure_fails_run_and_requeues(stack):
+    stack.submit("jf")
+    stack.executor.run_once()
+    res = stack.step()
+    assert res.events_by_kind().get("job_run_leased") == 1
+    (pod,) = stack.cluster.pod_states()
+
+    stack.cluster.fail_pod(pod.run_id, "disk on fire")
+    stack.executor.report_cycle()
+    stack.pipeline.run_until_caught_up()
+    res2 = stack.scheduler.cycle()
+    # terminal pod error -> run failed -> job failed (no retry for terminal errors)
+    kinds = res2.events_by_kind()
+    assert kinds.get("job_errors") == 1
+    job_rows, _ = stack.db.fetch_job_updates(0, 0)
+
+
+def test_cancellation_propagates_to_pod_deletion(stack):
+    stack.submit("jc")
+    stack.executor.run_once()
+    stack.step()
+    assert len(stack.cluster.pod_states()) == 1
+
+    stack.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js",
+                events=[
+                    pb.Event(
+                        created_ns=int(stack.clock() * 1e9),
+                        cancel_job=pb.CancelJob(job_id="jc"),
+                    )
+                ],
+            )
+        ]
+    )
+    stack.pipeline.run_until_caught_up()
+    res = stack.scheduler.cycle()
+    assert res.events_by_kind().get("cancelled_job") == 1
+    stack.pipeline.run_until_caught_up()
+    # next executor lease cycle learns the run is dead and deletes the pod
+    stack.executor.lease_cycle()
+    assert stack.cluster.pod_states() == []
+
+
+def test_preempt_request_deletes_pod_and_reports(stack):
+    stack.submit("jp")
+    stack.executor.run_once()
+    stack.step()
+    (pod,) = stack.cluster.pod_states()
+
+    # a preemption request arrives via the log (e.g. from armadactl preempt)
+    stack.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js",
+                events=[
+                    pb.Event(
+                        created_ns=int(stack.clock() * 1e9),
+                        job_run_preemption_requested=pb.JobRunPreemptionRequested(
+                            job_id="jp", run_id=pod.run_id
+                        ),
+                    )
+                ],
+            )
+        ]
+    )
+    stack.pipeline.run_until_caught_up()
+    stack.executor.lease_cycle()
+    assert stack.cluster.pod_states() == []
+    # the executor reported the preemption; it round-trips to fail the job
+    stack.pipeline.run_until_caught_up()
+    res = stack.scheduler.cycle()
+    kinds = res.events_by_kind()
+    assert kinds.get("job_errors") == 1  # preempted -> terminal
+
+
+def test_submission_rejection_reports_terminal_error(stack):
+    # job larger than any node: scheduler won't lease it at all
+    stack.submit("huge", cpu="64")
+    stack.executor.run_once()
+    res = stack.step()
+    assert res.events_by_kind().get("job_run_leased") is None
+
+    # inject a lease pointing at a node that cannot hold the pod, bypassing
+    # the scheduler (simulates node shrinking between decision and submission)
+    from armada_tpu.scheduler.api import JobRunLease, LeaseResponse
+
+    spec = JobSpec(
+        id="ghost",
+        queue="q1",
+        jobset="js",
+        resources=stack.factory.from_mapping({"cpu": "64", "memory": "1"}),
+    )
+    lease = JobRunLease(
+        run_id="r-ghost",
+        job_id="ghost",
+        queue="q1",
+        jobset="js",
+        node_id="n0",
+        node_name="n0",
+        pool="default",
+        scheduled_at_priority=1000,
+        spec=job_spec_to_proto(spec).SerializeToString(),
+    )
+
+    class OneShotApi:
+        def __init__(self, inner):
+            self.inner = inner
+            self.reported = []
+
+        def lease_job_runs(self, request):
+            return LeaseResponse(
+                leases=(lease,), runs_to_cancel=(), runs_to_preempt=()
+            )
+
+        def report_events(self, sequences):
+            self.reported.extend(sequences)
+            self.inner.report_events(sequences)
+
+    shim = OneShotApi(stack.api)
+    stack.executor.api = shim
+    stack.executor.lease_cycle()
+    assert stack.cluster.pod_states() == []
+    errs = [
+        ev.job_run_errors
+        for s in shim.reported
+        for ev in s.events
+        if ev.WhichOneof("event") == "job_run_errors"
+    ]
+    assert errs and errs[0].errors[0].reason == "podSubmissionRejected"

@@ -307,6 +307,13 @@ def test_executor_expiry_requeues_jobs(world):
     leased = events_of_kind(res3.published, "job_run_leased")
     assert len(leased) == 1 and leased[0].run_id != lease.run_id
 
+    # The returned run is materialized in the DB (MarkRunsReturned): a restart
+    # must not resurrect it as an active run.
+    world.ingest()
+    _, run_rows = world.db.fetch_job_updates(0, 0)
+    by_id = {r["run_id"]: r for r in run_rows}
+    assert by_id[lease.run_id]["returned"] == 1
+
 
 def test_terminal_run_error_fails_job(world):
     world.submit("job-f")
