@@ -49,6 +49,11 @@ class ExecutorService:
         self._reported: dict[str, PodPhase] = {}
         # runs leased to us that we could not start (reported as errors once)
         self._rejected: set[str] = set()
+        # Terminal runs whose pods were cleaned up locally but whose terminal
+        # event may not have reached the scheduler DB yet: they stay in
+        # active_run_ids until the scheduler tells us they're dead
+        # (runs_to_cancel), else a lagging ingester would re-lease them.
+        self._awaiting_ack: set[str] = set()
 
     # --- snapshot -----------------------------------------------------------
 
@@ -69,7 +74,9 @@ class ExecutorService:
     # --- lease loop (lease_requester.go:51) ---------------------------------
 
     def lease_cycle(self) -> LeaseResponse:
-        active = tuple(p.run_id for p in self.cluster.pod_states())
+        active = tuple(p.run_id for p in self.cluster.pod_states()) + tuple(
+            self._awaiting_ack
+        )
         request = LeaseRequest(snapshot=self.snapshot(), active_run_ids=active)
         response = self.api.lease_job_runs(request)
 
@@ -110,6 +117,8 @@ class ExecutorService:
         for run_id in response.runs_to_cancel:
             self.cluster.delete_pod(run_id)
             self._reported.pop(run_id, None)
+            # The scheduler knows this run is dead: stop advertising it.
+            self._awaiting_ack.discard(run_id)
 
         preempted: list[pb.EventSequence] = []
         for run_id in response.runs_to_preempt:
@@ -131,6 +140,8 @@ class ExecutorService:
 
         if errors or preempted:
             self.api.report_events(errors + preempted)
+        # Rejections resolve once the scheduler stops offering the run.
+        self._rejected &= {l.run_id for l in response.leases}
         return response
 
     # --- state reporting (job_state_reporter.go) ----------------------------
@@ -191,6 +202,7 @@ class ExecutorService:
             ):
                 self.cluster.delete_pod(pod.run_id)
                 self._reported.pop(pod.run_id, None)
+                self._awaiting_ack.add(pod.run_id)
                 n += 1
         return n
 

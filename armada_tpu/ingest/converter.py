@@ -82,6 +82,14 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
         return ops.UpdateJobQueuedState(
             state_by_job={e.job_id: (True, int(e.update_sequence_number))}
         )
+    if kind == "preempt_job":
+        return ops.MarkJobsPreemptRequested(job_ids={ev.preempt_job.job_id})
+    if kind == "reprioritise_job_set":
+        return ops.UpdateJobSetPriority(
+            queue=seq.queue,
+            jobset=seq.jobset,
+            priority=int(ev.reprioritise_job_set.priority),
+        )
     if kind == "job_run_leased":
         e = ev.job_run_leased
         return [

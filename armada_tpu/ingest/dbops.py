@@ -231,6 +231,34 @@ class MarkJobSetCancelRequested(DbOperation):
 
 
 @dataclasses.dataclass
+class MarkJobsPreemptRequested(_JobIdSetOp):
+    """Request preemption of the jobs' active runs (the server's PreemptJobs
+    path, internal/server/submit/submit.go PreemptJobs:202)."""
+
+
+@dataclasses.dataclass
+class UpdateJobSetPriority(DbOperation):
+    """Jobset-wide reprioritisation (ReprioritizeJobs on a whole jobset,
+    submit.go ReprioritizeJobs:251)."""
+
+    queue: str
+    jobset: str
+    priority: int
+
+    def tokens(self) -> set[str]:
+        return {f"*{self.queue}/{self.jobset}"}
+
+    def merge(self, other: DbOperation) -> bool:
+        if (
+            isinstance(other, UpdateJobSetPriority)
+            and (other.queue, other.jobset) == (self.queue, self.jobset)
+        ):
+            self.priority = other.priority  # last write wins
+            return True
+        return False
+
+
+@dataclasses.dataclass
 class InsertJobRunErrors(DbOperation):
     # run_id -> list of (reason, message, terminal)
     errors: dict[str, list[tuple[str, str, bool]]]

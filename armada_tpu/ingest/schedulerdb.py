@@ -105,6 +105,15 @@ CREATE TABLE IF NOT EXISTS job_dedup (
   dedup_key TEXT PRIMARY KEY,
   job_id TEXT NOT NULL
 );
+
+CREATE TABLE IF NOT EXISTS queues (
+  name TEXT PRIMARY KEY,
+  weight REAL NOT NULL DEFAULT 1.0,
+  cordoned INTEGER NOT NULL DEFAULT 0,
+  owners TEXT NOT NULL DEFAULT '[]',
+  groups_json TEXT NOT NULL DEFAULT '[]',
+  labels_json TEXT NOT NULL DEFAULT '{}'
+);
 """
 
 JOBS_COLUMNS = (
@@ -169,11 +178,17 @@ class SchedulerDb:
                 self._conn.rollback()
                 raise
 
+    def _query(self, sql: str, params=()) -> list[sqlite3.Row]:
+        """Locked read: same-connection reads must not observe another
+        thread's uncommitted (potentially rolled-back) transaction."""
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
     def positions(self, consumer: str = "scheduler") -> dict[int, int]:
-        rows = self._conn.execute(
+        rows = self._query(
             "SELECT partition, position FROM consumer_positions WHERE consumer = ?",
             (consumer,),
-        ).fetchall()
+        )
         return {int(r["partition"]): int(r["position"]) for r in rows}
 
     # --- op application -----------------------------------------------------
@@ -268,6 +283,22 @@ class SchedulerDb:
                 "WHERE run_id = ?",
                 [(rid,) for rid in op.runs],
             )
+        elif isinstance(op, ops.MarkJobsPreemptRequested):
+            serial = self._next_serial(cur, "runs")
+            cur.executemany(
+                f"UPDATE runs SET preempt_requested = 1, serial = {serial} "
+                "WHERE job_id = ? AND succeeded = 0 AND failed = 0 "
+                "AND cancelled = 0 AND preempted = 0 AND returned = 0",
+                [(jid,) for jid in op.job_ids],
+            )
+        elif isinstance(op, ops.UpdateJobSetPriority):
+            serial = self._next_serial(cur, "jobs")
+            cur.execute(
+                f"UPDATE jobs SET priority = ?, serial = {serial} "
+                "WHERE queue = ? AND jobset = ? "
+                "AND cancelled = 0 AND succeeded = 0 AND failed = 0",
+                (op.priority, op.queue, op.jobset),
+            )
         elif isinstance(op, ops.InsertJobRunErrors):
             cur.executemany(
                 "INSERT INTO job_run_errors (run_id, job_id, reason, message, terminal) "
@@ -304,37 +335,35 @@ class SchedulerDb:
     ) -> tuple[list[sqlite3.Row], list[sqlite3.Row]]:
         """Incremental fetch: all rows whose serial advanced past the cursor
         (job_repository.go FetchJobUpdates)."""
-        jobs = self._conn.execute(
+        jobs = self._query(
             "SELECT * FROM jobs WHERE serial > ? ORDER BY serial", (jobs_serial,)
-        ).fetchall()
-        runs = self._conn.execute(
+        )
+        runs = self._query(
             "SELECT * FROM runs WHERE serial > ? ORDER BY serial", (runs_serial,)
-        ).fetchall()
+        )
         return jobs, runs
 
     def max_serials(self) -> tuple[int, int]:
-        rows = dict(
-            self._conn.execute("SELECT name, value FROM serials").fetchall()
-        )
+        rows = dict(self._query("SELECT name, value FROM serials"))
         return int(rows.get("jobs", 0)), int(rows.get("runs", 0))
 
     def has_marker(self, group_id: str, num_partitions: int) -> bool:
-        n = self._conn.execute(
+        n = self._query(
             "SELECT COUNT(*) FROM markers WHERE group_id = ?", (group_id,)
-        ).fetchone()[0]
+        )[0][0]
         return int(n) >= num_partitions
 
     def run_errors(self, run_id: str) -> list[sqlite3.Row]:
-        return self._conn.execute(
+        return self._query(
             "SELECT * FROM job_run_errors WHERE run_id = ?", (run_id,)
-        ).fetchall()
+        )
 
     # --- executor api reads (internal/scheduler/api.go:88-122) --------------
 
     def leases_for_executor(self, executor_id: str, limit: int = 10_000) -> list[sqlite3.Row]:
         """Non-terminal runs assigned to `executor_id`, with their job's spec
         (FetchJobRunLeases, database/query/query.sql)."""
-        return self._conn.execute(
+        return self._query(
             "SELECT r.run_id, r.job_id, r.node_id, r.node_name, r.pool, "
             "       r.scheduled_at_priority, r.preempt_requested, "
             "       j.queue, j.jobset, j.spec "
@@ -344,7 +373,7 @@ class SchedulerDb:
             "  AND j.cancelled = 0 AND j.succeeded = 0 AND j.failed = 0 "
             "ORDER BY r.serial LIMIT ?",
             (executor_id, limit),
-        ).fetchall()
+        )
 
     def inactive_runs(self, run_ids: Iterable[str]) -> set[str]:
         """Of `run_ids`, those the scheduler no longer considers active: the
@@ -353,26 +382,26 @@ class SchedulerDb:
         if not run_ids:
             return set()
         qs = ",".join("?" for _ in run_ids)
-        rows = self._conn.execute(
+        rows = self._query(
             f"SELECT r.run_id FROM runs r JOIN jobs j ON j.job_id = r.job_id "
             f"WHERE r.run_id IN ({qs}) "
             "  AND r.succeeded = 0 AND r.failed = 0 AND r.cancelled = 0 "
             "  AND r.preempted = 0 AND r.returned = 0 "
             "  AND j.cancelled = 0 AND j.succeeded = 0 AND j.failed = 0",
             run_ids,
-        ).fetchall()
+        )
         active = {r["run_id"] for r in rows}
         return set(run_ids) - active
 
     def preempt_requested_runs(self, executor_id: str) -> list[str]:
         """Runs of this executor with a pending preemption request
         (api.go: runs to preempt are streamed to the executor)."""
-        rows = self._conn.execute(
+        rows = self._query(
             "SELECT run_id FROM runs WHERE executor = ? AND preempt_requested = 1 "
             "AND succeeded = 0 AND failed = 0 AND cancelled = 0 AND preempted = 0 "
             "AND returned = 0",
             (executor_id,),
-        ).fetchall()
+        )
         return [r["run_id"] for r in rows]
 
     # --- dedup kv (reference: server deduplication via PG kv) ---------------
@@ -381,10 +410,10 @@ class SchedulerDb:
         if not keys:
             return {}
         qs = ",".join("?" for _ in keys)
-        rows = self._conn.execute(
+        rows = self._query(
             f"SELECT dedup_key, job_id FROM job_dedup WHERE dedup_key IN ({qs})",
             keys,
-        ).fetchall()
+        )
         return {r["dedup_key"]: r["job_id"] for r in rows}
 
     def store_dedup(self, mapping: dict[str, str]) -> None:
@@ -394,6 +423,49 @@ class SchedulerDb:
                 list(mapping.items()),
             )
             self._conn.commit()
+
+    # --- queues (internal/server/queue/queue_repository.go:32-50) -----------
+
+    def upsert_queue(
+        self,
+        name: str,
+        weight: float = 1.0,
+        cordoned: bool = False,
+        owners: Optional[list] = None,
+        groups: Optional[list] = None,
+        labels: Optional[dict] = None,
+    ) -> None:
+        import json as _json
+
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO queues (name, weight, cordoned, owners, groups_json, labels_json) "
+                "VALUES (?, ?, ?, ?, ?, ?) ON CONFLICT(name) DO UPDATE SET "
+                "weight = excluded.weight, cordoned = excluded.cordoned, "
+                "owners = excluded.owners, groups_json = excluded.groups_json, "
+                "labels_json = excluded.labels_json",
+                (
+                    name,
+                    weight,
+                    int(cordoned),
+                    _json.dumps(owners or []),
+                    _json.dumps(groups or []),
+                    _json.dumps(labels or {}),
+                ),
+            )
+            self._conn.commit()
+
+    def delete_queue(self, name: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM queues WHERE name = ?", (name,))
+            self._conn.commit()
+
+    def get_queue(self, name: str) -> Optional[sqlite3.Row]:
+        rows = self._query("SELECT * FROM queues WHERE name = ?", (name,))
+        return rows[0] if rows else None
+
+    def list_queues(self) -> list[sqlite3.Row]:
+        return self._query("SELECT * FROM queues ORDER BY name")
 
     # --- executor snapshots (executor_repository.go) ------------------------
 
@@ -408,7 +480,7 @@ class SchedulerDb:
             self._conn.commit()
 
     def executors(self) -> list[sqlite3.Row]:
-        return self._conn.execute("SELECT * FROM executors").fetchall()
+        return self._query("SELECT * FROM executors")
 
 
 def _job_default(col: str):

@@ -1,0 +1,28 @@
+"""The control-plane front door: submission, queue management, event watch.
+
+Equivalent of the reference's `internal/server` (server.go:41): the Submit
+service validates/dedups/converts client requests into events on the log
+(submit/submit.go:72), the queue repository stores queue configuration
+(queue/queue_repository.go), and the Event API streams a jobset's events back
+to clients (event/event_repository.go) from the stream materialization the
+event ingester maintains.
+"""
+
+from armada_tpu.server.auth import Principal, ActionAuthorizer, Permission
+from armada_tpu.server.queues import QueueRecord, QueueRepository
+from armada_tpu.server.submit import SubmitServer, JobSubmitItem, SubmitError
+from armada_tpu.server.eventapi import EventDb, EventApi, event_sink_converter
+
+__all__ = [
+    "Principal",
+    "ActionAuthorizer",
+    "Permission",
+    "QueueRecord",
+    "QueueRepository",
+    "SubmitServer",
+    "JobSubmitItem",
+    "SubmitError",
+    "EventDb",
+    "EventApi",
+    "event_sink_converter",
+]

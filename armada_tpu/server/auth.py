@@ -1,0 +1,82 @@
+"""Authorization: principals, global permissions, per-queue ACLs.
+
+Equivalent of the reference's `internal/common/auth/authorization.go`
+(ActionAuthorizer, principal groups, per-queue ACLs) plus the permission
+vocabulary of internal/server/permissions/permissions.go.  Authentication
+itself (OIDC/basic/kerberos) is out of scope for an in-process control plane;
+principals arrive pre-authenticated from the transport layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from armada_tpu.server.queues import QueueRecord
+
+
+class Permission(enum.Enum):
+    SUBMIT_ANY_JOBS = "submit_any_jobs"
+    CANCEL_ANY_JOBS = "cancel_any_jobs"
+    PREEMPT_ANY_JOBS = "preempt_any_jobs"
+    REPRIORITIZE_ANY_JOBS = "reprioritize_any_jobs"
+    CREATE_QUEUE = "create_queue"
+    DELETE_QUEUE = "delete_queue"
+    CORDON_NODES = "cordon_nodes"
+    WATCH_ALL_EVENTS = "watch_all_events"
+
+
+@dataclasses.dataclass(frozen=True)
+class Principal:
+    name: str = "anonymous"
+    groups: tuple[str, ...] = ()
+    # Global permissions granted by the operator's config.
+    permissions: frozenset = frozenset()
+
+    def is_member_of(self, group: str) -> bool:
+        return group in self.groups
+
+
+EVERYONE = "everyone"
+
+
+class AuthorizationError(Exception):
+    pass
+
+
+class ActionAuthorizer:
+    """Global permission OR queue-ownership check (authorization.go)."""
+
+    def __init__(self, open_by_default: bool = True):
+        # open_by_default mirrors the reference's anonymous-auth dev mode.
+        self._open = open_by_default
+
+    def authorize_action(self, principal: Principal, permission: Permission) -> None:
+        if self._open or permission in principal.permissions:
+            return
+        raise AuthorizationError(
+            f"{principal.name} lacks permission {permission.value}"
+        )
+
+    def authorize_queue_action(
+        self,
+        principal: Principal,
+        queue: Optional[QueueRecord],
+        permission: Permission,
+    ) -> None:
+        """Allowed if globally permitted, or the principal owns / is grouped
+        into the queue (per-queue ACLs)."""
+        if self._open or permission in principal.permissions:
+            return
+        if queue is not None:
+            if principal.name and principal.name in queue.owners:
+                return
+            if any(
+                g == EVERYONE or principal.is_member_of(g) for g in queue.groups
+            ):
+                return
+        raise AuthorizationError(
+            f"{principal.name} may not {permission.value} on queue "
+            f"{queue.name if queue else '<unknown>'}"
+        )

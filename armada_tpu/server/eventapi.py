@@ -1,0 +1,203 @@
+"""Event streams: per-jobset event materialization + the watch API.
+
+Equivalent of the reference's eventingester (EventSequence -> compressed rows
+appended to Redis streams per (queue, jobset), internal/eventingester/store/
+eventstore.go:24-111) plus the server-side Event API reading them
+(internal/server/event/event_repository.go, api.Event/GetJobSetEvents).
+
+The store is SQLite: stream entries keyed (queue, jobset, idx); payloads are
+zlib-compressed EventSequence protos.  `EventApi.watch` is a polling generator
+-- the transport layer turns it into a server-stream.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+import zlib
+from typing import Callable, Iterator, NamedTuple, Optional
+
+from armada_tpu.events import events_pb2 as pb
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobset_events (
+  queue TEXT NOT NULL,
+  jobset TEXT NOT NULL,
+  idx INTEGER NOT NULL,
+  created_ns INTEGER NOT NULL,
+  payload BLOB NOT NULL,
+  PRIMARY KEY (queue, jobset, idx)
+);
+
+CREATE TABLE IF NOT EXISTS consumer_positions (
+  consumer TEXT NOT NULL,
+  partition INTEGER NOT NULL,
+  position INTEGER NOT NULL,
+  PRIMARY KEY (consumer, partition)
+);
+"""
+
+
+class EventDb:
+    """The stream store + ingestion sink (eventstore.go)."""
+
+    def __init__(self, path: str = ":memory:", retention_s: Optional[float] = None):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.executescript(_SCHEMA)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.commit()
+        self._lock = threading.Lock()
+        self._retention_s = retention_s
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # --- ingestion sink (Sink protocol of ingest.pipeline) ------------------
+
+    def store(
+        self,
+        batch,  # list[(queue, jobset, created_ns, payload_bytes)]
+        consumer: str = "events",
+        next_positions: Optional[dict[int, int]] = None,
+    ) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            try:
+                for queue, jobset, created_ns, payload in batch:
+                    row = cur.execute(
+                        "SELECT COALESCE(MAX(idx), -1) + 1 FROM jobset_events "
+                        "WHERE queue = ? AND jobset = ?",
+                        (queue, jobset),
+                    ).fetchone()
+                    cur.execute(
+                        "INSERT INTO jobset_events (queue, jobset, idx, created_ns, payload) "
+                        "VALUES (?, ?, ?, ?, ?)",
+                        (queue, jobset, int(row[0]), created_ns, payload),
+                    )
+                for part, pos in (next_positions or {}).items():
+                    cur.execute(
+                        "INSERT INTO consumer_positions(consumer, partition, position) "
+                        "VALUES (?, ?, ?) ON CONFLICT(consumer, partition) "
+                        "DO UPDATE SET position = excluded.position",
+                        (consumer, part, pos),
+                    )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
+    def positions(self, consumer: str = "events") -> dict[int, int]:
+        rows = self._conn.execute(
+            "SELECT partition, position FROM consumer_positions WHERE consumer = ?",
+            (consumer,),
+        ).fetchall()
+        return {int(r["partition"]): int(r["position"]) for r in rows}
+
+    # --- reads --------------------------------------------------------------
+
+    def read(
+        self, queue: str, jobset: str, from_idx: int = 0, limit: int = 1000
+    ) -> list[sqlite3.Row]:
+        # Same-connection reads see uncommitted writes: take the store lock so
+        # watchers can't observe a mid-transaction (potentially rolled back) row.
+        with self._lock:
+            return self._conn.execute(
+                "SELECT * FROM jobset_events WHERE queue = ? AND jobset = ? "
+                "AND idx >= ? ORDER BY idx LIMIT ?",
+                (queue, jobset, from_idx, limit),
+            ).fetchall()
+
+    def prune(self, now_ns: int) -> int:
+        """Drop entries older than the retention window (stream TTLs in the
+        reference, eventstore.go retention)."""
+        if self._retention_s is None:
+            return 0
+        cutoff = now_ns - int(self._retention_s * 1e9)
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM jobset_events WHERE created_ns < ?", (cutoff,)
+            )
+            self._conn.commit()
+            return cur.rowcount
+
+
+def event_sink_converter(sequences: list) -> list:
+    """IngestionPipeline converter: EventSequence -> stream rows.  Markers and
+    empty sequences are dropped (eventingester ignores them too)."""
+    rows = []
+    for seq in sequences:
+        events = [
+            ev for ev in seq.events if ev.WhichOneof("event") != "partition_marker"
+        ]
+        if not events or not seq.queue:
+            continue
+        trimmed = pb.EventSequence(
+            queue=seq.queue,
+            jobset=seq.jobset,
+            user_id=seq.user_id,
+            groups=seq.groups,
+            events=events,
+        )
+        created = events[0].created_ns
+        rows.append(
+            (
+                seq.queue,
+                seq.jobset,
+                created,
+                zlib.compress(trimmed.SerializeToString()),
+            )
+        )
+    return rows
+
+
+class JobSetEvent(NamedTuple):
+    idx: int
+    sequence: pb.EventSequence
+
+
+class EventApi:
+    """GetJobSetEvents / Watch (pkg/api/event.proto:272-283)."""
+
+    def __init__(self, db: EventDb):
+        self._db = db
+
+    def get_jobset_events(
+        self, queue: str, jobset: str, from_idx: int = 0, limit: int = 1000
+    ) -> list[JobSetEvent]:
+        out = []
+        for row in self._db.read(queue, jobset, from_idx, limit):
+            seq = pb.EventSequence.FromString(zlib.decompress(row["payload"]))
+            out.append(JobSetEvent(int(row["idx"]), seq))
+        return out
+
+    def watch(
+        self,
+        queue: str,
+        jobset: str,
+        from_idx: int = 0,
+        poll_interval_s: float = 0.1,
+        stop: Optional[threading.Event] = None,
+        idle_timeout_s: Optional[float] = None,
+    ) -> Iterator[JobSetEvent]:
+        """Stream events as they appear; ends on stop/idle-timeout."""
+        idx = from_idx
+        last_progress = time.monotonic()
+        while stop is None or not stop.is_set():
+            batch = self.get_jobset_events(queue, jobset, idx)
+            if batch:
+                for item in batch:
+                    yield item
+                idx = batch[-1].idx + 1
+                last_progress = time.monotonic()
+            else:
+                if (
+                    idle_timeout_s is not None
+                    and time.monotonic() - last_progress > idle_timeout_s
+                ):
+                    return
+                if stop is not None:
+                    stop.wait(poll_interval_s)
+                else:
+                    time.sleep(poll_interval_s)

@@ -1,0 +1,96 @@
+"""Queue repository: queue configuration behind the Submit API.
+
+Equivalent of the reference's `internal/server/queue/queue_repository.go`
+(PostgresQueueRepository:47) on the control-plane SQLite DB; the scheduler's
+queue provider (the reference's QueueCache, internal/scheduler/queue/
+queue_cache.go:27) reads the same table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from armada_tpu.core.types import Queue
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueRecord:
+    """A queue as configured by operators (pkg/api Queue)."""
+
+    name: str
+    # priority_factor in the reference; weight = 1/priority_factor there.
+    weight: float = 1.0
+    cordoned: bool = False
+    owners: tuple[str, ...] = ()
+    groups: tuple[str, ...] = ()
+    labels: dict = dataclasses.field(default_factory=dict)
+
+    def to_queue(self) -> Queue:
+        return Queue(self.name, self.weight)
+
+
+class QueueNotFound(KeyError):
+    pass
+
+
+class QueueAlreadyExists(ValueError):
+    pass
+
+
+class QueueRepository:
+    def __init__(self, db: SchedulerDb):
+        self._db = db
+
+    def create(self, record: QueueRecord) -> None:
+        if self._db.get_queue(record.name) is not None:
+            raise QueueAlreadyExists(record.name)
+        self._upsert(record)
+
+    def update(self, record: QueueRecord) -> None:
+        if self._db.get_queue(record.name) is None:
+            raise QueueNotFound(record.name)
+        self._upsert(record)
+
+    def _upsert(self, record: QueueRecord) -> None:
+        if record.weight <= 0:
+            raise ValueError(f"queue {record.name}: weight must be > 0")
+        if not record.name:
+            raise ValueError("queue name must be non-empty")
+        self._db.upsert_queue(
+            record.name,
+            weight=record.weight,
+            cordoned=record.cordoned,
+            owners=list(record.owners),
+            groups=list(record.groups),
+            labels=record.labels,
+        )
+
+    def delete(self, name: str) -> None:
+        self._db.delete_queue(name)
+
+    def get(self, name: str) -> Optional[QueueRecord]:
+        row = self._db.get_queue(name)
+        return _from_row(row) if row is not None else None
+
+    def list(self) -> list[QueueRecord]:
+        return [_from_row(r) for r in self._db.list_queues()]
+
+    def scheduling_queues(self) -> list[Queue]:
+        """Queues as the scheduling algorithm sees them: uncordoned, weighted
+        (the scheduler's queue provider; cordoned queues keep their jobs but
+        receive nothing new)."""
+        return [q.to_queue() for q in self.list() if not q.cordoned]
+
+
+def _from_row(row) -> QueueRecord:
+    return QueueRecord(
+        name=row["name"],
+        weight=float(row["weight"]),
+        cordoned=bool(row["cordoned"]),
+        owners=tuple(json.loads(row["owners"])),
+        groups=tuple(json.loads(row["groups_json"])),
+        labels=json.loads(row["labels_json"]),
+    )

@@ -1,0 +1,322 @@
+"""SubmitServer: the client-facing mutation API.
+
+Equivalent of the reference's Submit server (internal/server/submit/
+submit.go:32-42): every verb authorizes, validates, dedups (submission only),
+converts to events and publishes to the log -- the server never writes
+job state anywhere else; all databases catch up via ingestion.
+
+Verbs (submit.go): SubmitJobs:72, CancelJobs:155, PreemptJobs:202,
+ReprioritizeJobs:251, CancelJobSet:316, queue CRUD passthrough:431-455.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Callable, Mapping, Optional, Sequence
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, Toleration
+from armada_tpu.eventlog.publisher import Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.events.convert import job_spec_to_proto
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.server.auth import ActionAuthorizer, Permission, Principal
+from armada_tpu.server.queues import QueueRepository
+from armada_tpu.server.validation import ValidationError, validate_submission
+
+
+class SubmitError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSubmitItem:
+    """One job in a submission request (api.JobSubmitRequestItem)."""
+
+    resources: Mapping[str, "str | int | float"]
+    priority: int = 0
+    priority_class: str = ""
+    client_id: str = ""  # dedup id (submit/deduplication.go)
+    node_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: tuple[Toleration, ...] = ()
+    gang_id: str = ""
+    gang_cardinality: int = 1
+    gang_node_uniformity_label: str = ""
+    pools: tuple[str, ...] = ()
+    namespace: str = "default"
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _new_job_id() -> str:
+    # ULID-ish: time-prefixed so ids sort by submission within a process.
+    return f"{int(time.time() * 1e3):013x}-{uuid.uuid4().hex[:12]}"
+
+
+class SubmitServer:
+    def __init__(
+        self,
+        db: SchedulerDb,
+        publisher: Publisher,
+        queues: QueueRepository,
+        config: Optional[SchedulingConfig] = None,
+        authorizer: Optional[ActionAuthorizer] = None,
+        clock: Callable[[], float] = time.time,
+        job_id_factory: Callable[[], str] = _new_job_id,
+    ):
+        self._db = db
+        self._publisher = publisher
+        self._queues = queues
+        self._config = config or SchedulingConfig()
+        self._auth = authorizer or ActionAuthorizer()
+        self._clock = clock
+        self._job_id = job_id_factory
+
+    # --- helpers ------------------------------------------------------------
+
+    def _queue_or_raise(self, queue: str):
+        record = self._queues.get(queue)
+        if record is None:
+            raise SubmitError(f"queue {queue!r} does not exist")
+        return record
+
+    def _publish(self, queue: str, jobset: str, events: list, user: str) -> None:
+        self._publisher.publish(
+            [
+                pb.EventSequence(
+                    queue=queue, jobset=jobset, user_id=user, events=events
+                )
+            ]
+        )
+
+    # --- SubmitJobs (submit.go:72) ------------------------------------------
+
+    def submit_jobs(
+        self,
+        queue: str,
+        jobset: str,
+        items: Sequence[JobSubmitItem],
+        principal: Principal = Principal(),
+    ) -> list[str]:
+        """Returns the job id per item (the original id for deduped items)."""
+        record = self._queue_or_raise(queue)
+        self._auth.authorize_queue_action(
+            principal, record, Permission.SUBMIT_ANY_JOBS
+        )
+        if not jobset:
+            raise SubmitError("jobset must be non-empty")
+        try:
+            validate_submission(items, self._config)
+        except ValidationError as e:
+            raise SubmitError(str(e)) from None
+
+        # Dedup by client id (deduplication.go GetOriginalJobIds).
+        dedup_keys = {
+            item.client_id: f"{queue}:{item.client_id}"
+            for item in items
+            if item.client_id
+        }
+        existing = self._db.lookup_dedup(list(dedup_keys.values()))
+
+        now = self._clock()
+        now_ns = int(now * 1e9)
+        factory = self._config.resource_list_factory()
+        events: list[pb.Event] = []
+        job_ids: list[str] = []
+        new_dedup: dict[str, str] = {}
+        for item in items:
+            if item.client_id:
+                key = dedup_keys[item.client_id]
+                if key in existing:
+                    job_ids.append(existing[key])
+                    continue
+            job_id = self._job_id()
+            job_ids.append(job_id)
+            if item.client_id:
+                new_dedup[dedup_keys[item.client_id]] = job_id
+            spec = JobSpec(
+                id=job_id,
+                queue=queue,
+                jobset=jobset,
+                priority_class=item.priority_class,
+                priority=item.priority,
+                submit_time=now,
+                resources=factory.from_mapping(item.resources),
+                node_selector=dict(item.node_selector),
+                tolerations=tuple(item.tolerations),
+                gang_id=item.gang_id,
+                gang_cardinality=item.gang_cardinality,
+                gang_node_uniformity_label=item.gang_node_uniformity_label,
+                pools=tuple(item.pools),
+            )
+            msg = job_spec_to_proto(spec)
+            msg.annotations.update(dict(item.annotations))
+            msg.labels.update(dict(item.labels))
+            msg.namespace = item.namespace
+            events.append(
+                pb.Event(
+                    created_ns=now_ns,
+                    submit_job=pb.SubmitJob(
+                        job_id=job_id, spec=msg, client_id=item.client_id
+                    ),
+                )
+            )
+
+        if events:
+            self._publish(queue, jobset, events, principal.name)
+        if new_dedup:
+            self._db.store_dedup(new_dedup)
+        return job_ids
+
+    # --- CancelJobs (submit.go:155) -----------------------------------------
+
+    def cancel_jobs(
+        self,
+        queue: str,
+        jobset: str,
+        job_ids: Sequence[str],
+        reason: str = "",
+        principal: Principal = Principal(),
+    ) -> None:
+        record = self._queue_or_raise(queue)
+        self._auth.authorize_queue_action(
+            principal, record, Permission.CANCEL_ANY_JOBS
+        )
+        if not job_ids:
+            raise SubmitError("no job ids given")
+        now_ns = int(self._clock() * 1e9)
+        self._publish(
+            queue,
+            jobset,
+            [
+                pb.Event(
+                    created_ns=now_ns,
+                    cancel_job=pb.CancelJob(job_id=jid, reason=reason),
+                )
+                for jid in job_ids
+            ],
+            principal.name,
+        )
+
+    # --- CancelJobSet (submit.go:316) ---------------------------------------
+
+    def cancel_jobset(
+        self,
+        queue: str,
+        jobset: str,
+        states: Sequence[str] = (),
+        reason: str = "",
+        principal: Principal = Principal(),
+    ) -> None:
+        record = self._queue_or_raise(queue)
+        self._auth.authorize_queue_action(
+            principal, record, Permission.CANCEL_ANY_JOBS
+        )
+        for s in states:
+            if s not in ("queued", "leased"):
+                raise SubmitError(f"invalid jobset-cancel state {s!r}")
+        now_ns = int(self._clock() * 1e9)
+        self._publish(
+            queue,
+            jobset,
+            [
+                pb.Event(
+                    created_ns=now_ns,
+                    cancel_job_set=pb.CancelJobSet(
+                        reason=reason, states=list(states)
+                    ),
+                )
+            ],
+            principal.name,
+        )
+
+    # --- PreemptJobs (submit.go:202) ----------------------------------------
+
+    def preempt_jobs(
+        self,
+        queue: str,
+        jobset: str,
+        job_ids: Sequence[str],
+        reason: str = "",
+        principal: Principal = Principal(),
+    ) -> None:
+        record = self._queue_or_raise(queue)
+        self._auth.authorize_queue_action(
+            principal, record, Permission.PREEMPT_ANY_JOBS
+        )
+        if not job_ids:
+            raise SubmitError("no job ids given")
+        now_ns = int(self._clock() * 1e9)
+        self._publish(
+            queue,
+            jobset,
+            [
+                pb.Event(
+                    created_ns=now_ns,
+                    preempt_job=pb.PreemptJob(job_id=jid, reason=reason),
+                )
+                for jid in job_ids
+            ],
+            principal.name,
+        )
+
+    # --- ReprioritizeJobs (submit.go:251) -----------------------------------
+
+    def reprioritize_jobs(
+        self,
+        queue: str,
+        jobset: str,
+        priority: int,
+        job_ids: Sequence[str] = (),
+        principal: Principal = Principal(),
+    ) -> None:
+        """Empty job_ids reprioritises the whole jobset."""
+        record = self._queue_or_raise(queue)
+        self._auth.authorize_queue_action(
+            principal, record, Permission.REPRIORITIZE_ANY_JOBS
+        )
+        if priority < 0:
+            raise SubmitError("priority must be >= 0")
+        now_ns = int(self._clock() * 1e9)
+        if job_ids:
+            events = [
+                pb.Event(
+                    created_ns=now_ns,
+                    reprioritise_job=pb.ReprioritiseJob(
+                        job_id=jid, priority=priority
+                    ),
+                )
+                for jid in job_ids
+            ]
+        else:
+            events = [
+                pb.Event(
+                    created_ns=now_ns,
+                    reprioritise_job_set=pb.ReprioritiseJobSet(
+                        priority=priority
+                    ),
+                )
+            ]
+        self._publish(queue, jobset, events, principal.name)
+
+    # --- queue CRUD (submit.go:431-455) -------------------------------------
+
+    def create_queue(self, record, principal: Principal = Principal()) -> None:
+        self._auth.authorize_action(principal, Permission.CREATE_QUEUE)
+        self._queues.create(record)
+
+    def update_queue(self, record, principal: Principal = Principal()) -> None:
+        self._auth.authorize_action(principal, Permission.CREATE_QUEUE)
+        self._queues.update(record)
+
+    def delete_queue(self, name: str, principal: Principal = Principal()) -> None:
+        self._auth.authorize_action(principal, Permission.DELETE_QUEUE)
+        self._queues.delete(name)
+
+    def get_queue(self, name: str):
+        return self._queues.get(name)
+
+    def list_queues(self):
+        return self._queues.list()

@@ -1,0 +1,101 @@
+"""Submission validation rules.
+
+Equivalent of the reference's `internal/server/submit/validation/
+submit_request.go`: per-request and per-item checks applied before anything is
+published.  Each rule raises ValidationError with a message naming the item.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from armada_tpu.core.config import SchedulingConfig
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate_submission(
+    items: Sequence,  # list[JobSubmitItem]
+    config: SchedulingConfig,
+) -> None:
+    if not items:
+        raise ValidationError("empty submission")
+    factory = config.resource_list_factory()
+    gang_card: dict[str, int] = {}
+    gang_seen: dict[str, int] = {}
+    gang_uniformity: dict[str, str] = {}
+    client_ids: set[str] = set()
+
+    for i, item in enumerate(items):
+        where = f"item {i}"
+
+        if item.client_id:
+            if item.client_id in client_ids:
+                raise ValidationError(
+                    f"{where}: duplicate client_id {item.client_id!r} in request"
+                )
+            client_ids.add(item.client_id)
+
+        if item.priority_class:
+            try:
+                config.priority_class(item.priority_class)
+            except ValueError:
+                raise ValidationError(
+                    f"{where}: unknown priority class {item.priority_class!r}"
+                ) from None
+
+        if item.priority < 0:
+            raise ValidationError(f"{where}: priority must be >= 0")
+
+        # Resources: known names, non-negative, and at least one positive
+        # (podspec has containers with requests; zero-resource jobs are noise).
+        if not item.resources:
+            raise ValidationError(f"{where}: no resources requested")
+        for name, qty in item.resources.items():
+            if name not in factory.names:
+                raise ValidationError(
+                    f"{where}: unsupported resource {name!r} "
+                    f"(supported: {', '.join(factory.names)})"
+                )
+        rl = factory.from_mapping(item.resources)
+        if rl.has_negative():
+            raise ValidationError(f"{where}: negative resource request")
+        if rl.all_zero():
+            raise ValidationError(f"{where}: all-zero resource request")
+
+        # Gang consistency (validation.validateGangs): same declared
+        # cardinality and uniformity label across members.
+        if item.gang_id:
+            if item.gang_cardinality < 1:
+                raise ValidationError(
+                    f"{where}: gang {item.gang_id!r} cardinality must be >= 1"
+                )
+            prev = gang_card.get(item.gang_id)
+            if prev is not None and prev != item.gang_cardinality:
+                raise ValidationError(
+                    f"{where}: gang {item.gang_id!r} declares cardinality "
+                    f"{item.gang_cardinality} but earlier member said {prev}"
+                )
+            gang_card[item.gang_id] = item.gang_cardinality
+            gang_seen[item.gang_id] = gang_seen.get(item.gang_id, 0) + 1
+            prev_u = gang_uniformity.get(item.gang_id)
+            if prev_u is not None and prev_u != item.gang_node_uniformity_label:
+                raise ValidationError(
+                    f"{where}: gang {item.gang_id!r} uniformity label mismatch"
+                )
+            gang_uniformity[item.gang_id] = item.gang_node_uniformity_label
+        elif item.gang_cardinality > 1:
+            raise ValidationError(
+                f"{where}: gang_cardinality set without gang_id"
+            )
+
+    # A gang must be complete within one request (validateGangs): members can
+    # never be added later, so an under-submitted gang would queue forever.
+    for gang_id, card in gang_card.items():
+        if gang_seen[gang_id] != card:
+            raise ValidationError(
+                f"gang {gang_id!r}: {gang_seen[gang_id]} members submitted "
+                f"but cardinality is {card}"
+            )

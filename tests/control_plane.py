@@ -1,0 +1,183 @@
+"""A complete in-process control plane for tests and the testsuite runner.
+
+The whole system wired together the way schedulerapp.go + server.go +
+application.go wire the reference: event log, scheduler DB, event DB,
+ingestion pipelines, submit server, event API, scheduler, executor-api and a
+fake-executor fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import NodeSpec
+from armada_tpu.eventlog import EventLog
+from armada_tpu.eventlog.publisher import Publisher
+from armada_tpu.executor import ExecutorService, FakeClusterContext
+from armada_tpu.ingest.converter import convert_sequences
+from armada_tpu.ingest.pipeline import IngestionPipeline
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.jobdb.jobdb import JobDb
+from armada_tpu.scheduler import (
+    FairSchedulingAlgo,
+    Scheduler,
+    StandaloneLeaderController,
+)
+from armada_tpu.scheduler.api import ExecutorApi
+from armada_tpu.server import (
+    EventApi,
+    EventDb,
+    QueueRepository,
+    SubmitServer,
+    event_sink_converter,
+)
+
+
+class ManualClock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@dataclasses.dataclass
+class ControlPlane:
+    config: SchedulingConfig
+    clock: ManualClock
+    log: EventLog
+    db: SchedulerDb
+    eventdb: EventDb
+    publisher: Publisher
+    scheduler_pipeline: IngestionPipeline
+    event_pipeline: IngestionPipeline
+    queues: QueueRepository
+    server: SubmitServer
+    event_api: EventApi
+    jobdb: JobDb
+    scheduler: Scheduler
+    executor_api: ExecutorApi
+    executors: list
+
+    @staticmethod
+    def build(
+        tmp_path,
+        config: SchedulingConfig | None = None,
+        executor_specs: dict | None = None,
+        runtime_s: float = 5.0,
+    ) -> "ControlPlane":
+        """executor_specs: {executor_id: (num_nodes, cpu, mem)}."""
+        config = config or SchedulingConfig(shape_bucket=32)
+        clock = ManualClock()
+        factory = config.resource_list_factory()
+        log = EventLog(str(tmp_path / "log"), num_partitions=2)
+        db = SchedulerDb(":memory:")
+        eventdb = EventDb(":memory:")
+        publisher = Publisher(log, clock=clock)
+        scheduler_pipeline = IngestionPipeline(
+            log, db, convert_sequences, consumer_name="scheduler"
+        )
+        event_pipeline = IngestionPipeline(
+            log, eventdb, event_sink_converter, consumer_name="events"
+        )
+        queues = QueueRepository(db)
+        server = SubmitServer(db, publisher, queues, config, clock=clock)
+        jobdb = JobDb(config)
+        scheduler = Scheduler(
+            db,
+            jobdb,
+            FairSchedulingAlgo(
+                config,
+                queues=queues.scheduling_queues,
+                clock_ns=lambda: int(clock() * 1e9),
+            ),
+            publisher,
+            StandaloneLeaderController(),
+            config,
+            clock=clock,
+        )
+        executor_api = ExecutorApi(db, publisher, factory)
+        executors = []
+        for ex_id, (n, cpu, mem) in (executor_specs or {"ex1": (2, "8", "32")}).items():
+            nodes = [
+                NodeSpec(
+                    id=f"{ex_id}-n{i}",
+                    pool="default",
+                    executor=ex_id,
+                    total_resources=factory.from_mapping({"cpu": cpu, "memory": mem}),
+                )
+                for i in range(n)
+            ]
+            cluster = FakeClusterContext(
+                nodes, factory, runtime_of=lambda s, r=runtime_s: r
+            )
+            executors.append(
+                ExecutorService(ex_id, "default", cluster, executor_api, factory, clock=clock)
+            )
+        return ControlPlane(
+            config=config,
+            clock=clock,
+            log=log,
+            db=db,
+            eventdb=eventdb,
+            publisher=publisher,
+            scheduler_pipeline=scheduler_pipeline,
+            event_pipeline=event_pipeline,
+            queues=queues,
+            server=server,
+            event_api=EventApi(eventdb),
+            jobdb=jobdb,
+            scheduler=scheduler,
+            executor_api=executor_api,
+            executors=executors,
+        )
+
+    # --- driving ------------------------------------------------------------
+
+    def ingest(self) -> None:
+        self.scheduler_pipeline.run_until_caught_up()
+        self.event_pipeline.run_until_caught_up()
+
+    def step(self, tick_s: float = 1.0) -> None:
+        """One control-plane heartbeat: ingest, schedule, executors act."""
+        self.ingest()
+        self.scheduler.cycle()
+        self.ingest()
+        for ex in self.executors:
+            ex.cluster.tick(tick_s)
+            ex.run_once()
+        self.clock.advance(tick_s)
+
+    def run_until(self, predicate, max_steps: int = 200, tick_s: float = 1.0) -> int:
+        """Step until predicate() or exhaustion; returns steps taken."""
+        for i in range(max_steps):
+            if predicate():
+                return i
+            self.step(tick_s)
+        raise AssertionError(f"predicate not satisfied after {max_steps} steps")
+
+    def job_states(self) -> dict:
+        rows, _ = self.db.fetch_job_updates(0, 0)
+        out = {}
+        for r in rows:
+            if r["succeeded"]:
+                s = "succeeded"
+            elif r["failed"]:
+                s = "failed"
+            elif r["cancelled"]:
+                s = "cancelled"
+            elif r["queued"]:
+                s = "queued"
+            else:
+                s = "leased"
+            out[r["job_id"]] = s
+        return out
+
+    def close(self) -> None:
+        self.db.close()
+        self.eventdb.close()
+        self.log.close()
