@@ -1,0 +1,8 @@
+"""armadactl: the user CLI + service launchers.
+
+Equivalent of the reference's cmd/armadactl (queue CRUD, submit, cancel,
+preempt, reprioritize, watch -- internal/armadactl/*.go) plus the service
+entry points (cmd/server, cmd/scheduler, cmd/executor, cmd/fakeexecutor)
+collapsed into two launcher verbs: `serve` runs the whole control plane in
+one process; `executor` runs a (fake-cluster) agent against it.
+"""

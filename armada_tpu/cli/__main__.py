@@ -1,0 +1,4 @@
+from armada_tpu.cli.armadactl import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,363 @@
+"""armadactl: command-line interface.
+
+Verb surface mirrors the reference's cmd/armadactl (internal/armadactl):
+queue create/update/delete/describe/list, submit (YAML), cancel, preempt,
+reprioritize, watch; plus service launchers `serve` and `executor`.
+
+Submission YAML (the reference's pkg/client yaml shape, jobs reduced to the
+scheduler-relevant spec):
+
+    queue: my-queue
+    jobSetId: my-jobset
+    jobs:
+      - count: 10                # our extension; default 1
+        priority: 0
+        priorityClassName: armada-preemptible
+        resources: {cpu: "1", memory: 1Gi}
+        nodeSelector: {zone: us-east}
+        gangId: g1               # optional gang
+        gangCardinality: 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+DEFAULT_URL = os.environ.get("ARMADA_TPU_URL", "127.0.0.1:50051")
+
+
+def _client(args):
+    from armada_tpu.rpc.client import ArmadaClient
+
+    return ArmadaClient(
+        args.url,
+        principal=os.environ.get("ARMADA_TPU_PRINCIPAL", "anonymous"),
+    )
+
+
+def _fmt_event(idx, seq, ev):
+    kind = ev.WhichOneof("event")
+    body = getattr(ev, kind)
+    job_id = getattr(body, "job_id", "")
+    extra = ""
+    if kind == "job_run_leased":
+        extra = f" node={body.node_id} executor={body.executor_id}"
+    elif kind == "job_errors" and body.errors:
+        extra = f" reason={body.errors[0].reason}"
+    return f"[{idx}] {kind:<28} {job_id}{extra}"
+
+
+# --- verbs -------------------------------------------------------------------
+
+
+def cmd_queue_create(args):
+    from armada_tpu.server.queues import QueueRecord
+
+    with_closed(_client(args), lambda c: c.create_queue(
+        QueueRecord(args.name, weight=args.weight, owners=tuple(args.owner or ()))
+    ))
+    print(f"created queue {args.name} (weight {args.weight})")
+    return 0
+
+
+def cmd_queue_update(args):
+    import dataclasses
+
+    def go(c):
+        # Read-modify-write: flags not passed keep their current values.
+        current = c.get_queue(args.name)
+        changes = {}
+        if args.weight is not None:
+            changes["weight"] = args.weight
+        if args.cordon:
+            changes["cordoned"] = True
+        if args.uncordon:
+            changes["cordoned"] = False
+        if args.owner is not None:
+            changes["owners"] = tuple(args.owner)
+        c.update_queue(dataclasses.replace(current, **changes))
+
+    with_closed(_client(args), go)
+    print(f"updated queue {args.name}")
+    return 0
+
+
+def cmd_queue_delete(args):
+    with_closed(_client(args), lambda c: c.delete_queue(args.name))
+    print(f"deleted queue {args.name}")
+    return 0
+
+
+def cmd_queue_describe(args):
+    q = with_closed(_client(args), lambda c: c.get_queue(args.name))
+    print(f"name:     {q.name}")
+    print(f"weight:   {q.weight}")
+    print(f"cordoned: {q.cordoned}")
+    print(f"owners:   {', '.join(q.owners) or '-'}")
+    print(f"groups:   {', '.join(q.groups) or '-'}")
+    return 0
+
+
+def cmd_queue_list(args):
+    queues = with_closed(_client(args), lambda c: c.list_queues())
+    if not queues:
+        print("no queues")
+        return 0
+    print(f"{'NAME':<24} {'WEIGHT':>8} {'CORDONED':>9}")
+    for q in queues:
+        print(f"{q.name:<24} {q.weight:>8.2f} {str(q.cordoned):>9}")
+    return 0
+
+
+def _load_submission(path):
+    import yaml
+
+    from armada_tpu.core.types import Toleration
+    from armada_tpu.server.submit import JobSubmitItem
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    queue = doc["queue"]
+    jobset = doc.get("jobSetId") or doc.get("jobset")
+    if not jobset:
+        raise ValueError("submission must set jobSetId")
+    items = []
+    for spec in doc.get("jobs", []):
+        count = int(spec.get("count", 1))
+        for i in range(count):
+            client_id = spec.get("clientIdPrefix")
+            items.append(
+                JobSubmitItem(
+                    resources=spec.get("resources", {}),
+                    priority=int(spec.get("priority", 0)),
+                    priority_class=spec.get("priorityClassName", ""),
+                    client_id=f"{client_id}-{i}" if client_id else "",
+                    node_selector=spec.get("nodeSelector", {}),
+                    tolerations=tuple(
+                        Toleration(
+                            key=t.get("key", ""),
+                            operator=t.get("operator", "Equal"),
+                            value=t.get("value", ""),
+                            effect=t.get("effect", ""),
+                        )
+                        for t in spec.get("tolerations", [])
+                    ),
+                    gang_id=spec.get("gangId", ""),
+                    gang_cardinality=int(spec.get("gangCardinality", 1)),
+                    gang_node_uniformity_label=spec.get(
+                        "gangNodeUniformityLabel", ""
+                    ),
+                    pools=tuple(spec.get("pools", ())),
+                    namespace=spec.get("namespace", "default"),
+                    annotations=spec.get("annotations", {}),
+                    labels=spec.get("labels", {}),
+                )
+            )
+    return queue, jobset, items
+
+
+def cmd_submit(args):
+    queue, jobset, items = _load_submission(args.file)
+    ids = with_closed(_client(args), lambda c: c.submit_jobs(queue, jobset, items))
+    print(f"submitted {len(ids)} job(s) to {queue}/{jobset}")
+    for jid in ids:
+        print(f"  {jid}")
+    return 0
+
+
+def cmd_cancel(args):
+    def go(c):
+        if args.job_id:
+            c.cancel_jobs(args.queue, args.job_set, args.job_id, args.reason)
+            return f"cancellation requested for {len(args.job_id)} job(s)"
+        c.cancel_jobset(args.queue, args.job_set, args.state or (), args.reason)
+        return f"cancellation requested for jobset {args.job_set}"
+
+    print(with_closed(_client(args), go))
+    return 0
+
+
+def cmd_preempt(args):
+    with_closed(
+        _client(args),
+        lambda c: c.preempt_jobs(args.queue, args.job_set, args.job_id, args.reason),
+    )
+    print(f"preemption requested for {len(args.job_id)} job(s)")
+    return 0
+
+
+def cmd_reprioritize(args):
+    with_closed(
+        _client(args),
+        lambda c: c.reprioritize_jobs(
+            args.queue, args.job_set, args.priority, args.job_id or ()
+        ),
+    )
+    target = f"{len(args.job_id)} job(s)" if args.job_id else f"jobset {args.job_set}"
+    print(f"reprioritized {target} to {args.priority}")
+    return 0
+
+
+def cmd_watch(args):
+    client = _client(args)
+    try:
+        for e in client.watch(
+            args.queue,
+            args.job_set,
+            idle_timeout_s=args.timeout or 0.0,
+        ):
+            for ev in e.sequence.events:
+                print(_fmt_event(e.idx, e.sequence, ev))
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_serve(args):
+    from armada_tpu.cli.serve import start_control_plane
+
+    plane = start_control_plane(
+        data_dir=args.data_dir,
+        port=args.port,
+        cycle_interval_s=args.cycle_interval,
+        schedule_interval_s=args.schedule_interval,
+        leader_id=args.leader_id,
+    )
+    print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
+    print(f"state in {args.data_dir}")
+    try:
+        plane.wait()
+    except KeyboardInterrupt:
+        print("shutting down")
+        plane.stop()
+    return 0
+
+
+def cmd_executor(args):
+    from armada_tpu.cli.serve import run_fake_executor
+
+    print(
+        f"fake executor {args.id}: {args.nodes} nodes x {args.cpu} cpu / "
+        f"{args.memory} mem -> {args.url}"
+    )
+    try:
+        run_fake_executor(
+            args.url,
+            executor_id=args.id,
+            pool=args.pool,
+            num_nodes=args.nodes,
+            cpu=args.cpu,
+            memory=args.memory,
+            interval_s=args.interval,
+            default_runtime_s=args.default_runtime,
+        )
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def with_closed(client, fn):
+    try:
+        return fn(client)
+    finally:
+        client.close()
+
+
+# --- wiring ------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="armadactl", description="armada-tpu command-line interface"
+    )
+    p.add_argument("--url", default=DEFAULT_URL, help="control plane address")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    q = sub.add_parser("queue", help="queue management").add_subparsers(
+        dest="qcmd", required=True
+    )
+    qc = q.add_parser("create")
+    qc.add_argument("name")
+    qc.add_argument("--weight", type=float, default=1.0)
+    qc.add_argument("--owner", action="append")
+    qc.set_defaults(fn=cmd_queue_create)
+    qu = q.add_parser("update")
+    qu.add_argument("name")
+    qu.add_argument("--weight", type=float)
+    qu.add_argument("--cordon", action="store_true")
+    qu.add_argument("--uncordon", action="store_true")
+    qu.add_argument("--owner", action="append")
+    qu.set_defaults(fn=cmd_queue_update)
+    qd = q.add_parser("delete")
+    qd.add_argument("name")
+    qd.set_defaults(fn=cmd_queue_delete)
+    qs = q.add_parser("describe")
+    qs.add_argument("name")
+    qs.set_defaults(fn=cmd_queue_describe)
+    ql = q.add_parser("list")
+    ql.set_defaults(fn=cmd_queue_list)
+
+    s = sub.add_parser("submit", help="submit jobs from a YAML file")
+    s.add_argument("file")
+    s.set_defaults(fn=cmd_submit)
+
+    c = sub.add_parser("cancel", help="cancel jobs or a jobset")
+    c.add_argument("--queue", required=True)
+    c.add_argument("--job-set", required=True)
+    c.add_argument("--job-id", action="append")
+    c.add_argument("--state", action="append", choices=["queued", "leased"])
+    c.add_argument("--reason", default="")
+    c.set_defaults(fn=cmd_cancel)
+
+    pr = sub.add_parser("preempt", help="request preemption of jobs")
+    pr.add_argument("--queue", required=True)
+    pr.add_argument("--job-set", required=True)
+    pr.add_argument("--job-id", action="append", required=True)
+    pr.add_argument("--reason", default="")
+    pr.set_defaults(fn=cmd_preempt)
+
+    rp = sub.add_parser("reprioritize", help="change job/jobset priority")
+    rp.add_argument("--queue", required=True)
+    rp.add_argument("--job-set", required=True)
+    rp.add_argument("--priority", type=int, required=True)
+    rp.add_argument("--job-id", action="append")
+    rp.set_defaults(fn=cmd_reprioritize)
+
+    w = sub.add_parser("watch", help="stream a jobset's events")
+    w.add_argument("--queue", required=True)
+    w.add_argument("--job-set", required=True)
+    w.add_argument("--timeout", type=float, help="stop after this many idle seconds")
+    w.set_defaults(fn=cmd_watch)
+
+    srv = sub.add_parser("serve", help="run the control plane")
+    srv.add_argument("--data-dir", default="./armada-tpu-data")
+    srv.add_argument("--port", type=int, default=50051)
+    srv.add_argument("--cycle-interval", type=float, default=1.0)
+    srv.add_argument("--schedule-interval", type=float, default=5.0)
+    srv.add_argument("--leader-id", help="enable file-lease leader election")
+    srv.set_defaults(fn=cmd_serve)
+
+    ex = sub.add_parser("executor", help="run a fake-cluster executor agent")
+    ex.add_argument("--id", default="fake-1")
+    ex.add_argument("--pool", default="default")
+    ex.add_argument("--nodes", type=int, default=4)
+    ex.add_argument("--cpu", default="16")
+    ex.add_argument("--memory", default="64Gi")
+    ex.add_argument("--interval", type=float, default=1.0)
+    ex.add_argument(
+        "--default-runtime", type=float, default=10.0, help="simulated pod runtime"
+    )
+    ex.set_defaults(fn=cmd_executor)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

@@ -1,0 +1,217 @@
+"""`armadactl serve`: the whole control plane in one process.
+
+Equivalent of the reference's `mage localdev minimal` development topology
+(server + scheduler + ingesters + Pulsar + Postgres + Redis in docker,
+docs/developer_guide.md:88-105) collapsed onto the native event log + SQLite:
+event log, scheduler DB ingester, event-stream ingester, the scheduler loop,
+and the gRPC services, all under one roof.  State lives in --data-dir and
+survives restarts (event-sourced recovery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.eventlog import EventLog
+from armada_tpu.eventlog.publisher import Publisher
+from armada_tpu.ingest.converter import convert_sequences
+from armada_tpu.ingest.pipeline import IngestionPipeline
+from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.jobdb.jobdb import JobDb
+from armada_tpu.scheduler import (
+    FairSchedulingAlgo,
+    FileLeaseLeaderController,
+    Scheduler,
+    StandaloneLeaderController,
+)
+from armada_tpu.scheduler.api import ExecutorApi
+from armada_tpu.server import (
+    EventApi,
+    EventDb,
+    QueueRepository,
+    SubmitServer,
+    event_sink_converter,
+)
+
+
+@dataclasses.dataclass
+class ControlPlaneProcess:
+    """A running control plane; stop() shuts everything down cleanly."""
+
+    port: int
+    scheduler: Scheduler
+    submit_server: SubmitServer
+    event_api: EventApi
+    _grpc_server: object
+    _pipelines: list
+    _stop: threading.Event
+    _scheduler_thread: threading.Thread
+    _log: EventLog
+    _db: SchedulerDb
+    _eventdb: EventDb
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._scheduler_thread.join(timeout=10)
+        for p in self._pipelines:
+            p.stop()
+        self._grpc_server.stop(1).wait()
+        self._db.close()
+        self._eventdb.close()
+        self._log.close()
+
+    def wait(self) -> None:
+        self._scheduler_thread.join()
+
+
+def start_control_plane(
+    data_dir: str,
+    port: int = 0,
+    config: Optional[SchedulingConfig] = None,
+    cycle_interval_s: float = 1.0,
+    schedule_interval_s: float = 5.0,
+    leader_id: Optional[str] = None,
+    num_partitions: int = 4,
+) -> ControlPlaneProcess:
+    os.makedirs(data_dir, exist_ok=True)
+    config = config or SchedulingConfig()
+    factory = config.resource_list_factory()
+
+    log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
+    db = SchedulerDb(os.path.join(data_dir, "scheduler.db"))
+    eventdb = EventDb(os.path.join(data_dir, "events.db"))
+    publisher = Publisher(log)
+
+    scheduler_pipeline = IngestionPipeline(
+        log,
+        db,
+        convert_sequences,
+        consumer_name="scheduler",
+        start_positions=db.positions("scheduler"),
+    )
+    event_pipeline = IngestionPipeline(
+        log,
+        eventdb,
+        event_sink_converter,
+        consumer_name="events",
+        start_positions=eventdb.positions("events"),
+    )
+
+    queues = QueueRepository(db)
+    submit_server = SubmitServer(db, publisher, queues, config)
+    event_api = EventApi(eventdb)
+    jobdb = JobDb(config)
+    leader = (
+        FileLeaseLeaderController(os.path.join(data_dir, "leader.lease"), leader_id)
+        if leader_id
+        else StandaloneLeaderController()
+    )
+    scheduler = Scheduler(
+        db,
+        jobdb,
+        FairSchedulingAlgo(
+            config,
+            queues=queues.scheduling_queues,
+            clock_ns=lambda: int(__import__("time").time() * 1e9),
+        ),
+        publisher,
+        leader,
+        config,
+    )
+    executor_api = ExecutorApi(db, publisher, factory)
+
+    from armada_tpu.rpc.server import make_server
+
+    grpc_server, bound_port = make_server(
+        submit_server=submit_server,
+        event_api=event_api,
+        executor_api=executor_api,
+        factory=factory,
+        address=f"127.0.0.1:{port}",
+    )
+
+    scheduler_pipeline.start()
+    event_pipeline.start()
+
+    # Recovery fencing: don't take decisions until the DB reflects everything
+    # published before this process started (scheduler.go ensureDbUpToDate).
+    scheduler.ensure_db_up_to_date()
+
+    stop = threading.Event()
+    scheduler_thread = threading.Thread(
+        target=scheduler.run,
+        args=(stop,),
+        kwargs={
+            "cycle_interval_s": cycle_interval_s,
+            "schedule_interval_s": schedule_interval_s,
+        },
+        daemon=True,
+    )
+    scheduler_thread.start()
+
+    return ControlPlaneProcess(
+        port=bound_port,
+        scheduler=scheduler,
+        submit_server=submit_server,
+        event_api=event_api,
+        _grpc_server=grpc_server,
+        _pipelines=[scheduler_pipeline, event_pipeline],
+        _stop=stop,
+        _scheduler_thread=scheduler_thread,
+        _log=log,
+        _db=db,
+        _eventdb=eventdb,
+    )
+
+
+def run_fake_executor(
+    server_address: str,
+    executor_id: str = "fake-1",
+    pool: str = "default",
+    num_nodes: int = 4,
+    cpu: str = "16",
+    memory: str = "64Gi",
+    interval_s: float = 1.0,
+    stop: Optional[threading.Event] = None,
+    config: Optional[SchedulingConfig] = None,
+    default_runtime_s: float = 10.0,
+) -> None:
+    """`armadactl executor`: a fake-cluster agent against a remote control
+    plane (cmd/fakeexecutor)."""
+    import time
+
+    from armada_tpu.core.types import NodeSpec
+    from armada_tpu.executor import ExecutorService, FakeClusterContext
+    from armada_tpu.rpc.client import ExecutorApiClient
+
+    config = config or SchedulingConfig()
+    factory = config.resource_list_factory()
+    nodes = [
+        NodeSpec(
+            id=f"{executor_id}-n{i}",
+            pool=pool,
+            executor=executor_id,
+            total_resources=factory.from_mapping({"cpu": cpu, "memory": memory}),
+        )
+        for i in range(num_nodes)
+    ]
+    cluster = FakeClusterContext(
+        nodes, factory, runtime_of=lambda s: default_runtime_s
+    )
+    api = ExecutorApiClient(server_address)
+    agent = ExecutorService(executor_id, pool, cluster, api, factory)
+    stop = stop or threading.Event()
+    last = time.monotonic()
+    try:
+        while not stop.is_set():
+            now = time.monotonic()
+            cluster.tick(now - last)
+            last = now
+            agent.run_once()
+            stop.wait(interval_s)
+    finally:
+        api.close()

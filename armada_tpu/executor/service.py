@@ -125,6 +125,9 @@ class ExecutorService:
             pod = self.cluster.get_pod(run_id)
             self.cluster.delete_pod(run_id)
             self._reported.pop(run_id, None)
+            # Same re-lease race as cleanup(): keep advertising the run until
+            # the scheduler has ingested the preemption and cancels it.
+            self._awaiting_ack.add(run_id)
             if pod is not None:
                 ev = pb.Event(
                     created_ns=int(self._clock() * 1e9),

@@ -34,6 +34,7 @@ CREATE TABLE IF NOT EXISTS jobs (
   pools TEXT NOT NULL DEFAULT '',
   cancel_requested INTEGER NOT NULL DEFAULT 0,
   cancel_by_jobset_requested INTEGER NOT NULL DEFAULT 0,
+  preempt_requested INTEGER NOT NULL DEFAULT 0,
   cancelled INTEGER NOT NULL DEFAULT 0,
   succeeded INTEGER NOT NULL DEFAULT 0,
   failed INTEGER NOT NULL DEFAULT 0,
@@ -119,7 +120,8 @@ CREATE TABLE IF NOT EXISTS queues (
 JOBS_COLUMNS = (
     "job_id", "queue", "jobset", "priority", "submitted_ns", "queued",
     "queued_version", "validated", "pools", "cancel_requested",
-    "cancel_by_jobset_requested", "cancelled", "succeeded", "failed", "spec",
+    "cancel_by_jobset_requested", "preempt_requested", "cancelled",
+    "succeeded", "failed", "spec",
 )
 RUNS_COLUMNS = (
     "run_id", "job_id", "created_ns", "executor", "node_id", "node_name",
@@ -284,11 +286,21 @@ class SchedulerDb:
                 [(rid,) for rid in op.runs],
             )
         elif isinstance(op, ops.MarkJobsPreemptRequested):
+            # Mark active runs AND persist the request on the job row: if no
+            # run exists yet (job still queued, or the lease materializes
+            # later), the scheduler acts on the job flag instead of silently
+            # dropping the request.
             serial = self._next_serial(cur, "runs")
             cur.executemany(
                 f"UPDATE runs SET preempt_requested = 1, serial = {serial} "
                 "WHERE job_id = ? AND succeeded = 0 AND failed = 0 "
                 "AND cancelled = 0 AND preempted = 0 AND returned = 0",
+                [(jid,) for jid in op.job_ids],
+            )
+            jserial = self._next_serial(cur, "jobs")
+            cur.executemany(
+                f"UPDATE jobs SET preempt_requested = 1, serial = {jserial} "
+                "WHERE job_id = ? AND cancelled = 0 AND succeeded = 0 AND failed = 0",
                 [(jid,) for jid in op.job_ids],
             )
         elif isinstance(op, ops.UpdateJobSetPriority):
@@ -487,7 +499,8 @@ def _job_default(col: str):
     return {
         "priority": 0, "submitted_ns": 0, "queued": 1, "queued_version": 0,
         "validated": 0, "pools": "", "cancel_requested": 0,
-        "cancel_by_jobset_requested": 0, "cancelled": 0, "succeeded": 0,
+        "cancel_by_jobset_requested": 0, "preempt_requested": 0,
+        "cancelled": 0, "succeeded": 0,
         "failed": 0, "spec": b"",
     }.get(col, "")
 

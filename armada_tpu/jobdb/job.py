@@ -108,6 +108,8 @@ class Job:
     pools: tuple[str, ...] = ()
     cancel_requested: bool = False
     cancel_by_jobset_requested: bool = False
+    # Operator requested preemption (persists even before a run exists).
+    preempt_requested: bool = False
     cancelled: bool = False
     succeeded: bool = False
     failed: bool = False
@@ -191,6 +193,9 @@ class Job:
 
     def with_cancel_by_jobset_requested(self) -> "Job":
         return self._with(cancel_by_jobset_requested=True)
+
+    def with_preempt_requested(self) -> "Job":
+        return self._with(preempt_requested=True)
 
     def with_cancelled(self) -> "Job":
         return self._with(cancelled=True, queued=False)

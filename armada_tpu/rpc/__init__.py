@@ -1,0 +1,51 @@
+"""gRPC transport for the control plane.
+
+`rpc_pb2` is regenerated from rpc.proto with protoc when the .proto is newer
+than the generated module (same lazy-codegen pattern as armada_tpu.events).
+grpc_tools is not in this toolchain, so service stubs are hand-wired with
+grpc generic handlers (server.py) and channel.unary_unary (client.py) --
+functionally identical to generated code.
+"""
+
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_EVENTS_DIR = os.path.join(_HERE, os.pardir, "events")
+_PROTO = os.path.join(_HERE, "rpc.proto")
+_GEN = os.path.join(_HERE, "rpc_pb2.py")
+
+# Ensure events_pb2 exists first (rpc.proto imports events.proto).
+import armada_tpu.events  # noqa: F401,E402
+
+if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN):
+    with tempfile.TemporaryDirectory() as _tmp:
+        subprocess.run(
+            [
+                "protoc",
+                "-I",
+                _HERE,
+                "-I",
+                _EVENTS_DIR,
+                f"--python_out={_tmp}",
+                _PROTO,
+            ],
+            check=True,
+        )
+        src_path = os.path.join(_tmp, "rpc_pb2.py")
+        with open(src_path) as f:
+            src = f.read()
+        # protoc emits a sibling absolute import; our generated modules live in
+        # different packages, so point it at the real location.
+        src = src.replace(
+            "import events_pb2 as events__pb2",
+            "from armada_tpu.events import events_pb2 as events__pb2",
+        )
+        with open(src_path, "w") as f:
+            f.write(src)
+        os.replace(src_path, _GEN)
+
+from armada_tpu.rpc import rpc_pb2  # noqa: E402
+
+__all__ = ["rpc_pb2"]

@@ -1,0 +1,219 @@
+"""Client library over the gRPC services.
+
+Equivalent of the reference's pkg/client (Go) / client/python bindings:
+`ArmadaClient` speaks Submit + Event, `ExecutorApiClient` speaks ExecutorApi
+and is a drop-in for the in-process ExecutorApi object (ExecutorService only
+needs lease_job_runs/report_events), so the same agent code runs in-process
+or across the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import grpc
+
+from armada_tpu.rpc import convert, rpc_pb2 as pb
+from armada_tpu.scheduler.api import LeaseRequest, LeaseResponse
+from armada_tpu.server.eventapi import JobSetEvent
+from armada_tpu.server.queues import QueueRecord
+from armada_tpu.server.submit import JobSubmitItem
+
+_PRINCIPAL_KEY = "x-armada-principal"
+_GROUPS_KEY = "x-armada-groups"
+
+
+class _Base:
+    def __init__(
+        self,
+        address: str,
+        principal: str = "anonymous",
+        groups: Sequence[str] = (),
+        channel: Optional[grpc.Channel] = None,
+    ):
+        self._channel = channel or grpc.insecure_channel(address)
+        self._meta = [(_PRINCIPAL_KEY, principal)]
+        if groups:
+            self._meta.append((_GROUPS_KEY, ",".join(groups)))
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _unary(self, path: str, req, resp_cls):
+        call = self._channel.unary_unary(
+            path,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return call(req, metadata=self._meta)
+
+
+class ArmadaClient(_Base):
+    """Submit + Event client (pkg/client submit.go / watch.go)."""
+
+    # --- submission ---------------------------------------------------------
+
+    def submit_jobs(
+        self, queue: str, jobset: str, items: Sequence[JobSubmitItem]
+    ) -> list[str]:
+        resp = self._unary(
+            "/armada_tpu.api.Submit/SubmitJobs",
+            pb.SubmitJobsRequest(
+                queue=queue,
+                jobset=jobset,
+                items=[convert.submit_item_to_proto(i) for i in items],
+            ),
+            pb.SubmitJobsResponse,
+        )
+        return list(resp.job_ids)
+
+    def cancel_jobs(
+        self, queue: str, jobset: str, job_ids: Sequence[str], reason: str = ""
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/CancelJobs",
+            pb.CancelJobsRequest(
+                queue=queue, jobset=jobset, job_ids=list(job_ids), reason=reason
+            ),
+            pb.Empty,
+        )
+
+    def cancel_jobset(
+        self, queue: str, jobset: str, states: Sequence[str] = (), reason: str = ""
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/CancelJobSet",
+            pb.CancelJobSetRequest(
+                queue=queue, jobset=jobset, states=list(states), reason=reason
+            ),
+            pb.Empty,
+        )
+
+    def preempt_jobs(
+        self, queue: str, jobset: str, job_ids: Sequence[str], reason: str = ""
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/PreemptJobs",
+            pb.PreemptJobsRequest(
+                queue=queue, jobset=jobset, job_ids=list(job_ids), reason=reason
+            ),
+            pb.Empty,
+        )
+
+    def reprioritize_jobs(
+        self,
+        queue: str,
+        jobset: str,
+        priority: int,
+        job_ids: Sequence[str] = (),
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/ReprioritizeJobs",
+            pb.ReprioritizeJobsRequest(
+                queue=queue,
+                jobset=jobset,
+                priority=priority,
+                job_ids=list(job_ids),
+            ),
+            pb.Empty,
+        )
+
+    # --- queues -------------------------------------------------------------
+
+    def create_queue(self, record: QueueRecord) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/CreateQueue",
+            convert.queue_to_proto(record),
+            pb.Empty,
+        )
+
+    def update_queue(self, record: QueueRecord) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/UpdateQueue",
+            convert.queue_to_proto(record),
+            pb.Empty,
+        )
+
+    def delete_queue(self, name: str) -> None:
+        self._unary(
+            "/armada_tpu.api.Submit/DeleteQueue",
+            pb.QueueGetRequest(name=name),
+            pb.Empty,
+        )
+
+    def get_queue(self, name: str) -> QueueRecord:
+        resp = self._unary(
+            "/armada_tpu.api.Submit/GetQueue",
+            pb.QueueGetRequest(name=name),
+            pb.Queue,
+        )
+        return convert.queue_from_proto(resp)
+
+    def list_queues(self) -> list[QueueRecord]:
+        resp = self._unary(
+            "/armada_tpu.api.Submit/ListQueues", pb.Empty(), pb.QueueListResponse
+        )
+        return [convert.queue_from_proto(q) for q in resp.queues]
+
+    # --- events -------------------------------------------------------------
+
+    def get_jobset_events(
+        self, queue: str, jobset: str, from_idx: int = 0
+    ) -> list[JobSetEvent]:
+        return list(self._events(queue, jobset, from_idx, watch=False))
+
+    def watch(
+        self,
+        queue: str,
+        jobset: str,
+        from_idx: int = 0,
+        idle_timeout_s: float = 0.0,
+    ) -> Iterator[JobSetEvent]:
+        return self._events(
+            queue, jobset, from_idx, watch=True, idle_timeout_s=idle_timeout_s
+        )
+
+    def _events(
+        self,
+        queue: str,
+        jobset: str,
+        from_idx: int,
+        watch: bool,
+        idle_timeout_s: float = 0.0,
+    ) -> Iterator[JobSetEvent]:
+        call = self._channel.unary_stream(
+            "/armada_tpu.api.Event/GetJobSetEvents",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.JobSetEventMessage.FromString,
+        )
+        stream = call(
+            pb.JobSetEventsRequest(
+                queue=queue,
+                jobset=jobset,
+                from_idx=from_idx,
+                watch=watch,
+                idle_timeout_s=idle_timeout_s,
+            ),
+            metadata=self._meta,
+        )
+        for msg in stream:
+            yield JobSetEvent(int(msg.idx), msg.sequence)
+
+
+class ExecutorApiClient(_Base):
+    """Drop-in wire replacement for the in-process ExecutorApi."""
+
+    def lease_job_runs(self, request: LeaseRequest) -> LeaseResponse:
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorApi/LeaseJobRuns",
+            convert.lease_request_to_proto(request),
+            pb.LeaseJobRunsResponse,
+        )
+        return convert.lease_response_from_proto(resp)
+
+    def report_events(self, sequences) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorApi/ReportEvents",
+            pb.ReportEventsRequest(sequences=list(sequences)),
+            pb.Empty,
+        )

@@ -1,0 +1,209 @@
+"""Conversions between wire messages and the in-process API dataclasses."""
+
+from __future__ import annotations
+
+from armada_tpu.core.resources import ResourceListFactory
+from armada_tpu.core.types import NodeSpec, Taint, Toleration
+from armada_tpu.events import events_pb2 as epb
+from armada_tpu.rpc import rpc_pb2 as pb
+from armada_tpu.scheduler.api import (
+    JobRunLease,
+    LeaseRequest,
+    LeaseResponse,
+)
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+from armada_tpu.server.queues import QueueRecord
+from armada_tpu.server.submit import JobSubmitItem
+
+# ---- submit -----------------------------------------------------------------
+
+
+def submit_item_from_proto(msg: pb.SubmitItem) -> JobSubmitItem:
+    return JobSubmitItem(
+        resources=dict(msg.resources),
+        priority=int(msg.priority),
+        priority_class=msg.priority_class,
+        client_id=msg.client_id,
+        node_selector=dict(msg.node_selector),
+        tolerations=tuple(
+            Toleration(key=t.key, operator=t.operator or "Equal", value=t.value, effect=t.effect)
+            for t in msg.tolerations
+        ),
+        gang_id=msg.gang_id,
+        gang_cardinality=int(msg.gang_cardinality) or 1,
+        gang_node_uniformity_label=msg.gang_node_uniformity_label,
+        pools=tuple(msg.pools),
+        namespace=msg.namespace or "default",
+        annotations=dict(msg.annotations),
+        labels=dict(msg.labels),
+    )
+
+
+def submit_item_to_proto(item: JobSubmitItem) -> pb.SubmitItem:
+    return pb.SubmitItem(
+        resources={k: str(v) for k, v in dict(item.resources).items()},
+        priority=item.priority,
+        priority_class=item.priority_class,
+        client_id=item.client_id,
+        node_selector=dict(item.node_selector),
+        tolerations=[
+            epb.Toleration(key=t.key, operator=t.operator, value=t.value, effect=t.effect)
+            for t in item.tolerations
+        ],
+        gang_id=item.gang_id,
+        gang_cardinality=item.gang_cardinality,
+        gang_node_uniformity_label=item.gang_node_uniformity_label,
+        pools=list(item.pools),
+        namespace=item.namespace,
+        annotations=dict(item.annotations),
+        labels=dict(item.labels),
+    )
+
+
+def queue_to_proto(q: QueueRecord) -> pb.Queue:
+    return pb.Queue(
+        name=q.name,
+        weight=q.weight,
+        cordoned=q.cordoned,
+        owners=list(q.owners),
+        groups=list(q.groups),
+        labels={k: str(v) for k, v in q.labels.items()},
+    )
+
+
+def queue_from_proto(msg: pb.Queue) -> QueueRecord:
+    return QueueRecord(
+        name=msg.name,
+        weight=msg.weight or 1.0,
+        cordoned=msg.cordoned,
+        owners=tuple(msg.owners),
+        groups=tuple(msg.groups),
+        labels=dict(msg.labels),
+    )
+
+
+# ---- executor ---------------------------------------------------------------
+
+
+def node_to_proto(n: NodeSpec) -> pb.Node:
+    milli = {}
+    if n.total_resources is not None:
+        milli = {
+            name: int(a)
+            for name, a in zip(n.total_resources.factory.names, n.total_resources.atoms)
+            if a
+        }
+    return pb.Node(
+        id=n.id,
+        pool=n.pool,
+        executor=n.executor,
+        resources=epb.Resources(milli=milli),
+        taints=[epb.Taint(key=t.key, value=t.value, effect=t.effect) for t in n.taints],
+        labels=dict(n.labels),
+        unschedulable=n.unschedulable,
+    )
+
+
+def node_from_proto(msg: pb.Node, factory: ResourceListFactory) -> NodeSpec:
+    rl = factory.zero()
+    for name, atoms in msg.resources.milli.items():
+        if name in factory.names:
+            rl.atoms[factory.index_of(name)] = atoms
+    return NodeSpec(
+        id=msg.id,
+        pool=msg.pool or "default",
+        executor=msg.executor,
+        total_resources=rl,
+        taints=tuple(Taint(t.key, t.value, t.effect or "NoSchedule") for t in msg.taints),
+        labels=dict(msg.labels),
+        unschedulable=msg.unschedulable,
+    )
+
+
+def snapshot_to_proto(snap: ExecutorSnapshot) -> pb.ExecutorSnapshot:
+    return pb.ExecutorSnapshot(
+        id=snap.id,
+        pool=snap.pool,
+        nodes=[node_to_proto(n) for n in snap.nodes],
+        node_of_run=dict(snap.node_of_run),
+        unacknowledged_runs=list(snap.unacknowledged_runs),
+        last_update_ns=snap.last_update_ns,
+        cordoned=snap.cordoned,
+    )
+
+
+def snapshot_from_proto(
+    msg: pb.ExecutorSnapshot, factory: ResourceListFactory
+) -> ExecutorSnapshot:
+    return ExecutorSnapshot(
+        id=msg.id,
+        pool=msg.pool or "default",
+        nodes=tuple(node_from_proto(n, factory) for n in msg.nodes),
+        node_of_run=dict(msg.node_of_run),
+        unacknowledged_runs=tuple(msg.unacknowledged_runs),
+        last_update_ns=int(msg.last_update_ns),
+        cordoned=msg.cordoned,
+    )
+
+
+def lease_request_to_proto(req: LeaseRequest) -> pb.LeaseJobRunsRequest:
+    return pb.LeaseJobRunsRequest(
+        snapshot=snapshot_to_proto(req.snapshot),
+        active_run_ids=list(req.active_run_ids),
+    )
+
+
+def lease_request_from_proto(
+    msg: pb.LeaseJobRunsRequest, factory: ResourceListFactory
+) -> LeaseRequest:
+    return LeaseRequest(
+        snapshot=snapshot_from_proto(msg.snapshot, factory),
+        active_run_ids=tuple(msg.active_run_ids),
+    )
+
+
+def lease_response_to_proto(resp: LeaseResponse) -> pb.LeaseJobRunsResponse:
+    return pb.LeaseJobRunsResponse(
+        leases=[
+            pb.JobRunLease(
+                run_id=l.run_id,
+                job_id=l.job_id,
+                queue=l.queue,
+                jobset=l.jobset,
+                node_id=l.node_id,
+                node_name=l.node_name,
+                pool=l.pool,
+                scheduled_at_priority=l.scheduled_at_priority or 0,
+                has_scheduled_at_priority=l.scheduled_at_priority is not None,
+                spec=l.spec,
+            )
+            for l in resp.leases
+        ],
+        runs_to_cancel=list(resp.runs_to_cancel),
+        runs_to_preempt=list(resp.runs_to_preempt),
+    )
+
+
+def lease_response_from_proto(msg: pb.LeaseJobRunsResponse) -> LeaseResponse:
+    return LeaseResponse(
+        leases=tuple(
+            JobRunLease(
+                run_id=l.run_id,
+                job_id=l.job_id,
+                queue=l.queue,
+                jobset=l.jobset,
+                node_id=l.node_id,
+                node_name=l.node_name,
+                pool=l.pool,
+                scheduled_at_priority=(
+                    int(l.scheduled_at_priority)
+                    if l.has_scheduled_at_priority
+                    else None
+                ),
+                spec=l.spec,
+            )
+            for l in msg.leases
+        ),
+        runs_to_cancel=tuple(msg.runs_to_cancel),
+        runs_to_preempt=tuple(msg.runs_to_preempt),
+    )

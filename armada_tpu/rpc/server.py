@@ -1,0 +1,265 @@
+"""The gRPC server: Submit + Event + ExecutorApi services on one port.
+
+Equivalent of the reference's grpc server builder (internal/common/grpc/
+grpc.go) wiring api.Submit / api.Event (internal/server/server.go:41) and
+executorapi.ExecutorApi (internal/scheduler/schedulerapp.go).  Handlers are
+registered with grpc generic handlers; each delegates 1:1 to the in-process
+service objects, mapping domain errors to canonical status codes.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from armada_tpu.rpc import convert, rpc_pb2 as pb
+from armada_tpu.server.auth import AuthorizationError, Principal
+from armada_tpu.server.queues import QueueAlreadyExists, QueueNotFound
+from armada_tpu.server.submit import SubmitError
+
+_PRINCIPAL_KEY = "x-armada-principal"
+_GROUPS_KEY = "x-armada-groups"
+
+
+def _principal_from_context(context) -> Principal:
+    """Trusted-header authentication: the transport supplies the identity
+    (the reference's auth middlewares resolve to the same Principal shape)."""
+    meta = dict(context.invocation_metadata() or ())
+    name = meta.get(_PRINCIPAL_KEY, "anonymous")
+    groups = tuple(g for g in meta.get(_GROUPS_KEY, "").split(",") if g)
+    return Principal(name=name, groups=groups)
+
+
+def _guard(context, fn):
+    """Run fn(), translating domain errors to gRPC status codes."""
+    try:
+        return fn()
+    except SubmitError as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    except AuthorizationError as e:
+        context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+    except QueueNotFound as e:
+        context.abort(grpc.StatusCode.NOT_FOUND, f"queue {e} not found")
+    except QueueAlreadyExists as e:
+        context.abort(grpc.StatusCode.ALREADY_EXISTS, f"queue {e} exists")
+
+
+class _SubmitService:
+    def __init__(self, server):
+        self._server = server
+
+    def SubmitJobs(self, request, context):
+        principal = _principal_from_context(context)
+        items = [convert.submit_item_from_proto(m) for m in request.items]
+        ids = _guard(
+            context,
+            lambda: self._server.submit_jobs(
+                request.queue, request.jobset, items, principal
+            ),
+        )
+        return pb.SubmitJobsResponse(job_ids=ids)
+
+    def CancelJobs(self, request, context):
+        principal = _principal_from_context(context)
+        _guard(
+            context,
+            lambda: self._server.cancel_jobs(
+                request.queue,
+                request.jobset,
+                list(request.job_ids),
+                request.reason,
+                principal,
+            ),
+        )
+        return pb.Empty()
+
+    def CancelJobSet(self, request, context):
+        principal = _principal_from_context(context)
+        _guard(
+            context,
+            lambda: self._server.cancel_jobset(
+                request.queue,
+                request.jobset,
+                list(request.states),
+                request.reason,
+                principal,
+            ),
+        )
+        return pb.Empty()
+
+    def PreemptJobs(self, request, context):
+        principal = _principal_from_context(context)
+        _guard(
+            context,
+            lambda: self._server.preempt_jobs(
+                request.queue,
+                request.jobset,
+                list(request.job_ids),
+                request.reason,
+                principal,
+            ),
+        )
+        return pb.Empty()
+
+    def ReprioritizeJobs(self, request, context):
+        principal = _principal_from_context(context)
+        _guard(
+            context,
+            lambda: self._server.reprioritize_jobs(
+                request.queue,
+                request.jobset,
+                int(request.priority),
+                list(request.job_ids),
+                principal,
+            ),
+        )
+        return pb.Empty()
+
+    def CreateQueue(self, request, context):
+        principal = _principal_from_context(context)
+        record = convert.queue_from_proto(request)
+        _guard(context, lambda: self._server.create_queue(record, principal))
+        return pb.Empty()
+
+    def UpdateQueue(self, request, context):
+        principal = _principal_from_context(context)
+        record = convert.queue_from_proto(request)
+        _guard(context, lambda: self._server.update_queue(record, principal))
+        return pb.Empty()
+
+    def DeleteQueue(self, request, context):
+        principal = _principal_from_context(context)
+        _guard(context, lambda: self._server.delete_queue(request.name, principal))
+        return pb.Empty()
+
+    def GetQueue(self, request, context):
+        record = self._server.get_queue(request.name)
+        if record is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"queue {request.name!r} not found")
+        return convert.queue_to_proto(record)
+
+    def ListQueues(self, request, context):
+        return pb.QueueListResponse(
+            queues=[convert.queue_to_proto(q) for q in self._server.list_queues()]
+        )
+
+
+class _EventService:
+    def __init__(self, event_api):
+        self._api = event_api
+
+    def GetJobSetEvents(self, request, context):
+        if not request.watch:
+            for item in self._api.get_jobset_events(
+                request.queue, request.jobset, int(request.from_idx)
+            ):
+                yield pb.JobSetEventMessage(idx=item.idx, sequence=item.sequence)
+            return
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        idle = request.idle_timeout_s or None
+        for item in self._api.watch(
+            request.queue,
+            request.jobset,
+            from_idx=int(request.from_idx),
+            stop=stop,
+            idle_timeout_s=idle,
+        ):
+            yield pb.JobSetEventMessage(idx=item.idx, sequence=item.sequence)
+
+
+class _ExecutorApiService:
+    def __init__(self, executor_api, factory):
+        self._api = executor_api
+        self._factory = factory
+
+    def LeaseJobRuns(self, request, context):
+        req = convert.lease_request_from_proto(request, self._factory)
+        return convert.lease_response_to_proto(self._api.lease_job_runs(req))
+
+    def ReportEvents(self, request, context):
+        self._api.report_events(list(request.sequences))
+        return pb.Empty()
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def _server_stream(fn, req_cls):
+    return grpc.unary_stream_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def make_server(
+    submit_server=None,
+    event_api=None,
+    executor_api=None,
+    factory=None,
+    address: str = "127.0.0.1:0",
+    max_workers: int = 16,
+) -> tuple[grpc.Server, int]:
+    """Build and start a server hosting whichever services are given;
+    returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handlers = []
+    if submit_server is not None:
+        svc = _SubmitService(submit_server)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.Submit",
+                {
+                    "SubmitJobs": _unary(svc.SubmitJobs, pb.SubmitJobsRequest),
+                    "CancelJobs": _unary(svc.CancelJobs, pb.CancelJobsRequest),
+                    "CancelJobSet": _unary(svc.CancelJobSet, pb.CancelJobSetRequest),
+                    "PreemptJobs": _unary(svc.PreemptJobs, pb.PreemptJobsRequest),
+                    "ReprioritizeJobs": _unary(
+                        svc.ReprioritizeJobs, pb.ReprioritizeJobsRequest
+                    ),
+                    "CreateQueue": _unary(svc.CreateQueue, pb.Queue),
+                    "UpdateQueue": _unary(svc.UpdateQueue, pb.Queue),
+                    "DeleteQueue": _unary(svc.DeleteQueue, pb.QueueGetRequest),
+                    "GetQueue": _unary(svc.GetQueue, pb.QueueGetRequest),
+                    "ListQueues": _unary(svc.ListQueues, pb.Empty),
+                },
+            )
+        )
+    if event_api is not None:
+        esvc = _EventService(event_api)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.Event",
+                {
+                    "GetJobSetEvents": _server_stream(
+                        esvc.GetJobSetEvents, pb.JobSetEventsRequest
+                    ),
+                },
+            )
+        )
+    if executor_api is not None:
+        if factory is None:
+            raise ValueError("executor_api service requires a ResourceListFactory")
+        xsvc = _ExecutorApiService(executor_api, factory)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.ExecutorApi",
+                {
+                    "LeaseJobRuns": _unary(xsvc.LeaseJobRuns, pb.LeaseJobRunsRequest),
+                    "ReportEvents": _unary(xsvc.ReportEvents, pb.ReportEventsRequest),
+                },
+            )
+        )
+    server.add_generic_rpc_handlers(tuple(handlers))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port

@@ -59,6 +59,7 @@ def job_from_row(row, factory: ResourceListFactory) -> Job:
         pools=pools,
         cancel_requested=bool(row["cancel_requested"]),
         cancel_by_jobset_requested=bool(row["cancel_by_jobset_requested"]),
+        preempt_requested=bool(row["preempt_requested"]),
         cancelled=bool(row["cancelled"]),
         succeeded=bool(row["succeeded"]),
         failed=bool(row["failed"]),
@@ -119,6 +120,7 @@ def _merge_job(existing: Optional[Job], row, factory: ResourceListFactory) -> Jo
         cancel_by_jobset_requested=(
             fresh.cancel_by_jobset_requested or existing.cancel_by_jobset_requested
         ),
+        preempt_requested=fresh.preempt_requested or existing.preempt_requested,
         cancelled=fresh.cancelled or existing.cancelled,
         succeeded=fresh.succeeded or existing.succeeded,
         failed=fresh.failed or existing.failed,

@@ -250,6 +250,41 @@ class Scheduler:
                 continue
 
             run = job.latest_run
+
+            # Operator-requested preemption (persisted on the job row so a
+            # request that arrives before the lease materializes still acts).
+            if job.preempt_requested:
+                if run is None or run.in_terminal_state():
+                    if job.queued or run is None:
+                        # Preempted before it ever started: cancel it.
+                        builder.add(
+                            job.queue,
+                            job.jobset,
+                            pb.Event(
+                                created_ns=now_ns,
+                                cancelled_job=pb.CancelledJob(
+                                    job_id=job.id, reason=PREEMPTED_REASON
+                                ),
+                            ),
+                        )
+                        txn.upsert(job.with_cancelled())
+                        continue
+                elif not run.preempt_requested:
+                    # Ask the executor to stop the run; its report closes the loop.
+                    builder.add(
+                        job.queue,
+                        job.jobset,
+                        pb.Event(
+                            created_ns=now_ns,
+                            job_run_preemption_requested=pb.JobRunPreemptionRequested(
+                                job_id=job.id, run_id=run.id, reason=PREEMPTED_REASON
+                            ),
+                        ),
+                    )
+                    job = job.with_updated_run(run.with_preempt_requested())
+                    txn.upsert(job)
+                    run = job.latest_run
+
             if run is None:
                 continue
 

@@ -36,6 +36,15 @@ CREATE TABLE IF NOT EXISTS consumer_positions (
   position INTEGER NOT NULL,
   PRIMARY KEY (consumer, partition)
 );
+
+-- Monotonic per-stream index: survives retention pruning (Redis stream IDs
+-- are likewise monotonic in the reference), so watcher cursors stay valid.
+CREATE TABLE IF NOT EXISTS stream_cursors (
+  queue TEXT NOT NULL,
+  jobset TEXT NOT NULL,
+  next_idx INTEGER NOT NULL,
+  PRIMARY KEY (queue, jobset)
+);
 """
 
 
@@ -66,15 +75,26 @@ class EventDb:
             cur = self._conn.cursor()
             try:
                 for queue, jobset, created_ns, payload in batch:
+                    cur.execute(
+                        "INSERT INTO stream_cursors (queue, jobset, next_idx) "
+                        "VALUES (?, ?, 0) ON CONFLICT(queue, jobset) DO NOTHING",
+                        (queue, jobset),
+                    )
                     row = cur.execute(
-                        "SELECT COALESCE(MAX(idx), -1) + 1 FROM jobset_events "
+                        "SELECT next_idx FROM stream_cursors "
                         "WHERE queue = ? AND jobset = ?",
                         (queue, jobset),
                     ).fetchone()
+                    idx = int(row[0])
                     cur.execute(
                         "INSERT INTO jobset_events (queue, jobset, idx, created_ns, payload) "
                         "VALUES (?, ?, ?, ?, ?)",
-                        (queue, jobset, int(row[0]), created_ns, payload),
+                        (queue, jobset, idx, created_ns, payload),
+                    )
+                    cur.execute(
+                        "UPDATE stream_cursors SET next_idx = ? "
+                        "WHERE queue = ? AND jobset = ?",
+                        (idx + 1, queue, jobset),
                     )
                 for part, pos in (next_positions or {}).items():
                     cur.execute(
@@ -89,10 +109,11 @@ class EventDb:
                 raise
 
     def positions(self, consumer: str = "events") -> dict[int, int]:
-        rows = self._conn.execute(
-            "SELECT partition, position FROM consumer_positions WHERE consumer = ?",
-            (consumer,),
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT partition, position FROM consumer_positions WHERE consumer = ?",
+                (consumer,),
+            ).fetchall()
         return {int(r["partition"]): int(r["position"]) for r in rows}
 
     # --- reads --------------------------------------------------------------

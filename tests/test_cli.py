@@ -1,0 +1,156 @@
+"""armadactl CLI + `serve` launcher tests: real processes-shaped topology
+(control-plane thread + executor thread + CLI against the gRPC port), plus
+event-sourced restart recovery of the serve stack.
+"""
+
+import threading
+import time
+
+import pytest
+
+from armada_tpu.cli.armadactl import main
+from armada_tpu.cli.serve import run_fake_executor, start_control_plane
+from armada_tpu.core.config import SchedulingConfig
+
+
+@pytest.fixture
+def plane(tmp_path):
+    p = start_control_plane(
+        str(tmp_path / "data"),
+        port=0,
+        config=SchedulingConfig(shape_bucket=32),
+        cycle_interval_s=0.05,
+        schedule_interval_s=0.1,
+    )
+    yield p
+    p.stop()
+
+
+def ctl(plane, *argv):
+    return main(["--url", f"127.0.0.1:{plane.port}", *argv])
+
+
+def test_cli_full_workflow(plane, tmp_path, capsys):
+    assert ctl(plane, "queue", "create", "dev", "--weight", "2") == 0
+    assert ctl(plane, "queue", "list") == 0
+    out = capsys.readouterr().out
+    assert "dev" in out
+
+    # fake executor in a background thread
+    stop = threading.Event()
+    agent = threading.Thread(
+        target=run_fake_executor,
+        args=(f"127.0.0.1:{plane.port}",),
+        kwargs={
+            "executor_id": "t-ex",
+            "num_nodes": 2,
+            "cpu": "8",
+            "memory": "32",
+            "interval_s": 0.05,
+            "stop": stop,
+            "config": SchedulingConfig(shape_bucket=32),
+            "default_runtime_s": 0.2,
+        },
+        daemon=True,
+    )
+    agent.start()
+
+    sub = tmp_path / "job.yaml"
+    sub.write_text(
+        """
+queue: dev
+jobSetId: cli-test
+jobs:
+  - count: 3
+    priority: 0
+    resources: {cpu: "2", memory: "1"}
+"""
+    )
+    assert ctl(plane, "submit", str(sub)) == 0
+    out = capsys.readouterr().out
+    assert "submitted 3 job(s)" in out
+
+    # watch until the jobset drains (idle timeout ends the stream)
+    deadline = time.time() + 30
+    succeeded = 0
+    while time.time() < deadline and succeeded < 3:
+        assert ctl(plane, "watch", "--queue", "dev", "--job-set", "cli-test", "--timeout", "1") == 0
+        out = capsys.readouterr().out
+        succeeded = out.count("job_succeeded")
+    stop.set()
+    agent.join(timeout=5)
+    assert succeeded == 3, out
+
+    # lifecycle order visible in the final watch output
+    assert out.index("submit_job") < out.index("job_run_leased") < out.index(
+        "job_succeeded"
+    )
+
+
+def test_cli_cancel_and_reprioritize(plane, tmp_path, capsys):
+    ctl(plane, "queue", "create", "ops")
+    sub = tmp_path / "job.yaml"
+    sub.write_text(
+        """
+queue: ops
+jobSetId: stuck
+jobs:
+  - count: 2
+    resources: {cpu: "64", memory: "1"}   # unschedulably large
+"""
+    )
+    ctl(plane, "submit", str(sub))
+    capsys.readouterr()
+
+    assert ctl(plane, "reprioritize", "--queue", "ops", "--job-set", "stuck", "--priority", "5") == 0
+    assert ctl(plane, "cancel", "--queue", "ops", "--job-set", "stuck") == 0
+    deadline = time.time() + 20
+    cancelled = 0
+    while time.time() < deadline and cancelled < 2:
+        ctl(plane, "watch", "--queue", "ops", "--job-set", "stuck", "--timeout", "0.5")
+        cancelled = capsys.readouterr().out.count("cancelled_job")
+    assert cancelled == 2
+
+
+def test_serve_restart_recovers_state(tmp_path, capsys):
+    data = str(tmp_path / "data")
+    p1 = start_control_plane(
+        data,
+        port=0,
+        config=SchedulingConfig(shape_bucket=32),
+        cycle_interval_s=0.05,
+        schedule_interval_s=0.1,
+    )
+    try:
+        assert main(["--url", f"127.0.0.1:{p1.port}", "queue", "create", "persist"]) == 0
+        sub = tmp_path / "job.yaml"
+        sub.write_text(
+            "queue: persist\njobSetId: js\njobs:\n  - resources: {cpu: '1', memory: '1'}\n"
+        )
+        assert main(["--url", f"127.0.0.1:{p1.port}", "submit", str(sub)]) == 0
+        time.sleep(0.5)
+    finally:
+        p1.stop()
+
+    # second incarnation on the same data dir sees the queue AND the job
+    p2 = start_control_plane(
+        data,
+        port=0,
+        config=SchedulingConfig(shape_bucket=32),
+        cycle_interval_s=0.05,
+        schedule_interval_s=0.1,
+    )
+    try:
+        assert main(["--url", f"127.0.0.1:{p2.port}", "queue", "describe", "persist"]) == 0
+        out = capsys.readouterr().out
+        assert "persist" in out
+        rows, _ = p2.scheduler.db.fetch_job_updates(0, 0)
+        assert len(rows) == 1
+        # events replayed into the stream store exactly once
+        events = p2.event_api.get_jobset_events("persist", "js")
+        kinds = [
+            ev.WhichOneof("event") for e in events for ev in e.sequence.events
+        ]
+        assert kinds.count("submit_job") == 1
+    finally:
+        p2.stop()

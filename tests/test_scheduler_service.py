@@ -353,6 +353,69 @@ def test_terminal_run_error_fails_job(world):
     assert world.jobdb.read_txn().get("job-f").failed
 
 
+def test_preempt_request_on_queued_job_cancels_it(world):
+    """A preempt request that lands before the job ever leases must not be
+    silently dropped: the scheduler cancels the queued job."""
+    world.submit("job-pq")
+    world.ingest()
+    world.scheduler.cycle()  # validate (no executor: job stays queued)
+    world.ingest()
+
+    world.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js1",
+                events=[
+                    pb.Event(
+                        created_ns=world.scheduler.now_ns(),
+                        preempt_job=pb.PreemptJob(job_id="job-pq", reason="ops"),
+                    )
+                ],
+            )
+        ]
+    )
+    world.ingest()
+    res = world.scheduler.cycle()
+    cancelled = events_of_kind(res.published, "cancelled_job")
+    assert [c.job_id for c in cancelled] == ["job-pq"]
+    assert world.jobdb.read_txn().get("job-pq").cancelled
+
+
+def test_preempt_request_on_leased_job_asks_executor(world):
+    world.submit("job-pl")
+    world.ingest()
+    world.add_executor()
+    res = world.scheduler.cycle()
+    (lease,) = events_of_kind(res.published, "job_run_leased")
+    world.ingest()
+
+    world.publisher.publish(
+        [
+            pb.EventSequence(
+                queue="q1",
+                jobset="js1",
+                events=[
+                    pb.Event(
+                        created_ns=world.scheduler.now_ns(),
+                        preempt_job=pb.PreemptJob(job_id="job-pl"),
+                    )
+                ],
+            )
+        ]
+    )
+    world.ingest()
+    # The run existed when the preempt op applied, so the run row is marked
+    # directly and the executor learns via runs_to_preempt on its next lease
+    # call -- no extra scheduler event needed.
+    assert world.db.preempt_requested_runs("ex1") == [lease.run_id]
+    res2 = world.scheduler.cycle()
+    assert events_of_kind(res2.published, "job_run_preemption_requested") == []
+    # job not cancelled (it has a live run being preempted via the executor)
+    job = world.jobdb.read_txn().get("job-pl")
+    assert job is not None and not job.in_terminal_state()
+
+
 def test_follower_syncs_but_does_not_publish(world, tmp_path):
     class Follower:
         def get_token(self):

@@ -161,10 +161,13 @@ def test_cancel_preempt_reprioritize_roundtrip(stack):
     rows, _ = db.fetch_job_updates(0, 0)
     assert all(r["priority"] == 9 for r in rows)
 
-    # preemption requests mark active runs; no runs yet -> no-op, but the
-    # event still materializes once a run exists
+    # preemption of a job with no run yet persists on the job row, so the
+    # scheduler can act on it whenever the job's fate is decided
     server.preempt_jobs("q1", "js", [ids[2]])
-    pipeline.run_until_caught_up()  # no error
+    pipeline.run_until_caught_up()
+    rows, _ = db.fetch_job_updates(0, 0)
+    by_id = {r["job_id"]: r for r in rows}
+    assert by_id[ids[2]]["preempt_requested"] == 1
 
 
 def test_cancel_jobset_states_validated(stack):
@@ -287,4 +290,11 @@ def test_event_retention_prune(stack):
     assert eventdb.prune(created + int(30e9)) == 0
     assert eventdb.prune(created + int(120e9)) == 1
     assert eventdb.read("q1", "js") == []
+
+    # Stream indices stay monotonic across pruning: a watcher cursor that
+    # advanced past the pruned rows still sees everything new.
+    server.submit_jobs("q1", "js", [item()])
+    event_pipeline.run_until_caught_up()
+    rows = eventdb.read("q1", "js")
+    assert rows and rows[0]["idx"] == 1  # not reset to 0
     eventdb.close()
