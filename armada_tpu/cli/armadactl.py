@@ -219,6 +219,58 @@ def cmd_watch(args):
     return 0
 
 
+def cmd_jobs(args):
+    filters = []
+    if args.queue:
+        filters.append({"field": "queue", "value": args.queue})
+    if args.job_set:
+        filters.append({"field": "jobset", "value": args.job_set})
+    if args.state:
+        filters.append({"field": "state", "value": args.state, "match": "in"})
+    if args.annotation:
+        for pair in args.annotation:
+            k, _, v = pair.partition("=")
+            filters.append(
+                {"field": "annotation", "annotation_key": k, "value": v}
+            )
+
+    def go(c):
+        if args.group_by:
+            groups = c.group_jobs(args.group_by, filters)
+            print(f"{'GROUP':<32} {'COUNT':>7}  STATES")
+            for g in groups:
+                states = " ".join(
+                    f"{s}={n}" for s, n in g["states"].items() if n
+                )
+                print(f"{str(g['group']):<32} {g['count']:>7}  {states}")
+            return
+        order = {"field": args.order, "direction": "DESC" if args.desc else "ASC"}
+        jobs = c.get_jobs(filters, order, skip=args.skip, take=args.take)
+        if not jobs:
+            print("no jobs")
+            return
+        print(f"{'JOB ID':<28} {'QUEUE':<14} {'JOBSET':<16} {'STATE':<10} {'NODE':<18} PRI")
+        for j in jobs:
+            print(
+                f"{j['job_id']:<28} {j['queue']:<14} {j['jobset']:<16} "
+                f"{j['state']:<10} {j['node'] or '-':<18} {j['priority']}"
+            )
+
+    with_closed(_client(args), go)
+    return 0
+
+
+def cmd_describe_job(args):
+    j = with_closed(_client(args), lambda c: c.get_job_details(args.job_id))
+    runs = j.pop("runs", [])
+    for k, v in j.items():
+        print(f"{k}: {v}")
+    for r in runs:
+        print(f"run {r['run_id']}: state={r['state']} node={r['node']} "
+              f"executor={r['executor']}" + (f" error={r['error']}" if r.get("error") else ""))
+    return 0
+
+
 def cmd_serve(args):
     from armada_tpu.cli.serve import start_control_plane
 
@@ -334,6 +386,22 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--job-set", required=True)
     w.add_argument("--timeout", type=float, help="stop after this many idle seconds")
     w.set_defaults(fn=cmd_watch)
+
+    j = sub.add_parser("jobs", help="query jobs (lookout)")
+    j.add_argument("--queue")
+    j.add_argument("--job-set")
+    j.add_argument("--state", action="append", help="filter by state (repeatable)")
+    j.add_argument("--annotation", action="append", help="key=value filter")
+    j.add_argument("--group-by", help="group instead of list (e.g. state, queue)")
+    j.add_argument("--order", default="submitted")
+    j.add_argument("--desc", action="store_true")
+    j.add_argument("--skip", type=int, default=0)
+    j.add_argument("--take", type=int, default=50)
+    j.set_defaults(fn=cmd_jobs)
+
+    dj = sub.add_parser("describe-job", help="full job details incl. runs")
+    dj.add_argument("job_id")
+    dj.set_defaults(fn=cmd_describe_job)
 
     srv = sub.add_parser("serve", help="run the control plane")
     srv.add_argument("--data-dir", default="./armada-tpu-data")

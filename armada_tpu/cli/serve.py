@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 from typing import Optional
 
 from armada_tpu.core.config import SchedulingConfig
@@ -22,6 +23,7 @@ from armada_tpu.ingest.converter import convert_sequences
 from armada_tpu.ingest.pipeline import IngestionPipeline
 from armada_tpu.ingest.schedulerdb import SchedulerDb
 from armada_tpu.jobdb.jobdb import JobDb
+from armada_tpu.lookout import LookoutDb, LookoutQueries, lookout_converter
 from armada_tpu.scheduler import (
     FairSchedulingAlgo,
     FileLeaseLeaderController,
@@ -53,6 +55,7 @@ class ControlPlaneProcess:
     _log: EventLog
     _db: SchedulerDb
     _eventdb: EventDb
+    _lookoutdb: LookoutDb
 
     def stop(self) -> None:
         self._stop.set()
@@ -62,6 +65,7 @@ class ControlPlaneProcess:
         self._grpc_server.stop(1).wait()
         self._db.close()
         self._eventdb.close()
+        self._lookoutdb.close()
         self._log.close()
 
     def wait(self) -> None:
@@ -84,6 +88,7 @@ def start_control_plane(
     log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
     db = SchedulerDb(os.path.join(data_dir, "scheduler.db"))
     eventdb = EventDb(os.path.join(data_dir, "events.db"))
+    lookoutdb = LookoutDb(os.path.join(data_dir, "lookout.db"))
     publisher = Publisher(log)
 
     scheduler_pipeline = IngestionPipeline(
@@ -99,6 +104,13 @@ def start_control_plane(
         event_sink_converter,
         consumer_name="events",
         start_positions=eventdb.positions("events"),
+    )
+    lookout_pipeline = IngestionPipeline(
+        log,
+        lookoutdb,
+        lookout_converter,
+        consumer_name="lookout",
+        start_positions=lookoutdb.positions("lookout"),
     )
 
     queues = QueueRepository(db)
@@ -116,7 +128,7 @@ def start_control_plane(
         FairSchedulingAlgo(
             config,
             queues=queues.scheduling_queues,
-            clock_ns=lambda: int(__import__("time").time() * 1e9),
+            clock_ns=lambda: int(time.time() * 1e9),
         ),
         publisher,
         leader,
@@ -131,11 +143,13 @@ def start_control_plane(
         event_api=event_api,
         executor_api=executor_api,
         factory=factory,
+        lookout_queries=LookoutQueries(lookoutdb),
         address=f"127.0.0.1:{port}",
     )
 
     scheduler_pipeline.start()
     event_pipeline.start()
+    lookout_pipeline.start()
 
     # Recovery fencing: don't take decisions until the DB reflects everything
     # published before this process started (scheduler.go ensureDbUpToDate).
@@ -159,12 +173,13 @@ def start_control_plane(
         submit_server=submit_server,
         event_api=event_api,
         _grpc_server=grpc_server,
-        _pipelines=[scheduler_pipeline, event_pipeline],
+        _pipelines=[scheduler_pipeline, event_pipeline, lookout_pipeline],
         _stop=stop,
         _scheduler_thread=scheduler_thread,
         _log=log,
         _db=db,
         _eventdb=eventdb,
+        _lookoutdb=lookoutdb,
     )
 
 

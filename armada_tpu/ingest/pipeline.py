@@ -85,6 +85,21 @@ class IngestionPipeline:
             self._thread = None
 
     def _loop(self) -> None:
+        import logging
+
+        backoff = self._poll_interval
         while not self._stop.is_set():
-            if self.run_once() == 0:
+            try:
+                n = self.run_once()
+                backoff = self._poll_interval
+            except Exception:  # noqa: BLE001 - service thread must survive
+                logging.getLogger(__name__).exception(
+                    "ingestion pipeline %s: batch failed; retrying",
+                    self.consumer_name,
+                )
+                # positions were not acked: the batch replays after backoff
+                self._stop.wait(min(backoff, 5.0))
+                backoff = min(backoff * 2, 5.0)
+                continue
+            if n == 0:
                 self._stop.wait(self._poll_interval)

@@ -136,9 +136,23 @@ class SchedulerDb:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
+        self._migrate()
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
         self._lock = threading.Lock()
+
+    def _migrate(self) -> None:
+        """Columns added after a table existed: CREATE TABLE IF NOT EXISTS is
+        a no-op then, so patch the schema in place (the reference's numbered
+        migrations, database/migrations/)."""
+        cols = {
+            r["name"]
+            for r in self._conn.execute("PRAGMA table_info(jobs)").fetchall()
+        }
+        if "preempt_requested" not in cols:
+            self._conn.execute(
+                "ALTER TABLE jobs ADD COLUMN preempt_requested INTEGER NOT NULL DEFAULT 0"
+            )
 
     def close(self) -> None:
         self._conn.close()

@@ -1,0 +1,26 @@
+"""Lookout-lite: the job-query / observability side of the control plane.
+
+Equivalent of the reference's lookout stack (internal/lookoutingester:
+EventSequence -> denormalized lookout Postgres rows; internal/lookout:
+getjobs/groupjobs REST API with rich filter/group/order semantics,
+repository/querybuilder.go; internal/server/queryapi: job status straight
+from the lookout DB) on SQLite, as a library + CLI surface instead of a web
+UI.
+"""
+
+from armada_tpu.lookout.db import LookoutDb, JOB_STATES
+from armada_tpu.lookout.ingester import lookout_converter
+from armada_tpu.lookout.queries import (
+    JobFilter,
+    JobOrder,
+    LookoutQueries,
+)
+
+__all__ = [
+    "LookoutDb",
+    "JOB_STATES",
+    "lookout_converter",
+    "JobFilter",
+    "JobOrder",
+    "LookoutQueries",
+]

@@ -1,0 +1,252 @@
+"""The lookout database: denormalized job/run rows optimised for querying.
+
+Equivalent of the reference's lookout Postgres schema (internal/lookout/
+schema/migrations: `job` with state + timestamps + resource columns +
+annotations, `job_run` per attempt, `job_error`): one wide row per job kept
+current by the ingester, so list/group/detail queries are single-table scans
+with indexes -- no joins against the scheduler's store, which serves a
+different master (the cycle).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Iterable, Optional
+
+# Lookout job states (internal/lookoutui state enum; ingester state machine).
+JOB_STATES = (
+    "QUEUED",
+    "LEASED",
+    "PENDING",
+    "RUNNING",
+    "SUCCEEDED",
+    "FAILED",
+    "CANCELLED",
+    "PREEMPTED",
+)
+
+_TERMINAL_STATES = ("SUCCEEDED", "FAILED", "CANCELLED", "PREEMPTED")
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS job (
+  job_id TEXT PRIMARY KEY,
+  queue TEXT NOT NULL,
+  jobset TEXT NOT NULL,
+  namespace TEXT NOT NULL DEFAULT '',
+  state TEXT NOT NULL DEFAULT 'QUEUED',
+  priority INTEGER NOT NULL DEFAULT 0,
+  priority_class TEXT NOT NULL DEFAULT '',
+  cpu_milli INTEGER NOT NULL DEFAULT 0,
+  memory INTEGER NOT NULL DEFAULT 0,
+  gpu INTEGER NOT NULL DEFAULT 0,
+  gang_id TEXT NOT NULL DEFAULT '',
+  submitted_ns INTEGER NOT NULL DEFAULT 0,
+  last_transition_ns INTEGER NOT NULL DEFAULT 0,
+  latest_run_id TEXT NOT NULL DEFAULT '',
+  node TEXT NOT NULL DEFAULT '',
+  error TEXT NOT NULL DEFAULT '',
+  annotations_json TEXT NOT NULL DEFAULT '{}',
+  spec BLOB
+);
+CREATE INDEX IF NOT EXISTS idx_job_queue_jobset ON job(queue, jobset);
+CREATE INDEX IF NOT EXISTS idx_job_state ON job(state);
+CREATE INDEX IF NOT EXISTS idx_job_submitted ON job(submitted_ns);
+
+CREATE TABLE IF NOT EXISTS job_run (
+  run_id TEXT PRIMARY KEY,
+  job_id TEXT NOT NULL,
+  executor TEXT NOT NULL DEFAULT '',
+  node TEXT NOT NULL DEFAULT '',
+  state TEXT NOT NULL DEFAULT 'LEASED',
+  leased_ns INTEGER NOT NULL DEFAULT 0,
+  pending_ns INTEGER NOT NULL DEFAULT 0,
+  started_ns INTEGER NOT NULL DEFAULT 0,
+  finished_ns INTEGER NOT NULL DEFAULT 0,
+  error TEXT NOT NULL DEFAULT ''
+);
+CREATE INDEX IF NOT EXISTS idx_job_run_job ON job_run(job_id);
+
+CREATE TABLE IF NOT EXISTS consumer_positions (
+  consumer TEXT NOT NULL,
+  partition INTEGER NOT NULL,
+  position INTEGER NOT NULL,
+  PRIMARY KEY (consumer, partition)
+);
+"""
+
+
+class LookoutDb:
+    """Store + ingestion sink (lookoutingester/lookoutdb/insertion.go)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.executescript(_SCHEMA)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # --- sink ---------------------------------------------------------------
+
+    def store(
+        self,
+        batch,  # list of row-op dicts from lookout_converter
+        consumer: str = "lookout",
+        next_positions: Optional[dict[int, int]] = None,
+    ) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            try:
+                for op in batch:
+                    self._apply(cur, op)
+                for part, pos in (next_positions or {}).items():
+                    cur.execute(
+                        "INSERT INTO consumer_positions(consumer, partition, position) "
+                        "VALUES (?, ?, ?) ON CONFLICT(consumer, partition) "
+                        "DO UPDATE SET position = excluded.position",
+                        (consumer, part, pos),
+                    )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
+    def positions(self, consumer: str = "lookout") -> dict[int, int]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT partition, position FROM consumer_positions WHERE consumer = ?",
+                (consumer,),
+            ).fetchall()
+        return {int(r["partition"]): int(r["position"]) for r in rows}
+
+    def _apply(self, cur: sqlite3.Cursor, op: dict) -> None:
+        kind = op["kind"]
+        if kind == "insert_job":
+            cur.execute(
+                "INSERT OR IGNORE INTO job (job_id, queue, jobset, namespace, state, "
+                "priority, priority_class, cpu_milli, memory, gpu, gang_id, "
+                "submitted_ns, last_transition_ns, annotations_json, spec) "
+                "VALUES (?, ?, ?, ?, 'QUEUED', ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    op["job_id"],
+                    op["queue"],
+                    op["jobset"],
+                    op.get("namespace", ""),
+                    op.get("priority", 0),
+                    op.get("priority_class", ""),
+                    op.get("cpu_milli", 0),
+                    op.get("memory", 0),
+                    op.get("gpu", 0),
+                    op.get("gang_id", ""),
+                    op["ts"],
+                    op["ts"],
+                    json.dumps(op.get("annotations", {})),
+                    op.get("spec", b""),
+                ),
+            )
+        elif kind == "job_state":
+            # Terminal states are sticky: late events can't resurrect a job
+            # (lookoutdb insertion keeps the terminal row).
+            cur.execute(
+                "UPDATE job SET state = ?, last_transition_ns = ? "
+                "WHERE job_id = ? AND state NOT IN "
+                "('SUCCEEDED','FAILED','CANCELLED','PREEMPTED')",
+                (op["state"], op["ts"], op["job_id"]),
+            )
+            if op.get("error"):
+                cur.execute(
+                    "UPDATE job SET error = ? WHERE job_id = ? AND error = ''",
+                    (op["error"], op["job_id"]),
+                )
+        elif kind == "job_priority":
+            cur.execute(
+                "UPDATE job SET priority = ? WHERE job_id = ?",
+                (op["priority"], op["job_id"]),
+            )
+        elif kind == "jobset_priority":
+            cur.execute(
+                "UPDATE job SET priority = ? WHERE queue = ? AND jobset = ? "
+                "AND state NOT IN ('SUCCEEDED','FAILED','CANCELLED','PREEMPTED')",
+                (op["priority"], op["queue"], op["jobset"]),
+            )
+        elif kind == "insert_run":
+            cur.execute(
+                "INSERT OR IGNORE INTO job_run (run_id, job_id, executor, node, "
+                "state, leased_ns) VALUES (?, ?, ?, ?, 'LEASED', ?)",
+                (
+                    op["run_id"],
+                    op["job_id"],
+                    op.get("executor", ""),
+                    op.get("node", ""),
+                    op["ts"],
+                ),
+            )
+            cur.execute(
+                "UPDATE job SET latest_run_id = ?, node = ? WHERE job_id = ?",
+                (op["run_id"], op.get("node", ""), op["job_id"]),
+            )
+        elif kind == "run_state":
+            ts_col = {
+                "PENDING": "pending_ns",
+                "RUNNING": "started_ns",
+                "SUCCEEDED": "finished_ns",
+                "FAILED": "finished_ns",
+                "PREEMPTED": "finished_ns",
+                "CANCELLED": "finished_ns",
+            }.get(op["state"])
+            extra = f", {ts_col} = ?" if ts_col else ""
+            params = [op["state"]]
+            if ts_col:
+                params.append(op["ts"])
+            params.append(op["run_id"])
+            cur.execute(
+                "UPDATE job_run SET state = ?" + extra + " WHERE run_id = ? "
+                "AND state NOT IN ('SUCCEEDED','FAILED','CANCELLED','PREEMPTED')",
+                params,
+            )
+            if op.get("node"):
+                cur.execute(
+                    "UPDATE job_run SET node = ? WHERE run_id = ? AND node = ''",
+                    (op["node"], op["run_id"]),
+                )
+                cur.execute(
+                    "UPDATE job SET node = ? WHERE latest_run_id = ?",
+                    (op["node"], op["run_id"]),
+                )
+            if op.get("error"):
+                cur.execute(
+                    "UPDATE job_run SET error = ? WHERE run_id = ?",
+                    (op["error"], op["run_id"]),
+                )
+        else:
+            raise TypeError(f"unknown lookout op kind {kind!r}")
+
+    # --- pruning (internal/lookout/pruner) ----------------------------------
+
+    def prune(self, now_ns: int, keep_terminal_s: float) -> int:
+        """Delete terminal jobs (and their runs) older than the TTL."""
+        cutoff = now_ns - int(keep_terminal_s * 1e9)
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM job WHERE state IN "
+                "('SUCCEEDED','FAILED','CANCELLED','PREEMPTED') "
+                "AND last_transition_ns < ?",
+                (cutoff,),
+            )
+            n = cur.rowcount
+            self._conn.execute(
+                "DELETE FROM job_run WHERE job_id NOT IN (SELECT job_id FROM job)"
+            )
+            self._conn.commit()
+            return n
+
+    # --- raw reads (used by queries.py) -------------------------------------
+
+    def query(self, sql: str, params=()) -> list[sqlite3.Row]:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()

@@ -1,0 +1,195 @@
+"""EventSequence -> lookout row ops.
+
+Equivalent of the reference's lookoutingester instruction converter
+(internal/lookoutingester/instructions/instructions.go): each event updates
+the denormalized job/run rows; the state machine mirrors the lookout UI's
+job states.
+"""
+
+from __future__ import annotations
+
+from armada_tpu.events import events_pb2 as pb
+
+
+def lookout_converter(sequences) -> list[dict]:
+    ops: list[dict] = []
+    for seq in sequences:
+        for ev in seq.events:
+            kind = ev.WhichOneof("event")
+            ts = int(ev.created_ns)
+            if kind == "submit_job":
+                e = ev.submit_job
+                milli = dict(e.spec.resources.milli)
+                ops.append(
+                    {
+                        "kind": "insert_job",
+                        "job_id": e.job_id,
+                        "queue": seq.queue,
+                        "jobset": seq.jobset,
+                        "namespace": e.spec.namespace,
+                        "priority": int(e.spec.priority),
+                        "priority_class": e.spec.priority_class,
+                        "cpu_milli": int(milli.get("cpu", 0)),
+                        "memory": int(milli.get("memory", 0)),
+                        "gpu": int(milli.get("nvidia.com/gpu", 0)),
+                        "gang_id": e.spec.gang_id,
+                        "annotations": dict(e.spec.annotations),
+                        "spec": e.spec.SerializeToString(),
+                        "ts": ts,
+                    }
+                )
+            elif kind == "reprioritised_job":
+                ops.append(
+                    {
+                        "kind": "job_priority",
+                        "job_id": ev.reprioritised_job.job_id,
+                        "priority": int(ev.reprioritised_job.priority),
+                    }
+                )
+            elif kind == "reprioritise_job":
+                ops.append(
+                    {
+                        "kind": "job_priority",
+                        "job_id": ev.reprioritise_job.job_id,
+                        "priority": int(ev.reprioritise_job.priority),
+                    }
+                )
+            elif kind == "reprioritise_job_set":
+                ops.append(
+                    {
+                        "kind": "jobset_priority",
+                        "queue": seq.queue,
+                        "jobset": seq.jobset,
+                        "priority": int(ev.reprioritise_job_set.priority),
+                    }
+                )
+            elif kind == "cancelled_job":
+                ops.append(
+                    {
+                        "kind": "job_state",
+                        "job_id": ev.cancelled_job.job_id,
+                        "state": "CANCELLED",
+                        "ts": ts,
+                        "error": ev.cancelled_job.reason,
+                    }
+                )
+            elif kind == "job_succeeded":
+                ops.append(
+                    {
+                        "kind": "job_state",
+                        "job_id": ev.job_succeeded.job_id,
+                        "state": "SUCCEEDED",
+                        "ts": ts,
+                    }
+                )
+            elif kind == "job_errors":
+                e = ev.job_errors
+                terminal = [err for err in e.errors if err.terminal]
+                if terminal:
+                    state = (
+                        "PREEMPTED"
+                        if terminal[0].reason == "preempted"
+                        else "FAILED"
+                    )
+                    ops.append(
+                        {
+                            "kind": "job_state",
+                            "job_id": e.job_id,
+                            "state": state,
+                            "ts": ts,
+                            "error": f"{terminal[0].reason}: {terminal[0].message}",
+                        }
+                    )
+            elif kind == "job_requeued":
+                ops.append(
+                    {
+                        "kind": "job_state",
+                        "job_id": ev.job_requeued.job_id,
+                        "state": "QUEUED",
+                        "ts": ts,
+                    }
+                )
+            elif kind == "job_run_leased":
+                e = ev.job_run_leased
+                ops.append(
+                    {
+                        "kind": "insert_run",
+                        "run_id": e.run_id,
+                        "job_id": e.job_id,
+                        "executor": e.executor_id,
+                        "node": e.node_id,
+                        "ts": ts,
+                    }
+                )
+                ops.append(
+                    {
+                        "kind": "job_state",
+                        "job_id": e.job_id,
+                        "state": "LEASED",
+                        "ts": ts,
+                    }
+                )
+            elif kind == "job_run_assigned":
+                e = ev.job_run_assigned
+                ops.append(
+                    {"kind": "run_state", "run_id": e.run_id, "state": "PENDING", "ts": ts}
+                )
+                ops.append(
+                    {"kind": "job_state", "job_id": e.job_id, "state": "PENDING", "ts": ts}
+                )
+            elif kind == "job_run_running":
+                e = ev.job_run_running
+                ops.append(
+                    {
+                        "kind": "run_state",
+                        "run_id": e.run_id,
+                        "state": "RUNNING",
+                        "ts": ts,
+                        "node": e.node_id,
+                    }
+                )
+                ops.append(
+                    {"kind": "job_state", "job_id": e.job_id, "state": "RUNNING", "ts": ts}
+                )
+            elif kind == "job_run_succeeded":
+                e = ev.job_run_succeeded
+                ops.append(
+                    {"kind": "run_state", "run_id": e.run_id, "state": "SUCCEEDED", "ts": ts}
+                )
+            elif kind == "job_run_cancelled":
+                e = ev.job_run_cancelled
+                ops.append(
+                    {"kind": "run_state", "run_id": e.run_id, "state": "CANCELLED", "ts": ts}
+                )
+            elif kind == "job_run_preempted":
+                e = ev.job_run_preempted
+                ops.append(
+                    {"kind": "run_state", "run_id": e.run_id, "state": "PREEMPTED", "ts": ts}
+                )
+            elif kind == "job_run_errors":
+                e = ev.job_run_errors
+                terminal = [err for err in e.errors if err.terminal]
+                msg = "; ".join(
+                    f"{err.reason}: {err.message}" for err in e.errors
+                )
+                if terminal:
+                    ops.append(
+                        {
+                            "kind": "run_state",
+                            "run_id": e.run_id,
+                            "state": "FAILED",
+                            "ts": ts,
+                            "error": msg,
+                        }
+                    )
+                elif any(err.lease_returned for err in e.errors):
+                    ops.append(
+                        {
+                            "kind": "run_state",
+                            "run_id": e.run_id,
+                            "state": "FAILED",
+                            "ts": ts,
+                            "error": msg,
+                        }
+                    )
+    return ops

@@ -1,0 +1,201 @@
+"""Lookout queries: GetJobs / GroupJobs / job details.
+
+Equivalent of the reference's lookout repository (internal/lookout/
+repository/getjobs.go, groupjobs.go, querybuilder.go) and the Jobs query api
+(internal/server/queryapi/query_api.go:50-245): filterable, orderable,
+paginated job listing; grouping with aggregates; per-job detail incl. runs.
+
+Filter semantics (lookoutui match ops): exact, startsWith, contains, in,
+greaterThan/lessThan (numeric), annotation[key] matches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+from armada_tpu.lookout.db import JOB_STATES, LookoutDb
+
+_FIELDS = {
+    "job_id": "job_id",
+    "queue": "queue",
+    "jobset": "jobset",
+    "namespace": "namespace",
+    "state": "state",
+    "priority": "priority",
+    "priority_class": "priority_class",
+    "cpu_milli": "cpu_milli",
+    "memory": "memory",
+    "gpu": "gpu",
+    "gang_id": "gang_id",
+    "submitted": "submitted_ns",
+    "last_transition": "last_transition_ns",
+    "node": "node",
+}
+
+_OPS = {
+    "exact": "= ?",
+    "notEqual": "!= ?",
+    "startsWith": "LIKE ? ESCAPE '\\'",
+    "contains": "LIKE ? ESCAPE '\\'",
+    "greaterThan": "> ?",
+    "lessThan": "< ?",
+    "greaterThanOrEqual": ">= ?",
+    "lessThanOrEqual": "<= ?",
+    "in": None,  # expanded separately
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobFilter:
+    field: str  # one of _FIELDS, or "annotation"
+    value: object
+    match: str = "exact"
+    annotation_key: str = ""  # when field == "annotation"
+
+
+@dataclasses.dataclass(frozen=True)
+class JobOrder:
+    field: str = "submitted"
+    direction: str = "ASC"  # ASC | DESC
+
+
+def _escape_like(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
+
+
+class LookoutQueries:
+    def __init__(self, db: LookoutDb):
+        self._db = db
+
+    # --- where-clause builder (querybuilder.go) -----------------------------
+
+    def _where(self, filters: Sequence[JobFilter]) -> tuple[str, list]:
+        clauses, params = [], []
+        for f in filters:
+            if f.field == "annotation":
+                # JSON1 extraction; the key is quoted so dotted kubernetes-style
+                # keys ("armadaproject.io/stage") address the flat entry.
+                if '"' in f.annotation_key:
+                    raise ValueError("annotation keys may not contain '\"'")
+                clauses.append("json_extract(annotations_json, ?) = ?")
+                params.append(f'$."{f.annotation_key}"')
+                params.append(f.value)
+                continue
+            col = _FIELDS.get(f.field)
+            if col is None:
+                raise ValueError(f"unknown filter field {f.field!r}")
+            if f.match == "in":
+                values = list(f.value)  # type: ignore[arg-type]
+                if not values:
+                    clauses.append("0")
+                    continue
+                qs = ",".join("?" for _ in values)
+                clauses.append(f"{col} IN ({qs})")
+                params.extend(values)
+                continue
+            op = _OPS.get(f.match)
+            if op is None:
+                raise ValueError(f"unknown match {f.match!r}")
+            clauses.append(f"{col} {op}")
+            if f.match == "startsWith":
+                params.append(_escape_like(str(f.value)) + "%")
+            elif f.match == "contains":
+                params.append("%" + _escape_like(str(f.value)) + "%")
+            else:
+                params.append(f.value)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        return where, params
+
+    # --- GetJobs (repository/getjobs.go) ------------------------------------
+
+    def get_jobs(
+        self,
+        filters: Sequence[JobFilter] = (),
+        order: Optional[JobOrder] = None,
+        skip: int = 0,
+        take: int = 100,
+    ) -> list[dict]:
+        order = order or JobOrder()
+        col = _FIELDS.get(order.field)
+        if col is None:
+            raise ValueError(f"unknown order field {order.field!r}")
+        direction = "DESC" if order.direction.upper() == "DESC" else "ASC"
+        where, params = self._where(filters)
+        rows = self._db.query(
+            f"SELECT * FROM job{where} ORDER BY {col} {direction}, job_id "
+            "LIMIT ? OFFSET ?",
+            [*params, take, skip],
+        )
+        return [self._job_row_to_dict(r) for r in rows]
+
+    def count_jobs(self, filters: Sequence[JobFilter] = ()) -> int:
+        where, params = self._where(filters)
+        return int(self._db.query(f"SELECT COUNT(*) FROM job{where}", params)[0][0])
+
+    # --- GroupJobs (repository/groupjobs.go) --------------------------------
+
+    def group_jobs(
+        self,
+        group_by: str,
+        filters: Sequence[JobFilter] = (),
+        order_by_count_desc: bool = True,
+        take: int = 100,
+    ) -> list[dict]:
+        col = _FIELDS.get(group_by)
+        if col is None:
+            raise ValueError(f"unknown group field {group_by!r}")
+        where, params = self._where(filters)
+        state_counts = ", ".join(
+            f"SUM(state = '{s}') AS n_{s.lower()}" for s in JOB_STATES
+        )
+        direction = "DESC" if order_by_count_desc else "ASC"
+        rows = self._db.query(
+            f"SELECT {col} AS grp, COUNT(*) AS count, {state_counts}, "
+            f"AVG(submitted_ns) AS avg_submitted_ns "
+            f"FROM job{where} GROUP BY {col} ORDER BY count {direction}, grp "
+            "LIMIT ?",
+            [*params, take],
+        )
+        out = []
+        for r in rows:
+            d = {
+                "group": r["grp"],
+                "count": int(r["count"]),
+                "avg_submitted_ns": float(r["avg_submitted_ns"] or 0),
+                "states": {
+                    s: int(r[f"n_{s.lower()}"] or 0) for s in JOB_STATES
+                },
+            }
+            out.append(d)
+        return out
+
+    # --- details (queryapi/query_api.go GetJobDetails) ----------------------
+
+    def get_job_details(self, job_id: str) -> Optional[dict]:
+        rows = self._db.query("SELECT * FROM job WHERE job_id = ?", (job_id,))
+        if not rows:
+            return None
+        job = self._job_row_to_dict(rows[0])
+        job["runs"] = [
+            dict(r)
+            for r in self._db.query(
+                "SELECT * FROM job_run WHERE job_id = ? ORDER BY leased_ns",
+                (job_id,),
+            )
+        ]
+        return job
+
+    def get_run_error(self, run_id: str) -> str:
+        rows = self._db.query(
+            "SELECT error FROM job_run WHERE run_id = ?", (run_id,)
+        )
+        return rows[0]["error"] if rows else ""
+
+    @staticmethod
+    def _job_row_to_dict(r) -> dict:
+        d = dict(r)
+        d["annotations"] = json.loads(d.pop("annotations_json", "{}"))
+        d.pop("spec", None)
+        return d

@@ -155,6 +155,59 @@ class ArmadaClient(_Base):
         )
         return [convert.queue_from_proto(q) for q in resp.queues]
 
+    # --- lookout queries ----------------------------------------------------
+
+    def get_jobs(
+        self,
+        filters=(),
+        order=None,
+        skip: int = 0,
+        take: int = 100,
+    ) -> list[dict]:
+        """filters: list of dicts {field, value, match, annotation_key};
+        order: {field, direction}."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.Lookout/GetJobs",
+            pb.LookoutQuery(
+                query_json=json.dumps(
+                    {
+                        "filters": list(filters),
+                        "order": order,
+                        "skip": skip,
+                        "take": take,
+                    }
+                )
+            ),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def group_jobs(self, group_by: str, filters=(), take: int = 100) -> list[dict]:
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.Lookout/GroupJobs",
+            pb.LookoutQuery(
+                query_json=json.dumps(
+                    {"group_by": group_by, "filters": list(filters), "take": take}
+                )
+            ),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def get_job_details(self, job_id: str) -> dict:
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.Lookout/GetJobDetails",
+            pb.QueueGetRequest(name=job_id),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
     # --- events -------------------------------------------------------------
 
     def get_jobset_events(

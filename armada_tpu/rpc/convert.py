@@ -74,7 +74,7 @@ def queue_to_proto(q: QueueRecord) -> pb.Queue:
 def queue_from_proto(msg: pb.Queue) -> QueueRecord:
     return QueueRecord(
         name=msg.name,
-        weight=msg.weight or 1.0,
+        weight=msg.weight,
         cordoned=msg.cordoned,
         owners=tuple(msg.owners),
         groups=tuple(msg.groups),

@@ -45,6 +45,9 @@ def _guard(context, fn):
         context.abort(grpc.StatusCode.NOT_FOUND, f"queue {e} not found")
     except QueueAlreadyExists as e:
         context.abort(grpc.StatusCode.ALREADY_EXISTS, f"queue {e} exists")
+    except ValueError as e:
+        # e.g. queue weight validation in the repository
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
 
 class _SubmitService:
@@ -153,11 +156,15 @@ class _EventService:
 
     def GetJobSetEvents(self, request, context):
         if not request.watch:
-            for item in self._api.get_jobset_events(
-                request.queue, request.jobset, int(request.from_idx)
-            ):
-                yield pb.JobSetEventMessage(idx=item.idx, sequence=item.sequence)
-            return
+            # Page until a short read: jobsets can exceed one batch.
+            idx = int(request.from_idx)
+            while True:
+                batch = self._api.get_jobset_events(request.queue, request.jobset, idx)
+                for item in batch:
+                    yield pb.JobSetEventMessage(idx=item.idx, sequence=item.sequence)
+                if not batch:
+                    return
+                idx = batch[-1].idx + 1
         stop = threading.Event()
         context.add_callback(stop.set)
         idle = request.idle_timeout_s or None
@@ -169,6 +176,57 @@ class _EventService:
             idle_timeout_s=idle,
         ):
             yield pb.JobSetEventMessage(idx=item.idx, sequence=item.sequence)
+
+
+class _LookoutService:
+    """JSON-over-gRPC lookout queries (the reference's REST surface)."""
+
+    def __init__(self, queries):
+        self._queries = queries
+
+    def GetJobs(self, request, context):
+        import json
+
+        from armada_tpu.lookout.queries import JobFilter, JobOrder
+
+        q = json.loads(request.query_json or "{}")
+        filters = [JobFilter(**f) for f in q.get("filters", [])]
+        order = JobOrder(**q["order"]) if q.get("order") else None
+        try:
+            jobs = self._queries.get_jobs(
+                filters,
+                order,
+                skip=int(q.get("skip", 0)),
+                take=int(q.get("take", 100)),
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.JsonResponse(json=json.dumps(jobs))
+
+    def GroupJobs(self, request, context):
+        import json
+
+        from armada_tpu.lookout.queries import JobFilter
+
+        q = json.loads(request.query_json or "{}")
+        filters = [JobFilter(**f) for f in q.get("filters", [])]
+        try:
+            groups = self._queries.group_jobs(
+                q.get("group_by", "state"),
+                filters,
+                take=int(q.get("take", 100)),
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.JsonResponse(json=json.dumps(groups))
+
+    def GetJobDetails(self, request, context):
+        import json
+
+        details = self._queries.get_job_details(request.name)
+        if details is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"job {request.name!r} not found")
+        return pb.JsonResponse(json=json.dumps(details))
 
 
 class _ExecutorApiService:
@@ -206,6 +264,7 @@ def make_server(
     event_api=None,
     executor_api=None,
     factory=None,
+    lookout_queries=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
 ) -> tuple[grpc.Server, int]:
@@ -243,6 +302,18 @@ def make_server(
                     "GetJobSetEvents": _server_stream(
                         esvc.GetJobSetEvents, pb.JobSetEventsRequest
                     ),
+                },
+            )
+        )
+    if lookout_queries is not None:
+        lsvc = _LookoutService(lookout_queries)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.Lookout",
+                {
+                    "GetJobs": _unary(lsvc.GetJobs, pb.LookoutQuery),
+                    "GroupJobs": _unary(lsvc.GroupJobs, pb.LookoutQuery),
+                    "GetJobDetails": _unary(lsvc.GetJobDetails, pb.QueueGetRequest),
                 },
             )
         )

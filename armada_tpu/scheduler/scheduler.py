@@ -39,6 +39,7 @@ from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 from armada_tpu.scheduler.leader import LeaderController, LeaderToken
 from armada_tpu.scheduler.reconciliation import apply_rows
+from armada_tpu.scheduler.submitcheck import SubmitChecker
 
 MAX_RETRIES_EXCEEDED = "maxRetriesExceeded"
 PREEMPTED_REASON = "preempted"
@@ -102,6 +103,7 @@ class Scheduler:
         self.leader = leader
         self.config = config or jobdb.config
         self._clock = clock
+        self.submit_checker = SubmitChecker(self.config)
         # Incremental-fetch cursors (scheduler.go jobsSerial/runsSerial:79-81).
         self._jobs_serial = 0
         self._runs_serial = 0
@@ -398,23 +400,73 @@ class Scheduler:
             )
             txn.upsert(job.with_failed())
 
-    # --- validation (scheduler.go submitCheck:1011; full SubmitChecker TBD) -
+    # --- validation (scheduler.go submitCheck:1011, submitcheck.go Check:181)
 
     def _validate_jobs(
         self, txn: WriteTxn, builder: _SequenceBuilder, now_ns: int
     ) -> None:
-        all_pools = tuple(p.name for p in self.config.pools)
-        for job in txn.unvalidated_jobs():
-            pools = job.spec.pools or all_pools
-            builder.add(
-                job.queue,
-                job.jobset,
-                pb.Event(
-                    created_ns=now_ns,
-                    job_validated=pb.JobValidated(job_id=job.id, pools=pools),
-                ),
-            )
-            txn.upsert(job.with_validated(tuple(pools)))
+        unvalidated = txn.unvalidated_jobs()
+        if not unvalidated:
+            return
+        # Same staleness filter as the scheduling algo: a dead executor's
+        # snapshot must not vouch for (or block) a job's schedulability.
+        timeout_ns = int(self.config.executor_timeout_s * 1e9)
+        live = [
+            ex
+            for ex in self._executors()
+            if now_ns - ex.last_update_ns <= timeout_ns
+        ]
+        self.submit_checker.update_executors(live)
+        if not self.submit_checker.have_executors:
+            # No fleet yet: defer -- nothing can be judged unschedulable
+            # against zero executors, and nothing can lease anyway.
+            return
+
+        # Gangs validate atomically (one check per gang, like the reference
+        # checking whole gangs against mini NodeDbs).
+        gangs: dict = {}
+        for job in unvalidated:
+            key = (job.queue, job.spec.gang_id) if job.spec.gang_id else (job.id, "")
+            gangs.setdefault(key, []).append(job)
+
+        for members in gangs.values():
+            specs = [
+                dataclasses.replace(j.spec, priority=j.priority) for j in members
+            ]
+            result = self.submit_checker.check_gang(specs)
+            if result.ok:
+                for job in members:
+                    builder.add(
+                        job.queue,
+                        job.jobset,
+                        pb.Event(
+                            created_ns=now_ns,
+                            job_validated=pb.JobValidated(
+                                job_id=job.id, pools=result.pools
+                            ),
+                        ),
+                    )
+                    txn.upsert(job.with_validated(result.pools))
+            else:
+                for job in members:
+                    builder.add(
+                        job.queue,
+                        job.jobset,
+                        pb.Event(
+                            created_ns=now_ns,
+                            job_errors=pb.JobErrors(
+                                job_id=job.id,
+                                errors=[
+                                    pb.Error(
+                                        reason="unschedulable",
+                                        message=result.reason,
+                                        terminal=True,
+                                    )
+                                ],
+                            ),
+                        ),
+                    )
+                    txn.upsert(job.with_failed())
 
     # --- executor expiry (scheduler.go expireJobsIfNecessary:929) -----------
 

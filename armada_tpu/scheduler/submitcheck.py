@@ -1,0 +1,161 @@
+"""SubmitChecker: can this job/gang ever schedule anywhere?
+
+Equivalent of the reference's SubmitChecker (internal/scheduler/
+submitcheck.go:44-75,181,243): a static feasibility check run at validation
+time against the current executor fleet, so jobs that can never fit are
+rejected up front with a reason instead of sitting queued forever (or, worse,
+tripping round-termination constraints every cycle -- a pool-sized job would
+otherwise starve everything behind it).
+
+The check per pool mirrors getSchedulingResult/constructNodeDb: for a gang of
+cardinality k with per-member request r, some set of *empty* nodes whose node
+type statically fits (taints/selector) must hold all k members:
+sum_n floor_r(node_total_n / r) >= k over statically-fitting nodes.  Results
+are cached by (scheduling key, cardinality, uniformity label) until the
+executor fleet changes (the reference's LRU keyed on scheduling key,
+submitcheck.go:243).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.keys import (
+    NodeTypeIndex,
+    SchedulingKeyIndex,
+    static_fit_matrix,
+)
+from armada_tpu.core.types import JobSpec
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckResult:
+    ok: bool
+    reason: str = ""
+    # pools where the gang can in principle schedule (feeds JobValidated).
+    pools: tuple[str, ...] = ()
+
+
+class SubmitChecker:
+    """Static schedulability of gangs against the executor fleet."""
+
+    def __init__(self, config: SchedulingConfig):
+        self.config = config
+        self._factory = config.resource_list_factory()
+        # pool -> (node_total f64[N, R], node_labels list[dict], node_taints)
+        self._pools: dict[str, list] = {}
+        self._cache: dict = {}
+        self._have_executors = False
+
+    # --- fleet snapshot (reference: periodic executor refresh) --------------
+
+    def update_executors(self, executors: Sequence[ExecutorSnapshot]) -> None:
+        pools: dict[str, list] = {}
+        for ex in executors:
+            if ex.cordoned:
+                continue
+            for n in ex.nodes:
+                if n.unschedulable or n.total_resources is None:
+                    continue
+                pools.setdefault(n.pool, []).append(n)
+        self._pools = pools
+        self._cache = {}
+        self._have_executors = bool(executors)
+
+    @property
+    def have_executors(self) -> bool:
+        return self._have_executors
+
+    # --- the check (submitcheck.go Check:181) -------------------------------
+
+    def check_gang(self, members: Sequence[JobSpec]) -> CheckResult:
+        """All members share a scheduling shape (validation enforces gang
+        consistency); singleton jobs are gangs of one."""
+        if not members:
+            return CheckResult(False, "empty gang")
+        lead = members[0]
+        # Trust the declared cardinality over the members seen in this batch:
+        # a partially-arrived gang must be judged at full size.
+        cardinality = max(len(members), lead.gang_cardinality or 1)
+
+        kidx = SchedulingKeyIndex()
+        key_id = kidx.key_of(lead, self.config.node_id_label)
+        cache_key = (kidx.keys[key_id], cardinality, tuple(lead.pools))
+        cached = self._cache.get(cache_key)
+        if cached is not None:
+            return cached
+
+        result = self._check_uncached(lead, cardinality)
+        self._cache[cache_key] = result
+        return result
+
+    def _check_uncached(self, lead: JobSpec, cardinality: int) -> CheckResult:
+        req = (
+            np.asarray(lead.resources.atoms, dtype=np.float64)
+            if lead.resources is not None
+            else np.zeros(self._factory.num_resources)
+        )
+        candidate_pools = [
+            p for p in self._pools if not lead.pools or p in lead.pools
+        ]
+        if not candidate_pools:
+            return CheckResult(
+                False,
+                "no executor cluster provides "
+                + (f"pools {list(lead.pools)}" if lead.pools else "any nodes"),
+            )
+
+        ok_pools = []
+        best_reason = "does not fit on any node type"
+        for pool in candidate_pools:
+            nodes = self._pools[pool]
+            ntidx = NodeTypeIndex(
+                set(self.config.indexed_node_labels) | set(lead.node_selector)
+            )
+            type_of_node = [ntidx.type_of(n) for n in nodes]
+            kidx = SchedulingKeyIndex()
+            kidx.key_of(lead, self.config.node_id_label)
+            compat = static_fit_matrix(kidx.keys, ntidx.types)[0]
+
+            members_possible = 0
+            biggest_gap = None
+            for n, tid in zip(nodes, type_of_node):
+                if not compat[tid]:
+                    continue
+                total = np.asarray(n.total_resources.atoms, dtype=np.float64)
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    per_node = np.floor(
+                        np.where(req > 0, total / np.maximum(req, 1e-9), np.inf)
+                    ).min()
+                if per_node <= 0:
+                    gap = np.where(req > total, req - total, 0)
+                    biggest_gap = gap if biggest_gap is None else np.minimum(biggest_gap, gap)
+                    continue
+                members_possible += int(per_node)
+                if members_possible >= cardinality:
+                    break
+            if members_possible >= cardinality:
+                ok_pools.append(pool)
+            elif members_possible > 0:
+                best_reason = (
+                    f"pool {pool}: only {members_possible} of {cardinality} "
+                    "gang members fit on empty nodes"
+                )
+            elif biggest_gap is not None:
+                over = {
+                    self._factory.names[i]: int(biggest_gap[i])
+                    for i in range(len(biggest_gap))
+                    if biggest_gap[i] > 0
+                }
+                best_reason = (
+                    f"pool {pool}: request exceeds every node's capacity by {over}"
+                )
+
+        if ok_pools:
+            return CheckResult(True, pools=tuple(sorted(ok_pools)))
+        return CheckResult(False, best_reason)

@@ -75,10 +75,14 @@ class EventDb:
             cur = self._conn.cursor()
             try:
                 for queue, jobset, created_ns, payload in batch:
+                    # Seed from existing rows so a store predating the cursor
+                    # table resumes past them instead of colliding at idx 0.
                     cur.execute(
                         "INSERT INTO stream_cursors (queue, jobset, next_idx) "
-                        "VALUES (?, ?, 0) ON CONFLICT(queue, jobset) DO NOTHING",
-                        (queue, jobset),
+                        "SELECT ?, ?, COALESCE(MAX(idx), -1) + 1 FROM jobset_events "
+                        "WHERE queue = ? AND jobset = ? "
+                        "ON CONFLICT(queue, jobset) DO NOTHING",
+                        (queue, jobset, queue, jobset),
                     )
                     row = cur.execute(
                         "SELECT next_idx FROM stream_cursors "

@@ -1,0 +1,157 @@
+"""Lookout tests: ingestion state machine, filter/group/order queries,
+pruning, and the wire surface via armadactl jobs.
+
+Modeled on the reference's lookout repository tests
+(internal/lookout/repository/getjobs_test.go, groupjobs_test.go) and
+lookoutingester instruction tests.
+"""
+
+import pytest
+
+from armada_tpu.ingest.pipeline import IngestionPipeline
+from armada_tpu.lookout import (
+    JobFilter,
+    JobOrder,
+    LookoutDb,
+    LookoutQueries,
+    lookout_converter,
+)
+from armada_tpu.server import JobSubmitItem, QueueRecord
+from tests.control_plane import ControlPlane
+
+
+@pytest.fixture
+def cp(tmp_path):
+    plane = ControlPlane.build(tmp_path)
+    plane.server.create_queue(QueueRecord("qa", weight=2.0))
+    plane.server.create_queue(QueueRecord("qb"))
+    # attach a lookout pipeline to the plane's log
+    plane.lookoutdb = LookoutDb(":memory:")
+    plane.lookout_pipeline = IngestionPipeline(
+        plane.log, plane.lookoutdb, lookout_converter, consumer_name="lookout"
+    )
+    plane.queries = LookoutQueries(plane.lookoutdb)
+    yield plane
+    plane.lookoutdb.close()
+    plane.close()
+
+
+def lk(cp):
+    cp.lookout_pipeline.run_until_caught_up()
+    return cp.queries
+
+
+def item(cpu="2", **kw):
+    return JobSubmitItem(resources={"cpu": cpu, "memory": "2"}, **kw)
+
+
+def test_lifecycle_states_materialize(cp):
+    ids = cp.server.submit_jobs(
+        "qa", "js1", [item(annotations={"team": "ml", "run": "7"})]
+    )
+    q = lk(cp)
+    (row,) = q.get_jobs()
+    assert row["state"] == "QUEUED"
+    assert row["annotations"] == {"team": "ml", "run": "7"}
+    assert row["cpu_milli"] == 2000
+
+    cp.run_until(lambda: cp.job_states().get(ids[0]) == "succeeded", tick_s=3.0)
+    q = lk(cp)
+    (row,) = q.get_jobs()
+    assert row["state"] == "SUCCEEDED"
+    assert row["node"] != ""
+
+    details = q.get_job_details(ids[0])
+    assert details is not None
+    (run,) = details["runs"]
+    assert run["state"] == "SUCCEEDED"
+    assert run["leased_ns"] <= run["started_ns"] <= run["finished_ns"]
+
+
+def test_cancel_and_failure_states(cp):
+    ids = cp.server.submit_jobs("qa", "js2", [item(), item(cpu="999")])
+    cp.run_until(lambda: cp.job_states().get(ids[0]) == "leased")
+    cp.server.cancel_jobs("qa", "js2", [ids[0]])
+    cp.run_until(lambda: cp.job_states().get(ids[0]) == "cancelled")
+    q = lk(cp)
+    by_id = {j["job_id"]: j for j in q.get_jobs()}
+    assert by_id[ids[0]]["state"] == "CANCELLED"
+    # unschedulably large: rejected by the submit checker with a reason
+    assert by_id[ids[1]]["state"] == "FAILED"
+    assert "unschedulable" in by_id[ids[1]]["error"]
+
+
+def test_filters_order_pagination(cp):
+    cp.server.submit_jobs("qa", "alpha", [item() for _ in range(3)])
+    cp.server.submit_jobs("qb", "beta", [item() for _ in range(2)])
+    q = lk(cp)
+
+    assert q.count_jobs() == 5
+    assert q.count_jobs([JobFilter("queue", "qa")]) == 3
+    assert q.count_jobs([JobFilter("jobset", "bet", match="startsWith")]) == 2
+    assert q.count_jobs([JobFilter("queue", ["qa", "qb"], match="in")]) == 5
+    assert q.count_jobs([JobFilter("queue", "qa", match="notEqual")]) == 2
+
+    page1 = q.get_jobs(order=JobOrder("job_id"), take=3)
+    page2 = q.get_jobs(order=JobOrder("job_id"), skip=3, take=3)
+    assert len(page1) == 3 and len(page2) == 2
+    all_ids = [j["job_id"] for j in page1 + page2]
+    assert all_ids == sorted(all_ids)
+
+    desc = q.get_jobs(order=JobOrder("job_id", "DESC"), take=5)
+    assert [j["job_id"] for j in desc] == sorted(all_ids, reverse=True)
+
+    with pytest.raises(ValueError):
+        q.get_jobs([JobFilter("password", "x")])
+
+
+def test_group_jobs(cp):
+    cp.server.submit_jobs("qa", "g1", [item() for _ in range(3)])
+    cp.server.submit_jobs("qb", "g2", [item() for _ in range(1)])
+    q = lk(cp)
+    groups = q.group_jobs("queue")
+    assert groups[0]["group"] == "qa" and groups[0]["count"] == 3
+    assert groups[0]["states"]["QUEUED"] == 3
+    groups = q.group_jobs("state")
+    assert groups[0]["group"] == "QUEUED" and groups[0]["count"] == 4
+
+
+def test_annotation_filter(cp):
+    cp.server.submit_jobs("qa", "ann", [item(annotations={"team": "ml"})])
+    cp.server.submit_jobs("qa", "ann", [item(annotations={"team": "infra"})])
+    q = lk(cp)
+    rows = q.get_jobs([JobFilter("annotation", "ml", annotation_key="team")])
+    assert len(rows) == 1 and rows[0]["annotations"]["team"] == "ml"
+
+
+def test_prune_terminal_jobs(cp):
+    ids = cp.server.submit_jobs("qa", "old", [item()])
+    cp.run_until(lambda: cp.job_states().get(ids[0]) == "succeeded", tick_s=3.0)
+    q = lk(cp)
+    (row,) = q.get_jobs()
+    now_ns = row["last_transition_ns"]
+    assert cp.lookoutdb.prune(now_ns + int(10e9), keep_terminal_s=60.0) == 0
+    assert cp.lookoutdb.prune(now_ns + int(120e9), keep_terminal_s=60.0) == 1
+    assert q.get_jobs() == []
+    assert q.get_job_details(ids[0]) is None
+
+
+def test_jobs_cli_over_wire(cp, capsys):
+    from armada_tpu.cli.armadactl import main
+    from armada_tpu.rpc.server import make_server
+
+    ids = cp.server.submit_jobs("qa", "cli", [item(), item()])
+    lk(cp)
+    server, port = make_server(lookout_queries=cp.queries)
+    try:
+        assert main(["--url", f"127.0.0.1:{port}", "jobs", "--queue", "qa"]) == 0
+        out = capsys.readouterr().out
+        assert ids[0] in out and "QUEUED" in out
+        assert main(["--url", f"127.0.0.1:{port}", "jobs", "--group-by", "state"]) == 0
+        out = capsys.readouterr().out
+        assert "QUEUED" in out and "2" in out
+        assert main(["--url", f"127.0.0.1:{port}", "describe-job", ids[0]]) == 0
+        out = capsys.readouterr().out
+        assert "state: QUEUED" in out
+    finally:
+        server.stop(None)

@@ -1,0 +1,153 @@
+"""SubmitChecker tests: static schedulability at validation time.
+
+Modeled on the reference's submitcheck tests (internal/scheduler/
+submitcheck_test.go): jobs/gangs that can never fit are rejected with a
+reason; feasible ones validate with the pools they fit in.
+"""
+
+import pytest
+
+from armada_tpu.core.config import PoolConfig, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Taint, Toleration
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+from armada_tpu.scheduler.submitcheck import SubmitChecker
+
+CFG = SchedulingConfig(
+    shape_bucket=32,
+    pools=(PoolConfig("cpu-pool"), PoolConfig("gpu-pool")),
+)
+F = CFG.resource_list_factory()
+
+
+def snapshot(ex_id="ex1", pool="cpu-pool", num=2, cpu="8", mem="32", taints=(), labels=None):
+    nodes = tuple(
+        NodeSpec(
+            id=f"{ex_id}-n{i}",
+            pool=pool,
+            executor=ex_id,
+            total_resources=F.from_mapping({"cpu": cpu, "memory": mem}),
+            taints=tuple(taints),
+            labels=labels or {},
+        )
+        for i in range(num)
+    )
+    return ExecutorSnapshot(id=ex_id, pool=pool, nodes=nodes, last_update_ns=1)
+
+
+def job(cpu="2", mem="2", **kw):
+    return JobSpec(
+        id=kw.pop("id", "j1"),
+        queue="q",
+        resources=F.from_mapping({"cpu": cpu, "memory": mem}),
+        **kw,
+    )
+
+
+@pytest.fixture
+def checker():
+    c = SubmitChecker(CFG)
+    c.update_executors([snapshot()])
+    return c
+
+
+def test_feasible_job_passes_with_pools(checker):
+    res = checker.check_gang([job()])
+    assert res.ok and res.pools == ("cpu-pool",)
+
+
+def test_oversized_job_rejected_with_gap(checker):
+    res = checker.check_gang([job(cpu="999")])
+    assert not res.ok
+    assert "exceeds every node's capacity" in res.reason
+    assert "cpu" in res.reason
+
+
+def test_gang_larger_than_fleet_rejected(checker):
+    members = [job(cpu="4", id=f"g{i}") for i in range(5)]  # fleet fits 4
+    res = checker.check_gang(members)
+    assert not res.ok
+    assert "4 of 5" in res.reason
+
+
+def test_gang_that_fits_passes(checker):
+    members = [job(cpu="4", id=f"g{i}") for i in range(4)]
+    assert checker.check_gang(members).ok
+
+
+def test_selector_mismatch_rejected(checker):
+    res = checker.check_gang([job(node_selector={"zone": "mars"})])
+    assert not res.ok
+
+
+def test_selector_match_and_taints():
+    c = SubmitChecker(CFG)
+    c.update_executors(
+        [
+            snapshot(
+                taints=(Taint("dedicated", "ml", "NoSchedule"),),
+                labels={"zone": "east"},
+            )
+        ]
+    )
+    # intolerant job blocked by the taint
+    assert not c.check_gang([job()]).ok
+    # tolerating + matching selector passes
+    ok = c.check_gang(
+        [
+            job(
+                tolerations=(Toleration("dedicated", "Equal", "ml", "NoSchedule"),),
+                node_selector={"zone": "east"},
+            )
+        ]
+    )
+    assert ok.ok
+
+
+def test_requested_pool_must_exist(checker):
+    res = checker.check_gang([job(pools=("gpu-pool",))])
+    assert not res.ok and "gpu-pool" in res.reason
+
+
+def test_multi_pool_fleet_reports_fitting_pools():
+    c = SubmitChecker(CFG)
+    c.update_executors(
+        [snapshot("ex1", "cpu-pool"), snapshot("ex2", "gpu-pool", cpu="16")]
+    )
+    res = c.check_gang([job(cpu="12")])
+    assert res.ok and res.pools == ("gpu-pool",)
+    res = c.check_gang([job(cpu="2")])
+    assert res.pools == ("cpu-pool", "gpu-pool")
+
+
+def test_cache_invalidated_on_fleet_change():
+    c = SubmitChecker(CFG)
+    c.update_executors([snapshot(cpu="8")])
+    assert not c.check_gang([job(cpu="12")]).ok
+    c.update_executors([snapshot(cpu="16")])
+    assert c.check_gang([job(cpu="12")]).ok
+
+
+def test_scheduler_rejects_unschedulable_at_validation(tmp_path):
+    """End-to-end: an impossible job fails fast instead of starving the
+    queue behind a permanently-tripped round cap."""
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+    from tests.control_plane import ControlPlane
+
+    cp = ControlPlane.build(tmp_path)
+    cp.server.create_queue(QueueRecord("q"))
+    for ex in cp.executors:
+        ex.run_once()
+    big = cp.server.submit_jobs(
+        "q", "mix", [JobSubmitItem(resources={"cpu": "999", "memory": "1"})]
+    )
+    small = cp.server.submit_jobs(
+        "q", "mix", [JobSubmitItem(resources={"cpu": "2", "memory": "1"}) for _ in range(4)]
+    )
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    states = cp.job_states()
+    assert states[big[0]] == "failed"
+    # every small job leased in the same cycle -- no starvation
+    assert all(states[j] == "leased" for j in small)
+    cp.close()
