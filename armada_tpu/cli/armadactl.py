@@ -226,7 +226,13 @@ def cmd_jobs(args):
     if args.job_set:
         filters.append({"field": "jobset", "value": args.job_set})
     if args.state:
-        filters.append({"field": "state", "value": args.state, "match": "in"})
+        filters.append(
+            {
+                "field": "state",
+                "value": [s.upper() for s in args.state],
+                "match": "in",
+            }
+        )
     if args.annotation:
         for pair in args.annotation:
             k, _, v = pair.partition("=")
@@ -271,6 +277,35 @@ def cmd_describe_job(args):
     return 0
 
 
+def cmd_report(args):
+    def go(c):
+        if args.job_id:
+            r = c.get_job_report(args.job_id)
+            for k, v in r.items():
+                print(f"{k}: {v}")
+        elif args.queue:
+            for r in c.get_queue_report(args.queue):
+                print(
+                    f"pool={r['pool']} actual={r['actual_share']:.4f} "
+                    f"fair={r['fair_share']:.4f} adjusted={r['adjusted_fair_share']:.4f} "
+                    f"demand={r['demand_share']:.4f} weight={r['weight']}"
+                )
+        else:
+            for pool, r in c.get_pool_report(args.pool or "").items():
+                if not r:
+                    print(f"{pool}: no rounds recorded")
+                    continue
+                print(
+                    f"{pool}: nodes={r['num_nodes']} queued={r['num_queued']} "
+                    f"running={r['num_running']} scheduled={r['scheduled']} "
+                    f"preempted={r['preempted']} failed={r['failed']} "
+                    f"iterations={r['iterations']} termination={r['termination']}"
+                )
+
+    with_closed(_client(args), go)
+    return 0
+
+
 def cmd_serve(args):
     from armada_tpu.cli.serve import start_control_plane
 
@@ -280,6 +315,7 @@ def cmd_serve(args):
         cycle_interval_s=args.cycle_interval,
         schedule_interval_s=args.schedule_interval,
         leader_id=args.leader_id,
+        metrics_port=args.metrics_port,
     )
     print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
     print(f"state in {args.data_dir}")
@@ -409,7 +445,14 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--cycle-interval", type=float, default=1.0)
     srv.add_argument("--schedule-interval", type=float, default=5.0)
     srv.add_argument("--leader-id", help="enable file-lease leader election")
+    srv.add_argument("--metrics-port", type=int, help="expose prometheus metrics")
     srv.set_defaults(fn=cmd_serve)
+
+    rep = sub.add_parser("scheduling-report", help="why (not) scheduled forensics")
+    rep.add_argument("--job-id")
+    rep.add_argument("--queue")
+    rep.add_argument("--pool")
+    rep.set_defaults(fn=cmd_report)
 
     ex = sub.add_parser("executor", help="run a fake-cluster executor agent")
     ex.add_argument("--id", default="fake-1")
@@ -427,5 +470,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    import grpc
+
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except grpc.RpcError as e:
+        code = e.code().name if hasattr(e, "code") else "UNKNOWN"
+        details = e.details() if hasattr(e, "details") else str(e)
+        print(f"error ({code}): {details}", file=sys.stderr)
+        return 1

@@ -56,6 +56,7 @@ class ControlPlaneProcess:
     _db: SchedulerDb
     _eventdb: EventDb
     _lookoutdb: LookoutDb
+    _metrics_server: object = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -63,6 +64,14 @@ class ControlPlaneProcess:
         for p in self._pipelines:
             p.stop()
         self._grpc_server.stop(1).wait()
+        if self._metrics_server is not None:
+            # prometheus_client >= 0.17 returns (server, thread)
+            try:
+                server, thread = self._metrics_server
+                server.shutdown()
+                thread.join(timeout=5)
+            except (TypeError, ValueError):
+                pass
         self._db.close()
         self._eventdb.close()
         self._lookoutdb.close()
@@ -80,6 +89,7 @@ def start_control_plane(
     schedule_interval_s: float = 5.0,
     leader_id: Optional[str] = None,
     num_partitions: int = 4,
+    metrics_port: Optional[int] = None,
 ) -> ControlPlaneProcess:
     os.makedirs(data_dir, exist_ok=True)
     config = config or SchedulingConfig()
@@ -122,6 +132,20 @@ def start_control_plane(
         if leader_id
         else StandaloneLeaderController()
     )
+    from armada_tpu.scheduler.metrics import SchedulerMetrics
+    from armada_tpu.scheduler.reports import SchedulingReportsRepository
+
+    reports = SchedulingReportsRepository()
+    metrics = None
+    metrics_server = None
+    if metrics_port is not None:
+        from prometheus_client import CollectorRegistry, start_http_server
+
+        # Own registry: a restarted plane in the same process must not
+        # collide with the previous instance's collectors on the global one.
+        registry = CollectorRegistry()
+        metrics_server = start_http_server(metrics_port, registry=registry)
+        metrics = SchedulerMetrics(registry=registry)
     scheduler = Scheduler(
         db,
         jobdb,
@@ -133,6 +157,8 @@ def start_control_plane(
         publisher,
         leader,
         config,
+        metrics=metrics,
+        reports=reports,
     )
     executor_api = ExecutorApi(db, publisher, factory)
 
@@ -144,6 +170,7 @@ def start_control_plane(
         executor_api=executor_api,
         factory=factory,
         lookout_queries=LookoutQueries(lookoutdb),
+        reports=reports,
         address=f"127.0.0.1:{port}",
     )
 
@@ -180,6 +207,7 @@ def start_control_plane(
         _db=db,
         _eventdb=eventdb,
         _lookoutdb=lookoutdb,
+        _metrics_server=metrics_server,
     )
 
 

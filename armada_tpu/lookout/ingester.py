@@ -168,21 +168,13 @@ def lookout_converter(sequences) -> list[dict]:
                 )
             elif kind == "job_run_errors":
                 e = ev.job_run_errors
-                terminal = [err for err in e.errors if err.terminal]
-                msg = "; ".join(
-                    f"{err.reason}: {err.message}" for err in e.errors
+                run_over = any(
+                    err.terminal or err.lease_returned for err in e.errors
                 )
-                if terminal:
-                    ops.append(
-                        {
-                            "kind": "run_state",
-                            "run_id": e.run_id,
-                            "state": "FAILED",
-                            "ts": ts,
-                            "error": msg,
-                        }
+                if run_over:
+                    msg = "; ".join(
+                        f"{err.reason}: {err.message}" for err in e.errors
                     )
-                elif any(err.lease_returned for err in e.errors):
                     ops.append(
                         {
                             "kind": "run_state",

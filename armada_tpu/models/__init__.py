@@ -30,6 +30,8 @@ def run_scheduling_round(
     one pool (scheduling_algo.go SchedulePool:574)."""
     import jax.numpy as jnp
 
+    from armada_tpu.models.problem import queue_stats_from_result
+
     problem, ctx = build_problem(
         config,
         pool=pool,
@@ -45,7 +47,9 @@ def run_scheduling_round(
         max_slots=ctx.max_slots,
         slot_width=ctx.slot_width,
     )
-    return decode_result(result, ctx)
+    outcome = decode_result(result, ctx)
+    outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
+    return outcome
 
 
 __all__ = [

@@ -120,6 +120,10 @@ class RoundOutcome:
     failed: list  # job ids attempted and unschedulable this round
     num_iterations: int
     termination: str
+    # queue name -> {weight, fair_share, adjusted_fair_share, actual_share,
+    # demand_share} (feeds cycle metrics + reports; the reference's
+    # QueueSchedulingContext numbers, cycle_metrics.go:71-170).
+    queue_stats: dict = dataclasses.field(default_factory=dict)
 
 
 def _pad(n: int, bucket: int) -> int:
@@ -460,6 +464,33 @@ def build_problem(
 
 
 _TERMINATIONS = ["exhausted", "global_burst", "round_resource_cap", "max_iterations"]
+
+
+def queue_stats_from_result(result, problem: SchedulingProblem, ctx: HostContext) -> dict:
+    """Per-queue share numbers from the final round state (fair shares are
+    recomputed host-side from the same inputs the kernel used)."""
+    from armada_tpu.ops.fairness import fair_shares, unweighted_drf_cost
+
+    Q = int(problem.q_weight.shape[0])
+    shares = fair_shares(np.asarray(problem.q_weight), np.asarray(problem.q_cds))
+    actual = unweighted_drf_cost(
+        np.asarray(result.q_alloc),
+        np.asarray(problem.total_pool),
+        np.asarray(problem.drf_mult),
+    )
+    fs = np.asarray(shares.fair_share)
+    afs = np.asarray(shares.demand_capped_adjusted_fair_share)
+    actual = np.asarray(actual)
+    out = {}
+    for qi in range(ctx.num_real_queues):
+        out[ctx.queue_names[qi]] = {
+            "weight": float(problem.q_weight[qi]),
+            "fair_share": float(fs[qi]),
+            "adjusted_fair_share": float(afs[qi]),
+            "actual_share": float(actual[qi]),
+            "demand_share": float(problem.q_cds[qi]),
+        }
+    return out
 
 
 def decode_result(result, ctx: HostContext) -> RoundOutcome:

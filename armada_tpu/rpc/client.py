@@ -208,6 +208,38 @@ class ArmadaClient(_Base):
         )
         return json.loads(resp.json)
 
+    # --- scheduling reports -------------------------------------------------
+
+    def get_job_report(self, job_id: str) -> dict:
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.Reports/GetJobReport",
+            pb.QueueGetRequest(name=job_id),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def get_queue_report(self, queue: str) -> list[dict]:
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.Reports/GetQueueReport",
+            pb.QueueGetRequest(name=queue),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def get_pool_report(self, pool: str = "") -> dict:
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.Reports/GetPoolReport",
+            pb.QueueGetRequest(name=pool),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
     # --- events -------------------------------------------------------------
 
     def get_jobset_events(

@@ -189,17 +189,17 @@ class _LookoutService:
 
         from armada_tpu.lookout.queries import JobFilter, JobOrder
 
-        q = json.loads(request.query_json or "{}")
-        filters = [JobFilter(**f) for f in q.get("filters", [])]
-        order = JobOrder(**q["order"]) if q.get("order") else None
         try:
+            q = json.loads(request.query_json or "{}")
+            filters = [JobFilter(**f) for f in q.get("filters", [])]
+            order = JobOrder(**q["order"]) if q.get("order") else None
             jobs = self._queries.get_jobs(
                 filters,
                 order,
                 skip=int(q.get("skip", 0)),
                 take=int(q.get("take", 100)),
             )
-        except ValueError as e:
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.JsonResponse(json=json.dumps(jobs))
 
@@ -208,15 +208,15 @@ class _LookoutService:
 
         from armada_tpu.lookout.queries import JobFilter
 
-        q = json.loads(request.query_json or "{}")
-        filters = [JobFilter(**f) for f in q.get("filters", [])]
         try:
+            q = json.loads(request.query_json or "{}")
+            filters = [JobFilter(**f) for f in q.get("filters", [])]
             groups = self._queries.group_jobs(
                 q.get("group_by", "state"),
                 filters,
                 take=int(q.get("take", 100)),
             )
-        except ValueError as e:
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.JsonResponse(json=json.dumps(groups))
 
@@ -227,6 +227,35 @@ class _LookoutService:
         if details is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"job {request.name!r} not found")
         return pb.JsonResponse(json=json.dumps(details))
+
+
+class _ReportsService:
+    """SchedulingReports (internal/scheduler/reports/server.go) as JSON."""
+
+    def __init__(self, reports):
+        self._reports = reports
+
+    def GetJobReport(self, request, context):
+        import json
+
+        report = self._reports.job_report(request.name)
+        if report is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"no report for job {request.name!r}"
+            )
+        return pb.JsonResponse(json=json.dumps(report))
+
+    def GetQueueReport(self, request, context):
+        import json
+
+        return pb.JsonResponse(json=json.dumps(self._reports.queue_report(request.name)))
+
+    def GetPoolReport(self, request, context):
+        import json
+
+        return pb.JsonResponse(
+            json=json.dumps(self._reports.pool_report(request.name or None))
+        )
 
 
 class _ExecutorApiService:
@@ -265,6 +294,7 @@ def make_server(
     executor_api=None,
     factory=None,
     lookout_queries=None,
+    reports=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
 ) -> tuple[grpc.Server, int]:
@@ -314,6 +344,18 @@ def make_server(
                     "GetJobs": _unary(lsvc.GetJobs, pb.LookoutQuery),
                     "GroupJobs": _unary(lsvc.GroupJobs, pb.LookoutQuery),
                     "GetJobDetails": _unary(lsvc.GetJobDetails, pb.QueueGetRequest),
+                },
+            )
+        )
+    if reports is not None:
+        rsvc = _ReportsService(reports)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.Reports",
+                {
+                    "GetJobReport": _unary(rsvc.GetJobReport, pb.QueueGetRequest),
+                    "GetQueueReport": _unary(rsvc.GetQueueReport, pb.QueueGetRequest),
+                    "GetPoolReport": _unary(rsvc.GetPoolReport, pb.QueueGetRequest),
                 },
             )
         )

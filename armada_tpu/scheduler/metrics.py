@@ -1,0 +1,130 @@
+"""Prometheus metrics for the scheduler.
+
+Equivalent of the reference's cycle + state metrics
+(internal/scheduler/metrics/cycle_metrics.go:71-170, state_metrics.go), with
+the same metric names where the concept carries over, so dashboards written
+for the reference read against this framework:
+
+  armada_scheduler_fair_share{pool,queue}
+  armada_scheduler_adjusted_fair_share{pool,queue}
+  armada_scheduler_actual_share{pool,queue}
+  armada_scheduler_demand{pool,queue}
+  armada_scheduler_queue_weight{pool,queue}
+  armada_scheduler_fairness_error{pool}
+  armada_scheduler_scheduled_jobs{pool,queue}        (counter)
+  armada_scheduler_premptied_jobs{pool,queue}        (counter; [sic] the
+      reference's historical spelling is kept for dashboard compatibility)
+  armada_scheduler_schedule_cycle_times (histogram)
+  armada_scheduler_reconcile_cycle_times (histogram)
+  armada_scheduler_job_state_counter_by_queue{queue,state} (counter)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import Counter, Gauge, Histogram, REGISTRY
+
+
+class SchedulerMetrics:
+    def __init__(self, registry=REGISTRY):
+        g = lambda name, doc, labels: Gauge(  # noqa: E731
+            name, doc, labels, registry=registry
+        )
+        self.fair_share = g(
+            "armada_scheduler_fair_share", "Fair share of each queue", ["pool", "queue"]
+        )
+        self.adjusted_fair_share = g(
+            "armada_scheduler_adjusted_fair_share",
+            "Adjusted fair share of each queue",
+            ["pool", "queue"],
+        )
+        self.actual_share = g(
+            "armada_scheduler_actual_share", "Actual share of each queue", ["pool", "queue"]
+        )
+        self.demand = g(
+            "armada_scheduler_demand", "Demand share of each queue", ["pool", "queue"]
+        )
+        self.queue_weight = g(
+            "armada_scheduler_queue_weight", "Weight of each queue", ["pool", "queue"]
+        )
+        self.fairness_error = g(
+            "armada_scheduler_fairness_error",
+            "Cumulative delta between adjusted fair share and actual share",
+            ["pool"],
+        )
+        self.scheduled_jobs = Counter(
+            "armada_scheduler_scheduled_jobs",
+            "Number of jobs scheduled",
+            ["pool", "queue"],
+            registry=registry,
+        )
+        self.preempted_jobs = Counter(
+            "armada_scheduler_premptied_jobs",
+            "Number of jobs preempted",
+            ["pool", "queue"],
+            registry=registry,
+        )
+        self.schedule_cycle_time = Histogram(
+            "armada_scheduler_schedule_cycle_times",
+            "Cycle time when scheduling",
+            registry=registry,
+            buckets=[0.01, 0.05, 0.1, 0.5, 1, 2, 5, 10, 30],
+        )
+        self.reconcile_cycle_time = Histogram(
+            "armada_scheduler_reconcile_cycle_times",
+            "Cycle time when reconciling state only",
+            registry=registry,
+            buckets=[0.001, 0.01, 0.05, 0.1, 0.5, 1, 5],
+        )
+        self.job_state_counter = Counter(
+            "armada_scheduler_job_state_counter_by_queue",
+            "Job state transitions observed",
+            ["queue", "state"],
+            registry=registry,
+        )
+
+    # --- hooks called by the Scheduler --------------------------------------
+
+    def observe_cycle(self, result, duration_s: float) -> None:
+        """`result` is a CycleResult; records cycle time + decisions + shares."""
+        if result.scheduled:
+            self.schedule_cycle_time.observe(duration_s)
+        else:
+            self.reconcile_cycle_time.observe(duration_s)
+
+        for seq in result.published:
+            for ev in seq.events:
+                kind = ev.WhichOneof("event")
+                state = {
+                    "submit_job": "queued",
+                    "job_run_leased": "leased",
+                    "job_run_running": "running",
+                    "job_succeeded": "succeeded",
+                    "job_errors": "failed",
+                    "cancelled_job": "cancelled",
+                    "job_run_preempted": "preempted",
+                    "job_requeued": "requeued",
+                }.get(kind)
+                if state:
+                    self.job_state_counter.labels(seq.queue, state).inc()
+
+        sched = result.scheduler_result
+        if sched is None:
+            return
+        for job, run in sched.scheduled:
+            self.scheduled_jobs.labels(run.pool, job.queue).inc()
+        for job, run in sched.preempted:
+            self.preempted_jobs.labels(run.pool or "", job.queue).inc()
+        for stats in sched.pools:
+            error = 0.0
+            for qname, qs in stats.outcome.queue_stats.items():
+                self.fair_share.labels(stats.pool, qname).set(qs["fair_share"])
+                self.adjusted_fair_share.labels(stats.pool, qname).set(
+                    qs["adjusted_fair_share"]
+                )
+                self.actual_share.labels(stats.pool, qname).set(qs["actual_share"])
+                self.demand.labels(stats.pool, qname).set(qs["demand_share"])
+                self.queue_weight.labels(stats.pool, qname).set(qs["weight"])
+                error += abs(qs["adjusted_fair_share"] - qs["actual_share"])
+            self.fairness_error.labels(stats.pool).set(error)

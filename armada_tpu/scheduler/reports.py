@@ -1,0 +1,109 @@
+"""Scheduling reports: "why (was | wasn't) my job scheduled?" forensics.
+
+Equivalent of the reference's scheduling-context reports
+(internal/scheduler/reports: repository.go keeps the most recent round's
+SchedulingContext per queue and per job; server.go serves them over gRPC;
+armadactl surfaces them).  After every scheduling cycle the repository
+records, per pool: round stats + per-queue shares, and per job: what happened
+to it (scheduled where / failed why / preempted), in bounded LRU caches.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+
+class SchedulingReportsRepository:
+    def __init__(self, max_job_reports: int = 10_000):
+        self._lock = threading.Lock()
+        self._queue_reports: dict[tuple[str, str], dict] = {}  # (pool, queue)
+        self._pool_reports: dict[str, dict] = {}
+        self._job_reports: collections.OrderedDict[str, dict] = collections.OrderedDict()
+        self._max_jobs = max_job_reports
+
+    # --- recording (called by the Scheduler after algo.schedule) ------------
+
+    def record_cycle(self, scheduler_result, now: Optional[float] = None) -> None:
+        now = now or time.time()
+        with self._lock:
+            for job, run in scheduler_result.scheduled:
+                self._put_job(
+                    job.id,
+                    {
+                        "time": now,
+                        "outcome": "scheduled",
+                        "node": run.node_id,
+                        "executor": run.executor,
+                        "pool": run.pool,
+                        "queue": job.queue,
+                    },
+                )
+            for job, run in scheduler_result.preempted:
+                self._put_job(
+                    job.id,
+                    {
+                        "time": now,
+                        "outcome": "preempted",
+                        "node": run.node_id,
+                        "queue": job.queue,
+                        "reason": "fair-share or oversubscription eviction",
+                    },
+                )
+            for stats in scheduler_result.pools:
+                o = stats.outcome
+                for job_id in o.failed:
+                    self._put_job(
+                        job_id,
+                        {
+                            "time": now,
+                            "outcome": "failed",
+                            "pool": stats.pool,
+                            "reason": "no node with sufficient free capacity "
+                            "matched the job's scheduling key this round",
+                        },
+                    )
+                self._pool_reports[stats.pool] = {
+                    "time": now,
+                    "num_nodes": stats.num_nodes,
+                    "num_queued": stats.num_queued,
+                    "num_running": stats.num_running,
+                    "scheduled": len(o.scheduled),
+                    "preempted": len(o.preempted),
+                    "failed": len(o.failed),
+                    "iterations": o.num_iterations,
+                    "termination": o.termination,
+                }
+                for qname, qs in o.queue_stats.items():
+                    self._queue_reports[(stats.pool, qname)] = {
+                        "time": now,
+                        "pool": stats.pool,
+                        "queue": qname,
+                        **qs,
+                    }
+
+    def _put_job(self, job_id: str, report: dict) -> None:
+        self._job_reports[job_id] = report
+        self._job_reports.move_to_end(job_id)
+        while len(self._job_reports) > self._max_jobs:
+            self._job_reports.popitem(last=False)
+
+    # --- queries (reports/server.go) ----------------------------------------
+
+    def job_report(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._job_reports.get(job_id)
+
+    def queue_report(self, queue: str) -> list[dict]:
+        with self._lock:
+            return [
+                r for (p, q), r in self._queue_reports.items() if q == queue
+            ]
+
+    def pool_report(self, pool: Optional[str] = None) -> dict:
+        with self._lock:
+            if pool is not None:
+                return {pool: self._pool_reports.get(pool, {})}
+            return dict(self._pool_reports)

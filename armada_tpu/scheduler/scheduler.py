@@ -95,6 +95,8 @@ class Scheduler:
         leader: LeaderController,
         config: Optional[SchedulingConfig] = None,
         clock: Callable[[], float] = time.time,
+        metrics=None,
+        reports=None,
     ):
         self.db = db
         self.jobdb = jobdb
@@ -104,6 +106,10 @@ class Scheduler:
         self.config = config or jobdb.config
         self._clock = clock
         self.submit_checker = SubmitChecker(self.config)
+        # Optional observability hooks (SchedulerMetrics /
+        # SchedulingReportsRepository); None = disabled.
+        self.metrics = metrics
+        self.reports = reports
         # Incremental-fetch cursors (scheduler.go jobsSerial/runsSerial:79-81).
         self._jobs_serial = 0
         self._runs_serial = 0
@@ -163,6 +169,16 @@ class Scheduler:
     # --- the cycle (scheduler.go cycle:246) ---------------------------------
 
     def cycle(self, schedule: bool = True) -> CycleResult:
+        start = time.monotonic()
+        result = self._cycle(schedule)
+        duration = time.monotonic() - start
+        if self.metrics is not None:
+            self.metrics.observe_cycle(result, duration)
+        if self.reports is not None and result.scheduler_result is not None:
+            self.reports.record_cycle(result.scheduler_result, now=self._clock())
+        return result
+
+    def _cycle(self, schedule: bool = True) -> CycleResult:
         result = CycleResult()
         txn = self.jobdb.write_txn()
         try:

@@ -50,6 +50,7 @@ class SubmitChecker:
         # pool -> (node_total f64[N, R], node_labels list[dict], node_taints)
         self._pools: dict[str, list] = {}
         self._cache: dict = {}
+        self._fingerprint = None
         self._have_executors = False
 
     # --- fleet snapshot (reference: periodic executor refresh) --------------
@@ -63,8 +64,25 @@ class SubmitChecker:
                 if n.unschedulable or n.total_resources is None:
                     continue
                 pools.setdefault(n.pool, []).append(n)
-        self._pools = pools
-        self._cache = {}
+        # Invalidate cached verdicts only when the fleet actually changed --
+        # update_executors runs every cycle, the fleet changes rarely.
+        fingerprint = tuple(
+            sorted(
+                (
+                    pool,
+                    n.id,
+                    tuple(int(a) for a in n.total_resources.atoms),
+                    n.taints,
+                    tuple(sorted(n.labels.items())),
+                )
+                for pool, nodes in pools.items()
+                for n in nodes
+            )
+        )
+        if fingerprint != self._fingerprint:
+            self._pools = pools
+            self._cache = {}
+            self._fingerprint = fingerprint
         self._have_executors = bool(executors)
 
     @property
@@ -132,6 +150,9 @@ class SubmitChecker:
                     per_node = np.floor(
                         np.where(req > 0, total / np.maximum(req, 1e-9), np.inf)
                     ).min()
+                # All-zero requests give inf; clip before int() (one bad event
+                # on the log must not wedge the scheduler thread).
+                per_node = min(per_node, float(cardinality))
                 if per_node <= 0:
                     gap = np.where(req > total, req - total, 0)
                     biggest_gap = gap if biggest_gap is None else np.minimum(biggest_gap, gap)

@@ -1,0 +1,150 @@
+"""Scheduler metrics + scheduling reports tests.
+
+Modeled on the reference's cycle metrics tests (internal/scheduler/metrics/
+cycle_metrics_test.go) and reports repository tests (internal/scheduler/
+reports): gauge names match the reference's so existing dashboards carry over.
+"""
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from armada_tpu.scheduler.metrics import SchedulerMetrics
+from armada_tpu.scheduler.reports import SchedulingReportsRepository
+from armada_tpu.server import JobSubmitItem, QueueRecord
+from tests.control_plane import ControlPlane
+
+
+@pytest.fixture
+def cp(tmp_path):
+    plane = ControlPlane.build(tmp_path)
+    plane.registry = CollectorRegistry()
+    plane.scheduler.metrics = SchedulerMetrics(registry=plane.registry)
+    plane.scheduler.reports = SchedulingReportsRepository(max_job_reports=100)
+    plane.server.create_queue(QueueRecord("heavy", weight=3.0))
+    plane.server.create_queue(QueueRecord("light", weight=1.0))
+    yield plane
+    plane.close()
+
+
+def item(cpu="2"):
+    return JobSubmitItem(resources={"cpu": cpu, "memory": "2"})
+
+
+def sample(cp, name, labels=None):
+    return cp.registry.get_sample_value(name, labels or {})
+
+
+def test_cycle_metrics_exported(cp):
+    cp.server.submit_jobs("heavy", "m", [item() for _ in range(8)])
+    cp.server.submit_jobs("light", "m", [item() for _ in range(8)])
+    for ex in cp.executors:
+        ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+
+    # share gauges, reference names
+    heavy = {"pool": "default", "queue": "heavy"}
+    light = {"pool": "default", "queue": "light"}
+    assert sample(cp, "armada_scheduler_queue_weight", heavy) == 3.0
+    fs_heavy = sample(cp, "armada_scheduler_fair_share", heavy)
+    fs_light = sample(cp, "armada_scheduler_fair_share", light)
+    assert fs_heavy == pytest.approx(0.75) and fs_light == pytest.approx(0.25)
+    assert sample(cp, "armada_scheduler_actual_share", heavy) > sample(
+        cp, "armada_scheduler_actual_share", light
+    )
+    assert sample(cp, "armada_scheduler_demand", heavy) > 0
+    assert sample(cp, "armada_scheduler_fairness_error", {"pool": "default"}) >= 0
+
+    # decision counters
+    total_scheduled = sample(
+        cp, "armada_scheduler_scheduled_jobs_total", heavy
+    ) + sample(cp, "armada_scheduler_scheduled_jobs_total", light)
+    assert total_scheduled == 8  # 2 nodes x 8 cpu / 2 cpu
+
+    # cycle time histogram recorded one scheduling cycle
+    assert sample(cp, "armada_scheduler_schedule_cycle_times_count") == 1
+
+    # state transition counters from published events
+    assert sample(
+        cp,
+        "armada_scheduler_job_state_counter_by_queue_total",
+        {"queue": "heavy", "state": "leased"},
+    ) > 0
+
+
+def test_reports_record_rounds_and_jobs(cp):
+    ids = cp.server.submit_jobs("heavy", "r", [item()])
+    impossible = cp.server.submit_jobs(
+        "heavy", "r", [JobSubmitItem(resources={"cpu": "6", "memory": "500"})]
+    )
+    for ex in cp.executors:
+        ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    reports = cp.scheduler.reports
+
+    jr = reports.job_report(ids[0])
+    assert jr is not None and jr["outcome"] == "scheduled"
+    assert jr["node"].startswith("ex1-n")
+
+    pool = reports.pool_report("default")["default"]
+    assert pool["scheduled"] == 1
+    assert pool["num_nodes"] == 2
+    assert pool["termination"] in ("exhausted", "global_burst")
+
+    qr = reports.queue_report("heavy")
+    assert qr and 0 <= qr[0]["actual_share"] <= 1
+
+
+def test_reports_over_wire_and_cli(cp, capsys):
+    from armada_tpu.cli.armadactl import main
+    from armada_tpu.rpc.server import make_server
+
+    ids = cp.server.submit_jobs("heavy", "w", [item()])
+    for ex in cp.executors:
+        ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+
+    server, port = make_server(reports=cp.scheduler.reports)
+    try:
+        assert main(["--url", f"127.0.0.1:{port}", "scheduling-report"]) == 0
+        out = capsys.readouterr().out
+        assert "default:" in out and "scheduled=1" in out
+        assert main(
+            ["--url", f"127.0.0.1:{port}", "scheduling-report", "--queue", "heavy"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "actual=" in out
+        assert main(
+            ["--url", f"127.0.0.1:{port}", "scheduling-report", "--job-id", ids[0]]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "outcome: scheduled" in out
+        # unknown job -> clean error, nonzero exit, no traceback
+        assert main(
+            ["--url", f"127.0.0.1:{port}", "scheduling-report", "--job-id", "nope"]
+        ) == 1
+        err = capsys.readouterr().err
+        assert "NOT_FOUND" in err
+    finally:
+        server.stop(None)
+
+
+def test_job_report_lru_bound():
+    from armada_tpu.scheduler.algo import PoolStats, SchedulerResult
+    from armada_tpu.models import RoundOutcome
+
+    reports = SchedulingReportsRepository(max_job_reports=5)
+    for i in range(20):
+        outcome = RoundOutcome(
+            scheduled={}, preempted=[], failed=[f"j{i}"], num_iterations=1,
+            termination="exhausted",
+        )
+        result = SchedulerResult(
+            pools=[PoolStats("default", outcome, 1, 1, 0)]
+        )
+        reports.record_cycle(result, now=float(i))
+    assert reports.job_report("j0") is None
+    assert reports.job_report("j19") is not None
+    assert len(reports._job_reports) == 5
