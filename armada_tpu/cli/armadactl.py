@@ -112,20 +112,14 @@ def cmd_queue_list(args):
     return 0
 
 
-def _load_submission(path):
-    import yaml
-
+def job_items_from_docs(job_docs):
+    """Parse the submission-YAML `jobs:` documents into JobSubmitItems
+    (shared with the testsuite spec loader)."""
     from armada_tpu.core.types import Toleration
     from armada_tpu.server.submit import JobSubmitItem
 
-    with open(path) as f:
-        doc = yaml.safe_load(f)
-    queue = doc["queue"]
-    jobset = doc.get("jobSetId") or doc.get("jobset")
-    if not jobset:
-        raise ValueError("submission must set jobSetId")
     items = []
-    for spec in doc.get("jobs", []):
+    for spec in job_docs:
         count = int(spec.get("count", 1))
         for i in range(count):
             client_id = spec.get("clientIdPrefix")
@@ -156,7 +150,19 @@ def _load_submission(path):
                     labels=spec.get("labels", {}),
                 )
             )
-    return queue, jobset, items
+    return items
+
+
+def _load_submission(path):
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    queue = doc["queue"]
+    jobset = doc.get("jobSetId") or doc.get("jobset")
+    if not jobset:
+        raise ValueError("submission must set jobSetId")
+    return queue, jobset, job_items_from_docs(doc.get("jobs", []))
 
 
 def cmd_submit(args):
@@ -303,6 +309,59 @@ def cmd_report(args):
                 )
 
     with_closed(_client(args), go)
+    return 0
+
+
+def cmd_testsuite(args):
+    import glob
+    import os as _os
+
+    from armada_tpu.testsuite import TestRunner, load_spec
+    from armada_tpu.testsuite.runner import GrpcSuiteClient
+
+    paths = []
+    for target in args.path:
+        if _os.path.isdir(target):
+            paths.extend(
+                sorted(
+                    glob.glob(_os.path.join(target, "*.yaml"))
+                    + glob.glob(_os.path.join(target, "*.yml"))
+                )
+            )
+        elif _os.path.exists(target):
+            paths.append(target)
+        else:
+            print(f"no such spec file or directory: {target}", file=sys.stderr)
+            return 2
+    if not paths:
+        print("no test specs found", file=sys.stderr)
+        return 2
+
+    client = _client(args)
+    runner = TestRunner(GrpcSuiteClient(client))
+    failed = 0
+    try:
+        for p in paths:
+            result = runner.run(load_spec(p))
+            print(result.summary())
+            failed += 0 if result.passed else 1
+    finally:
+        client.close()
+    print(f"\n{len(paths) - failed}/{len(paths)} specs passed")
+    return 1 if failed else 0
+
+
+def cmd_load_test(args):
+    from armada_tpu.testsuite import LoadTester, load_loadtest_spec
+    from armada_tpu.testsuite.runner import GrpcSuiteClient
+
+    spec = load_loadtest_spec(args.file)
+    client = _client(args)
+    try:
+        result = LoadTester(GrpcSuiteClient(client)).run(spec)
+    finally:
+        client.close()
+    print(result.summary())
     return 0
 
 
@@ -453,6 +512,14 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument("--queue")
     rep.add_argument("--pool")
     rep.set_defaults(fn=cmd_report)
+
+    ts = sub.add_parser("testsuite", help="run declarative e2e test specs")
+    ts.add_argument("path", nargs="+", help="spec files or directories")
+    ts.set_defaults(fn=cmd_testsuite)
+
+    lt = sub.add_parser("load-test", help="run a load-test spec")
+    lt.add_argument("file")
+    lt.set_defaults(fn=cmd_load_test)
 
     ex = sub.add_parser("executor", help="run a fake-cluster executor agent")
     ex.add_argument("--id", default="fake-1")

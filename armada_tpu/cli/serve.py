@@ -153,6 +153,8 @@ def start_control_plane(
             config,
             queues=queues.scheduling_queues,
             clock_ns=lambda: int(time.time() * 1e9),
+            # reports are always on in serve; metrics when exposed
+            collect_stats=True,
         ),
         publisher,
         leader,

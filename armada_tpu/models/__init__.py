@@ -24,6 +24,7 @@ def run_scheduling_round(
     queues,
     queued_jobs,
     running=(),
+    collect_stats=True,
 ):
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
@@ -48,7 +49,10 @@ def run_scheduling_round(
         slot_width=ctx.slot_width,
     )
     outcome = decode_result(result, ctx)
-    outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
+    if collect_stats:
+        # Extra device->host transfer + host-side DRF recompute: skipped when
+        # neither metrics nor reports consume it.
+        outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
     return outcome
 
 

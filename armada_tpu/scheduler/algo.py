@@ -65,11 +65,15 @@ class FairSchedulingAlgo:
         queues: Callable[[], Sequence[Queue]],
         clock_ns: Callable[[], int],
         run_id_factory: Callable[[], str] = _new_run_id,
+        collect_stats: bool = True,
     ):
         self.config = config
         self._queues = queues
         self._clock_ns = clock_ns
         self._run_id = run_id_factory
+        # Per-queue share stats cost an extra device->host transfer; turn off
+        # when neither metrics nor reports are wired.
+        self.collect_stats = collect_stats
 
     # --- executor health (scheduling_algo.go:780-830) -----------------------
 
@@ -173,6 +177,7 @@ class FairSchedulingAlgo:
                 queues=queues,
                 queued_jobs=queued_jobs,
                 running=running,
+                collect_stats=self.collect_stats,
             )
             self._apply_outcome(
                 txn, outcome, pool, executor_of_node, now_ns, result

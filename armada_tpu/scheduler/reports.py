@@ -27,7 +27,7 @@ class SchedulingReportsRepository:
     # --- recording (called by the Scheduler after algo.schedule) ------------
 
     def record_cycle(self, scheduler_result, now: Optional[float] = None) -> None:
-        now = now or time.time()
+        now = time.time() if now is None else now
         with self._lock:
             for job, run in scheduler_result.scheduled:
                 self._put_job(

@@ -1,0 +1,21 @@
+"""Declarative black-box testsuite + load tester.
+
+Equivalent of the reference's cmd/testsuite (YAML TestSpecs: jobs x batches,
+expected event sequences, cancel modes, timeouts -- pkg/api/testspec.proto:13-53,
+engine in internal/testsuite with eventwatcher + eventbenchmark) and
+cmd/armada-load-tester (pkg/client/load-test.go:26-32).
+"""
+
+from armada_tpu.testsuite.spec import TestSpec, load_spec
+from armada_tpu.testsuite.runner import TestResult, TestRunner
+from armada_tpu.testsuite.loadtest import LoadTestSpec, LoadTester, load_loadtest_spec
+
+__all__ = [
+    "TestSpec",
+    "load_spec",
+    "TestResult",
+    "TestRunner",
+    "LoadTestSpec",
+    "LoadTester",
+    "load_loadtest_spec",
+]

@@ -1,0 +1,142 @@
+"""Load tester: submission throughput + drain latency.
+
+Equivalent of the reference's cmd/armada-load-tester over
+pkg/client/load-test.go:26-32 + example/loadtest.yaml: a spec fans jobs out
+over N queues, the tester measures submission rate and (optionally) waits for
+the backlog to drain, reporting wall-clock and per-phase throughput.
+
+    queuePrefix: load
+    numQueues: 4
+    jobsPerQueue: 250
+    job: {resources: {cpu: "1", memory: 1Gi}}
+    waitForCompletion: true
+    timeout: 300
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadTestSpec:
+    queue_prefix: str
+    num_queues: int
+    jobs_per_queue: int
+    job: object  # JobSubmitItem
+    wait_for_completion: bool = True
+    timeout_s: float = 300.0
+
+
+def load_loadtest_spec(path: str) -> LoadTestSpec:
+    import yaml
+
+    from armada_tpu.server.submit import JobSubmitItem
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    job_doc = doc.get("job", {})
+    return LoadTestSpec(
+        queue_prefix=doc.get("queuePrefix", "load"),
+        num_queues=int(doc.get("numQueues", 1)),
+        jobs_per_queue=int(doc.get("jobsPerQueue", 100)),
+        job=JobSubmitItem(
+            resources=job_doc.get("resources", {"cpu": "1", "memory": "1"}),
+            priority=int(job_doc.get("priority", 0)),
+            priority_class=job_doc.get("priorityClassName", ""),
+        ),
+        wait_for_completion=bool(doc.get("waitForCompletion", True)),
+        timeout_s=float(doc.get("timeout", 300.0)),
+    )
+
+
+@dataclasses.dataclass
+class LoadTestResult:
+    num_jobs: int
+    submit_s: float
+    drain_s: float  # -1 if completion was not waited for
+    succeeded: int
+    failed: int
+    # False when the timeout expired with jobs still not terminal.
+    drained: bool = True
+
+    def summary(self) -> str:
+        rate = self.num_jobs / max(self.submit_s, 1e-9)
+        lines = [
+            f"submitted {self.num_jobs} jobs in {self.submit_s:.2f}s "
+            f"({rate:.0f} jobs/s)"
+        ]
+        if self.drain_s >= 0:
+            terminal = self.succeeded + self.failed
+            if self.drained:
+                lines.append(
+                    f"drained in {self.drain_s:.1f}s: {self.succeeded} succeeded, "
+                    f"{self.failed} failed "
+                    f"({self.succeeded / max(self.drain_s, 1e-9):.1f} jobs/s throughput)"
+                )
+            else:
+                lines.append(
+                    f"TIMED OUT after {self.drain_s:.1f}s: only {terminal} of "
+                    f"{self.num_jobs} jobs reached a terminal state "
+                    f"({self.succeeded} succeeded, {self.failed} failed)"
+                )
+        return "\n".join(lines)
+
+
+class LoadTester:
+    def __init__(self, suite_client, clock=time.time):
+        """`suite_client` is the same adapter surface TestRunner uses, plus
+        job-state polling via watch events."""
+        self._client = suite_client
+        self._clock = clock
+
+    def run(self, spec: LoadTestSpec) -> LoadTestResult:
+        run_id = uuid.uuid4().hex[:8]
+        jobset = f"load-{run_id}"
+        queues = [
+            f"{spec.queue_prefix}-{i}" for i in range(spec.num_queues)
+        ]
+        for q in queues:
+            if self._client.get_queue_or_none(q) is None:
+                self._client.create_queue(q, 1.0)
+
+        t0 = self._clock()
+        all_ids: dict[str, list[str]] = {}
+        for q in queues:
+            all_ids[q] = self._client.submit_jobs(
+                q, jobset, [spec.job] * spec.jobs_per_queue
+            )
+        submit_s = self._clock() - t0
+        num_jobs = sum(len(v) for v in all_ids.values())
+
+        if not spec.wait_for_completion:
+            return LoadTestResult(num_jobs, submit_s, -1.0, 0, 0)
+
+        deadline = t0 + spec.timeout_s
+        done: dict[str, str] = {}  # job_id -> terminal kind
+        cursors = {q: 0 for q in queues}
+        while len(done) < num_jobs and self._clock() < deadline:
+            for q in queues:
+                for item in self._client.watch_events(
+                    q, jobset, from_idx=cursors[q]
+                ):
+                    cursors[q] = item.idx + 1
+                    for ev in item.sequence.events:
+                        kind = ev.WhichOneof("event")
+                        if kind in ("job_succeeded", "cancelled_job"):
+                            done[getattr(ev, kind).job_id] = kind
+                        elif kind == "job_errors" and any(
+                            e.terminal for e in ev.job_errors.errors
+                        ):
+                            done[ev.job_errors.job_id] = "failed"
+                    if len(done) >= num_jobs:
+                        break
+        drain_s = self._clock() - t0
+        succeeded = sum(1 for k in done.values() if k == "job_succeeded")
+        failed = sum(1 for k in done.values() if k != "job_succeeded")
+        return LoadTestResult(
+            num_jobs, submit_s, drain_s, succeeded, failed,
+            drained=len(done) >= num_jobs,
+        )

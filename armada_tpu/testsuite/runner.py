@@ -1,0 +1,160 @@
+"""TestRunner: submit a spec, watch events, assert per-job sequences.
+
+Equivalent of the reference's internal/testsuite engine: the submitter posts
+the spec's jobs under a fresh jobset, the eventwatcher consumes the jobset
+stream, and each job must exhibit the expected event kinds as an ordered
+subsequence before the timeout (eventwatcher.go); per-event latency
+percentiles come from the eventbenchmark package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+
+from armada_tpu.testsuite.spec import EVENT_NAMES, TestSpec
+
+
+@dataclasses.dataclass
+class TestResult:
+    spec: TestSpec
+    passed: bool
+    duration_s: float
+    jobset: str
+    failures: list  # [str] human-readable reasons
+    events_by_job: dict  # job_id -> [(kind, created_ns)]
+    latency_by_event: dict  # expected-event name -> seconds from submit (max)
+
+    def summary(self) -> str:
+        status = "PASS" if self.passed else "FAIL"
+        lines = [
+            f"{status} {self.spec.name} ({len(self.events_by_job)} jobs, "
+            f"{self.duration_s:.1f}s)"
+        ]
+        for name, latency in self.latency_by_event.items():
+            lines.append(f"  {name:<12} last at +{latency:.2f}s")
+        lines.extend(f"  !! {f}" for f in self.failures)
+        return "\n".join(lines)
+
+
+class TestRunner:
+    """Runs TestSpecs against any client with submit/cancel/watch (the gRPC
+    ArmadaClient or the in-process SubmitServer+EventApi pair via a shim)."""
+
+    def __init__(self, client, clock=time.time):
+        self._client = client
+        self._clock = clock
+
+    def run(self, spec: TestSpec) -> TestResult:
+        jobset = f"testsuite-{uuid.uuid4().hex[:10]}"
+        start = self._clock()
+
+        if self._client.get_queue_or_none(spec.queue) is None:
+            self._client.create_queue(spec.queue, spec.queue_weight)
+
+        job_ids = self._client.submit_jobs(spec.queue, jobset, list(spec.jobs))
+        if spec.cancel == "byId":
+            self._client.cancel_jobs(spec.queue, jobset, job_ids)
+        elif spec.cancel == "bySet":
+            self._client.cancel_jobset(spec.queue, jobset)
+
+        expected_kinds = [EVENT_NAMES[e] for e in spec.expected_events]
+        events_by_job: dict = {jid: [] for jid in job_ids}
+        pending = set(job_ids)
+        submit_ns: dict = {}
+        latency: dict = {}
+        deadline = start + spec.timeout_s
+
+        # Keep (re-)watching from the cursor until everything is seen or the
+        # deadline passes: a single stream may idle out during a long run.
+        next_idx = 0
+        while pending and self._clock() < deadline:
+            for item in self._client.watch_events(
+                spec.queue, jobset, from_idx=next_idx
+            ):
+                next_idx = item.idx + 1
+                for ev in item.sequence.events:
+                    kind = ev.WhichOneof("event")
+                    body = getattr(ev, kind)
+                    job_id = getattr(body, "job_id", "")
+                    if job_id not in events_by_job:
+                        continue
+                    if kind == "job_errors" and not any(
+                        e.terminal for e in body.errors
+                    ):
+                        continue  # non-terminal error noise
+                    events_by_job[job_id].append((kind, ev.created_ns))
+                    if kind == "submit_job":
+                        submit_ns[job_id] = ev.created_ns
+                for jid in list(pending):
+                    if _is_subsequence(
+                        expected_kinds, [k for k, _ in events_by_job[jid]]
+                    ):
+                        pending.discard(jid)
+                        for name, k in zip(spec.expected_events, expected_kinds):
+                            t = next(
+                                (ns for kk, ns in events_by_job[jid] if kk == k),
+                                None,
+                            )
+                            if t is not None and jid in submit_ns:
+                                dt = (t - submit_ns[jid]) / 1e9
+                                latency[name] = max(latency.get(name, 0.0), dt)
+                if not pending or self._clock() > deadline:
+                    break
+
+        failures = []
+        for jid in sorted(pending):
+            seen = [k for k, _ in events_by_job[jid]]
+            failures.append(
+                f"job {jid}: expected {expected_kinds}, saw {seen} "
+                f"within {spec.timeout_s}s"
+            )
+        return TestResult(
+            spec=spec,
+            passed=not failures,
+            duration_s=self._clock() - start,
+            jobset=jobset,
+            failures=failures,
+            events_by_job=events_by_job,
+            latency_by_event=latency,
+        )
+
+
+def _is_subsequence(needle: list, haystack: list) -> bool:
+    it = iter(haystack)
+    return all(k in it for k in needle)
+
+
+class GrpcSuiteClient:
+    """Adapter giving TestRunner its minimal surface over ArmadaClient."""
+
+    def __init__(self, client):
+        self._c = client
+
+    def get_queue_or_none(self, name):
+        import grpc
+
+        try:
+            return self._c.get_queue(name)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+
+    def create_queue(self, name, weight):
+        from armada_tpu.server.queues import QueueRecord
+
+        self._c.create_queue(QueueRecord(name, weight=weight))
+
+    def submit_jobs(self, queue, jobset, items):
+        return self._c.submit_jobs(queue, jobset, items)
+
+    def cancel_jobs(self, queue, jobset, job_ids):
+        self._c.cancel_jobs(queue, jobset, job_ids)
+
+    def cancel_jobset(self, queue, jobset):
+        self._c.cancel_jobset(queue, jobset)
+
+    def watch_events(self, queue, jobset, from_idx=0):
+        return self._c.watch(queue, jobset, from_idx=from_idx, idle_timeout_s=2.0)

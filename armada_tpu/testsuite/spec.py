@@ -1,0 +1,81 @@
+"""TestSpec: one declarative end-to-end scenario.
+
+Mirror of the reference's pkg/api/testspec.proto:13-53 in YAML:
+
+    name: gang-lifecycle
+    queue: e2e-test            # created if missing
+    timeout: 60                # seconds to see all expected events
+    jobs:                      # same job shape as armadactl submit
+      - count: 2
+        resources: {cpu: "1", memory: 1Gi}
+        gangId: g1
+        gangCardinality: 2
+    expectedEvents: [submitted, leased, running, succeeded]
+    cancel: none               # none | byId | bySet -- cancel after submit
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+# testsuite event vocabulary -> our event kinds (testspec.proto expected events)
+EVENT_NAMES = {
+    "submitted": "submit_job",
+    "validated": "job_validated",
+    "leased": "job_run_leased",
+    "pending": "job_run_assigned",
+    "running": "job_run_running",
+    "succeeded": "job_succeeded",
+    "failed": "job_errors",
+    "cancelled": "cancelled_job",
+    "preempted": "job_run_preempted",
+    "requeued": "job_requeued",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TestSpec:
+    __test__ = False  # tell pytest this is not a test class
+
+    name: str
+    queue: str
+    jobs: tuple  # tuple[JobSubmitItem, ...]
+    expected_events: tuple[str, ...]  # in EVENT_NAMES vocabulary
+    timeout_s: float = 60.0
+    cancel: str = "none"  # none | byId | bySet
+    queue_weight: float = 1.0
+
+    def __post_init__(self):
+        for ev in self.expected_events:
+            if ev not in EVENT_NAMES:
+                raise ValueError(
+                    f"spec {self.name}: unknown expected event {ev!r} "
+                    f"(known: {', '.join(sorted(EVENT_NAMES))})"
+                )
+        if self.cancel not in ("none", "byId", "bySet"):
+            raise ValueError(f"spec {self.name}: invalid cancel mode {self.cancel!r}")
+        if not self.jobs:
+            raise ValueError(f"spec {self.name}: no jobs")
+
+
+def _items_from_yaml(job_docs: Sequence[dict]):
+    from armada_tpu.cli.armadactl import job_items_from_docs
+
+    return tuple(job_items_from_docs(job_docs))
+
+
+def load_spec(path: str) -> TestSpec:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return TestSpec(
+        name=doc.get("name") or path,
+        queue=doc["queue"],
+        jobs=_items_from_yaml(doc.get("jobs", [])),
+        expected_events=tuple(doc.get("expectedEvents", ["submitted", "succeeded"])),
+        timeout_s=float(doc.get("timeout", 60.0)),
+        cancel=doc.get("cancel", "none"),
+        queue_weight=float(doc.get("queueWeight", 1.0)),
+    )

@@ -1,0 +1,107 @@
+"""Testsuite runner + load tester against a live in-process topology."""
+
+import threading
+
+import pytest
+
+from armada_tpu.cli.armadactl import main
+from armada_tpu.cli.serve import run_fake_executor, start_control_plane
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.testsuite import load_spec
+from armada_tpu.testsuite.spec import TestSpec
+
+
+@pytest.fixture
+def topo(tmp_path):
+    plane = start_control_plane(
+        str(tmp_path / "data"),
+        port=0,
+        config=SchedulingConfig(shape_bucket=32),
+        cycle_interval_s=0.05,
+        schedule_interval_s=0.1,
+    )
+    stop = threading.Event()
+    agent = threading.Thread(
+        target=run_fake_executor,
+        args=(f"127.0.0.1:{plane.port}",),
+        kwargs={
+            "executor_id": "ts-ex",
+            "num_nodes": 2,
+            "cpu": "8",
+            "memory": "32",
+            "interval_s": 0.05,
+            "stop": stop,
+            "config": SchedulingConfig(shape_bucket=32),
+            "default_runtime_s": 0.3,
+        },
+        daemon=True,
+    )
+    agent.start()
+    yield plane
+    stop.set()
+    agent.join(timeout=5)
+    plane.stop()
+
+
+def test_spec_loading_and_validation(tmp_path):
+    spec = load_spec("testdata/testsuite/gang.yaml")
+    assert spec.name == "gang-lifecycle"
+    assert len(spec.jobs) == 3 and spec.jobs[0].gang_cardinality == 3
+    assert spec.expected_events[-1] == "succeeded"
+
+    with pytest.raises(ValueError, match="unknown expected event"):
+        TestSpec(
+            name="bad",
+            queue="q",
+            jobs=spec.jobs,
+            expected_events=("submitted", "teleported"),
+        )
+    with pytest.raises(ValueError, match="invalid cancel mode"):
+        TestSpec(
+            name="bad",
+            queue="q",
+            jobs=spec.jobs,
+            expected_events=("submitted",),
+            cancel="maybe",
+        )
+
+
+def test_testsuite_cli_runs_all_specs(topo, capsys):
+    rc = main(
+        ["--url", f"127.0.0.1:{topo.port}", "testsuite", "testdata/testsuite"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert out.count("PASS") == 4
+    assert "4/4 specs passed" in out
+    # latency benchmark lines present
+    assert "succeeded" in out and ("+0." in out or "+1." in out)
+
+
+def test_testsuite_reports_failure(topo, tmp_path, capsys):
+    bad = tmp_path / "never.yaml"
+    bad.write_text(
+        """
+name: expects-the-impossible
+queue: e2e
+timeout: 3
+jobs:
+  - resources: {cpu: "1", memory: "1"}
+expectedEvents: [submitted, preempted]
+"""
+    )
+    rc = main(["--url", f"127.0.0.1:{topo.port}", "testsuite", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL expects-the-impossible" in out
+    assert "0/1 specs passed" in out
+
+
+def test_load_test_cli(topo, capsys):
+    rc = main(
+        ["--url", f"127.0.0.1:{topo.port}", "load-test", "testdata/loadtest/small.yaml"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "submitted 50 jobs" in out
+    assert "50 succeeded, 0 failed" in out
