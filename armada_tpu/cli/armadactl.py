@@ -145,6 +145,7 @@ def job_items_from_docs(job_docs):
                         "gangNodeUniformityLabel", ""
                     ),
                     pools=tuple(spec.get("pools", ())),
+                    price_band=spec.get("priceBand", ""),
                     namespace=spec.get("namespace", "default"),
                     annotations=spec.get("annotations", {}),
                     labels=spec.get("labels", {}),

@@ -38,6 +38,22 @@ class PoolConfig:
     name: str
     # Pools this pool may schedule "away" jobs onto (scheduling_algo.go:216-283).
     away_pools: tuple[str, ...] = ()
+    # Candidate ordering by bid price instead of DRF cost
+    # (experimentalMarketScheduling; market_iterator.go).
+    market_driven: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class FloatingResource:
+    """A pool-level resource never bound to nodes (e.g. storage connections):
+    counted in totals, fairness and constraints, but invisible to per-node fit
+    (internal/scheduler/floatingresources/floating_resource_types.go,
+    docs/floating_resources.md:9-19)."""
+
+    name: str
+    resolution: str = "1"
+    # pool -> total quantity available in that pool.
+    pools: Mapping[str, "str | int"] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +111,8 @@ class SchedulingConfig:
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
     enable_assertions: bool = False
+    # Pool-level resources never bound to nodes (floatingresources/).
+    floating_resources: tuple[FloatingResource, ...] = ()
     # Device-shape bucketing: round padded axis sizes up to the next multiple to
     # bound jit recompilation (ours; no reference equivalent -- Go has no shapes).
     shape_bucket: int = 256
@@ -123,11 +141,31 @@ class SchedulingConfig:
                 self.indexed_taints,
                 self.node_id_label,
                 self.shape_bucket,
+                tuple(
+                    (fr.name, fr.resolution, tuple(sorted(fr.pools.items())))
+                    for fr in self.floating_resources
+                ),
             )
         )
 
     def resource_list_factory(self) -> ResourceListFactory:
-        return ResourceListFactory.from_config(self.supported_resource_types)
+        # Floating resources are requestable: they extend the resource axis.
+        types = tuple(self.supported_resource_types) + tuple(
+            (fr.name, fr.resolution) for fr in self.floating_resources
+        )
+        return ResourceListFactory.from_config(types)
+
+    def floating_resource_names(self) -> tuple[str, ...]:
+        return tuple(fr.name for fr in self.floating_resources)
+
+    def floating_totals_for_pool(self, pool: str) -> dict[str, "str | int"]:
+        """name -> quantity of each floating resource available in `pool`
+        (floating_resource_types.go GetTotalAvailableForPool)."""
+        return {
+            fr.name: fr.pools[pool]
+            for fr in self.floating_resources
+            if pool in fr.pools
+        }
 
     def priority_class(self, name: Optional[str]) -> PriorityClass:
         if not name:
@@ -174,7 +212,12 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         )
     if "pools" in d:
         kw["pools"] = tuple(
-            PoolConfig(p["name"], tuple(p.get("awayPools", []))) for p in d["pools"]
+            PoolConfig(
+                p["name"],
+                tuple(p.get("awayPools", [])),
+                market_driven=bool(p.get("marketDriven", False)),
+            )
+            for p in d["pools"]
         )
     if "priorityClasses" in d:
         kw["priority_classes"] = _parse_priority_classes(d["priorityClasses"])
@@ -204,6 +247,17 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         kw["indexed_node_labels"] = tuple(d["indexedNodeLabels"])
     if "indexedTaints" in d:
         kw["indexed_taints"] = tuple(d["indexedTaints"])
+    if "floatingResources" in d:
+        kw["floating_resources"] = tuple(
+            FloatingResource(
+                name=fr["name"],
+                resolution=str(fr.get("resolution", "1")),
+                pools={
+                    p["name"]: p["quantity"] for p in fr.get("pools", [])
+                },
+            )
+            for fr in d["floatingResources"]
+        )
     return SchedulingConfig(**kw)
 
 

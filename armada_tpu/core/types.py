@@ -96,6 +96,8 @@ class JobSpec:
     gang_cardinality: int = 1
     gang_node_uniformity_label: str = ""
     pools: tuple[str, ...] = ()  # pools the job may schedule in; empty = all
+    # Price band for market-driven pools (reference: bidstore price bands).
+    price_band: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

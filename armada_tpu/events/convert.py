@@ -48,6 +48,7 @@ def job_spec_to_proto(job: JobSpec) -> pb.JobSpec:
         gang_cardinality=job.gang_cardinality,
         gang_node_uniformity_label=job.gang_node_uniformity_label,
         pools=list(job.pools),
+        price_band=job.price_band,
     )
 
 
@@ -76,4 +77,5 @@ def job_spec_from_proto(
         gang_cardinality=int(msg.gang_cardinality) or 1,
         gang_node_uniformity_label=msg.gang_node_uniformity_label,
         pools=tuple(msg.pools),
+        price_band=msg.price_band,
     )

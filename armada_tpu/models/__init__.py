@@ -25,6 +25,7 @@ def run_scheduling_round(
     queued_jobs,
     running=(),
     collect_stats=True,
+    bid_price_of=None,
 ):
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
@@ -40,6 +41,7 @@ def run_scheduling_round(
         queues=queues,
         queued_jobs=queued_jobs,
         running=running,
+        bid_price_of=bid_price_of,
     )
     device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
     result = schedule_round(

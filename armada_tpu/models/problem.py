@@ -67,6 +67,7 @@ class SchedulingProblem(NamedTuple):
     g_order: np.ndarray  # i32[G] rank within its queue (evictees first)
     g_run: np.ndarray  # i32[G] backing run for evictee slots, else -1
     g_valid: np.ndarray  # bool[G]
+    g_price: np.ndarray  # f32[G] bid price (market pools; 0 otherwise)
     # queue-ordered gang index: gangs sorted by (queue, order); per-queue
     # contiguous slices.  The kernel's candidate scan is O(Q) gathers into this
     # instead of O(G) segment reductions (the analog of the reference keeping
@@ -88,6 +89,13 @@ class SchedulingProblem(NamedTuple):
     protected_fraction: np.ndarray  # f32 scalar
     global_burst: np.ndarray  # i32 scalar
     perq_burst: np.ndarray  # i32 scalar
+    # Floating resources (floatingresources/): 1.0 on node-bound axes, 0.0 on
+    # floating axes; per-pool floating capacity (0 on node axes).
+    node_axes: np.ndarray  # f32[R]
+    float_total: np.ndarray  # f32[R]
+    # Market-driven pools order candidates by bid price instead of DRF cost
+    # (scheduling/market_iterator.go MarketCandidateGangIterator:245).
+    market: np.ndarray  # bool scalar
 
 
 @dataclasses.dataclass
@@ -162,12 +170,20 @@ def build_problem(
     queues: Sequence[Queue],
     queued_jobs: Sequence[JobSpec],
     running: Sequence[RunningJob] = (),
+    bid_price_of=None,
 ) -> tuple[SchedulingProblem, HostContext]:
+    """`bid_price_of(job) -> float` supplies bid prices; required for pools
+    configured market_driven (pricer/gang_pricer.go:29-40)."""
     factory = config.resource_list_factory()
     R = factory.num_resources
     bucket = config.shape_bucket
 
     pool_nodes = [n for n in nodes if n.pool == pool]
+    pool_cfg = next((pc for pc in config.pools if pc.name == pool), None)
+    market = bool(pool_cfg is not None and getattr(pool_cfg, "market_driven", False))
+    if market and bid_price_of is None:
+        raise ValueError(f"pool {pool} is market driven but no bid_price_of given")
+    price_of = bid_price_of or (lambda job: 0.0)
     queue_by_name = {q.name: i for i, q in enumerate(sorted(queues, key=lambda q: q.name))}
     sorted_queues = sorted(queues, key=lambda q: q.name)
 
@@ -215,7 +231,10 @@ def build_problem(
 
     # --- gangs: group queued jobs ----------------------------------------------
     class _Gang:
-        __slots__ = ("jobs", "queue", "key", "level", "pc", "req", "card", "order", "run")
+        __slots__ = (
+            "jobs", "queue", "key", "level", "pc", "req", "card", "order",
+            "run", "price",
+        )
 
     gangs: list[_Gang] = []
     per_queue_jobs: dict[int, list] = {qi: [] for qi in range(len(sorted_queues))}
@@ -255,11 +274,20 @@ def build_problem(
     run_gang = np.full((RJ,), -1, np.int32)
     for qi, ris in evictee_by_queue.items():
         # evictees ordered among themselves by the same comparator
-        ris.sort(
-            key=lambda ri: _job_sort_key(
-                ladder[run_level[ri] - 1], run_list[ri].job
+        if market:
+            ris.sort(
+                key=lambda ri: (
+                    -price_of(run_list[ri].job),
+                    run_list[ri].job.submit_time,
+                    run_list[ri].job.id,
+                )
             )
-        )
+        else:
+            ris.sort(
+                key=lambda ri: _job_sort_key(
+                    ladder[run_level[ri] - 1], run_list[ri].job
+                )
+            )
         for order, ri in enumerate(ris):
             g = _new_gang()
             g.jobs = []
@@ -271,6 +299,7 @@ def build_problem(
             g.card = 1
             g.order = order
             g.run = ri
+            g.price = float(price_of(run_list[ri].job))
             run_gang[ri] = len(gangs) - 1
             gang_members_out.append([])
 
@@ -285,10 +314,15 @@ def build_problem(
                 by_gang.setdefault(job.gang_id, []).append(job)
             else:
                 singles.append(job)
+        def unit_key(lead_pc_priority, job):
+            if market:
+                return (-price_of(job), job.submit_time, job.id)
+            return _job_sort_key(lead_pc_priority, job)
+
         units: list[tuple[tuple, list]] = []
         for job in singles:
             pc = config.priority_class(job.priority_class)
-            units.append((_job_sort_key(pc.priority, job), [job]))
+            units.append((unit_key(pc.priority, job), [job]))
         for gang_id, members in by_gang.items():
             keys = {kidx.key_of(m, config.node_id_label) for m in members}
             if len(keys) > 1:
@@ -309,7 +343,7 @@ def build_problem(
                     ),
                 )
                 pc = config.priority_class(lead.priority_class)
-                units.append((_job_sort_key(pc.priority, lead), grp))
+                units.append((unit_key(pc.priority, lead), grp))
         units.sort(key=lambda u: u[0])
         base = len(evictee_by_queue[qi])
         for order, (_, members) in enumerate(units[: config.max_queue_lookback]):
@@ -325,6 +359,7 @@ def build_problem(
             g.card = len(members)
             g.order = base + order
             g.run = -1
+            g.price = float(price_of(lead))
             gang_members_out.append(g.jobs)
 
     G = _pad(len(gangs), bucket)
@@ -337,6 +372,7 @@ def build_problem(
     g_order = np.zeros((G,), np.int32)
     g_run = np.full((G,), -1, np.int32)
     g_valid = np.zeros((G,), bool)
+    g_price = np.zeros((G,), np.float32)
     for i, g in enumerate(gangs):
         g_req[i] = g.req
         g_card[i] = g.card
@@ -347,6 +383,7 @@ def build_problem(
         g_order[i] = g.order
         g_run[i] = g.run
         g_valid[i] = True
+        g_price[i] = g.price
 
     # --- pinned node for evictee slots is derived in-kernel from run_node -------
 
@@ -358,7 +395,22 @@ def build_problem(
         compat[: len(kidx), : len(ntidx)] = static_fit_matrix(kidx.keys, ntidx.types)
 
     # --- pool totals, DRF, caps -------------------------------------------------
+    floating_names = set(config.floating_resource_names())
+    node_axes = np.array(
+        [0.0 if name in floating_names else 1.0 for name in factory.names],
+        np.float32,
+    )
+    float_total = np.zeros((R,), np.float32)
+    if floating_names:
+        fl = factory.from_mapping(config.floating_totals_for_pool(pool))
+        # Same resolution-unit scale as node_total/g_req (floor like capacity).
+        float_total = (
+            factory.floor_units(fl.atoms).astype(np.float64) * (1 - node_axes)
+        ).astype(np.float32)
     total_pool = node_total[: len(pool_nodes)].sum(axis=0, dtype=np.float64).astype(np.float32)
+    # Floating capacity joins the pool totals for fairness + caps
+    # (scheduling_algo.go:289,585 adds GetTotalAvailableForPool).
+    total_pool = total_pool + float_total
     drf_mult = factory.multipliers_for(config.drf_multipliers()).astype(np.float32)
     scale = node_total.max(axis=0) if len(pool_nodes) else np.zeros(R, np.float32)
     inv_scale = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-9), 0.0).astype(np.float32)
@@ -429,6 +481,7 @@ def build_problem(
         g_order=g_order,
         g_run=g_run,
         g_valid=g_valid,
+        g_price=g_price,
         gq_gang=gq_gang,
         q_start=q_start,
         q_len=q_len,
@@ -443,6 +496,9 @@ def build_problem(
         protected_fraction=np.float32(config.protected_fraction_of_fair_share),
         global_burst=np.int32(min(burst, 2**31 - 1)),
         perq_burst=np.int32(config.maximum_per_queue_scheduling_burst or 2**31 - 1),
+        node_axes=node_axes,
+        float_total=float_total,
+        market=np.bool_(market),
     )
     ctx = HostContext(
         config=config,

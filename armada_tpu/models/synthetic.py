@@ -160,6 +160,7 @@ def synthetic_problem(
         g_order=g_order,
         g_run=g_run,
         g_valid=g_valid,
+        g_price=np.zeros((G,), np.float32),
         gq_gang=gq_gang,
         q_start=q_start,
         q_len=q_len,
@@ -174,6 +175,9 @@ def synthetic_problem(
         protected_fraction=np.float32(1.0),
         global_burst=np.int32(global_burst),
         perq_burst=np.int32(perq_burst),
+        node_axes=np.ones((R,), np.float32),
+        float_total=np.zeros((R,), np.float32),
+        market=np.bool_(False),
     )
     meta = dict(
         num_levels=3,

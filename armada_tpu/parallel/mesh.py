@@ -94,6 +94,7 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         g_order=jobsax,
         g_run=jobsax,
         g_valid=jobsax,
+        g_price=jobsax,
         # gq_gang is read-only index data gathered with [Q,W] indices every
         # iteration; replicated so the gather never crosses devices.
         gq_gang=repl,
@@ -110,6 +111,9 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         protected_fraction=repl,
         global_burst=repl,
         perq_burst=repl,
+        node_axes=repl,
+        float_total=repl,
+        market=repl,
     )
 
 

@@ -66,11 +66,24 @@ class FairSchedulingAlgo:
         clock_ns: Callable[[], int],
         run_id_factory: Callable[[], str] = _new_run_id,
         collect_stats: bool = True,
+        bid_prices=None,
+        priority_overrides=None,
     ):
+        """bid_prices: BidPriceProvider for market-driven pools;
+        priority_overrides: PriorityOverrideProvider replacing per-(pool,
+        queue) fair-share weights (scheduler/providers.py)."""
         self.config = config
         self._queues = queues
         self._clock_ns = clock_ns
         self._run_id = run_id_factory
+        self.bid_prices = bid_prices
+        self.priority_overrides = priority_overrides
+        market_pools = [p.name for p in config.pools if p.market_driven]
+        if market_pools and bid_prices is None:
+            raise ValueError(
+                f"pools {market_pools} are market driven: FairSchedulingAlgo "
+                "needs a bid_prices provider (scheduler/providers.py)"
+            )
         # Per-queue share stats cost an extra device->host transfer; turn off
         # when neither metrics nor reports are wired.
         self.collect_stats = collect_stats
@@ -165,19 +178,36 @@ class FairSchedulingAlgo:
                 )
             )
 
+        bid_price_of = None
+        if self.bid_prices is not None:
+            provider = self.bid_prices
+            bid_price_of = lambda job: provider.price(job.queue, job.price_band)  # noqa: E731
+
         for pool in pools:
             pool_nodes = [n for n in nodes if n.pool == pool]
             running = running_by_pool.get(pool, [])
             if not pool_nodes or (not queued_jobs and not running):
                 continue
+            pool_queues = queues
+            if self.priority_overrides is not None:
+                pool_queues = [
+                    (
+                        Queue(q.name, ov)
+                        if (ov := self.priority_overrides.override(pool, q.name))
+                        is not None
+                        else q
+                    )
+                    for q in queues
+                ]
             outcome = run_scheduling_round(
                 self.config,
                 pool=pool,
                 nodes=pool_nodes,
-                queues=queues,
+                queues=pool_queues,
                 queued_jobs=queued_jobs,
                 running=running,
                 collect_stats=self.collect_stats,
+                bid_price_of=bid_price_of,
             )
             self._apply_outcome(
                 txn, outcome, pool, executor_of_node, now_ns, result

@@ -118,6 +118,15 @@ class SubmitChecker:
             if lead.resources is not None
             else np.zeros(self._factory.num_resources)
         )
+        # Floating resources are pool-level, not node-level: exclude them from
+        # per-node fit and check them against the pool's floating totals
+        # (floating_resource_types.go; the kernel applies the same split).
+        floating_names = set(self.config.floating_resource_names())
+        floating_axes = np.array(
+            [1.0 if n in floating_names else 0.0 for n in self._factory.names]
+        )
+        req_node = req * (1.0 - floating_axes)
+        req_float = req * floating_axes
         candidate_pools = [
             p for p in self._pools if not lead.pools or p in lead.pools
         ]
@@ -131,6 +140,24 @@ class SubmitChecker:
         ok_pools = []
         best_reason = "does not fit on any node type"
         for pool in candidate_pools:
+            if np.any(req_float) and floating_names:
+                fl = self._factory.from_mapping(
+                    self.config.floating_totals_for_pool(pool)
+                )
+                fl_total = np.asarray(fl.atoms, dtype=np.float64)
+                if np.any(req_float * cardinality > fl_total):
+                    over = {
+                        self._factory.names[i]: int(
+                            req_float[i] * cardinality - fl_total[i]
+                        )
+                        for i in range(len(req_float))
+                        if req_float[i] * cardinality > fl_total[i]
+                    }
+                    best_reason = (
+                        f"pool {pool}: floating-resource request exceeds the "
+                        f"pool total by {over}"
+                    )
+                    continue
             nodes = self._pools[pool]
             ntidx = NodeTypeIndex(
                 set(self.config.indexed_node_labels) | set(lead.node_selector)
@@ -148,13 +175,15 @@ class SubmitChecker:
                 total = np.asarray(n.total_resources.atoms, dtype=np.float64)
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per_node = np.floor(
-                        np.where(req > 0, total / np.maximum(req, 1e-9), np.inf)
+                        np.where(
+                            req_node > 0, total / np.maximum(req_node, 1e-9), np.inf
+                        )
                     ).min()
                 # All-zero requests give inf; clip before int() (one bad event
                 # on the log must not wedge the scheduler thread).
                 per_node = min(per_node, float(cardinality))
                 if per_node <= 0:
-                    gap = np.where(req > total, req - total, 0)
+                    gap = np.where(req_node > total, req_node - total, 0)
                     biggest_gap = gap if biggest_gap is None else np.minimum(biggest_gap, gap)
                     continue
                 members_possible += int(per_node)

@@ -45,6 +45,7 @@ class JobSubmitItem:
     gang_cardinality: int = 1
     gang_node_uniformity_label: str = ""
     pools: tuple[str, ...] = ()
+    price_band: str = ""
     namespace: str = "default"
     annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
@@ -150,6 +151,7 @@ class SubmitServer:
                 gang_cardinality=item.gang_cardinality,
                 gang_node_uniformity_label=item.gang_node_uniformity_label,
                 pools=tuple(item.pools),
+                price_band=item.price_band,
             )
             msg = job_spec_to_proto(spec)
             msg.annotations.update(dict(item.annotations))

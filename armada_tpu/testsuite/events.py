@@ -1,0 +1,20 @@
+"""Shared event-stream vocabulary for the testsuite package."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def terminal_outcome(ev) -> Optional[tuple[str, str]]:
+    """(job_id, outcome) if this event ends a job, else None.
+
+    outcome: "job_succeeded" | "cancelled_job" | "failed".  The single source
+    of truth for what counts as terminal, shared by the spec runner and the
+    load tester.
+    """
+    kind = ev.WhichOneof("event")
+    if kind in ("job_succeeded", "cancelled_job"):
+        return getattr(ev, kind).job_id, kind
+    if kind == "job_errors" and any(e.terminal for e in ev.job_errors.errors):
+        return ev.job_errors.job_id, "failed"
+    return None

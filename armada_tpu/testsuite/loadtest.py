@@ -19,6 +19,8 @@ import dataclasses
 import time
 import uuid
 
+from armada_tpu.testsuite.events import terminal_outcome
+
 
 @dataclasses.dataclass(frozen=True)
 class LoadTestSpec:
@@ -124,13 +126,9 @@ class LoadTester:
                 ):
                     cursors[q] = item.idx + 1
                     for ev in item.sequence.events:
-                        kind = ev.WhichOneof("event")
-                        if kind in ("job_succeeded", "cancelled_job"):
-                            done[getattr(ev, kind).job_id] = kind
-                        elif kind == "job_errors" and any(
-                            e.terminal for e in ev.job_errors.errors
-                        ):
-                            done[ev.job_errors.job_id] = "failed"
+                        outcome = terminal_outcome(ev)
+                        if outcome is not None:
+                            done[outcome[0]] = outcome[1]
                     if len(done) >= num_jobs:
                         break
         drain_s = self._clock() - t0

@@ -1,0 +1,296 @@
+"""Floating resources + market-driven scheduling tests.
+
+Modeled on the reference's floatingresources tests (internal/scheduler/
+floatingresources/floating_resource_types_test.go; docs/floating_resources.md)
+and market scheduling tests (market_iterator / gang_pricer tests).
+"""
+
+import pytest
+
+from armada_tpu.core.config import (
+    FloatingResource,
+    PoolConfig,
+    SchedulingConfig,
+)
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.models import run_scheduling_round
+from armada_tpu.scheduler.providers import (
+    StaticBidPriceProvider,
+    StaticPriorityOverrideProvider,
+)
+
+FLOAT_CFG = SchedulingConfig(
+    shape_bucket=32,
+    floating_resources=(
+        FloatingResource("storage-connections", pools={"default": 10}),
+    ),
+)
+F = FLOAT_CFG.resource_list_factory()
+
+
+def nodes(n=2, cpu="16", mem="64"):
+    return [
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": cpu, "memory": mem}),
+        )
+        for i in range(n)
+    ]
+
+
+def job(jid, cpu="1", conns=0, queue="q", **kw):
+    req = {"cpu": cpu, "memory": "1"}
+    if conns:
+        req["storage-connections"] = conns
+    return JobSpec(id=jid, queue=queue, resources=F.from_mapping(req), **kw)
+
+
+def test_floating_resource_extends_the_axis():
+    assert "storage-connections" in F.names
+    # nodes don't carry it; pool totals do
+    assert FLOAT_CFG.floating_totals_for_pool("default") == {
+        "storage-connections": 10
+    }
+    assert FLOAT_CFG.floating_totals_for_pool("other") == {}
+
+
+def test_floating_capacity_limits_scheduling():
+    # 6 jobs x 3 connections; pool has 10 -> only 3 fit (9 <= 10), despite
+    # abundant node cpu.
+    jobs = [job(f"j{i}", conns=3) for i in range(6)]
+    outcome = run_scheduling_round(
+        FLOAT_CFG,
+        pool="default",
+        nodes=nodes(),
+        queues=[Queue("q")],
+        queued_jobs=jobs,
+    )
+    assert len(outcome.scheduled) == 3
+    # jobs without floating requests are unaffected
+    outcome2 = run_scheduling_round(
+        FLOAT_CFG,
+        pool="default",
+        nodes=nodes(),
+        queues=[Queue("q")],
+        queued_jobs=[job(f"p{i}") for i in range(8)],
+    )
+    assert len(outcome2.scheduled) == 8
+
+
+def test_floating_usage_of_running_jobs_counts():
+    running = [
+        RunningJob(job=job(f"r{i}", conns=4), node_id="n0") for i in range(2)
+    ]  # 8 of 10 used
+    outcome = run_scheduling_round(
+        FLOAT_CFG,
+        pool="default",
+        nodes=nodes(),
+        queues=[Queue("q")],
+        queued_jobs=[job("new1", conns=3), job("new2", conns=2)],
+        running=running,
+    )
+    # only the 2-connection job fits in the remaining 2
+    assert list(outcome.scheduled) == ["new2"]
+
+
+def test_floating_counts_toward_fairness():
+    # Floating resources join DRF when configured as fairness resources
+    # (dominantResourceFairnessResourcesToConsider).
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        floating_resources=FLOAT_CFG.floating_resources,
+        dominant_resource_fairness_resources=(
+            "cpu",
+            "memory",
+            "storage-connections",
+        ),
+    )
+    running = [
+        RunningJob(job=job("ra", conns=5, queue="qa"), node_id="n0"),
+        RunningJob(job=job("rb", queue="qb"), node_id="n1"),
+    ]
+    outcome = run_scheduling_round(
+        cfg,
+        pool="default",
+        nodes=nodes(),
+        queues=[Queue("qa"), Queue("qb")],
+        queued_jobs=[],
+        running=running,
+    )
+    assert outcome.queue_stats["qa"]["actual_share"] > outcome.queue_stats["qb"]["actual_share"]
+
+
+MARKET_CFG = SchedulingConfig(
+    shape_bucket=32,
+    pools=(PoolConfig("default", market_driven=True),),
+)
+MF = MARKET_CFG.resource_list_factory()
+
+
+def mjob(jid, queue, band="", cpu="4"):
+    return JobSpec(
+        id=jid,
+        queue=queue,
+        price_band=band,
+        resources=MF.from_mapping({"cpu": cpu, "memory": "1"}),
+    )
+
+
+def mnodes(n=1, cpu="8"):
+    return [
+        NodeSpec(
+            id=f"m{i}",
+            pool="default",
+            total_resources=MF.from_mapping({"cpu": cpu, "memory": "64"}),
+        )
+        for i in range(n)
+    ]
+
+
+def test_market_pool_orders_by_bid_price():
+    prices = StaticBidPriceProvider(
+        {("rich", "gold"): 10.0, ("poor", ""): 1.0}
+    )
+    price_of = lambda j: prices.price(j.queue, j.price_band)  # noqa: E731
+    # capacity for 2 jobs; DRF would alternate queues, price order gives both
+    # slots to the rich queue's gold-band jobs.
+    outcome = run_scheduling_round(
+        MARKET_CFG,
+        pool="default",
+        nodes=mnodes(),
+        queues=[Queue("poor"), Queue("rich")],
+        queued_jobs=[
+            mjob("p1", "poor"),
+            mjob("p2", "poor"),
+            mjob("r1", "rich", band="gold"),
+            mjob("r2", "rich", band="gold"),
+        ],
+        bid_price_of=price_of,
+    )
+    assert set(outcome.scheduled) == {"r1", "r2"}
+
+
+def test_market_pool_requires_prices():
+    with pytest.raises(ValueError, match="market driven"):
+        run_scheduling_round(
+            MARKET_CFG,
+            pool="default",
+            nodes=mnodes(),
+            queues=[Queue("q")],
+            queued_jobs=[mjob("x", "q")],
+        )
+
+
+def test_non_market_pool_ignores_prices():
+    cfg = SchedulingConfig(shape_bucket=32)
+    f = cfg.resource_list_factory()
+    outcome = run_scheduling_round(
+        cfg,
+        pool="default",
+        nodes=[
+            NodeSpec(
+                id="n0",
+                pool="default",
+                total_resources=f.from_mapping({"cpu": "8", "memory": "64"}),
+            )
+        ],
+        queues=[Queue("a"), Queue("b")],
+        queued_jobs=[
+            JobSpec(id="a1", queue="a", resources=f.from_mapping({"cpu": "4", "memory": "1"})),
+            JobSpec(id="b1", queue="b", resources=f.from_mapping({"cpu": "4", "memory": "1"})),
+        ],
+        bid_price_of=lambda j: 100.0 if j.queue == "a" else 0.0,
+    )
+    # DRF still splits capacity evenly
+    assert set(outcome.scheduled) == {"a1", "b1"}
+
+
+def test_floating_job_passes_validation_and_schedules(tmp_path):
+    """End-to-end: a job requesting a floating resource must clear the submit
+    checker (floating axes are pool-level, not node-level) and schedule."""
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+    from tests.control_plane import ControlPlane
+
+    cp = ControlPlane.build(tmp_path, config=FLOAT_CFG)
+    cp.server.create_queue(QueueRecord("q"))
+    for ex in cp.executors:
+        ex.run_once()
+    ok = cp.server.submit_jobs(
+        "q",
+        "fl",
+        [JobSubmitItem(resources={"cpu": "1", "memory": "1", "storage-connections": 3})],
+    )
+    too_many = cp.server.submit_jobs(
+        "q",
+        "fl",
+        [JobSubmitItem(resources={"cpu": "1", "memory": "1", "storage-connections": 11})],
+    )
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    states = cp.job_states()
+    assert states[ok[0]] == "leased"
+    assert states[too_many[0]] == "failed"  # exceeds the pool's 10 connections
+    cp.close()
+
+
+def test_market_pool_without_provider_fails_fast():
+    from armada_tpu.scheduler import FairSchedulingAlgo
+
+    with pytest.raises(ValueError, match="market driven"):
+        FairSchedulingAlgo(
+            MARKET_CFG, queues=lambda: [], clock_ns=lambda: 0
+        )
+
+
+def test_yaml_parses_market_and_floating(tmp_path):
+    from armada_tpu.core.config import scheduling_config_from_yaml
+
+    path = tmp_path / "cfg.yaml"
+    path.write_text(
+        """
+scheduling:
+  pools:
+    - name: market
+      marketDriven: true
+    - name: batch
+  floatingResources:
+    - name: storage-connections
+      pools:
+        - name: batch
+          quantity: 25
+"""
+    )
+    cfg = scheduling_config_from_yaml(str(path))
+    assert cfg.pools[0].market_driven and not cfg.pools[1].market_driven
+    assert cfg.floating_totals_for_pool("batch") == {"storage-connections": 25}
+
+
+def test_priority_override_provider_changes_weights(tmp_path):
+    from armada_tpu.scheduler import FairSchedulingAlgo
+    from tests.control_plane import ControlPlane
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+
+    cp = ControlPlane.build(tmp_path)
+    cp.server.create_queue(QueueRecord("a", weight=1.0))
+    cp.server.create_queue(QueueRecord("b", weight=1.0))
+    # override flips a to weight 3 in pool default
+    cp.scheduler.algo.priority_overrides = StaticPriorityOverrideProvider(
+        {("default", "a"): 3.0}
+    )
+    for q in ("a", "b"):
+        cp.server.submit_jobs(
+            q, "w", [JobSubmitItem(resources={"cpu": "2", "memory": "1"}) for _ in range(8)]
+        )
+    for ex in cp.executors:
+        ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    txn = cp.jobdb.read_txn()
+    by_queue = {"a": 0, "b": 0}
+    for j in txn.all_jobs():
+        if j.has_active_run():
+            by_queue[j.queue] += 1
+    assert by_queue["a"] > by_queue["b"], by_queue
+    cp.close()
