@@ -121,3 +121,6 @@ class RunningJob:
     node_id: str
     # Priority at which its resources are held (normally its PC priority).
     priority: int = 0
+    # Scheduled away from its home pool: held at the lowest priority level and
+    # always evictable by home jobs (scheduling_algo.go:216-283).
+    away: bool = False

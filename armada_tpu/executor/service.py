@@ -80,6 +80,37 @@ class ExecutorService:
         request = LeaseRequest(snapshot=self.snapshot(), active_run_ids=active)
         response = self.api.lease_job_runs(request)
 
+        # Stop dead runs FIRST: a new lease may target the very capacity a
+        # cancelled/preempted pod still holds (e.g. home jobs displacing away
+        # guests in the same cycle) -- submitting before deleting would bounce
+        # the new pod off a full node.
+        for run_id in response.runs_to_cancel:
+            self.cluster.delete_pod(run_id)
+            self._reported.pop(run_id, None)
+            # The scheduler knows this run is dead: stop advertising it.
+            self._awaiting_ack.discard(run_id)
+
+        preempted: list[pb.EventSequence] = []
+        for run_id in response.runs_to_preempt:
+            pod = self.cluster.get_pod(run_id)
+            self.cluster.delete_pod(run_id)
+            self._reported.pop(run_id, None)
+            # Same re-lease race as cleanup(): keep advertising the run until
+            # the scheduler has ingested the preemption and cancels it.
+            self._awaiting_ack.add(run_id)
+            if pod is not None:
+                ev = pb.Event(
+                    created_ns=int(self._clock() * 1e9),
+                    job_run_preempted=pb.JobRunPreempted(
+                        job_id=pod.job_id, run_id=run_id, reason="preemptRequested"
+                    ),
+                )
+                preempted.append(
+                    pb.EventSequence(
+                        queue=pod.queue, jobset=pod.jobset, events=[ev]
+                    )
+                )
+
         errors: list[pb.EventSequence] = []
         for lease in response.leases:
             if lease.run_id in self._rejected:
@@ -111,33 +142,6 @@ class ExecutorService:
                         reason="podSubmissionRejected",
                         message=str(e),
                         now_ns=int(self._clock() * 1e9),
-                    )
-                )
-
-        for run_id in response.runs_to_cancel:
-            self.cluster.delete_pod(run_id)
-            self._reported.pop(run_id, None)
-            # The scheduler knows this run is dead: stop advertising it.
-            self._awaiting_ack.discard(run_id)
-
-        preempted: list[pb.EventSequence] = []
-        for run_id in response.runs_to_preempt:
-            pod = self.cluster.get_pod(run_id)
-            self.cluster.delete_pod(run_id)
-            self._reported.pop(run_id, None)
-            # Same re-lease race as cleanup(): keep advertising the run until
-            # the scheduler has ingested the preemption and cancels it.
-            self._awaiting_ack.add(run_id)
-            if pod is not None:
-                ev = pb.Event(
-                    created_ns=int(self._clock() * 1e9),
-                    job_run_preempted=pb.JobRunPreempted(
-                        job_id=pod.job_id, run_id=run_id, reason="preemptRequested"
-                    ),
-                )
-                preempted.append(
-                    pb.EventSequence(
-                        queue=pod.queue, jobset=pod.jobset, events=[ev]
                     )
                 )
 

@@ -26,6 +26,7 @@ def run_scheduling_round(
     running=(),
     collect_stats=True,
     bid_price_of=None,
+    away_mode=False,
 ):
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
@@ -42,11 +43,12 @@ def run_scheduling_round(
         queued_jobs=queued_jobs,
         running=running,
         bid_price_of=bid_price_of,
+        away_mode=away_mode,
     )
     device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
     result = schedule_round(
         device_problem,
-        num_levels=len(ctx.ladder) + 1,
+        num_levels=len(ctx.ladder) + 2,
         max_slots=ctx.max_slots,
         slot_width=ctx.slot_width,
     )

@@ -10,7 +10,10 @@ Layout conventions (see SURVEY.md section 7 "Tensor reformulation"):
 - P levels: priority ladder index; level 0 is reserved for the *evicted* marker
   priority (the reference's internaltypes.EvictedPriority = -1): resources of evicted
   jobs stay counted at level 0 so clean fit ("schedule without preemption",
-  nodedb.go:506-514) sees them, while fit at a real priority does not.
+  nodedb.go:506-514) sees them, while fit at a real priority does not.  Level 1 is
+  reserved for *away* placements (jobs borrowed onto another pool's nodes,
+  scheduling_algo.go:216-283): below every real priority, so any home job can
+  urgency-preempt them; real PC priorities occupy levels 2 and up.
 - Gangs are the unit of scheduling; a plain job is a gang of cardinality 1.  Every
   *preemptible running job* also gets a gang slot (its "evictee" re-scheduling
   candidate, pinned to its node), activated in-kernel only if the job is actually
@@ -171,9 +174,15 @@ def build_problem(
     queued_jobs: Sequence[JobSpec],
     running: Sequence[RunningJob] = (),
     bid_price_of=None,
+    away_mode: bool = False,
 ) -> tuple[SchedulingProblem, HostContext]:
     """`bid_price_of(job) -> float` supplies bid prices; required for pools
-    configured market_driven (pricer/gang_pricer.go:29-40)."""
+    configured market_driven (pricer/gang_pricer.go:29-40).
+
+    away_mode=True places queued gangs at the LOWEST real priority level (an
+    away round: jobs borrowing another pool's nodes, scheduling_algo.go:216-283);
+    they then never preempt anything, and home jobs evict them later via
+    urgency preemption since away runs hold resources at level 1."""
     factory = config.resource_list_factory()
     R = factory.num_resources
     bucket = config.shape_bucket
@@ -188,8 +197,9 @@ def build_problem(
     sorted_queues = sorted(queues, key=lambda q: q.name)
 
     # --- priority ladder: level 0 = evicted marker, 1..P = distinct PC priorities.
+    # Levels: 0 = evicted markers, 1 = away placements, 2.. = the PC ladder.
     ladder = config.priority_ladder()
-    level_of_priority = {p: i + 1 for i, p in enumerate(ladder)}
+    level_of_priority = {p: i + 2 for i, p in enumerate(ladder)}
     pc_names = sorted(config.priority_classes)
     pc_index = {name: i for i, name in enumerate(pc_names)}
 
@@ -260,15 +270,22 @@ def build_problem(
         run_req[ri] = factory.ceil_units(r.job.resources.atoms) if r.job.resources else 0
         run_node[ri] = node_index[r.node_id]
         pc = config.priority_class(r.job.priority_class)
-        run_level[ri] = level_of_priority[pc.priority]
+        if r.away:
+            # Away runs hold resources at the lowest real level and are
+            # always evictable by home jobs (scheduling_algo.go:216-283).
+            run_level[ri] = 1
+            preemptible = True
+        else:
+            run_level[ri] = level_of_priority[pc.priority]
+            preemptible = pc.preemptible
         qi = queue_by_name.get(r.job.queue, -1)
         if qi < 0:
             continue  # unknown queue: cannot be evicted (pqs.go:129-131)
         run_queue[ri] = qi
         run_pc[ri] = pc_index[pc.name]
-        run_preemptible[ri] = pc.preemptible
+        run_preemptible[ri] = preemptible
         run_valid[ri] = True
-        if pc.preemptible:
+        if preemptible:
             evictee_by_queue[qi].append(ri)
 
     run_gang = np.full((RJ,), -1, np.int32)
@@ -285,7 +302,7 @@ def build_problem(
         else:
             ris.sort(
                 key=lambda ri: _job_sort_key(
-                    ladder[run_level[ri] - 1], run_list[ri].job
+                    ladder[max(run_level[ri] - 2, 0)], run_list[ri].job
                 )
             )
         for order, ri in enumerate(ris):
@@ -353,7 +370,7 @@ def build_problem(
             g.jobs = [m.id for m in members]
             g.queue = qi
             g.key = kidx.key_of(lead, config.node_id_label)
-            g.level = job_level(lead)
+            g.level = 1 if away_mode else job_level(lead)
             g.pc = pc_index[pc.name]
             g.req = factory.ceil_units(lead.resources.atoms).astype(np.float32) if lead.resources else np.zeros(R, np.float32)
             g.card = len(members)
@@ -493,7 +510,12 @@ def build_problem(
         inv_scale=inv_scale,
         round_cap=round_cap,
         pc_queue_cap=pc_queue_cap.astype(np.float32),
-        protected_fraction=np.float32(config.protected_fraction_of_fair_share),
+        # Away rounds never evict: guests take genuinely free capacity only
+        # (the host's home rounds handle eviction; an away guest must not be
+        # able to displace a home job).
+        protected_fraction=np.float32(
+            _INF if away_mode else config.protected_fraction_of_fair_share
+        ),
         global_burst=np.int32(min(burst, 2**31 - 1)),
         perq_burst=np.int32(config.maximum_per_queue_scheduling_burst or 2**31 - 1),
         node_axes=node_axes,

@@ -175,6 +175,7 @@ class FairSchedulingAlgo:
                     job=dataclasses.replace(job.spec, priority=job.priority),
                     node_id=run.node_id,
                     priority=run.scheduled_at_priority or 0,
+                    away=run.pool_scheduled_away,
                 )
             )
 
@@ -183,27 +184,29 @@ class FairSchedulingAlgo:
             provider = self.bid_prices
             bid_price_of = lambda job: provider.price(job.queue, job.price_band)  # noqa: E731
 
+        def pool_queues(pool: str) -> list:
+            if self.priority_overrides is None:
+                return queues
+            return [
+                (
+                    Queue(q.name, ov)
+                    if (ov := self.priority_overrides.override(pool, q.name))
+                    is not None
+                    else q
+                )
+                for q in queues
+            ]
+
         for pool in pools:
             pool_nodes = [n for n in nodes if n.pool == pool]
             running = running_by_pool.get(pool, [])
             if not pool_nodes or (not queued_jobs and not running):
                 continue
-            pool_queues = queues
-            if self.priority_overrides is not None:
-                pool_queues = [
-                    (
-                        Queue(q.name, ov)
-                        if (ov := self.priority_overrides.override(pool, q.name))
-                        is not None
-                        else q
-                    )
-                    for q in queues
-                ]
             outcome = run_scheduling_round(
                 self.config,
                 pool=pool,
                 nodes=pool_nodes,
-                queues=pool_queues,
+                queues=pool_queues(pool),
                 queued_jobs=queued_jobs,
                 running=running,
                 collect_stats=self.collect_stats,
@@ -228,6 +231,84 @@ class FairSchedulingAlgo:
                     j for j in queued_jobs if j.id not in scheduled_ids
                 ]
 
+        # Away pass (scheduling_algo.go:216-283, nodePools:282): a pool's
+        # still-queued jobs borrow nodes FROM its configured away_pools, at the
+        # away priority level so the host pool's home jobs can always evict
+        # them.  The host's running set is refreshed with this cycle's own
+        # decisions (leases added, preemptions removed) so the away round
+        # cannot double-book capacity the home rounds just committed.
+        preempted_ids = {job.id for job, _ in result.preempted}
+        extra_running: dict[str, list[RunningJob]] = {}
+        for job, run in result.scheduled:
+            extra_running.setdefault(run.pool, []).append(
+                RunningJob(
+                    job=dataclasses.replace(job.spec, priority=job.priority),
+                    node_id=run.node_id,
+                    priority=run.scheduled_at_priority or 0,
+                    away=run.pool_scheduled_away,
+                )
+            )
+
+        def host_running(host: str) -> list[RunningJob]:
+            kept = [
+                r
+                for r in running_by_pool.get(host, [])
+                if r.job.id not in preempted_ids
+            ]
+            return kept + extra_running.get(host, [])
+
+        for pool_cfg in self.config.pools:
+            if not pool_cfg.away_pools:
+                continue
+            home_pool = pool_cfg.name
+            away_jobs = [
+                j
+                for j in queued_jobs
+                if j.pools and home_pool in j.pools
+            ]
+            if not away_jobs:
+                continue
+            for host in pool_cfg.away_pools:
+                host_nodes = [n for n in nodes if n.pool == host]
+                if not host_nodes or not away_jobs:
+                    continue
+                outcome = run_scheduling_round(
+                    self.config,
+                    pool=host,
+                    nodes=host_nodes,
+                    queues=pool_queues(host),
+                    queued_jobs=[
+                        dataclasses.replace(j, pools=(host,)) for j in away_jobs
+                    ],
+                    running=host_running(host),
+                    collect_stats=False,
+                    bid_price_of=bid_price_of,
+                    away_mode=True,
+                )
+                self._apply_outcome(
+                    txn, outcome, host, executor_of_node, now_ns, result, away=True
+                )
+                scheduled_ids = set(outcome.scheduled)
+                if scheduled_ids:
+                    queued_jobs = [
+                        j for j in queued_jobs if j.id not in scheduled_ids
+                    ]
+                    away_jobs = [
+                        j for j in away_jobs if j.id not in scheduled_ids
+                    ]
+                    for job, run in result.scheduled:
+                        if job.id in scheduled_ids:
+                            extra_running.setdefault(run.pool, []).append(
+                                RunningJob(
+                                    job=dataclasses.replace(
+                                        job.spec, priority=job.priority
+                                    ),
+                                    node_id=run.node_id,
+                                    priority=run.scheduled_at_priority or 0,
+                                    away=True,
+                                )
+                            )
+
         return result
 
     # --- applying a pool outcome to the txn ---------------------------------
@@ -240,7 +321,9 @@ class FairSchedulingAlgo:
         executor_of_node: dict,
         now_ns: int,
         result: SchedulerResult,
+        away: bool = False,
     ) -> None:
+        away_priority = self.config.priority_ladder()[0]
         for job_id, node_id in outcome.scheduled.items():
             job = txn.get(job_id)
             if job is None:
@@ -254,7 +337,8 @@ class FairSchedulingAlgo:
                 node_id=node_id,
                 node_name=node_id,
                 pool=pool,
-                scheduled_at_priority=pc.priority,
+                scheduled_at_priority=away_priority if away else pc.priority,
+                pool_scheduled_away=away,
             )
             job = job.with_new_run(run)
             txn.upsert(job)

@@ -127,8 +127,20 @@ class SubmitChecker:
         )
         req_node = req * (1.0 - floating_axes)
         req_float = req * floating_axes
+        # Pools that may host this job away from home (scheduling_algo.go:282:
+        # a pool's jobs may borrow nodes from its away_pools): feasibility
+        # there validates the job, but its pools stay the home ones -- only
+        # the away pass may use the host pool, at away priority.
+        away_hosts = {
+            host
+            for pc in self.config.pools
+            if pc.name in lead.pools
+            for host in pc.away_pools
+        }
         candidate_pools = [
-            p for p in self._pools if not lead.pools or p in lead.pools
+            p
+            for p in self._pools
+            if not lead.pools or p in lead.pools or p in away_hosts
         ]
         if not candidate_pools:
             return CheckResult(
@@ -138,6 +150,7 @@ class SubmitChecker:
             )
 
         ok_pools = []
+        ok_away = False
         best_reason = "does not fit on any node type"
         for pool in candidate_pools:
             if np.any(req_float) and floating_names:
@@ -190,7 +203,10 @@ class SubmitChecker:
                 if members_possible >= cardinality:
                     break
             if members_possible >= cardinality:
-                ok_pools.append(pool)
+                if lead.pools and pool not in lead.pools:
+                    ok_away = True  # fits only as an away guest
+                else:
+                    ok_pools.append(pool)
             elif members_possible > 0:
                 best_reason = (
                     f"pool {pool}: only {members_possible} of {cardinality} "
@@ -208,4 +224,8 @@ class SubmitChecker:
 
         if ok_pools:
             return CheckResult(True, pools=tuple(sorted(ok_pools)))
+        if ok_away:
+            # Feasible only away: keep the home designation; the away pass
+            # picks it up (scheduling_algo.go:216-283).
+            return CheckResult(True, pools=tuple(lead.pools))
         return CheckResult(False, best_reason)

@@ -23,7 +23,7 @@ def _both_rounds(cfg, nodes, queues, jobs, running=(), mesh=None):
         cfg, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs, running=running
     )
     kw = dict(
-        num_levels=len(ctx.ladder) + 1,
+        num_levels=len(ctx.ladder) + 2,
         max_slots=ctx.max_slots,
         slot_width=ctx.slot_width,
     )
