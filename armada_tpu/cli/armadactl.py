@@ -366,6 +366,55 @@ def cmd_load_test(args):
     return 0
 
 
+def _binoculars_call(args, fn):
+    """Binoculars lives NEXT TO each executor (its --binoculars-port), not on
+    the control plane; translate the inevitable wrong-URL mistake."""
+    import grpc
+
+    from armada_tpu.rpc.client import BinocularsClient
+
+    client = BinocularsClient(args.url)
+    try:
+        return fn(client)
+    except grpc.RpcError as e:
+        if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+            print(
+                f"error: no binoculars service at {args.url} -- logs/cordon are "
+                "served per cluster; point --url at an executor's "
+                "--binoculars-port address",
+                file=sys.stderr,
+            )
+            return None
+        raise
+    finally:
+        client.close()
+
+
+def cmd_logs(args):
+    text = _binoculars_call(
+        args, lambda c: c.logs(job_id=args.job_id or "", run_id=args.run_id or "")
+    )
+    if text is None:
+        return 1
+    print(text)
+    return 0
+
+
+def cmd_cordon_node(args):
+    def go(c):
+        if args.uncordon:
+            c.uncordon(args.node)
+            return f"uncordoned node {args.node}"
+        c.cordon(args.node)
+        return f"cordoned node {args.node}"
+
+    msg = _binoculars_call(args, go)
+    if msg is None:
+        return 1
+    print(msg)
+    return 0
+
+
 def cmd_serve(args):
     from armada_tpu.cli.serve import start_control_plane
 
@@ -404,6 +453,7 @@ def cmd_executor(args):
             memory=args.memory,
             interval_s=args.interval,
             default_runtime_s=args.default_runtime,
+            binoculars_port=args.binoculars_port,
         )
     except KeyboardInterrupt:
         pass
@@ -532,7 +582,20 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument(
         "--default-runtime", type=float, default=10.0, help="simulated pod runtime"
     )
+    ex.add_argument(
+        "--binoculars-port", type=int, help="host a logs/cordon service on this port"
+    )
     ex.set_defaults(fn=cmd_executor)
+
+    lg = sub.add_parser("logs", help="pod logs via a binoculars endpoint")
+    lg.add_argument("--job-id")
+    lg.add_argument("--run-id")
+    lg.set_defaults(fn=cmd_logs)
+
+    cn = sub.add_parser("cordon-node", help="(un)cordon a node via binoculars")
+    cn.add_argument("node")
+    cn.add_argument("--uncordon", action="store_true")
+    cn.set_defaults(fn=cmd_cordon_node)
 
     return p
 

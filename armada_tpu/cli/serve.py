@@ -224,6 +224,7 @@ def run_fake_executor(
     stop: Optional[threading.Event] = None,
     config: Optional[SchedulingConfig] = None,
     default_runtime_s: float = 10.0,
+    binoculars_port: Optional[int] = None,
 ) -> None:
     """`armadactl executor`: a fake-cluster agent against a remote control
     plane (cmd/fakeexecutor)."""
@@ -249,6 +250,15 @@ def run_fake_executor(
     )
     api = ExecutorApiClient(server_address)
     agent = ExecutorService(executor_id, pool, cluster, api, factory)
+    binoculars_server = None
+    if binoculars_port is not None:
+        from armada_tpu.executor.binoculars import Binoculars
+        from armada_tpu.rpc.server import make_server
+
+        binoculars_server, bport = make_server(
+            binoculars=Binoculars(cluster), address=f"127.0.0.1:{binoculars_port}"
+        )
+        print(f"binoculars (logs/cordon) on 127.0.0.1:{bport}")
     stop = stop or threading.Event()
     last = time.monotonic()
     try:
@@ -259,4 +269,6 @@ def run_fake_executor(
             agent.run_once()
             stop.wait(interval_s)
     finally:
+        if binoculars_server is not None:
+            binoculars_server.stop(1)
         api.close()

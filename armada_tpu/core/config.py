@@ -113,6 +113,10 @@ class SchedulingConfig:
     enable_assertions: bool = False
     # Pool-level resources never bound to nodes (floatingresources/).
     floating_resources: tuple[FloatingResource, ...] = ()
+    # Optimiser: targeted preemption for stuck jobs (optimiser/node_scheduler.go).
+    optimiser_enabled: bool = False
+    optimiser_max_stuck_jobs: int = 10
+    optimiser_maximum_job_size_to_preempt: Optional[Mapping[str, "str | int"]] = None
     # Device-shape bucketing: round padded axis sizes up to the next multiple to
     # bound jit recompilation (ours; no reference equivalent -- Go has no shapes).
     shape_bucket: int = 256
@@ -145,6 +149,7 @@ class SchedulingConfig:
                     (fr.name, fr.resolution, tuple(sorted(fr.pools.items())))
                     for fr in self.floating_resources
                 ),
+                self.optimiser_enabled,
             )
         )
 
@@ -232,6 +237,9 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("maxRetries", "max_retries"),
         ("nodeIdLabel", "node_id_label"),
         ("enableAssertions", "enable_assertions"),
+        ("optimiserEnabled", "optimiser_enabled"),
+        ("optimiserMaxStuckJobs", "optimiser_max_stuck_jobs"),
+        ("optimiserMaximumJobSizeToPreempt", "optimiser_maximum_job_size_to_preempt"),
     ]:
         if yaml_key in d:
             kw[attr] = d[yaml_key]

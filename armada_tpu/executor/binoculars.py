@@ -1,0 +1,33 @@
+"""Binoculars-lite: pod log retrieval + node cordon, next to the cluster.
+
+Equivalent of the reference's binoculars service (internal/binoculars:
+logs.go:39-43 reads pod logs straight from kube-api, cordon.go patches node
+schedulability) -- deployed per cluster beside the executor, NOT behind the
+control plane, because logs/cordon are cluster-local concerns.  Here it wraps
+the executor's ClusterContext; the gRPC surface lives in armada_tpu.rpc.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from armada_tpu.executor.cluster import ClusterContext
+
+
+class Binoculars:
+    def __init__(self, cluster: ClusterContext):
+        self._cluster = cluster
+
+    def logs(self, job_id: str = "", run_id: str = "") -> str:
+        """Log text of the job's (latest) pod; raises KeyError if unknown."""
+        if run_id:
+            return self._cluster.pod_logs(run_id)
+        if not job_id:
+            raise KeyError("job_id or run_id required")
+        pods = [p for p in self._cluster.pod_states() if p.job_id == job_id]
+        if not pods:
+            raise KeyError(f"no pod for job {job_id}")
+        return self._cluster.pod_logs(pods[-1].run_id)
+
+    def cordon(self, node_id: str, cordoned: bool = True) -> None:
+        self._cluster.cordon_node(node_id, cordoned)

@@ -29,6 +29,7 @@ class _Pod:
     requests: np.ndarray  # atoms
     start_at: float
     finish_at: float
+    log: list = dataclasses.field(default_factory=list)
 
 
 class FakeClusterContext:
@@ -103,6 +104,7 @@ class FakeClusterContext:
             requests=req,
             start_at=self.now + self._start_delay,
             finish_at=self.now + self._start_delay + runtime,
+            log=[f"[t={self.now:.1f}] pod created for job {job_id} on {node_id}"],
         )
 
     def delete_pod(self, run_id: str) -> None:
@@ -131,8 +133,10 @@ class FakeClusterContext:
         for pod in self._pods.values():
             if pod.state.phase is PodPhase.PENDING and self.now >= pod.start_at:
                 pod.state.phase = PodPhase.RUNNING
+                pod.log.append(f"[t={self.now:.1f}] container started")
             if pod.state.phase is PodPhase.RUNNING and self.now >= pod.finish_at:
                 pod.state.phase = PodPhase.SUCCEEDED
+                pod.log.append(f"[t={self.now:.1f}] exit 0")
                 self._allocated[pod.state.node_id] -= pod.requests
 
     def fail_pod(self, run_id: str, message: str = "injected failure") -> None:
@@ -142,3 +146,24 @@ class FakeClusterContext:
             self._allocated[pod.state.node_id] -= pod.requests
         pod.state.phase = PodPhase.FAILED
         pod.state.message = message
+        pod.log.append(f"[t={self.now:.1f}] FAILED: {message}")
+
+    # --- binoculars surface (logs + cordon) --------------------------------
+
+    def pod_logs(self, run_id: str) -> str:
+        """The pod's log text (reference: binoculars logs.go:43 reads via
+        kube-api; the fake synthesizes lifecycle lines)."""
+        pod = self._pods.get(run_id)
+        if pod is None:
+            raise KeyError(f"no pod for run {run_id}")
+        return "\n".join(pod.log)
+
+    def cordon_node(self, node_id: str, cordoned: bool = True) -> None:
+        """Mark a node (un)schedulable (binoculars cordon.go); the change
+        propagates to the scheduler with the next snapshot."""
+        import dataclasses as _dc
+
+        node = self._nodes.get(node_id)
+        if node is None:
+            raise KeyError(f"unknown node {node_id}")
+        self._nodes[node_id] = _dc.replace(node, unschedulable=cordoned)

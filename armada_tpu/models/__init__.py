@@ -27,6 +27,8 @@ def run_scheduling_round(
     collect_stats=True,
     bid_price_of=None,
     away_mode=False,
+    global_tokens=None,
+    queue_tokens=None,
 ):
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
@@ -44,6 +46,8 @@ def run_scheduling_round(
         running=running,
         bid_price_of=bid_price_of,
         away_mode=away_mode,
+        global_tokens=global_tokens,
+        queue_tokens=queue_tokens,
     )
     device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
     result = schedule_round(

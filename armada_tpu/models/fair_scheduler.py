@@ -213,7 +213,7 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         # retires them before candidate selection)
         hit_burst = (~is_evictee) & (c.sched_count + card > p.global_burst)
         hit_round_cap = (~is_evictee) & jnp.any(c.sched_res + req_tot > p.round_cap)
-        hit_q_burst = (~is_evictee) & (c.q_sched[qstar] + card > p.perq_burst)
+        hit_q_burst = (~is_evictee) & (c.q_sched[qstar] + card > p.perq_burst[qstar])
         hit_q_cap = (~is_evictee) & jnp.any(
             c.q_alloc_pc[qstar, pc] + req_tot > p.pc_queue_cap[pc]
         )

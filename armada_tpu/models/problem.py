@@ -91,7 +91,7 @@ class SchedulingProblem(NamedTuple):
     pc_queue_cap: np.ndarray  # f32[C, R] per-queue cap by priority class (absolute)
     protected_fraction: np.ndarray  # f32 scalar
     global_burst: np.ndarray  # i32 scalar
-    perq_burst: np.ndarray  # i32 scalar
+    perq_burst: np.ndarray  # i32[Q] per-queue burst (rate-limited)
     # Floating resources (floatingresources/): 1.0 on node-bound axes, 0.0 on
     # floating axes; per-pool floating capacity (0 on node axes).
     node_axes: np.ndarray  # f32[R]
@@ -175,6 +175,8 @@ def build_problem(
     running: Sequence[RunningJob] = (),
     bid_price_of=None,
     away_mode: bool = False,
+    global_tokens=None,
+    queue_tokens=None,
 ) -> tuple[SchedulingProblem, HostContext]:
     """`bid_price_of(job) -> float` supplies bid prices; required for pools
     configured market_driven (pricer/gang_pricer.go:29-40).
@@ -182,7 +184,10 @@ def build_problem(
     away_mode=True places queued gangs at the LOWEST real priority level (an
     away round: jobs borrowing another pool's nodes, scheduling_algo.go:216-283);
     they then never preempt anything, and home jobs evict them later via
-    urgency preemption since away runs hold resources at level 1."""
+    urgency preemption since away runs hold resources at level 1.
+
+    global_tokens / queue_tokens clamp the burst caps to the scheduler's rate
+    limiters (maximumSchedulingRate token buckets, queue_scheduler.go)."""
     factory = config.resource_list_factory()
     R = factory.num_resources
     bucket = config.shape_bucket
@@ -469,13 +474,23 @@ def build_problem(
             frac = np.where(total_pool > 0, capped / np.maximum(total_pool, 1e-9), 0.0)
         q_cds[qi] = max(0.0, float((frac * drf_mult).max())) if R else 0.0
 
+    # --- burst caps, clamped by the rate limiters' available tokens -----------
+    burst_cfg = config.maximum_scheduling_burst or 2**31 - 1
+    if global_tokens is not None:
+        burst_cfg = max(0, min(burst_cfg, int(global_tokens)))
+    perq_cfg = config.maximum_per_queue_scheduling_burst or 2**31 - 1
+    perq_burst = np.full((Q,), 2**31 - 1, np.int32)
+    for qi, q in enumerate(sorted_queues):
+        cap = perq_cfg
+        if queue_tokens is not None and q.name in queue_tokens:
+            cap = max(0, min(cap, int(queue_tokens[q.name])))
+        perq_burst[qi] = min(cap, 2**31 - 1)
+
     max_card = int(g_card.max()) if len(gangs) else 1
     if max_card > 10_000:
         raise ValueError(f"gang cardinality {max_card} exceeds the supported 10k")
     W = max(1, min(max_card, N))
-    # burst 0 means unlimited (like the per-queue knob below)
-    burst = config.maximum_scheduling_burst if config.maximum_scheduling_burst else 2**31 - 1
-    S = max(1, min(len(gangs), burst))
+    S = max(1, min(len(gangs), burst_cfg))
 
     problem = SchedulingProblem(
         node_total=node_total,
@@ -516,8 +531,8 @@ def build_problem(
         protected_fraction=np.float32(
             _INF if away_mode else config.protected_fraction_of_fair_share
         ),
-        global_burst=np.int32(min(burst, 2**31 - 1)),
-        perq_burst=np.int32(config.maximum_per_queue_scheduling_burst or 2**31 - 1),
+        global_burst=np.int32(min(burst_cfg, 2**31 - 1)),
+        perq_burst=perq_burst,
         node_axes=node_axes,
         float_total=float_total,
         market=np.bool_(market),

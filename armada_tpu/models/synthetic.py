@@ -174,7 +174,7 @@ def synthetic_problem(
         pc_queue_cap=np.full((C, R), _INF, np.float32),
         protected_fraction=np.float32(1.0),
         global_burst=np.int32(global_burst),
-        perq_burst=np.int32(perq_burst),
+        perq_burst=np.full((Q,), perq_burst, np.int32),
         node_axes=np.ones((R,), np.float32),
         float_total=np.zeros((R,), np.float32),
         market=np.bool_(False),

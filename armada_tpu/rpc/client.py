@@ -285,6 +285,32 @@ class ArmadaClient(_Base):
             yield JobSetEvent(int(msg.idx), msg.sequence)
 
 
+class BinocularsClient(_Base):
+    """Per-cluster logs + cordon client (pkg/api/binoculars)."""
+
+    def logs(self, job_id: str = "", run_id: str = "") -> str:
+        resp = self._unary(
+            "/armada_tpu.api.Binoculars/Logs",
+            pb.LogsRequest(job_id=job_id, run_id=run_id),
+            pb.LogsResponse,
+        )
+        return resp.log
+
+    def cordon(self, node_id: str) -> None:
+        self._unary(
+            "/armada_tpu.api.Binoculars/Cordon",
+            pb.CordonRequest(node_id=node_id),
+            pb.Empty,
+        )
+
+    def uncordon(self, node_id: str) -> None:
+        self._unary(
+            "/armada_tpu.api.Binoculars/Cordon",
+            pb.CordonRequest(node_id=node_id, uncordon=True),
+            pb.Empty,
+        )
+
+
 class ExecutorApiClient(_Base):
     """Drop-in wire replacement for the in-process ExecutorApi."""
 

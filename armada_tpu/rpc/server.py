@@ -258,6 +258,27 @@ class _ReportsService:
         )
 
 
+class _BinocularsService:
+    """Logs + Cordon next to the cluster (internal/binoculars)."""
+
+    def __init__(self, binoculars):
+        self._b = binoculars
+
+    def Logs(self, request, context):
+        try:
+            text = self._b.logs(job_id=request.job_id, run_id=request.run_id)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return pb.LogsResponse(log=text)
+
+    def Cordon(self, request, context):
+        try:
+            self._b.cordon(request.node_id, cordoned=not request.uncordon)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return pb.Empty()
+
+
 class _ExecutorApiService:
     def __init__(self, executor_api, factory):
         self._api = executor_api
@@ -295,6 +316,7 @@ def make_server(
     factory=None,
     lookout_queries=None,
     reports=None,
+    binoculars=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
 ) -> tuple[grpc.Server, int]:
@@ -356,6 +378,17 @@ def make_server(
                     "GetJobReport": _unary(rsvc.GetJobReport, pb.QueueGetRequest),
                     "GetQueueReport": _unary(rsvc.GetQueueReport, pb.QueueGetRequest),
                     "GetPoolReport": _unary(rsvc.GetPoolReport, pb.QueueGetRequest),
+                },
+            )
+        )
+    if binoculars is not None:
+        bsvc = _BinocularsService(binoculars)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.Binoculars",
+                {
+                    "Logs": _unary(bsvc.Logs, pb.LogsRequest),
+                    "Cordon": _unary(bsvc.Cordon, pb.CordonRequest),
                 },
             )
         )

@@ -28,6 +28,7 @@ from armada_tpu.jobdb.job import Job, JobRun
 from armada_tpu.jobdb.jobdb import WriteTxn
 from armada_tpu.models import RoundOutcome, run_scheduling_round
 from armada_tpu.scheduler.executors import ExecutorSnapshot
+from armada_tpu.scheduler.ratelimit import SchedulingRateLimiters
 
 
 @dataclasses.dataclass
@@ -54,6 +55,16 @@ class SchedulerResult:
 
 def _new_run_id() -> str:
     return uuid.uuid4().hex
+
+
+def _running_of(job: Job, run: JobRun) -> RunningJob:
+    """RunningJob view of a (job, run) pair for round inputs."""
+    return RunningJob(
+        job=dataclasses.replace(job.spec, priority=job.priority),
+        node_id=run.node_id,
+        priority=run.scheduled_at_priority or 0,
+        away=run.pool_scheduled_away,
+    )
 
 
 class FairSchedulingAlgo:
@@ -84,9 +95,32 @@ class FairSchedulingAlgo:
                 f"pools {market_pools} are market driven: FairSchedulingAlgo "
                 "needs a bid_prices provider (scheduler/providers.py)"
             )
+        self.optimiser = None
+        if config.optimiser_enabled:
+            from armada_tpu.scheduler.optimiser import Optimiser, OptimiserConfig
+
+            self.optimiser = Optimiser(
+                config,
+                OptimiserConfig(
+                    enabled=True,
+                    maximum_job_size_to_preempt=(
+                        config.optimiser_maximum_job_size_to_preempt
+                    ),
+                    max_stuck_jobs_per_cycle=config.optimiser_max_stuck_jobs,
+                ),
+            )
         # Per-queue share stats cost an extra device->host transfer; turn off
         # when neither metrics nor reports are wired.
         self.collect_stats = collect_stats
+        # Rate limiters (maximumSchedulingRate token buckets): clamp the
+        # per-round burst caps so sustained throughput meets the config.
+        self.rate_limiters = SchedulingRateLimiters(
+            config.maximum_scheduling_rate,
+            config.maximum_scheduling_burst,
+            config.maximum_per_queue_scheduling_rate,
+            config.maximum_per_queue_scheduling_burst,
+            clock=lambda: self._clock_ns() / 1e9,
+        )
 
     # --- executor health (scheduling_algo.go:780-830) -----------------------
 
@@ -170,14 +204,7 @@ class FairSchedulingAlgo:
             pool = run.pool or "default"
             if pool not in running_by_pool:
                 running_by_pool[pool] = []
-            running_by_pool[pool].append(
-                RunningJob(
-                    job=dataclasses.replace(job.spec, priority=job.priority),
-                    node_id=run.node_id,
-                    priority=run.scheduled_at_priority or 0,
-                    away=run.pool_scheduled_away,
-                )
-            )
+            running_by_pool[pool].append(_running_of(job, run))
 
         bid_price_of = None
         if self.bid_prices is not None:
@@ -197,11 +224,26 @@ class FairSchedulingAlgo:
                 for q in queues
             ]
 
+        queue_names = [q.name for q in queues]
+
+        def round_tokens():
+            return self.rate_limiters.tokens(queue_names)
+
+        def consume_round(outcome):
+            by_queue: dict[str, int] = {}
+            for jid in outcome.scheduled:
+                job = job_of_spec.get(jid)
+                if job is not None:
+                    by_queue[job.queue] = by_queue.get(job.queue, 0) + 1
+            if by_queue:
+                self.rate_limiters.consume(by_queue)
+
         for pool in pools:
             pool_nodes = [n for n in nodes if n.pool == pool]
             running = running_by_pool.get(pool, [])
             if not pool_nodes or (not queued_jobs and not running):
                 continue
+            g_tokens, q_tokens = round_tokens()
             outcome = run_scheduling_round(
                 self.config,
                 pool=pool,
@@ -211,7 +253,10 @@ class FairSchedulingAlgo:
                 running=running,
                 collect_stats=self.collect_stats,
                 bid_price_of=bid_price_of,
+                global_tokens=g_tokens,
+                queue_tokens=q_tokens,
             )
+            consume_round(outcome)
             self._apply_outcome(
                 txn, outcome, pool, executor_of_node, now_ns, result
             )
@@ -240,14 +285,7 @@ class FairSchedulingAlgo:
         preempted_ids = {job.id for job, _ in result.preempted}
         extra_running: dict[str, list[RunningJob]] = {}
         for job, run in result.scheduled:
-            extra_running.setdefault(run.pool, []).append(
-                RunningJob(
-                    job=dataclasses.replace(job.spec, priority=job.priority),
-                    node_id=run.node_id,
-                    priority=run.scheduled_at_priority or 0,
-                    away=run.pool_scheduled_away,
-                )
-            )
+            extra_running.setdefault(run.pool, []).append(_running_of(job, run))
 
         def host_running(host: str) -> list[RunningJob]:
             kept = [
@@ -272,6 +310,7 @@ class FairSchedulingAlgo:
                 host_nodes = [n for n in nodes if n.pool == host]
                 if not host_nodes or not away_jobs:
                     continue
+                g_tokens, q_tokens = round_tokens()
                 outcome = run_scheduling_round(
                     self.config,
                     pool=host,
@@ -284,7 +323,10 @@ class FairSchedulingAlgo:
                     collect_stats=False,
                     bid_price_of=bid_price_of,
                     away_mode=True,
+                    global_tokens=g_tokens,
+                    queue_tokens=q_tokens,
                 )
+                consume_round(outcome)
                 self._apply_outcome(
                     txn, outcome, host, executor_of_node, now_ns, result, away=True
                 )
@@ -299,17 +341,84 @@ class FairSchedulingAlgo:
                     for job, run in result.scheduled:
                         if job.id in scheduled_ids:
                             extra_running.setdefault(run.pool, []).append(
-                                RunningJob(
-                                    job=dataclasses.replace(
-                                        job.spec, priority=job.priority
-                                    ),
-                                    node_id=run.node_id,
-                                    priority=run.scheduled_at_priority or 0,
-                                    away=True,
-                                )
+                                _running_of(job, run)
                             )
 
+        # Optimiser pass (optimiser/node_scheduler.go via pqs.go:250-272):
+        # jobs the rounds could not place get one targeted-preemption attempt.
+        if self.optimiser is not None:
+            self._optimise_stuck(
+                txn,
+                result,
+                queued_jobs,
+                nodes,
+                running_by_pool,
+                extra_running,
+                executor_of_node,
+                now_ns,
+            )
+
         return result
+
+    def _optimise_stuck(
+        self,
+        txn: WriteTxn,
+        result: SchedulerResult,
+        queued_jobs: list,
+        nodes: list,
+        running_by_pool: dict,
+        extra_running: dict,
+        executor_of_node: dict,
+        now_ns: int,
+    ) -> None:
+        preempted_ids = {job.id for job, _ in result.preempted}
+        still_queued = {j.id: j for j in queued_jobs}
+        for stats in result.pools:
+            pool = stats.pool
+            stuck = [
+                still_queued[jid]
+                for jid in stats.outcome.failed
+                if jid in still_queued
+            ]
+            if not stuck:
+                continue
+            pool_nodes = [n for n in nodes if n.pool == pool]
+            running_now = [
+                r
+                for r in running_by_pool.get(pool, [])
+                if r.job.id not in preempted_ids
+            ] + extra_running.get(pool, [])
+            shares = stats.outcome.queue_stats
+            decisions = self.optimiser.optimise(
+                stuck,
+                pool_nodes,
+                running_now,
+                actual_share={q: s["actual_share"] for q, s in shares.items()},
+                fair_share={
+                    q: s["adjusted_fair_share"] for q, s in shares.items()
+                },
+            )
+            for d in decisions:
+                # The rate limiters gate optimiser placements too.
+                spec = still_queued.get(d.job_id)
+                queue = spec.queue if spec is not None else ""
+                g_tokens, q_tokens = self.rate_limiters.tokens([queue])
+                if g_tokens is not None and g_tokens < 1:
+                    break
+                if q_tokens is not None and q_tokens.get(queue, 1) < 1:
+                    continue
+                synthetic = RoundOutcome(
+                    scheduled={d.job_id: d.node_id},
+                    preempted=list(d.preempted_job_ids),
+                    failed=[],
+                    num_iterations=0,
+                    termination="optimiser",
+                )
+                self._apply_outcome(
+                    txn, synthetic, pool, executor_of_node, now_ns, result
+                )
+                self.rate_limiters.consume({queue: 1})
+                still_queued.pop(d.job_id, None)
 
     # --- applying a pool outcome to the txn ---------------------------------
 

@@ -1,0 +1,91 @@
+"""Binoculars-lite tests: pod logs + node cordon next to the cluster.
+
+Modeled on the reference's binoculars service (internal/binoculars/service/
+logs.go, cordon.go): logs come straight from the cluster; cordoning a node
+stops new placements there while running pods finish.
+"""
+
+import grpc
+import pytest
+
+from armada_tpu.executor.binoculars import Binoculars
+from armada_tpu.rpc.client import BinocularsClient
+from armada_tpu.rpc.server import make_server
+from armada_tpu.server import JobSubmitItem, QueueRecord
+from tests.control_plane import ControlPlane
+
+
+@pytest.fixture
+def stack(tmp_path):
+    cp = ControlPlane.build(tmp_path, runtime_s=5.0)
+    cp.server.create_queue(QueueRecord("q"))
+    cluster = cp.executors[0].cluster
+    server, port = make_server(binoculars=Binoculars(cluster))
+    client = BinocularsClient(f"127.0.0.1:{port}")
+    yield cp, cluster, client
+    client.close()
+    server.stop(None)
+    cp.close()
+
+
+def item(cpu="2"):
+    return JobSubmitItem(resources={"cpu": cpu, "memory": "2"})
+
+
+def test_logs_over_wire(stack):
+    cp, cluster, client = stack
+    (jid,) = cp.server.submit_jobs("q", "js", [item()])
+    for ex in cp.executors:
+        ex.run_once()
+    cp.step()
+    cluster.tick(1.0)
+
+    log = client.logs(job_id=jid)
+    assert "pod created for job" in log
+    assert "container started" in log
+
+    (pod,) = cluster.pod_states()
+    assert client.logs(run_id=pod.run_id) == log
+
+    with pytest.raises(grpc.RpcError) as e:
+        client.logs(job_id="ghost")
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_failed_pod_log_carries_reason(stack):
+    cp, cluster, client = stack
+    (jid,) = cp.server.submit_jobs("q", "js", [item()])
+    for ex in cp.executors:
+        ex.run_once()
+    cp.step()
+    (pod,) = cluster.pod_states()
+    cluster.fail_pod(pod.run_id, "disk exploded")
+    assert "FAILED: disk exploded" in client.logs(job_id=jid)
+
+
+def test_cordon_stops_new_placements(stack):
+    cp, cluster, client = stack
+    nodes = [n.id for n in cluster.node_specs()]
+    client.cordon(nodes[0])
+    assert next(
+        n for n in cluster.node_specs() if n.id == nodes[0]
+    ).unschedulable
+
+    # snapshot propagates on the next heartbeat; everything lands on node 1
+    ids = cp.server.submit_jobs("q", "js", [item() for _ in range(3)])
+    for ex in cp.executors:
+        ex.run_once()
+    cp.step()
+    placed = {p.node_id for p in cluster.pod_states()}
+    assert placed == {nodes[1]}
+
+    # uncordon restores the node
+    client.uncordon(nodes[0])
+    cp.server.submit_jobs("q", "js2", [item() for _ in range(3)])
+    cp.step()
+    cp.step()
+    placed = {p.node_id for p in cluster.pod_states()}
+    assert nodes[0] in placed
+
+    with pytest.raises(grpc.RpcError):
+        client.cordon("no-such-node")

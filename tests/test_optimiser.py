@@ -1,0 +1,187 @@
+"""Optimiser tests: targeted preemption places stuck jobs.
+
+Modeled on the reference's optimiser tests (internal/scheduler/scheduling/
+optimiser/node_scheduler_test.go): victims picked in ideal order (away
+guests, then most-over-fair-share queues, newest first), size caps honored,
+cheapest node chosen.
+"""
+
+import pytest
+
+from armada_tpu.core.config import PoolConfig, PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, RunningJob
+from armada_tpu.scheduler.optimiser import Optimiser, OptimiserConfig
+
+CFG = SchedulingConfig(shape_bucket=32)
+F = CFG.resource_list_factory()
+
+
+def node(nid, cpu="8"):
+    return NodeSpec(
+        id=nid, pool="default", total_resources=F.from_mapping({"cpu": cpu, "memory": "32"})
+    )
+
+
+def spec(jid, queue="q", cpu="4", pc="armada-preemptible", submit=0.0):
+    return JobSpec(
+        id=jid,
+        queue=queue,
+        priority_class=pc,
+        submit_time=submit,
+        resources=F.from_mapping({"cpu": cpu, "memory": "2"}),
+    )
+
+
+def running(jid, nid, queue="hog", cpu="4", submit=0.0, away=False):
+    return RunningJob(job=spec(jid, queue=queue, cpu=cpu, submit=submit), node_id=nid, away=away)
+
+
+def opt(**kw):
+    return Optimiser(CFG, OptimiserConfig(enabled=True, **kw))
+
+
+def test_disabled_returns_nothing():
+    o = Optimiser(CFG, OptimiserConfig(enabled=False))
+    assert o.optimise([spec("s")], [node("n0")], [], {}, {}) == []
+
+
+def test_preempts_over_share_victims_newest_first():
+    runs = [
+        running("old", "n0", submit=1.0),
+        running("new", "n0", submit=9.0),
+    ]
+    decisions = opt().optimise(
+        [spec("stuck", queue="starved")],
+        [node("n0")],
+        runs,
+        actual_share={"hog": 0.9, "starved": 0.0},
+        fair_share={"hog": 0.5, "starved": 0.5},
+    )
+    (d,) = decisions
+    assert d.job_id == "stuck" and d.node_id == "n0"
+    # only one 4cpu victim needed; the NEWEST goes first
+    assert d.preempted_job_ids == ["new"]
+
+
+def test_away_guests_evicted_before_home_jobs():
+    runs = [
+        running("home-job", "n0", submit=9.0),
+        running("guest", "n0", submit=1.0, away=True),
+    ]
+    (d,) = opt().optimise(
+        [spec("stuck", queue="starved")],
+        [node("n0")],
+        runs,
+        actual_share={"hog": 0.9},
+        fair_share={"hog": 0.5},
+    )
+    assert d.preempted_job_ids == ["guest"]
+
+
+def test_size_cap_protects_large_victims():
+    runs = [running("big", "n0", cpu="8")]
+    decisions = opt(maximum_job_size_to_preempt={"cpu": "4", "memory": "64"}).optimise(
+        [spec("stuck", cpu="8")],
+        [node("n0")],
+        runs,
+        actual_share={"hog": 1.0},
+        fair_share={"hog": 0.5},
+    )
+    assert decisions == []  # the only victim is oversized
+
+
+def test_non_preemptible_home_jobs_are_safe():
+    runs = [running("prod", "n0", cpu="8")]
+    runs = [RunningJob(job=spec("prod", queue="hog", cpu="8", pc="armada-default"), node_id="n0")]
+    assert (
+        opt().optimise(
+            [spec("stuck", cpu="8")],
+            [node("n0")],
+            runs,
+            actual_share={"hog": 1.0},
+            fair_share={"hog": 0.5},
+        )
+        == []
+    )
+
+
+def test_cheapest_node_wins():
+    # n0 needs 2 preemptions (all 2cpu victims), n1 needs 1 (4cpu victim)
+    runs = [
+        running("a1", "n0", cpu="2", submit=1),
+        running("a2", "n0", cpu="2", submit=2),
+        running("a3", "n0", cpu="2", submit=3),
+        running("a4", "n0", cpu="2", submit=4),
+        running("b1", "n1", cpu="4", submit=5),
+        running("b2", "n1", cpu="4", submit=6),
+    ]
+    (d,) = opt().optimise(
+        [spec("stuck", cpu="4")],
+        [node("n0"), node("n1")],
+        runs,
+        actual_share={"hog": 1.0},
+        fair_share={"hog": 0.3},
+    )
+    assert d.node_id == "n1" and len(d.preempted_job_ids) == 1
+
+
+def test_end_to_end_optimiser_unsticks_job(tmp_path):
+    """Normal rounds can't place the big job (same priority, fair-share
+    eviction disabled); the optimiser preempts over-share victims for it."""
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+    from tests.control_plane import ControlPlane
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        protected_fraction_of_fair_share=100.0,  # normal eviction off
+        optimiser_enabled=True,
+        default_priority_class="armada-preemptible",
+    )
+    cp = ControlPlane.build(tmp_path, config=cfg, runtime_s=600.0)
+    cp.server.create_queue(QueueRecord("hog"))
+    cp.server.create_queue(QueueRecord("starved"))
+    cp.server.submit_jobs(
+        "hog", "fill", [JobSubmitItem(resources={"cpu": "2", "memory": "2"}) for _ in range(8)]
+    )
+    for ex in cp.executors:
+        ex.run_once()
+    cp.step()
+    assert sum(1 for s in cp.job_states().values() if s == "leased") == 8
+
+    big = cp.server.submit_jobs(
+        "starved", "big", [JobSubmitItem(resources={"cpu": "8", "memory": "8"})]
+    )
+    cp.step()
+    cp.step()
+    states = cp.job_states()
+    assert states[big[0]] == "leased", states
+    # exactly one node's worth of hogs (4 x 2cpu) was preempted
+    assert sum(1 for s in states.values() if s == "failed") == 4
+    cp.close()
+
+
+def test_optimiser_off_leaves_job_stuck(tmp_path):
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+    from tests.control_plane import ControlPlane
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        protected_fraction_of_fair_share=100.0,
+        default_priority_class="armada-preemptible",
+    )
+    cp = ControlPlane.build(tmp_path, config=cfg, runtime_s=600.0)
+    cp.server.create_queue(QueueRecord("hog"))
+    cp.server.create_queue(QueueRecord("starved"))
+    cp.server.submit_jobs(
+        "hog", "fill", [JobSubmitItem(resources={"cpu": "2", "memory": "2"}) for _ in range(8)]
+    )
+    for ex in cp.executors:
+        ex.run_once()
+    cp.step()
+    big = cp.server.submit_jobs(
+        "starved", "big", [JobSubmitItem(resources={"cpu": "8", "memory": "8"})]
+    )
+    cp.step()
+    cp.step()
+    assert cp.job_states()[big[0]] == "queued"
+    cp.close()
