@@ -18,7 +18,7 @@ from typing import Iterator, NamedTuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_HERE, "_eventlog.so")
-_SRC = os.path.join(_HERE, os.pardir, os.pardir, "native", "eventlog.cc")
+_SRC = os.path.join(_HERE, os.pardir, "native", "eventlog.cc")
 
 _build_lock = threading.Lock()
 _lib = None

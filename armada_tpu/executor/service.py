@@ -38,13 +38,19 @@ class ExecutorService:
         api,
         factory: ResourceListFactory,
         clock: Callable[[], float] = time.time,
+        pending_timeout_s: float = 600.0,
     ):
+        """pending_timeout_s: pods stuck PENDING this long are returned for
+        rescheduling (podchecks' stuck-pod detection,
+        internal/executor/podchecks/pod_checks.go); <= 0 disables."""
         self.id = executor_id
         self.pool = pool
         self.cluster = cluster
         self.api = api
         self._factory = factory
         self._clock = clock
+        self._pending_timeout = pending_timeout_s
+        self._pending_since: dict[str, float] = {}
         # run_id -> last phase reported to the scheduler
         self._reported: dict[str, PodPhase] = {}
         # runs leased to us that we could not start (reported as errors once)
@@ -213,10 +219,57 @@ class ExecutorService:
                 n += 1
         return n
 
+    # --- stuck-pod checks (podchecks/pod_checks.go) -------------------------
+
+    def check_stuck_pods(self) -> int:
+        """Return pods stuck PENDING past the timeout; the scheduler requeues
+        them elsewhere (ACTION_RETRY of the reference's pod checks)."""
+        if self._pending_timeout <= 0:
+            return 0
+        now = self._clock()
+        returned = 0
+        sequences: list[pb.EventSequence] = []
+        current = {p.run_id for p in self.cluster.pod_states()}
+        # pods deleted by other paths (cancel/preempt) must not leak entries
+        self._pending_since = {
+            k: v for k, v in self._pending_since.items() if k in current
+        }
+        for pod in list(self.cluster.pod_states()):
+            if pod.phase is PodPhase.PENDING:
+                since = self._pending_since.setdefault(pod.run_id, now)
+                if now - since > self._pending_timeout:
+                    self.cluster.delete_pod(pod.run_id)
+                    self._reported.pop(pod.run_id, None)
+                    self._pending_since.pop(pod.run_id, None)
+                    self._awaiting_ack.add(pod.run_id)
+                    seq = _run_error_sequence(
+                        pod.queue,
+                        pod.jobset,
+                        pod.job_id,
+                        pod.run_id,
+                        reason="podStuckPending",
+                        message=(
+                            f"pod pending for more than {self._pending_timeout}s"
+                        ),
+                        now_ns=int(now * 1e9),
+                        node=pod.node_id,
+                    )
+                    # retryable: the run is over but the job may go elsewhere
+                    seq.events[0].job_run_errors.errors[0].terminal = False
+                    seq.events[0].job_run_errors.errors[0].lease_returned = True
+                    sequences.append(seq)
+                    returned += 1
+            else:
+                self._pending_since.pop(pod.run_id, None)
+        if sequences:
+            self.api.report_events(sequences)
+        return returned
+
     def run_once(self) -> None:
-        """One full agent iteration: lease, report, clean."""
+        """One full agent iteration: lease, report, check, clean."""
         self.lease_cycle()
         self.report_cycle()
+        self.check_stuck_pods()
         self.cleanup()
 
 

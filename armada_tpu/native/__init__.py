@@ -1,0 +1,1 @@
+"""Native C++ sources (built on demand by armada_tpu.eventlog)."""

@@ -110,8 +110,9 @@ class FairSchedulingAlgo:
                 ),
             )
         # Per-queue share stats cost an extra device->host transfer; turn off
-        # when neither metrics nor reports are wired.
-        self.collect_stats = collect_stats
+        # when neither metrics nor reports are wired.  The optimiser's ideal
+        # victim order NEEDS the shares, so it forces collection.
+        self.collect_stats = collect_stats or self.optimiser is not None
         # Rate limiters (maximumSchedulingRate token buckets): clamp the
         # per-round burst caps so sustained throughput meets the config.
         self.rate_limiters = SchedulingRateLimiters(

@@ -97,7 +97,10 @@ class Scheduler:
         clock: Callable[[], float] = time.time,
         metrics=None,
         reports=None,
+        ingest_step: Optional[Callable[[], int]] = None,
     ):
+        """ingest_step: drives an in-process ingestion pipeline during marker
+        fencing (deployments with background ingester threads leave it None)."""
         self.db = db
         self.jobdb = jobdb
         self.algo = algo
@@ -110,6 +113,7 @@ class Scheduler:
         # SchedulingReportsRepository); None = disabled.
         self.metrics = metrics
         self.reports = reports
+        self.ingest_step = ingest_step
         # Incremental-fetch cursors (scheduler.go jobsSerial/runsSerial:79-81).
         self._jobs_serial = 0
         self._runs_serial = 0
@@ -192,9 +196,18 @@ class Scheduler:
                 txn.commit()
                 return result
             if not self._was_leader:
-                # Fresh leadership: catch up with everything already published
-                # before taking decisions (scheduler.go:169-181).
-                self._was_leader = True
+                # Leadership acquired (first cycle or follower -> leader):
+                # replay everything already published -- possibly by the
+                # previous leader -- before taking decisions
+                # (scheduler.go:169-181, ensureDbUpToDate:1120), and treat
+                # EVERY job as touched so transitions ingested while we were
+                # not leader still generate their update messages (the
+                # reference's updateAll on leadership change).
+                self.ensure_db_up_to_date(ingest_step=self.ingest_step)
+                self.sync_state(txn)
+                touched = sorted({j.id for j in txn.all_jobs()})
+                result.synced_jobs = touched
+            self._was_leader = True
 
             builder = _SequenceBuilder()
             now_ns = self.now_ns()

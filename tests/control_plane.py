@@ -99,6 +99,7 @@ class ControlPlane:
             StandaloneLeaderController(),
             config,
             clock=clock,
+            ingest_step=scheduler_pipeline.run_until_caught_up,
         )
         executor_api = ExecutorApi(db, publisher, factory)
         executors = []

@@ -63,6 +63,7 @@ class Stack:
             StandaloneLeaderController(),
             self.config,
             clock=self.clock,
+            ingest_step=self.pipeline.run_until_caught_up,
         )
         self.api = ExecutorApi(self.db, self.publisher, self.factory)
         nodes = [
@@ -252,6 +253,78 @@ def test_preempt_request_deletes_pod_and_reports(stack):
     res = stack.scheduler.cycle()
     kinds = res.events_by_kind()
     assert kinds.get("job_errors") == 1  # preempted -> terminal
+
+
+def test_stuck_pending_pod_is_returned_and_requeued(stack):
+    """A pod that never starts is returned past the pending timeout and the
+    job reschedules (podchecks stuck-pod detection)."""
+    stack.executor._pending_timeout = 30.0
+    # pods never leave PENDING: start delay beyond the horizon
+    stack.cluster._start_delay = 10_000.0
+    stack.submit("jstuck")
+    stack.executor.run_once()
+    stack.step()
+    (pod,) = stack.cluster.pod_states()
+    assert pod.phase.value == "pending"
+
+    stack.clock.advance(31.0)
+    returned = stack.executor.check_stuck_pods()
+    assert returned == 1
+    assert stack.cluster.pod_states() == []
+
+    # the retryable error round-trips: run returned, job requeued -- and the
+    # same cycle re-leases it onto a fresh run
+    stack.pipeline.run_until_caught_up()
+    res = stack.scheduler.cycle()
+    kinds = res.events_by_kind()
+    assert kinds.get("job_requeued") == 1
+    assert kinds.get("job_run_leased") == 1
+    job = stack.jobdb.read_txn().get("jstuck")
+    assert job.runs[0].returned and job.has_active_run()
+
+
+def test_leader_transition_refences_db(stack):
+    """Regaining leadership replays the log before deciding (marker fencing
+    on follower -> leader transitions)."""
+    from armada_tpu.scheduler.leader import LeaderToken
+
+    class FlippableLeader:
+        def __init__(self):
+            self.is_leader = True
+            self.generation = 1
+
+        def get_token(self):
+            return LeaderToken(self.is_leader, self.generation)
+
+        def validate_token(self, token):
+            return token.leader and token.generation == self.generation
+
+    leader = FlippableLeader()
+    stack.scheduler.leader = leader
+
+    # background ingestion so the fencing wait can make progress; the inline
+    # ingest_step must not race the background thread
+    stack.scheduler.ingest_step = None
+    stack.pipeline.start()
+    try:
+        stack.submit("jl")
+        import time as _t
+
+        _t.sleep(0.2)
+        assert stack.scheduler.cycle().leader
+
+        leader.is_leader = False
+        assert not stack.scheduler.cycle().leader
+
+        # while a follower, someone else publishes
+        stack.submit("jl2")
+        leader.is_leader = True
+        leader.generation += 1
+        res = stack.scheduler.cycle()  # must fence + sync before deciding
+        assert res.leader
+        assert stack.jobdb.read_txn().get("jl2") is not None
+    finally:
+        stack.pipeline.stop()
 
 
 def test_submission_rejection_reports_terminal_error(stack):

@@ -68,6 +68,7 @@ class World:
             leader or StandaloneLeaderController(),
             self.config,
             clock=self.clock,
+            ingest_step=self.pipeline.run_until_caught_up,
         )
 
     def ingest(self):
@@ -460,6 +461,7 @@ def test_scheduler_restart_resumes_from_db(world, tmp_path):
         StandaloneLeaderController(),
         world.config,
         clock=world.clock,
+        ingest_step=world.pipeline.run_until_caught_up,
     )
     res2 = sched2.cycle()
     assert events_of_kind(res2.published, "job_run_leased") == []
