@@ -180,9 +180,9 @@ def start_control_plane(
     event_pipeline.start()
     lookout_pipeline.start()
 
-    # Recovery fencing: don't take decisions until the DB reflects everything
-    # published before this process started (scheduler.go ensureDbUpToDate).
-    scheduler.ensure_db_up_to_date()
+    # Recovery fencing happens inside the scheduler's first leader cycle
+    # (ensure_db_up_to_date on leadership acquisition); the background
+    # ingesters above make the marker wait progress.
 
     stop = threading.Event()
     scheduler_thread = threading.Thread(

@@ -242,22 +242,23 @@ class ExecutorService:
                     self._reported.pop(pod.run_id, None)
                     self._pending_since.pop(pod.run_id, None)
                     self._awaiting_ack.add(pod.run_id)
-                    seq = _run_error_sequence(
-                        pod.queue,
-                        pod.jobset,
-                        pod.job_id,
-                        pod.run_id,
-                        reason="podStuckPending",
-                        message=(
-                            f"pod pending for more than {self._pending_timeout}s"
-                        ),
-                        now_ns=int(now * 1e9),
-                        node=pod.node_id,
+                    sequences.append(
+                        _run_error_sequence(
+                            pod.queue,
+                            pod.jobset,
+                            pod.job_id,
+                            pod.run_id,
+                            reason="podStuckPending",
+                            message=(
+                                f"pod pending for more than {self._pending_timeout}s"
+                            ),
+                            now_ns=int(now * 1e9),
+                            node=pod.node_id,
+                            # retryable: the run is over, the job goes elsewhere
+                            terminal=False,
+                            lease_returned=True,
+                        )
                     )
-                    # retryable: the run is over but the job may go elsewhere
-                    seq.events[0].job_run_errors.errors[0].terminal = False
-                    seq.events[0].job_run_errors.errors[0].lease_returned = True
-                    sequences.append(seq)
                     returned += 1
             else:
                 self._pending_since.pop(pod.run_id, None)
@@ -282,6 +283,8 @@ def _run_error_sequence(
     message: str,
     now_ns: int,
     node: str = "",
+    terminal: bool = True,
+    lease_returned: bool = False,
 ) -> pb.EventSequence:
     return pb.EventSequence(
         queue=queue,
@@ -296,7 +299,8 @@ def _run_error_sequence(
                         pb.Error(
                             reason=reason,
                             message=message,
-                            terminal=True,
+                            terminal=terminal,
+                            lease_returned=lease_returned,
                             node=node,
                         )
                     ],

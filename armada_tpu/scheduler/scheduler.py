@@ -184,6 +184,9 @@ class Scheduler:
 
     def _cycle(self, schedule: bool = True) -> CycleResult:
         result = CycleResult()
+        # Fetch cursors only advance with a COMMITTED txn: an aborted cycle
+        # must re-fetch the same rows next time or their transitions are lost.
+        cursors0 = (self._jobs_serial, self._runs_serial)
         txn = self.jobdb.write_txn()
         try:
             touched = self.sync_state(txn)
@@ -227,6 +230,9 @@ class Scheduler:
                 # Fencing: never publish with stale authority (scheduler.go:355).
                 if not self.leader.validate_token(token):
                     txn.abort()
+                    self._jobs_serial, self._runs_serial = cursors0
+                    # Leadership lost: the next acquisition must re-fence.
+                    self._was_leader = False
                     result.leader = False
                     return result
                 self.publisher.publish(sequences)
@@ -238,6 +244,7 @@ class Scheduler:
             return result
         except BaseException:
             txn.abort()
+            self._jobs_serial, self._runs_serial = cursors0
             raise
 
     # --- job state transitions (scheduler.go generateUpdateMessages:698) ----

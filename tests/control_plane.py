@@ -71,7 +71,7 @@ class ControlPlane:
         runtime_s: float = 5.0,
     ) -> "ControlPlane":
         """executor_specs: {executor_id: (num_nodes, cpu, mem)}."""
-        config = config or SchedulingConfig(shape_bucket=32)
+        config = config or SchedulingConfig(shape_bucket=32, enable_assertions=True)
         clock = ManualClock()
         factory = config.resource_list_factory()
         log = EventLog(str(tmp_path / "log"), num_partitions=2)

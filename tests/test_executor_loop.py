@@ -41,7 +41,7 @@ class Stack:
     """Scheduler + executor-api + one fake executor, all in-process."""
 
     def __init__(self, tmp_path, num_nodes=2, cpu="8", mem="32"):
-        self.config = SchedulingConfig(shape_bucket=32)
+        self.config = SchedulingConfig(shape_bucket=32, enable_assertions=True)
         self.factory = self.config.resource_list_factory()
         self.clock = FakeClock()
         self.log = EventLog(str(tmp_path / "log"), num_partitions=2)
@@ -283,7 +283,7 @@ def test_stuck_pending_pod_is_returned_and_requeued(stack):
     assert job.runs[0].returned and job.has_active_run()
 
 
-def test_leader_transition_refences_db(stack):
+def test_leader_transition_fences_db(stack):
     """Regaining leadership replays the log before deciding (marker fencing
     on follower -> leader transitions)."""
     from armada_tpu.scheduler.leader import LeaderToken

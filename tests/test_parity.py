@@ -1,0 +1,206 @@
+"""Placement parity: the TPU round kernel vs an independent sequential oracle.
+
+BASELINE.json's gate is placement parity with the reference's greedy
+semantics (docs/scheduling_and_preempting_jobs.md:144-249: one gang at a
+time, cheapest-queue first, best-fit node).  This oracle re-implements those
+semantics directly in plain Python -- no shared code with the kernel beyond
+the input types -- and the property tests assert the kernel lands in the same
+equivalence class on randomized problems: identical scheduled-job sets where
+ordering is deterministic, identical per-queue counts and total allocations
+where only node-choice ties differ (SURVEY.md section 7 "Hard parts").
+"""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+from armada_tpu.models import run_scheduling_round
+
+CFG = SchedulingConfig(shape_bucket=32)
+F = CFG.resource_list_factory()
+
+
+# --- the oracle: sequential greedy, written independently --------------------
+
+
+def oracle_round(config, nodes, queues, jobs):
+    """Schedule singleton jobs one at a time:
+    - queue order: minimal proposed DRF cost (max over resources of
+      (alloc+req)/total, divided by weight); ties -> queue name order.
+    - within a queue: jobs in (pc priority desc, priority asc, submit, id).
+    - node: best-fit = fullest node that fits (min free capacity sum, scaled);
+      ties -> node order.
+    - stop when burst reached or nothing fits (a queue whose head fails is
+      done -- identical-shape retirement).
+    """
+    total = {}
+    free = {}
+    for n in nodes:
+        free[n.id] = np.array(n.total_resources.atoms, dtype=float)
+    total_pool = sum(free.values()) if free else np.zeros(F.num_resources)
+    scale = np.maximum.reduce([free[n.id] for n in nodes]) if nodes else None
+
+    per_queue = {q.name: [] for q in queues}
+    for j in jobs:
+        pc = config.priority_class(j.priority_class)
+        per_queue[j.queue].append((( -pc.priority, j.priority, j.submit_time, j.id), j))
+    for q in per_queue:
+        per_queue[q].sort(key=lambda t: t[0])
+    heads = {q: 0 for q in per_queue}
+    alloc = {q.name: np.zeros(F.num_resources) for q in queues}
+    weight = {q.name: q.weight for q in queues}
+    drf = np.array(
+        [1.0 if name in config.dominant_resource_fairness_resources else 0.0 for name in F.names]
+    )
+
+    def cost(qname, extra):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(total_pool > 0, (alloc[qname] + extra) / np.maximum(total_pool, 1e-9), 0.0)
+        return float((frac * drf).max()) / weight[qname]
+
+    scheduled = {}
+    burst = config.maximum_scheduling_burst
+    dead = set()  # resource shapes retired as unfeasible (scheduling keys
+    # exclude the queue, so retirement is round-global, gang_scheduler.go:85-96)
+    # per-round resource cap (maximumResourceFractionToSchedule): exceeding it
+    # TERMINATES the round (CheckRoundConstraints semantics)
+    round_cap = np.full(F.num_resources, np.inf)
+    for name, fracv in config.maximum_resource_fraction_to_schedule.items():
+        round_cap[F.index_of(name)] = fracv * total_pool[F.index_of(name)]
+    sched_res = np.zeros(F.num_resources)
+    while len(scheduled) < burst:
+        candidates = []
+        for qname in sorted(per_queue):
+            # skip heads whose shape was retired (unfeasible-key skip)
+            while heads[qname] < len(per_queue[qname]):
+                job = per_queue[qname][heads[qname]][1]
+                if tuple(job.resources.atoms) in dead:
+                    heads[qname] += 1
+                else:
+                    break
+            if heads[qname] >= len(per_queue[qname]):
+                continue
+            job = per_queue[qname][heads[qname]][1]
+            req = np.array(job.resources.atoms, dtype=float)
+            candidates.append((cost(qname, req), qname, job, req))
+        if not candidates:
+            break
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        _, qname, job, req = candidates[0]
+        if np.any(sched_res + req > round_cap):
+            break  # round over (global constraint)
+        # best-fit node
+        inv_scale = np.divide(
+            1.0, scale, out=np.zeros_like(scale), where=scale > 0
+        )
+        best = None
+        for n in nodes:
+            f = free[n.id]
+            if np.all(f >= req):
+                score = float((f * inv_scale).sum())
+                if best is None or score < best[0]:
+                    best = (score, n.id)
+        if best is None:
+            # shape-level retirement: identical jobs are skipped round-wide
+            dead.add(tuple(job.resources.atoms))
+            continue
+        free[best[1]] -= req
+        alloc[qname] += req
+        sched_res += req
+        scheduled[job.id] = best[1]
+        heads[qname] += 1
+    return scheduled
+
+
+def random_problem(rng, num_nodes, num_jobs, num_queues, distinct_shapes=True):
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources=F.from_mapping(
+                {"cpu": int(rng.choice([8, 16, 32])), "memory": int(rng.choice([32, 64]))}
+            ),
+        )
+        for i in range(num_nodes)
+    ]
+    queues = [Queue(f"q{i}", float(rng.choice([1.0, 2.0, 3.0]))) for i in range(num_queues)]
+    jobs = []
+    for i in range(num_jobs):
+        if distinct_shapes:
+            cpu = int(rng.choice([1, 2, 4, 8]))
+            mem = int(rng.choice([1, 2, 4]))
+        else:
+            cpu, mem = 2, 2
+        jobs.append(
+            JobSpec(
+                id=f"j{i:04d}",
+                queue=f"q{int(rng.integers(num_queues))}",
+                submit_time=float(i),
+                resources=F.from_mapping({"cpu": cpu, "memory": mem}),
+            )
+        )
+    return nodes, queues, jobs
+
+
+@pytest.mark.parametrize("seed", [1, 7, 13, 42, 99])
+def test_kernel_matches_oracle_scheduled_set(seed):
+    rng = np.random.default_rng(seed)
+    nodes, queues, jobs = random_problem(rng, num_nodes=12, num_jobs=80, num_queues=4)
+    expected = oracle_round(CFG, nodes, queues, jobs)
+    outcome = run_scheduling_round(
+        CFG, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs
+    )
+    assert set(outcome.scheduled) == set(expected), (
+        f"seed {seed}: kernel∖oracle={set(outcome.scheduled) - set(expected)}, "
+        f"oracle∖kernel={set(expected) - set(outcome.scheduled)}"
+    )
+
+
+@pytest.mark.parametrize("seed", [3, 21])
+def test_kernel_matches_oracle_under_saturation(seed):
+    """Demand far exceeds capacity: the exact fair split must match."""
+    rng = np.random.default_rng(seed)
+    nodes, queues, jobs = random_problem(rng, num_nodes=4, num_jobs=120, num_queues=3)
+    expected = oracle_round(CFG, nodes, queues, jobs)
+    outcome = run_scheduling_round(
+        CFG, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs
+    )
+    assert set(outcome.scheduled) == set(expected)
+    # per-queue counts identical (fair-share parity)
+    def by_queue(sched):
+        out = {}
+        jq = {j.id: j.queue for j in jobs}
+        for jid in sched:
+            out[jq[jid]] = out.get(jq[jid], 0) + 1
+        return out
+
+    assert by_queue(outcome.scheduled) == by_queue(expected)
+
+
+def test_placements_identical_when_ties_absent():
+    """With unique node shapes (no score ties) even the node CHOICES match."""
+    rng = np.random.default_rng(5)
+    nodes = [
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": 8 + 2 * i, "memory": 32 + 4 * i}),
+        )
+        for i in range(6)
+    ]
+    queues = [Queue("a"), Queue("b", 2.0)]
+    jobs = [
+        JobSpec(
+            id=f"j{i:02d}",
+            queue=("a", "b")[i % 2],
+            submit_time=float(i),
+            resources=F.from_mapping({"cpu": int(rng.choice([2, 3, 4])), "memory": 4}),
+        )
+        for i in range(20)
+    ]
+    expected = oracle_round(CFG, nodes, queues, jobs)
+    outcome = run_scheduling_round(
+        CFG, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs
+    )
+    assert outcome.scheduled == expected  # same jobs AND same nodes

@@ -45,7 +45,7 @@ class World:
     """One in-process control plane: log + db + ingester + scheduler."""
 
     def __init__(self, tmp_path, config=None, leader=None):
-        self.config = config or SchedulingConfig(shape_bucket=32)
+        self.config = config or SchedulingConfig(shape_bucket=32, enable_assertions=True)
         self.clock = FakeClock()
         self.log = EventLog(str(tmp_path / "log"), num_partitions=2)
         self.db = SchedulerDb(":memory:")
