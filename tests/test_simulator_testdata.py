@@ -1,0 +1,38 @@
+"""The shipped simulator testdata parses and carries the expected shapes
+(the reference ships testdata/{clusters,workloads}; ours lives in
+testdata/simulator)."""
+
+import glob
+
+from armada_tpu.simulator import (
+    cluster_spec_from_yaml,
+    workload_spec_from_yaml,
+)
+
+
+def test_all_cluster_specs_parse():
+    paths = sorted(glob.glob("testdata/simulator/clusters/*.yaml"))
+    assert len(paths) >= 2
+    specs = {p: cluster_spec_from_yaml(p) for p in paths}
+    tiny = next(s for s in specs.values() if s.name == "tiny")
+    assert tiny.clusters[0].node_templates[0].number == 4
+    assert tiny.workflow_manager_delay.minimum_s == 1.0
+    pools = next(s for s in specs.values() if s.name == "two-pools")
+    assert {c.pool for c in pools.clusters} == {"cpu", "gpu"}
+    gpu = next(c for c in pools.clusters if c.pool == "gpu")
+    assert gpu.node_templates[0].labels == {"accelerator": "a100"}
+
+
+def test_all_workload_specs_parse():
+    paths = sorted(glob.glob("testdata/simulator/workloads/*.yaml"))
+    assert len(paths) >= 2
+    specs = {p: workload_spec_from_yaml(p) for p in paths}
+    basic = next(s for s in specs.values() if s.name == "basic")
+    assert {q.name for q in basic.queues} == {"alice", "bob"}
+    assert basic.queues[0].job_templates[0].runtime.tail_mean_s == 15.0
+    dag = next(s for s in specs.values() if s.name == "dag")
+    train = next(
+        t for q in dag.queues for t in q.job_templates if t.id == "train"
+    )
+    assert train.dependencies == ("prepare",)
+    assert train.earliest_submit_time_from_dependency_completion_s == 10.0
