@@ -160,6 +160,18 @@ class Job:
             r.node_name for r in self.runs if r.failed and r.run_attempted and r.node_name
         )
 
+    def anti_affinity_nodes(self) -> tuple[str, ...]:
+        """Node ids a retry must avoid: every node where an ATTEMPTED run died
+        (failed or returned) -- the retry anti-affinity set the reference
+        injects as node exclusions (scheduler.go:522-568)."""
+        return tuple(
+            {
+                r.node_id
+                for r in self.runs
+                if r.run_attempted and (r.failed or r.returned) and r.node_id
+            }
+        )
+
     # --- state predicates ---------------------------------------------------
 
     def in_terminal_state(self) -> bool:

@@ -29,6 +29,7 @@ def run_scheduling_round(
     away_mode=False,
     global_tokens=None,
     queue_tokens=None,
+    banned_nodes=None,
 ):
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
@@ -48,6 +49,7 @@ def run_scheduling_round(
         away_mode=away_mode,
         global_tokens=global_tokens,
         queue_tokens=queue_tokens,
+        banned_nodes=banned_nodes,
     )
     device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
     result = schedule_round(

@@ -226,7 +226,14 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         pin_ok = jnp.where(
             pinned >= 0, jnp.arange(N, dtype=jnp.int32) == pinned, True
         )
-        ok_base = static_ok & p.node_ok & pin_ok
+        # Retry anti-affinity: scatter this gang's banned nodes (sparse pairs;
+        # O(B) per iteration, B ~ retried jobs only).
+        banned = (
+            jnp.zeros((N,), bool)
+            .at[jnp.clip(p.ban_node, 0, N - 1)]
+            .max(p.ban_gang == g)
+        )
+        ok_base = static_ok & p.node_ok & pin_ok & ~banned
         alloc_clean = c.alloc[0]
         alloc_lvl = c.alloc[level]
         # Capacity clipped to the gang cardinality: keeps int32 sums/cumsums exact

@@ -99,6 +99,10 @@ class SchedulingProblem(NamedTuple):
     # Market-driven pools order candidates by bid price instead of DRF cost
     # (scheduling/market_iterator.go MarketCandidateGangIterator:245).
     market: np.ndarray  # bool scalar
+    # Retry anti-affinity (scheduler.go:522-568): sparse (gang, node) pairs a
+    # gang must avoid -- nodes where a previous attempt died.  -1 = padding.
+    ban_gang: np.ndarray  # i32[B]
+    ban_node: np.ndarray  # i32[B]
 
 
 @dataclasses.dataclass
@@ -177,6 +181,7 @@ def build_problem(
     away_mode: bool = False,
     global_tokens=None,
     queue_tokens=None,
+    banned_nodes=None,
 ) -> tuple[SchedulingProblem, HostContext]:
     """`bid_price_of(job) -> float` supplies bid prices; required for pools
     configured market_driven (pricer/gang_pricer.go:29-40).
@@ -187,7 +192,10 @@ def build_problem(
     urgency preemption since away runs hold resources at level 1.
 
     global_tokens / queue_tokens clamp the burst caps to the scheduler's rate
-    limiters (maximumSchedulingRate token buckets, queue_scheduler.go)."""
+    limiters (maximumSchedulingRate token buckets, queue_scheduler.go).
+
+    banned_nodes: {job_id: iterable of node ids} a retried job must avoid
+    (retry anti-affinity, scheduler.go:522-568)."""
     factory = config.resource_list_factory()
     R = factory.num_resources
     bucket = config.shape_bucket
@@ -450,6 +458,28 @@ def build_problem(
                 ri = factory.index_of(name)
                 pc_queue_cap[ci, ri] = frac * total_pool[ri]
 
+    # --- retry anti-affinity pairs ----------------------------------------------
+    ban_pairs: list[tuple[int, int]] = []
+    if banned_nodes:
+        gang_of_job = {}
+        for gi, members in enumerate(gang_members_out):
+            for jid in members:
+                gang_of_job[jid] = gi
+        for jid, node_ids in banned_nodes.items():
+            gi = gang_of_job.get(jid)
+            if gi is None:
+                continue
+            for nid in node_ids:
+                ni = node_index.get(nid)
+                if ni is not None:
+                    ban_pairs.append((gi, ni))
+    B = _pad(len(ban_pairs), bucket) if ban_pairs else 1
+    ban_gang = np.full((B,), -1, np.int32)
+    ban_node = np.zeros((B,), np.int32)
+    for i, (gi, ni) in enumerate(ban_pairs):
+        ban_gang[i] = gi
+        ban_node[i] = ni
+
     # --- queue-ordered gang index ----------------------------------------------
     Q = _pad(len(sorted_queues), bucket)
     gq_gang, q_start, q_len = queue_ordered_gang_index(
@@ -536,6 +566,8 @@ def build_problem(
         node_axes=node_axes,
         float_total=float_total,
         market=np.bool_(market),
+        ban_gang=ban_gang,
+        ban_node=ban_node,
     )
     ctx = HostContext(
         config=config,

@@ -178,6 +178,8 @@ def synthetic_problem(
         node_axes=np.ones((R,), np.float32),
         float_total=np.zeros((R,), np.float32),
         market=np.bool_(False),
+        ban_gang=np.full((1,), -1, np.int32),
+        ban_node=np.zeros((1,), np.int32),
     )
     meta = dict(
         num_levels=3,

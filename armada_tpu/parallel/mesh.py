@@ -114,6 +114,8 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         node_axes=repl,
         float_total=repl,
         market=repl,
+        ban_gang=repl,
+        ban_node=repl,
     )
 
 

@@ -178,6 +178,7 @@ class FairSchedulingAlgo:
         # (reprioritisation updates Job.priority, not the immutable spec).
         queued_jobs: list[JobSpec] = []
         job_of_spec: dict[str, Job] = {}
+        banned_nodes: dict[str, tuple] = {}  # retry anti-affinity
         for qname in txn.queues_with_queued_jobs():
             if qname not in known_queues:
                 continue
@@ -193,6 +194,9 @@ class FairSchedulingAlgo:
                     )
                 )
                 job_of_spec[job.id] = job
+                bans = job.anti_affinity_nodes()
+                if bans:
+                    banned_nodes[job.id] = bans
 
         # Running jobs, grouped by pool of their run.
         running_by_pool: dict[str, list[RunningJob]] = {p: [] for p in pools}
@@ -256,6 +260,7 @@ class FairSchedulingAlgo:
                 bid_price_of=bid_price_of,
                 global_tokens=g_tokens,
                 queue_tokens=q_tokens,
+                banned_nodes=banned_nodes,
             )
             consume_round(outcome)
             self._apply_outcome(
@@ -326,6 +331,7 @@ class FairSchedulingAlgo:
                     away_mode=True,
                     global_tokens=g_tokens,
                     queue_tokens=q_tokens,
+                    banned_nodes=banned_nodes,
                 )
                 consume_round(outcome)
                 self._apply_outcome(

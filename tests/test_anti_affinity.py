@@ -1,0 +1,117 @@
+"""Retry anti-affinity: retried jobs avoid nodes where attempts died
+(scheduler.go:522-568 -- the reference injects node anti-affinity terms into
+retried jobs so they don't bounce off the same bad node forever)."""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+from armada_tpu.models import run_scheduling_round
+from tests.control_plane import ControlPlane
+from armada_tpu.server import JobSubmitItem, QueueRecord
+
+CFG = SchedulingConfig(shape_bucket=32)
+F = CFG.resource_list_factory()
+
+
+def test_kernel_honors_banned_nodes():
+    # n0 is emptier (best-fit would pick it); the ban forces n1.
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources=F.from_mapping({"cpu": "16", "memory": "64"})),
+        NodeSpec(id="n1", pool="default", total_resources=F.from_mapping({"cpu": "8", "memory": "32"})),
+    ]
+    job = JobSpec(id="retry-1", queue="q", resources=F.from_mapping({"cpu": "2", "memory": "2"}))
+    free = run_scheduling_round(
+        CFG, pool="default", nodes=nodes, queues=[Queue("q")], queued_jobs=[job]
+    )
+    banned = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[job],
+        banned_nodes={"retry-1": ["n1"]},
+    )
+    # without bans, best-fit picks the fuller node n1; the ban flips it
+    assert free.scheduled["retry-1"] == "n1"
+    assert banned.scheduled["retry-1"] == "n0"
+
+    both = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[job],
+        banned_nodes={"retry-1": ["n0", "n1"]},
+    )
+    assert both.scheduled == {} and "retry-1" in both.failed
+
+
+def test_bans_only_affect_their_job():
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources=F.from_mapping({"cpu": "8", "memory": "32"})),
+    ]
+    jobs = [
+        JobSpec(id="banned", queue="q", resources=F.from_mapping({"cpu": "2", "memory": "2"})),
+        JobSpec(id="fine", queue="q", resources=F.from_mapping({"cpu": "2", "memory": "2"})),
+    ]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=jobs,
+        banned_nodes={"banned": ["n0"]},
+    )
+    assert "fine" in out.scheduled and "banned" not in out.scheduled
+
+
+def test_retry_avoids_bad_node_end_to_end(tmp_path):
+    """A job whose pod sticks on one node retries on a DIFFERENT node."""
+    cp = ControlPlane.build(
+        tmp_path, executor_specs={"ex1": (2, "8", "32")}, runtime_s=5.0
+    )
+    cp.server.create_queue(QueueRecord("q"))
+    ex = cp.executors[0]
+    ex._pending_timeout = 10.0
+    (jid,) = cp.server.submit_jobs(
+        "q", "retry", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})]
+    )
+    ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    ex.run_once()
+    (pod,) = ex.cluster.pod_states()
+    first_node = pod.node_id
+
+    # wedge it: never starts; stuck-check returns the run with run_attempted
+    # semantics preserved by the executor report (pending pods attempted=False
+    # in the reference; force attempted here by letting it run first)
+    ex.cluster.tick(0.5)
+    ex.report_cycle()  # running reported -> run_attempted materializes
+    cp.ingest()
+    # then the executor dies with the pod running: lease expiry path
+    ex.cluster.delete_pod(pod.run_id)
+    cp.clock.advance(cp.config.executor_timeout_s + 10)
+    res = cp.scheduler.cycle()
+    assert res.events_by_kind().get("job_requeued") == 1
+
+    # the executor returns; retry must land on the OTHER node
+    import dataclasses
+
+    snap = ex.snapshot()
+    cp.db.upsert_executor(ex.id, snap.to_json(), snap.last_update_ns)
+    # advance the fleet heartbeat stamp past the expiry window
+    snap = dataclasses.replace(snap, last_update_ns=cp.scheduler.now_ns())
+    cp.db.upsert_executor(ex.id, snap.to_json(), snap.last_update_ns)
+    res2 = cp.scheduler.cycle()
+    leases = [
+        ev.job_run_leased
+        for s in res2.published
+        for ev in s.events
+        if ev.WhichOneof("event") == "job_run_leased"
+    ]
+    assert len(leases) == 1
+    assert leases[0].node_id != first_node
+    cp.close()
