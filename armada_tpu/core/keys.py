@@ -47,6 +47,10 @@ class SchedulingKey:
     tolerations: tuple[Toleration, ...]
     priority_class: str
     priority: int
+    # Retry anti-affinity terms (scheduler.go:522-568): the reference folds
+    # affinity into the key via the pod requirements, so a retried job never
+    # shares an unfeasible-key class with a clean one.
+    banned_nodes: tuple[str, ...] = ()
 
 
 class NodeTypeIndex:
@@ -80,7 +84,12 @@ class SchedulingKeyIndex:
         self.keys: list[SchedulingKey] = []
         self._ids: dict[SchedulingKey, int] = {}
 
-    def key_of(self, job: JobSpec, node_id_label: str = "kubernetes.io/hostname") -> int:
+    def key_of(
+        self,
+        job: JobSpec,
+        node_id_label: str = "kubernetes.io/hostname",
+        banned_nodes: Sequence[str] = (),
+    ) -> int:
         # The node-id pinning label is excluded: pinning is handled positionally via
         # the pinned-node tensor, the way the reference injects node-id selectors
         # for evicted jobs (internal/scheduler/api.go addNodeIdSelector:278).
@@ -93,6 +102,7 @@ class SchedulingKeyIndex:
             tolerations=tuple(job.tolerations),
             priority_class=job.priority_class,
             priority=job.priority,
+            banned_nodes=tuple(sorted(banned_nodes)),
         )
         kid = self._ids.get(key)
         if kid is None:

@@ -153,13 +153,6 @@ class Job:
     def num_attempts(self) -> int:
         return sum(1 for r in self.runs if r.run_attempted)
 
-    def failed_nodes(self) -> tuple[str, ...]:
-        """Nodes where an attempted run failed (drives retry anti-affinity,
-        scheduler.go:522-568)."""
-        return tuple(
-            r.node_name for r in self.runs if r.failed and r.run_attempted and r.node_name
-        )
-
     def anti_affinity_nodes(self) -> tuple[str, ...]:
         """Node ids a retry must avoid: every node where an ATTEMPTED run died
         (failed or returned) -- the retry anti-affinity set the reference

@@ -226,13 +226,9 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         pin_ok = jnp.where(
             pinned >= 0, jnp.arange(N, dtype=jnp.int32) == pinned, True
         )
-        # Retry anti-affinity: scatter this gang's banned nodes (sparse pairs;
-        # O(B) per iteration, B ~ retried jobs only).
-        banned = (
-            jnp.zeros((N,), bool)
-            .at[jnp.clip(p.ban_node, 0, N - 1)]
-            .max(p.ban_gang == g)
-        )
+        # Retry anti-affinity: one gather into the precomputed row table
+        # (row 0 = no bans); built outside the loop so XLA hoists it.
+        banned = p.ban_mask[p.g_ban_row[g]]
         ok_base = static_ok & p.node_ok & pin_ok & ~banned
         alloc_clean = c.alloc[0]
         alloc_lvl = c.alloc[level]

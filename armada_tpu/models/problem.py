@@ -99,10 +99,13 @@ class SchedulingProblem(NamedTuple):
     # Market-driven pools order candidates by bid price instead of DRF cost
     # (scheduling/market_iterator.go MarketCandidateGangIterator:245).
     market: np.ndarray  # bool scalar
-    # Retry anti-affinity (scheduler.go:522-568): sparse (gang, node) pairs a
-    # gang must avoid -- nodes where a previous attempt died.  -1 = padding.
-    ban_gang: np.ndarray  # i32[B]
-    ban_node: np.ndarray  # i32[B]
+    # Retry anti-affinity (scheduler.go:522-568): nodes a gang must avoid --
+    # nodes where a previous attempt died.  Precomputed outside the round loop
+    # as a row table so the kernel does one invariant-table gather per
+    # iteration (row 0 = no bans); an in-loop scatter keyed on the gathered
+    # candidate would defeat XLA's invariant hoisting (see CLAUDE.md).
+    ban_mask: np.ndarray  # bool[BR, N]
+    g_ban_row: np.ndarray  # i32[G]
 
 
 @dataclasses.dataclass
@@ -239,6 +242,12 @@ def build_problem(
 
     # --- scheduling keys for queued jobs ---------------------------------------
     kidx = SchedulingKeyIndex()
+    bans_of = banned_nodes or {}
+
+    def _key_of(j: JobSpec) -> int:
+        # Bans join the key (podutils.go folds affinity into SchedulingKey), so a
+        # retried job's placement failure never retires the clean jobs' key class.
+        return kidx.key_of(j, config.node_id_label, banned_nodes=bans_of.get(j.id, ()))
 
     # --- running jobs + evictee gang slots --------------------------------------
     run_list = [r for r in running if r.node_id in node_index]
@@ -354,14 +363,14 @@ def build_problem(
             pc = config.priority_class(job.priority_class)
             units.append((unit_key(pc.priority, job), [job]))
         for gang_id, members in by_gang.items():
-            keys = {kidx.key_of(m, config.node_id_label) for m in members}
+            keys = {_key_of(m) for m in members}
             if len(keys) > 1:
                 # Heterogeneous gangs are split per key class; each sub-gang stays
                 # all-or-nothing but cross-class atomicity is not yet enforced.
                 # (Gap vs gang_scheduler.go; tracked for a later round.)
                 by_key: dict[int, list] = {}
                 for m in members:
-                    by_key.setdefault(kidx.key_of(m, config.node_id_label), []).append(m)
+                    by_key.setdefault(_key_of(m), []).append(m)
                 groups = list(by_key.values())
             else:
                 groups = [members]
@@ -382,7 +391,7 @@ def build_problem(
             g = _new_gang()
             g.jobs = [m.id for m in members]
             g.queue = qi
-            g.key = kidx.key_of(lead, config.node_id_label)
+            g.key = _key_of(lead)
             g.level = 1 if away_mode else job_level(lead)
             g.pc = pc_index[pc.name]
             g.req = factory.ceil_units(lead.resources.atoms).astype(np.float32) if lead.resources else np.zeros(R, np.float32)
@@ -458,27 +467,38 @@ def build_problem(
                 ri = factory.index_of(name)
                 pc_queue_cap[ci, ri] = frac * total_pool[ri]
 
-    # --- retry anti-affinity pairs ----------------------------------------------
-    ban_pairs: list[tuple[int, int]] = []
-    if banned_nodes:
+    # --- retry anti-affinity rows ------------------------------------------------
+    # Row 0 is the all-clear; each gang with bans gets its own row.  Shapes are
+    # padded to small buckets so jit recompiles only when the banned-gang count
+    # crosses a bucket boundary.
+    g_ban_row = np.zeros((G,), np.int32)
+    ban_rows: list[np.ndarray] = []
+    if bans_of:
         gang_of_job = {}
         for gi, members in enumerate(gang_members_out):
             for jid in members:
                 gang_of_job[jid] = gi
-        for jid, node_ids in banned_nodes.items():
+        rows_by_gang: dict[int, np.ndarray] = {}
+        for jid, node_ids in bans_of.items():
             gi = gang_of_job.get(jid)
             if gi is None:
                 continue
+            row = rows_by_gang.get(gi)
+            if row is None:
+                row = np.zeros((N,), bool)
+                rows_by_gang[gi] = row
             for nid in node_ids:
                 ni = node_index.get(nid)
                 if ni is not None:
-                    ban_pairs.append((gi, ni))
-    B = _pad(len(ban_pairs), bucket) if ban_pairs else 1
-    ban_gang = np.full((B,), -1, np.int32)
-    ban_node = np.zeros((B,), np.int32)
-    for i, (gi, ni) in enumerate(ban_pairs):
-        ban_gang[i] = gi
-        ban_node[i] = ni
+                    row[ni] = True
+        for gi, row in rows_by_gang.items():
+            if row.any():
+                ban_rows.append(row)
+                g_ban_row[gi] = len(ban_rows)
+    BR = _pad(len(ban_rows) + 1, 8) if ban_rows else 1
+    ban_mask = np.zeros((BR, N), bool)
+    for i, row in enumerate(ban_rows):
+        ban_mask[i + 1] = row
 
     # --- queue-ordered gang index ----------------------------------------------
     Q = _pad(len(sorted_queues), bucket)
@@ -566,8 +586,8 @@ def build_problem(
         node_axes=node_axes,
         float_total=float_total,
         market=np.bool_(market),
-        ban_gang=ban_gang,
-        ban_node=ban_node,
+        ban_mask=ban_mask,
+        g_ban_row=g_ban_row,
     )
     ctx = HostContext(
         config=config,

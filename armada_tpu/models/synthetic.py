@@ -178,8 +178,8 @@ def synthetic_problem(
         node_axes=np.ones((R,), np.float32),
         float_total=np.zeros((R,), np.float32),
         market=np.bool_(False),
-        ban_gang=np.full((1,), -1, np.int32),
-        ban_node=np.zeros((1,), np.int32),
+        ban_mask=np.zeros((1, N), bool),
+        g_ban_row=np.zeros((G,), np.int32),
     )
     meta = dict(
         num_levels=3,

@@ -114,8 +114,9 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         node_axes=repl,
         float_total=repl,
         market=repl,
-        ban_gang=repl,
-        ban_node=repl,
+        # ban rows follow the node axis; the row-index vector follows gangs
+        ban_mask=s(None, AXIS_NODES),
+        g_ban_row=jobsax,
     )
 
 

@@ -363,6 +363,7 @@ class FairSchedulingAlgo:
                 extra_running,
                 executor_of_node,
                 now_ns,
+                banned_nodes,
             )
 
         return result
@@ -377,6 +378,7 @@ class FairSchedulingAlgo:
         extra_running: dict,
         executor_of_node: dict,
         now_ns: int,
+        banned_nodes: Optional[dict] = None,
     ) -> None:
         preempted_ids = {job.id for job, _ in result.preempted}
         still_queued = {j.id: j for j in queued_jobs}
@@ -404,6 +406,7 @@ class FairSchedulingAlgo:
                 fair_share={
                     q: s["adjusted_fair_share"] for q, s in shares.items()
                 },
+                banned_nodes=banned_nodes,
             )
             for d in decisions:
                 # The rate limiters gate optimiser placements too.

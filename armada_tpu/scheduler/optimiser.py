@@ -72,12 +72,16 @@ class Optimiser:
         running: Sequence[RunningJob],
         actual_share: Mapping[str, float],
         fair_share: Mapping[str, float],
+        banned_nodes: Optional[Mapping[str, Sequence[str]]] = None,
     ) -> list[OptimiserDecision]:
         """Place up to max_stuck_jobs_per_cycle stuck jobs by preempting
         over-fair-share victims; returns the decisions (caller applies them).
 
         `running` must reflect prior decisions; each decision consumes
         capacity, so the list is re-derived after each placement.
+
+        banned_nodes: {job_id: node ids} retry anti-affinity -- the optimiser
+        must never hand a retry back to the node it died on.
         """
         if not self.opt.enabled or not stuck:
             return []
@@ -126,6 +130,7 @@ class Optimiser:
                     actual_share,
                     fair_share,
                     max_size,
+                    frozenset((banned_nodes or {}).get(job.id, ())),
                 )
                 if decision is None:
                     ok = False
@@ -151,6 +156,7 @@ class Optimiser:
         actual_share: Mapping[str, float],
         fair_share: Mapping[str, float],
         max_size,
+        banned: frozenset = frozenset(),
     ) -> Optional[OptimiserDecision]:
         req = (
             np.asarray(job.resources.atoms, dtype=np.float64) * self._node_axes
@@ -171,6 +177,8 @@ class Optimiser:
         best: Optional[tuple[float, OptimiserDecision]] = None
         for n, tid in zip(nodes, type_of):
             if n.unschedulable or not compat[tid] or n.total_resources is None:
+                continue
+            if n.id in banned:
                 continue
             total = np.asarray(n.total_resources.atoms, dtype=np.float64) * self._node_axes
             residents = [

@@ -215,6 +215,9 @@ class Scheduler:
             builder = _SequenceBuilder()
             now_ns = self.now_ns()
 
+            # Refresh the submit checker's fleet BEFORE the update messages:
+            # the requeue anti-affinity gate (_fail_or_requeue) consults it.
+            self._refresh_checker_fleet(now_ns)
             self._generate_update_messages(txn, touched, builder, now_ns)
             self._validate_jobs(txn, builder, now_ns)
             self._expire_executor_jobs(txn, builder, now_ns)
@@ -401,9 +404,24 @@ class Scheduler:
     ) -> None:
         """Requeue up to max_retries attempted runs, else fail terminally
         (scheduler.go:473-568 retry logic)."""
-        if job.num_attempts() <= self.config.max_retries and not (
+        requeue = job.num_attempts() <= self.config.max_retries and not (
             job.cancel_requested or job.cancel_by_jobset_requested
-        ):
+        )
+        bans = job.anti_affinity_nodes() if requeue else ()
+        if bans and self.submit_checker.have_executors:
+            # A retry must avoid every node where an attempt died; if that
+            # leaves nowhere it can run, fail it now instead of requeueing it
+            # to starve forever (scheduler.go:826-840
+            # addNodeAntiAffinitiesForAttemptedRunsIfSchedulable).
+            spec = dataclasses.replace(job.spec, priority=job.priority)
+            if not self.submit_checker.check_gang([spec], banned_nodes=bans).ok:
+                requeue = False
+                message = (
+                    f"job was attempted {job.num_attempts()} times and has been "
+                    "tried once on all nodes it can run on - "
+                    "this job will no longer be retried"
+                ) + f" ({message})"
+        if requeue:
             builder.add(
                 job.queue,
                 job.jobset,
@@ -438,14 +456,10 @@ class Scheduler:
 
     # --- validation (scheduler.go submitCheck:1011, submitcheck.go Check:181)
 
-    def _validate_jobs(
-        self, txn: WriteTxn, builder: _SequenceBuilder, now_ns: int
-    ) -> None:
-        unvalidated = txn.unvalidated_jobs()
-        if not unvalidated:
-            return
-        # Same staleness filter as the scheduling algo: a dead executor's
-        # snapshot must not vouch for (or block) a job's schedulability.
+    def _refresh_checker_fleet(self, now_ns: int) -> None:
+        """Update the SubmitChecker's fleet snapshot for this cycle.  Same
+        staleness filter as the scheduling algo: a dead executor's snapshot
+        must not vouch for (or block) a job's schedulability."""
         timeout_ns = int(self.config.executor_timeout_s * 1e9)
         live = [
             ex
@@ -453,6 +467,13 @@ class Scheduler:
             if now_ns - ex.last_update_ns <= timeout_ns
         ]
         self.submit_checker.update_executors(live)
+
+    def _validate_jobs(
+        self, txn: WriteTxn, builder: _SequenceBuilder, now_ns: int
+    ) -> None:
+        unvalidated = txn.unvalidated_jobs()
+        if not unvalidated:
+            return
         if not self.submit_checker.have_executors:
             # No fleet yet: defer -- nothing can be judged unschedulable
             # against zero executors, and nothing can lease anyway.

@@ -91,9 +91,16 @@ class SubmitChecker:
 
     # --- the check (submitcheck.go Check:181) -------------------------------
 
-    def check_gang(self, members: Sequence[JobSpec]) -> CheckResult:
+    def check_gang(
+        self, members: Sequence[JobSpec], banned_nodes: Sequence[str] = ()
+    ) -> CheckResult:
         """All members share a scheduling shape (validation enforces gang
-        consistency); singleton jobs are gangs of one."""
+        consistency); singleton jobs are gangs of one.
+
+        banned_nodes: node ids excluded from fit -- retry anti-affinity, used
+        by the requeue gate (scheduler.go:826-840: a retried job is failed
+        terminally if it cannot schedule once its attempted nodes are
+        excluded)."""
         if not members:
             return CheckResult(False, "empty gang")
         lead = members[0]
@@ -101,6 +108,12 @@ class SubmitChecker:
         # a partially-arrived gang must be judged at full size.
         cardinality = max(len(members), lead.gang_cardinality or 1)
 
+        banned = frozenset(banned_nodes)
+        if banned:
+            # Ban sets are per-job and near-unique; caching them would grow the
+            # cache without bound between fleet changes (the reference bounds
+            # its cache with an LRU, submitcheck.go:243).  Gate calls are rare.
+            return self._check_uncached(lead, cardinality, banned)
         kidx = SchedulingKeyIndex()
         key_id = kidx.key_of(lead, self.config.node_id_label)
         cache_key = (kidx.keys[key_id], cardinality, tuple(lead.pools))
@@ -112,7 +125,9 @@ class SubmitChecker:
         self._cache[cache_key] = result
         return result
 
-    def _check_uncached(self, lead: JobSpec, cardinality: int) -> CheckResult:
+    def _check_uncached(
+        self, lead: JobSpec, cardinality: int, banned: frozenset = frozenset()
+    ) -> CheckResult:
         req = (
             np.asarray(lead.resources.atoms, dtype=np.float64)
             if lead.resources is not None
@@ -183,7 +198,7 @@ class SubmitChecker:
             members_possible = 0
             biggest_gap = None
             for n, tid in zip(nodes, type_of_node):
-                if not compat[tid]:
+                if not compat[tid] or n.id in banned:
                     continue
                 total = np.asarray(n.total_resources.atoms, dtype=np.float64)
                 with np.errstate(divide="ignore", invalid="ignore"):

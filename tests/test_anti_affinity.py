@@ -115,3 +115,49 @@ def test_retry_avoids_bad_node_end_to_end(tmp_path):
     assert len(leases) == 1
     assert leases[0].node_id != first_node
     cp.close()
+
+
+def test_requeue_gate_fails_job_with_nowhere_left_to_run(tmp_path):
+    """When anti-affinity bans cover every node the job could use, the requeue
+    is converted into a terminal failure (scheduler.go:826-840
+    addNodeAntiAffinitiesForAttemptedRunsIfSchedulable)."""
+    import dataclasses
+
+    cp = ControlPlane.build(
+        tmp_path,
+        # ex1 hosts the only node the job fits; ex2's node is too small.
+        executor_specs={"ex1": (1, "8", "32"), "ex2": (1, "1", "1")},
+        runtime_s=50.0,
+    )
+    cp.server.create_queue(QueueRecord("q"))
+    ex1, ex2 = cp.executors
+    (jid,) = cp.server.submit_jobs(
+        "q", "gate", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})]
+    )
+    ex1.run_once()
+    ex2.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    ex1.run_once()
+    (pod,) = ex1.cluster.pod_states()
+
+    # run long enough to be reported RUNNING -> run_attempted materializes
+    ex1.cluster.tick(0.5)
+    ex1.report_cycle()
+    cp.ingest()
+
+    # ex1 dies with the pod running; ex2 stays live (fresh heartbeat)
+    ex1.cluster.delete_pod(pod.run_id)
+    cp.clock.advance(cp.config.executor_timeout_s + 10)
+    snap2 = dataclasses.replace(ex2.snapshot(), last_update_ns=cp.scheduler.now_ns())
+    cp.db.upsert_executor(ex2.id, snap2.to_json(), snap2.last_update_ns)
+
+    res = cp.scheduler.cycle()
+    kinds = res.events_by_kind()
+    # the only node the retry could use is banned -> terminal failure, no requeue
+    assert kinds.get("job_requeued") is None
+    assert kinds.get("job_errors") == 1
+    job = cp.jobdb.read_txn().get(jid)
+    assert job.failed and not job.queued
+    cp.close()

@@ -189,12 +189,12 @@ def test_job_state_transitions():
     job = job.with_updated_run(job.latest_run.with_running("node-1"))
     job = job.with_updated_run(job.latest_run.with_succeeded()).with_succeeded()
     assert job.in_terminal_state() and not job.has_active_run()
-    # Failed runs on named nodes feed retry anti-affinity.
+    # Attempted runs that died feed retry anti-affinity (by node id).
     j2 = make_job("j2").with_new_run(
-        JobRun(id="r2", job_id="j2", node_name="bad-node")
+        JobRun(id="r2", job_id="j2", node_id="bad-node")
     )
     j2 = j2.with_updated_run(j2.latest_run.with_returned(run_attempted=True)._with(failed=True))
-    assert j2.failed_nodes() == ("bad-node",)
+    assert j2.anti_affinity_nodes() == ("bad-node",)
     assert j2.num_attempts() == 1
 
 

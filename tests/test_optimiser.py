@@ -185,3 +185,39 @@ def test_optimiser_off_leaves_job_stuck(tmp_path):
     cp.step()
     assert cp.job_states()[big[0]] == "queued"
     cp.close()
+
+def test_banned_node_never_hosts_the_retry():
+    """Retry anti-affinity reaches the optimiser: a stuck retry is not placed
+    back on the node its attempt died on (scheduler.go:522-568)."""
+    runs = [running("victim", "n0", submit=9.0)]
+    # Without bans the optimiser would preempt on n0.
+    (d,) = opt().optimise(
+        [spec("stuck", queue="starved")],
+        [node("n0")],
+        runs,
+        actual_share={"hog": 0.9},
+        fair_share={"hog": 0.5},
+    )
+    assert d.node_id == "n0"
+    # With the ban, n0 is off-limits and nothing places.
+    assert (
+        opt().optimise(
+            [spec("stuck", queue="starved")],
+            [node("n0")],
+            runs,
+            actual_share={"hog": 0.9},
+            fair_share={"hog": 0.5},
+            banned_nodes={"stuck": ("n0",)},
+        )
+        == []
+    )
+    # A second (banned-free) node wins instead, preferring no-preemption fit.
+    (d2,) = opt().optimise(
+        [spec("stuck", queue="starved")],
+        [node("n0"), node("n1")],
+        runs,
+        actual_share={"hog": 0.9},
+        fair_share={"hog": 0.5},
+        banned_nodes={"stuck": ("n0",)},
+    )
+    assert d2.node_id == "n1" and d2.preempted_job_ids == []
