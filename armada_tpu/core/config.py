@@ -9,6 +9,7 @@ kernel can be specialised (config values are static under jit).
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Mapping, Optional, Sequence
 
 from armada_tpu.core.resources import ResourceListFactory
@@ -41,6 +42,10 @@ class PoolConfig:
     # Candidate ordering by bid price instead of DRF cost
     # (experimentalMarketScheduling; market_iterator.go).
     market_driven: bool = False
+    # Jobs that exit sooner than this after starting keep charging their
+    # queue's DRF cost until the window passes (short_job_penalty.go;
+    # configuration.go:299 ShortJobPenaltyCutoff).  0 disables.
+    short_job_penalty_cutoff_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,6 +168,14 @@ class SchedulingConfig:
     def floating_resource_names(self) -> tuple[str, ...]:
         return tuple(fr.name for fr in self.floating_resources)
 
+    def short_job_penalty_cutoffs(self) -> dict[str, float]:
+        """pool -> cutoff seconds (configuration.go GetShortJobPenaltyCutoffs)."""
+        return {
+            p.name: p.short_job_penalty_cutoff_s
+            for p in self.pools
+            if p.short_job_penalty_cutoff_s > 0
+        }
+
     def floating_totals_for_pool(self, pool: str) -> dict[str, "str | int"]:
         """name -> quantity of each floating resource available in `pool`
         (floating_resource_types.go GetTotalAvailableForPool)."""
@@ -207,6 +220,32 @@ def _parse_priority_classes(d: Mapping) -> dict[str, PriorityClass]:
     return out
 
 
+_DURATION_RE = re.compile(r"([0-9]*\.?[0-9]+)\s*(ms|s|m|h|d|)")
+_DURATION_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0, "": 1.0}
+
+
+def parse_duration_s(d) -> float:
+    """'5m', '90s', '1h30m', '300ms', bare numbers (seconds) -> seconds.
+    The one duration parser (simulator specs and config share it)."""
+    if d is None:
+        return 0.0
+    if isinstance(d, (int, float)):
+        return float(d)
+    s = str(d).strip()
+    if not s:
+        return 0.0
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration: {d!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration: {d!r}")
+    return total
+
+
 def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
     """Build a SchedulingConfig from a parsed YAML mapping using the reference's
     key names (config/scheduler/config.yaml `scheduling:` block)."""
@@ -221,6 +260,9 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
                 p["name"],
                 tuple(p.get("awayPools", [])),
                 market_driven=bool(p.get("marketDriven", False)),
+                short_job_penalty_cutoff_s=parse_duration_s(
+                    p.get("shortJobPenaltyCutoff", 0)
+                ),
             )
             for p in d["pools"]
         )

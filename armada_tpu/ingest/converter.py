@@ -120,7 +120,9 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
         return ops.MarkRunsPending(runs={e.run_id: e.job_id})
     if kind == "job_run_running":
         e = ev.job_run_running
-        return ops.MarkRunsRunning(runs={e.run_id: e.job_id})
+        return ops.MarkRunsRunning(
+            runs={e.run_id: e.job_id}, times={e.run_id: int(ev.created_ns)}
+        )
     if kind == "job_run_succeeded":
         e = ev.job_run_succeeded
         return ops.MarkRunsSucceeded(runs={e.run_id: e.job_id})

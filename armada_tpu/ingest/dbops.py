@@ -182,8 +182,18 @@ class MarkRunsPending(_RunIdSetOp):
     pass
 
 
+@dataclasses.dataclass
 class MarkRunsRunning(_RunIdSetOp):
-    pass
+    # run_id -> event time ns: records running_ns for the short-job penalty
+    # window (short_job_penalty.go RunningTime).
+    times: dict = dataclasses.field(default_factory=dict)
+
+    def merge(self, other: DbOperation) -> bool:
+        if type(other) is type(self):
+            self.runs.update(other.runs)
+            self.times.update(other.times)
+            return True
+        return False
 
 
 class MarkRunsSucceeded(_RunIdSetOp):

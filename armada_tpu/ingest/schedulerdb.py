@@ -64,6 +64,7 @@ CREATE TABLE IF NOT EXISTS runs (
   returned INTEGER NOT NULL DEFAULT 0,
   run_attempted INTEGER NOT NULL DEFAULT 0,
   preempt_requested INTEGER NOT NULL DEFAULT 0,
+  running_ns INTEGER NOT NULL DEFAULT 0,
   serial INTEGER NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_runs_serial ON runs(serial);
@@ -152,6 +153,14 @@ class SchedulerDb:
         if "preempt_requested" not in cols:
             self._conn.execute(
                 "ALTER TABLE jobs ADD COLUMN preempt_requested INTEGER NOT NULL DEFAULT 0"
+            )
+        run_cols = {
+            r["name"]
+            for r in self._conn.execute("PRAGMA table_info(runs)").fetchall()
+        }
+        if "running_ns" not in run_cols:
+            self._conn.execute(
+                "ALTER TABLE runs ADD COLUMN running_ns INTEGER NOT NULL DEFAULT 0"
             )
 
     def close(self) -> None:
@@ -294,11 +303,21 @@ class SchedulerDb:
             run_attempted = (
                 ", run_attempted = 1" if flag in ("running", "succeeded") else ""
             )
-            cur.executemany(
-                f"UPDATE runs SET {flag} = 1{run_attempted}, serial = {serial} "
-                "WHERE run_id = ?",
-                [(rid,) for rid in op.runs],
-            )
+            if isinstance(op, ops.MarkRunsRunning):
+                # Record when the run started (short-job penalty window);
+                # keep the earliest timestamp on replay.
+                cur.executemany(
+                    f"UPDATE runs SET {flag} = 1{run_attempted}, serial = {serial}, "
+                    "running_ns = CASE WHEN running_ns > 0 THEN running_ns ELSE ? END "
+                    "WHERE run_id = ?",
+                    [(int(op.times.get(rid, 0)), rid) for rid in op.runs],
+                )
+            else:
+                cur.executemany(
+                    f"UPDATE runs SET {flag} = 1{run_attempted}, serial = {serial} "
+                    "WHERE run_id = ?",
+                    [(rid,) for rid in op.runs],
+                )
         elif isinstance(op, ops.MarkJobsPreemptRequested):
             # Mark active runs AND persist the request on the job row: if no
             # run exists yet (job still queued, or the lease materializes

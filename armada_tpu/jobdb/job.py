@@ -44,6 +44,9 @@ class JobRun:
     returned: bool = False
     # Executor reported it actually started the pod (counts toward attempts).
     run_attempted: bool = False
+    # When the run started RUNNING (job_run.go RunningTime); 0 = never ran.
+    # Feeds the short-job penalty window (short_job_penalty.go:46-52).
+    running_ns: int = 0
 
     def in_terminal_state(self) -> bool:
         return (
@@ -60,8 +63,12 @@ class JobRun:
     def with_pending(self) -> "JobRun":
         return self._with(pending=True)
 
-    def with_running(self, node_name: str = "") -> "JobRun":
-        return self._with(running=True, node_name=node_name or self.node_name)
+    def with_running(self, node_name: str = "", running_ns: int = 0) -> "JobRun":
+        return self._with(
+            running=True,
+            node_name=node_name or self.node_name,
+            running_ns=running_ns or self.running_ns,
+        )
 
     def with_succeeded(self) -> "JobRun":
         return self._with(succeeded=True, running=False)

@@ -30,6 +30,7 @@ def run_scheduling_round(
     global_tokens=None,
     queue_tokens=None,
     banned_nodes=None,
+    queue_penalty=None,
 ):
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
@@ -50,6 +51,7 @@ def run_scheduling_round(
         global_tokens=global_tokens,
         queue_tokens=queue_tokens,
         banned_nodes=banned_nodes,
+        queue_penalty=queue_penalty,
     )
     device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
     result = schedule_round(

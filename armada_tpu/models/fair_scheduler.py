@@ -184,8 +184,11 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         # --- queue order: min proposed DRF cost (queue_scheduler.go Less:589),
         # --- or max bid price in market pools (market_iterator.go:245) ------
         req_tot_q = p.g_req[cand] * p.g_card[cand][:, None].astype(jnp.float32)
+        # Ordering cost includes the short-job penalty (queue_scheduler.go:
+        # 514-515 GetAllocationInclShortJobPenalty); fair shares, caps and
+        # eviction protection do not.
         proposed = weighted_drf_cost(
-            c.q_alloc + req_tot_q, p.total_pool, p.drf_mult, p.q_weight
+            c.q_alloc + p.q_penalty + req_tot_q, p.total_pool, p.drf_mult, p.q_weight
         )
         proposed = jnp.where(p.market, -p.g_price[cand], proposed)
         proposed = jnp.where(has, proposed, _INF)

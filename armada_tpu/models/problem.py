@@ -81,6 +81,10 @@ class SchedulingProblem(NamedTuple):
     # queues
     q_weight: np.ndarray  # f32[Q] (0 = padding)
     q_cds: np.ndarray  # f32[Q] constrained demand share
+    # Short-job penalty (short_job_penalty.go): resources of recently-exited
+    # short jobs, charged to the queue-ordering cost only
+    # (queue_scheduler.go:514-515 GetAllocationInclShortJobPenalty).
+    q_penalty: np.ndarray  # f32[Q, R]
     # static fit
     compat: np.ndarray  # bool[K, T]
     # pool-level scalars/vectors
@@ -185,6 +189,7 @@ def build_problem(
     global_tokens=None,
     queue_tokens=None,
     banned_nodes=None,
+    queue_penalty=None,
 ) -> tuple[SchedulingProblem, HostContext]:
     """`bid_price_of(job) -> float` supplies bid prices; required for pools
     configured market_driven (pricer/gang_pricer.go:29-40).
@@ -198,7 +203,10 @@ def build_problem(
     limiters (maximumSchedulingRate token buckets, queue_scheduler.go).
 
     banned_nodes: {job_id: iterable of node ids} a retried job must avoid
-    (retry anti-affinity, scheduler.go:522-568)."""
+    (retry anti-affinity, scheduler.go:522-568).
+
+    queue_penalty: {queue: resource atoms} short-job penalty charged to the
+    queue-ordering cost (short_job_penalty.go; scheduling_algo.go:342-360)."""
     factory = config.resource_list_factory()
     R = factory.num_resources
     bucket = config.shape_bucket
@@ -244,10 +252,14 @@ def build_problem(
     kidx = SchedulingKeyIndex()
     bans_of = banned_nodes or {}
 
-    def _key_of(j: JobSpec) -> int:
+    def _key_of(j: JobSpec, gang_bans=None) -> int:
         # Bans join the key (podutils.go folds affinity into SchedulingKey), so a
         # retried job's placement failure never retires the clean jobs' key class.
-        return kidx.key_of(j, config.node_id_label, banned_nodes=bans_of.get(j.id, ()))
+        # Gang members share their gang's UNION ban set: per-member bans would
+        # give members distinct keys and shatter the gang into singleton
+        # sub-gangs, losing all-or-nothing atomicity.
+        bans = gang_bans if gang_bans is not None else bans_of.get(j.id, ())
+        return kidx.key_of(j, config.node_id_label, banned_nodes=bans)
 
     # --- running jobs + evictee gang slots --------------------------------------
     run_list = [r for r in running if r.node_id in node_index]
@@ -358,23 +370,26 @@ def build_problem(
                 return (-price_of(job), job.submit_time, job.id)
             return _job_sort_key(lead_pc_priority, job)
 
-        units: list[tuple[tuple, list]] = []
+        units: list[tuple[tuple, list, int]] = []
         for job in singles:
             pc = config.priority_class(job.priority_class)
-            units.append((unit_key(pc.priority, job), [job]))
+            units.append((unit_key(pc.priority, job), [job], _key_of(job)))
         for gang_id, members in by_gang.items():
-            keys = {_key_of(m) for m in members}
+            gang_bans = sorted(
+                set().union(*(bans_of.get(m.id, ()) for m in members))
+            ) if bans_of else ()
+            keys = {_key_of(m, gang_bans) for m in members}
             if len(keys) > 1:
                 # Heterogeneous gangs are split per key class; each sub-gang stays
                 # all-or-nothing but cross-class atomicity is not yet enforced.
                 # (Gap vs gang_scheduler.go; tracked for a later round.)
                 by_key: dict[int, list] = {}
                 for m in members:
-                    by_key.setdefault(_key_of(m), []).append(m)
-                groups = list(by_key.values())
+                    by_key.setdefault(_key_of(m, gang_bans), []).append(m)
+                groups = list(by_key.items())
             else:
-                groups = [members]
-            for grp in groups:
+                groups = [(next(iter(keys)), members)]
+            for grp_key, grp in groups:
                 lead = min(
                     grp,
                     key=lambda m: _job_sort_key(
@@ -382,16 +397,16 @@ def build_problem(
                     ),
                 )
                 pc = config.priority_class(lead.priority_class)
-                units.append((unit_key(pc.priority, lead), grp))
+                units.append((unit_key(pc.priority, lead), grp, grp_key))
         units.sort(key=lambda u: u[0])
         base = len(evictee_by_queue[qi])
-        for order, (_, members) in enumerate(units[: config.max_queue_lookback]):
+        for order, (_, members, key) in enumerate(units[: config.max_queue_lookback]):
             lead = members[0]
             pc = config.priority_class(lead.priority_class)
             g = _new_gang()
             g.jobs = [m.id for m in members]
             g.queue = qi
-            g.key = _key_of(lead)
+            g.key = key
             g.level = 1 if away_mode else job_level(lead)
             g.pc = pc_index[pc.name]
             g.req = factory.ceil_units(lead.resources.atoms).astype(np.float32) if lead.resources else np.zeros(R, np.float32)
@@ -509,6 +524,12 @@ def build_problem(
     # --- queues: weights + constrained demand share ----------------------------
     q_weight = np.zeros((Q,), np.float32)
     q_cds = np.zeros((Q,), np.float32)
+    q_penalty = np.zeros((Q, R), np.float32)
+    if queue_penalty:
+        for qname, atoms in queue_penalty.items():
+            qi = queue_by_name.get(qname)
+            if qi is not None:
+                q_penalty[qi] = factory.ceil_units(atoms).astype(np.float32)
     demand_by_pc = np.zeros((len(sorted_queues), C, R), np.float64)
     for g in gangs:
         if g.run < 0:
@@ -569,6 +590,7 @@ def build_problem(
         q_len=q_len,
         q_weight=q_weight,
         q_cds=q_cds,
+        q_penalty=q_penalty,
         compat=compat,
         total_pool=total_pool,
         drf_mult=drf_mult,
@@ -626,6 +648,12 @@ def queue_stats_from_result(result, problem: SchedulingProblem, ctx: HostContext
     fs = np.asarray(shares.fair_share)
     afs = np.asarray(shares.demand_capped_adjusted_fair_share)
     actual = np.asarray(actual)
+    penalty = unweighted_drf_cost(
+        np.asarray(problem.q_penalty),
+        np.asarray(problem.total_pool),
+        np.asarray(problem.drf_mult),
+    )
+    penalty = np.asarray(penalty)
     out = {}
     for qi in range(ctx.num_real_queues):
         out[ctx.queue_names[qi]] = {
@@ -634,6 +662,8 @@ def queue_stats_from_result(result, problem: SchedulingProblem, ctx: HostContext
             "adjusted_fair_share": float(afs[qi]),
             "actual_share": float(actual[qi]),
             "demand_share": float(problem.q_cds[qi]),
+            # cycle_metrics.go:443: unweighted cost of the penalty RL.
+            "short_job_penalty": float(penalty[qi]),
         }
     return out
 

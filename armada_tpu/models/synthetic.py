@@ -166,6 +166,7 @@ def synthetic_problem(
         q_len=q_len,
         q_weight=q_weight,
         q_cds=q_cds,
+        q_penalty=np.zeros((Q, R), np.float32),
         compat=compat,
         total_pool=total_pool,
         drf_mult=drf_mult,

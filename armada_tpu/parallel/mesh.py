@@ -102,6 +102,7 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         q_len=repl,
         q_weight=repl,
         q_cds=repl,
+        q_penalty=repl,
         compat=repl,
         total_pool=repl,
         drf_mult=repl,

@@ -95,6 +95,11 @@ class FairSchedulingAlgo:
                 f"pools {market_pools} are market driven: FairSchedulingAlgo "
                 "needs a bid_prices provider (scheduler/providers.py)"
             )
+        from armada_tpu.scheduler.short_job_penalty import ShortJobPenalty
+
+        self.short_job_penalty = ShortJobPenalty(
+            config.short_job_penalty_cutoffs()
+        )
         self.optimiser = None
         if config.optimiser_enabled:
             from armada_tpu.scheduler.optimiser import Optimiser, OptimiserConfig
@@ -198,13 +203,25 @@ class FairSchedulingAlgo:
                 if bans:
                     banned_nodes[job.id] = bans
 
-        # Running jobs, grouped by pool of their run.
+        # Running jobs, grouped by pool of their run; short-job penalties
+        # accumulate per (run pool, queue) off retained terminal jobs
+        # (scheduling_algo.go:342-360 shortJobPenaltyByQueue).
         running_by_pool: dict[str, list[RunningJob]] = {p: [] for p in pools}
+        penalty_by_pool: dict[str, dict[str, "object"]] = {}
         for job in txn.all_jobs():
             run = job.latest_run
-            if run is None or run.in_terminal_state() or job.in_terminal_state():
-                continue
             if job.queue not in known_queues:
+                continue
+            if run is not None and self.short_job_penalty.applies(job, now_ns):
+                if job.spec.resources is not None:
+                    pool_map = penalty_by_pool.setdefault(run.pool or "default", {})
+                    prev = pool_map.get(job.queue)
+                    atoms = job.spec.resources.atoms
+                    pool_map[job.queue] = (
+                        atoms if prev is None else [a + b for a, b in zip(prev, atoms)]
+                    )
+                continue
+            if run is None or run.in_terminal_state() or job.in_terminal_state():
                 continue
             pool = run.pool or "default"
             if pool not in running_by_pool:
@@ -261,6 +278,7 @@ class FairSchedulingAlgo:
                 global_tokens=g_tokens,
                 queue_tokens=q_tokens,
                 banned_nodes=banned_nodes,
+                queue_penalty=penalty_by_pool.get(pool),
             )
             consume_round(outcome)
             self._apply_outcome(
@@ -332,6 +350,7 @@ class FairSchedulingAlgo:
                     global_tokens=g_tokens,
                     queue_tokens=q_tokens,
                     banned_nodes=banned_nodes,
+                    queue_penalty=penalty_by_pool.get(host),
                 )
                 consume_round(outcome)
                 self._apply_outcome(

@@ -48,6 +48,11 @@ class SchedulerMetrics:
         self.queue_weight = g(
             "armada_scheduler_queue_weight", "Weight of each queue", ["pool", "queue"]
         )
+        self.short_job_penalty = g(
+            "armada_scheduler_short_job_penalty",
+            "Resource share charged for jobs that exited soon after starting",
+            ["pool", "queue"],
+        )
         self.fairness_error = g(
             "armada_scheduler_fairness_error",
             "Cumulative delta between adjusted fair share and actual share",
@@ -126,5 +131,8 @@ class SchedulerMetrics:
                 self.actual_share.labels(stats.pool, qname).set(qs["actual_share"])
                 self.demand.labels(stats.pool, qname).set(qs["demand_share"])
                 self.queue_weight.labels(stats.pool, qname).set(qs["weight"])
+                self.short_job_penalty.labels(stats.pool, qname).set(
+                    qs.get("short_job_penalty", 0.0)
+                )
                 error += abs(qs["adjusted_fair_share"] - qs["actual_share"])
             self.fairness_error.labels(stats.pool).set(error)

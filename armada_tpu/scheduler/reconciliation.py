@@ -91,6 +91,7 @@ def run_from_row(row) -> JobRun:
         preempted=bool(row["preempted"]),
         returned=bool(row["returned"]),
         run_attempted=bool(row["run_attempted"]),
+        running_ns=int(row["running_ns"]) if "running_ns" in row.keys() else 0,
     )
 
 
@@ -147,8 +148,17 @@ def apply_rows(
     job_rows: Iterable,
     run_rows: Iterable,
     config: SchedulingConfig,
+    retained_terminal: Optional[set] = None,
 ) -> list[str]:
-    """Apply fetched rows to the txn; returns ids of jobs that changed."""
+    """Apply fetched rows to the txn; returns ids of jobs that changed.
+
+    retained_terminal (a set, mutated): when given, DB-terminal jobs are kept
+    in the JobDb (queued=False) and their ids recorded, instead of being
+    deleted -- the short-job penalty needs to see recently finished jobs
+    (scheduler.go:436-447); the Scheduler's sweep deletes exactly the recorded
+    ids once the penalty window lapses.  Only DB-terminal jobs are eligible:
+    locally-terminal jobs whose events have not round-tripped must never be
+    deleted early (or a later row for them would resurrect a zombie)."""
     factory = config.resource_list_factory()
     touched: list[str] = []
 
@@ -156,10 +166,18 @@ def apply_rows(
         job_id = row["job_id"]
         if row["cancelled"] or row["succeeded"] or row["failed"]:
             # Terminal in the DB: state round-tripped; drop from the JobDb
-            # (the reference deletes persisted-terminal jobs, scheduler.go:414-441).
-            if txn.get(job_id) is not None:
+            # (the reference deletes persisted-terminal jobs, scheduler.go:414-441)
+            # unless the short-job penalty wants it kept around.
+            existing = txn.get(job_id)
+            if retained_terminal is not None:
+                merged = _merge_job(existing, row, factory)
+                # Never let a version-guarded stale queued flag resurrect a
+                # terminal job into the queued index.
+                txn.upsert(dataclasses.replace(merged, queued=False))
+                retained_terminal.add(job_id)
+            elif existing is not None:
                 txn.delete(job_id)
-                touched.append(job_id)
+            touched.append(job_id)
             continue
         existing = txn.get(job_id)
         txn.upsert(_merge_job(existing, row, factory))

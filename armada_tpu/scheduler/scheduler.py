@@ -39,6 +39,7 @@ from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 from armada_tpu.scheduler.leader import LeaderController, LeaderToken
 from armada_tpu.scheduler.reconciliation import apply_rows
+from armada_tpu.scheduler.short_job_penalty import ShortJobPenalty
 from armada_tpu.scheduler.submitcheck import SubmitChecker
 
 MAX_RETRIES_EXCEEDED = "maxRetriesExceeded"
@@ -109,6 +110,9 @@ class Scheduler:
         self.config = config or jobdb.config
         self._clock = clock
         self.submit_checker = SubmitChecker(self.config)
+        self.short_job_penalty = ShortJobPenalty(
+            self.config.short_job_penalty_cutoffs()
+        )
         # Optional observability hooks (SchedulerMetrics /
         # SchedulingReportsRepository); None = disabled.
         self.metrics = metrics
@@ -118,6 +122,9 @@ class Scheduler:
         self._jobs_serial = 0
         self._runs_serial = 0
         self._was_leader = False
+        # Terminal jobs kept in the JobDb for the short-job penalty window
+        # (scheduler.go:436-447); swept in sync_state once the window lapses.
+        self._retained_terminal: set = set()
 
     def now_ns(self) -> int:
         return int(self._clock() * 1e9)
@@ -128,11 +135,32 @@ class Scheduler:
         job_rows, run_rows = self.db.fetch_job_updates(
             self._jobs_serial, self._runs_serial
         )
-        touched = apply_rows(txn, job_rows, run_rows, self.config)
+        touched = apply_rows(
+            txn,
+            job_rows,
+            run_rows,
+            self.config,
+            retained_terminal=(
+                self._retained_terminal if self.short_job_penalty.enabled else None
+            ),
+        )
         if job_rows:
             self._jobs_serial = max(r["serial"] for r in job_rows)
         if run_rows:
             self._runs_serial = max(r["serial"] for r in run_rows)
+        if self._retained_terminal:
+            # Sweep ONLY the jobs retained from DB-terminal rows, once their
+            # penalty window lapses (scheduler.go:436-447 retains; the
+            # lapse-side delete is ours -- the reference only re-examines
+            # changed jobs and so leaks these).  O(retained), and never
+            # touches locally-terminal jobs still awaiting their round-trip.
+            now_ns = self.now_ns()
+            for job_id in list(self._retained_terminal):
+                job = txn.get(job_id)
+                if job is None or not self.short_job_penalty.applies(job, now_ns):
+                    if job is not None:
+                        txn.delete(job_id)
+                    self._retained_terminal.discard(job_id)
         return touched
 
     # --- recovery fencing (scheduler.go ensureDbUpToDate:1120) --------------
@@ -412,8 +440,15 @@ class Scheduler:
             # A retry must avoid every node where an attempt died; if that
             # leaves nowhere it can run, fail it now instead of requeueing it
             # to starve forever (scheduler.go:826-840
-            # addNodeAntiAffinitiesForAttemptedRunsIfSchedulable).
-            spec = dataclasses.replace(job.spec, priority=job.priority)
+            # addNodeAntiAffinitiesForAttemptedRunsIfSchedulable).  Validated
+            # pools override the requested ones, exactly as the algo offers
+            # them (algo.py): the gate must judge the pools the job will
+            # actually be scheduled into.
+            spec = dataclasses.replace(
+                job.spec,
+                priority=job.priority,
+                pools=job.pools or job.spec.pools,
+            )
             if not self.submit_checker.check_gang([spec], banned_nodes=bans).ok:
                 requeue = False
                 message = (

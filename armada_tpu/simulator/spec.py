@@ -10,34 +10,10 @@ never crosses a process boundary in this framework.
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Mapping, Optional, Sequence
 
+from armada_tpu.core.config import parse_duration_s as parse_duration
 from armada_tpu.core.types import Taint
-
-_DURATION_RE = re.compile(r"([0-9]*\.?[0-9]+)\s*(ms|s|m|h|d|)")
-_DURATION_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0, "": 1.0}
-
-
-def parse_duration(d) -> float:
-    """'5m', '90s', '1h30m', '300ms', bare numbers (seconds) -> seconds."""
-    if d is None:
-        return 0.0
-    if isinstance(d, (int, float)):
-        return float(d)
-    s = str(d).strip()
-    if not s:
-        return 0.0
-    pos = 0
-    total = 0.0
-    for m in _DURATION_RE.finditer(s):
-        if m.start() != pos:
-            raise ValueError(f"invalid duration: {d!r}")
-        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
-        pos = m.end()
-    if pos != len(s):
-        raise ValueError(f"invalid duration: {d!r}")
-    return total
 
 
 @dataclasses.dataclass(frozen=True)

@@ -161,3 +161,33 @@ def test_requeue_gate_fails_job_with_nowhere_left_to_run(tmp_path):
     job = cp.jobdb.read_txn().get(jid)
     assert job.failed and not job.queued
     cp.close()
+
+
+def test_gang_bans_apply_as_union_keeping_atomicity():
+    """A retried gang shares the UNION of member ban sets: per-member keys
+    would shatter the gang into independent singletons and allow a half-gang
+    to schedule (all-or-nothing, gang_scheduler.go)."""
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources=F.from_mapping({"cpu": "8", "memory": "32"})),
+        NodeSpec(id="n1", pool="default", total_resources=F.from_mapping({"cpu": "4", "memory": "16"})),
+    ]
+    members = [
+        JobSpec(id="m1", queue="q", gang_id="g1", gang_cardinality=2,
+                resources=F.from_mapping({"cpu": "8", "memory": "2"})),
+        JobSpec(id="m2", queue="q", gang_id="g1", gang_cardinality=2,
+                resources=F.from_mapping({"cpu": "8", "memory": "2"})),
+    ]
+    # m1's attempt died on n0.  Without the union, m2's singleton sub-gang
+    # would land on n0 while m1 stays queued -- a half-gang.
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=members,
+        banned_nodes={"m1": ["n0"]},
+    )
+    # Neither member may schedule alone (without the union, m2's singleton
+    # sub-gang would be placed on n0).  The gang is blocked before a fit
+    # attempt here (queue cap), so it is unscheduled rather than failed.
+    assert out.scheduled == {}

@@ -1,0 +1,194 @@
+"""Short-job penalty: recently-exited short jobs keep charging their queue
+(internal/scheduler/scheduling/short_job_penalty.go; scheduling_algo.go:342-360;
+queue_scheduler.go:514-515 GetAllocationInclShortJobPenalty;
+scheduler.go:436-447 JobDb retention)."""
+
+import pytest
+
+from armada_tpu.core.config import (
+    PoolConfig,
+    SchedulingConfig,
+    parse_duration_s,
+    scheduling_config_from_dict,
+)
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+from armada_tpu.jobdb.job import Job, JobRun
+from armada_tpu.models import run_scheduling_round
+from armada_tpu.scheduler.short_job_penalty import ShortJobPenalty
+from tests.control_plane import ControlPlane
+from armada_tpu.server import JobSubmitItem, QueueRecord
+
+CFG = SchedulingConfig(shape_bucket=32)
+F = CFG.resource_list_factory()
+
+
+def spec(jid, queue="q", cpu="8"):
+    return JobSpec(
+        id=jid, queue=queue, resources=F.from_mapping({"cpu": cpu, "memory": "2"})
+    )
+
+
+# --- config parsing ----------------------------------------------------------
+
+
+def test_parse_duration():
+    assert parse_duration_s("5m") == 300.0
+    assert parse_duration_s("90s") == 90.0
+    assert parse_duration_s("1h30m") == 5400.0
+    assert parse_duration_s("250ms") == 0.25
+    assert parse_duration_s(45) == 45.0
+    assert parse_duration_s("") == 0.0
+    with pytest.raises(ValueError):
+        parse_duration_s("5parsecs")
+
+
+def test_pool_cutoff_from_yaml_dict():
+    cfg = scheduling_config_from_dict(
+        {"pools": [{"name": "default", "shortJobPenaltyCutoff": "2m"}]}
+    )
+    assert cfg.short_job_penalty_cutoffs() == {"default": 120.0}
+
+
+# --- the predicate (short_job_penalty.go ShouldApplyPenalty) ----------------
+
+
+def _finished_job(jid="j", pool="default", running_ns=1_000, preempted=False):
+    run = JobRun(
+        id="r-" + jid,
+        job_id=jid,
+        node_id="n0",
+        pool=pool,
+        running=True,
+        running_ns=running_ns,
+        succeeded=not preempted,
+        preempted=preempted,
+        run_attempted=True,
+    )
+    return Job(
+        spec=spec(jid), queued=False, succeeded=not preempted, runs=(run,)
+    )
+
+
+def test_applies_within_window_only():
+    p = ShortJobPenalty({"default": 60.0})
+    job = _finished_job(running_ns=int(1e9))
+    assert p.applies(job, int(30e9))  # 29s after start < 60s
+    assert not p.applies(job, int(62e9))  # window lapsed
+    # preempted runs never count (short_job_penalty.go:44)
+    assert not p.applies(_finished_job(preempted=True), int(30e9))
+    # non-terminal jobs never count
+    running = Job(spec=spec("r"), queued=False, runs=(JobRun(
+        id="rr", job_id="r", node_id="n0", running=True, running_ns=int(1e9)
+    ),))
+    assert not p.applies(running, int(30e9))
+    # other pools are uncapped
+    assert not p.applies(_finished_job(pool="other"), int(30e9))
+    # disabled when no cutoffs
+    assert not ShortJobPenalty({}).applies(job, int(30e9))
+
+
+# --- kernel: penalty shifts candidate ordering ------------------------------
+
+
+def test_penalty_deprioritises_churning_queue():
+    nodes = [
+        NodeSpec(
+            id="n0",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": "8", "memory": "32"}),
+        )
+    ]
+    queues = [Queue("qa"), Queue("qb")]
+    jobs = [spec("ja", "qa"), spec("jb", "qb")]  # only one fits
+
+    # Baseline tie breaks toward the first queue index (qa).
+    base = run_scheduling_round(
+        CFG, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs
+    )
+    assert "ja" in base.scheduled and "jb" not in base.scheduled
+
+    # qa recently churned a short job -> its ordering cost includes the
+    # penalty, so qb goes first.
+    pen = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=queues,
+        queued_jobs=jobs,
+        queue_penalty={"qa": F.from_mapping({"cpu": "8", "memory": "2"}).atoms},
+    )
+    assert "jb" in pen.scheduled and "ja" not in pen.scheduled
+    assert pen.queue_stats["qa"]["short_job_penalty"] > 0.0
+    assert pen.queue_stats["qb"]["short_job_penalty"] == 0.0
+
+
+# --- end to end: retention, charging, sweep ---------------------------------
+
+
+def test_short_job_charges_queue_then_expires(tmp_path):
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        enable_assertions=True,
+        pools=(PoolConfig("default", short_job_penalty_cutoff_s=60.0),),
+    )
+    cp = ControlPlane.build(
+        tmp_path,
+        config=cfg,
+        executor_specs={"ex1": (1, "8", "32")},
+        runtime_s=1.0,  # jobs exit almost immediately
+    )
+    cp.server.create_queue(QueueRecord("qa"))
+    cp.server.create_queue(QueueRecord("qb"))
+    ex = cp.executors[0]
+    (ja,) = cp.server.submit_jobs(
+        "qa", "js", [JobSubmitItem(resources={"cpu": "8", "memory": "2"})]
+    )
+    ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    ex.run_once()
+    # report RUNNING first (running_ns must materialize -- a job that never
+    # reported running has no RunningTime, exactly like the reference), then
+    # run to completion and report success
+    ex.cluster.tick(0.5)
+    ex.report_cycle()
+    cp.ingest()
+    cp.scheduler.cycle()
+    ex.cluster.tick(2.0)
+    ex.report_cycle()
+    ex.cleanup()
+    cp.ingest()
+    res = cp.scheduler.cycle()
+    assert res.events_by_kind().get("job_succeeded") == 1
+    cp.ingest()
+    cp.scheduler.cycle()
+
+    # terminal but retained: the penalty window keeps it in the JobDb
+    job = cp.jobdb.read_txn().get(ja)
+    assert job is not None and job.in_terminal_state()
+    assert cp.scheduler.short_job_penalty.applies(job, cp.scheduler.now_ns())
+
+    # while the window lasts, qa's cost carries the penalty: with one slot
+    # free and one job per queue, qb wins the tie it would otherwise lose
+    (ja2,) = cp.server.submit_jobs(
+        "qa", "js", [JobSubmitItem(resources={"cpu": "8", "memory": "2"})]
+    )
+    (jb,) = cp.server.submit_jobs(
+        "qb", "js", [JobSubmitItem(resources={"cpu": "8", "memory": "2"})]
+    )
+    cp.ingest()
+    res2 = cp.scheduler.cycle()
+    leased = {
+        ev.job_run_leased.job_id
+        for s in res2.published
+        for ev in s.events
+        if ev.WhichOneof("event") == "job_run_leased"
+    }
+    assert leased == {jb}
+
+    # after the window lapses the sweep drops the finished job
+    cp.clock.advance(120.0)
+    cp.scheduler.cycle()
+    assert cp.jobdb.read_txn().get(ja) is None
+    cp.close()
