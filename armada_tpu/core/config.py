@@ -35,6 +35,31 @@ class PriorityClass:
 
 
 @dataclasses.dataclass(frozen=True)
+class GangDefinition:
+    """A job shape whose market price is published each round
+    (configuration.go:312 GangDefinition; priced by the indicative pricer)."""
+
+    size: int = 1
+    priority_class: str = ""
+    resources: Mapping[str, "str | int"] = dataclasses.field(default_factory=dict)
+    node_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: tuple = ()
+    node_uniformity: str = ""
+
+    def __hash__(self):
+        return hash(
+            (
+                self.size,
+                self.priority_class,
+                tuple(sorted((k, str(v)) for k, v in self.resources.items())),
+                tuple(sorted(self.node_selector.items())),
+                self.tolerations,
+                self.node_uniformity,
+            )
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class PoolConfig:
     name: str
     # Pools this pool may schedule "away" jobs onto (scheduling_algo.go:216-283).
@@ -46,6 +71,12 @@ class PoolConfig:
     # queue's DRF cost until the window passes (short_job_penalty.go;
     # configuration.go:299 ShortJobPenaltyCutoff).  0 disables.
     short_job_penalty_cutoff_s: float = 0.0
+    # Scheduled-share fraction past which the crossing gang's bid sets the
+    # pool spot price (MarketSchedulingConfig.SpotPriceCutoff).
+    spot_price_cutoff: float = 0.9
+    # Shape name -> gang definition priced each round by the indicative
+    # pricer (MarketSchedulingConfig.GangsToPrice).
+    gangs_to_price: tuple[tuple[str, "GangDefinition"], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -246,6 +277,21 @@ def parse_duration_s(d) -> float:
     return total
 
 
+def _parse_tolerations(entries) -> tuple:
+    """k8s-style toleration dicts -> core Toleration tuple."""
+    from armada_tpu.core.types import Toleration
+
+    return tuple(
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in entries
+    )
+
+
 def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
     """Build a SchedulingConfig from a parsed YAML mapping using the reference's
     key names (config/scheduler/config.yaml `scheduling:` block)."""
@@ -262,6 +308,23 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
                 market_driven=bool(p.get("marketDriven", False)),
                 short_job_penalty_cutoff_s=parse_duration_s(
                     p.get("shortJobPenaltyCutoff", 0)
+                ),
+                spot_price_cutoff=float(p.get("spotPriceCutoff", 0.9)),
+                gangs_to_price=tuple(
+                    (
+                        name,
+                        GangDefinition(
+                            size=int(g.get("size", 1)),
+                            priority_class=g.get("priorityClassName", ""),
+                            resources=dict(g.get("resources", {})),
+                            node_selector=dict(g.get("nodeSelector", {})),
+                            tolerations=_parse_tolerations(
+                                g.get("tolerations", ())
+                            ),
+                            node_uniformity=g.get("nodeUniformity", ""),
+                        ),
+                    )
+                    for name, g in p.get("gangsToPrice", {}).items()
                 ),
             )
             for p in d["pools"]

@@ -77,6 +77,9 @@ class RoundResult(NamedTuple):
     iterations: jax.Array  # i32
     termination: jax.Array  # i32
     scheduled_count: jax.Array  # i32 newly scheduled members
+    # Market pools: bid of the gang whose placement crossed the spot cutoff
+    # (queue_scheduler.go:135-150); -1 = not set.
+    spot_price: jax.Array  # f32
 
 
 class _Carry(NamedTuple):
@@ -100,6 +103,11 @@ class _Carry(NamedTuple):
     iterations: jax.Array
     done: jax.Array
     termination: jax.Array
+    spot_price: jax.Array  # f32; -1 = unset
+    # Resources of EVERY placed gang incl. rescheduled evictees -- the
+    # reference's scheduledResource (queue_scheduler.go:127-137) accrues all
+    # gangs, unlike sched_res which feeds the new-jobs-only round caps.
+    spot_res: jax.Array  # f32[R]
 
 
 # How many queue-head entries each queue can skip (retired gangs, unfeasible
@@ -283,6 +291,20 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         new_sched = placed & ~is_evictee
         sched_count = c.sched_count + jnp.where(new_sched, card, 0)
         sched_res = c.sched_res + jnp.where(new_sched, req_tot, 0.0)
+        # Spot price (queue_scheduler.go:135-150): first gang whose placement
+        # pushes the round's scheduled share past the cutoff sets the price
+        # (the gang's MINIMUM member bid, :138-144).  The share counts every
+        # placed gang, rescheduled evictees included, like the reference's
+        # scheduledResource.
+        spot_res = c.spot_res + jnp.where(placed, req_tot, 0.0)
+        sched_share = jnp.max(
+            jnp.where(p.total_pool > 0, spot_res / jnp.maximum(p.total_pool, 1e-9), 0.0)
+            * p.drf_mult
+        )
+        crossed = (
+            p.market & placed & (c.spot_price < 0) & (sched_share > p.spot_cutoff)
+        )
+        spot_price = jnp.where(crossed, p.g_spot_price[g], c.spot_price)
         float_used = c.float_used + jnp.where(new_sched, req_float_tot, 0.0)
         q_sched = c.q_sched.at[qstar].add(jnp.where(new_sched, card, 0))
         run_rescheduled = c.run_rescheduled.at[run_safe].set(
@@ -345,6 +367,8 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
             iterations=c.iterations + 1,
             done=done,
             termination=termination,
+            spot_price=spot_price,
+            spot_res=spot_res,
         )
 
     return body
@@ -498,6 +522,8 @@ def schedule_round(
         iterations=jnp.int32(0),
         done=jnp.bool_(False),
         termination=jnp.int32(TERM_EXHAUSTED),
+        spot_price=jnp.float32(-1.0),
+        spot_res=jnp.zeros((R,), jnp.float32),
     )
 
     body = _make_place_iteration(p, num_levels, slot_width, check_keys=True)
@@ -550,4 +576,5 @@ def schedule_round(
         iterations=carry.iterations,
         termination=termination,
         scheduled_count=carry.sched_count,
+        spot_price=carry.spot_price,
     )

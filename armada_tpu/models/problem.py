@@ -71,6 +71,9 @@ class SchedulingProblem(NamedTuple):
     g_run: np.ndarray  # i32[G] backing run for evictee slots, else -1
     g_valid: np.ndarray  # bool[G]
     g_price: np.ndarray  # f32[G] bid price (market pools; 0 otherwise)
+    # Minimum member bid: the spot price a crossing gang publishes
+    # (queue_scheduler.go:138-144 takes the lowest member bid).
+    g_spot_price: np.ndarray  # f32[G]
     # queue-ordered gang index: gangs sorted by (queue, order); per-queue
     # contiguous slices.  The kernel's candidate scan is O(Q) gathers into this
     # instead of O(G) segment reductions (the analog of the reference keeping
@@ -103,6 +106,10 @@ class SchedulingProblem(NamedTuple):
     # Market-driven pools order candidates by bid price instead of DRF cost
     # (scheduling/market_iterator.go MarketCandidateGangIterator:245).
     market: np.ndarray  # bool scalar
+    # Spot-price threshold (queue_scheduler.go:135-150): once the round's
+    # newly-scheduled share crosses this, the crossing gang's bid becomes the
+    # pool spot price.  _INF disables (non-market pools).
+    spot_cutoff: np.ndarray  # f32 scalar
     # Retry anti-affinity (scheduler.go:522-568): nodes a gang must avoid --
     # nodes where a previous attempt died.  Precomputed outside the round loop
     # as a row table so the kernel does one invariant-table gather per
@@ -146,6 +153,9 @@ class RoundOutcome:
     # demand_share} (feeds cycle metrics + reports; the reference's
     # QueueSchedulingContext numbers, cycle_metrics.go:71-170).
     queue_stats: dict = dataclasses.field(default_factory=dict)
+    # Market pools: bid price of the gang that crossed the spot cutoff this
+    # round (queue_scheduler.go:135-150); None when unset/not market.
+    spot_price: Optional[float] = None
 
 
 def _pad(n: int, bucket: int) -> int:
@@ -277,7 +287,7 @@ def build_problem(
     class _Gang:
         __slots__ = (
             "jobs", "queue", "key", "level", "pc", "req", "card", "order",
-            "run", "price",
+            "run", "price", "spot_price",
         )
 
     gangs: list[_Gang] = []
@@ -351,6 +361,7 @@ def build_problem(
             g.order = order
             g.run = ri
             g.price = float(price_of(run_list[ri].job))
+            g.spot_price = g.price
             run_gang[ri] = len(gangs) - 1
             gang_members_out.append([])
 
@@ -414,6 +425,7 @@ def build_problem(
             g.order = base + order
             g.run = -1
             g.price = float(price_of(lead))
+            g.spot_price = min(float(price_of(m)) for m in members)
             gang_members_out.append(g.jobs)
 
     G = _pad(len(gangs), bucket)
@@ -427,6 +439,7 @@ def build_problem(
     g_run = np.full((G,), -1, np.int32)
     g_valid = np.zeros((G,), bool)
     g_price = np.zeros((G,), np.float32)
+    g_spot_price = np.zeros((G,), np.float32)
     for i, g in enumerate(gangs):
         g_req[i] = g.req
         g_card[i] = g.card
@@ -438,6 +451,7 @@ def build_problem(
         g_run[i] = g.run
         g_valid[i] = True
         g_price[i] = g.price
+        g_spot_price[i] = g.spot_price
 
     # --- pinned node for evictee slots is derived in-kernel from run_node -------
 
@@ -585,6 +599,7 @@ def build_problem(
         g_run=g_run,
         g_valid=g_valid,
         g_price=g_price,
+        g_spot_price=g_spot_price,
         gq_gang=gq_gang,
         q_start=q_start,
         q_len=q_len,
@@ -608,6 +623,11 @@ def build_problem(
         node_axes=node_axes,
         float_total=float_total,
         market=np.bool_(market),
+        spot_cutoff=np.float32(
+            pool_cfg.spot_price_cutoff
+            if market and pool_cfg is not None and pool_cfg.spot_price_cutoff > 0
+            else _INF
+        ),
         ban_mask=ban_mask,
         g_ban_row=g_ban_row,
     )
@@ -700,10 +720,12 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
         if g_state[gi] == 2 and ctx.gang_members[gi]:
             failed.extend(ctx.gang_members[gi])
 
+    spot = float(result.spot_price)
     return RoundOutcome(
         scheduled=scheduled,
         preempted=preempted,
         failed=failed,
         num_iterations=int(result.iterations),
         termination=_TERMINATIONS[int(result.termination)],
+        spot_price=spot if spot >= 0 else None,
     )

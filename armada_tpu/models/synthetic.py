@@ -161,6 +161,7 @@ def synthetic_problem(
         g_run=g_run,
         g_valid=g_valid,
         g_price=np.zeros((G,), np.float32),
+        g_spot_price=np.zeros((G,), np.float32),
         gq_gang=gq_gang,
         q_start=q_start,
         q_len=q_len,
@@ -179,6 +180,7 @@ def synthetic_problem(
         node_axes=np.ones((R,), np.float32),
         float_total=np.zeros((R,), np.float32),
         market=np.bool_(False),
+        spot_cutoff=np.float32(_INF),
         ban_mask=np.zeros((1, N), bool),
         g_ban_row=np.zeros((G,), np.int32),
     )

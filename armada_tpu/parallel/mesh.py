@@ -95,6 +95,7 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         g_run=jobsax,
         g_valid=jobsax,
         g_price=jobsax,
+        g_spot_price=jobsax,
         # gq_gang is read-only index data gathered with [Q,W] indices every
         # iteration; replicated so the gather never crosses devices.
         gq_gang=repl,
@@ -115,6 +116,7 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         node_axes=repl,
         float_total=repl,
         market=repl,
+        spot_cutoff=repl,
         # ban rows follow the node axis; the row-index vector follows gangs
         ban_mask=s(None, AXIS_NODES),
         g_ban_row=jobsax,

@@ -38,6 +38,11 @@ class PoolStats:
     num_nodes: int
     num_queued: int
     num_running: int
+    # Market pools only (cycle_metrics.go:534,455): configured-shape prices
+    # and the per-queue idealised ("boundary-less cluster") values.
+    market: bool = False
+    indicative_prices: dict = dataclasses.field(default_factory=dict)
+    idealised_values: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -100,6 +105,11 @@ class FairSchedulingAlgo:
         self.short_job_penalty = ShortJobPenalty(
             config.short_job_penalty_cutoffs()
         )
+        self.gang_pricer = None
+        if any(p.market_driven and p.gangs_to_price for p in config.pools):
+            from armada_tpu.scheduler.pricer import IndicativeGangPricer
+
+            self.gang_pricer = IndicativeGangPricer(config)
         self.optimiser = None
         if config.optimiser_enabled:
             from armada_tpu.scheduler.optimiser import Optimiser, OptimiserConfig
@@ -284,15 +294,23 @@ class FairSchedulingAlgo:
             self._apply_outcome(
                 txn, outcome, pool, executor_of_node, now_ns, result
             )
-            result.pools.append(
-                PoolStats(
-                    pool=pool,
-                    outcome=outcome,
-                    num_nodes=len(pool_nodes),
-                    num_queued=len(queued_jobs),
-                    num_running=len(running),
-                )
+            stats = PoolStats(
+                pool=pool,
+                outcome=outcome,
+                num_nodes=len(pool_nodes),
+                num_queued=len(queued_jobs),
+                num_running=len(running),
             )
+            pool_cfg = next(
+                (p for p in self.config.pools if p.name == pool), None
+            )
+            if pool_cfg is not None and pool_cfg.market_driven:
+                stats.market = True
+                self._market_observability(
+                    stats, pool, pool_nodes, pool_queues(pool), queued_jobs,
+                    running, outcome, bid_price_of,
+                )
+            result.pools.append(stats)
             # Jobs scheduled in this pool are no longer queued for later pools.
             scheduled_ids = set(outcome.scheduled)
             if scheduled_ids:
@@ -386,6 +404,45 @@ class FairSchedulingAlgo:
             )
 
         return result
+
+    def _market_observability(
+        self,
+        stats: PoolStats,
+        pool: str,
+        pool_nodes: list,
+        queues: list,
+        queued_jobs: list,
+        running: list,
+        outcome: RoundOutcome,
+        bid_price_of,
+    ) -> None:
+        """Market-pool extras: indicative gang prices against the post-round
+        state (pqs.go runPricer:596) and idealised per-queue values
+        (scheduling_algo.go:595 CalculateIdealisedValue)."""
+        if bid_price_of is None:
+            return
+        if self.gang_pricer is not None:
+            preempted_now = set(outcome.preempted)
+            by_id = {j.id: j for j in queued_jobs}
+            running_now = [r for r in running if r.job.id not in preempted_now]
+            for jid, nid in outcome.scheduled.items():
+                job = by_id.get(jid)
+                if job is not None:
+                    running_now.append(RunningJob(job=job, node_id=nid))
+            stats.indicative_prices = self.gang_pricer.price_pool_gangs(
+                pool, pool_nodes, running_now, bid_price_of
+            )
+        from armada_tpu.scheduler.idealised import calculate_idealised_values
+
+        stats.idealised_values = calculate_idealised_values(
+            self.config,
+            pool=pool,
+            nodes=pool_nodes,
+            queues=queues,
+            queued_jobs=queued_jobs,
+            running=running,
+            bid_price_of=bid_price_of,
+        )
 
     def _optimise_stuck(
         self,

@@ -53,6 +53,27 @@ class SchedulerMetrics:
             "Resource share charged for jobs that exited soon after starting",
             ["pool", "queue"],
         )
+        # Market-pool gauges (cycle_metrics.go:231,279,295).
+        self.spot_price = g(
+            "armada_scheduler_spot_price",
+            "Spot price of each market-driven pool",
+            ["pool"],
+        )
+        self.indicative_price = g(
+            "armada_scheduler_indicative_price",
+            "Indicative price for configured job shapes in pool",
+            ["pool", "name"],
+        )
+        self.indicative_price_schedulable = g(
+            "armada_scheduler_indicative_price_schedulable",
+            "Whether the configured job shape could schedule",
+            ["pool", "name", "reason"],
+        )
+        self.idealised_scheduled_value = g(
+            "armada_scheduler_idealised_scheduled_value",
+            "Value each queue would realise on a boundary-less cluster",
+            ["pool", "queue"],
+        )
         self.fairness_error = g(
             "armada_scheduler_fairness_error",
             "Cumulative delta between adjusted fair share and actual share",
@@ -136,3 +157,18 @@ class SchedulerMetrics:
                 )
                 error += abs(qs["adjusted_fair_share"] - qs["actual_share"])
             self.fairness_error.labels(stats.pool).set(error)
+            if stats.market:
+                # Set every cycle -- 0 when no crossing happened -- so a stale
+                # previous-round price never lingers (context/scheduling.go
+                # GetSpotPrice returns 0 when unset).
+                self.spot_price.labels(stats.pool).set(
+                    stats.outcome.spot_price or 0.0
+                )
+            for name, pr in stats.indicative_prices.items():
+                if pr.evaluated:
+                    self.indicative_price.labels(stats.pool, name).set(pr.price)
+                    self.indicative_price_schedulable.labels(
+                        stats.pool, name, pr.unschedulable_reason
+                    ).set(1.0 if pr.schedulable else 0.0)
+            for qname, value in stats.idealised_values.items():
+                self.idealised_scheduled_value.labels(stats.pool, qname).set(value)

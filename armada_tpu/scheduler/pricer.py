@@ -1,0 +1,226 @@
+"""Indicative gang pricing for market-driven pools.
+
+Equivalent of the reference's pricer stack (internal/scheduler/scheduling/
+pricer/gang_pricer.go:29-110, pricer/node_scheduler.go MinPriceNodeScheduler,
+market_driven_indicative_pricer.go): given a configured gang *shape*
+(GangDefinition), determine the minimum bid price at which the gang could be
+scheduled against the current pool state.  Per member, each statically-fitting
+node is scored by the price needed to free room -- 0 if it fits in spare
+capacity, else the highest bid among the cheapest set of running jobs whose
+preemption frees enough (cheapest-first eviction, node_scheduler.go:66-86) --
+and the cheapest node wins; the gang's price is the max over members
+(gang_pricer.go:146).  Node-uniformity labels partition the search into node
+groups, lowest-cost group wins (gang_pricer.go:70-105).
+
+This is a host-side pure read of the round state (invoking it has no side
+effects, market_driven_indicative_pricer.go:51); results surface as the
+armada_scheduler_indicative_price{pool,name} metrics (cycle_metrics.go:534).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from armada_tpu.core.config import GangDefinition, SchedulingConfig
+from armada_tpu.core.types import (
+    JobSpec,
+    NodeSpec,
+    RunningJob,
+    selector_matches,
+    taints_tolerated,
+)
+
+# Canonical unschedulable reasons (market_driven_indicative_pricer.go:21-25,
+# gang_pricer.go:17-20).
+GANG_EXCEEDS_ALLOCATABLE = (
+    "The requested gang resources exceed the available capacity for scheduling"
+)
+GANG_CARDINALITY_ZERO = "The gang has cardinality zero"
+UNIFORMITY_NOT_INDEXED = "uniformity label is not indexed"
+NO_NODES_WITH_UNIFORMITY = "no nodes with uniformity label"
+DOES_NOT_FIT = "job/gang does not fit on any node"
+
+
+@dataclasses.dataclass(frozen=True)
+class GangPricingResult:
+    """pricer.GangPricingResult: can the shape schedule, and at what price."""
+
+    evaluated: bool
+    schedulable: bool
+    price: float = 0.0
+    unschedulable_reason: str = ""
+
+
+class IndicativeGangPricer:
+    """Prices configured gang shapes against a pool's live placement state."""
+
+    def __init__(self, config: SchedulingConfig):
+        self.config = config
+        self._factory = config.resource_list_factory()
+        floating = set(config.floating_resource_names())
+        self._node_axes = np.array(
+            [0.0 if n in floating else 1.0 for n in self._factory.names]
+        )
+
+    def price_pool_gangs(
+        self,
+        pool: str,
+        nodes: Sequence[NodeSpec],
+        running: Sequence[RunningJob],
+        price_of: Callable[[JobSpec], float],
+    ) -> dict:
+        """{shape name: GangPricingResult} for the pool's configured shapes
+        (MarketDrivenIndicativePricer.Price)."""
+        pool_cfg = next((p for p in self.config.pools if p.name == pool), None)
+        if pool_cfg is None or not pool_cfg.gangs_to_price:
+            return {}
+        prepared = self._prepare(pool, nodes, running, price_of)
+        out = {}
+        for name, definition in pool_cfg.gangs_to_price:
+            out[name] = self._price_prepared(definition, prepared)
+        return out
+
+    def _prepare(
+        self,
+        pool: str,
+        nodes: Sequence[NodeSpec],
+        running: Sequence[RunningJob],
+        price_of: Callable[[JobSpec], float],
+    ) -> dict:
+        """Shape-independent pool state, computed once per cycle: filtered
+        nodes, totals, and per-node residents as (resource vector, bid) pairs
+        sorted cheapest-first."""
+        pool_nodes = [n for n in nodes if n.pool == pool and not n.unschedulable]
+        total = np.zeros((self._factory.num_resources,), np.float64)
+        residents: dict[str, list] = {}
+        for r in running:
+            vec = (
+                np.asarray(r.job.resources.atoms, np.float64) * self._node_axes
+                if r.job.resources is not None
+                else np.zeros_like(total)
+            )
+            residents.setdefault(r.node_id, []).append(
+                (vec, float(price_of(r.job)), r.job.id)
+            )
+        for rs in residents.values():
+            rs.sort(key=lambda t: (t[1], t[2]))
+        for n in pool_nodes:
+            if n.total_resources is not None:
+                total += np.asarray(n.total_resources.atoms, np.float64)
+        return {"pool_nodes": pool_nodes, "total": total, "residents": residents}
+
+    def price_gang(
+        self,
+        definition: GangDefinition,
+        pool: str,
+        nodes: Sequence[NodeSpec],
+        running: Sequence[RunningJob],
+        price_of: Callable[[JobSpec], float],
+    ) -> GangPricingResult:
+        return self._price_prepared(
+            definition, self._prepare(pool, nodes, running, price_of)
+        )
+
+    def _price_prepared(
+        self, definition: GangDefinition, prepared: dict
+    ) -> GangPricingResult:
+        if definition.size <= 0:
+            return GangPricingResult(True, False, 0.0, GANG_CARDINALITY_ZERO)
+
+        req = np.asarray(
+            self._factory.from_mapping(definition.resources).atoms, np.float64
+        )
+        req_node = req * self._node_axes
+        pool_nodes = prepared["pool_nodes"]
+        total = prepared["total"]
+        if np.any(req_node * definition.size > total * self._node_axes):
+            return GangPricingResult(True, False, 0.0, GANG_EXCEEDS_ALLOCATABLE)
+
+        # --- uniformity grouping (gang_pricer.go:70-105,196-225) -------------
+        label = definition.node_uniformity
+        if label:
+            indexed = set(self.config.indexed_node_labels)
+            if label not in indexed:
+                return GangPricingResult(True, False, 0.0, UNIFORMITY_NOT_INDEXED)
+            groups: dict[str, list] = {}
+            for n in pool_nodes:
+                v = n.labels.get(label)
+                if v is not None:
+                    groups.setdefault(v, []).append(n)
+            if not groups:
+                return GangPricingResult(True, False, 0.0, NO_NODES_WITH_UNIFORMITY)
+            node_groups = list(groups.values())
+        else:
+            node_groups = [pool_nodes]
+
+        best: Optional[float] = None
+        for group in node_groups:
+            price = self._price_on_group(
+                definition, req_node, group, prepared["residents"]
+            )
+            if price is not None and (best is None or price < best):
+                best = price
+        if best is None:
+            return GangPricingResult(True, False, 0.0, DOES_NOT_FIT)
+        return GangPricingResult(True, True, best, "")
+
+    def _price_on_group(
+        self,
+        definition: GangDefinition,
+        req_node: np.ndarray,
+        group: Sequence[NodeSpec],
+        residents_by_node: Mapping[str, list],
+    ) -> Optional[float]:
+        """Min price to place all members on this node group, else None
+        (gang_pricer.go scheduleOnNodes:113-160).  `residents_by_node` holds
+        precomputed (resource vector, bid, job id) tuples, cheapest-first."""
+        selector = dict(definition.node_selector)
+        tolerations = tuple(definition.tolerations)
+        # Simulation state: per node, residual free vector + surviving residents.
+        state: dict[str, dict] = {}
+        for n in group:
+            if n.total_resources is None:
+                continue
+            if not taints_tolerated(n.taints, tolerations):
+                continue
+            if not selector_matches(selector, n.labels):
+                continue
+            residents = list(residents_by_node.get(n.id, ()))
+            free = np.asarray(n.total_resources.atoms, np.float64) * self._node_axes
+            for vec, _, _ in residents:
+                free -= vec
+            state[n.id] = {"free": free, "residents": residents}
+        if not state:
+            return None
+
+        gang_price = 0.0
+        for _ in range(definition.size):
+            # (price, evict count) per candidate node; free fit short-circuits.
+            best_node, best_price, best_evict = None, None, 0
+            for nid, st in state.items():
+                if np.all(req_node <= st["free"]):
+                    best_node, best_price, best_evict = nid, 0.0, 0
+                    break  # ideal result, exit early (gang_pricer.go:133)
+                freed = st["free"].copy()
+                price, count, fits = 0.0, 0, False
+                for vec, bid, _ in st["residents"]:
+                    freed += vec
+                    price = bid
+                    count += 1
+                    if np.all(req_node <= freed):
+                        fits = True
+                        break
+                if fits and (best_price is None or price < best_price):
+                    best_node, best_price, best_evict = nid, price, count
+            if best_node is None:
+                return None
+            st = state[best_node]
+            for vec, _, _ in st["residents"][:best_evict]:
+                st["free"] += vec
+            st["residents"] = st["residents"][best_evict:]
+            st["free"] = st["free"] - req_node
+            gang_price = max(gang_price, best_price)
+        return gang_price

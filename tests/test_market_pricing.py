@@ -1,0 +1,292 @@
+"""Market observability: spot price, indicative gang prices, idealised value.
+
+Modeled on the reference's pricer tests (internal/scheduler/scheduling/pricer/
+gang_pricer_test.go, node_scheduler_test.go, market_driven_indicative_pricer
+_test.go, idealised_value_test.go; spot price queue_scheduler.go:135-150)."""
+
+import pytest
+
+from armada_tpu.core.config import GangDefinition, PoolConfig, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.models import run_scheduling_round
+from armada_tpu.scheduler.idealised import calculate_idealised_values
+from armada_tpu.scheduler.pricer import (
+    GANG_EXCEEDS_ALLOCATABLE,
+    IndicativeGangPricer,
+)
+
+MARKET_CFG = SchedulingConfig(
+    shape_bucket=32,
+    pools=(PoolConfig("default", market_driven=True, spot_price_cutoff=0.5),),
+)
+F = MARKET_CFG.resource_list_factory()
+
+
+def node(nid, cpu="8"):
+    return NodeSpec(
+        id=nid,
+        pool="default",
+        total_resources=F.from_mapping({"cpu": cpu, "memory": "32"}),
+    )
+
+
+def job(jid, cpu="4", queue="q", pc="armada-preemptible"):
+    return JobSpec(
+        id=jid,
+        queue=queue,
+        priority_class=pc,
+        resources=F.from_mapping({"cpu": cpu, "memory": "2"}),
+    )
+
+
+def shape(cpu="4", size=1, uniformity=""):
+    return GangDefinition(
+        size=size,
+        priority_class="armada-preemptible",
+        resources={"cpu": cpu, "memory": "2"},
+        node_uniformity=uniformity,
+    )
+
+
+# --- spot price (queue_scheduler.go:135-150) --------------------------------
+
+
+def test_spot_price_set_by_cutoff_crossing_gang():
+    prices = {"a": 10.0, "b": 7.0, "c": 1.0}
+    out = run_scheduling_round(
+        MARKET_CFG,
+        pool="default",
+        nodes=[node("n0", cpu="12")],
+        queues=[Queue("q")],
+        queued_jobs=[job("a"), job("b"), job("c")],
+        bid_price_of=lambda j: prices[j.id],
+    )
+    # cutoff 0.5 of 12 cpu = 6: "a" (4) stays under, "b" crosses at 8 -> 7.0
+    assert set(out.scheduled) == {"a", "b", "c"}
+    assert out.spot_price == 7.0
+
+
+def test_no_spot_price_below_cutoff_or_non_market():
+    prices = {"a": 10.0}
+    out = run_scheduling_round(
+        MARKET_CFG,
+        pool="default",
+        nodes=[node("n0", cpu="16")],
+        queues=[Queue("q")],
+        queued_jobs=[job("a")],  # 4/16 = 0.25 < 0.5
+        bid_price_of=lambda j: prices[j.id],
+    )
+    assert out.spot_price is None
+    plain = run_scheduling_round(
+        SchedulingConfig(shape_bucket=32),
+        pool="default",
+        nodes=[node("n0", cpu="4")],
+        queues=[Queue("q")],
+        queued_jobs=[job("a")],
+    )
+    assert plain.spot_price is None
+
+
+# --- indicative gang prices (gang_pricer.go / node_scheduler.go) ------------
+
+
+def run_of(jid, nid, cpu="4", queue="hog"):
+    return RunningJob(job=job(jid, cpu=cpu, queue=queue), node_id=nid)
+
+
+def test_free_capacity_prices_at_zero():
+    pricer = IndicativeGangPricer(MARKET_CFG)
+    res = pricer.price_gang(
+        shape(), "default", [node("n0")], [], lambda j: 99.0
+    )
+    assert res.schedulable and res.price == 0.0
+
+
+def test_price_is_cheapest_eviction_set():
+    # n0 full with bids 5 and 2; freeing 4cpu needs only the 2-bid job.
+    pricer = IndicativeGangPricer(MARKET_CFG)
+    prices = {"r1": 5.0, "r2": 2.0}
+    res = pricer.price_gang(
+        shape(),
+        "default",
+        [node("n0")],
+        [run_of("r1", "n0"), run_of("r2", "n0")],
+        lambda j: prices[j.id],
+    )
+    assert res.schedulable and res.price == 2.0
+    # needing the whole node (8cpu) evicts both -> price is the max bid, 5.
+    res8 = pricer.price_gang(
+        shape(cpu="8"),
+        "default",
+        [node("n0")],
+        [run_of("r1", "n0"), run_of("r2", "n0")],
+        lambda j: prices[j.id],
+    )
+    assert res8.schedulable and res8.price == 5.0
+
+
+def test_gang_price_is_max_member_price_across_nodes():
+    # Two members: one fits free on n1, the other must evict the 3-bid job.
+    pricer = IndicativeGangPricer(MARKET_CFG)
+    res = pricer.price_gang(
+        shape(cpu="8", size=2),
+        "default",
+        [node("n0"), node("n1")],
+        [run_of("r1", "n0", cpu="8")],
+        lambda j: 3.0,
+    )
+    assert res.schedulable and res.price == 3.0
+
+
+def test_oversized_gang_reports_reason():
+    pricer = IndicativeGangPricer(MARKET_CFG)
+    res = pricer.price_gang(
+        shape(cpu="8", size=3), "default", [node("n0"), node("n1")], [], lambda j: 0.0
+    )
+    assert not res.schedulable
+    assert res.unschedulable_reason == GANG_EXCEEDS_ALLOCATABLE
+
+
+def test_uniformity_groups_price_within_one_domain():
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        indexed_node_labels=("rack",),
+        pools=(PoolConfig("default", market_driven=True),),
+    )
+    f = cfg.resource_list_factory()
+
+    def rnode(nid, rack):
+        return NodeSpec(
+            id=nid,
+            pool="default",
+            labels={"rack": rack},
+            total_resources=f.from_mapping({"cpu": "8", "memory": "32"}),
+        )
+
+    pricer = IndicativeGangPricer(cfg)
+    # 2x8cpu gang, racks of 1 node each: no single rack fits both members.
+    res = pricer.price_gang(
+        shape(cpu="8", size=2, uniformity="rack"),
+        "default",
+        [rnode("n0", "a"), rnode("n1", "b")],
+        [],
+        lambda j: 0.0,
+    )
+    assert not res.schedulable
+    # two nodes in rack a -> fits, price 0
+    res2 = pricer.price_gang(
+        shape(cpu="8", size=2, uniformity="rack"),
+        "default",
+        [rnode("n0", "a"), rnode("n1", "b"), rnode("n2", "a")],
+        [],
+        lambda j: 0.0,
+    )
+    assert res2.schedulable and res2.price == 0.0
+
+
+def test_pool_gangs_from_config():
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        pools=(
+            PoolConfig(
+                "default",
+                market_driven=True,
+                gangs_to_price=(("small", shape()), ("huge", shape(cpu="99", size=4))),
+            ),
+        ),
+    )
+    pricer = IndicativeGangPricer(cfg)
+    out = pricer.price_pool_gangs("default", [node("n0")], [], lambda j: 1.0)
+    assert out["small"].schedulable and not out["huge"].schedulable
+
+
+# --- idealised value (idealised_value.go) -----------------------------------
+
+
+def test_idealised_value_ignores_node_boundaries():
+    # Two 4cpu nodes cannot host one 8cpu job, but the mega node can: the
+    # idealised value credits the queue for it.
+    prices = {"big": 6.0}
+    values = calculate_idealised_values(
+        MARKET_CFG,
+        pool="default",
+        nodes=[node("n0", cpu="4"), node("n1", cpu="4")],
+        queues=[Queue("q")],
+        queued_jobs=[job("big", cpu="8")],
+        running=[],
+        bid_price_of=lambda j: prices[j.id],
+    )
+    # 8 cpu / 1 cpu unit = 8 units x price 6 = 48
+    assert values == {"q": 48.0}
+
+
+def test_idealised_value_strips_selectors_and_includes_running():
+    prices = {"sel": 2.0, "run": 3.0}
+    values = calculate_idealised_values(
+        MARKET_CFG,
+        pool="default",
+        nodes=[node("n0", cpu="8")],
+        queues=[Queue("q")],
+        queued_jobs=[
+            JobSpec(
+                id="sel",
+                queue="q",
+                priority_class="armada-preemptible",
+                node_selector={"zone": "nowhere"},
+                resources=F.from_mapping({"cpu": "4", "memory": "2"}),
+            )
+        ],
+        running=[run_of("run", "n0", queue="q")],
+        bid_price_of=lambda j: prices[j.id],
+    )
+    assert values == {"q": 2.0 * 4 + 3.0 * 4}
+
+
+# --- algo wiring: PoolStats carries the market observability ----------------
+
+
+def test_algo_populates_market_stats():
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.scheduler.algo import FairSchedulingAlgo
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+    from armada_tpu.scheduler.providers import StaticBidPriceProvider
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        pools=(
+            PoolConfig(
+                "default",
+                market_driven=True,
+                spot_price_cutoff=0.25,
+                gangs_to_price=(("probe", shape(cpu="4")),),
+            ),
+        ),
+    )
+    jobdb = JobDb(cfg)
+    with jobdb.write_txn() as txn:
+        txn.upsert(
+            Job(spec=job("j1", cpu="8"), validated=True, pools=("default",))
+        )
+        algo = FairSchedulingAlgo(
+            cfg,
+            queues=lambda: [Queue("q")],
+            clock_ns=lambda: 10**15,
+            bid_prices=StaticBidPriceProvider({}, default=5.0),
+        )
+        snap = ExecutorSnapshot(
+            id="ex1",
+            pool="default",
+            nodes=(node("n0", cpu="8"),),
+            last_update_ns=10**15,
+        )
+        result = algo.schedule(txn, [snap], now_ns=10**15)
+    (stats,) = result.pools
+    assert stats.outcome.scheduled == {"j1": "n0"}
+    # 8/8 share crosses the 0.25 cutoff -> spot = the job's bid
+    assert stats.outcome.spot_price == 5.0
+    # the probe shape needs the 5-bid job evicted
+    assert stats.indicative_prices["probe"].schedulable
+    assert stats.indicative_prices["probe"].price == 5.0
+    # idealised: 8 cpu units x bid 5
+    assert stats.idealised_values == {"q": 40.0}
