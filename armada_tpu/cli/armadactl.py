@@ -425,8 +425,12 @@ def cmd_serve(args):
         schedule_interval_s=args.schedule_interval,
         leader_id=args.leader_id,
         metrics_port=args.metrics_port,
+        health_port=args.health_port,
+        profiling=args.profiling,
     )
     print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
+    if plane.health_server is not None:
+        print(f"health on 127.0.0.1:{plane.health_server.port}/health")
     print(f"state in {args.data_dir}")
     try:
         plane.wait()
@@ -556,6 +560,16 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--schedule-interval", type=float, default=5.0)
     srv.add_argument("--leader-id", help="enable file-lease leader election")
     srv.add_argument("--metrics-port", type=int, help="expose prometheus metrics")
+    srv.add_argument(
+        "--health-port",
+        type=int,
+        help="serve /health liveness checks (0 = pick a free port)",
+    )
+    srv.add_argument(
+        "--profiling",
+        action="store_true",
+        help="expose /debug/pprof/* on the health port",
+    )
     srv.set_defaults(fn=cmd_serve)
 
     rep = sub.add_parser("scheduling-report", help="why (not) scheduled forensics")

@@ -57,6 +57,7 @@ class ControlPlaneProcess:
     _eventdb: EventDb
     _lookoutdb: LookoutDb
     _metrics_server: object = None
+    health_server: object = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -64,6 +65,8 @@ class ControlPlaneProcess:
         for p in self._pipelines:
             p.stop()
         self._grpc_server.stop(1).wait()
+        if self.health_server is not None:
+            self.health_server.stop()
         if self._metrics_server is not None:
             # prometheus_client >= 0.17 returns (server, thread)
             try:
@@ -90,7 +93,12 @@ def start_control_plane(
     leader_id: Optional[str] = None,
     num_partitions: int = 4,
     metrics_port: Optional[int] = None,
+    health_port: Optional[int] = None,
+    profiling: bool = False,
 ) -> ControlPlaneProcess:
+    """health_port: serve /health liveness (+ /debug/pprof/* when
+    `profiling`) on this port, 0 = pick a free one (common/health,
+    common/profiling/http.go)."""
     os.makedirs(data_dir, exist_ok=True)
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
@@ -196,6 +204,42 @@ def start_control_plane(
     )
     scheduler_thread.start()
 
+    if profiling and health_port is None:
+        # --profiling alone must not be a silent no-op: the profiling
+        # endpoints live on the health server.
+        health_port = 0
+    health_server = None
+    if health_port is not None:
+        from armada_tpu.core.health import (
+            FunctionChecker,
+            HealthServer,
+            StartupCompleteChecker,
+        )
+
+        health_server = HealthServer(health_port, profiling=profiling)
+        startup = StartupCompleteChecker()
+        health_server.checker.add(startup)
+        health_server.checker.add(
+            FunctionChecker(
+                lambda: None if scheduler_thread.is_alive() else "scheduler loop dead",
+                "scheduler",
+            )
+        )
+        for p, pname in (
+            (scheduler_pipeline, "scheduler-ingester"),
+            (event_pipeline, "event-ingester"),
+            (lookout_pipeline, "lookout-ingester"),
+        ):
+            health_server.checker.add(
+                FunctionChecker(
+                    lambda p=p, pname=pname: (
+                        None if p.alive() else f"{pname} pipeline dead"
+                    ),
+                    pname,
+                )
+            )
+        startup.mark_complete()
+
     return ControlPlaneProcess(
         port=bound_port,
         scheduler=scheduler,
@@ -210,6 +254,7 @@ def start_control_plane(
         _eventdb=eventdb,
         _lookoutdb=lookoutdb,
         _metrics_server=metrics_server,
+        health_server=health_server,
     )
 
 

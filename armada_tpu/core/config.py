@@ -149,6 +149,12 @@ class SchedulingConfig:
     enable_assertions: bool = False
     # Pool-level resources never bound to nodes (floatingresources/).
     floating_resources: tuple[FloatingResource, ...] = ()
+    # Node quarantine (README.md:28 "removing nodes exhibiting high failure
+    # rates"): this many attempted-run deaths on one node within the window
+    # excludes it from scheduling for the cooldown.  0 disables.
+    node_quarantine_failure_threshold: int = 0
+    node_quarantine_window_s: float = 600.0
+    node_quarantine_cooldown_s: float = 1200.0
     # Optimiser: targeted preemption for stuck jobs (optimiser/node_scheduler.go).
     optimiser_enabled: bool = False
     optimiser_max_stuck_jobs: int = 10
@@ -333,6 +339,8 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         kw["priority_classes"] = _parse_priority_classes(d["priorityClasses"])
     for yaml_key, attr in [
         ("defaultPriorityClassName", "default_priority_class"),
+        ("nodeQuarantineWindow", "node_quarantine_window_s"),
+        ("nodeQuarantineCooldown", "node_quarantine_cooldown_s"),
         ("protectedFractionOfFairShare", "protected_fraction_of_fair_share"),
         ("maxQueueLookback", "max_queue_lookback"),
         ("maximumSchedulingBurst", "maximum_scheduling_burst"),
@@ -342,12 +350,16 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("maxRetries", "max_retries"),
         ("nodeIdLabel", "node_id_label"),
         ("enableAssertions", "enable_assertions"),
+        ("nodeQuarantineFailureThreshold", "node_quarantine_failure_threshold"),
         ("optimiserEnabled", "optimiser_enabled"),
         ("optimiserMaxStuckJobs", "optimiser_max_stuck_jobs"),
         ("optimiserMaximumJobSizeToPreempt", "optimiser_maximum_job_size_to_preempt"),
     ]:
         if yaml_key in d:
             kw[attr] = d[yaml_key]
+    for attr in ("node_quarantine_window_s", "node_quarantine_cooldown_s"):
+        if attr in kw:
+            kw[attr] = parse_duration_s(kw[attr])
     if "dominantResourceFairnessResourcesToConsider" in d:
         kw["dominant_resource_fairness_resources"] = tuple(
             d["dominantResourceFairnessResourcesToConsider"]

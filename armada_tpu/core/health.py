@@ -1,0 +1,199 @@
+"""Health checkers + the debug/profiling HTTP endpoint.
+
+Equivalent of the reference's common runtime surface:
+- internal/common/health: `Checker` (checker.go), `MultiChecker`
+  (multi_checker.go), `StartupCompleteChecker` (startup_complete_checker.go),
+  and the HTTP handler semantics (http_handler.go: 204 when healthy, 503 +
+  error text when not; mounted at /health, http_mux_setup.go).
+- internal/common/profiling/http.go: an on-demand profiling server.  Go gets
+  net/http/pprof for free; the Python-native analogues here are
+  /debug/pprof/profile?seconds=N (process-wide statistical sampler over
+  sys._current_frames -- every thread, not just the handler's), /debug/pprof/
+  heap (tracemalloc snapshot, started on first use) and /debug/pprof/threads
+  (stack dump of every live thread).
+
+One ThreadingHTTPServer serves both surfaces; components register checkers.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def sample_profile(seconds: float, interval_s: float = 0.01) -> str:
+    """Statistical profile of EVERY thread in the process: sample
+    sys._current_frames at `interval_s` for `seconds`, report the hottest
+    (function, file:line) entries by inclusive sample count.  The py-spy-style
+    answer to Go's process-wide net/http/pprof CPU profile."""
+    own = threading.get_ident()
+    leaf = Counter()
+    inclusive = Counter()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == own:
+                continue
+            samples += 1
+            first = True
+            seen = set()
+            while frame is not None:
+                code = frame.f_code
+                key = f"{code.co_name} ({code.co_filename}:{code.co_firstlineno})"
+                if first:
+                    leaf[key] += 1
+                    first = False
+                if key not in seen:  # count once per stack for inclusive
+                    inclusive[key] += 1
+                    seen.add(key)
+                frame = frame.f_back
+        time.sleep(interval_s)
+    out = [f"{samples} samples over {seconds:.2f}s ({interval_s * 1000:.0f}ms interval)\n"]
+    out.append("--- inclusive (on stack) ---")
+    for key, n in inclusive.most_common(60):
+        out.append(f"{n:8d}  {key}")
+    out.append("--- self (leaf frame) ---")
+    for key, n in leaf.most_common(40):
+        out.append(f"{n:8d}  {key}")
+    return "\n".join(out) + "\n"
+
+
+class StartupCompleteChecker:
+    """Healthy once the component finished starting (startup_complete_checker.go)."""
+
+    def __init__(self):
+        self._complete = False
+
+    def mark_complete(self) -> None:
+        self._complete = True
+
+    def check(self) -> Optional[str]:
+        return None if self._complete else "startup not complete yet"
+
+
+class FunctionChecker:
+    """Wraps a callable returning None (healthy) or an error string."""
+
+    def __init__(self, fn: Callable[[], Optional[str]], name: str = ""):
+        self._fn = fn
+        self.name = name
+
+    def check(self) -> Optional[str]:
+        return self._fn()
+
+
+class MultiChecker:
+    """Joins constituent checkers; unhealthy if any is (multi_checker.go)."""
+
+    def __init__(self, *checkers):
+        self._lock = threading.Lock()
+        self._checkers = list(checkers)
+
+    def add(self, checker) -> None:
+        with self._lock:
+            self._checkers.append(checker)
+
+    def check(self) -> Optional[str]:
+        with self._lock:
+            checkers = list(self._checkers)
+        if not checkers:
+            return "no checkers registered"
+        errors = []
+        for c in checkers:
+            try:
+                e = c.check()
+            except Exception as exc:  # a broken checker is unhealthy, not a 500
+                e = f"checker {getattr(c, 'name', type(c).__name__)!r} raised: {exc}"
+            if e:
+                errors.append(e)
+        return "\n".join(errors) if errors else None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "armada-tpu-health/1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _respond(self, status: int, body: bytes = b"", ctype="text/plain") -> None:
+        self.send_response(status)
+        if body:
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        parsed = urlparse(self.path)
+        path = parsed.path.rstrip("/") or "/"
+        srv: "HealthServer" = self.server.owner  # type: ignore[attr-defined]
+        if path == "/health":
+            err = srv.checker.check()
+            if err is None:
+                self._respond(204)
+            else:
+                self._respond(503, err.encode())
+        elif path == "/debug/pprof/profile" and srv.profiling:
+            qs = parse_qs(parsed.query)
+            try:
+                seconds = float(qs.get("seconds", ["5"])[0])
+            except ValueError:
+                self._respond(400, b"bad seconds parameter\n")
+                return
+            seconds = min(max(seconds, 0.01), 120.0)
+            self._respond(200, sample_profile(seconds).encode())
+        elif path == "/debug/pprof/heap" and srv.profiling:
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                tracemalloc.start()
+                self._respond(
+                    200,
+                    b"tracemalloc started; call again for a snapshot\n",
+                )
+                return
+            snap = tracemalloc.take_snapshot()
+            lines = [
+                str(stat) for stat in snap.statistics("lineno")[:80]
+            ]
+            self._respond(200, ("\n".join(lines) + "\n").encode())
+        elif path == "/debug/pprof/threads" and srv.profiling:
+            out = []
+            for tid, frame in sys._current_frames().items():
+                name = next(
+                    (t.name for t in threading.enumerate() if t.ident == tid),
+                    str(tid),
+                )
+                out.append(f"--- thread {name} ({tid}) ---")
+                out.extend(traceback.format_stack(frame))
+            self._respond(200, "".join(f"{l}\n" if not l.endswith("\n") else l for l in out).encode())
+        else:
+            self._respond(404)
+
+
+class HealthServer:
+    """Serves /health (+ /debug/pprof/* when profiling=True) on `port`."""
+
+    def __init__(self, port: int = 0, profiling: bool = False):
+        self.checker = MultiChecker()
+        self.profiling = profiling
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()

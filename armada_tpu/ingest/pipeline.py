@@ -84,6 +84,10 @@ class IngestionPipeline:
             self._thread.join()
             self._thread = None
 
+    def alive(self) -> bool:
+        """True while the background loop is running (feeds health checks)."""
+        return self._thread is not None and self._thread.is_alive()
+
     def _loop(self) -> None:
         import logging
 

@@ -169,7 +169,11 @@ class FairSchedulingAlgo:
         txn: WriteTxn,
         executors: Sequence[ExecutorSnapshot],
         now_ns: Optional[int] = None,
+        quarantined_nodes: frozenset = frozenset(),
     ) -> SchedulerResult:
+        """quarantined_nodes: node ids excluded for high failure rates
+        (README.md:28; scheduler/quarantine.py) -- treated like cordoned
+        nodes: running jobs keep counting, nothing new lands."""
         now_ns = self._clock_ns() if now_ns is None else now_ns
         result = SchedulerResult()
 
@@ -178,6 +182,8 @@ class FairSchedulingAlgo:
         executor_of_node: dict[str, str] = {}
         for ex in healthy:
             for n in ex.nodes:
+                if n.id in quarantined_nodes and not n.unschedulable:
+                    n = dataclasses.replace(n, unschedulable=True)
                 nodes.append(n)
                 executor_of_node[n.id] = ex.id
 

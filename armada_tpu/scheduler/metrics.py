@@ -74,6 +74,11 @@ class SchedulerMetrics:
             "Value each queue would realise on a boundary-less cluster",
             ["pool", "queue"],
         )
+        self.quarantined_nodes = Gauge(
+            "armada_scheduler_quarantined_nodes",
+            "Nodes currently excluded for high failure rates",
+            registry=registry,
+        )
         self.fairness_error = g(
             "armada_scheduler_fairness_error",
             "Cumulative delta between adjusted fair share and actual share",

@@ -1,0 +1,64 @@
+"""Node quarantine: stop scheduling onto nodes with high failure rates.
+
+The reference advertises "automatically removing nodes exhibiting high
+failure rates from consideration for scheduling" (README.md:28); this is the
+scheduler-side implementation: every attempted run that dies reports its
+node; a node accumulating `failure_threshold` failures within `window_s` is
+quarantined -- treated unschedulable by the scheduling rounds, exactly like a
+cordoned node -- for `cooldown_s`, then re-admitted.
+
+Complementary to retry anti-affinity (scheduler.go:522-568), which keeps one
+job off its own bad nodes; quarantine protects EVERY job from a node that
+keeps killing other people's pods.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict
+
+
+class NodeQuarantine:
+    def __init__(
+        self,
+        failure_threshold: int = 0,
+        window_s: float = 600.0,
+        cooldown_s: float = 1200.0,
+    ):
+        """failure_threshold 0 disables the tracker entirely."""
+        self.failure_threshold = failure_threshold
+        self.window_ns = int(window_s * 1e9)
+        self.cooldown_ns = int(cooldown_s * 1e9)
+        self._failures: Dict[str, Deque[int]] = {}
+        self._quarantined_until: Dict[str, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.failure_threshold > 0
+
+    def record_failure(self, node_id: str, now_ns: int) -> bool:
+        """Record one run death on `node_id`; True if this trips quarantine."""
+        if not self.enabled or not node_id:
+            return False
+        q = self._failures.setdefault(node_id, deque())
+        q.append(now_ns)
+        cutoff = now_ns - self.window_ns
+        while q and q[0] < cutoff:
+            q.popleft()
+        if len(q) >= self.failure_threshold:
+            self._quarantined_until[node_id] = now_ns + self.cooldown_ns
+            q.clear()
+            return True
+        return False
+
+    def quarantined(self, now_ns: int) -> frozenset:
+        """Node ids currently quarantined (cooldown not yet lapsed)."""
+        if not self._quarantined_until:
+            return frozenset()
+        expired = [
+            nid for nid, until in self._quarantined_until.items() if until <= now_ns
+        ]
+        for nid in expired:
+            del self._quarantined_until[nid]
+            self._failures.pop(nid, None)
+        return frozenset(self._quarantined_until)

@@ -38,6 +38,7 @@ from armada_tpu.jobdb.jobdb import JobDb, WriteTxn
 from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 from armada_tpu.scheduler.leader import LeaderController, LeaderToken
+from armada_tpu.scheduler.quarantine import NodeQuarantine
 from armada_tpu.scheduler.reconciliation import apply_rows
 from armada_tpu.scheduler.short_job_penalty import ShortJobPenalty
 from armada_tpu.scheduler.submitcheck import SubmitChecker
@@ -112,6 +113,11 @@ class Scheduler:
         self.submit_checker = SubmitChecker(self.config)
         self.short_job_penalty = ShortJobPenalty(
             self.config.short_job_penalty_cutoffs()
+        )
+        self.node_quarantine = NodeQuarantine(
+            failure_threshold=self.config.node_quarantine_failure_threshold,
+            window_s=self.config.node_quarantine_window_s,
+            cooldown_s=self.config.node_quarantine_cooldown_s,
         )
         # Optional observability hooks (SchedulerMetrics /
         # SchedulingReportsRepository); None = disabled.
@@ -251,7 +257,15 @@ class Scheduler:
             self._expire_executor_jobs(txn, builder, now_ns)
 
             if schedule:
-                sched = self.algo.schedule(txn, self._executors(), now_ns)
+                quarantined = self.node_quarantine.quarantined(now_ns)
+                if self.metrics is not None:
+                    self.metrics.quarantined_nodes.set(len(quarantined))
+                sched = self.algo.schedule(
+                    txn,
+                    self._executors(),
+                    now_ns,
+                    quarantined_nodes=quarantined,
+                )
                 result.scheduler_result = sched
                 result.scheduled = True
                 self._events_from_scheduler_result(sched, builder, now_ns)
@@ -390,6 +404,7 @@ class Scheduler:
                 )
                 txn.upsert(job.with_failed())
             elif run.failed and not run.returned:
+                self.node_quarantine.record_failure(run.node_id, now_ns)
                 # A failed run means a terminal error was reported
                 # (instructions.go handleJobRunErrors): the job fails with it.
                 builder.add(
@@ -412,6 +427,10 @@ class Scheduler:
                 )
                 txn.upsert(job.with_failed())
             elif run.returned and not job.queued:
+                # Returned leases count whether or not the pod started: a
+                # stuck-PENDING return (podStuckPending) is the clearest
+                # broken-node signal and never sets run_attempted.
+                self.node_quarantine.record_failure(run.node_id, now_ns)
                 self._fail_or_requeue(
                     txn,
                     job,

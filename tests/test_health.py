@@ -1,0 +1,112 @@
+"""Health checkers + profiling endpoint (internal/common/health
+multi_checker.go, http_handler.go: 204 healthy / 503 + error text;
+internal/common/profiling/http.go pprof analogues)."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from armada_tpu.core.health import (
+    FunctionChecker,
+    HealthServer,
+    MultiChecker,
+    StartupCompleteChecker,
+)
+
+
+def get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_multi_checker_joins_errors():
+    mc = MultiChecker()
+    assert mc.check() == "no checkers registered"
+    mc.add(FunctionChecker(lambda: None))
+    assert mc.check() is None
+    mc.add(FunctionChecker(lambda: "a broke"))
+    mc.add(FunctionChecker(lambda: "b broke"))
+    assert mc.check() == "a broke\nb broke"
+
+
+def test_startup_checker_flips():
+    c = StartupCompleteChecker()
+    assert c.check() is not None
+    c.mark_complete()
+    assert c.check() is None
+
+
+def test_health_endpoint_204_then_503():
+    srv = HealthServer(0)
+    try:
+        startup = StartupCompleteChecker()
+        startup.mark_complete()
+        srv.checker.add(startup)
+        status, _ = get(f"http://127.0.0.1:{srv.port}/health")
+        assert status == 204
+        srv.checker.add(FunctionChecker(lambda: "pipeline dead"))
+        status, body = get(f"http://127.0.0.1:{srv.port}/health")
+        assert status == 503 and "pipeline dead" in body
+        # profiling disabled -> 404
+        status, _ = get(f"http://127.0.0.1:{srv.port}/debug/pprof/threads")
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+def test_profiling_endpoints():
+    import threading
+    import time
+
+    srv = HealthServer(0, profiling=True)
+
+    def busy_spin_marker(stop):
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    stop = threading.Event()
+    t = threading.Thread(target=busy_spin_marker, args=(stop,), daemon=True)
+    t.start()
+    try:
+        status, body = get(f"http://127.0.0.1:{srv.port}/debug/pprof/threads")
+        assert status == 200 and "thread" in body
+        status, body = get(
+            f"http://127.0.0.1:{srv.port}/debug/pprof/profile?seconds=0.3"
+        )
+        assert status == 200 and "samples over" in body
+        # the sampler must see OTHER threads, not just its own handler
+        assert "busy_spin_marker" in body
+        status, _ = get(
+            f"http://127.0.0.1:{srv.port}/debug/pprof/profile?seconds=abc"
+        )
+        assert status == 400
+        status, body = get(f"http://127.0.0.1:{srv.port}/debug/pprof/heap")
+        assert status == 200
+    finally:
+        stop.set()
+        srv.stop()
+
+
+def test_control_plane_serves_health(tmp_path):
+    from armada_tpu.cli.serve import start_control_plane
+
+    plane = start_control_plane(
+        str(tmp_path), cycle_interval_s=0.2, schedule_interval_s=0.5,
+        health_port=0, profiling=True,
+    )
+    try:
+        port = plane.health_server.port
+        status, _ = get(f"http://127.0.0.1:{port}/health")
+        assert status == 204
+        status, body = get(f"http://127.0.0.1:{port}/debug/pprof/threads")
+        assert status == 200
+        assert "thread" in body.lower() and "Thread" in body  # stack dump present
+    finally:
+        plane.stop()
+    # after stop, the scheduler thread is dead: a fresh probe would 503, but
+    # the server is down too -- just assert the stop completed cleanly
+    assert not plane._scheduler_thread.is_alive()

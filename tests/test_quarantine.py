@@ -1,0 +1,118 @@
+"""Node quarantine: high-failure-rate nodes leave scheduling (README.md:28)."""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig, scheduling_config_from_dict
+from armada_tpu.scheduler.quarantine import NodeQuarantine
+from tests.control_plane import ControlPlane
+from armada_tpu.server import JobSubmitItem, QueueRecord
+
+S = int(1e9)
+
+
+def test_threshold_window_and_cooldown():
+    q = NodeQuarantine(failure_threshold=3, window_s=60, cooldown_s=120)
+    assert not q.record_failure("n0", 0)
+    assert not q.record_failure("n0", 10 * S)
+    # third failure inside the window trips it
+    assert q.record_failure("n0", 20 * S)
+    assert q.quarantined(21 * S) == {"n0"}
+    # cooldown readmits
+    assert q.quarantined(20 * S + 121 * S) == frozenset()
+    # failures outside the window don't accumulate
+    q2 = NodeQuarantine(failure_threshold=3, window_s=60, cooldown_s=120)
+    q2.record_failure("n1", 0)
+    q2.record_failure("n1", 70 * S)
+    assert not q2.record_failure("n1", 140 * S)
+    assert q2.quarantined(141 * S) == frozenset()
+
+
+def test_disabled_records_nothing():
+    q = NodeQuarantine(failure_threshold=0)
+    assert not q.record_failure("n0", 0)
+    assert q.quarantined(1) == frozenset()
+
+
+def test_yaml_knobs():
+    cfg = scheduling_config_from_dict(
+        {
+            "nodeQuarantineFailureThreshold": 5,
+            "nodeQuarantineWindow": "2m",
+            "nodeQuarantineCooldown": "10m",
+        }
+    )
+    assert cfg.node_quarantine_failure_threshold == 5
+    assert cfg.node_quarantine_window_s == 120.0
+    assert cfg.node_quarantine_cooldown_s == 600.0
+
+
+def test_failing_node_is_quarantined_end_to_end(tmp_path):
+    """Two pods die on n0 -> n0 quarantined -> next job lands on n1 even
+    though n0 is emptier; after the cooldown n0 is schedulable again."""
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        enable_assertions=True,
+        node_quarantine_failure_threshold=2,
+        node_quarantine_window_s=600.0,
+        node_quarantine_cooldown_s=300.0,
+    )
+    cp = ControlPlane.build(
+        tmp_path,
+        config=cfg,
+        executor_specs={"ex1": (2, "8", "32")},
+        runtime_s=1000.0,
+    )
+    cp.server.create_queue(QueueRecord("q"))
+    ex = cp.executors[0]
+
+    def submit_and_place(name):
+        (jid,) = cp.server.submit_jobs(
+            "q", "js", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})]
+        )
+        ex.run_once()
+        cp.ingest()
+        cp.scheduler.cycle()
+        cp.ingest()
+        ex.run_once()
+        run = cp.jobdb.read_txn().get(jid).latest_run
+        return jid, run.id, run.node_id
+
+    # two jobs fail on whichever node they land (best-fit packs both on the
+    # same emptier node... they land on ex1-n0 both times)
+    for _ in range(2):
+        jid, rid, nid = submit_and_place("victim")
+        assert nid == "ex1-n0"
+        ex.cluster.tick(0.5)  # running -> attempted
+        ex.report_cycle()
+        cp.ingest()
+        cp.scheduler.cycle()
+        ex.cluster.fail_pod(rid, "disk on fire")
+        ex.report_cycle()
+        ex.cleanup()
+        cp.ingest()
+        cp.scheduler.cycle()
+
+    assert cp.scheduler.node_quarantine.quarantined(cp.scheduler.now_ns()) == {
+        "ex1-n0"
+    }
+
+    # next job avoids the quarantined node
+    jid3, _, nid3 = submit_and_place("survivor")
+    assert nid3 == "ex1-n1"
+
+    # cooldown readmits n0: the tracker clears, and a node-filling job that
+    # cannot fit next to the survivor on n1 lands on n0 again
+    cp.clock.advance(400.0)
+    assert (
+        cp.scheduler.node_quarantine.quarantined(cp.scheduler.now_ns())
+        == frozenset()
+    )
+    (big,) = cp.server.submit_jobs(
+        "q", "js", [JobSubmitItem(resources={"cpu": "8", "memory": "2"})]
+    )
+    ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    run = cp.jobdb.read_txn().get(big).latest_run
+    assert run is not None and run.node_id == "ex1-n0"
+    cp.close()
