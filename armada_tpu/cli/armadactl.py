@@ -443,10 +443,14 @@ def cmd_serve(args):
 def cmd_executor(args):
     from armada_tpu.cli.serve import run_fake_executor
 
-    print(
-        f"fake executor {args.id}: {args.nodes} nodes x {args.cpu} cpu / "
-        f"{args.memory} mem -> {args.url}"
-    )
+    if args.kubernetes or args.in_cluster:
+        target = args.kubernetes or "in-cluster kube-api"
+        print(f"kubernetes executor {args.id}: {target} -> {args.url}")
+    else:
+        print(
+            f"fake executor {args.id}: {args.nodes} nodes x {args.cpu} cpu / "
+            f"{args.memory} mem -> {args.url}"
+        )
     try:
         run_fake_executor(
             args.url,
@@ -458,6 +462,11 @@ def cmd_executor(args):
             interval_s=args.interval,
             default_runtime_s=args.default_runtime,
             binoculars_port=args.binoculars_port,
+            kubernetes_url=args.kubernetes,
+            kubernetes_in_cluster=args.in_cluster,
+            kube_token_file=args.kube_token_file,
+            kube_ca_file=args.kube_ca,
+            kube_insecure=args.kube_insecure,
         )
     except KeyboardInterrupt:
         pass
@@ -586,7 +595,11 @@ def build_parser() -> argparse.ArgumentParser:
     lt.add_argument("file")
     lt.set_defaults(fn=cmd_load_test)
 
-    ex = sub.add_parser("executor", help="run a fake-cluster executor agent")
+    ex = sub.add_parser(
+        "executor",
+        help="run an executor agent (fake cluster by default; --kubernetes "
+        "or --in-cluster for a real Kubernetes cluster)",
+    )
     ex.add_argument("--id", default="fake-1")
     ex.add_argument("--pool", default="default")
     ex.add_argument("--nodes", type=int, default=4)
@@ -598,6 +611,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ex.add_argument(
         "--binoculars-port", type=int, help="host a logs/cordon service on this port"
+    )
+    ex.add_argument(
+        "--kubernetes",
+        metavar="URL",
+        help="drive a real cluster via this kube-apiserver URL",
+    )
+    ex.add_argument(
+        "--in-cluster",
+        action="store_true",
+        help="drive the cluster this agent runs in (service-account config)",
+    )
+    ex.add_argument("--kube-token-file", help="bearer token file for --kubernetes")
+    ex.add_argument("--kube-ca", help="CA bundle for --kubernetes")
+    ex.add_argument(
+        "--kube-insecure", action="store_true", help="skip TLS verification"
     )
     ex.set_defaults(fn=cmd_executor)
 

@@ -270,9 +270,16 @@ def run_fake_executor(
     config: Optional[SchedulingConfig] = None,
     default_runtime_s: float = 10.0,
     binoculars_port: Optional[int] = None,
+    kubernetes_url: Optional[str] = None,
+    kubernetes_in_cluster: bool = False,
+    kube_token_file: Optional[str] = None,
+    kube_ca_file: Optional[str] = None,
+    kube_insecure: bool = False,
 ) -> None:
-    """`armadactl executor`: a fake-cluster agent against a remote control
-    plane (cmd/fakeexecutor)."""
+    """`armadactl executor`: a cluster agent against a remote control plane.
+    Default is the fake in-memory cluster (cmd/fakeexecutor); kubernetes_url
+    or kubernetes_in_cluster drives a real Kubernetes cluster via
+    KubernetesClusterContext (cmd/executor)."""
     import time
 
     from armada_tpu.core.types import NodeSpec
@@ -281,18 +288,40 @@ def run_fake_executor(
 
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
-    nodes = [
-        NodeSpec(
-            id=f"{executor_id}-n{i}",
-            pool=pool,
-            executor=executor_id,
-            total_resources=factory.from_mapping({"cpu": cpu, "memory": memory}),
+    if kubernetes_url or kubernetes_in_cluster:
+        from armada_tpu.executor.kubernetes import KubernetesClusterContext
+
+        if kubernetes_in_cluster:
+            cluster = KubernetesClusterContext.in_cluster(
+                factory, node_id_label=config.node_id_label, executor_id=executor_id
+            )
+        else:
+            token = None
+            if kube_token_file:
+                with open(kube_token_file) as f:
+                    token = f.read().strip()
+            cluster = KubernetesClusterContext(
+                kubernetes_url,
+                factory,
+                token=token,
+                ca_file=kube_ca_file,
+                insecure=kube_insecure,
+                node_id_label=config.node_id_label,
+                executor_id=executor_id,
+            )
+    else:
+        nodes = [
+            NodeSpec(
+                id=f"{executor_id}-n{i}",
+                pool=pool,
+                executor=executor_id,
+                total_resources=factory.from_mapping({"cpu": cpu, "memory": memory}),
+            )
+            for i in range(num_nodes)
+        ]
+        cluster = FakeClusterContext(
+            nodes, factory, runtime_of=lambda s: default_runtime_s
         )
-        for i in range(num_nodes)
-    ]
-    cluster = FakeClusterContext(
-        nodes, factory, runtime_of=lambda s: default_runtime_s
-    )
     api = ExecutorApiClient(server_address)
     agent = ExecutorService(executor_id, pool, cluster, api, factory)
     binoculars_server = None
@@ -306,12 +335,26 @@ def run_fake_executor(
         print(f"binoculars (logs/cordon) on 127.0.0.1:{bport}")
     stop = stop or threading.Event()
     last = time.monotonic()
+    tick = getattr(cluster, "tick", None)  # fake-cluster virtual time only
+    errors_in_a_row = 0
     try:
         while not stop.is_set():
             now = time.monotonic()
-            cluster.tick(now - last)
+            if tick is not None:
+                tick(now - last)
             last = now
-            agent.run_once()
+            try:
+                agent.run_once()
+                errors_in_a_row = 0
+            except Exception as exc:
+                # A transient apiserver / control-plane blip must not kill a
+                # long-running agent (the reference's task loops retry); back
+                # off up to 30s and keep reconciling.
+                errors_in_a_row += 1
+                backoff = min(interval_s * (2**errors_in_a_row), 30.0)
+                print(f"executor {executor_id}: cycle failed ({exc}); retrying in {backoff:.1f}s")
+                stop.wait(backoff)
+                continue
             stop.wait(interval_s)
     finally:
         if binoculars_server is not None:

@@ -98,6 +98,11 @@ class JobSpec:
     pools: tuple[str, ...] = ()  # pools the job may schedule in; empty = all
     # Price band for market-driven pools (reference: bidstore price bands).
     price_band: str = ""
+    # Pod payload passthrough (submit item -> events.proto JobSpec -> the
+    # cluster adapter): the scheduler itself never reads these.
+    namespace: str = "default"
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)

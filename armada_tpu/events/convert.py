@@ -49,6 +49,9 @@ def job_spec_to_proto(job: JobSpec) -> pb.JobSpec:
         gang_node_uniformity_label=job.gang_node_uniformity_label,
         pools=list(job.pools),
         price_band=job.price_band,
+        namespace=job.namespace,
+        annotations=dict(job.annotations),
+        labels=dict(job.labels),
     )
 
 
@@ -78,4 +81,7 @@ def job_spec_from_proto(
         gang_node_uniformity_label=msg.gang_node_uniformity_label,
         pools=tuple(msg.pools),
         price_band=msg.price_band,
+        namespace=msg.namespace or "default",
+        annotations=dict(msg.annotations),
+        labels=dict(msg.labels),
     )

@@ -1,0 +1,377 @@
+"""KubernetesClusterContext: the real-cluster adapter behind ClusterContext.
+
+Equivalent of the reference's `internal/executor/context/cluster_context.go`
+(KubernetesClusterContext): the ONLY kube-api touchpoint -- submit/delete
+pods, list nodes, observe pod state, fetch logs (binoculars,
+internal/binoculars/service/logs.go:39-43).  Uses the kube-apiserver REST API
+directly over stdlib HTTP (in-cluster service-account token + CA, or any
+base_url for tests), so no kubernetes client library is required.
+
+Pod payload: the scheduler schedules abstract resource shapes; the container
+to run rides on job annotations --
+  armada-tpu.io/image    (else `default_image`)
+  armada-tpu.io/command  (JSON list)
+  armada-tpu.io/args     (JSON list)
+Placement is pinned the way the reference pins evicted/leased jobs: a
+node-selector on the configured node-id label (internal/scheduler/api.go
+addNodeIdSelector:278).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Optional, Sequence
+
+from armada_tpu.core.resources import ResourceListFactory, format_quantity
+from armada_tpu.core.types import JobSpec, NodeSpec, Taint
+from armada_tpu.executor.cluster import PodPhase, PodState
+
+RUN_LABEL = "armada-tpu.io/run-id"
+JOB_LABEL = "armada-tpu.io/job-id"
+QUEUE_LABEL = "armada-tpu.io/queue"
+EXECUTOR_LABEL = "armada-tpu.io/executor"
+JOBSET_ANNOTATION = "armada-tpu.io/jobset"
+IMAGE_ANNOTATION = "armada-tpu.io/image"
+COMMAND_ANNOTATION = "armada-tpu.io/command"
+ARGS_ANNOTATION = "armada-tpu.io/args"
+
+_PHASES = {
+    "Pending": PodPhase.PENDING,
+    "Running": PodPhase.RUNNING,
+    "Succeeded": PodPhase.SUCCEEDED,
+    "Failed": PodPhase.FAILED,
+}
+
+
+class KubeApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"kube-api {status}: {message}")
+        self.status = status
+
+
+
+
+class KubernetesClusterContext:
+    """ClusterContext over the kube-apiserver REST API."""
+
+    def __init__(
+        self,
+        base_url: str,
+        factory: ResourceListFactory,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+        node_id_label: str = "kubernetes.io/hostname",
+        pool_label: str = "armada-tpu.io/pool",
+        default_pool: str = "default",
+        default_image: str = "busybox:latest",
+        timeout_s: float = 30.0,
+        executor_id: str = "",
+        namespaces: Optional[Sequence[str]] = None,
+    ):
+        """executor_id: stamped onto pods and used to filter listings, so two
+        executors sharing a cluster never adopt each other's pods.
+        namespaces: restrict pod listings to these namespaces (namespace-
+        scoped RBAC); None = cluster-scoped /api/v1/pods."""
+        self.base_url = base_url.rstrip("/")
+        self._factory = factory
+        self._token = token
+        self.executor_id = executor_id
+        self.namespaces = tuple(namespaces) if namespaces else None
+        self.node_id_label = node_id_label
+        self.pool_label = pool_label
+        self.default_pool = default_pool
+        self.default_image = default_image
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        # run_id -> (namespace, pod name); rebuilt from labels on relisting.
+        self._pods: dict[str, tuple[str, str]] = {}
+        if base_url.startswith("https"):
+            ctx = ssl.create_default_context(cafile=ca_file)
+            if insecure:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._ssl = ctx
+        else:
+            self._ssl = None
+
+    @classmethod
+    def in_cluster(cls, factory: ResourceListFactory, **kw) -> "KubernetesClusterContext":
+        """Standard in-cluster config: service-account token + CA + env host
+        (cluster_context.go's rest.InClusterConfig equivalent)."""
+        import os
+
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        with open(f"{sa}/token") as f:
+            token = f.read().strip()
+        return cls(
+            f"https://{host}:{port}",
+            factory,
+            token=token,
+            ca_file=f"{sa}/ca.crt",
+            **kw,
+        )
+
+    # --- http ----------------------------------------------------------------
+
+    def _request(self, method: str, path: str, body=None, raw: bool = False):
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout, context=self._ssl
+            ) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            raise KubeApiError(e.code, e.read().decode(errors="replace")) from e
+        if raw:
+            return payload.decode(errors="replace")
+        return json.loads(payload) if payload else {}
+
+    # --- ClusterContext -------------------------------------------------------
+
+    def submit_pod(
+        self,
+        run_id: str,
+        job_id: str,
+        queue: str,
+        jobset: str,
+        spec: JobSpec,
+        node_id: str,
+    ) -> None:
+        namespace = spec.namespace or "default"
+        name = f"armada-{run_id.lower()}"
+        manifest = self._pod_manifest(
+            name, run_id, job_id, queue, jobset, spec, node_id
+        )
+        try:
+            self._request("POST", f"/api/v1/namespaces/{namespace}/pods", manifest)
+        except KubeApiError as e:
+            if e.status != 409:  # already exists: idempotent resubmit
+                raise
+        with self._lock:
+            self._pods[run_id] = (namespace, name)
+
+    def _pod_manifest(
+        self, name, run_id, job_id, queue, jobset, spec: JobSpec, node_id
+    ) -> dict:
+        requests = {}
+        if spec.resources is not None:
+            for rname, atoms in zip(self._factory.names, spec.resources.atoms):
+                if atoms:
+                    requests[rname] = format_quantity(int(atoms))
+        container = {
+            "name": "main",
+            "image": spec.annotations.get(IMAGE_ANNOTATION, self.default_image),
+            "resources": {"requests": requests, "limits": dict(requests)},
+        }
+        for ann, key in ((COMMAND_ANNOTATION, "command"), (ARGS_ANNOTATION, "args")):
+            if ann in spec.annotations:
+                container[key] = json.loads(spec.annotations[ann])
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    **dict(spec.labels),
+                    RUN_LABEL: run_id,
+                    JOB_LABEL: job_id,
+                    QUEUE_LABEL: queue,
+                    **(
+                        {EXECUTOR_LABEL: self.executor_id}
+                        if self.executor_id
+                        else {}
+                    ),
+                },
+                "annotations": {
+                    **dict(spec.annotations),
+                    JOBSET_ANNOTATION: jobset,
+                },
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                # Pin to the scheduler's decision (api.go addNodeIdSelector).
+                "nodeSelector": {
+                    **dict(spec.node_selector),
+                    self.node_id_label: node_id,
+                },
+                "tolerations": [
+                    {
+                        "key": t.key,
+                        "operator": t.operator,
+                        "value": t.value,
+                        "effect": t.effect,
+                    }
+                    for t in spec.tolerations
+                ],
+                "containers": [container],
+            },
+        }
+
+    def delete_pod(self, run_id: str) -> None:
+        with self._lock:
+            loc = self._pods.get(run_id)
+        if loc is None:
+            # Unknown locally (e.g. agent restart): find it by label.
+            for p in self._list_pods():
+                if p["metadata"]["labels"].get(RUN_LABEL) == run_id:
+                    loc = (p["metadata"]["namespace"], p["metadata"]["name"])
+                    break
+        if loc is None:
+            return
+        namespace, name = loc
+        try:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{namespace}/pods/{name}",
+                {"gracePeriodSeconds": 0},
+            )
+        except KubeApiError as e:
+            if e.status != 404:  # already gone: idempotent
+                raise
+        with self._lock:
+            self._pods.pop(run_id, None)
+
+    def _list_pods(self) -> list:
+        selector = RUN_LABEL
+        if self.executor_id:
+            selector += f",{EXECUTOR_LABEL}%3D{self.executor_id}"
+        if self.namespaces is None:
+            out = self._request("GET", f"/api/v1/pods?labelSelector={selector}")
+            return out.get("items", [])
+        items: list = []
+        for ns in self.namespaces:
+            out = self._request(
+                "GET", f"/api/v1/namespaces/{ns}/pods?labelSelector={selector}"
+            )
+            items.extend(out.get("items", []))
+        return items
+
+    def pod_states(self) -> Sequence[PodState]:
+        states = []
+        with self._lock:
+            known = dict(self._pods)
+        seen = set()
+        for p in self._list_pods():
+            meta = p["metadata"]
+            run_id = meta["labels"].get(RUN_LABEL, "")
+            if not run_id:
+                continue
+            seen.add(run_id)
+            status = p.get("status", {})
+            phase = _PHASES.get(status.get("phase", "Pending"), PodPhase.PENDING)
+            states.append(
+                PodState(
+                    run_id=run_id,
+                    job_id=meta["labels"].get(JOB_LABEL, ""),
+                    queue=meta["labels"].get(QUEUE_LABEL, ""),
+                    jobset=meta.get("annotations", {}).get(JOBSET_ANNOTATION, ""),
+                    node_id=p.get("spec", {})
+                    .get("nodeSelector", {})
+                    .get(self.node_id_label, p.get("spec", {}).get("nodeName", "")),
+                    phase=phase,
+                    message=status.get("message", ""),
+                )
+            )
+            with self._lock:
+                self._pods[run_id] = (meta["namespace"], meta["name"])
+        # forget pods the API no longer returns
+        with self._lock:
+            for run_id in set(self._pods) - seen:
+                if run_id in known:
+                    self._pods.pop(run_id, None)
+        return states
+
+    def get_pod(self, run_id: str) -> Optional[PodState]:
+        with self._lock:
+            loc = self._pods.get(run_id)
+        if loc is not None:
+            # Known pod: one direct GET instead of a cluster-wide list.
+            namespace, name = loc
+            try:
+                p = self._request(
+                    "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"
+                )
+            except KubeApiError as e:
+                if e.status == 404:
+                    return None
+                raise
+            meta = p["metadata"]
+            status = p.get("status", {})
+            return PodState(
+                run_id=run_id,
+                job_id=meta.get("labels", {}).get(JOB_LABEL, ""),
+                queue=meta.get("labels", {}).get(QUEUE_LABEL, ""),
+                jobset=meta.get("annotations", {}).get(JOBSET_ANNOTATION, ""),
+                node_id=p.get("spec", {})
+                .get("nodeSelector", {})
+                .get(self.node_id_label, p.get("spec", {}).get("nodeName", "")),
+                phase=_PHASES.get(status.get("phase", "Pending"), PodPhase.PENDING),
+                message=status.get("message", ""),
+            )
+        for p in self.pod_states():
+            if p.run_id == run_id:
+                return p
+        return None
+
+    def node_specs(self) -> Sequence[NodeSpec]:
+        out = self._request("GET", "/api/v1/nodes")
+        nodes = []
+        for n in out.get("items", []):
+            meta = n["metadata"]
+            labels = meta.get("labels", {})
+            status = n.get("status", {})
+            allocatable = {
+                name: q
+                for name, q in status.get("allocatable", {}).items()
+                if name in self._factory.names
+            }
+            spec = n.get("spec", {})
+            taints = tuple(
+                Taint(
+                    key=t.get("key", ""),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", ""),
+                )
+                for t in spec.get("taints", ())
+            )
+            nodes.append(
+                NodeSpec(
+                    id=labels.get(self.node_id_label, meta["name"]),
+                    pool=labels.get(self.pool_label, self.default_pool),
+                    total_resources=self._factory.from_mapping(allocatable),
+                    labels=labels,
+                    taints=taints,
+                    unschedulable=bool(spec.get("unschedulable", False)),
+                )
+            )
+        return nodes
+
+    # --- binoculars (logs.go:39-43) ------------------------------------------
+
+    def pod_logs(self, run_id: str, tail_lines: Optional[int] = None) -> str:
+        with self._lock:
+            loc = self._pods.get(run_id)
+        if loc is None:
+            pod = self.get_pod(run_id)
+            if pod is None:
+                raise KeyError(f"no pod for run {run_id}")
+            with self._lock:
+                loc = self._pods[run_id]
+        namespace, name = loc
+        path = f"/api/v1/namespaces/{namespace}/pods/{name}/log"
+        if tail_lines:
+            path += f"?tailLines={int(tail_lines)}"
+        return self._request("GET", path, raw=True)

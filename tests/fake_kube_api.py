@@ -1,0 +1,163 @@
+"""An in-process fake kube-apiserver covering the endpoints the
+KubernetesClusterContext uses: create/delete/list pods, list nodes, pod logs.
+Test code mutates `pods`/`nodes` directly to simulate kubelet behavior
+(phase transitions, node drains)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class FakeKubeApi:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (namespace, name) -> pod manifest dict (with status injected)
+        self.pods: dict = {}
+        self.nodes: list = []
+        self.logs: dict = {}  # (namespace, name) -> str
+        self.requests: list = []  # (method, path) log for assertions
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+    def add_node(self, name, cpu="8", memory="32", labels=None, taints=None,
+                 unschedulable=False):
+        self.nodes.append(
+            {
+                "metadata": {"name": name, "labels": {**(labels or {})}},
+                "spec": {
+                    "taints": list(taints or ()),
+                    "unschedulable": unschedulable,
+                },
+                "status": {"allocatable": {"cpu": cpu, "memory": memory}},
+            }
+        )
+
+    def set_phase(self, namespace, name, phase, message=""):
+        with self.lock:
+            pod = self.pods[(namespace, name)]
+            pod["status"] = {"phase": phase, "message": message}
+
+    def _make_handler(api):  # noqa: N805 (closure over the fake)
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _text(self, status, text):
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _selected(self, pods, query):
+                """Apply k8s labelSelector semantics (bare key = exists,
+                k=v = equality)."""
+                sel = parse_qs(query).get("labelSelector", [""])[0]
+                terms = [t for t in sel.split(",") if t]
+                out = []
+                for p in pods:
+                    labels = p["metadata"].get("labels", {})
+                    ok = True
+                    for term in terms:
+                        if "=" in term:
+                            k, v = term.split("=", 1)
+                            ok = ok and labels.get(k) == v
+                        else:
+                            ok = ok and term in labels
+                    if ok:
+                        out.append(p)
+                return out
+
+            def do_GET(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                api.requests.append(("GET", parsed.path))
+                parts = parsed.path.strip("/").split("/")
+                if parsed.path == "/api/v1/nodes":
+                    self._json(200, {"items": list(api.nodes)})
+                elif parsed.path == "/api/v1/pods":
+                    with api.lock:
+                        pods = list(api.pods.values())
+                    self._json(200, {"items": self._selected(pods, parsed.query)})
+                elif len(parts) == 5 and parts[-1] == "pods":
+                    ns = parts[3]
+                    with api.lock:
+                        pods = [
+                            p for (pns, _), p in api.pods.items() if pns == ns
+                        ]
+                    self._json(200, {"items": self._selected(pods, parsed.query)})
+                elif len(parts) == 6 and parts[-2] == "pods":
+                    ns, name = parts[3], parts[5]
+                    with api.lock:
+                        pod = api.pods.get((ns, name))
+                    if pod is None:
+                        self._json(404, {"message": "not found"})
+                    else:
+                        self._json(200, pod)
+                elif len(parts) == 7 and parts[-1] == "log":
+                    ns, name = parts[3], parts[5]
+                    log = api.logs.get((ns, name))
+                    if log is None:
+                        self._json(404, {"message": "not found"})
+                    else:
+                        self._text(200, log)
+                else:
+                    self._json(404, {"message": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                api.requests.append(("POST", parsed.path))
+                parts = parsed.path.strip("/").split("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length)) if length else {}
+                if len(parts) == 5 and parts[-1] == "pods":
+                    ns = parts[3]
+                    name = body["metadata"]["name"]
+                    with api.lock:
+                        if (ns, name) in api.pods:
+                            self._json(409, {"message": "already exists"})
+                            return
+                        body["metadata"]["namespace"] = ns
+                        body.setdefault("status", {"phase": "Pending"})
+                        api.pods[(ns, name)] = body
+                    self._json(201, body)
+                else:
+                    self._json(404, {"message": "not found"})
+
+            def do_DELETE(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                api.requests.append(("DELETE", parsed.path))
+                parts = parsed.path.strip("/").split("/")
+                if len(parts) == 6 and parts[-2] == "pods":
+                    ns, name = parts[3], parts[5]
+                    with api.lock:
+                        if (ns, name) not in api.pods:
+                            self._json(404, {"message": "not found"})
+                            return
+                        del api.pods[(ns, name)]
+                    self._json(200, {})
+                else:
+                    self._json(404, {"message": "not found"})
+
+        return Handler

@@ -1,0 +1,248 @@
+"""KubernetesClusterContext against a fake kube-apiserver
+(internal/executor/context/cluster_context.go behavior)."""
+
+import json
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, Toleration
+from armada_tpu.executor.kubernetes import (
+    IMAGE_ANNOTATION,
+    COMMAND_ANNOTATION,
+    KubernetesClusterContext,
+    RUN_LABEL,
+)
+from armada_tpu.executor.cluster import PodPhase
+from tests.fake_kube_api import FakeKubeApi
+
+CFG = SchedulingConfig(shape_bucket=32)
+F = CFG.resource_list_factory()
+
+
+@pytest.fixture
+def kube():
+    api = FakeKubeApi()
+    yield api
+    api.stop()
+
+
+@pytest.fixture
+def ctx(kube):
+    return KubernetesClusterContext(kube.url, F, pool_label="pool")
+
+
+def spec(jid="j1", cpu="2", **kw):
+    return JobSpec(
+        id=jid,
+        queue="q",
+        resources=F.from_mapping({"cpu": cpu, "memory": "4Gi"}),
+        **kw,
+    )
+
+
+def test_node_specs_map_labels_taints_and_allocatable(kube, ctx):
+    kube.add_node(
+        "worker-1",
+        cpu="7500m",
+        memory="16Gi",
+        labels={"pool": "gpu", "kubernetes.io/hostname": "worker-1", "zone": "a"},
+        taints=[{"key": "gpu", "value": "true", "effect": "NoSchedule"}],
+    )
+    kube.add_node("worker-2", unschedulable=True)
+    n1, n2 = ctx.node_specs()
+    assert n1.id == "worker-1" and n1.pool == "gpu"
+    assert n1.labels["zone"] == "a"
+    assert n1.taints[0].key == "gpu" and n1.taints[0].effect == "NoSchedule"
+    # 7500m cpu = 7500 atoms; 16Gi memory
+    assert n1.total_resources.atoms[F.index_of("cpu")] == 7500
+    assert not n1.unschedulable and n2.unschedulable
+    assert n2.pool == "default"  # no pool label -> default
+
+
+def test_submit_builds_pinned_manifest(kube, ctx):
+    job = spec(
+        annotations={
+            IMAGE_ANNOTATION: "python:3.12",
+            COMMAND_ANNOTATION: json.dumps(["python", "-c", "print(1)"]),
+            "team": "x",
+        },
+        labels={"team": "x"},
+        tolerations=(Toleration(key="gpu", operator="Exists"),),
+        namespace="batch",
+    )
+    ctx.submit_pod("run-1", "j1", "q", "js", job, "worker-1")
+    pod = kube.pods[("batch", "armada-run-1")]
+    assert pod["metadata"]["labels"][RUN_LABEL] == "run-1"
+    assert pod["metadata"]["labels"]["team"] == "x"
+    s = pod["spec"]
+    assert s["nodeSelector"]["kubernetes.io/hostname"] == "worker-1"
+    assert s["tolerations"][0]["key"] == "gpu"
+    c = s["containers"][0]
+    assert c["image"] == "python:3.12"
+    assert c["command"] == ["python", "-c", "print(1)"]
+    assert c["resources"]["requests"]["cpu"] == "2"
+    assert c["resources"]["requests"]["memory"] == str(16 * 2**28)
+    # idempotent resubmit (409 swallowed)
+    ctx.submit_pod("run-1", "j1", "q", "js", job, "worker-1")
+
+
+def test_pod_states_and_phases(kube, ctx):
+    ctx.submit_pod("run-1", "j1", "q", "js", spec(), "w1")
+    (p,) = ctx.pod_states()
+    assert p.phase is PodPhase.PENDING and p.run_id == "run-1"
+    assert p.node_id == "w1" and p.queue == "q" and p.jobset == "js"
+    kube.set_phase("default", "armada-run-1", "Running")
+    assert ctx.get_pod("run-1").phase is PodPhase.RUNNING
+    kube.set_phase("default", "armada-run-1", "Failed", "oom")
+    (p,) = ctx.pod_states()
+    assert p.phase is PodPhase.FAILED and p.message == "oom"
+
+
+def test_delete_is_idempotent_and_label_recovering(kube, ctx):
+    ctx.submit_pod("run-1", "j1", "q", "js", spec(), "w1")
+    ctx.delete_pod("run-1")
+    assert kube.pods == {}
+    ctx.delete_pod("run-1")  # gone already: no error
+
+    # a pod created by a previous agent incarnation (not in the local map)
+    ctx.submit_pod("run-2", "j2", "q", "js", spec("j2"), "w1")
+    fresh = KubernetesClusterContext(kube.url, F)
+    fresh.delete_pod("run-2")
+    assert kube.pods == {}
+
+
+def test_pod_logs(kube, ctx):
+    ctx.submit_pod("run-1", "j1", "q", "js", spec(), "w1")
+    kube.logs[("default", "armada-run-1")] = "hello from pod\n"
+    assert ctx.pod_logs("run-1") == "hello from pod\n"
+
+
+def test_executor_service_runs_on_kubernetes_context(kube, tmp_path):
+    """The SAME executor agent logic drives the k8s adapter: lease -> pod
+    created; kubelet (the fake) runs it; report -> job succeeds."""
+    from tests.control_plane import ControlPlane
+    from armada_tpu.executor.service import ExecutorService
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+
+    cp = ControlPlane.build(tmp_path, executor_specs={})
+    factory = cp.config.resource_list_factory()
+    kube.add_node(
+        "kw-1", cpu="8", memory="32Gi", labels={"kubernetes.io/hostname": "kw-1"}
+    )
+    ctx = KubernetesClusterContext(kube.url, factory)
+    ex = ExecutorService(
+        "kex-1", "default", ctx, cp.executor_api, factory, clock=cp.clock
+    )
+    cp.server.create_queue(QueueRecord("q"))
+    (jid,) = cp.server.submit_jobs(
+        "q", "k8s", [JobSubmitItem(resources={"cpu": "2", "memory": "4Gi"})]
+    )
+    ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    ex.run_once()  # picks up the lease, creates the pod
+    (key,) = kube.pods
+    assert kube.pods[key]["spec"]["nodeSelector"]["kubernetes.io/hostname"] == "kw-1"
+
+    kube.set_phase(key[0], key[1], "Running")
+    ex.report_cycle()
+    cp.ingest()
+    cp.scheduler.cycle()
+    kube.set_phase(key[0], key[1], "Succeeded")
+    ex.report_cycle()
+    ex.cleanup()
+    cp.ingest()
+    res = cp.scheduler.cycle()
+    assert res.events_by_kind().get("job_succeeded") == 1
+    cp.close()
+
+
+def test_cli_agent_loop_drives_kubernetes(kube, tmp_path, capsys):
+    """`armadactl executor --kubernetes URL` end-to-end over gRPC."""
+    import threading
+    import time
+
+    from armada_tpu.cli.armadactl import main
+    from armada_tpu.cli.serve import run_fake_executor, start_control_plane
+
+    kube.add_node(
+        "kw-1", cpu="8", memory="32Gi", labels={"kubernetes.io/hostname": "kw-1"}
+    )
+    plane = start_control_plane(
+        str(tmp_path / "data"), cycle_interval_s=0.1, schedule_interval_s=0.2
+    )
+
+    def ctl(*argv):
+        return main(["--url", f"127.0.0.1:{plane.port}", *argv])
+
+    stop = threading.Event()
+    agent = threading.Thread(
+        target=run_fake_executor,
+        args=(f"127.0.0.1:{plane.port}",),
+        kwargs={
+            "executor_id": "kex",
+            "interval_s": 0.1,
+            "stop": stop,
+            "kubernetes_url": kube.url,
+        },
+        daemon=True,
+    )
+    agent.start()
+    try:
+        assert ctl("queue", "create", "q") == 0
+        sub = tmp_path / "job.yaml"
+        sub.write_text(
+            """
+queue: q
+jobSetId: k8s
+jobs:
+  - count: 1
+    resources: {cpu: "2", memory: "4Gi"}
+"""
+        )
+        assert ctl("submit", str(sub)) == 0
+        capsys.readouterr()
+
+        deadline = time.time() + 20
+        while time.time() < deadline and not kube.pods:
+            time.sleep(0.1)
+        assert kube.pods, "agent never created the pod"
+        ((ns, name),) = kube.pods
+        kube.set_phase(ns, name, "Succeeded")
+
+        deadline = time.time() + 20
+        succeeded = 0
+        while time.time() < deadline and not succeeded:
+            ctl("watch", "--queue", "q", "--job-set", "k8s", "--timeout", "0.5")
+            succeeded = capsys.readouterr().out.count("job_succeeded")
+        assert succeeded == 1
+    finally:
+        stop.set()
+        agent.join(timeout=10)
+        plane.stop()
+
+
+def test_executor_id_isolation_and_namespace_scoping(kube):
+    """Two executors on one cluster never adopt each other's pods; namespace
+    scoping keeps listings within granted RBAC."""
+    a = KubernetesClusterContext(kube.url, F, executor_id="ex-a")
+    b = KubernetesClusterContext(kube.url, F, executor_id="ex-b")
+    a.submit_pod("run-a", "ja", "q", "js", spec("ja"), "w1")
+    b.submit_pod("run-b", "jb", "q", "js", spec("jb"), "w1")
+    assert [p.run_id for p in a.pod_states()] == ["run-a"]
+    assert [p.run_id for p in b.pod_states()] == ["run-b"]
+    # b's delete of a's run is a no-op (label scan filtered by executor)
+    b.delete_pod("run-a")
+    assert len(kube.pods) == 2
+
+    scoped = KubernetesClusterContext(
+        kube.url, F, executor_id="ex-a", namespaces=("batch",)
+    )
+    scoped.submit_pod("run-c", "jc", "q", "js", spec("jc", namespace="batch"), "w1")
+    assert [p.run_id for p in scoped.pod_states()] == ["run-c"]
+    # cluster-scoped /api/v1/pods was never hit by the scoped context's listing
+    assert ("GET", "/api/v1/pods") not in [
+        r for r in kube.requests if r[1].endswith("/batch/pods")
+    ]
