@@ -427,10 +427,13 @@ def cmd_serve(args):
         metrics_port=args.metrics_port,
         health_port=args.health_port,
         profiling=args.profiling,
+        lookout_port=args.lookout_port,
     )
     print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
     if plane.health_server is not None:
         print(f"health on 127.0.0.1:{plane.health_server.port}/health")
+    if plane.lookout_web is not None:
+        print(f"lookout web UI on http://127.0.0.1:{plane.lookout_web.port}/")
     print(f"state in {args.data_dir}")
     try:
         plane.wait()
@@ -578,6 +581,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--profiling",
         action="store_true",
         help="expose /debug/pprof/* on the health port",
+    )
+    srv.add_argument(
+        "--lookout-port",
+        type=int,
+        help="host the lookout web UI on this port (0 = pick a free one)",
     )
     srv.set_defaults(fn=cmd_serve)
 

@@ -58,6 +58,7 @@ class ControlPlaneProcess:
     _lookoutdb: LookoutDb
     _metrics_server: object = None
     health_server: object = None
+    lookout_web: object = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -67,6 +68,8 @@ class ControlPlaneProcess:
         self._grpc_server.stop(1).wait()
         if self.health_server is not None:
             self.health_server.stop()
+        if self.lookout_web is not None:
+            self.lookout_web.stop()
         if self._metrics_server is not None:
             # prometheus_client >= 0.17 returns (server, thread)
             try:
@@ -95,10 +98,12 @@ def start_control_plane(
     metrics_port: Optional[int] = None,
     health_port: Optional[int] = None,
     profiling: bool = False,
+    lookout_port: Optional[int] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
-    common/profiling/http.go)."""
+    common/profiling/http.go).  lookout_port: host the lookout web UI
+    (internal/lookoutui equivalent) on this port."""
     os.makedirs(data_dir, exist_ok=True)
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
@@ -240,6 +245,12 @@ def start_control_plane(
             )
         startup.mark_complete()
 
+    lookout_web = None
+    if lookout_port is not None:
+        from armada_tpu.lookout.webui import LookoutWebUI
+
+        lookout_web = LookoutWebUI(LookoutQueries(lookoutdb), lookout_port)
+
     return ControlPlaneProcess(
         port=bound_port,
         scheduler=scheduler,
@@ -255,6 +266,7 @@ def start_control_plane(
         _lookoutdb=lookoutdb,
         _metrics_server=metrics_server,
         health_server=health_server,
+        lookout_web=lookout_web,
     )
 
 

@@ -1,0 +1,407 @@
+"""Lookout web UI: a single-page jobs dashboard over the lookout query stack.
+
+Plays the role of the reference's lookout UI (internal/lookoutui, React/TS ~18k
+LoC): a jobs table with filtering, grouping with per-state counts, job details
+with runs and errors -- served as one embedded HTML page + JSON endpoints on a
+stdlib HTTP server, backed by LookoutQueries (repository/getjobs.go,
+groupjobs.go semantics).
+
+Endpoints:
+  GET /                  the app
+  GET /api/jobs?...      filtered page of jobs + total count
+  GET /api/groups?by=X   grouped counts with per-state breakdown
+  GET /api/job/{id}      job details incl. runs
+  GET /api/overview      global state counts
+
+State colors are the validated categorical theme (dataviz skill reference
+palette; adjacency validated in both modes: CVD dE 9.1 light / 8.4 dark);
+identity is never color-alone -- every segment and chip carries the state name
+and count as text, and the table is the primary view.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from armada_tpu.lookout.db import JOB_STATES
+from armada_tpu.lookout.queries import JobFilter, JobOrder, LookoutQueries
+
+# Fixed state -> hue assignment in the theme's validated adjacency order
+# (the meter renders segments in exactly this order).
+STATE_ORDER = (
+    "RUNNING", "PREEMPTED", "LEASED", "QUEUED",
+    "PENDING", "SUCCEEDED", "CANCELLED", "FAILED",
+)
+STATE_COLORS_LIGHT = {
+    "RUNNING": "#2a78d6", "PREEMPTED": "#eb6834", "LEASED": "#1baf7a",
+    "QUEUED": "#eda100", "PENDING": "#e87ba4", "SUCCEEDED": "#008300",
+    "CANCELLED": "#4a3aa7", "FAILED": "#e34948",
+}
+STATE_COLORS_DARK = {
+    "RUNNING": "#3987e5", "PREEMPTED": "#d95926", "LEASED": "#199e70",
+    "QUEUED": "#c98500", "PENDING": "#d55181", "SUCCEEDED": "#008300",
+    "CANCELLED": "#9085e9", "FAILED": "#e66767",
+}
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>armada-tpu lookout</title>
+<style>
+:root {
+  color-scheme: light;
+  --surface: #fcfcfb; --surface-2: #f0efec; --border: #dcdbd6;
+  --text: #0b0b0b; --text-2: #52514e;
+__LIGHT_VARS__
+}
+@media (prefers-color-scheme: dark) {
+  :root:not([data-theme="light"]) {
+    color-scheme: dark;
+    --surface: #1a1a19; --surface-2: #262624; --border: #3a3a37;
+    --text: #ffffff; --text-2: #c3c2b7;
+__DARK_VARS__
+  }
+}
+:root[data-theme="dark"] {
+  color-scheme: dark;
+  --surface: #1a1a19; --surface-2: #262624; --border: #3a3a37;
+  --text: #ffffff; --text-2: #c3c2b7;
+__DARK_VARS__
+}
+* { box-sizing: border-box; }
+body { margin: 0; background: var(--surface); color: var(--text);
+       font: 13px/1.45 system-ui, sans-serif; }
+header { display: flex; align-items: center; gap: 12px; padding: 10px 16px;
+         border-bottom: 1px solid var(--border); }
+header h1 { font-size: 15px; margin: 0; font-weight: 600; }
+header .sub { color: var(--text-2); }
+main { padding: 12px 16px; max-width: 1280px; margin: 0 auto; }
+.filters { display: flex; flex-wrap: wrap; gap: 8px; margin-bottom: 12px; }
+.filters input, .filters select, .filters button, header button {
+  background: var(--surface); color: var(--text); border: 1px solid var(--border);
+  border-radius: 6px; padding: 5px 8px; font: inherit; }
+.filters button, header button { cursor: pointer; }
+.meter { display: flex; height: 14px; border-radius: 4px; overflow: hidden;
+         background: var(--surface-2); margin: 4px 0 6px; }
+.meter span { height: 100%; }
+.meter span + span { margin-left: 2px; }  /* 2px surface gap between fills */
+.chips { display: flex; flex-wrap: wrap; gap: 6px 14px; margin-bottom: 14px; }
+.chip { color: var(--text-2); white-space: nowrap; }
+.chip b { color: var(--text); font-weight: 600; }
+.dot { display: inline-block; width: 9px; height: 9px; border-radius: 50%;
+       margin-right: 5px; vertical-align: -1px; }
+table { border-collapse: collapse; width: 100%; }
+th, td { text-align: left; padding: 5px 10px; border-bottom: 1px solid var(--border); }
+th { color: var(--text-2); font-weight: 500; cursor: pointer; user-select: none;
+     white-space: nowrap; }
+tbody tr:hover { background: var(--surface-2); }
+tbody tr { cursor: pointer; }
+.num { text-align: right; font-variant-numeric: tabular-nums; }
+.mini { display: flex; height: 10px; border-radius: 3px; overflow: hidden;
+        background: var(--surface-2); min-width: 160px; }
+.mini span + span { margin-left: 2px; }
+#details { position: fixed; top: 0; right: 0; width: min(480px, 90vw);
+           height: 100vh; background: var(--surface); border-left: 1px solid var(--border);
+           padding: 16px; overflow: auto; display: none; box-shadow: -4px 0 24px #0003; }
+#details.open { display: block; }
+#details h2 { font-size: 14px; margin: 0 0 8px; word-break: break-all; }
+#details dl { display: grid; grid-template-columns: auto 1fr; gap: 2px 12px; }
+#details dt { color: var(--text-2); }
+#details pre { background: var(--surface-2); padding: 8px; border-radius: 6px;
+               white-space: pre-wrap; word-break: break-all; }
+.run { border: 1px solid var(--border); border-radius: 6px; padding: 8px;
+       margin: 6px 0; }
+.pager { display: flex; gap: 8px; align-items: center; margin-top: 10px;
+         color: var(--text-2); }
+.pager button { background: var(--surface); color: var(--text);
+  border: 1px solid var(--border); border-radius: 6px; padding: 4px 10px; cursor: pointer; }
+.empty { color: var(--text-2); padding: 24px; text-align: center; }
+</style></head>
+<body>
+<header>
+  <h1>armada-tpu lookout</h1><span class="sub" id="total"></span>
+  <span style="flex:1"></span>
+  <button id="theme" title="toggle light/dark">◐</button>
+</header>
+<main>
+  <div class="meter" id="overview" role="img" aria-label="job state distribution"></div>
+  <div class="chips" id="chips"></div>
+  <div class="filters">
+    <input id="f-queue" placeholder="queue contains…">
+    <input id="f-jobset" placeholder="jobset contains…">
+    <select id="f-state"><option value="">any state</option>__STATE_OPTIONS__</select>
+    <select id="f-group">
+      <option value="">no grouping</option>
+      <option value="queue">group by queue</option>
+      <option value="jobset">group by jobset</option>
+      <option value="state">group by state</option>
+    </select>
+    <button id="refresh">refresh</button>
+    <label class="chip"><input type="checkbox" id="auto" checked> auto (3s)</label>
+  </div>
+  <div id="content"></div>
+  <div class="pager" id="pager"></div>
+</main>
+<div id="details"></div>
+<script>
+const COLORS = __COLORS_JSON__;
+const ORDER = __ORDER_JSON__;
+const dark = () => document.documentElement.dataset.theme === "dark" ||
+  (!document.documentElement.dataset.theme &&
+   matchMedia("(prefers-color-scheme: dark)").matches);
+const color = (s) => COLORS[dark() ? "dark" : "light"][s] || "#999";
+let skip = 0, take = 50, orderField = "submitted", orderDir = "DESC";
+
+const $ = (id) => document.getElementById(id);
+const fmtT = (ns) => ns ? new Date(ns / 1e6).toLocaleString() : "—";
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+
+function filterQS() {
+  const p = new URLSearchParams();
+  if ($("f-queue").value) p.set("queue", $("f-queue").value);
+  if ($("f-jobset").value) p.set("jobset", $("f-jobset").value);
+  if ($("f-state").value) p.set("state", $("f-state").value);
+  return p;
+}
+async function j(url) { const r = await fetch(url); return r.json(); }
+
+function meterHTML(states, total) {
+  if (!total) return "";
+  return ORDER.filter((s) => states[s])
+    .map((s) => `<span style="flex:${states[s]};background:${color(s)}"
+      title="${s}: ${states[s]}"></span>`).join("");
+}
+function chipsHTML(states) {
+  return ORDER.filter((s) => states[s]).map((s) =>
+    `<span class="chip"><span class="dot" style="background:${color(s)}"></span>` +
+    `${s.toLowerCase()} <b>${states[s]}</b></span>`).join("") ||
+    '<span class="chip">no jobs yet</span>';
+}
+async function loadOverview() {
+  const d = await j("/api/overview");
+  const total = Object.values(d.states).reduce((a, b) => a + b, 0);
+  $("overview").innerHTML = meterHTML(d.states, total);
+  $("chips").innerHTML = chipsHTML(d.states);
+  $("total").textContent = total + " jobs";
+}
+function stateCell(s) {
+  return `<span class="dot" style="background:${color(s)}"></span>${s.toLowerCase()}`;
+}
+async function loadContent() {
+  const group = $("f-group").value;
+  if (group) {
+    const d = await j(`/api/groups?by=${group}&` + filterQS());
+    $("pager").innerHTML = "";
+    if (!d.groups.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; return; }
+    $("content").innerHTML = `<table><thead><tr><th>${esc(group)}</th>
+      <th class="num">jobs</th><th>states</th></tr></thead><tbody>` +
+      d.groups.map((g) => {
+        const total = g.count;
+        return `<tr data-group="${esc(g.group)}"><td>${esc(g.group)}</td>
+          <td class="num">${g.count}</td>
+          <td><div class="mini">${meterHTML(g.states, total)}</div></td></tr>`;
+      }).join("") + "</tbody></table>";
+    for (const tr of $("content").querySelectorAll("tr[data-group]")) {
+      tr.onclick = () => {
+        if (group === "state") $("f-state").value = tr.dataset.group;
+        else $(group === "queue" ? "f-queue" : "f-jobset").value = tr.dataset.group;
+        $("f-group").value = "";
+        refresh();
+      };
+    }
+    return;
+  }
+  const p = filterQS();
+  p.set("skip", skip); p.set("take", take);
+  p.set("order", orderField); p.set("dir", orderDir);
+  const d = await j("/api/jobs?" + p);
+  if (!d.jobs.length && d.total > 0 && skip > 0) {
+    // the filtered total shrank under our page cursor: snap back
+    skip = Math.max(0, (Math.ceil(d.total / take) - 1) * take);
+    return loadContent();
+  }
+  if (!d.jobs.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; $("pager").innerHTML = ""; return; }
+  const arrow = (f) => orderField === f ? (orderDir === "ASC" ? " ↑" : " ↓") : "";
+  $("content").innerHTML = `<table><thead><tr>
+      <th data-o="job_id">job${arrow("job_id")}</th>
+      <th data-o="queue">queue${arrow("queue")}</th>
+      <th data-o="jobset">jobset${arrow("jobset")}</th>
+      <th data-o="state">state${arrow("state")}</th>
+      <th class="num" data-o="priority">priority${arrow("priority")}</th>
+      <th data-o="submitted">submitted${arrow("submitted")}</th>
+      <th>node</th></tr></thead><tbody>` +
+    d.jobs.map((r) => `<tr data-id="${esc(r.job_id)}">
+      <td>${esc(r.job_id)}</td><td>${esc(r.queue)}</td><td>${esc(r.jobset)}</td>
+      <td>${stateCell(r.state)}</td><td class="num">${r.priority}</td>
+      <td>${fmtT(r.submitted_ns)}</td><td>${esc(r.node || "—")}</td></tr>`).join("") +
+    "</tbody></table>";
+  for (const th of $("content").querySelectorAll("th[data-o]")) {
+    th.onclick = () => {
+      if (orderField === th.dataset.o) orderDir = orderDir === "ASC" ? "DESC" : "ASC";
+      else { orderField = th.dataset.o; orderDir = "ASC"; }
+      refresh();
+    };
+  }
+  for (const tr of $("content").querySelectorAll("tr[data-id]"))
+    tr.onclick = () => openDetails(tr.dataset.id);
+  const page = Math.floor(skip / take) + 1;
+  const pages = Math.max(1, Math.ceil(d.total / take));
+  $("pager").innerHTML = `<button id="prev" ${skip ? "" : "disabled"}>‹ prev</button>
+    <span>page ${page} / ${pages} (${d.total} jobs)</span>
+    <button id="next" ${skip + take < d.total ? "" : "disabled"}>next ›</button>`;
+  if ($("prev")) $("prev").onclick = () => { skip = Math.max(0, skip - take); refresh(); };
+  if ($("next")) $("next").onclick = () => { skip += take; refresh(); };
+}
+async function openDetails(id) {
+  const d = await j("/api/job/" + encodeURIComponent(id));
+  if (!d) return;
+  const runs = (d.runs || []).map((r) => `<div class="run">
+    <div><b>run</b> ${esc(r.run_id)} — ${stateCell(r.state)}</div>
+    <dl><dt>node</dt><dd>${esc(r.node || "—")}</dd>
+    <dt>leased</dt><dd>${fmtT(r.leased_ns)}</dd>
+    <dt>started</dt><dd>${fmtT(r.started_ns)}</dd>
+    <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd></dl>
+    ${r.error ? `<pre>${esc(r.error)}</pre>` : ""}</div>`).join("");
+  $("details").innerHTML = `<h2>${esc(d.job_id)}</h2>
+    <dl><dt>state</dt><dd>${stateCell(d.state)}</dd>
+    <dt>queue</dt><dd>${esc(d.queue)}</dd>
+    <dt>jobset</dt><dd>${esc(d.jobset)}</dd>
+    <dt>priority</dt><dd>${d.priority}</dd>
+    <dt>submitted</dt><dd>${fmtT(d.submitted_ns)}</dd>
+    <dt>annotations</dt><dd><pre>${esc(JSON.stringify(d.annotations || {}, null, 1))}</pre></dd></dl>
+    <h2>runs</h2>${runs || '<div class="empty">no runs</div>'}
+    <button onclick="document.getElementById('details').classList.remove('open')">close</button>`;
+  $("details").classList.add("open");
+}
+function refresh() { loadOverview(); loadContent(); }
+$("refresh").onclick = refresh;
+for (const id of ["f-queue", "f-jobset", "f-state", "f-group"])
+  $(id).addEventListener("change", () => { skip = 0; refresh(); });
+$("theme").onclick = () => {
+  const r = document.documentElement;
+  r.dataset.theme = dark() ? "light" : "dark";
+  refresh();
+};
+setInterval(() => { if ($("auto").checked && !$("details").classList.contains("open")) refresh(); }, 3000);
+refresh();
+</script>
+</body></html>
+"""
+
+
+def _render_page() -> str:
+    light_vars = "\n".join(
+        f"  --state-{s.lower()}: {c};" for s, c in STATE_COLORS_LIGHT.items()
+    )
+    dark_vars = "\n".join(
+        f"    --state-{s.lower()}: {c};" for s, c in STATE_COLORS_DARK.items()
+    )
+    options = "".join(f'<option value="{s}">{s.lower()}</option>' for s in JOB_STATES)
+    return (
+        _PAGE.replace("__LIGHT_VARS__", light_vars)
+        .replace("__DARK_VARS__", dark_vars)
+        .replace("__STATE_OPTIONS__", options)
+        .replace(
+            "__COLORS_JSON__",
+            json.dumps({"light": STATE_COLORS_LIGHT, "dark": STATE_COLORS_DARK}),
+        )
+        .replace("__ORDER_JSON__", json.dumps(list(STATE_ORDER)))
+    )
+
+
+def _filters_from_query(qs: dict) -> list[JobFilter]:
+    filters = []
+    if qs.get("queue"):
+        filters.append(JobFilter("queue", qs["queue"][0], "contains"))
+    if qs.get("jobset"):
+        filters.append(JobFilter("jobset", qs["jobset"][0], "contains"))
+    if qs.get("state"):
+        filters.append(JobFilter("state", qs["state"][0]))
+    return filters
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "armada-tpu-lookout/1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
+        q = srv.queries
+        parsed = urlparse(self.path)
+        path = parsed.path
+        qs = parse_qs(parsed.query)
+        try:
+            if path == "/":
+                body = srv.page.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/api/jobs":
+                filters = _filters_from_query(qs)
+                order = JobOrder(
+                    field=qs.get("order", ["submitted"])[0],
+                    direction=qs.get("dir", ["DESC"])[0],
+                )
+                skip = max(0, int(qs.get("skip", ["0"])[0]))
+                take = max(1, min(int(qs.get("take", ["50"])[0]), 500))
+                self._json(
+                    {
+                        "jobs": q.get_jobs(filters, order, skip=skip, take=take),
+                        "total": q.count_jobs(filters),
+                    }
+                )
+            elif path == "/api/groups":
+                by = qs.get("by", ["queue"])[0]
+                self._json(
+                    {"groups": q.group_jobs(by, _filters_from_query(qs))}
+                )
+            elif path == "/api/overview":
+                groups = q.group_jobs("state", ())
+                states = {g["group"]: g["count"] for g in groups}
+                self._json({"states": states})
+            elif path.startswith("/api/job/"):
+                job_id = path[len("/api/job/") :]
+                details = q.get_job_details(job_id)
+                if details is None:
+                    self._json({"error": f"no job {job_id}"}, 404)
+                else:
+                    self._json(details)
+            else:
+                self._json({"error": "not found"}, 404)
+        except (ValueError, KeyError) as exc:
+            self._json({"error": str(exc)}, 400)
+
+
+class LookoutWebUI:
+    """Serves the dashboard + JSON API on `port` (0 = pick a free one)."""
+
+    def __init__(self, queries: LookoutQueries, port: int = 0, host: str = "127.0.0.1"):
+        self.queries = queries
+        self.page = _render_page()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()
