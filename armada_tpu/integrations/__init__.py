@@ -1,0 +1,1 @@
+"""Third-party integrations (reference: third_party/)."""

@@ -1,0 +1,177 @@
+"""Airflow operator for armada-tpu.
+
+Equivalent of the reference's airflow integration (third_party/airflow/
+armada/operators/armada.py ArmadaOperator): an Airflow task that submits one
+job, polls its jobset events until the job reaches a terminal state, raises
+on failure/cancellation/preemption, and cancels the job when the Airflow task
+is killed (on_kill, armada.py:313).
+
+Airflow itself is an optional dependency: when it is not installed the
+operator still imports and `execute(context=None)` works standalone, so the
+submit-and-wait flow is testable (and usable as a plain blocking helper)
+without an Airflow deployment.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Mapping, Optional
+
+try:  # pragma: no cover - exercised only under a real Airflow install
+    from airflow.exceptions import AirflowException
+    from airflow.models import BaseOperator
+except Exception:  # Airflow absent: minimal stand-ins with the same contract
+
+    class AirflowException(RuntimeError):
+        pass
+
+    class BaseOperator:  # noqa: D401 - duck-typed stand-in
+        """Stand-in exposing the attributes ArmadaOperator relies on."""
+
+        def __init__(self, task_id: str = "", **kwargs):
+            self.task_id = task_id
+
+TERMINAL_STATES = ("succeeded", "failed", "cancelled", "preempted")
+_FAILURE_EVENTS = {
+    "job_errors": "failed",
+    "cancelled_job": "cancelled",
+}
+
+
+class ArmadaOperator(BaseOperator):
+    """Submit one job and wait for it to finish.
+
+    :param armada_url: gRPC address of the control plane ("host:port").
+    :param queue: target queue (must exist).
+    :param job: the job shape -- a mapping accepted by JobSubmitItem
+        (resources, priority, priorityClass, annotations, ...).
+    :param jobset: jobset id; defaults to the Airflow task id.
+    :param poll_interval_s: seconds between event polls (armada.py:117).
+    :param timeout_s: overall deadline; 0 = wait forever.
+    """
+
+    template_fields = ("queue", "jobset")
+
+    def __init__(
+        self,
+        *,
+        armada_url: str,
+        queue: str,
+        job: Mapping,
+        jobset: str = "",
+        poll_interval_s: float = 5.0,
+        timeout_s: float = 0.0,
+        task_id: str = "armada-job",
+        **kwargs,
+    ):
+        super().__init__(task_id=task_id, **kwargs)
+        self.armada_url = armada_url
+        self.queue = queue
+        self.job = dict(job)
+        self.jobset = jobset or task_id
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+        self.job_id: Optional[str] = None
+        self._client = None
+
+    # --- client plumbing ----------------------------------------------------
+
+    def _get_client(self):
+        if self._client is None:
+            from armada_tpu.rpc.client import ArmadaClient
+
+            self._client = ArmadaClient(self.armada_url)
+        return self._client
+
+    def _close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    # --- the task -----------------------------------------------------------
+
+    def execute(self, context=None) -> str:
+        """Submit, then block until terminal; returns the job id."""
+        from armada_tpu.server import JobSubmitItem
+
+        client = self._get_client()
+        try:
+            item = JobSubmitItem(**_snake_item(self.job))
+            (self.job_id,) = client.submit_jobs(self.queue, self.jobset, [item])
+            state = self._poll_for_termination(client)
+            if state != "succeeded":
+                raise AirflowException(
+                    f"armada job {self.job_id} ended {state}"
+                )
+            return self.job_id
+        finally:
+            self._close()
+
+    def _poll_for_termination(self, client) -> str:
+        deadline = time.monotonic() + self.timeout_s if self.timeout_s else None
+        from_idx = 0
+        while True:
+            state, from_idx = self._scan_events(client, from_idx)
+            if state in TERMINAL_STATES:
+                return state
+            if deadline is not None and time.monotonic() > deadline:
+                # Airflow only calls on_kill on external termination, not when
+                # execute raises -- cancel here or the job leaks on-cluster.
+                try:
+                    client.cancel_jobs(
+                        self.queue,
+                        self.jobset,
+                        [self.job_id],
+                        reason=f"operator timeout after {self.timeout_s}s",
+                    )
+                except Exception:
+                    pass  # best effort; the timeout error is the headline
+                raise AirflowException(
+                    f"armada job {self.job_id} timed out after {self.timeout_s}s"
+                    " (cancellation requested)"
+                )
+            time.sleep(self.poll_interval_s)
+
+    def _scan_events(self, client, from_idx: int):
+        """One pass over new jobset events; returns (state | None, next idx)."""
+        for idx, seq in client.get_jobset_events(
+            self.queue, self.jobset, from_idx=from_idx
+        ):
+            from_idx = idx + 1
+            for ev in seq.events:
+                kind = ev.WhichOneof("event")
+                ev_job_id = getattr(getattr(ev, kind), "job_id", "")
+                if ev_job_id != self.job_id:
+                    continue
+                if kind == "job_succeeded":
+                    return "succeeded", from_idx
+                if kind == "job_run_preempted":
+                    return "preempted", from_idx
+                if kind in _FAILURE_EVENTS:
+                    return _FAILURE_EVENTS[kind], from_idx
+        return None, from_idx
+
+    def on_kill(self) -> None:
+        """Airflow task killed: cancel the armada job (armada.py:313)."""
+        if self.job_id is None:
+            return
+        try:
+            client = self._get_client()
+            client.cancel_jobs(
+                self.queue, self.jobset, [self.job_id], reason="airflow task killed"
+            )
+        finally:
+            self._close()
+
+
+def _snake_item(job: Mapping) -> dict:
+    """Accept both snake_case and the reference's camelCase job keys."""
+    aliases = {
+        "priorityClass": "priority_class",
+        "priorityClassName": "priority_class",
+        "nodeSelector": "node_selector",
+        "gangId": "gang_id",
+        "gangCardinality": "gang_cardinality",
+        "clientId": "client_id",
+    }
+    return {aliases.get(k, k): v for k, v in job.items()}

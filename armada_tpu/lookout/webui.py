@@ -153,6 +153,7 @@ const dark = () => document.documentElement.dataset.theme === "dark" ||
    matchMedia("(prefers-color-scheme: dark)").matches);
 const color = (s) => COLORS[dark() ? "dark" : "light"][s] || "#999";
 let skip = 0, take = 50, orderField = "submitted", orderDir = "DESC";
+let contentSeq = 0, overviewSeq = 0;  // drop stale responses
 
 const $ = (id) => document.getElementById(id);
 const fmtT = (ns) => ns ? new Date(ns / 1e6).toLocaleString() : "—";
@@ -181,7 +182,9 @@ function chipsHTML(states) {
     '<span class="chip">no jobs yet</span>';
 }
 async function loadOverview() {
+  const my = ++overviewSeq;
   const d = await j("/api/overview");
+  if (my !== overviewSeq) return;  // a newer request superseded this one
   const total = Object.values(d.states).reduce((a, b) => a + b, 0);
   $("overview").innerHTML = meterHTML(d.states, total);
   $("chips").innerHTML = chipsHTML(d.states);
@@ -191,11 +194,16 @@ function stateCell(s) {
   return `<span class="dot" style="background:${color(s)}"></span>${s.toLowerCase()}`;
 }
 async function loadContent() {
+  const my = ++contentSeq;
   const group = $("f-group").value;
   if (group) {
-    const d = await j(`/api/groups?by=${group}&` + filterQS());
+    const d = await j(`/api/groups?by=${group}&take=500&` + filterQS());
+    if (my !== contentSeq) return;
     $("pager").innerHTML = "";
     if (!d.groups.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; return; }
+    const note = d.truncated
+      ? `<div class="empty">showing the ${d.groups.length} largest groups — refine the filters to see the rest</div>`
+      : "";
     $("content").innerHTML = `<table><thead><tr><th>${esc(group)}</th>
       <th class="num">jobs</th><th>states</th></tr></thead><tbody>` +
       d.groups.map((g) => {
@@ -203,7 +211,7 @@ async function loadContent() {
         return `<tr data-group="${esc(g.group)}"><td>${esc(g.group)}</td>
           <td class="num">${g.count}</td>
           <td><div class="mini">${meterHTML(g.states, total)}</div></td></tr>`;
-      }).join("") + "</tbody></table>";
+      }).join("") + "</tbody></table>" + note;
     for (const tr of $("content").querySelectorAll("tr[data-group]")) {
       tr.onclick = () => {
         if (group === "state") $("f-state").value = tr.dataset.group;
@@ -218,6 +226,7 @@ async function loadContent() {
   p.set("skip", skip); p.set("take", take);
   p.set("order", orderField); p.set("dir", orderDir);
   const d = await j("/api/jobs?" + p);
+  if (my !== contentSeq) return;
   if (!d.jobs.length && d.total > 0 && skip > 0) {
     // the filtered total shrank under our page cursor: snap back
     skip = Math.max(0, (Math.ceil(d.total / take) - 1) * take);
@@ -367,9 +376,11 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             elif path == "/api/groups":
                 by = qs.get("by", ["queue"])[0]
-                self._json(
-                    {"groups": q.group_jobs(by, _filters_from_query(qs))}
-                )
+                take = max(1, min(int(qs.get("take", ["100"])[0]), 500))
+                # one extra row detects truncation
+                groups = q.group_jobs(by, _filters_from_query(qs), take=take + 1)
+                truncated = len(groups) > take
+                self._json({"groups": groups[:take], "truncated": truncated})
             elif path == "/api/overview":
                 groups = q.group_jobs("state", ())
                 states = {g["group"]: g["count"] for g in groups}

@@ -1,0 +1,132 @@
+"""ArmadaOperator (third_party/airflow equivalent) against a live control
+plane over gRPC, without Airflow installed (the gated-import path)."""
+
+import threading
+
+import pytest
+
+from armada_tpu.cli.serve import run_fake_executor, start_control_plane
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.integrations.airflow import AirflowException, ArmadaOperator
+from armada_tpu.rpc.client import ArmadaClient
+from armada_tpu.server.queues import QueueRecord
+
+
+@pytest.fixture
+def plane(tmp_path):
+    p = start_control_plane(
+        str(tmp_path / "data"),
+        config=SchedulingConfig(shape_bucket=32),
+        cycle_interval_s=0.05,
+        schedule_interval_s=0.1,
+    )
+    client = ArmadaClient(f"127.0.0.1:{p.port}")
+    client.create_queue(QueueRecord("af"))
+    client.close()
+    yield p
+    p.stop()
+
+
+def agent(plane, runtime_s=0.2):
+    stop = threading.Event()
+    t = threading.Thread(
+        target=run_fake_executor,
+        args=(f"127.0.0.1:{plane.port}",),
+        kwargs={
+            "interval_s": 0.05,
+            "stop": stop,
+            "default_runtime_s": runtime_s,
+            "config": SchedulingConfig(shape_bucket=32),
+        },
+        daemon=True,
+    )
+    t.start()
+    return stop, t
+
+
+def test_operator_runs_job_to_success(plane):
+    stop, t = agent(plane)
+    try:
+        op = ArmadaOperator(
+            task_id="sim",
+            armada_url=f"127.0.0.1:{plane.port}",
+            queue="af",
+            job={"resources": {"cpu": "2", "memory": "1"}},
+            poll_interval_s=0.2,
+            timeout_s=30,
+        )
+        job_id = op.execute()
+        assert job_id and op.jobset == "sim"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_operator_raises_on_unschedulable_failure(plane):
+    stop, t = agent(plane)
+    try:
+        op = ArmadaOperator(
+            task_id="toolarge",
+            armada_url=f"127.0.0.1:{plane.port}",
+            queue="af",
+            # larger than any fake node: the submit check fails it terminally
+            job={"resources": {"cpu": "9999", "memory": "1"}},
+            poll_interval_s=0.2,
+            timeout_s=30,
+        )
+        with pytest.raises(AirflowException, match="failed"):
+            op.execute()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_on_kill_cancels_the_job(plane):
+    # No executor: the job stays queued; on_kill cancels it.
+    op = ArmadaOperator(
+        task_id="killme",
+        armada_url=f"127.0.0.1:{plane.port}",
+        queue="af",
+        job={"resources": {"cpu": "1", "memory": "1"}, "priorityClass": ""},
+        poll_interval_s=0.1,
+        timeout_s=2,
+    )
+    with pytest.raises(AirflowException, match="timed out"):
+        op.execute()
+    assert op.job_id is not None
+    op.on_kill()
+    # the cancellation lands as a cancelled_job event
+    client = ArmadaClient(f"127.0.0.1:{plane.port}")
+    try:
+        import time
+
+        deadline = time.time() + 10
+        cancelled = False
+        while time.time() < deadline and not cancelled:
+            for _, seq in client.get_jobset_events("af", "killme"):
+                for ev in seq.events:
+                    if ev.WhichOneof("event") == "cancelled_job":
+                        cancelled = True
+        assert cancelled
+    finally:
+        client.close()
+
+
+def test_camel_case_job_keys_accepted():
+    op = ArmadaOperator(
+        task_id="x",
+        armada_url="localhost:1",
+        queue="q",
+        job={
+            "resources": {"cpu": "1"},
+            "priorityClassName": "armada-default",
+            "nodeSelector": {"zone": "a"},
+            "gangCardinality": 2,
+        },
+    )
+    from armada_tpu.integrations.airflow import _snake_item
+
+    item = _snake_item(op.job)
+    assert item["priority_class"] == "armada-default"
+    assert item["node_selector"] == {"zone": "a"}
+    assert item["gang_cardinality"] == 2
