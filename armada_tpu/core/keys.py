@@ -51,6 +51,10 @@ class SchedulingKey:
     # affinity into the key via the pod requirements, so a retried job never
     # shares an unfeasible-key class with a clean one.
     banned_nodes: tuple[str, ...] = ()
+    # (uniformity label, chosen domain value) for gangs constrained to one
+    # node domain (gang_scheduler.go NodeUniformity): a domain-restricted
+    # gang must never retire the unrestricted jobs' key class.
+    uniformity: tuple[str, str] = ("", "")
 
 
 class NodeTypeIndex:
@@ -89,6 +93,7 @@ class SchedulingKeyIndex:
         job: JobSpec,
         node_id_label: str = "kubernetes.io/hostname",
         banned_nodes: Sequence[str] = (),
+        uniformity: tuple = ("", ""),
     ) -> int:
         # The node-id pinning label is excluded: pinning is handled positionally via
         # the pinned-node tensor, the way the reference injects node-id selectors
@@ -103,6 +108,7 @@ class SchedulingKeyIndex:
             priority_class=job.priority_class,
             priority=job.priority,
             banned_nodes=tuple(sorted(banned_nodes)),
+            uniformity=tuple(uniformity),
         )
         kid = self._ids.get(key)
         if kid is None:

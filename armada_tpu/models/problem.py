@@ -128,6 +128,9 @@ class HostContext:
     queue_names: list  # index -> queue name
     node_ids: list  # index -> node id
     gang_members: list  # gang index -> list of member job ids ([] for evictee slots)
+    # gang index -> shared tag for sub-gangs split from one declared gang
+    # ("" otherwise); drives the cross-class atomicity unwind in decode_result.
+    gang_group: list
     run_job_ids: list  # run index -> job id
     num_real_nodes: int
     num_real_queues: int
@@ -178,6 +181,119 @@ def queue_ordered_gang_index(
         q_len[:] = counts
         q_start[1:] = np.cumsum(counts)[:-1]
     return gq_gang, q_start, q_len
+
+
+class _GangFitContext:
+    """Per-round vectorized helpers for host-side gang feasibility: per-node
+    member capacity (one numpy op over the [N,R] totals), static-fit masks
+    memoized by (selector, tolerations) signature, and per-label domain
+    index arrays built once however many gangs share the label."""
+
+    def __init__(self, pool_nodes, node_total, node_index, factory):
+        self.pool_nodes = pool_nodes
+        self.node_index = node_index
+        self.num_real = len(pool_nodes)
+        self.totals = node_total[: self.num_real].astype(np.float64)  # [n, R]
+        self.ok = np.array(
+            [not n.unschedulable for n in pool_nodes], bool
+        ) if pool_nodes else np.zeros((0,), bool)
+        self.factory = factory
+        self._static: dict = {}
+        self._domains: dict = {}
+
+    def capacity(self, req_units: np.ndarray, cardinality: int) -> np.ndarray:
+        """i64[n]: members of `req_units` each node holds, capped at card."""
+        if not self.num_real:
+            return np.zeros((0,), np.int64)
+        req = np.asarray(req_units, np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.floor(
+                np.where(
+                    req[None, :] > 0,
+                    self.totals / np.maximum(req[None, :], 1e-9),
+                    np.inf,
+                )
+            ).min(axis=1)
+        return np.minimum(np.where(np.isfinite(per), per, cardinality), cardinality).astype(np.int64)
+
+    def static_fit(self, job: JobSpec, node_id_label: str) -> np.ndarray:
+        """bool[n]: taints tolerated and selector satisfied, memoized by the
+        job's static signature (nodematching.go StaticJobRequirementsMet)."""
+        from armada_tpu.core.types import selector_matches, taints_tolerated
+
+        sel = tuple(
+            sorted((k, v) for k, v in job.node_selector.items() if k != node_id_label)
+        )
+        sig = (sel, tuple(job.tolerations))
+        cached = self._static.get(sig)
+        if cached is None:
+            seld = dict(sel)
+            cached = np.array(
+                [
+                    taints_tolerated(n.taints, job.tolerations)
+                    and selector_matches(seld, n.labels)
+                    for n in self.pool_nodes
+                ],
+                bool,
+            ) if self.pool_nodes else np.zeros((0,), bool)
+            self._static[sig] = cached
+        return cached
+
+    def domains(self, label: str) -> dict:
+        """{value: i64 node-index array} for nodes carrying `label`."""
+        cached = self._domains.get(label)
+        if cached is None:
+            by_value: dict[str, list] = {}
+            for i, n in enumerate(self.pool_nodes):
+                v = n.labels.get(label)
+                if v is not None:
+                    by_value.setdefault(v, []).append(i)
+            cached = {
+                v: np.asarray(idx, np.int64) for v, idx in sorted(by_value.items())
+            }
+            self._domains[label] = cached
+        return cached
+
+
+def _uniform_domain_ban(
+    fit: _GangFitContext,
+    label: str,
+    lead: JobSpec,
+    cardinality: int,
+    banned_node_ids,
+    node_id_label: str,
+) -> tuple[set, str]:
+    """(banned node indices, chosen value) restricting a uniformity gang to
+    its best label-value domain (gang_scheduler.go tries domains; here the
+    highest-usable-capacity domain is chosen per round).  Capacity counts
+    only schedulable, statically-fitting, non-retry-banned nodes, so a
+    domain poisoned by bans or selector misses never wins over a viable
+    one.  Nodes lacking the label are always excluded."""
+    req = (
+        fit.factory.ceil_units(lead.resources.atoms).astype(np.float64)
+        if lead.resources is not None
+        else np.zeros((fit.factory.num_resources,), np.float64)
+    )
+    cap = fit.capacity(req, cardinality)
+    usable = fit.ok & fit.static_fit(lead, node_id_label)
+    if banned_node_ids:
+        for nid in banned_node_ids:
+            ni = fit.node_index.get(nid)
+            if ni is not None and ni < usable.shape[0]:
+                usable = usable.copy()
+                usable[ni] = False
+    best_value, best_cap = "", -1
+    for v, idx in fit.domains(label).items():
+        c = int(cap[idx][usable[idx]].sum())
+        if c > best_cap:
+            best_value, best_cap = v, c
+        if best_cap >= cardinality:
+            break
+    allowed = set(
+        int(i) for i in fit.domains(label).get(best_value, np.zeros(0, np.int64))
+    )
+    banned = set(range(fit.num_real)) - allowed
+    return banned, best_value
 
 
 def _job_sort_key(pc_priority: int, job: JobSpec):
@@ -262,14 +378,17 @@ def build_problem(
     kidx = SchedulingKeyIndex()
     bans_of = banned_nodes or {}
 
-    def _key_of(j: JobSpec, gang_bans=None) -> int:
+    def _key_of(j: JobSpec, gang_bans=None, uniformity=("", "")) -> int:
         # Bans join the key (podutils.go folds affinity into SchedulingKey), so a
         # retried job's placement failure never retires the clean jobs' key class.
         # Gang members share their gang's UNION ban set: per-member bans would
         # give members distinct keys and shatter the gang into singleton
-        # sub-gangs, losing all-or-nothing atomicity.
+        # sub-gangs, losing all-or-nothing atomicity.  A uniformity gang's
+        # chosen domain joins the key the same way.
         bans = gang_bans if gang_bans is not None else bans_of.get(j.id, ())
-        return kidx.key_of(j, config.node_id_label, banned_nodes=bans)
+        return kidx.key_of(
+            j, config.node_id_label, banned_nodes=bans, uniformity=uniformity
+        )
 
     # --- running jobs + evictee gang slots --------------------------------------
     run_list = [r for r in running if r.node_id in node_index]
@@ -287,8 +406,10 @@ def build_problem(
     class _Gang:
         __slots__ = (
             "jobs", "queue", "key", "level", "pc", "req", "card", "order",
-            "run", "price", "spot_price",
+            "run", "price", "spot_price", "group", "uban", "dead",
         )
+
+    fitctx = _GangFitContext(pool_nodes, node_total, node_index, factory)
 
     gangs: list[_Gang] = []
     per_queue_jobs: dict[int, list] = {qi: [] for qi in range(len(sorted_queues))}
@@ -362,6 +483,9 @@ def build_problem(
             g.run = ri
             g.price = float(price_of(run_list[ri].job))
             g.spot_price = g.price
+            g.group = ""
+            g.uban = None
+            g.dead = False
             run_gang[ri] = len(gangs) - 1
             gang_members_out.append([])
 
@@ -381,25 +505,64 @@ def build_problem(
                 return (-price_of(job), job.submit_time, job.id)
             return _job_sort_key(lead_pc_priority, job)
 
-        units: list[tuple[tuple, list, int]] = []
+        units: list[tuple[tuple, list, int, str, object, bool]] = []
         for job in singles:
             pc = config.priority_class(job.priority_class)
-            units.append((unit_key(pc.priority, job), [job], _key_of(job)))
+            units.append(
+                (unit_key(pc.priority, job), [job], _key_of(job), "", None, False)
+            )
         for gang_id, members in by_gang.items():
             gang_bans = sorted(
                 set().union(*(bans_of.get(m.id, ()) for m in members))
             ) if bans_of else ()
-            keys = {_key_of(m, gang_bans) for m in members}
+            # Node-uniformity (gang_scheduler.go NodeUniformity): restrict the
+            # whole gang to the single best label-value domain, chosen by
+            # usable static capacity; encoded as extra ban rows, so the
+            # kernel needs no new machinery.  Re-chosen every round.
+            label = members[0].gang_node_uniformity_label
+            uniformity = ("", "")
+            uban: Optional[set] = None
+            if label:
+                card_total = max(len(members), members[0].gang_cardinality or 1)
+                uban, chosen = _uniform_domain_ban(
+                    fitctx, label, members[0], card_total, gang_bans,
+                    config.node_id_label,
+                )
+                uniformity = (label, chosen)
+            keys = {_key_of(m, gang_bans, uniformity) for m in members}
             if len(keys) > 1:
-                # Heterogeneous gangs are split per key class; each sub-gang stays
-                # all-or-nothing but cross-class atomicity is not yet enforced.
-                # (Gap vs gang_scheduler.go; tracked for a later round.)
+                # Heterogeneous gangs split per key class; the hopeless check
+                # below + the decode unwind keep them atomic across classes.
                 by_key: dict[int, list] = {}
                 for m in members:
-                    by_key.setdefault(_key_of(m, gang_bans), []).append(m)
+                    by_key.setdefault(_key_of(m, gang_bans, uniformity), []).append(m)
                 groups = list(by_key.items())
             else:
                 groups = [(next(iter(keys)), members)]
+            group_tag = f"{qi}:{gang_id}" if len(groups) > 1 else ""
+            # If ANY sub-gang is statically hopeless (no usable node fits its
+            # class at all), the whole declared gang can never fully place:
+            # kill every sub-gang up front so no sibling placement has to be
+            # unwound after the fact (and no eviction is spent on it).
+            dead = False
+            if len(groups) > 1:
+                for _, grp in groups:
+                    glead = grp[0]
+                    usable = fitctx.ok & fitctx.static_fit(
+                        glead, config.node_id_label
+                    )
+                    if uban:
+                        usable = usable.copy()
+                        usable[np.asarray(sorted(uban), np.int64)] = False
+                    req_units = (
+                        fitctx.factory.ceil_units(glead.resources.atoms).astype(np.float64)
+                        if glead.resources is not None
+                        else np.zeros((R,), np.float64)
+                    )
+                    cap = fitctx.capacity(req_units, len(grp))
+                    if int(cap[usable].sum()) < len(grp):
+                        dead = True
+                        break
             for grp_key, grp in groups:
                 lead = min(
                     grp,
@@ -408,10 +571,22 @@ def build_problem(
                     ),
                 )
                 pc = config.priority_class(lead.priority_class)
-                units.append((unit_key(pc.priority, lead), grp, grp_key))
+                units.append(
+                    (unit_key(pc.priority, lead), grp, grp_key, group_tag, uban, dead)
+                )
         units.sort(key=lambda u: u[0])
+        kept = units[: config.max_queue_lookback]
+        if len(units) > len(kept):
+            # The lookback cap must keep or drop a split gang's sub-gangs
+            # ATOMICALLY: a sibling truncated out of the problem would be
+            # invisible to the decode unwind and a half-gang could lease.
+            kept_tags = {u[3] for u in kept if u[3]}
+            cut_tags = {u[3] for u in units[len(kept):] if u[3]}
+            partial = kept_tags & cut_tags
+            if partial:
+                kept = [u for u in kept if u[3] not in partial]
         base = len(evictee_by_queue[qi])
-        for order, (_, members, key) in enumerate(units[: config.max_queue_lookback]):
+        for order, (_, members, key, group_tag, uban, dead) in enumerate(kept):
             lead = members[0]
             pc = config.priority_class(lead.priority_class)
             g = _new_gang()
@@ -426,6 +601,9 @@ def build_problem(
             g.run = -1
             g.price = float(price_of(lead))
             g.spot_price = min(float(price_of(m)) for m in members)
+            g.group = group_tag
+            g.uban = uban
+            g.dead = dead
             gang_members_out.append(g.jobs)
 
     G = _pad(len(gangs), bucket)
@@ -449,7 +627,7 @@ def build_problem(
         g_pc[i] = g.pc
         g_order[i] = g.order
         g_run[i] = g.run
-        g_valid[i] = True
+        g_valid[i] = not g.dead
         g_price[i] = g.price
         g_spot_price[i] = g.spot_price
 
@@ -496,34 +674,44 @@ def build_problem(
                 ri = factory.index_of(name)
                 pc_queue_cap[ci, ri] = frac * total_pool[ri]
 
-    # --- retry anti-affinity rows ------------------------------------------------
+    # --- ban rows: retry anti-affinity + uniformity-domain restrictions --------
     # Row 0 is the all-clear; each gang with bans gets its own row.  Shapes are
     # padded to small buckets so jit recompiles only when the banned-gang count
     # crosses a bucket boundary.
     g_ban_row = np.zeros((G,), np.int32)
     ban_rows: list[np.ndarray] = []
+    rows_by_gang: dict[int, np.ndarray] = {}
+
+    def _gang_row(gi: int) -> np.ndarray:
+        row = rows_by_gang.get(gi)
+        if row is None:
+            row = np.zeros((N,), bool)
+            rows_by_gang[gi] = row
+        return row
+
     if bans_of:
         gang_of_job = {}
         for gi, members in enumerate(gang_members_out):
             for jid in members:
                 gang_of_job[jid] = gi
-        rows_by_gang: dict[int, np.ndarray] = {}
         for jid, node_ids in bans_of.items():
             gi = gang_of_job.get(jid)
             if gi is None:
                 continue
-            row = rows_by_gang.get(gi)
-            if row is None:
-                row = np.zeros((N,), bool)
-                rows_by_gang[gi] = row
+            row = _gang_row(gi)
             for nid in node_ids:
                 ni = node_index.get(nid)
                 if ni is not None:
                     row[ni] = True
-        for gi, row in rows_by_gang.items():
-            if row.any():
-                ban_rows.append(row)
-                g_ban_row[gi] = len(ban_rows)
+    for gi, g in enumerate(gangs):
+        if g.uban:
+            row = _gang_row(gi)
+            for ni in g.uban:
+                row[ni] = True
+    for gi, row in rows_by_gang.items():
+        if row.any():
+            ban_rows.append(row)
+            g_ban_row[gi] = len(ban_rows)
     BR = _pad(len(ban_rows) + 1, 8) if ban_rows else 1
     ban_mask = np.zeros((BR, N), bool)
     for i, row in enumerate(ban_rows):
@@ -637,6 +825,7 @@ def build_problem(
         queue_names=[q.name for q in sorted_queues],
         node_ids=[n.id for n in pool_nodes],
         gang_members=gang_members_out,
+        gang_group=[g.group for g in gangs],
         run_job_ids=run_job_ids,
         num_real_nodes=len(pool_nodes),
         num_real_queues=len(sorted_queues),
@@ -719,6 +908,28 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
     for gi in range(ctx.num_real_gangs):
         if g_state[gi] == 2 and ctx.gang_members[gi]:
             failed.extend(ctx.gang_members[gi])
+
+    # Cross-class gang atomicity (gang_scheduler.go all-or-nothing): a
+    # heterogeneous gang is split into per-key sub-gangs for the kernel; if
+    # any sub-gang of a declared gang failed to place while a sibling placed,
+    # unwind the placed siblings -- no half-gang may lease.  The statically-
+    # hopeless case is killed before the round (build_problem `dead`), so
+    # this backstop fires only on runtime capacity contention; in that rare
+    # case evictions the placed sibling triggered are not rolled back (the
+    # reference rolls back with the gang txn -- known divergence).
+    groups: dict = {}
+    for gi in range(ctx.num_real_gangs):
+        tag = ctx.gang_group[gi]
+        if tag:
+            groups.setdefault(tag, []).append(gi)
+    for tag, gis in groups.items():
+        states = {int(g_state[gi]) for gi in gis}
+        if 1 in states and states != {1}:
+            for gi in gis:
+                if int(g_state[gi]) == 1:
+                    for jid in ctx.gang_members[gi]:
+                        scheduled.pop(jid, None)
+                        failed.append(jid)
 
     spot = float(result.spot_price)
     return RoundOutcome(

@@ -115,7 +115,11 @@ class SubmitChecker:
             # its cache with an LRU, submitcheck.go:243).  Gate calls are rare.
             return self._check_uncached(lead, cardinality, banned)
         kidx = SchedulingKeyIndex()
-        key_id = kidx.key_of(lead, self.config.node_id_label)
+        key_id = kidx.key_of(
+            lead,
+            self.config.node_id_label,
+            uniformity=(lead.gang_node_uniformity_label, ""),
+        )
         cache_key = (kidx.keys[key_id], cardinality, tuple(lead.pools))
         cached = self._cache.get(cache_key)
         if cached is not None:
@@ -195,11 +199,18 @@ class SubmitChecker:
             kidx.key_of(lead, self.config.node_id_label)
             compat = static_fit_matrix(kidx.keys, ntidx.types)[0]
 
-            members_possible = 0
+            # Node uniformity: all members must land in ONE label-value
+            # domain (gang_scheduler.go NodeUniformity); count capacity per
+            # domain and take the best.
+            label = lead.gang_node_uniformity_label
+            members_by_domain: dict = {}
             biggest_gap = None
             for n, tid in zip(nodes, type_of_node):
                 if not compat[tid] or n.id in banned:
                     continue
+                domain = n.labels.get(label) if label else ""
+                if label and domain is None:
+                    continue  # unlabeled nodes can't host a uniformity gang
                 total = np.asarray(n.total_resources.atoms, dtype=np.float64)
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per_node = np.floor(
@@ -214,9 +225,12 @@ class SubmitChecker:
                     gap = np.where(req_node > total, req_node - total, 0)
                     biggest_gap = gap if biggest_gap is None else np.minimum(biggest_gap, gap)
                     continue
-                members_possible += int(per_node)
-                if members_possible >= cardinality:
+                members_by_domain[domain] = members_by_domain.get(domain, 0) + int(
+                    per_node
+                )
+                if members_by_domain[domain] >= cardinality:
                     break
+            members_possible = max(members_by_domain.values(), default=0)
             if members_possible >= cardinality:
                 if lead.pools and pool not in lead.pools:
                     ok_away = True  # fits only as an away guest

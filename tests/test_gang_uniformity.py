@@ -1,0 +1,175 @@
+"""Gang node-uniformity domains + cross-class atomicity
+(gang_scheduler.go NodeUniformity + all-or-nothing, :100-247)."""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+from armada_tpu.models import run_scheduling_round
+from armada_tpu.scheduler.submitcheck import SubmitChecker
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+CFG = SchedulingConfig(shape_bucket=32, indexed_node_labels=("rack",))
+F = CFG.resource_list_factory()
+
+
+def rnode(nid, rack, cpu="8"):
+    return NodeSpec(
+        id=nid,
+        pool="default",
+        labels={"rack": rack},
+        total_resources=F.from_mapping({"cpu": cpu, "memory": "32"}),
+    )
+
+
+def member(jid, cpu="8", gang="g1", card=2, uniformity="rack", **kw):
+    return JobSpec(
+        id=jid,
+        queue="q",
+        gang_id=gang,
+        gang_cardinality=card,
+        gang_node_uniformity_label=uniformity,
+        resources=F.from_mapping({"cpu": cpu, "memory": "2"}),
+        **kw,
+    )
+
+
+def test_gang_lands_in_one_uniformity_domain():
+    # rack a has two nodes, rack b one: both members must land in rack a.
+    nodes = [rnode("a1", "a"), rnode("b1", "b"), rnode("a2", "a")]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[member("m1"), member("m2")],
+    )
+    assert set(out.scheduled) == {"m1", "m2"}
+    assert set(out.scheduled.values()) == {"a1", "a2"}
+
+
+def test_gang_never_straddles_domains():
+    # one node per rack: the gang COULD fit split across racks, but
+    # uniformity forbids it.
+    nodes = [rnode("a1", "a"), rnode("b1", "b")]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[member("m1"), member("m2")],
+    )
+    assert out.scheduled == {}
+    # a non-uniformity gang of the same shape happily straddles
+    free = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[
+            member("m1", uniformity=""),
+            member("m2", uniformity=""),
+        ],
+    )
+    assert set(free.scheduled) == {"m1", "m2"}
+
+
+def test_unlabeled_nodes_cannot_host_uniformity_gangs():
+    nodes = [
+        NodeSpec(
+            id="plain",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": "32", "memory": "64"}),
+        )
+    ]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[member("m1", cpu="2"), member("m2", cpu="2")],
+    )
+    assert out.scheduled == {}
+
+
+def test_heterogeneous_gang_is_atomic_across_key_classes():
+    # m2's selector matches nothing: its sub-gang can never place, so m1's
+    # schedulable sub-gang must unwind (no half-gang).
+    nodes = [rnode("a1", "a"), rnode("a2", "a")]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[
+            member("m1", uniformity=""),
+            member("m2", uniformity="", node_selector={"rack": "nowhere"}),
+        ],
+    )
+    assert out.scheduled == {}
+    assert "m1" in out.failed and "m2" in out.failed
+
+
+def test_heterogeneous_gang_schedules_when_all_classes_fit():
+    nodes = [rnode("a1", "a"), rnode("b1", "b")]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[
+            member("m1", uniformity=""),
+            member("m2", uniformity="", node_selector={"rack": "b"}),
+        ],
+    )
+    assert set(out.scheduled) == {"m1", "m2"}
+    assert out.scheduled["m2"] == "b1"
+
+
+def test_submit_checker_respects_uniformity_domains():
+    checker = SubmitChecker(CFG)
+    checker.update_executors(
+        [
+            ExecutorSnapshot(
+                id="ex1",
+                pool="default",
+                nodes=(rnode("a1", "a"), rnode("b1", "b")),
+                last_update_ns=1,
+            )
+        ]
+    )
+    # 2x8cpu with uniformity: no single rack holds both -> unschedulable
+    res = checker.check_gang([member("m1"), member("m2")])
+    assert not res.ok
+    # without uniformity the same shape passes
+    res2 = checker.check_gang(
+        [member("m1", uniformity=""), member("m2", uniformity="")]
+    )
+    assert res2.ok
+
+
+def test_lookback_cap_keeps_split_gangs_atomic():
+    """A split gang whose sibling falls past maxQueueLookback is dropped
+    whole -- a truncated sibling must not let a half-gang lease."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, max_queue_lookback=3)
+    nodes = [rnode("a1", "a", cpu="32"), rnode("b1", "b", cpu="32")]
+    singles = [
+        JobSpec(id=f"a{i}", queue="q", resources=F.from_mapping({"cpu": "2", "memory": "1"}))
+        for i in range(2)
+    ]
+    gang = [
+        member("m1", cpu="2", uniformity=""),
+        member("m2", cpu="2", uniformity="", node_selector={"rack": "b"}),
+    ]
+    out = run_scheduling_round(
+        cfg,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=singles + gang,
+    )
+    # 2 singles + 1 sub-gang fit the lookback; the second sub-gang is cut:
+    # neither gang member may schedule.
+    assert set(out.scheduled) == {"a0", "a1"}
