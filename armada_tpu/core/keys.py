@@ -81,6 +81,31 @@ class NodeTypeIndex:
         return len(self.types)
 
 
+def class_signature(job: JobSpec, node_id_label: str) -> tuple:
+    """The hashable identity of a job's scheduling class -- EXACTLY the
+    fields SchedulingKeyIndex.key_of folds into the key (minus per-gang bans
+    and uniformity, which are gang-level).  Shared by the problem builder's
+    provisional gang grouping and the SubmitChecker so their class splits can
+    never diverge from the interned keys (the node-id pinning label is
+    excluded in both, matching key_of)."""
+    selector = (
+        tuple(
+            sorted(
+                (k, v) for k, v in job.node_selector.items() if k != node_id_label
+            )
+        )
+        if job.node_selector
+        else ()
+    )
+    return (
+        job.resources.atoms_tuple() if job.resources else (),
+        selector,
+        tuple(job.tolerations),
+        job.priority_class,
+        job.priority,
+    )
+
+
 class SchedulingKeyIndex:
     """Assigns each job a dense scheduling-key id; built per round on host."""
 
@@ -98,23 +123,39 @@ class SchedulingKeyIndex:
         # The node-id pinning label is excluded: pinning is handled positionally via
         # the pinned-node tensor, the way the reference injects node-id selectors
         # for evicted jobs (internal/scheduler/api.go addNodeIdSelector:278).
-        selector = tuple(
-            sorted((k, v) for k, v in job.node_selector.items() if k != node_id_label)
+        # Hot path (one call per queued job per round): probe with a plain
+        # tuple and only materialize the SchedulingKey dataclass on a miss.
+        selector = (
+            tuple(
+                sorted(
+                    (k, v)
+                    for k, v in job.node_selector.items()
+                    if k != node_id_label
+                )
+            )
+            if job.node_selector
+            else ()
         )
-        key = SchedulingKey(
-            resources=tuple(int(a) for a in job.resources.atoms) if job.resources else (),
-            node_selector=selector,
-            tolerations=tuple(job.tolerations),
-            priority_class=job.priority_class,
-            priority=job.priority,
-            banned_nodes=tuple(sorted(banned_nodes)),
-            uniformity=tuple(uniformity),
-        )
-        kid = self._ids.get(key)
+        resources = job.resources.atoms_tuple() if job.resources else ()
+        tolerations = tuple(job.tolerations)
+        bans = tuple(sorted(banned_nodes)) if banned_nodes else ()
+        uni = tuple(uniformity)
+        probe = (resources, selector, tolerations, job.priority_class, job.priority, bans, uni)
+        kid = self._ids.get(probe)
         if kid is None:
             kid = len(self.keys)
-            self.keys.append(key)
-            self._ids[key] = kid
+            self.keys.append(
+                SchedulingKey(
+                    resources=resources,
+                    node_selector=selector,
+                    tolerations=tolerations,
+                    priority_class=job.priority_class,
+                    priority=job.priority,
+                    banned_nodes=bans,
+                    uniformity=uni,
+                )
+            )
+            self._ids[probe] = kid
         return kid
 
     def __len__(self) -> int:

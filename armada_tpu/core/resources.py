@@ -170,6 +170,15 @@ class ResourceList:
     factory: ResourceListFactory
     atoms: np.ndarray  # int64[R]
 
+    def atoms_tuple(self) -> tuple:
+        """Hashable atoms, cached: the scheduling-key hot path converts each
+        job's vector exactly once however often keys are recomputed."""
+        cached = getattr(self, "_atoms_tuple", None)
+        if cached is None:
+            cached = tuple(int(a) for a in self.atoms)
+            object.__setattr__(self, "_atoms_tuple", cached)
+        return cached
+
     def _check(self, other: "ResourceList"):
         if other.factory is not self.factory and other.factory != self.factory:
             raise ValueError("resource lists from different factories")

@@ -36,6 +36,7 @@ from armada_tpu.core.ordering import scheduling_order_key
 from armada_tpu.core.keys import (
     NodeTypeIndex,
     SchedulingKeyIndex,
+    class_signature,
     labels_referenced_by_selectors,
     static_fit_matrix,
 )
@@ -189,7 +190,7 @@ class _GangFitContext:
     memoized by (selector, tolerations) signature, and per-label domain
     index arrays built once however many gangs share the label."""
 
-    def __init__(self, pool_nodes, node_total, node_index, factory):
+    def __init__(self, pool_nodes, node_total, node_index, factory, node_axes):
         self.pool_nodes = pool_nodes
         self.node_index = node_index
         self.num_real = len(pool_nodes)
@@ -198,19 +199,47 @@ class _GangFitContext:
             [not n.unschedulable for n in pool_nodes], bool
         ) if pool_nodes else np.zeros((0,), bool)
         self.factory = factory
+        # 1.0 on node-bound axes, 0.0 on floating axes: per-node fit must
+        # never see floating requests (floating_resource_types.go; the pool
+        # totals gate handles them).
+        self.node_axes = np.asarray(node_axes, np.float64)
+        # Free capacity (totals minus running usage) once set_running_usage is
+        # called; falls back to totals until then.
+        self.free = self.totals
         self._static: dict = {}
         self._domains: dict = {}
 
-    def capacity(self, req_units: np.ndarray, cardinality: int) -> np.ndarray:
-        """i64[n]: members of `req_units` each node holds, capped at card."""
+    def set_running_usage(self, run_req, run_node, run_valid) -> None:
+        """Subtract running jobs' usage so occupancy-aware choices (the
+        uniformity domain pick) see actual headroom, not raw node sizes."""
+        if not self.num_real:
+            return
+        used = np.zeros_like(self.totals)
+        valid = np.asarray(run_valid, bool)
+        if valid.any():
+            np.add.at(
+                used,
+                np.asarray(run_node)[valid],
+                np.asarray(run_req, np.float64)[valid],
+            )
+        self.free = np.maximum(self.totals - used, 0.0)
+
+    def capacity(
+        self, req_units: np.ndarray, cardinality: int, occupancy: bool = False
+    ) -> np.ndarray:
+        """i64[n]: members of `req_units` each node holds, capped at card.
+        occupancy=True measures against FREE capacity (for preferences like
+        the domain pick); False against totals (static feasibility -- a full
+        node is not statically infeasible, preemption can clear it)."""
         if not self.num_real:
             return np.zeros((0,), np.int64)
-        req = np.asarray(req_units, np.float64)
+        base = self.free if occupancy else self.totals
+        req = np.asarray(req_units, np.float64) * self.node_axes
         with np.errstate(divide="ignore", invalid="ignore"):
             per = np.floor(
                 np.where(
                     req[None, :] > 0,
-                    self.totals / np.maximum(req[None, :], 1e-9),
+                    base / np.maximum(req[None, :], 1e-9),
                     np.inf,
                 )
             ).min(axis=1)
@@ -258,37 +287,50 @@ class _GangFitContext:
 def _uniform_domain_ban(
     fit: _GangFitContext,
     label: str,
-    lead: JobSpec,
-    cardinality: int,
+    classes,
     banned_node_ids,
     node_id_label: str,
 ) -> tuple[set, str]:
     """(banned node indices, chosen value) restricting a uniformity gang to
     its best label-value domain (gang_scheduler.go tries domains; here the
-    highest-usable-capacity domain is chosen per round).  Capacity counts
-    only schedulable, statically-fitting, non-retry-banned nodes, so a
-    domain poisoned by bans or selector misses never wins over a viable
-    one.  Nodes lacking the label are always excluded."""
-    req = (
-        fit.factory.ceil_units(lead.resources.atoms).astype(np.float64)
-        if lead.resources is not None
-        else np.zeros((fit.factory.num_resources,), np.float64)
-    )
-    cap = fit.capacity(req, cardinality)
-    usable = fit.ok & fit.static_fit(lead, node_id_label)
-    if banned_node_ids:
-        for nid in banned_node_ids:
-            ni = fit.node_index.get(nid)
-            if ni is not None and ni < usable.shape[0]:
-                usable = usable.copy()
-                usable[ni] = False
-    best_value, best_cap = "", -1
+    best domain is chosen per round).  `classes` is [(lead job, member
+    count)] -- ONE per key class of the gang, so a heterogeneous gang's
+    domain must work for every class, not just the lead's.  Scoring counts
+    only schedulable, statically-fitting, non-retry-banned nodes; a domain
+    whose FREE capacity satisfies every class beats one satisfying on
+    totals only, which beats neither, ties broken by free capacity -- so an
+    occupied domain never shadows an empty viable one, and the choice
+    self-corrects round over round as occupancy shifts.  Nodes lacking the
+    label are always excluded."""
+    per_class = []
+    for lead, count in classes:
+        req = (
+            fit.factory.ceil_units(lead.resources.atoms).astype(np.float64)
+            if lead.resources is not None
+            else np.zeros((fit.factory.num_resources,), np.float64)
+        )
+        cap_total = fit.capacity(req, count)
+        cap_free = fit.capacity(req, count, occupancy=True)
+        usable = fit.ok & fit.static_fit(lead, node_id_label)  # fresh array
+        if banned_node_ids:
+            for nid in banned_node_ids:
+                ni = fit.node_index.get(nid)
+                if ni is not None and ni < usable.shape[0]:
+                    usable[ni] = False
+        per_class.append((cap_total, cap_free, usable, count))
+    best_value, best = "", (False, False, -1)
     for v, idx in fit.domains(label).items():
-        c = int(cap[idx][usable[idx]].sum())
-        if c > best_cap:
-            best_value, best_cap = v, c
-        if best_cap >= cardinality:
-            break
+        free_total, fits_free, fits_static = 0, True, True
+        for cap_total, cap_free, usable, count in per_class:
+            u = usable[idx]
+            cf = int(cap_free[idx][u].sum())
+            free_total += cf
+            if cf < count:
+                fits_free = False
+            if int(cap_total[idx][u].sum()) < count:
+                fits_static = False
+        if (fits_free, fits_static, free_total) > best:
+            best_value, best = v, (fits_free, fits_static, free_total)
     allowed = set(
         int(i) for i in fit.domains(label).get(best_value, np.zeros(0, np.int64))
     )
@@ -367,12 +409,18 @@ def build_problem(
     node_type = np.zeros((N,), np.int32)
     node_ok = np.zeros((N,), bool)
     node_index = {}
+    atoms_rows = []
+    atoms_idx = []
     for i, node in enumerate(pool_nodes):
         node_index[node.id] = i
         if node.total_resources is not None:
-            node_total[i] = factory.floor_units(node.total_resources.atoms)
+            atoms_rows.append(node.total_resources.atoms)
+            atoms_idx.append(i)
         node_type[i] = ntidx.type_of(node)
         node_ok[i] = not node.unschedulable
+    if atoms_rows:
+        # one vectorized floor instead of a per-node numpy call
+        node_total[atoms_idx] = factory.floor_units(np.stack(atoms_rows))
 
     # --- scheduling keys for queued jobs ---------------------------------------
     kidx = SchedulingKeyIndex()
@@ -405,11 +453,16 @@ def build_problem(
     # --- gangs: group queued jobs ----------------------------------------------
     class _Gang:
         __slots__ = (
-            "jobs", "queue", "key", "level", "pc", "req", "card", "order",
-            "run", "price", "spot_price", "group", "uban", "dead",
+            "jobs", "queue", "key", "level", "pc", "req", "req_atoms", "card",
+            "order", "run", "price", "spot_price", "group", "uban", "dead",
         )
 
-    fitctx = _GangFitContext(pool_nodes, node_total, node_index, factory)
+    floating_names = set(config.floating_resource_names())
+    node_axes = np.array(
+        [0.0 if name in floating_names else 1.0 for name in factory.names],
+        np.float32,
+    )
+    fitctx = _GangFitContext(pool_nodes, node_total, node_index, factory, node_axes)
 
     gangs: list[_Gang] = []
     per_queue_jobs: dict[int, list] = {qi: [] for qi in range(len(sorted_queues))}
@@ -478,6 +531,7 @@ def build_problem(
             g.level = int(run_level[ri])
             g.pc = int(run_pc[ri])
             g.req = run_req[ri].copy()
+            g.req_atoms = None
             g.card = 1
             g.order = order
             g.run = ri
@@ -488,6 +542,19 @@ def build_problem(
             g.dead = False
             run_gang[ri] = len(gangs) - 1
             gang_members_out.append([])
+
+    # Occupancy for the uniformity-domain pick (run tensors are now filled),
+    # and where each partially-running gang's siblings already live: re-queued
+    # members must rejoin the SAME domain, not the statically-best one.
+    fitctx.set_running_usage(run_req, run_node, run_valid)
+    running_gang_nodes: dict[tuple, list[int]] = {}
+    for r in run_list:
+        if r.job.gang_id:
+            rqi = queue_by_name.get(r.job.queue)
+            if rqi is not None:
+                running_gang_nodes.setdefault((rqi, r.job.gang_id), []).append(
+                    node_index[r.node_id]
+                )
 
     # queued gangs, per queue, lookback-capped
     for qi in range(len(sorted_queues)):
@@ -518,16 +585,47 @@ def build_problem(
             # Node-uniformity (gang_scheduler.go NodeUniformity): restrict the
             # whole gang to the single best label-value domain, chosen by
             # usable static capacity; encoded as extra ban rows, so the
-            # kernel needs no new machinery.  Re-chosen every round.
+            # kernel needs no new machinery.  Re-chosen every round.  The
+            # choice sees every key CLASS of the gang (grouped provisionally,
+            # without interning junk keys), so a heterogeneous gang's domain
+            # must work for all of its classes.
             label = members[0].gang_node_uniformity_label
             uniformity = ("", "")
             uban: Optional[set] = None
             if label:
-                card_total = max(len(members), members[0].gang_cardinality or 1)
-                uban, chosen = _uniform_domain_ban(
-                    fitctx, label, members[0], card_total, gang_bans,
-                    config.node_id_label,
-                )
+                def _sig(m: JobSpec):
+                    return class_signature(m, config.node_id_label)
+
+                prov: dict = {}
+                for m in members:
+                    prov.setdefault(_sig(m), []).append(m)
+                classes = [(grp[0], len(grp)) for grp in prov.values()]
+                if len(classes) == 1:
+                    classes = [
+                        (
+                            members[0],
+                            max(len(members), members[0].gang_cardinality or 1),
+                        )
+                    ]
+                # Partially-running gang: siblings already occupy a domain;
+                # re-queued members MUST rejoin it or the gang straddles.
+                pinned_values = {
+                    pool_nodes[ni].labels.get(label)
+                    for ni in running_gang_nodes.get((qi, gang_id), ())
+                } - {None}
+                if len(pinned_values) == 1:
+                    chosen = next(iter(pinned_values))
+                    allowed = {
+                        int(i)
+                        for i in fitctx.domains(label).get(
+                            chosen, np.zeros(0, np.int64)
+                        )
+                    }
+                    uban = set(range(fitctx.num_real)) - allowed
+                else:
+                    uban, chosen = _uniform_domain_ban(
+                        fitctx, label, classes, gang_bans, config.node_id_label
+                    )
                 uniformity = (label, chosen)
             keys = {_key_of(m, gang_bans, uniformity) for m in members}
             if len(keys) > 1:
@@ -595,12 +693,18 @@ def build_problem(
             g.key = key
             g.level = 1 if away_mode else job_level(lead)
             g.pc = pc_index[pc.name]
-            g.req = factory.ceil_units(lead.resources.atoms).astype(np.float32) if lead.resources else np.zeros(R, np.float32)
+            # raw atoms; unit-ceiled in ONE vectorized pass at assembly
+            g.req = None
+            g.req_atoms = lead.resources.atoms if lead.resources else None
             g.card = len(members)
             g.order = base + order
             g.run = -1
             g.price = float(price_of(lead))
-            g.spot_price = min(float(price_of(m)) for m in members)
+            g.spot_price = (
+                g.price
+                if len(members) == 1
+                else min(float(price_of(m)) for m in members)
+            )
             g.group = group_tag
             g.uban = uban
             g.dead = dead
@@ -619,7 +723,8 @@ def build_problem(
     g_price = np.zeros((G,), np.float32)
     g_spot_price = np.zeros((G,), np.float32)
     for i, g in enumerate(gangs):
-        g_req[i] = g.req
+        if g.req is not None:
+            g_req[i] = g.req
         g_card[i] = g.card
         g_level[i] = g.level
         g_queue[i] = g.queue
@@ -630,6 +735,20 @@ def build_problem(
         g_valid[i] = not g.dead
         g_price[i] = g.price
         g_spot_price[i] = g.spot_price
+    # Unit-ceil every queued gang's request in one vectorized pass (a per-gang
+    # ceil_units call costs ~3us of numpy overhead; at 1M gangs that is
+    # seconds of host time per round).
+    atom_rows = [i for i, g in enumerate(gangs) if g.req is None]
+    if atom_rows:
+        mat = np.stack(
+            [
+                gangs[i].req_atoms
+                if gangs[i].req_atoms is not None
+                else np.zeros((R,), np.int64)
+                for i in atom_rows
+            ]
+        )
+        g_req[atom_rows] = factory.ceil_units(mat).astype(np.float32)
 
     # --- pinned node for evictee slots is derived in-kernel from run_node -------
 
@@ -641,11 +760,6 @@ def build_problem(
         compat[: len(kidx), : len(ntidx)] = static_fit_matrix(kidx.keys, ntidx.types)
 
     # --- pool totals, DRF, caps -------------------------------------------------
-    floating_names = set(config.floating_resource_names())
-    node_axes = np.array(
-        [0.0 if name in floating_names else 1.0 for name in factory.names],
-        np.float32,
-    )
     float_total = np.zeros((R,), np.float32)
     if floating_names:
         fl = factory.from_mapping(config.floating_totals_for_pool(pool))
@@ -733,12 +847,23 @@ def build_problem(
             if qi is not None:
                 q_penalty[qi] = factory.ceil_units(atoms).astype(np.float32)
     demand_by_pc = np.zeros((len(sorted_queues), C, R), np.float64)
-    for g in gangs:
-        if g.run < 0:
-            demand_by_pc[g.queue, g.pc] += g.req.astype(np.float64) * g.card
-    for ri in range(len(run_list)):
-        if run_valid[ri]:
-            demand_by_pc[run_queue[ri], run_pc[ri]] += run_req[ri].astype(np.float64)
+    nreal = len(gangs)
+    if nreal:
+        queued_mask = g_run[:nreal] < 0
+        contrib = g_req[:nreal].astype(np.float64) * g_card[:nreal, None]
+        np.add.at(
+            demand_by_pc,
+            (g_queue[:nreal][queued_mask], g_pc[:nreal][queued_mask]),
+            contrib[queued_mask],
+        )
+    nr = len(run_list)
+    if nr:
+        rv = run_valid[:nr]
+        np.add.at(
+            demand_by_pc,
+            (run_queue[:nr][rv], run_pc[:nr][rv]),
+            run_req[:nr][rv].astype(np.float64),
+        )
     for qi, q in enumerate(sorted_queues):
         q_weight[qi] = q.weight
         capped = np.minimum(demand_by_pc[qi], pc_queue_cap).sum(axis=0)

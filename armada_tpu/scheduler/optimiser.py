@@ -111,6 +111,11 @@ class Optimiser:
         for (queue, gang_id), members in by_gang.items():
             if len(members) < max(m.gang_cardinality or 1 for m in members):
                 continue  # partially-stuck gang: other members already run
+            if any(m.gang_node_uniformity_label for m in members):
+                # The per-member placement loop cannot enforce a common
+                # uniformity domain; leave these to the round kernel, which
+                # can (problem.py _uniform_domain_ban).
+                continue
             units.append(members)
 
         decisions: list[OptimiserDecision] = []

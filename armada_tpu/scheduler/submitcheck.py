@@ -27,6 +27,7 @@ from armada_tpu.core.config import SchedulingConfig
 from armada_tpu.core.keys import (
     NodeTypeIndex,
     SchedulingKeyIndex,
+    class_signature,
     static_fit_matrix,
 )
 from armada_tpu.core.types import JobSpec
@@ -104,39 +105,67 @@ class SubmitChecker:
         if not members:
             return CheckResult(False, "empty gang")
         lead = members[0]
-        # Trust the declared cardinality over the members seen in this batch:
-        # a partially-arrived gang must be judged at full size.
-        cardinality = max(len(members), lead.gang_cardinality or 1)
+        # Per-key-class member grouping: a heterogeneous gang is only
+        # schedulable if EVERY class fits (the round kernel enforces gang
+        # atomicity, so a never-schedulable class means the whole gang sits
+        # queued forever -- exactly what this check exists to reject).
+        by_sig: dict = {}
+        for m in members:
+            by_sig.setdefault(
+                class_signature(m, self.config.node_id_label), []
+            ).append(m)
+        if len(by_sig) == 1:
+            # Trust the declared cardinality over the members seen in this
+            # batch: a partially-arrived gang must be judged at full size.
+            classes = [(lead, max(len(members), lead.gang_cardinality or 1))]
+        else:
+            classes = [(grp[0], len(grp)) for grp in by_sig.values()]
+            # Partially-arrived heterogeneous gang: unseen members have
+            # unknown shapes; attribute the missing count to the first class
+            # so the declared cardinality still gates feasibility.
+            declared = lead.gang_cardinality or 1
+            if declared > len(members):
+                clead, count = classes[0]
+                classes[0] = (clead, count + declared - len(members))
 
         banned = frozenset(banned_nodes)
         if banned:
             # Ban sets are per-job and near-unique; caching them would grow the
             # cache without bound between fleet changes (the reference bounds
             # its cache with an LRU, submitcheck.go:243).  Gate calls are rare.
-            return self._check_uncached(lead, cardinality, banned)
+            return self._check_uncached(classes, banned)
         kidx = SchedulingKeyIndex()
-        key_id = kidx.key_of(
-            lead,
-            self.config.node_id_label,
-            uniformity=(lead.gang_node_uniformity_label, ""),
+        key_ids = tuple(
+            (
+                kidx.key_of(
+                    m,
+                    self.config.node_id_label,
+                    uniformity=(lead.gang_node_uniformity_label, ""),
+                ),
+                count,
+            )
+            for m, count in classes
         )
-        cache_key = (kidx.keys[key_id], cardinality, tuple(lead.pools))
+        cache_key = (
+            tuple((kidx.keys[kid], count) for kid, count in key_ids),
+            tuple(lead.pools),
+        )
         cached = self._cache.get(cache_key)
         if cached is not None:
             return cached
 
-        result = self._check_uncached(lead, cardinality)
+        result = self._check_uncached(classes)
         self._cache[cache_key] = result
         return result
 
     def _check_uncached(
-        self, lead: JobSpec, cardinality: int, banned: frozenset = frozenset()
+        self, classes, banned: frozenset = frozenset()
     ) -> CheckResult:
-        req = (
-            np.asarray(lead.resources.atoms, dtype=np.float64)
-            if lead.resources is not None
-            else np.zeros(self._factory.num_resources)
-        )
+        """classes: [(lead job, member count)] -- one per key class; every
+        class must fit, within one uniformity domain when the gang declares
+        a uniformity label."""
+        lead = classes[0][0]
+        cardinality = sum(count for _, count in classes)
         # Floating resources are pool-level, not node-level: exclude them from
         # per-node fit and check them against the pool's floating totals
         # (floating_resource_types.go; the kernel applies the same split).
@@ -144,8 +173,6 @@ class SubmitChecker:
         floating_axes = np.array(
             [1.0 if n in floating_names else 0.0 for n in self._factory.names]
         )
-        req_node = req * (1.0 - floating_axes)
-        req_float = req * floating_axes
         # Pools that may host this job away from home (scheduling_algo.go:282:
         # a pool's jobs may borrow nodes from its away_pools): feasibility
         # there validates the job, but its pools stay the home ones -- only
@@ -168,22 +195,32 @@ class SubmitChecker:
                 + (f"pools {list(lead.pools)}" if lead.pools else "any nodes"),
             )
 
+        # Per-class node-bound and floating request vectors.
+        class_reqs = []
+        total_float = np.zeros(self._factory.num_resources, dtype=np.float64)
+        for clead, count in classes:
+            creq = (
+                np.asarray(clead.resources.atoms, dtype=np.float64)
+                if clead.resources is not None
+                else np.zeros(self._factory.num_resources)
+            )
+            class_reqs.append((clead, count, creq * (1.0 - floating_axes)))
+            total_float += creq * floating_axes * count
+
         ok_pools = []
         ok_away = False
         best_reason = "does not fit on any node type"
         for pool in candidate_pools:
-            if np.any(req_float) and floating_names:
+            if np.any(total_float) and floating_names:
                 fl = self._factory.from_mapping(
                     self.config.floating_totals_for_pool(pool)
                 )
                 fl_total = np.asarray(fl.atoms, dtype=np.float64)
-                if np.any(req_float * cardinality > fl_total):
+                if np.any(total_float > fl_total):
                     over = {
-                        self._factory.names[i]: int(
-                            req_float[i] * cardinality - fl_total[i]
-                        )
-                        for i in range(len(req_float))
-                        if req_float[i] * cardinality > fl_total[i]
+                        self._factory.names[i]: int(total_float[i] - fl_total[i])
+                        for i in range(len(total_float))
+                        if total_float[i] > fl_total[i]
                     }
                     best_reason = (
                         f"pool {pool}: floating-resource request exceeds the "
@@ -191,47 +228,79 @@ class SubmitChecker:
                     )
                     continue
             nodes = self._pools[pool]
+            all_selector_labels = set().union(
+                *(set(c.node_selector) for c, _, _ in class_reqs)
+            )
             ntidx = NodeTypeIndex(
-                set(self.config.indexed_node_labels) | set(lead.node_selector)
+                set(self.config.indexed_node_labels) | all_selector_labels
             )
             type_of_node = [ntidx.type_of(n) for n in nodes]
             kidx = SchedulingKeyIndex()
-            kidx.key_of(lead, self.config.node_id_label)
-            compat = static_fit_matrix(kidx.keys, ntidx.types)[0]
+            # Index compat by each class's interned key id: classes that
+            # key_of dedupes (e.g. differing only in the excluded node-id
+            # label) share a row instead of running the matrix off the end.
+            class_key_ids = [
+                kidx.key_of(clead, self.config.node_id_label)
+                for clead, _, _ in class_reqs
+            ]
+            compat = static_fit_matrix(kidx.keys, ntidx.types)
 
-            # Node uniformity: all members must land in ONE label-value
-            # domain (gang_scheduler.go NodeUniformity); count capacity per
-            # domain and take the best.
+            # Node uniformity: all members of every class must land in ONE
+            # label-value domain (gang_scheduler.go NodeUniformity); count
+            # per-class capacity per domain, then find a domain satisfying
+            # every class.
             label = lead.gang_node_uniformity_label
-            members_by_domain: dict = {}
             biggest_gap = None
-            for n, tid in zip(nodes, type_of_node):
-                if not compat[tid] or n.id in banned:
-                    continue
-                domain = n.labels.get(label) if label else ""
-                if label and domain is None:
-                    continue  # unlabeled nodes can't host a uniformity gang
-                total = np.asarray(n.total_resources.atoms, dtype=np.float64)
-                with np.errstate(divide="ignore", invalid="ignore"):
-                    per_node = np.floor(
-                        np.where(
-                            req_node > 0, total / np.maximum(req_node, 1e-9), np.inf
+            per_class_domains: list[dict] = []
+            for ci, (clead, count, creq_node) in enumerate(class_reqs):
+                members_by_domain: dict = {}
+                for n, tid in zip(nodes, type_of_node):
+                    if not compat[class_key_ids[ci]][tid] or n.id in banned:
+                        continue
+                    domain = n.labels.get(label) if label else ""
+                    if label and domain is None:
+                        continue  # unlabeled nodes can't host a uniformity gang
+                    total = np.asarray(n.total_resources.atoms, dtype=np.float64)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        per_node = np.floor(
+                            np.where(
+                                creq_node > 0,
+                                total / np.maximum(creq_node, 1e-9),
+                                np.inf,
+                            )
+                        ).min()
+                    # All-zero requests give inf; clip before int() (one bad
+                    # event on the log must not wedge the scheduler thread).
+                    per_node = min(per_node, float(count))
+                    if per_node <= 0:
+                        gap = np.where(creq_node > total, creq_node - total, 0)
+                        biggest_gap = (
+                            gap if biggest_gap is None else np.minimum(biggest_gap, gap)
                         )
-                    ).min()
-                # All-zero requests give inf; clip before int() (one bad event
-                # on the log must not wedge the scheduler thread).
-                per_node = min(per_node, float(cardinality))
-                if per_node <= 0:
-                    gap = np.where(req_node > total, req_node - total, 0)
-                    biggest_gap = gap if biggest_gap is None else np.minimum(biggest_gap, gap)
-                    continue
-                members_by_domain[domain] = members_by_domain.get(domain, 0) + int(
-                    per_node
-                )
-                if members_by_domain[domain] >= cardinality:
+                        continue
+                    members_by_domain[domain] = members_by_domain.get(
+                        domain, 0
+                    ) + int(per_node)
+                per_class_domains.append(members_by_domain)
+
+            # A domain works iff every class's count fits in it; report the
+            # best total for the reason string.
+            domains = set().union(*(d.keys() for d in per_class_domains)) or {""}
+            members_possible = 0
+            feasible = False
+            for d in domains:
+                per = [
+                    min(pcd.get(d, 0), count)
+                    for pcd, (_, count, _) in zip(per_class_domains, class_reqs)
+                ]
+                members_possible = max(members_possible, sum(per))
+                if all(
+                    pcd.get(d, 0) >= count
+                    for pcd, (_, count, _) in zip(per_class_domains, class_reqs)
+                ):
+                    feasible = True
                     break
-            members_possible = max(members_by_domain.values(), default=0)
-            if members_possible >= cardinality:
+            if feasible:
                 if lead.pools and pool not in lead.pools:
                     ok_away = True  # fits only as an away guest
                 else:

@@ -173,3 +173,108 @@ def test_lookback_cap_keeps_split_gangs_atomic():
     # 2 singles + 1 sub-gang fit the lookback; the second sub-gang is cut:
     # neither gang member may schedule.
     assert set(out.scheduled) == {"a0", "a1"}
+
+
+def test_submit_checker_rejects_gang_with_impossible_class():
+    """A heterogeneous gang with one never-schedulable member class is
+    rejected up front (the round would keep it perma-dead otherwise)."""
+    checker = SubmitChecker(CFG)
+    checker.update_executors(
+        [
+            ExecutorSnapshot(
+                id="ex1",
+                pool="default",
+                nodes=(rnode("a1", "a"), rnode("a2", "a")),
+                last_update_ns=1,
+            )
+        ]
+    )
+    res = checker.check_gang(
+        [
+            member("m1", cpu="2", uniformity=""),
+            member("m2", cpu="2", uniformity="", node_selector={"rack": "nowhere"}),
+        ]
+    )
+    assert not res.ok
+    ok = checker.check_gang(
+        [
+            member("m1", cpu="2", uniformity=""),
+            member("m2", cpu="2", uniformity="", node_selector={"rack": "a"}),
+        ]
+    )
+    assert ok.ok
+
+
+def test_het_uniformity_gang_domain_works_for_all_classes():
+    """The chosen domain must satisfy every key class: m2 only fits rack b,
+    so the gang must land wholly in rack b even though rack a has more
+    capacity for m1."""
+    nodes = [
+        rnode("a1", "a", cpu="32"),
+        rnode("a2", "a", cpu="32"),
+        rnode("b1", "b", cpu="8"),
+        rnode("b2", "b", cpu="8"),
+    ]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[
+            member("m1", cpu="2"),
+            member("m2", cpu="2", node_selector={"rack": "b"}),
+        ],
+    )
+    assert set(out.scheduled) == {"m1", "m2"}
+    assert all(n in ("b1", "b2") for n in out.scheduled.values())
+
+
+def test_submit_check_survives_node_id_only_selector_difference():
+    """Members differing only in the excluded node-id-label selector share a
+    key class; the checker must not crash or mis-split (regression: the
+    class split used raw selectors while key_of excludes the pin label)."""
+    checker = SubmitChecker(CFG)
+    checker.update_executors(
+        [
+            ExecutorSnapshot(
+                id="ex1",
+                pool="default",
+                nodes=(rnode("a1", "a"), rnode("a2", "a")),
+                last_update_ns=1,
+            )
+        ]
+    )
+    res = checker.check_gang(
+        [
+            member("m1", cpu="2", uniformity="",
+                   node_selector={"kubernetes.io/hostname": "a1"}),
+            member("m2", cpu="2", uniformity="",
+                   node_selector={"kubernetes.io/hostname": "a2"}),
+        ]
+    )
+    assert res.ok
+
+
+def test_requeued_members_rejoin_their_running_siblings_domain():
+    """Half a gang runs in rack b; the re-queued half must rejoin rack b even
+    though rack a has more free capacity."""
+    from armada_tpu.core.types import RunningJob
+
+    nodes = [
+        rnode("a1", "a", cpu="32"),
+        rnode("a2", "a", cpu="32"),
+        rnode("b1", "b", cpu="8"),
+        rnode("b2", "b", cpu="8"),
+    ]
+    running = [
+        RunningJob(job=member("m1", cpu="8"), node_id="b1", priority=1000)
+    ]
+    out = run_scheduling_round(
+        CFG,
+        pool="default",
+        nodes=nodes,
+        queues=[Queue("q")],
+        queued_jobs=[member("m2", cpu="8", card=2)],
+        running=running,
+    )
+    assert out.scheduled == {"m2": "b2"}
