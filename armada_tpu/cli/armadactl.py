@@ -470,6 +470,7 @@ def cmd_executor(args):
             kube_token_file=args.kube_token_file,
             kube_ca_file=args.kube_ca,
             kube_insecure=args.kube_insecure,
+            pod_checks_file=args.pod_checks,
         )
     except KeyboardInterrupt:
         pass
@@ -634,6 +635,12 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--kube-ca", help="CA bundle for --kubernetes")
     ex.add_argument(
         "--kube-insecure", action="store_true", help="skip TLS verification"
+    )
+    ex.add_argument(
+        "--pod-checks",
+        metavar="FILE",
+        help="YAML list of pending-pod check rules "
+        "({regexp, action: Fail|Retry, gracePeriod, inverse})",
     )
     ex.set_defaults(fn=cmd_executor)
 

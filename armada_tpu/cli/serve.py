@@ -287,6 +287,7 @@ def run_fake_executor(
     kube_token_file: Optional[str] = None,
     kube_ca_file: Optional[str] = None,
     kube_insecure: bool = False,
+    pod_checks_file: Optional[str] = None,
 ) -> None:
     """`armadactl executor`: a cluster agent against a remote control plane.
     Default is the fake in-memory cluster (cmd/fakeexecutor); kubernetes_url
@@ -334,8 +335,18 @@ def run_fake_executor(
         cluster = FakeClusterContext(
             nodes, factory, runtime_of=lambda s: default_runtime_s
         )
+    pod_check_rules = ()
+    if pod_checks_file:
+        import yaml
+
+        from armada_tpu.executor.podchecks import rules_from_config
+
+        with open(pod_checks_file) as f:
+            pod_check_rules = rules_from_config(yaml.safe_load(f) or [])
     api = ExecutorApiClient(server_address)
-    agent = ExecutorService(executor_id, pool, cluster, api, factory)
+    agent = ExecutorService(
+        executor_id, pool, cluster, api, factory, pod_check_rules=pod_check_rules
+    )
     binoculars_server = None
     if binoculars_port is not None:
         from armada_tpu.executor.binoculars import Binoculars

@@ -139,6 +139,13 @@ class FakeClusterContext:
                 pod.log.append(f"[t={self.now:.1f}] exit 0")
                 self._allocated[pod.state.node_id] -= pod.requests
 
+    def set_pod_message(self, run_id: str, message: str) -> None:
+        """Fault injection: attach a kubelet-style diagnostic (e.g. an image
+        pull error) without changing phase -- feeds the pending-pod checks."""
+        pod = self._pods[run_id]
+        pod.state.message = message
+        pod.log.append(f"[t={self.now:.1f}] {message}")
+
     def fail_pod(self, run_id: str, message: str = "injected failure") -> None:
         """Fault injection: flip a live pod to FAILED (pod_issue_handler tests)."""
         pod = self._pods[run_id]

@@ -47,6 +47,31 @@ _PHASES = {
 }
 
 
+def _pod_message(status: dict) -> str:
+    """Pod diagnostic text: status.message, false pod conditions (this is
+    where the k8s scheduler's '0/N nodes are available' FailedScheduling
+    text lives, via the PodScheduled condition) and container waiting
+    reasons (ImagePullBackOff etc.) -- the signals the pending-pod checks
+    match on (podchecks/container_state_checks.go, event_checks.go)."""
+    parts = []
+    if status.get("message"):
+        parts.append(status["message"])
+    for cond in status.get("conditions", ()):
+        if cond.get("status") == "False" and (
+            cond.get("reason") or cond.get("message")
+        ):
+            reason = cond.get("reason", "")
+            msg = cond.get("message", "")
+            parts.append(f"{reason}: {msg}" if msg else reason)
+    for cs in status.get("containerStatuses", ()):
+        waiting = cs.get("state", {}).get("waiting")
+        if waiting:
+            reason = waiting.get("reason", "")
+            msg = waiting.get("message", "")
+            parts.append(f"{reason}: {msg}" if msg else reason)
+    return "; ".join(p for p in parts if p)
+
+
 class KubeApiError(RuntimeError):
     def __init__(self, status: int, message: str):
         super().__init__(f"kube-api {status}: {message}")
@@ -282,7 +307,7 @@ class KubernetesClusterContext:
                     .get("nodeSelector", {})
                     .get(self.node_id_label, p.get("spec", {}).get("nodeName", "")),
                     phase=phase,
-                    message=status.get("message", ""),
+                    message=_pod_message(status),
                 )
             )
             with self._lock:
@@ -319,7 +344,7 @@ class KubernetesClusterContext:
                 .get("nodeSelector", {})
                 .get(self.node_id_label, p.get("spec", {}).get("nodeName", "")),
                 phase=_PHASES.get(status.get("phase", "Pending"), PodPhase.PENDING),
-                message=status.get("message", ""),
+                message=_pod_message(status),
             )
         for p in self.pod_states():
             if p.run_id == run_id:

@@ -1,0 +1,76 @@
+"""Configurable pending-pod checks.
+
+Equivalent of the reference's podchecks (internal/executor/podchecks/
+pod_checks.go + config/executor/config.yaml pendingPodChecks): regex rules
+over a pending pod's diagnostic text (events / container-status reasons),
+each with a grace period, resolving to ACTION_RETRY (return the lease, the
+job reschedules elsewhere) or ACTION_FAIL (terminal error -- e.g. an invalid
+image name that will never pull).  `inverse` rules match when the regex does
+NOT appear (the reference's catch-all "no scheduling progress" rule).
+
+The blanket stuck-PENDING timeout in ExecutorService remains the backstop;
+these rules act earlier and can fail fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+ACTION_FAIL = "fail"
+ACTION_RETRY = "retry"
+
+
+@dataclasses.dataclass(frozen=True)
+class PodCheckRule:
+    """One rule: `regexp` against the pod's diagnostic message, active once
+    the pod has been PENDING for `grace_s` seconds."""
+
+    regexp: str
+    action: str  # ACTION_FAIL | ACTION_RETRY
+    grace_s: float = 0.0
+    inverse: bool = False
+
+    def __post_init__(self):
+        if self.action not in (ACTION_FAIL, ACTION_RETRY):
+            raise ValueError(f"bad pod-check action {self.action!r}")
+        object.__setattr__(self, "_re", re.compile(self.regexp))
+
+    def matches(self, message: str, pending_for_s: float) -> bool:
+        if pending_for_s < self.grace_s:
+            return False
+        hit = bool(self._re.search(message or ""))
+        return (not hit) if self.inverse else hit
+
+
+def rules_from_config(entries: Sequence[dict]) -> tuple:
+    """YAML-shaped dicts (reference key names) -> rules:
+    {regexp, action: Fail|Retry, gracePeriod: \"90s\", inverse: false}."""
+    from armada_tpu.core.config import parse_duration_s
+
+    return tuple(
+        PodCheckRule(
+            regexp=e["regexp"],
+            action=str(e.get("action", "Retry")).lower(),
+            grace_s=parse_duration_s(e.get("gracePeriod", 0)),
+            inverse=bool(e.get("inverse", False)),
+        )
+        for e in entries
+    )
+
+
+def evaluate(
+    rules: Sequence[PodCheckRule], message: str, pending_for_s: float
+) -> Optional[str]:
+    """All matching rules combine at MAX severity -- Fail beats Retry
+    regardless of config order (the reference's maxAction, podchecks/
+    action.go:42, pod_checks.go:72): a retryable symptom must never mask a
+    fatal one appearing in the same diagnostics."""
+    action = None
+    for rule in rules:
+        if rule.matches(message, pending_for_s):
+            if rule.action == ACTION_FAIL:
+                return ACTION_FAIL
+            action = rule.action
+    return action

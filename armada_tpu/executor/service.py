@@ -39,10 +39,13 @@ class ExecutorService:
         factory: ResourceListFactory,
         clock: Callable[[], float] = time.time,
         pending_timeout_s: float = 600.0,
+        pod_check_rules: tuple = (),
     ):
         """pending_timeout_s: pods stuck PENDING this long are returned for
         rescheduling (podchecks' stuck-pod detection,
-        internal/executor/podchecks/pod_checks.go); <= 0 disables."""
+        internal/executor/podchecks/pod_checks.go); <= 0 disables.
+        pod_check_rules: regex rules over pending pods' diagnostics that can
+        retry or fail-fast before the blanket timeout (executor/podchecks.py)."""
         self.id = executor_id
         self.pool = pool
         self.cluster = cluster
@@ -50,6 +53,7 @@ class ExecutorService:
         self._factory = factory
         self._clock = clock
         self._pending_timeout = pending_timeout_s
+        self._pod_check_rules = tuple(pod_check_rules)
         self._pending_since: dict[str, float] = {}
         # run_id -> last phase reported to the scheduler
         self._reported: dict[str, PodPhase] = {}
@@ -222,12 +226,15 @@ class ExecutorService:
     # --- stuck-pod checks (podchecks/pod_checks.go) -------------------------
 
     def check_stuck_pods(self) -> int:
-        """Return pods stuck PENDING past the timeout; the scheduler requeues
-        them elsewhere (ACTION_RETRY of the reference's pod checks)."""
-        if self._pending_timeout <= 0:
+        """Apply the configured pending-pod checks, then the blanket stuck-
+        PENDING timeout (podchecks/pod_checks.go: rule actions Fail/Retry,
+        timeout = the catch-all ACTION_RETRY)."""
+        if self._pending_timeout <= 0 and not self._pod_check_rules:
             return 0
+        from armada_tpu.executor.podchecks import ACTION_FAIL, evaluate
+
         now = self._clock()
-        returned = 0
+        acted = 0
         sequences: list[pb.EventSequence] = []
         current = {p.run_id for p in self.cluster.pod_states()}
         # pods deleted by other paths (cancel/preempt) must not leak entries
@@ -235,36 +242,47 @@ class ExecutorService:
             k: v for k, v in self._pending_since.items() if k in current
         }
         for pod in list(self.cluster.pod_states()):
-            if pod.phase is PodPhase.PENDING:
-                since = self._pending_since.setdefault(pod.run_id, now)
-                if now - since > self._pending_timeout:
-                    self.cluster.delete_pod(pod.run_id)
-                    self._reported.pop(pod.run_id, None)
-                    self._pending_since.pop(pod.run_id, None)
-                    self._awaiting_ack.add(pod.run_id)
-                    sequences.append(
-                        _run_error_sequence(
-                            pod.queue,
-                            pod.jobset,
-                            pod.job_id,
-                            pod.run_id,
-                            reason="podStuckPending",
-                            message=(
-                                f"pod pending for more than {self._pending_timeout}s"
-                            ),
-                            now_ns=int(now * 1e9),
-                            node=pod.node_id,
-                            # retryable: the run is over, the job goes elsewhere
-                            terminal=False,
-                            lease_returned=True,
-                        )
-                    )
-                    returned += 1
-            else:
+            if pod.phase is not PodPhase.PENDING:
                 self._pending_since.pop(pod.run_id, None)
+                continue
+            since = self._pending_since.setdefault(pod.run_id, now)
+            action = evaluate(self._pod_check_rules, pod.message, now - since)
+            reason, message = "podCheckFailed", ""
+            if action is not None:
+                message = f"pod check matched: {pod.message or '(no diagnostics)'}"
+            elif (
+                self._pending_timeout > 0
+                and now - since > self._pending_timeout
+            ):
+                action = "retry"
+                reason = "podStuckPending"
+                message = f"pod pending for more than {self._pending_timeout}s"
+            if action is None:
+                continue
+            self.cluster.delete_pod(pod.run_id)
+            self._reported.pop(pod.run_id, None)
+            self._pending_since.pop(pod.run_id, None)
+            self._awaiting_ack.add(pod.run_id)
+            sequences.append(
+                _run_error_sequence(
+                    pod.queue,
+                    pod.jobset,
+                    pod.job_id,
+                    pod.run_id,
+                    reason=reason,
+                    message=message,
+                    now_ns=int(now * 1e9),
+                    node=pod.node_id,
+                    # Fail = terminal error; Retry = lease returned, the job
+                    # reschedules elsewhere.
+                    terminal=action == ACTION_FAIL,
+                    lease_returned=action != ACTION_FAIL,
+                )
+            )
+            acted += 1
         if sequences:
             self.api.report_events(sequences)
-        return returned
+        return acted
 
     def run_once(self) -> None:
         """One full agent iteration: lease, report, check, clean."""

@@ -1,0 +1,149 @@
+"""Configurable pending-pod checks (internal/executor/podchecks/
+pod_checks.go + config.yaml pendingPodChecks)."""
+
+import pytest
+
+from armada_tpu.executor.podchecks import (
+    ACTION_FAIL,
+    ACTION_RETRY,
+    PodCheckRule,
+    evaluate,
+    rules_from_config,
+)
+from tests.control_plane import ControlPlane
+from armada_tpu.server import JobSubmitItem, QueueRecord
+
+
+def test_rule_matching_grace_and_inverse():
+    fail_fast = PodCheckRule(regexp="InvalidImageName", action=ACTION_FAIL)
+    backoff = PodCheckRule(
+        regexp="ImagePullBackOff", action=ACTION_RETRY, grace_s=60
+    )
+    progress = PodCheckRule(
+        regexp="nodes are available", action=ACTION_RETRY, grace_s=120, inverse=True
+    )
+    rules = (fail_fast, backoff, progress)
+    # fail-fast matches immediately
+    assert evaluate(rules, "InvalidImageName: https://x", 0) == ACTION_FAIL
+    # backoff respects its grace period
+    assert evaluate(rules, "ImagePullBackOff", 30) is None
+    assert evaluate(rules, "ImagePullBackOff", 90) == ACTION_RETRY
+    # inverse: no scheduling progress at all -> retry after the grace
+    assert evaluate(rules, "", 60) is None
+    assert evaluate(rules, "", 150) == ACTION_RETRY
+    assert evaluate(rules, "0/3 nodes are available", 150) is None
+
+
+def test_rules_from_reference_shaped_yaml():
+    rules = rules_from_config(
+        [
+            {"regexp": "Failed to pull image", "action": "Fail", "gracePeriod": "90s"},
+            {"regexp": "nodes are available", "action": "Retry",
+             "gracePeriod": "5m", "inverse": True},
+        ]
+    )
+    assert rules[0].action == ACTION_FAIL and rules[0].grace_s == 90.0
+    assert rules[1].inverse and rules[1].grace_s == 300.0
+    with pytest.raises(ValueError, match="action"):
+        PodCheckRule(regexp="x", action="explode")
+
+
+def test_fail_fast_rule_fails_job_terminally(tmp_path):
+    cp = ControlPlane.build(tmp_path, executor_specs={"ex1": (2, "8", "32")})
+    cp.server.create_queue(QueueRecord("q"))
+    ex = cp.executors[0]
+    ex._pod_check_rules = (
+        PodCheckRule(regexp="InvalidImageName", action=ACTION_FAIL),
+    )
+    ex.cluster._start_delay = 10_000.0  # stays PENDING
+    (jid,) = cp.server.submit_jobs(
+        "q", "js", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})]
+    )
+    ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    ex.run_once()
+    (pod,) = ex.cluster.pod_states()
+    ex.cluster.set_pod_message(pod.run_id, "InvalidImageName: https://oops")
+    assert ex.check_stuck_pods() == 1
+    assert ex.cluster.pod_states() == []
+    cp.ingest()
+    res = cp.scheduler.cycle()
+    assert res.events_by_kind().get("job_errors") == 1  # terminal, no requeue
+    assert cp.jobdb.read_txn().get(jid) is None or cp.jobdb.read_txn().get(jid).failed
+    cp.close()
+
+
+def test_retry_rule_returns_lease_and_reschedules(tmp_path):
+    cp = ControlPlane.build(tmp_path, executor_specs={"ex1": (2, "8", "32")})
+    cp.server.create_queue(QueueRecord("q"))
+    ex = cp.executors[0]
+    ex._pod_check_rules = (
+        PodCheckRule(regexp="ImagePullBackOff", action=ACTION_RETRY, grace_s=30),
+    )
+    ex.cluster._start_delay = 10_000.0
+    (jid,) = cp.server.submit_jobs(
+        "q", "js", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})]
+    )
+    ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+    cp.ingest()
+    ex.run_once()
+    (pod,) = ex.cluster.pod_states()
+    ex.cluster.set_pod_message(pod.run_id, "ImagePullBackOff")
+    assert ex.check_stuck_pods() == 0  # inside the grace period
+    cp.clock.advance(60.0)
+    ex.cluster.tick(0.0)
+    assert ex.check_stuck_pods() == 1
+    cp.ingest()
+    res = cp.scheduler.cycle()
+    assert res.events_by_kind().get("job_requeued") == 1
+    cp.close()
+
+
+def test_fail_beats_retry_regardless_of_order():
+    """maxAction semantics (podchecks/action.go): a retryable symptom never
+    masks a fatal one in the same diagnostics."""
+    rules = (
+        PodCheckRule(regexp="Back-off pulling image", action=ACTION_RETRY),
+        PodCheckRule(regexp="InvalidImageName", action=ACTION_FAIL),
+    )
+    both = "Back-off pulling image x; InvalidImageName: bad"
+    assert evaluate(rules, both, 10) == ACTION_FAIL
+
+
+def test_k8s_adapter_surfaces_scheduling_conditions():
+    from tests.fake_kube_api import FakeKubeApi
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.core.types import JobSpec
+    from armada_tpu.executor.kubernetes import KubernetesClusterContext
+
+    F = SchedulingConfig(shape_bucket=32).resource_list_factory()
+    kube = FakeKubeApi()
+    try:
+        ctx = KubernetesClusterContext(kube.url, F)
+        ctx.submit_pod(
+            "r1", "j1", "q", "js",
+            JobSpec(id="j1", queue="q",
+                    resources=F.from_mapping({"cpu": "1", "memory": "1"})),
+            "w1",
+        )
+        ((ns, name),) = kube.pods
+        kube.pods[(ns, name)]["status"] = {
+            "phase": "Pending",
+            "conditions": [
+                {"type": "PodScheduled", "status": "False",
+                 "reason": "Unschedulable",
+                 "message": "0/3 nodes are available: insufficient cpu"}
+            ],
+        }
+        (p,) = ctx.pod_states()
+        assert "0/3 nodes are available" in p.message
+        # an inverse no-progress rule correctly sees progress text
+        rule = PodCheckRule(regexp="nodes are available", action=ACTION_RETRY,
+                            grace_s=0, inverse=True)
+        assert evaluate((rule,), p.message, 100) is None
+    finally:
+        kube.stop()
