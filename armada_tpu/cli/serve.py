@@ -335,17 +335,25 @@ def run_fake_executor(
         cluster = FakeClusterContext(
             nodes, factory, runtime_of=lambda s: default_runtime_s
         )
-    pod_check_rules = ()
+    pod_check_rules, failed_pod_checker = (), None
     if pod_checks_file:
         import yaml
 
-        from armada_tpu.executor.podchecks import rules_from_config
+        from armada_tpu.executor.podchecks import checks_from_config
 
         with open(pod_checks_file) as f:
-            pod_check_rules = rules_from_config(yaml.safe_load(f) or [])
+            pod_check_rules, failed_pod_checker = checks_from_config(
+                yaml.safe_load(f)
+            )
     api = ExecutorApiClient(server_address)
     agent = ExecutorService(
-        executor_id, pool, cluster, api, factory, pod_check_rules=pod_check_rules
+        executor_id,
+        pool,
+        cluster,
+        api,
+        factory,
+        pod_check_rules=pod_check_rules,
+        failed_pod_checker=failed_pod_checker,
     )
     binoculars_server = None
     if binoculars_port is not None:

@@ -63,12 +63,15 @@ def _pod_message(status: dict) -> str:
             reason = cond.get("reason", "")
             msg = cond.get("message", "")
             parts.append(f"{reason}: {msg}" if msg else reason)
-    for cs in status.get("containerStatuses", ()):
-        waiting = cs.get("state", {}).get("waiting")
-        if waiting:
-            reason = waiting.get("reason", "")
-            msg = waiting.get("message", "")
-            parts.append(f"{reason}: {msg}" if msg else reason)
+    # Init containers too (util/pod_util.go:263-266 appends them before the
+    # checks match): a stuck init image is as fatal as a stuck main one.
+    for key in ("initContainerStatuses", "containerStatuses"):
+        for cs in status.get(key, ()):
+            waiting = cs.get("state", {}).get("waiting")
+            if waiting:
+                reason = waiting.get("reason", "")
+                msg = waiting.get("message", "")
+                parts.append(f"{reason}: {msg}" if msg else reason)
     return "; ".join(p for p in parts if p)
 
 

@@ -60,6 +60,44 @@ def rules_from_config(entries: Sequence[dict]) -> tuple:
     )
 
 
+class FailedPodRetryChecker:
+    """Retryable failed-pod checks (internal/executor/podchecks/
+    failedpodchecks/): a FAILED pod whose diagnostics match any regex is
+    reported as a returned lease (the job reschedules) instead of a
+    terminal error -- e.g. node-level infrastructure deaths."""
+
+    def __init__(self, regexps: Sequence[str] = ()):
+        self._res = tuple(re.compile(r) for r in regexps)
+
+    def is_retryable(self, message: str) -> bool:
+        return any(r.search(message or "") for r in self._res)
+
+
+def checks_from_config(doc) -> tuple:
+    """(pending rules, FailedPodRetryChecker) from YAML: either a bare list
+    (pending rules only) or {pending: [...], failedRetryable: [regexp, ...]}.
+    Unknown keys raise -- a misspelled section must not silently disable
+    every check."""
+    if doc is None:
+        return (), FailedPodRetryChecker()
+    if isinstance(doc, dict):
+        unknown = set(doc) - {"pending", "failedRetryable"}
+        if unknown:
+            raise ValueError(
+                f"unknown pod-check sections {sorted(unknown)}; "
+                "expected 'pending' and/or 'failedRetryable'"
+            )
+        return (
+            rules_from_config(doc.get("pending", ())),
+            FailedPodRetryChecker(doc.get("failedRetryable", ())),
+        )
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"pod-check config must be a list or mapping, got {type(doc).__name__}"
+        )
+    return rules_from_config(doc), FailedPodRetryChecker()
+
+
 def evaluate(
     rules: Sequence[PodCheckRule], message: str, pending_for_s: float
 ) -> Optional[str]:

@@ -40,6 +40,7 @@ class ExecutorService:
         clock: Callable[[], float] = time.time,
         pending_timeout_s: float = 600.0,
         pod_check_rules: tuple = (),
+        failed_pod_checker=None,
     ):
         """pending_timeout_s: pods stuck PENDING this long are returned for
         rescheduling (podchecks' stuck-pod detection,
@@ -54,6 +55,9 @@ class ExecutorService:
         self._clock = clock
         self._pending_timeout = pending_timeout_s
         self._pod_check_rules = tuple(pod_check_rules)
+        # Retryable failed-pod checks (podchecks/failedpodchecks/): None =
+        # every pod failure is terminal.
+        self._failed_pod_checker = failed_pod_checker
         self._pending_since: dict[str, float] = {}
         # run_id -> last phase reported to the scheduler
         self._reported: dict[str, PodPhase] = {}
@@ -183,16 +187,24 @@ class ExecutorService:
                 ev.job_run_succeeded.job_id = pod.job_id
                 ev.job_run_succeeded.run_id = pod.run_id
             elif pod.phase is PodPhase.FAILED:
+                retryable = (
+                    self._failed_pod_checker is not None
+                    and self._failed_pod_checker.is_retryable(pod.message)
+                )
                 sequences.append(
                     _run_error_sequence(
                         pod.queue,
                         pod.jobset,
                         pod.job_id,
                         pod.run_id,
-                        reason="podFailed",
+                        reason="podFailedRetryable" if retryable else "podFailed",
                         message=pod.message or "pod failed",
                         now_ns=now_ns,
                         node=pod.node_id,
+                        # Retryable infra deaths return the lease so the job
+                        # reschedules (failedpodchecks/pod_checks.go).
+                        terminal=not retryable,
+                        lease_returned=retryable,
                     )
                 )
                 self._reported[pod.run_id] = pod.phase
@@ -247,8 +259,11 @@ class ExecutorService:
                 continue
             since = self._pending_since.setdefault(pod.run_id, now)
             action = evaluate(self._pod_check_rules, pod.message, now - since)
-            reason, message = "podCheckFailed", ""
+            reason, message = "", ""
             if action is not None:
+                reason = (
+                    "podCheckFailed" if action == ACTION_FAIL else "podCheckRetry"
+                )
                 message = f"pod check matched: {pod.message or '(no diagnostics)'}"
             elif (
                 self._pending_timeout > 0

@@ -147,3 +147,74 @@ def test_k8s_adapter_surfaces_scheduling_conditions():
         assert evaluate((rule,), p.message, 100) is None
     finally:
         kube.stop()
+
+
+def test_retryable_failed_pod_requeues_instead_of_failing(tmp_path):
+    """failedpodchecks: a FAILED pod matching a retryable regex returns the
+    lease (job reschedules); non-matching failures stay terminal."""
+    from armada_tpu.executor.podchecks import FailedPodRetryChecker
+
+    cp = ControlPlane.build(tmp_path, executor_specs={"ex1": (2, "8", "32")})
+    cp.server.create_queue(QueueRecord("q"))
+    ex = cp.executors[0]
+    ex._failed_pod_checker = FailedPodRetryChecker(("node shutdown", "Evicted"))
+
+    def run_to_failure(message):
+        (jid,) = cp.server.submit_jobs(
+            "q", "js", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})]
+        )
+        ex.run_once()
+        cp.ingest()
+        cp.scheduler.cycle()
+        cp.ingest()
+        ex.run_once()
+        run = cp.jobdb.read_txn().get(jid).latest_run
+        ex.cluster.fail_pod(run.id, message)
+        ex.report_cycle()
+        ex.cleanup()
+        cp.ingest()
+        return cp.scheduler.cycle()
+
+    res1 = run_to_failure("node shutdown during maintenance")
+    assert res1.events_by_kind().get("job_requeued") == 1
+
+    res2 = run_to_failure("OOMKilled: exit 137")
+    assert res2.events_by_kind().get("job_errors") == 1
+    cp.close()
+
+
+def test_checks_from_config_mapping_and_list():
+    from armada_tpu.executor.podchecks import checks_from_config
+
+    pend, failed = checks_from_config(
+        {
+            "pending": [{"regexp": "ImagePullBackOff", "action": "Retry"}],
+            "failedRetryable": ["node shutdown"],
+        }
+    )
+    assert len(pend) == 1 and failed.is_retryable("node shutdown now")
+    assert not failed.is_retryable("OOMKilled")
+    pend2, failed2 = checks_from_config([{"regexp": "x", "action": "Fail"}])
+    assert len(pend2) == 1 and not failed2.is_retryable("anything")
+
+
+def test_config_rejects_unknown_sections_and_bad_types():
+    from armada_tpu.executor.podchecks import checks_from_config
+
+    with pytest.raises(ValueError, match="unknown pod-check sections"):
+        checks_from_config({"pendingPodChecks": []})
+    with pytest.raises(ValueError, match="list or mapping"):
+        checks_from_config("regexp: x")
+
+
+def test_init_container_statuses_feed_diagnostics():
+    from armada_tpu.executor.kubernetes import _pod_message
+
+    msg = _pod_message(
+        {
+            "initContainerStatuses": [
+                {"state": {"waiting": {"reason": "InvalidImageName"}}}
+            ]
+        }
+    )
+    assert "InvalidImageName" in msg
