@@ -149,6 +149,10 @@ class SchedulingConfig:
     enable_assertions: bool = False
     # Pool-level resources never bound to nodes (floatingresources/).
     floating_resources: tuple[FloatingResource, ...] = ()
+    # Publish per-cycle per-pool metrics to the event log (the reference's
+    # metric-events Pulsar topic, pkg/metricevents): consumers subscribe to
+    # the "armada-metrics" stream instead of scraping Prometheus.
+    publish_metric_events: bool = False
     # Node quarantine (README.md:28 "removing nodes exhibiting high failure
     # rates"): this many attempted-run deaths on one node within the window
     # excludes it from scheduling for the cooldown.  0 disables.
@@ -350,6 +354,7 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("maxRetries", "max_retries"),
         ("nodeIdLabel", "node_id_label"),
         ("enableAssertions", "enable_assertions"),
+        ("publishMetricEvents", "publish_metric_events"),
         ("nodeQuarantineFailureThreshold", "node_quarantine_failure_threshold"),
         ("optimiserEnabled", "optimiser_enabled"),
         ("optimiserMaxStuckJobs", "optimiser_max_stuck_jobs"),

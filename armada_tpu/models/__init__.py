@@ -61,6 +61,7 @@ def run_scheduling_round(
         slot_width=ctx.slot_width,
     )
     outcome = decode_result(result, ctx)
+    outcome.pool_totals = ctx.pool_total_atoms
     if collect_stats:
         # Extra device->host transfer + host-side DRF recompute: skipped when
         # neither metrics nor reports consume it.

@@ -141,6 +141,11 @@ class HostContext:
     pc_names: list  # priority-class index -> name
     max_slots: int
     slot_width: int
+    # Host-only extras for metrics: raw (uncapped) per-queue demand shares and
+    # the pool's fairness total in resource atoms (node + floating capacity --
+    # the denominator every published share is a fraction of).
+    q_demand_raw: list = dataclasses.field(default_factory=list)
+    pool_total_atoms: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -160,6 +165,9 @@ class RoundOutcome:
     # Market pools: bid price of the gang that crossed the spot cutoff this
     # round (queue_scheduler.go:135-150); None when unset/not market.
     spot_price: Optional[float] = None
+    # Pool fairness total (resource name -> atoms, node + floating): the
+    # denominator of every share above (feeds metric events).
+    pool_totals: dict = dataclasses.field(default_factory=dict)
 
 
 def _pad(n: int, bucket: int) -> int:
@@ -767,10 +775,14 @@ def build_problem(
         float_total = (
             factory.floor_units(fl.atoms).astype(np.float64) * (1 - node_axes)
         ).astype(np.float32)
-    total_pool = node_total[: len(pool_nodes)].sum(axis=0, dtype=np.float64).astype(np.float32)
+    # Keep an exact f64 copy: the f32 device tensor is fine for shares, but
+    # metric events publish the totals as exact quantities (a 50k-node pool's
+    # byte count exceeds f32's 2^24 integer range).
+    total_pool64 = node_total[: len(pool_nodes)].sum(axis=0, dtype=np.float64)
     # Floating capacity joins the pool totals for fairness + caps
     # (scheduling_algo.go:289,585 adds GetTotalAvailableForPool).
-    total_pool = total_pool + float_total
+    total_pool64 = total_pool64 + float_total.astype(np.float64)
+    total_pool = total_pool64.astype(np.float32)
     drf_mult = factory.multipliers_for(config.drf_multipliers()).astype(np.float32)
     scale = node_total.max(axis=0) if len(pool_nodes) else np.zeros(R, np.float32)
     inv_scale = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-9), 0.0).astype(np.float32)
@@ -864,13 +876,21 @@ def build_problem(
             (run_queue[:nr][rv], run_pc[:nr][rv]),
             run_req[:nr][rv].astype(np.float64),
         )
+    q_demand_raw = [0.0] * len(sorted_queues)
     for qi, q in enumerate(sorted_queues):
         q_weight[qi] = q.weight
+        raw = demand_by_pc[qi].sum(axis=0)
         capped = np.minimum(demand_by_pc[qi], pc_queue_cap).sum(axis=0)
         capped = np.minimum(capped, total_pool.astype(np.float64))
         with np.errstate(divide="ignore", invalid="ignore"):
             frac = np.where(total_pool > 0, capped / np.maximum(total_pool, 1e-9), 0.0)
+            rawfrac = np.where(
+                total_pool > 0, raw / np.maximum(total_pool, 1e-9), 0.0
+            )
         q_cds[qi] = max(0.0, float((frac * drf_mult).max())) if R else 0.0
+        # RAW demand share (may exceed 1) for metric events: the reference's
+        # metricevents distinguishes demand from constrained_demand.
+        q_demand_raw[qi] = max(0.0, float((rawfrac * drf_mult).max())) if R else 0.0
 
     # --- burst caps, clamped by the rate limiters' available tokens -----------
     burst_cfg = config.maximum_scheduling_burst or 2**31 - 1
@@ -960,6 +980,12 @@ def build_problem(
         pc_names=pc_names,
         max_slots=S,
         slot_width=W,
+        q_demand_raw=q_demand_raw,
+        pool_total_atoms={
+            name: int(round(float(total_pool64[i]) * factory.resolutions[i]))
+            for i, name in enumerate(factory.names)
+            if total_pool64[i]
+        },
     )
     return problem, ctx
 
@@ -996,6 +1022,11 @@ def queue_stats_from_result(result, problem: SchedulingProblem, ctx: HostContext
             "adjusted_fair_share": float(afs[qi]),
             "actual_share": float(actual[qi]),
             "demand_share": float(problem.q_cds[qi]),
+            # RAW demand (may exceed 1; metricevents distinguishes it from
+            # the constrained demand_share above).
+            "demand_share_raw": (
+                float(ctx.q_demand_raw[qi]) if qi < len(ctx.q_demand_raw) else 0.0
+            ),
             # cycle_metrics.go:443: unweighted cost of the penalty RL.
             "short_job_penalty": float(penalty[qi]),
         }

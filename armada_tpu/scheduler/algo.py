@@ -126,8 +126,13 @@ class FairSchedulingAlgo:
             )
         # Per-queue share stats cost an extra device->host transfer; turn off
         # when neither metrics nor reports are wired.  The optimiser's ideal
-        # victim order NEEDS the shares, so it forces collection.
-        self.collect_stats = collect_stats or self.optimiser is not None
+        # victim order NEEDS the shares, and metric events publish them, so
+        # either forces collection.
+        self.collect_stats = (
+            collect_stats
+            or self.optimiser is not None
+            or config.publish_metric_events
+        )
         # Rate limiters (maximumSchedulingRate token buckets): clamp the
         # per-round burst caps so sustained throughput meets the config.
         self.rate_limiters = SchedulingRateLimiters(

@@ -269,6 +269,8 @@ class Scheduler:
                 result.scheduler_result = sched
                 result.scheduled = True
                 self._events_from_scheduler_result(sched, builder, now_ns)
+                if self.config.publish_metric_events:
+                    self._metric_events(sched, builder, now_ns)
 
             sequences = builder.build()
             if sequences:
@@ -507,6 +509,49 @@ class Scheduler:
                 ),
             )
             txn.upsert(job.with_failed())
+
+    # --- metric events (pkg/metricevents; cycle_metrics.go:637-671) ---------
+
+    METRICS_QUEUE = "armada-metrics"
+    METRICS_JOBSET = "cycle-metrics"
+
+    def _metric_events(
+        self, sched, builder: "_SequenceBuilder", now_ns: int
+    ) -> None:
+        """One CycleMetrics event per pool onto the log under the reserved
+        ("armada-metrics", "cycle-metrics") stream: the reference's
+        metric-events topic, watchable via the ordinary Event API.  The
+        published totals are the round's OWN fairness denominator (node +
+        floating capacity, RoundOutcome.pool_totals) -- every share in the
+        event is a fraction of exactly these numbers."""
+        for stats in sched.pools:
+            alloc = pb.Resources(milli=dict(stats.outcome.pool_totals))
+            qm = [
+                pb.QueueCycleMetrics(
+                    queue=qname,
+                    actual_share=qs.get("actual_share", 0.0),
+                    demand=qs.get("demand_share_raw", 0.0),
+                    constrained_demand=qs.get("demand_share", 0.0),
+                    fair_share=qs.get("fair_share", 0.0),
+                    adjusted_fair_share=qs.get("adjusted_fair_share", 0.0),
+                    short_job_penalty=qs.get("short_job_penalty", 0.0),
+                )
+                for qname, qs in stats.outcome.queue_stats.items()
+            ]
+            builder.add(
+                self.METRICS_QUEUE,
+                self.METRICS_JOBSET,
+                pb.Event(
+                    created_ns=now_ns,
+                    cycle_metrics=pb.CycleMetrics(
+                        pool=stats.pool,
+                        queue_metrics=qm,
+                        allocatable_resources=alloc,
+                        spot_price=stats.outcome.spot_price or 0.0,
+                        cycle_time_ns=now_ns,
+                    ),
+                ),
+            )
 
     # --- validation (scheduler.go submitCheck:1011, submitcheck.go Check:181)
 

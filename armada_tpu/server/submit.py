@@ -307,6 +307,13 @@ class SubmitServer:
 
     def create_queue(self, record, principal: Principal = Principal()) -> None:
         self._auth.authorize_action(principal, Permission.CREATE_QUEUE)
+        if record.name.startswith("armada-"):
+            # "armada-*" is reserved for system streams (e.g. the
+            # armada-metrics cycle-metrics stream): user traffic must never
+            # interleave with scheduler telemetry.
+            raise ValueError(
+                f"queue name {record.name!r} is reserved (armada- prefix)"
+            )
         self._queues.create(record)
 
     def update_queue(self, record, principal: Principal = Principal()) -> None:

@@ -1,0 +1,92 @@
+"""Cycle metric events to the log (pkg/metricevents equivalent: external
+consumers subscribe to the armada-metrics stream instead of scraping)."""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig, scheduling_config_from_dict
+from armada_tpu.scheduler.scheduler import Scheduler
+from armada_tpu.server import JobSubmitItem, QueueRecord
+from tests.control_plane import ControlPlane
+
+
+def test_yaml_knob():
+    cfg = scheduling_config_from_dict({"publishMetricEvents": True})
+    assert cfg.publish_metric_events
+
+
+def test_cycle_metrics_events_flow_to_the_stream(tmp_path):
+    cfg = SchedulingConfig(
+        shape_bucket=32, enable_assertions=True, publish_metric_events=True
+    )
+    cp = ControlPlane.build(tmp_path, config=cfg)
+    cp.server.create_queue(QueueRecord("q"))
+    for ex in cp.executors:
+        ex.run_once()
+    cp.server.submit_jobs(
+        "q", "js", [JobSubmitItem(resources={"cpu": "2", "memory": "2"})] * 3
+    )
+    cp.ingest()
+    res = cp.scheduler.cycle()
+    metric_seqs = [
+        s for s in res.published if s.queue == Scheduler.METRICS_QUEUE
+    ]
+    assert metric_seqs, "no metric events published"
+    (ev,) = [e for s in metric_seqs for e in s.events]
+    cm = ev.cycle_metrics
+    assert cm.pool == "default"
+    assert cm.allocatable_resources.milli["cpu"] > 0
+    stats = {m.queue: m for m in cm.queue_metrics}
+    assert stats["q"].actual_share > 0  # jobs just leased
+    assert stats["q"].fair_share == 1.0
+
+    # the stream is watchable through the ordinary event API
+    cp.ingest()
+    events = cp.event_api.get_jobset_events(
+        Scheduler.METRICS_QUEUE, Scheduler.METRICS_JOBSET, from_idx=0
+    )
+    kinds = [
+        e.WhichOneof("event") for _, seq in events for e in seq.events
+    ]
+    assert "cycle_metrics" in kinds
+    cp.close()
+
+
+def test_demand_vs_constrained_demand_and_reserved_queue(tmp_path):
+    from armada_tpu.core.config import PriorityClass
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        enable_assertions=True,
+        publish_metric_events=True,
+        priority_classes={
+            "armada-default": PriorityClass(
+                "armada-default", priority=1000,
+                maximum_resource_fraction_per_queue={"cpu": 0.5, "memory": 1.0},
+            ),
+        },
+    )
+    cp = ControlPlane.build(tmp_path, config=cfg)
+    cp.server.create_queue(QueueRecord("q"))
+    for ex in cp.executors:
+        ex.run_once()
+    # demand 32 cpu on a 16-cpu fleet: raw demand 2.0, constrained 0.5 (cap)
+    cp.server.submit_jobs(
+        "q", "js", [JobSubmitItem(resources={"cpu": "8", "memory": "1"})] * 4
+    )
+    cp.ingest()
+    res = cp.scheduler.cycle()
+    (ev,) = [
+        e
+        for s in res.published
+        if s.queue == Scheduler.METRICS_QUEUE
+        for e in s.events
+    ]
+    (m,) = [m for m in ev.cycle_metrics.queue_metrics if m.queue == "q"]
+    assert m.demand == pytest.approx(2.0)
+    assert m.constrained_demand == pytest.approx(0.5)
+    # the published totals are the fairness denominator
+    assert ev.cycle_metrics.allocatable_resources.milli["cpu"] == 16_000
+
+    with pytest.raises(ValueError, match="reserved"):
+        cp.server.create_queue(QueueRecord("armada-metrics"))
+    cp.close()
