@@ -168,6 +168,9 @@ class RoundOutcome:
     # Pool fairness total (resource name -> atoms, node + floating): the
     # denominator of every share above (feeds metric events).
     pool_totals: dict = dataclasses.field(default_factory=dict)
+    # job ids evicted this round and re-placed (they keep running; counted by
+    # the realised-value metric like the reference's RescheduledJobSchedulingContexts)
+    rescheduled: list = dataclasses.field(default_factory=list)
 
 
 def _pad(n: int, bucket: int) -> int:
@@ -1056,9 +1059,12 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
                     mi += 1
 
     preempted = []
+    rescheduled = []
     for ri in range(ctx.num_real_runs):
         if run_evicted[ri] and not run_resched[ri]:
             preempted.append(ctx.run_job_ids[ri])
+        elif run_evicted[ri] and run_resched[ri]:
+            rescheduled.append(ctx.run_job_ids[ri])
 
     failed = []
     for gi in range(ctx.num_real_gangs):
@@ -1091,6 +1097,7 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
     return RoundOutcome(
         scheduled=scheduled,
         preempted=preempted,
+        rescheduled=rescheduled,
         failed=failed,
         num_iterations=int(result.iterations),
         termination=_TERMINATIONS[int(result.termination)],

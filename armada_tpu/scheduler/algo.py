@@ -38,11 +38,14 @@ class PoolStats:
     num_nodes: int
     num_queued: int
     num_running: int
-    # Market pools only (cycle_metrics.go:534,455): configured-shape prices
-    # and the per-queue idealised ("boundary-less cluster") values.
+    # Market pools only (cycle_metrics.go:534,455,456): configured-shape
+    # prices, the per-queue idealised ("boundary-less cluster") values, and
+    # the realised values of what actually scheduled -- idealised minus
+    # realised is the expectation gap (idealised_value_scheduler.go:28-33).
     market: bool = False
     indicative_prices: dict = dataclasses.field(default_factory=dict)
     idealised_values: dict = dataclasses.field(default_factory=dict)
+    realised_values: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -443,7 +446,10 @@ class FairSchedulingAlgo:
             stats.indicative_prices = self.gang_pricer.price_pool_gangs(
                 pool, pool_nodes, running_now, bid_price_of
             )
-        from armada_tpu.scheduler.idealised import calculate_idealised_values
+        from armada_tpu.scheduler.idealised import (
+            calculate_idealised_values,
+            value_of_jobs,
+        )
 
         stats.idealised_values = calculate_idealised_values(
             self.config,
@@ -453,6 +459,20 @@ class FairSchedulingAlgo:
             queued_jobs=queued_jobs,
             running=running,
             bid_price_of=bid_price_of,
+        )
+        # Realised value: what this round's actual placements are worth --
+        # newly scheduled jobs plus evicted-and-rescheduled ones
+        # (scheduling_algo.go:670-676 valueFromSchedulingResult on the real
+        # context), in the SAME valuation currency as idealised.
+        spec_of = {j.id: j for j in queued_jobs}
+        spec_of.update({r.job.id: r.job for r in running})
+        placed = (
+            spec_of[jid]
+            for jid in list(outcome.scheduled) + list(outcome.rescheduled)
+            if jid in spec_of
+        )
+        stats.realised_values = value_of_jobs(
+            placed, bid_price_of, self.config.resource_list_factory()
         )
 
     def _optimise_stuck(

@@ -25,6 +25,33 @@ from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 DEFAULT_RESOURCE_UNIT = {"cpu": 1}
 
 
+def value_of_jobs(
+    jobs,
+    bid_price_of: Callable[[JobSpec], float],
+    factory,
+    resource_unit: Optional[Mapping[str, "str | int"]] = None,
+) -> dict:
+    """{queue: Σ bid x resource-units} -- THE valuation currency
+    (idealised_value.go valueFromSchedulingResult): units = max over
+    resources of request/unit.  Shared by the idealised and realised value
+    computations so the expectation gap always compares like with like."""
+    unit = np.asarray(
+        factory.from_mapping(resource_unit or DEFAULT_RESOURCE_UNIT).atoms,
+        np.float64,
+    )
+    values: dict = {}
+    for job in jobs:
+        if job.resources is None:
+            continue
+        req = np.asarray(job.resources.atoms, np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            units = np.where(unit > 0, req / np.maximum(unit, 1e-12), 0.0).max()
+        values[job.queue] = values.get(job.queue, 0.0) + float(
+            bid_price_of(job)
+        ) * float(units)
+    return values
+
+
 def _strip_static_requirements(job: JobSpec) -> JobSpec:
     """StaticRequirementsIgnoringIterator: the mega node has no labels or
     taints, so selectors/tolerations are dropped (idealised_value_scheduler.go:75)."""
@@ -90,20 +117,10 @@ def calculate_idealised_values(
         bid_price_of=bid_price_of,
     )
 
-    unit = np.asarray(
-        factory.from_mapping(resource_unit or DEFAULT_RESOURCE_UNIT).atoms,
-        np.float64,
-    )
     job_by_id = {j.id: j for j in candidates}
-    values: dict = {}
-    for jid in outcome.scheduled:
-        job = job_by_id.get(jid)
-        if job is None or job.resources is None:
-            continue
-        req = np.asarray(job.resources.atoms, np.float64)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            units = np.where(unit > 0, req / np.maximum(unit, 1e-12), 0.0).max()
-        values[job.queue] = values.get(job.queue, 0.0) + bid_price_of(job) * float(
-            units
-        )
-    return values
+    return value_of_jobs(
+        (job_by_id[jid] for jid in outcome.scheduled if jid in job_by_id),
+        bid_price_of,
+        factory,
+        resource_unit,
+    )

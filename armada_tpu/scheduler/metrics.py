@@ -74,6 +74,11 @@ class SchedulerMetrics:
             "Value each queue would realise on a boundary-less cluster",
             ["pool", "queue"],
         )
+        self.realised_scheduled_value = g(
+            "armada_scheduler_realised_scheduled_value",
+            "Value each queue actually realised this cycle",
+            ["pool", "queue"],
+        )
         self.quarantined_nodes = Gauge(
             "armada_scheduler_quarantined_nodes",
             "Nodes currently excluded for high failure rates",
@@ -175,5 +180,14 @@ class SchedulerMetrics:
                     self.indicative_price_schedulable.labels(
                         stats.pool, name, pr.unschedulable_reason
                     ).set(1.0 if pr.schedulable else 0.0)
-            for qname, value in stats.idealised_values.items():
-                self.idealised_scheduled_value.labels(stats.pool, qname).set(value)
+            if stats.market:
+                # Per-cycle flow values: set 0 for queues with no placements
+                # this cycle, like spot_price above, so stale values never
+                # linger on a quiet queue.
+                for qname in stats.outcome.queue_stats:
+                    self.idealised_scheduled_value.labels(stats.pool, qname).set(
+                        stats.idealised_values.get(qname, 0.0)
+                    )
+                    self.realised_scheduled_value.labels(stats.pool, qname).set(
+                        stats.realised_values.get(qname, 0.0)
+                    )
