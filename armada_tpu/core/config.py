@@ -149,6 +149,11 @@ class SchedulingConfig:
     enable_assertions: bool = False
     # Pool-level resources never bound to nodes (floatingresources/).
     floating_resources: tuple[FloatingResource, ...] = ()
+    # Base priorities for the indicative-share metric (config.yaml
+    # experimentalIndicativeShare.basePriorities): per pool, the share a NEW
+    # queue joining at weight 1/priority would receive, published as
+    # armada_scheduler_indicative_share{pool,priority}.
+    indicative_share_base_priorities: tuple[int, ...] = ()
     # Publish per-cycle per-pool metrics to the event log (the reference's
     # metric-events Pulsar topic, pkg/metricevents): consumers subscribe to
     # the "armada-metrics" stream instead of scraping Prometheus.
@@ -373,6 +378,16 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         kw["maximum_resource_fraction_to_schedule"] = dict(
             d["maximumResourceFractionToSchedule"]
         )
+    if "experimentalIndicativeShare" in d:
+        base = tuple(
+            int(p) for p in d["experimentalIndicativeShare"].get("basePriorities", ())
+        )
+        bad = [p for p in base if p <= 0]
+        if bad:
+            raise ValueError(
+                f"experimentalIndicativeShare.basePriorities must be positive: {bad}"
+            )
+        kw["indicative_share_base_priorities"] = base
     if "indexedNodeLabels" in d:
         kw["indexed_node_labels"] = tuple(d["indexedNodeLabels"])
     if "indexedTaints" in d:

@@ -66,6 +66,14 @@ def run_scheduling_round(
         # Extra device->host transfer + host-side DRF recompute: skipped when
         # neither metrics nor reports consume it.
         outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
+        if config.indicative_share_base_priorities:
+            from armada_tpu.ops.fairness import theoretical_share
+
+            # config parsing rejects non-positive priorities up front
+            outcome.indicative_shares = {
+                p: theoretical_share(problem.q_weight, problem.q_cds, float(p))
+                for p in config.indicative_share_base_priorities
+            }
     return outcome
 
 

@@ -171,6 +171,9 @@ class RoundOutcome:
     # job ids evicted this round and re-placed (they keep running; counted by
     # the realised-value metric like the reference's RescheduledJobSchedulingContexts)
     rescheduled: list = dataclasses.field(default_factory=list)
+    # {base priority: share a new queue at that priority would get}
+    # (CalculateTheoreticalShare; indicative_share metric).
+    indicative_shares: dict = dataclasses.field(default_factory=dict)
 
 
 def _pad(n: int, bucket: int) -> int:

@@ -46,6 +46,20 @@ class FairShares(NamedTuple):
     uncapped_adjusted_fair_share: jax.Array  # share if demand were infinite
 
 
+def theoretical_share(weights, constrained_demand_share, priority: float) -> float:
+    """The demand-capped adjusted fair share a NEW queue with weight
+    1/priority and unbounded demand would receive alongside the given queues
+    (context/scheduling.go CalculateTheoreticalShare:199)."""
+    import numpy as np
+
+    w = np.append(np.asarray(weights, np.float32), np.float32(1.0 / priority))
+    cds = np.append(
+        np.asarray(constrained_demand_share, np.float32), np.float32(1.0)
+    )
+    shares = fair_shares(w, cds)
+    return float(np.asarray(shares.demand_capped_adjusted_fair_share)[-1])
+
+
 def fair_shares(weights, constrained_demand_share, *, max_iterations: int = 10) -> FairShares:
     """Water-filling fair-share computation over [Q] vectors.
 

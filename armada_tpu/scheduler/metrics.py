@@ -79,6 +79,11 @@ class SchedulerMetrics:
             "Value each queue actually realised this cycle",
             ["pool", "queue"],
         )
+        self.indicative_share = g(
+            "armada_scheduler_indicative_share",
+            "Share a new queue at the base priority would receive",
+            ["pool", "priority"],
+        )
         self.quarantined_nodes = Gauge(
             "armada_scheduler_quarantined_nodes",
             "Nodes currently excluded for high failure rates",
@@ -167,6 +172,8 @@ class SchedulerMetrics:
                 )
                 error += abs(qs["adjusted_fair_share"] - qs["actual_share"])
             self.fairness_error.labels(stats.pool).set(error)
+            for prio, share in stats.outcome.indicative_shares.items():
+                self.indicative_share.labels(stats.pool, str(prio)).set(share)
             if stats.market:
                 # Set every cycle -- 0 when no crossing happened -- so a stale
                 # previous-round price never lingers (context/scheduling.go

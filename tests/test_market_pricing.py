@@ -325,3 +325,50 @@ def test_algo_realised_value_tracks_actual_placements():
     # here (the boundary-gap case is test_idealised_value_ignores_node_boundaries)
     assert stats.realised_values == {"q": 24.0}
     assert stats.idealised_values == {"q": 24.0}
+
+
+# --- indicative share (CalculateTheoreticalShare, context/scheduling.go:199)
+
+
+def test_theoretical_share_of_a_new_queue():
+    from armada_tpu.ops.fairness import theoretical_share
+
+    # two demanding queues of weight 1; a phantom at priority 1 (weight 1)
+    # splits the pool three ways
+    share = theoretical_share([1.0, 1.0], [1.0, 1.0], priority=1.0)
+    assert share == pytest.approx(1 / 3, abs=1e-3)
+    # priority 2 -> weight 0.5 -> 0.5 / 2.5
+    share2 = theoretical_share([1.0, 1.0], [1.0, 1.0], priority=2.0)
+    assert share2 == pytest.approx(0.2, abs=1e-3)
+    # idle incumbents donate their spare capacity to the phantom
+    share3 = theoretical_share([1.0, 1.0], [0.0, 0.0], priority=1.0)
+    assert share3 == pytest.approx(1.0, abs=1e-3)
+
+
+def test_indicative_shares_flow_through_the_round():
+    from armada_tpu.core.config import scheduling_config_from_dict
+
+    cfg = scheduling_config_from_dict(
+        {"experimentalIndicativeShare": {"basePriorities": [1, 2]}}
+    )
+    assert cfg.indicative_share_base_priorities == (1, 2)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, shape_bucket=32)
+    f = cfg.resource_list_factory()
+    out = run_scheduling_round(
+        cfg,
+        pool="default",
+        nodes=[
+            NodeSpec(id="n0", pool="default",
+                     total_resources=f.from_mapping({"cpu": "8", "memory": "32"}))
+        ],
+        queues=[Queue("q")],
+        queued_jobs=[
+            JobSpec(id="j1", queue="q",
+                    resources=f.from_mapping({"cpu": "8", "memory": "2"}))
+        ],
+    )
+    # one fully-demanding queue + the phantom at weight 1 -> 1/2
+    assert out.indicative_shares[1] == pytest.approx(0.5, abs=1e-3)
+    assert out.indicative_shares[2] == pytest.approx(1 / 3, abs=1e-2)

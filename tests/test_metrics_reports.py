@@ -148,3 +148,43 @@ def test_job_report_lru_bound():
     assert reports.job_report("j0") is None
     assert reports.job_report("j19") is not None
     assert len(reports._job_reports) == 5
+
+
+def test_indicative_share_gauge_end_to_end(tmp_path):
+    from armada_tpu.core.config import SchedulingConfig
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        enable_assertions=True,
+        indicative_share_base_priorities=(1, 2),
+    )
+    plane = ControlPlane.build(tmp_path, config=cfg)
+    plane.registry = CollectorRegistry()
+    plane.scheduler.metrics = SchedulerMetrics(registry=plane.registry)
+    plane.server.create_queue(QueueRecord("q"))
+    plane.server.submit_jobs("q", "m", [item("8") for _ in range(4)])
+    for ex in plane.executors:
+        ex.run_once()
+    plane.ingest()
+    plane.scheduler.cycle()
+    s1 = sample(
+        plane, "armada_scheduler_indicative_share",
+        {"pool": "default", "priority": "1"},
+    )
+    s2 = sample(
+        plane, "armada_scheduler_indicative_share",
+        {"pool": "default", "priority": "2"},
+    )
+    # one fully-demanding queue + phantom: 1/2 at priority 1, 1/3 at 2
+    assert s1 == pytest.approx(0.5, abs=1e-3)
+    assert s2 == pytest.approx(1 / 3, abs=1e-2)
+    plane.close()
+
+
+def test_base_priorities_must_be_positive():
+    from armada_tpu.core.config import scheduling_config_from_dict
+
+    with pytest.raises(ValueError, match="must be positive"):
+        scheduling_config_from_dict(
+            {"experimentalIndicativeShare": {"basePriorities": [0]}}
+        )
