@@ -147,6 +147,9 @@ class SchedulingConfig:
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
     enable_assertions: bool = False
+    # Pause scheduling while keeping state sync + event processing running
+    # (config.yaml:82 disableScheduling -- operators flip it during incidents).
+    disable_scheduling: bool = False
     # Pool-level resources never bound to nodes (floatingresources/).
     floating_resources: tuple[FloatingResource, ...] = ()
     # Base priorities for the indicative-share metric (config.yaml
@@ -359,6 +362,9 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("maxRetries", "max_retries"),
         ("nodeIdLabel", "node_id_label"),
         ("enableAssertions", "enable_assertions"),
+        ("disableScheduling", "disable_scheduling"),
+        ("executorTimeout", "executor_timeout_s"),
+        ("maxUnacknowledgedJobsPerExecutor", "max_unacknowledged_jobs_per_executor"),
         ("publishMetricEvents", "publish_metric_events"),
         ("nodeQuarantineFailureThreshold", "node_quarantine_failure_threshold"),
         ("optimiserEnabled", "optimiser_enabled"),
@@ -367,7 +373,11 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
     ]:
         if yaml_key in d:
             kw[attr] = d[yaml_key]
-    for attr in ("node_quarantine_window_s", "node_quarantine_cooldown_s"):
+    for attr in (
+        "node_quarantine_window_s",
+        "node_quarantine_cooldown_s",
+        "executor_timeout_s",
+    ):
         if attr in kw:
             kw[attr] = parse_duration_s(kw[attr])
     if "dominantResourceFairnessResourcesToConsider" in d:

@@ -184,6 +184,11 @@ class FairSchedulingAlgo:
         nodes: running jobs keep counting, nothing new lands."""
         now_ns = self._clock_ns() if now_ns is None else now_ns
         result = SchedulerResult()
+        if self.config.disable_scheduling:
+            # Incident brake (config disableScheduling): an EMPTY result, not
+            # a skipped cycle, so metrics/reports cadence continues
+            # (scheduling_algo.go:116 returns an empty SchedulerResult).
+            return result
 
         healthy = self._healthy_executors(executors, now_ns)
         nodes: list[NodeSpec] = []
