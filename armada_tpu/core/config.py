@@ -150,6 +150,11 @@ class SchedulingConfig:
     # Pause scheduling while keeping state sync + event processing running
     # (config.yaml:82 disableScheduling -- operators flip it during incidents).
     disable_scheduling: bool = False
+    # Alternate candidate ordering (queue_scheduler.go Less:598-626): within
+    # budget, order queues by CURRENT cost with larger gangs breaking ties
+    # (reduces fragmentation, helps big gangs on); over-budget queues rank by
+    # proposed cost and always behind within-budget ones.
+    enable_prefer_large_job_ordering: bool = False
     # Pool-level resources never bound to nodes (floatingresources/).
     floating_resources: tuple[FloatingResource, ...] = ()
     # Base priorities for the indicative-share metric (config.yaml
@@ -363,6 +368,7 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("nodeIdLabel", "node_id_label"),
         ("enableAssertions", "enable_assertions"),
         ("disableScheduling", "disable_scheduling"),
+        ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering"),
         ("executorTimeout", "executor_timeout_s"),
         ("maxUnacknowledgedJobsPerExecutor", "max_unacknowledged_jobs_per_executor"),
         ("publishMetricEvents", "publish_metric_events"),

@@ -59,6 +59,12 @@ def run_scheduling_round(
         num_levels=len(ctx.ladder) + 2,
         max_slots=ctx.max_slots,
         slot_width=ctx.slot_width,
+        # Static flag (not a tensor): the default compile carries none of the
+        # alternate-ordering work.  Market pools keep bid ordering.
+        prefer_large=bool(
+            config.enable_prefer_large_job_ordering
+            and not bool(problem.market)
+        ),
     )
     outcome = decode_result(result, ctx)
     outcome.pool_totals = ctx.pool_total_atoms

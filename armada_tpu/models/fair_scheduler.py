@@ -57,6 +57,9 @@ from armada_tpu.ops.packing import (
 
 _BIGI = jnp.int32(2**31 - 1)
 _INF = jnp.float32(3.0e38)
+# Prefer-large ordering: offset lifting over-budget keys above every
+# within-budget key while staying far below the masked-out _INF.
+_PL_OVER = jnp.float32(1.0e30)
 
 TERM_EXHAUSTED = 0
 TERM_GLOBAL_BURST = 1
@@ -144,7 +147,18 @@ def _move_runs_to_evicted(alloc, q_alloc, q_alloc_pc, p: SchedulingProblem, move
     return alloc, q_alloc, q_alloc_pc
 
 
-def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int, check_keys: bool):
+def _make_place_iteration(
+    p: SchedulingProblem,
+    num_levels: int,
+    slot_width: int,
+    check_keys: bool,
+    prefer_large: bool = False,
+    q_budget=None,
+):
+    """prefer_large is a STATIC flag (like check_keys): the default compile
+    carries none of the alternate-ordering work.  q_budget is the per-queue
+    weighted budget from the round's fair-share computation (passed in so the
+    water-filling loop is not traced twice)."""
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
     Q = p.q_weight.shape[0]
@@ -157,6 +171,14 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
     g_float_tot = (
         p.g_req * (1.0 - p.node_axes)[None, :]
     ) * p.g_card[:, None].astype(jnp.float32)  # [G, R] floating total per gang
+    if prefer_large:
+        # itemSize = unweighted gang cost x queue weight (queue_scheduler.go:518
+        # -- a highly-weighted queue's gangs "look larger"); [G], gathered.
+        g_size = unweighted_drf_cost(
+            p.g_req * p.g_card[:, None].astype(jnp.float32),
+            p.total_pool,
+            p.drf_mult,
+        ) * p.q_weight[p.g_queue]
 
     def body(c: _Carry) -> _Carry:
         # --- advance per-queue cursors past retired/unfeasible heads ------------
@@ -198,9 +220,31 @@ def _make_place_iteration(p: SchedulingProblem, num_levels: int, slot_width: int
         proposed = weighted_drf_cost(
             c.q_alloc + p.q_penalty + req_tot_q, p.total_pool, p.drf_mult, p.q_weight
         )
-        proposed = jnp.where(p.market, -p.g_price[cand], proposed)
-        proposed = jnp.where(has, proposed, _INF)
-        qstar = jnp.argmin(proposed).astype(jnp.int32)
+        if prefer_large:
+            # Prefer-large ordering (queue_scheduler.go Less:598-626): queues
+            # within budget rank by CURRENT cost (larger gang breaks exact
+            # ties) and always beat over-budget queues, which rank by
+            # proposed cost.
+            current = weighted_drf_cost(
+                c.q_alloc + p.q_penalty, p.total_pool, p.drf_mult, p.q_weight
+            )
+            size = g_size[cand]
+            within = proposed <= q_budget
+            order_key = jnp.where(within, current, _PL_OVER + proposed)
+            order_key = jnp.where(p.market, -p.g_price[cand], order_key)
+            order_key = jnp.where(has, order_key, _INF)
+            kmin = jnp.min(order_key)
+            tied = has & (order_key == kmin)
+            # among exact ties: the largest gang, then the lowest queue index
+            # (the reference's queue-name tie-break).
+            tie_size = jnp.where(tied, size, -_INF)
+            pick = tied & (tie_size >= jnp.max(tie_size))
+            qidx = jnp.arange(Q, dtype=jnp.int32)
+            qstar = jnp.min(jnp.where(pick, qidx, Q - 1)).astype(jnp.int32)
+        else:
+            order_key = jnp.where(p.market, -p.g_price[cand], proposed)
+            order_key = jnp.where(has, order_key, _INF)
+            qstar = jnp.argmin(order_key).astype(jnp.int32)
         any_q = jnp.any(has)
 
         g = cand[qstar]
@@ -442,7 +486,10 @@ def _phase_b(p: SchedulingProblem, alloc, q_alloc, q_alloc_pc, run_evicted,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_levels", "max_slots", "slot_width", "max_iterations")
+    jax.jit,
+    static_argnames=(
+        "num_levels", "max_slots", "slot_width", "max_iterations", "prefer_large",
+    ),
 )
 def schedule_round(
     p: SchedulingProblem,
@@ -451,6 +498,7 @@ def schedule_round(
     max_slots: int,
     slot_width: int,
     max_iterations: int = 0,
+    prefer_large: bool = False,
 ) -> RoundResult:
     """Run one full scheduling round on device.
 
@@ -526,7 +574,20 @@ def schedule_round(
         spot_res=jnp.zeros((R,), jnp.float32),
     )
 
-    body = _make_place_iteration(p, num_levels, slot_width, check_keys=True)
+    q_budget = None
+    if prefer_large:
+        # weighted budget = adjustedFairShare / weight (queue_scheduler.go:417);
+        # reuses the shares already computed for eviction above.
+        q_budget = jnp.where(
+            p.q_weight > 0,
+            shares.demand_capped_adjusted_fair_share
+            / jnp.maximum(p.q_weight, 1e-9),
+            0.0,
+        )
+    body = _make_place_iteration(
+        p, num_levels, slot_width, check_keys=True,
+        prefer_large=prefer_large, q_budget=q_budget,
+    )
     carry = jax.lax.while_loop(
         lambda c: (~c.done) & (c.iterations < max_iterations), body, carry
     )

@@ -289,3 +289,42 @@ def test_round_is_pure_and_repeatable():
     out2 = run_round(cfg, nodes, [Queue("A")], jobs)
     assert out1.scheduled == out2.scheduled
     assert out1.preempted == out2.preempted
+
+
+def test_prefer_large_job_ordering():
+    """enablePreferLargeJobOrdering (queue_scheduler.go Less:598-626): on an
+    empty farm (equal current costs) the larger gang goes first; the default
+    ordering prefers the cheaper proposed cost instead."""
+    import dataclasses
+
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+    from armada_tpu.models import run_scheduling_round
+
+    # burst 1: only the FIRST candidate schedules, exposing the ordering.
+    # Both queues stay within their budgets (4/8 and 2/8 vs fair 0.5/0.25).
+    cfg = SchedulingConfig(shape_bucket=32, maximum_scheduling_burst=1)
+    f = cfg.resource_list_factory()
+    nodes = [
+        NodeSpec(id="n0", pool="default",
+                 total_resources=f.from_mapping({"cpu": "8", "memory": "32"}))
+    ]
+    queues = [Queue("big"), Queue("small")]
+    jobs = [
+        JobSpec(id="jb", queue="big",
+                resources=f.from_mapping({"cpu": "4", "memory": "2"})),
+        JobSpec(id="js", queue="small",
+                resources=f.from_mapping({"cpu": "2", "memory": "2"})),
+    ]
+    # default: cheapest proposed cost first -> the small job goes first
+    base = run_scheduling_round(
+        cfg, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs
+    )
+    assert "js" in base.scheduled and "jb" not in base.scheduled
+
+    # prefer-large: equal current costs (empty farm), larger job first
+    plcfg = dataclasses.replace(cfg, enable_prefer_large_job_ordering=True)
+    pl = run_scheduling_round(
+        plcfg, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs
+    )
+    assert "jb" in pl.scheduled and "js" not in pl.scheduled
