@@ -158,7 +158,10 @@ def start_control_plane(
         # collide with the previous instance's collectors on the global one.
         registry = CollectorRegistry()
         metrics_server = start_http_server(metrics_port, registry=registry)
-        metrics = SchedulerMetrics(registry=registry)
+        metrics = SchedulerMetrics(
+            registry=registry,
+            state_reset_interval_s=config.job_state_metrics_reset_interval_s,
+        )
     scheduler = Scheduler(
         db,
         jobdb,

@@ -162,6 +162,10 @@ class SchedulingConfig:
     # queue joining at weight 1/priority would receive, published as
     # armada_scheduler_indicative_share{pool,priority}.
     indicative_share_base_priorities: tuple[int, ...] = ()
+    # Reset the job-state counter vectors this often (config.yaml:12
+    # jobStateMetricsResetInterval, 12h in the reference's shipped config):
+    # bounds label-series churn from high-cardinality queue labels.  0 = never.
+    job_state_metrics_reset_interval_s: float = 12 * 3600.0
     # Publish per-cycle per-pool metrics to the event log (the reference's
     # metric-events Pulsar topic, pkg/metricevents): consumers subscribe to
     # the "armada-metrics" stream instead of scraping Prometheus.
@@ -370,6 +374,7 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("disableScheduling", "disable_scheduling"),
         ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering"),
         ("executorTimeout", "executor_timeout_s"),
+        ("jobStateMetricsResetInterval", "job_state_metrics_reset_interval_s"),
         ("maxUnacknowledgedJobsPerExecutor", "max_unacknowledged_jobs_per_executor"),
         ("publishMetricEvents", "publish_metric_events"),
         ("nodeQuarantineFailureThreshold", "node_quarantine_failure_threshold"),
@@ -383,6 +388,7 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         "node_quarantine_window_s",
         "node_quarantine_cooldown_s",
         "executor_timeout_s",
+        "job_state_metrics_reset_interval_s",
     ):
         if attr in kw:
             kw[attr] = parse_duration_s(kw[attr])

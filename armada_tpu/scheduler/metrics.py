@@ -21,13 +21,19 @@ for the reference read against this framework:
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from prometheus_client import Counter, Gauge, Histogram, REGISTRY
 
 
 class SchedulerMetrics:
-    def __init__(self, registry=REGISTRY):
+    def __init__(self, registry=REGISTRY, state_reset_interval_s: float = 0.0):
+        """state_reset_interval_s: clear the job-state counter vector this
+        often (state_metrics.go:157,307 jobStateMetricsResetInterval) to
+        bound label-series churn; 0 = never reset."""
+        self._state_reset_interval_s = state_reset_interval_s
+        self._last_state_reset: Optional[float] = None
         g = lambda name, doc, labels: Gauge(  # noqa: E731
             name, doc, labels, registry=registry
         )
@@ -127,8 +133,15 @@ class SchedulerMetrics:
 
     # --- hooks called by the Scheduler --------------------------------------
 
-    def observe_cycle(self, result, duration_s: float) -> None:
+    def observe_cycle(self, result, duration_s: float, now: Optional[float] = None) -> None:
         """`result` is a CycleResult; records cycle time + decisions + shares."""
+        if self._state_reset_interval_s > 0:
+            now = time.time() if now is None else now
+            if self._last_state_reset is None:
+                self._last_state_reset = now
+            elif now - self._last_state_reset > self._state_reset_interval_s:
+                self.job_state_counter.clear()
+                self._last_state_reset = now
         if result.scheduled:
             self.schedule_cycle_time.observe(duration_s)
         else:

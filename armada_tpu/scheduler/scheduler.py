@@ -211,7 +211,7 @@ class Scheduler:
         result = self._cycle(schedule)
         duration = time.monotonic() - start
         if self.metrics is not None:
-            self.metrics.observe_cycle(result, duration)
+            self.metrics.observe_cycle(result, duration, now=self._clock())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
         return result

@@ -188,3 +188,34 @@ def test_base_priorities_must_be_positive():
         scheduling_config_from_dict(
             {"experimentalIndicativeShare": {"basePriorities": [0]}}
         )
+
+
+def test_job_state_counters_reset_on_interval(tmp_path):
+    """jobStateMetricsResetInterval (config.yaml:12; state_metrics.go:157):
+    the state counter vector clears once the interval lapses, bounding
+    label-series churn."""
+    from armada_tpu.core.config import scheduling_config_from_dict
+    import dataclasses as _dc
+
+    cfg = scheduling_config_from_dict({"jobStateMetricsResetInterval": "12h"})
+    assert cfg.job_state_metrics_reset_interval_s == 12 * 3600.0
+    cfg = _dc.replace(cfg, shape_bucket=32, enable_assertions=True)
+
+    plane = ControlPlane.build(tmp_path, config=cfg)
+    plane.registry = CollectorRegistry()
+    plane.scheduler.metrics = SchedulerMetrics(
+        registry=plane.registry, state_reset_interval_s=60.0
+    )
+    plane.server.create_queue(QueueRecord("q"))
+    plane.server.submit_jobs("q", "m", [item()])
+    for ex in plane.executors:
+        ex.run_once()
+    plane.ingest()
+    plane.scheduler.cycle()
+    labels = {"queue": "q", "state": "leased"}
+    assert sample(plane, "armada_scheduler_job_state_counter_by_queue_total", labels) == 1
+    # interval lapses -> the vector clears on the next cycle
+    plane.clock.advance(120.0)
+    plane.scheduler.cycle()
+    assert sample(plane, "armada_scheduler_job_state_counter_by_queue_total", labels) is None
+    plane.close()
