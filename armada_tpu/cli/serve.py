@@ -148,7 +148,9 @@ def start_control_plane(
     from armada_tpu.scheduler.metrics import SchedulerMetrics
     from armada_tpu.scheduler.reports import SchedulingReportsRepository
 
-    reports = SchedulingReportsRepository()
+    reports = SchedulingReportsRepository(
+        max_job_reports=config.max_job_scheduling_contexts_per_executor
+    )
     metrics = None
     metrics_server = None
     if metrics_port is not None:
@@ -162,6 +164,12 @@ def start_control_plane(
             registry=registry,
             state_reset_interval_s=config.job_state_metrics_reset_interval_s,
         )
+    feed = None
+    if config.incremental_problem_build:
+        from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+        feed = IncrementalProblemFeed(config)
+        feed.attach(jobdb)
     scheduler = Scheduler(
         db,
         jobdb,
@@ -171,6 +179,7 @@ def start_control_plane(
             clock_ns=lambda: int(time.time() * 1e9),
             # reports are always on in serve; metrics when exposed
             collect_stats=True,
+            feed=feed,
         ),
         publisher,
         leader,

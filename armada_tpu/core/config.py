@@ -150,6 +150,16 @@ class SchedulingConfig:
     # Pause scheduling while keeping state sync + event processing running
     # (config.yaml:82 disableScheduling -- operators flip it during incidents).
     disable_scheduling: bool = False
+    # Cap on retained per-job scheduling reports (the reference's
+    # maxJobSchedulingContextsPerExecutor, config/scheduler/config.yaml:107):
+    # bounds both the report LRU and the per-cycle failed-id decode.
+    max_job_scheduling_contexts_per_executor: int = 10_000
+    # Assemble non-market pool problems from cycle-persistent columnar
+    # builders fed by JobDb deltas (models/incremental.py) instead of
+    # re-reading every Job per cycle -- the analog of the reference keeping
+    # its jobDb between cycles (scheduler.go:240-246).  Required to meet the
+    # <1s end-to-end round budget at 1M-job backlogs.
+    incremental_problem_build: bool = False
     # Alternate candidate ordering (queue_scheduler.go Less:598-626): within
     # budget, order queues by CURRENT cost with larger gangs breaking ties
     # (reduces fragmentation, helps big gangs on); over-budget queues rank by
@@ -372,6 +382,11 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("nodeIdLabel", "node_id_label"),
         ("enableAssertions", "enable_assertions"),
         ("disableScheduling", "disable_scheduling"),
+        ("incrementalProblemBuild", "incremental_problem_build"),
+        (
+            "maxJobSchedulingContextsPerExecutor",
+            "max_job_scheduling_contexts_per_executor",
+        ),
         ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering"),
         ("executorTimeout", "executor_timeout_s"),
         ("jobStateMetricsResetInterval", "job_state_metrics_reset_interval_s"),

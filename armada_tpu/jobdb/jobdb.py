@@ -69,6 +69,16 @@ class JobDb:
         # Guards in-place index mutation during _apply against concurrent
         # reader iteration (readers snapshot under this lock).
         self._state = threading.Lock()
+        # Commit subscribers: fn(upserts: dict[str, Job], deletes: set[str]),
+        # called after each committed txn -- the delta feed for the
+        # incremental problem builder (scheduler/incremental_algo.py), the
+        # analog of the reference's scheduler keeping its jobDb between
+        # cycles (scheduler.go:240-246).  Callbacks run under the writer
+        # lock; they must not open txns.
+        self._subscribers: list = []
+
+    def subscribe(self, fn) -> None:
+        self._subscribers.append(fn)
 
     # --- transactions -------------------------------------------------------
 
@@ -104,6 +114,8 @@ class JobDb:
                     self._deindex(old)
                 self._jobs[job_id] = job
                 self._index(job)
+        for fn in self._subscribers:
+            fn(upserts, deletes)
 
     def _index(self, job: Job) -> None:
         for run in job.runs:

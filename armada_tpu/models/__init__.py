@@ -1,6 +1,7 @@
 """Scheduling models: the tensorised scheduling round.
 
 `problem` builds dense device tensors from host job/node/queue objects;
+`incremental` maintains them across cycles from event deltas;
 `fair_scheduler` is the jitted round kernel -- the TPU-native replacement for the
 reference's PreemptingQueueScheduler -> QueueScheduler -> GangScheduler -> NodeDb
 pipeline (internal/scheduler/scheduling/*.go).
@@ -14,6 +15,75 @@ from armada_tpu.models.problem import (
     RoundOutcome,
 )
 from armada_tpu.models.fair_scheduler import schedule_round, RoundResult
+
+
+def run_round_on_device(problem, ctx, config, device_problem=None):
+    """(result, outcome): run the jitted round on a built problem and decode,
+    including the gang-txn rollback loop.  Shared by the from-scratch path
+    (run_scheduling_round) and the incremental-builder path
+    (scheduler/incremental_algo.py); `device_problem` lets callers supply
+    cached device buffers (models.incremental.DeviceProblemCache)."""
+    import jax.numpy as jnp
+    import numpy as _np
+
+    if device_problem is None:
+        device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    kernel_kwargs = dict(
+        num_levels=len(ctx.ladder) + 2,
+        max_slots=ctx.max_slots,
+        slot_width=ctx.slot_width,
+        # Static flag (not a tensor): the default compile carries none of the
+        # alternate-ordering work.  Market pools keep bid ordering.
+        prefer_large=bool(
+            config.enable_prefer_large_job_ordering
+            and not bool(problem.market)
+        ),
+    )
+    result = schedule_round(device_problem, **kernel_kwargs)
+    outcome = decode_result(result, ctx)
+
+    # Gang-txn rollback (nodedb.go:347 ScheduleManyWithTxn: a gang is one txn,
+    # all-or-nothing): if a split gang's sibling placed but another sub-gang
+    # failed on runtime contention, decode unwound the sibling -- but evictions
+    # its placement caused are still in the round state.  Re-run the same
+    # compiled kernel with the doomed gangs invalidated, so the outcome equals
+    # a round in which they were never attempted; the re-decode reports the
+    # doomed members failed (invalid gangs start at g_state=2).  Each re-run
+    # kills >=1 declared gang, so this terminates; the attempt cap only bounds
+    # latency in adversarial rounds (beyond it the unwind itself is still
+    # applied, so no half-gang ever leases either way).
+    attempts = 0
+    while outcome.unwound_groups and attempts < 4:
+        attempts += 1
+        kill = [
+            gi
+            for gi in range(ctx.num_real_gangs)
+            if ctx.gang_group[gi] in outcome.unwound_groups
+        ]
+        g_valid = _np.asarray(device_problem.g_valid).copy()
+        g_valid[_np.asarray(kill, _np.int64)] = False
+        device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
+        result = schedule_round(device_problem, **kernel_kwargs)
+        outcome = decode_result(result, ctx)
+    outcome.pool_totals = ctx.pool_total_atoms
+    return result, outcome
+
+
+def collect_round_stats(result, problem, ctx, config, outcome) -> None:
+    """Attach per-queue share stats (and indicative shares) to the outcome --
+    an extra device->host transfer + host-side DRF recompute, so callers skip
+    it when neither metrics nor reports consume it."""
+    from armada_tpu.models.problem import queue_stats_from_result
+
+    outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
+    if config.indicative_share_base_priorities:
+        from armada_tpu.ops.fairness import theoretical_share
+
+        # config parsing rejects non-positive priorities up front
+        outcome.indicative_shares = {
+            p: theoretical_share(problem.q_weight, problem.q_cds, float(p))
+            for p in config.indicative_share_base_priorities
+        }
 
 
 def run_scheduling_round(
@@ -35,10 +105,6 @@ def run_scheduling_round(
     """Convenience host API: build the dense problem, run the jitted round on
     device, decode back to ids.  Equivalent of one SchedulingAlgo.Schedule call for
     one pool (scheduling_algo.go SchedulePool:574)."""
-    import jax.numpy as jnp
-
-    from armada_tpu.models.problem import queue_stats_from_result
-
     problem, ctx = build_problem(
         config,
         pool=pool,
@@ -53,38 +119,16 @@ def run_scheduling_round(
         banned_nodes=banned_nodes,
         queue_penalty=queue_penalty,
     )
-    device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
-    result = schedule_round(
-        device_problem,
-        num_levels=len(ctx.ladder) + 2,
-        max_slots=ctx.max_slots,
-        slot_width=ctx.slot_width,
-        # Static flag (not a tensor): the default compile carries none of the
-        # alternate-ordering work.  Market pools keep bid ordering.
-        prefer_large=bool(
-            config.enable_prefer_large_job_ordering
-            and not bool(problem.market)
-        ),
-    )
-    outcome = decode_result(result, ctx)
-    outcome.pool_totals = ctx.pool_total_atoms
+    result, outcome = run_round_on_device(problem, ctx, config)
     if collect_stats:
-        # Extra device->host transfer + host-side DRF recompute: skipped when
-        # neither metrics nor reports consume it.
-        outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
-        if config.indicative_share_base_priorities:
-            from armada_tpu.ops.fairness import theoretical_share
-
-            # config parsing rejects non-positive priorities up front
-            outcome.indicative_shares = {
-                p: theoretical_share(problem.q_weight, problem.q_cds, float(p))
-                for p in config.indicative_share_base_priorities
-            }
+        collect_round_stats(result, problem, ctx, config, outcome)
     return outcome
 
 
 __all__ = [
     "run_scheduling_round",
+    "run_round_on_device",
+    "collect_round_stats",
     "SchedulingProblem",
     "HostContext",
     "build_problem",

@@ -44,6 +44,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from armada_tpu.models.problem import SchedulingProblem
 from armada_tpu.ops.fairness import fair_shares, unweighted_drf_cost, weighted_drf_cost
@@ -55,11 +56,14 @@ from armada_tpu.ops.packing import (
     select_gang_nodes_compact,
 )
 
-_BIGI = jnp.int32(2**31 - 1)
-_INF = jnp.float32(3.0e38)
+# Plain numpy, NOT jnp: module-level jnp scalars initialize the default jax
+# backend at import time (under the axon plugin that dials the TPU tunnel
+# before any caller can pin a platform).
+_BIGI = np.int32(2**31 - 1)
+_INF = np.float32(3.0e38)
 # Prefer-large ordering: offset lifting over-budget keys above every
 # within-budget key while staying far below the masked-out _INF.
-_PL_OVER = jnp.float32(1.0e30)
+_PL_OVER = np.float32(1.0e30)
 
 TERM_EXHAUSTED = 0
 TERM_GLOBAL_BURST = 1

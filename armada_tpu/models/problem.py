@@ -146,6 +146,30 @@ class HostContext:
     # the denominator every published share is a fraction of).
     q_demand_raw: list = dataclasses.field(default_factory=list)
     pool_total_atoms: dict = dataclasses.field(default_factory=dict)
+    # Vectorized decode support (models/incremental.py): per-gang single job
+    # id as bytes (b"" for evictee slots and multi-member units), overrides
+    # for multi-member units, and per-run job ids as bytes.  When set,
+    # gang_members / run_job_ids may be None and decode_result takes the
+    # numpy path -- a 1M-gang Python loop in decode would cost the time the
+    # incremental builder saves.
+    gang_ids_vec: Optional[np.ndarray] = None
+    gang_members_over: dict = dataclasses.field(default_factory=dict)
+    run_ids_vec: Optional[np.ndarray] = None
+
+    def members_of(self, gi: int) -> list:
+        """Member job ids of gang `gi` under either representation."""
+        if self.gang_members is not None:
+            return self.gang_members[gi]
+        over = self.gang_members_over.get(gi)
+        if over is not None:
+            return over
+        jid = self.gang_ids_vec[gi]
+        return [jid.decode()] if jid else []
+
+    def run_job_id(self, ri: int) -> str:
+        if self.run_job_ids is not None:
+            return self.run_job_ids[ri]
+        return self.run_ids_vec[ri].decode()
 
 
 @dataclasses.dataclass
@@ -174,10 +198,105 @@ class RoundOutcome:
     # {base priority: share a new queue at that priority would get}
     # (CalculateTheoreticalShare; indicative_share metric).
     indicative_shares: dict = dataclasses.field(default_factory=dict)
+    # Declared-gang group tags whose placed siblings were unwound at decode
+    # because another sub-gang failed (runtime contention).  Non-empty means
+    # evictions those placements caused are still in the result; the caller
+    # re-runs without the doomed gangs to roll them back (the reference's
+    # gang-txn rollback, nodedb.go:347).
+    unwound_groups: frozenset = frozenset()
 
 
 def _pad(n: int, bucket: int) -> int:
     return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+class LazyJobIds:
+    """List-like over a numpy byte-id array, decoded on demand.
+
+    A round can retire whole unfeasible key classes, putting ~the entire
+    backlog into `failed`; materialising a million Python strings cost ~2s
+    per cycle at 1M jobs.  Consumers that only count (simulator, pool
+    reports) pay O(1); only consumers that actually iterate pay the decode.
+    """
+
+    __slots__ = ("_raw", "_extra")
+
+    def __init__(self, raw=None, extra=None):
+        self._raw = raw if raw is not None and raw.size else None
+        self._extra = list(extra) if extra else []
+
+    def __len__(self):
+        return (self._raw.size if self._raw is not None else 0) + len(self._extra)
+
+    def __iter__(self):
+        if self._raw is not None:
+            width = self._raw.dtype.itemsize
+            for s in self._raw.astype(f"U{width}"):
+                yield str(s)
+        yield from self._extra
+
+    def __bool__(self):
+        return len(self) > 0
+
+    def __contains__(self, jid):
+        if jid in self._extra:
+            return True
+        return self._raw is not None and self._raw.dtype.type(jid) in self._raw
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return list(self)[i]
+        n_raw = self._raw.size if self._raw is not None else 0
+        if i < 0:
+            i += len(self)
+        if i < n_raw:
+            return self._raw[i].decode()
+        return self._extra[i - n_raw]
+
+    def append(self, jid):
+        self._extra.append(jid)
+
+    def extend(self, jids):
+        self._extra.extend(jids)
+
+    def __eq__(self, other):
+        return list(self) == list(other)
+
+    def __repr__(self):
+        return f"LazyJobIds(n={len(self)})"
+
+
+class ChainedJobIds:
+    """Concatenation of id sequences that NEVER materialises its parts on
+    extend -- `SchedulerResult.failed` collects one (possibly lazy) sequence
+    per pool round; a plain list.extend would decode every id."""
+
+    __slots__ = ("_parts",)
+
+    def __init__(self):
+        self._parts: list = []
+
+    def extend(self, part) -> None:
+        self._parts.append(part)
+
+    def append(self, jid) -> None:
+        self._parts.append([jid])
+
+    def __len__(self):
+        return sum(len(p) for p in self._parts)
+
+    def __iter__(self):
+        for p in self._parts:
+            yield from p
+
+    def __bool__(self):
+        return any(len(p) for p in self._parts)
+
+    def __eq__(self, other):
+        return list(self) == list(other)
+
+    def __repr__(self):
+        return f"ChainedJobIds(n={len(self)})"
 
 
 def queue_ordered_gang_index(
@@ -259,6 +378,24 @@ class _GangFitContext:
             ).min(axis=1)
         return np.minimum(np.where(np.isfinite(per), per, cardinality), cardinality).astype(np.int64)
 
+    def frac_capacity(self, req_units: np.ndarray) -> np.ndarray:
+        """f64[n]: FRACTIONAL members of `req_units` each node's total holds
+        (no floor, no cardinality cap).  An upper bound on any integral
+        packing, which is what the joint hopeless-gang check needs: the LP
+        relaxation of "how many mixed-class members fit on this node" attains
+        its optimum on a single class, so max-over-classes of this bound is
+        sound for class subsets."""
+        if not self.num_real:
+            return np.zeros((0,), np.float64)
+        req = np.asarray(req_units, np.float64) * self.node_axes
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(
+                req[None, :] > 0,
+                self.totals / np.maximum(req[None, :], 1e-9),
+                np.inf,
+            ).min(axis=1)
+        return np.where(np.isfinite(per), per, np.inf)
+
     def static_fit(self, job: JobSpec, node_id_label: str) -> np.ndarray:
         """bool[n]: taints tolerated and selector satisfied, memoized by the
         job's static signature (nodematching.go StaticJobRequirementsMet)."""
@@ -296,6 +433,48 @@ class _GangFitContext:
             }
             self._domains[label] = cached
         return cached
+
+
+def _joint_capacity_ok(class_info) -> bool:
+    """Hall-condition bound over class subsets of a split gang.
+
+    class_info: [(usable bool[n], frac_cap f64[n], member count)] per key
+    class.  For a subset S of classes, no packing can place more than
+    sum_n max_{c in S, usable} frac_cap_n(c) members of S (fractional-LP
+    upper bound per node), so if that is < the members S needs, the declared
+    gang is jointly infeasible even though each class fits alone -- the case
+    the reference discovers by attempting the placement
+    (gang_scheduler.go:152-227) and we must pre-kill to keep the kernel's
+    sibling-unwind path cold.  Sound: only definitely-infeasible gangs fail.
+    Subset enumeration is capped at 2^10; larger splits check the full set
+    and pairs only (still sound, just less sharp)."""
+    k = len(class_info)
+    if k < 2:
+        return True
+    total_members = sum(count for _, _, count in class_info)
+    # per-class capacity capped at what the subset could ever need: keeps
+    # inf (zero-request classes) from masking a genuine shortfall elsewhere.
+    caps = np.stack(
+        [
+            np.where(usable, np.minimum(frac, float(total_members)), 0.0)
+            for usable, frac, _ in class_info
+        ]
+    )  # [k, n]
+    counts = np.array([count for _, _, count in class_info], np.int64)
+    if k <= 10:
+        subsets = range(1, 1 << k)
+    else:
+        subsets = [(1 << k) - 1] + [
+            (1 << i) | (1 << j) for i in range(k) for j in range(i + 1, k)
+        ]
+    for s in subsets:
+        members = np.array([(s >> i) & 1 for i in range(k)], bool)
+        if members.sum() < 2:
+            continue  # singletons already checked with the tighter bound
+        ub = caps[members].max(axis=0).sum()
+        if ub < counts[members].sum():
+            return False
+    return True
 
 
 def _uniform_domain_ban(
@@ -652,12 +831,19 @@ def build_problem(
             else:
                 groups = [(next(iter(keys)), members)]
             group_tag = f"{qi}:{gang_id}" if len(groups) > 1 else ""
-            # If ANY sub-gang is statically hopeless (no usable node fits its
-            # class at all), the whole declared gang can never fully place:
-            # kill every sub-gang up front so no sibling placement has to be
-            # unwound after the fact (and no eviction is spent on it).
+            # If the declared gang is statically hopeless, kill every sub-gang
+            # up front so no sibling placement has to be unwound after the
+            # fact (and no eviction is spent on it).  Two tiers, both sound
+            # (never kill a feasible gang):
+            #   1. per class: integer member capacity across usable nodes
+            #      < member count;
+            #   2. jointly: classes are individually feasible but COMPETE for
+            #      the same nodes (gang_scheduler.go:152-227 discovers this by
+            #      actually placing; here a Hall-condition bound over class
+            #      subsets with a fractional-LP per-node capacity).
             dead = False
             if len(groups) > 1:
+                class_info = []  # (usable[n], frac_cap[n], count)
                 for _, grp in groups:
                     glead = grp[0]
                     usable = fitctx.ok & fitctx.static_fit(
@@ -675,6 +861,11 @@ def build_problem(
                     if int(cap[usable].sum()) < len(grp):
                         dead = True
                         break
+                    class_info.append(
+                        (usable, fitctx.frac_capacity(req_units), len(grp))
+                    )
+                if not dead:
+                    dead = not _joint_capacity_ok(class_info)
             for grp_key, grp in groups:
                 lead = min(
                     grp,
@@ -1052,7 +1243,7 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
     scheduled: dict = {}
     for s in range(n_slots):
         gi = int(slot_gang[s])
-        members = ctx.gang_members[gi]
+        members = ctx.members_of(gi)
         mi = 0
         for w in range(ctx.slot_width):
             node = int(slot_nodes[s, w])
@@ -1061,38 +1252,63 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
                     scheduled[members[mi]] = ctx.node_ids[node]
                     mi += 1
 
-    preempted = []
-    rescheduled = []
-    for ri in range(ctx.num_real_runs):
-        if run_evicted[ri] and not run_resched[ri]:
-            preempted.append(ctx.run_job_ids[ri])
-        elif run_evicted[ri] and run_resched[ri]:
-            rescheduled.append(ctx.run_job_ids[ri])
+    # Flag vectors first, Python only over the flagged indices: decode must
+    # stay O(decisions), not O(backlog) -- a 1M-gang Python loop here would
+    # cost the time the incremental builder saves.
+    nr = ctx.num_real_runs
+    ev = np.asarray(run_evicted[:nr], bool)
+    rs = np.asarray(run_resched[:nr], bool)
+    preempted = [ctx.run_job_id(int(ri)) for ri in np.flatnonzero(ev & ~rs)]
+    rescheduled = [ctx.run_job_id(int(ri)) for ri in np.flatnonzero(ev & rs)]
 
-    failed = []
-    for gi in range(ctx.num_real_gangs):
-        if g_state[gi] == 2 and ctx.gang_members[gi]:
-            failed.extend(ctx.gang_members[gi])
+    g2 = np.flatnonzero(np.asarray(g_state[: ctx.num_real_gangs]) == 2)
+    if ctx.gang_members is None:
+        # Vectorized path: a round can retire WHOLE unfeasible key classes
+        # (g_state=2 en masse); per-id Python here cost seconds at 1M gangs,
+        # so decode stays lazy until someone iterates.
+        ids = ctx.gang_ids_vec[g2]
+        extra = [
+            m
+            for gi, members in ctx.gang_members_over.items()
+            if int(g_state[gi]) == 2
+            for m in members
+        ]
+        failed = LazyJobIds(ids[ids != b""], extra)
+    else:
+        failed = []
+        for gi in g2:
+            failed.extend(ctx.members_of(int(gi)))
 
     # Cross-class gang atomicity (gang_scheduler.go all-or-nothing): a
     # heterogeneous gang is split into per-key sub-gangs for the kernel; if
     # any sub-gang of a declared gang failed to place while a sibling placed,
     # unwind the placed siblings -- no half-gang may lease.  The statically-
-    # hopeless case is killed before the round (build_problem `dead`), so
-    # this backstop fires only on runtime capacity contention; in that rare
-    # case evictions the placed sibling triggered are not rolled back (the
-    # reference rolls back with the gang txn -- known divergence).
+    # hopeless case is killed before the round (build_problem `dead` + the
+    # joint Hall check), so this backstop fires only on runtime capacity
+    # contention.  The affected group tags are reported so the caller can
+    # re-run the round WITHOUT the doomed gangs (run_scheduling_round):
+    # evictions a now-unwound sibling triggered must not stand either -- the
+    # reference rolls the whole gang txn back (nodedb.go:347).
+    unwound = set()
     groups: dict = {}
-    for gi in range(ctx.num_real_gangs):
+    # Split-gang tags live only on multi-member units under the vectorized
+    # representation; the list path may tag any gang.
+    tagged = (
+        ctx.gang_members_over.keys()
+        if ctx.gang_members is None
+        else range(ctx.num_real_gangs)
+    )
+    for gi in tagged:
         tag = ctx.gang_group[gi]
         if tag:
             groups.setdefault(tag, []).append(gi)
     for tag, gis in groups.items():
         states = {int(g_state[gi]) for gi in gis}
         if 1 in states and states != {1}:
+            unwound.add(tag)
             for gi in gis:
                 if int(g_state[gi]) == 1:
-                    for jid in ctx.gang_members[gi]:
+                    for jid in ctx.members_of(gi):
                         scheduled.pop(jid, None)
                         failed.append(jid)
 
@@ -1105,4 +1321,5 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
         num_iterations=int(result.iterations),
         termination=_TERMINATIONS[int(result.termination)],
         spot_price=spot if spot >= 0 else None,
+        unwound_groups=frozenset(unwound),
     )

@@ -6,6 +6,11 @@ build_problem) would spend minutes materialising Python dataclasses; production
 rounds keep state device-resident between cycles anyway (the reference's jobDb
 cache, scheduler.go:240-246), so scale testing goes straight to the dense form.
 Shapes/semantics are identical to build_problem's output.
+
+`synthetic_world` is the spec-level twin: JobSpec/NodeSpec objects feeding the
+incremental builder for END-TO-END cycle benchmarks (delta apply + assemble +
+upload + kernel + decode), the number the reference's 5s round budget is
+actually comparable to.
 """
 
 from __future__ import annotations
@@ -15,6 +20,106 @@ import numpy as np
 from armada_tpu.models.problem import SchedulingProblem, queue_ordered_gang_index
 
 _INF = np.float32(3.0e38)
+
+
+def synthetic_world(
+    *,
+    num_nodes: int,
+    num_jobs: int,
+    num_queues: int,
+    num_runs: int = 0,
+    seed: int = 0,
+    shape_bucket: int = 8192,
+):
+    """(config, nodes, queues, specs, running, spec_factory): a JobSpec-level
+    world mirroring synthetic_problem's distribution.
+
+    `spec_factory(n, t0)` mints n fresh queued specs with submit times after
+    t0 -- the per-cycle arrival delta for steady-state benchmarks.  ResourceList
+    instances are shared across jobs of the same shape so 1M specs stay cheap.
+    shape_bucket defaults high so +-1000-job deltas never change the padded
+    tensor shapes (one compile serves every measured cycle).
+    """
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+
+    rng = np.random.default_rng(seed)
+    config = SchedulingConfig(
+        shape_bucket=shape_bucket,
+        priority_classes={
+            "batch": PriorityClass("batch", priority=100, preemptible=True),
+            "prod": PriorityClass("prod", priority=1000, preemptible=False),
+        },
+        default_priority_class="batch",
+    )
+    factory = config.resource_list_factory()
+
+    queues = [Queue(f"q{i:03d}", 1.0) for i in range(num_queues)]
+    nodes = []
+    node_shapes = {}
+    for i in range(num_nodes):
+        cores = int(rng.choice([16, 32, 64, 96]))
+        rl = node_shapes.get(cores)
+        if rl is None:
+            rl = factory.from_mapping({"cpu": str(cores), "memory": str(cores * 4)})
+            node_shapes[cores] = rl
+        nodes.append(NodeSpec(id=f"n{i:06d}", pool="default", total_resources=rl))
+
+    job_shapes = {}
+
+    def _req(cpu_m: int, mem: int):
+        rl = job_shapes.get((cpu_m, mem))
+        if rl is None:
+            rl = factory.from_mapping({"cpu": f"{cpu_m}m", "memory": str(mem)})
+            job_shapes[(cpu_m, mem)] = rl
+        return rl
+
+    probs = 1.0 / np.arange(1, num_queues + 1)
+    probs /= probs.sum()
+    counter = [0]
+
+    def spec_factory(n: int, t0: float) -> list:
+        qs = rng.choice(num_queues, size=n, p=probs)
+        cpus = rng.choice([500, 1000, 2000, 4000], size=n)
+        memm = rng.choice([2, 4, 8], size=n)
+        pcs = rng.random(n) < 0.7
+        subs = t0 + rng.random(n)
+        out = []
+        base = counter[0]
+        counter[0] += n
+        for i in range(n):
+            out.append(
+                JobSpec(
+                    id=f"j{base + i:09d}",
+                    queue=f"q{qs[i]:03d}",
+                    priority_class="batch" if pcs[i] else "prod",
+                    submit_time=float(subs[i]),
+                    resources=_req(int(cpus[i]), int(cpus[i] // 1000 * memm[i] + 1)),
+                )
+            )
+        return out
+
+    specs = spec_factory(num_jobs, 0.0)
+    running = []
+    if num_runs:
+        run_nodes = rng.integers(0, num_nodes, num_runs)
+        run_cpus = rng.choice([500, 1000, 2000], size=num_runs)
+        run_pc = rng.random(num_runs) < 0.5
+        run_q = rng.integers(0, num_queues, num_runs)
+        for i in range(num_runs):
+            running.append(
+                RunningJob(
+                    job=JobSpec(
+                        id=f"r{i:08d}",
+                        queue=f"q{run_q[i]:03d}",
+                        priority_class="batch" if run_pc[i] else "prod",
+                        submit_time=-1.0,
+                        resources=_req(int(run_cpus[i]), 4),
+                    ),
+                    node_id=f"n{run_nodes[i]:06d}",
+                )
+            )
+    return config, nodes, queues, specs, running, spec_factory
 
 
 def synthetic_problem(

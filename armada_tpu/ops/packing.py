@@ -14,9 +14,15 @@ cardinality is covered (all-or-nothing, gang_scheduler.go:100-247).
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
-_BIG = jnp.float32(3.0e38)
+# Plain numpy, NOT jnp: a module-level jnp scalar would initialize the default
+# jax backend at import time -- under the axon TPU plugin that dials the
+# hardware tunnel (and hangs if it is down) before any caller can pin a
+# platform.  Importing this package must never touch a backend.
+_BIG = np.float32(3.0e38)
 
 
 def node_packing_score(alloc_at_p, inv_scale):

@@ -26,7 +26,12 @@ from armada_tpu.core.config import SchedulingConfig
 from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 from armada_tpu.jobdb.job import Job, JobRun
 from armada_tpu.jobdb.jobdb import WriteTxn
-from armada_tpu.models import RoundOutcome, run_scheduling_round
+from armada_tpu.models import (
+    RoundOutcome,
+    collect_round_stats,
+    run_round_on_device,
+    run_scheduling_round,
+)
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 from armada_tpu.scheduler.ratelimit import SchedulingRateLimiters
 
@@ -56,9 +61,18 @@ class SchedulerResult:
     scheduled: list = dataclasses.field(default_factory=list)
     # (job AFTER preemption applied, the preempted run)
     preempted: list = dataclasses.field(default_factory=list)
-    # job ids attempted but unplaceable this round
-    failed: list = dataclasses.field(default_factory=list)
+    # job ids attempted but unplaceable this round; lazily chained -- a round
+    # can retire an entire unfeasible key class (~the whole backlog), and
+    # materialising those ids costs seconds at 1M jobs (models/problem.py
+    # LazyJobIds).
+    failed: "object" = None
     pools: list = dataclasses.field(default_factory=list)  # list[PoolStats]
+
+    def __post_init__(self):
+        if self.failed is None:
+            from armada_tpu.models.problem import ChainedJobIds
+
+            self.failed = ChainedJobIds()
 
 
 def _new_run_id() -> str:
@@ -87,10 +101,17 @@ class FairSchedulingAlgo:
         collect_stats: bool = True,
         bid_prices=None,
         priority_overrides=None,
+        feed=None,
     ):
         """bid_prices: BidPriceProvider for market-driven pools;
         priority_overrides: PriorityOverrideProvider replacing per-(pool,
-        queue) fair-share weights (scheduler/providers.py)."""
+        queue) fair-share weights (scheduler/providers.py);
+        feed: scheduler.incremental_algo.IncrementalProblemFeed -- when set,
+        non-market pool rounds assemble from cycle-persistent builders
+        instead of re-reading every Job from the txn (the reference keeps its
+        jobDb between cycles, scheduler.go:240-246).  The feed must be
+        attached to the same JobDb the txns come from."""
+        self.feed = feed
         self.config = config
         self._queues = queues
         self._clock_ns = clock_ns
@@ -208,54 +229,76 @@ class FairSchedulingAlgo:
             if n.pool not in pools:
                 pools.append(n.pool)
 
+        incremental = self.feed is not None
+        market_pools = {p.name for p in self.config.pools if p.market_driven}
+        if incremental:
+            # Overlay this txn's uncommitted changes onto the persistent
+            # builders (idempotent: the same deltas fire again at commit via
+            # the JobDb subscription).
+            self.feed.on_delta(txn._upserts, txn._deletes)
+        # The full per-job txn scans below are what the incremental feed
+        # exists to avoid; they remain for the legacy path, market pools
+        # (bid ordering re-sorts the backlog per cycle) and the short-job
+        # penalty (derived from retained TERMINAL jobs the feed drops).
+        need_job_scan = (not incremental) or bool(market_pools)
+        need_run_scan = (
+            (not incremental)
+            or bool(market_pools)
+            or self.short_job_penalty.enabled
+        )
+
         # Queued jobs: validated, in a known queue, with their CURRENT priority
         # (reprioritisation updates Job.priority, not the immutable spec).
         queued_jobs: list[JobSpec] = []
         job_of_spec: dict[str, Job] = {}
         banned_nodes: dict[str, tuple] = {}  # retry anti-affinity
-        for qname in txn.queues_with_queued_jobs():
-            if qname not in known_queues:
-                continue
-            for job in txn.queued_jobs(qname):
-                if not job.validated:
+        if need_job_scan:
+            for qname in txn.queues_with_queued_jobs():
+                if qname not in known_queues:
                     continue
-                # Validated pools (Job.pools) override the requested ones.
-                queued_jobs.append(
-                    dataclasses.replace(
-                        job.spec,
-                        priority=job.priority,
-                        pools=job.pools or job.spec.pools,
+                for job in txn.queued_jobs(qname):
+                    if not job.validated:
+                        continue
+                    # Validated pools (Job.pools) override the requested ones.
+                    queued_jobs.append(
+                        dataclasses.replace(
+                            job.spec,
+                            priority=job.priority,
+                            pools=job.pools or job.spec.pools,
+                        )
                     )
-                )
-                job_of_spec[job.id] = job
-                bans = job.anti_affinity_nodes()
-                if bans:
-                    banned_nodes[job.id] = bans
+                    job_of_spec[job.id] = job
+                    bans = job.anti_affinity_nodes()
+                    if bans:
+                        banned_nodes[job.id] = bans
 
         # Running jobs, grouped by pool of their run; short-job penalties
         # accumulate per (run pool, queue) off retained terminal jobs
         # (scheduling_algo.go:342-360 shortJobPenaltyByQueue).
         running_by_pool: dict[str, list[RunningJob]] = {p: [] for p in pools}
         penalty_by_pool: dict[str, dict[str, "object"]] = {}
-        for job in txn.all_jobs():
-            run = job.latest_run
-            if job.queue not in known_queues:
-                continue
-            if run is not None and self.short_job_penalty.applies(job, now_ns):
-                if job.spec.resources is not None:
-                    pool_map = penalty_by_pool.setdefault(run.pool or "default", {})
-                    prev = pool_map.get(job.queue)
-                    atoms = job.spec.resources.atoms
-                    pool_map[job.queue] = (
-                        atoms if prev is None else [a + b for a, b in zip(prev, atoms)]
-                    )
-                continue
-            if run is None or run.in_terminal_state() or job.in_terminal_state():
-                continue
-            pool = run.pool or "default"
-            if pool not in running_by_pool:
-                running_by_pool[pool] = []
-            running_by_pool[pool].append(_running_of(job, run))
+        if need_run_scan:
+            for job in txn.all_jobs():
+                run = job.latest_run
+                if job.queue not in known_queues:
+                    continue
+                if run is not None and self.short_job_penalty.applies(job, now_ns):
+                    if job.spec.resources is not None:
+                        pool_map = penalty_by_pool.setdefault(run.pool or "default", {})
+                        prev = pool_map.get(job.queue)
+                        atoms = job.spec.resources.atoms
+                        pool_map[job.queue] = (
+                            atoms
+                            if prev is None
+                            else [a + b for a, b in zip(prev, atoms)]
+                        )
+                    continue
+                if run is None or run.in_terminal_state() or job.in_terminal_state():
+                    continue
+                pool = run.pool or "default"
+                if pool not in running_by_pool:
+                    running_by_pool[pool] = []
+                running_by_pool[pool].append(_running_of(job, run))
 
         bid_price_of = None
         if self.bid_prices is not None:
@@ -283,7 +326,7 @@ class FairSchedulingAlgo:
         def consume_round(outcome):
             by_queue: dict[str, int] = {}
             for jid in outcome.scheduled:
-                job = job_of_spec.get(jid)
+                job = job_of_spec.get(jid) or txn.get(jid)
                 if job is not None:
                     by_queue[job.queue] = by_queue.get(job.queue, 0) + 1
             if by_queue:
@@ -291,34 +334,64 @@ class FairSchedulingAlgo:
 
         for pool in pools:
             pool_nodes = [n for n in nodes if n.pool == pool]
-            running = running_by_pool.get(pool, [])
-            if not pool_nodes or (not queued_jobs and not running):
+            if not pool_nodes:
                 continue
-            g_tokens, q_tokens = round_tokens()
-            outcome = run_scheduling_round(
-                self.config,
-                pool=pool,
-                nodes=pool_nodes,
-                queues=pool_queues(pool),
-                queued_jobs=queued_jobs,
-                running=running,
-                collect_stats=self.collect_stats,
-                bid_price_of=bid_price_of,
-                global_tokens=g_tokens,
-                queue_tokens=q_tokens,
-                banned_nodes=banned_nodes,
-                queue_penalty=penalty_by_pool.get(pool),
-            )
+            if incremental and pool not in market_pools:
+                b = self.feed.builder_for(pool, txn)
+                b.set_queues(pool_queues(pool))
+                b.set_nodes(pool_nodes)
+                num_queued = len(b.jobs.key_of_id) + len(b.gang_jobs)
+                num_running = len(b.runs.key_of_id)
+                if not num_queued and not num_running:
+                    continue
+                g_tokens, q_tokens = round_tokens()
+                problem, ctx = b.assemble(
+                    global_tokens=g_tokens,
+                    queue_tokens=q_tokens,
+                    queue_penalty=penalty_by_pool.get(pool),
+                )
+                res, outcome = run_round_on_device(
+                    problem,
+                    ctx,
+                    self.config,
+                    device_problem=self.feed.devcache_for(pool).put(problem),
+                )
+                if self.collect_stats:
+                    collect_round_stats(res, problem, ctx, self.config, outcome)
+            else:
+                running = running_by_pool.get(pool, [])
+                if not queued_jobs and not running:
+                    continue
+                num_queued, num_running = len(queued_jobs), len(running)
+                g_tokens, q_tokens = round_tokens()
+                outcome = run_scheduling_round(
+                    self.config,
+                    pool=pool,
+                    nodes=pool_nodes,
+                    queues=pool_queues(pool),
+                    queued_jobs=queued_jobs,
+                    running=running,
+                    collect_stats=self.collect_stats,
+                    bid_price_of=bid_price_of,
+                    global_tokens=g_tokens,
+                    queue_tokens=q_tokens,
+                    banned_nodes=banned_nodes,
+                    queue_penalty=penalty_by_pool.get(pool),
+                )
             consume_round(outcome)
             self._apply_outcome(
                 txn, outcome, pool, executor_of_node, now_ns, result
             )
+            if incremental:
+                # Later pools must see this pool's leases/preemptions; the
+                # overlay re-apply is O(changed) and idempotent.
+                self.feed.on_delta(txn._upserts, set())
             stats = PoolStats(
                 pool=pool,
                 outcome=outcome,
                 num_nodes=len(pool_nodes),
-                num_queued=len(queued_jobs),
-                num_running=len(running),
+                num_queued=num_queued,
+                num_running=num_running,
             )
             pool_cfg = next(
                 (p for p in self.config.pools if p.name == pool), None
@@ -360,13 +433,26 @@ class FairSchedulingAlgo:
             if not pool_cfg.away_pools:
                 continue
             home_pool = pool_cfg.name
+            # The feed tracks pool-restricted queued jobs in a side set, so
+            # the away candidate scan is O(candidates), not O(backlog).
+            away_pool_source = (
+                self.feed.away_candidates(txn) if incremental else queued_jobs
+            )
             away_jobs = [
                 j
-                for j in queued_jobs
+                for j in away_pool_source
                 if j.pools and home_pool in j.pools
             ]
             if not away_jobs:
                 continue
+            if incremental:
+                # Retry anti-affinity for away candidates (the legacy scan
+                # collected these into banned_nodes already).
+                for j in away_jobs:
+                    job = txn.get(j.id)
+                    bans = job.anti_affinity_nodes() if job is not None else ()
+                    if bans:
+                        banned_nodes[j.id] = bans
             for host in pool_cfg.away_pools:
                 host_nodes = [n for n in nodes if n.pool == host]
                 if not host_nodes or not away_jobs:
@@ -380,7 +466,11 @@ class FairSchedulingAlgo:
                     queued_jobs=[
                         dataclasses.replace(j, pools=(host,)) for j in away_jobs
                     ],
-                    running=host_running(host),
+                    running=(
+                        self.feed.running_of(host, txn)
+                        if incremental and host not in market_pools
+                        else host_running(host)
+                    ),
                     collect_stats=False,
                     bid_price_of=bid_price_of,
                     away_mode=True,
@@ -393,6 +483,8 @@ class FairSchedulingAlgo:
                 self._apply_outcome(
                     txn, outcome, host, executor_of_node, now_ns, result, away=True
                 )
+                if incremental:
+                    self.feed.on_delta(txn._upserts, set())
                 scheduled_ids = set(outcome.scheduled)
                 if scheduled_ids:
                     queued_jobs = [
@@ -494,21 +586,37 @@ class FairSchedulingAlgo:
     ) -> None:
         preempted_ids = {job.id for job, _ in result.preempted}
         still_queued = {j.id: j for j in queued_jobs}
+
+        def resolve_queued(jid):
+            spec = still_queued.get(jid)
+            if spec is not None:
+                return spec
+            # Incremental mode keeps no spec list; the txn is the truth.
+            job = txn.get(jid)
+            if job is None or not job.queued or not job.validated:
+                return None
+            return dataclasses.replace(
+                job.spec, priority=job.priority, pools=job.pools or job.spec.pools
+            )
+
         for stats in result.pools:
             pool = stats.pool
-            stuck = [
-                still_queued[jid]
-                for jid in stats.outcome.failed
-                if jid in still_queued
-            ]
+            stuck = []
+            for jid in stats.outcome.failed:
+                spec = resolve_queued(jid)
+                if spec is not None:
+                    stuck.append(spec)
             if not stuck:
                 continue
             pool_nodes = [n for n in nodes if n.pool == pool]
-            running_now = [
-                r
-                for r in running_by_pool.get(pool, [])
-                if r.job.id not in preempted_ids
-            ] + extra_running.get(pool, [])
+            if self.feed is not None:
+                running_now = self.feed.running_of(pool, txn)
+            else:
+                running_now = [
+                    r
+                    for r in running_by_pool.get(pool, [])
+                    if r.job.id not in preempted_ids
+                ] + extra_running.get(pool, [])
             shares = stats.outcome.queue_stats
             decisions = self.optimiser.optimise(
                 stuck,
@@ -522,7 +630,7 @@ class FairSchedulingAlgo:
             )
             for d in decisions:
                 # The rate limiters gate optimiser placements too.
-                spec = still_queued.get(d.job_id)
+                spec = resolve_queued(d.job_id)
                 queue = spec.queue if spec is not None else ""
                 g_tokens, q_tokens = self.rate_limiters.tokens([queue])
                 if g_tokens is not None and g_tokens < 1:

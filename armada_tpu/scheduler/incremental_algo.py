@@ -1,0 +1,200 @@
+"""Feed JobDb deltas into cycle-persistent incremental problem builders.
+
+The reference's scheduler keeps its jobDb between cycles and only applies
+event deltas (internal/scheduler/scheduler.go:240-246); the tensor analog is
+models/incremental.IncrementalBuilder, and THIS module is the glue: a JobDb
+commit subscriber that translates job-state changes into builder deltas, so
+FairSchedulingAlgo can assemble a 1M-job pool problem in O(delta) Python +
+O(G) numpy instead of re-reading a million Job objects every second.
+
+Mapping (idempotent -- the same delta may arrive twice: once from the open
+txn's overlay at schedule time and again at commit):
+
+  queued+validated  -> submit(spec @ current priority, retry bans) per pool
+  running           -> remove from backlogs; lease(run) on the run's pool
+  terminal/deleted  -> remove + unlease everywhere
+
+Away-pass candidates (jobs restricted to specific pools) are tracked in a
+side set so the away rounds never need a full backlog scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import RunningJob
+from armada_tpu.jobdb.job import Job
+from armada_tpu.models.incremental import DeviceProblemCache, IncrementalBuilder
+
+
+class IncrementalProblemFeed:
+    """Per-pool IncrementalBuilders + device caches, fed from JobDb commits.
+
+    Market-driven pools are NOT handled here (bid ordering re-sorts the
+    backlog every cycle); FairSchedulingAlgo keeps them on the per-cycle
+    build_problem path.
+    """
+
+    def __init__(self, config: SchedulingConfig):
+        self.config = config
+        self._market_pools = {p.name for p in config.pools if p.market_driven}
+        self.builders: dict[str, IncrementalBuilder] = {}
+        self.devcaches: dict[str, DeviceProblemCache] = {}
+        # queued job ids with an explicit pools restriction: the away pass's
+        # candidate set (scheduling_algo.go:216-283) without a backlog scan.
+        self.pool_restricted: set[str] = set()
+        # running gang membership: job id -> (pool, queue, gang id), so gang
+        # domain pins can be forgotten when the run ends (else the
+        # note_running_gang sets grow forever).
+        self._gang_of: dict[str, tuple] = {}
+        # Builders must exist BEFORE the first delta arrives or it is lost --
+        # the feed retains no job state of its own.  Configured pools are
+        # eager; pools discovered later from node snapshots are backfilled
+        # from the JobDb in builder_for.
+        for p in config.pools:
+            if not p.market_driven:
+                self.builders[p.name] = IncrementalBuilder(config, p.name)
+                self.devcaches[p.name] = DeviceProblemCache()
+
+    def attach(self, jobdb) -> None:
+        jobdb.subscribe(self.on_delta)
+
+    def builder_for(self, pool: str, txn=None) -> Optional[IncrementalBuilder]:
+        if pool in self._market_pools:
+            return None
+        b = self.builders.get(pool)
+        if b is None:
+            b = IncrementalBuilder(self.config, pool)
+            self.builders[pool] = b
+            self.devcaches[pool] = DeviceProblemCache()
+            if txn is not None:
+                # Late pool discovery (a node snapshot introduced a pool not
+                # in config): one-time backfill scan.
+                for job in txn.all_jobs():
+                    self.apply_job(job)
+        return b
+
+    def devcache_for(self, pool: str) -> DeviceProblemCache:
+        return self.devcaches[pool]
+
+    # ------------------------------------------------------------ deltas ----
+
+    def on_delta(self, upserts: dict, deletes: set) -> None:
+        for job_id in deletes:
+            self._remove_everywhere(job_id)
+        for job in upserts.values():
+            self.apply_job(job)
+
+    def _remove_everywhere(self, job_id: str) -> None:
+        self.pool_restricted.discard(job_id)
+        for b in self.builders.values():
+            b.remove(job_id)
+            b.unlease(job_id)
+        self._forget_gang(job_id)
+
+    def _forget_gang(self, job_id: str) -> None:
+        entry = self._gang_of.pop(job_id, None)
+        if entry is not None:
+            pool, queue, gang_id = entry
+            b = self.builders.get(pool)
+            if b is not None:
+                b.forget_running_gang(queue, gang_id, job_id)
+
+    def apply_job(self, job: Job) -> None:
+        if job.in_terminal_state():
+            self._remove_everywhere(job.id)
+            return
+        if job.queued:
+            if not job.validated:
+                return
+            spec = dataclasses.replace(
+                job.spec,
+                priority=job.priority,
+                pools=job.pools or job.spec.pools,
+            )
+            bans = job.anti_affinity_nodes()
+            if spec.pools:
+                self.pool_restricted.add(job.id)
+            else:
+                self.pool_restricted.discard(job.id)
+            for b in self.builders.values():
+                b.unlease(job.id)
+                b.submit(spec, bans)
+            return
+        # leased / running
+        self.pool_restricted.discard(job.id)
+        run = job.latest_run
+        for b in self.builders.values():
+            b.remove(job.id)
+        if run is None or run.in_terminal_state():
+            for b in self.builders.values():
+                b.unlease(job.id)
+            self._forget_gang(job.id)
+            return
+        pool = run.pool or "default"
+        for name, b in self.builders.items():
+            if name != pool:
+                b.unlease(job.id)
+        # Existing builders only: creating one here would skip builder_for's
+        # one-time JobDb backfill and permanently hide the queued backlog
+        # from a late-discovered pool (the algo creates builders WITH a txn).
+        b = self.builders.get(pool)
+        if b is None:
+            return
+        r = RunningJob(
+            job=dataclasses.replace(job.spec, priority=job.priority),
+            node_id=run.node_id,
+            priority=run.scheduled_at_priority or 0,
+            away=run.pool_scheduled_away,
+        )
+        b.lease(r)
+        if job.spec.gang_id:
+            b.note_running_gang(job.queue, job.spec.gang_id, job.id)
+            self._gang_of[job.id] = (pool, job.queue, job.spec.gang_id)
+
+    # ------------------------------------------------------------ queries ---
+
+    def running_of(self, pool: str, txn) -> list[RunningJob]:
+        """RunningJob views of the pool's leased set, reconstructed from the
+        builder's run table + txn specs -- for the away rounds, which go
+        through the per-cycle builder and need host objects.  O(runs in
+        pool), not O(all jobs)."""
+        b = self.builders.get(pool)
+        if b is None:
+            return []
+        out = []
+        for row in b.runs.live_rows():
+            jid = b.runs.ids[row].tobytes().rstrip(b"\0").decode()
+            job = txn.get(jid)
+            if job is None:
+                continue
+            run = job.latest_run
+            if run is None or run.in_terminal_state():
+                continue
+            out.append(
+                RunningJob(
+                    job=dataclasses.replace(job.spec, priority=job.priority),
+                    node_id=run.node_id,
+                    priority=run.scheduled_at_priority or 0,
+                    away=run.pool_scheduled_away,
+                )
+            )
+        return out
+
+    def away_candidates(self, txn) -> list:
+        """Still-queued specs with an explicit pools restriction."""
+        out = []
+        for jid in sorted(self.pool_restricted):
+            job = txn.get(jid)
+            if job is None or not job.queued or not job.validated:
+                continue
+            out.append(
+                dataclasses.replace(
+                    job.spec,
+                    priority=job.priority,
+                    pools=job.pools or job.spec.pools,
+                )
+            )
+        return out

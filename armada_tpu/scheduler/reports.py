@@ -54,7 +54,15 @@ class SchedulingReportsRepository:
                 )
             for stats in scheduler_result.pools:
                 o = stats.outcome
-                for job_id in o.failed:
+                # Bounded like the reference's
+                # maxJobSchedulingContextsPerExecutor (config.yaml:107): a
+                # round can retire a whole unfeasible key class (~the entire
+                # backlog in o.failed); decoding more ids than the LRU can
+                # hold burns seconds per cycle for entries that would evict
+                # each other anyway.
+                import itertools
+
+                for job_id in itertools.islice(o.failed, self._max_jobs):
                     self._put_job(
                         job_id,
                         {

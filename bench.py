@@ -1,20 +1,24 @@
-"""Headline benchmark: one full scheduling round at reference scale.
+"""Headline benchmark: one END-TO-END scheduling cycle at reference scale.
 
-Metric (BASELINE.json): wall-clock of a scheduling round over 1M queued jobs x
-50k nodes, scheduling a full default burst (1,000 jobs, the reference's
-maximumSchedulingBurst, config/scheduler/config.yaml:104).  The reference
-budgets maxSchedulingDuration=5s per round (config.yaml:3) -- that is the
-baseline; the north star is <1s on TPU.
+Metric (BASELINE.json): wall-clock of a full steady-state cycle over 1M
+queued jobs x 50k nodes -- apply the cycle's event deltas (new submits, last
+round's leases) to the incremental state, assemble the dense problem, upload,
+run the round kernel, decode the decisions back to job/node ids.  The
+reference budgets maxSchedulingDuration=5s per round (config.yaml:3) -- that
+is the baseline; the north star is <1s.  Round 1 reported the kernel alone
+(VERDICT.md weakness #3: host prep excluded); the kernel-only number is still
+reported alongside as `kernel_s`.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 vs_baseline = 5.0 / value  (x times faster than the reference's round budget).
 
 Env knobs for local runs: ARMADA_BENCH_JOBS, ARMADA_BENCH_NODES,
-ARMADA_BENCH_QUEUES, ARMADA_BENCH_REPEATS.
+ARMADA_BENCH_QUEUES, ARMADA_BENCH_REPEATS, ARMADA_BENCH_RUNS.
 """
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -27,12 +31,102 @@ from armada_tpu.models.synthetic import synthetic_problem
 BASELINE_ROUND_BUDGET_S = 5.0
 
 
-def main():
-    num_gangs = int(os.environ.get("ARMADA_BENCH_JOBS", 1_000_000))
-    num_nodes = int(os.environ.get("ARMADA_BENCH_NODES", 50_000))
-    num_queues = int(os.environ.get("ARMADA_BENCH_QUEUES", 64))
-    repeats = int(os.environ.get("ARMADA_BENCH_REPEATS", 3))
+def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
+    """Health-check the axon TPU backend in a SUBPROCESS with a hard timeout.
 
+    Round-1 lesson (VERDICT.md "what's weak" #1): the axon backend can fail to
+    initialize (UNAVAILABLE, rc=1, no JSON line) -- and worse, init can HANG
+    on the tunnel's chip claim, which no in-process retry recovers from (the
+    backend lock stays held).  So the health check runs out-of-process where
+    a hang is just a timeout.
+    """
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((256, 256), jnp.bfloat16);"
+        "(x @ x).block_until_ready();"
+        "print('PLATFORM=' + jax.devices()[0].platform)"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s (tunnel hang)"
+    if out.returncode == 0 and "PLATFORM=" in out.stdout:
+        platform = out.stdout.split("PLATFORM=")[-1].strip()
+        if platform == "cpu":
+            # The plugin failed non-fatally and jax fell back to CPU inside
+            # the probe: that is NOT a healthy TPU -- report it as a failure
+            # so the retry/backoff (and the labelled fallback) still happen.
+            return False, "probe ran on cpu (TPU plugin failed non-fatally)"
+        return True, platform
+    tail = (out.stderr or out.stdout).strip().splitlines()
+    return False, (tail[-1] if tail else f"rc={out.returncode}")[:300]
+
+
+def _ready_backend():
+    """Pick the platform: real TPU if the tunnel is healthy, else CPU.
+
+    The decision is made BEFORE this process touches any jax backend, so a
+    hung tunnel cannot wedge the measurement.  The CPU pin must be at config
+    level: the axon plugin force-sets jax_platforms at import, overriding the
+    JAX_PLATFORMS env var (same hazard tests/conftest.py documents).
+    """
+    probe_timeout = float(os.environ.get("ARMADA_BENCH_PROBE_TIMEOUT_S", 120))
+    tries = int(os.environ.get("ARMADA_BENCH_PROBE_TRIES", 2))
+    last_err = None
+    delay = 10.0
+    for i in range(tries):
+        ok, detail = _probe_tpu(probe_timeout)
+        if ok:
+            return detail, None
+        last_err = detail
+        print(f"bench: TPU probe {i + 1}/{tries} failed: {detail}", file=sys.stderr)
+        if i + 1 < tries:
+            time.sleep(delay)
+            delay *= 2
+    print("bench: falling back to CPU", file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices()[0].platform, last_err
+
+
+def _arm_watchdog():
+    """Last-resort guarantee of the one-JSON-line contract: if the measurement
+    stalls (e.g. the tunnel hangs mid-compile after a healthy probe), emit a
+    structured failure line and exit before the driver's own timeout hits."""
+    import threading
+
+    budget = float(os.environ.get("ARMADA_BENCH_WATCHDOG_S", 1200))
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": "scheduling_round_wall_clock",
+                    "value": None,
+                    "unit": "s",
+                    "vs_baseline": None,
+                    "error": f"watchdog: bench stalled >{budget:.0f}s",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _kernel_bench(num_gangs, num_nodes, num_queues, repeats):
+    """Kernel-only round time on pre-built device tensors (round 1's
+    headline; kept as the `kernel_s` extra)."""
     problem, meta = synthetic_problem(
         num_nodes=num_nodes,
         num_gangs=num_gangs,
@@ -48,33 +142,160 @@ def main():
         max_slots=meta["max_slots"],
         slot_width=meta["slot_width"],
     )
-
-    # compile + warm up
-    result = schedule_round(dev, **kw)
-    jax.block_until_ready(result)
+    # compile + warm up (first TPU compile is slow, ~20-40s; retry once if
+    # the tunnel drops mid-compile)
+    try:
+        result = schedule_round(dev, **kw)
+        jax.block_until_ready(result)
+    except RuntimeError as e:
+        if "UNAVAILABLE" not in str(e):
+            raise
+        print(f"bench: compile hit UNAVAILABLE, retrying once: {e}", file=sys.stderr)
+        time.sleep(10)
+        result = schedule_round(dev, **kw)
+        jax.block_until_ready(result)
     scheduled = int(result.scheduled_count)
     iters = int(result.iterations)
-
+    assert scheduled > 0, f"kernel round scheduled nothing ({iters} iterations)"
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         r = schedule_round(dev, **kw)
         jax.block_until_ready(r)
         times.append(time.perf_counter() - t0)
-    value = min(times)
+    return min(times)
 
-    assert scheduled > 0, f"round scheduled nothing ({iters} iterations)"
-    print(
-        json.dumps(
-            {
-                "metric": f"scheduling_round_wall_clock_{num_gangs//1000}kjobs_x_{num_nodes//1000}knodes",
-                "value": round(value, 4),
-                "unit": "s",
-                "vs_baseline": round(BASELINE_ROUND_BUDGET_S / value, 2),
-            }
-        )
+
+def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
+    """Full steady-state cycle: deltas -> assemble -> upload -> kernel ->
+    decode, over the incremental builder (models/incremental.py).  Returns
+    (cycle_s, breakdown dict, scheduled count)."""
+    from armada_tpu.core.types import RunningJob
+    from armada_tpu.models import decode_result
+    from armada_tpu.models.incremental import DeviceProblemCache, IncrementalBuilder
+    from armada_tpu.models.synthetic import synthetic_world
+
+    config, nodes, queues, specs, running, spec_factory = synthetic_world(
+        num_nodes=num_nodes,
+        num_jobs=num_jobs,
+        num_queues=num_queues,
+        num_runs=num_runs,
+        seed=7,
     )
+    t0 = time.perf_counter()
+    builder = IncrementalBuilder(config, "default", queues)
+    builder.set_nodes(nodes)
+    builder.submit_many(specs)
+    for r in running:
+        builder.lease(r)
+    print(
+        f"bench: e2e setup (one-time backlog load) {time.perf_counter() - t0:.1f}s",
+        file=sys.stderr,
+    )
+    spec_of = {s.id: s for s in specs}
+    kw = None
+    devcache = DeviceProblemCache()
+
+    def cycle(t_now):
+        nonlocal kw
+        t_start = time.perf_counter()
+        problem, ctx = builder.assemble()
+        t_asm = time.perf_counter()
+        dev = devcache.put(problem)
+        kw = dict(
+            num_levels=len(ctx.ladder) + 2,
+            max_slots=ctx.max_slots,
+            slot_width=ctx.slot_width,
+        )
+        result = schedule_round(dev, **kw)
+        jax.block_until_ready(result)
+        t_kernel = time.perf_counter()
+        outcome = decode_result(result, ctx)
+        t_decode = time.perf_counter()
+        # Feed the decisions back (part of the measured cycle: the reference
+        # applies SchedulerResult to the jobDb inside its 5s budget too).
+        leases = []
+        for jid, nid in outcome.scheduled.items():
+            spec = spec_of.pop(jid, None)
+            builder.remove(jid)
+            if spec is not None:
+                leases.append(RunningJob(job=spec, node_id=nid))
+        builder.lease_many(leases)
+        for jid in outcome.preempted:
+            builder.unlease(jid)
+        fresh = spec_factory(max(1, len(outcome.scheduled)), t_now)
+        for s in fresh:
+            spec_of[s.id] = s
+        builder.submit_many(fresh)
+        t_end = time.perf_counter()
+        return (
+            t_end - t_start,
+            {
+                "assemble_s": round(t_asm - t_start, 4),
+                "upload_kernel_s": round(t_kernel - t_asm, 4),
+                "decode_apply_s": round(t_end - t_kernel, 4),
+            },
+            len(outcome.scheduled),
+        )
+
+    # warm-up cycle compiles the kernel at these shapes
+    cycle(100.0)
+    best, best_parts, scheduled = None, None, 0
+    for rep in range(repeats):
+        total, parts, n_sched = cycle(200.0 + rep)
+        if best is None or total < best:
+            best, best_parts, scheduled = total, parts, n_sched
+    assert scheduled > 0, "e2e cycle scheduled nothing"
+    return best, best_parts, scheduled
+
+
+def main():
+    watchdog = _arm_watchdog()
+    platform, init_err = _ready_backend()
+    num_jobs = int(os.environ.get("ARMADA_BENCH_JOBS", 1_000_000))
+    num_nodes = int(os.environ.get("ARMADA_BENCH_NODES", 50_000))
+    num_queues = int(os.environ.get("ARMADA_BENCH_QUEUES", 64))
+    num_runs = int(os.environ.get("ARMADA_BENCH_RUNS", num_nodes // 2))
+    repeats = int(os.environ.get("ARMADA_BENCH_REPEATS", 3))
+
+    kernel_s = _kernel_bench(num_jobs, num_nodes, num_queues, repeats)
+    print(f"bench: kernel-only round {kernel_s:.4f}s", file=sys.stderr)
+    e2e_s, parts, scheduled = _e2e_bench(
+        num_jobs, num_nodes, num_queues, num_runs, repeats
+    )
+
+    line = {
+        "metric": f"e2e_cycle_wall_clock_{num_jobs//1000}kjobs_x_{num_nodes//1000}knodes",
+        "value": round(e2e_s, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_ROUND_BUDGET_S / e2e_s, 2),
+        "kernel_s": round(kernel_s, 4),
+        "scheduled_per_cycle": scheduled,
+        "platform": platform,
+        **parts,
+    }
+    if init_err is not None:
+        line["backend_fallback"] = init_err
+    watchdog.cancel()
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # always emit exactly one JSON line for the driver
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "scheduling_round_wall_clock",
+                    "value": None,
+                    "unit": "s",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            )
+        )
+        sys.exit(1)

@@ -87,6 +87,12 @@ class ControlPlane:
         queues = QueueRepository(db)
         server = SubmitServer(db, publisher, queues, config, clock=clock)
         jobdb = JobDb(config)
+        feed = None
+        if config.incremental_problem_build:
+            from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+            feed = IncrementalProblemFeed(config)
+            feed.attach(jobdb)
         scheduler = Scheduler(
             db,
             jobdb,
@@ -94,6 +100,7 @@ class ControlPlane:
                 config,
                 queues=queues.scheduling_queues,
                 clock_ns=lambda: int(clock() * 1e9),
+                feed=feed,
             ),
             publisher,
             StandaloneLeaderController(),

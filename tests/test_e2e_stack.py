@@ -11,9 +11,20 @@ from armada_tpu.server import JobSubmitItem, QueueRecord
 from tests.control_plane import ControlPlane
 
 
-@pytest.fixture
-def cp(tmp_path):
-    plane = ControlPlane.build(tmp_path)
+@pytest.fixture(params=[False, True], ids=["legacy", "incremental"])
+def cp(tmp_path, request):
+    """Both problem-build paths (per-cycle and cycle-persistent incremental,
+    scheduler.go:240-246 analog) must drive the full stack identically."""
+    from armada_tpu.core.config import SchedulingConfig
+
+    plane = ControlPlane.build(
+        tmp_path,
+        config=SchedulingConfig(
+            shape_bucket=32,
+            enable_assertions=True,
+            incremental_problem_build=request.param,
+        ),
+    )
     plane.server.create_queue(QueueRecord("tenant-a", weight=2.0))
     plane.server.create_queue(QueueRecord("tenant-b", weight=1.0))
     yield plane

@@ -55,10 +55,17 @@ class World:
         )
         self.jobdb = JobDb(self.config)
         self.factory = self.config.resource_list_factory()
+        feed = None
+        if self.config.incremental_problem_build:
+            from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+            feed = IncrementalProblemFeed(self.config)
+            feed.attach(self.jobdb)
         algo = FairSchedulingAlgo(
             self.config,
             queues=lambda: [Queue("q1"), Queue("q2")],
             clock_ns=lambda: int(self.clock() * 1e9),
+            feed=feed,
         )
         self.scheduler = Scheduler(
             self.db,
@@ -137,9 +144,19 @@ class World:
         self.log.close()
 
 
-@pytest.fixture
-def world(tmp_path):
-    w = World(tmp_path)
+@pytest.fixture(params=[False, True], ids=["legacy", "incremental"])
+def world(tmp_path, request):
+    """Every scenario runs twice: against the per-cycle problem builder and
+    against the cycle-persistent incremental feed (scheduler.go:240-246
+    analog) -- the two paths must be behaviorally identical."""
+    w = World(
+        tmp_path,
+        config=SchedulingConfig(
+            shape_bucket=32,
+            enable_assertions=True,
+            incremental_problem_build=request.param,
+        ),
+    )
     yield w
     w.close()
 
