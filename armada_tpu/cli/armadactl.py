@@ -253,7 +253,7 @@ def cmd_jobs(args):
             print(f"{'GROUP':<32} {'COUNT':>7}  STATES")
             for g in groups:
                 states = " ".join(
-                    f"{s}={n}" for s, n in g["states"].items() if n
+                    f"{s}={n}" for s, n in g.get("states", {}).items() if n
                 )
                 print(f"{str(g['group']):<32} {g['count']:>7}  {states}")
             return
@@ -428,12 +428,17 @@ def cmd_serve(args):
         health_port=args.health_port,
         profiling=args.profiling,
         lookout_port=args.lookout_port,
+        rest_port=args.rest_port,
+        kube_lease_url=args.kube_lease_url,
+        kube_lease_namespace=args.kube_lease_namespace,
     )
     print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
     if plane.health_server is not None:
         print(f"health on 127.0.0.1:{plane.health_server.port}/health")
     if plane.lookout_web is not None:
         print(f"lookout web UI on http://127.0.0.1:{plane.lookout_web.port}/")
+    if plane.rest_gateway is not None:
+        print(f"REST gateway on http://127.0.0.1:{plane.rest_gateway.port}/v1/")
     print(f"state in {args.data_dir}")
     try:
         plane.wait()
@@ -572,6 +577,16 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--cycle-interval", type=float, default=1.0)
     srv.add_argument("--schedule-interval", type=float, default=5.0)
     srv.add_argument("--leader-id", help="enable file-lease leader election")
+    srv.add_argument(
+        "--kube-lease-url",
+        help="kube-apiserver URL: elect via a coordination/v1 Lease instead "
+        "of the file lease (replicated k8s deployments, leader.go:112-186)",
+    )
+    srv.add_argument(
+        "--kube-lease-namespace",
+        default="default",
+        help="namespace of the election Lease object",
+    )
     srv.add_argument("--metrics-port", type=int, help="expose prometheus metrics")
     srv.add_argument(
         "--health-port",
@@ -587,6 +602,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--lookout-port",
         type=int,
         help="host the lookout web UI on this port (0 = pick a free one)",
+    )
+    srv.add_argument(
+        "--rest-port",
+        type=int,
+        help="serve the grpc-gateway-parity REST/JSON API on this port "
+        "(0 = pick a free one); the C++ client (client/cpp) targets it",
     )
     srv.set_defaults(fn=cmd_serve)
 

@@ -59,6 +59,7 @@ class ControlPlaneProcess:
     _metrics_server: object = None
     health_server: object = None
     lookout_web: object = None
+    rest_gateway: object = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -70,6 +71,8 @@ class ControlPlaneProcess:
             self.health_server.stop()
         if self.lookout_web is not None:
             self.lookout_web.stop()
+        if self.rest_gateway is not None:
+            self.rest_gateway.stop()
         if self._metrics_server is not None:
             # prometheus_client >= 0.17 returns (server, thread)
             try:
@@ -99,6 +102,9 @@ def start_control_plane(
     health_port: Optional[int] = None,
     profiling: bool = False,
     lookout_port: Optional[int] = None,
+    rest_port: Optional[int] = None,
+    kube_lease_url: Optional[str] = None,
+    kube_lease_namespace: str = "default",
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -140,11 +146,24 @@ def start_control_plane(
     submit_server = SubmitServer(db, publisher, queues, config)
     event_api = EventApi(eventdb)
     jobdb = JobDb(config)
-    leader = (
-        FileLeaseLeaderController(os.path.join(data_dir, "leader.lease"), leader_id)
-        if leader_id
-        else StandaloneLeaderController()
-    )
+    if leader_id and kube_lease_url:
+        # Replicated deployment on Kubernetes: coordination/v1 Lease election
+        # (leader.go:112-186); falls back to the file lease off-cluster.
+        from armada_tpu.scheduler.kube_leader import KubernetesLeaseLeaderController
+
+        leader = KubernetesLeaseLeaderController(
+            kube_lease_url,
+            leader_id,
+            namespace=kube_lease_namespace,
+        )
+    else:
+        leader = (
+            FileLeaseLeaderController(
+                os.path.join(data_dir, "leader.lease"), leader_id
+            )
+            if leader_id
+            else StandaloneLeaderController()
+        )
     from armada_tpu.scheduler.metrics import SchedulerMetrics
     from armada_tpu.scheduler.reports import SchedulingReportsRepository
 
@@ -263,6 +282,12 @@ def start_control_plane(
 
         lookout_web = LookoutWebUI(LookoutQueries(lookoutdb), lookout_port)
 
+    rest_gateway = None
+    if rest_port is not None:
+        from armada_tpu.server.gateway import RestGateway
+
+        rest_gateway = RestGateway(submit_server, event_api, rest_port)
+
     return ControlPlaneProcess(
         port=bound_port,
         scheduler=scheduler,
@@ -279,6 +304,7 @@ def start_control_plane(
         _metrics_server=metrics_server,
         health_server=health_server,
         lookout_web=lookout_web,
+        rest_gateway=rest_gateway,
     )
 
 

@@ -58,3 +58,10 @@ class ClusterContext(Protocol):
 
     def get_pod(self, run_id: str) -> Optional[PodState]:
         ...
+
+    def queue_usage(self) -> "dict[str, list[int]]":
+        """Actual resource usage (atoms by fixed resource axis) of this
+        cluster's non-terminal armada pods, keyed by queue -- the usage
+        scrape the reference's ClusterUtilisationService feeds into lease
+        requests and the queue_resource_used metric
+        (internal/executor/utilisation/cluster_utilisation.go:68,125)."""

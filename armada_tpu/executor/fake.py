@@ -121,6 +121,22 @@ class FakeClusterContext:
     def pod_states(self) -> Sequence[PodState]:
         return [p.state for p in self._pods.values()]
 
+    def queue_usage(self) -> dict[str, list[int]]:
+        """Per-queue atoms of pending/running pods (the fake cluster's
+        "usage" is the pods' requests, the same approximation the reference
+        takes for pods without metrics,
+        utilisation/cluster_utilisation.go getAllocatedResourceByNodeName)."""
+        out: dict[str, list[int]] = {}
+        for pod in self._pods.values():
+            if pod.state.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+                prev = out.get(pod.state.queue)
+                if prev is None:
+                    out[pod.state.queue] = [int(a) for a in pod.requests]
+                else:
+                    for i, a in enumerate(pod.requests):
+                        prev[i] += int(a)
+        return out
+
     def get_pod(self, run_id: str) -> Optional[PodState]:
         pod = self._pods.get(run_id)
         return pod.state if pod else None

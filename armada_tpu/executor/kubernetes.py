@@ -322,6 +322,32 @@ class KubernetesClusterContext:
                     self._pods.pop(run_id, None)
         return states
 
+    def queue_usage(self) -> dict[str, list[int]]:
+        """Per-queue atoms of non-terminal armada pods' container requests
+        (utilisation/cluster_utilisation.go:68 -- requests stand in for usage
+        where no metrics pipeline exists)."""
+        from armada_tpu.core.resources import parse_quantity
+
+        out: dict[str, list[int]] = {}
+        R = self._factory.num_resources
+        index_of = {name: i for i, name in enumerate(self._factory.names)}
+        for p in self._list_pods():
+            status = p.get("status", {})
+            if status.get("phase", "Pending") in ("Succeeded", "Failed"):
+                continue
+            queue = p["metadata"].get("labels", {}).get(QUEUE_LABEL, "")
+            if not queue:
+                continue
+            row = out.setdefault(queue, [0] * R)
+            for c in p.get("spec", {}).get("containers", ()):
+                for rname, qty in (
+                    c.get("resources", {}).get("requests", {}) or {}
+                ).items():
+                    i = index_of.get(rname)
+                    if i is not None:
+                        row[i] += int(parse_quantity(str(qty)))
+        return out
+
     def get_pod(self, run_id: str) -> Optional[PodState]:
         with self._lock:
             loc = self._pods.get(run_id)

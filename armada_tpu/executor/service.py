@@ -77,12 +77,18 @@ class ExecutorService:
             for p in self.cluster.pod_states()
             if p.phase not in _TERMINAL
         }
+        usage = (
+            self.cluster.queue_usage()
+            if hasattr(self.cluster, "queue_usage")
+            else {}
+        )
         return ExecutorSnapshot(
             id=self.id,
             pool=self.pool,
             nodes=tuple(self.cluster.node_specs()),
             node_of_run=node_of_run,
             last_update_ns=int(self._clock() * 1e9),
+            queue_usage={q: tuple(v) for q, v in usage.items()},
         )
 
     # --- lease loop (lease_requester.go:51) ---------------------------------

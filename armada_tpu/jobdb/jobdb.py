@@ -74,11 +74,18 @@ class JobDb:
         # incremental problem builder (scheduler/incremental_algo.py), the
         # analog of the reference's scheduler keeping its jobDb between
         # cycles (scheduler.go:240-246).  Callbacks run under the writer
-        # lock; they must not open txns.
+        # lock; they must not open txns.  Abort subscribers fire when a txn
+        # with buffered changes is discarded: anyone who peeked at the
+        # overlay (the feed does, at schedule time) must resynchronize from
+        # committed state.
         self._subscribers: list = []
+        self._abort_subscribers: list = []
 
     def subscribe(self, fn) -> None:
         self._subscribers.append(fn)
+
+    def subscribe_abort(self, fn) -> None:
+        self._abort_subscribers.append(fn)
 
     # --- transactions -------------------------------------------------------
 
@@ -342,7 +349,11 @@ class WriteTxn(ReadTxn):
 
     def abort(self) -> None:
         if not self._done:
+            had_changes = bool(self._upserts or self._deletes)
             self._finish()
+            if had_changes:
+                for fn in self._db._abort_subscribers:
+                    fn()
 
     def _finish(self) -> None:
         self._done = True

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 from typing import Optional, Sequence
 
 from armada_tpu.lookout.db import JOB_STATES, LookoutDb
@@ -65,27 +66,47 @@ def _escape_like(value: str) -> str:
     return value.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
 
 
+_ANNOTATION_KEY_RE = re.compile(r"[A-Za-z0-9_./-]+")
+
+
 class LookoutQueries:
     def __init__(self, db: LookoutDb):
         self._db = db
 
     # --- where-clause builder (querybuilder.go) -----------------------------
 
+    @staticmethod
+    def _annotation_expr(key: str) -> str:
+        """SQL expression extracting one annotation value (JSON1); the key is
+        quoted so dotted kubernetes-style keys ("armadaproject.io/stage")
+        address the flat entry -- the sqlite analog of the reference's
+        `annotations->>'key'` (querybuilder.go:333-346).
+
+        The key is interpolated (GROUP BY cannot take a placeholder in every
+        position), so it is validated against the kubernetes annotation-key
+        grammar -- anything outside [A-Za-z0-9_./-] is rejected, which
+        excludes every SQL metacharacter."""
+        if not key or not _ANNOTATION_KEY_RE.fullmatch(key):
+            raise ValueError(f"invalid annotation key {key!r}")
+        return f'json_extract(annotations_json, \'$."{key}"\')'
+
     def _where(self, filters: Sequence[JobFilter]) -> tuple[str, list]:
         clauses, params = [], []
         for f in filters:
             if f.field == "annotation":
-                # JSON1 extraction; the key is quoted so dotted kubernetes-style
-                # keys ("armadaproject.io/stage") address the flat entry.
-                if '"' in f.annotation_key:
-                    raise ValueError("annotation keys may not contain '\"'")
-                clauses.append("json_extract(annotations_json, ?) = ?")
-                params.append(f'$."{f.annotation_key}"')
-                params.append(f.value)
-                continue
-            col = _FIELDS.get(f.field)
-            if col is None:
-                raise ValueError(f"unknown filter field {f.field!r}")
+                col = self._annotation_expr(f.annotation_key)
+                # annotation filters carry the SAME match modes as columns
+                # (querybuilder.go:320-346), plus `exists` (the reference's
+                # MatchExists / `annotations ? key`).
+                if f.match == "exists":
+                    clauses.append(f"{col} IS NOT NULL")
+                    continue
+            else:
+                col = _FIELDS.get(f.field)
+                if col is None:
+                    raise ValueError(f"unknown filter field {f.field!r}")
+                if f.match == "exists":
+                    raise ValueError("match 'exists' applies to annotations only")
             if f.match == "in":
                 values = list(f.value)  # type: ignore[arg-type]
                 if not values:
@@ -136,38 +157,80 @@ class LookoutQueries:
 
     # --- GroupJobs (repository/groupjobs.go) --------------------------------
 
+    # Requestable per-group aggregates (reference groupAggregates map,
+    # tables.go:110-114: submitted->Min, lastTransitionTime->Average,
+    # state->StateCounts) plus per-group RESOURCE SUMS, which the reference's
+    # UI derives client-side but belong server-side at 1M-job scale.
+    _AGGREGATES = {
+        "submitted": "MIN(submitted_ns) AS submitted",
+        "last_transition": "AVG(last_transition_ns) AS last_transition",
+        "cpu_milli": "SUM(cpu_milli) AS cpu_milli",
+        "memory": "SUM(memory) AS memory",
+        "gpu": "SUM(gpu) AS gpu",
+        "state": None,  # expands to per-state counts
+    }
+
     def group_jobs(
         self,
         group_by: str,
         filters: Sequence[JobFilter] = (),
+        aggregates: Sequence[str] = ("state",),
         order_by_count_desc: bool = True,
         take: int = 100,
+        annotation_key: str = "",
     ) -> list[dict]:
-        col = _FIELDS.get(group_by)
-        if col is None:
-            raise ValueError(f"unknown group field {group_by!r}")
+        """group_by may be a column or "annotation" with `annotation_key`
+        (groupjobs.go; grouping by an annotation implies an exists filter so
+        jobs without the key do not form a null group,
+        querybuilder.go:206-213)."""
+        if group_by == "annotation":
+            col = self._annotation_expr(annotation_key)
+            filters = list(filters) + [
+                JobFilter(
+                    field="annotation",
+                    value=None,
+                    match="exists",
+                    annotation_key=annotation_key,
+                )
+            ]
+        else:
+            col = _FIELDS.get(group_by)
+            if col is None:
+                raise ValueError(f"unknown group field {group_by!r}")
+        selects = []
+        want_states = False
+        for agg in aggregates:
+            if agg not in self._AGGREGATES:
+                raise ValueError(f"unknown aggregate {agg!r}")
+            if agg == "state":
+                want_states = True
+                selects.append(
+                    ", ".join(
+                        f"SUM(state = '{s}') AS n_{s.lower()}" for s in JOB_STATES
+                    )
+                )
+            else:
+                selects.append(self._AGGREGATES[agg])
         where, params = self._where(filters)
-        state_counts = ", ".join(
-            f"SUM(state = '{s}') AS n_{s.lower()}" for s in JOB_STATES
-        )
+        select_sql = (", " + ", ".join(selects)) if selects else ""
         direction = "DESC" if order_by_count_desc else "ASC"
         rows = self._db.query(
-            f"SELECT {col} AS grp, COUNT(*) AS count, {state_counts}, "
-            f"AVG(submitted_ns) AS avg_submitted_ns "
+            f"SELECT {col} AS grp, COUNT(*) AS count{select_sql} "
             f"FROM job{where} GROUP BY {col} ORDER BY count {direction}, grp "
             "LIMIT ?",
             [*params, take],
         )
         out = []
         for r in rows:
-            d = {
-                "group": r["grp"],
-                "count": int(r["count"]),
-                "avg_submitted_ns": float(r["avg_submitted_ns"] or 0),
-                "states": {
+            d = {"group": r["grp"], "count": int(r["count"])}
+            for agg in aggregates:
+                if agg == "state":
+                    continue
+                d[agg] = float(r[agg] or 0)
+            if want_states:
+                d["states"] = {
                     s: int(r[f"n_{s.lower()}"] or 0) for s in JOB_STATES
-                },
-            }
+                }
             out.append(d)
         return out
 

@@ -329,6 +329,21 @@ def _filters_from_query(qs: dict) -> list[JobFilter]:
         filters.append(JobFilter("jobset", qs["jobset"][0], "contains"))
     if qs.get("state"):
         filters.append(JobFilter("state", qs["state"][0]))
+    # annotation filters: ann.<key>=<value> (exact), ann.<key>=* (exists),
+    # annmatch=<mode> applies one of the querybuilder match modes to all
+    # annotation terms (querybuilder.go:320-346 parity).
+    mode = qs.get("annmatch", ["exact"])[0]
+    for param, values in qs.items():
+        if param.startswith("ann.") and values:
+            key = param[4:]
+            if values[0] == "*":
+                filters.append(
+                    JobFilter("annotation", None, "exists", annotation_key=key)
+                )
+            else:
+                filters.append(
+                    JobFilter("annotation", values[0], mode, annotation_key=key)
+                )
     return filters
 
 
@@ -377,8 +392,17 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/api/groups":
                 by = qs.get("by", ["queue"])[0]
                 take = max(1, min(int(qs.get("take", ["100"])[0]), 500))
+                aggs = tuple(
+                    qs.get("aggs", ["state"])[0].split(",")
+                ) if qs.get("aggs", ["state"])[0] else ()
                 # one extra row detects truncation
-                groups = q.group_jobs(by, _filters_from_query(qs), take=take + 1)
+                groups = q.group_jobs(
+                    by,
+                    _filters_from_query(qs),
+                    aggregates=aggs,
+                    take=take + 1,
+                    annotation_key=qs.get("key", [""])[0],
+                )
                 truncated = len(groups) > take
                 self._json({"groups": groups[:take], "truncated": truncated})
             elif path == "/api/overview":

@@ -184,14 +184,27 @@ class ArmadaClient(_Base):
         )
         return json.loads(resp.json)
 
-    def group_jobs(self, group_by: str, filters=(), take: int = 100) -> list[dict]:
+    def group_jobs(
+        self,
+        group_by: str,
+        filters=(),
+        take: int = 100,
+        aggregates=("state",),
+        annotation_key: str = "",
+    ) -> list[dict]:
         import json
 
         resp = self._unary(
             "/armada_tpu.api.Lookout/GroupJobs",
             pb.LookoutQuery(
                 query_json=json.dumps(
-                    {"group_by": group_by, "filters": list(filters), "take": take}
+                    {
+                        "group_by": group_by,
+                        "filters": list(filters),
+                        "take": take,
+                        "aggregates": list(aggregates),
+                        "annotation_key": annotation_key,
+                    }
                 )
             ),
             pb.JsonResponse,

@@ -214,7 +214,9 @@ class _LookoutService:
             groups = self._queries.group_jobs(
                 q.get("group_by", "state"),
                 filters,
+                aggregates=tuple(q.get("aggregates", ("state",))),
                 take=int(q.get("take", 100)),
+                annotation_key=q.get("annotation_key", ""),
             )
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))

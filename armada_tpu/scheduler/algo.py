@@ -599,6 +599,7 @@ class FairSchedulingAlgo:
                 job.spec, priority=job.priority, pools=job.pools or job.spec.pools
             )
 
+        market_pools = {p.name for p in self.config.pools if p.market_driven}
         for stats in result.pools:
             pool = stats.pool
             stuck = []
@@ -609,7 +610,9 @@ class FairSchedulingAlgo:
             if not stuck:
                 continue
             pool_nodes = [n for n in nodes if n.pool == pool]
-            if self.feed is not None:
+            if self.feed is not None and pool not in market_pools:
+                # Market pools have no builder (feed.running_of would claim
+                # an empty cluster); they stay on the legacy lists below.
                 running_now = self.feed.running_of(pool, txn)
             else:
                 running_now = [
@@ -617,6 +620,15 @@ class FairSchedulingAlgo:
                     for r in running_by_pool.get(pool, [])
                     if r.job.id not in preempted_ids
                 ] + extra_running.get(pool, [])
+            if self.feed is not None and banned_nodes is not None:
+                # Incremental mode skipped the legacy scan that collects
+                # retry anti-affinity: resolve bans for the stuck set so the
+                # optimiser cannot re-place a job on the node it died on.
+                for spec in stuck:
+                    job = txn.get(spec.id)
+                    bans = job.anti_affinity_nodes() if job is not None else ()
+                    if bans:
+                        banned_nodes[spec.id] = bans
             shares = stats.outcome.queue_stats
             decisions = self.optimiser.optimise(
                 stuck,

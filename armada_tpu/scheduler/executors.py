@@ -38,6 +38,13 @@ class ExecutorSnapshot:
     unacknowledged_runs: tuple[str, ...] = ()
     last_update_ns: int = 0
     cordoned: bool = False
+    # Actual per-queue resource usage of the executor's non-terminal pods
+    # (atoms by fixed resource axis) -- the usage scrape the reference ships
+    # in its lease requests (utilisation/cluster_utilisation.go:125
+    # ResourceUsageByQueueAndPool) and surfaces as queue_resource_used.
+    queue_usage: Mapping[str, tuple[int, ...]] = dataclasses.field(
+        default_factory=dict
+    )
 
     # --- serialization ------------------------------------------------------
 
@@ -51,6 +58,7 @@ class ExecutorSnapshot:
                 "unacknowledged_runs": list(self.unacknowledged_runs),
                 "last_update_ns": self.last_update_ns,
                 "cordoned": self.cordoned,
+                "queue_usage": {q: list(v) for q, v in self.queue_usage.items()},
             }
         ).encode()
 
@@ -65,6 +73,9 @@ class ExecutorSnapshot:
             unacknowledged_runs=tuple(d.get("unacknowledged_runs", ())),
             last_update_ns=int(d.get("last_update_ns", 0)),
             cordoned=bool(d.get("cordoned", False)),
+            queue_usage={
+                q: tuple(v) for q, v in d.get("queue_usage", {}).items()
+            },
         )
 
 

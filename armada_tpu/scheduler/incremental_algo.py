@@ -49,6 +49,7 @@ class IncrementalProblemFeed:
         # domain pins can be forgotten when the run ends (else the
         # note_running_gang sets grow forever).
         self._gang_of: dict[str, tuple] = {}
+        self._jobdb = None
         # Builders must exist BEFORE the first delta arrives or it is lost --
         # the feed retains no job state of its own.  Configured pools are
         # eager; pools discovered later from node snapshots are backfilled
@@ -59,7 +60,28 @@ class IncrementalProblemFeed:
                 self.devcaches[p.name] = DeviceProblemCache()
 
     def attach(self, jobdb) -> None:
+        self._jobdb = jobdb
         jobdb.subscribe(self.on_delta)
+        # schedule() overlays the OPEN txn's buffer onto the builders; if
+        # that txn aborts (publish failure, leadership fencing), builder
+        # state has run ahead of the JobDb with nothing to correct it --
+        # CLAUDE.md's "state only advances with a committed txn" invariant.
+        # Aborts are rare, so the remedy is a full resync.
+        jobdb.subscribe_abort(self.resync)
+
+    def resync(self) -> None:
+        """Discard all builder state and rebuild from committed JobDb state."""
+        self.builders = {}
+        self.devcaches = {}
+        self.pool_restricted = set()
+        self._gang_of = {}
+        for p in self.config.pools:
+            if not p.market_driven:
+                self.builders[p.name] = IncrementalBuilder(self.config, p.name)
+                self.devcaches[p.name] = DeviceProblemCache()
+        if self._jobdb is not None:
+            for job in self._jobdb.read_txn().all_jobs():
+                self.apply_job(job)
 
     def builder_for(self, pool: str, txn=None) -> Optional[IncrementalBuilder]:
         if pool in self._market_pools:

@@ -1,0 +1,194 @@
+"""Kubernetes Lease leader election for replicated scheduler deployments.
+
+Equivalent of the reference's KubernetesLeaderController
+(internal/scheduler/leader/leader.go:112-186), which runs client-go's
+leaderelection over a coordination.k8s.io/v1 Lease.  The same protocol,
+hand-rolled over the kube REST API:
+
+  * acquire: create the Lease if absent; take it over when the holder's
+    renewTime is older than leaseDurationSeconds; otherwise follow.
+  * renew: update renewTime while holding.
+  * fencing: every acquisition bumps `leaseTransitions`, which doubles as the
+    token generation -- a cycle begun under generation g must not publish
+    once any replica has acquired generation > g (scheduler.go:263,355).
+  * races: all writes send `metadata.resourceVersion` as an optimistic
+    precondition; the apiserver answers 409 to the loser, exactly the fence
+    client-go relies on.
+
+Satisfies the same LeaderController protocol as Standalone/FileLease
+(scheduler/leader.py); wire with `armadactl serve --leader-mode kubernetes`.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from armada_tpu.scheduler.leader import LeaderToken
+
+_RFC3339 = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+class KubeApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"kube api {status}: {message}")
+        self.status = status
+
+
+class KubernetesLeaseLeaderController:
+    def __init__(
+        self,
+        base_url: str,
+        holder_id: str,
+        *,
+        namespace: str = "default",
+        lease_name: str = "armada-tpu-scheduler",
+        lease_duration_s: float = 15.0,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+        timeout_s: float = 10.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._base = base_url.rstrip("/")
+        self._holder = holder_id
+        self._path = (
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{lease_name}"
+        )
+        self._create_path = (
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        )
+        self._name = lease_name
+        self._duration = lease_duration_s
+        self._token = token
+        self._timeout = timeout_s
+        self._clock = clock
+        if base_url.startswith("https"):
+            ctx = ssl.create_default_context(cafile=ca_file)
+            if insecure:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._ssl = ctx
+        else:
+            self._ssl = None
+
+    # ------------------------------------------------------------- http ----
+
+    def _request(self, method: str, path: str, body=None):
+        req = urllib.request.Request(
+            self._base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+        )
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout, context=self._ssl
+            ) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise KubeApiError(e.code, e.read().decode(errors="replace")) from e
+        except urllib.error.URLError as e:
+            raise KubeApiError(0, str(e.reason)) from e
+
+    # ------------------------------------------------------------ lease ----
+
+    def _now_str(self) -> str:
+        return time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.gmtime(self._clock())
+        ) + ".%06dZ" % int((self._clock() % 1) * 1e6)
+
+    @staticmethod
+    def _parse_time(s: str) -> float:
+        import calendar
+
+        s = s.rstrip("Z")
+        if "." in s:
+            base, frac = s.split(".", 1)
+        else:
+            base, frac = s, "0"
+        t = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+        return t + float("0." + frac)
+
+    def _spec(self, transitions: int) -> dict:
+        return {
+            "holderIdentity": self._holder,
+            "leaseDurationSeconds": int(self._duration),
+            "renewTime": self._now_str(),
+            "leaseTransitions": transitions,
+        }
+
+    def get_token(self) -> LeaderToken:
+        try:
+            lease = self._request("GET", self._path)
+        except KubeApiError as e:
+            if e.status != 404:
+                # apiserver unreachable: fail SAFE as follower (the reference
+                # drops leadership when it cannot renew, leader.go:171-178)
+                return LeaderToken(leader=False, generation=0)
+            try:
+                created = self._request(
+                    "POST",
+                    self._create_path,
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": self._name},
+                        "spec": self._spec(transitions=1),
+                    },
+                )
+                return LeaderToken(
+                    leader=True,
+                    generation=created["spec"].get("leaseTransitions", 1),
+                )
+            except KubeApiError as e2:
+                if e2.status == 409:  # lost the creation race
+                    return LeaderToken(leader=False, generation=0)
+                return LeaderToken(leader=False, generation=0)
+
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        transitions = int(spec.get("leaseTransitions", 0))
+        renew = spec.get("renewTime")
+        duration = float(spec.get("leaseDurationSeconds", self._duration))
+        expired = (
+            renew is None or self._clock() >= self._parse_time(renew) + duration
+        )
+        if holder == self._holder or expired:
+            new_transitions = transitions if holder == self._holder else transitions + 1
+            lease["spec"] = self._spec(new_transitions)
+            try:
+                updated = self._request("PUT", self._path, lease)
+            except KubeApiError as e:
+                if e.status == 409:  # another replica won the takeover race
+                    return LeaderToken(leader=False, generation=transitions)
+                return LeaderToken(leader=False, generation=transitions)
+            return LeaderToken(
+                leader=True,
+                generation=int(updated["spec"].get("leaseTransitions", new_transitions)),
+            )
+        return LeaderToken(leader=False, generation=transitions)
+
+    def validate_token(self, token: LeaderToken) -> bool:
+        if not token.leader:
+            return False
+        try:
+            lease = self._request("GET", self._path)
+        except KubeApiError:
+            return False
+        spec = lease.get("spec", {})
+        renew = spec.get("renewTime")
+        duration = float(spec.get("leaseDurationSeconds", self._duration))
+        return (
+            spec.get("holderIdentity") == self._holder
+            and int(spec.get("leaseTransitions", 0)) == token.generation
+            and renew is not None
+            and self._clock() < self._parse_time(renew) + duration
+        )

@@ -34,6 +34,7 @@ class SchedulerMetrics:
         bound label-series churn; 0 = never reset."""
         self._state_reset_interval_s = state_reset_interval_s
         self._last_state_reset: Optional[float] = None
+        self._used_labels: set = set()
         g = lambda name, doc, labels: Gauge(  # noqa: E731
             name, doc, labels, registry=registry
         )
@@ -95,6 +96,14 @@ class SchedulerMetrics:
             "Nodes currently excluded for high failure rates",
             registry=registry,
         )
+        # Executor-reported ACTUAL usage (reference metrics.go:387-395 +
+        # commonmetrics QueueUsedDesc "queue_resource_used"): what pods are
+        # consuming, as opposed to what the scheduler allocated.
+        self.queue_resource_used = g(
+            "armada_scheduler_queue_resource_used",
+            "Resource usage of non-terminal pods per queue, as reported by executors",
+            ["cluster", "pool", "queue", "resource"],
+        )
         self.fairness_error = g(
             "armada_scheduler_fairness_error",
             "Cumulative delta between adjusted fair share and actual share",
@@ -132,6 +141,28 @@ class SchedulerMetrics:
         )
 
     # --- hooks called by the Scheduler --------------------------------------
+
+    def observe_executor_usage(self, executors, factory) -> None:
+        """Publish executor-reported per-queue usage (metrics.go:387-395).
+        Values are in resource base units (atoms).  Label sets not reported
+        this round are REMOVED -- a queue whose pods all finished must not
+        keep exporting its last nonzero usage forever."""
+        seen = set()
+        for ex in executors:
+            for queue, atoms in ex.queue_usage.items():
+                for i, name in enumerate(factory.names):
+                    if i < len(atoms):
+                        labels = (ex.id, ex.pool, queue, name)
+                        seen.add(labels)
+                        self.queue_resource_used.labels(*labels).set(
+                            float(atoms[i])
+                        )
+        for labels in self._used_labels - seen:
+            try:
+                self.queue_resource_used.remove(*labels)
+            except KeyError:
+                pass
+        self._used_labels = seen
 
     def observe_cycle(self, result, duration_s: float, now: Optional[float] = None) -> None:
         """`result` is a CycleResult; records cycle time + decisions + shares."""

@@ -258,11 +258,15 @@ class Scheduler:
 
             if schedule:
                 quarantined = self.node_quarantine.quarantined(now_ns)
+                executors = self._executors()
                 if self.metrics is not None:
                     self.metrics.quarantined_nodes.set(len(quarantined))
+                    self.metrics.observe_executor_usage(
+                        executors, self.config.resource_list_factory()
+                    )
                 sched = self.algo.schedule(
                     txn,
-                    self._executors(),
+                    executors,
                     now_ns,
                     quarantined_nodes=quarantined,
                 )

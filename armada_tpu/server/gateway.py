@@ -1,0 +1,245 @@
+"""REST/JSON gateway: the grpc-gateway analog for the Submit + Event APIs.
+
+The reference exposes every Submit/Event verb over REST alongside gRPC via
+grpc-gateway (pkg/api/submit.proto google.api.http annotations:314-380,
+event.proto:274-277); this serves the SAME routes with proto-JSON bodies,
+delegating to the same in-process service objects the gRPC server wraps
+(rpc/server.py) -- so any HTTP client (including the C++ client in
+client/cpp/, built on libprotobuf's json_util) speaks a wire format byte-
+compatible with the proto schema.
+
+Routes (reference paths):
+  POST   /v1/job/submit          SubmitJobsRequest  -> SubmitJobsResponse
+  POST   /v1/job/cancel          CancelJobsRequest  -> {}
+  POST   /v1/jobset/cancel       CancelJobSetRequest-> {}
+  POST   /v1/job/reprioritize    ReprioritizeJobsRequest -> {}
+  POST   /v1/job/preempt         PreemptJobsRequest -> {}
+  POST   /v1/queue               Queue -> {}
+  PUT    /v1/queue/{name}        Queue -> {}
+  DELETE /v1/queue/{name}        -> {}
+  GET    /v1/queue/{name}        -> Queue
+  GET    /v1/batched/queues      -> QueueListResponse
+  GET    /v1/job-set/{queue}/{jobset}?from_idx=N
+         -> NDJSON stream of JobSetEventMessage (catch-up read; the
+            reference's POST /v1/job-set/{queue}/{id} stream)
+
+Identity rides the same trusted headers the gRPC metadata uses
+(x-armada-principal / x-armada-groups).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from google.protobuf import json_format
+
+from armada_tpu.rpc import convert, rpc_pb2 as pb
+from armada_tpu.server.auth import AuthorizationError, Principal
+from armada_tpu.server.queues import QueueAlreadyExists, QueueNotFound
+from armada_tpu.server.submit import SubmitError
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "armada-tpu-gateway/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # ------------------------------------------------------------------ util
+
+    def _principal(self) -> Principal:
+        name = self.headers.get("x-armada-principal", "anonymous")
+        groups = tuple(
+            g for g in (self.headers.get("x-armada-groups", "")).split(",") if g
+        )
+        return Principal(name=name, groups=groups)
+
+    def _read_proto(self, msg):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"{}"
+        return json_format.Parse(body.decode() or "{}", msg)
+
+    def _send(self, status: int, body: bytes, content_type="application/json"):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _proto(self, msg, status=200):
+        self._send(status, json_format.MessageToJson(msg).encode())
+
+    def _error(self, status: int, message: str):
+        # grpc-gateway error shape: {"code": ..., "message": ...}
+        self._send(status, json.dumps({"code": status, "message": message}).encode())
+
+    def _guard(self, fn):
+        try:
+            return fn(), True
+        except SubmitError as e:
+            self._error(400, str(e))
+        except AuthorizationError as e:
+            self._error(403, str(e))
+        except QueueNotFound as e:
+            self._error(404, f"queue {e} not found")
+        except QueueAlreadyExists as e:
+            self._error(409, f"queue {e} exists")
+        except ValueError as e:  # AFTER the queue errors, which subclass it
+            self._error(400, str(e))
+        return None, False
+
+    # ----------------------------------------------------------------- verbs
+
+    def do_POST(self):  # noqa: N802
+        gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
+        srv = gw.submit_server
+        path = urlparse(self.path).path
+        principal = self._principal()
+        if path == "/v1/job/submit":
+            req = self._read_proto(pb.SubmitJobsRequest())
+            items = [convert.submit_item_from_proto(m) for m in req.items]
+            ids, ok = self._guard(
+                lambda: srv.submit_jobs(req.queue, req.jobset, items, principal)
+            )
+            if ok:
+                self._proto(pb.SubmitJobsResponse(job_ids=ids))
+        elif path == "/v1/job/cancel":
+            req = self._read_proto(pb.CancelJobsRequest())
+            _, ok = self._guard(
+                lambda: srv.cancel_jobs(
+                    req.queue, req.jobset, list(req.job_ids), req.reason, principal
+                )
+            )
+            if ok:
+                self._proto(pb.Empty())
+        elif path == "/v1/jobset/cancel":
+            req = self._read_proto(pb.CancelJobSetRequest())
+            _, ok = self._guard(
+                lambda: srv.cancel_jobset(
+                    req.queue, req.jobset, list(req.states), req.reason, principal
+                )
+            )
+            if ok:
+                self._proto(pb.Empty())
+        elif path == "/v1/job/reprioritize":
+            req = self._read_proto(pb.ReprioritizeJobsRequest())
+            _, ok = self._guard(
+                lambda: srv.reprioritize_jobs(
+                    req.queue, req.jobset, int(req.priority), list(req.job_ids),
+                    principal,
+                )
+            )
+            if ok:
+                self._proto(pb.Empty())
+        elif path == "/v1/job/preempt":
+            req = self._read_proto(pb.PreemptJobsRequest())
+            _, ok = self._guard(
+                lambda: srv.preempt_jobs(
+                    req.queue, req.jobset, list(req.job_ids), req.reason, principal
+                )
+            )
+            if ok:
+                self._proto(pb.Empty())
+        elif path == "/v1/queue":
+            req = self._read_proto(pb.Queue())
+            record = convert.queue_from_proto(req)
+            _, ok = self._guard(lambda: srv.create_queue(record, principal))
+            if ok:
+                self._proto(pb.Empty())
+        else:
+            self._error(404, f"no route {path}")
+
+    def do_PUT(self):  # noqa: N802
+        gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
+        path = urlparse(self.path).path
+        if path.startswith("/v1/queue/"):
+            req = self._read_proto(pb.Queue())
+            req.name = path[len("/v1/queue/") :] or req.name
+            record = convert.queue_from_proto(req)
+            _, ok = self._guard(
+                lambda: gw.submit_server.update_queue(record, self._principal())
+            )
+            if ok:
+                self._proto(pb.Empty())
+        else:
+            self._error(404, f"no route {path}")
+
+    def do_DELETE(self):  # noqa: N802
+        gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
+        path = urlparse(self.path).path
+        if path.startswith("/v1/queue/"):
+            name = path[len("/v1/queue/") :]
+            _, ok = self._guard(
+                lambda: gw.submit_server.delete_queue(name, self._principal())
+            )
+            if ok:
+                self._proto(pb.Empty())
+        else:
+            self._error(404, f"no route {path}")
+
+    def do_GET(self):  # noqa: N802
+        gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
+        parsed = urlparse(self.path)
+        path = parsed.path
+        if path == "/v1/batched/queues":
+            self._proto(
+                pb.QueueListResponse(
+                    queues=[
+                        convert.queue_to_proto(q)
+                        for q in gw.submit_server.list_queues()
+                    ]
+                )
+            )
+        elif path.startswith("/v1/queue/"):
+            name = path[len("/v1/queue/") :]
+            record = gw.submit_server.get_queue(name)
+            if record is None:
+                self._error(404, f"queue {name!r} not found")
+            else:
+                self._proto(convert.queue_to_proto(record))
+        elif path.startswith("/v1/job-set/"):
+            rest = path[len("/v1/job-set/") :].split("/")
+            if len(rest) != 2 or not all(rest):
+                self._error(404, "expected /v1/job-set/{queue}/{jobset}")
+                return
+            queue, jobset = rest
+            qs = parse_qs(parsed.query)
+            idx = int(qs.get("from_idx", ["0"])[0])
+            # catch-up NDJSON stream, one JobSetEventMessage per line
+            lines: list[bytes] = []
+            while True:
+                batch = gw.event_api.get_jobset_events(queue, jobset, idx)
+                if not batch:
+                    break
+                for item in batch:
+                    msg = pb.JobSetEventMessage(idx=item.idx, sequence=item.sequence)
+                    lines.append(
+                        json_format.MessageToJson(msg, indent=None).encode()
+                        .replace(b"\n", b" ")
+                    )
+                idx = batch[-1].idx + 1
+            self._send(200, b"\n".join(lines), "application/x-ndjson")
+        else:
+            self._error(404, f"no route {path}")
+
+
+class RestGateway:
+    """Serves the gateway on `port` (0 = pick a free one)."""
+
+    def __init__(self, submit_server, event_api, port: int = 0, host: str = "127.0.0.1"):
+        self.submit_server = submit_server
+        self.event_api = event_api
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()

@@ -1,0 +1,192 @@
+// See client.h.  Transport: minimal HTTP/1.1 over POSIX sockets -- the
+// gateway always answers with Content-Length, so reads are exact.
+
+#include "client.h"
+
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+
+#include <google/protobuf/util/json_util.h>
+
+namespace armada {
+
+namespace {
+
+int Dial(const std::string& host, int port) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res) {
+    throw ClientError{0, "cannot resolve " + host};
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) throw ClientError{0, "cannot connect to " + host + ":" + port_s};
+  return fd;
+}
+
+void WriteAll(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = write(fd, data.data() + off, data.size() - off);
+    if (n <= 0) {
+      close(fd);
+      throw ClientError{0, "short write"};
+    }
+    off += static_cast<size_t>(n);
+  }
+}
+
+}  // namespace
+
+HttpResponse Client::Request(const std::string& method, const std::string& path,
+                             const std::string& body) {
+  int fd = Dial(host_, port_);
+  std::ostringstream req;
+  req << method << " " << path << " HTTP/1.1\r\n"
+      << "Host: " << host_ << "\r\n"
+      << "Connection: close\r\n"
+      << "Content-Type: application/json\r\n";
+  if (!principal_.empty()) req << "x-armada-principal: " << principal_ << "\r\n";
+  if (!groups_.empty()) req << "x-armada-groups: " << groups_ << "\r\n";
+  req << "Content-Length: " << body.size() << "\r\n\r\n" << body;
+  WriteAll(fd, req.str());
+
+  std::string raw;
+  char buf[8192];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) raw.append(buf, static_cast<size_t>(n));
+  close(fd);
+
+  HttpResponse resp;
+  const size_t header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) throw ClientError{0, "malformed response"};
+  const size_t sp = raw.find(' ');
+  resp.status = std::stoi(raw.substr(sp + 1, 3));
+  resp.body = raw.substr(header_end + 4);
+  return resp;
+}
+
+std::string Client::CallJson(const std::string& method, const std::string& path,
+                             const google::protobuf::Message* request) {
+  std::string body;
+  if (request != nullptr) {
+    auto status =
+        google::protobuf::util::MessageToJsonString(*request, &body);
+    if (!status.ok()) throw ClientError{0, "request encode failed"};
+  }
+  HttpResponse resp = Request(method, path, body);
+  if (resp.status < 200 || resp.status >= 300) {
+    throw ClientError{resp.status, resp.body};
+  }
+  return resp.body;
+}
+
+void Client::Call(const std::string& method, const std::string& path,
+                  const google::protobuf::Message* request,
+                  google::protobuf::Message* response) {
+  std::string body = CallJson(method, path, request);
+  if (response != nullptr) {
+    google::protobuf::util::JsonParseOptions opts;
+    opts.ignore_unknown_fields = true;
+    auto status = google::protobuf::util::JsonStringToMessage(
+        body.empty() ? "{}" : body, response, opts);
+    if (!status.ok()) {
+      throw ClientError{0, "response decode failed: " + body};
+    }
+  }
+}
+
+void Client::CreateQueue(const armada_tpu::api::Queue& queue) {
+  armada_tpu::api::Empty empty;
+  Call("POST", "/v1/queue", &queue, &empty);
+}
+
+void Client::UpdateQueue(const armada_tpu::api::Queue& queue) {
+  armada_tpu::api::Empty empty;
+  Call("PUT", "/v1/queue/" + queue.name(), &queue, &empty);
+}
+
+void Client::DeleteQueue(const std::string& name) {
+  armada_tpu::api::Empty empty;
+  Call("DELETE", "/v1/queue/" + name, nullptr, &empty);
+}
+
+armada_tpu::api::Queue Client::GetQueue(const std::string& name) {
+  armada_tpu::api::Queue out;
+  Call("GET", "/v1/queue/" + name, nullptr, &out);
+  return out;
+}
+
+armada_tpu::api::QueueListResponse Client::ListQueues() {
+  armada_tpu::api::QueueListResponse out;
+  Call("GET", "/v1/batched/queues", nullptr, &out);
+  return out;
+}
+
+armada_tpu::api::SubmitJobsResponse Client::SubmitJobs(
+    const armada_tpu::api::SubmitJobsRequest& request) {
+  armada_tpu::api::SubmitJobsResponse out;
+  Call("POST", "/v1/job/submit", &request, &out);
+  return out;
+}
+
+void Client::CancelJobs(const armada_tpu::api::CancelJobsRequest& request) {
+  armada_tpu::api::Empty empty;
+  Call("POST", "/v1/job/cancel", &request, &empty);
+}
+
+void Client::CancelJobSet(const armada_tpu::api::CancelJobSetRequest& request) {
+  armada_tpu::api::Empty empty;
+  Call("POST", "/v1/jobset/cancel", &request, &empty);
+}
+
+void Client::PreemptJobs(const armada_tpu::api::PreemptJobsRequest& request) {
+  armada_tpu::api::Empty empty;
+  Call("POST", "/v1/job/preempt", &request, &empty);
+}
+
+void Client::ReprioritizeJobs(
+    const armada_tpu::api::ReprioritizeJobsRequest& request) {
+  armada_tpu::api::Empty empty;
+  Call("POST", "/v1/job/reprioritize", &request, &empty);
+}
+
+std::vector<armada_tpu::api::JobSetEventMessage> Client::GetJobSetEvents(
+    const std::string& queue, const std::string& jobset, long from_idx) {
+  std::string body = CallJson(
+      "GET",
+      "/v1/job-set/" + queue + "/" + jobset +
+          "?from_idx=" + std::to_string(from_idx),
+      nullptr);
+  std::vector<armada_tpu::api::JobSetEventMessage> out;
+  std::istringstream lines(body);
+  std::string line;
+  google::protobuf::util::JsonParseOptions opts;
+  opts.ignore_unknown_fields = true;
+  while (std::getline(lines, line)) {
+    if (line.empty()) continue;
+    armada_tpu::api::JobSetEventMessage msg;
+    auto status =
+        google::protobuf::util::JsonStringToMessage(line, &msg, opts);
+    if (!status.ok()) throw ClientError{0, "event decode failed: " + line};
+    out.push_back(std::move(msg));
+  }
+  return out;
+}
+
+}  // namespace armada

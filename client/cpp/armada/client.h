@@ -1,0 +1,79 @@
+// armada-tpu C++ client: proto-typed bindings over the REST gateway.
+//
+// The reference ships native client bindings (client/DotNet, client/java,
+// client/scala); this image carries no JVM or .NET toolchain, so the native
+// binding here is C++ against the grpc-gateway-parity REST surface
+// (armada_tpu/server/gateway.py), using libprotobuf's json_util so every
+// request/response is a typed message from the SAME rpc.proto/events.proto
+// the Python services compile (reference paths: pkg/api/submit.proto
+// google.api.http annotations :314-380).
+//
+// No dependencies beyond libprotobuf and POSIX sockets.
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "rpc.pb.h"
+
+namespace armada {
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+};
+
+// Thrown on transport errors and non-2xx statuses.
+struct ClientError {
+  int status;          // 0 = transport failure
+  std::string message;
+};
+
+class Client {
+ public:
+  Client(std::string host, int port) : host_(std::move(host)), port_(port) {}
+
+  // --- queue CRUD -----------------------------------------------------------
+  void CreateQueue(const armada_tpu::api::Queue& queue);
+  void UpdateQueue(const armada_tpu::api::Queue& queue);
+  void DeleteQueue(const std::string& name);
+  armada_tpu::api::Queue GetQueue(const std::string& name);
+  armada_tpu::api::QueueListResponse ListQueues();
+
+  // --- job verbs ------------------------------------------------------------
+  armada_tpu::api::SubmitJobsResponse SubmitJobs(
+      const armada_tpu::api::SubmitJobsRequest& request);
+  void CancelJobs(const armada_tpu::api::CancelJobsRequest& request);
+  void CancelJobSet(const armada_tpu::api::CancelJobSetRequest& request);
+  void PreemptJobs(const armada_tpu::api::PreemptJobsRequest& request);
+  void ReprioritizeJobs(const armada_tpu::api::ReprioritizeJobsRequest& request);
+
+  // --- events ---------------------------------------------------------------
+  // Catch-up read of a jobset's event stream from `from_idx` (the
+  // reference's GetJobSetEvents, pkg/api/event.proto:272).
+  std::vector<armada_tpu::api::JobSetEventMessage> GetJobSetEvents(
+      const std::string& queue, const std::string& jobset, long from_idx = 0);
+
+  // Identity headers (x-armada-principal / x-armada-groups).
+  void SetPrincipal(std::string principal, std::string groups = "") {
+    principal_ = std::move(principal);
+    groups_ = std::move(groups);
+  }
+
+ private:
+  HttpResponse Request(const std::string& method, const std::string& path,
+                       const std::string& body);
+  std::string CallJson(const std::string& method, const std::string& path,
+                       const google::protobuf::Message* request);
+  void Call(const std::string& method, const std::string& path,
+            const google::protobuf::Message* request,
+            google::protobuf::Message* response);
+
+  std::string host_;
+  int port_;
+  std::string principal_;
+  std::string groups_;
+};
+
+}  // namespace armada

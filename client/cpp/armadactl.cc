@@ -1,0 +1,79 @@
+// Demo CLI for the C++ client -- the smoke-test driver
+// (tests/test_cpp_client.py) and a minimal native armadactl:
+//
+//   armadactl-cpp HOST PORT create-queue NAME WEIGHT
+//   armadactl-cpp HOST PORT list-queues
+//   armadactl-cpp HOST PORT submit QUEUE JOBSET CPU MEMORY [N]
+//   armadactl-cpp HOST PORT cancel QUEUE JOBSET JOB_ID
+//   armadactl-cpp HOST PORT events QUEUE JOBSET        (prints one kind/line)
+
+#include <cstdio>
+#include <cstdlib>
+#include <iostream>
+#include <string>
+
+#include "armada/client.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s HOST PORT VERB ...\n", argv[0]);
+    return 2;
+  }
+  armada::Client client(argv[1], std::atoi(argv[2]));
+  client.SetPrincipal("cpp-client");
+  const std::string verb = argv[3];
+  try {
+    if (verb == "create-queue" && argc >= 6) {
+      armada_tpu::api::Queue q;
+      q.set_name(argv[4]);
+      q.set_weight(std::atof(argv[5]));
+      client.CreateQueue(q);
+      std::printf("created %s\n", q.name().c_str());
+    } else if (verb == "list-queues") {
+      // bind the response first: ranging over `.queues()` of a temporary is
+      // a use-after-scope (the temporary is not lifetime-extended)
+      const auto queues = client.ListQueues();
+      for (const auto& q : queues.queues()) {
+        std::printf("%s weight=%g\n", q.name().c_str(), q.weight());
+      }
+    } else if (verb == "submit" && argc >= 8) {
+      armada_tpu::api::SubmitJobsRequest req;
+      req.set_queue(argv[4]);
+      req.set_jobset(argv[5]);
+      int n = argc >= 9 ? std::atoi(argv[8]) : 1;
+      for (int i = 0; i < n; ++i) {
+        auto* item = req.add_items();
+        (*item->mutable_resources())["cpu"] = argv[6];
+        (*item->mutable_resources())["memory"] = argv[7];
+      }
+      auto resp = client.SubmitJobs(req);
+      for (const auto& id : resp.job_ids()) std::printf("%s\n", id.c_str());
+    } else if (verb == "cancel" && argc >= 7) {
+      armada_tpu::api::CancelJobsRequest req;
+      req.set_queue(argv[4]);
+      req.set_jobset(argv[5]);
+      req.add_job_ids(argv[6]);
+      req.set_reason("cancelled via cpp client");
+      client.CancelJobs(req);
+      std::printf("cancelled %s\n", argv[6]);
+    } else if (verb == "events" && argc >= 6) {
+      for (const auto& msg : client.GetJobSetEvents(argv[4], argv[5])) {
+        for (const auto& ev : msg.sequence().events()) {
+          // the oneof case name doubles as the event kind
+          const auto* desc = ev.GetDescriptor()->FindOneofByName("event");
+          const auto* field =
+              ev.GetReflection()->GetOneofFieldDescriptor(ev, desc);
+          std::printf("%lld %s\n", static_cast<long long>(msg.idx()),
+                      field ? field->name().c_str() : "?");
+        }
+      }
+    } else {
+      std::fprintf(stderr, "unknown verb %s\n", verb.c_str());
+      return 2;
+    }
+  } catch (const armada::ClientError& e) {
+    std::fprintf(stderr, "error (%d): %s\n", e.status, e.message.c_str());
+    return 1;
+  }
+  return 0;
+}

@@ -1,7 +1,9 @@
 """An in-process fake kube-apiserver covering the endpoints the
-KubernetesClusterContext uses: create/delete/list pods, list nodes, pod logs.
-Test code mutates `pods`/`nodes` directly to simulate kubelet behavior
-(phase transitions, node drains)."""
+KubernetesClusterContext uses (create/delete/list pods, list nodes, pod
+logs) plus coordination.k8s.io/v1 Leases with resourceVersion optimistic
+concurrency (for KubernetesLeaseLeaderController).  Test code mutates
+`pods`/`nodes` directly to simulate kubelet behavior (phase transitions,
+node drains)."""
 
 from __future__ import annotations
 
@@ -19,6 +21,9 @@ class FakeKubeApi:
         self.nodes: list = []
         self.logs: dict = {}  # (namespace, name) -> str
         self.requests: list = []  # (method, path) log for assertions
+        # (namespace, name) -> lease dict with metadata.resourceVersion
+        self.leases: dict = {}
+        self._rv = 0
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self.port = self._httpd.server_address[1]
@@ -90,10 +95,33 @@ class FakeKubeApi:
                         out.append(p)
                 return out
 
+            def _lease_key(self, parts):
+                # apis/coordination.k8s.io/v1/namespaces/{ns}/leases[/{name}]
+                if (
+                    len(parts) >= 6
+                    and parts[0] == "apis"
+                    and parts[1] == "coordination.k8s.io"
+                    and parts[3] == "namespaces"
+                    and parts[5] == "leases"
+                ):
+                    ns = parts[4]
+                    name = parts[6] if len(parts) > 6 else None
+                    return ns, name
+                return None
+
             def do_GET(self):  # noqa: N802
                 parsed = urlparse(self.path)
                 api.requests.append(("GET", parsed.path))
                 parts = parsed.path.strip("/").split("/")
+                lk = self._lease_key(parts)
+                if lk is not None and lk[1] is not None:
+                    with api.lock:
+                        lease = api.leases.get(lk)
+                    if lease is None:
+                        self._json(404, {"message": "not found"})
+                    else:
+                        self._json(200, lease)
+                    return
                 if parsed.path == "/api/v1/nodes":
                     self._json(200, {"items": list(api.nodes)})
                 elif parsed.path == "/api/v1/pods":
@@ -131,6 +159,20 @@ class FakeKubeApi:
                 parts = parsed.path.strip("/").split("/")
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length)) if length else {}
+                lk = self._lease_key(parts)
+                if lk is not None and lk[1] is None:
+                    ns = lk[0]
+                    name = body["metadata"]["name"]
+                    with api.lock:
+                        if (ns, name) in api.leases:
+                            self._json(409, {"message": "already exists"})
+                            return
+                        api._rv += 1
+                        body["metadata"]["namespace"] = ns
+                        body["metadata"]["resourceVersion"] = str(api._rv)
+                        api.leases[(ns, name)] = body
+                    self._json(201, body)
+                    return
                 if len(parts) == 5 and parts[-1] == "pods":
                     ns = parts[3]
                     name = body["metadata"]["name"]
@@ -144,6 +186,31 @@ class FakeKubeApi:
                     self._json(201, body)
                 else:
                     self._json(404, {"message": "not found"})
+
+            def do_PUT(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                api.requests.append(("PUT", parsed.path))
+                parts = parsed.path.strip("/").split("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length)) if length else {}
+                lk = self._lease_key(parts)
+                if lk is not None and lk[1] is not None:
+                    with api.lock:
+                        cur = api.leases.get(lk)
+                        if cur is None:
+                            self._json(404, {"message": "not found"})
+                            return
+                        # optimistic concurrency: stale resourceVersion -> 409
+                        sent_rv = body.get("metadata", {}).get("resourceVersion")
+                        if sent_rv != cur["metadata"]["resourceVersion"]:
+                            self._json(409, {"message": "conflict"})
+                            return
+                        api._rv += 1
+                        body["metadata"]["resourceVersion"] = str(api._rv)
+                        api.leases[lk] = body
+                    self._json(200, body)
+                    return
+                self._json(404, {"message": "not found"})
 
             def do_DELETE(self):  # noqa: N802
                 parsed = urlparse(self.path)

@@ -318,3 +318,47 @@ def test_sorted_table_invariant():
         ]
         assert keys == sorted(keys), f"sort invariant broken at step {step}"
         assert {t.ids[r].tobytes().rstrip(b'\0') for r in rows} == set(live)
+
+
+def test_txn_abort_resyncs_the_feed(tmp_path):
+    """An aborted txn (publish failure / fencing loss) must not leave the
+    cycle-persistent builders ahead of the JobDb: the feed resyncs from
+    committed state (CLAUDE.md: state only advances with a committed txn)."""
+    from armada_tpu.jobdb.job import Job, JobRun
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+    jobdb = JobDb(CFG)
+    feed = IncrementalProblemFeed(CFG)
+    feed.attach(jobdb)
+    b = feed.builder_for("default")
+    b.set_queues([Queue("qa")])
+    b.set_nodes([_node("n0")])
+
+    with jobdb.write_txn() as txn:
+        txn.upsert(Job(spec=_job("j1", "qa", 2), validated=True))
+        txn.upsert(Job(spec=_job("j2", "qa", 2), validated=True))
+    assert len(b.jobs.key_of_id) == 2
+
+    # an aborted overlay: j1 leased + j3 submitted, then the txn dies
+    txn = jobdb.write_txn()
+    j1 = txn.get("j1")
+    txn.upsert(
+        dataclasses.replace(
+            j1,
+            queued=False,
+            runs=(JobRun(id="r1", job_id="j1", created_ns=1, node_id="n0",
+                         pool="default"),),
+        )
+    )
+    txn.upsert(Job(spec=_job("j3", "qa", 2), validated=True))
+    feed.on_delta(txn._upserts, txn._deletes)  # what schedule() does
+    assert len(b.jobs.key_of_id) == 2  # j1 out, j3 in
+    txn.abort()
+
+    # after the abort the builders reflect committed state again
+    b = feed.builder_for("default")
+    b.set_queues([Queue("qa")])
+    b.set_nodes([_node("n0")])
+    assert sorted(k.decode() for k in b.jobs.key_of_id) == ["j1", "j2"]
+    assert len(b.runs.key_of_id) == 0

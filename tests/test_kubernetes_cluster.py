@@ -246,3 +246,21 @@ def test_executor_id_isolation_and_namespace_scoping(kube):
     assert ("GET", "/api/v1/pods") not in [
         r for r in kube.requests if r[1].endswith("/batch/pods")
     ]
+
+
+def test_queue_usage_scrapes_pod_requests(kube, ctx):
+    """The kube adapter's usage scrape sums non-terminal armada pods'
+    container requests per queue (cluster_utilisation.go:68)."""
+    ctx.submit_pod("run-1", "j1", "qa", "js", spec(), "w1")
+    ctx.submit_pod("run-2", "j2", "qa", "js", spec(), "w1")
+    ctx.submit_pod("run-3", "j3", "qb", "js", spec(), "w1")
+    kube.set_phase("default", "armada-run-2", "Running")
+    kube.set_phase("default", "armada-run-3", "Succeeded")
+
+    usage = ctx.queue_usage()
+    from armada_tpu.core.resources import parse_quantity
+
+    cpu_i = ctx._factory.names.index("cpu")
+    # qa: one pending + one running pod, 2 cpu each; qb's pod is terminal
+    assert usage["qa"][cpu_i] == 2 * parse_quantity("2")
+    assert "qb" not in usage

@@ -155,3 +155,91 @@ def test_jobs_cli_over_wire(cp, capsys):
         assert "state: QUEUED" in out
     finally:
         server.stop(None)
+
+
+def test_annotation_match_modes(cp):
+    """Annotation filters carry the full match-mode set
+    (querybuilder.go:320-346: exact / startsWith / contains / exists)."""
+    cp.server.submit_jobs("qa", "ann2", [item(annotations={"stage": "training-7"})])
+    cp.server.submit_jobs("qa", "ann2", [item(annotations={"stage": "eval-7"})])
+    cp.server.submit_jobs("qa", "ann2", [item(annotations={"other": "x"})])
+    q = lk(cp)
+    ann = lambda v, m: JobFilter("annotation", v, m, annotation_key="stage")
+    assert len(q.get_jobs([ann("training-7", "exact")])) == 1
+    assert len(q.get_jobs([ann("training", "startsWith")])) == 1
+    assert len(q.get_jobs([ann("-7", "contains")])) == 2
+    assert len(q.get_jobs([ann(None, "exists")])) == 2
+    assert len(q.get_jobs([ann(["training-7", "eval-7"], "in")])) == 2
+    # exists is annotation-only
+    with pytest.raises(ValueError):
+        q.get_jobs([JobFilter("queue", None, "exists")])
+
+
+def test_group_by_annotation(cp):
+    """Grouping by an annotation key implies an exists filter so jobs
+    without the key never form a null group (querybuilder.go:206-213)."""
+    cp.server.submit_jobs("qa", "g3", [item(annotations={"team": "ml"})] * 2)
+    cp.server.submit_jobs("qa", "g3", [item(annotations={"team": "infra"})])
+    cp.server.submit_jobs("qa", "g3", [item()])  # no team annotation
+    q = lk(cp)
+    groups = q.group_jobs("annotation", annotation_key="team")
+    assert [(g["group"], g["count"]) for g in groups] == [("ml", 2), ("infra", 1)]
+
+
+def test_group_aggregates(cp):
+    """Requestable aggregates (tables.go:110-114 groupAggregates: min
+    submitted, avg lastTransitionTime, state counts) plus per-group resource
+    sums."""
+    cp.server.submit_jobs("qa", "g4", [item(cpu="2"), item(cpu="3")])
+    cp.server.submit_jobs("qb", "g4", [item(cpu="1")])
+    q = lk(cp)
+    groups = q.group_jobs(
+        "queue", aggregates=("state", "submitted", "cpu_milli", "memory")
+    )
+    by_q = {g["group"]: g for g in groups}
+    assert by_q["qa"]["count"] == 2
+    assert by_q["qa"]["cpu_milli"] == 5000.0
+    # memory rides the same milli-unit encoding the ingester stores
+    assert by_q["qa"]["memory"] == 4000.0
+    assert by_q["qb"]["cpu_milli"] == 1000.0
+    assert by_q["qa"]["submitted"] > 0
+    assert by_q["qa"]["states"]["QUEUED"] == 2
+    with pytest.raises(ValueError):
+        q.group_jobs("queue", aggregates=("bogus",))
+
+
+def test_group_aggregates_over_wire_and_webui(cp):
+    """The new group options ride the gRPC Lookout surface and the webui
+    query params."""
+    import json
+    import urllib.request
+
+    from armada_tpu.lookout.webui import LookoutWebUI
+
+    cp.server.submit_jobs("qa", "g5", [item(annotations={"team": "ml"})])
+    cp.server.submit_jobs("qa", "g5", [item(annotations={"team": "ml"})])
+    q = lk(cp)
+    ui = LookoutWebUI(q, port=0)
+    try:
+        url = (
+            f"http://127.0.0.1:{ui.port}/api/groups?by=annotation&key=team"
+            "&aggs=state,cpu_milli&take=10"
+        )
+        with urllib.request.urlopen(url) as resp:
+            data = json.loads(resp.read())
+        assert data["groups"][0]["group"] == "ml"
+        assert data["groups"][0]["count"] == 2
+        assert data["groups"][0]["cpu_milli"] == 4000.0
+        # annotation filter on the jobs listing
+        url2 = (
+            f"http://127.0.0.1:{ui.port}/api/jobs?ann.team=ml&take=10"
+        )
+        with urllib.request.urlopen(url2) as resp:
+            data2 = json.loads(resp.read())
+        assert data2["total"] == 2
+        url3 = f"http://127.0.0.1:{ui.port}/api/jobs?ann.team=*&take=10"
+        with urllib.request.urlopen(url3) as resp:
+            data3 = json.loads(resp.read())
+        assert data3["total"] == 2
+    finally:
+        ui.stop()

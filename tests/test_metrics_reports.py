@@ -219,3 +219,44 @@ def test_job_state_counters_reset_on_interval(tmp_path):
     plane.scheduler.cycle()
     assert sample(plane, "armada_scheduler_job_state_counter_by_queue_total", labels) is None
     plane.close()
+
+
+def test_executor_usage_flows_into_queue_resource_used(cp):
+    """Executor-reported pod usage reaches the scheduler's
+    queue_resource_used gauge (cluster_utilisation.go:68,125 ->
+    metrics.go:387-395 -> commonmetrics queue_resource_used): the fake
+    cluster reports pending/running pods' requests per queue in its
+    snapshot, and the next cycle publishes them."""
+    cp.server.submit_jobs("heavy", "u", [item(cpu="2"), item(cpu="2")])
+    cp.run_until(
+        lambda: sum(
+            1 for s in cp.job_states().values() if s in ("leased", "running")
+        )
+        == 2
+    )
+    # one more executor round-trip + cycle so the post-lease snapshot (with
+    # the pods pending/running) reaches the scheduler
+    for ex in cp.executors:
+        ex.run_once()
+    cp.ingest()
+    cp.scheduler.cycle()
+
+    ex_id = cp.executors[0].id
+    used_cpu = sample(
+        cp,
+        "armada_scheduler_queue_resource_used",
+        {"cluster": ex_id, "pool": "default", "queue": "heavy", "resource": "cpu"},
+    )
+    used_mem = sample(
+        cp,
+        "armada_scheduler_queue_resource_used",
+        {"cluster": ex_id, "pool": "default", "queue": "heavy", "resource": "memory"},
+    )
+    assert used_cpu is not None and used_cpu > 0
+    assert used_mem is not None and used_mem > 0
+    # usage equals the two pods' cpu requests in atoms (2 cpu each)
+    factory = cp.config.resource_list_factory()
+    two_cpu_atoms = 2 * factory.from_mapping({"cpu": "2", "memory": "2"}).atoms[
+        factory.index_of("cpu")
+    ]
+    assert used_cpu == float(two_cpu_atoms)
