@@ -431,6 +431,7 @@ def cmd_serve(args):
         rest_port=args.rest_port,
         kube_lease_url=args.kube_lease_url,
         kube_lease_namespace=args.kube_lease_namespace,
+        bind_host=args.bind_host,
     )
     print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
     if plane.health_server is not None:
@@ -609,6 +610,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve the grpc-gateway-parity REST/JSON API on this port "
         "(0 = pick a free one); the C++ client (client/cpp) targets it",
     )
+    srv.add_argument(
+        "--bind-host",
+        default="127.0.0.1",
+        help="address every server binds (gRPC/REST/lookout/health); "
+        "use 0.0.0.0 in containers so other hosts can reach the plane",
+    )
     srv.set_defaults(fn=cmd_serve)
 
     rep = sub.add_parser("scheduling-report", help="why (not) scheduled forensics")
@@ -681,6 +688,9 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     import grpc
 
+    from armada_tpu.core.platform import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)

@@ -105,6 +105,7 @@ def start_control_plane(
     rest_port: Optional[int] = None,
     kube_lease_url: Optional[str] = None,
     kube_lease_namespace: str = "default",
+    bind_host: str = "127.0.0.1",
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -146,15 +147,32 @@ def start_control_plane(
     submit_server = SubmitServer(db, publisher, queues, config)
     event_api = EventApi(eventdb)
     jobdb = JobDb(config)
+    if kube_lease_url and not leader_id:
+        # Silent fallback to always-leader here would be split-brain with two
+        # replicas: requesting kube election without a holder id is an error.
+        raise ValueError("--kube-lease-url requires --leader-id (the holder identity)")
     if leader_id and kube_lease_url:
         # Replicated deployment on Kubernetes: coordination/v1 Lease election
         # (leader.go:112-186); falls back to the file lease off-cluster.
         from armada_tpu.scheduler.kube_leader import KubernetesLeaseLeaderController
 
+        # In-cluster credentials: the standard service-account mount
+        # (rest.InClusterConfig's sources); without them the apiserver answers
+        # 401/TLS failure and no replica would ever lead.
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        sa_token = None
+        sa_ca = None
+        if os.path.exists(f"{sa}/token"):
+            with open(f"{sa}/token") as f:
+                sa_token = f.read().strip()
+            if os.path.exists(f"{sa}/ca.crt"):
+                sa_ca = f"{sa}/ca.crt"
         leader = KubernetesLeaseLeaderController(
             kube_lease_url,
             leader_id,
             namespace=kube_lease_namespace,
+            token=sa_token,
+            ca_file=sa_ca,
         )
     else:
         leader = (
@@ -217,7 +235,7 @@ def start_control_plane(
         factory=factory,
         lookout_queries=LookoutQueries(lookoutdb),
         reports=reports,
-        address=f"127.0.0.1:{port}",
+        address=f"{bind_host}:{port}",
     )
 
     scheduler_pipeline.start()
@@ -252,7 +270,7 @@ def start_control_plane(
             StartupCompleteChecker,
         )
 
-        health_server = HealthServer(health_port, profiling=profiling)
+        health_server = HealthServer(health_port, profiling=profiling, host=bind_host)
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(
@@ -280,13 +298,13 @@ def start_control_plane(
     if lookout_port is not None:
         from armada_tpu.lookout.webui import LookoutWebUI
 
-        lookout_web = LookoutWebUI(LookoutQueries(lookoutdb), lookout_port)
+        lookout_web = LookoutWebUI(LookoutQueries(lookoutdb), lookout_port, host=bind_host)
 
     rest_gateway = None
     if rest_port is not None:
         from armada_tpu.server.gateway import RestGateway
 
-        rest_gateway = RestGateway(submit_server, event_api, rest_port)
+        rest_gateway = RestGateway(submit_server, event_api, rest_port, host=bind_host)
 
     return ControlPlaneProcess(
         port=bound_port,

@@ -182,10 +182,10 @@ class _Handler(BaseHTTPRequestHandler):
 class HealthServer:
     """Serves /health (+ /debug/pprof/* when profiling=True) on `port`."""
 
-    def __init__(self, port: int = 0, profiling: bool = False):
+    def __init__(self, port: int = 0, profiling: bool = False, host: str = "127.0.0.1"):
         self.checker = MultiChecker()
         self.profiling = profiling
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

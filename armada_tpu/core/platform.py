@@ -1,0 +1,28 @@
+"""Make user-facing entry points honor JAX_PLATFORMS.
+
+The axon TPU plugin force-sets jax's `jax_platforms` CONFIG at import time,
+which silently overrides the JAX_PLATFORMS environment variable -- so
+`JAX_PLATFORMS=cpu python -m armada_tpu.simulator` would still dial the TPU
+tunnel (and hang indefinitely when it is down; the tunnel blocks on its chip
+claim rather than failing).  Every CLI entry point calls
+`respect_jax_platforms_env()` before any jax computation: if the user set
+JAX_PLATFORMS, that choice is re-asserted at config level, restoring
+standard JAX behavior.
+
+Library code never calls this (and never touches a backend at import);
+tests pin CPU in conftest; bench.py/__graft_entry__.py carry their own
+stronger pinning (subprocess probes + backend resets).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_jax_platforms_env() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", env)

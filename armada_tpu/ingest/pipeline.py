@@ -89,15 +89,19 @@ class IngestionPipeline:
         return self._thread is not None and self._thread.is_alive()
 
     def _loop(self) -> None:
-        import logging
+        from armada_tpu.core.logging import get_logger, log_context
 
         backoff = self._poll_interval
+        with log_context(consumer=self.consumer_name):
+            self._loop_inner(get_logger(__name__), backoff)
+
+    def _loop_inner(self, log, backoff) -> None:
         while not self._stop.is_set():
             try:
                 n = self.run_once()
                 backoff = self._poll_interval
             except Exception:  # noqa: BLE001 - service thread must survive
-                logging.getLogger(__name__).exception(
+                log.exception(
                     "ingestion pipeline %s: batch failed; retrying",
                     self.consumer_name,
                 )

@@ -132,14 +132,19 @@ tbody tr { cursor: pointer; }
     <input id="f-queue" placeholder="queue contains…">
     <input id="f-jobset" placeholder="jobset contains…">
     <select id="f-state"><option value="">any state</option>__STATE_OPTIONS__</select>
+    <input id="f-ann" placeholder="annotation key=value (or key=*)" title="filter by annotation; key=* matches any value">
     <select id="f-group">
       <option value="">no grouping</option>
       <option value="queue">group by queue</option>
       <option value="jobset">group by jobset</option>
       <option value="state">group by state</option>
+      <option value="annotation">group by annotation…</option>
     </select>
+    <input id="f-groupkey" placeholder="annotation key" style="display:none">
     <button id="refresh">refresh</button>
     <label class="chip"><input type="checkbox" id="auto" checked> auto (3s)</label>
+    <select id="views"><option value="">saved views…</option></select>
+    <button id="save-view" title="save the current filters as a named view">save view</button>
   </div>
   <div id="content"></div>
   <div class="pager" id="pager"></div>
@@ -165,7 +170,29 @@ function filterQS() {
   if ($("f-queue").value) p.set("queue", $("f-queue").value);
   if ($("f-jobset").value) p.set("jobset", $("f-jobset").value);
   if ($("f-state").value) p.set("state", $("f-state").value);
+  const ann = $("f-ann").value.trim();
+  if (ann && ann.includes("=")) {
+    const i = ann.indexOf("=");
+    p.set("ann." + ann.slice(0, i).trim(), ann.slice(i + 1).trim() || "*");
+  }
   return p;
+}
+
+// --- saved views (localStorage; the reference UI's saved-view feature) ----
+const VIEWS_KEY = "armada-tpu-views";
+const loadViews = () => JSON.parse(localStorage.getItem(VIEWS_KEY) || "{}");
+function renderViews() {
+  const views = loadViews();
+  $("views").innerHTML = '<option value="">saved views…</option>' +
+    Object.keys(views).sort().map((n) =>
+      `<option value="${esc(n)}">${esc(n)}</option>`).join("") +
+    (Object.keys(views).length ? '<option value="__clear__">✕ delete all</option>' : "");
+}
+function applyView(v) {
+  for (const [id, val] of Object.entries(v)) { if ($(id)) $(id).value = val; }
+  $("f-groupkey").style.display =
+    $("f-group").value === "annotation" ? "" : "none";
+  refresh();
 }
 async function j(url) { const r = await fetch(url); return r.json(); }
 
@@ -196,8 +223,15 @@ function stateCell(s) {
 async function loadContent() {
   const my = ++contentSeq;
   const group = $("f-group").value;
+  if (group === "annotation" && !$("f-groupkey").value.trim()) {
+    $("content").innerHTML = '<div class="empty">enter an annotation key to group by</div>';
+    $("pager").innerHTML = "";
+    return;
+  }
   if (group) {
-    const d = await j(`/api/groups?by=${group}&take=500&` + filterQS());
+    const keyQ = group === "annotation"
+      ? `&key=${encodeURIComponent($("f-groupkey").value.trim())}` : "";
+    const d = await j(`/api/groups?by=${group}&take=500${keyQ}&` + filterQS());
     if (my !== contentSeq) return;
     $("pager").innerHTML = "";
     if (!d.groups.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; return; }
@@ -215,6 +249,8 @@ async function loadContent() {
     for (const tr of $("content").querySelectorAll("tr[data-group]")) {
       tr.onclick = () => {
         if (group === "state") $("f-state").value = tr.dataset.group;
+        else if (group === "annotation")
+          $("f-ann").value = $("f-groupkey").value.trim() + "=" + tr.dataset.group;
         else $(group === "queue" ? "f-queue" : "f-jobset").value = tr.dataset.group;
         $("f-group").value = "";
         refresh();
@@ -287,8 +323,34 @@ async function openDetails(id) {
 }
 function refresh() { loadOverview(); loadContent(); }
 $("refresh").onclick = refresh;
-for (const id of ["f-queue", "f-jobset", "f-state", "f-group"])
+for (const id of ["f-queue", "f-jobset", "f-state", "f-group", "f-ann", "f-groupkey"])
   $(id).addEventListener("change", () => { skip = 0; refresh(); });
+$("f-group").addEventListener("change", () => {
+  $("f-groupkey").style.display =
+    $("f-group").value === "annotation" ? "" : "none";
+});
+$("save-view").onclick = () => {
+  const name = prompt("view name:");
+  if (!name) return;
+  const views = loadViews();
+  views[name] = Object.fromEntries(
+    ["f-queue", "f-jobset", "f-state", "f-ann", "f-group", "f-groupkey"]
+      .map((id) => [id, $(id).value]));
+  localStorage.setItem(VIEWS_KEY, JSON.stringify(views));
+  renderViews();
+  $("views").value = name;
+};
+$("views").addEventListener("change", () => {
+  const name = $("views").value;
+  if (name === "__clear__") {
+    localStorage.removeItem(VIEWS_KEY);
+    renderViews();
+    return;
+  }
+  const v = loadViews()[name];
+  if (v) applyView(v);
+});
+renderViews();
 $("theme").onclick = () => {
   const r = document.documentElement;
   r.dataset.theme = dark() ? "light" : "dark";

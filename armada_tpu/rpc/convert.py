@@ -121,7 +121,7 @@ def node_from_proto(msg: pb.Node, factory: ResourceListFactory) -> NodeSpec:
 
 
 def snapshot_to_proto(snap: ExecutorSnapshot) -> pb.ExecutorSnapshot:
-    return pb.ExecutorSnapshot(
+    msg = pb.ExecutorSnapshot(
         id=snap.id,
         pool=snap.pool,
         nodes=[node_to_proto(n) for n in snap.nodes],
@@ -130,11 +130,37 @@ def snapshot_to_proto(snap: ExecutorSnapshot) -> pb.ExecutorSnapshot:
         last_update_ns=snap.last_update_ns,
         cordoned=snap.cordoned,
     )
+    # name-keyed so the axis order never has to match across versions
+    names = _factory_names(snap)
+    for queue, atoms in snap.queue_usage.items():
+        entry = msg.queue_usage[queue]
+        for i, amount in enumerate(atoms):
+            if i < len(names) and amount:
+                entry.atoms[names[i]] = int(amount)
+    return msg
+
+
+def _factory_names(snap: ExecutorSnapshot) -> tuple:
+    # The snapshot's nodes carry ResourceLists built by the shared factory;
+    # fall back to the default registry when the snapshot has no nodes.
+    for n in snap.nodes:
+        if n.total_resources is not None:
+            return n.total_resources.factory.names
+    from armada_tpu.core.config import default_scheduling_config
+
+    return default_scheduling_config().resource_list_factory().names
 
 
 def snapshot_from_proto(
     msg: pb.ExecutorSnapshot, factory: ResourceListFactory
 ) -> ExecutorSnapshot:
+    queue_usage = {}
+    for queue, entry in msg.queue_usage.items():
+        atoms = [0] * factory.num_resources
+        for name, amount in entry.atoms.items():
+            if name in factory.names:
+                atoms[factory.index_of(name)] = int(amount)
+        queue_usage[queue] = tuple(atoms)
     return ExecutorSnapshot(
         id=msg.id,
         pool=msg.pool or "default",
@@ -143,6 +169,7 @@ def snapshot_from_proto(
         unacknowledged_runs=tuple(msg.unacknowledged_runs),
         last_update_ns=int(msg.last_update_ns),
         cordoned=msg.cordoned,
+        queue_usage=queue_usage,
     )
 
 

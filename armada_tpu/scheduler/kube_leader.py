@@ -16,7 +16,9 @@ hand-rolled over the kube REST API:
     client-go relies on.
 
 Satisfies the same LeaderController protocol as Standalone/FileLease
-(scheduler/leader.py); wire with `armadactl serve --leader-mode kubernetes`.
+(scheduler/leader.py); wire with
+`armadactl serve --leader-id <holder> --kube-lease-url <apiserver>`
+(in-cluster service-account credentials are picked up automatically).
 """
 
 from __future__ import annotations
@@ -101,9 +103,11 @@ class KubernetesLeaseLeaderController:
     # ------------------------------------------------------------ lease ----
 
     def _now_str(self) -> str:
+        now = self._clock()  # single read: two reads straddling a second
+        # boundary would encode a renewTime up to ~1s stale
         return time.strftime(
-            "%Y-%m-%dT%H:%M:%S", time.gmtime(self._clock())
-        ) + ".%06dZ" % int((self._clock() % 1) * 1e6)
+            "%Y-%m-%dT%H:%M:%S", time.gmtime(now)
+        ) + ".%06dZ" % int((now % 1) * 1e6)
 
     @staticmethod
     def _parse_time(s: str) -> float:
@@ -148,9 +152,9 @@ class KubernetesLeaseLeaderController:
                     leader=True,
                     generation=created["spec"].get("leaseTransitions", 1),
                 )
-            except KubeApiError as e2:
-                if e2.status == 409:  # lost the creation race
-                    return LeaderToken(leader=False, generation=0)
+            except KubeApiError:
+                # 409 = lost the creation race; anything else = follow and
+                # retry next cycle
                 return LeaderToken(leader=False, generation=0)
 
         spec = lease.get("spec", {})

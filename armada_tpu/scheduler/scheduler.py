@@ -207,8 +207,14 @@ class Scheduler:
     # --- the cycle (scheduler.go cycle:246) ---------------------------------
 
     def cycle(self, schedule: bool = True) -> CycleResult:
+        from armada_tpu.core.logging import log_context
+
         start = time.monotonic()
-        result = self._cycle(schedule)
+        self._cycle_seq = getattr(self, "_cycle_seq", 0) + 1
+        # Context fields ride every log line this cycle emits, in any
+        # component (armadacontext parity, armada_context.go).
+        with log_context(cycle=self._cycle_seq, scheduling=schedule):
+            result = self._cycle(schedule)
         duration = time.monotonic() - start
         if self.metrics is not None:
             self.metrics.observe_cycle(result, duration, now=self._clock())

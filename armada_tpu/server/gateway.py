@@ -58,10 +58,28 @@ class _Handler(BaseHTTPRequestHandler):
         )
         return Principal(name=name, groups=groups)
 
+    class _BadRequest(Exception):
+        pass
+
     def _read_proto(self, msg):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b"{}"
-        return json_format.Parse(body.decode() or "{}", msg)
+        try:
+            return json_format.Parse(body.decode() or "{}", msg)
+        except (json_format.ParseError, UnicodeDecodeError) as e:
+            # must surface as HTTP 400, not a dropped connection
+            raise _Handler._BadRequest(str(e)) from e
+
+    def handle_one_request(self):  # noqa: D102 (stdlib override)
+        try:
+            super().handle_one_request()
+        except (_Handler._BadRequest, ValueError, KeyError) as e:
+            # bad inputs (unparseable JSON body, non-integer query params)
+            # must surface as a 400, not a dropped connection
+            try:
+                self._error(400, f"bad request: {e}")
+            except OSError:
+                pass
 
     def _send(self, status: int, body: bytes, content_type="application/json"):
         self.send_response(status)

@@ -1,0 +1,63 @@
+"""Structured logging context propagation (armadacontext parity:
+internal/common/armadacontext/armada_context.go + common/logging)."""
+
+import logging
+
+from armada_tpu.core.logging import (
+    current_fields,
+    get_logger,
+    log_context,
+    spawn_with_context,
+)
+
+
+def test_fields_nest_and_restore():
+    assert current_fields() == {}
+    with log_context(cycle=1):
+        assert current_fields() == {"cycle": 1}
+        with log_context(pool="default"):
+            assert current_fields() == {"cycle": 1, "pool": "default"}
+        assert current_fields() == {"cycle": 1}
+    assert current_fields() == {}
+
+
+def test_records_are_stamped():
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    log = get_logger("armada_tpu.test_logging")
+    handler = Capture()
+    log.addHandler(handler)
+    try:
+        with log_context(cycle=7, consumer="scheduler"):
+            log.info("hello")
+        log.info("outside")
+    finally:
+        log.removeHandler(handler)
+    stamped = records[0]
+    assert stamped.armada_fields == {"cycle": 7, "consumer": "scheduler"}
+    assert "cycle=7" in stamped.armada_suffix
+    assert records[1].armada_fields == {}
+    assert records[1].armada_suffix == ""
+
+
+def test_fields_cross_threads_via_spawn():
+    seen = {}
+
+    def body():
+        seen.update(current_fields())
+
+    with log_context(executor="ex1"):
+        t = spawn_with_context(body)
+        t.start()
+        t.join()
+    assert seen == {"executor": "ex1"}
+
+
+def test_inner_fields_shadow_outer():
+    with log_context(pool="a"):
+        with log_context(pool="b"):
+            assert current_fields() == {"pool": "b"}

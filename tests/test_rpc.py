@@ -145,3 +145,71 @@ def test_principal_metadata_reaches_authorizer(wired):
     events = cp.event_api.get_jobset_events("q1", "js")
     assert events[0].sequence.user_id == "alice"
     named.close()
+
+
+def test_snapshot_queue_usage_round_trips():
+    """queue_usage must survive the executor->scheduler proto hop (the
+    reference ships ResourceUsageByQueueAndPool in NodeInfo); name-keyed so
+    axis order never matters."""
+    from armada_tpu.core.config import default_scheduling_config
+    from armada_tpu.core.types import NodeSpec
+    from armada_tpu.rpc.convert import snapshot_from_proto, snapshot_to_proto
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+    factory = default_scheduling_config().resource_list_factory()
+    cpu_i = factory.index_of("cpu")
+    atoms = [0] * factory.num_resources
+    atoms[cpu_i] = 4000
+    snap = ExecutorSnapshot(
+        id="ex1",
+        pool="default",
+        nodes=(
+            NodeSpec(
+                id="n1",
+                pool="default",
+                total_resources=factory.from_mapping({"cpu": "8", "memory": "32"}),
+            ),
+        ),
+        last_update_ns=7,
+        queue_usage={"qa": tuple(atoms)},
+    )
+    back = snapshot_from_proto(snapshot_to_proto(snap), factory)
+    assert back.queue_usage["qa"][cpu_i] == 4000
+    assert sum(back.queue_usage["qa"]) == 4000
+
+
+def test_gateway_malformed_body_is_a_400():
+    """Unparseable JSON must come back as HTTP 400, not a dropped socket."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from armada_tpu.server.gateway import RestGateway
+
+    class _StubServer:
+        pass
+
+    gw = RestGateway(_StubServer(), _StubServer(), port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/v1/job/submit",
+            method="POST",
+            data=b"not json at all",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())["code"] == 400
+        # non-integer from_idx likewise
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/v1/job-set/q/s?from_idx=abc"
+            )
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        gw.stop()
