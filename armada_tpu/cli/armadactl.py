@@ -433,7 +433,7 @@ def cmd_serve(args):
         kube_lease_namespace=args.kube_lease_namespace,
         bind_host=args.bind_host,
     )
-    print(f"armada-tpu control plane listening on 127.0.0.1:{plane.port}")
+    print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
         print(f"health on 127.0.0.1:{plane.health_server.port}/health")
     if plane.lookout_web is not None:

@@ -158,20 +158,17 @@ def start_control_plane(
 
         # In-cluster credentials: the standard service-account mount
         # (rest.InClusterConfig's sources); without them the apiserver answers
-        # 401/TLS failure and no replica would ever lead.
+        # 401/TLS failure and no replica would ever lead.  The token FILE is
+        # passed (not its contents): bound tokens rotate ~hourly and the
+        # controller re-reads per request.
         sa = "/var/run/secrets/kubernetes.io/serviceaccount"
-        sa_token = None
-        sa_ca = None
-        if os.path.exists(f"{sa}/token"):
-            with open(f"{sa}/token") as f:
-                sa_token = f.read().strip()
-            if os.path.exists(f"{sa}/ca.crt"):
-                sa_ca = f"{sa}/ca.crt"
+        sa_token_file = f"{sa}/token" if os.path.exists(f"{sa}/token") else None
+        sa_ca = f"{sa}/ca.crt" if os.path.exists(f"{sa}/ca.crt") else None
         leader = KubernetesLeaseLeaderController(
             kube_lease_url,
             leader_id,
             namespace=kube_lease_namespace,
-            token=sa_token,
+            token_file=sa_token_file,
             ca_file=sa_ca,
         )
     else:

@@ -78,7 +78,11 @@ def _ensure_configured() -> None:
         return
     _configured = True
     root = logging.getLogger("armada_tpu")
-    if not root.handlers:
+    # Self-configure ONLY when nothing else is: if the operator wired the
+    # root logger (logging.basicConfig, json shippers, pytest caplog),
+    # records must keep propagating there -- hijacking them onto our own
+    # stderr handler would bypass the operator's formatting/shipping.
+    if not root.handlers and not logging.getLogger().handlers:
         handler = logging.StreamHandler()
         handler.setFormatter(logging.Formatter(_FORMAT))
         handler.addFilter(_ContextFilter())

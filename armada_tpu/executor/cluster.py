@@ -35,6 +35,18 @@ class PodState:
     message: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class UsageSample:
+    """Per-run usage observation (ResourceUtilisation event payload)."""
+
+    run_id: str
+    job_id: str
+    queue: str
+    jobset: str
+    node_id: str
+    atoms: tuple  # by the factory's fixed resource axis
+
+
 class ClusterContext(Protocol):
     def submit_pod(
         self,
@@ -65,3 +77,8 @@ class ClusterContext(Protocol):
         scrape the reference's ClusterUtilisationService feeds into lease
         requests and the queue_resource_used metric
         (internal/executor/utilisation/cluster_utilisation.go:68,125)."""
+
+    def usage_samples(self) -> "Sequence[UsageSample]":
+        """One usage sample per RUNNING armada pod (everything the
+        ResourceUtilisation event needs, from ONE listing -- a per-run
+        follow-up GET would be an N+1 against the apiserver)."""

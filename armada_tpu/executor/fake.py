@@ -137,6 +137,24 @@ class FakeClusterContext:
                         prev[i] += int(a)
         return out
 
+    def usage_samples(self):
+        """One sample per RUNNING pod -- the payloads behind the
+        ResourceUtilisation events (armadaevents oneof entry 17)."""
+        from armada_tpu.executor.cluster import UsageSample
+
+        return [
+            UsageSample(
+                run_id=run_id,
+                job_id=pod.state.job_id,
+                queue=pod.state.queue,
+                jobset=pod.state.jobset,
+                node_id=pod.state.node_id,
+                atoms=tuple(int(a) for a in pod.requests),
+            )
+            for run_id, pod in self._pods.items()
+            if pod.state.phase is PodPhase.RUNNING
+        ]
+
     def get_pod(self, run_id: str) -> Optional[PodState]:
         pod = self._pods.get(run_id)
         return pod.state if pod else None

@@ -322,23 +322,23 @@ class KubernetesClusterContext:
                     self._pods.pop(run_id, None)
         return states
 
-    def queue_usage(self) -> dict[str, list[int]]:
-        """Per-queue atoms of non-terminal armada pods' container requests
-        (utilisation/cluster_utilisation.go:68 -- requests stand in for usage
-        where no metrics pipeline exists)."""
+    def _usage_rows(self, phases) -> list:
+        """(pod manifest, atoms) per armada pod in `phases` -- container
+        requests stand in for usage where no metrics pipeline exists
+        (utilisation/cluster_utilisation.go:68).  ONE listing serves both
+        aggregations below; per-pod follow-up GETs would be an N+1."""
         from armada_tpu.core.resources import parse_quantity
 
-        out: dict[str, list[int]] = {}
+        out = []
         R = self._factory.num_resources
         index_of = {name: i for i, name in enumerate(self._factory.names)}
         for p in self._list_pods():
             status = p.get("status", {})
-            if status.get("phase", "Pending") in ("Succeeded", "Failed"):
+            if status.get("phase", "Pending") not in phases:
                 continue
-            queue = p["metadata"].get("labels", {}).get(QUEUE_LABEL, "")
-            if not queue:
+            if not p["metadata"].get("labels", {}).get(QUEUE_LABEL, ""):
                 continue
-            row = out.setdefault(queue, [0] * R)
+            row = [0] * R
             for c in p.get("spec", {}).get("containers", ()):
                 for rname, qty in (
                     c.get("resources", {}).get("requests", {}) or {}
@@ -346,6 +346,43 @@ class KubernetesClusterContext:
                     i = index_of.get(rname)
                     if i is not None:
                         row[i] += int(parse_quantity(str(qty)))
+            out.append((p, row))
+        return out
+
+    def queue_usage(self) -> dict[str, list[int]]:
+        """Per-queue atoms of non-terminal armada pods."""
+        out: dict[str, list[int]] = {}
+        R = self._factory.num_resources
+        for p, row in self._usage_rows(("Pending", "Running", "Unknown")):
+            queue = p["metadata"]["labels"][QUEUE_LABEL]
+            agg = out.setdefault(queue, [0] * R)
+            for i, a in enumerate(row):
+                agg[i] += a
+        return out
+
+    def usage_samples(self):
+        """One sample per RUNNING pod (ResourceUtilisation payloads)."""
+        from armada_tpu.executor.cluster import UsageSample
+
+        out = []
+        for p, row in self._usage_rows(("Running",)):
+            meta = p["metadata"]
+            labels = meta.get("labels", {})
+            run_id = labels.get(RUN_LABEL, "")
+            if not run_id:
+                continue
+            out.append(
+                UsageSample(
+                    run_id=run_id,
+                    job_id=labels.get(JOB_LABEL, ""),
+                    queue=labels.get(QUEUE_LABEL, ""),
+                    jobset=meta.get("annotations", {}).get(JOBSET_ANNOTATION, ""),
+                    node_id=p.get("spec", {})
+                    .get("nodeSelector", {})
+                    .get(self.node_id_label, p.get("spec", {}).get("nodeName", "")),
+                    atoms=tuple(row),
+                )
+            )
         return out
 
     def get_pod(self, run_id: str) -> Optional[PodState]:

@@ -305,10 +305,65 @@ class ExecutorService:
             self.api.report_events(sequences)
         return acted
 
+    # Accumulators for runs that missed a sample (pod flapped to Unknown)
+    # survive this long before being dropped -- the cumulative series must
+    # not reset on a transient phase flap.
+    _USAGE_RETENTION_S = 900.0
+
+    def utilisation_cycle(self) -> int:
+        """Publish per-run usage samples (armadaevents ResourceUtilisation;
+        the reference's utilisation reporting task).  Everything comes from
+        the cluster context's single pod listing (UsageSample); cumulative
+        usage accumulates one sample per observation."""
+        samples = (
+            self.cluster.usage_samples()
+            if hasattr(self.cluster, "usage_samples")
+            else ()
+        )
+        if not hasattr(self, "_usage_cum"):
+            # run_id -> [cum atoms list, last_seen wall-clock]
+            self._usage_cum = {}
+        now = self._clock()
+        for run_id, entry in list(self._usage_cum.items()):
+            if now - entry[1] > self._USAGE_RETENTION_S:
+                self._usage_cum.pop(run_id, None)
+        now_ns = int(now * 1e9)
+        names = self._factory.names
+        sequences = []
+        for s in samples:
+            entry = self._usage_cum.setdefault(
+                s.run_id, [[0] * len(s.atoms), now]
+            )
+            cum = entry[0]
+            entry[1] = now
+            for i, a in enumerate(s.atoms):
+                cum[i] += a
+            ev = pb.Event(created_ns=now_ns)
+            ev.resource_utilisation.run_id = s.run_id
+            ev.resource_utilisation.job_id = s.job_id
+            ev.resource_utilisation.node_id = s.node_id
+            for i, a in enumerate(s.atoms):
+                if a:
+                    ev.resource_utilisation.max_resources_for_period.milli[
+                        names[i]
+                    ] = int(a)
+            for i, a in enumerate(cum):
+                if a:
+                    ev.resource_utilisation.total_cumulative_usage.milli[
+                        names[i]
+                    ] = int(a)
+            sequences.append(
+                pb.EventSequence(queue=s.queue, jobset=s.jobset, events=[ev])
+            )
+        if sequences:
+            self.api.report_events(sequences)
+        return len(sequences)
+
     def run_once(self) -> None:
         """One full agent iteration: lease, report, check, clean."""
         self.lease_cycle()
         self.report_cycle()
+        self.utilisation_cycle()
         self.check_stuck_pods()
         self.cleanup()
 

@@ -64,7 +64,8 @@ CREATE TABLE IF NOT EXISTS job_run (
   pending_ns INTEGER NOT NULL DEFAULT 0,
   started_ns INTEGER NOT NULL DEFAULT 0,
   finished_ns INTEGER NOT NULL DEFAULT 0,
-  error TEXT NOT NULL DEFAULT ''
+  error TEXT NOT NULL DEFAULT '',
+  usage_json TEXT NOT NULL DEFAULT ''
 );
 CREATE INDEX IF NOT EXISTS idx_job_run_job ON job_run(job_id);
 
@@ -84,6 +85,14 @@ class LookoutDb:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
+        # in-place migration for file DBs created before usage reporting
+        cols = {
+            r[1] for r in self._conn.execute("PRAGMA table_info(job_run)")
+        }
+        if "usage_json" not in cols:
+            self._conn.execute(
+                "ALTER TABLE job_run ADD COLUMN usage_json TEXT NOT NULL DEFAULT ''"
+            )
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
         self._lock = threading.Lock()
@@ -173,6 +182,11 @@ class LookoutDb:
                 "UPDATE job SET priority = ? WHERE queue = ? AND jobset = ? "
                 "AND state NOT IN ('SUCCEEDED','FAILED','CANCELLED','PREEMPTED')",
                 (op["priority"], op["queue"], op["jobset"]),
+            )
+        elif kind == "run_usage":
+            cur.execute(
+                "UPDATE job_run SET usage_json = ? WHERE run_id = ?",
+                (json.dumps(op["usage"]), op["run_id"]),
             )
         elif kind == "insert_run":
             cur.execute(

@@ -166,6 +166,19 @@ def lookout_converter(sequences) -> list[dict]:
                 ops.append(
                     {"kind": "run_state", "run_id": e.run_id, "state": "PREEMPTED", "ts": ts}
                 )
+            elif kind == "resource_utilisation":
+                e = ev.resource_utilisation
+                ops.append(
+                    {
+                        "kind": "run_usage",
+                        "run_id": e.run_id,
+                        "usage": {
+                            "max": dict(e.max_resources_for_period.milli),
+                            "cumulative": dict(e.total_cumulative_usage.milli),
+                            "ts": ts,
+                        },
+                    }
+                )
             elif kind == "job_run_errors":
                 e = ev.job_run_errors
                 run_over = any(

@@ -51,6 +51,7 @@ class KubernetesLeaseLeaderController:
         lease_name: str = "armada-tpu-scheduler",
         lease_duration_s: float = 15.0,
         token: Optional[str] = None,
+        token_file: Optional[str] = None,
         ca_file: Optional[str] = None,
         insecure: bool = False,
         timeout_s: float = 10.0,
@@ -67,6 +68,10 @@ class KubernetesLeaseLeaderController:
         self._name = lease_name
         self._duration = lease_duration_s
         self._token = token
+        # Bound service-account tokens expire (~1h) and the kubelet rotates
+        # the mounted file; read it per request like client-go does -- a
+        # token captured once at startup breaks election an hour in.
+        self._token_file = token_file
         self._timeout = timeout_s
         self._clock = clock
         if base_url.startswith("https"):
@@ -88,8 +93,15 @@ class KubernetesLeaseLeaderController:
         )
         if body is not None:
             req.add_header("Content-Type", "application/json")
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
+        token = self._token
+        if self._token_file:
+            try:
+                with open(self._token_file) as f:
+                    token = f.read().strip()
+            except OSError:
+                pass
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
             with urllib.request.urlopen(
                 req, timeout=self._timeout, context=self._ssl

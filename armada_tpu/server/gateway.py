@@ -70,18 +70,19 @@ class _Handler(BaseHTTPRequestHandler):
             # must surface as HTTP 400, not a dropped connection
             raise _Handler._BadRequest(str(e)) from e
 
-    def handle_one_request(self):  # noqa: D102 (stdlib override)
+    def _route(self, fn):
+        """Run one verb handler, translating bad-input errors to 400 -- but
+        only if no response has been written yet (a doubled response would
+        corrupt keep-alive clients)."""
         try:
-            super().handle_one_request()
-        except (_Handler._BadRequest, ValueError, KeyError) as e:
-            # bad inputs (unparseable JSON body, non-integer query params)
-            # must surface as a 400, not a dropped connection
-            try:
-                self._error(400, f"bad request: {e}")
-            except OSError:
-                pass
+            fn()
+        except (_Handler._BadRequest, ValueError) as e:
+            if getattr(self, "_responded", False):
+                raise
+            self._error(400, f"bad request: {e}")
 
     def _send(self, status: int, body: bytes, content_type="application/json"):
+        self._responded = True
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -112,7 +113,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ----------------------------------------------------------------- verbs
 
-    def do_POST(self):  # noqa: N802
+    def _do_post(self):
         gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
         srv = gw.submit_server
         path = urlparse(self.path).path
@@ -171,7 +172,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._error(404, f"no route {path}")
 
-    def do_PUT(self):  # noqa: N802
+    def _do_put(self):
         gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
         path = urlparse(self.path).path
         if path.startswith("/v1/queue/"):
@@ -186,7 +187,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._error(404, f"no route {path}")
 
-    def do_DELETE(self):  # noqa: N802
+    def _do_delete(self):
         gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
         path = urlparse(self.path).path
         if path.startswith("/v1/queue/"):
@@ -199,7 +200,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._error(404, f"no route {path}")
 
-    def do_GET(self):  # noqa: N802
+    def _do_get(self):
         gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
         parsed = urlparse(self.path)
         path = parsed.path
@@ -243,6 +244,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, b"\n".join(lines), "application/x-ndjson")
         else:
             self._error(404, f"no route {path}")
+
+
+    # thin verb wrappers: reset per-request state and route through the
+    # 400-translating guard
+    def do_POST(self):  # noqa: N802
+        self._responded = False
+        self._route(self._do_post)
+
+    def do_PUT(self):  # noqa: N802
+        self._responded = False
+        self._route(self._do_put)
+
+    def do_DELETE(self):  # noqa: N802
+        self._responded = False
+        self._route(self._do_delete)
+
+    def do_GET(self):  # noqa: N802
+        self._responded = False
+        self._route(self._do_get)
 
 
 class RestGateway:

@@ -29,6 +29,7 @@ def test_records_are_stamped():
             records.append(record)
 
     log = get_logger("armada_tpu.test_logging")
+    log.setLevel(logging.INFO)  # self-config is skipped when pytest owns root
     handler = Capture()
     log.addHandler(handler)
     try:
