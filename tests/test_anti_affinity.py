@@ -66,10 +66,20 @@ def test_bans_only_affect_their_job():
     assert "fine" in out.scheduled and "banned" not in out.scheduled
 
 
-def test_retry_avoids_bad_node_end_to_end(tmp_path):
-    """A job whose pod sticks on one node retries on a DIFFERENT node."""
+@pytest.fixture(params=[False, True], ids=["legacy", "incremental"])
+def _inc_cfg(request):
+    import dataclasses
+
+    return dataclasses.replace(CFG, incremental_problem_build=request.param)
+
+
+def test_retry_avoids_bad_node_end_to_end(tmp_path, _inc_cfg):
+    """A job whose pod sticks on one node retries on a DIFFERENT node --
+    in incremental mode the retry ban routes the job through the feed's
+    slow path (banned jobs join gang_jobs)."""
     cp = ControlPlane.build(
-        tmp_path, executor_specs={"ex1": (2, "8", "32")}, runtime_s=5.0
+        tmp_path, config=_inc_cfg, executor_specs={"ex1": (2, "8", "32")},
+        runtime_s=5.0,
     )
     cp.server.create_queue(QueueRecord("q"))
     ex = cp.executors[0]
@@ -117,7 +127,7 @@ def test_retry_avoids_bad_node_end_to_end(tmp_path):
     cp.close()
 
 
-def test_requeue_gate_fails_job_with_nowhere_left_to_run(tmp_path):
+def test_requeue_gate_fails_job_with_nowhere_left_to_run(tmp_path, _inc_cfg):
     """When anti-affinity bans cover every node the job could use, the requeue
     is converted into a terminal failure (scheduler.go:826-840
     addNodeAntiAffinitiesForAttemptedRunsIfSchedulable)."""
@@ -125,6 +135,7 @@ def test_requeue_gate_fails_job_with_nowhere_left_to_run(tmp_path):
 
     cp = ControlPlane.build(
         tmp_path,
+        config=_inc_cfg,
         # ex1 hosts the only node the job fits; ex2's node is too small.
         executor_specs={"ex1": (1, "8", "32"), "ex2": (1, "1", "1")},
         runtime_s=50.0,

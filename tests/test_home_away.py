@@ -24,6 +24,20 @@ CFG = SchedulingConfig(
 )
 
 
+@pytest.fixture(autouse=True, params=[False, True], ids=["legacy", "incremental"])
+def _problem_build_mode(request, monkeypatch):
+    """Every away scenario runs on both problem-build paths: the away pass
+    exercises the incremental feed's pool_restricted index + running_of
+    reconstruction (scheduler/incremental_algo.py)."""
+    import dataclasses
+
+    import tests.test_home_away as m
+
+    monkeypatch.setattr(
+        m, "CFG", dataclasses.replace(CFG, incremental_problem_build=request.param)
+    )
+
+
 def build_plane(tmp_path, cpu_nodes=1, gpu_nodes=2):
     cp = ControlPlane.build(tmp_path, config=CFG, executor_specs={})
     factory = CFG.resource_list_factory()
