@@ -471,6 +471,7 @@ def cmd_executor(args):
             interval_s=args.interval,
             default_runtime_s=args.default_runtime,
             binoculars_port=args.binoculars_port,
+            metrics_port=args.metrics_port,
             kubernetes_url=args.kubernetes,
             kubernetes_in_cluster=args.in_cluster,
             kube_token_file=args.kube_token_file,
@@ -648,6 +649,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ex.add_argument(
         "--binoculars-port", type=int, help="host a logs/cordon service on this port"
+    )
+    ex.add_argument(
+        "--metrics-port",
+        type=int,
+        help="expose executor pod metrics (counts/requests/usage by queue "
+        "and phase; pod_metrics parity) on this port",
     )
     ex.add_argument(
         "--kubernetes",

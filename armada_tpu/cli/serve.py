@@ -335,6 +335,7 @@ def run_fake_executor(
     config: Optional[SchedulingConfig] = None,
     default_runtime_s: float = 10.0,
     binoculars_port: Optional[int] = None,
+    metrics_port: Optional[int] = None,
     kubernetes_url: Optional[str] = None,
     kubernetes_in_cluster: bool = False,
     kube_token_file: Optional[str] = None,
@@ -417,6 +418,13 @@ def run_fake_executor(
             binoculars=Binoculars(cluster), address=f"127.0.0.1:{binoculars_port}"
         )
         print(f"binoculars (logs/cordon) on 127.0.0.1:{bport}")
+    metrics = None
+    _metrics_handle = None
+    if metrics_port is not None:
+        from armada_tpu.executor.metrics import start_executor_metrics
+
+        metrics, _metrics_handle = start_executor_metrics(metrics_port)
+        print(f"executor metrics on :{metrics_port}/metrics")
     stop = stop or threading.Event()
     last = time.monotonic()
     tick = getattr(cluster, "tick", None)  # fake-cluster virtual time only
@@ -439,8 +447,23 @@ def run_fake_executor(
                 print(f"executor {executor_id}: cycle failed ({exc}); retrying in {backoff:.1f}s")
                 stop.wait(backoff)
                 continue
+            if metrics is not None:
+                # observability must never throttle reconciliation: a
+                # metrics bug outside this try would read as a cluster
+                # failure and pin the loop in backoff
+                try:
+                    metrics.observe(agent)
+                except Exception:  # noqa: BLE001
+                    pass
             stop.wait(interval_s)
     finally:
         if binoculars_server is not None:
             binoculars_server.stop(1)
+        if metrics is not None and _metrics_handle is not None:
+            try:
+                server, thread = _metrics_handle
+                server.shutdown()
+                thread.join(timeout=5)
+            except (TypeError, ValueError):
+                pass
         api.close()

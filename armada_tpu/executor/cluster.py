@@ -37,7 +37,8 @@ class PodState:
 
 @dataclasses.dataclass(frozen=True)
 class UsageSample:
-    """Per-run usage observation (ResourceUtilisation event payload)."""
+    """Per-run usage observation (ResourceUtilisation event payload and the
+    executor pod-metrics source)."""
 
     run_id: str
     job_id: str
@@ -45,6 +46,7 @@ class UsageSample:
     jobset: str
     node_id: str
     atoms: tuple  # by the factory's fixed resource axis
+    phase: str = "RUNNING"  # PodPhase name
 
 
 class ClusterContext(Protocol):
@@ -79,6 +81,7 @@ class ClusterContext(Protocol):
         (internal/executor/utilisation/cluster_utilisation.go:68,125)."""
 
     def usage_samples(self) -> "Sequence[UsageSample]":
-        """One usage sample per RUNNING armada pod (everything the
-        ResourceUtilisation event needs, from ONE listing -- a per-run
-        follow-up GET would be an N+1 against the apiserver)."""
+        """One sample per PENDING/RUNNING armada pod (everything the
+        ResourceUtilisation event and the executor pod metrics need, from
+        ONE listing -- a per-run follow-up GET would be an N+1 against the
+        apiserver).  Utilisation events publish only the RUNNING ones."""

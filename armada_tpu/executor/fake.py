@@ -138,8 +138,9 @@ class FakeClusterContext:
         return out
 
     def usage_samples(self):
-        """One sample per RUNNING pod -- the payloads behind the
-        ResourceUtilisation events (armadaevents oneof entry 17)."""
+        """One sample per PENDING/RUNNING pod -- the payloads behind the
+        ResourceUtilisation events (armadaevents oneof entry 17) and the
+        executor pod metrics."""
         from armada_tpu.executor.cluster import UsageSample
 
         return [
@@ -150,9 +151,10 @@ class FakeClusterContext:
                 jobset=pod.state.jobset,
                 node_id=pod.state.node_id,
                 atoms=tuple(int(a) for a in pod.requests),
+                phase=pod.state.phase.name,
             )
             for run_id, pod in self._pods.items()
-            if pod.state.phase is PodPhase.RUNNING
+            if pod.state.phase in (PodPhase.PENDING, PodPhase.RUNNING)
         ]
 
     def get_pod(self, run_id: str) -> Optional[PodState]:

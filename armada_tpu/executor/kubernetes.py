@@ -361,11 +361,12 @@ class KubernetesClusterContext:
         return out
 
     def usage_samples(self):
-        """One sample per RUNNING pod (ResourceUtilisation payloads)."""
+        """One sample per PENDING/RUNNING pod (ResourceUtilisation payloads
+        + executor pod metrics)."""
         from armada_tpu.executor.cluster import UsageSample
 
         out = []
-        for p, row in self._usage_rows(("Running",)):
+        for p, row in self._usage_rows(("Pending", "Running")):
             meta = p["metadata"]
             labels = meta.get("labels", {})
             run_id = labels.get(RUN_LABEL, "")
@@ -381,6 +382,10 @@ class KubernetesClusterContext:
                     .get("nodeSelector", {})
                     .get(self.node_id_label, p.get("spec", {}).get("nodeName", "")),
                     atoms=tuple(row),
+                    phase=_PHASES.get(
+                        p.get("status", {}).get("phase", "Pending"),
+                        PodPhase.PENDING,
+                    ).name,
                 )
             )
         return out

@@ -1,0 +1,110 @@
+"""Executor-side Prometheus metrics (reference
+internal/executor/metrics/pod_metrics/cluster_context.go): pod counts,
+requests and usage by (queue, phase), refreshed from the cluster context on
+every agent iteration.  Exposed by `armadactl executor --metrics-port`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import CollectorRegistry, Gauge, start_http_server
+
+
+class ExecutorMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.pod_count = Gauge(
+            "armada_executor_pod_count",
+            "Pods in different phases by queue",
+            ["queue", "phase"],
+            registry=self.registry,
+        )
+        self.pod_requests = Gauge(
+            "armada_executor_pod_resource_request",
+            "Pod resource requests (atoms) in different phases by queue",
+            ["queue", "phase", "resource"],
+            registry=self.registry,
+        )
+        self.pod_usage = Gauge(
+            "armada_executor_pod_resource_usage",
+            "Pod resource usage (atoms) by queue for running pods",
+            ["queue", "resource"],
+            registry=self.registry,
+        )
+        self.capacity = Gauge(
+            "armada_executor_node_capacity",
+            "Total allocatable capacity (atoms) of the cluster's nodes",
+            ["resource"],
+            registry=self.registry,
+        )
+        self._seen: set = set()
+
+    def observe(self, service) -> None:
+        """Refresh the gauges from an ExecutorService's cluster context.
+        Label sets absent this round are removed (no phantom series)."""
+        cluster = service.cluster
+        factory = service._factory
+        names = factory.names
+
+        counts: dict = {}
+        for pod in cluster.pod_states():
+            key = (pod.queue, pod.phase.name)
+            counts[key] = counts.get(key, 0) + 1
+        seen = set()
+        for (queue, phase), n in counts.items():
+            self.pod_count.labels(queue, phase).set(n)
+            seen.add(("count", queue, phase, ""))
+        # requests by (queue, phase) + usage by queue, from ONE listing
+        requests: dict = {}
+        usage: dict = {}
+        samples = (
+            cluster.usage_samples() if hasattr(cluster, "usage_samples") else ()
+        )
+        for s in samples:
+            req = requests.setdefault((s.queue, s.phase), [0] * len(names))
+            for i, a in enumerate(s.atoms):
+                req[i] += a
+            if s.phase == "RUNNING":
+                use = usage.setdefault(s.queue, [0] * len(names))
+                for i, a in enumerate(s.atoms):
+                    use[i] += a
+        for (queue, phase), atoms in requests.items():
+            for i, a in enumerate(atoms):
+                if a:
+                    self.pod_requests.labels(queue, phase, names[i]).set(float(a))
+                    seen.add(("request", queue, phase, names[i]))
+        for queue, atoms in usage.items():
+            for i, a in enumerate(atoms):
+                if a:
+                    self.pod_usage.labels(queue, names[i]).set(float(a))
+                    seen.add(("usage", queue, "", names[i]))
+        totals = [0] * len(names)
+        for node in cluster.node_specs():
+            if node.total_resources is not None:
+                for i, a in enumerate(node.total_resources.atoms):
+                    totals[i] += int(a)
+        for i, a in enumerate(totals):
+            if a:
+                self.capacity.labels(names[i]).set(float(a))
+                seen.add(("capacity", "", "", names[i]))
+        for kind, queue, phase, resource in self._seen - seen:
+            try:
+                if kind == "count":
+                    self.pod_count.remove(queue, phase)
+                elif kind == "request":
+                    self.pod_requests.remove(queue, phase, resource)
+                elif kind == "usage":
+                    self.pod_usage.remove(queue, resource)
+                elif kind == "capacity":
+                    self.capacity.remove(resource)
+            except KeyError:
+                pass
+        self._seen = seen
+
+
+def start_executor_metrics(port: int) -> tuple:
+    """(metrics, server_handle): serve the registry on `port`."""
+    metrics = ExecutorMetrics()
+    handle = start_http_server(port, registry=metrics.registry)
+    return metrics, handle

@@ -331,6 +331,8 @@ class ExecutorService:
         names = self._factory.names
         sequences = []
         for s in samples:
+            if s.phase != "RUNNING":
+                continue
             entry = self._usage_cum.setdefault(
                 s.run_id, [[0] * len(s.atoms), now]
             )

@@ -381,3 +381,50 @@ def test_submission_rejection_reports_terminal_error(stack):
         if ev.WhichOneof("event") == "job_run_errors"
     ]
     assert errs and errs[0].errors[0].reason == "podSubmissionRejected"
+
+
+def test_executor_pod_metrics(stack):
+    """Executor-side pod metrics (pod_metrics/cluster_context.go parity):
+    counts by (queue, phase), usage by queue, cluster capacity -- with stale
+    label sets removed when pods finish."""
+    from armada_tpu.executor.metrics import ExecutorMetrics
+
+    metrics = ExecutorMetrics()
+    stack.submit("m1")
+    stack.submit("m2")
+    stack.executor.run_once()
+    stack.step()
+    stack.executor.run_once()
+    metrics.observe(stack.executor)
+
+    def count_samples():
+        return [
+            s
+            for m in metrics.registry.collect()
+            if m.name == "armada_executor_pod_count"
+            for s in m.samples
+        ]
+
+    assert sum(s.value for s in count_samples()) == 2
+    assert all(s.labels["queue"] == "q1" for s in count_samples())
+    cap = metrics.registry.get_sample_value(
+        "armada_executor_node_capacity", {"resource": "cpu"}
+    )
+    assert cap and cap > 0
+    req = [
+        s
+        for m in metrics.registry.collect()
+        if m.name == "armada_executor_pod_resource_request"
+        for s in m.samples
+        if s.labels["resource"] == "cpu"
+    ]
+    assert req and sum(s.value for s in req) > 0
+
+    # drain: pods finish, get reported + cleaned; stale series disappear
+    for _ in range(8):
+        stack.clock.advance(10.0)
+        stack.cluster.tick(10.0)
+        stack.executor.run_once()
+        stack.step()
+    metrics.observe(stack.executor)
+    assert count_samples() == []
