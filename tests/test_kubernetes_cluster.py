@@ -205,14 +205,14 @@ jobs:
         assert ctl("submit", str(sub)) == 0
         capsys.readouterr()
 
-        deadline = time.time() + 20
+        deadline = time.time() + 60
         while time.time() < deadline and not kube.pods:
             time.sleep(0.1)
         assert kube.pods, "agent never created the pod"
         ((ns, name),) = kube.pods
         kube.set_phase(ns, name, "Succeeded")
 
-        deadline = time.time() + 20
+        deadline = time.time() + 60
         succeeded = 0
         while time.time() < deadline and not succeeded:
             ctl("watch", "--queue", "q", "--job-set", "k8s", "--timeout", "0.5")
