@@ -230,9 +230,12 @@ class LazyJobIds:
 
     def __iter__(self):
         if self._raw is not None:
+            # decode in chunks: a bounded consumer (itertools.islice) must
+            # not pay a whole-array unicode conversion up front
             width = self._raw.dtype.itemsize
-            for s in self._raw.astype(f"U{width}"):
-                yield str(s)
+            for start in range(0, self._raw.size, 4096):
+                for s in self._raw[start : start + 4096].astype(f"U{width}"):
+                    yield str(s)
         yield from self._extra
 
     def __bool__(self):

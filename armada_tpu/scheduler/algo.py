@@ -600,6 +600,12 @@ class FairSchedulingAlgo:
             )
 
         market_pools = {p.name for p in self.config.pools if p.market_driven}
+        # The optimiser places at most max_stuck_jobs_per_cycle; collecting a
+        # generous multiple of that preserves its own candidate ordering
+        # while keeping the scan O(candidates), not O(failed backlog) -- a
+        # round can retire whole key classes (~the entire backlog in
+        # outcome.failed, decoded lazily in chunks).
+        candidate_cap = max(100, 10 * self.optimiser.opt.max_stuck_jobs_per_cycle)
         for stats in result.pools:
             pool = stats.pool
             stuck = []
@@ -607,6 +613,8 @@ class FairSchedulingAlgo:
                 spec = resolve_queued(jid)
                 if spec is not None:
                     stuck.append(spec)
+                    if len(stuck) >= candidate_cap:
+                        break
             if not stuck:
                 continue
             pool_nodes = [n for n in nodes if n.pool == pool]
