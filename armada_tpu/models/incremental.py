@@ -282,6 +282,12 @@ class IncrementalBuilder:
         self.node_total = np.zeros((0, self.R), np.float32)
         self.node_type = np.zeros((0,), np.int32)
         self.node_ok = np.zeros((0,), bool)
+        # present != ok: cordoned/unschedulable nodes (ok=False, present=True)
+        # still count in pool totals, exactly as build_problem counts every
+        # snapshot node; REMOVED nodes (present=False) must vanish from
+        # totals/scale/caps and drop their runs, matching the legacy builder
+        # which only ever sees snapshot nodes (problem.py run_list filter).
+        self.node_present = np.zeros((0,), bool)
         self._retype_needed = False
         # Node-derived tensors are identical between cycles unless the fleet
         # changed; cache them keyed on an epoch so assemble() can hand back
@@ -354,19 +360,22 @@ class IncrementalBuilder:
                         else 0
                     )
                     self.node_type[i] = self.ntidx.type_of(n)
-                if self.node_ok[i] != (not n.unschedulable):
+                if self.node_ok[i] != (not n.unschedulable) or not self.node_present[i]:
                     changed = True
                 self.node_ok[i] = not n.unschedulable
+                self.node_present[i] = True
         for i, nid in enumerate(self.node_ids):
             if nid not in seen:
-                if self.node_ok[i]:
+                if self.node_ok[i] or self.node_present[i]:
                     changed = True
                 self.node_ok[i] = False
+                self.node_present[i] = False
         if new_rows:
             base = len(self.node_ids)
             total = _grow(self.node_total, base + len(new_rows))
             ntype = _grow(self.node_type, base + len(new_rows))
             ok = _grow(self.node_ok, base + len(new_rows))
+            present = _grow(self.node_present, base + len(new_rows))
             for j, n in enumerate(new_rows):
                 i = base + j
                 self.node_index[n.id] = i
@@ -376,7 +385,9 @@ class IncrementalBuilder:
                     total[i] = self.factory.floor_units(n.total_resources.atoms)
                 ntype[i] = self.ntidx.type_of(n)
                 ok[i] = not n.unschedulable
-            self.node_total, self.node_type, self.node_ok = total, ntype, ok
+                present[i] = True
+            self.node_total, self.node_type = total, ntype
+            self.node_ok, self.node_present = ok, present
             changed = True
         if changed:
             self._node_epoch += 1
@@ -541,8 +552,13 @@ class IncrementalBuilder:
         array objects and skip the device re-upload."""
         cfg = self.config
         R = self.R
+        # Removed nodes are tombstones (stable indices for the run table) but
+        # must not contribute capacity anywhere: zero their rows and exclude
+        # them from totals/scale/caps, matching build_problem which never
+        # sees them at all.
+        live_total = self.node_total * self.node_present[:, None]
         node_total = np.zeros((N, R), np.float32)
-        node_total[:Nreal] = self.node_total
+        node_total[:Nreal] = live_total
         node_type = np.zeros((N,), np.int32)
         node_type[:Nreal] = self.node_type
         node_ok = np.zeros((N,), bool)
@@ -558,15 +574,13 @@ class IncrementalBuilder:
             float_total = (
                 self.factory.floor_units(fl.atoms).astype(np.float64) * (1 - node_axes)
             ).astype(np.float32)
-        total_pool64 = self.node_total[:Nreal].sum(axis=0, dtype=np.float64)
+        total_pool64 = live_total.sum(axis=0, dtype=np.float64)
         total_pool64 = total_pool64 + float_total.astype(np.float64)
         total_pool = total_pool64.astype(np.float32)
         drf_mult = self.factory.multipliers_for(cfg.drf_multipliers()).astype(
             np.float32
         )
-        scale = (
-            self.node_total[:Nreal].max(axis=0) if Nreal else np.zeros(R, np.float32)
-        )
+        scale = live_total.max(axis=0) if Nreal else np.zeros(R, np.float32)
         inv_scale = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-9), 0.0).astype(
             np.float32
         )
@@ -725,6 +739,12 @@ class IncrementalBuilder:
             # (the reference skips unknown-queue jobs entirely,
             # pqs.go:129-131).
             run_rows = run_rows[self.queue_known[rt.qi[run_rows]]]
+        if Nreal and not self.node_present.all():
+            # Runs on REMOVED nodes drop out of the problem entirely, like
+            # build_problem's `r.node_id in node_index` filter: they neither
+            # count toward queue usage nor get evictee slots (heartbeat
+            # expiry fails them through the scheduler, not the builder).
+            run_rows = run_rows[self.node_present[rt.node[run_rows]]]
         nr = run_rows.shape[0]
         rq = rt.qi[run_rows].astype(np.int64)
         ev_mask = rt.preempt[run_rows]
@@ -1060,9 +1080,13 @@ class IncrementalBuilder:
         )
 
         cfg = self.config
+        # node_specs retains tombstones for removed nodes; mask their totals
+        # to zero and their ok bit off so uniformity-domain picks and the
+        # joint hopeless-capacity check see only live nodes (build_problem
+        # constructs its fit context from the snapshot alone).
         fitctx = _GangFitContext(
             self.node_specs,
-            self.node_total,
+            self.node_total * self.node_present[:, None],
             self.node_index,
             self.factory,
             np.array(
@@ -1073,11 +1097,12 @@ class IncrementalBuilder:
                 np.float64,
             ),
         )
+        fitctx.ok &= self.node_present
         run_rows = self.runs.live_rows()
         fitctx.set_running_usage(
             self.runs.req[run_rows],
             self.runs.node[run_rows],
-            np.ones(run_rows.shape[0], bool),
+            self.node_present[self.runs.node[run_rows]],
         )
 
         by_gang: dict[tuple, list[JobSpec]] = {}
@@ -1170,7 +1195,12 @@ class IncrementalBuilder:
                 for sib_id in self._running_gang_members.get((qi, gang_id), ()):
                     row = self.runs._locate(sib_id.encode())
                     if row is not None:
-                        v = self.node_specs[int(self.runs.node[row])].labels.get(label)
+                        ni = int(self.runs.node[row])
+                        # a sibling stranded on a REMOVED node pins nothing:
+                        # build_problem drops that run before pinned_values
+                        if not self.node_present[ni]:
+                            continue
+                        v = self.node_specs[ni].labels.get(label)
                         if v is not None:
                             pinned_values.add(v)
                 if len(pinned_values) == 1:

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from typing import Optional
+
 from armada_tpu.core.resources import ResourceListFactory
 from armada_tpu.core.types import NodeSpec, Taint, Toleration
 from armada_tpu.events import events_pb2 as epb
@@ -120,7 +122,14 @@ def node_from_proto(msg: pb.Node, factory: ResourceListFactory) -> NodeSpec:
     )
 
 
-def snapshot_to_proto(snap: ExecutorSnapshot) -> pb.ExecutorSnapshot:
+def snapshot_to_proto(
+    snap: ExecutorSnapshot, factory: Optional[ResourceListFactory] = None
+) -> pb.ExecutorSnapshot:
+    """`factory` should be the executor's own ResourceListFactory: the
+    queue_usage atom tuples were built against ITS axis order.  Inferring
+    the names from node payloads (the fallback) mislabels usage keys when a
+    custom resource axis is configured and the snapshot has no nodes with
+    totals (round-3 advisor finding)."""
     msg = pb.ExecutorSnapshot(
         id=snap.id,
         pool=snap.pool,
@@ -131,7 +140,7 @@ def snapshot_to_proto(snap: ExecutorSnapshot) -> pb.ExecutorSnapshot:
         cordoned=snap.cordoned,
     )
     # name-keyed so the axis order never has to match across versions
-    names = _factory_names(snap)
+    names = factory.names if factory is not None else _factory_names(snap)
     for queue, atoms in snap.queue_usage.items():
         entry = msg.queue_usage[queue]
         for i, amount in enumerate(atoms):
@@ -173,9 +182,11 @@ def snapshot_from_proto(
     )
 
 
-def lease_request_to_proto(req: LeaseRequest) -> pb.LeaseJobRunsRequest:
+def lease_request_to_proto(
+    req: LeaseRequest, factory: Optional[ResourceListFactory] = None
+) -> pb.LeaseJobRunsRequest:
     return pb.LeaseJobRunsRequest(
-        snapshot=snapshot_to_proto(req.snapshot),
+        snapshot=snapshot_to_proto(req.snapshot, factory),
         active_run_ids=list(req.active_run_ids),
     )
 

@@ -80,8 +80,10 @@ class IncrementalProblemFeed:
                 self.builders[p.name] = IncrementalBuilder(self.config, p.name)
                 self.devcaches[p.name] = DeviceProblemCache()
         if self._jobdb is not None:
+            pending = {}
             for job in self._jobdb.read_txn().all_jobs():
-                self.apply_job(job)
+                self.apply_job(job, pending)
+            self._flush(pending)
 
     def builder_for(self, pool: str, txn=None) -> Optional[IncrementalBuilder]:
         if pool in self._market_pools:
@@ -94,8 +96,10 @@ class IncrementalProblemFeed:
             if txn is not None:
                 # Late pool discovery (a node snapshot introduced a pool not
                 # in config): one-time backfill scan.
+                pending = {}
                 for job in txn.all_jobs():
-                    self.apply_job(job)
+                    self.apply_job(job, pending)
+                self._flush(pending)
         return b
 
     def devcache_for(self, pool: str) -> DeviceProblemCache:
@@ -104,10 +108,44 @@ class IncrementalProblemFeed:
     # ------------------------------------------------------------ deltas ----
 
     def on_delta(self, upserts: dict, deletes: set) -> None:
+        # Per-job submit()/lease() is one np.insert PER COLUMN PER JOB --
+        # O(table) each, so a K-job commit against a 1M-row table would cost
+        # O(K x table x pools).  Accumulate the batch and flush once per
+        # builder (one np.insert per column total), the same shape bench.py's
+        # backlog load uses.
         for job_id in deletes:
             self._remove_everywhere(job_id)
+        pending: dict = {}
         for job in upserts.values():
-            self.apply_job(job)
+            self.apply_job(job, pending)
+        self._flush(pending)
+
+    def _pending_for(self, pending: dict, pool: str) -> tuple[dict, dict, dict]:
+        entry = pending.get(pool)
+        if entry is None:
+            # submits/bans/leases all keyed by job id: a re-applied job within
+            # one batch must not become two live rows (submit_many/lease_many
+            # only de-dupe against the TABLE, not within their own batch).
+            entry = pending[pool] = ({}, {}, {})
+        return entry
+
+    @staticmethod
+    def _purge_pending(pending: dict, job_id: str, leases_too: bool) -> None:
+        for submits, ban_map, leases in pending.values():
+            submits.pop(job_id, None)
+            ban_map.pop(job_id, None)
+            if leases_too:
+                leases.pop(job_id, None)
+
+    def _flush(self, pending: dict) -> None:
+        for pool, (submits, bans, leases) in pending.items():
+            b = self.builders.get(pool)
+            if b is None:
+                continue
+            if submits:
+                b.submit_many(list(submits.values()), bans or None)
+            if leases:
+                b.lease_many(list(leases.values()))
 
     def _remove_everywhere(self, job_id: str) -> None:
         self.pool_restricted.discard(job_id)
@@ -124,9 +162,17 @@ class IncrementalProblemFeed:
             if b is not None:
                 b.forget_running_gang(queue, gang_id, job_id)
 
-    def apply_job(self, job: Job) -> None:
+    def apply_job(self, job: Job, pending: Optional[dict] = None) -> None:
+        """Translate one job's state into builder deltas.  Removes/unleases
+        apply immediately (tombstones, cheap); submits/leases go into
+        `pending` (flushed by the caller as one batch per builder) or flush
+        inline when called one-shot."""
+        flush_here = pending is None
+        if pending is None:
+            pending = {}
         if job.in_terminal_state():
             self._remove_everywhere(job.id)
+            self._purge_pending(pending, job.id, leases_too=True)
             return
         if job.queued:
             if not job.validated:
@@ -141,15 +187,22 @@ class IncrementalProblemFeed:
                 self.pool_restricted.add(job.id)
             else:
                 self.pool_restricted.discard(job.id)
-            for b in self.builders.values():
+            self._purge_pending(pending, job.id, leases_too=True)
+            for name, b in self.builders.items():
                 b.unlease(job.id)
-                b.submit(spec, bans)
+                submits, ban_map, _ = self._pending_for(pending, name)
+                submits[spec.id] = spec
+                if bans:
+                    ban_map[spec.id] = tuple(bans)
+            if flush_here:
+                self._flush(pending)
             return
         # leased / running
         self.pool_restricted.discard(job.id)
         run = job.latest_run
         for b in self.builders.values():
             b.remove(job.id)
+        self._purge_pending(pending, job.id, leases_too=True)
         if run is None or run.in_terminal_state():
             for b in self.builders.values():
                 b.unlease(job.id)
@@ -171,10 +224,12 @@ class IncrementalProblemFeed:
             priority=run.scheduled_at_priority or 0,
             away=run.pool_scheduled_away,
         )
-        b.lease(r)
+        self._pending_for(pending, pool)[2][job.id] = r
         if job.spec.gang_id:
             b.note_running_gang(job.queue, job.spec.gang_id, job.id)
             self._gang_of[job.id] = (pool, job.queue, job.spec.gang_id)
+        if flush_here:
+            self._flush(pending)
 
     # ------------------------------------------------------------ queries ---
 

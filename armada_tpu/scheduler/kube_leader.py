@@ -82,6 +82,12 @@ class KubernetesLeaseLeaderController:
             self._ssl = ctx
         else:
             self._ssl = None
+        # Expiry is judged by how long since WE first observed the holder's
+        # current (holder, renewTime, transitions) record -- client-go's
+        # observedTime -- never by comparing the remote renewTime timestamp
+        # against the local clock, which flaps leadership under clock skew.
+        self._observed: Optional[tuple] = None
+        self._observed_at: float = 0.0
 
     # ------------------------------------------------------------- http ----
 
@@ -121,17 +127,18 @@ class KubernetesLeaseLeaderController:
             "%Y-%m-%dT%H:%M:%S", time.gmtime(now)
         ) + ".%06dZ" % int((now % 1) * 1e6)
 
-    @staticmethod
-    def _parse_time(s: str) -> float:
-        import calendar
-
-        s = s.rstrip("Z")
-        if "." in s:
-            base, frac = s.split(".", 1)
-        else:
-            base, frac = s, "0"
-        t = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
-        return t + float("0." + frac)
+    def _observe(
+        self, holder: str, renew: str, transitions: int, duration: float
+    ) -> bool:
+        """True when the holder's record has gone unrenewed for a full lease
+        duration ON OUR CLOCK since we first saw it (client-go measures time
+        since observedRecord last changed, not renewTime vs local now)."""
+        record = (holder, renew, transitions)
+        if record != self._observed:
+            self._observed = record
+            self._observed_at = self._clock()
+            return False
+        return self._clock() >= self._observed_at + duration
 
     def _spec(self, transitions: int) -> dict:
         return {
@@ -174,9 +181,7 @@ class KubernetesLeaseLeaderController:
         transitions = int(spec.get("leaseTransitions", 0))
         renew = spec.get("renewTime")
         duration = float(spec.get("leaseDurationSeconds", self._duration))
-        expired = (
-            renew is None or self._clock() >= self._parse_time(renew) + duration
-        )
+        expired = renew is None or self._observe(holder, renew, transitions, duration)
         if holder == self._holder or expired:
             new_transitions = transitions if holder == self._holder else transitions + 1
             lease["spec"] = self._spec(new_transitions)
@@ -200,11 +205,13 @@ class KubernetesLeaseLeaderController:
         except KubeApiError:
             return False
         spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        transitions = int(spec.get("leaseTransitions", 0))
         renew = spec.get("renewTime")
         duration = float(spec.get("leaseDurationSeconds", self._duration))
         return (
-            spec.get("holderIdentity") == self._holder
-            and int(spec.get("leaseTransitions", 0)) == token.generation
+            holder == self._holder
+            and transitions == token.generation
             and renew is not None
-            and self._clock() < self._parse_time(renew) + duration
+            and not self._observe(holder, renew, transitions, duration)
         )

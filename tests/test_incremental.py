@@ -274,6 +274,69 @@ def test_node_churn_and_unschedulable():
     _outcomes_equal(fresh, incr)
 
 
+def test_removed_nodes_leave_totals_and_runs():
+    """Scale-down regression (round-3 advisor, high): a removed node must
+    vanish from pool totals / round caps / fair-share scale, and its runs
+    must drop out of the problem WITHOUT an explicit unlease -- exactly what
+    build_problem does by never seeing the node (problem.py run_list
+    filter)."""
+    nodes, queues, jobs, running = _random_world(11, num_jobs=40, gangs=2)
+    b = _incremental(nodes, queues, jobs, running)
+    b.assemble()  # populate the node-tensor cache at full fleet size
+    dropped = nodes[-1]
+    nodes2 = nodes[:-1]
+    b.set_nodes(nodes2)
+    running2 = [r for r in running if r.node_id != dropped.id]
+    fresh_p, fresh_ctx = _fresh(nodes2, queues, jobs, running2)
+    incr_p, incr_ctx = b.assemble()
+    np.testing.assert_allclose(
+        np.asarray(incr_p.total_pool), np.asarray(fresh_p.total_pool)
+    )
+    np.testing.assert_allclose(
+        np.asarray(incr_p.round_cap), np.asarray(fresh_p.round_cap)
+    )
+    _outcomes_equal(_round(fresh_p, fresh_ctx), _round(incr_p, incr_ctx))
+    # the node comes back: totals recover and its still-leased runs (never
+    # unleased -- the tombstone retained their rows) count again
+    b.set_nodes(nodes)
+    running3 = running2 + [r for r in running if r.node_id == dropped.id]
+    fresh_p3, fresh_ctx3 = _fresh(nodes, queues, jobs, running3)
+    incr_p3, incr_ctx3 = b.assemble()
+    np.testing.assert_allclose(
+        np.asarray(incr_p3.total_pool), np.asarray(fresh_p3.total_pool)
+    )
+    _outcomes_equal(_round(fresh_p3, fresh_ctx3), _round(incr_p3, incr_ctx3))
+
+
+def test_removed_node_does_not_pin_uniformity_domain():
+    """A gang sibling stranded on a REMOVED node must not pin the uniformity
+    domain: build_problem drops that run before computing pinned_values, so
+    the re-queued members are free to land in any (live) domain."""
+    nodes = [_node(f"n{i}", rack=("a" if i < 2 else "b")) for i in range(4)]
+    queues = [Queue("qa", 1.0)]
+    sib = _job(
+        "sib", "qa", 4, sub=0.0, gang_id="g1", gang_cardinality=2,
+        gang_node_uniformity_label="rack",
+    )
+    mate = _job(
+        "mate", "qa", 4, sub=0.1, gang_id="g1", gang_cardinality=2,
+        gang_node_uniformity_label="rack",
+    )
+    b = IncrementalBuilder(CFG, "default", queues)
+    b.set_nodes(nodes)
+    b.lease(RunningJob(job=sib, node_id="n0"))  # rack a
+    b.note_running_gang("qa", "g1", "sib")
+    b.submit(mate)
+    # rack-a nodes vanish: only rack b remains
+    b.set_nodes(nodes[2:])
+    fresh_p, fresh_ctx = _fresh(nodes[2:], queues, [mate], [])
+    incr_p, incr_ctx = b.assemble()
+    fresh = _round(fresh_p, fresh_ctx)
+    incr = _round(incr_p, incr_ctx)
+    _outcomes_equal(fresh, incr)
+    assert "mate" in incr.scheduled  # not banned off every live node
+
+
 def test_sorted_table_invariant():
     """Random inserts/removes keep the (qi, npc, prio, sub, id) order."""
     from armada_tpu.models.incremental import _SortedTable

@@ -57,6 +57,10 @@ def test_failover_bumps_generation_and_fences_old_leader(kube):
     b = ctrl(kube, "replica-b", clock)
     ta = a.get_token()
     assert ta.leader
+    # b observes a's record; expiry is measured from this LOCAL observation
+    # (client-go observedTime), so a skewed remote renewTime alone can never
+    # trigger takeover
+    assert not b.get_token().leader
 
     # a goes silent past the lease duration; b takes over
     clock.advance(20)
@@ -76,21 +80,45 @@ def test_takeover_race_has_one_winner(kube):
     stays follower) -- the client-go optimistic-concurrency fence."""
     clock = Clock()
     a = ctrl(kube, "replica-a", clock)
+    b = ctrl(kube, "replica-b", clock)
+    c = ctrl(kube, "replica-c", clock)
     ta = a.get_token()
     assert ta.leader
+    # both challengers observe a's record, then a goes silent past duration
+    assert not b.get_token().leader and not c.get_token().leader
     clock.advance(20)
 
     # simulate the race: both see the stale lease, then both try to update.
-    # The fake apiserver serializes; drive it via two fresh controllers whose
-    # first get_token runs back-to-back -- the second one's PUT (or create)
-    # must lose on resourceVersion/409 and report follower.
-    b = ctrl(kube, "replica-b", clock)
-    c = ctrl(kube, "replica-c", clock)
+    # The fake apiserver serializes; the second one's PUT must lose on
+    # resourceVersion/409 and report follower.
     tb = b.get_token()
     tc = c.get_token()
     assert tb.leader ^ tc.leader  # exactly one winner
     winner_gen = (tb if tb.leader else tc).generation
     assert winner_gen == 2
+
+
+def test_clock_skew_does_not_flap_leadership(kube):
+    """A leader whose clock runs 1000s behind writes renewTime stamps that
+    look long-expired to a skewed follower; takeover must still only happen
+    after the record goes UNCHANGED for a full duration on the follower's
+    own clock (round-3 advisor finding)."""
+    slow, fast = Clock(1_000_000.0), Clock(1_001_000.0)
+    a = ctrl(kube, "replica-a", slow)
+    b = ctrl(kube, "replica-b", fast)
+    assert a.get_token().leader
+    # b sees renewTime 1000s in the past -- renewTime vs local clock would
+    # take over immediately; observed-time must not
+    assert not b.get_token().leader
+    # a keeps renewing: b keeps following indefinitely
+    for _ in range(4):
+        slow.advance(5)
+        fast.advance(5)
+        assert a.get_token().leader
+        assert not b.get_token().leader
+    # a actually dies: b takes over one duration after its last observation
+    fast.advance(20)
+    assert b.get_token().leader
 
 
 def test_apiserver_outage_fails_safe_as_follower(kube):

@@ -178,6 +178,34 @@ def test_snapshot_queue_usage_round_trips():
     assert sum(back.queue_usage["qa"]) == 4000
 
 
+def test_snapshot_queue_usage_custom_axis_without_nodes():
+    """With a custom resource axis and an empty node list, the explicit
+    factory must label queue_usage keys -- the node-payload inference would
+    fall back to the default config's axis order and silently drop the
+    custom resource (round-3 advisor finding)."""
+    import dataclasses
+
+    from armada_tpu.core.config import default_scheduling_config
+    from armada_tpu.rpc.convert import snapshot_from_proto, snapshot_to_proto
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+    cfg = dataclasses.replace(
+        default_scheduling_config(),
+        supported_resource_types=(("tpu-chips", "1"),)
+        + default_scheduling_config().supported_resource_types,
+    )
+    factory = cfg.resource_list_factory()
+    chips_i = factory.index_of("tpu-chips")
+    atoms = [0] * factory.num_resources
+    atoms[chips_i] = 8
+    snap = ExecutorSnapshot(
+        id="ex1", pool="default", nodes=(), last_update_ns=7,
+        queue_usage={"qa": tuple(atoms)},
+    )
+    back = snapshot_from_proto(snapshot_to_proto(snap, factory), factory)
+    assert back.queue_usage["qa"][chips_i] == 8
+
+
 def test_gateway_malformed_body_is_a_400():
     """Unparseable JSON must come back as HTTP 400, not a dropped socket."""
     import json

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Opportunistic TPU-tunnel probe (VERDICT.md round-3 task #1).
+# Probes the axon TPU backend in a subprocess with a hard timeout, every
+# ARMADA_PROBE_INTERVAL_S (default 600s), appending one line per attempt to
+# .tpu_probe.log.  On the FIRST success it writes .tpu_probe.ok and keeps
+# looping (so we also learn whether the tunnel stays up).
+cd "$(dirname "$0")/.." || exit 1
+INTERVAL="${ARMADA_PROBE_INTERVAL_S:-600}"
+TIMEOUT="${ARMADA_PROBE_TIMEOUT_S:-90}"
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout "$TIMEOUT" python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('PLATFORM=' + jax.devices()[0].platform)
+" 2>&1)
+  rc=$?
+  platform=$(printf '%s' "$out" | grep -o 'PLATFORM=.*' | cut -d= -f2)
+  if [ "$rc" -eq 0 ] && [ -n "$platform" ] && [ "$platform" != "cpu" ]; then
+    echo "$ts OK platform=$platform" >> .tpu_probe.log
+    echo "$ts $platform" >> .tpu_probe.ok
+  else
+    tail=$(printf '%s' "$out" | tail -n 1 | cut -c1-160)
+    echo "$ts FAIL rc=$rc $tail" >> .tpu_probe.log
+  fi
+  sleep "$INTERVAL"
+done
