@@ -415,12 +415,74 @@ def cmd_cordon_node(args):
     return 0
 
 
+# Effective defaults for serve flags.  The argparse defaults are all None
+# (sentinels) so "flag not given" is distinguishable from "flag given at its
+# default value" -- an explicit `--port 50051` must beat the config file
+# (flag > env > file, internal/common/startup.go precedence).  These values
+# apply LAST, after the file merge.
+_SERVE_FALLBACKS = {
+    "data_dir": "./armada-tpu-data",
+    "port": 50051,
+    "cycle_interval": 1.0,
+    "schedule_interval": 5.0,
+    "metrics_port": None,
+    "health_port": None,
+    "lookout_port": None,
+    "rest_port": None,
+    "bind_host": "127.0.0.1",
+    "leader_id": None,
+}
+
+
+def load_serve_config(args):
+    """Resolve --config into (SchedulingConfig | None, authenticator | None),
+    filling UNSET serve flags (argparse sentinel None) from the file's serve:
+    section, then from _SERVE_FALLBACKS -- explicit CLI flags always win,
+    even when set to their default value (flag > env > file,
+    internal/common/startup.go precedence)."""
+    config = None
+    authenticator = None
+    serve_doc: dict = {}
+    if args.config:
+        from armada_tpu.core.config import operator_config_from_yaml
+        from armada_tpu.server.authn import authn_from_config
+
+        loaded = operator_config_from_yaml(args.config)
+        config = loaded["scheduling"]
+        authenticator = (
+            authn_from_config(loaded["auth"]) if loaded["auth"] is not None else None
+        )
+        serve_doc = {k.lower(): v for k, v in loaded["serve"].items()}
+    mapping = {
+        "data_dir": ("datadir", str),
+        "port": ("port", int),
+        "cycle_interval": ("cycleinterval", float),
+        "schedule_interval": ("scheduleinterval", float),
+        "metrics_port": ("metricsport", int),
+        "health_port": ("healthport", int),
+        "lookout_port": ("lookoutport", int),
+        "rest_port": ("restport", int),
+        "bind_host": ("bindhost", str),
+        "leader_id": ("leaderid", str),
+    }
+    for attr, (key, cast) in mapping.items():
+        if getattr(args, attr) is None:
+            if key in serve_doc and serve_doc[key] is not None:
+                setattr(args, attr, cast(serve_doc[key]))
+            else:
+                setattr(args, attr, _SERVE_FALLBACKS[attr])
+    return config, authenticator
+
+
 def cmd_serve(args):
     from armada_tpu.cli.serve import start_control_plane
 
+    config, authenticator = load_serve_config(args)
     plane = start_control_plane(
         data_dir=args.data_dir,
         port=args.port,
+        config=config,
+        authenticator=authenticator,
         cycle_interval_s=args.cycle_interval,
         schedule_interval_s=args.schedule_interval,
         leader_id=args.leader_id,
@@ -478,6 +540,9 @@ def cmd_executor(args):
             kube_ca_file=args.kube_ca,
             kube_insecure=args.kube_insecure,
             pod_checks_file=args.pod_checks,
+            auth_token=args.auth_token,
+            auth_token_file=args.auth_token_file,
+            auth_basic=args.auth_basic,
         )
     except KeyboardInterrupt:
         pass
@@ -574,10 +639,18 @@ def build_parser() -> argparse.ArgumentParser:
     dj.set_defaults(fn=cmd_describe_job)
 
     srv = sub.add_parser("serve", help="run the control plane")
-    srv.add_argument("--data-dir", default="./armada-tpu-data")
-    srv.add_argument("--port", type=int, default=50051)
-    srv.add_argument("--cycle-interval", type=float, default=1.0)
-    srv.add_argument("--schedule-interval", type=float, default=5.0)
+    srv.add_argument(
+        "--config",
+        help="operator config YAML (scheduling:/auth:/serve: sections) with "
+        "ARMADA_* env overlay (internal/common/startup.go LoadConfig)",
+    )
+    # serve flag defaults are None SENTINELS: load_serve_config fills unset
+    # flags from the config file, then from _SERVE_FALLBACKS (so an explicit
+    # flag -- even at its default value -- always beats the file).
+    srv.add_argument("--data-dir", help="state directory (default ./armada-tpu-data)")
+    srv.add_argument("--port", type=int, help="gRPC port (default 50051)")
+    srv.add_argument("--cycle-interval", type=float, help="seconds (default 1.0)")
+    srv.add_argument("--schedule-interval", type=float, help="seconds (default 5.0)")
     srv.add_argument("--leader-id", help="enable file-lease leader election")
     srv.add_argument(
         "--kube-lease-url",
@@ -613,9 +686,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     srv.add_argument(
         "--bind-host",
-        default="127.0.0.1",
         help="address every server binds (gRPC/REST/lookout/health); "
-        "use 0.0.0.0 in containers so other hosts can reach the plane",
+        "use 0.0.0.0 in containers so other hosts can reach the plane "
+        "(default 127.0.0.1)",
     )
     srv.set_defaults(fn=cmd_serve)
 
@@ -676,6 +749,19 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="FILE",
         help="YAML list of pending-pod check rules "
         "({regexp, action: Fail|Retry, gracePeriod, inverse})",
+    )
+    ex.add_argument(
+        "--auth-token", help="bearer token presented to the control plane"
+    )
+    ex.add_argument(
+        "--auth-token-file",
+        help="file holding the bearer token (e.g. a projected service-account "
+        "token when the plane uses kubernetes_token_review auth)",
+    )
+    ex.add_argument(
+        "--auth-basic",
+        metavar="USER:PASS",
+        help="basic credentials presented to the control plane",
     )
     ex.set_defaults(fn=cmd_executor)
 

@@ -106,11 +106,14 @@ def start_control_plane(
     kube_lease_url: Optional[str] = None,
     kube_lease_namespace: str = "default",
     bind_host: str = "127.0.0.1",
+    authenticator=None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
     common/profiling/http.go).  lookout_port: host the lookout web UI
-    (internal/lookoutui equivalent) on this port."""
+    (internal/lookoutui equivalent) on this port.  authenticator: the
+    server/authn.py chain gating the gRPC services and REST gateway; None =
+    dev chain (trusted headers + anonymous)."""
     os.makedirs(data_dir, exist_ok=True)
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
@@ -233,6 +236,7 @@ def start_control_plane(
         lookout_queries=LookoutQueries(lookoutdb),
         reports=reports,
         address=f"{bind_host}:{port}",
+        authenticator=authenticator,
     )
 
     scheduler_pipeline.start()
@@ -301,7 +305,13 @@ def start_control_plane(
     if rest_port is not None:
         from armada_tpu.server.gateway import RestGateway
 
-        rest_gateway = RestGateway(submit_server, event_api, rest_port, host=bind_host)
+        rest_gateway = RestGateway(
+            submit_server,
+            event_api,
+            rest_port,
+            host=bind_host,
+            authenticator=authenticator,
+        )
 
     return ControlPlaneProcess(
         port=bound_port,
@@ -342,11 +352,19 @@ def run_fake_executor(
     kube_ca_file: Optional[str] = None,
     kube_insecure: bool = False,
     pod_checks_file: Optional[str] = None,
+    auth_token: Optional[str] = None,
+    auth_token_file: Optional[str] = None,
+    auth_basic: Optional[str] = None,
 ) -> None:
     """`armadactl executor`: a cluster agent against a remote control plane.
     Default is the fake in-memory cluster (cmd/fakeexecutor); kubernetes_url
     or kubernetes_in_cluster drives a real Kubernetes cluster via
-    KubernetesClusterContext (cmd/executor)."""
+    KubernetesClusterContext (cmd/executor).
+
+    auth_token / auth_token_file / auth_basic ("user:pass") present
+    credentials to a control plane running a non-dev auth chain
+    (server/authn.py); without them only trusted-header/anonymous chains
+    accept the lease stream."""
     import time
 
     from armada_tpu.core.types import NodeSpec
@@ -399,7 +417,17 @@ def run_fake_executor(
             pod_check_rules, failed_pod_checker = checks_from_config(
                 yaml.safe_load(f)
             )
-    api = ExecutorApiClient(server_address)
+    bearer = auth_token
+    if auth_token_file:
+        with open(auth_token_file) as f:
+            bearer = f.read().strip()
+    basic = None
+    if auth_basic:
+        user, _, password = auth_basic.partition(":")
+        basic = (user, password)
+    api = ExecutorApiClient(
+        server_address, factory=factory, bearer_token=bearer, basic_auth=basic
+    )
     agent = ExecutorService(
         executor_id,
         pool,

@@ -331,7 +331,21 @@ def _parse_tolerations(entries) -> tuple:
 
 def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
     """Build a SchedulingConfig from a parsed YAML mapping using the reference's
-    key names (config/scheduler/config.yaml `scheduling:` block)."""
+    key names (config/scheduler/config.yaml `scheduling:` block).
+
+    Top-level keys match case-insensitively: the ARMADA_* env overlay
+    (apply_env_overlay) can only spell keys in one case, and viper's own
+    lookups are case-insensitive too."""
+    lowered = {(k.lower() if isinstance(k, str) else k): v for k, v in d.items()}
+
+    class _CI:
+        def __contains__(self, key):
+            return key.lower() in lowered
+
+        def __getitem__(self, key):
+            return lowered[key.lower()]
+
+    d = _CI()  # type: ignore[assignment]
     kw: dict = {}
     if "supportedResourceTypes" in d:
         kw["supported_resource_types"] = tuple(
@@ -380,6 +394,7 @@ def scheduling_config_from_dict(d: Mapping) -> SchedulingConfig:
         ("maximumPerQueueSchedulingRate", "maximum_per_queue_scheduling_rate"),
         ("maxRetries", "max_retries"),
         ("nodeIdLabel", "node_id_label"),
+        ("shapeBucket", "shape_bucket"),
         ("enableAssertions", "enable_assertions"),
         ("disableScheduling", "disable_scheduling"),
         ("incrementalProblemBuild", "incremental_problem_build"),
@@ -451,3 +466,76 @@ def scheduling_config_from_yaml(path: str) -> SchedulingConfig:
     if "scheduling" in doc:
         doc = doc["scheduling"]
     return scheduling_config_from_dict(doc)
+
+
+def apply_env_overlay(doc: dict, env: Mapping[str, str]) -> dict:
+    """Overlay `ARMADA_*` environment variables onto a parsed config mapping,
+    the reference's viper env binding (internal/common/startup.go:45-60:
+    prefix ARMADA, key path joined with underscores).
+
+    `ARMADA_SECTION__SUBKEY=value` sets doc["section"]["subKey"]; path
+    segments split on DOUBLE underscores so snake_case keys survive, and each
+    segment matches the existing key case-insensitively (so both yaml
+    camelCase keys and config snake_case keys are addressable).  Values parse
+    as YAML scalars (`true`, `5`, `[a, b]`, quoted strings...).
+    """
+    import copy
+
+    import yaml
+
+    out = copy.deepcopy(doc)
+    for name, raw in sorted(env.items()):
+        if not name.startswith("ARMADA_") or name.startswith("ARMADA_BENCH"):
+            continue
+        path = [seg for seg in name[len("ARMADA_") :].split("__") if seg]
+        if not path:
+            continue
+        node = out
+        for i, seg in enumerate(path):
+            match = next(
+                (k for k in node if isinstance(k, str) and k.lower() == seg.lower()),
+                None,
+            )
+            leaf = i == len(path) - 1
+            if leaf:
+                try:
+                    value = yaml.safe_load(raw)
+                except yaml.YAMLError:
+                    value = raw
+                node[match if match is not None else seg.lower()] = value
+            else:
+                if match is None or not isinstance(node.get(match), dict):
+                    match = match if match is not None else seg.lower()
+                    node[match] = {}
+                node = node[match]
+    return out
+
+
+def operator_config_from_yaml(
+    path: str, env: Optional[Mapping[str, str]] = None
+) -> dict:
+    """Load a full operator config file for `armadactl serve` (the analog of
+    the reference's per-component config/<c>/config.yaml + --config overlays
+    + ARMADA_* env bindings, internal/common/startup.go LoadConfig).
+
+    Sections:
+      scheduling: <SchedulingConfig keys, reference names>   -> "scheduling"
+      auth:       <server/authn.py authn_from_config block>  -> "auth" (raw)
+      serve:      port/dataDir/cycleInterval/... defaults    -> "serve" (raw)
+
+    Returns {"scheduling": SchedulingConfig, "auth": dict|None,
+    "serve": dict} with the env overlay applied BEFORE parsing.
+    """
+    import os as _os
+
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    doc = apply_env_overlay(doc, _os.environ if env is None else env)
+    scheduling = scheduling_config_from_dict(doc.get("scheduling") or {})
+    return {
+        "scheduling": scheduling,
+        "auth": doc.get("auth"),
+        "serve": doc.get("serve") or {},
+    }

@@ -30,11 +30,25 @@ class _Base:
         principal: str = "anonymous",
         groups: Sequence[str] = (),
         channel: Optional[grpc.Channel] = None,
+        bearer_token: Optional[str] = None,
+        basic_auth: Optional[tuple[str, str]] = None,
     ):
+        """principal/groups ride trusted headers (dev chains only);
+        bearer_token / basic_auth produce a standard `authorization` header
+        for OIDC / token-review / basic authenticators (pkg/client/auth)."""
         self._channel = channel or grpc.insecure_channel(address)
         self._meta = [(_PRINCIPAL_KEY, principal)]
         if groups:
             self._meta.append((_GROUPS_KEY, ",".join(groups)))
+        if bearer_token:
+            self._meta.append(("authorization", f"Bearer {bearer_token}"))
+        elif basic_auth:
+            import base64
+
+            cred = base64.b64encode(
+                f"{basic_auth[0]}:{basic_auth[1]}".encode()
+            ).decode()
+            self._meta.append(("authorization", f"Basic {cred}"))
 
     def close(self) -> None:
         self._channel.close()
@@ -325,12 +339,21 @@ class BinocularsClient(_Base):
 
 
 class ExecutorApiClient(_Base):
-    """Drop-in wire replacement for the in-process ExecutorApi."""
+    """Drop-in wire replacement for the in-process ExecutorApi.
+
+    `factory` should be the executor's ResourceListFactory so queue_usage
+    axis names serialize against the true axis order (convert.py
+    snapshot_to_proto); without it the names are inferred from node
+    payloads."""
+
+    def __init__(self, *args, factory=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._factory = factory
 
     def lease_job_runs(self, request: LeaseRequest) -> LeaseResponse:
         resp = self._unary(
             "/armada_tpu.api.ExecutorApi/LeaseJobRuns",
-            convert.lease_request_to_proto(request),
+            convert.lease_request_to_proto(request, self._factory),
             pb.LeaseJobRunsResponse,
         )
         return convert.lease_response_from_proto(resp)

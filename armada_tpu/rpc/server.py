@@ -17,20 +17,32 @@ import grpc
 
 from armada_tpu.rpc import convert, rpc_pb2 as pb
 from armada_tpu.server.auth import AuthorizationError, Principal
+from armada_tpu.server.authn import (
+    AnonymousAuthenticator,
+    AuthenticationError,
+    MultiAuthenticator,
+    TrustedHeaderAuthenticator,
+)
 from armada_tpu.server.queues import QueueAlreadyExists, QueueNotFound
 from armada_tpu.server.submit import SubmitError
 
-_PRINCIPAL_KEY = "x-armada-principal"
-_GROUPS_KEY = "x-armada-groups"
+
+def default_authenticator() -> MultiAuthenticator:
+    """Dev-mode chain (the reference's anonymousAuth default): trusted
+    headers honoured, everything else anonymous.  Production deployments
+    pass an explicit chain (server/authn.py authn_from_config) where
+    trusted headers are an opt-in."""
+    return MultiAuthenticator([TrustedHeaderAuthenticator(), AnonymousAuthenticator()])
 
 
-def _principal_from_context(context) -> Principal:
-    """Trusted-header authentication: the transport supplies the identity
-    (the reference's auth middlewares resolve to the same Principal shape)."""
-    meta = dict(context.invocation_metadata() or ())
-    name = meta.get(_PRINCIPAL_KEY, "anonymous")
-    groups = tuple(g for g in meta.get(_GROUPS_KEY, "").split(",") if g)
-    return Principal(name=name, groups=groups)
+def _authenticate(auth, context) -> Principal:
+    """Resolve the caller or abort UNAUTHENTICATED.  Runs on EVERY service
+    handler -- an unauthenticated or forged request never reaches a service."""
+    meta = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+    try:
+        return auth.authenticate(meta)
+    except AuthenticationError as e:
+        context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
 
 
 def _guard(context, fn):
@@ -51,11 +63,12 @@ def _guard(context, fn):
 
 
 class _SubmitService:
-    def __init__(self, server):
+    def __init__(self, server, auth):
         self._server = server
+        self._auth = auth
 
     def SubmitJobs(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         items = [convert.submit_item_from_proto(m) for m in request.items]
         ids = _guard(
             context,
@@ -66,7 +79,7 @@ class _SubmitService:
         return pb.SubmitJobsResponse(job_ids=ids)
 
     def CancelJobs(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         _guard(
             context,
             lambda: self._server.cancel_jobs(
@@ -80,7 +93,7 @@ class _SubmitService:
         return pb.Empty()
 
     def CancelJobSet(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         _guard(
             context,
             lambda: self._server.cancel_jobset(
@@ -94,7 +107,7 @@ class _SubmitService:
         return pb.Empty()
 
     def PreemptJobs(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         _guard(
             context,
             lambda: self._server.preempt_jobs(
@@ -108,7 +121,7 @@ class _SubmitService:
         return pb.Empty()
 
     def ReprioritizeJobs(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         _guard(
             context,
             lambda: self._server.reprioritize_jobs(
@@ -122,39 +135,43 @@ class _SubmitService:
         return pb.Empty()
 
     def CreateQueue(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         record = convert.queue_from_proto(request)
         _guard(context, lambda: self._server.create_queue(record, principal))
         return pb.Empty()
 
     def UpdateQueue(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         record = convert.queue_from_proto(request)
         _guard(context, lambda: self._server.update_queue(record, principal))
         return pb.Empty()
 
     def DeleteQueue(self, request, context):
-        principal = _principal_from_context(context)
+        principal = _authenticate(self._auth, context)
         _guard(context, lambda: self._server.delete_queue(request.name, principal))
         return pb.Empty()
 
     def GetQueue(self, request, context):
+        _authenticate(self._auth, context)
         record = self._server.get_queue(request.name)
         if record is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"queue {request.name!r} not found")
         return convert.queue_to_proto(record)
 
     def ListQueues(self, request, context):
+        _authenticate(self._auth, context)
         return pb.QueueListResponse(
             queues=[convert.queue_to_proto(q) for q in self._server.list_queues()]
         )
 
 
 class _EventService:
-    def __init__(self, event_api):
+    def __init__(self, event_api, auth):
         self._api = event_api
+        self._auth = auth
 
     def GetJobSetEvents(self, request, context):
+        _authenticate(self._auth, context)
         if not request.watch:
             # Page until a short read: jobsets can exceed one batch.
             idx = int(request.from_idx)
@@ -181,10 +198,12 @@ class _EventService:
 class _LookoutService:
     """JSON-over-gRPC lookout queries (the reference's REST surface)."""
 
-    def __init__(self, queries):
+    def __init__(self, queries, auth):
         self._queries = queries
+        self._auth = auth
 
     def GetJobs(self, request, context):
+        _authenticate(self._auth, context)
         import json
 
         from armada_tpu.lookout.queries import JobFilter, JobOrder
@@ -204,6 +223,7 @@ class _LookoutService:
         return pb.JsonResponse(json=json.dumps(jobs))
 
     def GroupJobs(self, request, context):
+        _authenticate(self._auth, context)
         import json
 
         from armada_tpu.lookout.queries import JobFilter
@@ -223,6 +243,7 @@ class _LookoutService:
         return pb.JsonResponse(json=json.dumps(groups))
 
     def GetJobDetails(self, request, context):
+        _authenticate(self._auth, context)
         import json
 
         details = self._queries.get_job_details(request.name)
@@ -234,10 +255,12 @@ class _LookoutService:
 class _ReportsService:
     """SchedulingReports (internal/scheduler/reports/server.go) as JSON."""
 
-    def __init__(self, reports):
+    def __init__(self, reports, auth):
         self._reports = reports
+        self._auth = auth
 
     def GetJobReport(self, request, context):
+        _authenticate(self._auth, context)
         import json
 
         report = self._reports.job_report(request.name)
@@ -248,11 +271,13 @@ class _ReportsService:
         return pb.JsonResponse(json=json.dumps(report))
 
     def GetQueueReport(self, request, context):
+        _authenticate(self._auth, context)
         import json
 
         return pb.JsonResponse(json=json.dumps(self._reports.queue_report(request.name)))
 
     def GetPoolReport(self, request, context):
+        _authenticate(self._auth, context)
         import json
 
         return pb.JsonResponse(
@@ -263,10 +288,12 @@ class _ReportsService:
 class _BinocularsService:
     """Logs + Cordon next to the cluster (internal/binoculars)."""
 
-    def __init__(self, binoculars):
+    def __init__(self, binoculars, auth):
         self._b = binoculars
+        self._auth = auth
 
     def Logs(self, request, context):
+        _authenticate(self._auth, context)
         try:
             text = self._b.logs(job_id=request.job_id, run_id=request.run_id)
         except KeyError as e:
@@ -274,6 +301,7 @@ class _BinocularsService:
         return pb.LogsResponse(log=text)
 
     def Cordon(self, request, context):
+        _authenticate(self._auth, context)
         try:
             self._b.cordon(request.node_id, cordoned=not request.uncordon)
         except KeyError as e:
@@ -282,15 +310,18 @@ class _BinocularsService:
 
 
 class _ExecutorApiService:
-    def __init__(self, executor_api, factory):
+    def __init__(self, executor_api, factory, auth):
         self._api = executor_api
         self._factory = factory
+        self._auth = auth
 
     def LeaseJobRuns(self, request, context):
+        _authenticate(self._auth, context)
         req = convert.lease_request_from_proto(request, self._factory)
         return convert.lease_response_to_proto(self._api.lease_job_runs(req))
 
     def ReportEvents(self, request, context):
+        _authenticate(self._auth, context)
         self._api.report_events(list(request.sequences))
         return pb.Empty()
 
@@ -321,13 +352,16 @@ def make_server(
     binoculars=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
+    authenticator=None,
 ) -> tuple[grpc.Server, int]:
     """Build and start a server hosting whichever services are given;
-    returns (server, bound_port)."""
+    returns (server, bound_port).  `authenticator` gates EVERY handler;
+    None = the dev chain (trusted headers + anonymous)."""
+    auth = authenticator if authenticator is not None else default_authenticator()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = []
     if submit_server is not None:
-        svc = _SubmitService(submit_server)
+        svc = _SubmitService(submit_server, auth)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.Submit",
@@ -348,7 +382,7 @@ def make_server(
             )
         )
     if event_api is not None:
-        esvc = _EventService(event_api)
+        esvc = _EventService(event_api, auth)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.Event",
@@ -360,7 +394,7 @@ def make_server(
             )
         )
     if lookout_queries is not None:
-        lsvc = _LookoutService(lookout_queries)
+        lsvc = _LookoutService(lookout_queries, auth)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.Lookout",
@@ -372,7 +406,7 @@ def make_server(
             )
         )
     if reports is not None:
-        rsvc = _ReportsService(reports)
+        rsvc = _ReportsService(reports, auth)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.Reports",
@@ -384,7 +418,7 @@ def make_server(
             )
         )
     if binoculars is not None:
-        bsvc = _BinocularsService(binoculars)
+        bsvc = _BinocularsService(binoculars, auth)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.Binoculars",
@@ -397,7 +431,7 @@ def make_server(
     if executor_api is not None:
         if factory is None:
             raise ValueError("executor_api service requires a ResourceListFactory")
-        xsvc = _ExecutorApiService(executor_api, factory)
+        xsvc = _ExecutorApiService(executor_api, factory, auth)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.ExecutorApi",

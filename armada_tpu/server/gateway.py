@@ -23,8 +23,9 @@ Routes (reference paths):
          -> NDJSON stream of JobSetEventMessage (catch-up read; the
             reference's POST /v1/job-set/{queue}/{id} stream)
 
-Identity rides the same trusted headers the gRPC metadata uses
-(x-armada-principal / x-armada-groups).
+Identity resolves through the same authenticator chain the gRPC transport
+uses (server/authn.py): basic / OIDC bearer / kubernetes token review /
+trusted headers / anonymous, per the gateway's configured chain.
 """
 
 from __future__ import annotations
@@ -38,6 +39,7 @@ from google.protobuf import json_format
 
 from armada_tpu.rpc import convert, rpc_pb2 as pb
 from armada_tpu.server.auth import AuthorizationError, Principal
+from armada_tpu.server.authn import AuthenticationError
 from armada_tpu.server.queues import QueueAlreadyExists, QueueNotFound
 from armada_tpu.server.submit import SubmitError
 
@@ -51,12 +53,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------------ util
 
+    class _Unauthenticated(Exception):
+        pass
+
     def _principal(self) -> Principal:
-        name = self.headers.get("x-armada-principal", "anonymous")
-        groups = tuple(
-            g for g in (self.headers.get("x-armada-groups", "")).split(",") if g
-        )
-        return Principal(name=name, groups=groups)
+        """Authenticate through the gateway's configured chain (same
+        authenticators as the gRPC transport, server/authn.py)."""
+        gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
+        meta = {k.lower(): v for k, v in self.headers.items()}
+        try:
+            return gw.authenticator.authenticate(meta)
+        except AuthenticationError as e:
+            raise _Handler._Unauthenticated(str(e)) from e
 
     class _BadRequest(Exception):
         pass
@@ -71,11 +79,15 @@ class _Handler(BaseHTTPRequestHandler):
             raise _Handler._BadRequest(str(e)) from e
 
     def _route(self, fn):
-        """Run one verb handler, translating bad-input errors to 400 -- but
-        only if no response has been written yet (a doubled response would
-        corrupt keep-alive clients)."""
+        """Run one verb handler, translating bad-input errors to 400 and
+        failed authentication to 401 -- but only if no response has been
+        written yet (a doubled response would corrupt keep-alive clients)."""
         try:
             fn()
+        except _Handler._Unauthenticated as e:
+            if getattr(self, "_responded", False):
+                raise
+            self._error(401, f"unauthenticated: {e}")
         except (_Handler._BadRequest, ValueError) as e:
             if getattr(self, "_responded", False):
                 raise
@@ -202,6 +214,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_get(self):
         gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
+        self._principal()  # reads also require authentication
         parsed = urlparse(self.path)
         path = parsed.path
         if path == "/v1/batched/queues":
@@ -268,9 +281,21 @@ class _Handler(BaseHTTPRequestHandler):
 class RestGateway:
     """Serves the gateway on `port` (0 = pick a free one)."""
 
-    def __init__(self, submit_server, event_api, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        submit_server,
+        event_api,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        authenticator=None,
+    ):
+        from armada_tpu.rpc.server import default_authenticator
+
         self.submit_server = submit_server
         self.event_api = event_api
+        self.authenticator = (
+            authenticator if authenticator is not None else default_authenticator()
+        )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]

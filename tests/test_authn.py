@@ -1,0 +1,365 @@
+"""Authentication (internal/common/auth parity): basic, OIDC bearer,
+kubernetes token review, trusted headers, multi chains -- and the transport
+contract that an unauthenticated or forged request is rejected on EVERY
+gRPC service and the REST gateway (VERDICT round-2 missing #2)."""
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+
+import grpc
+import pytest
+
+from armada_tpu.server.authn import (
+    AnonymousAuthenticator,
+    AuthenticationError,
+    BasicAuthenticator,
+    KubernetesTokenReviewAuthenticator,
+    MultiAuthenticator,
+    OidcAuthenticator,
+    TrustedHeaderAuthenticator,
+    authn_from_config,
+)
+
+
+def _b64u(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def make_jwt(claims, secret=None, rsa_key=None, kid="k1", alg=None):
+    alg = alg or ("HS256" if secret else "RS256")
+    header = {"alg": alg, "kid": kid, "typ": "JWT"}
+    signed = f"{_b64u(json.dumps(header).encode())}.{_b64u(json.dumps(claims).encode())}"
+    if alg == "HS256":
+        sig = hmac.new(secret.encode(), signed.encode(), hashlib.sha256).digest()
+    else:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        sig = rsa_key.sign(signed.encode(), padding.PKCS1v15(), hashes.SHA256())
+    return f"{signed}.{_b64u(sig)}"
+
+
+# --------------------------------------------------------------- unit -------
+
+
+def test_basic_accepts_and_rejects():
+    a = BasicAuthenticator({"alice": ("pw1", ("team",)), "bob": "pw2"})
+    cred = base64.b64encode(b"alice:pw1").decode()
+    p = a.authenticate({"authorization": f"Basic {cred}"})
+    assert p.name == "alice" and p.groups == ("team",)
+    bad = base64.b64encode(b"alice:wrong").decode()
+    with pytest.raises(AuthenticationError):
+        a.authenticate({"authorization": f"Basic {bad}"})
+    unknown = base64.b64encode(b"eve:pw1").decode()
+    with pytest.raises(AuthenticationError):
+        a.authenticate({"authorization": f"Basic {unknown}"})
+    assert a.authenticate({}) is None  # no credentials -> not handled
+
+
+def test_malformed_credentials_reject_cleanly():
+    """Attacker-shaped input must produce AuthenticationError, never an
+    unhandled crash (round-3 review findings: non-ASCII basic passwords hit
+    compare_digest's str TypeError; JSON-list JWT segments hit .get())."""
+    a = BasicAuthenticator({"alice": "pw"})
+    cred = base64.b64encode("alice:pässwörd".encode()).decode()
+    with pytest.raises(AuthenticationError):
+        a.authenticate({"authorization": f"Basic {cred}"})
+
+    o = OidcAuthenticator("iss", "aud", {"": "hs256:s"})
+    list_seg = _b64u(b"[]")
+    for tok in (
+        f"{list_seg}.{list_seg}.{list_seg}",
+        "not-base64!.x.y",
+    ):
+        with pytest.raises(AuthenticationError):
+            o.authenticate({"authorization": f"Bearer {tok}"})
+
+
+def test_token_review_verdicts_are_cached():
+    calls = []
+
+    class _FakeReview(KubernetesTokenReviewAuthenticator):
+        def __init__(self):
+            super().__init__("http://unused", clock=lambda: now[0])
+
+        def authenticate(self, metadata):  # route through the real cache
+            return super().authenticate(metadata)
+
+    now = [0.0]
+    a = _FakeReview()
+
+    def fake_urlopen(req, timeout=None, context=None):
+        import io
+
+        calls.append(1)
+        body = json.dumps(
+            {"status": {"authenticated": True, "user": {"username": "sa"}}}
+        ).encode()
+
+        class R(io.BytesIO):
+            status = 201
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        return R(body)
+
+    import urllib.request as ur
+
+    orig = ur.urlopen
+    ur.urlopen = fake_urlopen
+    try:
+        md = {"authorization": "Bearer tok"}
+        assert a.authenticate(md).name == "sa"
+        assert a.authenticate(md).name == "sa"
+        assert len(calls) == 1  # second hit served from cache
+        now[0] = 301.0  # TTL expired -> re-review
+        assert a.authenticate(md).name == "sa"
+        assert len(calls) == 2
+    finally:
+        ur.urlopen = orig
+
+
+def test_oidc_hs256_claims():
+    clock = lambda: 1000.0
+    a = OidcAuthenticator(
+        "https://issuer", "armada", {"k1": "hs256:sekrit"}, clock=clock
+    )
+    claims = {
+        "iss": "https://issuer",
+        "aud": "armada",
+        "sub": "alice",
+        "groups": ["team-a", "team-b"],
+        "exp": 2000,
+    }
+    p = a.authenticate(
+        {"authorization": "Bearer " + make_jwt(claims, secret="sekrit")}
+    )
+    assert p.name == "alice" and p.groups == ("team-a", "team-b")
+    # tampered signature
+    with pytest.raises(AuthenticationError):
+        a.authenticate(
+            {"authorization": "Bearer " + make_jwt(claims, secret="wrong")}
+        )
+    # expired
+    with pytest.raises(AuthenticationError):
+        a.authenticate(
+            {
+                "authorization": "Bearer "
+                + make_jwt({**claims, "exp": 100}, secret="sekrit")
+            }
+        )
+    # wrong issuer / audience
+    for bad in ({"iss": "https://evil"}, {"aud": "other"}):
+        with pytest.raises(AuthenticationError):
+            a.authenticate(
+                {
+                    "authorization": "Bearer "
+                    + make_jwt({**claims, **bad}, secret="sekrit")
+                }
+            )
+
+
+def test_oidc_rs256_roundtrip():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo
+    ).decode()
+    a = OidcAuthenticator("iss", "aud", {"k1": pem})
+    claims = {"iss": "iss", "aud": ["aud", "other"], "sub": "svc",
+              "exp": time.time() + 60}
+    p = a.authenticate({"authorization": "Bearer " + make_jwt(claims, rsa_key=key)})
+    assert p.name == "svc"
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    with pytest.raises(AuthenticationError):
+        a.authenticate(
+            {"authorization": "Bearer " + make_jwt(claims, rsa_key=other)}
+        )
+
+
+def test_token_review_against_fake_apiserver():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            tok = body["spec"]["token"]
+            if tok == "good":
+                out = {"status": {"authenticated": True,
+                                  "user": {"username": "system:sa:ns:runner",
+                                           "groups": ["system:serviceaccounts"]}}}
+            else:
+                out = {"status": {"authenticated": False}}
+            data = json.dumps(out).encode()
+            self.send_response(201)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        a = KubernetesTokenReviewAuthenticator(
+            f"http://127.0.0.1:{httpd.server_address[1]}"
+        )
+        p = a.authenticate({"authorization": "Bearer good"})
+        assert p.name == "system:sa:ns:runner"
+        assert "system:serviceaccounts" in p.groups
+        with pytest.raises(AuthenticationError):
+            a.authenticate({"authorization": "Bearer bad"})
+        assert a.authenticate({}) is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_multi_chain_order_and_rejection():
+    chain = MultiAuthenticator(
+        [BasicAuthenticator({"alice": "pw"}), AnonymousAuthenticator()]
+    )
+    cred = base64.b64encode(b"alice:pw").decode()
+    assert chain.authenticate({"authorization": f"Basic {cred}"}).name == "alice"
+    assert chain.authenticate({}).name == "anonymous"
+    strict = MultiAuthenticator([BasicAuthenticator({"alice": "pw"})])
+    with pytest.raises(AuthenticationError):
+        strict.authenticate({})  # no credentials, no anonymous fallback
+    with pytest.raises(AuthenticationError):
+        # forged trusted header means nothing to a strict chain
+        strict.authenticate({"x-armada-principal": "admin"})
+
+
+def test_authn_from_config():
+    cfg = {
+        "basic": {"users": {"alice": {"password": "pw", "groups": ["team"]}}},
+        "oidc": {"issuer": "iss", "audience": "aud", "keys": {"": "hs256:s"}},
+        "trusted_headers": True,
+        "anonymous": True,
+    }
+    chain = authn_from_config(cfg)
+    assert chain.authenticate({"x-armada-principal": "ops"}).name == "ops"
+    assert chain.authenticate({}).name == "anonymous"
+    # config WITHOUT anonymous/trusted: strict
+    strict = authn_from_config({"basic": {"users": {"a": "p"}}})
+    with pytest.raises(AuthenticationError):
+        strict.authenticate({"x-armada-principal": "admin"})
+
+
+# ------------------------------------------------- transport contract -------
+
+
+class _StubSubmit:
+    def list_queues(self):
+        return []
+
+
+class _StubEvents:
+    def get_jobset_events(self, queue, jobset, idx):
+        return []
+
+
+class _StubQueries:
+    def get_jobs(self, *a, **k):
+        return []
+
+
+class _StubReports:
+    def pool_report(self, name):
+        return {}
+
+
+class _StubBinoculars:
+    def logs(self, job_id="", run_id=""):
+        return ""
+
+
+class _StubExecApi:
+    def report_events(self, seqs):
+        pass
+
+
+@pytest.fixture
+def strict_server():
+    from armada_tpu.core.config import default_scheduling_config
+    from armada_tpu.rpc.server import make_server
+
+    auth = MultiAuthenticator([BasicAuthenticator({"alice": ("pw", ("team",))})])
+    server, port = make_server(
+        submit_server=_StubSubmit(),
+        event_api=_StubEvents(),
+        lookout_queries=_StubQueries(),
+        reports=_StubReports(),
+        binoculars=_StubBinoculars(),
+        executor_api=_StubExecApi(),
+        factory=default_scheduling_config().resource_list_factory(),
+        authenticator=auth,
+    )
+    yield port
+    server.stop(None)
+
+
+def test_every_grpc_service_rejects_unauthenticated(strict_server):
+    from armada_tpu.rpc.client import (
+        ArmadaClient,
+        BinocularsClient,
+        ExecutorApiClient,
+    )
+
+    addr = f"127.0.0.1:{strict_server}"
+    # forged trusted header: the strict chain must NOT honour it
+    calls = [
+        lambda: ArmadaClient(addr, principal="admin").list_queues(),
+        lambda: ArmadaClient(addr, principal="admin").get_jobset_events("q", "js"),
+        lambda: ArmadaClient(addr, principal="admin").get_jobs(),
+        lambda: ArmadaClient(addr, principal="admin").get_pool_report(),
+        lambda: BinocularsClient(addr, principal="admin").logs(job_id="x"),
+        lambda: ExecutorApiClient(addr, principal="admin").report_events([]),
+    ]
+    for call in calls:
+        with pytest.raises(grpc.RpcError) as exc:
+            call()
+        assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    # valid credentials pass the same chain
+    ok = ArmadaClient(addr, basic_auth=("alice", "pw"))
+    assert ok.list_queues() == []
+    bad = ArmadaClient(addr, basic_auth=("alice", "wrong"))
+    with pytest.raises(grpc.RpcError) as exc:
+        bad.list_queues()
+    assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+
+def test_gateway_rejects_unauthenticated():
+    import urllib.error
+    import urllib.request
+
+    from armada_tpu.server.gateway import RestGateway
+
+    auth = MultiAuthenticator([BasicAuthenticator({"alice": "pw"})])
+    gw = RestGateway(_StubSubmit(), _StubEvents(), authenticator=auth)
+    try:
+        url = f"http://127.0.0.1:{gw.port}/v1/batched/queues"
+        req = urllib.request.Request(url)
+        req.add_header("x-armada-principal", "admin")  # forged
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 401
+        ok = urllib.request.Request(url)
+        cred = base64.b64encode(b"alice:pw").decode()
+        ok.add_header("Authorization", f"Basic {cred}")
+        with urllib.request.urlopen(ok, timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        gw.stop()

