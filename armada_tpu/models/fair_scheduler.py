@@ -115,6 +115,25 @@ class _Carry(NamedTuple):
     # reference's scheduledResource (queue_scheduler.go:127-137) accrues all
     # gangs, unlike sched_res which feeds the new-jobs-only round caps.
     spot_res: jax.Array  # f32[R]
+    # --- per-scheduling-key fit/score caches (see _make_place_iteration) ----
+    # The per-iteration cost of the placement loop is dominated by the [N,R]
+    # member-capacity chains; a scheduling key determines (request, priority
+    # class) exactly (core/keys.py key_of folds resources + PC into the key,
+    # like the reference's SchedulingKeyGenerator), so single-job candidates
+    # with an interned key can reuse a cached bool[N] fit row, incrementally
+    # re-derived on the <=W nodes each commit touches.  Decisions are
+    # bit-identical to the uncached path: rows/scores are exact recomputes of
+    # the same formulas, just memoized.
+    fitc_clean: jax.Array  # bool[S*N] flat: fit at the clean level 0, ok-masked
+    fitc_lvl: jax.Array  # bool[S*N] flat: fit at the key's own level, ok-masked
+    score_c: jax.Array  # f32[P1*N] flat node packing score per level
+    # Block-minima of the masked score per slot (f32[S*(N/B)] flat): the hot
+    # path's argmin runs over these [N/B] rows + one [B] block, never [N].
+    bmc_clean: jax.Array
+    bmc_lvl: jax.Array
+    cslot_key: jax.Array  # i32[S] interned key cached in each slot (-1 empty)
+    cslot_lvl: jax.Array  # i32[S]
+    cslot_req: jax.Array  # f32[S, R] node-axis request of the cached key
 
 
 # How many queue-head entries each queue can skip (retired gangs, unfeasible
@@ -151,6 +170,24 @@ def _move_runs_to_evicted(alloc, q_alloc, q_alloc_pc, p: SchedulingProblem, move
     return alloc, q_alloc, q_alloc_pc
 
 
+def _block_size(n: int) -> int:
+    """Largest power-of-two block size <= 64 dividing n (block-minima rows
+    must tile the node axis exactly)."""
+    for b in (64, 32, 16, 8, 4, 2):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def _fit_row(alloc_rows, req):
+    """bool[...]: >=1 member of `req` fits, replicating member_capacity's
+    exact arithmetic (floor-of-division then min) so cached rows are
+    bit-identical to the uncached mask."""
+    safe_req = jnp.where(req > 0, req, 1.0)
+    per = jnp.where(req > 0, jnp.floor(alloc_rows / safe_req), _INF)
+    return jnp.min(per, axis=-1) >= 1.0
+
+
 def _make_place_iteration(
     p: SchedulingProblem,
     num_levels: int,
@@ -158,15 +195,18 @@ def _make_place_iteration(
     check_keys: bool,
     prefer_large: bool = False,
     q_budget=None,
+    cache_slots: int = 0,
 ):
     """prefer_large is a STATIC flag (like check_keys): the default compile
     carries none of the alternate-ordering work.  q_budget is the per-queue
     weighted budget from the round's fair-share computation (passed in so the
-    water-filling loop is not traced twice)."""
+    water-filling loop is not traced twice).  cache_slots sizes the
+    per-scheduling-key fit cache (see _Carry; 0 compiles the uncached body)."""
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
     Q = p.q_weight.shape[0]
     RJ = p.run_req.shape[0]
+    S = cache_slots
 
     # Loop-invariant masked request tables, gathered per iteration: computing
     # req * node_axes inside the body would depend on the gathered row and
@@ -280,49 +320,174 @@ def _make_place_iteration(
         gate_queue = (hit_q_burst | hit_q_cap) & ~gate_global & any_q
         attempt = any_q & ~gate_global & ~gate_queue
 
-        # --- fit masks ----------------------------------------------------------
+        # --- fit + node selection ----------------------------------------------
+        # Three compute classes (cheapest first); all produce decisions
+        # bit-identical to the original single [N,R] path:
+        #   0. pinned evictee: only its run node can host it -- O(R).
+        #   1. cacheable single (card 1, no bans, interned key): cached
+        #      bool[N] fit rows + the maintained score table; a miss pays the
+        #      full [N,R] member-capacity chains once per (key % S) slot.
+        #   2. general (gangs, banned, keyless): the original full path.
         static_ok = jnp.where(key >= 0, p.compat[jnp.maximum(key, 0)][p.node_type], True)
-        pin_ok = jnp.where(
-            pinned >= 0, jnp.arange(N, dtype=jnp.int32) == pinned, True
-        )
-        # Retry anti-affinity: one gather into the precomputed row table
-        # (row 0 = no bans); built outside the loop so XLA hoists it.
-        banned = p.ban_mask[p.g_ban_row[g]]
-        ok_base = static_ok & p.node_ok & pin_ok & ~banned
-        alloc_clean = c.alloc[0]
-        alloc_lvl = c.alloc[level]
-        # Capacity clipped to the gang cardinality: keeps int32 sums/cumsums exact
-        # (the builder rejects cardinalities large enough to overflow N * card).
-        cap_clean = jnp.where(ok_base, jnp.minimum(member_capacity(alloc_clean, req_node), card), 0)
-        cap_lvl = jnp.where(ok_base, jnp.minimum(member_capacity(alloc_lvl, req_node), card), 0)
-        use_clean = (~is_evictee) & (jnp.sum(cap_clean) >= card)
-        cap_sel = jnp.where(use_clean, cap_clean, cap_lvl)
-        alloc_sel = jnp.where(use_clean, alloc_clean, alloc_lvl)
-        score = node_packing_score(alloc_sel, p.inv_scale)
         # Pool-level floating capacity (evictee slots already counted at init).
         float_ok = is_evictee | jnp.all(
             c.float_used + req_float_tot <= p.float_total + 1e-3
         )
-        feasible = (jnp.sum(cap_sel) >= card) & float_ok
+        empty_nodes = jnp.full((slot_width,), N, jnp.int32)
+        empty_counts = jnp.zeros((slot_width,), jnp.int32)
+        zero_row = jnp.zeros((N,), bool)
+        B = _block_size(N)
+        NB = N // B
+        zero_bm = jnp.full((NB,), _INF, jnp.float32)
 
-        def single_branch(_):
-            # Cheap path: one argmin, no sort (select_best_node semantics).
-            found, node = select_best_node(cap_sel >= 1, score)
-            nodes = jnp.full((slot_width,), N, jnp.int32).at[0].set(
-                jnp.where(found, node, N)
+        def evictee_path(_):
+            pin_safe = jnp.clip(pinned, 0, N - 1)
+            fits = (
+                _fit_row(c.alloc[level, pin_safe], req_node) & p.node_ok[pin_safe]
             )
-            counts = jnp.zeros((slot_width,), jnp.int32).at[0].set(
-                found.astype(jnp.int32)
+            nodes = empty_nodes.at[0].set(jnp.where(fits, pinned, N))
+            counts = empty_counts.at[0].set(fits.astype(jnp.int32))
+            return (
+                nodes, counts, fits, zero_row, zero_row, zero_bm, zero_bm,
+                jnp.bool_(False),
             )
-            return nodes, counts
 
-        def gang_branch(_):
-            _, nodes, counts = select_gang_nodes_compact(
-                cap_sel >= 1, cap_sel, card, score, slot_width
-            )
-            return nodes, counts
+        def cached_single_path(_):
+            slot = jnp.where(key >= 0, key, 0) % S
+            hit = c.cslot_key[slot] == key
 
-        nodes_w, counts_w = jax.lax.cond(card == 1, single_branch, gang_branch, None)
+            def pick_cached(_):
+                # Two-level exact argmin: the [NB] block-minima row names the
+                # FIRST block attaining the global min (argmin tie-break),
+                # then the first in-block index attaining it -- the global
+                # first argmin, with no [N]-length reduce on the hot path
+                # (XLA:CPU's argmin is a scalar loop, ~190us at N=51k; the
+                # [NB]+[B] pair is ~2us).
+                bm0 = jax.lax.dynamic_slice(c.bmc_clean, (slot * NB,), (NB,))
+
+                def pick_at(bm, score_off):
+                    b = jnp.argmin(bm).astype(jnp.int32)
+                    m = bm[b]
+                    found = m < _INF
+                    fit_b = jax.lax.dynamic_slice(
+                        c.fitc_clean if score_off is None else c.fitc_lvl,
+                        (slot * N + b * B,),
+                        (B,),
+                    )
+                    sc_b = jax.lax.dynamic_slice(
+                        c.score_c,
+                        ((0 if score_off is None else score_off) * N + b * B,),
+                        (B,),
+                    )
+                    masked = jnp.where(fit_b, sc_b, _INF)
+                    j = jnp.argmin(masked).astype(jnp.int32)
+                    return (b * B + j).astype(jnp.int32), found
+
+                def clean_pick(_):
+                    return pick_at(bm0, None)
+
+                def lvl_pick(_):
+                    bml = jax.lax.dynamic_slice(c.bmc_lvl, (slot * NB,), (NB,))
+                    return pick_at(bml, level)
+
+                found0 = jnp.min(bm0) < _INF
+                node, found = jax.lax.cond(found0, clean_pick, lvl_pick, None)
+                return node, found, zero_row, zero_row, zero_bm, zero_bm
+
+            def pick_fresh(_):
+                ok = static_ok & p.node_ok
+                fc_row = ok & _fit_row(c.alloc[0], req_node)
+                fl_row = ok & _fit_row(c.alloc[level], req_node)
+                score0 = jax.lax.dynamic_slice(c.score_c, (0,), (N,))
+                masked0 = jnp.where(fc_row, score0, _INF)
+                bm0 = jnp.min(masked0.reshape(NB, B), axis=1)
+                scorel = jax.lax.dynamic_slice(c.score_c, (level * N,), (N,))
+                maskedl = jnp.where(fl_row, scorel, _INF)
+                bml = jnp.min(maskedl.reshape(NB, B), axis=1)
+                node0 = jnp.argmin(masked0).astype(jnp.int32)
+                found0 = masked0[node0] < _INF
+
+                def clean_pick(_):
+                    return node0, found0
+
+                def lvl_pick(_):
+                    nodel = jnp.argmin(maskedl).astype(jnp.int32)
+                    return nodel, maskedl[nodel] < _INF
+
+                node, found = jax.lax.cond(found0, clean_pick, lvl_pick, None)
+                return node, found, fc_row, fl_row, bm0, bml
+
+            node, found, fc_row, fl_row, bm0, bml = jax.lax.cond(
+                hit, pick_cached, pick_fresh, None
+            )
+            nodes = empty_nodes.at[0].set(jnp.where(found, node, N))
+            counts = empty_counts.at[0].set(found.astype(jnp.int32))
+            return nodes, counts, found, fc_row, fl_row, bm0, bml, ~hit
+
+        def general_path(_):
+            pin_ok = jnp.where(
+                pinned >= 0, jnp.arange(N, dtype=jnp.int32) == pinned, True
+            )
+            # Retry anti-affinity: one gather into the precomputed row table
+            # (row 0 = no bans); built outside the loop so XLA hoists it.
+            banned = p.ban_mask[p.g_ban_row[g]]
+            ok_base = static_ok & p.node_ok & pin_ok & ~banned
+            alloc_clean = c.alloc[0]
+            alloc_lvl = c.alloc[level]
+            # Capacity clipped to the gang cardinality: keeps int32 sums/
+            # cumsums exact (the builder rejects cardinalities large enough
+            # to overflow N * card).
+            cap_clean = jnp.where(
+                ok_base, jnp.minimum(member_capacity(alloc_clean, req_node), card), 0
+            )
+            cap_lvl = jnp.where(
+                ok_base, jnp.minimum(member_capacity(alloc_lvl, req_node), card), 0
+            )
+            use_clean = (~is_evictee) & (jnp.sum(cap_clean) >= card)
+            cap_sel = jnp.where(use_clean, cap_clean, cap_lvl)
+            alloc_sel = jnp.where(use_clean, alloc_clean, alloc_lvl)
+            score = node_packing_score(alloc_sel, p.inv_scale)
+            fit_feasible = jnp.sum(cap_sel) >= card
+
+            def single_branch(_):
+                # Cheap path: one argmin, no sort (select_best_node semantics).
+                found, node = select_best_node(cap_sel >= 1, score)
+                nodes = empty_nodes.at[0].set(jnp.where(found, node, N))
+                counts = empty_counts.at[0].set(found.astype(jnp.int32))
+                return nodes, counts
+
+            def gang_branch(_):
+                _, nodes, counts = select_gang_nodes_compact(
+                    cap_sel >= 1, cap_sel, card, score, slot_width
+                )
+                return nodes, counts
+
+            nodes, counts = jax.lax.cond(card == 1, single_branch, gang_branch, None)
+            return (
+                nodes, counts, fit_feasible, zero_row, zero_row, zero_bm,
+                zero_bm, jnp.bool_(False),
+            )
+
+        if S > 0:
+            cacheable = (
+                (card == 1) & (~is_evictee) & (key >= 0) & (p.g_ban_row[g] == 0)
+            )
+            branch = jnp.where(is_evictee, 0, jnp.where(cacheable, 1, 2))
+            branches = [evictee_path, cached_single_path, general_path]
+        else:
+            branch = jnp.where(is_evictee, 0, 1)
+            branches = [evictee_path, general_path]
+        (
+            nodes_w,
+            counts_w,
+            fit_feasible,
+            fc_row,
+            fl_row,
+            bm0_row,
+            bml_row,
+            cache_write,
+        ) = jax.lax.switch(branch, branches, None)
+        feasible = fit_feasible & float_ok
 
         placed = attempt & feasible
         place_f = placed.astype(jnp.float32)
@@ -394,6 +559,68 @@ def _make_place_iteration(
         )
         done = ~any_q & ~advanced
 
+        # --- cache maintenance --------------------------------------------------
+        fitc_clean, fitc_lvl, score_c = c.fitc_clean, c.fitc_lvl, c.score_c
+        bmc_clean, bmc_lvl = c.bmc_clean, c.bmc_lvl
+        cslot_key, cslot_lvl, cslot_req = c.cslot_key, c.cslot_lvl, c.cslot_req
+        if S > 0:
+            # 1. write-back on a cached-path miss: the freshly computed fit
+            # rows + block-minima (pre-commit alloc) land in the key's slot;
+            # step 2 then re-derives anything this iteration's own commit
+            # touched.  (All flat leading-dim scatters: in-place.)
+            iota_n = jnp.arange(N, dtype=jnp.int32)
+            wslot = jnp.where(cache_write, jnp.where(key >= 0, key, 0) % S, S)
+            widx = wslot * N + iota_n  # >= S*N when dropped
+            fitc_clean = fitc_clean.at[widx].set(fc_row, mode="drop")
+            fitc_lvl = fitc_lvl.at[widx].set(fl_row, mode="drop")
+            bidx = wslot * NB + jnp.arange(NB, dtype=jnp.int32)
+            bmc_clean = bmc_clean.at[bidx].set(bm0_row, mode="drop")
+            bmc_lvl = bmc_lvl.at[bidx].set(bml_row, mode="drop")
+            cslot_key = cslot_key.at[wslot].set(key, mode="drop")
+            cslot_lvl = cslot_lvl.at[wslot].set(level, mode="drop")
+            cslot_req = cslot_req.at[wslot].set(req_node, mode="drop")
+            # 2. exact re-derivation at the <=slot_width nodes the commit
+            # touched (unplaced iterations recompute unchanged values: no-op).
+            tn = nodes_w  # [W], N = unused sentinel (pushed out of range below)
+            tn_safe = jnp.clip(tn, 0, N - 1)
+            a_rows = alloc[:, tn_safe, :]  # [P1, W, R]
+            sc_rows = jnp.sum(a_rows * p.inv_scale[None, None, :], axis=-1)  # [P1, W]
+            lv = jnp.arange(num_levels, dtype=jnp.int32)
+            sidx = jnp.where(
+                tn[None, :] < N, lv[:, None] * N + tn[None, :], num_levels * N
+            )
+            score_c = score_c.at[sidx].set(sc_rows, mode="drop")
+            key_s = cslot_key  # post-write-back tables: a new slot patches too
+            ok_t = (
+                p.compat[jnp.maximum(key_s, 0)][:, p.node_type[tn_safe]]  # [S, W]
+                & p.node_ok[tn_safe][None, :]
+                & (key_s >= 0)[:, None]
+            )
+            a0_t = alloc[0, tn_safe]  # [W, R]
+            al_t = alloc[cslot_lvl[:, None], tn_safe[None, :]]  # [S, W, R]
+            fit0_t = ok_t & _fit_row(a0_t[None, :, :], cslot_req[:, None, :])
+            fitl_t = ok_t & _fit_row(al_t, cslot_req[:, None, :])
+            sl = jnp.arange(S, dtype=jnp.int32)
+            fidx = jnp.where(tn[None, :] < N, sl[:, None] * N + tn[None, :], S * N)
+            fitc_clean = fitc_clean.at[fidx].set(fit0_t, mode="drop")
+            fitc_lvl = fitc_lvl.at[fidx].set(fitl_t, mode="drop")
+            # 3. block-minima of every touched (slot, block), recomputed from
+            # the PATCHED fit rows + scores: gather the whole [B] block per
+            # touched node per slot ([S, W, B] -- a few thousand elements).
+            tb = tn_safe // B  # [W] touched blocks
+            jb = jnp.arange(B, dtype=jnp.int32)
+            nblk = tb[:, None] * B + jb[None, :]  # [W, B] node ids
+            fblk_idx = sl[:, None, None] * N + nblk[None, :, :]  # [S, W, B]
+            f0_blk = fitc_clean[fblk_idx]
+            fl_blk = fitc_lvl[fblk_idx]
+            s0_blk = score_c[nblk]  # [W, B] level-0 scores
+            slvl_blk = score_c[cslot_lvl[:, None, None] * N + nblk[None, :, :]]
+            bm0_t = jnp.min(jnp.where(f0_blk, s0_blk[None, :, :], _INF), axis=-1)
+            bml_t = jnp.min(jnp.where(fl_blk, slvl_blk, _INF), axis=-1)  # [S, W]
+            bpidx = jnp.where(tn[None, :] < N, sl[:, None] * NB + tb[None, :], S * NB)
+            bmc_clean = bmc_clean.at[bpidx].set(bm0_t, mode="drop")
+            bmc_lvl = bmc_lvl.at[bpidx].set(bml_t, mode="drop")
+
         return _Carry(
             alloc=alloc,
             q_alloc=q_alloc,
@@ -417,6 +644,14 @@ def _make_place_iteration(
             termination=termination,
             spot_price=spot_price,
             spot_res=spot_res,
+            fitc_clean=fitc_clean,
+            fitc_lvl=fitc_lvl,
+            score_c=score_c,
+            bmc_clean=bmc_clean,
+            bmc_lvl=bmc_lvl,
+            cslot_key=cslot_key,
+            cslot_lvl=cslot_lvl,
+            cslot_req=cslot_req,
         )
 
     return body
@@ -493,6 +728,7 @@ def _phase_b(p: SchedulingProblem, alloc, q_alloc, q_alloc_pc, run_evicted,
     jax.jit,
     static_argnames=(
         "num_levels", "max_slots", "slot_width", "max_iterations", "prefer_large",
+        "cache_slots",
     ),
 )
 def schedule_round(
@@ -503,17 +739,22 @@ def schedule_round(
     slot_width: int,
     max_iterations: int = 0,
     prefer_large: bool = False,
+    cache_slots: int = -1,
 ) -> RoundResult:
     """Run one full scheduling round on device.
 
     num_levels = priority-ladder length + 1 (level 0 = evicted marker level).
     max_slots/slot_width size the placement record buffer (HostContext.max_slots /
     .slot_width).  max_iterations=0 derives the safe bound #gangs + #queues + 8.
+    cache_slots sizes the per-scheduling-key fit cache (-1 = derive from the
+    compat table; 0 = disable, compiling the original uncached body).
     """
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
     Q = p.q_weight.shape[0]
     C = p.pc_queue_cap.shape[0]
+    if cache_slots < 0:
+        cache_slots = min(64, p.compat.shape[0])
     if max_iterations <= 0:
         # every iteration either decides a gang (<= G), advances a cursor
         # (<= G total across the round), or is the final no-op
@@ -576,6 +817,19 @@ def schedule_round(
         termination=jnp.int32(TERM_EXHAUSTED),
         spot_price=jnp.float32(-1.0),
         spot_res=jnp.zeros((R,), jnp.float32),
+        # key-fit caches: score over the POST-eviction alloc (the loop's
+        # starting state); fit slots start empty and fill on first miss.
+        # Flat slot-major [S*N] / level-major [P1*N] layouts: row reads are
+        # contiguous dynamic slices and every update is a leading-dim scatter
+        # (in-place; 2-D axis-1 scatters copy the buffer each iteration).
+        fitc_clean=jnp.zeros((cache_slots * N,), bool),
+        fitc_lvl=jnp.zeros((cache_slots * N,), bool),
+        score_c=jnp.sum(alloc * p.inv_scale[None, None, :], axis=-1).reshape(-1),
+        bmc_clean=jnp.full((cache_slots * (N // _block_size(N)),), _INF, jnp.float32),
+        bmc_lvl=jnp.full((cache_slots * (N // _block_size(N)),), _INF, jnp.float32),
+        cslot_key=jnp.full((cache_slots,), -1, jnp.int32),
+        cslot_lvl=jnp.zeros((cache_slots,), jnp.int32),
+        cslot_req=jnp.zeros((cache_slots, R), jnp.float32),
     )
 
     q_budget = None
@@ -590,7 +844,7 @@ def schedule_round(
         )
     body = _make_place_iteration(
         p, num_levels, slot_width, check_keys=True,
-        prefer_large=prefer_large, q_budget=q_budget,
+        prefer_large=prefer_large, q_budget=q_budget, cache_slots=cache_slots,
     )
     carry = jax.lax.while_loop(
         lambda c: (~c.done) & (c.iterations < max_iterations), body, carry

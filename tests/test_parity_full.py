@@ -1,0 +1,525 @@
+"""Full-semantics placement parity: kernel vs an independent sequential
+oracle covering the phases the singleton oracle (test_parity.py) skips --
+fair-share eviction + reschedule + preemption, gang all-or-nothing
+placement, home/away level preemption with oversubscription repair, and
+market bid ordering (VERDICT round-2 "what's weak" #2; reference semantics:
+preempting_queue_scheduler.go:108-300, queue_scheduler.go:87-270,
+gang_scheduler.go:100-247, market_iterator.go:245).
+
+The oracle shares NO code with the kernel: it walks plain dicts one gang at
+a time.  Properties asserted per random world (>=20 seeds, hundreds of
+nodes): identical scheduled JOB sets, identical preempted run sets, and
+identical per-queue scheduled counts (node ids may differ only on exact
+score ties; submit times are unique to keep ordering deterministic).
+
+Eviction scenarios pin protected_fraction = 0.0 (any usage evicts every
+preemptible run -- decidable without replicating the water-filling shares)
+or leave it high (no eviction); the in-between band is covered by the
+scenario tests.
+"""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PoolConfig, PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.models import run_scheduling_round
+
+CFG = SchedulingConfig(
+    shape_bucket=32,
+    priority_classes={
+        "low": PriorityClass("low", priority=100, preemptible=True),
+        "high": PriorityClass("high", priority=1000, preemptible=False),
+    },
+    default_priority_class="high",
+    protected_fraction_of_fair_share=1e9,  # no fair-share eviction by default
+)
+F = CFG.resource_list_factory()
+RES = list(F.names)
+
+
+def cap_units(spec_res):
+    """Node capacity in the factory's floored resolution units -- the same
+    quantisation the problem builder applies (floor for capacity), which
+    keeps every score/cost a small exact dyadic in f32."""
+    return np.asarray(F.floor_units(spec_res.atoms), dtype=float)
+
+
+def req_units(spec_res):
+    """Request in ceiled resolution units (builder: ceil for requests)."""
+    return np.asarray(F.ceil_units(spec_res.atoms), dtype=float)
+
+
+# --- the oracle --------------------------------------------------------------
+
+
+class _Oracle:
+    """Sequential greedy re-implementation of the round semantics."""
+
+    def __init__(self, config, nodes, queues, jobs, running, prices=None):
+        self.config = config
+        self.market = prices is not None
+        self.prices = prices or {}
+        ladder = sorted({pc.priority for pc in config.priority_classes.values()})
+        self.level_of = {p: i + 2 for i, p in enumerate(ladder)}
+        self.num_levels = len(ladder) + 2
+        self.nodes = [n for n in nodes]
+        self.node_idx = {n.id: i for i, n in enumerate(nodes)}
+        self.total = {n.id: cap_units(n.total_resources) for n in nodes}
+        # usage[node_id][level] = summed request vectors bound at that level
+        self.usage = {
+            n.id: [np.zeros(len(RES)) for _ in range(self.num_levels)]
+            for n in nodes
+        }
+        self.queues = {q.name: q for q in queues}
+        self.qorder = sorted(self.queues)
+        self.alloc = {q.name: np.zeros(len(RES)) for q in queues}
+        self.total_pool = (
+            sum(self.total.values()) if nodes else np.zeros(len(RES))
+        )
+        scale = (
+            np.maximum.reduce([self.total[n.id] for n in nodes])
+            if nodes
+            else np.ones(len(RES))
+        )
+        # same arithmetic as the problem builder: f64 reciprocal, cast f32
+        scale32 = scale.astype(np.float32)
+        self.inv_scale32 = np.where(
+            scale32 > 0, 1.0 / np.maximum(scale32, 1e-9), 0.0
+        ).astype(np.float32)
+        self.drf32 = np.array(
+            [
+                1.0 if name in config.dominant_resource_fairness_resources else 0.0
+                for name in RES
+            ],
+            np.float32,
+        )
+        self.jobs = list(jobs)
+        self.running = list(running)
+        for r in running:
+            lvl = self._run_level(r)
+            self.usage[r.node_id][lvl] += req_units(r.job.resources)
+            self.alloc[r.job.queue] += req_units(r.job.resources)
+
+    def _run_level(self, r: RunningJob) -> int:
+        if r.away:
+            return 1
+        pc = self.config.priority_class(r.job.priority_class)
+        return self.level_of[pc.priority]
+
+    def _allocatable(self, nid: str, level: int) -> np.ndarray:
+        u = self.usage[nid]
+        return self.total[nid] - sum(u[lv] for lv in range(level, self.num_levels))
+
+    def _cost(self, qname: str, extra: np.ndarray) -> float:
+        # float32 like the kernel: scores/costs are the only inexact
+        # quantities, and x64 would break near-ties the other way (the
+        # integral capacity/fit arithmetic is exact in either precision).
+        alloc32 = (self.alloc[qname] + extra).astype(np.float32)
+        total32 = self.total_pool.astype(np.float32)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(
+                total32 > 0, alloc32 / np.maximum(total32, np.float32(1e-9)), 0.0
+            ).astype(np.float32)
+        cost = np.float32(max(np.float32(0.0), (frac * self.drf32).max()))
+        return float(cost / np.float32(self.queues[qname].weight))
+
+    def _score(self, nid: str, level: int) -> float:
+        free32 = self._allocatable(nid, level).astype(np.float32)
+        return float((free32 * self.inv_scale32).sum(dtype=np.float32))
+
+    def run(self):
+        cfg = self.config
+        # --- phase A: fair-share eviction (pqs.go:117-160) -------------------
+        evicted = []  # list of (RunningJob, level)
+        for r in self.running:
+            pc = cfg.priority_class(r.job.priority_class)
+            preemptible = True if r.away else pc.preemptible
+            over = (
+                self._cost(r.job.queue, np.zeros(len(RES))) > 0
+                and cfg.protected_fraction_of_fair_share <= 0.0
+            )
+            if preemptible and over:
+                lvl = self._run_level(r)
+                req = req_units(r.job.resources)
+                self.usage[r.node_id][lvl] -= req
+                self.usage[r.node_id][0] += req  # evicted marker
+                self.alloc[r.job.queue] -= req
+                evicted.append((r, lvl))
+
+        # --- candidate streams per queue -------------------------------------
+        def qkey(j):
+            pc = cfg.priority_class(j.priority_class)
+            return (-pc.priority, j.priority, j.submit_time, j.id)
+
+        # gangs group into one unit (uniform members; lead = sort-first)
+        by_gang, singles = {}, []
+        for j in self.jobs:
+            if j.gang_id:
+                by_gang.setdefault((j.queue, j.gang_id), []).append(j)
+            else:
+                singles.append(j)
+        units = []
+        for members in by_gang.values():
+            members.sort(key=qkey)
+            units.append((members[0], members))
+        for j in singles:
+            units.append((j, [j]))
+        per_queue = {q: [] for q in self.queues}
+        for lead, members in units:
+            per_queue[lead.queue].append((qkey(lead), "new", lead, members))
+        for r, lvl in evicted:
+            pc = cfg.priority_class(r.job.priority_class)
+            ladder_prio = (
+                sorted({p.priority for p in cfg.priority_classes.values()})[
+                    max(lvl - 2, 0)
+                ]
+            )
+            per_queue[r.job.queue].append(
+                (
+                    (-ladder_prio, r.job.priority, r.job.submit_time, r.job.id),
+                    "evictee",
+                    r,
+                    lvl,
+                )
+            )
+        for q in per_queue:
+            # evictees precede queued units of the same queue (incremental.py
+            # gq layout); both sub-streams sort by their own keys
+            ev = sorted([e for e in per_queue[q] if e[1] == "evictee"])
+            new = sorted([e for e in per_queue[q] if e[1] == "new"])
+            per_queue[q] = ev + new
+        heads = {q: 0 for q in self.queues}
+
+        scheduled = {}
+        rescheduled = set()
+        dead_keys = set()
+        sched_members = 0
+        burst = cfg.maximum_scheduling_burst
+        perq_burst = cfg.maximum_per_queue_scheduling_burst
+        q_sched = {q: 0 for q in self.queues}
+        q_blocked = set()
+        new_blocked = False
+
+        def job_key(j):
+            pc = cfg.priority_class(j.priority_class)
+            return (
+                tuple(req_units(j.resources)),
+                tuple(sorted(j.node_selector.items())),
+                pc.name,
+            )
+
+        def fit_nodes(req, level, card, clean):
+            """(feasible, [(node_id, count)]): best-fit spread at `level`
+            against clean (level-0) or urgency allocatable."""
+            fit_level = 0 if clean else level
+            caps = []
+            for n in self.nodes:
+                free = self._allocatable(n.id, fit_level)
+                if np.all(free >= req):
+                    per = int(
+                        min(
+                            np.floor(free[r] / req[r])
+                            for r in range(len(RES))
+                            if req[r] > 0
+                        )
+                        if np.any(req > 0)
+                        else card
+                    )
+                    caps.append((self._score(n.id, fit_level), self.node_idx[n.id], n.id, min(per, card)))
+            if sum(c[3] for c in caps) < card:
+                return False, []
+            caps.sort()
+            out, left = [], card
+            for _, _, nid, per in caps:
+                take = min(per, left)
+                out.append((nid, take))
+                left -= take
+                if left == 0:
+                    break
+            return True, out
+
+        while True:
+            candidates = []
+            for q in self.qorder:
+                lst = per_queue[q]
+                while heads[q] < len(lst):
+                    entry = lst[heads[q]]
+                    if entry[1] == "new" and job_key(entry[2]) in dead_keys:
+                        heads[q] += 1
+                        continue
+                    break
+                if heads[q] >= len(lst):
+                    continue
+                entry = lst[heads[q]]
+                if entry[1] == "new" and (new_blocked or q in q_blocked):
+                    continue
+                if entry[1] == "evictee":
+                    req_tot = req_units(entry[2].job.resources)
+                    price = self.prices.get(q, 0.0)
+                else:
+                    req_tot = req_units(entry[2].resources) * len(entry[3])
+                    price = self.prices.get(q, 0.0)
+                order = -price if self.market else self._cost(q, req_tot)
+                candidates.append((order, q, entry))
+            if not candidates:
+                break
+            candidates.sort(key=lambda c: (c[0], c[1]))
+            _, q, entry = candidates[0]
+
+            if entry[1] == "evictee":
+                _, _, r, lvl = entry
+                req = req_units(r.job.resources)
+                free = self._allocatable(r.node_id, lvl)
+                if np.all(free >= req):
+                    self.usage[r.node_id][0] -= req
+                    self.usage[r.node_id][lvl] += req
+                    self.alloc[q] += req
+                    rescheduled.add(r.job.id)
+                heads[q] += 1
+                continue
+
+            _, _, lead, members = entry
+            card = len(members)
+            req = req_units(lead.resources)
+            # constraint gates (new jobs only)
+            if sched_members + card > burst:
+                new_blocked = True
+                continue
+            if q_sched[q] + card > perq_burst:
+                q_blocked.add(q)
+                continue
+            pc = cfg.priority_class(lead.priority_class)
+            level = self.level_of[pc.priority]
+            feasible, spread = fit_nodes(req, level, card, clean=True)
+            if not feasible:
+                feasible, spread = fit_nodes(req, level, card, clean=False)
+            if not feasible:
+                if card == 1:
+                    dead_keys.add(job_key(lead))
+                heads[q] += 1
+                continue
+            mi = 0
+            for nid, count in spread:
+                for _ in range(count):
+                    scheduled[members[mi].id] = nid
+                    mi += 1
+                self.usage[nid][level] += req * count
+            self.alloc[q] += req * card
+            sched_members += card
+            q_sched[q] += card
+            heads[q] += 1
+
+        # --- phase B: oversubscription repair (eviction.go:130-180) ----------
+        # The kernel flags every oversubscribed run from ONE snapshot of the
+        # post-placement state and evicts them simultaneously; a sequential
+        # walk would stop evicting once the first eviction clears the node.
+        phase_a_ids = {e[0].job.id for e in evicted}
+        flagged = []
+        for r in self.running:
+            if r.job.id in phase_a_ids and r.job.id not in rescheduled:
+                continue  # no slot held: already evicted and not back
+            pc = cfg.priority_class(r.job.priority_class)
+            preemptible = True if r.away else pc.preemptible
+            if not preemptible:
+                continue
+            lvl = self._run_level(r)
+            if np.any(self._allocatable(r.node_id, lvl) < 0):
+                flagged.append((r, lvl))
+        over_evicted = []
+        for r, lvl in flagged:
+            req = req_units(r.job.resources)
+            self.usage[r.node_id][lvl] -= req
+            self.usage[r.node_id][0] += req
+            self.alloc[r.job.queue] -= req
+            rescheduled.discard(r.job.id)
+            over_evicted.append((r, lvl))
+        # pinned re-schedule fixed point (pqs.go:222-247): per iteration each
+        # node admits its (cost, run-table-order) minimal fitting evictee --
+        # the kernel breaks cost ties by run row index, whose table sorts on
+        # (queue, evictee priority, job priority, submit, id).
+        qidx = {q: i for i, q in enumerate(self.qorder)}
+        ladder = sorted({p.priority for p in cfg.priority_classes.values()})
+
+        def run_order(r, lvl):
+            return (
+                qidx[r.job.queue],
+                -ladder[max(lvl - 2, 0)],
+                r.job.priority,
+                r.job.submit_time,
+                r.job.id,
+            )
+
+        pending = list(over_evicted)
+        progress = True
+        while pending and progress:
+            progress = False
+            by_node = {}
+            for r, lvl in pending:
+                req = req_units(r.job.resources)
+                if np.all(self._allocatable(r.node_id, lvl) >= req):
+                    cand = (self._cost(r.job.queue, req), run_order(r, lvl), r, lvl)
+                    cur = by_node.get(r.node_id)
+                    if cur is None or cand[:2] < cur[:2]:
+                        by_node[r.node_id] = cand
+            for _, _, r, lvl in by_node.values():
+                req = req_units(r.job.resources)
+                self.usage[r.node_id][0] -= req
+                self.usage[r.node_id][lvl] += req
+                self.alloc[r.job.queue] += req
+                rescheduled.add(r.job.id)
+                pending = [(p, pl) for p, pl in pending if p.job.id != r.job.id]
+                progress = True
+
+        preempted = set()
+        for r, _ in evicted + over_evicted:
+            if r.job.id not in rescheduled:
+                preempted.add(r.job.id)
+        return scheduled, preempted, rescheduled
+
+
+# --- worlds ------------------------------------------------------------------
+
+
+def world(seed, num_nodes=200, num_jobs=300, num_queues=5, gangs=6,
+          num_running=40, away_frac=0.0):
+    rng = np.random.default_rng(seed)
+    nodes = [
+        NodeSpec(
+            id=f"n{i:04d}",
+            pool="default",
+            total_resources=F.from_mapping(
+                {"cpu": int(rng.choice([8, 16, 32])), "memory": int(rng.choice([32, 64]))}
+            ),
+        )
+        for i in range(num_nodes)
+    ]
+    queues = [Queue(f"q{i}", float(rng.choice([1.0, 2.0]))) for i in range(num_queues)]
+    jobs = []
+    t = 0.0
+    for i in range(num_jobs):
+        t += 0.001 + float(rng.random()) * 0.01  # unique submit times
+        jobs.append(
+            JobSpec(
+                id=f"j{i:05d}",
+                queue=f"q{int(rng.integers(num_queues))}",
+                priority_class=str(rng.choice(["low", "high"])),
+                submit_time=t,
+                resources=F.from_mapping(
+                    {"cpu": int(rng.choice([1, 2, 4])), "memory": int(rng.choice([2, 4]))}
+                ),
+            )
+        )
+    for g in range(gangs):
+        t += 0.01
+        card = int(rng.choice([2, 3, 4]))
+        for m in range(card):
+            jobs.append(
+                JobSpec(
+                    id=f"g{g}m{m}",
+                    queue=f"q{int(rng.integers(num_queues))}"
+                    if False
+                    else f"q{g % num_queues}",
+                    priority_class="high",
+                    submit_time=t,
+                    resources=F.from_mapping({"cpu": 2, "memory": 2}),
+                    gang_id=f"gang{g}",
+                    gang_cardinality=card,
+                )
+            )
+    running = []
+    for i in range(num_running):
+        t += 0.01
+        away = bool(rng.random() < away_frac)
+        running.append(
+            RunningJob(
+                job=JobSpec(
+                    id=f"r{i:04d}",
+                    queue=f"q{int(rng.integers(num_queues))}",
+                    priority_class="low" if (away or rng.random() < 0.7) else "high",
+                    submit_time=-100.0 + t,
+                    resources=F.from_mapping({"cpu": 2, "memory": 2}),
+                ),
+                node_id=f"n{int(rng.integers(num_nodes)):04d}",
+                away=away,
+            )
+        )
+    return nodes, queues, jobs, running
+
+
+def _compare(cfg, nodes, queues, jobs, running, prices=None, seed=None):
+    oracle = _Oracle(cfg, nodes, queues, jobs, running, prices=prices)
+    o_sched, o_preempted, _ = oracle.run()
+    bid = None
+    if prices is not None:
+        bid = lambda job: prices.get(job.queue, 0.0)  # noqa: E731
+    outcome = run_scheduling_round(
+        cfg, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs,
+        running=running, bid_price_of=bid, collect_stats=False,
+    )
+    label = f"seed {seed}"
+    assert set(outcome.scheduled) == set(o_sched), (
+        f"{label}: kernel-only={set(outcome.scheduled) - set(o_sched)} "
+        f"oracle-only={set(o_sched) - set(outcome.scheduled)}"
+    )
+    assert sorted(outcome.preempted) == sorted(o_preempted), (
+        f"{label}: kernel={sorted(outcome.preempted)} oracle={sorted(o_preempted)}"
+    )
+    jq = {j.id: j.queue for j in jobs}
+    def by_queue(ids):
+        out = {}
+        for jid in ids:
+            out[jq[jid]] = out.get(jq[jid], 0) + 1
+        return out
+    assert by_queue(outcome.scheduled) == by_queue(o_sched), label
+    return outcome
+
+
+@pytest.mark.parametrize("seed", list(range(1, 21)))
+def test_gangs_and_runs_without_eviction(seed):
+    """Gangs + running jobs + mixed PCs at hundreds of nodes: scheduled-set
+    and per-queue-count parity with the independent oracle."""
+    nodes, queues, jobs, running = world(seed)
+    _compare(CFG, nodes, queues, jobs, running, seed=seed)
+
+
+@pytest.mark.parametrize("seed", [2, 5, 9, 13, 17, 23, 31, 41])
+def test_fair_share_eviction_and_preemption(seed):
+    """protected_fraction=0: every preemptible run evicts; each either
+    reschedules (usually onto its pinned node) or is preempted."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, protected_fraction_of_fair_share=0.0)
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=120, num_jobs=150, num_running=60, gangs=0
+    )
+    outcome = _compare(cfg, nodes, queues, jobs, running, seed=seed)
+    # sanity: the scenario actually exercises eviction machinery
+    assert outcome.rescheduled or outcome.preempted
+
+
+@pytest.mark.parametrize("seed", [3, 7, 11, 19])
+def test_away_runs_preempted_by_home_jobs(seed):
+    """Away runs (level 1) are urgency-preempted when home jobs need the
+    capacity; the repair pass preempts what cannot re-fit."""
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=60, num_jobs=400, num_running=80, gangs=0,
+        away_frac=1.0,
+    )
+    _compare(CFG, nodes, queues, jobs, running, seed=seed)
+
+
+@pytest.mark.parametrize("seed", [4, 8, 15, 16])
+def test_market_bid_ordering(seed):
+    """Market pools order queues by bid price, not DRF cost."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, pools=(PoolConfig("default", market_driven=True),)
+    )
+    rng = np.random.default_rng(seed)
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=40, num_jobs=200, num_running=0, gangs=0
+    )
+    prices = {q.name: float(rng.integers(1, 10)) for q in queues}
+    _compare(cfg, nodes, queues, jobs, running, prices=prices, seed=seed)
