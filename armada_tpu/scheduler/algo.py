@@ -303,7 +303,21 @@ class FairSchedulingAlgo:
         bid_price_of = None
         if self.bid_prices is not None:
             provider = self.bid_prices
-            bid_price_of = lambda job: provider.price(job.queue, job.price_band)  # noqa: E731
+            # Providers may scope bids per pool (pkg/bidstore keys prices by
+            # pool; external_providers.BidPriceServiceClient takes pool=);
+            # static in-process providers ignore the extra argument.
+            import inspect
+
+            takes_pool = "pool" in inspect.signature(provider.price).parameters
+
+            def _pool_pricer(pool: str):
+                if takes_pool:
+                    return lambda job: provider.price(
+                        job.queue, job.price_band, pool
+                    )
+                return lambda job: provider.price(job.queue, job.price_band)
+
+            bid_price_of = _pool_pricer("")
 
         def pool_queues(pool: str) -> list:
             if self.priority_overrides is None:
@@ -336,6 +350,7 @@ class FairSchedulingAlgo:
             pool_nodes = [n for n in nodes if n.pool == pool]
             if not pool_nodes:
                 continue
+            bid_price_of = _pool_pricer(pool) if self.bid_prices is not None else None
             if incremental and pool not in market_pools:
                 b = self.feed.builder_for(pool, txn)
                 b.set_queues(pool_queues(pool))
@@ -472,7 +487,11 @@ class FairSchedulingAlgo:
                         else host_running(host)
                     ),
                     collect_stats=False,
-                    bid_price_of=bid_price_of,
+                    bid_price_of=(
+                        _pool_pricer(host)
+                        if self.bid_prices is not None
+                        else None
+                    ),
                     away_mode=True,
                     global_tokens=g_tokens,
                     queue_tokens=q_tokens,

@@ -1,0 +1,258 @@
+"""External gRPC provider services: market bid prices + priority overrides.
+
+The reference's scheduler can consume two OPTIONAL external gRPC services --
+bid prices per (queue, price band) for market-driven pools
+(internal/scheduler/pricing/bid_price.go + client.go; pkg/bidstore protos)
+and per-(pool, queue) fair-share weight overrides
+(internal/scheduler/priorityoverride/service_provider.go;
+pkg/priorityoverride).  Both follow the same shape: poll the service on an
+interval, cache the last good answer atomically, and keep scheduling from
+the cache when the API is down (ServiceProvider.Run / fetchOverrides).
+
+This module provides BOTH halves:
+
+  * polling clients implementing the in-process provider protocols
+    (scheduler/providers.py BidPriceProvider / PriorityOverrideProvider),
+    drop-in for FairSchedulingAlgo's `bid_prices=` / `priority_overrides=`;
+  * a host for provider processes (`serve_providers`) so an operator --
+    or a test -- can run a price/override source the plane polls.
+
+Wire messages: rpc.proto BidPricesResponse / PriorityOverridesResponse.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping, Optional
+
+import grpc
+
+from armada_tpu.rpc import rpc_pb2 as pb
+
+_BID_METHOD = "/armada_tpu.api.BidPriceService/GetBidPrices"
+_OVERRIDE_METHOD = "/armada_tpu.api.PriorityOverrideService/GetPriorityOverrides"
+
+
+class ProviderNotReady(Exception):
+    """No successful fetch yet (ServiceProvider.Ready() == false).
+
+    Raised by refresh_or_raise() for callers that want startup to block on a
+    live provider; the read paths (price()/override()) never raise -- a
+    never-answered provider serves "no data" (0 bids / no overrides), so a
+    down optional service cannot crash the scheduling cycle."""
+
+
+class _PollingClient:
+    """Poll `fetch` every interval; keep the last good snapshot atomically.
+
+    A fetch failure logs-and-keeps-serving the stale cache, exactly the
+    reference's "cache the overrides in memory so that we can continue
+    scheduling even if the API is unavailable"."""
+
+    def __init__(
+        self,
+        address: str,
+        method: str,
+        response_cls,
+        poll_interval_s: float = 30.0,
+        channel: Optional[grpc.Channel] = None,
+        timeout_s: float = 10.0,
+    ):
+        self._channel = channel or grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            method,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_cls.FromString,
+        )
+        self._interval = poll_interval_s
+        self._timeout = timeout_s
+        self._snapshot = None  # immutable dict, swapped atomically
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_error: Optional[str] = None
+
+    def _decode(self, resp) -> Mapping:
+        raise NotImplementedError
+
+    def _request(self):
+        raise NotImplementedError
+
+    def refresh(self) -> bool:
+        """One fetch; returns True on success.  Called by the poll loop and
+        available to tests/cycle hooks for deterministic refreshes."""
+        try:
+            resp = self._call(self._request(), timeout=self._timeout)
+        except grpc.RpcError as e:
+            self.last_error = f"{e.code().name}: {e.details()}"
+            return False
+        self._snapshot = self._decode(resp)
+        self.last_error = None
+        return True
+
+    def start(self) -> "_PollingClient":
+        """Fetch once now, then poll in the background."""
+        self.refresh()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.refresh()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._channel.close()
+
+    def ready(self) -> bool:
+        return self._snapshot is not None
+
+    def refresh_or_raise(self) -> None:
+        """One fetch, erroring if the provider has still never answered --
+        for deployments that want startup to block on provider readiness."""
+        if not self.refresh() and self._snapshot is None:
+            raise ProviderNotReady(self.last_error or "provider unreachable")
+
+
+class BidPriceServiceClient(_PollingClient):
+    """BidPriceProvider backed by a remote BidPriceService
+    (pricing/bid_price.go BidPriceProvider + client.go)."""
+
+    def __init__(self, address: str, **kw):
+        super().__init__(address, _BID_METHOD, pb.BidPricesResponse, **kw)
+
+    def _request(self):
+        return pb.BidPricesRequest()
+
+    def _decode(self, resp) -> Mapping:
+        prices = {}
+        for q in resp.queues:
+            for b in q.bids:
+                prices[(q.queue, b.band, b.pool)] = float(b.price)
+        return prices
+
+    def price(self, queue: str, band: str, pool: str = "") -> float:
+        """Most specific match wins: (queue, band, pool) > (queue, band, any
+        pool) > (queue, default band).  0 = no bid (never scheduled by a
+        market pool, market_iterator.go); a never-answered provider bids 0
+        for everyone rather than crashing the cycle."""
+        snap = self._snapshot
+        if snap is None:
+            return 0.0
+        for k in (
+            (queue, band, pool),
+            (queue, band, ""),
+            (queue, "", pool),
+            (queue, "", ""),
+        ):
+            if k in snap:
+                return snap[k]
+        return 0.0
+
+
+class PriorityOverrideServiceClient(_PollingClient):
+    """PriorityOverrideProvider backed by a remote PriorityOverrideService
+    (priorityoverride/service_provider.go)."""
+
+    def __init__(self, address: str, **kw):
+        super().__init__(
+            address, _OVERRIDE_METHOD, pb.PriorityOverridesResponse, **kw
+        )
+
+    def _request(self):
+        return pb.PriorityOverridesRequest()
+
+    def _decode(self, resp) -> Mapping:
+        return {
+            (o.pool, o.queue): float(o.priority) for o in resp.overrides
+        }
+
+    def override(self, pool: str, queue: str) -> Optional[float]:
+        """None = no override.  A never-answered provider overrides nothing
+        (the reference's Override() errors when unready, but its scheduler
+        only consumes overrides once Ready(); here the read path is simply
+        empty until the first successful fetch -- a down optional service
+        must not fail cycles)."""
+        snap = self._snapshot
+        if snap is None:
+            return None
+        return snap.get((pool, queue))
+
+
+# ------------------------------------------------------------- the host ----
+
+
+def serve_providers(
+    bid_prices: Optional[Callable[[], Mapping]] = None,
+    priority_overrides: Optional[Callable[[], Mapping]] = None,
+    address: str = "127.0.0.1:0",
+) -> tuple[grpc.Server, int]:
+    """Host BidPriceService / PriorityOverrideService from live sources.
+
+    bid_prices() -> {(queue, band, pool) | (queue, band): price}
+    priority_overrides() -> {(pool, queue): weight}
+
+    Sources are called per request, so a mutable dict the operator updates
+    becomes visible to the scheduler on its next poll -- which is what the
+    e2e test exercises (prices change mid-run, the next cycle reorders).
+    """
+    from concurrent import futures
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    handlers = []
+    if bid_prices is not None:
+
+        def get_bids(request, context):
+            by_queue: dict[str, list] = {}
+            for k, price in bid_prices().items():
+                queue, band, pool = (k if len(k) == 3 else (*k, ""))
+                by_queue.setdefault(queue, []).append(
+                    pb.PriceBandBid(band=band, pool=pool, price=float(price))
+                )
+            return pb.BidPricesResponse(
+                queues=[
+                    pb.QueueBids(queue=q, bids=bids)
+                    for q, bids in sorted(by_queue.items())
+                ]
+            )
+
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.BidPriceService",
+                {
+                    "GetBidPrices": grpc.unary_unary_rpc_method_handler(
+                        get_bids,
+                        request_deserializer=pb.BidPricesRequest.FromString,
+                        response_serializer=lambda m: m.SerializeToString(),
+                    )
+                },
+            )
+        )
+    if priority_overrides is not None:
+
+        def get_overrides(request, context):
+            return pb.PriorityOverridesResponse(
+                overrides=[
+                    pb.PriorityOverride(pool=pool, queue=queue, priority=float(w))
+                    for (pool, queue), w in sorted(priority_overrides().items())
+                ]
+            )
+
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.PriorityOverrideService",
+                {
+                    "GetPriorityOverrides": grpc.unary_unary_rpc_method_handler(
+                        get_overrides,
+                        request_deserializer=pb.PriorityOverridesRequest.FromString,
+                        response_serializer=lambda m: m.SerializeToString(),
+                    )
+                },
+            )
+        )
+    server.add_generic_rpc_handlers(tuple(handlers))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port

@@ -1,0 +1,182 @@
+"""External provider services (pricing/bid_price.go + client.go,
+priorityoverride/service_provider.go parity): polling gRPC clients with
+atomic stale-tolerant caches, a provider host, and the e2e property that a
+provider changing prices mid-run reorders the next cycle."""
+
+import pytest
+
+from armada_tpu.core.config import PoolConfig, SchedulingConfig
+from armada_tpu.core.types import NodeSpec, JobSpec, Queue
+from armada_tpu.scheduler.external_providers import (
+    BidPriceServiceClient,
+    PriorityOverrideServiceClient,
+    ProviderNotReady,
+    serve_providers,
+)
+
+CFG = SchedulingConfig(shape_bucket=32)
+F = CFG.resource_list_factory()
+
+
+def _node(nid, cpu="8"):
+    return NodeSpec(
+        id=nid, pool="default",
+        total_resources=F.from_mapping({"cpu": cpu, "memory": "32"}),
+    )
+
+
+def _job(jid, queue, cpu="8", band=""):
+    return JobSpec(
+        id=jid, queue=queue,
+        resources=F.from_mapping({"cpu": cpu, "memory": "1"}),
+        price_band=band,
+    )
+
+
+def test_bid_price_client_specificity_and_staleness():
+    prices = {("qa", "", ""): 5.0, ("qa", "gold", ""): 9.0, ("qb", "", "poolx"): 2.0}
+    server, port = serve_providers(bid_prices=lambda: prices)
+    client = BidPriceServiceClient(f"127.0.0.1:{port}", poll_interval_s=3600)
+    try:
+        assert client.refresh()
+        assert client.ready()
+        assert client.price("qa", "") == 5.0
+        assert client.price("qa", "gold") == 9.0  # band-specific beats default
+        assert client.price("qa", "silver") == 5.0  # unknown band -> default
+        assert client.price("qb", "", pool="poolx") == 2.0
+        assert client.price("qc", "") == 0.0  # no bid at all
+        # source changes become visible on the next poll
+        prices[("qa", "", "")] = 1.25
+        assert client.refresh()
+        assert client.price("qa", "") == 1.25
+        # service goes away: refresh fails but the cache keeps serving
+        server.stop(None).wait()
+        assert not client.refresh()
+        assert client.last_error
+        assert client.price("qa", "") == 1.25
+    finally:
+        client.stop()
+
+
+def test_override_client_and_not_ready():
+    overrides = {("default", "qb"): 10.0}
+    server, port = serve_providers(priority_overrides=lambda: overrides)
+    client = PriorityOverrideServiceClient(f"127.0.0.1:{port}", poll_interval_s=3600)
+    try:
+        # never fetched: the read path serves "no data" -- a down OPTIONAL
+        # service must not crash scheduling cycles (round-3 review finding)
+        assert client.override("default", "qb") is None
+        assert not client.ready()
+        assert client.refresh()
+        assert client.override("default", "qb") == 10.0
+        assert client.override("default", "qa") is None
+    finally:
+        client.stop()
+        server.stop(None)
+
+    dead = BidPriceServiceClient("127.0.0.1:1", poll_interval_s=3600)
+    try:
+        assert not dead.refresh()
+        assert dead.price("qa", "") == 0.0  # no bids, not a crash
+        with pytest.raises(ProviderNotReady):
+            dead.refresh_or_raise()  # blocking-startup variant DOES raise
+    finally:
+        dead.stop()
+
+
+def test_price_change_mid_run_reorders_next_cycle():
+    """The verdict's done-criterion: a provider process changes prices and
+    the scheduler's next cycle orders queues differently."""
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.scheduler.algo import FairSchedulingAlgo
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        pools=(PoolConfig("default", market_driven=True),),
+    )
+    # POOL-scoped bids: proves the algo passes the pool through to price()
+    # (round-3 review finding: pool-keyed bids were unreachable)
+    prices = {("qa", "", "default"): 10.0, ("qb", "", "default"): 1.0}
+    server, port = serve_providers(bid_prices=lambda: prices)
+    client = BidPriceServiceClient(f"127.0.0.1:{port}", poll_interval_s=3600)
+    assert client.refresh()
+
+    def cycle():
+        jobdb = JobDb(cfg)
+        with jobdb.write_txn() as txn:
+            txn.upsert(Job(spec=_job("j-a", "qa"), validated=True, pools=("default",)))
+            txn.upsert(Job(spec=_job("j-b", "qb"), validated=True, pools=("default",)))
+            algo = FairSchedulingAlgo(
+                cfg,
+                queues=lambda: [Queue("qa"), Queue("qb")],
+                clock_ns=lambda: 10**15,
+                bid_prices=client,
+            )
+            snap = ExecutorSnapshot(
+                id="ex1", pool="default", nodes=(_node("n0"),),
+                last_update_ns=10**15,
+            )
+            return algo.schedule(txn, [snap], now_ns=10**15)
+
+    try:
+        # one 8cpu node, two 8cpu jobs: the higher bid wins the capacity
+        first = cycle().pools[0].outcome.scheduled
+        assert set(first) == {"j-a"}
+        # the provider's prices flip; the scheduler's next poll reorders
+        prices[("qa", "", "default")] = 1.0
+        prices[("qb", "", "default")] = 10.0
+        assert client.refresh()
+        second = cycle().pools[0].outcome.scheduled
+        assert set(second) == {"j-b"}
+    finally:
+        client.stop()
+        server.stop(None)
+
+
+def test_priority_override_changes_fair_shares():
+    """Override weights flow into the round's queue weights
+    (scheduling_algo.go Schedule -> priorityoverride Provider.Override)."""
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.scheduler.algo import FairSchedulingAlgo
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+    overrides = {}
+    server, port = serve_providers(priority_overrides=lambda: overrides)
+    client = PriorityOverrideServiceClient(f"127.0.0.1:{port}", poll_interval_s=3600)
+    assert client.refresh()
+
+    def cycle():
+        jobdb = JobDb(CFG)
+        with jobdb.write_txn() as txn:
+            for i in range(4):
+                txn.upsert(Job(spec=_job(f"a{i}", "qa", cpu="4"), validated=True))
+                txn.upsert(Job(spec=_job(f"b{i}", "qb", cpu="4"), validated=True))
+            algo = FairSchedulingAlgo(
+                CFG,
+                queues=lambda: [Queue("qa"), Queue("qb")],
+                clock_ns=lambda: 10**15,
+                priority_overrides=client,
+            )
+            snap = ExecutorSnapshot(
+                id="ex1", pool="default", nodes=(_node("n0", cpu="8"),),
+                last_update_ns=10**15,
+            )
+            return algo.schedule(txn, [snap], now_ns=10**15)
+
+    try:
+        # equal weights: one 4cpu job each
+        first = cycle().pools[0].outcome.scheduled
+        assert len([j for j in first if j.startswith("a")]) == 1
+        assert len([j for j in first if j.startswith("b")]) == 1
+        # qb's weight overridden sky-high: it takes the whole node
+        overrides[("default", "qb")] = 100.0
+        assert client.refresh()
+        second = cycle().pools[0].outcome.scheduled
+        assert len([j for j in second if j.startswith("b")]) == 2
+        assert not [j for j in second if j.startswith("a")]
+    finally:
+        client.stop()
+        server.stop(None)
