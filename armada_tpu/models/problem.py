@@ -1233,15 +1233,108 @@ def queue_stats_from_result(result, problem: SchedulingProblem, ctx: HostContext
     return out
 
 
+# Caps for the packed single-transfer decode (decode_result fast path); a
+# round whose failed/evicted counts exceed them falls back to the full pull.
+# Module-level so tests can shrink them to force the fallback.
+_COMPACT_FCAP = 8192
+_COMPACT_ECAP = 8192
+
+
+def _fetch_compact(result, ctx: HostContext):
+    """Pull the O(decisions) decode inputs in ONE device->host transfer.
+
+    Returns (n_slots, slot_gang, slot_nodes, slot_counts, g2, pre_idx,
+    res_idx, state_of, iterations, termination, spot) or None when a cap
+    overflowed (fall back to the full-array pull) or the result is not a
+    device RoundResult.
+    """
+    import jax
+
+    from armada_tpu.models.fair_scheduler import _COMPACT_HEADER, compact_result
+
+    if not isinstance(result.g_state, jax.Array):
+        return None
+    G = int(result.g_state.shape[0])
+    RJ = int(result.run_evicted.shape[0])
+    fcap = min(G, _COMPACT_FCAP)
+    ecap = min(RJ, _COMPACT_ECAP) if RJ else 0
+    buf = np.asarray(
+        compact_result(
+            result,
+            np.int32(ctx.num_real_gangs),
+            np.int32(ctx.num_real_runs),
+            fcap=fcap,
+            ecap=ecap,
+        )
+    )
+    n_slots, iterations, termination, _sched_count, spot_bits, n_failed, n_pre, n_res = (
+        int(v) for v in buf[:_COMPACT_HEADER]
+    )
+    if n_failed > fcap or n_pre > ecap or n_res > ecap:
+        return None
+    spot = float(np.int32(spot_bits).view(np.float32))
+    S, W = ctx.max_slots, ctx.slot_width
+    off = _COMPACT_HEADER
+    slot_gang = buf[off : off + S]
+    off += S
+    slot_nodes = buf[off : off + S * W].reshape(S, W)
+    off += S * W
+    slot_counts = buf[off : off + S * W].reshape(S, W)
+    off += S * W
+    g2 = buf[off : off + n_failed]
+    off += fcap
+    pre_idx = buf[off : off + n_pre]
+    off += ecap
+    res_idx = buf[off : off + n_res]
+
+    sched_set = set(int(g) for g in slot_gang[:n_slots])
+    failed_set = set(int(g) for g in g2)
+
+    def state_of(gi: int) -> int:
+        if gi in sched_set:
+            return 1
+        return 2 if gi in failed_set else 0
+
+    return (
+        n_slots, slot_gang, slot_nodes, slot_counts, g2, pre_idx, res_idx,
+        state_of, iterations, termination, spot,
+    )
+
+
 def decode_result(result, ctx: HostContext) -> RoundOutcome:
-    """Map device tensors back to job/node ids (the reference's SchedulerResult)."""
-    g_state = np.asarray(result.g_state)
-    slot_gang = np.asarray(result.slot_gang)
-    slot_nodes = np.asarray(result.slot_nodes)
-    slot_counts = np.asarray(result.slot_counts)
-    n_slots = int(result.n_slots)
-    run_resched = np.asarray(result.run_rescheduled)
-    run_evicted = np.asarray(result.run_evicted)
+    """Map device tensors back to job/node ids (the reference's SchedulerResult).
+
+    Decode stays O(decisions) on the wire too: when the result lives on
+    device, a jitted compaction packs failed/evicted indices + placement
+    slots into one small buffer (fair_scheduler.compact_result) so the
+    tunnel transfer is ~100KB instead of the [G] g_state pull."""
+    compact = _fetch_compact(result, ctx)
+    if compact is not None:
+        (
+            n_slots, slot_gang, slot_nodes, slot_counts, g2, pre_idx, res_idx,
+            state_of, iterations, termination, spot,
+        ) = compact
+    else:
+        g_state = np.asarray(result.g_state)
+        slot_gang = np.asarray(result.slot_gang)
+        slot_nodes = np.asarray(result.slot_nodes)
+        slot_counts = np.asarray(result.slot_counts)
+        n_slots = int(result.n_slots)
+        run_resched = np.asarray(result.run_rescheduled)
+        run_evicted = np.asarray(result.run_evicted)
+        # Flag vectors first, Python only over the flagged indices: decode must
+        # stay O(decisions), not O(backlog) -- a 1M-gang Python loop here would
+        # cost the time the incremental builder saves.
+        nr = ctx.num_real_runs
+        ev = np.asarray(run_evicted[:nr], bool)
+        rs = np.asarray(run_resched[:nr], bool)
+        pre_idx = np.flatnonzero(ev & ~rs)
+        res_idx = np.flatnonzero(ev & rs)
+        g2 = np.flatnonzero(np.asarray(g_state[: ctx.num_real_gangs]) == 2)
+        state_of = lambda gi: int(g_state[gi])  # noqa: E731
+        iterations = int(result.iterations)
+        termination = int(result.termination)
+        spot = float(result.spot_price)
 
     scheduled: dict = {}
     for s in range(n_slots):
@@ -1255,16 +1348,9 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
                     scheduled[members[mi]] = ctx.node_ids[node]
                     mi += 1
 
-    # Flag vectors first, Python only over the flagged indices: decode must
-    # stay O(decisions), not O(backlog) -- a 1M-gang Python loop here would
-    # cost the time the incremental builder saves.
-    nr = ctx.num_real_runs
-    ev = np.asarray(run_evicted[:nr], bool)
-    rs = np.asarray(run_resched[:nr], bool)
-    preempted = [ctx.run_job_id(int(ri)) for ri in np.flatnonzero(ev & ~rs)]
-    rescheduled = [ctx.run_job_id(int(ri)) for ri in np.flatnonzero(ev & rs)]
+    preempted = [ctx.run_job_id(int(ri)) for ri in pre_idx]
+    rescheduled = [ctx.run_job_id(int(ri)) for ri in res_idx]
 
-    g2 = np.flatnonzero(np.asarray(g_state[: ctx.num_real_gangs]) == 2)
     if ctx.gang_members is None:
         # Vectorized path: a round can retire WHOLE unfeasible key classes
         # (g_state=2 en masse); per-id Python here cost seconds at 1M gangs,
@@ -1273,7 +1359,7 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
         extra = [
             m
             for gi, members in ctx.gang_members_over.items()
-            if int(g_state[gi]) == 2
+            if state_of(gi) == 2
             for m in members
         ]
         failed = LazyJobIds(ids[ids != b""], extra)
@@ -1306,23 +1392,22 @@ def decode_result(result, ctx: HostContext) -> RoundOutcome:
         if tag:
             groups.setdefault(tag, []).append(gi)
     for tag, gis in groups.items():
-        states = {int(g_state[gi]) for gi in gis}
+        states = {state_of(gi) for gi in gis}
         if 1 in states and states != {1}:
             unwound.add(tag)
             for gi in gis:
-                if int(g_state[gi]) == 1:
+                if state_of(gi) == 1:
                     for jid in ctx.members_of(gi):
                         scheduled.pop(jid, None)
                         failed.append(jid)
 
-    spot = float(result.spot_price)
     return RoundOutcome(
         scheduled=scheduled,
         preempted=preempted,
         rescheduled=rescheduled,
         failed=failed,
-        num_iterations=int(result.iterations),
-        termination=_TERMINATIONS[int(result.termination)],
+        num_iterations=iterations,
+        termination=_TERMINATIONS[termination],
         spot_price=spot if spot >= 0 else None,
         unwound_groups=frozenset(unwound),
     )

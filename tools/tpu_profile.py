@@ -1,0 +1,105 @@
+"""Ad-hoc TPU-cycle profiler: where do the seconds go over the axon tunnel?
+
+Measures (1) raw host->device and device->host bandwidth, (2) per-field
+upload cost of the 1M-gang SchedulingProblem, (3) kernel time cached vs
+uncached (cache_slots A/B -- the fit caches were tuned for XLA:CPU's scalar
+argmin; TPU has a real vector unit), (4) decode readback cost.
+
+Usage: python tools/tpu_profile.py [jobs] [nodes]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bw_probe():
+    for mb in (8, 64):
+        x = np.ones((mb * 1024 * 1024 // 4,), np.float32)
+        t0 = time.perf_counter()
+        d = jax.device_put(x)
+        d.block_until_ready()
+        up = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _ = np.asarray(d)
+        down = time.perf_counter() - t0
+        print(f"bw {mb}MB: up {up:.3f}s ({mb/up:.1f} MB/s)  down {down:.3f}s ({mb/down:.1f} MB/s)")
+
+
+def main():
+    num_jobs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    num_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 50_000
+    print("platform:", jax.devices()[0].platform)
+    bw_probe()
+
+    from armada_tpu.models.fair_scheduler import schedule_round
+    from armada_tpu.models.problem import SchedulingProblem
+    from armada_tpu.models.synthetic import synthetic_problem
+
+    problem, meta = synthetic_problem(
+        num_nodes=num_nodes,
+        num_gangs=num_jobs,
+        num_queues=64,
+        num_runs=num_nodes // 2,
+        global_burst=1_000,
+        perq_burst=1_000,
+        seed=7,
+    )
+    total_bytes = 0
+    t_all = time.perf_counter()
+    devs = []
+    for name, arr in zip(problem._fields, problem):
+        a = np.asarray(arr)
+        t0 = time.perf_counter()
+        d = jax.device_put(a)
+        d.block_until_ready()
+        dt = time.perf_counter() - t0
+        total_bytes += a.nbytes
+        if a.nbytes > 1 << 20 or dt > 0.05:
+            print(f"  upload {name:16s} {a.nbytes/1e6:8.1f}MB {dt:6.3f}s")
+        devs.append(d)
+    print(f"upload total {total_bytes/1e6:.1f}MB {time.perf_counter()-t_all:.3f}s")
+    dev = SchedulingProblem(*devs)
+
+    kw = dict(
+        num_levels=meta["num_levels"],
+        max_slots=meta["max_slots"],
+        slot_width=meta["slot_width"],
+    )
+    for label, extra in (("cached", {}), ("uncached", {"cache_slots": 0})):
+        t0 = time.perf_counter()
+        r = schedule_round(dev, **kw, **extra)
+        jax.block_until_ready(r)
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = schedule_round(dev, **kw, **extra)
+            jax.block_until_ready(r)
+            times.append(time.perf_counter() - t0)
+        print(
+            f"kernel[{label}]: compile+1st {compile_s:.2f}s  best {min(times):.4f}s"
+            f"  iters {int(r.iterations)} scheduled {int(r.scheduled_count)}"
+        )
+
+    # decode readback: what does pulling the result cost?
+    t0 = time.perf_counter()
+    host = jax.tree_util.tree_map(np.asarray, r)
+    dt = time.perf_counter() - t0
+    nbytes = sum(
+        getattr(x, "nbytes", 0) for x in jax.tree_util.tree_leaves(host)
+    )
+    print(f"result readback {nbytes/1e6:.1f}MB {dt:.3f}s")
+    for name, x in zip(r._fields, host):
+        if getattr(x, "nbytes", 0) > 1 << 20:
+            print(f"  result {name:20s} {x.nbytes/1e6:8.1f}MB")
+
+
+if __name__ == "__main__":
+    main()
