@@ -55,11 +55,16 @@ def run_round_on_device(problem, ctx, config, device_problem=None):
     attempts = 0
     while outcome.unwound_groups and attempts < 4:
         attempts += 1
-        kill = [
-            gi
-            for gi in range(ctx.num_real_gangs)
-            if ctx.gang_group[gi] in outcome.unwound_groups
-        ]
+        # Group tags live only on multi-member units under the vectorized
+        # representation (same rule as decode's unwind scan) -- and slab
+        # contexts have G ~ backlog slots, so never range-scan num_real_gangs
+        # unless gangs are list-represented.
+        tagged = (
+            ctx.gang_members_over.keys()
+            if ctx.gang_members is None
+            else range(ctx.num_real_gangs)
+        )
+        kill = [gi for gi in tagged if ctx.gang_group[gi] in outcome.unwound_groups]
         g_valid = _np.asarray(device_problem.g_valid).copy()
         g_valid[_np.asarray(kill, _np.int64)] = False
         device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))

@@ -72,7 +72,7 @@ TERM_MAX_ITER = 3
 
 
 class RoundResult(NamedTuple):
-    g_state: jax.Array  # i32[G]: 0 not attempted, 1 scheduled, 2 failed/skipped
+    g_state: jax.Array  # i32[G]: 0 not attempted, 1 scheduled, 2 failed/skipped, 3 absent
     slot_gang: jax.Array  # i32[S]
     slot_nodes: jax.Array  # i32[S, W]
     slot_counts: jax.Array  # i32[S, W]
@@ -867,7 +867,15 @@ def schedule_round(
     )
     pending0 = p.g_valid & ((p.g_run < 0) | evictee_active)
     g_state = jnp.where(pending0, 0, 2).astype(jnp.int32)
+    # Evictee slots whose run was NOT evicted are not candidates this round:
+    # absent (3), not failed.  Decode ignored them anyway (empty ids), but
+    # counting them as state 2 overflowed the compact-decode cap at scale
+    # (every preemptible run would land in n_failed).
+    g_state = jnp.where(p.g_valid & (p.g_run >= 0) & ~evictee_active, 3, g_state)
     g_state = jnp.where(p.g_valid, g_state, 2)
+    # Slots not in this cycle's problem (slab holes, beyond-lookback jobs,
+    # slack regions) are ABSENT, not failed: decode must never report them.
+    g_state = jnp.where(p.g_absent, 3, g_state)
 
     carry = _Carry(
         alloc=alloc,

@@ -178,16 +178,21 @@ class _SortedTable:
         for r in rows:
             self.key_of_id[r["ids"]] = (r["qi"], r["npc"], r["prio"], r["sub"])
 
-    def remove(self, jid: bytes) -> bool:
+    def remove(self, jid: bytes) -> Optional[dict]:
+        """Tombstone the row; returns its column values (qi + extras + req
+        copy) so callers can release slab slots / adjust demand, or None if
+        the id was absent.  The snapshot is taken BEFORE any compaction."""
         row = self._locate(jid)
         self.key_of_id.pop(jid, None)
         if row is None:
-            return False
+            return None
+        info = {c: getattr(self, c)[row] for c in ("qi",) + self._extra}
+        info["req"] = self.req[row].copy()
         self.alive[row] = False
         self.dead += 1
         if self.dead > max(1024, self.n // 4):
             self.compact()
-        return True
+        return info
 
     def compact(self) -> None:
         keep = self.alive[: self.n]
@@ -257,7 +262,13 @@ class IncrementalBuilder:
 
         self.jobs = _SortedTable(
             self.R,
-            {"level": np.int32, "pc": np.int32, "key": np.int32, "band": np.int32},
+            {
+                "level": np.int32,
+                "pc": np.int32,
+                "key": np.int32,
+                "band": np.int32,
+                "slot": np.int32,
+            },
         )
         self.runs = _SortedTable(
             self.R,
@@ -267,9 +278,56 @@ class IncrementalBuilder:
                 "pc": np.int32,
                 "preempt": bool,
                 "band": np.int32,
+                "slot": np.int32,
             },
             cap=256,
         )
+        # Slot-stable slabs mirroring the tables (models/slab.py): device
+        # content lives at a fixed slot per job/run so the per-cycle upload
+        # is O(deltas); the sorted tables keep serving order/lookup.
+        from armada_tpu.models.slab import RowSlab
+
+        bucket = max(64, config.shape_bucket)
+        self._sg = RowSlab(
+            self.R,
+            {
+                "level": np.int32,
+                "queue": np.int32,
+                "key": np.int32,
+                "pc": np.int32,
+                "band": np.int32,
+            },
+            bucket=bucket,
+        )
+        self._rr = RowSlab(
+            self.R,
+            {
+                "node": np.int32,
+                "level": np.int32,
+                "queue": np.int32,
+                "pc": np.int32,
+                "band": np.int32,
+                "preempt": bool,
+            },
+            bucket=bucket,
+        )
+        # Gang-unit region sizing (units rebuilt wholesale each cycle).
+        self._u_cap = 0
+        self._u_prev_n = 0
+        self._unit_cols: dict[str, np.ndarray] = {}
+        # Device-visible gang ids across all regions ([G] grows with caps).
+        self._g_ids = np.zeros((0,), self.jobs.ids.dtype)
+        # Exact integral demand accounting per (queue, pc): resolution units
+        # are integers, so incremental float64 +=/-= is exact and
+        # order-independent (matches assemble()'s fresh bincounts).
+        C = len(self.pc_names)
+        self._demand_sg = np.zeros((0, C, self.R), np.float64)
+        self._demand_run = np.zeros((0, C, self.R), np.float64)
+        # Bundle sequencing for the single DeviceDeltaCache consumer (a
+        # skipped bundle forces its full-upload fallback).
+        self._bundle_seq = 0
+        # Identity-stable small tensors (re-sent only when values change).
+        self._stable_smalls: dict[str, np.ndarray] = {}
         self.gang_jobs: dict[str, JobSpec] = {}  # job id -> spec (slow path)
         self.banned: dict[str, tuple] = {}  # job id -> banned node ids
         self.bands: list[str] = [""]
@@ -323,6 +381,10 @@ class IncrementalBuilder:
         for name, qi in self.queue_by_name.items():
             self.queue_weight[qi] = known.get(name, 0.0)
             self.queue_known[qi] = name in known
+        nq = len(self.queue_names)
+        if self._demand_sg.shape[0] < nq:
+            self._demand_sg = _grow(self._demand_sg, nq)
+            self._demand_run = _grow(self._demand_run, nq)
         if self._unknown_queue:
             flush = [
                 args
@@ -449,6 +511,36 @@ class IncrementalBuilder:
         must be the CURRENT priority (reprioritisation updates it)."""
         self.submit_many([spec], {spec.id: tuple(banned_nodes)} if banned_nodes else None)
 
+    def _release_single(self, info: Optional[dict]) -> None:
+        """Free a removed single's slab slot + retire its demand share."""
+        if info is None:
+            return
+        slot = int(info["slot"])
+        if self._sg.valid[slot]:
+            self._demand_sg[int(info["qi"]), int(info["pc"])] -= info["req"].astype(
+                np.float64
+            )
+        self._sg.release(slot)
+        if slot < self._g_ids.shape[0]:
+            self._g_ids[slot] = b""
+
+    def _release_run(self, info: Optional[dict]) -> None:
+        if info is None:
+            return
+        slot = int(info["slot"])
+        if self._rr.valid[slot]:
+            self._demand_run[int(info["qi"]), int(info["pc"])] -= info["req"].astype(
+                np.float64
+            )
+        self._rr.release(slot)
+
+    def _ensure_g_ids(self) -> None:
+        """Keep the [G] id vector covering the singles region after growth."""
+        if self._g_ids.shape[0] < self._sg.cap:
+            old = self._g_ids
+            self._g_ids = np.zeros((self._sg.cap,), _ID_DTYPE)
+            self._g_ids[: old.shape[0]] = old
+
     def submit_many(
         self, specs: Sequence[JobSpec], banned: Optional[Mapping] = None
     ) -> None:
@@ -469,22 +561,47 @@ class IncrementalBuilder:
                 self.gang_jobs[spec.id] = spec
                 if bans:
                     self.banned[spec.id] = tuple(bans)
-                self.jobs.remove(spec.id.encode())
+                self._release_single(self.jobs.remove(spec.id.encode()))
                 continue
             jid = spec.id.encode()
             if jid in self.jobs:
-                self.jobs.remove(jid)
+                self._release_single(self.jobs.remove(jid))
             row, req = self._single_row(spec)
             rows.append(row)
             reqs.append(req)
+        if not rows:
+            return
+        slots = self._sg.alloc(len(rows))
+        for r, s in zip(rows, slots):
+            r["slot"] = s
         self.jobs.insert_batch(rows, reqs)
+        reqs_arr = np.stack(reqs)
+        qis = np.array([r["qi"] for r in rows], np.int64)
+        pcs = np.array([r["pc"] for r in rows], np.int64)
+        self._sg.write_batch(
+            slots,
+            [r["ids"] for r in rows],
+            reqs_arr,
+            level=np.array([r["level"] for r in rows], np.int32),
+            queue=qis.astype(np.int32),
+            key=np.array([r["key"] for r in rows], np.int32),
+            pc=pcs.astype(np.int32),
+            band=np.array([r["band"] for r in rows], np.int32),
+        )
+        self._ensure_g_ids()
+        self._g_ids[slots] = np.array([r["ids"] for r in rows], _ID_DTYPE)
+        np.add.at(
+            self._demand_sg,
+            (qis, pcs),
+            reqs_arr.astype(np.float64),
+        )
 
     def remove(self, job_id: str) -> None:
         """Job left the backlog (scheduled, cancelled, or terminal)."""
         self.gang_jobs.pop(job_id, None)
         self.banned.pop(job_id, None)
         self._unknown_queue.pop(job_id, None)
-        self.jobs.remove(job_id.encode())
+        self._release_single(self.jobs.remove(job_id.encode()))
 
     def reprioritise(self, spec: JobSpec) -> None:
         """Priority changed: re-slot (the order key embeds the priority)."""
@@ -519,7 +636,7 @@ class IncrementalBuilder:
             )
             jid = r.job.id.encode()
             if jid in self.runs:
-                self.runs.remove(jid)
+                self._release_run(self.runs.remove(jid))
             rows.append(
                 {
                     "ids": jid,
@@ -538,11 +655,31 @@ class IncrementalBuilder:
                 }
             )
             reqs.append(req)
+        if not rows:
+            return
+        slots = self._rr.alloc(len(rows))
+        for r, s in zip(rows, slots):
+            r["slot"] = s
         self.runs.insert_batch(rows, reqs)
+        reqs_arr = np.stack(reqs)
+        qis = np.array([r["qi"] for r in rows], np.int64)
+        pcs = np.array([r["pc"] for r in rows], np.int64)
+        self._rr.write_batch(
+            slots,
+            [r["ids"] for r in rows],
+            reqs_arr,
+            node=np.array([r["node"] for r in rows], np.int32),
+            level=np.array([r["level"] for r in rows], np.int32),
+            queue=qis.astype(np.int32),
+            pc=pcs.astype(np.int32),
+            band=np.array([r["band"] for r in rows], np.int32),
+            preempt=np.array([r["preempt"] for r in rows], bool),
+        )
+        np.add.at(self._demand_run, (qis, pcs), reqs_arr.astype(np.float64))
 
     def unlease(self, job_id: str) -> None:
         """The run ended (terminal or preempted)."""
-        self.runs.remove(job_id.encode())
+        self._release_run(self.runs.remove(job_id.encode()))
 
     # ---------------------------------------------------------- assemble ----
 
@@ -997,6 +1134,7 @@ class IncrementalBuilder:
             g_order=g_order.astype(np.int32),
             g_run=g_run,
             g_valid=g_valid,
+            g_absent=np.zeros_like(g_valid),
             g_price=g_price,
             g_spot_price=g_spot,
             gq_gang=gq_gang,
@@ -1060,6 +1198,577 @@ class IncrementalBuilder:
             run_ids_vec=rt.ids[run_rows],
         )
         return problem, ctx
+
+    # ------------------------------------------------ slab delta assemble ----
+
+    def _stable(self, name: str, arr: np.ndarray) -> np.ndarray:
+        """Identity-stable small tensor: hand back the previous object while
+        the VALUE is unchanged, so the device cache's identity check skips
+        the re-upload (the same trick _node_cache plays for node tensors)."""
+        prev = self._stable_smalls.get(name)
+        if (
+            prev is not None
+            and prev.shape == arr.shape
+            and prev.dtype == arr.dtype
+            and np.array_equal(prev, arr)
+        ):
+            return prev
+        self._stable_smalls[name] = arr
+        return arr
+
+    def assemble_delta(
+        self,
+        *,
+        global_tokens=None,
+        queue_tokens=None,
+        queue_penalty: Optional[Mapping] = None,
+    ):
+        """One cycle's device update on the slot-stable slab layout.
+
+        Returns (DeltaBundle, HostContext).  Feed the bundle to a
+        slab.DeviceDeltaCache for a device-resident SchedulingProblem kept
+        current by scatter (O(deltas) upload per cycle -- the point: the
+        dense layout assemble() emits shifts positionally every cycle, so
+        ~85% of the 1M-row job tensors re-upload, ~2s over the TPU tunnel).
+        bundle.materialize() builds the equivalent full host problem (first
+        upload / fallback / tests; must be called before further builder
+        mutations).
+
+        Candidate order, demand and outcomes are identical to assemble() --
+        only the gang/run axis layout differs (stable slots + absent holes
+        vs packed positions).  Away-mode and market pools stay on
+        assemble().  tests/test_slab_delta.py pins both the outcome
+        equivalence and scatter==materialize bit-equality."""
+        from armada_tpu.models.slab import DeltaBundle
+
+        if self._retype_needed:
+            self._retype_nodes()
+        cfg = self.config
+        R = self.R
+        qbucket = min(cfg.shape_bucket, 256)
+        nbucket = min(cfg.shape_bucket, 1024)
+        Qreal = len(self.queue_names)
+        Nreal = len(self.node_ids)
+        N = _pad(Nreal, nbucket)
+        nc = self._node_cache
+        if nc is None or nc["key"] != (self._node_epoch, N):
+            nc = self._build_node_tensors(N, Nreal)
+            self._node_cache = nc
+
+        jt, rt = self.jobs, self.runs
+        sg, rr = self._sg, self._rr
+
+        # --- singles: live rows, (queue, order-key) table order ---------------
+        rows = jt.live_rows()
+        mask_known = np.ones(rows.shape[0], bool)
+        if Qreal and not self.queue_known.all():
+            mask_known = self.queue_known[jt.qi[rows]]
+        rows_known = rows[mask_known]
+        sq = jt.qi[rows_known].astype(np.int64)
+        counts_s = np.bincount(sq, minlength=Qreal)
+        starts_s = np.zeros((max(1, Qreal),), np.int64)
+        if Qreal:
+            starts_s[1:Qreal] = np.cumsum(counts_s)[:-1]
+        rank_s = np.arange(rows_known.shape[0], dtype=np.int64) - starts_s[sq]
+
+        # --- units merged into the per-queue order (same as assemble()) -------
+        units, unit_members, unit_ubans = self._gang_units()
+        if units:
+            unit_qi = np.array([u["qi"] for u in units], np.int64)
+            unit_vrank = np.array([u["rank"] for u in units], np.int64)
+            shift = np.zeros(rows_known.shape[0], np.int64)
+            units_before = np.zeros(len(units), np.int64)
+            for q in np.unique(unit_qi):
+                in_q = np.flatnonzero(unit_qi == q)
+                order_q = in_q[np.argsort(unit_vrank[in_q], kind="stable")]
+                units_before[order_q] = np.arange(in_q.shape[0])
+                ur = np.sort(unit_vrank[in_q])
+                sel = sq == q
+                shift[sel] = np.searchsorted(ur, rank_s[sel], "right")
+            merged_rank_s = rank_s + shift
+            merged_rank_u = unit_vrank + units_before
+        else:
+            merged_rank_s = rank_s
+            merged_rank_u = np.zeros((0,), np.int64)
+
+        L = cfg.max_queue_lookback
+        keep_s = merged_rank_s < L
+        rows_kept = rows_known[keep_s]
+        sq_kept = sq[keep_s]
+        merged_rank_kept = merged_rank_s[keep_s]
+        kept_units: list[tuple] = []
+        if units:
+            cut_tags = {
+                units[i]["tag"]
+                for i in range(len(units))
+                if units[i]["tag"] and merged_rank_u[i] >= L
+            }
+            for i, u in enumerate(units):
+                if merged_rank_u[i] >= L or (u["tag"] and u["tag"] in cut_tags):
+                    continue
+                kept_units.append((u, merged_rank_u[i], unit_members[i], unit_ubans[i]))
+
+        # --- singles participation flips -> slab validity + demand ------------
+        slots_live = jt.slot[rows].astype(np.int64)
+        valid_flags = np.zeros(rows.shape[0], bool)
+        idx_known = np.flatnonzero(mask_known)
+        valid_flags[idx_known[keep_s]] = True
+        cur_valid = sg.valid[slots_live]
+        flip_on = slots_live[valid_flags & ~cur_valid]
+        flip_off = slots_live[~valid_flags & cur_valid]
+        for flips, sign in ((flip_on, 1.0), (flip_off, -1.0)):
+            if flips.size:
+                np.add.at(
+                    self._demand_sg,
+                    (sg.queue[flips].astype(np.int64), sg.pc[flips].astype(np.int64)),
+                    sign * sg.req[flips].astype(np.float64),
+                )
+        sg.set_valid(flip_on, True)
+        sg.set_valid(flip_off, False)
+
+        # --- runs participation flips (queue/node filters) --------------------
+        run_rows = rt.live_rows()
+        rvalid = np.ones(run_rows.shape[0], bool)
+        if Qreal and not self.queue_known.all():
+            rvalid &= self.queue_known[rt.qi[run_rows]]
+        if Nreal and not self.node_present.all():
+            rvalid &= self.node_present[rt.node[run_rows]]
+        rslots = rt.slot[run_rows].astype(np.int64)
+        cur_rvalid = rr.valid[rslots]
+        rflip_on = rslots[rvalid & ~cur_rvalid]
+        rflip_off = rslots[~rvalid & cur_rvalid]
+        for flips, sign in ((rflip_on, 1.0), (rflip_off, -1.0)):
+            if flips.size:
+                np.add.at(
+                    self._demand_run,
+                    (rr.queue[flips].astype(np.int64), rr.pc[flips].astype(np.int64)),
+                    sign * rr.req[flips].astype(np.float64),
+                )
+        rr.set_valid(rflip_on, True)
+        rr.set_valid(rflip_off, False)
+
+        # evictee candidates: preemptible valid runs, table order
+        ev_mask = rt.preempt[run_rows] & rvalid
+        ev_rows = run_rows[ev_mask]
+        evq = rt.qi[ev_rows].astype(np.int64)
+
+        # --- region layout -----------------------------------------------------
+        # Zero-size axes break the kernel's gathers (legacy pads to >=1
+        # bucket); grow empty slabs to their first bucket up front.
+        if sg.cap == 0:
+            sg._grow(1)
+        if rr.cap == 0:
+            rr._grow(1)
+        s_cap = sg.cap
+        r_cap = rr.cap
+        u_n = len(kept_units)
+        if u_n > self._u_cap:
+            self._u_cap = _pad(u_n, 64)
+        u_cap = self._u_cap
+        u_base = s_cap + r_cap
+        G = s_cap + r_cap + u_cap
+        if self._g_ids.shape[0] != G:
+            new_ids = np.zeros((G,), _ID_DTYPE)
+            n_keep = min(self._g_ids.shape[0], s_cap)
+            new_ids[:n_keep] = self._g_ids[:n_keep]
+            self._g_ids = new_ids
+
+        # --- units region content (rebuilt wholesale; small) ------------------
+        uc = {
+            "g_req": np.zeros((u_cap, R), np.float32),
+            "g_card": np.zeros((u_cap,), np.int32),
+            "g_level": np.zeros((u_cap,), np.int32),
+            "g_queue": np.zeros((u_cap,), np.int32),
+            "g_key": np.full((u_cap,), -1, np.int32),
+            "g_pc": np.zeros((u_cap,), np.int32),
+            "g_run": np.full((u_cap,), -1, np.int32),
+            "g_valid": np.zeros((u_cap,), bool),
+            "g_absent": np.ones((u_cap,), bool),
+            "g_price": np.zeros((u_cap,), np.float32),
+            "g_spot_price": np.zeros((u_cap,), np.float32),
+            "g_ban_row": np.zeros((u_cap,), np.int32),
+        }
+        ban_rows: list[np.ndarray] = []
+        members_over: dict[int, list] = {}
+        group_of: dict[int, str] = {}
+        demand_u = np.zeros((max(1, Qreal), len(self.pc_names), R), np.float64)
+        for i, (u, _, members, uban) in enumerate(kept_units):
+            uc["g_req"][i] = u["req"]
+            uc["g_card"][i] = u["card"]
+            uc["g_level"][i] = u["level"]
+            uc["g_queue"][i] = u["qi"]
+            uc["g_key"][i] = u["key"]
+            uc["g_pc"][i] = u["pc"]
+            uc["g_valid"][i] = not u["dead"]
+            uc["g_absent"][i] = False
+            uc["g_price"][i] = u["price"]
+            uc["g_spot_price"][i] = u["spot"]
+            members_over[u_base + i] = list(members)
+            if u["tag"]:
+                group_of[u_base + i] = u["tag"]
+            demand_u[u["qi"], u["pc"]] += u["req"].astype(np.float64) * u["card"]
+            bans = set()
+            for jid in members:
+                bans.update(self.banned.get(jid, ()))
+            if not uban and not bans:
+                continue
+            row = np.zeros((N,), bool)
+            for ni in uban or ():
+                row[ni] = True
+            for nid in bans:
+                ni = self.node_index.get(nid)
+                if ni is not None:
+                    row[ni] = True
+            if row.any():
+                ban_rows.append(row)
+                uc["g_ban_row"][i] = len(ban_rows)
+        BR = _pad(len(ban_rows) + 1, 8) if ban_rows else 1
+        ban_mask = np.zeros((BR, N), bool)
+        for i, row in enumerate(ban_rows):
+            ban_mask[i + 1] = row
+
+        # --- final candidate order: sorted merge on slot ids ------------------
+        key_s = (sq_kept << 32) | merged_rank_kept
+        seq_s = jt.slot[rows_kept].astype(np.int32)
+        if kept_units:
+            key_u = np.array(
+                [(int(u["qi"]) << 32) | int(mr) for (u, mr, _, _) in kept_units],
+                np.int64,
+            )
+            order_u = np.argsort(key_u, kind="stable")
+            key_u = key_u[order_u]
+            seq_u = (u_base + order_u).astype(np.int32)
+            pos = np.searchsorted(key_s, key_u)
+            queued_seq = np.insert(seq_s, pos, seq_u)
+            queued_q = np.insert(
+                sq_kept,
+                pos,
+                np.array([u["qi"] for (u, _, _, _) in kept_units], np.int64)[order_u],
+            )
+        else:
+            queued_seq = seq_s
+            queued_q = sq_kept
+
+        ev_seq = (s_cap + rt.slot[ev_rows].astype(np.int64)).astype(np.int32)
+        pos_e = np.searchsorted(queued_q, evq, "left")
+        gq_real = np.insert(queued_seq, pos_e, ev_seq)
+        gq_q = np.insert(queued_q, pos_e, evq)
+        nreal_candidates = gq_real.shape[0]
+
+        Q = _pad(Qreal, qbucket)
+        q_len64 = np.bincount(gq_q, minlength=Q)
+        q_start = np.zeros((Q,), np.int32)
+        q_start[1:] = np.cumsum(q_len64)[:-1].astype(np.int32)
+        q_len = q_len64.astype(np.int32)
+        gq_gang = np.zeros((G,), np.int32)
+        gq_gang[:nreal_candidates] = gq_real
+
+        # --- demand -> constrained shares (assemble()'s exact math) -----------
+        C = len(self.pc_names)
+        total_pool = nc["total_pool"]
+        total_pool64 = nc["total_pool64"]
+        drf_mult = nc["drf_mult"]
+        pc_queue_cap = nc["pc_queue_cap"]
+        q_weight = np.zeros((Q,), np.float32)
+        q_weight[:Qreal] = self.queue_weight
+        q_cds = np.zeros((Q,), np.float32)
+        q_penalty = np.zeros((Q, R), np.float32)
+        if queue_penalty:
+            for qname, atoms in queue_penalty.items():
+                qi = self.queue_by_name.get(qname)
+                if qi is not None:
+                    q_penalty[qi] = self.factory.ceil_units(atoms).astype(np.float32)
+        q_demand_raw = [0.0] * Qreal
+        if Qreal and R:
+            demand_by_pc = (
+                self._demand_sg[:Qreal] + self._demand_run[:Qreal] + demand_u[:Qreal]
+            )
+            with np.errstate(divide="ignore", invalid="ignore"):
+                denom = np.maximum(total_pool, 1e-9)
+                raw = demand_by_pc.sum(axis=1)
+                capped = np.minimum(demand_by_pc, pc_queue_cap[None]).sum(axis=1)
+                capped = np.minimum(capped, total_pool.astype(np.float64)[None])
+                frac = np.where(total_pool[None] > 0, capped / denom[None], 0.0)
+                rawfrac = np.where(total_pool[None] > 0, raw / denom[None], 0.0)
+            q_cds[:Qreal] = np.maximum(0.0, (frac * drf_mult[None]).max(axis=1))
+            q_demand_raw = [
+                float(v)
+                for v in np.maximum(0.0, (rawfrac * drf_mult[None]).max(axis=1))
+            ]
+
+        # --- burst caps -------------------------------------------------------
+        burst_cfg = cfg.maximum_scheduling_burst or 2**31 - 1
+        if global_tokens is not None:
+            burst_cfg = max(0, min(burst_cfg, int(global_tokens)))
+        perq_cfg = cfg.maximum_per_queue_scheduling_burst or 2**31 - 1
+        perq_burst = np.full((Q,), 2**31 - 1, np.int32)
+        for qname, qi in self.queue_by_name.items():
+            cap = perq_cfg
+            if queue_tokens is not None and qname in queue_tokens:
+                cap = max(0, min(cap, int(queue_tokens[qname])))
+            perq_burst[qi] = min(cap, 2**31 - 1)
+
+        max_card = max((int(u["card"]) for (u, _, _, _) in kept_units), default=1)
+        if max_card > 10_000:
+            raise ValueError(f"gang cardinality {max_card} exceeds the supported 10k")
+        W = max(1, min(max_card, N))
+        S_slots = max(1, min(max(nreal_candidates, 1), burst_cfg))
+
+        # --- dirty extraction -------------------------------------------------
+        sg_dirty = (
+            np.unique(np.asarray(sg.dirty_log, np.int64))
+            if sg.dirty_log
+            else np.zeros((0,), np.int64)
+        )
+        sg.dirty_log.clear()
+        unit_dirty = np.arange(u_base, u_base + max(u_n, self._u_prev_n), dtype=np.int64)
+        self._u_prev_n = u_n
+        sg_idx = np.concatenate([sg_dirty, unit_dirty])
+        rr_dirty = (
+            np.unique(np.asarray(rr.dirty_log, np.int64))
+            if rr.dirty_log
+            else np.zeros((0,), np.int64)
+        )
+        rr.dirty_log.clear()
+
+        is_unit = sg_idx >= u_base
+        i_sing = sg_idx[~is_unit]
+        i_unit = sg_idx[is_unit] - u_base
+        k = sg_idx.shape[0]
+
+        def sg_field(name, sing_vals, dtype):
+            out = np.zeros((k,) + sing_vals.shape[1:], dtype)
+            out[~is_unit] = sing_vals
+            out[is_unit] = uc[name][i_unit]
+            return out
+
+        sg_valid_rows = sg.valid[i_sing]
+        sg_cols = {
+            "g_req": sg_field("g_req", sg.req[i_sing], np.float32),
+            "g_card": sg_field("g_card", np.ones((i_sing.shape[0],), np.int32), np.int32),
+            "g_level": sg_field("g_level", sg.level[i_sing], np.int32),
+            "g_queue": sg_field("g_queue", sg.queue[i_sing], np.int32),
+            "g_key": sg_field("g_key", sg.key[i_sing], np.int32),
+            "g_pc": sg_field("g_pc", sg.pc[i_sing], np.int32),
+            "g_run": sg_field("g_run", np.full((i_sing.shape[0],), -1, np.int32), np.int32),
+            "g_valid": sg_field("g_valid", sg_valid_rows, bool),
+            "g_absent": sg_field("g_absent", ~sg_valid_rows, bool),
+            "g_price": sg_field("g_price", np.zeros((i_sing.shape[0],), np.float32), np.float32),
+            "g_spot_price": sg_field(
+                "g_spot_price", np.zeros((i_sing.shape[0],), np.float32), np.float32
+            ),
+            "g_ban_row": sg_field(
+                "g_ban_row", np.zeros((i_sing.shape[0],), np.int32), np.int32
+            ),
+        }
+        rr_valid_rows = rr.valid[rr_dirty]
+        rr_preempt_rows = rr.preempt[rr_dirty]
+        ev_valid_rows = rr_valid_rows & rr_preempt_rows
+        rr_cols = {
+            "run_req": rr.req[rr_dirty],
+            "run_node": rr.node[rr_dirty],
+            "run_level": rr.level[rr_dirty],
+            "run_queue": rr.queue[rr_dirty],
+            "run_pc": rr.pc[rr_dirty],
+            "run_preemptible": rr_preempt_rows,
+            "run_gang": np.where(
+                ev_valid_rows, (s_cap + rr_dirty).astype(np.int32), np.int32(-1)
+            ),
+            "run_valid": rr_valid_rows,
+        }
+        ev_cols = {
+            "g_req": rr.req[rr_dirty],
+            "g_level": rr.level[rr_dirty],
+            "g_queue": rr.queue[rr_dirty],
+            "g_pc": rr.pc[rr_dirty],
+            "g_run": rr_dirty.astype(np.int32),
+            "g_valid": ev_valid_rows,
+            "g_absent": ~ev_valid_rows,
+            "g_price": np.zeros((rr_dirty.shape[0],), np.float32),
+            "g_spot_price": np.zeros((rr_dirty.shape[0],), np.float32),
+        }
+
+        fulls = {
+            "gq_gang": gq_gang,
+            "q_start": q_start,
+            "q_len": q_len,
+            "q_weight": self._stable("q_weight", q_weight),
+            "q_cds": q_cds,
+            "q_penalty": self._stable("q_penalty", q_penalty),
+            "compat": self._compat_matrix(),
+            "total_pool": total_pool,
+            "drf_mult": drf_mult,
+            "inv_scale": nc["inv_scale"],
+            "round_cap": nc["round_cap"],
+            "pc_queue_cap": pc_queue_cap.astype(np.float32)
+            if pc_queue_cap.dtype != np.float32
+            else pc_queue_cap,
+            "protected_fraction": self._stable(
+                "protected_fraction",
+                np.float32(cfg.protected_fraction_of_fair_share),
+            ),
+            "global_burst": self._stable(
+                "global_burst", np.int32(min(burst_cfg, 2**31 - 1))
+            ),
+            "perq_burst": self._stable("perq_burst", perq_burst),
+            "node_axes": nc["node_axes"],
+            "float_total": nc["float_total"],
+            # self.market is always False here: __init__ rejects market
+            # pools (they stay on build_problem until bid re-sort lands).
+            "market": self._stable("market", np.bool_(self.market)),
+            "spot_cutoff": self._stable("spot_cutoff", np.asarray(self.spot_cutoff)),
+            "ban_mask": self._stable("ban_mask", ban_mask),
+            "node_total": nc["node_total"],
+            "node_type": nc["node_type"],
+            "node_ok": nc["node_ok"],
+        }
+
+        def materialize():
+            """Full host problem equal to what the scatter stream maintains
+            (called on first upload / fallback; also the test oracle).  Must
+            run before further builder mutations."""
+            g_valid_full = np.concatenate(
+                [sg.valid, rr.valid & rr.preempt, uc["g_valid"]]
+            )
+            g_absent_full = np.concatenate(
+                [~sg.valid, ~(rr.valid & rr.preempt), uc["g_absent"]]
+            )
+            run_gang_full = np.where(
+                rr.valid & rr.preempt,
+                (s_cap + np.arange(r_cap)).astype(np.int32),
+                np.int32(-1),
+            )
+            return SchedulingProblem(
+                node_total=nc["node_total"],
+                node_type=nc["node_type"],
+                node_ok=nc["node_ok"],
+                run_req=rr.req.copy(),
+                run_node=rr.node.copy(),
+                run_level=rr.level.copy(),
+                run_queue=rr.queue.copy(),
+                run_pc=rr.pc.copy(),
+                run_preemptible=rr.preempt.copy(),
+                run_gang=run_gang_full,
+                run_valid=rr.valid.copy(),
+                g_req=np.concatenate([sg.req, rr.req, uc["g_req"]]),
+                g_card=np.concatenate(
+                    [
+                        np.ones((s_cap,), np.int32),
+                        np.ones((r_cap,), np.int32),
+                        uc["g_card"],
+                    ]
+                ),
+                g_level=np.concatenate([sg.level, rr.level, uc["g_level"]]),
+                g_queue=np.concatenate([sg.queue, rr.queue, uc["g_queue"]]),
+                g_key=np.concatenate(
+                    [sg.key, np.full((r_cap,), -1, np.int32), uc["g_key"]]
+                ),
+                g_pc=np.concatenate([sg.pc, rr.pc, uc["g_pc"]]),
+                g_order=np.zeros((G,), np.int32),
+                g_run=np.concatenate(
+                    [
+                        np.full((s_cap,), -1, np.int32),
+                        np.arange(r_cap, dtype=np.int32),
+                        uc["g_run"],
+                    ]
+                ),
+                g_valid=g_valid_full,
+                g_absent=g_absent_full,
+                g_price=np.zeros((G,), np.float32),
+                g_spot_price=np.zeros((G,), np.float32),
+                gq_gang=gq_gang,
+                q_start=q_start,
+                q_len=q_len,
+                q_weight=fulls["q_weight"],
+                q_cds=q_cds,
+                q_penalty=fulls["q_penalty"],
+                compat=fulls["compat"],
+                total_pool=total_pool,
+                drf_mult=drf_mult,
+                inv_scale=nc["inv_scale"],
+                round_cap=nc["round_cap"],
+                pc_queue_cap=fulls["pc_queue_cap"],
+                protected_fraction=fulls["protected_fraction"],
+                global_burst=fulls["global_burst"],
+                perq_burst=fulls["perq_burst"],
+                node_axes=nc["node_axes"],
+                float_total=nc["float_total"],
+                market=fulls["market"],
+                spot_cutoff=fulls["spot_cutoff"],
+                ban_mask=fulls["ban_mask"],
+                g_ban_row=np.concatenate(
+                    [
+                        np.zeros((s_cap,), np.int32),
+                        np.zeros((r_cap,), np.int32),
+                        uc["g_ban_row"],
+                    ]
+                ),
+            )
+
+        sig = (
+            G,
+            r_cap,
+            N,
+            Q,
+            sg.epoch,
+            rr.epoch,
+            u_cap,
+            self._node_epoch,
+        )
+        seq = self._bundle_seq
+        self._bundle_seq += 1
+        bundle = DeltaBundle(
+            sig=sig,
+            seq=seq,
+            materialize=materialize,
+            ev_base=s_cap,
+            sg_idx=sg_idx,
+            sg_cols=sg_cols,
+            rr_idx=rr_dirty,
+            rr_cols=rr_cols,
+            ev_cols=ev_cols,
+            fulls=fulls,
+        )
+
+        class _SparseGroups:
+            __slots__ = ("_d",)
+
+            def __init__(self, d):
+                self._d = d
+
+            def __getitem__(self, i):
+                return self._d.get(i, "")
+
+        ctx = HostContext(
+            config=cfg,
+            pool=self.pool,
+            queue_names=list(self.queue_names),
+            node_ids=list(self.node_ids),
+            gang_members=None,
+            gang_group=_SparseGroups(group_of),
+            run_job_ids=None,
+            num_real_nodes=Nreal,
+            num_real_queues=Qreal,
+            num_real_gangs=G,
+            num_real_runs=r_cap,
+            ladder=self.ladder,
+            pc_names=list(self.pc_names),
+            max_slots=S_slots,
+            slot_width=W,
+            q_demand_raw=q_demand_raw,
+            pool_total_atoms={
+                name: int(round(float(total_pool64[i]) * self.factory.resolutions[i]))
+                for i, name in enumerate(self.factory.names)
+                if total_pool64[i]
+            },
+            # Snapshots, not views: a mutation landing between assemble and
+            # decode (slot reuse after remove) must not corrupt decode's ids
+            # (legacy assemble() snapshots too).  ~20ms at 1M gangs.
+            gang_ids_vec=self._g_ids.copy(),
+            gang_members_over=members_over,
+            run_ids_vec=rr.ids.copy(),
+        )
+        return bundle, ctx
 
     # ---------------------------------------------------- gang slow path ----
 

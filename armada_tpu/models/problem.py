@@ -71,6 +71,12 @@ class SchedulingProblem(NamedTuple):
     g_order: np.ndarray  # i32[G] rank within its queue (evictees first)
     g_run: np.ndarray  # i32[G] backing run for evictee slots, else -1
     g_valid: np.ndarray  # bool[G]
+    # Slot not part of THIS cycle's problem (slab free-list holes, jobs beyond
+    # the queue lookback, slack regions): the kernel marks these state 3
+    # (absent) instead of 2 (failed) so decode never reports them.  All-False
+    # under the legacy dense builders, whose padding is sliced off by
+    # num_real_gangs instead.
+    g_absent: np.ndarray  # bool[G]
     g_price: np.ndarray  # f32[G] bid price (market pools; 0 otherwise)
     # Minimum member bid: the spot price a crossing gang publishes
     # (queue_scheduler.go:138-144 takes the lowest member bid).
@@ -1131,6 +1137,7 @@ def build_problem(
         g_order=g_order,
         g_run=g_run,
         g_valid=g_valid,
+        g_absent=np.zeros_like(g_valid),
         g_price=g_price,
         g_spot_price=g_spot_price,
         gq_gang=gq_gang,

@@ -1,0 +1,312 @@
+"""Slot-stable problem slabs: O(deltas) device upload per scheduling cycle.
+
+The incremental builder's tables give O(1) *host* work per delta, but the
+dense problem they assemble is laid out positionally -- removals compact and
+inserts shift, so ~85% of the 1M-row job tensors change content every cycle
+and the device upload over the axon TPU tunnel (~16MB/s up) costs ~2s of the
+round (measured round 3; the reference's analog is keeping the jobDb cached
+between cycles, scheduler.go:240-246).
+
+This module fixes the layout: every queued single, running job, and gang
+unit owns a SLOT whose content never moves.  Slots are allocated from a
+free-list (no compaction, no shifts); candidate *order* is carried entirely
+by the per-cycle ``gq_gang`` permutation (small enough to re-upload whole).
+The gang axis is three fixed regions::
+
+    [ singles 0..s_cap | evictee slots s_cap..s_cap+r_cap | units ... ]
+
+The evictee region is a pure projection of the run slab (evictee slot i
+mirrors run slot i), so run-slot writes dirty both axes at once.  Slots not
+in the current cycle's problem (free-list holes, jobs beyond the queue
+lookback, unknown-queue rows, unit slack) are marked ``g_absent`` so the
+kernel gives them state 3 (absent), which decode ignores (fair_scheduler.py).
+
+Per cycle the builder emits a :class:`DeltaBundle` -- dirty slot ids + their
+rows, the rebuilt order/queue tensors, and scalars -- and
+:class:`DeviceDeltaCache` applies it to the device-resident problem with one
+jitted scatter program (device-to-device copies; XLA fuses the
+scatters, and on-device copy bandwidth makes them microseconds).
+Exactness: slot content is written once per logical row; demand is
+maintained in integral float64 (resolution units are integers, so
+incremental +=/-= is exact and order-independent).  The bundle carries a
+``materialize`` thunk building the complete host-side problem;
+tests/test_slab_delta.py pins that the scattered device state equals a
+fresh upload of it bit-for-bit, cycle after cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+_ID_DTYPE = "S48"
+
+# Dirty-index buckets: scatter index vectors are padded to these sizes so the
+# jitted apply program recompiles only on bucket crossings, not every cycle.
+_IDX_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+
+def _pad_bucket(n: int) -> int:
+    for b in _IDX_BUCKETS:
+        if n <= b:
+            return b
+    return n
+
+
+def _grow2(arr: np.ndarray, cap: int) -> np.ndarray:
+    out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def _pad_rows(arr: np.ndarray, k: int) -> np.ndarray:
+    if arr.shape[0] == k:
+        return arr
+    out = np.zeros((k,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+class RowSlab:
+    """Append-only columnar slot store with free-list reuse.
+
+    Content at a slot is immutable while the slot is held; ``write_batch``
+    marks slots dirty, ``release`` invalidates (valid=False) and returns to
+    the free-list.  ``epoch`` bumps when capacity grows: all content must
+    re-upload, and shapes changed anyway so the kernel recompiles."""
+
+    def __init__(self, num_resources: int, columns: dict, bucket: int):
+        self.R = num_resources
+        self.bucket = max(64, bucket)
+        self.cap = 0
+        self.hw = 0  # high-water mark
+        self.free: list[int] = []
+        self.epoch = 0
+        self._columns = dict(columns)  # name -> dtype (besides req/ids/valid)
+        self.req = np.zeros((0, num_resources), np.float32)
+        self.ids = np.zeros((0,), _ID_DTYPE)
+        self.valid = np.zeros((0,), bool)
+        for name, dt in self._columns.items():
+            setattr(self, name, np.zeros((0,), dt))
+        # Mutation log of dirtied slots; assemble_delta drains and clears
+        # it once per cycle (single consumer; a skipped bundle is caught by
+        # the DeltaBundle seq guard and forces a full upload).
+        self.dirty_log: list[int] = []
+
+    def _grow(self, need: int) -> None:
+        new_cap = self.cap
+        while new_cap < need:
+            new_cap += self.bucket
+        self.req = _grow2(self.req, new_cap)
+        self.ids = _grow2(self.ids, new_cap)
+        self.valid = _grow2(self.valid, new_cap)
+        for name in self._columns:
+            setattr(self, name, _grow2(getattr(self, name), new_cap))
+        self.cap = new_cap
+        self.epoch += 1
+
+    def alloc(self, n: int = 1) -> np.ndarray:
+        take = min(n, len(self.free))
+        slots = [self.free.pop() for _ in range(take)]
+        fresh = n - take
+        if fresh:
+            if self.hw + fresh > self.cap:
+                self._grow(self.hw + fresh)
+            slots.extend(range(self.hw, self.hw + fresh))
+            self.hw += fresh
+        return np.asarray(slots, np.int64)
+
+    def write_batch(self, slots: np.ndarray, ids, reqs, **cols) -> None:
+        self.req[slots] = reqs
+        self.ids[slots] = ids
+        self.valid[slots] = True
+        for name, vals in cols.items():
+            getattr(self, name)[slots] = vals
+        self.dirty_log.extend(int(s) for s in slots)
+
+    def release(self, slot: int) -> None:
+        self.valid[slot] = False
+        self.ids[slot] = b""
+        self.free.append(slot)
+        self.dirty_log.append(slot)
+
+    def set_valid(self, slots: np.ndarray, value) -> None:
+        """Participation flips (lookback/queue/node filters); content stays."""
+        if len(slots):
+            self.valid[slots] = value
+            self.dirty_log.extend(int(s) for s in slots)
+
+
+@dataclasses.dataclass
+class DeltaBundle:
+    """One cycle's device update.
+
+    `sig` guards shape/epoch compatibility: a mismatch with the device
+    cache's stored sig (slab growth, node-fleet change, first cycle) falls
+    back to a full upload via `materialize()`.  `materialize` is a thunk
+    building the complete current host-side SchedulingProblem -- the ground
+    truth the scatter path must reproduce exactly.  It closes over live
+    slab state: call it before any further builder mutation."""
+
+    sig: tuple
+    seq: int  # consecutive-cycle guard: a skipped bundle forces full upload
+    materialize: object  # () -> SchedulingProblem of host arrays (ground truth)
+    ev_base: int  # gang-axis offset of the evictee region (= s_cap)
+    sg_idx: np.ndarray  # gang-axis dirty slots (singles + units regions)
+    sg_cols: dict  # field -> rows at sg_idx
+    rr_idx: np.ndarray  # run-axis dirty slots
+    rr_cols: dict  # run_* field -> rows at rr_idx
+    ev_cols: dict  # evictee g-row field -> rows at ev_base + rr_idx
+    fulls: dict  # field name -> host array re-uploaded whole (identity-skipped)
+
+    def stats_view(self):
+        """The small host tensors run_round_on_device / queue-stats read
+        (problem.market, q_weight, ...) without materializing the problem."""
+        import types
+
+        f = self.fulls
+        return types.SimpleNamespace(
+            market=f["market"],
+            q_weight=f["q_weight"],
+            q_cds=f["q_cds"],
+            total_pool=f["total_pool"],
+            drf_mult=f["drf_mult"],
+            q_penalty=f["q_penalty"],
+        )
+
+
+# Node-axis fields: identity-cached (same array objects across cycles while
+# the fleet is unchanged), re-uploaded only on node-epoch change.
+_NODE_FIELDS = ("node_total", "node_type", "node_ok", "compat")
+
+_SG_FIELDS = (
+    "g_req", "g_card", "g_level", "g_queue", "g_key", "g_pc", "g_run",
+    "g_valid", "g_absent", "g_price", "g_spot_price", "g_ban_row",
+)
+_RR_FIELDS = (
+    "run_req", "run_node", "run_level", "run_queue", "run_pc",
+    "run_preemptible", "run_gang", "run_valid",
+)
+_EV_FIELDS = (
+    "g_req", "g_level", "g_queue", "g_pc", "g_run", "g_valid", "g_absent",
+    "g_price", "g_spot_price",
+)
+
+
+def _make_apply():
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("ev_base",))
+    def apply_delta(prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls, *, ev_base):
+        """Scatter one cycle's dirty rows into the device-resident problem.
+
+        Index vectors are bucket-padded; padding entries carry sentinel G
+        (gang axis) / RJ (run axis) and are dropped (scatter mode='drop';
+        the evictee projection maps run sentinels to G explicitly so they
+        cannot land on the units region)."""
+        import jax.numpy as jnp
+
+        out = prev._asdict()
+        G = prev.g_req.shape[0]
+        RJ = prev.run_req.shape[0]
+        out.update(fulls)
+        for name in _SG_FIELDS:
+            out[name] = out[name].at[sg_idx].set(sg_cols[name], mode="drop")
+        for name in _RR_FIELDS:
+            out[name] = out[name].at[rr_idx].set(rr_cols[name], mode="drop")
+        ev_idx = jnp.where(rr_idx >= RJ, G, rr_idx + ev_base)
+        for name in _EV_FIELDS:
+            out[name] = out[name].at[ev_idx].set(ev_cols[name], mode="drop")
+        return type(prev)(**out)
+
+    return apply_delta
+
+
+_APPLY = None
+
+
+class DeviceDeltaCache:
+    """Device-resident SchedulingProblem updated by DeltaBundle scatters.
+
+    Falls back to a full upload whenever the bundle's shape/epoch signature
+    changes (slab growth, node-fleet change) or a bundle was skipped."""
+
+    def __init__(self):
+        self._sig = None
+        self._seq = None
+        self._prev = None
+        # host-object identity of what is currently on device, per field;
+        # node tensors also keep their device copy for reuse across full
+        # uploads (the fleet rarely changes).
+        self._host_ids: dict = {}
+        self._node_dev: dict = {}
+
+    def _full_upload(self, problem):
+        import jax.numpy as jnp
+
+        out = []
+        for name, arr in zip(problem._fields, problem):
+            if (
+                name in _NODE_FIELDS
+                and self._host_ids.get(name) is arr
+                and name in self._node_dev
+            ):
+                out.append(self._node_dev[name])
+            else:
+                dev = jnp.asarray(arr)
+                if name in _NODE_FIELDS:
+                    self._node_dev[name] = dev
+                out.append(dev)
+            self._host_ids[name] = arr
+        self._prev = type(problem)(*out)
+        return self._prev
+
+    def apply(self, bundle: DeltaBundle):
+        global _APPLY
+
+        if (
+            self._sig != bundle.sig
+            or self._prev is None
+            or self._seq is None
+            or bundle.seq != self._seq + 1
+        ):
+            self._sig = bundle.sig
+            self._seq = bundle.seq
+            return self._full_upload(bundle.materialize())
+        self._seq = bundle.seq
+
+        G = self._prev.g_req.shape[0]
+        RJ = self._prev.run_req.shape[0]
+        kg = _pad_bucket(bundle.sg_idx.shape[0])
+        kr = _pad_bucket(bundle.rr_idx.shape[0])
+        sg_idx = np.full((kg,), G, np.int32)
+        sg_idx[: bundle.sg_idx.shape[0]] = bundle.sg_idx
+        rr_idx = np.full((kr,), RJ, np.int32)
+        rr_idx[: bundle.rr_idx.shape[0]] = bundle.rr_idx
+        sg_cols = {n: _pad_rows(bundle.sg_cols[n], kg) for n in _SG_FIELDS}
+        rr_cols = {n: _pad_rows(bundle.rr_cols[n], kr) for n in _RR_FIELDS}
+        ev_cols = {n: _pad_rows(bundle.ev_cols[n], kr) for n in _EV_FIELDS}
+        import jax.numpy as jnp
+
+        fulls = {}
+        for name, arr in bundle.fulls.items():
+            if self._host_ids.get(name) is arr:
+                continue  # unchanged object, device copy is current
+            if name in _NODE_FIELDS:
+                # keep the reusable device copy current, else a later full
+                # upload would resurrect a stale buffer via _node_dev
+                dev = jnp.asarray(np.asarray(arr))
+                self._node_dev[name] = dev
+                fulls[name] = dev
+            else:
+                fulls[name] = np.asarray(arr)
+            self._host_ids[name] = arr
+        if _APPLY is None:
+            _APPLY = _make_apply()
+        self._prev = _APPLY(
+            self._prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls,
+            ev_base=bundle.ev_base,
+        )
+        return self._prev

@@ -265,6 +265,7 @@ def synthetic_problem(
         g_order=g_order,
         g_run=g_run,
         g_valid=g_valid,
+        g_absent=np.zeros_like(g_valid),
         g_price=np.zeros((G,), np.float32),
         g_spot_price=np.zeros((G,), np.float32),
         gq_gang=gq_gang,

@@ -94,6 +94,7 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         g_order=jobsax,
         g_run=jobsax,
         g_valid=jobsax,
+        g_absent=jobsax,
         g_price=jobsax,
         g_spot_price=jobsax,
         # gq_gang is read-only index data gathered with [Q,W] indices every

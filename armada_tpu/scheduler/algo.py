@@ -360,19 +360,23 @@ class FairSchedulingAlgo:
                 if not num_queued and not num_running:
                     continue
                 g_tokens, q_tokens = round_tokens()
-                problem, ctx = b.assemble(
+                # Slot-stable slab deltas: O(deltas) device upload per cycle
+                # (models/slab.py); the round runs on the device-resident
+                # problem the cache keeps current by scatter.
+                bundle, ctx = b.assemble_delta(
                     global_tokens=g_tokens,
                     queue_tokens=q_tokens,
                     queue_penalty=penalty_by_pool.get(pool),
                 )
+                pview = bundle.stats_view()
                 res, outcome = run_round_on_device(
-                    problem,
+                    pview,
                     ctx,
                     self.config,
-                    device_problem=self.feed.devcache_for(pool).put(problem),
+                    device_problem=self.feed.devcache_for(pool).apply(bundle),
                 )
                 if self.collect_stats:
-                    collect_round_stats(res, problem, ctx, self.config, outcome)
+                    collect_round_stats(res, pview, ctx, self.config, outcome)
             else:
                 running = running_by_pool.get(pool, [])
                 if not queued_jobs and not running:

@@ -26,7 +26,8 @@ from typing import Optional
 from armada_tpu.core.config import SchedulingConfig
 from armada_tpu.core.types import RunningJob
 from armada_tpu.jobdb.job import Job
-from armada_tpu.models.incremental import DeviceProblemCache, IncrementalBuilder
+from armada_tpu.models.incremental import IncrementalBuilder
+from armada_tpu.models.slab import DeviceDeltaCache
 
 
 class IncrementalProblemFeed:
@@ -41,7 +42,7 @@ class IncrementalProblemFeed:
         self.config = config
         self._market_pools = {p.name for p in config.pools if p.market_driven}
         self.builders: dict[str, IncrementalBuilder] = {}
-        self.devcaches: dict[str, DeviceProblemCache] = {}
+        self.devcaches: dict[str, DeviceDeltaCache] = {}
         # queued job ids with an explicit pools restriction: the away pass's
         # candidate set (scheduling_algo.go:216-283) without a backlog scan.
         self.pool_restricted: set[str] = set()
@@ -57,7 +58,7 @@ class IncrementalProblemFeed:
         for p in config.pools:
             if not p.market_driven:
                 self.builders[p.name] = IncrementalBuilder(config, p.name)
-                self.devcaches[p.name] = DeviceProblemCache()
+                self.devcaches[p.name] = DeviceDeltaCache()
 
     def attach(self, jobdb) -> None:
         self._jobdb = jobdb
@@ -78,7 +79,7 @@ class IncrementalProblemFeed:
         for p in self.config.pools:
             if not p.market_driven:
                 self.builders[p.name] = IncrementalBuilder(self.config, p.name)
-                self.devcaches[p.name] = DeviceProblemCache()
+                self.devcaches[p.name] = DeviceDeltaCache()
         if self._jobdb is not None:
             pending = {}
             for job in self._jobdb.read_txn().all_jobs():
@@ -92,7 +93,7 @@ class IncrementalProblemFeed:
         if b is None:
             b = IncrementalBuilder(self.config, pool)
             self.builders[pool] = b
-            self.devcaches[pool] = DeviceProblemCache()
+            self.devcaches[pool] = DeviceDeltaCache()
             if txn is not None:
                 # Late pool discovery (a node snapshot introduced a pool not
                 # in config): one-time backfill scan.
@@ -102,7 +103,7 @@ class IncrementalProblemFeed:
                 self._flush(pending)
         return b
 
-    def devcache_for(self, pool: str) -> DeviceProblemCache:
+    def devcache_for(self, pool: str) -> DeviceDeltaCache:
         return self.devcaches[pool]
 
     # ------------------------------------------------------------ deltas ----

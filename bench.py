@@ -173,6 +173,7 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
     from armada_tpu.core.types import RunningJob
     from armada_tpu.models import decode_result
     from armada_tpu.models.incremental import DeviceProblemCache, IncrementalBuilder
+    from armada_tpu.models.slab import DeviceDeltaCache
     from armada_tpu.models.synthetic import synthetic_world
 
     config, nodes, queues, specs, running, spec_factory = synthetic_world(
@@ -194,14 +195,22 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
     )
     spec_of = {s.id: s for s in specs}
     kw = None
-    devcache = DeviceProblemCache()
+    # Slot-stable slab deltas by default (O(deltas) upload per cycle); the
+    # legacy dense rebuild+full-upload path stays behind a knob for A/B.
+    legacy_build = os.environ.get("ARMADA_BENCH_LEGACY_BUILD") == "1"
+    devcache = DeviceProblemCache() if legacy_build else DeviceDeltaCache()
 
     def cycle(t_now):
         nonlocal kw
         t_start = time.perf_counter()
-        problem, ctx = builder.assemble()
-        t_asm = time.perf_counter()
-        dev = devcache.put(problem)
+        if legacy_build:
+            problem, ctx = builder.assemble()
+            t_asm = time.perf_counter()
+            dev = devcache.put(problem)
+        else:
+            bundle, ctx = builder.assemble_delta()
+            t_asm = time.perf_counter()
+            dev = devcache.apply(bundle)
         kw = dict(
             num_levels=len(ctx.ladder) + 2,
             max_slots=ctx.max_slots,

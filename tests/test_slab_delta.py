@@ -1,0 +1,266 @@
+"""Slab delta path equivalence: assemble_delta + DeviceDeltaCache must be
+indistinguishable from the legacy assemble() dense build, cycle after cycle.
+
+Two invariants:
+
+1. *Outcome equality*: the same mutation feed driven through a legacy
+   builder (assemble -> full upload -> schedule_round -> decode) and a slab
+   builder (assemble_delta -> scatter apply -> schedule_round -> decode)
+   yields identical RoundOutcomes every cycle -- scheduled map, preempted/
+   rescheduled/failed sets, termination.
+
+2. *Scatter == materialize*: after each delta apply, the device-resident
+   problem is bit-identical to a fresh upload of bundle.materialize() --
+   the scatter stream reproduces the ground truth exactly (no drift).
+
+The scenario exercises submits, scheduling removals + leases, preemptions,
+cancels mid-queue, reprioritisation, gang units (incl. a retry-banned
+job), queue deletion, node removal, and a tight lookback that truncates a
+queue (absent-slot handling).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.models import SchedulingProblem, decode_result, schedule_round
+from armada_tpu.models.incremental import IncrementalBuilder
+from armada_tpu.models.slab import DeviceDeltaCache
+
+
+def make_config(lookback=100_000):
+    return SchedulingConfig(
+        shape_bucket=64,
+        priority_classes={
+            "low": PriorityClass("low", priority=100, preemptible=True),
+            "high": PriorityClass("high", priority=1000, preemptible=False),
+        },
+        default_priority_class="high",
+        max_queue_lookback=lookback,
+        maximum_scheduling_burst=16,
+    )
+
+
+def make_world(cfg, rng, num_nodes=12, num_queues=3):
+    F = cfg.resource_list_factory()
+    nodes = [
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": "16", "memory": "64"}),
+        )
+        for i in range(num_nodes)
+    ]
+    queues = [Queue(f"q{i}", weight=1.0 + i) for i in range(num_queues)]
+    return F, nodes, queues
+
+
+def make_job(F, i, queue, pc="high", cpu=2, gang=None, sub=None):
+    return JobSpec(
+        id=f"j{i}",
+        queue=queue,
+        priority_class=pc,
+        submit_time=float(i if sub is None else sub),
+        resources=F.from_mapping({"cpu": str(cpu), "memory": "1"}),
+        gang_id=gang or "",
+        gang_cardinality=2 if gang else 0,
+    )
+
+
+class DualDriver:
+    """Drives the same mutations through a legacy and a slab builder."""
+
+    def __init__(self, cfg, queues, nodes):
+        self.legacy = IncrementalBuilder(cfg, "default", queues)
+        self.slab = IncrementalBuilder(cfg, "default", queues)
+        for b in (self.legacy, self.slab):
+            b.set_nodes(nodes)
+        self.cache = DeviceDeltaCache()
+        self.full_uploads = 0
+        orig = self.cache._full_upload
+
+        def counting(problem):
+            self.full_uploads += 1
+            return orig(problem)
+
+        self.cache._full_upload = counting
+
+    def each(self, fn):
+        fn(self.legacy)
+        fn(self.slab)
+
+    def cycle(self, check_bits=True):
+        problem, lctx = self.legacy.assemble()
+        ldev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+        lres = schedule_round(
+            ldev,
+            num_levels=len(lctx.ladder) + 2,
+            max_slots=lctx.max_slots,
+            slot_width=lctx.slot_width,
+        )
+        lout = decode_result(lres, lctx)
+
+        bundle, sctx = self.slab.assemble_delta()
+        sdev = self.cache.apply(bundle)
+        if check_bits:
+            truth = bundle.materialize()
+            for name, dev_arr, host_arr in zip(sdev._fields, sdev, truth):
+                np.testing.assert_array_equal(
+                    np.asarray(dev_arr),
+                    np.asarray(host_arr),
+                    err_msg=f"scatter drift in field {name}",
+                )
+        sres = schedule_round(
+            sdev,
+            num_levels=len(sctx.ladder) + 2,
+            max_slots=sctx.max_slots,
+            slot_width=sctx.slot_width,
+        )
+        sout = decode_result(sres, sctx)
+
+        assert sout.scheduled == lout.scheduled
+        assert sorted(sout.preempted) == sorted(lout.preempted)
+        assert sorted(sout.rescheduled) == sorted(lout.rescheduled)
+        assert sorted(sout.failed) == sorted(lout.failed)
+        assert sout.termination == lout.termination
+        return lout
+
+
+def apply_outcome(driver, out, spec_of, t):
+    """Feed decisions back like the scheduler does."""
+    leases = []
+    for jid, nid in out.scheduled.items():
+        spec = spec_of.get(jid)
+        driver.each(lambda b: b.remove(jid))
+        if spec is not None:
+            leases.append(RunningJob(job=spec, node_id=nid))
+    driver.each(lambda b: b.lease_many(leases))
+    for jid in out.preempted:
+        driver.each(lambda b: b.unlease(jid))
+
+
+def test_slab_delta_matches_legacy_over_cycles():
+    rng = np.random.default_rng(11)
+    cfg = make_config()
+    F, nodes, queues = make_world(cfg, rng)
+    d = DualDriver(cfg, queues, nodes)
+    spec_of = {}
+    next_id = 0
+
+    def submit(n, queue, pc="high", cpu=2, gang=None):
+        nonlocal next_id
+        out = []
+        for _ in range(n):
+            s = make_job(F, next_id, queue, pc=pc, cpu=cpu, gang=gang)
+            spec_of[s.id] = s
+            out.append(s)
+            next_id += 1
+        d.each(lambda b: b.submit_many(out))
+        return out
+
+    # preemptible background load hogging two nodes
+    hogs = []
+    for i in range(4):
+        s = make_job(F, 10_000 + i, "q0", pc="low", cpu=8, sub=0)
+        spec_of[s.id] = s
+        hogs.append(s)
+    d.each(lambda b: b.lease_many(
+        [RunningJob(job=s, node_id=f"n{i // 2}") for i, s in enumerate(hogs)]
+    ))
+
+    submit(10, "q0")
+    submit(8, "q1", cpu=3)
+    submit(6, "q2", pc="low")
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 1)
+
+    # gang unit + a retry-banned single (slow path)
+    gang_jobs = submit(2, "q1", gang="gang-a")
+    banned = make_job(F, 20_000, "q2", cpu=2)
+    spec_of[banned.id] = banned
+    d.each(lambda b: b.submit(banned, banned_nodes=["n0", "n1"]))
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 2)
+
+    # churn: cancels mid-queue, reprioritisation, more submits
+    victims = [jid for jid in list(spec_of) if jid.startswith("j")][:3]
+    for jid in victims:
+        d.each(lambda b: b.remove(jid))
+        spec_of.pop(jid, None)
+    repri = next(iter([s for s in spec_of.values() if s.queue == "q1"]), None)
+    if repri is not None:
+        bumped = JobSpec(
+            id=repri.id, queue=repri.queue, priority_class=repri.priority_class,
+            submit_time=repri.submit_time, resources=repri.resources,
+            priority=50,
+        )
+        spec_of[bumped.id] = bumped
+        d.each(lambda b: b.reprioritise(bumped))
+    submit(5, "q2")
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 3)
+
+    # queue deletion + node removal
+    d.each(lambda b: b.set_queues([Queue("q0", weight=1.0), Queue("q1", weight=2.0)]))
+    d.each(lambda b: b.set_nodes(
+        [n for n in nodes if n.id != "n3"]
+    ))
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 4)
+
+    # restore + more cycles
+    d.each(lambda b: b.set_queues(queues))
+    d.each(lambda b: b.set_nodes(nodes))
+    submit(6, "q2", pc="low", cpu=1)
+    for t in range(5, 8):
+        out = d.cycle()
+        apply_outcome(d, out, spec_of, t)
+
+    # The delta path must actually be exercised: full uploads only on shape
+    # or epoch changes (first cycle + slab growths + node epoch bumps), not
+    # every cycle.
+    assert d.full_uploads < 7, f"delta path never engaged ({d.full_uploads} full uploads)"
+
+
+def test_slab_delta_lookback_truncation():
+    """A queue longer than the lookback: beyond-lookback jobs become absent
+    slots (not failed), and re-enter exactly when the queue drains."""
+    cfg = make_config(lookback=6)
+    rng = np.random.default_rng(5)
+    F, nodes, queues = make_world(cfg, rng, num_nodes=4, num_queues=2)
+    d = DualDriver(cfg, queues, nodes)
+    spec_of = {}
+    jobs = []
+    for i in range(14):
+        s = make_job(F, i, "q0", cpu=4)
+        spec_of[s.id] = s
+        jobs.append(s)
+    d.each(lambda b: b.submit_many(jobs))
+    for t in range(4):
+        out = d.cycle()
+        # beyond-lookback jobs must never be reported failed
+        assert not list(out.failed)
+        apply_outcome(d, out, spec_of, t)
+
+
+def test_bundle_seq_gap_forces_full_upload():
+    cfg = make_config()
+    rng = np.random.default_rng(7)
+    F, nodes, queues = make_world(cfg, rng)
+    b = IncrementalBuilder(cfg, "default", queues)
+    b.set_nodes(nodes)
+    b.submit_many([make_job(F, i, "q0") for i in range(5)])
+    cache = DeviceDeltaCache()
+    bundle, _ = b.assemble_delta()
+    cache.apply(bundle)
+    skipped, _ = b.assemble_delta()  # never applied
+    b.submit_many([make_job(F, 100, "q1")])
+    bundle3, ctx3 = b.assemble_delta()
+    dev = cache.apply(bundle3)
+    truth = bundle3.materialize()
+    for name, dev_arr, host_arr in zip(dev._fields, dev, truth):
+        np.testing.assert_array_equal(
+            np.asarray(dev_arr), np.asarray(host_arr), err_msg=name
+        )
