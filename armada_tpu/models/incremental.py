@@ -24,6 +24,15 @@ slices fall out of the stored order instead of a lexsort (a string-keyed
 lexsort at 1M rows costs ~3.5s -- measured; keeping the order is ~30x cheaper
 than recreating it).
 
+Market-driven pools order by (-bid_price, submit_time, id) instead
+(scheduling/market_iterator.go:245), and prices move between cycles -- but a
+job's price BAND is immutable and the price is a function of (queue, band)
+(pkg/bidstore).  So market tables sort by (queue, band, submit_time, id): the
+stored order is cycle-stable, and the per-cycle "bid re-sort" reduces to
+permuting whole contiguous (queue, band) slices by current price
+(`_market_perm`), O(bands) bookkeeping + one index gather -- never a row
+sort.  Bands tied on price are merged exactly by (submit_time, id).
+
 Gang jobs and retry-banned jobs ride a small per-cycle Python path (they are
 a sliver of a 1M-job backlog); singleton jobs never touch Python after
 submission.
@@ -59,7 +68,8 @@ def _grow(arr: np.ndarray, new_cap: int) -> np.ndarray:
 
 
 class _SortedTable:
-    """Columnar store kept sorted by (qi, npc, prio, sub, id).
+    """Columnar store kept sorted by `sort_cols` (default
+    (qi, npc, prio, sub, id); market tables use (qi, band, sub, id)).
 
     `extra` declares additional numeric columns beyond the sort key and the
     [*, R] request matrix.  Rows are located by binary refinement on the sort
@@ -71,10 +81,18 @@ class _SortedTable:
 
     _SORT_COLS = ("qi", "npc", "prio", "sub", "ids")
 
-    def __init__(self, num_resources: int, extra: Mapping[str, np.dtype], cap: int = 1024):
+    def __init__(
+        self,
+        num_resources: int,
+        extra: Mapping[str, np.dtype],
+        cap: int = 1024,
+        sort_cols: tuple = _SORT_COLS,
+    ):
         self.R = num_resources
         self.n = 0
         self.dead = 0
+        assert sort_cols[0] == "qi" and sort_cols[-1] == "ids"
+        self.sort_cols = tuple(sort_cols)
         self.ids = np.zeros((cap,), _ID_DTYPE)
         self.qi = np.zeros((cap,), np.int32)
         self.npc = np.zeros((cap,), np.int64)
@@ -85,8 +103,8 @@ class _SortedTable:
         for name, dt in extra.items():
             setattr(self, name, np.zeros((cap,), dt))
         self.req = np.zeros((cap, num_resources), np.float32)
-        # id -> (qi, npc, prio, sub): enough to re-find the row by binary
-        # search; also the membership test.
+        # id -> sort_cols[:-1] column values: enough to re-find the row by
+        # binary search; also the membership test.
         self.key_of_id: dict[bytes, tuple] = {}
 
     def _cols(self):
@@ -100,12 +118,9 @@ class _SortedTable:
         if key is None:
             return None
         lo, hi = 0, self.n
-        for col, v in (
-            (self.qi, key[0]),
-            (self.npc, key[1]),
-            (self.prio, key[2]),
-            (self.sub, key[3]),
-            (self.ids, jid),
+        for col, v in zip(
+            [getattr(self, c) for c in self.sort_cols],
+            key + (jid,),
         ):
             a = col[lo:hi]
             # The probe MUST match the column dtype: searchsorted with e.g. a
@@ -123,15 +138,10 @@ class _SortedTable:
                 return row
         return None
 
-    def _position(self, qi, npc, prio, sub, jid) -> int:
+    def _position(self, row: Mapping) -> int:
         lo, hi = 0, self.n
-        for col, v in (
-            (self.qi, qi),
-            (self.npc, npc),
-            (self.prio, prio),
-            (self.sub, sub),
-            (self.ids, jid),
-        ):
+        for c in self.sort_cols:
+            col, v = getattr(self, c), row[c]
             a = col[lo:hi]
             v = a.dtype.type(v)  # see _locate: dtype mismatch copies the column
             lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
@@ -144,12 +154,10 @@ class _SortedTable:
         np.insert per column for the whole batch."""
         if not rows:
             return
+        scols = self.sort_cols
         order = sorted(
             range(len(rows)),
-            key=lambda i: (
-                rows[i]["qi"], rows[i]["npc"], rows[i]["prio"], rows[i]["sub"],
-                rows[i]["ids"],
-            ),
+            key=lambda i: tuple(rows[i][c] for c in scols),
         )
         rows = [rows[i] for i in order]
         reqs = [reqs[i] for i in order]
@@ -158,13 +166,7 @@ class _SortedTable:
             # the table.
             pos = np.zeros((len(rows),), np.int64)
         else:
-            pos = np.array(
-                [
-                    self._position(r["qi"], r["npc"], r["prio"], r["sub"], r["ids"])
-                    for r in rows
-                ],
-                np.int64,
-            )
+            pos = np.array([self._position(r) for r in rows], np.int64)
         live = slice(0, self.n)
         for c in self._cols():
             cur = getattr(self, c)
@@ -176,7 +178,7 @@ class _SortedTable:
         self.req = np.insert(self.req[live], pos, np.stack(reqs), axis=0)
         self.n += len(rows)
         for r in rows:
-            self.key_of_id[r["ids"]] = (r["qi"], r["npc"], r["prio"], r["sub"])
+            self.key_of_id[r["ids"]] = tuple(r[c] for c in scols[:-1])
 
     def remove(self, jid: bytes) -> Optional[dict]:
         """Tombstone the row; returns its column values (qi + extras + req
@@ -238,15 +240,15 @@ class IncrementalBuilder:
             if self.market and pool_cfg is not None and pool_cfg.spot_price_cutoff > 0
             else _INF
         )
-        if self.market:
-            # Market pools order the backlog by bid price, which moves every
-            # cycle -- incompatible with a sorted-between-cycles table (the
-            # whole point of this module).  They stay on the per-cycle
-            # builder; price-band columns are stored anyway as the seam for
-            # a future sorted-by-band variant.
-            raise ValueError(
-                f"pool {pool} is market driven: use models.problem.build_problem"
-            )
+        # Market pools sort by (queue, band, submit, id) -- see module
+        # docstring: the band is immutable per job, so the stored order is
+        # cycle-stable and the per-cycle bid re-sort is a permutation of
+        # contiguous band slices by current price (_market_perm).
+        self._sort_cols = (
+            ("qi", "band", "sub", "ids")
+            if self.market
+            else _SortedTable._SORT_COLS
+        )
         self.bid_price_of = bid_price_of
 
         self.ladder = config.priority_ladder()
@@ -269,6 +271,7 @@ class IncrementalBuilder:
                 "band": np.int32,
                 "slot": np.int32,
             },
+            sort_cols=self._sort_cols,
         )
         self.runs = _SortedTable(
             self.R,
@@ -281,6 +284,7 @@ class IncrementalBuilder:
                 "slot": np.int32,
             },
             cap=256,
+            sort_cols=self._sort_cols,
         )
         # Slot-stable slabs mirroring the tables (models/slab.py): device
         # content lives at a fixed slot per job/run so the per-cycle upload
@@ -326,6 +330,13 @@ class IncrementalBuilder:
         # Bundle sequencing for the single DeviceDeltaCache consumer (a
         # skipped bundle forces its full-upload fallback).
         self._bundle_seq = 0
+        # Market: g_price is a function of per-slot (queue, band) and the
+        # per-cycle price table; a price MOVE invalidates every slot's price
+        # at once, so it bumps an epoch in the bundle sig and rides the
+        # device cache's full-upload fallback (cheap: providers re-price at
+        # poll granularity, not per 1s cycle; unchanged prices cost nothing).
+        self._last_prices: Optional[np.ndarray] = None
+        self._price_epoch = 0
         # Identity-stable small tensors (re-sent only when values change).
         self._stable_smalls: dict[str, np.ndarray] = {}
         self.gang_jobs: dict[str, JobSpec] = {}  # job id -> spec (slow path)
@@ -779,6 +790,54 @@ class IncrementalBuilder:
                 table[qi, bi] = float(self.bid_price_of(_BandProbe(qname, band)))
         return table
 
+    def _market_perm(
+        self, table: _SortedTable, rows: np.ndarray, prices: np.ndarray
+    ) -> np.ndarray:
+        """Permutation of `rows` (live rows in stored (qi, band, sub, id)
+        order) into the market serving order (qi, -price, sub, id)
+        (market_iterator.go:245 orders by price, then submit time, then id).
+
+        Rows within one (queue, band) slice are already (sub, id)-sorted, so
+        the "re-sort" moves WHOLE contiguous slices by current price:
+        O(#slices log #slices) keys + one index gather.  Only bands tied on
+        price need an exact (sub, id) row merge."""
+        n = rows.shape[0]
+        if n == 0:
+            return np.zeros((0,), np.int64)
+        q = table.qi[rows].astype(np.int64)
+        b = table.band[rows].astype(np.int64)
+        new_grp = np.empty(n, bool)
+        new_grp[0] = True
+        np.logical_or(q[1:] != q[:-1], b[1:] != b[:-1], out=new_grp[1:])
+        gstart = np.flatnonzero(new_grp)
+        glen = np.diff(np.append(gstart, n))
+        gq = q[gstart]
+        gp = prices[gq, b[gstart]]
+        # groups by (queue, -price, band): the band tiebreak is provisional,
+        # fixed to the exact (sub, id) merge below
+        gorder = np.lexsort((b[gstart], -gp, gq))
+        lens = glen[gorder]
+        new_start = np.zeros(gorder.shape[0], np.int64)
+        if gorder.shape[0] > 1:
+            new_start[1:] = np.cumsum(lens)[:-1]
+        perm = np.repeat(gstart[gorder] - new_start, lens) + np.arange(n)
+        oq, op = gq[gorder], gp[gorder]
+        tie = np.flatnonzero((oq[1:] == oq[:-1]) & (op[1:] == op[:-1]))
+        k = 0
+        while k < tie.size:
+            # run of consecutive tied groups [j0, j1] in the new order
+            j0 = int(tie[k])
+            j1 = j0 + 1
+            while k + 1 < tie.size and int(tie[k + 1]) == int(tie[k]) + 1:
+                k += 1
+                j1 = int(tie[k]) + 1
+            k += 1
+            lo, hi = int(new_start[j0]), int(new_start[j1] + lens[j1])
+            seg = perm[lo:hi]
+            r = rows[seg]
+            perm[lo:hi] = seg[np.lexsort((table.ids[r], table.sub[r]))]
+        return perm
+
     def assemble(
         self,
         *,
@@ -814,10 +873,13 @@ class IncrementalBuilder:
         node_ok = nc["node_ok"]
 
         # --- singles: live rows, already in (queue, order-key) order ----------
+        prices = self._prices()  # market: per-cycle (queue, band) bid table
         jt = self.jobs
         rows = jt.live_rows()
         if Qreal and not self.queue_known.all():
             rows = rows[self.queue_known[jt.qi[rows]]]
+        if prices is not None:
+            rows = rows[self._market_perm(jt, rows, prices)]
         sq = jt.qi[rows].astype(np.int64)
         counts_s = np.bincount(sq, minlength=Qreal)
         starts_s = np.zeros((max(1, Qreal),), np.int64)
@@ -826,7 +888,7 @@ class IncrementalBuilder:
         rank_s = np.arange(rows.shape[0], dtype=np.int64) - starts_s[sq]
 
         # --- slow path: gang units + banned singles ---------------------------
-        units, unit_members, unit_ubans = self._gang_units()
+        units, unit_members, unit_ubans = self._gang_units(prices)
 
         # Merge units into the per-queue order.  Every element's merged rank
         # is unique within its queue; the lookback cap and atomic split-gang
@@ -886,6 +948,10 @@ class IncrementalBuilder:
         rq = rt.qi[run_rows].astype(np.int64)
         ev_mask = rt.preempt[run_rows]
         ev_rows = run_rows[ev_mask]
+        if prices is not None:
+            # evictees order among themselves by the same market comparator
+            # (build_problem's evictee sort)
+            ev_rows = ev_rows[self._market_perm(rt, ev_rows, prices)]
         evq = rt.qi[ev_rows].astype(np.int64)
         counts_e = np.bincount(evq, minlength=Qreal)
         starts_e = np.zeros((max(1, Qreal),), np.int64)
@@ -908,8 +974,6 @@ class IncrementalBuilder:
         g_valid = np.zeros((G,), bool)
         g_price = np.zeros((G,), np.float32)
         g_spot = np.zeros((G,), np.float32)
-
-        prices = self._prices()
 
         RJ = _pad(nr, bucket)
         run_req = np.zeros((RJ, R), np.float32)
@@ -1236,9 +1300,12 @@ class IncrementalBuilder:
 
         Candidate order, demand and outcomes are identical to assemble() --
         only the gang/run axis layout differs (stable slots + absent holes
-        vs packed positions).  Away-mode and market pools stay on
-        assemble().  tests/test_slab_delta.py pins both the outcome
-        equivalence and scatter==materialize bit-equality."""
+        vs packed positions).  Away-mode stays on assemble().  Market pools
+        ride the same slots: order is per-cycle anyway (gq permutation via
+        _market_perm), per-slot prices are scattered with the dirty rows,
+        and a price-table MOVE bumps a sig epoch so the device cache falls
+        back to one full upload.  tests/test_slab_delta.py pins both the
+        outcome equivalence and scatter==materialize bit-equality."""
         from armada_tpu.models.slab import DeltaBundle
 
         if self._retype_needed:
@@ -1258,12 +1325,26 @@ class IncrementalBuilder:
         jt, rt = self.jobs, self.runs
         sg, rr = self._sg, self._rr
 
+        prices = self._prices()  # market: per-cycle (queue, band) bid table
+        if prices is not None and (
+            self._last_prices is None
+            or self._last_prices.shape != prices.shape
+            or not np.array_equal(self._last_prices, prices)
+        ):
+            self._price_epoch += 1
+            self._last_prices = prices
+
         # --- singles: live rows, (queue, order-key) table order ---------------
         rows = jt.live_rows()
         mask_known = np.ones(rows.shape[0], bool)
         if Qreal and not self.queue_known.all():
             mask_known = self.queue_known[jt.qi[rows]]
         rows_known = rows[mask_known]
+        idx_known = np.flatnonzero(mask_known)
+        if prices is not None:
+            perm = self._market_perm(jt, rows_known, prices)
+            rows_known = rows_known[perm]
+            idx_known = idx_known[perm]
         sq = jt.qi[rows_known].astype(np.int64)
         counts_s = np.bincount(sq, minlength=Qreal)
         starts_s = np.zeros((max(1, Qreal),), np.int64)
@@ -1272,7 +1353,7 @@ class IncrementalBuilder:
         rank_s = np.arange(rows_known.shape[0], dtype=np.int64) - starts_s[sq]
 
         # --- units merged into the per-queue order (same as assemble()) -------
-        units, unit_members, unit_ubans = self._gang_units()
+        units, unit_members, unit_ubans = self._gang_units(prices)
         if units:
             unit_qi = np.array([u["qi"] for u in units], np.int64)
             unit_vrank = np.array([u["rank"] for u in units], np.int64)
@@ -1311,7 +1392,6 @@ class IncrementalBuilder:
         # --- singles participation flips -> slab validity + demand ------------
         slots_live = jt.slot[rows].astype(np.int64)
         valid_flags = np.zeros(rows.shape[0], bool)
-        idx_known = np.flatnonzero(mask_known)
         valid_flags[idx_known[keep_s]] = True
         cur_valid = sg.valid[slots_live]
         flip_on = slots_live[valid_flags & ~cur_valid]
@@ -1350,6 +1430,8 @@ class IncrementalBuilder:
         # evictee candidates: preemptible valid runs, table order
         ev_mask = rt.preempt[run_rows] & rvalid
         ev_rows = run_rows[ev_mask]
+        if prices is not None:
+            ev_rows = ev_rows[self._market_perm(rt, ev_rows, prices)]
         evq = rt.qi[ev_rows].astype(np.int64)
 
         # --- region layout -----------------------------------------------------
@@ -1542,6 +1624,18 @@ class IncrementalBuilder:
             out[is_unit] = uc[name][i_unit]
             return out
 
+        if prices is not None:
+            # per-slot price is a pure function of (queue, band); stale
+            # content at free slots is g_absent so any value is harmless
+            sing_price = prices[
+                sg.queue[i_sing].astype(np.int64), sg.band[i_sing].astype(np.int64)
+            ]
+            ev_price = prices[
+                rr.queue[rr_dirty].astype(np.int64), rr.band[rr_dirty].astype(np.int64)
+            ]
+        else:
+            sing_price = np.zeros((i_sing.shape[0],), np.float32)
+            ev_price = np.zeros((rr_dirty.shape[0],), np.float32)
         sg_valid_rows = sg.valid[i_sing]
         sg_cols = {
             "g_req": sg_field("g_req", sg.req[i_sing], np.float32),
@@ -1553,10 +1647,8 @@ class IncrementalBuilder:
             "g_run": sg_field("g_run", np.full((i_sing.shape[0],), -1, np.int32), np.int32),
             "g_valid": sg_field("g_valid", sg_valid_rows, bool),
             "g_absent": sg_field("g_absent", ~sg_valid_rows, bool),
-            "g_price": sg_field("g_price", np.zeros((i_sing.shape[0],), np.float32), np.float32),
-            "g_spot_price": sg_field(
-                "g_spot_price", np.zeros((i_sing.shape[0],), np.float32), np.float32
-            ),
+            "g_price": sg_field("g_price", sing_price, np.float32),
+            "g_spot_price": sg_field("g_spot_price", sing_price, np.float32),
             "g_ban_row": sg_field(
                 "g_ban_row", np.zeros((i_sing.shape[0],), np.int32), np.int32
             ),
@@ -1584,8 +1676,8 @@ class IncrementalBuilder:
             "g_run": rr_dirty.astype(np.int32),
             "g_valid": ev_valid_rows,
             "g_absent": ~ev_valid_rows,
-            "g_price": np.zeros((rr_dirty.shape[0],), np.float32),
-            "g_spot_price": np.zeros((rr_dirty.shape[0],), np.float32),
+            "g_price": ev_price,
+            "g_spot_price": ev_price,
         }
 
         fulls = {
@@ -1613,8 +1705,6 @@ class IncrementalBuilder:
             "perq_burst": self._stable("perq_burst", perq_burst),
             "node_axes": nc["node_axes"],
             "float_total": nc["float_total"],
-            # self.market is always False here: __init__ rejects market
-            # pools (they stay on build_problem until bid re-sort lands).
             "market": self._stable("market", np.bool_(self.market)),
             "spot_cutoff": self._stable("spot_cutoff", np.asarray(self.spot_cutoff)),
             "ban_mask": self._stable("ban_mask", ban_mask),
@@ -1627,6 +1717,27 @@ class IncrementalBuilder:
             """Full host problem equal to what the scatter stream maintains
             (called on first upload / fallback; also the test oracle).  Must
             run before further builder mutations."""
+            if prices is not None:
+                slot_price = np.concatenate(
+                    [
+                        prices[
+                            sg.queue.astype(np.int64), sg.band.astype(np.int64)
+                        ],
+                        prices[
+                            rr.queue.astype(np.int64), rr.band.astype(np.int64)
+                        ],
+                        uc["g_price"],
+                    ]
+                )
+                slot_spot = np.concatenate(
+                    [
+                        slot_price[: s_cap + r_cap],
+                        uc["g_spot_price"],
+                    ]
+                )
+            else:
+                slot_price = np.zeros((G,), np.float32)
+                slot_spot = slot_price
             g_valid_full = np.concatenate(
                 [sg.valid, rr.valid & rr.preempt, uc["g_valid"]]
             )
@@ -1674,8 +1785,8 @@ class IncrementalBuilder:
                 ),
                 g_valid=g_valid_full,
                 g_absent=g_absent_full,
-                g_price=np.zeros((G,), np.float32),
-                g_spot_price=np.zeros((G,), np.float32),
+                g_price=slot_price,
+                g_spot_price=slot_spot,
                 gq_gang=gq_gang,
                 q_start=q_start,
                 q_len=q_len,
@@ -1714,6 +1825,9 @@ class IncrementalBuilder:
             rr.epoch,
             u_cap,
             self._node_epoch,
+            # market: a price move re-prices every slot at once; ride the
+            # full-upload fallback instead of dirtying the whole slab
+            self._price_epoch,
         )
         seq = self._bundle_seq
         self._bundle_seq += 1
@@ -1772,7 +1886,7 @@ class IncrementalBuilder:
 
     # ---------------------------------------------------- gang slow path ----
 
-    def _gang_units(self):
+    def _gang_units(self, prices=None):
         """Per-cycle Python for the complex residue: gang grouping,
         uniformity domains, joint hopeless check, banned singles -- the same
         decisions build_problem makes (problem.py queued-gang loop), derived
@@ -1845,7 +1959,11 @@ class IncrementalBuilder:
             units.append(
                 {
                     "qi": qi,
-                    "rank": self._virtual_rank(qi, lead_pc.priority, lead),
+                    "rank": (
+                        self._virtual_rank_market(qi, price, lead, prices)
+                        if prices is not None
+                        else self._virtual_rank(qi, lead_pc.priority, lead)
+                    ),
                     "req": req,
                     "card": len(grp),
                     "level": self.level_of_priority[lead_pc.priority],
@@ -1855,6 +1973,12 @@ class IncrementalBuilder:
                     "spot": spot,
                     "tag": tag,
                     "dead": dead,
+                    # market tie-break among same-rank units: the full
+                    # (-price, sub, id) comparator (build_problem sorts its
+                    # units list by unit_key; the merge below orders
+                    # same-vrank units by list position)
+                    "_sub": lead.submit_time,
+                    "_id": lead.id,
                 }
             )
             members_out.append([m.id for m in grp])
@@ -1975,6 +2099,21 @@ class IncrementalBuilder:
                 )
                 pc = cfg.priority_class(lead.priority_class)
                 add_unit(qi, pc, lead, grp, grp_key, tag, uban, dead)
+        if self.market and len(units) > 1:
+            # List position breaks same-vrank ties in the assemble merge;
+            # market mode needs that order to be the unit_key order.
+            order = sorted(
+                range(len(units)),
+                key=lambda i: (
+                    units[i]["qi"],
+                    -units[i]["price"],
+                    units[i]["_sub"],
+                    units[i]["_id"],
+                ),
+            )
+            units = [units[i] for i in order]
+            members_out = [members_out[i] for i in order]
+            ubans_out = [ubans_out[i] for i in order]
         return units, members_out, ubans_out
 
     # Running gang membership for the uniformity pin: maintained by lease()
@@ -2001,6 +2140,47 @@ class IncrementalBuilder:
                 members.discard(job_id)
                 if not members:
                     self._running_gang_members.pop((qi, gang_id), None)
+
+    def _virtual_rank_market(
+        self, qi: int, price: float, lead: JobSpec, prices: np.ndarray
+    ) -> int:
+        """Market-order rank of a slow-path unit among the queue's live
+        fast-table rows: the count of singles whose (-price, sub, id) key
+        strictly precedes the unit's.  Bands are contiguous in the stored
+        (qi, band, sub, id) order, so this is O(bands) binary searches."""
+        jt = self.jobs
+        qv = jt.qi.dtype.type(qi)
+        q_lo = int(np.searchsorted(jt.qi[: jt.n], qv, "left"))
+        q_hi = int(np.searchsorted(jt.qi[: jt.n], qv, "right"))
+        if q_lo == q_hi:
+            return 0
+        # The table is f32; a raw-f64 probe (e.g. 4.7) would never equal its
+        # own band's entry and mis-rank the unit (CLAUDE.md parity: f32
+        # score arithmetic, raw f64 flips near-ties).
+        price = float(np.float32(price))
+        band_col = jt.band[q_lo:q_hi]
+        count = 0
+        for bi in range(len(self.bands)):
+            b_lo = q_lo + int(np.searchsorted(band_col, np.int32(bi), "left"))
+            b_hi = q_lo + int(np.searchsorted(band_col, np.int32(bi), "right"))
+            if b_lo == b_hi:
+                continue
+            p = float(prices[qi, bi])
+            if p > price:
+                count += int(jt.alive[b_lo:b_hi].sum())
+            elif p == price:
+                lo, hi = b_lo, b_hi
+                for col, v in (
+                    (jt.sub, lead.submit_time),
+                    (jt.ids, lead.id.encode()),
+                ):
+                    a = col[lo:hi]
+                    v = a.dtype.type(v)  # dtype mismatch copies the column
+                    lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
+                        np.searchsorted(a, v, "right")
+                    )
+                count += int(jt.alive[b_lo:lo].sum())
+        return count
 
     def _virtual_rank(self, qi: int, pc_priority: int, lead: JobSpec) -> int:
         """Rank of a slow-path unit among the queue's live fast-table rows:

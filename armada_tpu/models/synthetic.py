@@ -22,6 +22,21 @@ from armada_tpu.models.problem import SchedulingProblem, queue_ordered_gang_inde
 _INF = np.float32(3.0e38)
 
 
+# bidstore-style price bands for market benchmarks (pkg/bidstore enumerates
+# a small fixed band set; prices are per (queue, band))
+SYNTHETIC_BANDS = tuple(f"band{i}" for i in range(8))
+
+
+def synthetic_bid_price(job) -> float:
+    """Deterministic (queue, band) pricer for market benchmarks: stable
+    across runs/cycles, spreads bands across queues so the serving
+    permutation is non-trivial."""
+    import zlib
+
+    h = zlib.crc32(f"{job.queue}/{job.price_band}".encode())
+    return 1.0 + (h % 97) / 10.0
+
+
 def synthetic_world(
     *,
     num_nodes: int,
@@ -30,6 +45,7 @@ def synthetic_world(
     num_runs: int = 0,
     seed: int = 0,
     shape_bucket: int = 8192,
+    market: bool = False,
 ):
     """(config, nodes, queues, specs, running, spec_factory): a JobSpec-level
     world mirroring synthetic_problem's distribution.
@@ -39,8 +55,12 @@ def synthetic_world(
     instances are shared across jobs of the same shape so 1M specs stay cheap.
     shape_bucket defaults high so +-1000-job deltas never change the padded
     tensor shapes (one compile serves every measured cycle).
+
+    `market=True` marks the pool market-driven and stamps every spec with one
+    of 8 price bands (pkg/bidstore-style); pair with a (queue, band) pricer
+    such as `synthetic_bid_price`.
     """
-    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.config import PoolConfig, PriorityClass, SchedulingConfig
     from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 
     rng = np.random.default_rng(seed)
@@ -51,6 +71,9 @@ def synthetic_world(
             "prod": PriorityClass("prod", priority=1000, preemptible=False),
         },
         default_priority_class="batch",
+        pools=(
+            PoolConfig("default", market_driven=market, spot_price_cutoff=0.9),
+        ),
     )
     factory = config.resource_list_factory()
 
@@ -84,6 +107,7 @@ def synthetic_world(
         memm = rng.choice([2, 4, 8], size=n)
         pcs = rng.random(n) < 0.7
         subs = t0 + rng.random(n)
+        bands = rng.integers(0, len(SYNTHETIC_BANDS), n) if market else None
         out = []
         base = counter[0]
         counter[0] += n
@@ -95,6 +119,7 @@ def synthetic_world(
                     priority_class="batch" if pcs[i] else "prod",
                     submit_time=float(subs[i]),
                     resources=_req(int(cpus[i]), int(cpus[i] // 1000 * memm[i] + 1)),
+                    price_band=SYNTHETIC_BANDS[bands[i]] if market else "",
                 )
             )
         return out

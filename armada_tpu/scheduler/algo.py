@@ -237,9 +237,12 @@ class FairSchedulingAlgo:
             # the JobDb subscription).
             self.feed.on_delta(txn._upserts, txn._deletes)
         # The full per-job txn scans below are what the incremental feed
-        # exists to avoid; they remain for the legacy path, market pools
-        # (bid ordering re-sorts the backlog per cycle) and the short-job
-        # penalty (derived from retained TERMINAL jobs the feed drops).
+        # exists to avoid; they remain for the legacy path, the short-job
+        # penalty (derived from retained TERMINAL jobs the feed drops), and
+        # market OBSERVABILITY (idealised/realised valuation walks every
+        # spec, as the reference's CalculateIdealisedValue does -- the
+        # market ROUND itself rides the incremental builders, which keep
+        # (queue, band, submit, id) order and re-sort by price per cycle).
         need_job_scan = (not incremental) or bool(market_pools)
         need_run_scan = (
             (not incremental)
@@ -351,8 +354,12 @@ class FairSchedulingAlgo:
             if not pool_nodes:
                 continue
             bid_price_of = _pool_pricer(pool) if self.bid_prices is not None else None
-            if incremental and pool not in market_pools:
+            running = running_by_pool.get(pool, [])
+            if incremental:
                 b = self.feed.builder_for(pool, txn)
+                # Market prices are re-read from the provider every cycle;
+                # the builder's _prices() snapshot uses this callable.
+                b.bid_price_of = bid_price_of
                 b.set_queues(pool_queues(pool))
                 b.set_nodes(pool_nodes)
                 num_queued = len(b.jobs.key_of_id) + len(b.gang_jobs)
@@ -378,7 +385,6 @@ class FairSchedulingAlgo:
                 if self.collect_stats:
                     collect_round_stats(res, pview, ctx, self.config, outcome)
             else:
-                running = running_by_pool.get(pool, [])
                 if not queued_jobs and not running:
                     continue
                 num_queued, num_running = len(queued_jobs), len(running)
@@ -487,7 +493,7 @@ class FairSchedulingAlgo:
                     ],
                     running=(
                         self.feed.running_of(host, txn)
-                        if incremental and host not in market_pools
+                        if incremental
                         else host_running(host)
                     ),
                     collect_stats=False,
@@ -622,7 +628,6 @@ class FairSchedulingAlgo:
                 job.spec, priority=job.priority, pools=job.pools or job.spec.pools
             )
 
-        market_pools = {p.name for p in self.config.pools if p.market_driven}
         # The optimiser places at most max_stuck_jobs_per_cycle; collecting a
         # generous multiple of that preserves its own candidate ordering
         # while keeping the scan O(candidates), not O(failed backlog) -- a
@@ -641,9 +646,7 @@ class FairSchedulingAlgo:
             if not stuck:
                 continue
             pool_nodes = [n for n in nodes if n.pool == pool]
-            if self.feed is not None and pool not in market_pools:
-                # Market pools have no builder (feed.running_of would claim
-                # an empty cluster); they stay on the legacy lists below.
+            if self.feed is not None:
                 running_now = self.feed.running_of(pool, txn)
             else:
                 running_now = [

@@ -33,14 +33,15 @@ from armada_tpu.models.slab import DeviceDeltaCache
 class IncrementalProblemFeed:
     """Per-pool IncrementalBuilders + device caches, fed from JobDb commits.
 
-    Market-driven pools are NOT handled here (bid ordering re-sorts the
-    backlog every cycle); FairSchedulingAlgo keeps them on the per-cycle
-    build_problem path.
+    Market-driven pools ride the same tables, stored in (queue, band,
+    submit, id) order; the per-cycle bid re-sort is a slice permutation
+    inside the builder (models/incremental._market_perm).  The scheduling
+    algo refreshes each market builder's `bid_price_of` before assembling
+    (prices come from the provider, re-read every cycle).
     """
 
     def __init__(self, config: SchedulingConfig):
         self.config = config
-        self._market_pools = {p.name for p in config.pools if p.market_driven}
         self.builders: dict[str, IncrementalBuilder] = {}
         self.devcaches: dict[str, DeviceDeltaCache] = {}
         # queued job ids with an explicit pools restriction: the away pass's
@@ -56,9 +57,8 @@ class IncrementalProblemFeed:
         # eager; pools discovered later from node snapshots are backfilled
         # from the JobDb in builder_for.
         for p in config.pools:
-            if not p.market_driven:
-                self.builders[p.name] = IncrementalBuilder(config, p.name)
-                self.devcaches[p.name] = DeviceDeltaCache()
+            self.builders[p.name] = IncrementalBuilder(config, p.name)
+            self.devcaches[p.name] = DeviceDeltaCache()
 
     def attach(self, jobdb) -> None:
         self._jobdb = jobdb
@@ -77,9 +77,8 @@ class IncrementalProblemFeed:
         self.pool_restricted = set()
         self._gang_of = {}
         for p in self.config.pools:
-            if not p.market_driven:
-                self.builders[p.name] = IncrementalBuilder(self.config, p.name)
-                self.devcaches[p.name] = DeviceDeltaCache()
+            self.builders[p.name] = IncrementalBuilder(self.config, p.name)
+            self.devcaches[p.name] = DeviceDeltaCache()
         if self._jobdb is not None:
             pending = {}
             for job in self._jobdb.read_txn().all_jobs():
@@ -87,8 +86,6 @@ class IncrementalProblemFeed:
             self._flush(pending)
 
     def builder_for(self, pool: str, txn=None) -> Optional[IncrementalBuilder]:
-        if pool in self._market_pools:
-            return None
         b = self.builders.get(pool)
         if b is None:
             b = IncrementalBuilder(self.config, pool)

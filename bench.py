@@ -174,17 +174,25 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
     from armada_tpu.models import decode_result
     from armada_tpu.models.incremental import DeviceProblemCache, IncrementalBuilder
     from armada_tpu.models.slab import DeviceDeltaCache
-    from armada_tpu.models.synthetic import synthetic_world
+    from armada_tpu.models.synthetic import synthetic_bid_price, synthetic_world
 
+    # ARMADA_BENCH_MARKET=1: same cycle over a market-driven pool (bid-price
+    # candidate order; the incremental tables store (queue, band, submit, id)
+    # and permute band slices by price per cycle -- VERDICT r2 #8).
+    market = os.environ.get("ARMADA_BENCH_MARKET") == "1"
     config, nodes, queues, specs, running, spec_factory = synthetic_world(
         num_nodes=num_nodes,
         num_jobs=num_jobs,
         num_queues=num_queues,
         num_runs=num_runs,
         seed=7,
+        market=market,
     )
     t0 = time.perf_counter()
-    builder = IncrementalBuilder(config, "default", queues)
+    builder = IncrementalBuilder(
+        config, "default", queues,
+        bid_price_of=synthetic_bid_price if market else None,
+    )
     builder.set_nodes(nodes)
     builder.submit_many(specs)
     for r in running:
@@ -273,8 +281,9 @@ def main():
         num_jobs, num_nodes, num_queues, num_runs, repeats
     )
 
+    market_tag = "_market" if os.environ.get("ARMADA_BENCH_MARKET") == "1" else ""
     line = {
-        "metric": f"e2e_cycle_wall_clock_{num_jobs//1000}kjobs_x_{num_nodes//1000}knodes",
+        "metric": f"e2e_cycle_wall_clock_{num_jobs//1000}kjobs_x_{num_nodes//1000}knodes{market_tag}",
         "value": round(e2e_s, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_ROUND_BUDGET_S / e2e_s, 2),

@@ -425,3 +425,194 @@ def test_txn_abort_resyncs_the_feed(tmp_path):
     b.set_nodes([_node("n0")])
     assert sorted(k.decode() for k in b.jobs.key_of_id) == ["j1", "j2"]
     assert len(b.runs.key_of_id) == 0
+
+
+# --------------------------------------------------------------- market ----
+# Market pools (market_iterator.go:245): candidates order by
+# (-bid_price, submit_time, id); prices are a function of (queue, band) and
+# move between cycles.  The incremental tables store (queue, band, submit,
+# id) order and permute band slices by current price at assemble time
+# (models/incremental._market_perm) -- these tests pin exact equivalence
+# with the from-scratch market builder.
+
+from armada_tpu.core.config import PoolConfig
+
+MCFG = dataclasses.replace(
+    CFG, pools=(PoolConfig("default", market_driven=True, spot_price_cutoff=0.5),)
+)
+
+_BANDS = ("", "low", "mid", "high")
+
+
+def _pricer(prices):
+    """bid_price_of keyed strictly on (queue, band) -- the only shape the
+    band table can represent (pkg/bidstore prices per band)."""
+
+    def price(job):
+        return prices.get((job.queue, job.price_band), 0.0)
+
+    return price
+
+
+def _market_world(seed, **kw):
+    rng = random.Random(seed * 977)
+    nodes, queues, jobs, running = _random_world(seed, **kw)
+    jobs = [
+        dataclasses.replace(j, price_band=rng.choice(_BANDS)) for j in jobs
+    ]
+    running = [
+        dataclasses.replace(
+            r, job=dataclasses.replace(r.job, price_band=rng.choice(_BANDS))
+        )
+        for r in running
+    ]
+    prices = {
+        (q.name, b): float(rng.randrange(1, 8)) for q in queues for b in _BANDS
+    }
+    return nodes, queues, jobs, running, prices
+
+
+def _market_fresh(nodes, queues, jobs, running, price_of, banned=None):
+    return build_problem(
+        MCFG,
+        pool="default",
+        nodes=nodes,
+        queues=queues,
+        queued_jobs=jobs,
+        running=running,
+        banned_nodes=banned,
+        bid_price_of=price_of,
+    )
+
+
+def _market_incr(nodes, queues, jobs, running, price_of, banned=None):
+    b = IncrementalBuilder(MCFG, "default", queues, bid_price_of=price_of)
+    b.set_nodes(nodes)
+    b.submit_many(jobs, banned)
+    for r in running:
+        b.lease(r)
+        if r.job.gang_id:
+            b.note_running_gang(r.job.queue, r.job.gang_id, r.job.id)
+    return b
+
+
+def test_market_equivalence_single_shot():
+    for seed in range(4):
+        nodes, queues, jobs, running, prices = _market_world(seed)
+        price_of = _pricer(prices)
+        fresh = _round(*_market_fresh(nodes, queues, jobs, running, price_of))
+        incr = _round(
+            *_market_incr(nodes, queues, jobs, running, price_of).assemble()
+        )
+        _outcomes_equal(fresh, incr)
+        assert fresh.spot_price == incr.spot_price
+
+
+def test_market_equivalence_with_banned_and_gangs():
+    nodes, queues, jobs, running, prices = _market_world(21, num_jobs=60, gangs=4)
+    banned = {jobs[3].id: (nodes[0].id,), jobs[9].id: (nodes[1].id, nodes[2].id)}
+    price_of = _pricer(prices)
+    fresh = _round(
+        *_market_fresh(nodes, queues, jobs, running, price_of, banned)
+    )
+    incr = _round(
+        *_market_incr(nodes, queues, jobs, running, price_of, banned).assemble()
+    )
+    _outcomes_equal(fresh, incr)
+
+
+def test_market_price_moves_between_cycles():
+    """Prices move every cycle; the stored order never changes, only the
+    per-cycle slice permutation.  Equivalence must hold at every move,
+    including exact (sub, id) merges when bands tie on price."""
+    rng = random.Random(31)
+    nodes, queues, jobs, running, prices = _market_world(5, num_jobs=90, gangs=2)
+    jobs_by_id = {j.id: j for j in jobs}
+    running = list(running)
+    prices = dict(prices)
+    price_of = _pricer(prices)  # reads `prices` live
+    builder = _market_incr(nodes, queues, jobs, running, price_of)
+    next_id = [0]
+
+    for cycle in range(5):
+        fresh = _round(
+            *_market_fresh(
+                nodes, queues, list(jobs_by_id.values()), running, price_of
+            )
+        )
+        incr = _round(*builder.assemble())
+        _outcomes_equal(fresh, incr)
+
+        for jid, nid in incr.scheduled.items():
+            spec = jobs_by_id.pop(jid, None)
+            if spec is None:
+                continue
+            builder.remove(jid)
+            r = RunningJob(job=spec, node_id=nid)
+            running.append(r)
+            builder.lease(r)
+            if spec.gang_id:
+                builder.note_running_gang(spec.queue, spec.gang_id, spec.id)
+        for jid in incr.preempted:
+            running = [r for r in running if r.job.id != jid]
+            builder.unlease(jid)
+        for _ in range(8):
+            i = next_id[0]
+            next_id[0] += 1
+            spec = _job(
+                f"mkt{i:04d}",
+                rng.choice(["qa", "qb", "qc"]),
+                rng.choice([1, 2, 4]),
+                pc=rng.choice(["low", "high"]),
+                sub=20.0 + cycle + rng.random(),
+                price_band=rng.choice(_BANDS),
+            )
+            jobs_by_id[spec.id] = spec
+            builder.submit(spec)
+        # move prices -- every third cycle force a two-band TIE in one queue
+        # so the exact (sub, id) merge path is exercised
+        for key in prices:
+            prices[key] = float(rng.randrange(1, 8))
+        if cycle % 3 == 1:
+            prices[("qa", "low")] = prices[("qa", "high")] = 5.0
+
+
+def test_market_tie_merge_is_exact():
+    """Two bands at the same price interleave by (submit_time, id) exactly
+    as the reference comparator orders them."""
+    nodes = [_node("n0", cpu="4")]
+    queues = [Queue("qa", 1.0)]
+    jobs = []
+    for i, (band, sub) in enumerate(
+        [("low", 1.0), ("high", 2.0), ("low", 3.0), ("high", 4.0)]
+    ):
+        jobs.append(_job(f"t{i}", "qa", 1, sub=sub, price_band=band))
+    prices = {("qa", "low"): 5.0, ("qa", "high"): 5.0, ("qa", ""): 0.0}
+    price_of = _pricer(prices)
+    fresh = _round(*_market_fresh(nodes, queues, jobs, [], price_of))
+    incr = _round(*_market_incr(nodes, queues, jobs, [], price_of).assemble())
+    _outcomes_equal(fresh, incr)
+    assert len(incr.scheduled) == 4
+
+
+def test_market_non_f32_exact_price_ranks_units_correctly():
+    """Regression (round-3 review): the unit rank probe must round to f32
+    before comparing with the f32 price table, else a price like 4.7 never
+    equals its own band's entry and the unit jumps the whole band."""
+    nodes = [_node("n0", cpu="4")]
+    queues = [Queue("qa", 1.0)]
+    jobs = [_job(f"j{i}", "qa", 1, sub=float(i), price_band="low") for i in range(4)]
+    late_banned = _job("zz-late", "qa", 1, sub=10.0, price_band="low")
+    prices = {("qa", "low"): 4.7, ("qa", ""): 0.0}  # 4.7 is not f32-exact
+    price_of = _pricer(prices)
+    banned = {"zz-late": ("n-nonexistent",)}
+    fresh = _round(
+        *_market_fresh(nodes, queues, jobs + [late_banned], [], price_of, banned)
+    )
+    incr = _round(
+        *_market_incr(
+            nodes, queues, jobs + [late_banned], [], price_of, banned
+        ).assemble()
+    )
+    _outcomes_equal(fresh, incr)
+    assert sorted(incr.scheduled) == ["j0", "j1", "j2", "j3"]

@@ -372,3 +372,94 @@ def test_indicative_shares_flow_through_the_round():
     # one fully-demanding queue + the phantom at weight 1 -> 1/2
     assert out.indicative_shares[1] == pytest.approx(0.5, abs=1e-3)
     assert out.indicative_shares[2] == pytest.approx(1 / 3, abs=1e-2)
+
+
+def test_algo_market_pool_rides_incremental_feed():
+    """Market pools assemble from the cycle-persistent builders when the
+    feed is attached (VERDICT r2 #8): same scheduled set, spot price and
+    market observability as the legacy from-scratch path, across cycles
+    with a price move in between."""
+    import dataclasses
+
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.scheduler.algo import FairSchedulingAlgo
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+    from armada_tpu.scheduler.providers import StaticBidPriceProvider
+
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        pools=(
+            PoolConfig("default", market_driven=True, spot_price_cutoff=0.25),
+        ),
+    )
+    def specs():
+        out = []
+        for i in range(6):
+            out.append(
+                dataclasses.replace(
+                    job(f"a{i}", cpu="2", queue="qa"),
+                    submit_time=float(i),
+                    price_band="gold" if i % 2 else "",
+                )
+            )
+            out.append(
+                dataclasses.replace(
+                    job(f"b{i}", cpu="2", queue="qb"),
+                    submit_time=float(i),
+                    price_band="gold" if i % 3 else "",
+                )
+            )
+        return out
+
+    def run_world(use_feed):
+        # fresh provider per world: the mid-test price move must not leak
+        provider = StaticBidPriceProvider(
+            {
+                ("qa", "gold"): 9.0,
+                ("qa", ""): 2.0,
+                ("qb", "gold"): 5.0,
+                ("qb", ""): 4.0,
+            },
+            default=1.0,
+        )
+        jobdb = JobDb(cfg)
+        feed = None
+        if use_feed:
+            feed = IncrementalProblemFeed(cfg)
+            feed.attach(jobdb)
+        algo = FairSchedulingAlgo(
+            cfg,
+            queues=lambda: [Queue("qa"), Queue("qb")],
+            clock_ns=lambda: 10**15,
+            bid_prices=provider,
+            feed=feed,
+        )
+        snap = ExecutorSnapshot(
+            id="ex1",
+            pool="default",
+            nodes=(node("n0", cpu="8"), node("n1", cpu="8")),
+            last_update_ns=10**15,
+        )
+        outs = []
+        with jobdb.write_txn() as txn:
+            for s in specs():
+                txn.upsert(Job(spec=s, validated=True, pools=("default",)))
+            outs.append(algo.schedule(txn, [snap], now_ns=10**15))
+        # price move between cycles: bands reorder
+        provider._prices[("qa", "gold")] = 1.5
+        with jobdb.write_txn() as txn:
+            outs.append(algo.schedule(txn, [snap], now_ns=10**15))
+        return outs
+
+    legacy = run_world(False)
+    incr = run_world(True)
+    for lres, ires in zip(legacy, incr):
+        (lstats,), (istats,) = lres.pools, ires.pools
+        assert istats.outcome.scheduled == lstats.outcome.scheduled
+        assert sorted(istats.outcome.preempted) == sorted(lstats.outcome.preempted)
+        assert istats.outcome.spot_price == lstats.outcome.spot_price
+        assert istats.idealised_values == lstats.idealised_values
+        assert istats.realised_values == lstats.realised_values
+        assert istats.market

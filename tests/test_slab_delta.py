@@ -264,3 +264,84 @@ def test_bundle_seq_gap_forces_full_upload():
         np.testing.assert_array_equal(
             np.asarray(dev_arr), np.asarray(host_arr), err_msg=name
         )
+
+
+def test_slab_delta_market_pool():
+    """Market pools ride the slab path: candidate order is the per-cycle
+    price permutation (incremental._market_perm), per-slot prices are
+    scattered with the dirty rows, and a price-table MOVE bumps the bundle
+    sig's price epoch so exactly one full upload re-prices every slot."""
+    import dataclasses
+    from armada_tpu.core.config import PoolConfig
+
+    cfg = dataclasses.replace(
+        make_config(),
+        pools=(PoolConfig("default", market_driven=True, spot_price_cutoff=0.5),),
+    )
+    rng = np.random.default_rng(23)
+    F, nodes, queues = make_world(cfg, rng)
+    prices = {}
+
+    def price_of(job):
+        return prices.get((job.queue, job.price_band), 0.0)
+
+    d = DualDriver(cfg, queues, nodes)
+    d.each(lambda b: setattr(b, "bid_price_of", price_of))
+    bands = ("", "low", "high")
+    for q in queues:
+        for band in bands:
+            prices[(q.name, band)] = float(rng.integers(1, 8))
+    spec_of = {}
+    next_id = [0]
+
+    def submit(n, queue, band, pc="high", cpu=2, gang=None):
+        batch = []
+        for _ in range(n):
+            s = dataclasses.replace(
+                make_job(F, next_id[0], queue, pc=pc, cpu=cpu, gang=gang),
+                price_band=band,
+            )
+            spec_of[s.id] = s
+            batch.append(s)
+            next_id[0] += 1
+        d.each(lambda b: b.submit_many(batch))
+
+    # preemptible running load in mixed bands: evictee market order
+    hogs = []
+    for i in range(4):
+        s = dataclasses.replace(
+            make_job(F, 10_000 + i, "q0", pc="low", cpu=8, sub=0),
+            price_band=bands[i % 3],
+        )
+        spec_of[s.id] = s
+        hogs.append(s)
+    d.each(
+        lambda b: b.lease_many(
+            [RunningJob(job=s, node_id=f"n{i // 2}") for i, s in enumerate(hogs)]
+        )
+    )
+    submit(8, "q0", "low")
+    submit(8, "q1", "high", cpu=3)
+    submit(6, "q2", "", pc="low")
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 1)
+
+    # gang unit (market virtual rank) + steady prices: deltas engage
+    submit(2, "q1", "low", gang="gang-m")
+    submit(4, "q1", "low")
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 2)
+    uploads_before_move = d.full_uploads
+
+    # price move: q1 bands TIE exactly (sub, id merge) and q0 reorders
+    prices[("q1", "low")] = prices[("q1", "high")] = 6.0
+    prices[("q0", "low")] = 7.0
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 3)
+    assert d.full_uploads == uploads_before_move + 1
+
+    # prices unchanged again: back to O(deltas) scatters
+    submit(3, "q2", "high")
+    out = d.cycle()
+    apply_outcome(d, out, spec_of, 4)
+    assert d.full_uploads == uploads_before_move + 1
