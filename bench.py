@@ -126,7 +126,11 @@ def _arm_watchdog():
 
 def _kernel_bench(num_gangs, num_nodes, num_queues, repeats):
     """Kernel-only round time on pre-built device tensors (round 1's
-    headline; kept as the `kernel_s` extra)."""
+    headline; kept as the `kernel_s` extra).
+
+    ARMADA_BENCH_SHARDED=1 runs the same round SPMD over ALL visible devices
+    (parallel/mesh.py: nodes-axis sharding, XLA collectives over ICI) -- the
+    multi-chip path needs zero new code, just more chips visible."""
     problem, meta = synthetic_problem(
         num_nodes=num_nodes,
         num_gangs=num_gangs,
@@ -135,13 +139,40 @@ def _kernel_bench(num_gangs, num_nodes, num_queues, repeats):
         global_burst=1_000,
         perq_burst=1_000,
         seed=7,
+        node_pad_to=len(jax.devices()),
     )
-    dev = jax.device_put(SchedulingProblem(*(jnp.asarray(a) for a in problem)))
     kw = dict(
         num_levels=meta["num_levels"],
         max_slots=meta["max_slots"],
         slot_width=meta["slot_width"],
     )
+    if os.environ.get("ARMADA_BENCH_SHARDED") == "1":
+        from armada_tpu.parallel import make_mesh, shard_problem, sharded_schedule_round
+
+        mesh = make_mesh()
+        print(
+            f"bench: sharded kernel over {mesh.devices.size} devices",
+            file=sys.stderr,
+        )
+        # Pre-shard once: the timed repeats must measure the round, not the
+        # host->device transfer (sharded_schedule_round's internal
+        # device_put is a no-op on already-correctly-sharded arrays).
+        problem = shard_problem(problem, mesh)
+
+        def run():
+            return sharded_schedule_round(problem, mesh, **kw)
+
+        result = run()
+        jax.block_until_ready(result)
+        scheduled = int(result.scheduled_count)
+        assert scheduled > 0, "sharded round scheduled nothing"
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run())
+            times.append(time.perf_counter() - t0)
+        return min(times)
+    dev = jax.device_put(SchedulingProblem(*(jnp.asarray(a) for a in problem)))
     # compile + warm up (first TPU compile is slow, ~20-40s; retry once if
     # the tunnel drops mid-compile)
     try:
