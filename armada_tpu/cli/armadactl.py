@@ -428,6 +428,7 @@ _SERVE_FALLBACKS = {
     "metrics_port": None,
     "health_port": None,
     "lookout_port": None,
+    "binoculars_url": None,
     "rest_port": None,
     "bind_host": "127.0.0.1",
     "leader_id": None,
@@ -461,6 +462,7 @@ def load_serve_config(args):
         "metrics_port": ("metricsport", int),
         "health_port": ("healthport", int),
         "lookout_port": ("lookoutport", int),
+        "binoculars_url": ("binocularsurl", str),
         "rest_port": ("restport", int),
         "bind_host": ("bindhost", str),
         "leader_id": ("leaderid", str),
@@ -490,6 +492,7 @@ def cmd_serve(args):
         health_port=args.health_port,
         profiling=args.profiling,
         lookout_port=args.lookout_port,
+        binoculars_url=args.binoculars_url,
         rest_port=args.rest_port,
         kube_lease_url=args.kube_lease_url,
         kube_lease_namespace=args.kube_lease_namespace,
@@ -677,6 +680,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--lookout-port",
         type=int,
         help="host the lookout web UI on this port (0 = pick a free one)",
+    )
+    srv.add_argument(
+        "--binoculars-url",
+        help="address of a cluster's binoculars service (executor "
+        "--binoculars-port); wires the lookout web UI's live log viewer",
     )
     srv.add_argument(
         "--rest-port",

@@ -102,6 +102,7 @@ def start_control_plane(
     health_port: Optional[int] = None,
     profiling: bool = False,
     lookout_port: Optional[int] = None,
+    binoculars_url: Optional[str] = None,
     rest_port: Optional[int] = None,
     kube_lease_url: Optional[str] = None,
     kube_lease_namespace: str = "default",
@@ -111,7 +112,9 @@ def start_control_plane(
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
     common/profiling/http.go).  lookout_port: host the lookout web UI
-    (internal/lookoutui equivalent) on this port.  authenticator: the
+    (internal/lookoutui equivalent) on this port; binoculars_url: a
+    cluster's binoculars gRPC address -- wires the UI's live log viewer
+    (lookoutui job log view via binoculars logs.go).  authenticator: the
     server/authn.py chain gating the gRPC services and REST gateway; None =
     dev chain (trusted headers + anonymous)."""
     os.makedirs(data_dir, exist_ok=True)
@@ -299,7 +302,17 @@ def start_control_plane(
     if lookout_port is not None:
         from armada_tpu.lookout.webui import LookoutWebUI
 
-        lookout_web = LookoutWebUI(LookoutQueries(lookoutdb), lookout_port, host=bind_host)
+        logs_of = None
+        if binoculars_url:
+            from armada_tpu.rpc.client import BinocularsClient
+
+            logs_of = BinocularsClient(binoculars_url).logs
+        lookout_web = LookoutWebUI(
+            LookoutQueries(lookoutdb),
+            lookout_port,
+            host=bind_host,
+            logs_of=logs_of,
+        )
 
     rest_gateway = None
     if rest_port is not None:

@@ -75,6 +75,15 @@ CREATE TABLE IF NOT EXISTS consumer_positions (
   position INTEGER NOT NULL,
   PRIMARY KEY (consumer, partition)
 );
+
+-- Server-side saved views (the reference UI stores named filter sets
+-- server-side; internal/lookoutui job filter views).  payload is the
+-- client's opaque filter-state JSON.
+CREATE TABLE IF NOT EXISTS saved_view (
+  name TEXT PRIMARY KEY,
+  payload TEXT NOT NULL,
+  updated_ns INTEGER NOT NULL DEFAULT 0
+);
 """
 
 
@@ -264,3 +273,11 @@ class LookoutDb:
     def query(self, sql: str, params=()) -> list[sqlite3.Row]:
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
+
+    def execute(self, sql: str, params=()) -> int:
+        """One write statement, committed; returns the affected row count
+        (saved views and other small non-ingestion writes)."""
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur.rowcount

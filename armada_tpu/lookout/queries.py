@@ -262,3 +262,28 @@ class LookoutQueries:
         d["annotations"] = json.loads(d.pop("annotations_json", "{}"))
         d.pop("spec", None)
         return d
+
+    # --- saved views (internal/lookoutui server-side job filter views) ------
+
+    def save_view(self, name: str, payload: str, now_ns: int = 0) -> None:
+        if not name or len(name) > 200:
+            raise ValueError("view name must be 1-200 characters")
+        self._db.execute(
+            "INSERT INTO saved_view(name, payload, updated_ns) VALUES (?, ?, ?) "
+            "ON CONFLICT(name) DO UPDATE SET payload = excluded.payload, "
+            "updated_ns = excluded.updated_ns",
+            (name, payload, now_ns),
+        )
+
+    def list_views(self) -> list[dict]:
+        return [
+            {"name": r["name"], "payload": r["payload"]}
+            for r in self._db.query(
+                "SELECT name, payload FROM saved_view ORDER BY name"
+            )
+        ]
+
+    def delete_view(self, name: str) -> bool:
+        return self._db.execute(
+            "DELETE FROM saved_view WHERE name = ?", (name,)
+        ) > 0

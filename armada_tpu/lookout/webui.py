@@ -12,6 +12,16 @@ Endpoints:
   GET /api/groups?by=X   grouped counts with per-state breakdown
   GET /api/job/{id}      job details incl. runs
   GET /api/overview      global state counts
+  GET /api/logs?job=&run=   pod logs via binoculars (logs.go:39-43); 501
+                            when the UI has no binoculars wired
+  GET/POST /api/views    server-side saved views (lookout DB saved_view
+                            table; the reference UI's server-backed views)
+  DELETE /api/views/{name}
+
+Drilldown: grouping by queue and clicking a row descends to jobsets within
+that queue; clicking a jobset lands on its job list; a job opens details
+with runs and a live log viewer -- queue -> group -> job -> runs -> logs
+without the CLI (App.tsx navigation parity).
 
 State colors are the validated categorical theme (dataviz skill reference
 palette; adjacency validated in both modes: CVD dE 9.1 light / 8.4 dark);
@@ -23,9 +33,10 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from typing import Callable, Optional
+from urllib.parse import parse_qs, unquote, urlparse
 
 from armada_tpu.lookout.db import JOB_STATES
 from armada_tpu.lookout.queries import JobFilter, JobOrder, LookoutQueries
@@ -113,6 +124,15 @@ tbody tr { cursor: pointer; }
                white-space: pre-wrap; word-break: break-all; }
 .run { border: 1px solid var(--border); border-radius: 6px; padding: 8px;
        margin: 6px 0; }
+.crumbs { display: flex; flex-wrap: wrap; gap: 6px; margin-bottom: 8px; }
+.crumbs:empty { display: none; }
+.crumb { background: var(--surface-2); border: 1px solid var(--border);
+         border-radius: 12px; padding: 2px 10px; cursor: pointer; }
+.crumb:hover { border-color: var(--text-2); }
+.logbox { margin-top: 6px; }
+.logbox pre { max-height: 320px; overflow: auto; }
+.logbtn { background: var(--surface); color: var(--text); cursor: pointer;
+          border: 1px solid var(--border); border-radius: 6px; padding: 3px 8px; }
 .pager { display: flex; gap: 8px; align-items: center; margin-top: 10px;
          color: var(--text-2); }
 .pager button { background: var(--surface); color: var(--text);
@@ -144,8 +164,10 @@ tbody tr { cursor: pointer; }
     <button id="refresh">refresh</button>
     <label class="chip"><input type="checkbox" id="auto" checked> auto (3s)</label>
     <select id="views"><option value="">saved views…</option></select>
-    <button id="save-view" title="save the current filters as a named view">save view</button>
+    <button id="save-view" title="save the current filters as a named view (server-side)">save view</button>
+    <button id="del-view" title="delete the selected view">✕ view</button>
   </div>
+  <div class="crumbs" id="crumbs"></div>
   <div id="content"></div>
   <div class="pager" id="pager"></div>
 </main>
@@ -159,6 +181,9 @@ const dark = () => document.documentElement.dataset.theme === "dark" ||
 const color = (s) => COLORS[dark() ? "dark" : "light"][s] || "#999";
 let skip = 0, take = 50, orderField = "submitted", orderDir = "DESC";
 let contentSeq = 0, overviewSeq = 0;  // drop stale responses
+// drilldown trail: [{field, value, group}] -- group is the grouping that was
+// active when the crumb was pushed, restored when the crumb is popped
+let drill = [];
 
 const $ = (id) => document.getElementById(id);
 const fmtT = (ns) => ns ? new Date(ns / 1e6).toLocaleString() : "—";
@@ -178,20 +203,28 @@ function filterQS() {
   return p;
 }
 
-// --- saved views (localStorage; the reference UI's saved-view feature) ----
-const VIEWS_KEY = "armada-tpu-views";
-const loadViews = () => JSON.parse(localStorage.getItem(VIEWS_KEY) || "{}");
+// --- saved views (server-side: lookout DB saved_view table) ---------------
+let serverViews = {};
+async function loadViews() {
+  try {
+    const d = await j("/api/views");
+    serverViews = Object.fromEntries(
+      d.views.map((v) => [v.name, JSON.parse(v.payload)]));
+  } catch (e) { serverViews = {}; }
+  renderViews();
+}
 function renderViews() {
-  const views = loadViews();
+  const sel = $("views").value;
   $("views").innerHTML = '<option value="">saved views…</option>' +
-    Object.keys(views).sort().map((n) =>
-      `<option value="${esc(n)}">${esc(n)}</option>`).join("") +
-    (Object.keys(views).length ? '<option value="__clear__">✕ delete all</option>' : "");
+    Object.keys(serverViews).sort().map((n) =>
+      `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+  if (serverViews[sel] !== undefined) $("views").value = sel;
 }
 function applyView(v) {
   for (const [id, val] of Object.entries(v)) { if ($(id)) $(id).value = val; }
   $("f-groupkey").style.display =
     $("f-group").value === "annotation" ? "" : "none";
+  drill = [];
   refresh();
 }
 async function j(url) { const r = await fetch(url); return r.json(); }
@@ -248,11 +281,22 @@ async function loadContent() {
       }).join("") + "</tbody></table>" + note;
     for (const tr of $("content").querySelectorAll("tr[data-group]")) {
       tr.onclick = () => {
-        if (group === "state") $("f-state").value = tr.dataset.group;
-        else if (group === "annotation")
-          $("f-ann").value = $("f-groupkey").value.trim() + "=" + tr.dataset.group;
-        else $(group === "queue" ? "f-queue" : "f-jobset").value = tr.dataset.group;
-        $("f-group").value = "";
+        const v = tr.dataset.group;
+        if (group === "state") { $("f-state").value = v; $("f-group").value = ""; }
+        else if (group === "annotation") {
+          $("f-ann").value = $("f-groupkey").value.trim() + "=" + v;
+          $("f-group").value = "";
+        } else if (group === "queue") {
+          // drill: queue -> its jobsets -> job list
+          drill.push({field: "f-queue", value: v, group});
+          $("f-queue").value = v;
+          $("f-group").value = "jobset";
+        } else {
+          drill.push({field: "f-jobset", value: v, group});
+          $("f-jobset").value = v;
+          $("f-group").value = "";
+        }
+        skip = 0;
         refresh();
       };
     }
@@ -300,16 +344,50 @@ async function loadContent() {
   if ($("prev")) $("prev").onclick = () => { skip = Math.max(0, skip - take); refresh(); };
   if ($("next")) $("next").onclick = () => { skip += take; refresh(); };
 }
+const logTimers = new Map();  // run id -> live-tail interval (one per box)
+function stopLogTimer(runId) {
+  if (logTimers.has(runId)) { clearInterval(logTimers.get(runId)); logTimers.delete(runId); }
+}
+function stopAllLogTimers() { for (const id of [...logTimers.keys()]) stopLogTimer(id); }
+async function fetchLogs(jobId, runId, boxId) {
+  const box = $(boxId);
+  if (!box) { stopLogTimer(runId); return; }
+  const r = await fetch(`/api/logs?job=${encodeURIComponent(jobId)}&run=${encodeURIComponent(runId)}`);
+  const d = await r.json();
+  const pre = box.querySelector("pre");
+  if (!pre) return;
+  const atEnd = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 4;
+  pre.textContent = r.ok ? (d.log || "(empty)") : `⚠ ${d.error}`;
+  if (atEnd) pre.scrollTop = pre.scrollHeight;  // follow the tail
+}
+function openLogs(jobId, runId, live) {
+  const boxId = "log-" + runId;
+  const box = $(boxId);
+  if (!box) return;
+  if (box.innerHTML) {  // toggle off
+    box.innerHTML = "";
+    stopLogTimer(runId);
+    return;
+  }
+  box.innerHTML = "<pre>loading…</pre>";
+  fetchLogs(jobId, runId, boxId);
+  stopLogTimer(runId);
+  if (live) logTimers.set(runId, setInterval(() => fetchLogs(jobId, runId, boxId), 3000));
+}
 async function openDetails(id) {
   const d = await j("/api/job/" + encodeURIComponent(id));
   if (!d) return;
+  const live = new Set(["LEASED", "PENDING", "RUNNING"]);
   const runs = (d.runs || []).map((r) => `<div class="run">
-    <div><b>run</b> ${esc(r.run_id)} — ${stateCell(r.state)}</div>
+    <div><b>run</b> ${esc(r.run_id)} — ${stateCell(r.state)}
+      <button class="logbtn" data-run="${esc(r.run_id)}"
+        data-live="${live.has(r.state) ? 1 : ""}">logs${live.has(r.state) ? " (live)" : ""}</button></div>
     <dl><dt>node</dt><dd>${esc(r.node || "—")}</dd>
     <dt>leased</dt><dd>${fmtT(r.leased_ns)}</dd>
     <dt>started</dt><dd>${fmtT(r.started_ns)}</dd>
     <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd></dl>
-    ${r.error ? `<pre>${esc(r.error)}</pre>` : ""}</div>`).join("");
+    ${r.error ? `<pre>${esc(r.error)}</pre>` : ""}
+    <div class="logbox" id="log-${esc(r.run_id)}"></div></div>`).join("");
   $("details").innerHTML = `<h2>${esc(d.job_id)}</h2>
     <dl><dt>state</dt><dd>${stateCell(d.state)}</dd>
     <dt>queue</dt><dd>${esc(d.queue)}</dd>
@@ -318,39 +396,71 @@ async function openDetails(id) {
     <dt>submitted</dt><dd>${fmtT(d.submitted_ns)}</dd>
     <dt>annotations</dt><dd><pre>${esc(JSON.stringify(d.annotations || {}, null, 1))}</pre></dd></dl>
     <h2>runs</h2>${runs || '<div class="empty">no runs</div>'}
-    <button onclick="document.getElementById('details').classList.remove('open')">close</button>`;
+    <button id="close-details">close</button>`;
+  for (const b of $("details").querySelectorAll(".logbtn"))
+    b.onclick = () => openLogs(d.job_id, b.dataset.run, !!b.dataset.live);
+  $("close-details").onclick = () => {
+    $("details").classList.remove("open");
+    stopAllLogTimers();
+  };
   $("details").classList.add("open");
 }
-function refresh() { loadOverview(); loadContent(); }
+function renderCrumbs() {
+  $("crumbs").innerHTML = drill.map((c, i) =>
+    `<span class="crumb" data-i="${i}" title="back to this level">` +
+    `${esc(c.field === "f-queue" ? "queue" : "jobset")}: ${esc(c.value)} ✕</span>`
+  ).join("");
+  for (const el of $("crumbs").querySelectorAll(".crumb")) {
+    el.onclick = () => {
+      const i = +el.dataset.i;
+      // pop this crumb and everything after it; restore its grouping level
+      const popped = drill[i];
+      for (const c of drill.slice(i)) $(c.field).value = "";
+      drill = drill.slice(0, i);
+      $("f-group").value = popped.group;
+      skip = 0;
+      refresh();
+    };
+  }
+}
+function refresh() { renderCrumbs(); loadOverview(); loadContent(); }
 $("refresh").onclick = refresh;
 for (const id of ["f-queue", "f-jobset", "f-state", "f-group", "f-ann", "f-groupkey"])
-  $(id).addEventListener("change", () => { skip = 0; refresh(); });
+  $(id).addEventListener("change", () => {
+    skip = 0;
+    // manual edits invalidate any drilldown crumb they contradict
+    drill = drill.filter((c) => $(c.field).value === c.value);
+    refresh();
+  });
 $("f-group").addEventListener("change", () => {
   $("f-groupkey").style.display =
     $("f-group").value === "annotation" ? "" : "none";
 });
-$("save-view").onclick = () => {
+$("save-view").onclick = async () => {
   const name = prompt("view name:");
   if (!name) return;
-  const views = loadViews();
-  views[name] = Object.fromEntries(
+  const payload = Object.fromEntries(
     ["f-queue", "f-jobset", "f-state", "f-ann", "f-group", "f-groupkey"]
       .map((id) => [id, $(id).value]));
-  localStorage.setItem(VIEWS_KEY, JSON.stringify(views));
-  renderViews();
+  await fetch("/api/views", {
+    method: "POST", headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({name, payload}),
+  });
+  await loadViews();
   $("views").value = name;
 };
-$("views").addEventListener("change", () => {
+$("del-view").onclick = async () => {
   const name = $("views").value;
-  if (name === "__clear__") {
-    localStorage.removeItem(VIEWS_KEY);
-    renderViews();
-    return;
-  }
-  const v = loadViews()[name];
+  if (!name || !confirm(`delete view "${name}"?`)) return;
+  await fetch("/api/views/" + encodeURIComponent(name), {method: "DELETE"});
+  $("views").value = "";
+  await loadViews();
+};
+$("views").addEventListener("change", () => {
+  const v = serverViews[$("views").value];
   if (v) applyView(v);
 });
-renderViews();
+loadViews();
 $("theme").onclick = () => {
   const r = document.documentElement;
   r.dataset.theme = dark() ? "light" : "dark";
@@ -478,17 +588,75 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"error": f"no job {job_id}"}, 404)
                 else:
                     self._json(details)
+            elif path == "/api/logs":
+                if srv.logs_of is None:
+                    self._json(
+                        {"error": "no binoculars wired (serve --binoculars-url)"},
+                        501,
+                    )
+                    return
+                job_id = qs.get("job", [""])[0]
+                run_id = qs.get("run", [""])[0]
+                try:
+                    self._json(
+                        {"log": srv.logs_of(job_id=job_id, run_id=run_id)}
+                    )
+                except KeyError as exc:
+                    self._json({"error": str(exc)}, 404)
+                except Exception as exc:  # cluster-side failure, not a 500
+                    self._json({"error": f"binoculars: {exc}"}, 502)
+            elif path == "/api/views":
+                self._json({"views": q.list_views()})
             else:
                 self._json({"error": "not found"}, 404)
         except (ValueError, KeyError) as exc:
             self._json({"error": str(exc)}, 400)
 
+    def do_POST(self):  # noqa: N802
+        srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
+        path = urlparse(self.path).path
+        try:
+            if path == "/api/views":
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                name = str(body.get("name", ""))
+                payload = json.dumps(body.get("payload", {}))
+                srv.queries.save_view(name, payload, now_ns=time.time_ns())
+                self._json({"ok": True})
+            else:
+                self._json({"error": "not found"}, 404)
+        except (ValueError, KeyError) as exc:
+            self._json({"error": str(exc)}, 400)
+
+    def do_DELETE(self):  # noqa: N802
+        srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
+        path = urlparse(self.path).path
+        if path.startswith("/api/views/"):
+            name = unquote(path[len("/api/views/") :])
+            if srv.queries.delete_view(name):
+                self._json({"ok": True})
+            else:
+                self._json({"error": f"no view {name}"}, 404)
+        else:
+            self._json({"error": "not found"}, 404)
+
 
 class LookoutWebUI:
-    """Serves the dashboard + JSON API on `port` (0 = pick a free one)."""
+    """Serves the dashboard + JSON API on `port` (0 = pick a free one).
 
-    def __init__(self, queries: LookoutQueries, port: int = 0, host: str = "127.0.0.1"):
+    `logs_of(job_id=..., run_id=...) -> str` supplies pod logs -- wire a
+    BinocularsClient.logs (rpc/client.py) or an in-process
+    executor.binoculars.Binoculars.logs; None disables the log viewer."""
+
+    def __init__(
+        self,
+        queries: LookoutQueries,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        logs_of: Optional[Callable] = None,
+    ):
         self.queries = queries
+        self.logs_of = logs_of
         self.page = _render_page()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -1947,12 +1947,20 @@ class IncrementalBuilder:
                 if lead.resources is not None
                 else np.zeros((self.R,), np.float32)
             )
-            price = float(self.bid_price_of(lead)) if self.bid_price_of else 0.0
+            # f32-canonical, like the [Q,B] table and the kernel's g_price
+            # (build_problem rounds identically)
+            price = (
+                float(np.float32(self.bid_price_of(lead)))
+                if self.bid_price_of
+                else 0.0
+            )
             spot = (
                 price
                 if len(grp) == 1
                 else min(
-                    float(self.bid_price_of(m)) if self.bid_price_of else 0.0
+                    float(np.float32(self.bid_price_of(m)))
+                    if self.bid_price_of
+                    else 0.0
                     for m in grp
                 )
             )

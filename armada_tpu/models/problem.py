@@ -586,7 +586,13 @@ def build_problem(
     market = bool(pool_cfg is not None and getattr(pool_cfg, "market_driven", False))
     if market and bid_price_of is None:
         raise ValueError(f"pool {pool} is market driven but no bid_price_of given")
-    price_of = bid_price_of or (lambda job: 0.0)
+    # Prices are f32-canonical everywhere they order candidates: the kernel
+    # orders queues by the f32 g_price tensor, and the incremental builder's
+    # (queue, band) table is f32 -- rounding HERE too keeps the within-queue
+    # order consistent across all three, even for f64-distinct prices that
+    # collide in f32 (CLAUDE.md parity: f32 score arithmetic is the canon).
+    _raw_price_of = bid_price_of or (lambda job: 0.0)
+    price_of = lambda job: float(np.float32(_raw_price_of(job)))  # noqa: E731
     queue_by_name = {q.name: i for i, q in enumerate(sorted(queues, key=lambda q: q.name))}
     sorted_queues = sorted(queues, key=lambda q: q.name)
 

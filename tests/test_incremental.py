@@ -616,3 +616,25 @@ def test_market_non_f32_exact_price_ranks_units_correctly():
     )
     _outcomes_equal(fresh, incr)
     assert sorted(incr.scheduled) == ["j0", "j1", "j2", "j3"]
+
+
+def test_market_f32_colliding_prices_order_identically():
+    """Two bands whose prices differ in f64 but collide in f32 must order
+    the same on both paths: prices are f32-canonical everywhere they order
+    candidates (the kernel's g_price is f32; build_problem and the
+    incremental table both round before comparing)."""
+    nodes = [_node("n0", cpu="3")]
+    queues = [Queue("qa", 1.0)]
+    jobs = []
+    for i, (band, sub) in enumerate(
+        [("low", 1.0), ("high", 2.0), ("low", 3.0), ("high", 4.0), ("low", 5.0)]
+    ):
+        jobs.append(_job(f"c{i}", "qa", 1, sub=sub, price_band=band))
+    # f64-distinct, f32-equal: both round to np.float32(1.0000000001) == 1.0
+    prices = {("qa", "low"): 1.0000000001, ("qa", "high"): 1.0, ("qa", ""): 0.0}
+    price_of = _pricer(prices)
+    fresh = _round(*_market_fresh(nodes, queues, jobs, [], price_of))
+    incr = _round(*_market_incr(nodes, queues, jobs, [], price_of).assemble())
+    _outcomes_equal(fresh, incr)
+    # the f32 tie means (sub, id) interleave: earliest submits win the node
+    assert sorted(fresh.scheduled) == ["c0", "c1", "c2"]

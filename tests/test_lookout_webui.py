@@ -126,3 +126,109 @@ def test_take_clamped_and_unknown_job_404(world):
     with pytest.raises(urllib.error.HTTPError) as e:
         get(ui.port, "/api/job/no-such-job")
     assert e.value.code == 404
+
+
+def req(port, path, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=data,
+        method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_saved_views_are_server_side(world):
+    """Saved views persist in the lookout DB (the reference UI's
+    server-backed views), not the browser."""
+    plane, pipeline, ui = world
+    assert get(ui.port, "/api/views") == {"views": []}
+    st, _ = req(ui.port, "/api/views", "POST",
+                {"name": "prod-fails", "payload": {"f-queue": "qa", "f-state": "FAILED"}})
+    assert st == 200
+    views = get(ui.port, "/api/views")["views"]
+    assert [v["name"] for v in views] == ["prod-fails"]
+    assert json.loads(views[0]["payload"])["f-queue"] == "qa"
+    # upsert overwrites
+    req(ui.port, "/api/views", "POST",
+        {"name": "prod-fails", "payload": {"f-queue": "qb"}})
+    views = get(ui.port, "/api/views")["views"]
+    assert len(views) == 1 and json.loads(views[0]["payload"])["f-queue"] == "qb"
+    st, _ = req(ui.port, "/api/views/prod-fails", "DELETE")
+    assert st == 200
+    assert get(ui.port, "/api/views") == {"views": []}
+    st, _ = req(ui.port, "/api/views/missing", "DELETE")
+    assert st == 404
+    st, _ = req(ui.port, "/api/views", "POST", {"name": "", "payload": {}})
+    assert st == 400
+
+
+def test_logs_endpoint_without_binoculars_is_501(world):
+    plane, pipeline, ui = world
+    st, body = req(ui.port, "/api/logs?job=x&run=y")
+    assert st == 501 and "binoculars" in body["error"]
+
+
+def test_logs_endpoint_serves_pod_logs(tmp_path):
+    """queue -> job -> runs -> logs without the CLI: the UI fetches pod logs
+    through a binoculars logs callable (binoculars logs.go:39-43)."""
+    plane = ControlPlane.build(tmp_path)
+    plane.server.create_queue(QueueRecord("qa"))
+    lookoutdb = LookoutDb(":memory:")
+    pipeline = IngestionPipeline(
+        plane.log, lookoutdb, lookout_converter, consumer_name="lookout"
+    )
+
+    def logs_of(job_id="", run_id=""):
+        if run_id == "gone" or job_id == "gone":
+            raise KeyError(f"no pod for {job_id or run_id}")
+        return f"log line for {job_id or run_id}\n"
+
+    ui = LookoutWebUI(LookoutQueries(lookoutdb), logs_of=logs_of)
+    try:
+        (jid,) = plane.server.submit_jobs(
+            "qa", "js1", [JobSubmitItem(resources={"cpu": "1", "memory": "1"})]
+        )
+        plane.executors[0].run_once()
+        pipeline.run_until_caught_up()
+        st, body = req(ui.port, f"/api/logs?job={jid}")
+        assert st == 200 and jid in body["log"]
+        st, body = req(ui.port, "/api/logs?job=gone")
+        assert st == 404
+    finally:
+        ui.stop()
+        lookoutdb.close()
+        plane.close()
+
+
+def test_serve_wires_binoculars_log_viewer(tmp_path):
+    """serve --binoculars-url: the control plane's lookout UI reaches a
+    cluster's binoculars service over gRPC for the log viewer."""
+    from armada_tpu.rpc.server import make_server
+    from armada_tpu.cli.serve import start_control_plane
+
+    class _Logs:
+        def logs(self, job_id="", run_id=""):
+            if job_id == "ghost":
+                raise KeyError("no pod for job ghost")
+            return f"hello from {job_id or run_id}"
+
+    bserver, bport = make_server(binoculars=_Logs())
+    plane = start_control_plane(
+        str(tmp_path), cycle_interval_s=0.2, schedule_interval_s=0.5,
+        lookout_port=0, binoculars_url=f"127.0.0.1:{bport}",
+    )
+    try:
+        st, body = req(plane.lookout_web.port, "/api/logs?job=j123")
+        assert st == 200 and body["log"] == "hello from j123"
+        st, body = req(plane.lookout_web.port, "/api/logs?job=ghost")
+        # gRPC NOT_FOUND surfaces as an upstream error, not a UI crash
+        assert st in (404, 502) and "ghost" in body["error"]
+    finally:
+        plane.stop()
+        bserver.stop(None)
