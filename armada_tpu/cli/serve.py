@@ -386,8 +386,12 @@ def run_fake_executor(
 
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
+    submit_brake = None
     if kubernetes_url or kubernetes_in_cluster:
-        from armada_tpu.executor.kubernetes import KubernetesClusterContext
+        from armada_tpu.executor.kubernetes import (
+            KubernetesClusterContext,
+            etcd_health_brake,
+        )
 
         if kubernetes_in_cluster:
             cluster = KubernetesClusterContext.in_cluster(
@@ -407,6 +411,9 @@ def run_fake_executor(
                 node_id_label=config.node_id_label,
                 executor_id=executor_id,
             )
+        # Real clusters get the etcd-health submission brake by default
+        # (executor/application.go:63-103); the fake cluster has no etcd.
+        submit_brake = etcd_health_brake(cluster)
     else:
         nodes = [
             NodeSpec(
@@ -449,6 +456,7 @@ def run_fake_executor(
         factory,
         pod_check_rules=pod_check_rules,
         failed_pod_checker=failed_pod_checker,
+        submit_brake=submit_brake,
     )
     binoculars_server = None
     if binoculars_port is not None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Optional, Sequence
@@ -471,3 +472,30 @@ class KubernetesClusterContext:
         if tail_lines:
             path += f"?tailLines={int(tail_lines)}"
         return self._request("GET", path, raw=True)
+
+
+def etcd_health_brake(cluster: "KubernetesClusterContext", cooldown_s: float = 10.0):
+    """Submission brake over the kube apiserver's etcd readiness
+    (`/readyz/etcd`) -- the reference executor pauses pod submission when
+    etcd is over its health limits (common/etcdhealth/etcdhealth.go,
+    executor/application.go:63-103).  Returns a callable for
+    ExecutorService(submit_brake=...): a reason string while etcd is
+    unhealthy/unreachable, None when ok.  Probes at most every `cooldown_s`
+    (the lease loop runs every second; readyz is cheap but not free)."""
+    state = {"t": -cooldown_s, "reason": None}
+
+    def brake():
+        now = time.monotonic()  # wall-clock steps must not freeze re-probing
+        if now - state["t"] < cooldown_s:
+            return state["reason"]
+        state["t"] = now
+        try:
+            body = cluster._request("GET", "/readyz/etcd", raw=True)
+            state["reason"] = (
+                None if "ok" in body.lower() else f"etcd readyz: {body[:120]}"
+            )
+        except Exception as e:  # unreachable apiserver counts as unhealthy
+            state["reason"] = f"etcd readyz probe failed: {e}"[:200]
+        return state["reason"]
+
+    return brake

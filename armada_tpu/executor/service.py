@@ -15,6 +15,7 @@ ExecutorApi or a gRPC client stub.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Optional
 
@@ -41,12 +42,21 @@ class ExecutorService:
         pending_timeout_s: float = 600.0,
         pod_check_rules: tuple = (),
         failed_pod_checker=None,
+        submit_brake: Optional[Callable[[], Optional[str]]] = None,
     ):
         """pending_timeout_s: pods stuck PENDING this long are returned for
         rescheduling (podchecks' stuck-pod detection,
         internal/executor/podchecks/pod_checks.go); <= 0 disables.
         pod_check_rules: regex rules over pending pods' diagnostics that can
-        retry or fail-fast before the blanket timeout (executor/podchecks.py)."""
+        retry or fail-fast before the blanket timeout (executor/podchecks.py).
+        submit_brake: () -> reason-or-None; a non-None reason pauses NEW pod
+        submission for the cycle (cancels/preempts/reports still flow) -- the
+        reference's etcd-health brake (common/etcdhealth/etcdhealth.go,
+        executor/application.go:63-103 gates allocation on the soft health
+        limit).  Wire executor.kubernetes.etcd_health_brake for real
+        clusters.  Leases withheld while braked stay leased scheduler-side
+        and are re-offered when the brake lifts; a prolonged pause ends in
+        the scheduler's unacknowledged-lease expiry reclaiming them."""
         self.id = executor_id
         self.pool = pool
         self.cluster = cluster
@@ -68,6 +78,9 @@ class ExecutorService:
         # active_run_ids until the scheduler tells us they're dead
         # (runs_to_cancel), else a lagging ingester would re-lease them.
         self._awaiting_ack: set[str] = set()
+        self._submit_brake = submit_brake
+        # Last brake reason (None = flowing); exposed for metrics/logs.
+        self.brake_reason: Optional[str] = None
 
     # --- snapshot -----------------------------------------------------------
 
@@ -97,7 +110,20 @@ class ExecutorService:
         active = tuple(p.run_id for p in self.cluster.pod_states()) + tuple(
             self._awaiting_ack
         )
-        request = LeaseRequest(snapshot=self.snapshot(), active_run_ids=active)
+        reason = self._submit_brake() if self._submit_brake is not None else None
+        if reason != self.brake_reason:
+            logging.getLogger(__name__).warning(
+                "executor %s submission brake %s%s",
+                self.id,
+                "ENGAGED" if reason else "released",
+                f": {reason}" if reason else "",
+            )
+            self.brake_reason = reason
+        request = LeaseRequest(
+            snapshot=self.snapshot(),
+            active_run_ids=active,
+            pause_new_leases=reason is not None,
+        )
         response = self.api.lease_job_runs(request)
 
         # Stop dead runs FIRST: a new lease may target the very capacity a
@@ -167,8 +193,13 @@ class ExecutorService:
 
         if errors or preempted:
             self.api.report_events(errors + preempted)
-        # Rejections resolve once the scheduler stops offering the run.
-        self._rejected &= {l.run_id for l in response.leases}
+        # Rejections resolve once the scheduler stops offering the run -- but
+        # a braked cycle withholds offers without the scheduler having
+        # stopped, so it must not clear the suppression set (a cleared entry
+        # would let a still-leased rejected run resubmit after release,
+        # duplicating its terminal error event).
+        if request.pause_new_leases is False:
+            self._rejected &= {l.run_id for l in response.leases}
         return response
 
     # --- state reporting (job_state_reporter.go) ----------------------------

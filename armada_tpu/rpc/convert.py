@@ -188,6 +188,7 @@ def lease_request_to_proto(
     return pb.LeaseJobRunsRequest(
         snapshot=snapshot_to_proto(req.snapshot, factory),
         active_run_ids=list(req.active_run_ids),
+        pause_new_leases=req.pause_new_leases,
     )
 
 
@@ -197,6 +198,7 @@ def lease_request_from_proto(
     return LeaseRequest(
         snapshot=snapshot_from_proto(msg.snapshot, factory),
         active_run_ids=tuple(msg.active_run_ids),
+        pause_new_leases=bool(msg.pause_new_leases),
     )
 
 

@@ -38,10 +38,16 @@ class JobRunLease:
 @dataclasses.dataclass(frozen=True)
 class LeaseRequest:
     """What the executor sends: its snapshot + the runs it believes it owns
-    (executorapi.proto LeaseRequest:  capacity, node infos, run ids)."""
+    (executorapi.proto LeaseRequest:  capacity, node infos, run ids).
+
+    pause_new_leases: the executor's submission brake is engaged (the
+    reference's etcd-health soft limit pauses pod submission,
+    common/etcdhealth/etcdhealth.go + executor/application.go:63-103) --
+    report state and receive cancels/preempts, but offer no new leases."""
 
     snapshot: ExecutorSnapshot
     active_run_ids: tuple[str, ...] = ()
+    pause_new_leases: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +87,16 @@ class ExecutorApi:
         )
 
         leases = []
+        if request.pause_new_leases:
+            # Submission brake engaged cluster-side: state is reported and
+            # cancels/preempts still flow, but no new work is offered (the
+            # runs stay leased in the DB and are offered again once the
+            # brake releases).
+            return LeaseResponse(
+                leases=(),
+                runs_to_cancel=to_cancel,
+                runs_to_preempt=to_preempt,
+            )
         for row in self._db.leases_for_executor(snap.id, self._max_leases):
             if row["run_id"] in known:
                 continue

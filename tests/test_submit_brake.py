@@ -1,0 +1,116 @@
+"""Executor submission brake: the etcd-health analog.
+
+The reference executor pauses NEW pod submission when etcd is over its
+health limits while cancels/preempts/state reports keep flowing
+(internal/common/etcdhealth/etcdhealth.go, executor/application.go:63-103
+gates AllocateSpareClusterCapacity on the soft limit).  Here the brake is a
+pluggable `submit_brake` callable on ExecutorService; while engaged the
+lease request carries pause_new_leases and the scheduler offers nothing new
+-- withheld leases re-offer when the brake lifts.
+"""
+
+import http.server
+import threading
+
+from armada_tpu.server import JobSubmitItem, QueueRecord
+from tests.control_plane import ControlPlane
+
+
+def _world(tmp_path, brake):
+    plane = ControlPlane.build(tmp_path, runtime_s=300.0)
+    plane.server.create_queue(QueueRecord("q"))
+    ex = plane.executors[0]
+    ex._submit_brake = brake
+    return plane, ex
+
+
+def item(cpu="1"):
+    return JobSubmitItem(resources={"cpu": cpu, "memory": "1"})
+
+
+def test_brake_pauses_new_pods_and_releases(tmp_path):
+    state = {"reason": None}
+    plane, ex = _world(tmp_path, lambda: state["reason"])
+    ex.run_once()  # register the executor's snapshot with the scheduler
+    ids = plane.server.submit_jobs("q", "js", [item()] * 3)
+    plane.ingest()
+    plane.scheduler.cycle()  # leases assigned scheduler-side
+    plane.ingest()  # lease events land in the runs table
+
+    state["reason"] = "etcd 95% full"  # brake engages before any pod starts
+    ex.run_once()
+    assert ex.brake_reason == "etcd 95% full"
+    assert not ex.cluster.pod_states()  # nothing submitted while braked
+
+    ex.run_once()
+    assert not ex.cluster.pod_states()  # still paused, still no pods
+
+    state["reason"] = None  # etcd recovered
+    ex.run_once()
+    assert ex.brake_reason is None
+    # the withheld leases were re-offered and submitted
+    assert {p.job_id for p in ex.cluster.pod_states()} == set(ids)
+
+
+def test_brake_still_processes_cancels(tmp_path):
+    state = {"reason": None}
+    plane, ex = _world(tmp_path, lambda: state["reason"])
+    ex.run_once()  # register the executor's snapshot
+    ids = plane.server.submit_jobs("q", "js", [item()] * 2)
+    plane.ingest()
+    plane.scheduler.cycle()
+    plane.ingest()
+    ex.run_once()
+    assert len(ex.cluster.pod_states()) == 2
+
+    # brake engages; a cancellation arrives
+    state["reason"] = "etcd degraded"
+    plane.server.cancel_jobs("q", "js", [ids[0]], "user asked")
+    plane.ingest()
+    plane.scheduler.cycle()
+    plane.ingest()
+    ex.run_once()
+    # the cancelled pod was deleted even though submission is paused
+    assert {p.job_id for p in ex.cluster.pod_states()} == {ids[1]}
+
+
+def test_etcd_health_brake_against_http_endpoint(tmp_path):
+    """etcd_health_brake probes the apiserver's /readyz/etcd."""
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.executor.kubernetes import (
+        KubernetesClusterContext,
+        etcd_health_brake,
+    )
+
+    state = {"body": b"ok", "status": 200}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/readyz/etcd":
+                self.send_response(state["status"])
+                self.send_header("Content-Length", str(len(state["body"])))
+                self.end_headers()
+                self.wfile.write(state["body"])
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        factory = SchedulingConfig().resource_list_factory()
+        cluster = KubernetesClusterContext(
+            f"http://127.0.0.1:{srv.server_address[1]}", factory
+        )
+        brake = etcd_health_brake(cluster, cooldown_s=0.0)
+        assert brake() is None
+        state["body"], state["status"] = b"etcd failed: context deadline", 500
+        assert "etcd" in brake()
+        state["body"], state["status"] = b"ok", 200
+        assert brake() is None
+    finally:
+        srv.shutdown()
+        srv.server_close()
