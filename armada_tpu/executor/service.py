@@ -198,7 +198,7 @@ class ExecutorService:
         # stopped, so it must not clear the suppression set (a cleared entry
         # would let a still-leased rejected run resubmit after release,
         # duplicating its terminal error event).
-        if request.pause_new_leases is False:
+        if not request.pause_new_leases:
             self._rejected &= {l.run_id for l in response.leases}
         return response
 

@@ -337,6 +337,11 @@ class IncrementalBuilder:
         # poll granularity, not per 1s cycle; unchanged prices cost nothing).
         self._last_prices: Optional[np.ndarray] = None
         self._price_epoch = 0
+        # Previous cycle's candidate order, for the device-side gq splice
+        # (DeltaBundle.gq_splice): shipping the 4MB [G] order vector whole
+        # every cycle was the dominant per-cycle upload on the TPU tunnel.
+        self._prev_gq: Optional[np.ndarray] = None
+        self._prev_gq_real = 0
         # Identity-stable small tensors (re-sent only when values change).
         self._stable_smalls: dict[str, np.ndarray] = {}
         self.gang_jobs: dict[str, JobSpec] = {}  # job id -> spec (slow path)
@@ -1613,6 +1618,60 @@ class IncrementalBuilder:
         )
         rr.dirty_log.clear()
 
+        # --- gq splice: rebuild the order vector ON DEVICE from last cycle's
+        # (slab.DeltaBundle.gq_splice) instead of re-uploading 4MB.  Sound
+        # exactly when the SURVIVING candidates' relative order is unchanged
+        # (steady state: departures + arrivals, order carried by the stable
+        # tables); verified against our own previous vector -- the device's
+        # copy matches it whenever the cache takes the delta path
+        # (seq-consecutive + same sig), and any fallback re-uploads whole.
+        # Slots dirtied THIS cycle never count as survivors: a slot released
+        # by a scheduled job and re-allocated to a fresh submit keeps its id
+        # but moves position (remove old + insert new is always sound).
+        gq_splice = None
+        prev_gq, L0 = self._prev_gq, self._prev_gq_real
+        L1 = int(nreal_candidates)
+        if prev_gq is not None and prev_gq.shape[0] == G:
+            dirty_slot = np.zeros((G,), bool)
+            dirty_slot[sg_idx[sg_idx < G]] = True  # singles + units regions
+            ev_dirty = s_cap + rr_dirty
+            dirty_slot[ev_dirty[ev_dirty < G]] = True  # evictee projection
+            prev_real = prev_gq[:L0]
+            in_new = np.zeros((G,), bool)
+            in_new[gq_real] = True
+            in_prev = np.zeros((G,), bool)
+            in_prev[prev_real] = True
+            surv = in_new & in_prev & ~dirty_slot
+            dep = ~surv[prev_real]  # departed/moved, prev positions
+            arr = ~surv[gq_real]  # arrived/moved, final positions
+            kept_prev = prev_real[~dep]
+            new_minus = gq_real[~arr]
+            if kept_prev.shape[0] == new_minus.shape[0] and np.array_equal(
+                kept_prev, new_minus
+            ):
+                rem = np.flatnonzero(dep)
+                ins = np.flatnonzero(arr)
+                vals = gq_real[ins]
+                # padded-tail zeros shift with the real-region length
+                if L1 > L0:  # fewer tail zeros: drop from the prev tail
+                    rem = np.concatenate([rem, np.arange(G - (L1 - L0), G)])
+                elif L0 > L1:  # more tail zeros: insert at the final tail
+                    ins = np.concatenate([ins, np.arange(G - (L0 - L1), G)])
+                    vals = np.concatenate(
+                        [vals, np.zeros((L0 - L1,), vals.dtype)]
+                    )
+                # a big splice costs more than the 4MB it saves
+                if rem.shape[0] + ins.shape[0] <= max(4096, G // 8):
+                    gq_splice = (
+                        rem.astype(np.int32),
+                        ins.astype(np.int32),
+                        vals.astype(np.int32),
+                    )
+        # gq_gang is freshly allocated per cycle and never mutated after
+        # this point: keep the reference, no 4MB copy
+        self._prev_gq = gq_gang
+        self._prev_gq_real = L1
+
         is_unit = sg_idx >= u_base
         i_sing = sg_idx[~is_unit]
         i_unit = sg_idx[is_unit] - u_base
@@ -1681,7 +1740,8 @@ class IncrementalBuilder:
         }
 
         fulls = {
-            "gq_gang": gq_gang,
+            # omitted when the splice carries the order (a few KB vs 4MB)
+            **({} if gq_splice is not None else {"gq_gang": gq_gang}),
             "q_start": q_start,
             "q_len": q_len,
             "q_weight": self._stable("q_weight", q_weight),
@@ -1842,6 +1902,7 @@ class IncrementalBuilder:
             rr_cols=rr_cols,
             ev_cols=ev_cols,
             fulls=fulls,
+            gq_splice=gq_splice,
         )
 
         class _SparseGroups:

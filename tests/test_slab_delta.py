@@ -222,6 +222,9 @@ def test_slab_delta_matches_legacy_over_cycles():
     # or epoch changes (first cycle + slab growths + node epoch bumps), not
     # every cycle.
     assert d.full_uploads < 7, f"delta path never engaged ({d.full_uploads} full uploads)"
+    # ... and steady-state cycles must carry the candidate order as a gq
+    # SPLICE (device-side rebuild), not a 4MB re-upload.
+    assert getattr(d.cache, "splice_applies", 0) > 0, "gq splice never engaged"
 
 
 def test_slab_delta_lookback_truncation():
