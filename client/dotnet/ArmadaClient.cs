@@ -1,0 +1,137 @@
+// Thin .NET client for the armada-tpu control plane.
+//
+// Mirrors the Python client's approach (armada_tpu/rpc/client.py): generic
+// gRPC method descriptors over the generated protobuf messages -- no
+// Grpc.Tools service codegen needed, only `tools/genclients.sh OUT csharp`
+// for the message classes (ArmadaTpu.Api / ArmadaTpu.Events namespaces).
+//
+// Reference parity: client/DotNet (Armada.Client).
+
+using System;
+using System.Collections.Generic;
+using Grpc.Core;
+using Grpc.Net.Client;
+using ArmadaTpu.Api;
+
+namespace ArmadaTpu.Client
+{
+    public sealed class ArmadaClient : IDisposable
+    {
+        private readonly GrpcChannel _channel;
+        private readonly CallInvoker _invoker;
+        private readonly Metadata _headers;
+
+        /// <param name="address">http://host:port (plaintext dev; https behind TLS)</param>
+        /// <param name="principal">x-armada-principal trusted header (dev auth
+        /// chains); use bearerToken for OIDC/token-review chains</param>
+        public ArmadaClient(string address, string principal = "anonymous",
+                            string bearerToken = null)
+        {
+            _channel = GrpcChannel.ForAddress(address);
+            _invoker = _channel.CreateCallInvoker();
+            _headers = new Metadata();
+            if (bearerToken != null)
+                _headers.Add("authorization", $"Bearer {bearerToken}");
+            else
+                _headers.Add("x-armada-principal", principal);
+        }
+
+        private static Method<TReq, TRes> Unary<TReq, TRes>(string service, string name)
+            where TReq : class, Google.Protobuf.IMessage<TReq>, new()
+            where TRes : class, Google.Protobuf.IMessage<TRes>, new()
+        {
+            return new Method<TReq, TRes>(
+                MethodType.Unary, service, name,
+                Marshallers.Create(
+                    m => Google.Protobuf.MessageExtensions.ToByteArray(m),
+                    d => new Google.Protobuf.MessageParser<TReq>(() => new TReq()).ParseFrom(d)),
+                Marshallers.Create(
+                    m => Google.Protobuf.MessageExtensions.ToByteArray(m),
+                    d => new Google.Protobuf.MessageParser<TRes>(() => new TRes()).ParseFrom(d)));
+        }
+
+        private TRes Call<TReq, TRes>(string service, string name, TReq req)
+            where TReq : class, Google.Protobuf.IMessage<TReq>, new()
+            where TRes : class, Google.Protobuf.IMessage<TRes>, new()
+        {
+            return _invoker.BlockingUnaryCall(
+                Unary<TReq, TRes>(service, name), null,
+                new CallOptions(_headers), req);
+        }
+
+        // --- submit surface (armada_tpu.api.Submit) -------------------------
+
+        public IList<string> SubmitJobs(string queue, string jobset,
+                                        IEnumerable<SubmitItem> items)
+        {
+            var req = new SubmitJobsRequest { Queue = queue, Jobset = jobset };
+            req.Items.AddRange(items);
+            return Call<SubmitJobsRequest, SubmitJobsResponse>(
+                "armada_tpu.api.Submit", "SubmitJobs", req).JobIds;
+        }
+
+        public void CancelJobs(string queue, string jobset,
+                               IEnumerable<string> jobIds, string reason = "")
+        {
+            var req = new CancelJobsRequest
+            { Queue = queue, Jobset = jobset, Reason = reason };
+            req.JobIds.AddRange(jobIds);
+            Call<CancelJobsRequest, Empty>("armada_tpu.api.Submit", "CancelJobs", req);
+        }
+
+        public void PreemptJobs(string queue, string jobset,
+                                IEnumerable<string> jobIds, string reason = "")
+        {
+            var req = new PreemptJobsRequest
+            { Queue = queue, Jobset = jobset, Reason = reason };
+            req.JobIds.AddRange(jobIds);
+            Call<PreemptJobsRequest, Empty>("armada_tpu.api.Submit", "PreemptJobs", req);
+        }
+
+        public void ReprioritizeJobs(string queue, string jobset, long priority,
+                                     IEnumerable<string> jobIds)
+        {
+            var req = new ReprioritizeJobsRequest
+            { Queue = queue, Jobset = jobset, Priority = priority };
+            req.JobIds.AddRange(jobIds);
+            Call<ReprioritizeJobsRequest, Empty>(
+                "armada_tpu.api.Submit", "ReprioritizeJobs", req);
+        }
+
+        public void CreateQueue(Queue queue) =>
+            Call<Queue, Empty>("armada_tpu.api.Submit", "CreateQueue", queue);
+
+        public IList<Queue> ListQueues() =>
+            Call<Empty, QueueListResponse>(
+                "armada_tpu.api.Submit", "ListQueues", new Empty()).Queues;
+
+        // --- event surface (armada_tpu.api.Event) ---------------------------
+
+        /// Stream jobset events from fromIdx; watch keeps the stream open
+        /// (idleTimeoutS without progress ends it).  Each message's Idx is
+        /// the resume cursor to persist.
+        public IAsyncEnumerable<JobSetEventMessage> Watch(
+            string queue, string jobset, long fromIdx = 0,
+            bool watch = true, double idleTimeoutS = 0)
+        {
+            var method = new Method<JobSetEventsRequest, JobSetEventMessage>(
+                MethodType.ServerStreaming, "armada_tpu.api.Event", "GetJobSetEvents",
+                Marshallers.Create(
+                    m => Google.Protobuf.MessageExtensions.ToByteArray(m),
+                    d => JobSetEventsRequest.Parser.ParseFrom(d)),
+                Marshallers.Create(
+                    m => Google.Protobuf.MessageExtensions.ToByteArray(m),
+                    d => JobSetEventMessage.Parser.ParseFrom(d)));
+            var call = _invoker.AsyncServerStreamingCall(
+                method, null, new CallOptions(_headers),
+                new JobSetEventsRequest
+                {
+                    Queue = queue, Jobset = jobset, FromIdx = fromIdx,
+                    Watch = watch, IdleTimeoutS = idleTimeoutS,
+                });
+            return call.ResponseStream.ReadAllAsync();
+        }
+
+        public void Dispose() => _channel.Dispose();
+    }
+}

@@ -1,0 +1,159 @@
+/*
+ * Thin Java client for the armada-tpu control plane.
+ *
+ * Mirrors the Python client's approach (armada_tpu/rpc/client.py): generic
+ * gRPC method descriptors over the generated protobuf messages -- no
+ * grpc-java service codegen needed, only `tools/genclients.sh OUT java`
+ * for the message classes (armada_tpu.api.Rpc / armada_tpu.events.Events).
+ *
+ * Reference parity: client/java (pkg/api bindings); the verbs here are the
+ * Submit/Event service surface armadactl exposes.
+ */
+package io.armadatpu;
+
+import armada_tpu.api.Rpc;
+import io.grpc.CallOptions;
+import io.grpc.ManagedChannel;
+import io.grpc.ManagedChannelBuilder;
+import io.grpc.Metadata;
+import io.grpc.MethodDescriptor;
+import io.grpc.protobuf.ProtoUtils;
+import io.grpc.stub.ClientCalls;
+import io.grpc.stub.MetadataUtils;
+
+import java.util.Iterator;
+import java.util.List;
+
+public final class ArmadaClient implements AutoCloseable {
+
+    private final ManagedChannel channel;
+    private final io.grpc.Channel stubChannel;
+
+    /**
+     * @param target    host:port of the control plane (plaintext gRPC; put a
+     *                  TLS terminator in front for production)
+     * @param principal rides the x-armada-principal trusted header (dev auth
+     *                  chains); pass a bearer token via {@link #withBearer}
+     *                  for OIDC/token-review chains instead
+     */
+    public ArmadaClient(String target, String principal) {
+        this.channel = ManagedChannelBuilder.forTarget(target).usePlaintext().build();
+        Metadata md = new Metadata();
+        md.put(Metadata.Key.of("x-armada-principal", Metadata.ASCII_STRING_MARSHALLER),
+                principal);
+        this.stubChannel = io.grpc.ClientInterceptors.intercept(
+                channel, MetadataUtils.newAttachHeadersInterceptor(md));
+    }
+
+    private ArmadaClient(ManagedChannel channel, io.grpc.Channel stubChannel) {
+        this.channel = channel;
+        this.stubChannel = stubChannel;
+    }
+
+    /** The same client with an Authorization: Bearer header (server authn). */
+    public static ArmadaClient withBearer(String target, String token) {
+        ManagedChannel ch = ManagedChannelBuilder.forTarget(target).usePlaintext().build();
+        Metadata md = new Metadata();
+        md.put(Metadata.Key.of("authorization", Metadata.ASCII_STRING_MARSHALLER),
+                "Bearer " + token);
+        return new ArmadaClient(ch, io.grpc.ClientInterceptors.intercept(
+                ch, MetadataUtils.newAttachHeadersInterceptor(md)));
+    }
+
+    private static <Req extends com.google.protobuf.Message,
+                    Res extends com.google.protobuf.Message>
+            MethodDescriptor<Req, Res> unary(String fullName, Req defReq, Res defRes) {
+        return MethodDescriptor.<Req, Res>newBuilder()
+                .setType(MethodDescriptor.MethodType.UNARY)
+                .setFullMethodName(fullName)
+                .setRequestMarshaller(ProtoUtils.marshaller(defReq))
+                .setResponseMarshaller(ProtoUtils.marshaller(defRes))
+                .build();
+    }
+
+    private <Req extends com.google.protobuf.Message,
+             Res extends com.google.protobuf.Message>
+            Res call(String fullName, Req req, Res defRes) {
+        @SuppressWarnings("unchecked")
+        MethodDescriptor<Req, Res> md =
+                unary(fullName, (Req) req.getDefaultInstanceForType(), defRes);
+        return ClientCalls.blockingUnaryCall(stubChannel, md, CallOptions.DEFAULT, req);
+    }
+
+    // --- submit surface (armada_tpu.api.Submit) ----------------------------
+
+    public List<String> submitJobs(String queue, String jobset,
+                                   List<Rpc.SubmitItem> items) {
+        Rpc.SubmitJobsRequest req = Rpc.SubmitJobsRequest.newBuilder()
+                .setQueue(queue).setJobset(jobset).addAllItems(items).build();
+        return call("armada_tpu.api.Submit/SubmitJobs", req,
+                Rpc.SubmitJobsResponse.getDefaultInstance()).getJobIdsList();
+    }
+
+    public void cancelJobs(String queue, String jobset, List<String> jobIds,
+                           String reason) {
+        call("armada_tpu.api.Submit/CancelJobs",
+                Rpc.CancelJobsRequest.newBuilder().setQueue(queue).setJobset(jobset)
+                        .addAllJobIds(jobIds).setReason(reason).build(),
+                Rpc.Empty.getDefaultInstance());
+    }
+
+    public void preemptJobs(String queue, String jobset, List<String> jobIds,
+                            String reason) {
+        call("armada_tpu.api.Submit/PreemptJobs",
+                Rpc.PreemptJobsRequest.newBuilder().setQueue(queue).setJobset(jobset)
+                        .addAllJobIds(jobIds).setReason(reason).build(),
+                Rpc.Empty.getDefaultInstance());
+    }
+
+    public void reprioritizeJobs(String queue, String jobset, long priority,
+                                 List<String> jobIds) {
+        call("armada_tpu.api.Submit/ReprioritizeJobs",
+                Rpc.ReprioritizeJobsRequest.newBuilder().setQueue(queue)
+                        .setJobset(jobset).setPriority(priority)
+                        .addAllJobIds(jobIds).build(),
+                Rpc.Empty.getDefaultInstance());
+    }
+
+    public void createQueue(Rpc.Queue queue) {
+        call("armada_tpu.api.Submit/CreateQueue", queue,
+                Rpc.Empty.getDefaultInstance());
+    }
+
+    public List<Rpc.Queue> listQueues() {
+        return call("armada_tpu.api.Submit/ListQueues",
+                Rpc.Empty.getDefaultInstance(),
+                Rpc.QueueListResponse.getDefaultInstance()).getQueuesList();
+    }
+
+    // --- event surface (armada_tpu.api.Event) ------------------------------
+
+    /**
+     * Stream jobset events from {@code fromIdx}; {@code watch} keeps the
+     * stream open for new events ({@code idleTimeoutS} without progress ends
+     * it).  Each message's {@code idx} is the resume cursor to persist.
+     */
+    public Iterator<Rpc.JobSetEventMessage> watch(String queue, String jobset,
+                                                  long fromIdx, boolean watch,
+                                                  double idleTimeoutS) {
+        MethodDescriptor<Rpc.JobSetEventsRequest, Rpc.JobSetEventMessage> md =
+                MethodDescriptor.<Rpc.JobSetEventsRequest, Rpc.JobSetEventMessage>newBuilder()
+                        .setType(MethodDescriptor.MethodType.SERVER_STREAMING)
+                        .setFullMethodName("armada_tpu.api.Event/GetJobSetEvents")
+                        .setRequestMarshaller(ProtoUtils.marshaller(
+                                Rpc.JobSetEventsRequest.getDefaultInstance()))
+                        .setResponseMarshaller(ProtoUtils.marshaller(
+                                Rpc.JobSetEventMessage.getDefaultInstance()))
+                        .build();
+        Rpc.JobSetEventsRequest req = Rpc.JobSetEventsRequest.newBuilder()
+                .setQueue(queue).setJobset(jobset).setFromIdx(fromIdx)
+                .setWatch(watch).setIdleTimeoutS(idleTimeoutS).build();
+        return ClientCalls.blockingServerStreamingCall(
+                stubChannel, md, CallOptions.DEFAULT, req);
+    }
+
+    @Override
+    public void close() {
+        channel.shutdown();
+    }
+}

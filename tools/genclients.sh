@@ -1,0 +1,17 @@
+#!/bin/sh
+# Generate client message bindings from the two wire protos.
+# Usage: tools/genclients.sh OUTDIR [java|csharp|kotlin|python ...]
+# (exercised by tests/test_client_codegen.py; docs/clients.md is the recipe)
+set -e
+OUT="${1:?usage: genclients.sh OUTDIR [langs...]}"
+shift
+LANGS="${*:-java csharp kotlin}"
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+GEN="$OUT/protos"
+mkdir -p "$GEN"
+cp "$ROOT/armada_tpu/events/events.proto" "$ROOT/armada_tpu/rpc/rpc.proto" "$GEN/"
+for lang in $LANGS; do
+  mkdir -p "$OUT/$lang"
+  protoc -I "$GEN" "--${lang}_out=$OUT/$lang" "$GEN"/events.proto "$GEN"/rpc.proto
+done
+echo "generated: $LANGS -> $OUT"
