@@ -36,18 +36,33 @@ namespace ArmadaTpu.Client
                 _headers.Add("x-armada-principal", principal);
         }
 
+        // Descriptors and marshallers are compile-time constants: build each
+        // verb's once (generated gRPC stubs cache statically the same way).
+        private static readonly
+            System.Collections.Concurrent.ConcurrentDictionary<string, object>
+            _methods = new();
+
         private static Method<TReq, TRes> Unary<TReq, TRes>(string service, string name)
             where TReq : class, Google.Protobuf.IMessage<TReq>, new()
             where TRes : class, Google.Protobuf.IMessage<TRes>, new()
         {
-            return new Method<TReq, TRes>(
-                MethodType.Unary, service, name,
-                Marshallers.Create(
-                    m => Google.Protobuf.MessageExtensions.ToByteArray(m),
-                    d => new Google.Protobuf.MessageParser<TReq>(() => new TReq()).ParseFrom(d)),
-                Marshallers.Create(
-                    m => Google.Protobuf.MessageExtensions.ToByteArray(m),
-                    d => new Google.Protobuf.MessageParser<TRes>(() => new TRes()).ParseFrom(d)));
+            return (Method<TReq, TRes>)_methods.GetOrAdd(
+                $"{service}/{name}",
+                _ => new Method<TReq, TRes>(
+                    MethodType.Unary, service, name,
+                    Marshallers.Create(
+                        m => Google.Protobuf.MessageExtensions.ToByteArray(m),
+                        ParserCache<TReq>.Parser.ParseFrom),
+                    Marshallers.Create(
+                        m => Google.Protobuf.MessageExtensions.ToByteArray(m),
+                        ParserCache<TRes>.Parser.ParseFrom)));
+        }
+
+        private static class ParserCache<T>
+            where T : class, Google.Protobuf.IMessage<T>, new()
+        {
+            public static readonly Google.Protobuf.MessageParser<T> Parser =
+                new(() => new T());
         }
 
         private TRes Call<TReq, TRes>(string service, string name, TReq req)
@@ -107,29 +122,37 @@ namespace ArmadaTpu.Client
 
         // --- event surface (armada_tpu.api.Event) ---------------------------
 
-        /// Stream jobset events from fromIdx; watch keeps the stream open
-        /// (idleTimeoutS without progress ends it).  Each message's Idx is
-        /// the resume cursor to persist.
-        public IAsyncEnumerable<JobSetEventMessage> Watch(
-            string queue, string jobset, long fromIdx = 0,
-            bool watch = true, double idleTimeoutS = 0)
-        {
-            var method = new Method<JobSetEventsRequest, JobSetEventMessage>(
+        private static readonly Method<JobSetEventsRequest, JobSetEventMessage>
+            _watchMethod = new(
                 MethodType.ServerStreaming, "armada_tpu.api.Event", "GetJobSetEvents",
                 Marshallers.Create(
                     m => Google.Protobuf.MessageExtensions.ToByteArray(m),
-                    d => JobSetEventsRequest.Parser.ParseFrom(d)),
+                    JobSetEventsRequest.Parser.ParseFrom),
                 Marshallers.Create(
                     m => Google.Protobuf.MessageExtensions.ToByteArray(m),
-                    d => JobSetEventMessage.Parser.ParseFrom(d)));
-            var call = _invoker.AsyncServerStreamingCall(
-                method, null, new CallOptions(_headers),
+                    JobSetEventMessage.Parser.ParseFrom));
+
+        /// Stream jobset events from fromIdx; watch keeps the stream open
+        /// (idleTimeoutS without progress ends it).  Each message's Idx is
+        /// the resume cursor to persist.  Breaking out of the enumeration
+        /// (or cancelling the token) cancels and disposes the RPC -- an
+        /// endless watch stream must not outlive its consumer.
+        public async IAsyncEnumerable<JobSetEventMessage> Watch(
+            string queue, string jobset, long fromIdx = 0,
+            bool watch = true, double idleTimeoutS = 0,
+            [System.Runtime.CompilerServices.EnumeratorCancellation]
+            System.Threading.CancellationToken cancel = default)
+        {
+            using var call = _invoker.AsyncServerStreamingCall(
+                _watchMethod, null,
+                new CallOptions(_headers, cancellationToken: cancel),
                 new JobSetEventsRequest
                 {
                     Queue = queue, Jobset = jobset, FromIdx = fromIdx,
                     Watch = watch, IdleTimeoutS = idleTimeoutS,
                 });
-            return call.ResponseStream.ReadAllAsync();
+            while (await call.ResponseStream.MoveNext(cancel).ConfigureAwait(false))
+                yield return call.ResponseStream.Current;
         }
 
         public void Dispose() => _channel.Dispose();

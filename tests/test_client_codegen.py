@@ -73,13 +73,29 @@ def test_thin_clients_reference_only_generated_messages(generated):
 
     rpc_src = (generated / "java" / "armada_tpu" / "api" / "Rpc.java").read_text()
     java = (ROOT / "client/java/src/main/java/io/armadatpu/ArmadaClient.java").read_text()
-    for m in sorted(set(re.findall(r"Rpc\.(\w+)\.newBuilder", java))):
-        assert f"class {m} " in rpc_src or f"class {m}\n" in rpc_src, (
+    java_refs = set(re.findall(r"Rpc\.(\w+)\.newBuilder", java))
+    java_refs |= set(re.findall(r"Rpc\.(\w+)\.getDefaultInstance", java))
+    java_refs |= set(re.findall(r"Rpc\.(\w+)[>\s,)]", java))
+    for m in sorted(java_refs):
+        assert re.search(rf"class {m}\b", rpc_src), (
             f"ArmadaClient.java references Rpc.{m} which codegen does not emit"
         )
     cs_src = (generated / "csharp" / "Rpc.cs").read_text()
     cs = (ROOT / "client/dotnet/ArmadaClient.cs").read_text()
-    for m in sorted(set(re.findall(r"new (\w+)Request", cs))):
-        assert f"class {m}Request" in cs_src, (
-            f"ArmadaClient.cs references {m}Request which codegen does not emit"
+    generated_cs = {
+        m for m in re.findall(r"sealed partial class (\w+)", cs_src)
+    }
+    # every generated-message type the thin client names, in any position:
+    # generics, news, Parser references
+    cs_refs = set(re.findall(r"new (\w+)(?:Request)?\s*[({]", cs))
+    cs_refs |= set(re.findall(r"(\w+)\.Parser\.ParseFrom", cs))
+    cs_refs |= set(re.findall(r"[<,]\s*(\w+)\s*[>,]", cs))
+    suspects = {
+        r for r in cs_refs
+        if r.endswith(("Request", "Response", "Message", "Item"))
+        or r in ("Queue", "Empty")
+    }
+    for m in sorted(suspects):
+        assert m in generated_cs, (
+            f"ArmadaClient.cs references {m} which codegen does not emit"
         )
