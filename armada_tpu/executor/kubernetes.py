@@ -494,6 +494,16 @@ def etcd_health_brake(cluster: "KubernetesClusterContext", cooldown_s: float = 1
             state["reason"] = (
                 None if "ok" in body.lower() else f"etcd readyz: {body[:120]}"
             )
+        except KubeApiError as e:
+            # An apiserver that does not EXPOSE the check (404) or forbids it
+            # (403, RBAC) is no signal, not an unhealthy etcd -- the
+            # reference's monitor is likewise optional.  5xx (including the
+            # 500 readyz returns when etcd IS failing) engages the brake.
+            state["reason"] = (
+                None
+                if e.status in (403, 404)
+                else f"etcd readyz probe failed: {e}"[:200]
+            )
         except Exception as e:  # unreachable apiserver counts as unhealthy
             state["reason"] = f"etcd readyz probe failed: {e}"[:200]
         return state["reason"]

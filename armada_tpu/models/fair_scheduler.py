@@ -40,6 +40,7 @@ distinct unfeasible keys + queue deactivations), not by queue length.
 from __future__ import annotations
 
 import functools
+import os as _os
 from typing import NamedTuple
 
 import jax
@@ -260,12 +261,32 @@ def _make_place_iteration(
     prefer_large: bool = False,
     q_budget=None,
     cache_slots: int = 0,
+    max_iterations: int = 0,
+    batch_k: int = 1,
 ):
     """prefer_large is a STATIC flag (like check_keys): the default compile
     carries none of the alternate-ordering work.  q_budget is the per-queue
     weighted budget from the round's fair-share computation (passed in so the
     water-filling loop is not traced twice).  cache_slots sizes the
-    per-scheduling-key fit cache (see _Carry; 0 compiles the uncached body)."""
+    per-scheduling-key fit cache (see _Carry; 0 compiles the uncached body).
+
+    max_iterations > 0 compiles an `active` gate into the body: a step past
+    done/max-iterations is a true no-op (no cursor movement, no commits, no
+    iteration count), which is what lets schedule_round UNROLL several body
+    applications inside one while_loop iteration with bit-exact semantics
+    (the tail steps of the last unrolled group self-disable).
+
+    batch_k > 1 appends the CERTIFIED BATCH extension (SURVEY section 7
+    "schedule K gangs per device step"): after the normal head placement,
+    up to batch_k-1 additional queue heads commit in the same iteration --
+    each one proven to be exactly what the sequential loop's next iteration
+    would have decided (cost order vs every placed queue's next candidate
+    with the argmin tie-break, node choice re-derived exactly at the <=K
+    nodes this batch touched, caps/burst/spot walked in commit order).
+    Anything unprovable cuts the batch and defers to the next iteration, so
+    the batch commits a certified PREFIX of the sequential order or
+    nothing; decisions are bit-identical at any batch_k.  Requires
+    cache_slots == 0 and not prefer_large (enforced by schedule_round)."""
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
     Q = p.q_weight.shape[0]
@@ -289,6 +310,12 @@ def _make_place_iteration(
         ) * p.q_weight[p.g_queue]
 
     def body(c: _Carry) -> _Carry:
+        # Unrolled-group gate: once done (or past the iteration budget) the
+        # remaining inner steps of the group are exact no-ops.
+        if max_iterations > 0:
+            active = (~c.done) & (c.iterations < max_iterations)
+        else:
+            active = jnp.bool_(True)
         # --- advance per-queue cursors past retired/unfeasible heads ------------
         # Window gather into the (queue, order)-sorted gang index: O(Q*W), never
         # O(G).  An entry is skippable if its gang was already decided (state!=0)
@@ -303,7 +330,7 @@ def _make_place_iteration(
         wbad = jnp.bool_(check_keys) & (wkey >= 0) & c.key_bad[jnp.maximum(wkey, 0)]
         skippable = in_r & ((c.g_state[wg] != 0) | wbad)
         lead = jnp.cumprod(skippable.astype(jnp.int32), axis=1)  # leading-True run
-        nskip = jnp.sum(lead, axis=1).astype(jnp.int32)  # [Q]
+        nskip = jnp.sum(lead, axis=1).astype(jnp.int32) * active.astype(jnp.int32)
         q_head = c.q_head + nskip
         advanced = jnp.any(nskip > 0)
 
@@ -380,9 +407,9 @@ def _make_place_iteration(
         hit_q_cap = (~is_evictee) & jnp.any(
             c.q_alloc_pc[qstar, pc] + req_tot > p.pc_queue_cap[pc]
         )
-        gate_global = (hit_burst | hit_round_cap) & any_q
-        gate_queue = (hit_q_burst | hit_q_cap) & ~gate_global & any_q
-        attempt = any_q & ~gate_global & ~gate_queue
+        gate_global = (hit_burst | hit_round_cap) & any_q & active
+        gate_queue = (hit_q_burst | hit_q_cap) & ~gate_global & any_q & active
+        attempt = any_q & active & ~gate_global & ~gate_queue
 
         # --- fit + node selection ----------------------------------------------
         # Three compute classes (cheapest first); all produce decisions
@@ -418,7 +445,15 @@ def _make_place_iteration(
 
         def cached_single_path(_):
             slot = jnp.where(key >= 0, key, 0) % S
-            hit = c.cslot_key[slot] == key
+            # Builder problems intern (request, PC) into the key
+            # (core/keys.py), but the kernel must stay correct for ANY
+            # input: a same-key gang with a different request/level (e.g.
+            # synthetic label keys) must miss, not reuse foreign fit rows.
+            hit = (
+                (c.cslot_key[slot] == key)
+                & (c.cslot_lvl[slot] == level)
+                & jnp.all(c.cslot_req[slot] == req_node)
+            )
 
             def pick_cached(_):
                 # Two-level exact argmin: the [NB] block-minima row names the
@@ -621,7 +656,9 @@ def _make_place_iteration(
             jnp.where(hit_burst, TERM_GLOBAL_BURST, TERM_ROUND_CAP),
             c.termination,
         )
-        done = ~any_q & ~advanced
+        # An inactive step keeps done as-is: flipping it would misreport a
+        # max-iterations exit as exhaustion.
+        done = jnp.where(active, ~any_q & ~advanced, c.done)
 
         # --- cache maintenance --------------------------------------------------
         fitc_clean, fitc_lvl, score_c = c.fitc_clean, c.fitc_lvl, c.score_c
@@ -685,6 +722,387 @@ def _make_place_iteration(
             bmc_clean = bmc_clean.at[bpidx].set(bm0_t, mode="drop")
             bmc_lvl = bmc_lvl.at[bpidx].set(bml_t, mode="drop")
 
+        extra_iters = jnp.int32(0)
+        if batch_k > 1:
+            # --- certified pick-chain extension (see docstring) --------------
+            # After the head commit, SIMULATE the sequential loop's next
+            # picks with tiny [Q] state (per-queue keys + window cursors)
+            # and commit up to batch_k-1 of them in this iteration.  The
+            # simulation replays the exact argmin pick order -- including
+            # same-queue monopolies, the dominant pattern under DRF (the
+            # cheapest queue places many consecutive jobs) -- and every
+            # f32 expression matches the sequential path's association, so
+            # decisions are bit-identical.  Anything unprovable (gangs,
+            # window exhaustion, cap trips, float shortfalls, no-fit
+            # failures) cuts the chain and defers to the next iteration.
+            E = batch_k - 1
+            max_slots_cap = slot_gang.shape[0]
+            iota_q = jnp.arange(Q, dtype=jnp.int32)
+
+            # Window candidate tables ([Q, W] gathers; the window is the
+            # simulation horizon)
+            wcard = p.g_card[wg]
+            wrun = p.g_run[wg]
+            wev = wrun >= 0
+            wlevel = p.g_level[wg]
+            wpc = p.g_pc[wg]
+            wkey_g = p.g_key[wg]
+            wban = p.g_ban_row[wg]
+            wreq = p.g_req[wg]  # [Q, W, R] per-member
+            wreq_tot = wreq * wcard[..., None].astype(jnp.float32)
+            wreq_node = g_req_node[wg]
+            wfloat = g_float_tot[wg]
+            wprice = p.g_price[wg]
+            wspot = p.g_spot_price[wg]
+            wpin = jnp.where(wev, p.run_node[jnp.maximum(wrun, 0)], 0)
+            # Cursor semantics EXACTLY mirror the sequential loop: the
+            # cursor parks on any undecided entry (in_r & ~skippable),
+            # whether or not the candidate gate would allow picking it.
+            # The gate (new_blocked / q_killed / zero weight -- `has`)
+            # applies to the KEY instead: a parked-blocked queue reads +INF
+            # -- never picked, never constraining, exactly like sequential.
+            wallowed = (
+                ~((~wev) & (c.new_blocked | c.q_killed[:, None]))
+                & (p.q_weight > 0)[:, None]
+            )
+            parked = in_r & ~skippable
+            # next parked (cursor) window index at-or-after i (W = none)
+            nn = jnp.full((Q, W + 1), W, jnp.int32)
+            for i in range(W - 1, -1, -1):
+                nn = nn.at[:, i].set(
+                    jnp.where(parked[:, i], i, nn[:, i + 1])
+                )
+            # window reaches past the queue tail: nothing hides beyond it
+            tail_known = ~in_r[:, W - 1]
+
+            # simulation state
+            sim_row = q_alloc  # post-head [Q, R]; value-identical to what
+            # the sequential loop reads next iteration
+            pos_clip = jnp.minimum(pos + 1, W)
+            simpos = jnp.where(
+                iota_q == qstar, nn[iota_q, pos_clip], nn[iota_q, pos]
+            )
+            sp_safe = jnp.minimum(simpos, W - 1)
+            head_tot = jnp.take_along_axis(
+                wreq_tot, sp_safe[:, None, None], axis=1
+            )[:, 0]
+            sim_keys = weighted_drf_cost(
+                (sim_row + p.q_penalty) + head_tot,
+                p.total_pool, p.drf_mult, p.q_weight,
+            )
+            head_price = jnp.take_along_axis(
+                wprice, sp_safe[:, None], axis=1
+            )[:, 0]
+            sim_keys = jnp.where(p.market, -head_price, sim_keys)
+            head_allowed = jnp.take_along_axis(
+                wallowed, sp_safe[:, None], axis=1
+            )[:, 0]
+            sim_keys = jnp.where(head_allowed, sim_keys, _INF)
+            # beyond-window queues: certifiable only when truly exhausted
+            sim_keys = jnp.where(
+                simpos < W, sim_keys, jnp.where(tail_known, _INF, -_INF)
+            )
+
+            # chain accumulators
+            t_nodes = jnp.full((E,), N, jnp.int32)
+            t_lo = jnp.zeros((E,), jnp.int32)
+            t_level = jnp.zeros((E,), jnp.int32)
+            t_req = jnp.zeros((E, R), jnp.float32)
+            ex_placed = jnp.zeros((E,), bool)
+            ex_gang = jnp.zeros((E,), jnp.int32)
+            ex_queue = jnp.zeros((E,), jnp.int32)
+            ex_pcv = jnp.zeros((E,), jnp.int32)
+            ex_reqs = jnp.zeros((E, R), jnp.float32)
+            ex_floats = jnp.zeros((E, R), jnp.float32)
+            ex_evs = jnp.zeros((E,), bool)
+            ex_runs = jnp.full((E,), RJ, jnp.int32)
+            r_count, r_res, r_float = sched_count, sched_res, float_used
+            r_spot_res, r_spot = spot_res, spot_price
+            r_iter = c.iterations + active.astype(jnp.int32)
+            alive = placed
+            iota_e = jnp.arange(E, dtype=jnp.int32)
+            # one-entry within-step fit-row cache: same-key chains reuse it
+            cache_key = jnp.int32(-2)
+            cache_lvl = jnp.int32(-1)
+            cache_ban = jnp.int32(-1)
+            cache_req = jnp.full((R,), -1.0, jnp.float32)
+            zrow = jnp.zeros((N,), bool)
+            cache_fit0, cache_fitl = zrow, zrow
+            cache_m0 = jnp.full((N,), _INF, jnp.float32)
+            cache_ml = jnp.full((N,), _INF, jnp.float32)
+            cache_n0 = jnp.int32(0)
+            score_all = jnp.sum(alloc * p.inv_scale[None, None, :], axis=-1)
+
+            def deltas_at(nodes, lvl):
+                vis = ex_placed_l & (t_lo_l <= lvl) & (lvl <= t_level_l)
+                aff = (
+                    (nodes[:, None] == t_nodes_l[None, :]) & vis[None, :]
+                ).astype(jnp.float32)
+                return aff @ t_req_l
+
+            for k in range(E):
+                qj = jnp.argmin(sim_keys).astype(jnp.int32)
+                kj = sim_keys[qj]
+                i_j = simpos[qj]
+                ok = alive & (kj < _INF) & (i_j < W) & (
+                    r_iter < max_iterations
+                )
+                i_safe = jnp.minimum(i_j, W - 1)
+                g_j = wg[qj, i_safe]
+                card_j = wcard[qj, i_safe]
+                ev_j = wev[qj, i_safe]
+                run_j = jnp.where(ev_j, wrun[qj, i_safe], RJ)
+                lvl_j = wlevel[qj, i_safe]
+                pc_j = wpc[qj, i_safe]
+                key_j = wkey_g[qj, i_safe]
+                ban_j = wban[qj, i_safe]
+                req_j = wreq[qj, i_safe]
+                reqn_j = wreq_node[qj, i_safe]
+                flt_j = wfloat[qj, i_safe]
+                pin_j = wpin[qj, i_safe]
+                ok &= card_j == 1  # gang heads defer to the full path
+                # running caps/bursts incl. same-queue repeats in this chain
+                prevq = ex_placed & (ex_queue == qj) & ~ex_evs
+                prev_cnt = jnp.sum(prevq.astype(jnp.int32))
+                prev_pc = prevq & (ex_pcv == pc_j)
+                prev_pc_res = jnp.sum(
+                    jnp.where(prev_pc[:, None], ex_reqs, 0.0), axis=0
+                )
+                ok &= ev_j | (
+                    (r_count + 1 <= p.global_burst)
+                    & jnp.all(r_res + req_j <= p.round_cap)
+                    & (q_sched[qj] + prev_cnt + 1 <= p.perq_burst[qj])
+                    & jnp.all(
+                        (q_alloc_pc[qj, pc_j] + prev_pc_res) + req_j
+                        <= p.pc_queue_cap[pc_j]
+                    )
+                )
+                ok &= ev_j | jnp.all(
+                    r_float + flt_j <= p.float_total + 1e-3
+                )
+
+                # fit rows: reuse the cached (key, level, ban) rows or
+                # recompute; either way identical to the sequential formulas
+                ex_placed_l, t_lo_l, t_level_l = ex_placed, t_lo, t_level
+                t_nodes_l, t_req_l = t_nodes, t_req
+                # key AND request must match: builder problems intern the
+                # request into the key (core/keys.py), but the kernel must
+                # stay correct for any input (synthetic keys are labels)
+                match = (
+                    (key_j == cache_key)
+                    & (key_j >= 0)
+                    & (lvl_j == cache_lvl)
+                    & (ban_j == cache_ban)
+                    & jnp.all(reqn_j == cache_req)
+                )
+
+                def fresh(_):
+                    static_j = jnp.where(
+                        key_j >= 0,
+                        p.compat[jnp.maximum(key_j, 0)][p.node_type],
+                        True,
+                    )
+                    okn = static_j & p.node_ok & ~p.ban_mask[ban_j]
+                    f0 = okn & _fit_row(alloc[0], reqn_j[None, :])
+                    fl = okn & _fit_row(alloc[lvl_j], reqn_j[None, :])
+                    m0 = jnp.where(f0, score_all[0], _INF)
+                    ml = jnp.where(fl, score_all[lvl_j], _INF)
+                    return f0, fl, m0, ml, jnp.sum(f0).astype(jnp.int32)
+
+                def cached(_):
+                    return (
+                        cache_fit0, cache_fitl, cache_m0, cache_ml, cache_n0
+                    )
+
+                fit0_j, fitl_j, m0_j, ml_j, n0_j = jax.lax.cond(
+                    match, cached, fresh, None
+                )
+                cache_key = jnp.where(ev_j, cache_key, key_j)
+                cache_req = jnp.where(ev_j, cache_req, reqn_j)
+                cache_lvl = jnp.where(ev_j, cache_lvl, lvl_j)
+                cache_ban = jnp.where(ev_j, cache_ban, ban_j)
+                cache_fit0 = jnp.where(ev_j, cache_fit0, fit0_j)
+                cache_fitl = jnp.where(ev_j, cache_fitl, fitl_j)
+                cache_m0 = jnp.where(ev_j, cache_m0, m0_j)
+                cache_ml = jnp.where(ev_j, cache_ml, ml_j)
+                cache_n0 = jnp.where(ev_j, cache_n0, n0_j)
+
+                # clean-count corrections at touched nodes (fits only flip
+                # True -> False; count distinct nodes once)
+                tn_safe = jnp.clip(t_nodes, 0, N - 1)
+                first_occ = ex_placed & (
+                    jnp.sum(
+                        (
+                            (t_nodes[None, :] == t_nodes[:, None])
+                            & ex_placed[None, :]
+                            & (iota_e[None, :] < iota_e[:, None])
+                        ),
+                        axis=1,
+                    )
+                    == 0
+                )
+                adj0 = alloc[0][tn_safe] - deltas_at(tn_safe, jnp.int32(0))
+                fit0_adj = (
+                    _fit_row(adj0, reqn_j[None, :]) & fit0_j[tn_safe]
+                )
+                flips = first_occ & fit0_j[tn_safe] & ~fit0_adj
+                n0_adj = n0_j - jnp.sum(flips.astype(jnp.int32))
+                use_clean = (~ev_j) & (n0_adj >= 1)
+                lvl_sel = jnp.where(use_clean, 0, lvl_j)
+
+                msel = jnp.where(use_clean, m0_j, ml_j)
+                msel = msel.at[t_nodes].set(_INF, mode="drop")
+                u_node = jnp.argmin(msel).astype(jnp.int32)
+                u_score = msel[u_node]
+                adjs = alloc[lvl_sel][tn_safe] - deltas_at(tn_safe, lvl_sel)
+                fsel = jnp.where(use_clean, fit0_j, fitl_j)
+                fit_t = (
+                    _fit_row(adjs, reqn_j[None, :])
+                    & fsel[tn_safe]  # static/ok/ban masks are node-stable
+                    & ex_placed
+                )
+                sc_t = jnp.where(
+                    fit_t,
+                    jnp.sum(adjs * p.inv_scale[None, :], axis=-1),
+                    _INF,
+                )
+                t_best_score = jnp.min(sc_t)
+                t_best_node = jnp.min(
+                    jnp.where(sc_t == t_best_score, t_nodes, N)
+                ).astype(jnp.int32)
+                t_wins = (t_best_score < u_score) | (
+                    (t_best_score == u_score) & (t_best_node < u_node)
+                )
+                node_j = jnp.where(t_wins, t_best_node, u_node)
+                found = jnp.minimum(t_best_score, u_score) < _INF
+
+                # evictee: pinned-node fit at its level, exactly
+                pin_adj = alloc[lvl_j, pin_j] - deltas_at(
+                    pin_j[None], lvl_j
+                )[0]
+                ev_fit = (
+                    _fit_row(pin_adj[None, :], reqn_j[None, :])[0]
+                    & p.node_ok[pin_j]
+                )
+                node_j = jnp.where(ev_j, pin_j, node_j)
+                found = jnp.where(ev_j, ev_fit, found)
+                # a no-fit FAILS sequentially (state 2 + key retirement):
+                # defer; an unplaced pick always ends the chain
+                ok &= found
+
+                t_nodes = t_nodes.at[k].set(jnp.where(ok, node_j, N))
+                t_lo = t_lo.at[k].set(jnp.where(ev_j, 1, 0))
+                t_level = t_level.at[k].set(lvl_j)
+                t_req = t_req.at[k].set(reqn_j * ok.astype(jnp.float32))
+                ex_placed = ex_placed.at[k].set(ok)
+                ex_gang = ex_gang.at[k].set(g_j)
+                ex_queue = ex_queue.at[k].set(qj)
+                ex_pcv = ex_pcv.at[k].set(pc_j)
+                ex_reqs = ex_reqs.at[k].set(
+                    req_j * ok.astype(jnp.float32)
+                )
+                ex_floats = ex_floats.at[k].set(
+                    flt_j * ok.astype(jnp.float32)
+                )
+                ex_evs = ex_evs.at[k].set(ev_j & ok)
+                ex_runs = ex_runs.at[k].set(jnp.where(ev_j & ok, run_j, RJ))
+                new_k = ok & ~ev_j
+                r_count = r_count + new_k.astype(jnp.int32)
+                r_res = r_res + jnp.where(new_k, req_j, 0.0)
+                r_float = r_float + jnp.where(new_k, flt_j, 0.0)
+                r_spot_res = r_spot_res + jnp.where(ok, req_j, 0.0)
+                share_k = jnp.max(
+                    jnp.where(
+                        p.total_pool > 0,
+                        r_spot_res / jnp.maximum(p.total_pool, 1e-9),
+                        0.0,
+                    )
+                    * p.drf_mult
+                )
+                crossed_k = (
+                    p.market & ok & (r_spot < 0) & (share_k > p.spot_cutoff)
+                )
+                r_spot = jnp.where(
+                    crossed_k, wspot[qj, i_safe], r_spot
+                )
+                r_iter = r_iter + ok.astype(jnp.int32)
+
+                # advance the picked queue's simulation state
+                npos = nn[qj, jnp.minimum(i_j + 1, W)]
+                np_safe = jnp.minimum(npos, W - 1)
+                sim_row = sim_row.at[qj].add(
+                    jnp.where(ok, req_j, 0.0)
+                )
+                next_tot = wreq_tot[qj, np_safe]
+                keyn = weighted_drf_cost(
+                    ((sim_row[qj] + p.q_penalty[qj]) + next_tot)[None, :],
+                    p.total_pool, p.drf_mult, p.q_weight[qj][None],
+                )[0]
+                keyn = jnp.where(p.market, -wprice[qj, np_safe], keyn)
+                keyn = jnp.where(wallowed[qj, np_safe], keyn, _INF)
+                keyn = jnp.where(
+                    npos < W,
+                    keyn,
+                    jnp.where(tail_known[qj], _INF, -_INF),
+                )
+                sim_keys = sim_keys.at[qj].set(
+                    jnp.where(ok, keyn, sim_keys[qj])
+                )
+                simpos = simpos.at[qj].set(jnp.where(ok, npos, simpos[qj]))
+                alive = ok
+
+            # --- vectorized commit of the placed picks -----------------------
+            pf = ex_placed.astype(jnp.float32)
+            lv_e = jnp.arange(num_levels, dtype=jnp.int32)
+            lm_e = (
+                (lv_e[:, None] >= t_lo[None, :])
+                & (lv_e[:, None] <= t_level[None, :])
+            ).astype(jnp.float32)
+            alloc = alloc.at[:, t_nodes, :].add(
+                -lm_e[:, :, None] * t_req[None, :, :], mode="drop"
+            )
+            # duplicate queue indices accumulate; integral units stay exact
+            q_alloc = q_alloc.at[ex_queue].add(ex_reqs)
+            q_alloc_pc = q_alloc_pc.at[ex_queue, ex_pcv].add(ex_reqs)
+            new_e = ex_placed & ~ex_evs
+            sched_count = sched_count + jnp.sum(new_e.astype(jnp.int32))
+            sched_res = sched_res + jnp.sum(
+                ex_reqs * new_e[:, None].astype(jnp.float32), axis=0
+            )
+            float_used = float_used + jnp.sum(
+                ex_floats * new_e[:, None].astype(jnp.float32), axis=0
+            )
+            q_sched = q_sched.at[ex_queue].add(new_e.astype(jnp.int32))
+            spot_res = r_spot_res
+            spot_price = r_spot
+            # scatter ONLY placed picks: unplaced rows default to gang 0 /
+            # run RJ, and a gather-set there races the real writes
+            g_state = g_state.at[jnp.where(ex_placed, ex_gang, G)].set(
+                1, mode="drop"
+            )
+            run_rescheduled = run_rescheduled.at[ex_runs].set(
+                True, mode="drop"
+            )
+            ranks = jnp.cumsum(new_e.astype(jnp.int32)) - new_e.astype(
+                jnp.int32
+            )
+            sidx = jnp.where(new_e, cursor + ranks, max_slots_cap)
+            ex_nodes_w = (
+                jnp.full((E, slot_width), N, jnp.int32)
+                .at[:, 0]
+                .set(jnp.where(new_e, t_nodes, N))
+            )
+            ex_counts_w = (
+                jnp.zeros((E, slot_width), jnp.int32)
+                .at[:, 0]
+                .set(new_e.astype(jnp.int32))
+            )
+            slot_gang = slot_gang.at[sidx].set(ex_gang, mode="drop")
+            slot_nodes = slot_nodes.at[sidx].set(ex_nodes_w, mode="drop")
+            slot_counts = slot_counts.at[sidx].set(ex_counts_w, mode="drop")
+            cursor = cursor + jnp.sum(new_e.astype(jnp.int32))
+            extra_iters = jnp.sum(ex_placed.astype(jnp.int32))
+
         return _Carry(
             alloc=alloc,
             q_alloc=q_alloc,
@@ -703,7 +1121,7 @@ def _make_place_iteration(
             sched_res=sched_res,
             float_used=float_used,
             new_blocked=new_blocked,
-            iterations=c.iterations + 1,
+            iterations=c.iterations + active.astype(jnp.int32) + extra_iters,
             done=done,
             termination=termination,
             spot_price=spot_price,
@@ -788,13 +1206,6 @@ def _phase_b(p: SchedulingProblem, alloc, q_alloc, q_alloc_pc, run_evicted,
     return alloc, q_alloc, run_evicted, run_rescheduled
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "num_levels", "max_slots", "slot_width", "max_iterations", "prefer_large",
-        "cache_slots",
-    ),
-)
 def schedule_round(
     p: SchedulingProblem,
     *,
@@ -804,6 +1215,8 @@ def schedule_round(
     max_iterations: int = 0,
     prefer_large: bool = False,
     cache_slots: int = -1,
+    unroll: int = -1,
+    batch_k: int = -1,
 ) -> RoundResult:
     """Run one full scheduling round on device.
 
@@ -812,6 +1225,12 @@ def schedule_round(
     .slot_width).  max_iterations=0 derives the safe bound #gangs + #queues + 8.
     cache_slots sizes the per-scheduling-key fit cache (-1 = derive from the
     compat table; 0 = disable, compiling the original uncached body).
+    unroll applies the placement body this many times per while_loop
+    iteration (-1 = derive: several on accelerators, 1 on CPU) -- each inner
+    step IS one full sequential iteration (decisions bit-identical at any
+    unroll; tail steps past done self-disable via the body's active gate),
+    but grouping them lets XLA fuse/overlap the many small per-iteration ops
+    whose fixed latencies dominate the accelerator round.
     """
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
@@ -826,14 +1245,86 @@ def schedule_round(
         # bit-identical either way (the cache is exact memoization).
         # Polarity: cache only on XLA:CPU -- any accelerator platform string
         # (tpu; the axon plugin also registers as plain "tpu") gets the
-        # vectorized body.
-        cache_slots = (
-            min(64, p.compat.shape[0]) if jax.default_backend() == "cpu" else 0
-        )
+        # vectorized body.  ARMADA_CACHE_SLOTS / ARMADA_BATCH_K override the
+        # platform defaults (how the CPU parity suites pin the TPU-shaped
+        # compile: cache 0 + batch 8).
+        env = _os.environ.get("ARMADA_CACHE_SLOTS")
+        if env is not None:
+            cache_slots = min(int(env), p.compat.shape[0])
+        else:
+            cache_slots = (
+                min(64, p.compat.shape[0])
+                if jax.default_backend() == "cpu"
+                else 0
+            )
+    if unroll < 0:
+        # Measured (TPU v5e, 1M x 50k): unroll 8/16 changes NOTHING
+        # (~0.19s either way) -- the per-iteration cost is the sequential
+        # dependence chain of the body's ops, not while_loop overhead, so
+        # grouping steps cannot overlap them.  The knob stays for
+        # experiments; batching that actually shortens the chain is
+        # batch_k (certified multi-placement per iteration).
+        unroll = 1
+    if batch_k < 0:
+        # Default 1 EVERYWHERE -- measured on the real chip (v5e-lite,
+        # 1M x 50k): the certified pick chain is bit-exact (full parity
+        # gauntlet green at batch_k=8) but SLOWER (0.46s vs 0.19s at k=8,
+        # 0.36s at k=16): per-op dispatch latency ~1-2us dominates this
+        # chip, so replaying K sequential decisions inside one iteration
+        # costs what K iterations cost.  The machinery stays behind the
+        # knob (ARMADA_BATCH_K) for chips where [N]-vector work, not op
+        # count, is the per-iteration floor.  prefer_large's within-budget
+        # ordering re-ranks per placement, which the certification does
+        # not model; the cached CPU body would recompute what its cache
+        # exists to avoid -- both force 1.
+        env = _os.environ.get("ARMADA_BATCH_K")
+        batch_k = int(env) if env is not None else 1
+    if cache_slots > 0 or prefer_large:
+        batch_k = 1
     if max_iterations <= 0:
         # every iteration either decides a gang (<= G), advances a cursor
         # (<= G total across the round), or is the final no-op
         max_iterations = 2 * G + Q + 8
+    return _schedule_round_jit(
+        p,
+        num_levels=num_levels,
+        max_slots=max_slots,
+        slot_width=slot_width,
+        max_iterations=max_iterations,
+        prefer_large=prefer_large,
+        cache_slots=cache_slots,
+        unroll=unroll,
+        batch_k=batch_k,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_levels", "max_slots", "slot_width", "max_iterations", "prefer_large",
+        "cache_slots", "unroll", "batch_k",
+    ),
+)
+def _schedule_round_jit(
+    p: SchedulingProblem,
+    *,
+    num_levels: int,
+    max_slots: int,
+    slot_width: int,
+    max_iterations: int,
+    prefer_large: bool,
+    cache_slots: int,
+    unroll: int,
+    batch_k: int,
+) -> RoundResult:
+    """The fully-resolved compile: schedule_round (the public wrapper)
+    resolves platform/env-derived statics OUTSIDE the jit boundary, so the
+    jit cache keys on the RESOLVED values -- an env override mid-process
+    can never silently reuse a compile traced under the old value."""
+    G = p.g_req.shape[0]
+    N, R = p.node_total.shape
+    Q = p.q_weight.shape[0]
+    C = p.pc_queue_cap.shape[0]
 
     runf = p.run_valid.astype(jnp.float32)
     run_req_node = p.run_req * p.node_axes[None, :]
@@ -928,7 +1419,16 @@ def schedule_round(
     body = _make_place_iteration(
         p, num_levels, slot_width, check_keys=True,
         prefer_large=prefer_large, q_budget=q_budget, cache_slots=cache_slots,
+        max_iterations=max_iterations, batch_k=batch_k,
     )
+    if unroll > 1:
+        inner = body
+
+        def body(c):  # noqa: F811 - the grouped body replaces the single step
+            for _ in range(unroll):
+                c = inner(c)
+            return c
+
     carry = jax.lax.while_loop(
         lambda c: (~c.done) & (c.iterations < max_iterations), body, carry
     )
