@@ -312,6 +312,10 @@ def start_control_plane(
             lookout_port,
             host=bind_host,
             logs_of=logs_of,
+            # the UI gates on the SAME chain as the gRPC/REST transports: a
+            # strict operator config (serve --config authn:) locks the page,
+            # the dev default (trusted headers + anonymous) keeps it open
+            authenticator=authenticator,
         )
 
     rest_gateway = None

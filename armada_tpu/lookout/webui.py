@@ -533,7 +533,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _authed(self) -> bool:
+        """Gate every request on the server's authenticator chain (the same
+        server/authn.py chain the gRPC/REST transports use; None = open dev
+        default).  Browsers get a Basic challenge; scripts send a bearer.
+        A failed/absent credential answers 401 and writes the response."""
+        srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
+        if srv.authenticator is None:
+            return True
+        from armada_tpu.server.authn import authenticate_http_headers
+
+        principal, reason = authenticate_http_headers(
+            srv.authenticator, self.headers
+        )
+        if principal is not None:
+            return True
+        body = json.dumps({"error": f"unauthenticated: {reason}"}).encode()
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", 'Basic realm="armada-tpu lookout"')
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return False
+
     def do_GET(self):  # noqa: N802
+        if not self._authed():
+            return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         q = srv.queries
         parsed = urlparse(self.path)
@@ -613,6 +639,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": str(exc)}, 400)
 
     def do_POST(self):  # noqa: N802
+        if not self._authed():
+            return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         path = urlparse(self.path).path
         try:
@@ -629,6 +657,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": str(exc)}, 400)
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authed():
+            return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         path = urlparse(self.path).path
         if path.startswith("/api/views/"):
@@ -654,9 +684,16 @@ class LookoutWebUI:
         port: int = 0,
         host: str = "127.0.0.1",
         logs_of: Optional[Callable] = None,
+        authenticator=None,
     ):
+        # authenticator: a server/authn.py chain gating the page AND the
+        # JSON API (401 + Basic challenge; bearer headers also work).  None
+        # keeps the dev default: the page trusts its bind address.  OIDC
+        # browser login remains future work -- with an OIDC-only chain, use
+        # a bearer-capable client.
         self.queries = queries
         self.logs_of = logs_of
+        self.authenticator = authenticator
         self.page = _render_page()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

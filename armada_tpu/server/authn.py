@@ -370,3 +370,19 @@ def authn_from_config(cfg: Mapping) -> MultiAuthenticator:
     if cfg.get("anonymous", not chain):
         chain.append(AnonymousAuthenticator())
     return MultiAuthenticator(chain)
+
+
+def authenticate_http_headers(authenticator, headers):
+    """Shared HTTP-handler adaptation of the chain: lowercase the header
+    map into gRPC-style metadata and authenticate.  Returns
+    (principal, None) on success or (None, reason) on failure -- the REST
+    gateway and the lookout web UI both gate on this, so metadata
+    normalization can never diverge between the transports."""
+    meta = {k.lower(): v for k, v in headers.items()}
+    try:
+        principal = authenticator.authenticate(meta)
+    except AuthenticationError as e:
+        return None, str(e)
+    if principal is None:
+        return None, "credentials required"
+    return principal, None

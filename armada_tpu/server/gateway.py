@@ -60,11 +60,14 @@ class _Handler(BaseHTTPRequestHandler):
         """Authenticate through the gateway's configured chain (same
         authenticators as the gRPC transport, server/authn.py)."""
         gw: "RestGateway" = self.server.owner  # type: ignore[attr-defined]
-        meta = {k.lower(): v for k, v in self.headers.items()}
-        try:
-            return gw.authenticator.authenticate(meta)
-        except AuthenticationError as e:
-            raise _Handler._Unauthenticated(str(e)) from e
+        from armada_tpu.server.authn import authenticate_http_headers
+
+        principal, reason = authenticate_http_headers(
+            gw.authenticator, self.headers
+        )
+        if principal is None:
+            raise _Handler._Unauthenticated(reason)
+        return principal
 
     class _BadRequest(Exception):
         pass
