@@ -87,6 +87,7 @@ class _SortedTable:
         extra: Mapping[str, np.dtype],
         cap: int = 1024,
         sort_cols: tuple = _SORT_COLS,
+        with_atoms: bool = False,
     ):
         self.R = num_resources
         self.n = 0
@@ -103,6 +104,12 @@ class _SortedTable:
         for name, dt in extra.items():
             setattr(self, name, np.zeros((cap,), dt))
         self.req = np.zeros((cap, num_resources), np.float32)
+        # Raw-atom [*, R] mirror of req (market pools only): observability
+        # valuation uses RAW atoms (idealised.value_of_jobs), which the
+        # quantised req rows cannot recover.
+        self.atoms: Optional[np.ndarray] = (
+            np.zeros((cap, num_resources), np.int64) if with_atoms else None
+        )
         # id -> sort_cols[:-1] column values: enough to re-find the row by
         # binary search; also the membership test.
         self.key_of_id: dict[bytes, tuple] = {}
@@ -149,7 +156,12 @@ class _SortedTable:
             )
         return lo
 
-    def insert_batch(self, rows: list[dict], reqs: list[np.ndarray]) -> None:
+    def insert_batch(
+        self,
+        rows: list[dict],
+        reqs: list[np.ndarray],
+        atoms: Optional[list[np.ndarray]] = None,
+    ) -> None:
         """rows: per-row dict of every column value (ids as bytes); one
         np.insert per column for the whole batch."""
         if not rows:
@@ -176,6 +188,13 @@ class _SortedTable:
             )
             setattr(self, c, np.insert(cur[live], pos, vals))
         self.req = np.insert(self.req[live], pos, np.stack(reqs), axis=0)
+        if self.atoms is not None:
+            vals = (
+                np.stack([atoms[i] for i in order])
+                if atoms is not None
+                else np.zeros((len(rows), self.R), np.int64)
+            )
+            self.atoms = np.insert(self.atoms[live], pos, vals, axis=0)
         self.n += len(rows)
         for r in rows:
             self.key_of_id[r["ids"]] = tuple(r[c] for c in scols[:-1])
@@ -203,6 +222,8 @@ class _SortedTable:
             cur = getattr(self, c)
             setattr(self, c, cur[: self.n][keep])
         self.req = self.req[: self.n][keep]
+        if self.atoms is not None:
+            self.atoms = self.atoms[: self.n][keep]
         self.n = kept
         self.dead = 0
 
@@ -270,8 +291,10 @@ class IncrementalBuilder:
                 "key": np.int32,
                 "band": np.int32,
                 "slot": np.int32,
+                "hasres": bool,
             },
             sort_cols=self._sort_cols,
+            with_atoms=self.market,
         )
         self.runs = _SortedTable(
             self.R,
@@ -282,10 +305,23 @@ class IncrementalBuilder:
                 "preempt": bool,
                 "band": np.int32,
                 "slot": np.int32,
+                # Observability extras: `hasres` distinguishes a resources-None
+                # job from an all-zero request (value_of_jobs skips the
+                # former); `pok` = this pool satisfies the spec's validated
+                # pools restriction (build_problem's per-job pool filter,
+                # problem.py queued-job loop).
+                "hasres": bool,
+                "pok": bool,
             },
             cap=256,
             sort_cols=self._sort_cols,
+            with_atoms=self.market,
         )
+        # Leased gang members' full specs (market pools): the idealised
+        # mega-round re-enters running jobs as candidates and must regroup
+        # gang siblings exactly as the legacy spec walk does; gangs are few
+        # by design (the same slow path as gang_jobs).
+        self.running_gang_specs: dict[str, JobSpec] = {}
         # Slot-stable slabs mirroring the tables (models/slab.py): device
         # content lives at a fixed slot per job/run so the per-cycle upload
         # is O(deltas); the sorted tables keep serving order/lookup.
@@ -518,6 +554,7 @@ class IncrementalBuilder:
                 "pc": self.pc_index[pc.name],
                 "key": self.kidx.key_of(spec, self.config.node_id_label),
                 "band": self._band(spec.price_band),
+                "hasres": spec.resources is not None,
             },
             req,
         )
@@ -562,6 +599,7 @@ class IncrementalBuilder:
     ) -> None:
         """Batched submit: one np.insert for the whole batch."""
         rows, reqs = [], []
+        atoms: Optional[list] = [] if self.market else None
         for spec in specs:
             if spec.pools and self.pool not in spec.pools:
                 continue
@@ -585,12 +623,18 @@ class IncrementalBuilder:
             row, req = self._single_row(spec)
             rows.append(row)
             reqs.append(req)
+            if atoms is not None:
+                atoms.append(
+                    np.asarray(spec.resources.atoms, np.int64)
+                    if spec.resources is not None
+                    else np.zeros((self.R,), np.int64)
+                )
         if not rows:
             return
         slots = self._sg.alloc(len(rows))
         for r, s in zip(rows, slots):
             r["slot"] = s
-        self.jobs.insert_batch(rows, reqs)
+        self.jobs.insert_batch(rows, reqs, atoms)
         reqs_arr = np.stack(reqs)
         qis = np.array([r["qi"] for r in rows], np.int64)
         pcs = np.array([r["pc"] for r in rows], np.int64)
@@ -617,6 +661,7 @@ class IncrementalBuilder:
         self.gang_jobs.pop(job_id, None)
         self.banned.pop(job_id, None)
         self._unknown_queue.pop(job_id, None)
+        self.running_gang_specs.pop(job_id, None)
         self._release_single(self.jobs.remove(job_id.encode()))
 
     def reprioritise(self, spec: JobSpec) -> None:
@@ -635,10 +680,13 @@ class IncrementalBuilder:
         """Batched lease: one np.insert on the run table for the whole
         cycle's placements (a per-lease insert is O(run table) each)."""
         rows, reqs = [], []
+        atoms: Optional[list] = [] if self.market else None
         for r in rs:
             ni = self.node_index.get(r.node_id)
             if ni is None or r.job.queue not in self.queue_by_name:
                 continue
+            if self.market and r.job.gang_id:
+                self.running_gang_specs[r.job.id] = r.job
             pc = self.config.priority_class(r.job.priority_class)
             if r.away:
                 level, preemptible = 1, True
@@ -668,15 +716,23 @@ class IncrementalBuilder:
                     "pc": self.pc_index[pc.name],
                     "preempt": preemptible,
                     "band": self._band(r.job.price_band),
+                    "hasres": r.job.resources is not None,
+                    "pok": (not r.job.pools) or (self.pool in r.job.pools),
                 }
             )
             reqs.append(req)
+            if atoms is not None:
+                atoms.append(
+                    np.asarray(r.job.resources.atoms, np.int64)
+                    if r.job.resources is not None
+                    else np.zeros((self.R,), np.int64)
+                )
         if not rows:
             return
         slots = self._rr.alloc(len(rows))
         for r, s in zip(rows, slots):
             r["slot"] = s
-        self.runs.insert_batch(rows, reqs)
+        self.runs.insert_batch(rows, reqs, atoms)
         reqs_arr = np.stack(reqs)
         qis = np.array([r["qi"] for r in rows], np.int64)
         pcs = np.array([r["pc"] for r in rows], np.int64)
@@ -695,6 +751,7 @@ class IncrementalBuilder:
 
     def unlease(self, job_id: str) -> None:
         """The run ended (terminal or preempted)."""
+        self.running_gang_specs.pop(job_id, None)
         self._release_run(self.runs.remove(job_id.encode()))
 
     # ---------------------------------------------------------- assemble ----

@@ -237,18 +237,14 @@ class FairSchedulingAlgo:
             # the JobDb subscription).
             self.feed.on_delta(txn._upserts, txn._deletes)
         # The full per-job txn scans below are what the incremental feed
-        # exists to avoid; they remain for the legacy path, the short-job
-        # penalty (derived from retained TERMINAL jobs the feed drops), and
-        # market OBSERVABILITY (idealised/realised valuation walks every
-        # spec, as the reference's CalculateIdealisedValue does -- the
-        # market ROUND itself rides the incremental builders, which keep
-        # (queue, band, submit, id) order and re-sort by price per cycle).
-        need_job_scan = (not incremental) or bool(market_pools)
-        need_run_scan = (
-            (not incremental)
-            or bool(market_pools)
-            or self.short_job_penalty.enabled
-        )
+        # exists to avoid; they remain for the legacy path and the short-job
+        # penalty (derived from retained TERMINAL jobs the feed drops).
+        # Market OBSERVABILITY (idealised/realised/indicative) used to force
+        # them too; incremental market pools now compute it straight off the
+        # builder columns (scheduler/idealised_columnar.py, pricer
+        # _prepare_columnar), so a 1M-job market cycle stays O(deltas).
+        need_job_scan = not incremental
+        need_run_scan = (not incremental) or self.short_job_penalty.enabled
 
         # Queued jobs: validated, in a known queue, with their CURRENT priority
         # (reprioritisation updates Job.priority, not the immutable spec).
@@ -423,10 +419,15 @@ class FairSchedulingAlgo:
             )
             if pool_cfg is not None and pool_cfg.market_driven:
                 stats.market = True
-                self._market_observability(
-                    stats, pool, pool_nodes, pool_queues(pool), queued_jobs,
-                    running, outcome, bid_price_of,
-                )
+                if incremental:
+                    self._market_observability_columnar(
+                        stats, pool, pool_nodes, txn, b, outcome, bid_price_of
+                    )
+                else:
+                    self._market_observability(
+                        stats, pool, pool_nodes, pool_queues(pool), queued_jobs,
+                        running, outcome, bid_price_of,
+                    )
             result.pools.append(stats)
             # Jobs scheduled in this pool are no longer queued for later pools.
             scheduled_ids = set(outcome.scheduled)
@@ -597,6 +598,62 @@ class FairSchedulingAlgo:
             for jid in list(outcome.scheduled) + list(outcome.rescheduled)
             if jid in spec_of
         )
+        stats.realised_values = value_of_jobs(
+            placed, bid_price_of, self.config.resource_list_factory()
+        )
+
+    def _market_observability_columnar(
+        self,
+        stats: PoolStats,
+        pool: str,
+        pool_nodes: list,
+        txn: WriteTxn,
+        builder,
+        outcome: RoundOutcome,
+        bid_price_of,
+    ) -> None:
+        """Incremental-mode market observability: the same three quantities
+        as _market_observability, read off the builder columns instead of
+        spec lists (the builder's runs table already reflects this pool's
+        leases and preemptions -- feed.on_delta ran before stats).
+        Realised values stay O(decisions) via txn lookups."""
+        if bid_price_of is None:
+            return
+        from armada_tpu.scheduler.idealised import value_of_jobs
+        from armada_tpu.scheduler.idealised_columnar import (
+            _band_price_table,
+            calculate_idealised_values_columnar,
+        )
+
+        price_table = _band_price_table(builder, bid_price_of)
+        if self.gang_pricer is not None:
+            stats.indicative_prices = self.gang_pricer.price_pool_gangs_columnar(
+                pool, pool_nodes, builder, bid_price_of, price_table
+            )
+        # The mega round's candidate set is the PRE-round state
+        # (idealised_value.go:68-76): jobs preempted this cycle already left
+        # the builder tables (feed.on_delta ran), so they re-enter here
+        # explicitly -- O(preempted) txn lookups.
+        preempted_specs = []
+        for jid in outcome.preempted:
+            job = txn.get(jid)
+            if job is not None:
+                preempted_specs.append(
+                    dataclasses.replace(job.spec, priority=job.priority)
+                )
+        stats.idealised_values = calculate_idealised_values_columnar(
+            self.config,
+            pool=pool,
+            builder=builder,
+            bid_price_of=bid_price_of,
+            extra_candidates=tuple(preempted_specs),
+            price_table=price_table,
+        )
+        placed = []
+        for jid in list(outcome.scheduled) + list(outcome.rescheduled):
+            job = txn.get(jid)
+            if job is not None:
+                placed.append(job.spec)
         stats.realised_values = value_of_jobs(
             placed, bid_price_of, self.config.resource_list_factory()
         )

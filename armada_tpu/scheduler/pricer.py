@@ -91,25 +91,91 @@ class IndicativeGangPricer:
         price_of: Callable[[JobSpec], float],
     ) -> dict:
         """Shape-independent pool state, computed once per cycle: filtered
-        nodes, totals, and per-node residents as (resource vector, bid) pairs
-        sorted cheapest-first."""
+        nodes, totals, and per-node residents as (vec matrix, bid vector)
+        pairs sorted cheapest-first (bid, then job id)."""
         pool_nodes = [n for n in nodes if n.pool == pool and not n.unschedulable]
         total = np.zeros((self._factory.num_resources,), np.float64)
-        residents: dict[str, list] = {}
+        by_node: dict[str, list] = {}
         for r in running:
             vec = (
                 np.asarray(r.job.resources.atoms, np.float64) * self._node_axes
                 if r.job.resources is not None
                 else np.zeros_like(total)
             )
-            residents.setdefault(r.node_id, []).append(
+            by_node.setdefault(r.node_id, []).append(
                 (vec, float(price_of(r.job)), r.job.id)
             )
-        for rs in residents.values():
+        residents: dict[str, tuple] = {}
+        for nid, rs in by_node.items():
             rs.sort(key=lambda t: (t[1], t[2]))
+            residents[nid] = (
+                np.stack([t[0] for t in rs]),
+                np.array([t[1] for t in rs], np.float64),
+            )
         for n in pool_nodes:
             if n.total_resources is not None:
                 total += np.asarray(n.total_resources.atoms, np.float64)
+        return {"pool_nodes": pool_nodes, "total": total, "residents": residents}
+
+    def price_pool_gangs_columnar(
+        self, pool: str, nodes: Sequence[NodeSpec], builder, price_of,
+        price_table=None,
+    ) -> dict:
+        """price_pool_gangs with residents read straight from the incremental
+        builder's post-round run columns (models/incremental.py) -- no
+        RunningJob materialisation, the market cycle's O(running) Python walk
+        replaced by one lexsort."""
+        pool_cfg = next((p for p in self.config.pools if p.name == pool), None)
+        if pool_cfg is None or not pool_cfg.gangs_to_price:
+            return {}
+        prepared = self._prepare_columnar(
+            pool, nodes, builder, price_of, price_table
+        )
+        out = {}
+        for name, definition in pool_cfg.gangs_to_price:
+            out[name] = self._price_prepared(definition, prepared)
+        return out
+
+    def _prepare_columnar(
+        self, pool: str, nodes: Sequence[NodeSpec], builder, price_of,
+        price_table=None,
+    ) -> dict:
+        """Array residents from the builder's runs table: vec = raw atoms x
+        node axes (the table's `atoms` mirror), bid = the (queue, band) price
+        table, sorted (bid, id) per node exactly like _prepare."""
+        pool_nodes = [n for n in nodes if n.pool == pool and not n.unschedulable]
+        total = np.zeros((self._factory.num_resources,), np.float64)
+        for n in pool_nodes:
+            if n.total_resources is not None:
+                total += np.asarray(n.total_resources.atoms, np.float64)
+        rt = builder.runs
+        rows = rt.live_rows()
+        if rows.size:
+            # deleted queues' runs stop counting (the legacy running scan's
+            # known-queues filter; incremental.py set_queues)
+            rows = rows[builder.queue_known[rt.qi[rows]]]
+        residents: dict[str, tuple] = {}
+        if rows.size:
+            from armada_tpu.scheduler.idealised_columnar import _band_price_table
+
+            table = (
+                price_table
+                if price_table is not None
+                else _band_price_table(builder, price_of)
+            )
+            node_i = rt.node[rows].astype(np.int64)
+            bids = table[rt.qi[rows].astype(np.int64), rt.band[rows].astype(np.int64)]
+            ids = rt.ids[rows]
+            vecs = rt.atoms[rows].astype(np.float64) * self._node_axes[None, :]
+            order = np.lexsort((ids, bids, node_i))
+            node_i, bids, vecs = node_i[order], bids[order], vecs[order]
+            starts = np.flatnonzero(
+                np.concatenate([[True], node_i[1:] != node_i[:-1]])
+            )
+            bounds = np.append(starts, node_i.shape[0])
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                nid = builder.node_ids[node_i[s]]
+                residents[nid] = (vecs[s:e], bids[s:e])
         return {"pool_nodes": pool_nodes, "total": total, "residents": residents}
 
     def price_gang(
@@ -172,15 +238,22 @@ class IndicativeGangPricer:
         definition: GangDefinition,
         req_node: np.ndarray,
         group: Sequence[NodeSpec],
-        residents_by_node: Mapping[str, list],
+        residents_by_node: Mapping[str, tuple],
     ) -> Optional[float]:
         """Min price to place all members on this node group, else None
-        (gang_pricer.go scheduleOnNodes:113-160).  `residents_by_node` holds
-        precomputed (resource vector, bid, job id) tuples, cheapest-first."""
+        (gang_pricer.go scheduleOnNodes:113-160).  Per member, the winner is
+        the first node in group order whose spare capacity fits (free-fit
+        short-circuit, gang_pricer.go:133), else the min-eviction-price node
+        (strict <, so first index wins ties); cheapest-first eviction within
+        a node.  State is array-based: one flat vectorized pass computes the
+        initial per-node (eviction count, price) table, then each placement
+        recomputes only its own node -- all arithmetic is integer-valued
+        f64, so the flat sums match the legacy sequential ones exactly."""
         selector = dict(definition.node_selector)
         tolerations = tuple(definition.tolerations)
-        # Simulation state: per node, residual free vector + surviving residents.
-        state: dict[str, dict] = {}
+        R = req_node.shape[0]
+        empty = (np.zeros((0, R), np.float64), np.zeros((0,), np.float64))
+        free_l, vecs_l, bids_l = [], [], []
         for n in group:
             if n.total_resources is None:
                 continue
@@ -188,39 +261,72 @@ class IndicativeGangPricer:
                 continue
             if not selector_matches(selector, n.labels):
                 continue
-            residents = list(residents_by_node.get(n.id, ()))
-            free = np.asarray(n.total_resources.atoms, np.float64) * self._node_axes
-            for vec, _, _ in residents:
-                free -= vec
-            state[n.id] = {"free": free, "residents": residents}
-        if not state:
+            vecs, bids = residents_by_node.get(n.id, empty)
+            free_l.append(
+                np.asarray(n.total_resources.atoms, np.float64) * self._node_axes
+                - vecs.sum(axis=0)
+            )
+            vecs_l.append(vecs)
+            bids_l.append(bids)
+        N = len(free_l)
+        if N == 0:
             return None
+        free = np.stack(free_l)  # [N, R]
+        k = np.array([v.shape[0] for v in vecs_l], np.int64)
+        seg = np.zeros((N + 1,), np.int64)
+        np.cumsum(k, out=seg[1:])
+        T = int(seg[-1])
+        flat_vec = (
+            np.concatenate(vecs_l, axis=0) if T else np.zeros((0, R), np.float64)
+        )
+        flat_bid = np.concatenate(bids_l) if T else np.zeros((0,), np.float64)
+        # cumulative freed capacity within each node's cheapest-first slice
+        cum = np.cumsum(flat_vec, axis=0)
+        shift = np.vstack([np.zeros((1, R)), cum])[seg[:-1]]  # [N, R]
+        node_of = np.repeat(np.arange(N), k)
+        cumseg = cum - shift[node_of] if T else cum
+        # initial per-node first-fit table
+        evict = np.full((N,), -1, np.int64)
+        price = np.full((N,), np.inf, np.float64)
+        if T:
+            fits_flat = (free[node_of] + cumseg >= req_node[None, :]).all(axis=1)
+            tidx = np.flatnonzero(fits_flat)
+            if tidx.size:
+                pos = np.searchsorted(tidx, seg[:-1])
+                first = tidx[np.minimum(pos, tidx.size - 1)]
+                valid = (pos < tidx.size) & (first < seg[1:])
+                evict = np.where(valid, first - seg[:-1] + 1, -1)
+                price = np.where(valid, flat_bid[first], np.inf)
+        freefit = (free >= req_node[None, :]).all(axis=1)
+        c = np.zeros((N,), np.int64)  # evicted-so-far offsets
 
         gang_price = 0.0
         for _ in range(definition.size):
-            # (price, evict count) per candidate node; free fit short-circuits.
-            best_node, best_price, best_evict = None, None, 0
-            for nid, st in state.items():
-                if np.all(req_node <= st["free"]):
-                    best_node, best_price, best_evict = nid, 0.0, 0
-                    break  # ideal result, exit early (gang_pricer.go:133)
-                freed = st["free"].copy()
-                price, count, fits = 0.0, 0, False
-                for vec, bid, _ in st["residents"]:
-                    freed += vec
-                    price = bid
-                    count += 1
-                    if np.all(req_node <= freed):
-                        fits = True
-                        break
-                if fits and (best_price is None or price < best_price):
-                    best_node, best_price, best_evict = nid, price, count
-            if best_node is None:
-                return None
-            st = state[best_node]
-            for vec, _, _ in st["residents"][:best_evict]:
-                st["free"] += vec
-            st["residents"] = st["residents"][best_evict:]
-            st["free"] = st["free"] - req_node
+            ff = np.flatnonzero(freefit)
+            if ff.size:
+                b, best_price, best_evict = int(ff[0]), 0.0, 0
+            else:
+                cand = np.flatnonzero(evict >= 0)
+                if cand.size == 0:
+                    return None
+                b = int(cand[np.argmin(price[cand])])
+                best_price, best_evict = float(price[b]), int(evict[b])
+            s0 = int(seg[b] + c[b])
+            if best_evict:
+                free[b] += flat_vec[s0 : s0 + best_evict].sum(axis=0)
+                c[b] += best_evict
+            free[b] -= req_node
             gang_price = max(gang_price, best_price)
+            # refresh only node b
+            s, e = int(seg[b] + c[b]), int(seg[b + 1])
+            freefit[b] = bool((free[b] >= req_node).all())
+            evict[b], price[b] = -1, np.inf
+            if e > s:
+                freed_b = free[b] + np.cumsum(flat_vec[s:e], axis=0)
+                hit = np.flatnonzero(
+                    (freed_b >= req_node[None, :]).all(axis=1)
+                )
+                if hit.size:
+                    evict[b] = int(hit[0]) + 1
+                    price[b] = float(flat_bid[s + int(hit[0])])
         return gang_price

@@ -1,0 +1,394 @@
+"""Columnar market observability == the legacy spec paths.
+
+The incremental scheduler computes idealised values and indicative gang
+prices straight off the builder columns (scheduler/idealised_columnar.py,
+pricer._prepare_columnar) instead of walking every spec; these randomized
+cross-checks pin them to the legacy implementations (which run the real
+round kernel on the mega node / the list-based resident scan), the same way
+tests/test_parity*.py pin the round kernel to its sequential oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import GangDefinition, PoolConfig, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
+from armada_tpu.models.incremental import IncrementalBuilder
+from armada_tpu.scheduler.idealised import calculate_idealised_values
+from armada_tpu.scheduler.idealised_columnar import (
+    calculate_idealised_values_columnar,
+)
+from armada_tpu.scheduler.pricer import IndicativeGangPricer
+
+PCS = ("armada-preemptible", "armada-default")
+BANDS = ("", "low", "mid", "high")
+
+
+def make_config(gangs_to_price=(), lookback=100_000):
+    return SchedulingConfig(
+        shape_bucket=32,
+        max_queue_lookback=lookback,
+        pools=(
+            PoolConfig(
+                "default",
+                market_driven=True,
+                spot_price_cutoff=0.5,
+                gangs_to_price=tuple(gangs_to_price),
+            ),
+        ),
+    )
+
+
+def make_prices(rng, queues):
+    # f32-exact prices: the columnar path compares the (queue, band) price
+    # table exactly as the kernel does (f32 g_price)
+    table = {
+        (q.name, b): float(np.float32(rng.choice([1.0, 2.0, 3.5, 5.0, 8.0])))
+        for q in queues
+        for b in BANDS
+    }
+
+    def price_of(job):
+        return table[(job.queue, job.price_band)]
+
+    return price_of
+
+
+def random_world(seed, *, gangs=True, lookback=100_000):
+    rng = random.Random(seed)
+    nq = rng.randint(1, 3)
+    queues = [Queue(f"q{i}", weight=rng.choice([0.5, 1.0, 2.0]))
+              for i in range(nq)]
+    config = make_config(lookback=lookback)
+    F = config.resource_list_factory()
+    nodes = [
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            total_resources=F.from_mapping(
+                {"cpu": rng.choice([4, 8, 16]), "memory": 32}
+            ),
+            unschedulable=(rng.random() < 0.1),
+        )
+        for i in range(rng.randint(2, 5))
+    ]
+    price_of = make_prices(rng, queues)
+
+    queued, running = [], []
+    jid = 0
+
+    def spec(queue, cpu, pc, band, gang_id="", card=0, label="", prio=0):
+        nonlocal jid
+        jid += 1
+        return JobSpec(
+            id=f"j{jid:04d}",
+            queue=queue,
+            priority=prio,
+            priority_class=pc,
+            price_band=band,
+            submit_time=float(rng.randint(0, 5)),
+            resources=(
+                None
+                if cpu is None
+                else F.from_mapping({"cpu": cpu, "memory": rng.choice([1, 2])})
+            ),
+            gang_id=gang_id,
+            gang_cardinality=card,
+            gang_node_uniformity_label=label,
+        )
+
+    for _ in range(rng.randint(10, 40)):
+        q = rng.choice(queues).name
+        s = spec(
+            q,
+            rng.choice([1, 2, 4, None if rng.random() < 0.05 else 8]),
+            rng.choice(PCS),
+            rng.choice(BANDS),
+        )
+        queued.append(s)
+    if gangs:
+        for g in range(rng.randint(0, 3)):
+            q = rng.choice(queues).name
+            card = rng.randint(1, 4)
+            label = "zone" if rng.random() < 0.3 else ""
+            hetero = rng.random() < 0.4
+            members = [
+                spec(
+                    q,
+                    rng.choice([1, 2]) if (hetero and m % 2) else 2,
+                    PCS[m % 2] if hetero else PCS[0],
+                    rng.choice(BANDS),
+                    gang_id=f"g{g}",
+                    card=card,
+                    label=label,
+                )
+                for m in range(card)
+            ]
+            split = rng.randint(0, card)  # some members already running
+            for m in members[:split]:
+                running.append(
+                    RunningJob(job=m, node_id=rng.choice(nodes).id)
+                )
+            queued.extend(members[split:])
+    for _ in range(rng.randint(0, 12)):
+        q = rng.choice(queues).name
+        s = spec(q, rng.choice([1, 2, 4]), rng.choice(PCS), rng.choice(BANDS))
+        running.append(RunningJob(job=s, node_id=rng.choice(nodes).id))
+
+    builder = IncrementalBuilder(config, "default", queues, bid_price_of=price_of)
+    builder.set_nodes(nodes)
+    builder.submit_many(queued)
+    builder.lease_many(running)
+    return config, queues, nodes, queued, running, builder, price_of
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_columnar_idealised_matches_kernel(seed):
+    config, queues, nodes, queued, running, builder, price_of = random_world(seed)
+    legacy = calculate_idealised_values(
+        config,
+        pool="default",
+        nodes=nodes,
+        queues=queues,
+        queued_jobs=queued,
+        running=running,
+        bid_price_of=price_of,
+    )
+    columnar = calculate_idealised_values_columnar(
+        config, pool="default", builder=builder, bid_price_of=price_of
+    )
+    assert set(legacy) == set(columnar), (seed, legacy, columnar)
+    for q in legacy:
+        assert np.isclose(legacy[q], columnar[q]), (seed, q, legacy, columnar)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_columnar_idealised_matches_kernel_tight_capacity(seed):
+    """Capacity exhaustion mid-stream: bulk admission must cut exactly where
+    the sequential kernel does."""
+    config, queues, nodes, queued, running, builder, price_of = random_world(
+        1000 + seed
+    )
+    # shrink the fleet to one small node so most candidates fail
+    small = [
+        NodeSpec(
+            id=nodes[0].id,
+            pool="default",
+            total_resources=config.resource_list_factory().from_mapping(
+                {"cpu": 5, "memory": 8}
+            ),
+        )
+    ]
+    builder.set_nodes(small)
+    legacy = calculate_idealised_values(
+        config,
+        pool="default",
+        nodes=small,
+        queues=queues,
+        queued_jobs=queued,
+        running=running,
+        bid_price_of=price_of,
+    )
+    columnar = calculate_idealised_values_columnar(
+        config, pool="default", builder=builder, bid_price_of=price_of
+    )
+    assert set(legacy) == set(columnar), (seed, legacy, columnar)
+    for q in legacy:
+        assert np.isclose(legacy[q], columnar[q]), (seed, q, legacy, columnar)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_columnar_idealised_lookback_truncation(seed):
+    config, queues, nodes, queued, running, builder, price_of = random_world(
+        2000 + seed, lookback=7
+    )
+    legacy = calculate_idealised_values(
+        config,
+        pool="default",
+        nodes=nodes,
+        queues=queues,
+        queued_jobs=queued,
+        running=running,
+        bid_price_of=price_of,
+    )
+    columnar = calculate_idealised_values_columnar(
+        config, pool="default", builder=builder, bid_price_of=price_of
+    )
+    assert set(legacy) == set(columnar), (seed, legacy, columnar)
+    for q in legacy:
+        assert np.isclose(legacy[q], columnar[q]), (seed, q, legacy, columnar)
+
+
+def _algo_market_stats(incremental, seed, preempt_cycle=False):
+    """Drive FairSchedulingAlgo over a random market world in one mode and
+    return the market PoolStats (observability fields).  With
+    preempt_cycle, a second cycle submits top-band jobs that outbid and
+    preempt cycle-1 placements -- the preempted jobs must still enter the
+    idealised mega round (pre-round running semantics)."""
+    import random as _random
+
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.scheduler.algo import FairSchedulingAlgo
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+    rng = _random.Random(seed)
+    shapes = [
+        ("probe", GangDefinition(size=2, priority_class=PCS[0],
+                                 resources={"cpu": 2, "memory": 1})),
+    ]
+    config = make_config(gangs_to_price=shapes)
+    F = config.resource_list_factory()
+    queues = [Queue(f"q{i}") for i in range(rng.randint(1, 3))]
+    nodes = tuple(
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            executor="ex1",
+            total_resources=F.from_mapping(
+                {"cpu": rng.choice([4, 8]), "memory": 16}
+            ),
+        )
+        for i in range(rng.randint(2, 4))
+    )
+    price_table = {
+        (q.name, b): float(np.float32(rng.choice([1.0, 2.0, 4.0])))
+        for q in queues
+        for b in BANDS
+    }
+    jobdb = JobDb(config)
+    feed = None
+    if incremental:
+        feed = IncrementalProblemFeed(config)
+        feed.attach(jobdb)
+
+    from armada_tpu.scheduler.providers import StaticBidPriceProvider
+
+    class TableProvider(StaticBidPriceProvider):
+        def price(self, queue, band):
+            return price_table[(queue, band)]
+
+    with jobdb.write_txn() as txn:
+        for i in range(rng.randint(6, 25)):
+            q = rng.choice(queues).name
+            gang = rng.random() < 0.2
+            gid = f"g{i}" if gang else ""
+            card = rng.randint(2, 3) if gang else 1
+            for m in range(card):
+                spec = JobSpec(
+                    id=f"j{i:03d}m{m}",
+                    queue=q,
+                    priority_class=rng.choice(PCS),
+                    price_band=rng.choice(BANDS),
+                    submit_time=float(rng.randint(0, 3)),
+                    resources=F.from_mapping(
+                        {"cpu": rng.choice([1, 2, 4]), "memory": 1}
+                    ),
+                    gang_id=gid,
+                    gang_cardinality=card,
+                )
+                txn.upsert(Job(spec=spec, validated=True, pools=("default",)))
+        algo = FairSchedulingAlgo(
+            config,
+            queues=lambda: queues,
+            clock_ns=lambda: 10**15,
+            bid_prices=TableProvider({}, default=1.0),
+            feed=feed,
+        )
+        snap = ExecutorSnapshot(
+            id="ex1", pool="default", nodes=nodes, last_update_ns=10**15
+        )
+        result = algo.schedule(txn, [snap], now_ns=10**15)
+    if not preempt_cycle:
+        (stats,) = [s for s in result.pools if s.market]
+        return stats
+    # cycle 2: top-band submissions outbid and preempt cycle-1 placements
+    price_table.update({(q.name, "high"): 50.0 for q in queues})
+    import dataclasses as _dc
+
+    snap2 = _dc.replace(snap, last_update_ns=10**15 + 10**9)
+    with jobdb.write_txn() as txn:
+        for i in range(rng.randint(4, 10)):
+            q = rng.choice(queues).name
+            txn.upsert(
+                Job(
+                    spec=JobSpec(
+                        id=f"p{i:03d}",
+                        queue=q,
+                        priority_class=PCS[0],
+                        price_band="high",
+                        submit_time=5.0,
+                        resources=F.from_mapping(
+                            {"cpu": rng.choice([2, 4]), "memory": 2}
+                        ),
+                    ),
+                    validated=True,
+                    pools=("default",),
+                )
+            )
+        result = algo.schedule(txn, [snap2], now_ns=10**15 + 10**9)
+    (stats,) = [s for s in result.pools if s.market]
+    return stats
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("preempt", [False, True], ids=["fresh", "preempt"])
+def test_algo_market_stats_mode_equivalence(seed, preempt):
+    """The incremental (columnar) observability and the legacy spec-walk
+    produce identical PoolStats on the same world -- including cycles where
+    market preemption removes jobs from the builder tables mid-txn (the
+    idealised mega round still counts them: pre-round running semantics)."""
+    legacy = _algo_market_stats(False, seed, preempt_cycle=preempt)
+    inc = _algo_market_stats(True, seed, preempt_cycle=preempt)
+    assert sorted(legacy.outcome.scheduled) == sorted(inc.outcome.scheduled)
+    assert set(legacy.idealised_values) == set(inc.idealised_values)
+    for q in legacy.idealised_values:
+        assert np.isclose(legacy.idealised_values[q], inc.idealised_values[q])
+    assert set(legacy.realised_values) == set(inc.realised_values)
+    for q in legacy.realised_values:
+        assert np.isclose(legacy.realised_values[q], inc.realised_values[q])
+    assert set(legacy.indicative_prices) == set(inc.indicative_prices)
+    for name in legacy.indicative_prices:
+        lr, cr = legacy.indicative_prices[name], inc.indicative_prices[name]
+        assert (lr.schedulable, lr.price, lr.unschedulable_reason) == (
+            cr.schedulable,
+            cr.price,
+            cr.unschedulable_reason,
+        )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_columnar_pricer_matches_legacy(seed):
+    shapes = [
+        ("small", GangDefinition(size=1, priority_class=PCS[0],
+                                 resources={"cpu": 2, "memory": 1})),
+        ("wide", GangDefinition(size=3, priority_class=PCS[0],
+                                resources={"cpu": 4, "memory": 2})),
+        ("zoned", GangDefinition(size=2, priority_class=PCS[0],
+                                 resources={"cpu": 2, "memory": 1},
+                                 node_uniformity="zone")),
+    ]
+    config = make_config(gangs_to_price=shapes)
+    _, queues, nodes, queued, running, builder, price_of = random_world(
+        3000 + seed
+    )
+    # rebuild the builder under the gangs_to_price config (same world)
+    builder = IncrementalBuilder(config, "default", queues, bid_price_of=price_of)
+    builder.set_nodes(nodes)
+    builder.submit_many(queued)
+    builder.lease_many(running)
+    pricer = IndicativeGangPricer(config)
+    legacy = pricer.price_pool_gangs("default", nodes, running, price_of)
+    columnar = pricer.price_pool_gangs_columnar(
+        "default", nodes, builder, price_of
+    )
+    assert set(legacy) == set(columnar)
+    for name in legacy:
+        lr, cr = legacy[name], columnar[name]
+        assert (lr.schedulable, lr.unschedulable_reason) == (
+            cr.schedulable,
+            cr.unschedulable_reason,
+        ), (seed, name, lr, cr)
+        assert lr.price == cr.price, (seed, name, lr, cr)
