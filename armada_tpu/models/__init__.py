@@ -8,6 +8,7 @@ pipeline (internal/scheduler/scheduling/*.go).
 """
 
 from armada_tpu.models.problem import (
+    begin_decode,
     SchedulingProblem,
     HostContext,
     build_problem,
@@ -137,6 +138,7 @@ __all__ = [
     "SchedulingProblem",
     "HostContext",
     "build_problem",
+    "begin_decode",
     "decode_result",
     "RoundOutcome",
     "schedule_round",

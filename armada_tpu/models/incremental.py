@@ -799,14 +799,9 @@ class IncrementalBuilder:
             if name in self.factory.names:
                 i = self.factory.index_of(name)
                 round_cap[i] = frac * total_pool[i]
-        C = len(self.pc_names)
-        pc_queue_cap = np.full((C, R), _INF, np.float32)
-        for ci, pc_name in enumerate(self.pc_names):
-            fr = cfg.priority_classes[pc_name].maximum_resource_fraction_per_queue
-            for name, frac in fr.items():
-                if name in self.factory.names:
-                    i = self.factory.index_of(name)
-                    pc_queue_cap[ci, i] = (frac * total_pool[i]).astype(np.float32)
+        from armada_tpu.models.problem import pc_queue_caps
+
+        pc_queue_cap = pc_queue_caps(cfg, self.pc_names, self.factory, total_pool)
         return {
             "key": (self._node_epoch, N),
             "node_total": node_total,

@@ -259,18 +259,15 @@ def calculate_idealised_values_columnar(
 
     # Per-(queue, priority-class) allocation caps stay ACTIVE in the mega
     # round (idealised.py's permissive config clears only the per-round
-    # limits).  Cap values mirror the builder's f32 math: frac x f32
-    # total_pool (node floor units + float).
-    C = len(builder.pc_names)
-    tp32 = (mega_units + float_total).astype(np.float32)
-    pc_queue_cap = np.full((C, R), np.float32(3.0e38), np.float32)
-    for ci, pc_name in enumerate(builder.pc_names):
-        fr = config.priority_classes[pc_name].maximum_resource_fraction_per_queue
-        for name, frac in fr.items():
-            if name in factory.names:
-                ri = factory.index_of(name)
-                pc_queue_cap[ci, ri] = np.float32(frac * tp32[ri])
-    pc_queue_cap = pc_queue_cap.astype(np.float64)
+    # limits); same f32 math as the kernel problems (problem.pc_queue_caps).
+    from armada_tpu.models.problem import pc_queue_caps
+
+    pc_queue_cap = pc_queue_caps(
+        config,
+        builder.pc_names,
+        factory,
+        (mega_units + float_total).astype(np.float32),
+    ).astype(np.float64)
 
     # per-row valuation: price x max_r(raw atoms / unit) (value_of_jobs)
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -562,9 +559,8 @@ def _admit(
             excluded0[i] = True
 
     killed_groups: set = set()
-    values: dict = {}
     partial: set = set()
-    value_by_tag: dict[str, float] = {}
+    admitted = np.zeros((n,), bool)
     for _ in range(5):
         excluded = excluded0.copy()
         if killed_groups:
@@ -576,40 +572,37 @@ def _admit(
             cap_fit, pc_queue_cap, len(builder.queue_names),
         )
         placed_by_tag: dict[str, int] = {}
-        value_by_tag = {}
         for i in np.flatnonzero(admitted & (unit_of >= 0)):
             t = units[unit_of[i]].tag
             if t:
                 placed_by_tag[t] = placed_by_tag.get(t, 0) + 1
-                value_by_tag[t] = (
-                    value_by_tag.get(t, 0.0) + units[unit_of[i]].value
-                )
         partial = {
             t
             for t, total in total_by_tag.items()
             if 0 < placed_by_tag.get(t, 0) < total
         } - killed_groups
-        values = {}
-        take = admitted & hasres
-        if take.any():
-            counts = np.bincount(qi[take])
-            sums = np.bincount(
-                qi[admitted],
-                weights=rowvalue[admitted],
-                minlength=counts.shape[0],
-            )
-            for q in np.flatnonzero(counts):
-                values[builder.queue_names[q]] = float(sums[q])
         if not partial:
-            return values
+            break
         killed_groups |= partial
-    # Attempt cap reached (models/__init__.py attempts < 4): decode unwinds
-    # the still-partial groups, so their placed members carry no value while
-    # the capacity they consumed stays consumed.
-    for t in partial:
-        qn = builder.queue_names[int(t.split(":")[0])]
-        if qn in values:
-            values[qn] -= value_by_tag.get(t, 0.0)
+    if partial:
+        # Attempt cap reached (models/__init__.py attempts < 4): decode
+        # unwinds the still-partial groups, so their placed members leave
+        # the scheduled set entirely (no value, no queue entry) while the
+        # capacity they consumed stays consumed.
+        for i in np.flatnonzero(unit_of >= 0):
+            if units[unit_of[i]].tag in partial:
+                admitted[i] = False
+    values: dict = {}
+    take = admitted & hasres
+    if take.any():
+        counts = np.bincount(qi[take])
+        sums = np.bincount(
+            qi[admitted],
+            weights=rowvalue[admitted],
+            minlength=counts.shape[0],
+        )
+        for q in np.flatnonzero(counts):
+            values[builder.queue_names[q]] = float(sums[q])
     return values
 
 

@@ -202,7 +202,7 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
     decode, over the incremental builder (models/incremental.py).  Returns
     (cycle_s, breakdown dict, scheduled count)."""
     from armada_tpu.core.types import RunningJob
-    from armada_tpu.models import decode_result
+    from armada_tpu.models import begin_decode, decode_result
     from armada_tpu.models.incremental import DeviceProblemCache, IncrementalBuilder
     from armada_tpu.models.slab import DeviceDeltaCache
     from armada_tpu.models.synthetic import synthetic_bid_price, synthetic_world
@@ -256,10 +256,25 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
             slot_width=ctx.slot_width,
         )
         result = schedule_round(dev, **kw)
-        jax.block_until_ready(result)
-        t_kernel = time.perf_counter()
-        outcome = decode_result(result, ctx)
-        t_decode = time.perf_counter()
+        # Overlapped decode (default): the compaction + its device->host copy
+        # are enqueued BEHIND the kernel without a host sync, and the cycle's
+        # decision-independent work (next submits) runs while kernel +
+        # transfer are in flight -- each avoided sync/fetch round trip costs
+        # ~0.1s on the axon tunnel.  ARMADA_BENCH_NO_OVERLAP=1 restores the
+        # blocking flow for A/B (its keys split upload+kernel vs decode).
+        overlap = os.environ.get("ARMADA_BENCH_NO_OVERLAP") != "1"
+        if overlap:
+            finish = begin_decode(result, ctx)
+            fresh = spec_factory(1000, t_now)
+            for s in fresh:
+                spec_of[s.id] = s
+            builder.submit_many(fresh)
+            t_kernel = time.perf_counter()  # dispatch + overlapped submits
+            outcome = finish()
+        else:
+            jax.block_until_ready(result)
+            t_kernel = time.perf_counter()
+            outcome = decode_result(result, ctx)
         # Feed the decisions back (part of the measured cycle: the reference
         # applies SchedulerResult to the jobDb inside its 5s budget too).
         leases = []
@@ -271,10 +286,13 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats):
         builder.lease_many(leases)
         for jid in outcome.preempted:
             builder.unlease(jid)
-        fresh = spec_factory(max(1, len(outcome.scheduled)), t_now)
-        for s in fresh:
-            spec_of[s.id] = s
-        builder.submit_many(fresh)
+        if not overlap:
+            # same outcome-independent count as the overlapped arm, so the
+            # A/B times identical host work and neither backlog drifts
+            fresh = spec_factory(1000, t_now)
+            for s in fresh:
+                spec_of[s.id] = s
+            builder.submit_many(fresh)
         t_end = time.perf_counter()
         return (
             t_end - t_start,

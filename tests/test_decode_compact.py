@@ -133,3 +133,29 @@ def test_compact_fetch_reports_overflow(monkeypatch):
     result, ctx = _round(_fail_world)
     monkeypatch.setattr(problem_mod, "_COMPACT_FCAP", 1)
     assert problem_mod._fetch_compact(result, ctx) is None
+
+
+@pytest.mark.parametrize("world", [_evict_world, _fail_world])
+def test_begin_decode_matches_blocking_decode(world):
+    """The non-blocking begin_decode/finish pair (compaction + async
+    device->host copy enqueued behind the kernel) must produce the same
+    outcome as the blocking decode."""
+    from armada_tpu.models import begin_decode
+
+    result, ctx = _round(world)
+    finish = begin_decode(result, ctx)
+    overlapped = finish()
+    blocking = decode_result(result, ctx)
+    _assert_same(overlapped, blocking)
+
+
+def test_begin_decode_overflow_falls_back(monkeypatch):
+    monkeypatch.setattr(problem_mod, "_COMPACT_FCAP", 1)
+    from armada_tpu.models import begin_decode
+
+    result, ctx = _round(_fail_world)
+    finish = begin_decode(result, ctx)
+    overlapped = finish()
+    blocking = decode_result(result, ctx)
+    _assert_same(overlapped, blocking)
+    assert len(list(overlapped.failed)) > 1  # the cap was genuinely exceeded
