@@ -357,6 +357,7 @@ class IncrementalBuilder:
         self._unit_cols: dict[str, np.ndarray] = {}
         # Device-visible gang ids across all regions ([G] grows with caps).
         self._g_ids = np.zeros((0,), self.jobs.ids.dtype)
+        self._g_ids_shared = False  # copy-on-write, see _own_g_ids
         # Exact integral demand accounting per (queue, pc): resolution units
         # are integers, so incremental float64 +=/-= is exact and
         # order-independent (matches assemble()'s fresh bincounts).
@@ -575,6 +576,7 @@ class IncrementalBuilder:
             )
         self._sg.release(slot)
         if slot < self._g_ids.shape[0]:
+            self._own_g_ids()
             self._g_ids[slot] = b""
 
     def _release_run(self, info: Optional[dict]) -> None:
@@ -587,12 +589,27 @@ class IncrementalBuilder:
             )
         self._rr.release(slot)
 
+    def _own_g_ids(self) -> None:
+        """Copy-on-write for the shared [G] id snapshot (assemble_delta hands
+        self._g_ids to the HostContext; the first in-place write after that
+        copies, so mutation-free cycles pay nothing and the copy otherwise
+        runs in the overlapped decode shadow, not the assemble path)."""
+        if self._g_ids_shared:
+            self._g_ids = self._g_ids.copy()
+            self._g_ids_shared = False
+
+    def _share_g_ids(self) -> np.ndarray:
+        self._g_ids_shared = True
+        return self._g_ids
+
     def _ensure_g_ids(self) -> None:
-        """Keep the [G] id vector covering the singles region after growth."""
+        """Keep the [G] id vector covering the singles region after growth
+        (a fresh array object, so an outstanding snapshot keeps the old)."""
         if self._g_ids.shape[0] < self._sg.cap:
             old = self._g_ids
             self._g_ids = np.zeros((self._sg.cap,), _ID_DTYPE)
             self._g_ids[: old.shape[0]] = old
+            self._g_ids_shared = False
 
     def submit_many(
         self, specs: Sequence[JobSpec], banned: Optional[Mapping] = None
@@ -649,6 +666,7 @@ class IncrementalBuilder:
             band=np.array([r["band"] for r in rows], np.int32),
         )
         self._ensure_g_ids()
+        self._own_g_ids()
         self._g_ids[slots] = np.array([r["ids"] for r in rows], _ID_DTYPE)
         np.add.at(
             self._demand_sg,
@@ -1988,12 +2006,13 @@ class IncrementalBuilder:
                 for i, name in enumerate(self.factory.names)
                 if total_pool64[i]
             },
-            # Snapshots, not views: a mutation landing between assemble and
-            # decode (slot reuse after remove) must not corrupt decode's ids
-            # (legacy assemble() snapshots too).  ~20ms at 1M gangs.
-            gang_ids_vec=self._g_ids.copy(),
+            # Copy-on-write snapshots: a mutation landing between assemble
+            # and decode (slot reuse after remove) must not corrupt decode's
+            # ids, but eagerly copying [G] ids cost ~30ms of every assemble;
+            # now the first post-assemble id write copies instead.
+            gang_ids_vec=self._share_g_ids(),
             gang_members_over=members_over,
-            run_ids_vec=rr.ids.copy(),
+            run_ids_vec=rr.share_ids(),
         )
         return bundle, ctx
 

@@ -94,13 +94,19 @@ class RowSlab:
         # it once per cycle (single consumer; a skipped bundle is caught by
         # the DeltaBundle seq guard and forces a full upload).
         self.dirty_log: list[int] = []
+        # Copy-on-write guard for the ids vector: share_ids() hands the
+        # CURRENT array to a decode context; the next in-place id write
+        # copies first, so the snapshot costs nothing on mutation-free
+        # cycles and otherwise lands in the overlapped decode shadow.
+        self._ids_shared = False
 
     def _grow(self, need: int) -> None:
         new_cap = self.cap
         while new_cap < need:
             new_cap += self.bucket
         self.req = _grow2(self.req, new_cap)
-        self.ids = _grow2(self.ids, new_cap)
+        self.ids = _grow2(self.ids, new_cap)  # fresh object: snapshots keep the old one
+        self._ids_shared = False
         self.valid = _grow2(self.valid, new_cap)
         for name in self._columns:
             setattr(self, name, _grow2(getattr(self, name), new_cap))
@@ -118,8 +124,19 @@ class RowSlab:
             self.hw += fresh
         return np.asarray(slots, np.int64)
 
+    def share_ids(self) -> np.ndarray:
+        """Snapshot of the ids vector for a decode context (copy-on-write)."""
+        self._ids_shared = True
+        return self.ids
+
+    def _own_ids(self) -> None:
+        if self._ids_shared:
+            self.ids = self.ids.copy()
+            self._ids_shared = False
+
     def write_batch(self, slots: np.ndarray, ids, reqs, **cols) -> None:
         self.req[slots] = reqs
+        self._own_ids()
         self.ids[slots] = ids
         self.valid[slots] = True
         for name, vals in cols.items():
@@ -128,6 +145,7 @@ class RowSlab:
 
     def release(self, slot: int) -> None:
         self.valid[slot] = False
+        self._own_ids()
         self.ids[slot] = b""
         self.free.append(slot)
         self.dirty_log.append(slot)

@@ -348,3 +348,43 @@ def test_slab_delta_market_pool():
     out = d.cycle()
     apply_outcome(d, out, spec_of, 4)
     assert d.full_uploads == uploads_before_move + 1
+
+
+def test_ctx_id_snapshots_survive_post_assemble_mutations():
+    """HostContext id vectors are copy-on-write: a slot reused by a remove +
+    resubmit AFTER assemble_delta must not corrupt the outstanding context's
+    ids (the overlapped decode reads them after the next cycle's submits)."""
+    cfg = make_config()
+    F = cfg.resource_list_factory()
+    b = IncrementalBuilder(cfg, "default", [Queue("q")])
+    b.set_nodes(
+        [NodeSpec(id="n0", pool="default",
+                  total_resources=F.from_mapping({"cpu": 8, "memory": 32}))]
+    )
+    spec = JobSpec(id="old-job", queue="q",
+                   resources=F.from_mapping({"cpu": 1, "memory": 1}))
+    b.submit(spec)
+    bundle, ctx = b.assemble_delta()
+    bundle.materialize()
+    slot = int(b.jobs.slot[b.jobs._locate(b"old-job")])
+    assert ctx.gang_ids_vec[slot] == b"old-job"
+    # reuse the slot: remove then submit a different job
+    b.remove("old-job")
+    b.submit(JobSpec(id="new-job", queue="q",
+                     resources=F.from_mapping({"cpu": 1, "memory": 1})))
+    assert b.jobs.slot[b.jobs._locate(b"new-job")] == slot  # slot reused
+    # the outstanding ctx still decodes the OLD id
+    assert ctx.gang_ids_vec[slot] == b"old-job"
+    # runs-table ids likewise
+    b.lease(RunningJob(job=JobSpec(
+        id="r0", queue="q", resources=F.from_mapping({"cpu": 1, "memory": 1})),
+        node_id="n0"))
+    bundle2, ctx2 = b.assemble_delta()
+    bundle2.materialize()
+    rslot = int(b.runs.slot[b.runs._locate(b"r0")])
+    assert ctx2.run_ids_vec[rslot] == b"r0"
+    b.unlease("r0")
+    b.lease(RunningJob(job=JobSpec(
+        id="r1", queue="q", resources=F.from_mapping({"cpu": 1, "memory": 1})),
+        node_id="n0"))
+    assert ctx2.run_ids_vec[rslot] == b"r0"
