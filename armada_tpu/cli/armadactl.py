@@ -454,6 +454,8 @@ def load_serve_config(args):
             authn_from_config(loaded["auth"]) if loaded["auth"] is not None else None
         )
         serve_doc = {k.lower(): v for k, v in loaded["serve"].items()}
+    # lookoutOidc is a nested mapping, not a scalar flag: config-file only
+    args.lookout_oidc = serve_doc.get("lookoutoidc")
     mapping = {
         "data_dir": ("datadir", str),
         "port": ("port", int),
@@ -492,6 +494,7 @@ def cmd_serve(args):
         health_port=args.health_port,
         profiling=args.profiling,
         lookout_port=args.lookout_port,
+        lookout_oidc=getattr(args, "lookout_oidc", None),
         binoculars_url=args.binoculars_url,
         rest_port=args.rest_port,
         kube_lease_url=args.kube_lease_url,

@@ -11,6 +11,7 @@ survives restarts (event-sourced recovery).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import threading
 import time
@@ -108,6 +109,7 @@ def start_control_plane(
     kube_lease_namespace: str = "default",
     bind_host: str = "127.0.0.1",
     authenticator=None,
+    lookout_oidc=None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -307,6 +309,26 @@ def start_control_plane(
             from armada_tpu.rpc.client import BinocularsClient
 
             logs_of = BinocularsClient(binoculars_url).logs
+        oidc = lookout_oidc
+        if isinstance(oidc, dict):
+            from armada_tpu.lookout.oidc import web_config_from_dict
+
+            try:
+                oidc = web_config_from_dict(oidc)
+            except ValueError:
+                raise  # misconfiguration: fail loudly
+            except Exception as e:
+                # Issuer discovery is a network fetch; an IdP outage at boot
+                # must not take the scheduler down.  The UI still gates on
+                # the authn chain -- only the browser login flow is lost
+                # until a restart (operators wanting boot-time certainty
+                # configure explicit endpoints).
+                logging.getLogger("armada.serve").warning(
+                    "lookoutOidc discovery failed (%s); serving the UI "
+                    "without the browser login flow",
+                    e,
+                )
+                oidc = None
         lookout_web = LookoutWebUI(
             LookoutQueries(lookoutdb),
             lookout_port,
@@ -316,6 +338,8 @@ def start_control_plane(
             # strict operator config (serve --config authn:) locks the page,
             # the dev default (trusted headers + anonymous) keeps it open
             authenticator=authenticator,
+            # serve: lookoutOidc: enables the browser login flow
+            oidc=oidc,
         )
 
     rest_gateway = None

@@ -1,0 +1,480 @@
+"""OIDC login for the lookout web UI: the browser-facing authorization-code
+flow the reference UI runs through oidc-client-ts
+(internal/lookoutui/src/oidcAuth/OidcAuthProvider.tsx: signinRedirect ->
+signinCallback -> tokens attached to every API call -> silent renew).
+
+The reference exchanges the code IN the browser (public client + PKCE) and
+keeps tokens in localStorage; this UI is served by the same process that
+already holds the server authn chain, so the exchange runs SERVER-side
+(still PKCE -- the modern recommendation for web apps too) and the browser
+holds only an opaque HttpOnly session cookie:
+
+  GET /login?next=...   remember (state -> verifier, next), 302 to the IdP's
+                        authorization endpoint (response_type=code,
+                        code_challenge S256, state)
+  GET /oauth/callback   validate state (single-use, TTL-bound), POST the
+                        token endpoint (grant_type=authorization_code +
+                        code_verifier), validate the ACCESS TOKEN against
+                        the server authn chain (the same OidcAuthenticator
+                        the gRPC/REST transports trust -- a token the API
+                        would reject never becomes a session), set the
+                        session cookie, 302 back to `next`
+  every request         session cookie -> bearer metadata -> the chain; an
+                        expired access token refreshes transparently via
+                        grant_type=refresh_token (oidc-client-ts renew
+                        analog) before re-validation
+  GET /logout           drop the session, clear the cookie, 302 to the
+                        IdP's end_session endpoint when it has one
+
+Endpoints come from RFC 8414 / OIDC discovery
+(`/.well-known/openid-configuration`) via `OidcWebConfig.discover`, or are
+set explicitly (zero-egress deployments configure all three URLs).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import json
+import secrets
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Mapping, Optional
+
+from armada_tpu.server.auth import Principal
+from armada_tpu.server.authn import AUTH_HEADER, authenticate_http_headers
+
+SESSION_COOKIE = "armada_lookout_session"
+# Login attempts that never come back expire (state is single-use either way).
+_PENDING_TTL_S = 600.0
+# Refresh this many seconds BEFORE the token's expires_in elapses, so an API
+# call near the boundary never sends a just-expired token to the chain.
+_EXPIRY_SKEW_S = 30.0
+# Server-side session bounds: sessions whose cookies were abandoned (browser
+# closed, re-login overwrote the cookie) must not accumulate live tokens in a
+# long-lived serve process.
+_MAX_SESSIONS = 4096
+_SESSION_IDLE_TTL_S = 24 * 3600.0
+
+
+class OidcFlowError(Exception):
+    """A login-flow step failed (bad state, rejected code exchange, token
+    rejected by the authn chain).  The handler answers 400/401 with this."""
+
+
+@dataclasses.dataclass(frozen=True)
+class OidcWebConfig:
+    """Client registration + endpoints for the UI's login flow.
+
+    `client_secret` may be empty: a public client authenticates the exchange
+    with PKCE alone (oidc-client-ts's shape); confidential clients send the
+    secret as client_secret_post."""
+
+    issuer: str
+    client_id: str
+    authorization_endpoint: str
+    token_endpoint: str
+    client_secret: str = ""
+    end_session_endpoint: str = ""
+    scope: str = "openid profile"
+
+    @staticmethod
+    def discover(
+        issuer: str,
+        client_id: str,
+        client_secret: str = "",
+        scope: str = "openid profile",
+        timeout_s: float = 10.0,
+    ) -> "OidcWebConfig":
+        """Fetch `/.well-known/openid-configuration` from the issuer
+        (OidcAuthProvider's `authority`)."""
+        url = issuer.rstrip("/") + "/.well-known/openid-configuration"
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read())
+        return OidcWebConfig(
+            issuer=doc.get("issuer", issuer),
+            client_id=client_id,
+            client_secret=client_secret,
+            authorization_endpoint=doc["authorization_endpoint"],
+            token_endpoint=doc["token_endpoint"],
+            end_session_endpoint=doc.get("end_session_endpoint", ""),
+            scope=scope,
+        )
+
+
+def web_config_from_dict(d: Mapping) -> OidcWebConfig:
+    """Operator-config shape (serve: lookoutOidc: ...), reference names from
+    the lookout UI's oidc config (config/lookout/config.yaml uiConfig.oidc):
+
+      lookoutOidc:
+        issuer: https://idp.example          # enables discovery when the
+        clientId: lookout-ui                 # endpoints are not given
+        clientSecret: ""                     # omit for a public client
+        scope: openid profile
+        authorizationEndpoint: ...           # explicit endpoints skip
+        tokenEndpoint: ...                   # discovery (zero-egress)
+        endSessionEndpoint: ...
+    """
+    get = lambda *names: next(  # noqa: E731  (case-tolerant key lookup)
+        (d[n] for n in names if n in d), ""
+    )
+    issuer = str(get("issuer"))
+    client_id = str(get("clientId", "clientid", "client_id"))
+    client_secret = str(get("clientSecret", "clientsecret", "client_secret"))
+    scope = str(get("scope") or "openid profile")
+    authz = str(get("authorizationEndpoint", "authorizationendpoint",
+                    "authorization_endpoint"))
+    token = str(get("tokenEndpoint", "tokenendpoint", "token_endpoint"))
+    end = str(get("endSessionEndpoint", "endsessionendpoint",
+                  "end_session_endpoint"))
+    if not client_id:
+        raise ValueError("lookoutOidc needs a clientId")
+    if authz and token:
+        return OidcWebConfig(
+            issuer=issuer,
+            client_id=client_id,
+            client_secret=client_secret,
+            authorization_endpoint=authz,
+            token_endpoint=token,
+            end_session_endpoint=end,
+            scope=scope,
+        )
+    if not issuer:
+        raise ValueError(
+            "lookoutOidc needs either an issuer (for discovery) or explicit "
+            "authorizationEndpoint + tokenEndpoint"
+        )
+    return OidcWebConfig.discover(
+        issuer, client_id, client_secret=client_secret, scope=scope
+    )
+
+
+@dataclasses.dataclass
+class _Session:
+    access_token: str
+    refresh_token: str
+    id_token: str
+    expires_at: float  # manager-clock seconds; 0 = no known expiry
+    last_seen: float = 0.0  # manager-clock; idle sessions get pruned
+
+
+def _cookie_value(headers: Mapping[str, str], name: str) -> Optional[str]:
+    for part in (headers.get("cookie") or headers.get("Cookie") or "").split(";"):
+        k, _, v = part.strip().partition("=")
+        if k == name:
+            return v or None
+    return None
+
+
+class OidcSessionManager:
+    """Login-flow state machine + session store for one UI process.
+
+    `authenticator` is the server authn chain; every access token (fresh or
+    refreshed) passes through it before a request is served, so UI sessions
+    can never outrun what the API transports would accept.  `clock` is
+    injectable for tests (expiry/refresh without sleeping)."""
+
+    def __init__(
+        self,
+        config: OidcWebConfig,
+        authenticator,
+        *,
+        clock: Callable[[], float] = time.time,
+        http_timeout_s: float = 10.0,
+    ):
+        self.config = config
+        self.authenticator = authenticator
+        self._clock = clock
+        self._timeout = http_timeout_s
+        # One lock guards both maps: the handler runs on ThreadingHTTPServer
+        # threads and the SPA fires concurrent API calls every 3s.
+        self._lock = threading.Lock()
+        self._pending: dict[str, tuple[str, str, float]] = {}  # state -> (verifier, next, deadline)
+        self._sessions: dict[str, _Session] = {}
+        self._refresh_locks: dict[str, threading.Lock] = {}
+
+    @staticmethod
+    def _safe_next(next_path: str) -> str:
+        """Relative paths only: no open redirects (absolute / protocol-
+        relative / backslash-normalized URLs) and no header injection
+        (parse_qs decodes %0d%0a, and send_header writes values raw)."""
+        if (
+            not next_path.startswith("/")
+            or next_path.startswith("//")
+            or "\\" in next_path
+            or any(ord(c) < 0x20 or c == "\x7f" for c in next_path)
+        ):
+            return "/"
+        return next_path
+
+    # ------------------------------------------------------------- login ----
+
+    def login_redirect(self, next_path: str, redirect_uri: str) -> str:
+        """Start a login: returns the IdP authorization URL to 302 to."""
+        now = self._clock()
+        state = secrets.token_urlsafe(24)
+        verifier = secrets.token_urlsafe(48)
+        challenge = (
+            base64.urlsafe_b64encode(
+                hashlib.sha256(verifier.encode()).digest()
+            )
+            .rstrip(b"=")
+            .decode()
+        )
+        next_path = self._safe_next(next_path)
+        with self._lock:
+            if len(self._pending) > 4096:  # bound memory under abandoned logins
+                self._pending = {
+                    s: p for s, p in self._pending.items() if p[2] > now
+                }
+            self._pending[state] = (verifier, next_path, now + _PENDING_TTL_S)
+        params = {
+            "response_type": "code",
+            "client_id": self.config.client_id,
+            "redirect_uri": redirect_uri,
+            "scope": self.config.scope,
+            "state": state,
+            "code_challenge": challenge,
+            "code_challenge_method": "S256",
+        }
+        return (
+            self.config.authorization_endpoint
+            + "?"
+            + urllib.parse.urlencode(params)
+        )
+
+    def _token_request(self, form: dict) -> dict:
+        if self.config.client_secret:
+            form["client_secret"] = self.config.client_secret
+        req = urllib.request.Request(
+            self.config.token_endpoint,
+            data=urllib.parse.urlencode(form).encode(),
+            method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:200]
+            raise OidcFlowError(
+                f"token endpoint rejected the grant ({e.code}): {detail}"
+            ) from e
+        except (urllib.error.URLError, ValueError) as e:
+            raise OidcFlowError(f"token endpoint unreachable: {e}") from e
+
+    def _validate_token(self, access_token: str) -> Principal:
+        principal, reason = authenticate_http_headers(
+            self.authenticator, {AUTH_HEADER: f"Bearer {access_token}"}
+        )
+        if principal is None:
+            raise OidcFlowError(
+                f"IdP token rejected by the server authn chain: {reason}"
+            )
+        return principal
+
+    def handle_callback(
+        self, params: Mapping[str, str], redirect_uri: str
+    ) -> tuple[str, str, Principal]:
+        """Finish a login: exchange the code, validate the token through the
+        chain, mint a session.  Returns (next_path, set_cookie_value,
+        principal)."""
+        if params.get("error"):
+            raise OidcFlowError(
+                f"IdP returned error {params['error']!r}: "
+                f"{params.get('error_description', '')}"
+            )
+        state = params.get("state", "")
+        with self._lock:
+            pending = self._pending.pop(state, None)  # single-use
+        if pending is None:
+            raise OidcFlowError("unknown or replayed login state")
+        verifier, next_path, deadline = pending
+        if self._clock() > deadline:
+            raise OidcFlowError("login attempt expired; start again")
+        code = params.get("code", "")
+        if not code:
+            raise OidcFlowError("IdP callback carried no code")
+        tokens = self._token_request(
+            {
+                "grant_type": "authorization_code",
+                "code": code,
+                "redirect_uri": redirect_uri,
+                "client_id": self.config.client_id,
+                "code_verifier": verifier,
+            }
+        )
+        access = tokens.get("access_token", "")
+        if not access:
+            raise OidcFlowError("token response carried no access_token")
+        principal = self._validate_token(access)
+        sid = secrets.token_urlsafe(32)
+        now = self._clock()
+        expires_in = float(tokens.get("expires_in") or 0)
+        session = _Session(
+            access_token=access,
+            refresh_token=tokens.get("refresh_token", ""),
+            id_token=tokens.get("id_token", ""),
+            expires_at=(now + expires_in - _EXPIRY_SKEW_S if expires_in else 0),
+            last_seen=now,
+        )
+        with self._lock:
+            self._prune_sessions_locked(now)
+            self._sessions[sid] = session
+        secure = redirect_uri.startswith("https://")
+        return next_path, self._set_cookie(sid, secure), principal
+
+    def _prune_sessions_locked(self, now: float) -> None:
+        if len(self._sessions) < _MAX_SESSIONS:
+            return
+        alive = {
+            sid: s
+            for sid, s in self._sessions.items()
+            if now - s.last_seen < _SESSION_IDLE_TTL_S
+        }
+        if len(alive) >= _MAX_SESSIONS:
+            # still over: drop the longest-idle (cookie likely abandoned)
+            for sid, _ in sorted(
+                alive.items(), key=lambda kv: kv[1].last_seen
+            )[: len(alive) - _MAX_SESSIONS + 1]:
+                alive.pop(sid)
+        self._sessions = alive
+        self._refresh_locks = {
+            sid: lk for sid, lk in self._refresh_locks.items() if sid in alive
+        }
+
+    # ----------------------------------------------------------- request ----
+
+    def authenticate(self, headers: Mapping[str, str]) -> Optional[Principal]:
+        """Resolve a request's session cookie to a Principal, refreshing the
+        access token first when the manager clock says it expired.  None =
+        no (valid) session -- the caller falls through to the plain header
+        chain, exactly like an unrecognised credential in MultiAuthenticator."""
+        sid = _cookie_value(headers, SESSION_COOKIE)
+        if not sid:
+            return None
+        now = self._clock()
+        with self._lock:
+            session = self._sessions.get(sid)
+            if session is not None:
+                session.last_seen = now
+        if session is None:
+            return None
+        if session.expires_at and now >= session.expires_at:
+            if not self._refresh(sid, session.access_token):
+                return None
+            with self._lock:
+                session = self._sessions.get(sid)
+            if session is None:
+                return None
+        try:
+            return self._validate_token(session.access_token)
+        except OidcFlowError:
+            # chain stopped accepting the token (e.g. key rotation, real-time
+            # expiry ahead of the manager clock): one refresh attempt, then
+            # the session dies and the browser re-logs-in.
+            if self._refresh(sid, session.access_token):
+                with self._lock:
+                    session = self._sessions.get(sid)
+                if session is not None:
+                    try:
+                        return self._validate_token(session.access_token)
+                    except OidcFlowError:
+                        pass
+            with self._lock:
+                self._sessions.pop(sid, None)
+            return None
+
+    def _refresh(self, sid: str, observed_access: str) -> bool:
+        """Refresh the session's tokens via the refresh_token grant.
+
+        Single-flight per session: the SPA fires concurrent API calls, and
+        two threads refreshing the SAME (possibly single-use) refresh token
+        would have the loser kill the session the winner just renewed.  The
+        per-sid lock serializes them; whoever arrives second sees the access
+        token already changed from `observed_access` and treats the refresh
+        as done."""
+        with self._lock:
+            if sid not in self._sessions:
+                return False
+            flight = self._refresh_locks.setdefault(sid, threading.Lock())
+        with flight:
+            with self._lock:
+                session = self._sessions.get(sid)
+                if session is None:
+                    return False
+                if session.access_token != observed_access:
+                    return True  # another thread already refreshed
+                refresh_token = session.refresh_token
+            if not refresh_token:
+                with self._lock:
+                    self._sessions.pop(sid, None)
+                return False
+            try:
+                tokens = self._token_request(
+                    {
+                        "grant_type": "refresh_token",
+                        "refresh_token": refresh_token,
+                        "client_id": self.config.client_id,
+                    }
+                )
+            except OidcFlowError:
+                tokens = {}
+            access = tokens.get("access_token", "")
+            now = self._clock()
+            with self._lock:
+                if not access:
+                    self._sessions.pop(sid, None)
+                    return False
+                expires_in = float(tokens.get("expires_in") or 0)
+                old = self._sessions.get(sid)
+                self._sessions[sid] = _Session(
+                    access_token=access,
+                    # IdPs may rotate the refresh token; keep the old one
+                    # otherwise
+                    refresh_token=tokens.get("refresh_token", refresh_token),
+                    id_token=tokens.get(
+                        "id_token", old.id_token if old else ""
+                    ),
+                    expires_at=(
+                        now + expires_in - _EXPIRY_SKEW_S if expires_in else 0
+                    ),
+                    last_seen=now,
+                )
+            return True
+
+    # ------------------------------------------------------------ logout ----
+
+    def logout(self, headers: Mapping[str, str]) -> tuple[str, str]:
+        """Drop the session.  Returns (redirect_url, clearing_cookie): the
+        redirect goes to the IdP's end_session endpoint when configured
+        (with id_token_hint) and to "/" otherwise."""
+        sid = _cookie_value(headers, SESSION_COOKIE)
+        with self._lock:
+            session = self._sessions.pop(sid, None) if sid else None
+            if sid:
+                self._refresh_locks.pop(sid, None)
+        target = "/"
+        if self.config.end_session_endpoint:
+            params = {}
+            if session is not None and session.id_token:
+                params["id_token_hint"] = session.id_token
+            target = self.config.end_session_endpoint + (
+                "?" + urllib.parse.urlencode(params) if params else ""
+            )
+        clearing = (
+            f"{SESSION_COOKIE}=; Path=/; Max-Age=0; HttpOnly; SameSite=Lax"
+        )
+        return target, clearing
+
+    @staticmethod
+    def _set_cookie(sid: str, secure: bool) -> str:
+        # Secure whenever the browser reached us over https (X-Forwarded-
+        # Proto rides into redirect_uri): an https-deployed session cookie
+        # must never ride a cleartext request.
+        flags = "; Secure" if secure else ""
+        return f"{SESSION_COOKIE}={sid}; Path=/; HttpOnly; SameSite=Lax{flags}"

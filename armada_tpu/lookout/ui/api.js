@@ -1,0 +1,31 @@
+// Fetch wrapper: the session cookie rides along automatically; a 401 from
+// an OIDC-enabled server means the session died (expired + refresh failed,
+// or logged out elsewhere) -- bounce through /login and come back to the
+// exact URL we were on (OidcAuthProvider signinRedirect(state: href) parity).
+export class AuthRequired extends Error {}
+
+function bounceToLogin() {
+  const next = location.pathname + location.search + location.hash;
+  location.assign("/login?next=" + encodeURIComponent(next));
+}
+
+export async function j(url, init) {
+  const r = await fetch(url, init);
+  if (r.status === 401) {
+    let d = {};
+    try { d = await r.json(); } catch (e) { /* non-JSON 401 */ }
+    if (d.login) { bounceToLogin(); throw new AuthRequired("redirecting to login"); }
+  }
+  return r.json();
+}
+
+// Raw variant for callers that need status + body (logs viewer).
+export async function raw(url, init) {
+  const r = await fetch(url, init);
+  if (r.status === 401) {
+    let d = {};
+    try { d = await r.clone().json(); } catch (e) { /* non-JSON 401 */ }
+    if (d.login) { bounceToLogin(); throw new AuthRequired("redirecting to login"); }
+  }
+  return r;
+}

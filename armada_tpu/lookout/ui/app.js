@@ -1,0 +1,188 @@
+// Lookout SPA entry point: jobs table, grouping with per-state meters,
+// drilldown (queue -> jobsets -> jobs -> details -> logs), URL-state
+// routing, saved views, identity chip.  Capability map of the reference's
+// React lookout UI (internal/lookoutui/src/App.tsx) over the same JSON API.
+import { $, esc, fmtT, dark, meterHTML, chipsHTML, stateCell } from "./util.js";
+import { j, AuthRequired } from "./api.js";
+import { renderWhoami } from "./auth.js";
+import { applyHash, syncHash } from "./router.js";
+import { loadViews, wireViews } from "./views.js";
+import { openDetails } from "./details.js";
+
+const state = {
+  skip: 0, take: 50, orderField: "submitted", orderDir: "DESC",
+  // drilldown trail: [{field, value, group}] -- group is the grouping that
+  // was active when the crumb was pushed, restored when the crumb is popped
+  drill: [],
+};
+let contentSeq = 0, overviewSeq = 0;  // drop stale responses
+
+function filterQS() {
+  const p = new URLSearchParams();
+  if ($("f-queue").value) p.set("queue", $("f-queue").value);
+  if ($("f-jobset").value) p.set("jobset", $("f-jobset").value);
+  if ($("f-state").value) p.set("state", $("f-state").value);
+  const ann = $("f-ann").value.trim();
+  if (ann && ann.includes("=")) {
+    const i = ann.indexOf("=");
+    p.set("ann." + ann.slice(0, i).trim(), ann.slice(i + 1).trim() || "*");
+  }
+  return p;
+}
+
+async function loadOverview() {
+  const my = ++overviewSeq;
+  const d = await j("/api/overview");
+  if (my !== overviewSeq) return;  // a newer request superseded this one
+  const total = Object.values(d.states).reduce((a, b) => a + b, 0);
+  $("overview").innerHTML = meterHTML(d.states, total);
+  $("chips").innerHTML = chipsHTML(d.states);
+  $("total").textContent = total + " jobs";
+}
+
+async function loadContent() {
+  const my = ++contentSeq;
+  const group = $("f-group").value;
+  if (group === "annotation" && !$("f-groupkey").value.trim()) {
+    $("content").innerHTML = '<div class="empty">enter an annotation key to group by</div>';
+    $("pager").innerHTML = "";
+    return;
+  }
+  if (group) {
+    const keyQ = group === "annotation"
+      ? `&key=${encodeURIComponent($("f-groupkey").value.trim())}` : "";
+    const d = await j(`/api/groups?by=${group}&take=500${keyQ}&` + filterQS());
+    if (my !== contentSeq) return;
+    $("pager").innerHTML = "";
+    if (!d.groups.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; return; }
+    const note = d.truncated
+      ? `<div class="empty">showing the ${d.groups.length} largest groups — refine the filters to see the rest</div>`
+      : "";
+    $("content").innerHTML = `<table><thead><tr><th>${esc(group)}</th>
+      <th class="num">jobs</th><th>states</th></tr></thead><tbody>` +
+      d.groups.map((g) => {
+        const total = g.count;
+        return `<tr data-group="${esc(g.group)}"><td>${esc(g.group)}</td>
+          <td class="num">${g.count}</td>
+          <td><div class="mini">${meterHTML(g.states, total)}</div></td></tr>`;
+      }).join("") + "</tbody></table>" + note;
+    for (const tr of $("content").querySelectorAll("tr[data-group]")) {
+      tr.onclick = () => {
+        const v = tr.dataset.group;
+        if (group === "state") { $("f-state").value = v; $("f-group").value = ""; }
+        else if (group === "annotation") {
+          $("f-ann").value = $("f-groupkey").value.trim() + "=" + v;
+          $("f-group").value = "";
+        } else if (group === "queue") {
+          // drill: queue -> its jobsets -> job list
+          state.drill.push({field: "f-queue", value: v, group});
+          $("f-queue").value = v;
+          $("f-group").value = "jobset";
+        } else {
+          state.drill.push({field: "f-jobset", value: v, group});
+          $("f-jobset").value = v;
+          $("f-group").value = "";
+        }
+        state.skip = 0;
+        refresh(true);  // drill steps push history: back button walks out
+      };
+    }
+    return;
+  }
+  const p = filterQS();
+  p.set("skip", state.skip); p.set("take", state.take);
+  p.set("order", state.orderField); p.set("dir", state.orderDir);
+  const d = await j("/api/jobs?" + p);
+  if (my !== contentSeq) return;
+  if (!d.jobs.length && d.total > 0 && state.skip > 0) {
+    // the filtered total shrank under our page cursor: snap back
+    state.skip = Math.max(0, (Math.ceil(d.total / state.take) - 1) * state.take);
+    return loadContent();
+  }
+  if (!d.jobs.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; $("pager").innerHTML = ""; return; }
+  const arrow = (f) => state.orderField === f ? (state.orderDir === "ASC" ? " ↑" : " ↓") : "";
+  $("content").innerHTML = `<table><thead><tr>
+      <th data-o="job_id">job${arrow("job_id")}</th>
+      <th data-o="queue">queue${arrow("queue")}</th>
+      <th data-o="jobset">jobset${arrow("jobset")}</th>
+      <th data-o="state">state${arrow("state")}</th>
+      <th class="num" data-o="priority">priority${arrow("priority")}</th>
+      <th data-o="submitted">submitted${arrow("submitted")}</th>
+      <th>node</th></tr></thead><tbody>` +
+    d.jobs.map((r) => `<tr data-id="${esc(r.job_id)}">
+      <td>${esc(r.job_id)}</td><td>${esc(r.queue)}</td><td>${esc(r.jobset)}</td>
+      <td>${stateCell(r.state)}</td><td class="num">${r.priority}</td>
+      <td>${fmtT(r.submitted_ns)}</td><td>${esc(r.node || "—")}</td></tr>`).join("") +
+    "</tbody></table>";
+  for (const th of $("content").querySelectorAll("th[data-o]")) {
+    th.onclick = () => {
+      if (state.orderField === th.dataset.o) state.orderDir = state.orderDir === "ASC" ? "DESC" : "ASC";
+      else { state.orderField = th.dataset.o; state.orderDir = "ASC"; }
+      refresh();
+    };
+  }
+  for (const tr of $("content").querySelectorAll("tr[data-id]"))
+    tr.onclick = () => openDetails(tr.dataset.id);
+  const page = Math.floor(state.skip / state.take) + 1;
+  const pages = Math.max(1, Math.ceil(d.total / state.take));
+  $("pager").innerHTML = `<button id="prev" ${state.skip ? "" : "disabled"}>‹ prev</button>
+    <span>page ${page} / ${pages} (${d.total} jobs)</span>
+    <button id="next" ${state.skip + state.take < d.total ? "" : "disabled"}>next ›</button>`;
+  if ($("prev")) $("prev").onclick = () => { state.skip = Math.max(0, state.skip - state.take); refresh(); };
+  if ($("next")) $("next").onclick = () => { state.skip += state.take; refresh(); };
+}
+
+function renderCrumbs() {
+  $("crumbs").innerHTML = state.drill.map((c, i) =>
+    `<span class="crumb" data-i="${i}" title="back to this level">` +
+    `${esc(c.field === "f-queue" ? "queue" : "jobset")}: ${esc(c.value)} ✕</span>`
+  ).join("");
+  for (const el of $("crumbs").querySelectorAll(".crumb")) {
+    el.onclick = () => {
+      const i = +el.dataset.i;
+      // pop this crumb and everything after it; restore its grouping level
+      const popped = state.drill[i];
+      for (const c of state.drill.slice(i)) $(c.field).value = "";
+      state.drill = state.drill.slice(0, i);
+      $("f-group").value = popped.group;
+      state.skip = 0;
+      refresh(true);
+    };
+  }
+}
+
+function refresh(push) {
+  syncHash(state, !!push);
+  renderCrumbs();
+  loadOverview().catch(swallowAuthRedirect);
+  loadContent().catch(swallowAuthRedirect);
+}
+function swallowAuthRedirect(e) {
+  if (!(e instanceof AuthRequired)) throw e;
+}
+
+$("refresh").onclick = () => refresh();
+for (const id of ["f-queue", "f-jobset", "f-state", "f-group", "f-ann", "f-groupkey"])
+  $(id).addEventListener("change", () => {
+    state.skip = 0;
+    // manual edits invalidate any drilldown crumb they contradict
+    state.drill = state.drill.filter((c) => $(c.field).value === c.value);
+    refresh();
+  });
+$("f-group").addEventListener("change", () => {
+  $("f-groupkey").style.display =
+    $("f-group").value === "annotation" ? "" : "none";
+});
+$("theme").onclick = () => {
+  const r = document.documentElement;
+  r.dataset.theme = dark() ? "light" : "dark";
+  refresh();
+};
+addEventListener("popstate", () => { applyHash(state); refresh(); });
+setInterval(() => { if ($("auto").checked && !$("details").classList.contains("open")) refresh(); }, 3000);
+
+wireViews(state, refresh);
+loadViews();
+renderWhoami();
+applyHash(state);
+refresh();

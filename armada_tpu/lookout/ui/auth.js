@@ -1,0 +1,17 @@
+// Header identity chip: who the server authn chain says we are, with a
+// logout link when the session came from the OIDC login flow
+// (NavBar.tsx + useUsername hook parity).
+import { $, esc } from "./util.js";
+import { j } from "./api.js";
+
+export async function renderWhoami() {
+  try {
+    const me = await j("/api/me");
+    if (!me || !me.name) { $("whoami").innerHTML = ""; return; }
+    const logout = me.session
+      ? ' · <a href="/logout" title="end the session">logout</a>' : "";
+    $("whoami").innerHTML = `<b>${esc(me.name)}</b>${logout}`;
+  } catch (e) {
+    $("whoami").innerHTML = "";
+  }
+}

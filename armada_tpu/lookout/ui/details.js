@@ -1,0 +1,36 @@
+// Job details side panel: spec fields, runs, errors, per-run log boxes.
+import { $, esc, fmtT, stateCell } from "./util.js";
+import { j } from "./api.js";
+import { openLogs, stopAllLogTimers } from "./logs.js";
+
+export async function openDetails(id) {
+  const d = await j("/api/job/" + encodeURIComponent(id));
+  if (!d) return;
+  const live = new Set(["LEASED", "PENDING", "RUNNING"]);
+  const runs = (d.runs || []).map((r) => `<div class="run">
+    <div><b>run</b> ${esc(r.run_id)} — ${stateCell(r.state)}
+      <button class="logbtn" data-run="${esc(r.run_id)}"
+        data-live="${live.has(r.state) ? 1 : ""}">logs${live.has(r.state) ? " (live)" : ""}</button></div>
+    <dl><dt>node</dt><dd>${esc(r.node || "—")}</dd>
+    <dt>leased</dt><dd>${fmtT(r.leased_ns)}</dd>
+    <dt>started</dt><dd>${fmtT(r.started_ns)}</dd>
+    <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd></dl>
+    ${r.error ? `<pre>${esc(r.error)}</pre>` : ""}
+    <div class="logbox" id="log-${esc(r.run_id)}"></div></div>`).join("");
+  $("details").innerHTML = `<h2>${esc(d.job_id)}</h2>
+    <dl><dt>state</dt><dd>${stateCell(d.state)}</dd>
+    <dt>queue</dt><dd>${esc(d.queue)}</dd>
+    <dt>jobset</dt><dd>${esc(d.jobset)}</dd>
+    <dt>priority</dt><dd>${d.priority}</dd>
+    <dt>submitted</dt><dd>${fmtT(d.submitted_ns)}</dd>
+    <dt>annotations</dt><dd><pre>${esc(JSON.stringify(d.annotations || {}, null, 1))}</pre></dd></dl>
+    <h2>runs</h2>${runs || '<div class="empty">no runs</div>'}
+    <button id="close-details">close</button>`;
+  for (const b of $("details").querySelectorAll(".logbtn"))
+    b.onclick = () => openLogs(d.job_id, b.dataset.run, !!b.dataset.live);
+  $("close-details").onclick = () => {
+    $("details").classList.remove("open");
+    stopAllLogTimers();
+  };
+  $("details").classList.add("open");
+}

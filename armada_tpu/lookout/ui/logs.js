@@ -1,0 +1,38 @@
+// Live pod-log viewer: fetch + 3s tail-follow per run box (binoculars
+// logs.go behind /api/logs).
+import { $ } from "./util.js";
+import { raw } from "./api.js";
+
+const logTimers = new Map();  // run id -> live-tail interval (one per box)
+
+export function stopLogTimer(runId) {
+  if (logTimers.has(runId)) { clearInterval(logTimers.get(runId)); logTimers.delete(runId); }
+}
+export function stopAllLogTimers() { for (const id of [...logTimers.keys()]) stopLogTimer(id); }
+
+async function fetchLogs(jobId, runId, boxId) {
+  const box = $(boxId);
+  if (!box) { stopLogTimer(runId); return; }
+  const r = await raw(`/api/logs?job=${encodeURIComponent(jobId)}&run=${encodeURIComponent(runId)}`);
+  const d = await r.json();
+  const pre = box.querySelector("pre");
+  if (!pre) return;
+  const atEnd = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 4;
+  pre.textContent = r.ok ? (d.log || "(empty)") : `⚠ ${d.error}`;
+  if (atEnd) pre.scrollTop = pre.scrollHeight;  // follow the tail
+}
+
+export function openLogs(jobId, runId, live) {
+  const boxId = "log-" + runId;
+  const box = $(boxId);
+  if (!box) return;
+  if (box.innerHTML) {  // toggle off
+    box.innerHTML = "";
+    stopLogTimer(runId);
+    return;
+  }
+  box.innerHTML = "<pre>loading…</pre>";
+  fetchLogs(jobId, runId, boxId);
+  stopLogTimer(runId);
+  if (live) logTimers.set(runId, setInterval(() => fetchLogs(jobId, runId, boxId), 3000));
+}

@@ -1,0 +1,41 @@
+// URL-state routing: filters, grouping, sort, page and the drilldown trail
+// serialize into location.hash, so views are linkable and the back button
+// walks the drilldown (the reference SPA keeps this state in the React
+// Router location; a hand-rolled hash is the same capability).
+import { $ } from "./util.js";
+
+const FILTER_IDS = ["f-queue", "f-jobset", "f-state", "f-ann", "f-group", "f-groupkey"];
+
+export function encodeState(s) {
+  const p = new URLSearchParams();
+  for (const id of FILTER_IDS) { if ($(id).value) p.set(id, $(id).value); }
+  if (s.skip) p.set("skip", s.skip);
+  if (s.orderField !== "submitted") p.set("order", s.orderField);
+  if (s.orderDir !== "DESC") p.set("dir", s.orderDir);
+  if (s.drill.length) p.set("drill", JSON.stringify(s.drill));
+  const h = p.toString();
+  return h ? "#" + h : "";
+}
+
+export function applyHash(s) {
+  // Restore UI state from location.hash; returns true when the hash carried
+  // any state (caller refreshes).
+  const h = location.hash.replace(/^#/, "");
+  const p = new URLSearchParams(h);
+  for (const id of FILTER_IDS) { $(id).value = p.get(id) || ""; }
+  $("f-groupkey").style.display =
+    $("f-group").value === "annotation" ? "" : "none";
+  s.skip = +(p.get("skip") || 0);
+  s.orderField = p.get("order") || "submitted";
+  s.orderDir = p.get("dir") || "DESC";
+  try { s.drill = JSON.parse(p.get("drill") || "[]"); }
+  catch (e) { s.drill = []; }
+  return h.length > 0;
+}
+
+export function syncHash(s, push) {
+  const h = encodeState(s);
+  if (h === location.hash || (!h && !location.hash)) return;
+  if (push) history.pushState(null, "", h || location.pathname);
+  else history.replaceState(null, "", h || location.pathname);
+}

@@ -1,0 +1,30 @@
+// Shared helpers + boot config (colors/state order injected by the server).
+export const BOOT = JSON.parse(document.getElementById("boot").textContent);
+export const COLORS = BOOT.colors;
+export const ORDER = BOOT.order;
+
+export const $ = (id) => document.getElementById(id);
+export const fmtT = (ns) => ns ? new Date(ns / 1e6).toLocaleString() : "—";
+export const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+
+export const dark = () => document.documentElement.dataset.theme === "dark" ||
+  (!document.documentElement.dataset.theme &&
+   matchMedia("(prefers-color-scheme: dark)").matches);
+export const color = (s) => COLORS[dark() ? "dark" : "light"][s] || "#999";
+
+export function meterHTML(states, total) {
+  if (!total) return "";
+  return ORDER.filter((s) => states[s])
+    .map((s) => `<span style="flex:${states[s]};background:${color(s)}"
+      title="${s}: ${states[s]}"></span>`).join("");
+}
+export function chipsHTML(states) {
+  return ORDER.filter((s) => states[s]).map((s) =>
+    `<span class="chip"><span class="dot" style="background:${color(s)}"></span>` +
+    `${s.toLowerCase()} <b>${states[s]}</b></span>`).join("") ||
+    '<span class="chip">no jobs yet</span>';
+}
+export function stateCell(s) {
+  return `<span class="dot" style="background:${color(s)}"></span>${s.toLowerCase()}`;
+}

@@ -1,0 +1,56 @@
+// Server-side saved views (lookout DB saved_view table -- the reference
+// UI's server-backed job-table views).
+import { $, esc } from "./util.js";
+import { j } from "./api.js";
+
+let serverViews = {};
+
+export async function loadViews() {
+  try {
+    const d = await j("/api/views");
+    serverViews = Object.fromEntries(
+      d.views.map((v) => [v.name, JSON.parse(v.payload)]));
+  } catch (e) { serverViews = {}; }
+  renderViews();
+}
+
+function renderViews() {
+  const sel = $("views").value;
+  $("views").innerHTML = '<option value="">saved views…</option>' +
+    Object.keys(serverViews).sort().map((n) =>
+      `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+  if (serverViews[sel] !== undefined) $("views").value = sel;
+}
+
+export function wireViews(state, refresh) {
+  $("save-view").onclick = async () => {
+    const name = prompt("view name:");
+    if (!name) return;
+    const payload = Object.fromEntries(
+      ["f-queue", "f-jobset", "f-state", "f-ann", "f-group", "f-groupkey"]
+        .map((id) => [id, $(id).value]));
+    await fetch("/api/views", {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify({name, payload}),
+    });
+    await loadViews();
+    $("views").value = name;
+  };
+  $("del-view").onclick = async () => {
+    const name = $("views").value;
+    if (!name || !confirm(`delete view "${name}"?`)) return;
+    await fetch("/api/views/" + encodeURIComponent(name), {method: "DELETE"});
+    $("views").value = "";
+    await loadViews();
+  };
+  $("views").addEventListener("change", () => {
+    const v = serverViews[$("views").value];
+    if (!v) return;
+    for (const [id, val] of Object.entries(v)) { if ($(id)) $(id).value = val; }
+    $("f-groupkey").style.display =
+      $("f-group").value === "annotation" ? "" : "none";
+    state.drill = [];
+    state.skip = 0;
+    refresh();
+  });
+}

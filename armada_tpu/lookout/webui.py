@@ -1,22 +1,29 @@
 """Lookout web UI: a single-page jobs dashboard over the lookout query stack.
 
-Plays the role of the reference's lookout UI (internal/lookoutui, React/TS ~18k
-LoC): a jobs table with filtering, grouping with per-state counts, job details
-with runs and errors -- served as one embedded HTML page + JSON endpoints on a
+Plays the role of the reference's lookout UI (internal/lookoutui, React/TS
+~18k LoC): a jobs table with filtering, grouping with per-state counts, job
+details with runs and errors, drilldown navigation, a live log viewer,
+server-side saved views, URL-state routing, and an OIDC login flow -- a
+hand-rolled module SPA (armada_tpu/lookout/ui/*.js) + JSON endpoints on a
 stdlib HTTP server, backed by LookoutQueries (repository/getjobs.go,
 groupjobs.go semantics).
 
 Endpoints:
-  GET /                  the app
+  GET /                  the app shell (ui/index.html + boot config)
+  GET /static/*          the SPA's modules and stylesheet
   GET /api/jobs?...      filtered page of jobs + total count
   GET /api/groups?by=X   grouped counts with per-state breakdown
   GET /api/job/{id}      job details incl. runs
   GET /api/overview      global state counts
+  GET /api/me            the authenticated principal (identity chip)
   GET /api/logs?job=&run=   pod logs via binoculars (logs.go:39-43); 501
                             when the UI has no binoculars wired
   GET/POST /api/views    server-side saved views (lookout DB saved_view
                             table; the reference UI's server-backed views)
   DELETE /api/views/{name}
+  GET /login /oauth/callback /logout   the OIDC authorization-code flow
+      (lookout/oidc.py; the browser analog of
+      internal/lookoutui/src/oidcAuth/OidcAuthProvider.tsx)
 
 Drilldown: grouping by queue and clicking a row descends to jobsets within
 that queue; clicking a jobset lands on its job list; a job opens details
@@ -35,10 +42,16 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 from typing import Callable, Optional
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs, quote, unquote, urlparse
 
 from armada_tpu.lookout.db import JOB_STATES
+from armada_tpu.lookout.oidc import (
+    OidcFlowError,
+    OidcSessionManager,
+    OidcWebConfig,
+)
 from armada_tpu.lookout.queries import JobFilter, JobOrder, LookoutQueries
 
 # Fixed state -> hue assignment in the theme's validated adjacency order
@@ -58,439 +71,40 @@ STATE_COLORS_DARK = {
     "CANCELLED": "#9085e9", "FAILED": "#e66767",
 }
 
-_PAGE = """<!doctype html>
-<html><head><meta charset="utf-8"><title>armada-tpu lookout</title>
-<style>
-:root {
-  color-scheme: light;
-  --surface: #fcfcfb; --surface-2: #f0efec; --border: #dcdbd6;
-  --text: #0b0b0b; --text-2: #52514e;
-__LIGHT_VARS__
+_UI_DIR = Path(__file__).parent / "ui"
+_CONTENT_TYPES = {
+    ".js": "text/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".html": "text/html; charset=utf-8",
 }
-@media (prefers-color-scheme: dark) {
-  :root:not([data-theme="light"]) {
-    color-scheme: dark;
-    --surface: #1a1a19; --surface-2: #262624; --border: #3a3a37;
-    --text: #ffffff; --text-2: #c3c2b7;
-__DARK_VARS__
-  }
-}
-:root[data-theme="dark"] {
-  color-scheme: dark;
-  --surface: #1a1a19; --surface-2: #262624; --border: #3a3a37;
-  --text: #ffffff; --text-2: #c3c2b7;
-__DARK_VARS__
-}
-* { box-sizing: border-box; }
-body { margin: 0; background: var(--surface); color: var(--text);
-       font: 13px/1.45 system-ui, sans-serif; }
-header { display: flex; align-items: center; gap: 12px; padding: 10px 16px;
-         border-bottom: 1px solid var(--border); }
-header h1 { font-size: 15px; margin: 0; font-weight: 600; }
-header .sub { color: var(--text-2); }
-main { padding: 12px 16px; max-width: 1280px; margin: 0 auto; }
-.filters { display: flex; flex-wrap: wrap; gap: 8px; margin-bottom: 12px; }
-.filters input, .filters select, .filters button, header button {
-  background: var(--surface); color: var(--text); border: 1px solid var(--border);
-  border-radius: 6px; padding: 5px 8px; font: inherit; }
-.filters button, header button { cursor: pointer; }
-.meter { display: flex; height: 14px; border-radius: 4px; overflow: hidden;
-         background: var(--surface-2); margin: 4px 0 6px; }
-.meter span { height: 100%; }
-.meter span + span { margin-left: 2px; }  /* 2px surface gap between fills */
-.chips { display: flex; flex-wrap: wrap; gap: 6px 14px; margin-bottom: 14px; }
-.chip { color: var(--text-2); white-space: nowrap; }
-.chip b { color: var(--text); font-weight: 600; }
-.dot { display: inline-block; width: 9px; height: 9px; border-radius: 50%;
-       margin-right: 5px; vertical-align: -1px; }
-table { border-collapse: collapse; width: 100%; }
-th, td { text-align: left; padding: 5px 10px; border-bottom: 1px solid var(--border); }
-th { color: var(--text-2); font-weight: 500; cursor: pointer; user-select: none;
-     white-space: nowrap; }
-tbody tr:hover { background: var(--surface-2); }
-tbody tr { cursor: pointer; }
-.num { text-align: right; font-variant-numeric: tabular-nums; }
-.mini { display: flex; height: 10px; border-radius: 3px; overflow: hidden;
-        background: var(--surface-2); min-width: 160px; }
-.mini span + span { margin-left: 2px; }
-#details { position: fixed; top: 0; right: 0; width: min(480px, 90vw);
-           height: 100vh; background: var(--surface); border-left: 1px solid var(--border);
-           padding: 16px; overflow: auto; display: none; box-shadow: -4px 0 24px #0003; }
-#details.open { display: block; }
-#details h2 { font-size: 14px; margin: 0 0 8px; word-break: break-all; }
-#details dl { display: grid; grid-template-columns: auto 1fr; gap: 2px 12px; }
-#details dt { color: var(--text-2); }
-#details pre { background: var(--surface-2); padding: 8px; border-radius: 6px;
-               white-space: pre-wrap; word-break: break-all; }
-.run { border: 1px solid var(--border); border-radius: 6px; padding: 8px;
-       margin: 6px 0; }
-.crumbs { display: flex; flex-wrap: wrap; gap: 6px; margin-bottom: 8px; }
-.crumbs:empty { display: none; }
-.crumb { background: var(--surface-2); border: 1px solid var(--border);
-         border-radius: 12px; padding: 2px 10px; cursor: pointer; }
-.crumb:hover { border-color: var(--text-2); }
-.logbox { margin-top: 6px; }
-.logbox pre { max-height: 320px; overflow: auto; }
-.logbtn { background: var(--surface); color: var(--text); cursor: pointer;
-          border: 1px solid var(--border); border-radius: 6px; padding: 3px 8px; }
-.pager { display: flex; gap: 8px; align-items: center; margin-top: 10px;
-         color: var(--text-2); }
-.pager button { background: var(--surface); color: var(--text);
-  border: 1px solid var(--border); border-radius: 6px; padding: 4px 10px; cursor: pointer; }
-.empty { color: var(--text-2); padding: 24px; text-align: center; }
-</style></head>
-<body>
-<header>
-  <h1>armada-tpu lookout</h1><span class="sub" id="total"></span>
-  <span style="flex:1"></span>
-  <button id="theme" title="toggle light/dark">◐</button>
-</header>
-<main>
-  <div class="meter" id="overview" role="img" aria-label="job state distribution"></div>
-  <div class="chips" id="chips"></div>
-  <div class="filters">
-    <input id="f-queue" placeholder="queue contains…">
-    <input id="f-jobset" placeholder="jobset contains…">
-    <select id="f-state"><option value="">any state</option>__STATE_OPTIONS__</select>
-    <input id="f-ann" placeholder="annotation key=value (or key=*)" title="filter by annotation; key=* matches any value">
-    <select id="f-group">
-      <option value="">no grouping</option>
-      <option value="queue">group by queue</option>
-      <option value="jobset">group by jobset</option>
-      <option value="state">group by state</option>
-      <option value="annotation">group by annotation…</option>
-    </select>
-    <input id="f-groupkey" placeholder="annotation key" style="display:none">
-    <button id="refresh">refresh</button>
-    <label class="chip"><input type="checkbox" id="auto" checked> auto (3s)</label>
-    <select id="views"><option value="">saved views…</option></select>
-    <button id="save-view" title="save the current filters as a named view (server-side)">save view</button>
-    <button id="del-view" title="delete the selected view">✕ view</button>
-  </div>
-  <div class="crumbs" id="crumbs"></div>
-  <div id="content"></div>
-  <div class="pager" id="pager"></div>
-</main>
-<div id="details"></div>
-<script>
-const COLORS = __COLORS_JSON__;
-const ORDER = __ORDER_JSON__;
-const dark = () => document.documentElement.dataset.theme === "dark" ||
-  (!document.documentElement.dataset.theme &&
-   matchMedia("(prefers-color-scheme: dark)").matches);
-const color = (s) => COLORS[dark() ? "dark" : "light"][s] || "#999";
-let skip = 0, take = 50, orderField = "submitted", orderDir = "DESC";
-let contentSeq = 0, overviewSeq = 0;  // drop stale responses
-// drilldown trail: [{field, value, group}] -- group is the grouping that was
-// active when the crumb was pushed, restored when the crumb is popped
-let drill = [];
-
-const $ = (id) => document.getElementById(id);
-const fmtT = (ns) => ns ? new Date(ns / 1e6).toLocaleString() : "—";
-const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
-  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
-
-function filterQS() {
-  const p = new URLSearchParams();
-  if ($("f-queue").value) p.set("queue", $("f-queue").value);
-  if ($("f-jobset").value) p.set("jobset", $("f-jobset").value);
-  if ($("f-state").value) p.set("state", $("f-state").value);
-  const ann = $("f-ann").value.trim();
-  if (ann && ann.includes("=")) {
-    const i = ann.indexOf("=");
-    p.set("ann." + ann.slice(0, i).trim(), ann.slice(i + 1).trim() || "*");
-  }
-  return p;
-}
-
-// --- saved views (server-side: lookout DB saved_view table) ---------------
-let serverViews = {};
-async function loadViews() {
-  try {
-    const d = await j("/api/views");
-    serverViews = Object.fromEntries(
-      d.views.map((v) => [v.name, JSON.parse(v.payload)]));
-  } catch (e) { serverViews = {}; }
-  renderViews();
-}
-function renderViews() {
-  const sel = $("views").value;
-  $("views").innerHTML = '<option value="">saved views…</option>' +
-    Object.keys(serverViews).sort().map((n) =>
-      `<option value="${esc(n)}">${esc(n)}</option>`).join("");
-  if (serverViews[sel] !== undefined) $("views").value = sel;
-}
-function applyView(v) {
-  for (const [id, val] of Object.entries(v)) { if ($(id)) $(id).value = val; }
-  $("f-groupkey").style.display =
-    $("f-group").value === "annotation" ? "" : "none";
-  drill = [];
-  refresh();
-}
-async function j(url) { const r = await fetch(url); return r.json(); }
-
-function meterHTML(states, total) {
-  if (!total) return "";
-  return ORDER.filter((s) => states[s])
-    .map((s) => `<span style="flex:${states[s]};background:${color(s)}"
-      title="${s}: ${states[s]}"></span>`).join("");
-}
-function chipsHTML(states) {
-  return ORDER.filter((s) => states[s]).map((s) =>
-    `<span class="chip"><span class="dot" style="background:${color(s)}"></span>` +
-    `${s.toLowerCase()} <b>${states[s]}</b></span>`).join("") ||
-    '<span class="chip">no jobs yet</span>';
-}
-async function loadOverview() {
-  const my = ++overviewSeq;
-  const d = await j("/api/overview");
-  if (my !== overviewSeq) return;  // a newer request superseded this one
-  const total = Object.values(d.states).reduce((a, b) => a + b, 0);
-  $("overview").innerHTML = meterHTML(d.states, total);
-  $("chips").innerHTML = chipsHTML(d.states);
-  $("total").textContent = total + " jobs";
-}
-function stateCell(s) {
-  return `<span class="dot" style="background:${color(s)}"></span>${s.toLowerCase()}`;
-}
-async function loadContent() {
-  const my = ++contentSeq;
-  const group = $("f-group").value;
-  if (group === "annotation" && !$("f-groupkey").value.trim()) {
-    $("content").innerHTML = '<div class="empty">enter an annotation key to group by</div>';
-    $("pager").innerHTML = "";
-    return;
-  }
-  if (group) {
-    const keyQ = group === "annotation"
-      ? `&key=${encodeURIComponent($("f-groupkey").value.trim())}` : "";
-    const d = await j(`/api/groups?by=${group}&take=500${keyQ}&` + filterQS());
-    if (my !== contentSeq) return;
-    $("pager").innerHTML = "";
-    if (!d.groups.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; return; }
-    const note = d.truncated
-      ? `<div class="empty">showing the ${d.groups.length} largest groups — refine the filters to see the rest</div>`
-      : "";
-    $("content").innerHTML = `<table><thead><tr><th>${esc(group)}</th>
-      <th class="num">jobs</th><th>states</th></tr></thead><tbody>` +
-      d.groups.map((g) => {
-        const total = g.count;
-        return `<tr data-group="${esc(g.group)}"><td>${esc(g.group)}</td>
-          <td class="num">${g.count}</td>
-          <td><div class="mini">${meterHTML(g.states, total)}</div></td></tr>`;
-      }).join("") + "</tbody></table>" + note;
-    for (const tr of $("content").querySelectorAll("tr[data-group]")) {
-      tr.onclick = () => {
-        const v = tr.dataset.group;
-        if (group === "state") { $("f-state").value = v; $("f-group").value = ""; }
-        else if (group === "annotation") {
-          $("f-ann").value = $("f-groupkey").value.trim() + "=" + v;
-          $("f-group").value = "";
-        } else if (group === "queue") {
-          // drill: queue -> its jobsets -> job list
-          drill.push({field: "f-queue", value: v, group});
-          $("f-queue").value = v;
-          $("f-group").value = "jobset";
-        } else {
-          drill.push({field: "f-jobset", value: v, group});
-          $("f-jobset").value = v;
-          $("f-group").value = "";
-        }
-        skip = 0;
-        refresh();
-      };
-    }
-    return;
-  }
-  const p = filterQS();
-  p.set("skip", skip); p.set("take", take);
-  p.set("order", orderField); p.set("dir", orderDir);
-  const d = await j("/api/jobs?" + p);
-  if (my !== contentSeq) return;
-  if (!d.jobs.length && d.total > 0 && skip > 0) {
-    // the filtered total shrank under our page cursor: snap back
-    skip = Math.max(0, (Math.ceil(d.total / take) - 1) * take);
-    return loadContent();
-  }
-  if (!d.jobs.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; $("pager").innerHTML = ""; return; }
-  const arrow = (f) => orderField === f ? (orderDir === "ASC" ? " ↑" : " ↓") : "";
-  $("content").innerHTML = `<table><thead><tr>
-      <th data-o="job_id">job${arrow("job_id")}</th>
-      <th data-o="queue">queue${arrow("queue")}</th>
-      <th data-o="jobset">jobset${arrow("jobset")}</th>
-      <th data-o="state">state${arrow("state")}</th>
-      <th class="num" data-o="priority">priority${arrow("priority")}</th>
-      <th data-o="submitted">submitted${arrow("submitted")}</th>
-      <th>node</th></tr></thead><tbody>` +
-    d.jobs.map((r) => `<tr data-id="${esc(r.job_id)}">
-      <td>${esc(r.job_id)}</td><td>${esc(r.queue)}</td><td>${esc(r.jobset)}</td>
-      <td>${stateCell(r.state)}</td><td class="num">${r.priority}</td>
-      <td>${fmtT(r.submitted_ns)}</td><td>${esc(r.node || "—")}</td></tr>`).join("") +
-    "</tbody></table>";
-  for (const th of $("content").querySelectorAll("th[data-o]")) {
-    th.onclick = () => {
-      if (orderField === th.dataset.o) orderDir = orderDir === "ASC" ? "DESC" : "ASC";
-      else { orderField = th.dataset.o; orderDir = "ASC"; }
-      refresh();
-    };
-  }
-  for (const tr of $("content").querySelectorAll("tr[data-id]"))
-    tr.onclick = () => openDetails(tr.dataset.id);
-  const page = Math.floor(skip / take) + 1;
-  const pages = Math.max(1, Math.ceil(d.total / take));
-  $("pager").innerHTML = `<button id="prev" ${skip ? "" : "disabled"}>‹ prev</button>
-    <span>page ${page} / ${pages} (${d.total} jobs)</span>
-    <button id="next" ${skip + take < d.total ? "" : "disabled"}>next ›</button>`;
-  if ($("prev")) $("prev").onclick = () => { skip = Math.max(0, skip - take); refresh(); };
-  if ($("next")) $("next").onclick = () => { skip += take; refresh(); };
-}
-const logTimers = new Map();  // run id -> live-tail interval (one per box)
-function stopLogTimer(runId) {
-  if (logTimers.has(runId)) { clearInterval(logTimers.get(runId)); logTimers.delete(runId); }
-}
-function stopAllLogTimers() { for (const id of [...logTimers.keys()]) stopLogTimer(id); }
-async function fetchLogs(jobId, runId, boxId) {
-  const box = $(boxId);
-  if (!box) { stopLogTimer(runId); return; }
-  const r = await fetch(`/api/logs?job=${encodeURIComponent(jobId)}&run=${encodeURIComponent(runId)}`);
-  const d = await r.json();
-  const pre = box.querySelector("pre");
-  if (!pre) return;
-  const atEnd = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 4;
-  pre.textContent = r.ok ? (d.log || "(empty)") : `⚠ ${d.error}`;
-  if (atEnd) pre.scrollTop = pre.scrollHeight;  // follow the tail
-}
-function openLogs(jobId, runId, live) {
-  const boxId = "log-" + runId;
-  const box = $(boxId);
-  if (!box) return;
-  if (box.innerHTML) {  // toggle off
-    box.innerHTML = "";
-    stopLogTimer(runId);
-    return;
-  }
-  box.innerHTML = "<pre>loading…</pre>";
-  fetchLogs(jobId, runId, boxId);
-  stopLogTimer(runId);
-  if (live) logTimers.set(runId, setInterval(() => fetchLogs(jobId, runId, boxId), 3000));
-}
-async function openDetails(id) {
-  const d = await j("/api/job/" + encodeURIComponent(id));
-  if (!d) return;
-  const live = new Set(["LEASED", "PENDING", "RUNNING"]);
-  const runs = (d.runs || []).map((r) => `<div class="run">
-    <div><b>run</b> ${esc(r.run_id)} — ${stateCell(r.state)}
-      <button class="logbtn" data-run="${esc(r.run_id)}"
-        data-live="${live.has(r.state) ? 1 : ""}">logs${live.has(r.state) ? " (live)" : ""}</button></div>
-    <dl><dt>node</dt><dd>${esc(r.node || "—")}</dd>
-    <dt>leased</dt><dd>${fmtT(r.leased_ns)}</dd>
-    <dt>started</dt><dd>${fmtT(r.started_ns)}</dd>
-    <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd></dl>
-    ${r.error ? `<pre>${esc(r.error)}</pre>` : ""}
-    <div class="logbox" id="log-${esc(r.run_id)}"></div></div>`).join("");
-  $("details").innerHTML = `<h2>${esc(d.job_id)}</h2>
-    <dl><dt>state</dt><dd>${stateCell(d.state)}</dd>
-    <dt>queue</dt><dd>${esc(d.queue)}</dd>
-    <dt>jobset</dt><dd>${esc(d.jobset)}</dd>
-    <dt>priority</dt><dd>${d.priority}</dd>
-    <dt>submitted</dt><dd>${fmtT(d.submitted_ns)}</dd>
-    <dt>annotations</dt><dd><pre>${esc(JSON.stringify(d.annotations || {}, null, 1))}</pre></dd></dl>
-    <h2>runs</h2>${runs || '<div class="empty">no runs</div>'}
-    <button id="close-details">close</button>`;
-  for (const b of $("details").querySelectorAll(".logbtn"))
-    b.onclick = () => openLogs(d.job_id, b.dataset.run, !!b.dataset.live);
-  $("close-details").onclick = () => {
-    $("details").classList.remove("open");
-    stopAllLogTimers();
-  };
-  $("details").classList.add("open");
-}
-function renderCrumbs() {
-  $("crumbs").innerHTML = drill.map((c, i) =>
-    `<span class="crumb" data-i="${i}" title="back to this level">` +
-    `${esc(c.field === "f-queue" ? "queue" : "jobset")}: ${esc(c.value)} ✕</span>`
-  ).join("");
-  for (const el of $("crumbs").querySelectorAll(".crumb")) {
-    el.onclick = () => {
-      const i = +el.dataset.i;
-      // pop this crumb and everything after it; restore its grouping level
-      const popped = drill[i];
-      for (const c of drill.slice(i)) $(c.field).value = "";
-      drill = drill.slice(0, i);
-      $("f-group").value = popped.group;
-      skip = 0;
-      refresh();
-    };
-  }
-}
-function refresh() { renderCrumbs(); loadOverview(); loadContent(); }
-$("refresh").onclick = refresh;
-for (const id of ["f-queue", "f-jobset", "f-state", "f-group", "f-ann", "f-groupkey"])
-  $(id).addEventListener("change", () => {
-    skip = 0;
-    // manual edits invalidate any drilldown crumb they contradict
-    drill = drill.filter((c) => $(c.field).value === c.value);
-    refresh();
-  });
-$("f-group").addEventListener("change", () => {
-  $("f-groupkey").style.display =
-    $("f-group").value === "annotation" ? "" : "none";
-});
-$("save-view").onclick = async () => {
-  const name = prompt("view name:");
-  if (!name) return;
-  const payload = Object.fromEntries(
-    ["f-queue", "f-jobset", "f-state", "f-ann", "f-group", "f-groupkey"]
-      .map((id) => [id, $(id).value]));
-  await fetch("/api/views", {
-    method: "POST", headers: {"Content-Type": "application/json"},
-    body: JSON.stringify({name, payload}),
-  });
-  await loadViews();
-  $("views").value = name;
-};
-$("del-view").onclick = async () => {
-  const name = $("views").value;
-  if (!name || !confirm(`delete view "${name}"?`)) return;
-  await fetch("/api/views/" + encodeURIComponent(name), {method: "DELETE"});
-  $("views").value = "";
-  await loadViews();
-};
-$("views").addEventListener("change", () => {
-  const v = serverViews[$("views").value];
-  if (v) applyView(v);
-});
-loadViews();
-$("theme").onclick = () => {
-  const r = document.documentElement;
-  r.dataset.theme = dark() ? "light" : "dark";
-  refresh();
-};
-setInterval(() => { if ($("auto").checked && !$("details").classList.contains("open")) refresh(); }, 3000);
-refresh();
-</script>
-</body></html>
-"""
 
 
 def _render_page() -> str:
-    light_vars = "\n".join(
-        f"  --state-{s.lower()}: {c};" for s, c in STATE_COLORS_LIGHT.items()
-    )
-    dark_vars = "\n".join(
-        f"    --state-{s.lower()}: {c};" for s, c in STATE_COLORS_DARK.items()
-    )
     options = "".join(f'<option value="{s}">{s.lower()}</option>' for s in JOB_STATES)
-    return (
-        _PAGE.replace("__LIGHT_VARS__", light_vars)
-        .replace("__DARK_VARS__", dark_vars)
-        .replace("__STATE_OPTIONS__", options)
-        .replace(
-            "__COLORS_JSON__",
-            json.dumps({"light": STATE_COLORS_LIGHT, "dark": STATE_COLORS_DARK}),
-        )
-        .replace("__ORDER_JSON__", json.dumps(list(STATE_ORDER)))
+    boot = json.dumps(
+        {
+            "colors": {"light": STATE_COLORS_LIGHT, "dark": STATE_COLORS_DARK},
+            "order": list(STATE_ORDER),
+        }
     )
+    template = (_UI_DIR / "index.html").read_text()
+    return template.replace("__STATE_OPTIONS__", options).replace(
+        "__BOOT_JSON__", boot
+    )
+
+
+def _load_static() -> dict[str, tuple[bytes, str]]:
+    """The SPA's modules, read once at startup (they are package data; a
+    dev editing them restarts the process like any Python change)."""
+    out = {}
+    for path in _UI_DIR.iterdir():
+        if path.name == "index.html" or path.suffix not in _CONTENT_TYPES:
+            continue
+        out["/static/" + path.name] = (
+            path.read_bytes(),
+            _CONTENT_TYPES[path.suffix],
+        )
+    return out
 
 
 def _filters_from_query(qs: dict) -> list[JobFilter]:
@@ -525,46 +139,122 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
-    def _json(self, obj, status=200):
+    def _json(self, obj, status=200, extra_headers=()):
         body = json.dumps(obj).encode()
         self.send_response(status)
+        for k, v in extra_headers:
+            self.send_header(k, v)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
-    def _authed(self) -> bool:
+    def _redirect(self, location: str, set_cookie: Optional[str] = None):
+        self.send_response(302)
+        self.send_header("Location", location)
+        if set_cookie:
+            self.send_header("Set-Cookie", set_cookie)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _redirect_uri(self) -> str:
+        """The callback URL as the browser sees this server (reverse proxies
+        forward the original host/proto)."""
+        host = self.headers.get("X-Forwarded-Host") or self.headers.get(
+            "Host", "127.0.0.1"
+        )
+        proto = self.headers.get("X-Forwarded-Proto", "http")
+        return f"{proto}://{host}/oauth/callback"
+
+    def _authed(self) -> Optional["object"]:
         """Gate every request on the server's authenticator chain (the same
         server/authn.py chain the gRPC/REST transports use; None = open dev
-        default).  Browsers get a Basic challenge; scripts send a bearer.
-        A failed/absent credential answers 401 and writes the response."""
+        default).  Precedence: OIDC session cookie (validated through the
+        chain after transparent refresh), then plain header credentials
+        (bearer / basic).  Returns the principal (or an anonymous truthy
+        marker when no chain is configured); writes the 401/302 and returns
+        None on failure."""
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         if srv.authenticator is None:
-            return True
+            return object()  # open dev default
+        if srv.oidc is not None:
+            principal = srv.oidc.authenticate(self.headers)
+            if principal is not None:
+                self.session_principal = principal
+                return principal
         from armada_tpu.server.authn import authenticate_http_headers
 
         principal, reason = authenticate_http_headers(
             srv.authenticator, self.headers
         )
         if principal is not None:
+            return principal
+        path = urlparse(self.path).path
+        if (
+            srv.oidc is not None
+            and self.command == "GET"
+            and not path.startswith("/api/")
+        ):
+            # page navigation: bounce through the login flow and come back
+            self._redirect("/login?next=" + quote(self.path, safe=""))
+            return None
+        extra = []
+        body = {"error": f"unauthenticated: {reason}"}
+        if srv.oidc is not None:
+            body["login"] = "/login"  # the SPA's api.js follows this
+        else:
+            extra.append(
+                ("WWW-Authenticate", 'Basic realm="armada-tpu lookout"')
+            )
+        self._json(body, 401, extra_headers=extra)
+        return None
+
+    def _handle_oidc_routes(self, path: str, qs: dict) -> bool:
+        """Login-flow routes run BEFORE authentication (they exist to
+        establish it).  Returns True when the request was handled."""
+        srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
+        if path == "/login":
+            if srv.oidc is None:
+                self._json({"error": "no OIDC login flow configured"}, 404)
+                return True
+            nxt = qs.get("next", ["/"])[0]
+            self._redirect(srv.oidc.login_redirect(nxt, self._redirect_uri()))
             return True
-        body = json.dumps({"error": f"unauthenticated: {reason}"}).encode()
-        self.send_response(401)
-        self.send_header("WWW-Authenticate", 'Basic realm="armada-tpu lookout"')
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        if path == "/oauth/callback":
+            if srv.oidc is None:
+                self._json({"error": "no OIDC login flow configured"}, 404)
+                return True
+            params = {k: v[0] for k, v in qs.items()}
+            try:
+                nxt, cookie, _principal = srv.oidc.handle_callback(
+                    params, self._redirect_uri()
+                )
+            except OidcFlowError as e:
+                self._json({"error": str(e), "login": "/login"}, 401)
+                return True
+            self._redirect(nxt, set_cookie=cookie)
+            return True
+        if path == "/logout":
+            if srv.oidc is None:
+                self._json({"error": "no OIDC login flow configured"}, 404)
+                return True
+            target, clearing = srv.oidc.logout(self.headers)
+            self._redirect(target, set_cookie=clearing)
+            return True
         return False
 
     def do_GET(self):  # noqa: N802
-        if not self._authed():
-            return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
-        q = srv.queries
         parsed = urlparse(self.path)
         path = parsed.path
         qs = parse_qs(parsed.query)
+        self.session_principal = None
+        if self._handle_oidc_routes(path, qs):
+            return
+        principal = self._authed()
+        if principal is None:
+            return
+        q = srv.queries
         try:
             if path == "/":
                 body = srv.page.encode()
@@ -573,6 +263,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path in srv.static:
+                body, ctype = srv.static[path]
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/api/me":
+                name = getattr(principal, "name", None)
+                groups = list(getattr(principal, "groups", ()) or ())
+                self._json(
+                    {
+                        "name": name,
+                        "groups": groups,
+                        # logout link only makes sense for cookie sessions
+                        "session": self.session_principal is not None,
+                    }
+                )
             elif path == "/api/jobs":
                 filters = _filters_from_query(qs)
                 order = JobOrder(
@@ -639,7 +347,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": str(exc)}, 400)
 
     def do_POST(self):  # noqa: N802
-        if not self._authed():
+        self.session_principal = None
+        if self._authed() is None:
             return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         path = urlparse(self.path).path
@@ -657,7 +366,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": str(exc)}, 400)
 
     def do_DELETE(self):  # noqa: N802
-        if not self._authed():
+        self.session_principal = None
+        if self._authed() is None:
             return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         path = urlparse(self.path).path
@@ -676,7 +386,16 @@ class LookoutWebUI:
 
     `logs_of(job_id=..., run_id=...) -> str` supplies pod logs -- wire a
     BinocularsClient.logs (rpc/client.py) or an in-process
-    executor.binoculars.Binoculars.logs; None disables the log viewer."""
+    executor.binoculars.Binoculars.logs; None disables the log viewer.
+
+    `authenticator`: a server/authn.py chain gating the page AND the JSON
+    API; None keeps the dev default (the page trusts its bind address).
+
+    `oidc`: an OidcWebConfig (or a pre-built OidcSessionManager, for tests
+    that inject a clock) enabling the browser login flow -- /login bounces
+    to the IdP, /oauth/callback exchanges the code and mints an HttpOnly
+    session, and every session token re-validates through `authenticator`.
+    Without it, browsers fall back to a Basic challenge."""
 
     def __init__(
         self,
@@ -685,16 +404,21 @@ class LookoutWebUI:
         host: str = "127.0.0.1",
         logs_of: Optional[Callable] = None,
         authenticator=None,
+        oidc=None,
     ):
-        # authenticator: a server/authn.py chain gating the page AND the
-        # JSON API (401 + Basic challenge; bearer headers also work).  None
-        # keeps the dev default: the page trusts its bind address.  OIDC
-        # browser login remains future work -- with an OIDC-only chain, use
-        # a bearer-capable client.
         self.queries = queries
         self.logs_of = logs_of
         self.authenticator = authenticator
+        if oidc is not None and isinstance(oidc, OidcWebConfig):
+            if authenticator is None:
+                raise ValueError(
+                    "OIDC login needs an authenticator chain to validate "
+                    "tokens against (auth.oidc in the server config)"
+                )
+            oidc = OidcSessionManager(oidc, authenticator)
+        self.oidc: Optional[OidcSessionManager] = oidc
         self.page = _render_page()
+        self.static = _load_static()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]

@@ -704,6 +704,11 @@ class IncrementalBuilder:
             if ni is None or r.job.queue not in self.queue_by_name:
                 continue
             if self.market and r.job.gang_id:
+                # Stored spec carries the priority current at lease time;
+                # reprioritisation of a running member refreshes it because
+                # the feed re-leases on every job upsert (apply_job's
+                # leased/running branch) -- pinned by
+                # test_incremental.test_running_gang_spec_refreshes_on_reprioritise.
                 self.running_gang_specs[r.job.id] = r.job
             pc = self.config.priority_class(r.job.priority_class)
             if r.away:

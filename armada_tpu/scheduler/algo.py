@@ -639,7 +639,11 @@ class FairSchedulingAlgo:
             job = txn.get(jid)
             if job is not None:
                 preempted_specs.append(
-                    dataclasses.replace(job.spec, priority=job.priority)
+                    dataclasses.replace(
+                        job.spec,
+                        priority=job.priority,
+                        pools=job.pools or job.spec.pools,
+                    )
                 )
         stats.idealised_values = calculate_idealised_values_columnar(
             self.config,

@@ -44,6 +44,16 @@ Exactness against the kernel path is pinned by
 tests/test_market_columnar.py's randomized cross-checks (dozens of seeds
 incl. tight capacity, lookback truncation, split gangs, the per-(queue, pc)
 cap queue-kill, plus full-algo mode-equivalence runs).
+
+Known bound (ADVICE r3): the sweep accumulates per-(queue, pc) allocation in
+f64 while the kernel's gate accumulates q_alloc in f32.  The two paths agree
+while every (queue, pc, resource) allocation stays below ~2^24 resolution
+units (f32 integer-exact range); past that the kernel's f32 sum rounds and a
+cap trip sitting exactly on the boundary can flip between the paths.  The
+cap *threshold* itself is shared f32 (pc_queue_caps), so the divergence is
+metric-only and requires both >16M units on one (queue, pc, resource) AND a
+trip within one rounding ulp of the boundary -- accepted, not mirrored,
+because the f64 sweep is the more accurate of the two.
 """
 
 from __future__ import annotations

@@ -1,0 +1,227 @@
+"""A minimal OIDC identity provider for login-flow tests.
+
+Implements just enough of the spec for the lookout UI's authorization-code
++ PKCE flow (lookout/oidc.py) to run end-to-end against it:
+
+  GET  /.well-known/openid-configuration   discovery document
+  GET  /authorize      auto-approves (no login form): validates client_id +
+                       redirect_uri shape + PKCE challenge present, mints a
+                       single-use code bound to (challenge, redirect_uri),
+                       302s back with code + state
+  POST /token          authorization_code grant: verifies the code, the
+                       redirect_uri echo and the S256 code_verifier, then
+                       issues an HS256-signed JWT access token (+ id_token,
+                       refresh_token).  refresh_token grant: rotates the
+                       access token.  Counters record every grant so tests
+                       can assert refresh happened.
+  GET  /logout         end_session endpoint; records the hit.
+
+Tokens sign with HS256 over `secret`, so the server's chain validates them
+with an OidcAuthenticator key of "hs256:<secret>" -- the same trust setup a
+deployment gets from the IdP's JWKS.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets as pysecrets
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlencode, urlparse
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def make_jwt(claims: dict, secret: str, kid: str = "") -> str:
+    header = {"alg": "HS256", "typ": "JWT"}
+    if kid:
+        header["kid"] = kid
+    signed = (
+        _b64url(json.dumps(header).encode())
+        + "."
+        + _b64url(json.dumps(claims).encode())
+    )
+    sig = hmac.new(secret.encode(), signed.encode(), hashlib.sha256).digest()
+    return signed + "." + _b64url(sig)
+
+
+class MockIdp:
+    def __init__(
+        self,
+        *,
+        issuer_path: str = "",
+        secret: str = "idp-signing-secret",
+        audience: str = "lookout-ui",
+        subject: str = "alice",
+        groups: tuple = ("sre",),
+        access_ttl_s: float = 3600.0,
+        client_id: str = "lookout-ui",
+        client_secret: str = "",
+        expected_scope: str = "",
+    ):
+        self.secret = secret
+        self.audience = audience
+        self.subject = subject
+        self.groups = groups
+        self.access_ttl_s = access_ttl_s
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.expected_scope = expected_scope
+        self.codes: dict[str, dict] = {}  # code -> {challenge, redirect_uri}
+        self.refresh_tokens: set[str] = set()
+        self.code_grants = 0
+        self.refresh_grants = 0
+        self.logouts = 0
+        self.authorize_requests: list[dict] = []
+        idp = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                qs = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                if parsed.path == "/.well-known/openid-configuration":
+                    self._json(
+                        {
+                            "issuer": idp.issuer,
+                            "authorization_endpoint": idp.base + "/authorize",
+                            "token_endpoint": idp.base + "/token",
+                            "end_session_endpoint": idp.base + "/logout",
+                        }
+                    )
+                elif parsed.path == "/authorize":
+                    idp.authorize_requests.append(qs)
+                    if qs.get("client_id") != idp.client_id:
+                        self._json({"error": "unknown client"}, 400)
+                        return
+                    if qs.get("response_type") != "code":
+                        self._json({"error": "unsupported response_type"}, 400)
+                        return
+                    if qs.get("code_challenge_method") != "S256" or not qs.get(
+                        "code_challenge"
+                    ):
+                        self._json({"error": "PKCE required"}, 400)
+                        return
+                    if idp.expected_scope and qs.get("scope") != idp.expected_scope:
+                        self._json({"error": "bad scope"}, 400)
+                        return
+                    code = pysecrets.token_urlsafe(16)
+                    idp.codes[code] = {
+                        "challenge": qs["code_challenge"],
+                        "redirect_uri": qs.get("redirect_uri", ""),
+                    }
+                    sep = "&" if "?" in qs.get("redirect_uri", "") else "?"
+                    self.send_response(302)
+                    self.send_header(
+                        "Location",
+                        qs.get("redirect_uri", "")
+                        + sep
+                        + urlencode(
+                            {"code": code, "state": qs.get("state", "")}
+                        ),
+                    )
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif parsed.path == "/logout":
+                    idp.logouts += 1
+                    self._json({"ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                if urlparse(self.path).path != "/token":
+                    self._json({"error": "not found"}, 404)
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                form = {
+                    k: v[0]
+                    for k, v in parse_qs(
+                        self.rfile.read(length).decode()
+                    ).items()
+                }
+                if form.get("client_id") != idp.client_id:
+                    self._json({"error": "invalid_client"}, 401)
+                    return
+                if idp.client_secret and form.get("client_secret") != idp.client_secret:
+                    self._json({"error": "invalid_client"}, 401)
+                    return
+                grant = form.get("grant_type")
+                if grant == "authorization_code":
+                    entry = idp.codes.pop(form.get("code", ""), None)
+                    if entry is None:
+                        self._json({"error": "invalid_grant"}, 400)
+                        return
+                    if form.get("redirect_uri") != entry["redirect_uri"]:
+                        self._json({"error": "redirect_uri mismatch"}, 400)
+                        return
+                    verifier = form.get("code_verifier", "")
+                    expect = _b64url(
+                        hashlib.sha256(verifier.encode()).digest()
+                    )
+                    if expect != entry["challenge"]:
+                        self._json({"error": "PKCE verification failed"}, 400)
+                        return
+                    idp.code_grants += 1
+                    self._json(idp._token_response())
+                elif grant == "refresh_token":
+                    token = form.get("refresh_token")
+                    if token not in idp.refresh_tokens:
+                        self._json({"error": "invalid_grant"}, 400)
+                        return
+                    # single-use rotation (the strict IdP posture): clients
+                    # must store the rotated token from the response
+                    idp.refresh_tokens.discard(token)
+                    idp.refresh_grants += 1
+                    self._json(idp._token_response())
+                else:
+                    self._json({"error": "unsupported_grant_type"}, 400)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self.base = f"http://127.0.0.1:{self.port}"
+        self.issuer = self.base + issuer_path
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def _token_response(self) -> dict:
+        now = time.time()
+        claims = {
+            "iss": self.issuer,
+            "aud": self.audience,
+            "sub": self.subject,
+            "groups": list(self.groups),
+            "iat": now,
+            "exp": now + self.access_ttl_s,
+        }
+        refresh = pysecrets.token_urlsafe(16)
+        self.refresh_tokens.add(refresh)
+        return {
+            "access_token": make_jwt(claims, self.secret),
+            "id_token": make_jwt(dict(claims, nonce=""), self.secret),
+            "refresh_token": refresh,
+            "token_type": "Bearer",
+            "expires_in": self.access_ttl_s,
+        }
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()

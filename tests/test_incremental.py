@@ -638,3 +638,40 @@ def test_market_f32_colliding_prices_order_identically():
     _outcomes_equal(fresh, incr)
     # the f32 tie means (sub, id) interleave: earliest submits win the node
     assert sorted(fresh.scheduled) == ["c0", "c1", "c2"]
+
+
+def test_running_gang_spec_refreshes_on_reprioritise():
+    """ADVICE r3: running_gang_specs stores the spec captured at lease time;
+    a reprioritisation of a RUNNING market gang member must not leave the
+    columnar mega round reading a stale priority.  The feed's delta flow
+    already guarantees this (apply_job re-leases the run with the job's
+    CURRENT priority, and lease_many refreshes the stored spec) -- this test
+    pins that path so a future lease_many/apply_job refactor cannot lose it."""
+    from armada_tpu.jobdb.job import Job, JobRun
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+    jobdb = JobDb(MCFG)
+    feed = IncrementalProblemFeed(MCFG)
+    feed.attach(jobdb)
+    b = feed.builder_for("default")
+    b.set_queues([Queue("qa")])
+    b.set_nodes([_node("n0")])
+
+    spec = _job("jg", "qa", 2, prio=1, gang_id="gang0", gang_cardinality=1,
+                price_band="low")
+    with jobdb.write_txn() as txn:
+        txn.upsert(
+            Job(
+                spec=spec,
+                validated=True,
+                queued=False,
+                runs=(JobRun(id="r1", job_id="jg", created_ns=1, node_id="n0",
+                             pool="default"),),
+            )
+        )
+    assert b.running_gang_specs["jg"].priority == 1
+
+    with jobdb.write_txn() as txn:
+        txn.upsert(dataclasses.replace(txn.get("jg"), priority=7))
+    assert b.running_gang_specs["jg"].priority == 7
