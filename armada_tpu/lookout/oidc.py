@@ -119,8 +119,9 @@ def web_config_from_dict(d: Mapping) -> OidcWebConfig:
         tokenEndpoint: ...                   # discovery (zero-egress)
         endSessionEndpoint: ...
     """
-    get = lambda *names: next(  # noqa: E731  (case-tolerant key lookup)
-        (d[n] for n in names if n in d), ""
+    # case-tolerant key lookup; YAML blanks arrive as None, not ""
+    get = lambda *names: next(  # noqa: E731
+        (d[n] for n in names if d.get(n) is not None), ""
     )
     issuer = str(get("issuer"))
     client_id = str(get("clientId", "clientid", "client_id"))
@@ -227,10 +228,17 @@ class OidcSessionManager:
         )
         next_path = self._safe_next(next_path)
         with self._lock:
-            if len(self._pending) > 4096:  # bound memory under abandoned logins
+            if len(self._pending) >= 4096:
+                # bound memory under abandoned logins: TTL-prune, then
+                # hard-evict oldest (unauthenticated /login hits are free to
+                # an attacker, so the cap must hold within the TTL too)
                 self._pending = {
                     s: p for s, p in self._pending.items() if p[2] > now
                 }
+                while len(self._pending) >= 4096:
+                    self._pending.pop(
+                        min(self._pending, key=lambda s: self._pending[s][2])
+                    )
             self._pending[state] = (verifier, next_path, now + _PENDING_TTL_S)
         params = {
             "response_type": "code",

@@ -1,16 +1,24 @@
 // Header identity chip: who the server authn chain says we are, with a
-// logout link when the session came from the OIDC login flow
-// (NavBar.tsx + useUsername hook parity).
+// logout control when the session came from the OIDC login flow
+// (NavBar.tsx + useUsername hook parity).  Logout POSTs -- the server
+// rejects GET /logout so cross-site links can't force-kill the session.
 import { $, esc } from "./util.js";
-import { j } from "./api.js";
+import { j, raw } from "./api.js";
 
 export async function renderWhoami() {
   try {
     const me = await j("/api/me");
     if (!me || !me.name) { $("whoami").innerHTML = ""; return; }
     const logout = me.session
-      ? ' · <a href="/logout" title="end the session">logout</a>' : "";
+      ? ' · <a href="#" id="logout" title="end the session">logout</a>' : "";
     $("whoami").innerHTML = `<b>${esc(me.name)}</b>${logout}`;
+    const el = $("logout");
+    if (el) el.onclick = async (ev) => {
+      ev.preventDefault();
+      const r = await raw("/logout", { method: "POST" });
+      const d = await r.json();
+      location.assign(d.redirect || "/");
+    };
   } catch (e) {
     $("whoami").innerHTML = "";
   }

@@ -1,7 +1,7 @@
 // Server-side saved views (lookout DB saved_view table -- the reference
 // UI's server-backed job-table views).
 import { $, esc } from "./util.js";
-import { j } from "./api.js";
+import { j, raw } from "./api.js";
 
 let serverViews = {};
 
@@ -29,17 +29,22 @@ export function wireViews(state, refresh) {
     const payload = Object.fromEntries(
       ["f-queue", "f-jobset", "f-state", "f-ann", "f-group", "f-groupkey"]
         .map((id) => [id, $(id).value]));
-    await fetch("/api/views", {
+    // raw() (not bare fetch): a dead session bounces to /login instead of
+    // silently losing the save
+    const r = await raw("/api/views", {
       method: "POST", headers: {"Content-Type": "application/json"},
       body: JSON.stringify({name, payload}),
     });
+    if (!r.ok) { alert(`save failed: ${(await r.json()).error}`); return; }
     await loadViews();
     $("views").value = name;
   };
   $("del-view").onclick = async () => {
     const name = $("views").value;
     if (!name || !confirm(`delete view "${name}"?`)) return;
-    await fetch("/api/views/" + encodeURIComponent(name), {method: "DELETE"});
+    const r = await raw("/api/views/" + encodeURIComponent(name),
+                        {method: "DELETE"});
+    if (!r.ok) { alert(`delete failed: ${(await r.json()).error}`); return; }
     $("views").value = "";
     await loadViews();
   };

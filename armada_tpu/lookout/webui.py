@@ -213,14 +213,14 @@ class _Handler(BaseHTTPRequestHandler):
         """Login-flow routes run BEFORE authentication (they exist to
         establish it).  Returns True when the request was handled."""
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
-        if path == "/login":
+        if path == "/login" and self.command == "GET":
             if srv.oidc is None:
                 self._json({"error": "no OIDC login flow configured"}, 404)
                 return True
             nxt = qs.get("next", ["/"])[0]
             self._redirect(srv.oidc.login_redirect(nxt, self._redirect_uri()))
             return True
-        if path == "/oauth/callback":
+        if path == "/oauth/callback" and self.command == "GET":
             if srv.oidc is None:
                 self._json({"error": "no OIDC login flow configured"}, 404)
                 return True
@@ -235,11 +235,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._redirect(nxt, set_cookie=cookie)
             return True
         if path == "/logout":
+            # POST-only: the session cookie is SameSite=Lax, which rides
+            # top-level cross-site GET navigations -- a GET logout would let
+            # any page force-kill the victim's session (CSRF).  auth.js
+            # POSTs and follows the returned redirect.
             if srv.oidc is None:
                 self._json({"error": "no OIDC login flow configured"}, 404)
                 return True
+            if self.command != "POST":
+                self._json(
+                    {"error": "logout requires POST (CSRF protection)"}, 405
+                )
+                return True
             target, clearing = srv.oidc.logout(self.headers)
-            self._redirect(target, set_cookie=clearing)
+            self._json(
+                {"redirect": target},
+                extra_headers=[("Set-Cookie", clearing)],
+            )
             return True
         return False
 
@@ -348,10 +360,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         self.session_principal = None
+        parsed = urlparse(self.path)
+        if self._handle_oidc_routes(parsed.path, parse_qs(parsed.query)):
+            return
         if self._authed() is None:
             return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
-        path = urlparse(self.path).path
+        path = parsed.path
         try:
             if path == "/api/views":
                 length = int(self.headers.get("Content-Length", "0"))

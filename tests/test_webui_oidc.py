@@ -130,11 +130,19 @@ def test_full_login_flow_api_refresh_logout(flow):
     assert idp.refresh_grants == 1
     assert idp.code_grants == 1  # refreshed, not re-logged-in
 
-    # 5. logout drops the session and hits the IdP's end_session endpoint
-    st, h, _ = hop(base + "/logout", cookie=cookie)
-    assert st == 302
-    assert h["Location"].startswith(idp.base + "/logout")
-    assert "id_token_hint=" in h["Location"]
+    # 5. logout is POST-only (GET would be CSRF-able under SameSite=Lax):
+    # a cross-site GET cannot kill the session...
+    st, _, body = hop(base + "/logout", cookie=cookie)
+    assert st == 405
+    st, _, body = hop(base + "/api/me", cookie=cookie)
+    assert st == 200  # session survived the forged GET
+    # ...the SPA's POST drops the session and returns the IdP end_session
+    # redirect target (auth.js follows it)
+    st, h, body = hop(base + "/logout", cookie=cookie, method="POST")
+    assert st == 200
+    d = json.loads(body)
+    assert d["redirect"].startswith(idp.base + "/logout")
+    assert "id_token_hint=" in d["redirect"]
     assert "Max-Age=0" in h.get("Set-Cookie", "")
     # the old cookie is dead: API 401s, pages bounce to login again
     st, _, body = hop(base + "/api/me", cookie=cookie)
@@ -355,3 +363,29 @@ def test_concurrent_refresh_is_single_flight(flow):
         t.join()
     assert results and all(r == (200, "alice") for r in results), results
     assert idp.refresh_grants == 1  # one grant served every concurrent call
+
+
+def test_web_config_blank_yaml_values_fail_loudly():
+    """YAML blanks arrive as None: a blank clientId must raise, and a blank
+    clientSecret must stay empty (public client), not become 'None'."""
+    from armada_tpu.lookout.oidc import web_config_from_dict
+
+    with pytest.raises(ValueError):
+        web_config_from_dict(
+            {"clientId": None, "authorizationEndpoint": "http://a",
+             "tokenEndpoint": "http://t"}
+        )
+    cfg = web_config_from_dict(
+        {"clientId": "ui", "clientSecret": None,
+         "authorizationEndpoint": "http://a", "tokenEndpoint": "http://t"}
+    )
+    assert cfg.client_secret == ""
+
+
+def test_pending_login_store_is_bounded(flow):
+    """Unauthenticated /login hits are free to an attacker: the pending
+    store must hold its cap even inside the state TTL."""
+    idp, ui, _, manager = flow
+    for _ in range(4200):
+        manager.login_redirect("/", "http://x/oauth/callback")
+    assert len(manager._pending) <= 4096
