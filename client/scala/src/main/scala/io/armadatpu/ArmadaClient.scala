@@ -1,0 +1,245 @@
+/*
+ * Thin Scala client for the armada-tpu control plane.
+ *
+ * Mirrors the Python client's approach (armada_tpu/rpc/client.py): generic
+ * gRPC method descriptors over the protoc-java message classes -- no
+ * ScalaPB or grpc service codegen needed, only
+ * `tools/genclients.sh OUT java` for the messages (armada_tpu.api.Rpc /
+ * armada_tpu.events.Events), shared with client/java.
+ *
+ * Reference parity: client/scala/armada-scala-client
+ * (io.armadaproject.armada.ArmadaClient -- submit/cancel/reprioritize/
+ * queue CRUD/events over a plaintext-or-TLS channel with optional bearer
+ * metadata); this client speaks the armada-tpu Submit/Event services.
+ */
+package io.armadatpu
+
+import armada_tpu.api.Rpc
+import com.google.protobuf.Message
+import io.grpc.{CallOptions, Channel, ClientInterceptors, ManagedChannel, ManagedChannelBuilder, Metadata, MethodDescriptor}
+import io.grpc.protobuf.ProtoUtils
+import io.grpc.stub.{ClientCalls, MetadataUtils}
+
+import scala.jdk.CollectionConverters._
+
+final class ArmadaClient private (channel: ManagedChannel, stubChannel: Channel)
+    extends AutoCloseable {
+
+  private def unary[Req <: Message, Res <: Message](
+      fullName: String,
+      defReq: Req,
+      defRes: Res
+  ): MethodDescriptor[Req, Res] =
+    MethodDescriptor
+      .newBuilder[Req, Res]()
+      .setType(MethodDescriptor.MethodType.UNARY)
+      .setFullMethodName(fullName)
+      .setRequestMarshaller(ProtoUtils.marshaller(defReq))
+      .setResponseMarshaller(ProtoUtils.marshaller(defRes))
+      .build()
+
+  private def call[Req <: Message, Res <: Message](
+      fullName: String,
+      req: Req,
+      defRes: Res
+  ): Res = {
+    val md = unary(
+      fullName,
+      req.getDefaultInstanceForType.asInstanceOf[Req],
+      defRes
+    )
+    ClientCalls.blockingUnaryCall(stubChannel, md, CallOptions.DEFAULT, req)
+  }
+
+  // --- submit surface (armada_tpu.api.Submit) ------------------------------
+
+  def submitJobs(
+      queue: String,
+      jobset: String,
+      items: Seq[Rpc.SubmitItem]
+  ): Seq[String] =
+    call(
+      "armada_tpu.api.Submit/SubmitJobs",
+      Rpc.SubmitJobsRequest
+        .newBuilder()
+        .setQueue(queue)
+        .setJobset(jobset)
+        .addAllItems(items.asJava)
+        .build(),
+      Rpc.SubmitJobsResponse.getDefaultInstance
+    ).getJobIdsList.asScala.toSeq
+
+  def cancelJobs(
+      queue: String,
+      jobset: String,
+      jobIds: Seq[String],
+      reason: String = ""
+  ): Unit =
+    call(
+      "armada_tpu.api.Submit/CancelJobs",
+      Rpc.CancelJobsRequest
+        .newBuilder()
+        .setQueue(queue)
+        .setJobset(jobset)
+        .addAllJobIds(jobIds.asJava)
+        .setReason(reason)
+        .build(),
+      Rpc.Empty.getDefaultInstance
+    )
+
+  def cancelJobSet(queue: String, jobset: String): Unit =
+    call(
+      "armada_tpu.api.Submit/CancelJobSet",
+      Rpc.CancelJobSetRequest
+        .newBuilder()
+        .setQueue(queue)
+        .setJobset(jobset)
+        .build(),
+      Rpc.Empty.getDefaultInstance
+    )
+
+  def preemptJobs(
+      queue: String,
+      jobset: String,
+      jobIds: Seq[String],
+      reason: String = ""
+  ): Unit =
+    call(
+      "armada_tpu.api.Submit/PreemptJobs",
+      Rpc.PreemptJobsRequest
+        .newBuilder()
+        .setQueue(queue)
+        .setJobset(jobset)
+        .addAllJobIds(jobIds.asJava)
+        .setReason(reason)
+        .build(),
+      Rpc.Empty.getDefaultInstance
+    )
+
+  def reprioritizeJobs(
+      queue: String,
+      jobset: String,
+      priority: Long,
+      jobIds: Seq[String]
+  ): Unit =
+    call(
+      "armada_tpu.api.Submit/ReprioritizeJobs",
+      Rpc.ReprioritizeJobsRequest
+        .newBuilder()
+        .setQueue(queue)
+        .setJobset(jobset)
+        .setPriority(priority)
+        .addAllJobIds(jobIds.asJava)
+        .build(),
+      Rpc.Empty.getDefaultInstance
+    )
+
+  def createQueue(queue: Rpc.Queue): Unit =
+    call(
+      "armada_tpu.api.Submit/CreateQueue",
+      queue,
+      Rpc.Empty.getDefaultInstance
+    )
+
+  def listQueues(): Seq[Rpc.Queue] =
+    call(
+      "armada_tpu.api.Submit/ListQueues",
+      Rpc.Empty.getDefaultInstance,
+      Rpc.QueueListResponse.getDefaultInstance
+    ).getQueuesList.asScala.toSeq
+
+  // --- event surface (armada_tpu.api.Event) --------------------------------
+
+  /** Stream jobset events from `fromIdx`; `watch` keeps the stream open for
+    * new events (`idleTimeoutS` without progress ends it).  Each message's
+    * `idx` is the resume cursor to persist.
+    */
+  def watch(
+      queue: String,
+      jobset: String,
+      fromIdx: Long = 0,
+      watch: Boolean = false,
+      idleTimeoutS: Double = 0.0
+  ): Iterator[Rpc.JobSetEventMessage] = {
+    val md = MethodDescriptor
+      .newBuilder[Rpc.JobSetEventsRequest, Rpc.JobSetEventMessage]()
+      .setType(MethodDescriptor.MethodType.SERVER_STREAMING)
+      .setFullMethodName("armada_tpu.api.Event/GetJobSetEvents")
+      .setRequestMarshaller(
+        ProtoUtils.marshaller(Rpc.JobSetEventsRequest.getDefaultInstance)
+      )
+      .setResponseMarshaller(
+        ProtoUtils.marshaller(Rpc.JobSetEventMessage.getDefaultInstance)
+      )
+      .build()
+    val req = Rpc.JobSetEventsRequest
+      .newBuilder()
+      .setQueue(queue)
+      .setJobset(jobset)
+      .setFromIdx(fromIdx)
+      .setWatch(watch)
+      .setIdleTimeoutS(idleTimeoutS)
+      .build()
+    ClientCalls
+      .blockingServerStreamingCall(stubChannel, md, CallOptions.DEFAULT, req)
+      .asScala
+  }
+
+  override def close(): Unit = { channel.shutdown(); () }
+}
+
+object ArmadaClient {
+
+  /** Channel with the x-armada-principal trusted header (dev auth chains);
+    * use `withBearer` for OIDC/token-review chains.  `useTls` turns on
+    * transport security (the reference client's grpcs:// / useSsl mode) --
+    * required before sending credentials across untrusted networks.
+    */
+  def apply(
+      target: String,
+      principal: String = "anonymous",
+      useTls: Boolean = false
+  ): ArmadaClient = {
+    val md = new Metadata()
+    md.put(
+      Metadata.Key.of("x-armada-principal", Metadata.ASCII_STRING_MARSHALLER),
+      principal
+    )
+    build(target, md, useTls)
+  }
+
+  /** The same client with an Authorization: Bearer header (server authn).
+    * Defaults to TLS: bearer tokens must not ride cleartext channels
+    * (plaintext only for localhost development).
+    */
+  def withBearer(
+      target: String,
+      token: String,
+      useTls: Boolean = true
+  ): ArmadaClient = {
+    val md = new Metadata()
+    md.put(
+      Metadata.Key.of("authorization", Metadata.ASCII_STRING_MARSHALLER),
+      "Bearer " + token
+    )
+    build(target, md, useTls)
+  }
+
+  private def build(
+      target: String,
+      md: Metadata,
+      useTls: Boolean
+  ): ArmadaClient = {
+    val builder = ManagedChannelBuilder.forTarget(target)
+    val channel =
+      (if (useTls) builder.useTransportSecurity() else builder.usePlaintext())
+        .build()
+    new ArmadaClient(
+      channel,
+      ClientInterceptors.intercept(
+        channel,
+        MetadataUtils.newAttachHeadersInterceptor(md)
+      )
+    )
+  }
+}

@@ -99,3 +99,32 @@ def test_thin_clients_reference_only_generated_messages(generated):
         assert m in generated_cs, (
             f"ArmadaClient.cs references {m} which codegen does not emit"
         )
+
+
+def test_scala_client_references_only_generated_messages(generated):
+    """The Scala thin client compiles against the SAME protoc-java messages
+    as client/java (no ScalaPB): every Rpc.X it names must exist in the
+    generated Java surface, and its gRPC method names must match the
+    services the server actually registers (reference parity:
+    client/scala/armada-scala-client)."""
+    import re
+
+    rpc_src = (generated / "java" / "armada_tpu" / "api" / "Rpc.java").read_text()
+    scala = (
+        ROOT / "client/scala/src/main/scala/io/armadatpu/ArmadaClient.scala"
+    ).read_text()
+    refs = set(re.findall(r"Rpc\.(\w+)", scala))
+    for m in sorted(refs):
+        assert re.search(rf"class {m}\b", rpc_src), (
+            f"ArmadaClient.scala references Rpc.{m} which codegen does not emit"
+        )
+    # the verb surface matches the Java thin client (shared service set)
+    java = (
+        ROOT / "client/java/src/main/java/io/armadatpu/ArmadaClient.java"
+    ).read_text()
+    scala_methods = set(re.findall(r'"(armada_tpu\.api\.[\w./]+)"', scala))
+    java_methods = set(re.findall(r'"(armada_tpu\.api\.[\w./]+)"', java))
+    assert scala_methods, "Scala client names no gRPC methods"
+    assert scala_methods >= java_methods, (
+        f"Scala client missing verbs: {java_methods - scala_methods}"
+    )
