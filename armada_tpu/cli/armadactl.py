@@ -432,6 +432,7 @@ _SERVE_FALLBACKS = {
     "rest_port": None,
     "bind_host": "127.0.0.1",
     "leader_id": None,
+    "advertised_address": None,
 }
 
 
@@ -456,6 +457,14 @@ def load_serve_config(args):
         serve_doc = {k.lower(): v for k, v in loaded["serve"].items()}
     # lookoutOidc is a nested mapping, not a scalar flag: config-file only
     args.lookout_oidc = serve_doc.get("lookoutoidc")
+    # Follower-to-leader proxy credential (reports proxying under a strict
+    # authn chain).  Config-file only -- tokens do not belong on argv.
+    # proxyBearerTokenFile wins over an inline proxyBearerToken.
+    args.proxy_bearer_token = serve_doc.get("proxybearertoken")
+    token_file = serve_doc.get("proxybearertokenfile")
+    if token_file:
+        with open(token_file) as f:
+            args.proxy_bearer_token = f.read().strip()
     mapping = {
         "data_dir": ("datadir", str),
         "port": ("port", int),
@@ -468,6 +477,7 @@ def load_serve_config(args):
         "rest_port": ("restport", int),
         "bind_host": ("bindhost", str),
         "leader_id": ("leaderid", str),
+        "advertised_address": ("advertisedaddress", str),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -500,6 +510,8 @@ def cmd_serve(args):
         kube_lease_url=args.kube_lease_url,
         kube_lease_namespace=args.kube_lease_namespace,
         bind_host=args.bind_host,
+        advertised_address=args.advertised_address,
+        proxy_bearer_token=getattr(args, "proxy_bearer_token", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -700,6 +712,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="address every server binds (gRPC/REST/lookout/health); "
         "use 0.0.0.0 in containers so other hosts can reach the plane "
         "(default 127.0.0.1)",
+    )
+    srv.add_argument(
+        "--advertised-address",
+        help="host:port other replicas use to reach THIS replica (rides the "
+        "leader-election record so followers proxy reports to the leader); "
+        "default <bind-host-or-hostname>:<port>",
     )
     srv.set_defaults(fn=cmd_serve)
 

@@ -110,6 +110,8 @@ def start_control_plane(
     bind_host: str = "127.0.0.1",
     authenticator=None,
     lookout_oidc=None,
+    advertised_address: Optional[str] = None,
+    proxy_bearer_token: Optional[str] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -188,11 +190,33 @@ def start_control_plane(
             else StandaloneLeaderController()
         )
     from armada_tpu.scheduler.metrics import SchedulerMetrics
-    from armada_tpu.scheduler.reports import SchedulingReportsRepository
+    from armada_tpu.scheduler.reports import (
+        LeaderProxyingReports,
+        SchedulingReportsRepository,
+    )
 
     reports = SchedulingReportsRepository(
         max_job_reports=config.max_job_scheduling_contexts_per_executor
     )
+
+    # Queries go through the proxying wrapper: followers forward to the
+    # leader's advertised address from the election record
+    # (leader_proxying_reports_server.go) instead of answering NOT_FOUND
+    # from their empty local repository.  Recording stays on the plain
+    # repository (only the leader runs cycles).
+    def _reports_client(address: str):
+        from armada_tpu.rpc.client import ArmadaClient
+
+        # Follower-to-leader hop: the leader's chain sees this replica, not
+        # the original caller.  Dev chains ride the trusted header; strict
+        # deployments configure a service credential.
+        return ArmadaClient(
+            address,
+            principal=leader_id or "scheduler-follower",
+            bearer_token=proxy_bearer_token,
+        )
+
+    reports_query = LeaderProxyingReports(reports, leader, _reports_client)
     metrics = None
     metrics_server = None
     if metrics_port is not None:
@@ -239,10 +263,25 @@ def start_control_plane(
         executor_api=executor_api,
         factory=factory,
         lookout_queries=LookoutQueries(lookoutdb),
-        reports=reports,
+        reports=reports_query,
         address=f"{bind_host}:{port}",
         authenticator=authenticator,
     )
+
+    # Now the port is bound: advertise this replica's address through the
+    # election record so followers can proxy leader-local queries.
+    if hasattr(leader, "set_advertised_address"):
+        if advertised_address is None:
+            import socket as _socket
+
+            advertise_host = (
+                bind_host
+                if bind_host not in ("0.0.0.0", "::")
+                else _socket.gethostname()
+            )
+            advertised_address = f"{advertise_host}:{bound_port}"
+        leader.set_advertised_address(advertised_address)
+        reports_query.set_self_address(advertised_address)
 
     scheduler_pipeline.start()
     event_pipeline.start()

@@ -253,17 +253,33 @@ class _LookoutService:
 
 
 class _ReportsService:
-    """SchedulingReports (internal/scheduler/reports/server.go) as JSON."""
+    """SchedulingReports (internal/scheduler/reports/server.go) as JSON.
+
+    `reports` may be a plain SchedulingReportsRepository or the
+    LeaderProxyingReports wrapper (leader_proxying_reports_server.go):
+    followers then answer by forwarding to the leader, and a follower that
+    cannot reach the leader aborts UNAVAILABLE (retryable), never a
+    misleading NOT_FOUND."""
 
     def __init__(self, reports, auth):
         self._reports = reports
         self._auth = auth
 
+    def _guard(self, context, fn):
+        from armada_tpu.scheduler.reports import ReportsUnavailable
+
+        try:
+            return fn()
+        except ReportsUnavailable as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
     def GetJobReport(self, request, context):
         _authenticate(self._auth, context)
         import json
 
-        report = self._reports.job_report(request.name)
+        report = self._guard(
+            context, lambda: self._reports.job_report(request.name)
+        )
         if report is None:
             context.abort(
                 grpc.StatusCode.NOT_FOUND, f"no report for job {request.name!r}"
@@ -274,14 +290,25 @@ class _ReportsService:
         _authenticate(self._auth, context)
         import json
 
-        return pb.JsonResponse(json=json.dumps(self._reports.queue_report(request.name)))
+        return pb.JsonResponse(
+            json=json.dumps(
+                self._guard(
+                    context, lambda: self._reports.queue_report(request.name)
+                )
+            )
+        )
 
     def GetPoolReport(self, request, context):
         _authenticate(self._auth, context)
         import json
 
         return pb.JsonResponse(
-            json=json.dumps(self._reports.pool_report(request.name or None))
+            json=json.dumps(
+                self._guard(
+                    context,
+                    lambda: self._reports.pool_report(request.name or None),
+                )
+            )
         )
 
 

@@ -33,6 +33,7 @@ from typing import Callable, Optional
 from armada_tpu.scheduler.leader import LeaderToken
 
 _RFC3339 = "%Y-%m-%dT%H:%M:%S.%fZ"
+_ADDRESS_ANNOTATION = "armada-tpu.io/advertised-address"
 
 
 class KubeApiError(Exception):
@@ -56,9 +57,14 @@ class KubernetesLeaseLeaderController:
         insecure: bool = False,
         timeout_s: float = 10.0,
         clock: Callable[[], float] = time.time,
+        advertised_address: str = "",
     ):
         self._base = base_url.rstrip("/")
         self._holder = holder_id
+        # Rides a Lease annotation so followers can proxy leader-local
+        # queries (reports) -- the analog of the reference deriving the
+        # leader pod's DNS from holderIdentity (leader_client.go).
+        self._address = advertised_address
         self._path = (
             f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{lease_name}"
         )
@@ -88,6 +94,11 @@ class KubernetesLeaseLeaderController:
         # against the local clock, which flaps leadership under clock skew.
         self._observed: Optional[tuple] = None
         self._observed_at: float = 0.0
+        # Leader address as of the last lease read/write: leader_address()
+        # serves from this cache (query paths must not block on the
+        # apiserver, and an apiserver blip must not fail the LEADER's own
+        # local queries).  Refreshed every get_token (once per cycle).
+        self._last_seen_address: str = ""
 
     # ------------------------------------------------------------- http ----
 
@@ -140,6 +151,23 @@ class KubernetesLeaseLeaderController:
             return False
         return self._clock() >= self._observed_at + duration
 
+    def set_advertised_address(self, address: str) -> None:
+        self._address = address  # picked up by the next acquire/renew write
+
+    def leader_address(self) -> Optional[str]:
+        """Read-only peek from the election state the cycle loop already
+        maintains (NO apiserver round trip: report queries would otherwise
+        each pay a blocking GET, and an apiserver blip would fail even the
+        leader's own local queries).  None = we hold the lease, address =
+        another holder advertises one, "" = unknown/no address (see
+        leader.py LeaderController protocol).  Staleness is bounded by the
+        cycle interval (get_token refreshes every cycle)."""
+        if self._observed is None:
+            return ""  # no election state observed yet
+        if self._observed[0] == self._holder:
+            return None
+        return self._last_seen_address or ""
+
     def _spec(self, transitions: int) -> dict:
         return {
             "holderIdentity": self._holder,
@@ -163,10 +191,16 @@ class KubernetesLeaseLeaderController:
                     {
                         "apiVersion": "coordination.k8s.io/v1",
                         "kind": "Lease",
-                        "metadata": {"name": self._name},
+                        "metadata": {
+                            "name": self._name,
+                            "annotations": {
+                                _ADDRESS_ANNOTATION: self._address
+                            },
+                        },
                         "spec": self._spec(transitions=1),
                     },
                 )
+                self._note_acquired(created["spec"])
                 return LeaderToken(
                     leader=True,
                     generation=created["spec"].get("leaseTransitions", 1),
@@ -181,21 +215,42 @@ class KubernetesLeaseLeaderController:
         transitions = int(spec.get("leaseTransitions", 0))
         renew = spec.get("renewTime")
         duration = float(spec.get("leaseDurationSeconds", self._duration))
+        self._last_seen_address = (
+            lease.get("metadata", {})
+            .get("annotations", {})
+            .get(_ADDRESS_ANNOTATION, "")
+        )
         expired = renew is None or self._observe(holder, renew, transitions, duration)
         if holder == self._holder or expired:
             new_transitions = transitions if holder == self._holder else transitions + 1
             lease["spec"] = self._spec(new_transitions)
+            lease.setdefault("metadata", {}).setdefault("annotations", {})[
+                _ADDRESS_ANNOTATION
+            ] = self._address
             try:
                 updated = self._request("PUT", self._path, lease)
             except KubeApiError as e:
                 if e.status == 409:  # another replica won the takeover race
                     return LeaderToken(leader=False, generation=transitions)
                 return LeaderToken(leader=False, generation=transitions)
+            self._note_acquired(updated["spec"])
             return LeaderToken(
                 leader=True,
                 generation=int(updated["spec"].get("leaseTransitions", new_transitions)),
             )
         return LeaderToken(leader=False, generation=transitions)
+
+    def _note_acquired(self, spec: dict) -> None:
+        """After a successful acquire/renew WE are the observed holder:
+        leader_address() must answer None (serve locally) immediately, not
+        report the pre-takeover holder's address until the next cycle."""
+        self._observed = (
+            self._holder,
+            spec.get("renewTime"),
+            int(spec.get("leaseTransitions", 0)),
+        )
+        self._observed_at = self._clock()
+        self._last_seen_address = self._address
 
     def validate_token(self, token: LeaderToken) -> bool:
         if not token.leader:

@@ -36,6 +36,14 @@ class LeaderController(Protocol):
         """True iff `token` still confers leadership (fencing re-check before
         publishing, scheduler.go:263,355)."""
 
+    def leader_address(self) -> Optional[str]:
+        """READ-ONLY leadership peek for followers that proxy leader-local
+        queries (the reference's LeaderClientConnectionProvider,
+        leader/leader_client.go).  Must not acquire/renew (query paths call
+        this).  Returns None when this process holds the lease (serve
+        locally), the leader's advertised address when another holder does,
+        and "" when another holder is known but advertised no address."""
+
 
 class StandaloneLeaderController:
     """Always leader (leader.go StandaloneLeaderController:64)."""
@@ -45,6 +53,9 @@ class StandaloneLeaderController:
 
     def validate_token(self, token: LeaderToken) -> bool:
         return token.leader
+
+    def leader_address(self) -> Optional[str]:
+        return None  # we ARE the leader
 
 
 class FileLeaseLeaderController:
@@ -63,11 +74,25 @@ class FileLeaseLeaderController:
         holder_id: str,
         lease_duration_s: float = 15.0,
         clock: Callable[[], float] = time.time,
+        advertised_address: str = "",
     ):
         self._path = lease_path
         self._holder = holder_id
         self._duration = lease_duration_s
         self._clock = clock
+        # Rides in the lease record so followers can proxy leader-local
+        # queries (reports).  Often set post-construction once the gRPC
+        # port is bound (set_advertised_address).
+        self._address = advertised_address
+
+    def set_advertised_address(self, address: str) -> None:
+        self._address = address  # picked up by the next acquire/renew write
+
+    def leader_address(self) -> Optional[str]:
+        lease = self._locked(self._read)
+        if lease is None or lease.get("holder") == self._holder:
+            return None
+        return lease.get("address") or ""
 
     # --- lease file access (always under flock) -----------------------------
 
@@ -105,12 +130,14 @@ class FileLeaseLeaderController:
                         "holder": self._holder,
                         "generation": generation,
                         "expiry": now + self._duration,
+                        "address": self._address,
                     }
                 )
                 return LeaderToken(leader=True, generation=generation)
             if lease["holder"] == self._holder:
                 # renew
                 lease["expiry"] = now + self._duration
+                lease["address"] = self._address
                 self._write(lease)
                 return LeaderToken(leader=True, generation=lease["generation"])
             return LeaderToken(leader=False, generation=lease["generation"])

@@ -160,3 +160,47 @@ def test_scheduler_runs_on_kube_lease_controller(kube, tmp_path):
         assert res2.leader
     finally:
         w.close()
+
+
+def test_leader_address_rides_the_lease_annotation(kube):
+    """Followers discover the leader's advertised gRPC address from the
+    Lease annotation (reports proxying, leader_client.go analog) -- served
+    from the election state WITHOUT an apiserver round trip per query."""
+    clock = Clock()
+    a = KubernetesLeaseLeaderController(
+        kube.url, "replica-a", clock=clock, advertised_address="hostA:50051"
+    )
+    b = KubernetesLeaseLeaderController(
+        kube.url, "replica-b", clock=clock, advertised_address="hostB:50052"
+    )
+    assert b.leader_address() == ""  # no election state observed yet
+    assert a.get_token().leader
+    # the holder answers None IMMEDIATELY after acquiring (serve locally)
+    assert a.leader_address() is None
+    assert b.get_token().leader is False
+    assert b.leader_address() == "hostA:50051"
+    # cached peek: an apiserver outage must not flip answers mid-lease
+    kube.stop()
+    assert b.leader_address() == "hostA:50051"
+    assert a.leader_address() is None
+
+
+def test_leader_address_follows_takeover(kube):
+    clock = Clock()
+    a = KubernetesLeaseLeaderController(
+        kube.url, "replica-a", clock=clock, advertised_address="hostA:1",
+        lease_duration_s=15.0,
+    )
+    b = KubernetesLeaseLeaderController(
+        kube.url, "replica-b", clock=clock, advertised_address="hostB:2",
+        lease_duration_s=15.0,
+    )
+    assert a.get_token().leader
+    assert not b.get_token().leader
+    # a dies; b (which already observed a's record at its first follow)
+    # sees it unrenewed for a full duration and takes over
+    clock.advance(16)
+    assert b.get_token().leader
+    assert b.leader_address() is None
+    assert a.get_token().leader is False
+    assert a.leader_address() == "hostB:2"

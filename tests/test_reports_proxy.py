@@ -1,0 +1,144 @@
+"""Reports leader-proxying (VERDICT r3 missing #2): any replica answers
+"why (wasn't) my job scheduled" by forwarding follower queries to the
+leader's advertised address from the election record -- the analog of
+internal/scheduler/reports/leader_proxying_reports_server.go +
+leader/leader_client.go."""
+
+import time
+
+import grpc
+import pytest
+
+from armada_tpu.scheduler.leader import (
+    FileLeaseLeaderController,
+    StandaloneLeaderController,
+)
+from armada_tpu.scheduler.reports import (
+    LeaderProxyingReports,
+    ReportsUnavailable,
+    SchedulingReportsRepository,
+)
+
+
+def test_lease_record_carries_the_advertised_address(tmp_path):
+    lease = (tmp_path / "leader.lease").as_posix()
+    a = FileLeaseLeaderController(lease, "a", advertised_address="hostA:50051")
+    b = FileLeaseLeaderController(lease, "b", advertised_address="hostB:50052")
+    assert a.get_token().leader
+    # the holder peeks None (serve locally); the follower sees A's address
+    assert a.leader_address() is None
+    assert b.leader_address() == "hostA:50051"
+    # read-only: peeking did not steal or disturb the lease
+    assert a.validate_token(a.get_token())
+
+
+def test_pre_address_lease_is_unavailable_not_empty(tmp_path):
+    """A lease written by an old replica without an address must surface as
+    UNAVAILABLE to report queries, never as an empty (misleading) answer."""
+    lease = (tmp_path / "leader.lease").as_posix()
+    a = FileLeaseLeaderController(lease, "a")  # no advertised address
+    assert a.get_token().leader
+    b = FileLeaseLeaderController(lease, "b", advertised_address="hostB:1")
+    proxy = LeaderProxyingReports(
+        SchedulingReportsRepository(), b, lambda addr: None
+    )
+    assert b.leader_address() == ""
+    with pytest.raises(ReportsUnavailable):
+        proxy.job_report("j1")
+
+
+def test_standalone_controller_serves_locally():
+    repo = SchedulingReportsRepository()
+    proxy = LeaderProxyingReports(
+        repo, StandaloneLeaderController(),
+        lambda addr: pytest.fail("standalone must not dial"),
+    )
+    assert proxy.job_report("nope") is None
+    assert proxy.pool_report() == {}
+
+
+def _wait(predicate, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_follower_replica_proxies_reports_to_leader(tmp_path):
+    """The docker-compose topology in-process: two replicas over one data
+    dir with file-lease election.  Reports record only on the leader; the
+    follower's Reports service answers by proxying."""
+    import threading
+
+    from armada_tpu.cli.serve import run_fake_executor, start_control_plane
+    from armada_tpu.rpc.client import ArmadaClient
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+
+    data = (tmp_path / "data").as_posix()
+    plane_a = start_control_plane(
+        data, cycle_interval_s=0.2, schedule_interval_s=0.5, leader_id="a",
+    )
+    plane_b = None
+    stop_exec = threading.Event()
+    exec_thread = None
+    try:
+        # A started first and owns the lease; B follows
+        plane_b = start_control_plane(
+            data, cycle_interval_s=0.2, schedule_interval_s=0.5, leader_id="b",
+        )
+        exec_thread = threading.Thread(
+            target=run_fake_executor,
+            args=(f"127.0.0.1:{plane_a.port}",),
+            kwargs={"interval_s": 0.2, "stop": stop_exec},
+            daemon=True,
+        )
+        exec_thread.start()
+        client_a = ArmadaClient(f"127.0.0.1:{plane_a.port}")
+        client_b = ArmadaClient(f"127.0.0.1:{plane_b.port}")
+        client_a.create_queue(QueueRecord("qa"))
+        (jid,) = client_a.submit_jobs(
+            "qa", "js1", [JobSubmitItem(resources={"cpu": "1", "memory": "1"})]
+        )
+
+        # the leader's cycle records the report
+        def leader_has_report():
+            try:
+                return client_a.get_job_report(jid)["outcome"] == "scheduled"
+            except grpc.RpcError:
+                return False
+
+        assert _wait(leader_has_report), "leader never recorded the report"
+
+        # the FOLLOWER answers the same query by proxying to the leader
+        report = client_b.get_job_report(jid)
+        assert report["outcome"] == "scheduled"
+        assert report == client_a.get_job_report(jid)
+        # pool + queue reports proxy too
+        assert client_b.get_pool_report() == client_a.get_pool_report()
+        assert client_b.get_queue_report("qa") == client_a.get_queue_report("qa")
+    finally:
+        stop_exec.set()
+        if exec_thread is not None:
+            exec_thread.join(timeout=10)
+        if plane_b is not None:
+            plane_b.stop()
+        plane_a.stop()
+
+
+def test_misadvertised_self_address_fails_fast_not_recursively(tmp_path):
+    """A copy-pasted --advertised-address that routes a follower back to
+    itself must abort UNAVAILABLE, not recurse through its own Reports
+    service until the thread pool starves."""
+    lease = (tmp_path / "leader.lease").as_posix()
+    a = FileLeaseLeaderController(lease, "a", advertised_address="shared:1")
+    b = FileLeaseLeaderController(lease, "b", advertised_address="shared:1")
+    assert a.get_token().leader
+    proxy = LeaderProxyingReports(
+        SchedulingReportsRepository(), b,
+        lambda addr: pytest.fail("must not dial itself"),
+    )
+    proxy.set_self_address("shared:1")
+    with pytest.raises(ReportsUnavailable, match="advertised-address"):
+        proxy.job_report("j1")
