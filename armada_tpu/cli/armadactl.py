@@ -400,6 +400,81 @@ def cmd_logs(args):
     return 0
 
 
+def cmd_cordon_executor(args):
+    client = _client(args)
+    if args.uncordon:
+        client.upsert_executor_settings(args.executor, cordoned=False)
+        print(f"uncordoned executor {args.executor}")
+    else:
+        if not args.reason:
+            print("error: --reason is required when cordoning", file=sys.stderr)
+            return 1
+        client.upsert_executor_settings(
+            args.executor, cordoned=True, cordon_reason=args.reason
+        )
+        print(f"cordoned executor {args.executor}: {args.reason}")
+    return 0
+
+
+def cmd_executor_settings_rm(args):
+    _client(args).delete_executor_settings(args.executor)
+    print(f"deleted settings for executor {args.executor}")
+    return 0
+
+
+def _reject_mismatched_scope_flags(args, states_flag: bool = False) -> bool:
+    """A filter flag that does not apply to the chosen target must ERROR,
+    not silently widen a mass destructive action past the operator's
+    stated filter."""
+    if args.target == "queue" and args.queues:
+        print("error: --queues only applies to the executor target",
+              file=sys.stderr)
+        return False
+    if states_flag and args.target == "executor" and args.states:
+        print("error: --states only applies to the queue target",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def cmd_preempt_on(args):
+    if not _reject_mismatched_scope_flags(args):
+        return 1
+    client = _client(args)
+    pcs = [p for p in (args.priority_classes or "").split(",") if p]
+    if args.target == "executor":
+        client.preempt_on_executor(
+            args.name,
+            queues=[q for q in (args.queues or "").split(",") if q],
+            priority_classes=pcs,
+        )
+    else:
+        client.preempt_on_queue(args.name, priority_classes=pcs)
+    print(f"requested preemption on {args.target} {args.name}")
+    return 0
+
+
+def cmd_cancel_on(args):
+    if not _reject_mismatched_scope_flags(args, states_flag=True):
+        return 1
+    client = _client(args)
+    pcs = [p for p in (args.priority_classes or "").split(",") if p]
+    if args.target == "executor":
+        client.cancel_on_executor(
+            args.name,
+            queues=[q for q in (args.queues or "").split(",") if q],
+            priority_classes=pcs,
+        )
+    else:
+        client.cancel_on_queue(
+            args.name,
+            priority_classes=pcs,
+            job_states=[s for s in (args.states or "").split(",") if s],
+        )
+    print(f"requested cancellation on {args.target} {args.name}")
+    return 0
+
+
 def cmd_cordon_node(args):
     def go(c):
         if args.uncordon:
@@ -798,6 +873,41 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--job-id")
     lg.add_argument("--run-id")
     lg.set_defaults(fn=cmd_logs)
+
+    ce = sub.add_parser(
+        "cordon-executor",
+        help="(un)cordon an EXECUTOR via control-plane events (event-sourced"
+        "; every replica converges by replay)",
+    )
+    ce.add_argument("executor")
+    ce.add_argument("--uncordon", action="store_true")
+    ce.add_argument("--reason", help="required when cordoning (forensics)")
+    ce.set_defaults(fn=cmd_cordon_executor)
+
+    cer = sub.add_parser(
+        "delete-executor-settings", help="drop an executor's operator settings"
+    )
+    cer.add_argument("executor")
+    cer.set_defaults(fn=cmd_executor_settings_rm)
+
+    po = sub.add_parser(
+        "preempt-on", help="preempt all matching jobs on an executor or queue"
+    )
+    po.add_argument("target", choices=["executor", "queue"])
+    po.add_argument("name")
+    po.add_argument("--queues", help="comma-separated (executor target only)")
+    po.add_argument("--priority-classes", help="comma-separated filter")
+    po.set_defaults(fn=cmd_preempt_on)
+
+    co = sub.add_parser(
+        "cancel-on", help="cancel all matching jobs on an executor or queue"
+    )
+    co.add_argument("target", choices=["executor", "queue"])
+    co.add_argument("name")
+    co.add_argument("--queues", help="comma-separated (executor target only)")
+    co.add_argument("--priority-classes", help="comma-separated filter")
+    co.add_argument("--states", help="queued,leased (queue target only)")
+    co.set_defaults(fn=cmd_cancel_on)
 
     cn = sub.add_parser("cordon-node", help="(un)cordon a node via binoculars")
     cn.add_argument("node")

@@ -156,6 +156,9 @@ def start_control_plane(
     queues = QueueRepository(db)
     submit_server = SubmitServer(db, publisher, queues, config)
     event_api = EventApi(eventdb)
+    from armada_tpu.server.controlplane import ControlPlaneServer
+
+    control_plane = ControlPlaneServer(publisher)
     jobdb = JobDb(config)
     if kube_lease_url and not leader_id:
         # Silent fallback to always-leader here would be split-brain with two
@@ -264,6 +267,7 @@ def start_control_plane(
         factory=factory,
         lookout_queries=LookoutQueries(lookoutdb),
         reports=reports_query,
+        control_plane=control_plane,
         address=f"{bind_host}:{port}",
         authenticator=authenticator,
     )

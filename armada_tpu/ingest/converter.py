@@ -84,6 +84,49 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
         )
     if kind == "preempt_job":
         return ops.MarkJobsPreemptRequested(job_ids={ev.preempt_job.job_id})
+    # control-plane events (the "$control-plane" stream; reference
+    # scheduleringester ControlPlaneEventsInstructionConverter)
+    if kind == "executor_settings_upsert":
+        e = ev.executor_settings_upsert
+        return ops.UpsertExecutorSettings(
+            settings_by_name={
+                e.name: {
+                    "cordoned": bool(e.cordoned),
+                    "cordon_reason": e.cordon_reason,
+                    "set_by_user": e.set_by_user,
+                }
+            }
+        )
+    if kind == "executor_settings_delete":
+        return ops.DeleteExecutorSettings(
+            names={ev.executor_settings_delete.name}
+        )
+    if kind == "preempt_on_executor":
+        e = ev.preempt_on_executor
+        return ops.PreemptOnExecutor(
+            executor=e.name,
+            queues=tuple(e.queues),
+            priority_classes=tuple(e.priority_classes),
+        )
+    if kind == "cancel_on_executor":
+        e = ev.cancel_on_executor
+        return ops.CancelOnExecutor(
+            executor=e.name,
+            queues=tuple(e.queues),
+            priority_classes=tuple(e.priority_classes),
+        )
+    if kind == "preempt_on_queue":
+        e = ev.preempt_on_queue
+        return ops.PreemptOnQueue(
+            queue=e.name, priority_classes=tuple(e.priority_classes)
+        )
+    if kind == "cancel_on_queue":
+        e = ev.cancel_on_queue
+        return ops.CancelOnQueue(
+            queue=e.name,
+            priority_classes=tuple(e.priority_classes),
+            job_states=tuple(e.job_states),
+        )
     if kind == "reprioritise_job_set":
         return ops.UpdateJobSetPriority(
             queue=seq.queue,

@@ -296,6 +296,83 @@ class InsertPartitionMarker(DbOperation):
         return {f"*marker/{self.group_id}/{self.partition}"}
 
 
+# ---- control-plane ops (scheduleringester dbops.go:67-80,366-370,540-553) ---
+# Operator actions from the "$control-plane" stream.  All carry a wildcard
+# token: they may touch jobs whose membership is only known at apply time, so
+# they never commute past other ops (CanBeAppliedBefore conservatism).
+
+
+@dataclasses.dataclass
+class UpsertExecutorSettings(DbOperation):
+    # name -> {"cordoned": bool, "cordon_reason": str, "set_by_user": str}
+    settings_by_name: dict[str, dict]
+
+    def tokens(self) -> set[str]:
+        return {f"*executor-settings/{n}" for n in self.settings_by_name}
+
+    def merge(self, other: DbOperation) -> bool:
+        if isinstance(other, UpsertExecutorSettings):
+            self.settings_by_name.update(other.settings_by_name)
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class DeleteExecutorSettings(DbOperation):
+    names: set[str]
+
+    def tokens(self) -> set[str]:
+        return {f"*executor-settings/{n}" for n in self.names}
+
+    def merge(self, other: DbOperation) -> bool:
+        if isinstance(other, DeleteExecutorSettings):
+            self.names |= other.names
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class _ExecutorScopedJobOp(DbOperation):
+    """Preempt/cancel every matching job on an executor (membership resolved
+    at apply time against the runs table, schedulerdb.go:411-431)."""
+
+    executor: str
+    queues: tuple[str, ...] = ()  # empty = all
+    priority_classes: tuple[str, ...] = ()  # empty = all
+
+    def tokens(self) -> set[str]:
+        return {f"*executor-jobs/{self.executor}"}
+
+
+class PreemptOnExecutor(_ExecutorScopedJobOp):
+    pass
+
+
+class CancelOnExecutor(_ExecutorScopedJobOp):
+    pass
+
+
+@dataclasses.dataclass
+class _QueueScopedJobOp(DbOperation):
+    """Preempt/cancel every matching job of a queue."""
+
+    queue: str
+    priority_classes: tuple[str, ...] = ()
+    # "queued" / "leased"; empty = both (CancelOnQueue.jobStates)
+    job_states: tuple[str, ...] = ()
+
+    def tokens(self) -> set[str]:
+        return {f"*queue-jobs/{self.queue}"}
+
+
+class PreemptOnQueue(_QueueScopedJobOp):
+    pass
+
+
+class CancelOnQueue(_QueueScopedJobOp):
+    pass
+
+
 def append_db_operation(ops: list[DbOperation], op: DbOperation) -> None:
     """Append with merge-past-commuting-ops (dbops.go AppendDbOperation):
     scan from the tail, merging into the first same-shaped op reachable

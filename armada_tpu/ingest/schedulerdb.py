@@ -91,6 +91,16 @@ CREATE TABLE IF NOT EXISTS executors (
   last_updated_ns INTEGER NOT NULL
 );
 
+-- Operator cordon state, materialized from "$control-plane" events (the
+-- reference's executor_settings table, scheduleringester dbops.go) -- NEVER
+-- written directly: replaying the log rebuilds it on any replica.
+CREATE TABLE IF NOT EXISTS executor_settings (
+  executor_id TEXT PRIMARY KEY,
+  cordoned INTEGER NOT NULL DEFAULT 0,
+  cordon_reason TEXT NOT NULL DEFAULT '',
+  set_by_user TEXT NOT NULL DEFAULT ''
+);
+
 CREATE TABLE IF NOT EXISTS consumer_positions (
   consumer TEXT NOT NULL,
   partition INTEGER NOT NULL,
@@ -360,8 +370,95 @@ class SchedulerDb:
                 "VALUES (?, ?, ?)",
                 (op.group_id, op.partition, op.created_ns),
             )
+        elif isinstance(op, ops.UpsertExecutorSettings):
+            cur.executemany(
+                "INSERT INTO executor_settings "
+                "(executor_id, cordoned, cordon_reason, set_by_user) "
+                "VALUES (?, ?, ?, ?) ON CONFLICT(executor_id) DO UPDATE SET "
+                "cordoned = excluded.cordoned, "
+                "cordon_reason = excluded.cordon_reason, "
+                "set_by_user = excluded.set_by_user",
+                [
+                    (
+                        name,
+                        int(s.get("cordoned", False)),
+                        s.get("cordon_reason", ""),
+                        s.get("set_by_user", ""),
+                    )
+                    for name, s in op.settings_by_name.items()
+                ],
+            )
+        elif isinstance(op, ops.DeleteExecutorSettings):
+            cur.executemany(
+                "DELETE FROM executor_settings WHERE executor_id = ?",
+                [(n,) for n in op.names],
+            )
+        elif isinstance(op, (ops.PreemptOnExecutor, ops.CancelOnExecutor)):
+            # Membership resolves at apply time against the runs table
+            # (reference schedulerdb.go:411-431 SelectJobsByExecutorAndQueues
+            # + PC filter on the parsed scheduling info).
+            # spec blobs only load when a PC filter needs them: an unfiltered
+            # mass action on a 1M-job queue must not materialize 1M blobs
+            # inside the ingestion transaction.
+            spec_col = ", j.spec" if op.priority_classes else ""
+            where = (
+                f"SELECT DISTINCT j.job_id{spec_col} FROM jobs j "
+                "JOIN runs r ON r.job_id = j.job_id "
+                "WHERE r.executor = ? AND r.succeeded = 0 AND r.failed = 0 "
+                "  AND r.cancelled = 0 AND r.preempted = 0 AND r.returned = 0 "
+                "  AND j.cancelled = 0 AND j.succeeded = 0 AND j.failed = 0"
+            )
+            params: list = [op.executor]
+            if op.queues:
+                where += f" AND j.queue IN ({','.join('?' * len(op.queues))})"
+                params.extend(op.queues)
+            job_ids = self._filter_by_priority_class(
+                cur.execute(where, params).fetchall(), op.priority_classes
+            )
+            if isinstance(op, ops.PreemptOnExecutor):
+                self._apply(cur, ops.MarkJobsPreemptRequested(job_ids=job_ids))
+            else:
+                self._mark_jobs(cur, "cancel_requested", job_ids)
+        elif isinstance(op, (ops.PreemptOnQueue, ops.CancelOnQueue)):
+            spec_col = ", spec" if op.priority_classes else ""
+            where = (
+                f"SELECT job_id{spec_col} FROM jobs "
+                "WHERE queue = ? AND cancelled = 0 AND succeeded = 0 "
+                "AND failed = 0"
+            )
+            params = [op.queue]
+            if isinstance(op, ops.PreemptOnQueue):
+                where += " AND queued = 0"  # only leased/running can preempt
+            elif op.job_states:
+                conds = []
+                if "queued" in op.job_states:
+                    conds.append("queued = 1")
+                if "leased" in op.job_states:
+                    conds.append("queued = 0")
+                where += f" AND ({' OR '.join(conds) or '0'})"
+            job_ids = self._filter_by_priority_class(
+                cur.execute(where, params).fetchall(), op.priority_classes
+            )
+            if isinstance(op, ops.PreemptOnQueue):
+                self._apply(cur, ops.MarkJobsPreemptRequested(job_ids=job_ids))
+            else:
+                self._mark_jobs(cur, "cancel_requested", job_ids)
         else:
             raise TypeError(f"unknown DbOperation: {type(op).__name__}")
+
+    @staticmethod
+    def _filter_by_priority_class(rows, priority_classes) -> set[str]:
+        if not priority_classes:
+            return {row[0] for row in rows}
+        from armada_tpu.events import events_pb2 as _pb
+
+        allowed = set(priority_classes)
+        out = set()
+        for job_id, spec_blob in rows:
+            spec = _pb.JobSpec.FromString(spec_blob)
+            if spec.priority_class in allowed:
+                out.add(job_id)
+        return out
 
     def _mark_jobs(
         self, cur: sqlite3.Cursor, flag: str, job_ids: Iterable[str], also: str = ""
@@ -526,6 +623,18 @@ class SchedulerDb:
 
     def executors(self) -> list[sqlite3.Row]:
         return self._query("SELECT * FROM executors")
+
+    def executor_settings(self) -> dict[str, dict]:
+        """Operator cordon state by executor id (scheduling_algo.go:250
+        GetExecutorSettings) -- replayed from control-plane events."""
+        return {
+            row["executor_id"]: {
+                "cordoned": bool(row["cordoned"]),
+                "cordon_reason": row["cordon_reason"],
+                "set_by_user": row["set_by_user"],
+            }
+            for row in self._query("SELECT * FROM executor_settings")
+        }
 
 
 def _job_default(col: str):

@@ -235,6 +235,85 @@ class ArmadaClient(_Base):
         )
         return json.loads(resp.json)
 
+    # --- executor admin (control-plane events) ------------------------------
+
+    def upsert_executor_settings(
+        self, name: str, cordoned: bool, cordon_reason: str = ""
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorAdmin/UpsertExecutorSettings",
+            pb.ExecutorSettingsUpsertRequest(
+                name=name, cordoned=cordoned, cordon_reason=cordon_reason
+            ),
+            pb.Empty,
+        )
+
+    def delete_executor_settings(self, name: str) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DeleteExecutorSettings",
+            pb.ExecutorSettingsDeleteRequest(name=name),
+            pb.Empty,
+        )
+
+    def preempt_on_executor(
+        self,
+        name: str,
+        queues: Sequence[str] = (),
+        priority_classes: Sequence[str] = (),
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorAdmin/PreemptOnExecutor",
+            pb.ExecutorScopedActionRequest(
+                name=name,
+                queues=list(queues),
+                priority_classes=list(priority_classes),
+            ),
+            pb.Empty,
+        )
+
+    def cancel_on_executor(
+        self,
+        name: str,
+        queues: Sequence[str] = (),
+        priority_classes: Sequence[str] = (),
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorAdmin/CancelOnExecutor",
+            pb.ExecutorScopedActionRequest(
+                name=name,
+                queues=list(queues),
+                priority_classes=list(priority_classes),
+            ),
+            pb.Empty,
+        )
+
+    def preempt_on_queue(
+        self, name: str, priority_classes: Sequence[str] = ()
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorAdmin/PreemptOnQueue",
+            pb.QueueScopedActionRequest(
+                name=name, priority_classes=list(priority_classes)
+            ),
+            pb.Empty,
+        )
+
+    def cancel_on_queue(
+        self,
+        name: str,
+        priority_classes: Sequence[str] = (),
+        job_states: Sequence[str] = (),
+    ) -> None:
+        self._unary(
+            "/armada_tpu.api.ExecutorAdmin/CancelOnQueue",
+            pb.QueueScopedActionRequest(
+                name=name,
+                priority_classes=list(priority_classes),
+                job_states=list(job_states),
+            ),
+            pb.Empty,
+        )
+
     # --- scheduling reports -------------------------------------------------
 
     def get_job_report(self, job_id: str) -> dict:

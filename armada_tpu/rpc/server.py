@@ -336,6 +336,89 @@ class _BinocularsService:
         return pb.Empty()
 
 
+class _ExecutorAdminService:
+    """Operator actions on executors/queues (pkg/api/executor.proto): each
+    verb publishes a control-plane event (server/controlplane.py)."""
+
+    def __init__(self, control_plane, auth):
+        self._cp = control_plane
+        self._auth = auth
+
+    def UpsertExecutorSettings(self, request, context):
+        principal = _authenticate(self._auth, context)
+        _guard(
+            context,
+            lambda: self._cp.upsert_executor_settings(
+                request.name,
+                cordoned=request.cordoned,
+                cordon_reason=request.cordon_reason,
+                principal=principal,
+            ),
+        )
+        return pb.Empty()
+
+    def DeleteExecutorSettings(self, request, context):
+        principal = _authenticate(self._auth, context)
+        _guard(
+            context,
+            lambda: self._cp.delete_executor_settings(
+                request.name, principal=principal
+            ),
+        )
+        return pb.Empty()
+
+    def PreemptOnExecutor(self, request, context):
+        principal = _authenticate(self._auth, context)
+        _guard(
+            context,
+            lambda: self._cp.preempt_on_executor(
+                request.name,
+                queues=list(request.queues),
+                priority_classes=list(request.priority_classes),
+                principal=principal,
+            ),
+        )
+        return pb.Empty()
+
+    def CancelOnExecutor(self, request, context):
+        principal = _authenticate(self._auth, context)
+        _guard(
+            context,
+            lambda: self._cp.cancel_on_executor(
+                request.name,
+                queues=list(request.queues),
+                priority_classes=list(request.priority_classes),
+                principal=principal,
+            ),
+        )
+        return pb.Empty()
+
+    def PreemptOnQueue(self, request, context):
+        principal = _authenticate(self._auth, context)
+        _guard(
+            context,
+            lambda: self._cp.preempt_on_queue(
+                request.name,
+                priority_classes=list(request.priority_classes),
+                principal=principal,
+            ),
+        )
+        return pb.Empty()
+
+    def CancelOnQueue(self, request, context):
+        principal = _authenticate(self._auth, context)
+        _guard(
+            context,
+            lambda: self._cp.cancel_on_queue(
+                request.name,
+                priority_classes=list(request.priority_classes),
+                job_states=list(request.job_states),
+                principal=principal,
+            ),
+        )
+        return pb.Empty()
+
+
 class _ExecutorApiService:
     def __init__(self, executor_api, factory, auth):
         self._api = executor_api
@@ -377,6 +460,7 @@ def make_server(
     lookout_queries=None,
     reports=None,
     binoculars=None,
+    control_plane=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
     authenticator=None,
@@ -452,6 +536,35 @@ def make_server(
                 {
                     "Logs": _unary(bsvc.Logs, pb.LogsRequest),
                     "Cordon": _unary(bsvc.Cordon, pb.CordonRequest),
+                },
+            )
+        )
+    if control_plane is not None:
+        csvc = _ExecutorAdminService(control_plane, auth)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.ExecutorAdmin",
+                {
+                    "UpsertExecutorSettings": _unary(
+                        csvc.UpsertExecutorSettings,
+                        pb.ExecutorSettingsUpsertRequest,
+                    ),
+                    "DeleteExecutorSettings": _unary(
+                        csvc.DeleteExecutorSettings,
+                        pb.ExecutorSettingsDeleteRequest,
+                    ),
+                    "PreemptOnExecutor": _unary(
+                        csvc.PreemptOnExecutor, pb.ExecutorScopedActionRequest
+                    ),
+                    "CancelOnExecutor": _unary(
+                        csvc.CancelOnExecutor, pb.ExecutorScopedActionRequest
+                    ),
+                    "PreemptOnQueue": _unary(
+                        csvc.PreemptOnQueue, pb.QueueScopedActionRequest
+                    ),
+                    "CancelOnQueue": _unary(
+                        csvc.CancelOnQueue, pb.QueueScopedActionRequest
+                    ),
                 },
             )
         )

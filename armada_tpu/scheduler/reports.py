@@ -142,6 +142,8 @@ class LeaderProxyingReports:
         self.local = local
         self._controller = controller
         self._client_factory = client_factory
+        # Guarded: gRPC worker threads race the cache on leadership churn.
+        self._clients_lock = threading.Lock()
         self._clients: dict[str, object] = {}
         self._self_address = ""
 
@@ -172,22 +174,27 @@ class LeaderProxyingReports:
                 f"{address!r} but another replica holds the lease -- check "
                 f"each replica's --advertised-address"
             )
-        client = self._clients.get(address)
-        if client is None:
+        with self._clients_lock:
+            client = self._clients.get(address)
+            if client is not None:
+                return client
+            stale = []
             if len(self._clients) > 8:
-                # leadership churn: close and drop stale dials (gRPC
-                # channels hold sockets), keeping only the current target
-                for addr, stale in list(self._clients.items()):
-                    if addr == address:
-                        continue
-                    close = getattr(stale, "close", None)
-                    if close is not None:
-                        try:
-                            close()
-                        except Exception:
-                            pass
-                    del self._clients[addr]
+                # leadership churn: drop dials to old leaders, keeping only
+                # the current target.  An RPC in flight on a just-closed
+                # channel fails UNAVAILABLE -- the retryable semantic the
+                # caller already maps for a gone leader.
+                for addr in list(self._clients):
+                    if addr != address:
+                        stale.append(self._clients.pop(addr))
             client = self._clients[address] = self._client_factory(address)
+        for old in stale:  # close outside the lock (network teardown)
+            close = getattr(old, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
         return client
 
     def _proxy(self, call, not_found):

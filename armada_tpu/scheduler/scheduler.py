@@ -199,10 +199,19 @@ class Scheduler:
 
     def _executors(self) -> list[ExecutorSnapshot]:
         factory = self.config.resource_list_factory()
-        return [
-            ExecutorSnapshot.from_json(row["snapshot"], factory)
-            for row in self.db.executors()
-        ]
+        # Operator cordon state overlays the snapshots (the reference reads
+        # executor_settings separately and filters cordoned executors,
+        # scheduling_algo.go:250,779-791); it is event-sourced via the
+        # "$control-plane" stream, so every replica converges by replay.
+        settings = self.db.executor_settings()
+        out = []
+        for row in self.db.executors():
+            snap = ExecutorSnapshot.from_json(row["snapshot"], factory)
+            s = settings.get(snap.id)
+            if s is not None and s["cordoned"] and not snap.cordoned:
+                snap = dataclasses.replace(snap, cordoned=True)
+            out.append(snap)
+        return out
 
     # --- the cycle (scheduler.go cycle:246) ---------------------------------
 

@@ -59,8 +59,12 @@ class SubmitChecker:
     def update_executors(self, executors: Sequence[ExecutorSnapshot]) -> None:
         pools: dict[str, list] = {}
         for ex in executors:
-            if ex.cordoned:
-                continue
+            # Cordoned executors still COUNT here: cordon is a temporary
+            # scheduling gate (filterCordonedExecutors applies per round),
+            # not a statement that the capacity can never fit the job -- the
+            # reference's submit check has no cordon filter (submitcheck.go),
+            # so draining the fleet leaves jobs queued instead of terminally
+            # failing validation (pinned by test_controlplane_events).
             for n in ex.nodes:
                 if n.unschedulable or n.total_resources is None:
                     continue

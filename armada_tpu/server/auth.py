@@ -25,6 +25,8 @@ class Permission(enum.Enum):
     DELETE_QUEUE = "delete_queue"
     CORDON_NODES = "cordon_nodes"
     WATCH_ALL_EVENTS = "watch_all_events"
+    # Executor-level cordon/settings (reference permissions.UpdateExecutorSettings)
+    UPDATE_EXECUTOR_SETTINGS = "update_executor_settings"
 
 
 @dataclasses.dataclass(frozen=True)
