@@ -54,25 +54,103 @@ def run_round_on_device(problem, ctx, config, device_problem=None):
     # latency in adversarial rounds (beyond it the unwind itself is still
     # applied, so no half-gang ever leases either way).
     attempts = 0
-    while outcome.unwound_groups and attempts < 4:
+    while attempts < 4:
+        kill: list = []
+        if outcome.unwound_groups:
+            # Group tags live only on multi-member units under the vectorized
+            # representation (same rule as decode's unwind scan) -- and slab
+            # contexts have G ~ backlog slots, so never range-scan
+            # num_real_gangs unless gangs are list-represented.
+            tagged = (
+                ctx.gang_members_over.keys()
+                if ctx.gang_members is None
+                else range(ctx.num_real_gangs)
+            )
+            kill.extend(
+                gi for gi in tagged
+                if ctx.gang_group[gi] in outcome.unwound_groups
+            )
+        # Running-gang fate-sharing (preempting_queue_scheduler.go:345-399):
+        # the reference evicts the REMAINS of partially evicted gangs and
+        # re-schedules each evicted gang as one all-or-nothing unit with
+        # per-member node pins, so a running gang either keeps every member
+        # or loses every member.  Our kernel gives each preemptible run an
+        # independent evictee slot; when a round preempts SOME members of a
+        # running gang but retains others, invalidate ALL the gang's evictee
+        # slots and re-run -- none can re-place, so the whole gang preempts
+        # and its capacity frees for the rest of the round's decisions,
+        # exactly like the reference's failed unit (pinned members that lost
+        # their node doom the unit).  Golden trace: "Preempted Gang Job"
+        # (testdata/golden/, ref simulator_test.go).
+        kill.extend(_partial_running_gangs(ctx, device_problem, outcome))
+        if not kill:
+            break
         attempts += 1
-        # Group tags live only on multi-member units under the vectorized
-        # representation (same rule as decode's unwind scan) -- and slab
-        # contexts have G ~ backlog slots, so never range-scan num_real_gangs
-        # unless gangs are list-represented.
-        tagged = (
-            ctx.gang_members_over.keys()
-            if ctx.gang_members is None
-            else range(ctx.num_real_gangs)
-        )
-        kill = [gi for gi in tagged if ctx.gang_group[gi] in outcome.unwound_groups]
         g_valid = _np.asarray(device_problem.g_valid).copy()
-        g_valid[_np.asarray(kill, _np.int64)] = False
+        g_valid[_np.asarray(sorted(set(kill)), _np.int64)] = False
         device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
         result = schedule_round(device_problem, **kernel_kwargs)
         outcome = decode_result(result, ctx)
+    if attempts >= 4:
+        # Attempt-cap backstop: never report a half-preempted running gang.
+        # Force the retained members into the preempted set -- their freed
+        # capacity goes unused this cycle (under-scheduling is safe,
+        # half-gangs are not).
+        _force_preempt_partials(ctx, outcome)
     outcome.pool_totals = ctx.pool_total_atoms
     return result, outcome
+
+
+def _iter_partial_gangs(ctx, outcome):
+    """Yield (run_indices, retained_job_ids) for each running gang this
+    round preempted only PARTIALLY (some members kept, some lost) -- the one
+    predicate both the cascade trigger and the attempt-cap backstop share.
+
+    ctx.running_gangs may be a zero-arg callable (the incremental assembles
+    build the mapping lazily: most cycles preempt nothing, and an eager
+    per-member locate on the slab hot path would erode the TPU cycle);
+    materialization is deferred until a round actually preempted something.
+    """
+    if not outcome.preempted or not ctx.running_gangs:
+        return
+    rg = ctx.running_gangs
+    if callable(rg):
+        rg = ctx.running_gangs = rg()  # cache across re-runs
+        if not rg:
+            return
+    pre = set(outcome.preempted)
+    for ris in rg.values():
+        retained = [
+            jid
+            for ri in ris
+            if (jid := ctx.run_job_id(int(ri))) not in pre
+        ]
+        if retained and len(retained) < len(ris):
+            yield ris, retained
+
+
+def _partial_running_gangs(ctx, device_problem, outcome) -> list:
+    """Evictee-slot gang indices to invalidate for the cascade re-run."""
+    import numpy as _np
+
+    run_gang = None
+    kill: list = []
+    for ris, _retained in _iter_partial_gangs(ctx, outcome):
+        if run_gang is None:
+            run_gang = _np.asarray(device_problem.run_gang)
+        for ri in ris:
+            gi = int(run_gang[ri])
+            if gi >= 0:
+                kill.append(gi)
+    return kill
+
+
+def _force_preempt_partials(ctx, outcome) -> None:
+    for _ris, retained in _iter_partial_gangs(ctx, outcome):
+        for jid in retained:
+            outcome.preempted.append(jid)
+            if jid in outcome.rescheduled:
+                outcome.rescheduled.remove(jid)
 
 
 def collect_round_stats(result, problem, ctx, config, outcome) -> None:

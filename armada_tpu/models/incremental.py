@@ -1340,6 +1340,17 @@ class IncrementalBuilder:
             gang_ids_vec=gang_ids_vec,
             gang_members_over=members_over,
             run_ids_vec=rt.ids[run_rows],
+            # lazy: materialized only by a round that actually preempted
+            # (models._iter_partial_gangs); eager per-member locates would
+            # tax every assemble for a rarely-consumed mapping
+            running_gangs=lambda: self._running_gang_ctx_groups(
+                lambda row: (
+                    int(pos)
+                    if (pos := np.searchsorted(run_rows, row)) < nr
+                    and run_rows[pos] == row
+                    else None
+                )
+            ),
         )
         return problem, ctx
 
@@ -2018,6 +2029,17 @@ class IncrementalBuilder:
             gang_ids_vec=self._share_g_ids(),
             gang_members_over=members_over,
             run_ids_vec=rr.share_ids(),
+            # slab run axis IS the slot axis; lazy like the dense path (the
+            # mapping reads slot-stable state, and the production flow
+            # materializes within the decode window, before apply_outcome
+            # mutates the tables)
+            running_gangs=lambda: self._running_gang_ctx_groups(
+                lambda row: (
+                    int(s)
+                    if rr.valid[(s := int(self.runs.slot[row]))]
+                    else None
+                )
+            ),
         )
         return bundle, ctx
 
@@ -2271,6 +2293,30 @@ class IncrementalBuilder:
             store = {}
             self._rgm = store
         return store
+
+    def _running_gang_ctx_groups(self, run_index_of) -> dict:
+        """HostContext.running_gangs for this assemble: tag -> run indices of
+        each running gang's preemptible members (problem.py's evictee-loop
+        grouping; drives the partial-preemption cascade in
+        run_round_on_device).  `run_index_of(row) -> Optional[int]` maps a
+        runs-table row to the problem's run axis (position for the dense
+        assemble, slot for the slab path)."""
+        groups: dict = {}
+        rt = self.runs
+        for (qi, gang_id), members in self._running_gang_members.items():
+            if len(members) < 2:
+                continue
+            ris = []
+            for jid in sorted(members):
+                row = rt._locate(jid.encode())
+                if row is None or not rt.preempt[row]:
+                    continue
+                idx = run_index_of(row)
+                if idx is not None:
+                    ris.append(int(idx))
+            if len(ris) > 1:
+                groups[f"{qi}/{gang_id}"] = tuple(ris)
+        return groups
 
     def note_running_gang(self, queue: str, gang_id: str, job_id: str) -> None:
         qi = self.queue_by_name.get(queue)

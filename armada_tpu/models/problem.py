@@ -161,6 +161,13 @@ class HostContext:
     gang_ids_vec: Optional[np.ndarray] = None
     gang_members_over: dict = dataclasses.field(default_factory=dict)
     run_ids_vec: Optional[np.ndarray] = None
+    # Running-gang fate-sharing (preempting_queue_scheduler.go:345-399 +
+    # setEvictedGangCardinality): tag -> run indices of the gang's
+    # PREEMPTIBLE members present in this problem.  run_round_on_device uses
+    # it to cascade partial preemptions -- a running gang either keeps all
+    # members or loses all (the reference evicts the remains of partially
+    # evicted gangs and re-schedules them as one all-or-nothing unit).
+    running_gangs: dict = dataclasses.field(default_factory=dict)
 
     def members_of(self, gi: int) -> list:
         """Member job ids of gang `gi` under either representation."""
@@ -711,6 +718,7 @@ def build_problem(
 
     # evictee slots first (order ranks below queued gangs per queue)
     evictee_by_queue: dict[int, list] = {qi: [] for qi in range(len(sorted_queues))}
+    running_gang_groups: dict[str, list] = {}
     for ri, r in enumerate(run_list):
         run_job_ids.append(r.job.id)
         run_req[ri] = factory.ceil_units(r.job.resources.atoms) if r.job.resources else 0
@@ -733,6 +741,11 @@ def build_problem(
         run_valid[ri] = True
         if preemptible:
             evictee_by_queue[qi].append(ri)
+            if r.job.gang_id:
+                # fate-sharing group for the partial-preemption cascade
+                running_gang_groups.setdefault(
+                    f"{r.job.queue}/{r.job.gang_id}", []
+                ).append(ri)
 
     run_gang = np.full((RJ,), -1, np.int32)
     for qi, ris in evictee_by_queue.items():
@@ -1213,6 +1226,11 @@ def build_problem(
             name: int(round(float(total_pool64[i]) * factory.resolutions[i]))
             for i, name in enumerate(factory.names)
             if total_pool64[i]
+        },
+        running_gangs={
+            tag: tuple(ris)
+            for tag, ris in running_gang_groups.items()
+            if len(ris) > 1
         },
     )
     return problem, ctx

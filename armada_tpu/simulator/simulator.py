@@ -259,16 +259,11 @@ class Simulator:
             )
             wf_delay = self.cluster_spec.workflow_manager_delay
             pend_delay = self.cluster_spec.pending_delay
-            for jid, node_id in outcome.scheduled.items():
-                job = self.queued.pop(jid)
-                tmpl = self.templates[self.job_template[jid]].template
-                runtime = tmpl.runtime.sample(self.rng)
-                start_delay = wf_delay.sample(self.rng) + pend_delay.sample(self.rng)
-                finish = self.now + start_delay + runtime
-                self.running[jid] = _Running(job, node_id, pool, finish)
-                self._push(finish, _FINISH, (jid, self.job_attempts.get(jid, 0)))
-                self.trace.append((self.now, "leased", jid))
-                progress = True
+            # Event order within a round mirrors the reference's publication
+            # order (simulator_test.go golden traces): preemptions first,
+            # then new leases, then the preempted jobs' RE-SUBMISSIONS (the
+            # reference models requeue as a fresh SubmitJob event).
+            requeued: list = []
             for jid in outcome.preempted:
                 run = self.running.pop(jid, None)
                 if run is None:
@@ -281,8 +276,21 @@ class Simulator:
                     self.failed.add(jid)
                     self.trace.append((self.now, "failed", jid))
                 else:
-                    self.queued[jid] = run.job
+                    requeued.append((jid, run.job))
                 progress = True
+            for jid, node_id in outcome.scheduled.items():
+                job = self.queued.pop(jid)
+                tmpl = self.templates[self.job_template[jid]].template
+                runtime = tmpl.runtime.sample(self.rng)
+                start_delay = wf_delay.sample(self.rng) + pend_delay.sample(self.rng)
+                finish = self.now + start_delay + runtime
+                self.running[jid] = _Running(job, node_id, pool, finish)
+                self._push(finish, _FINISH, (jid, self.job_attempts.get(jid, 0)))
+                self.trace.append((self.now, "leased", jid))
+                progress = True
+            for jid, job in requeued:
+                self.queued[jid] = job
+                self.trace.append((self.now, "resubmitted", jid))
             self._total_scheduled += len(outcome.scheduled)
 
             # per-queue actual share for the sink

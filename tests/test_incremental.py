@@ -59,14 +59,13 @@ def _job(jid, queue, cpu, pc="high", prio=0, sub=0.0, **kw):
 
 
 def _round(problem, ctx):
-    dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
-    result = schedule_round(
-        dev,
-        num_levels=len(ctx.ladder) + 2,
-        max_slots=ctx.max_slots,
-        slot_width=ctx.slot_width,
-    )
-    return decode_result(result, ctx)
+    # The production wrapper (gang-txn rollback + running-gang cascade), not
+    # a bare schedule_round: equivalence must hold on the path the scheduler
+    # actually runs.
+    from armada_tpu.models import run_round_on_device
+
+    _, outcome = run_round_on_device(problem, ctx, ctx.config)
+    return outcome
 
 
 def _outcomes_equal(a, b):
@@ -675,3 +674,46 @@ def test_running_gang_spec_refreshes_on_reprioritise():
     with jobdb.write_txn() as txn:
         txn.upsert(dataclasses.replace(txn.get("jg"), priority=7))
     assert b.running_gang_specs["jg"].priority == 7
+
+
+def test_running_gang_partial_preemption_cascades_both_modes():
+    """Running-gang fate-sharing (preempting_queue_scheduler.go:345-399 +
+    setEvictedGangCardinality; golden trace 'Preempted Gang Job'): a round
+    that preempts SOME members of a running gang preempts them ALL -- on the
+    from-scratch path and the incremental path alike."""
+    nodes = [_node("n0", cpu="4"), _node("n1", cpu="4")]
+    queues = [Queue("qa", 1.0), Queue("qb", 1.0)]
+    gang_running = [
+        RunningJob(
+            job=_job(f"gm{i}", "qa", 4, pc="low", sub=-1.0,
+                     gang_id="g1", gang_cardinality=2),
+            node_id=f"n{i}",
+        )
+        for i in range(2)
+    ]
+    # one high-priority job urgency-preempts ONE node's worth
+    intruder = [_job("hi1", "qb", 4, pc="high", sub=0.0)]
+
+    fresh = _round(*_fresh(nodes, queues, intruder, gang_running))
+    incr = _round(
+        *_incremental(nodes, queues, intruder, gang_running).assemble()
+    )
+    _outcomes_equal(fresh, incr)
+    assert sorted(fresh.preempted) == ["gm0", "gm1"], (
+        f"partial preemption must cascade to the whole running gang; "
+        f"got {sorted(fresh.preempted)}"
+    )
+    assert "hi1" in fresh.scheduled
+
+    # control: WITHOUT gang identity only one run is preempted
+    solo_running = [
+        RunningJob(job=_job(f"s{i}", "qa", 4, pc="low", sub=-1.0),
+                   node_id=f"n{i}")
+        for i in range(2)
+    ]
+    fresh2 = _round(*_fresh(nodes, queues, intruder, solo_running))
+    incr2 = _round(
+        *_incremental(nodes, queues, intruder, solo_running).assemble()
+    )
+    _outcomes_equal(fresh2, incr2)
+    assert len(fresh2.preempted) == 1

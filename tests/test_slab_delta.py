@@ -388,3 +388,37 @@ def test_ctx_id_snapshots_survive_post_assemble_mutations():
         id="r1", queue="q", resources=F.from_mapping({"cpu": 1, "memory": 1})),
         node_id="n0"))
     assert ctx2.run_ids_vec[rslot] == b"r0"
+
+
+def test_running_gang_cascade_on_slab_path():
+    """The partial-preemption cascade (run_round_on_device running-gang
+    fate-sharing) works off the SLAB context's running_gangs mapping: slot
+    indices, not table positions."""
+    from armada_tpu.models import run_round_on_device
+
+    cfg = make_config()
+    F, nodes, queues = make_world(cfg, None, num_nodes=2, num_queues=2)
+    # two full-node gang members running; a non-preemptible high job wants
+    # one node
+    driver = DualDriver(cfg, queues, nodes)
+    members = [
+        make_job(F, i, "q0", pc="low", cpu=16, gang="g1", sub=-1.0)
+        for i in range(2)
+    ]
+    leases = [RunningJob(job=m, node_id=f"n{i}") for i, m in enumerate(members)]
+    driver.each(lambda b: b.lease_many(leases))
+    driver.each(lambda b: [b.note_running_gang("q0", "g1", m.id) for m in members])
+    intruder = make_job(F, 9, "q1", pc="high", cpu=16)
+    driver.each(lambda b: b.submit(intruder))
+
+    problem, lctx = driver.legacy.assemble()
+    _, lout = run_round_on_device(problem, lctx, cfg)
+    bundle, sctx = driver.slab.assemble_delta()
+    assert sctx.running_gangs, "slab ctx lost the running-gang groups"
+    _, sout = run_round_on_device(
+        bundle.stats_view(), sctx, cfg, device_problem=driver.cache.apply(bundle)
+    )
+    for out in (lout, sout):
+        assert sorted(out.preempted) == ["j0", "j1"], out.preempted
+        assert "j9" in out.scheduled
+    assert sout.scheduled == lout.scheduled
