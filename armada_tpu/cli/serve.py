@@ -383,6 +383,9 @@ def start_control_plane(
             authenticator=authenticator,
             # serve: lookoutOidc: enables the browser login flow
             oidc=oidc,
+            # cancel/reprioritise from the UI ride the same SubmitServer
+            # (and therefore the same queue ACLs) as the gRPC verbs
+            submit=submit_server,
         )
 
     rest_gateway = None

@@ -1,0 +1,85 @@
+"""Minimal kubeconfig loader for out-of-cluster executors.
+
+The analog of client-go's clientcmd for the two auth modes the executor's
+REST plumbing speaks: bearer tokens and mTLS client certificates (what
+kind / admin kubeconfigs ship, ref:e2e/setup/kind.yaml dev flow).  Reads
+the current-context's cluster + user, materializing inline base64 data
+(certificate-authority-data etc.) into temp files, and returns the kwargs
+for KubernetesClusterContext.
+"""
+
+from __future__ import annotations
+
+import atexit
+import base64
+import os
+import tempfile
+from typing import Optional
+
+
+def _data_file(b64: str, suffix: str) -> str:
+    # delete=False so the ssl/urllib machinery can reopen by path, but the
+    # materialized credential (possibly a private key) must not outlive the
+    # process -- unlink at exit.
+    f = tempfile.NamedTemporaryFile(
+        prefix="armada-kubeconfig-", suffix=suffix, delete=False
+    )
+    f.write(base64.b64decode(b64))
+    f.close()
+    atexit.register(_unlink_quiet, f.name)
+    return f.name
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def load_kubeconfig(path: Optional[str] = None, context: Optional[str] = None) -> dict:
+    """Returns {base_url, token?, ca_file?, client_cert_file?,
+    client_key_file?, insecure?} for KubernetesClusterContext(**kw minus
+    base_url/factory)."""
+    import yaml
+
+    path = path or os.environ.get(
+        "KUBECONFIG", os.path.expanduser("~/.kube/config")
+    )
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    ctx_name = context or doc.get("current-context")
+    contexts = {e["name"]: e.get("context", {}) for e in doc.get("contexts", ())}
+    clusters = {e["name"]: e.get("cluster", {}) for e in doc.get("clusters", ())}
+    users = {e["name"]: e.get("user", {}) for e in doc.get("users", ())}
+    if ctx_name not in contexts:
+        raise ValueError(f"kubeconfig {path}: no context {ctx_name!r}")
+    ctx = contexts[ctx_name]
+    cluster = clusters.get(ctx.get("cluster"), {})
+    user = users.get(ctx.get("user"), {})
+
+    out: dict = {"base_url": cluster.get("server", "")}
+    if cluster.get("insecure-skip-tls-verify"):
+        out["insecure"] = True
+    if cluster.get("certificate-authority"):
+        out["ca_file"] = cluster["certificate-authority"]
+    elif cluster.get("certificate-authority-data"):
+        out["ca_file"] = _data_file(
+            cluster["certificate-authority-data"], ".crt"
+        )
+    if user.get("token"):
+        out["token"] = user["token"]
+    elif user.get("tokenFile"):
+        with open(user["tokenFile"]) as f:
+            out["token"] = f.read().strip()
+    if user.get("client-certificate"):
+        out["client_cert_file"] = user["client-certificate"]
+    elif user.get("client-certificate-data"):
+        out["client_cert_file"] = _data_file(
+            user["client-certificate-data"], ".crt"
+        )
+    if user.get("client-key"):
+        out["client_key_file"] = user["client-key"]
+    elif user.get("client-key-data"):
+        out["client_key_file"] = _data_file(user["client-key-data"], ".key")
+    return out

@@ -101,11 +101,15 @@ class KubernetesClusterContext:
         timeout_s: float = 30.0,
         executor_id: str = "",
         namespaces: Optional[Sequence[str]] = None,
+        client_cert_file: Optional[str] = None,
+        client_key_file: Optional[str] = None,
     ):
         """executor_id: stamped onto pods and used to filter listings, so two
         executors sharing a cluster never adopt each other's pods.
         namespaces: restrict pod listings to these namespaces (namespace-
-        scoped RBAC); None = cluster-scoped /api/v1/pods."""
+        scoped RBAC); None = cluster-scoped /api/v1/pods.
+        client_cert_file/client_key_file: mTLS client credentials (the auth
+        mode kind/admin kubeconfigs use; token auth is the alternative)."""
         self.base_url = base_url.rstrip("/")
         self._factory = factory
         self._token = token
@@ -121,6 +125,8 @@ class KubernetesClusterContext:
         self._pods: dict[str, tuple[str, str]] = {}
         if base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
+            if client_cert_file:
+                ctx.load_cert_chain(client_cert_file, client_key_file)
             if insecure:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE

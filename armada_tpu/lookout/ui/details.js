@@ -1,7 +1,38 @@
-// Job details side panel: spec fields, runs, errors, per-run log boxes.
+// Job details side panel: spec fields, runs, errors, per-run log boxes,
+// and the operator actions (cancel / reprioritise -- the reference UI's
+// CancelDialog / ReprioritiseDialog) for non-terminal jobs.
 import { $, esc, fmtT, stateCell } from "./util.js";
-import { j } from "./api.js";
+import { j, raw } from "./api.js";
 import { openLogs, stopAllLogTimers } from "./logs.js";
+
+const TERMINAL = new Set(["SUCCEEDED", "FAILED", "CANCELLED", "PREEMPTED"]);
+
+async function act(path, body, refreshId) {
+  try {
+    const r = await raw(path, {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify(body),
+    });
+    if (!r.ok) {
+      let msg = r.statusText;
+      try { msg = (await r.json()).error || msg; } catch (e) { /* non-JSON */ }
+      alert(`action failed: ${msg}`);
+      return;
+    }
+  } catch (e) {
+    alert(`action failed: ${e}`);
+    return;
+  }
+  // The action published an event; the lookout row updates only after the
+  // scheduler cycle + ingest catch up.  Poll briefly instead of refetching
+  // a guaranteed-stale row (which would re-show the button and invite a
+  // double click).
+  const pre = $("details").querySelector("h2");
+  if (pre) pre.textContent += " — action submitted…";
+  for (const b of $("details").querySelectorAll("button"))
+    if (b.id !== "close-details") b.disabled = true;
+  setTimeout(() => openDetails(refreshId), 2500);
+}
 
 export async function openDetails(id) {
   const d = await j("/api/job/" + encodeURIComponent(id));
@@ -25,9 +56,26 @@ export async function openDetails(id) {
     <dt>submitted</dt><dd>${fmtT(d.submitted_ns)}</dd>
     <dt>annotations</dt><dd><pre>${esc(JSON.stringify(d.annotations || {}, null, 1))}</pre></dd></dl>
     <h2>runs</h2>${runs || '<div class="empty">no runs</div>'}
+    ${TERMINAL.has(d.state) ? "" : `
+      <button id="act-cancel">cancel job</button>
+      <button id="act-reprio">reprioritise…</button>`}
     <button id="close-details">close</button>`;
   for (const b of $("details").querySelectorAll(".logbtn"))
     b.onclick = () => openLogs(d.job_id, b.dataset.run, !!b.dataset.live);
+  if ($("act-cancel")) $("act-cancel").onclick = () => {
+    const reason = prompt(`cancel ${d.job_id}? reason:`, "cancelled via UI");
+    if (reason === null) return;
+    act("/api/jobs/cancel",
+        {queue: d.queue, jobset: d.jobset, job_ids: [d.job_id], reason},
+        d.job_id);
+  };
+  if ($("act-reprio")) $("act-reprio").onclick = () => {
+    const p = prompt(`new priority for ${d.job_id}:`, String(d.priority));
+    if (p === null || p === "" || isNaN(+p)) return;
+    act("/api/jobs/reprioritize",
+        {queue: d.queue, jobset: d.jobset, job_ids: [d.job_id], priority: +p},
+        d.job_id);
+  };
   $("close-details").onclick = () => {
     $("details").classList.remove("open");
     stopAllLogTimers();

@@ -363,7 +363,8 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         if self._handle_oidc_routes(parsed.path, parse_qs(parsed.query)):
             return
-        if self._authed() is None:
+        principal = self._authed()
+        if principal is None:
             return
         srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
         path = parsed.path
@@ -374,6 +375,61 @@ class _Handler(BaseHTTPRequestHandler):
                 name = str(body.get("name", ""))
                 payload = json.dumps(body.get("payload", {}))
                 srv.queries.save_view(name, payload, now_ns=time.time_ns())
+                self._json({"ok": True})
+            elif path in ("/api/jobs/cancel", "/api/jobs/reprioritize"):
+                # Operator actions from the SPA (the reference UI's
+                # CancelDialog / ReprioritiseDialog, lookoutui/src/components
+                # /lookout) -- routed through the SAME SubmitServer the gRPC
+                # verbs use, so queue ACLs / permissions hold identically.
+                if srv.submit is None:
+                    self._json(
+                        {"error": "no submit server wired (read-only UI)"},
+                        501,
+                    )
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                from armada_tpu.server.auth import (
+                    AuthorizationError,
+                    Principal,
+                )
+                from armada_tpu.server.submit import SubmitError
+
+                p = (
+                    principal
+                    if isinstance(principal, Principal)
+                    else Principal()
+                )
+                job_ids = [str(j) for j in body.get("job_ids", [])]
+                if not job_ids:
+                    # SubmitServer treats empty ids as a JOBSET-wide action
+                    # (reprioritise semantics, submit.py); this per-job UI
+                    # surface must never widen a click into a mass action.
+                    self._json({"error": "job_ids must be non-empty"}, 400)
+                    return
+                try:
+                    if path == "/api/jobs/cancel":
+                        srv.submit.cancel_jobs(
+                            str(body["queue"]),
+                            str(body["jobset"]),
+                            job_ids,
+                            reason=str(body.get("reason", "cancelled via UI")),
+                            principal=p,
+                        )
+                    else:
+                        srv.submit.reprioritize_jobs(
+                            str(body["queue"]),
+                            str(body["jobset"]),
+                            int(body["priority"]),
+                            job_ids,
+                            principal=p,
+                        )
+                except AuthorizationError as exc:
+                    self._json({"error": str(exc)}, 403)
+                    return
+                except SubmitError as exc:
+                    self._json({"error": str(exc)}, 400)
+                    return
                 self._json({"ok": True})
             else:
                 self._json({"error": "not found"}, 404)
@@ -420,9 +476,14 @@ class LookoutWebUI:
         logs_of: Optional[Callable] = None,
         authenticator=None,
         oidc=None,
+        submit=None,
     ):
+        # `submit`: a server.submit.SubmitServer enabling the UI's operator
+        # actions (cancel / reprioritise, the reference UI's dialogs); None
+        # keeps the UI read-only (501 on the action endpoints).
         self.queries = queries
         self.logs_of = logs_of
+        self.submit = submit
         self.authenticator = authenticator
         if oidc is not None and isinstance(oidc, OidcWebConfig):
             if authenticator is None:

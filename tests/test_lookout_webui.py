@@ -281,3 +281,71 @@ def test_ui_gated_by_authenticator_chain():
     finally:
         ui.stop()
         lookoutdb.close()
+
+
+def test_action_endpoints_require_a_submit_server(world):
+    plane, pipeline, ui = world  # fixture wires no submit server
+    st, body = req(ui.port, "/api/jobs/cancel", "POST",
+                   {"queue": "qa", "jobset": "js1", "job_ids": ["x"]})
+    assert st == 501 and "read-only" in body["error"]
+
+
+def test_ui_cancel_and_reprioritize_actions(tmp_path):
+    """Operator actions from the SPA (the reference UI's CancelDialog /
+    ReprioritiseDialog) ride the SAME SubmitServer as the gRPC verbs."""
+    plane = ControlPlane.build(tmp_path)
+    plane.server.create_queue(QueueRecord("qa"))
+    lookoutdb = LookoutDb(":memory:")
+    pipeline = IngestionPipeline(
+        plane.log, lookoutdb, lookout_converter, consumer_name="lookout"
+    )
+    ui = LookoutWebUI(LookoutQueries(lookoutdb), submit=plane.server)
+    try:
+        ids = plane.server.submit_jobs(
+            "qa", "js1",
+            [JobSubmitItem(resources={"cpu": "1", "memory": "1"})] * 2,
+        )
+        pipeline.run_until_caught_up()
+
+        st, body = req(ui.port, "/api/jobs/reprioritize", "POST",
+                       {"queue": "qa", "jobset": "js1",
+                        "job_ids": [ids[0]], "priority": 7})
+        assert st == 200, body
+        st, body = req(ui.port, "/api/jobs/cancel", "POST",
+                       {"queue": "qa", "jobset": "js1",
+                        "job_ids": [ids[1]], "reason": "ui test"})
+        assert st == 200, body
+        plane.ingest()
+        plane.scheduler.cycle()
+        pipeline.run_until_caught_up()
+        d0 = get(ui.port, f"/api/job/{ids[0]}")
+        d1 = get(ui.port, f"/api/job/{ids[1]}")
+        assert d0["priority"] == 7
+        assert d1["state"] == "CANCELLED"
+        # unknown queue surfaces as a client error, not a 500
+        st, body = req(ui.port, "/api/jobs/cancel", "POST",
+                       {"queue": "nope", "jobset": "x", "job_ids": ["y"]})
+        assert st in (400, 404)
+    finally:
+        ui.stop()
+        lookoutdb.close()
+        plane.close()
+
+
+def test_reprioritize_rejects_empty_job_ids(tmp_path):
+    """Empty job_ids means JOBSET-wide to SubmitServer; the per-job UI
+    endpoint must never widen a click into a mass action."""
+    plane = ControlPlane.build(tmp_path)
+    plane.server.create_queue(QueueRecord("qa"))
+    lookoutdb = LookoutDb(":memory:")
+    ui = LookoutWebUI(LookoutQueries(lookoutdb), submit=plane.server)
+    try:
+        for path in ("/api/jobs/reprioritize", "/api/jobs/cancel"):
+            st, body = req(ui.port, path, "POST",
+                           {"queue": "qa", "jobset": "js", "priority": 1,
+                            "job_ids": []})
+            assert st == 400 and "non-empty" in body["error"], (path, body)
+    finally:
+        ui.stop()
+        lookoutdb.close()
+        plane.close()
