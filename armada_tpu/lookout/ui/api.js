@@ -19,6 +19,27 @@ export async function j(url, init) {
   return r.json();
 }
 
+// Shared operator-action POST (details panel + jobset rows): returns null
+// on success or an error message; rides raw() so an expired OIDC session
+// bounces to /login like every other API call.
+export async function postAction(path, body) {
+  try {
+    const r = await raw(path, {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify(body),
+    });
+    if (!r.ok) {
+      let msg = r.statusText;
+      try { msg = (await r.json()).error || msg; } catch (e) { /* non-JSON */ }
+      return msg;
+    }
+    return null;
+  } catch (e) {
+    if (e instanceof AuthRequired) throw e;
+    return String(e);
+  }
+}
+
 // Raw variant for callers that need status + body (logs viewer).
 export async function raw(url, init) {
   const r = await fetch(url, init);

@@ -3,7 +3,7 @@
 // routing, saved views, identity chip.  Capability map of the reference's
 // React lookout UI (internal/lookoutui/src/App.tsx) over the same JSON API.
 import { $, esc, fmtT, dark, meterHTML, chipsHTML, stateCell } from "./util.js";
-import { j, AuthRequired } from "./api.js";
+import { j, postAction, AuthRequired } from "./api.js";
 import { renderWhoami } from "./auth.js";
 import { applyHash, syncHash } from "./router.js";
 import { loadViews, wireViews } from "./views.js";
@@ -58,14 +58,53 @@ async function loadContent() {
     const note = d.truncated
       ? `<div class="empty">showing the ${d.groups.length} largest groups — refine the filters to see the rest</div>`
       : "";
+    // Jobset mass actions (CancelJobSetsDialog / ReprioritizeJobSetsDialog
+    // parity) need an unambiguous queue: offered whenever a queue filter is
+    // set (drilldown, hand-typed, or a saved view -- the server validates
+    // the exact queue name either way).
+    const qname = $("f-queue").value.trim();
+    const jsActions = group === "jobset" && qname;
     $("content").innerHTML = `<table><thead><tr><th>${esc(group)}</th>
-      <th class="num">jobs</th><th>states</th></tr></thead><tbody>` +
+      <th class="num">jobs</th><th>states</th>${jsActions ? "<th></th>" : ""}</tr></thead><tbody>` +
       d.groups.map((g) => {
         const total = g.count;
         return `<tr data-group="${esc(g.group)}"><td>${esc(g.group)}</td>
           <td class="num">${g.count}</td>
-          <td><div class="mini">${meterHTML(g.states, total)}</div></td></tr>`;
+          <td><div class="mini">${meterHTML(g.states, total)}</div></td>
+          ${jsActions ? `<td><button class="logbtn js-cancel" data-js="${esc(g.group)}">cancel set</button>
+            <button class="logbtn js-reprio" data-js="${esc(g.group)}">reprioritise…</button></td>` : ""}</tr>`;
       }).join("") + "</tbody></table>" + note;
+    if (jsActions) {
+      const doAct = async (btn, path, body) => {
+        // disable the row's buttons until the refresh: the lookout rows
+        // lag the scheduler cycle, and a still-live button invites a
+        // duplicate jobset-wide action (same guard as details.js act())
+        const row = btn.closest("tr");
+        for (const b of row.querySelectorAll("button")) b.disabled = true;
+        const err = await postAction(path, body);
+        if (err !== null) {
+          alert(`action failed: ${err}`);
+          for (const b of row.querySelectorAll("button")) b.disabled = false;
+          return;
+        }
+        setTimeout(() => refresh(), 2000);
+      };
+      for (const b of $("content").querySelectorAll(".js-cancel"))
+        b.onclick = (ev) => {
+          ev.stopPropagation();
+          if (!confirm(`cancel ALL jobs of jobset "${b.dataset.js}"?`)) return;
+          doAct(b, "/api/jobsets/cancel",
+                {queue: qname, jobset: b.dataset.js});
+        };
+      for (const b of $("content").querySelectorAll(".js-reprio"))
+        b.onclick = (ev) => {
+          ev.stopPropagation();
+          const p = prompt(`new priority for every job of "${b.dataset.js}":`);
+          if (p === null || p === "" || isNaN(+p)) return;
+          doAct(b, "/api/jobsets/reprioritize",
+                {queue: qname, jobset: b.dataset.js, priority: +p});
+        };
+    }
     for (const tr of $("content").querySelectorAll("tr[data-group]")) {
       tr.onclick = () => {
         const v = tr.dataset.group;

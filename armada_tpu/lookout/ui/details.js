@@ -2,27 +2,14 @@
 // and the operator actions (cancel / reprioritise -- the reference UI's
 // CancelDialog / ReprioritiseDialog) for non-terminal jobs.
 import { $, esc, fmtT, stateCell } from "./util.js";
-import { j, raw } from "./api.js";
+import { j, postAction } from "./api.js";
 import { openLogs, stopAllLogTimers } from "./logs.js";
 
 const TERMINAL = new Set(["SUCCEEDED", "FAILED", "CANCELLED", "PREEMPTED"]);
 
 async function act(path, body, refreshId) {
-  try {
-    const r = await raw(path, {
-      method: "POST", headers: {"Content-Type": "application/json"},
-      body: JSON.stringify(body),
-    });
-    if (!r.ok) {
-      let msg = r.statusText;
-      try { msg = (await r.json()).error || msg; } catch (e) { /* non-JSON */ }
-      alert(`action failed: ${msg}`);
-      return;
-    }
-  } catch (e) {
-    alert(`action failed: ${e}`);
-    return;
-  }
+  const err = await postAction(path, body);
+  if (err !== null) { alert(`action failed: ${err}`); return; }
   // The action published an event; the lookout row updates only after the
   // scheduler cycle + ingest catch up.  Poll briefly instead of refetching
   // a guaranteed-stale row (which would re-show the button and invite a

@@ -358,6 +358,70 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError) as exc:
             self._json({"error": str(exc)}, 400)
 
+    def _operator_action(self, srv, principal, path: str) -> None:
+        """Shared prologue + error mapping for the SPA's operator actions:
+        submit-server presence, body parse, principal coercion, and the
+        AuthorizationError->403 / SubmitError->400 mapping live ONCE here."""
+        if srv.submit is None:
+            self._json(
+                {"error": "no submit server wired (read-only UI)"}, 501
+            )
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        from armada_tpu.server.auth import AuthorizationError, Principal
+        from armada_tpu.server.submit import SubmitError
+
+        p = principal if isinstance(principal, Principal) else Principal()
+        job_ids = [str(j) for j in body.get("job_ids", [])]
+        if path.startswith("/api/jobs/") and not job_ids:
+            # SubmitServer treats empty ids as a JOBSET-wide action
+            # (reprioritise semantics, submit.py); the per-job surface must
+            # never widen a click into a mass action.  The /api/jobsets/*
+            # endpoints are the deliberate mass-action surface.
+            self._json({"error": "job_ids must be non-empty"}, 400)
+            return
+        try:
+            if path == "/api/jobsets/cancel":
+                srv.submit.cancel_jobset(
+                    str(body["queue"]),
+                    str(body["jobset"]),
+                    states=[str(s) for s in body.get("states", [])],
+                    reason=str(body.get("reason", "jobset cancelled via UI")),
+                    principal=p,
+                )
+            elif path == "/api/jobsets/reprioritize":
+                srv.submit.reprioritize_jobs(
+                    str(body["queue"]),
+                    str(body["jobset"]),
+                    int(body["priority"]),
+                    [],  # empty = the whole jobset (submit.py:277)
+                    principal=p,
+                )
+            elif path == "/api/jobs/cancel":
+                srv.submit.cancel_jobs(
+                    str(body["queue"]),
+                    str(body["jobset"]),
+                    job_ids,
+                    reason=str(body.get("reason", "cancelled via UI")),
+                    principal=p,
+                )
+            else:
+                srv.submit.reprioritize_jobs(
+                    str(body["queue"]),
+                    str(body["jobset"]),
+                    int(body["priority"]),
+                    job_ids,
+                    principal=p,
+                )
+        except AuthorizationError as exc:
+            self._json({"error": str(exc)}, 403)
+            return
+        except SubmitError as exc:
+            self._json({"error": str(exc)}, 400)
+            return
+        self._json({"ok": True})
+
     def do_POST(self):  # noqa: N802
         self.session_principal = None
         parsed = urlparse(self.path)
@@ -376,61 +440,17 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = json.dumps(body.get("payload", {}))
                 srv.queries.save_view(name, payload, now_ns=time.time_ns())
                 self._json({"ok": True})
-            elif path in ("/api/jobs/cancel", "/api/jobs/reprioritize"):
+            elif path in (
+                "/api/jobsets/cancel",
+                "/api/jobsets/reprioritize",
+                "/api/jobs/cancel",
+                "/api/jobs/reprioritize",
+            ):
                 # Operator actions from the SPA (the reference UI's
-                # CancelDialog / ReprioritiseDialog, lookoutui/src/components
-                # /lookout) -- routed through the SAME SubmitServer the gRPC
-                # verbs use, so queue ACLs / permissions hold identically.
-                if srv.submit is None:
-                    self._json(
-                        {"error": "no submit server wired (read-only UI)"},
-                        501,
-                    )
-                    return
-                length = int(self.headers.get("Content-Length", "0"))
-                body = json.loads(self.rfile.read(length) or b"{}")
-                from armada_tpu.server.auth import (
-                    AuthorizationError,
-                    Principal,
-                )
-                from armada_tpu.server.submit import SubmitError
-
-                p = (
-                    principal
-                    if isinstance(principal, Principal)
-                    else Principal()
-                )
-                job_ids = [str(j) for j in body.get("job_ids", [])]
-                if not job_ids:
-                    # SubmitServer treats empty ids as a JOBSET-wide action
-                    # (reprioritise semantics, submit.py); this per-job UI
-                    # surface must never widen a click into a mass action.
-                    self._json({"error": "job_ids must be non-empty"}, 400)
-                    return
-                try:
-                    if path == "/api/jobs/cancel":
-                        srv.submit.cancel_jobs(
-                            str(body["queue"]),
-                            str(body["jobset"]),
-                            job_ids,
-                            reason=str(body.get("reason", "cancelled via UI")),
-                            principal=p,
-                        )
-                    else:
-                        srv.submit.reprioritize_jobs(
-                            str(body["queue"]),
-                            str(body["jobset"]),
-                            int(body["priority"]),
-                            job_ids,
-                            principal=p,
-                        )
-                except AuthorizationError as exc:
-                    self._json({"error": str(exc)}, 403)
-                    return
-                except SubmitError as exc:
-                    self._json({"error": str(exc)}, 400)
-                    return
-                self._json({"ok": True})
+                # Cancel/Reprioritise dialogs, per-job and jobset-wide) --
+                # routed through the SAME SubmitServer the gRPC verbs use,
+                # so queue ACLs / permissions hold identically.
+                self._operator_action(srv, principal, path)
             else:
                 self._json({"error": "not found"}, 404)
         except (ValueError, KeyError) as exc:

@@ -349,3 +349,38 @@ def test_reprioritize_rejects_empty_job_ids(tmp_path):
         ui.stop()
         lookoutdb.close()
         plane.close()
+
+
+def test_jobset_mass_actions(tmp_path):
+    """Jobset-wide cancel/reprioritise (the reference UI's
+    CancelJobSetsDialog / ReprioritizeJobSetsDialog) -- the deliberate
+    mass-action endpoints, distinct from the per-job ones."""
+    plane = ControlPlane.build(tmp_path)
+    plane.server.create_queue(QueueRecord("qa"))
+    lookoutdb = LookoutDb(":memory:")
+    pipeline = IngestionPipeline(
+        plane.log, lookoutdb, lookout_converter, consumer_name="lookout"
+    )
+    ui = LookoutWebUI(LookoutQueries(lookoutdb), submit=plane.server)
+    try:
+        ids = plane.server.submit_jobs(
+            "qa", "massjs",
+            [JobSubmitItem(resources={"cpu": "1", "memory": "1"})] * 3,
+        )
+        st, body = req(ui.port, "/api/jobsets/reprioritize", "POST",
+                       {"queue": "qa", "jobset": "massjs", "priority": 9})
+        assert st == 200, body
+        st, body = req(ui.port, "/api/jobsets/cancel", "POST",
+                       {"queue": "qa", "jobset": "massjs"})
+        assert st == 200, body
+        plane.ingest()
+        plane.scheduler.cycle()
+        pipeline.run_until_caught_up()
+        for jid in ids:
+            d = get(ui.port, f"/api/job/{jid}")
+            assert d["state"] == "CANCELLED", d
+            assert d["priority"] == 9
+    finally:
+        ui.stop()
+        lookoutdb.close()
+        plane.close()
