@@ -12,10 +12,12 @@ nodes): identical scheduled JOB sets, identical preempted run sets, and
 identical per-queue scheduled counts (node ids may differ only on exact
 score ties; submit times are unique to keep ordering deterministic).
 
-Eviction scenarios pin protected_fraction = 0.0 (any usage evicts every
-preemptible run -- decidable without replicating the water-filling shares)
-or leave it high (no eviction); the in-between band is covered by the
-scenario tests.
+Eviction coverage spans the whole protected-fraction range: 0.0 (any usage
+evicts every preemptible run), INTERMEDIATE fractions (the reference's
+production shape -- the oracle independently reimplements the water-filling
+fair-share redistribution of context/scheduling.go updateFairShares and the
+pqs.go:146-156 gate, cross-checking the kernel's ops/fairness.fair_shares),
+and high (no eviction).
 """
 
 import numpy as np
@@ -128,17 +130,120 @@ class _Oracle:
         free32 = self._allocatable(nid, level).astype(np.float32)
         return float((free32 * self.inv_scale32).sum(dtype=np.float32))
 
+    # --- protected fair share (pqs.go:146-156 + scheduling.go:220-300) ------
+    def _water_fill_shares(self, weights, cds, max_iterations=10):
+        """Scalar per-queue transcription of the REFERENCE's updateFairShares
+        loop (context/scheduling.go:220-300): queues capped at their
+        constrained demand re-share spare capacity by weight until it is
+        gone.  Structured after the Go per-queue loops -- NOT after the
+        kernel's vectorized ops/fairness op -- so a transcription error in
+        the kernel cannot hide here.  f32 scalars because scores/costs are
+        f32-canonical everywhere (this file's parity discipline); the
+        gate consumes only fair_share and the demand-capped share."""
+        f = np.float32
+        qs = [
+            {"w": f(w), "cds": f(c), "dcafs": f(0.0), "achieved": False}
+            for w, c in zip(weights, cds)
+        ]
+        weight_sum = f(0.0)
+        for q in qs:
+            weight_sum = f(weight_sum + q["w"])
+        fair_share = np.array(
+            [f(q["w"] / weight_sum) if weight_sum > 0 else f(0.0) for q in qs],
+            np.float32,
+        )
+        unallocated = f(1.0)  # proportion of the cluster shared each pass
+        for _ in range(max_iterations):
+            if not (unallocated > 0.01):
+                break
+            total_weight = f(0.0)
+            for q in qs:
+                if not q["achieved"]:
+                    total_weight = f(total_weight + q["w"])
+            if total_weight <= 0.0:
+                break
+            for q in qs:
+                if not q["achieved"]:
+                    q["dcafs"] = f(
+                        q["dcafs"] + f(q["w"] / total_weight) * unallocated
+                    )
+            unallocated = f(0.0)
+            for q in qs:
+                spare = f(q["dcafs"] - q["cds"])
+                if spare > 0:
+                    q["dcafs"] = q["cds"]
+                    q["achieved"] = True
+                    unallocated = f(unallocated + spare)
+        return fair_share, np.array([q["dcafs"] for q in qs], np.float32)
+
+    def _protected_over(self) -> dict:
+        """queue -> 'allocation exceeds protected fraction of fair share'
+        (the eviction gate).  Demand/shares follow the reference: constrained
+        demand = queued + running request sums capped at the pool total; the
+        fair share each queue is measured against is
+        max(demand-capped-adjusted, plain weight share)."""
+        cfg = self.config
+        assert not any(
+            pc.maximum_resource_fraction_per_queue
+            for pc in cfg.priority_classes.values()
+        ), "oracle does not model per-(queue,pc) demand caps"
+        qnames = self.qorder
+        w = np.array(
+            [self.queues[q].weight for q in qnames], np.float32
+        )
+        demand = {q: np.zeros(len(RES), np.float64) for q in qnames}
+        for j in self.jobs:
+            if j.queue in demand:
+                demand[j.queue] += req_units(j.resources).astype(np.float64)
+        for r in self.running:
+            if r.job.queue in demand:
+                demand[r.job.queue] += req_units(r.job.resources).astype(
+                    np.float64
+                )
+        total64 = self.total_pool.astype(np.float64)
+        cds = np.zeros(len(qnames), np.float32)
+        for i, q in enumerate(qnames):
+            capped = np.minimum(demand[q], total64)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                frac = np.where(total64 > 0, capped / np.maximum(total64, 1e-9), 0.0)
+            cds[i] = max(0.0, float((frac * self.drf32.astype(np.float64)).max()))
+        fair_share, dcafs = self._water_fill_shares(w, cds)
+        out = {}
+        for i, q in enumerate(qnames):
+            # unweighted DRF cost of the CURRENT allocation (f32, kernel's
+            # unweighted_drf_cost arithmetic)
+            alloc32 = self.alloc[q].astype(np.float32)
+            total32 = self.total_pool.astype(np.float32)
+            frac32 = (
+                np.where(total32 > 0, alloc32 / np.where(total32 > 0, total32, 1), 0)
+                .astype(np.float32)
+                * self.drf32
+            )
+            actual = np.float32(max(np.float32(0), frac32.max()))
+            fairsh = np.float32(max(dcafs[i], fair_share[i]))
+            frac = actual / fairsh if fairsh > 0 else np.inf
+            out[q] = bool(
+                frac > np.float32(cfg.protected_fraction_of_fair_share)
+                and w[i] > 0
+            )
+        return out
+
     def run(self):
         cfg = self.config
         # --- phase A: fair-share eviction (pqs.go:117-160) -------------------
+        # The water-fill (and its no-per-(queue,pc)-caps assert) only runs
+        # when the gate can conceivably trip: actual/fairsh is bounded by
+        # ~1/min_fair_share, so a sentinel-huge protected fraction (the
+        # default CFG's 1e9) means no queue ever evicts.
+        if cfg.protected_fraction_of_fair_share < 1e6:
+            over_by_queue = self._protected_over()
+        else:
+            over_by_queue = {}
         evicted = []  # list of (RunningJob, level)
         for r in self.running:
             pc = cfg.priority_class(r.job.priority_class)
             preemptible = True if r.away else pc.preemptible
-            over = (
-                self._cost(r.job.queue, np.zeros(len(RES))) > 0
-                and cfg.protected_fraction_of_fair_share <= 0.0
-            )
+            over = over_by_queue.get(r.job.queue, False)
             if preemptible and over:
                 lvl = self._run_level(r)
                 req = req_units(r.job.resources)
@@ -523,3 +628,69 @@ def test_market_bid_ordering(seed):
     )
     prices = {q.name: float(rng.integers(1, 10)) for q in queues}
     _compare(cfg, nodes, queues, jobs, running, prices=prices, seed=seed)
+
+
+@pytest.mark.parametrize("seed,protected", [
+    (2, 0.25), (5, 0.25), (9, 0.5), (13, 0.5), (17, 0.5),
+    (23, 1.0), (31, 1.0), (41, 2.0),
+])
+def test_protected_fair_share_intermediate(seed, protected):
+    """INTERMEDIATE protected fractions (the reference's production shape,
+    pqs.go:146-156): only queues whose allocation exceeds `protected` x
+    max(demand-capped-adjusted fair share, weight share) evict -- the oracle
+    independently reimplements the water-filling share computation
+    (context/scheduling.go updateFairShares), so the kernel's fair_shares op
+    is cross-checked, not mirrored."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, protected_fraction_of_fair_share=protected
+    )
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=120, num_jobs=150, num_running=60, gangs=0
+    )
+    _compare(cfg, nodes, queues, jobs, running, seed=seed)
+
+
+def test_protected_fraction_gates_eviction_directionally():
+    """Deterministic sanity around the gate: an over-allocated queue evicts
+    at a low protected fraction and is protected at a high one."""
+    import dataclasses
+
+    nodes = [
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": "8", "memory": "32"}),
+        )
+        for i in range(4)
+    ]
+    queues = [Queue("hog", 1.0), Queue("starved", 1.0)]
+    # hog runs 4 full nodes; starved wants one job
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"r{i}", queue="hog", priority_class="low",
+                submit_time=-1.0 - i,
+                resources=F.from_mapping({"cpu": "8", "memory": "8"}),
+            ),
+            node_id=f"n{i}",
+        )
+        for i in range(4)
+    ]
+    jobs = [
+        JobSpec(
+            id="j0", queue="starved", priority_class="low", submit_time=0.0,
+            resources=F.from_mapping({"cpu": "8", "memory": "8"}),
+        )
+    ]
+    # hog's actual share ~1.0.  Water-filling raises hog's demand-capped
+    # fair share to 0.75 (starved's capped demand is only 0.25; its spare
+    # re-shares to hog), so frac = 1.0/0.75 ~ 1.33.  protected=1: evicts
+    # (1.33 > 1), starved schedules.  protected=4: protected, nothing moves.
+    lo = dataclasses.replace(CFG, protected_fraction_of_fair_share=1.0)
+    hi = dataclasses.replace(CFG, protected_fraction_of_fair_share=4.0)
+    out_lo = _compare(lo, nodes, queues, jobs, running, seed=0)
+    out_hi = _compare(hi, nodes, queues, jobs, running, seed=1)
+    assert "j0" in out_lo.scheduled and len(out_lo.preempted) == 1
+    assert not out_hi.preempted and not out_hi.scheduled
