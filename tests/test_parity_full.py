@@ -17,7 +17,12 @@ evicts every preemptible run), INTERMEDIATE fractions (the reference's
 production shape -- the oracle independently reimplements the water-filling
 fair-share redistribution of context/scheduling.go updateFairShares and the
 pqs.go:146-156 gate, cross-checking the kernel's ops/fairness.fair_shares),
-and high (no eviction).
+and high (no eviction).  Per-(queue, pc) allocation caps
+(maximumResourceFractionPerQueue) are modeled too: the gate runs BEFORE the
+fit check, a trip does not place the candidate and KILLS the queue for the
+round (new candidates stop, evictees keep re-placing) -- the semantics
+tests/test_market_columnar.py pinned for the mega round, now cross-checked
+for the real round against this oracle.
 """
 
 import numpy as np
@@ -98,10 +103,34 @@ class _Oracle:
         )
         self.jobs = list(jobs)
         self.running = list(running)
+        # per-(queue, pc) allocation + the f32 cap thresholds
+        # (maximumResourceFractionPerQueue, constraints.go): the gate
+        # compares f32, but unit-quantised requests are integral so the
+        # running sums stay exact; only the THRESHOLD rounds (frac x f32
+        # total, transcribed from the config semantics, not the builder).
+        # RESTRICTION: the threshold derives from NODE capacity only --
+        # floating totals join total_pool for caps in the builder
+        # (problem.py:1026-1041), so cap worlds with floating resources
+        # would need float totals added here first.
+        self.alloc_pc = {
+            q.name: {pc: np.zeros(len(RES)) for pc in config.priority_classes}
+            for q in queues
+        }
+        self.pc_cap = {}
+        tp32 = self.total_pool.astype(np.float32)
+        for pc_name, pc in config.priority_classes.items():
+            cap = np.full(len(RES), np.inf, np.float32)
+            for rname, frac in pc.maximum_resource_fraction_per_queue.items():
+                if rname in RES:
+                    cap[RES.index(rname)] = np.float32(frac * tp32[RES.index(rname)])
+            self.pc_cap[pc_name] = cap
         for r in running:
             lvl = self._run_level(r)
             self.usage[r.node_id][lvl] += req_units(r.job.resources)
             self.alloc[r.job.queue] += req_units(r.job.resources)
+            self.alloc_pc[r.job.queue][r.job.priority_class] += req_units(
+                r.job.resources
+            )
 
     def _run_level(self, r: RunningJob) -> int:
         if r.away:
@@ -250,6 +279,7 @@ class _Oracle:
                 self.usage[r.node_id][lvl] -= req
                 self.usage[r.node_id][0] += req  # evicted marker
                 self.alloc[r.job.queue] -= req
+                self.alloc_pc[r.job.queue][r.job.priority_class] -= req
                 evicted.append((r, lvl))
 
         # --- candidate streams per queue -------------------------------------
@@ -380,6 +410,7 @@ class _Oracle:
                     self.usage[r.node_id][0] -= req
                     self.usage[r.node_id][lvl] += req
                     self.alloc[q] += req
+                    self.alloc_pc[q][r.job.priority_class] += req
                     rescheduled.add(r.job.id)
                 heads[q] += 1
                 continue
@@ -391,10 +422,19 @@ class _Oracle:
             if sched_members + card > burst:
                 new_blocked = True
                 continue
-            if q_sched[q] + card > perq_burst:
+            pc = cfg.priority_class(lead.priority_class)
+            hit_q_cap = bool(
+                np.any(
+                    (self.alloc_pc[q][pc.name] + req * card).astype(np.float32)
+                    > self.pc_cap[pc.name]
+                )
+            )
+            if q_sched[q] + card > perq_burst or hit_q_cap:
+                # per-queue gate (kernel gate_queue -> q_killed): the
+                # tripping candidate does NOT place and the queue stops
+                # producing NEW candidates; evictees keep re-placing.
                 q_blocked.add(q)
                 continue
-            pc = cfg.priority_class(lead.priority_class)
             level = self.level_of[pc.priority]
             feasible, spread = fit_nodes(req, level, card, clean=True)
             if not feasible:
@@ -411,6 +451,7 @@ class _Oracle:
                     mi += 1
                 self.usage[nid][level] += req * count
             self.alloc[q] += req * card
+            self.alloc_pc[q][pc.name] += req * card
             sched_members += card
             q_sched[q] += card
             heads[q] += 1
@@ -437,6 +478,7 @@ class _Oracle:
             self.usage[r.node_id][lvl] -= req
             self.usage[r.node_id][0] += req
             self.alloc[r.job.queue] -= req
+            self.alloc_pc[r.job.queue][r.job.priority_class] -= req
             rescheduled.discard(r.job.id)
             over_evicted.append((r, lvl))
         # pinned re-schedule fixed point (pqs.go:222-247): per iteration each
@@ -472,6 +514,7 @@ class _Oracle:
                 self.usage[r.node_id][0] -= req
                 self.usage[r.node_id][lvl] += req
                 self.alloc[r.job.queue] += req
+                self.alloc_pc[r.job.queue][r.job.priority_class] += req
                 rescheduled.add(r.job.id)
                 pending = [(p, pl) for p, pl in pending if p.job.id != r.job.id]
                 progress = True
@@ -694,3 +737,86 @@ def test_protected_fraction_gates_eviction_directionally():
     out_hi = _compare(hi, nodes, queues, jobs, running, seed=1)
     assert "j0" in out_lo.scheduled and len(out_lo.preempted) == 1
     assert not out_hi.preempted and not out_hi.scheduled
+
+
+CAP_CFG = SchedulingConfig(
+    shape_bucket=32,
+    priority_classes={
+        "low": PriorityClass(
+            "low", priority=100, preemptible=True,
+            maximum_resource_fraction_per_queue={"cpu": 0.01},
+        ),
+        "high": PriorityClass("high", priority=1000, preemptible=False),
+    },
+    default_priority_class="high",
+    protected_fraction_of_fair_share=1e9,
+)
+
+
+@pytest.mark.parametrize("seed", [6, 12, 21, 34, 47])
+def test_per_queue_pc_caps_kill_queues_midround(seed):
+    """maximumResourceFractionPerQueue (constraints.go CheckJobConstraints):
+    a candidate whose (queue, pc) allocation would cross the cap trips the
+    per-queue gate, does NOT place, and KILLS its queue for the round (new
+    candidates stop; evictees still re-place).  Random worlds where the
+    'low' class's 1% cpu cap trips mid-round in most queues."""
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=60, num_jobs=250, num_running=30, gangs=0
+    )
+    outcome = _compare(CAP_CFG, nodes, queues, jobs, running, seed=seed)
+    # sanity: the cap actually bit -- fewer low jobs scheduled than capacity
+    # alone would admit
+    low_sched = sum(
+        1 for j in jobs
+        if j.id in outcome.scheduled and j.priority_class == "low"
+    )
+    low_total = sum(1 for j in jobs if j.priority_class == "low")
+    assert low_sched < low_total, "cap never tripped; scenario too loose"
+
+
+def test_pc_cap_trip_is_a_kill_not_a_skip():
+    """Deterministic: the 3rd low job crosses the cap -> it does not place
+    AND the queue's later (smaller!) low job is dead too -- the reference
+    kills the queue, it does not skip past the tripping candidate."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CAP_CFG,
+        priority_classes={
+            "low": PriorityClass(
+                "low", priority=100, preemptible=True,
+                # pool = 4 nodes x 8 cpu = 32; cap = 0.1 x 32 = 3.2 cpu
+                maximum_resource_fraction_per_queue={"cpu": 0.1},
+            ),
+            "high": PriorityClass("high", priority=1000, preemptible=False),
+        },
+    )
+    nodes = [
+        NodeSpec(
+            id=f"n{i}", pool="default",
+            total_resources=F.from_mapping({"cpu": "8", "memory": "32"}),
+        )
+        for i in range(4)
+    ]
+    queues = [Queue("qa", 1.0), Queue("qb", 1.0)]
+    jobs = [
+        _mkjob("a1", "qa", 2, 0.1),
+        # a2 takes qa/low to 2+2=4 cpu > 3.2: trips, kills qa
+        _mkjob("a2", "qa", 2, 0.2),
+        # a3 WOULD pass the cap arithmetic on its own (2+1=3 <= 3.2) -- under
+        # skip-the-tripping-candidate semantics it places; under the
+        # reference's queue-kill it is dead.  This is the discriminating
+        # candidate that makes the test able to catch a kill->skip
+        # regression even if applied to kernel and oracle alike.
+        _mkjob("a3", "qa", 1, 0.3),
+        _mkjob("b1", "qb", 2, 0.5),
+    ]
+    outcome = _compare(cfg, nodes, queues, jobs, [], seed="kill-not-skip")
+    assert set(outcome.scheduled) == {"a1", "b1"}
+
+
+def _mkjob(jid, q, cpu, sub):
+    return JobSpec(
+        id=jid, queue=q, priority_class="low", submit_time=sub,
+        resources=F.from_mapping({"cpu": str(cpu), "memory": "1"}),
+    )
