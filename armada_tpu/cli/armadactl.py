@@ -532,6 +532,7 @@ def load_serve_config(args):
         serve_doc = {k.lower(): v for k, v in loaded["serve"].items()}
     # lookoutOidc is a nested mapping, not a scalar flag: config-file only
     args.lookout_oidc = serve_doc.get("lookoutoidc")
+    args.lookout_trust_proxy = bool(serve_doc.get("lookouttrustproxy", False))
     # Follower-to-leader proxy credential (reports proxying under a strict
     # authn chain).  Config-file only -- tokens do not belong on argv.
     # proxyBearerTokenFile wins over an inline proxyBearerToken.
@@ -580,6 +581,7 @@ def cmd_serve(args):
         profiling=args.profiling,
         lookout_port=args.lookout_port,
         lookout_oidc=getattr(args, "lookout_oidc", None),
+        lookout_trust_proxy=getattr(args, "lookout_trust_proxy", False),
         binoculars_url=args.binoculars_url,
         rest_port=args.rest_port,
         kube_lease_url=args.kube_lease_url,

@@ -110,6 +110,7 @@ def start_control_plane(
     bind_host: str = "127.0.0.1",
     authenticator=None,
     lookout_oidc=None,
+    lookout_trust_proxy: bool = False,
     advertised_address: Optional[str] = None,
     proxy_bearer_token: Optional[str] = None,
 ) -> ControlPlaneProcess:
@@ -383,6 +384,9 @@ def start_control_plane(
             authenticator=authenticator,
             # serve: lookoutOidc: enables the browser login flow
             oidc=oidc,
+            # serve: lookoutTrustProxy: honour X-Forwarded-* (reverse-proxy
+            # deployments only; client-controlled when exposed directly)
+            trust_proxy=lookout_trust_proxy,
             # cancel/reprioritise from the UI ride the same SubmitServer
             # (and therefore the same queue ACLs) as the gRPC verbs
             submit=submit_server,

@@ -395,6 +395,7 @@ class OidcSessionManager:
                         pass
             with self._lock:
                 self._sessions.pop(sid, None)
+                self._refresh_locks.pop(sid, None)
             return None
 
     def _refresh(self, sid: str, observed_access: str) -> bool:
@@ -421,6 +422,7 @@ class OidcSessionManager:
             if not refresh_token:
                 with self._lock:
                     self._sessions.pop(sid, None)
+                    self._refresh_locks.pop(sid, None)
                 return False
             try:
                 tokens = self._token_request(
@@ -437,6 +439,7 @@ class OidcSessionManager:
             with self._lock:
                 if not access:
                     self._sessions.pop(sid, None)
+                    self._refresh_locks.pop(sid, None)
                     return False
                 expires_in = float(tokens.get("expires_in") or 0)
                 old = self._sessions.get(sid)
@@ -481,8 +484,10 @@ class OidcSessionManager:
 
     @staticmethod
     def _set_cookie(sid: str, secure: bool) -> str:
-        # Secure whenever the browser reached us over https (X-Forwarded-
-        # Proto rides into redirect_uri): an https-deployed session cookie
-        # must never ride a cleartext request.
+        # Secure whenever the browser reached us over https (the scheme
+        # comes from redirect_uri; behind a TLS-terminating proxy that
+        # requires the UI's trust_proxy flag so X-Forwarded-Proto is
+        # honoured): an https-deployed session cookie must never ride a
+        # cleartext request.
         flags = "; Secure" if secure else ""
         return f"{SESSION_COOKIE}={sid}; Path=/; HttpOnly; SameSite=Lax{flags}"

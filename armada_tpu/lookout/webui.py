@@ -158,12 +158,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def _redirect_uri(self) -> str:
-        """The callback URL as the browser sees this server (reverse proxies
-        forward the original host/proto)."""
-        host = self.headers.get("X-Forwarded-Host") or self.headers.get(
-            "Host", "127.0.0.1"
-        )
-        proto = self.headers.get("X-Forwarded-Proto", "http")
+        """The callback URL as the browser sees this server.  X-Forwarded-*
+        are honoured only behind a declared reverse proxy (trust_proxy) --
+        on a directly exposed server they are client-controlled and could
+        steer the IdP redirect_uri (ADVICE r4)."""
+        srv: "LookoutWebUI" = self.server.owner  # type: ignore[attr-defined]
+        host = self.headers.get("Host", "127.0.0.1")
+        proto = "http"
+        if getattr(srv, "trust_proxy", False):
+            host = self.headers.get("X-Forwarded-Host") or host
+            proto = self.headers.get("X-Forwarded-Proto", proto)
         return f"{proto}://{host}/oauth/callback"
 
     def _authed(self) -> Optional["object"]:
@@ -497,20 +501,28 @@ class LookoutWebUI:
         authenticator=None,
         oidc=None,
         submit=None,
+        trust_proxy: bool = False,
     ):
         # `submit`: a server.submit.SubmitServer enabling the UI's operator
         # actions (cancel / reprioritise, the reference UI's dialogs); None
         # keeps the UI read-only (501 on the action endpoints).
+        # `trust_proxy`: honour X-Forwarded-Host/Proto when building the
+        # OIDC redirect_uri + cookie Secure flag.  Off by default -- on a
+        # directly exposed server those headers are client-controlled.
         self.queries = queries
         self.logs_of = logs_of
         self.submit = submit
         self.authenticator = authenticator
-        if oidc is not None and isinstance(oidc, OidcWebConfig):
-            if authenticator is None:
-                raise ValueError(
-                    "OIDC login needs an authenticator chain to validate "
-                    "tokens against (auth.oidc in the server config)"
-                )
+        self.trust_proxy = trust_proxy
+        if oidc is not None and authenticator is None:
+            # applies to the pre-built OidcSessionManager form too: a wired
+            # session manager with no chain would leave _authed()'s open dev
+            # default in front of it (ADVICE r4).
+            raise ValueError(
+                "OIDC login needs an authenticator chain to validate "
+                "tokens against (auth.oidc in the server config)"
+            )
+        if isinstance(oidc, OidcWebConfig):
             oidc = OidcSessionManager(oidc, authenticator)
         self.oidc: Optional[OidcSessionManager] = oidc
         self.page = _render_page()

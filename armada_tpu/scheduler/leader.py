@@ -90,8 +90,19 @@ class FileLeaseLeaderController:
 
     def leader_address(self) -> Optional[str]:
         lease = self._locked(self._read)
-        if lease is None or lease.get("holder") == self._holder:
+        if lease is None:
+            # No election yet: "" maps to the retryable UNAVAILABLE in the
+            # reports proxy; answering None here would have a replica that
+            # never won serve report queries from its empty local repository
+            # (ADVICE r4).
+            return ""
+        if lease.get("holder") == self._holder:
+            # Our lease -- even just-expired: local state is current and the
+            # next cycle's get_token renews/re-acquires.  Comparing our own
+            # write against our own clock can't skew-flap.
             return None
+        if self._clock() >= lease.get("expiry", 0):
+            return ""  # expired foreign lease: election gap, retry
         return lease.get("address") or ""
 
     # --- lease file access (always under flock) -----------------------------

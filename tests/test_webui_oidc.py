@@ -18,7 +18,11 @@ from armada_tpu.lookout.oidc import (
     SESSION_COOKIE,
 )
 from armada_tpu.lookout.webui import LookoutWebUI
-from armada_tpu.server.authn import MultiAuthenticator, OidcAuthenticator
+from armada_tpu.server.authn import (
+    AnonymousAuthenticator,
+    MultiAuthenticator,
+    OidcAuthenticator,
+)
 from tests.mock_idp import MockIdp
 
 
@@ -64,7 +68,10 @@ def flow():
     )
     db = LookoutDb(":memory:")
     ui = LookoutWebUI(
-        LookoutQueries(db), authenticator=chain, oidc=manager
+        # trust_proxy: the https/forwarded-host tests below simulate a
+        # reverse-proxy deployment; the untrusted default has its own test
+        LookoutQueries(db), authenticator=chain, oidc=manager,
+        trust_proxy=True,
     )
     yield idp, ui, offset, manager
     ui.stop()
@@ -338,6 +345,53 @@ def test_https_deployment_sets_secure_cookie(flow):
     _, cookie, _ = manager.handle_callback(
         cb, "https://lookout.example/oauth/callback")
     assert "Secure" in cookie
+
+
+def test_forwarded_headers_ignored_without_trust_proxy(tmp_path):
+    """On a directly exposed server (trust_proxy off, the default) a client
+    must not steer the redirect_uri via X-Forwarded-*: the IdP sees the real
+    Host (ADVICE r4)."""
+    chain = MultiAuthenticator([AnonymousAuthenticator()])
+    config = OidcWebConfig(
+        issuer="https://idp.example",
+        authorization_endpoint="https://idp.example/authorize",
+        token_endpoint="https://idp.example/token",
+        client_id="lookout-ui",
+    )
+    db = LookoutDb(":memory:")
+    ui = LookoutWebUI(LookoutQueries(db), authenticator=chain, oidc=config)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ui.port, timeout=10)
+        conn.request("GET", "/login?next=/", headers={
+            "X-Forwarded-Proto": "https",
+            "X-Forwarded-Host": "attacker.example",
+        })
+        r = conn.getresponse()
+        auth_url = r.getheader("Location")
+        r.read()
+        conn.close()
+        qs = {k: v[0] for k, v in parse_qs(urlparse(auth_url).query).items()}
+        assert qs["redirect_uri"] == (
+            f"http://127.0.0.1:{ui.port}/oauth/callback"
+        )
+    finally:
+        ui.stop()
+        db.close()
+
+
+def test_oidc_manager_without_authenticator_rejected():
+    """A pre-built session manager with no authn chain would leave the open
+    dev default in front of the UI -- constructor must refuse (ADVICE r4)."""
+    db = LookoutDb(":memory:")
+    try:
+        with pytest.raises(ValueError):
+            LookoutWebUI(
+                LookoutQueries(db),
+                oidc=object(),  # any non-None manager form
+                authenticator=None,
+            )
+    finally:
+        db.close()
 
 
 def test_concurrent_refresh_is_single_flight(flow):
