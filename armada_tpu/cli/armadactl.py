@@ -505,6 +505,7 @@ _SERVE_FALLBACKS = {
     "lookout_port": None,
     "binoculars_url": None,
     "rest_port": None,
+    "algo_port": None,
     "bind_host": "127.0.0.1",
     "leader_id": None,
     "advertised_address": None,
@@ -551,6 +552,7 @@ def load_serve_config(args):
         "lookout_port": ("lookoutport", int),
         "binoculars_url": ("binocularsurl", str),
         "rest_port": ("restport", int),
+        "algo_port": ("algoport", int),
         "bind_host": ("bindhost", str),
         "leader_id": ("leaderid", str),
         "advertised_address": ("advertisedaddress", str),
@@ -584,6 +586,7 @@ def cmd_serve(args):
         lookout_trust_proxy=getattr(args, "lookout_trust_proxy", False),
         binoculars_url=args.binoculars_url,
         rest_port=args.rest_port,
+        algo_port=getattr(args, "algo_port", None),
         kube_lease_url=args.kube_lease_url,
         kube_lease_namespace=args.kube_lease_namespace,
         bind_host=args.bind_host,
@@ -783,6 +786,13 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         help="serve the grpc-gateway-parity REST/JSON API on this port "
         "(0 = pick a free one); the C++ client (client/cpp) targets it",
+    )
+    srv.add_argument(
+        "--algo-port",
+        type=int,
+        help="serve the scheduling sidecar (armada_tpu.api.Schedule: the "
+        "round kernel behind the SchedulingAlgo boundary for external "
+        "control planes) on this port (0 = pick a free one)",
     )
     srv.add_argument(
         "--bind-host",

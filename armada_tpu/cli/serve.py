@@ -61,6 +61,8 @@ class ControlPlaneProcess:
     health_server: object = None
     lookout_web: object = None
     rest_gateway: object = None
+    algo_port: Optional[int] = None
+    _algo_server: object = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -68,6 +70,8 @@ class ControlPlaneProcess:
         for p in self._pipelines:
             p.stop()
         self._grpc_server.stop(1).wait()
+        if self._algo_server is not None:
+            self._algo_server.stop(1).wait()
         if self.health_server is not None:
             self.health_server.stop()
         if self.lookout_web is not None:
@@ -113,6 +117,7 @@ def start_control_plane(
     lookout_trust_proxy: bool = False,
     advertised_address: Optional[str] = None,
     proxy_bearer_token: Optional[str] = None,
+    algo_port: Optional[int] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -404,6 +409,21 @@ def start_control_plane(
             authenticator=authenticator,
         )
 
+    # Scheduling sidecar (SURVEY §7 step 5): the round kernel as a gRPC
+    # backend for EXTERNAL control planes (scheduling_algo.go:36-41).  A
+    # dedicated port because its callers (a colocated Go scheduler) are a
+    # different trust/deployment surface from job submitters.
+    algo_server = None
+    algo_bound = None
+    if algo_port is not None:
+        from armada_tpu.scheduler.sidecar import ScheduleSidecar
+
+        algo_server, algo_bound = make_server(
+            schedule_sidecar=ScheduleSidecar(config),
+            address=f"{bind_host}:{algo_port}",
+            authenticator=authenticator,
+        )
+
     return ControlPlaneProcess(
         port=bound_port,
         scheduler=scheduler,
@@ -421,6 +441,8 @@ def start_control_plane(
         health_server=health_server,
         lookout_web=lookout_web,
         rest_gateway=rest_gateway,
+        algo_port=algo_bound,
+        _algo_server=algo_server,
     )
 
 

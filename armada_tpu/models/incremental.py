@@ -386,6 +386,12 @@ class IncrementalBuilder:
         self.bands: list[str] = [""]
         self._band_index: dict[str, int] = {"": 0}
         self._unknown_queue: dict[str, tuple] = {}
+        # Leases whose node or queue the builder has not seen yet: state can
+        # legitimately arrive runs-first (restart replay; a sidecar session
+        # syncing its mirror before the first round) and a silent drop would
+        # make every running job invisible to fairness/preemption.  Flushed
+        # by set_nodes/set_queues once the reference appears.
+        self._pending_runs: dict[str, RunningJob] = {}
 
         self.node_ids: list[str] = []
         self.node_index: dict[str, int] = {}
@@ -447,6 +453,7 @@ class IncrementalBuilder:
             for spec, bans in flush:
                 self._unknown_queue.pop(spec.id, None)
                 self.submit(spec, bans)
+        self._flush_pending_runs()
 
     # ------------------------------------------------------------- nodes ----
 
@@ -508,6 +515,7 @@ class IncrementalBuilder:
             self._node_epoch += 1
         if self._retype_needed:
             self._retype_nodes()
+        self._flush_pending_runs()
 
     def _retype_nodes(self) -> None:
         """A selector referenced a label outside the indexed set: node types
@@ -702,7 +710,9 @@ class IncrementalBuilder:
         for r in rs:
             ni = self.node_index.get(r.node_id)
             if ni is None or r.job.queue not in self.queue_by_name:
+                self._pending_runs[r.job.id] = r
                 continue
+            self._pending_runs.pop(r.job.id, None)
             if self.market and r.job.gang_id:
                 # Stored spec carries the priority current at lease time;
                 # reprioritisation of a running member refreshes it because
@@ -775,7 +785,20 @@ class IncrementalBuilder:
     def unlease(self, job_id: str) -> None:
         """The run ended (terminal or preempted)."""
         self.running_gang_specs.pop(job_id, None)
+        self._pending_runs.pop(job_id, None)
         self._release_run(self.runs.remove(job_id.encode()))
+
+    def _flush_pending_runs(self) -> None:
+        ready = [
+            r
+            for r in self._pending_runs.values()
+            if r.node_id in self.node_index
+            and r.job.queue in self.queue_by_name
+        ]
+        if ready:
+            for r in ready:
+                self._pending_runs.pop(r.job.id, None)
+            self.lease_many(ready)
 
     # ---------------------------------------------------------- assemble ----
 

@@ -443,3 +443,126 @@ class ExecutorApiClient(_Base):
             pb.ReportEventsRequest(sequences=list(sequences)),
             pb.Empty,
         )
+
+
+def job_state_of(job) -> "pb.JobState":
+    """jobdb Job -> JobState wire message: what a mirroring control plane
+    sends in SyncState (the Go caller builds the equivalent from ITS jobDb
+    rows, jobdb/job.go)."""
+    from armada_tpu.events.convert import job_spec_to_proto
+
+    msg = pb.JobState(
+        job_id=job.id,
+        queue=job.queue,
+        jobset=job.jobset,
+        spec=job_spec_to_proto(job.spec),
+        priority=int(job.priority),
+        queued=job.queued,
+        validated=job.validated,
+        pools=list(job.pools),
+        terminal=job.in_terminal_state(),
+        banned_nodes=list(job.anti_affinity_nodes()),
+        submit_time=job.spec.submit_time,
+    )
+    # Live runs always ride; a TERMINAL job's final run rides too -- the
+    # short-job penalty needs its pool + running_ns to keep charging the
+    # queue (short_job_penalty.py applies()).
+    run = job.latest_run
+    if run is not None and (
+        not run.in_terminal_state() or job.in_terminal_state()
+    ):
+        msg.run.MergeFrom(
+            pb.JobRunState(
+                run_id=run.id,
+                node_id=run.node_id,
+                node_name=run.node_name,
+                executor=run.executor,
+                pool=run.pool,
+                scheduled_at_priority=run.scheduled_at_priority or 0,
+                has_scheduled_at_priority=run.scheduled_at_priority is not None,
+                away=run.pool_scheduled_away,
+                running=run.running,
+                running_ns=run.running_ns,
+                preempted=run.preempted or run.preempt_requested,
+            )
+        )
+    return msg
+
+
+class ScheduleClient(_Base):
+    """Client for the scheduling sidecar (armada_tpu.api.Schedule): mirror
+    job/executor/queue state into a server-side session, then drive rounds.
+    The reference-Go-colocation client would be generated from rpc.proto;
+    this is the same wire surface from python."""
+
+    def create_session(
+        self, session_id: str = "", config_yaml: str = ""
+    ) -> str:
+        resp = self._unary(
+            "/armada_tpu.api.Schedule/CreateSession",
+            pb.ScheduleSessionConfig(
+                session_id=session_id, config_yaml=config_yaml
+            ),
+            pb.ScheduleSessionHandle,
+        )
+        return resp.session_id
+
+    def sync_state(
+        self,
+        session_id: str,
+        jobs=(),
+        deleted_job_ids=(),
+        executors=None,
+        queues=None,
+        bids=None,
+        factory=None,
+    ) -> None:
+        """jobs: JobState messages (see job_state_of) or jobdb Jobs;
+        executors: ExecutorSnapshot dataclasses (None = leave unchanged);
+        queues: core Queue sequence (None = leave unchanged);
+        bids: {(queue, band, pool): price} (None = leave unchanged)."""
+        msg = pb.SyncStateRequest(session_id=session_id)
+        for j in jobs:
+            msg.jobs.append(j if isinstance(j, pb.JobState) else job_state_of(j))
+        msg.deleted_job_ids.extend(deleted_job_ids)
+        if executors is not None:
+            msg.set_executors = True
+            for e in executors:
+                msg.executors.append(convert.snapshot_to_proto(e, factory))
+        if queues is not None:
+            msg.set_queues = True
+            for q in queues:
+                msg.queues.append(pb.Queue(name=q.name, weight=q.weight))
+        if bids is not None:
+            msg.set_bids = True
+            by_queue = {}
+            for (queue, band, pool), price in bids.items():
+                by_queue.setdefault(queue, []).append(
+                    pb.PriceBandBid(band=band, pool=pool, price=price)
+                )
+            for queue, items in by_queue.items():
+                msg.bids.queues.append(pb.QueueBids(queue=queue, bids=items))
+        self._unary("/armada_tpu.api.Schedule/SyncState", msg, pb.Empty)
+
+    def schedule_round(
+        self,
+        session_id: str,
+        now_ns: int = 0,
+        quarantined_node_ids=(),
+    ) -> "pb.ScheduleRoundResponse":
+        return self._unary(
+            "/armada_tpu.api.Schedule/ScheduleRound",
+            pb.ScheduleRoundRequest(
+                session_id=session_id,
+                now_ns=now_ns,
+                quarantined_node_ids=list(quarantined_node_ids),
+            ),
+            pb.ScheduleRoundResponse,
+        )
+
+    def close_session(self, session_id: str) -> None:
+        self._unary(
+            "/armada_tpu.api.Schedule/CloseSession",
+            pb.ScheduleSessionHandle(session_id=session_id),
+            pb.Empty,
+        )

@@ -419,6 +419,59 @@ class _ExecutorAdminService:
         return pb.Empty()
 
 
+class _ScheduleService:
+    """The scheduling sidecar (scheduler/sidecar.py): the TPU round kernel
+    behind the SchedulingAlgo boundary (scheduling_algo.go:36-41) for
+    external control planes."""
+
+    def __init__(self, sidecar, auth):
+        self._sidecar = sidecar
+        self._auth = auth
+
+    def _session_guard(self, context, fn):
+        from armada_tpu.scheduler.sidecar import SessionExists, UnknownSession
+
+        try:
+            return fn()
+        except UnknownSession as e:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"unknown session {e.args[0]!r}"
+            )
+        except SessionExists as e:
+            context.abort(
+                grpc.StatusCode.ALREADY_EXISTS,
+                f"session {e.args[0]!r} already exists",
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    def CreateSession(self, request, context):
+        _authenticate(self._auth, context)
+        sid = self._session_guard(
+            context,
+            lambda: self._sidecar.create_session(
+                request.session_id, request.config_yaml
+            ),
+        )
+        return pb.ScheduleSessionHandle(session_id=sid)
+
+    def SyncState(self, request, context):
+        _authenticate(self._auth, context)
+        self._session_guard(context, lambda: self._sidecar.handle_sync(request))
+        return pb.Empty()
+
+    def ScheduleRound(self, request, context):
+        _authenticate(self._auth, context)
+        return self._session_guard(
+            context, lambda: self._sidecar.handle_round(request)
+        )
+
+    def CloseSession(self, request, context):
+        _authenticate(self._auth, context)
+        self._sidecar.close_session(request.session_id)
+        return pb.Empty()
+
+
 class _ExecutorApiService:
     def __init__(self, executor_api, factory, auth):
         self._api = executor_api
@@ -461,6 +514,7 @@ def make_server(
     reports=None,
     binoculars=None,
     control_plane=None,
+    schedule_sidecar=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
     authenticator=None,
@@ -564,6 +618,25 @@ def make_server(
                     ),
                     "CancelOnQueue": _unary(
                         csvc.CancelOnQueue, pb.QueueScopedActionRequest
+                    ),
+                },
+            )
+        )
+    if schedule_sidecar is not None:
+        ssvc = _ScheduleService(schedule_sidecar, auth)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.Schedule",
+                {
+                    "CreateSession": _unary(
+                        ssvc.CreateSession, pb.ScheduleSessionConfig
+                    ),
+                    "SyncState": _unary(ssvc.SyncState, pb.SyncStateRequest),
+                    "ScheduleRound": _unary(
+                        ssvc.ScheduleRound, pb.ScheduleRoundRequest
+                    ),
+                    "CloseSession": _unary(
+                        ssvc.CloseSession, pb.ScheduleSessionHandle
                     ),
                 },
             )

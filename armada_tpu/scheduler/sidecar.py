@@ -1,0 +1,368 @@
+"""The scheduling sidecar: the TPU round kernel as a gRPC-callable backend.
+
+Mirrors the reference's SchedulingAlgo boundary (internal/scheduler/
+scheduling/scheduling_algo.go:36-41 -- Schedule(ctx, txn) -> SchedulerResult)
+so an EXTERNAL control plane (the build plan's "colocate with the reference's
+Go scheduler" deployment, SURVEY.md north star) can use this repo's kernel
+without adopting its Python control plane:
+
+  caller owns job truth  --SyncState deltas-->  session's JobDb mirror
+  caller's cycle         --ScheduleRound----->  FairSchedulingAlgo.schedule
+  response               <--leases/preemptions  (caller applies to ITS jobDb)
+
+The session keeps the full incremental machinery server-side (JobDb mirror,
+per-pool IncrementalBuilders, device-resident slabs), so a steady-state call
+carries O(cycle delta) bytes: the state-transfer economics the reference gets
+from Schedule() being an in-process call are preserved across the boundary.
+
+The sidecar's decisions are applied to its own mirror when the round commits,
+exactly like the in-process scheduler -- the caller's subsequent SyncState
+deltas are idempotent re-assertions (latest state wins), so an accepted lease
+round-trips as a no-op and a rejected one (caller failed to publish) is
+corrected by the next sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import uuid
+from typing import Optional, Sequence
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import Queue
+from armada_tpu.events.convert import job_spec_from_proto
+from armada_tpu.jobdb.job import Job, JobRun
+from armada_tpu.jobdb.jobdb import JobDb
+from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
+from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+FAILED_SAMPLE_CAP = 1000
+
+
+class UnknownSession(KeyError):
+    """No session with this id -- maps to gRPC NOT_FOUND.  A dedicated type
+    so an incidental KeyError inside a round can never masquerade as a
+    missing session (the caller would wrongly rebuild its mirror)."""
+
+
+class SessionExists(ValueError):
+    """Caller-chosen session id already live -- maps to ALREADY_EXISTS.
+    Silently replacing the session would discard a mirror another caller
+    (or a retried CreateSession) is still feeding."""
+
+
+class SessionBids:
+    """Latest synced market bid prices, (queue, band, pool)-keyed.
+
+    Stands in for the polling BidPriceProvider (scheduler/
+    external_providers.py): the CALLER refreshes prices by syncing a new
+    table; lookups between syncs serve the cached one, matching the
+    reference's bid-price cache semantics (pricing/bid_price.go).
+    """
+
+    def __init__(self):
+        self._prices: dict[tuple[str, str, str], float] = {}
+
+    def update(self, prices: dict[tuple[str, str, str], float]) -> None:
+        self._prices = dict(prices)
+
+    def price(self, queue: str, band: str = "", pool: str = "") -> float:
+        for key in (
+            (queue, band, pool),
+            (queue, band, ""),
+            (queue, "", pool),
+            (queue, "", ""),
+        ):
+            v = self._prices.get(key)
+            if v is not None:
+                return v
+        return 0.0
+
+
+def _job_from_state(msg, factory) -> Job:
+    """JobState wire message -> jobdb Job (the mirror's view of the caller's
+    job).  Ban nodes ride as synthetic terminal attempted runs so
+    Job.anti_affinity_nodes derives them exactly like a native retry."""
+    spec = job_spec_from_proto(
+        msg.job_id,
+        msg.queue,
+        msg.jobset,
+        msg.spec,
+        factory,
+        submit_time=msg.submit_time,
+    )
+    runs = []
+    for node_id in msg.banned_nodes:
+        runs.append(
+            JobRun(
+                id=f"ban/{msg.job_id}/{node_id}",
+                job_id=msg.job_id,
+                node_id=node_id,
+                node_name=node_id,
+                failed=True,
+                run_attempted=True,
+            )
+        )
+    has_run = bool(msg.run.run_id or msg.run.node_id)
+    if has_run:
+        r = msg.run
+        runs.append(
+            JobRun(
+                id=r.run_id or uuid.uuid4().hex,
+                job_id=msg.job_id,
+                executor=r.executor,
+                node_id=r.node_id,
+                node_name=r.node_name or r.node_id,
+                pool=r.pool or "default",
+                scheduled_at_priority=(
+                    int(r.scheduled_at_priority)
+                    if r.has_scheduled_at_priority
+                    else None
+                ),
+                pool_scheduled_away=r.away,
+                running=r.running,
+                running_ns=int(r.running_ns),
+                run_attempted=r.running or bool(r.running_ns),
+                # A terminal job's run is over (resources free); the job row
+                # is retained only for the short-job penalty window, which
+                # exempts preempted runs.
+                failed=bool(msg.terminal) and not r.preempted,
+                preempted=bool(msg.terminal) and r.preempted,
+            )
+        )
+    return Job(
+        spec=spec,
+        priority=int(msg.priority),
+        queued=bool(msg.queued) and not msg.terminal,
+        validated=bool(msg.validated),
+        pools=tuple(msg.pools),
+        failed=bool(msg.terminal),
+        runs=tuple(runs),
+    )
+
+
+class ScheduleSession:
+    """One caller's mirrored world + algo; serialized rounds."""
+
+    def __init__(
+        self,
+        session_id: str,
+        config: SchedulingConfig,
+        clock_ns=lambda: int(time.time() * 1e9),
+    ):
+        self.id = session_id
+        self.config = config
+        self.factory = config.resource_list_factory()
+        self.jobdb = JobDb(config)
+        self.queues: list[Queue] = []
+        self.executors: list[ExecutorSnapshot] = []
+        self.bids = SessionBids()
+        self.feed = None
+        if config.incremental_problem_build:
+            from armada_tpu.scheduler.incremental_algo import (
+                IncrementalProblemFeed,
+            )
+
+            self.feed = IncrementalProblemFeed(config)
+            self.feed.attach(self.jobdb)
+        market = any(p.market_driven for p in config.pools)
+        self.algo = FairSchedulingAlgo(
+            config,
+            queues=lambda: self.queues,
+            clock_ns=clock_ns,
+            collect_stats=False,
+            bid_prices=self.bids if market else None,
+            feed=self.feed,
+        )
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- syncing ----
+    # One SyncState request applies ATOMICALLY with respect to rounds: the
+    # session lock is held across all its parts, so a concurrent
+    # ScheduleRound can never see (say) this request's jobs against the
+    # executor set the same request replaces.
+
+    def apply_sync(
+        self,
+        jobs: Sequence = (),
+        deletes: Sequence[str] = (),
+        executors: Optional[Sequence[ExecutorSnapshot]] = None,
+        queues: Optional[Sequence[Queue]] = None,
+        bids: Optional[dict] = None,
+    ) -> None:
+        with self._lock:
+            if jobs or deletes:
+                txn = self.jobdb.write_txn()
+                if deletes:
+                    txn.delete(list(deletes))
+                if jobs:
+                    txn.upsert(
+                        [_job_from_state(m, self.factory) for m in jobs]
+                    )
+                txn.commit()
+            if executors is not None:
+                self.executors = list(executors)
+            if queues is not None:
+                self.queues = list(queues)
+            if bids is not None:
+                self.bids.update(bids)
+
+    def sync_jobs(self, jobs: Sequence, deletes: Sequence[str] = ()) -> None:
+        self.apply_sync(jobs=jobs, deletes=deletes)
+
+    def set_executors(self, executors: Sequence[ExecutorSnapshot]) -> None:
+        self.apply_sync(executors=executors)
+
+    def set_queues(self, queues: Sequence[Queue]) -> None:
+        self.apply_sync(queues=queues)
+
+    def set_bids(self, prices: dict) -> None:
+        self.apply_sync(bids=prices)
+
+    # ------------------------------------------------------------ rounds ----
+
+    def schedule_round(
+        self, now_ns: Optional[int] = None, quarantined=frozenset()
+    ) -> SchedulerResult:
+        with self._lock:
+            txn = self.jobdb.write_txn()
+            result = self.algo.schedule(
+                txn,
+                self.executors,
+                now_ns=now_ns or None,
+                quarantined_nodes=frozenset(quarantined),
+            )
+            # Commit the mirror like the in-process scheduler commits its
+            # jobDb: later rounds must see this round's leases.  The caller
+            # re-asserting job state via SyncState is idempotent on top.
+            txn.commit()
+            return result
+
+
+def _stats_of(result: SchedulerResult) -> str:
+    pools = []
+    for s in result.pools:
+        entry = {
+            "pool": s.pool,
+            "num_nodes": s.num_nodes,
+            "num_queued": s.num_queued,
+            "num_running": s.num_running,
+            "scheduled": len(s.outcome.scheduled),
+            "preempted": len(s.outcome.preempted),
+            "termination": s.outcome.termination,
+            "queue_stats": s.outcome.queue_stats,
+        }
+        if s.market:
+            entry["indicative_prices"] = s.indicative_prices
+            entry["idealised_values"] = s.idealised_values
+            entry["realised_values"] = s.realised_values
+        pools.append(entry)
+    return json.dumps({"pools": pools}, default=float)
+
+
+class ScheduleSidecar:
+    """Session registry behind the armada_tpu.api.Schedule service."""
+
+    def __init__(self, default_config: SchedulingConfig, clock_ns=None):
+        self.default_config = default_config
+        self._clock_ns = clock_ns or (lambda: int(time.time() * 1e9))
+        self._sessions: dict[str, ScheduleSession] = {}
+        self._lock = threading.Lock()
+
+    def create_session(
+        self, session_id: str = "", config_yaml: str = ""
+    ) -> str:
+        config = self.default_config
+        if config_yaml:
+            import yaml
+
+            from armada_tpu.core.config import scheduling_config_from_dict
+
+            doc = yaml.safe_load(config_yaml) or {}
+            if "scheduling" in doc:
+                doc = doc["scheduling"]
+            config = scheduling_config_from_dict(doc)
+        sid = session_id or uuid.uuid4().hex
+        with self._lock:
+            if sid in self._sessions:
+                raise SessionExists(sid)
+            self._sessions[sid] = ScheduleSession(
+                sid, config, clock_ns=self._clock_ns
+            )
+        return sid
+
+    def session(self, session_id: str) -> ScheduleSession:
+        with self._lock:
+            s = self._sessions.get(session_id)
+        if s is None:
+            raise UnknownSession(session_id)
+        return s
+
+    def close_session(self, session_id: str) -> None:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    # ----------------------------------------------------- wire handling ----
+    # (proto-level entry points used by the gRPC service; kept here so the
+    # service class in rpc/server.py stays a thin auth + status-code shim)
+
+    def handle_sync(self, msg) -> None:
+        s = self.session(msg.session_id)
+        executors = None
+        if msg.set_executors:
+            from armada_tpu.rpc.convert import snapshot_from_proto
+
+            executors = [
+                snapshot_from_proto(e, s.factory) for e in msg.executors
+            ]
+        queues = None
+        if msg.set_queues:
+            queues = [Queue(q.name, q.weight or 1.0) for q in msg.queues]
+        bids = None
+        if msg.set_bids:
+            bids = {}
+            for qb in msg.bids.queues:
+                for bid in qb.bids:
+                    bids[(qb.queue, bid.band, bid.pool)] = bid.price
+        s.apply_sync(
+            jobs=list(msg.jobs),
+            deletes=list(msg.deleted_job_ids),
+            executors=executors,
+            queues=queues,
+            bids=bids,
+        )
+
+    def handle_round(self, msg):
+        from armada_tpu.rpc import rpc_pb2 as pb
+
+        s = self.session(msg.session_id)
+        result = s.schedule_round(
+            now_ns=int(msg.now_ns) or None,
+            quarantined=frozenset(msg.quarantined_node_ids),
+        )
+        resp = pb.ScheduleRoundResponse(pool_stats_json=_stats_of(result))
+        for job, run in result.scheduled:
+            resp.scheduled.append(
+                pb.RoundLease(
+                    job_id=job.id,
+                    run_id=run.id,
+                    queue=job.queue,
+                    node_id=run.node_id,
+                    executor=run.executor,
+                    pool=run.pool,
+                    scheduled_at_priority=run.scheduled_at_priority or 0,
+                    away=run.pool_scheduled_away,
+                )
+            )
+        for job, run in result.preempted:
+            resp.preempted.append(
+                pb.RoundPreemption(job_id=job.id, run_id=run.id)
+            )
+        for jid in result.failed:
+            if len(resp.failed_sample) >= FAILED_SAMPLE_CAP:
+                break
+            resp.failed_sample.append(jid)
+        return resp

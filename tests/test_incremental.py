@@ -717,3 +717,35 @@ def test_running_gang_partial_preemption_cascades_both_modes():
     )
     _outcomes_equal(fresh2, incr2)
     assert len(fresh2.preempted) == 1
+
+
+def test_leases_before_nodes_or_queues_are_buffered():
+    """State can arrive runs-first (restart replay; a sidecar session
+    syncing before its first round): leases naming nodes/queues the builder
+    has not seen must be BUFFERED, not dropped -- a silent drop makes every
+    running job invisible to fairness and preemption (round-5 sidecar
+    equality failure)."""
+    nodes, queues, jobs, running = _random_world(4)
+    reference = _round(*_incremental(nodes, queues, jobs, running).assemble())
+
+    # runs first, into a builder that knows neither queues nor nodes yet
+    b = IncrementalBuilder(CFG, "default")
+    for r in running:
+        b.lease(r)
+        if r.job.gang_id:
+            b.note_running_gang(r.job.queue, r.job.gang_id, r.job.id)
+    b.submit_many(jobs)
+    b.set_queues(queues)
+    b.set_nodes(nodes)
+    late = _round(*b.assemble())
+    _outcomes_equal(reference, late)
+    assert len(b.runs.key_of_id) == len(running)
+    assert not b._pending_runs
+
+    # an unlease while still pending must discard the buffered entry
+    b2 = IncrementalBuilder(CFG, "default")
+    b2.lease(running[0])
+    b2.unlease(running[0].job.id)
+    b2.set_queues(queues)
+    b2.set_nodes(nodes)
+    assert len(b2.runs.key_of_id) == 0 and not b2._pending_runs
