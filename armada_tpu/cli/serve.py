@@ -131,6 +131,17 @@ def start_control_plane(
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
 
+    # Persist XLA compilations: a restarted replica re-pays 15-20s of kernel
+    # compile otherwise (ARMADA_COMPILE_CACHE overrides the location; "0"
+    # disables).
+    from armada_tpu.core.platform import enable_compilation_cache
+
+    cache_dir = os.environ.get("ARMADA_COMPILE_CACHE", "")
+    if cache_dir != "0":
+        enable_compilation_cache(
+            cache_dir or os.path.join(data_dir, "jax_cache")
+        )
+
     log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
     db = SchedulerDb(os.path.join(data_dir, "scheduler.db"))
     eventdb = EventDb(os.path.join(data_dir, "events.db"))

@@ -26,3 +26,32 @@ def respect_jax_platforms_env() -> None:
     import jax
 
     jax.config.update("jax_platforms", env)
+
+
+_COMPILE_CACHE_DIR: str | None = None
+
+
+def enable_compilation_cache(cache_dir: str) -> None:
+    """Persist XLA compilations across process restarts.
+
+    A cold start pays 15-20s compiling the round kernel (single-chip) and
+    ~19s sharded (MULTICHIP_SCALE r04 compile_sharded), re-paid on every
+    serve/bench process start and on shape-bucket drift; the persistent
+    cache turns warm starts into a disk read.  Wired through serve (under
+    data_dir) and bench (ARMADA_COMPILE_CACHE); the threshold floors keep
+    tiny test jits from churning the directory.
+    """
+    global _COMPILE_CACHE_DIR
+    if _COMPILE_CACHE_DIR is not None:
+        # jax config is process-global: first enabler wins.  A second plane
+        # in the same process (leader+follower tests, embedded uses) must
+        # not silently redirect every compilation to ITS data_dir -- which
+        # may be a tmpdir the first plane outlives.
+        return
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _COMPILE_CACHE_DIR = cache_dir

@@ -141,15 +141,9 @@ class BidPriceServiceClient(_PollingClient):
         snap = self._snapshot
         if snap is None:
             return 0.0
-        for k in (
-            (queue, band, pool),
-            (queue, band, ""),
-            (queue, "", pool),
-            (queue, "", ""),
-        ):
-            if k in snap:
-                return snap[k]
-        return 0.0
+        from armada_tpu.scheduler.providers import most_specific_bid
+
+        return most_specific_bid(snap, queue, band, pool)
 
 
 class PriorityOverrideServiceClient(_PollingClient):

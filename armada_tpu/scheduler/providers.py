@@ -16,6 +16,27 @@ from __future__ import annotations
 from typing import Mapping, Optional, Protocol
 
 
+def most_specific_bid(
+    prices: Mapping, queue: str, band: str, pool: str = ""
+) -> float:
+    """The bid-price fallback chain (pricing/bid_price.go): most specific
+    match wins -- (queue, band, pool) > (queue, band, any pool) >
+    (queue, default band, pool) > (queue, default band).  0 = no bid
+    (market pools never schedule it, market_iterator.go).  Shared by the
+    polling client (external_providers.py) and the sidecar's synced table
+    so the semantics cannot diverge."""
+    for k in (
+        (queue, band, pool),
+        (queue, band, ""),
+        (queue, "", pool),
+        (queue, "", ""),
+    ):
+        v = prices.get(k)
+        if v is not None:
+            return v
+    return 0.0
+
+
 class BidPriceProvider(Protocol):
     def price(self, queue: str, band: str) -> float:
         """Bid price for jobs of `queue` in price band `band` (0 = no bid)."""

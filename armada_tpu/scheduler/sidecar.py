@@ -24,7 +24,6 @@ corrected by the next sync.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import threading
 import time
@@ -70,16 +69,9 @@ class SessionBids:
         self._prices = dict(prices)
 
     def price(self, queue: str, band: str = "", pool: str = "") -> float:
-        for key in (
-            (queue, band, pool),
-            (queue, band, ""),
-            (queue, "", pool),
-            (queue, "", ""),
-        ):
-            v = self._prices.get(key)
-            if v is not None:
-                return v
-        return 0.0
+        from armada_tpu.scheduler.providers import most_specific_bid
+
+        return most_specific_bid(self._prices, queue, band, pool)
 
 
 def _job_from_state(msg, factory) -> Job:
@@ -155,6 +147,13 @@ class ScheduleSession:
     ):
         self.id = session_id
         self.config = config
+        self._clock_ns = clock_ns
+        # Terminal jobs the caller synced (retained only for the short-job
+        # penalty window): job id -> running_ns (or sync time when the run
+        # never ran).  Swept at round end so a long-lived session's mirror
+        # cannot grow without bound on a caller that deletes lazily -- the
+        # in-process scheduler's _retained_terminal sweep equivalent.
+        self._terminal_synced: dict[str, int] = {}
         self.factory = config.resource_list_factory()
         self.jobdb = JobDb(config)
         self.queues: list[Queue] = []
@@ -195,6 +194,15 @@ class ScheduleSession:
     ) -> None:
         with self._lock:
             if jobs or deletes:
+                for m in jobs:
+                    if m.terminal:
+                        self._terminal_synced[m.job_id] = (
+                            int(m.run.running_ns) or self._clock_ns()
+                        )
+                    else:
+                        self._terminal_synced.pop(m.job_id, None)
+                for jid in deletes:
+                    self._terminal_synced.pop(jid, None)
                 txn = self.jobdb.write_txn()
                 if deletes:
                     txn.delete(list(deletes))
@@ -235,6 +243,27 @@ class ScheduleSession:
                 now_ns=now_ns or None,
                 quarantined_nodes=frozenset(quarantined),
             )
+            # Sweep synced terminal jobs once they leave the short-job
+            # penalty window (immediately when no penalty is configured):
+            # only ids from _terminal_synced, O(tracked), never a backlog
+            # scan.
+            now = now_ns or self._clock_ns()
+            window = int(
+                max(
+                    self.config.short_job_penalty_cutoffs().values(),
+                    default=0.0,
+                )
+                * 1e9
+            )
+            expired = [
+                jid
+                for jid, ns in self._terminal_synced.items()
+                if now - ns >= window
+            ]
+            if expired:
+                txn.delete(expired)
+                for jid in expired:
+                    self._terminal_synced.pop(jid, None)
             # Commit the mirror like the in-process scheduler commits its
             # jobDb: later rounds must see this round's leases.  The caller
             # re-asserting job state via SyncState is idempotent on top.
@@ -281,17 +310,23 @@ class ScheduleSidecar:
 
             from armada_tpu.core.config import scheduling_config_from_dict
 
-            doc = yaml.safe_load(config_yaml) or {}
-            if "scheduling" in doc:
-                doc = doc["scheduling"]
-            config = scheduling_config_from_dict(doc)
+            try:
+                doc = yaml.safe_load(config_yaml) or {}
+                if "scheduling" in doc:
+                    doc = doc["scheduling"]
+                config = scheduling_config_from_dict(doc)
+            except (yaml.YAMLError, TypeError, KeyError) as e:
+                # caller data -> INVALID_ARGUMENT, never a server traceback
+                raise ValueError(f"bad session config_yaml: {e}") from e
         sid = session_id or uuid.uuid4().hex
+        # Construct outside the registry lock (JobDb + feed + algo setup is
+        # not instant; other sessions' lookups must not stall behind it),
+        # then publish under it.
+        session = ScheduleSession(sid, config, clock_ns=self._clock_ns)
         with self._lock:
             if sid in self._sessions:
                 raise SessionExists(sid)
-            self._sessions[sid] = ScheduleSession(
-                sid, config, clock_ns=self._clock_ns
-            )
+            self._sessions[sid] = session
         return sid
 
     def session(self, session_id: str) -> ScheduleSession:
