@@ -2,28 +2,56 @@
 
 Equivalent of the reference's airflow integration (third_party/airflow/
 armada/operators/armada.py ArmadaOperator): an Airflow task that submits one
-job, polls its jobset events until the job reaches a terminal state, raises
-on failure/cancellation/preemption, and cancels the job when the Airflow task
+job, waits until the job reaches a terminal state, raises on
+failure/cancellation/preemption, and cancels the job when the Airflow task
 is killed (on_kill, armada.py:313).
 
+Two wait modes, like the reference (armada.py `deferrable=`):
+
+* blocking (default): execute() polls jobset events in the worker slot.
+* deferrable: execute() submits, then DEFERS -- the worker slot is released
+  and an `ArmadaPollJobTrigger` waits in the triggerer's event loop
+  (third_party/airflow/armada/triggers.py); on a terminal event Airflow
+  resumes the operator at `resume()`.
+
 Airflow itself is an optional dependency: when it is not installed the
-operator still imports and `execute(context=None)` works standalone, so the
-submit-and-wait flow is testable (and usable as a plain blocking helper)
-without an Airflow deployment.
+operator and trigger still import with duck-typed stand-ins (TaskDeferred /
+TriggerEvent carry the same payloads), so both flows are testable (and
+usable as plain helpers) without an Airflow deployment.
 """
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Mapping, Optional
 
 try:  # pragma: no cover - exercised only under a real Airflow install
-    from airflow.exceptions import AirflowException
+    from airflow.exceptions import AirflowException, TaskDeferred
     from airflow.models import BaseOperator
+    from airflow.triggers.base import BaseTrigger, TriggerEvent
 except Exception:  # Airflow absent: minimal stand-ins with the same contract
 
     class AirflowException(RuntimeError):
         pass
+
+    class TaskDeferred(Exception):  # noqa: N818 - airflow's name
+        """Raised by defer(): carries the trigger + resume method name."""
+
+        def __init__(self, trigger=None, method_name: str = ""):
+            super().__init__(f"task deferred to {method_name}")
+            self.trigger = trigger
+            self.method_name = method_name
+
+    class TriggerEvent:
+        def __init__(self, payload):
+            self.payload = payload
+
+        def __eq__(self, other):
+            return getattr(other, "payload", None) == self.payload
+
+    class BaseTrigger:
+        """Stand-in: triggers are serialized to (classpath, kwargs)."""
 
     class BaseOperator:  # noqa: D401 - duck-typed stand-in
         """Stand-in exposing the attributes ArmadaOperator relies on."""
@@ -31,11 +59,131 @@ except Exception:  # Airflow absent: minimal stand-ins with the same contract
         def __init__(self, task_id: str = "", **kwargs):
             self.task_id = task_id
 
+        def defer(self, *, trigger, method_name: str, **_):
+            raise TaskDeferred(trigger=trigger, method_name=method_name)
+
 TERMINAL_STATES = ("succeeded", "failed", "cancelled", "preempted")
 _FAILURE_EVENTS = {
     "job_errors": "failed",
     "cancelled_job": "cancelled",
 }
+
+
+def scan_events(client, queue: str, jobset: str, job_id: str, from_idx: int):
+    """One pass over new jobset events; returns (state | None, next idx).
+    Shared by the blocking poll loop and the deferrable trigger."""
+    for idx, seq in client.get_jobset_events(queue, jobset, from_idx=from_idx):
+        from_idx = idx + 1
+        for ev in seq.events:
+            kind = ev.WhichOneof("event")
+            ev_job_id = getattr(getattr(ev, kind), "job_id", "")
+            if ev_job_id != job_id:
+                continue
+            if kind == "job_succeeded":
+                return "succeeded", from_idx
+            if kind == "job_run_preempted":
+                return "preempted", from_idx
+            if kind in _FAILURE_EVENTS:
+                return _FAILURE_EVENTS[kind], from_idx
+    return None, from_idx
+
+
+class ArmadaPollJobTrigger(BaseTrigger):
+    """Async wait-for-termination, run in the triggerer's event loop while
+    the worker slot is free (the reference's ArmadaPollJobTrigger,
+    third_party/airflow/armada/triggers.py).  Yields ONE TriggerEvent:
+    {"job_id", "state"} with state from TERMINAL_STATES, or
+    {"job_id", "error"} when polling itself fails."""
+
+    def __init__(
+        self,
+        *,
+        armada_url: str,
+        queue: str,
+        jobset: str,
+        job_id: str,
+        poll_interval_s: float = 5.0,
+        timeout_s: float = 0.0,
+    ):
+        self.armada_url = armada_url
+        self.queue = queue
+        self.jobset = jobset
+        self.job_id = job_id
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def serialize(self):
+        """(classpath, kwargs): how Airflow persists a deferred trigger."""
+        return (
+            "armada_tpu.integrations.airflow.ArmadaPollJobTrigger",
+            {
+                "armada_url": self.armada_url,
+                "queue": self.queue,
+                "jobset": self.jobset,
+                "job_id": self.job_id,
+                "poll_interval_s": self.poll_interval_s,
+                "timeout_s": self.timeout_s,
+            },
+        )
+
+    async def run(self):
+        from armada_tpu.rpc.client import ArmadaClient
+
+        loop = asyncio.get_running_loop()
+        client = ArmadaClient(self.armada_url)
+        deadline = (
+            time.monotonic() + self.timeout_s if self.timeout_s else None
+        )
+        from_idx = 0
+        try:
+            while True:
+                # the sync gRPC read runs in the default executor so one
+                # slow poll cannot stall the triggerer's loop
+                state, from_idx = await loop.run_in_executor(
+                    None,
+                    scan_events,
+                    client,
+                    self.queue,
+                    self.jobset,
+                    self.job_id,
+                    from_idx,
+                )
+                if state in TERMINAL_STATES:
+                    yield TriggerEvent(
+                        {"job_id": self.job_id, "state": state}
+                    )
+                    return
+                if deadline is not None and time.monotonic() > deadline:
+                    yield TriggerEvent(
+                        {
+                            "job_id": self.job_id,
+                            "error": (
+                                f"timed out after {self.timeout_s}s"
+                            ),
+                        }
+                    )
+                    return
+                await asyncio.sleep(self.poll_interval_s)
+        except asyncio.CancelledError:
+            # The task was killed while deferred (trigger cancellation is
+            # how Airflow tears down a deferred task): resume() never runs
+            # and the re-created operator's on_kill has no job_id, so the
+            # cancel MUST happen here or the job runs on-cluster forever --
+            # blocking mode's on_kill contract (armada.py:313).
+            try:
+                client.cancel_jobs(
+                    self.queue,
+                    self.jobset,
+                    [self.job_id],
+                    reason="airflow task killed while deferred",
+                )
+            except Exception:
+                pass  # best effort during teardown
+            raise
+        except Exception as e:  # polling failure -> resume() raises
+            yield TriggerEvent({"job_id": self.job_id, "error": str(e)})
+        finally:
+            client.close()
 
 
 class ArmadaOperator(BaseOperator):
@@ -48,6 +196,9 @@ class ArmadaOperator(BaseOperator):
     :param jobset: jobset id; defaults to the Airflow task id.
     :param poll_interval_s: seconds between event polls (armada.py:117).
     :param timeout_s: overall deadline; 0 = wait forever.
+    :param deferrable: release the worker slot after submit and wait in the
+        triggerer via ArmadaPollJobTrigger (armada.py `deferrable=`);
+        Airflow resumes the task at `resume()` on the terminal event.
     """
 
     template_fields = ("queue", "jobset")
@@ -61,6 +212,7 @@ class ArmadaOperator(BaseOperator):
         jobset: str = "",
         poll_interval_s: float = 5.0,
         timeout_s: float = 0.0,
+        deferrable: bool = False,
         task_id: str = "armada-job",
         **kwargs,
     ):
@@ -71,6 +223,7 @@ class ArmadaOperator(BaseOperator):
         self.jobset = jobset or task_id
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
+        self.deferrable = deferrable
         self.job_id: Optional[str] = None
         self._client = None
 
@@ -91,13 +244,28 @@ class ArmadaOperator(BaseOperator):
     # --- the task -----------------------------------------------------------
 
     def execute(self, context=None) -> str:
-        """Submit, then block until terminal; returns the job id."""
+        """Submit, then wait until terminal; returns the job id.  In
+        deferrable mode the wait happens in the triggerer (defer() raises
+        TaskDeferred and the worker slot is released)."""
         from armada_tpu.server import JobSubmitItem
 
         client = self._get_client()
         try:
             item = JobSubmitItem(**_snake_item(self.job))
             (self.job_id,) = client.submit_jobs(self.queue, self.jobset, [item])
+            if self.deferrable:
+                self._close()
+                self.defer(
+                    trigger=ArmadaPollJobTrigger(
+                        armada_url=self.armada_url,
+                        queue=self.queue,
+                        jobset=self.jobset,
+                        job_id=self.job_id,
+                        poll_interval_s=self.poll_interval_s,
+                        timeout_s=self.timeout_s,
+                    ),
+                    method_name="resume",
+                )
             state = self._poll_for_termination(client)
             if state != "succeeded":
                 raise AirflowException(
@@ -106,6 +274,35 @@ class ArmadaOperator(BaseOperator):
             return self.job_id
         finally:
             self._close()
+
+    def resume(self, context=None, event=None) -> str:
+        """Deferred-task continuation: Airflow calls this with the trigger's
+        terminal TriggerEvent payload (armada.py:resume)."""
+        payload = getattr(event, "payload", event) or {}
+        self.job_id = payload.get("job_id", self.job_id)
+        error = payload.get("error")
+        if error:
+            # the trigger timed out or could not poll -- cancel like the
+            # blocking path's deadline, then fail the task
+            try:
+                client = self._get_client()
+                client.cancel_jobs(
+                    self.queue,
+                    self.jobset,
+                    [self.job_id],
+                    reason=f"deferred wait failed: {error}",
+                )
+            except Exception:
+                pass  # best effort; the trigger error is the headline
+            finally:
+                self._close()
+            raise AirflowException(
+                f"armada job {self.job_id} deferred wait failed: {error}"
+            )
+        state = payload.get("state")
+        if state != "succeeded":
+            raise AirflowException(f"armada job {self.job_id} ended {state}")
+        return self.job_id
 
     def _poll_for_termination(self, client) -> str:
         deadline = time.monotonic() + self.timeout_s if self.timeout_s else None
@@ -133,23 +330,9 @@ class ArmadaOperator(BaseOperator):
             time.sleep(self.poll_interval_s)
 
     def _scan_events(self, client, from_idx: int):
-        """One pass over new jobset events; returns (state | None, next idx)."""
-        for idx, seq in client.get_jobset_events(
-            self.queue, self.jobset, from_idx=from_idx
-        ):
-            from_idx = idx + 1
-            for ev in seq.events:
-                kind = ev.WhichOneof("event")
-                ev_job_id = getattr(getattr(ev, kind), "job_id", "")
-                if ev_job_id != self.job_id:
-                    continue
-                if kind == "job_succeeded":
-                    return "succeeded", from_idx
-                if kind == "job_run_preempted":
-                    return "preempted", from_idx
-                if kind in _FAILURE_EVENTS:
-                    return _FAILURE_EVENTS[kind], from_idx
-        return None, from_idx
+        return scan_events(
+            client, self.queue, self.jobset, self.job_id, from_idx
+        )
 
     def on_kill(self) -> None:
         """Airflow task killed: cancel the armada job (armada.py:313)."""

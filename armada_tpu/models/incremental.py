@@ -178,7 +178,22 @@ class _SortedTable:
             # the table.
             pos = np.zeros((len(rows),), np.int64)
         else:
-            pos = np.array([self._position(r) for r in rows], np.int64)
+            # Same probe as _position, on locally-bound columns via the
+            # ndarray method: the numpy dispatch wrappers dominate at the
+            # per-cycle ~1k-lease batch against big tables (see remove_many).
+            n = self.n
+            cols = [getattr(self, c) for c in scols]
+            dtypes = [c.dtype.type for c in cols]
+            pos = np.empty((len(rows),), np.int64)
+            for i, r in enumerate(rows):
+                lo, hi = 0, n
+                for col, dt, c in zip(cols, dtypes, scols):
+                    a = col[lo:hi]
+                    v = dt(r[c])
+                    left = int(a.searchsorted(v, "left"))
+                    hi = lo + int(a.searchsorted(v, "right"))
+                    lo = lo + left
+                pos[i] = lo
         live = slice(0, self.n)
         for c in self._cols():
             cur = getattr(self, c)
@@ -214,6 +229,49 @@ class _SortedTable:
         if self.dead > max(1024, self.n // 4):
             self.compact()
         return info
+
+    def remove_many(self, jids: Sequence[bytes]) -> list:
+        """Batched tombstone: same per-id semantics as remove(), but the
+        binary searches run on locally-bound columns via the ndarray method
+        (the numpy dispatch wrappers are most of remove()'s cost for the
+        per-cycle ~1k-decision feedback at 1M rows) and the compaction
+        check runs once for the whole batch."""
+        n = self.n
+        cols = [getattr(self, c) for c in self.sort_cols]
+        dtypes = [c.dtype.type for c in cols]
+        alive = self.alive
+        extra = ("qi",) + self._extra
+        extra_cols = {c: getattr(self, c) for c in extra}
+        pop_key = self.key_of_id.pop
+        out = []
+        for jid in jids:
+            key = pop_key(jid, None)
+            if key is None:
+                out.append(None)
+                continue
+            lo, hi = 0, n
+            for col, dt, v in zip(cols, dtypes, key + (jid,)):
+                a = col[lo:hi]
+                v = dt(v)
+                left = int(a.searchsorted(v, "left"))
+                hi = lo + int(a.searchsorted(v, "right"))
+                lo = lo + left
+            row = None
+            for r in range(lo, hi):
+                if alive[r]:
+                    row = r
+                    break
+            if row is None:
+                out.append(None)
+                continue
+            info = {c: extra_cols[c][row] for c in extra}
+            info["req"] = self.req[row].copy()
+            alive[row] = False
+            self.dead += 1
+            out.append(info)
+        if self.dead > max(1024, self.n // 4):
+            self.compact()
+        return out
 
     def compact(self) -> None:
         keep = self.alive[: self.n]
@@ -695,6 +753,42 @@ class IncrementalBuilder:
         self._unknown_queue.pop(job_id, None)
         self.running_gang_specs.pop(job_id, None)
         self._release_single(self.jobs.remove(job_id.encode()))
+
+    def remove_many(self, job_ids: Sequence[str]) -> None:
+        """Batched remove() for the cycle's decision feedback (~1k scheduled
+        jobs leave the backlog per cycle): one table pass + ONE vectorized
+        demand update instead of per-job numpy scalar ops -- the builder
+        apply was ~0.08s of the 1M x 50k TPU cycle's decode tail."""
+        enc = []
+        for job_id in job_ids:
+            self.gang_jobs.pop(job_id, None)
+            self.banned.pop(job_id, None)
+            self._unknown_queue.pop(job_id, None)
+            self.running_gang_specs.pop(job_id, None)
+            enc.append(job_id.encode())
+        qis, pcs, reqs = [], [], []
+        own_gids = False
+        gw = self._g_ids.shape[0]
+        for info in self.jobs.remove_many(enc):
+            if info is None:
+                continue
+            slot = int(info["slot"])
+            if self._sg.valid[slot]:
+                qis.append(int(info["qi"]))
+                pcs.append(int(info["pc"]))
+                reqs.append(info["req"])
+            self._sg.release(slot)
+            if slot < gw:
+                if not own_gids:
+                    self._own_g_ids()
+                    own_gids = True
+                self._g_ids[slot] = b""
+        if qis:
+            np.subtract.at(
+                self._demand_sg,
+                (np.asarray(qis, np.int64), np.asarray(pcs, np.int64)),
+                np.stack(reqs).astype(np.float64),
+            )
 
     def reprioritise(self, spec: JobSpec) -> None:
         """Priority changed: re-slot (the order key embeds the priority)."""

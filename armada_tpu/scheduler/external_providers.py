@@ -28,6 +28,7 @@ from typing import Callable, Mapping, Optional
 import grpc
 
 from armada_tpu.rpc import rpc_pb2 as pb
+from armada_tpu.scheduler.providers import most_specific_bid
 
 _BID_METHOD = "/armada_tpu.api.BidPriceService/GetBidPrices"
 _OVERRIDE_METHOD = "/armada_tpu.api.PriorityOverrideService/GetPriorityOverrides"
@@ -141,8 +142,6 @@ class BidPriceServiceClient(_PollingClient):
         snap = self._snapshot
         if snap is None:
             return 0.0
-        from armada_tpu.scheduler.providers import most_specific_bid
-
         return most_specific_bid(snap, queue, band, pool)
 
 

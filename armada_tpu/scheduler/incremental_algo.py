@@ -118,28 +118,36 @@ class IncrementalProblemFeed:
             self.apply_job(job, pending)
         self._flush(pending)
 
-    def _pending_for(self, pending: dict, pool: str) -> tuple[dict, dict, dict]:
+    def _pending_for(
+        self, pending: dict, pool: str
+    ) -> tuple[dict, dict, dict, dict]:
         entry = pending.get(pool)
         if entry is None:
-            # submits/bans/leases all keyed by job id: a re-applied job within
-            # one batch must not become two live rows (submit_many/lease_many
-            # only de-dupe against the TABLE, not within their own batch).
-            entry = pending[pool] = ({}, {}, {})
+            # submits/bans/leases/removals all keyed by job id: a re-applied
+            # job within one batch must not become two live rows
+            # (submit_many/lease_many only de-dupe against the TABLE, not
+            # within their own batch).
+            entry = pending[pool] = ({}, {}, {}, {})
         return entry
 
     @staticmethod
     def _purge_pending(pending: dict, job_id: str, leases_too: bool) -> None:
-        for submits, ban_map, leases in pending.values():
+        for submits, ban_map, leases, _removals in pending.values():
             submits.pop(job_id, None)
             ban_map.pop(job_id, None)
             if leases_too:
                 leases.pop(job_id, None)
 
     def _flush(self, pending: dict) -> None:
-        for pool, (submits, bans, leases) in pending.items():
+        for pool, (submits, bans, leases, removals) in pending.items():
             b = self.builders.get(pool)
             if b is None:
                 continue
+            if removals:
+                # Batched: a cycle's ~1k scheduled jobs leave the backlog
+                # with one table pass + one demand update (remove_many),
+                # not 1k binary searches through numpy dispatch wrappers.
+                b.remove_many(list(removals))
             if submits:
                 b.submit_many(list(submits.values()), bans or None)
             if leases:
@@ -188,7 +196,7 @@ class IncrementalProblemFeed:
             self._purge_pending(pending, job.id, leases_too=True)
             for name, b in self.builders.items():
                 b.unlease(job.id)
-                submits, ban_map, _ = self._pending_for(pending, name)
+                submits, ban_map, _, _ = self._pending_for(pending, name)
                 submits[spec.id] = spec
                 if bans:
                     ban_map[spec.id] = tuple(bans)
@@ -198,8 +206,8 @@ class IncrementalProblemFeed:
         # leased / running
         self.pool_restricted.discard(job.id)
         run = job.latest_run
-        for b in self.builders.values():
-            b.remove(job.id)
+        for name in self.builders:
+            self._pending_for(pending, name)[3][job.id] = True
         self._purge_pending(pending, job.id, leases_too=True)
         if run is None or run.in_terminal_state():
             for b in self.builders.values():

@@ -36,6 +36,7 @@ from armada_tpu.events.convert import job_spec_from_proto
 from armada_tpu.jobdb.job import Job, JobRun
 from armada_tpu.jobdb.jobdb import JobDb
 from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
+from armada_tpu.scheduler.providers import most_specific_bid
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 
 FAILED_SAMPLE_CAP = 1000
@@ -69,8 +70,6 @@ class SessionBids:
         self._prices = dict(prices)
 
     def price(self, queue: str, band: str = "", pool: str = "") -> float:
-        from armada_tpu.scheduler.providers import most_specific_bid
-
         return most_specific_bid(self._prices, queue, band, pool)
 
 
@@ -196,8 +195,13 @@ class ScheduleSession:
             if jobs or deletes:
                 for m in jobs:
                     if m.terminal:
-                        self._terminal_synced[m.job_id] = (
-                            int(m.run.running_ns) or self._clock_ns()
+                        # 0 = never ran: the penalty can't apply
+                        # (ShortJobPenalty.applies needs running_ns > 0), so
+                        # the sweep drops it at the next round -- and never
+                        # mixes the sidecar wall clock with the caller's
+                        # logical now_ns.
+                        self._terminal_synced[m.job_id] = int(
+                            m.run.running_ns
                         )
                     else:
                         self._terminal_synced.pop(m.job_id, None)
@@ -258,7 +262,7 @@ class ScheduleSession:
             expired = [
                 jid
                 for jid, ns in self._terminal_synced.items()
-                if now - ns >= window
+                if ns == 0 or now - ns >= window
             ]
             if expired:
                 txn.delete(expired)

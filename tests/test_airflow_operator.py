@@ -130,3 +130,147 @@ def test_camel_case_job_keys_accepted():
     assert item["priority_class"] == "armada-default"
     assert item["node_selector"] == {"zone": "a"}
     assert item["gang_cardinality"] == 2
+
+
+def run_deferred(op, context=None):
+    """Drive the deferrable flow the way Airflow's triggerer would: catch
+    TaskDeferred, round-trip the trigger through serialize() (Airflow
+    persists deferred triggers that way), run it to its one TriggerEvent,
+    then resume the operator with it."""
+    import asyncio
+    import importlib
+
+    from armada_tpu.integrations.airflow import TaskDeferred
+
+    try:
+        op.execute(context)
+    except TaskDeferred as d:
+        classpath, kwargs = d.trigger.serialize()
+        mod, cls = classpath.rsplit(".", 1)
+        trigger = getattr(importlib.import_module(mod), cls)(**kwargs)
+
+        async def first_event():
+            async for ev in trigger.run():
+                return ev
+
+        event = asyncio.run(first_event())
+        return getattr(op, d.method_name)(context, event)
+    raise AssertionError("deferrable execute() must raise TaskDeferred")
+
+
+def test_deferrable_operator_success(plane):
+    stop, t = agent(plane)
+    try:
+        op = ArmadaOperator(
+            task_id="defer-ok",
+            armada_url=f"127.0.0.1:{plane.port}",
+            queue="af",
+            job={"resources": {"cpu": "2", "memory": "1"}},
+            poll_interval_s=0.2,
+            timeout_s=30,
+            deferrable=True,
+        )
+        job_id = run_deferred(op)
+        assert job_id == op.job_id and job_id
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_deferrable_operator_failure_raises_on_resume(plane):
+    stop, t = agent(plane)
+    try:
+        op = ArmadaOperator(
+            task_id="defer-fail",
+            armada_url=f"127.0.0.1:{plane.port}",
+            queue="af",
+            job={"resources": {"cpu": "9999", "memory": "1"}},
+            poll_interval_s=0.2,
+            timeout_s=30,
+            deferrable=True,
+        )
+        with pytest.raises(AirflowException, match="failed"):
+            run_deferred(op)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_deferrable_timeout_cancels_and_raises(plane):
+    # No executor: the job never runs; the trigger times out, resume()
+    # cancels the job (parity with the blocking path's deadline) and raises.
+    op = ArmadaOperator(
+        task_id="defer-timeout",
+        armada_url=f"127.0.0.1:{plane.port}",
+        queue="af",
+        job={"resources": {"cpu": "1", "memory": "1"}},
+        poll_interval_s=0.1,
+        timeout_s=1,
+        deferrable=True,
+    )
+    with pytest.raises(AirflowException, match="timed out"):
+        run_deferred(op)
+    client = ArmadaClient(f"127.0.0.1:{plane.port}")
+    try:
+        import time
+
+        deadline = time.time() + 10
+        cancelled = False
+        while time.time() < deadline and not cancelled:
+            for _, seq in client.get_jobset_events("af", "defer-timeout"):
+                for ev in seq.events:
+                    if ev.WhichOneof("event") == "cancelled_job":
+                        cancelled = True
+        assert cancelled
+    finally:
+        client.close()
+
+
+def test_deferred_trigger_cancellation_cancels_the_job(plane):
+    """Killing a DEFERRED task cancels the trigger's asyncio task -- the
+    only teardown signal a deferred operator gets.  The trigger must cancel
+    the armada job on its way out (blocking mode's on_kill contract), or
+    the job runs on-cluster forever."""
+    import asyncio
+
+    from armada_tpu.integrations.airflow import (
+        ArmadaPollJobTrigger,
+        TaskDeferred,
+    )
+
+    op = ArmadaOperator(
+        task_id="defer-killed",
+        armada_url=f"127.0.0.1:{plane.port}",
+        queue="af",
+        job={"resources": {"cpu": "1", "memory": "1"}},
+        poll_interval_s=0.1,
+        deferrable=True,
+    )
+    with pytest.raises(TaskDeferred) as deferred:
+        op.execute()
+    trigger = deferred.value.trigger
+    assert isinstance(trigger, ArmadaPollJobTrigger)
+
+    async def run_then_kill():
+        gen = trigger.run()
+        task = asyncio.ensure_future(gen.__anext__())
+        await asyncio.sleep(0.3)  # let it start polling
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    asyncio.run(run_then_kill())
+    client = ArmadaClient(f"127.0.0.1:{plane.port}")
+    try:
+        import time
+
+        deadline = time.time() + 10
+        cancelled = False
+        while time.time() < deadline and not cancelled:
+            for _, seq in client.get_jobset_events("af", "defer-killed"):
+                for ev in seq.events:
+                    if ev.WhichOneof("event") == "cancelled_job":
+                        cancelled = True
+        assert cancelled
+    finally:
+        client.close()

