@@ -115,7 +115,7 @@ def cmd_queue_list(args):
 def job_items_from_docs(job_docs):
     """Parse the submission-YAML `jobs:` documents into JobSubmitItems
     (shared with the testsuite spec loader)."""
-    from armada_tpu.core.types import Toleration
+    from armada_tpu.core.types import IngressSpec, ServiceSpec, Toleration
     from armada_tpu.server.submit import JobSubmitItem
 
     items = []
@@ -149,6 +149,24 @@ def job_items_from_docs(job_docs):
                     namespace=spec.get("namespace", "default"),
                     annotations=spec.get("annotations", {}),
                     labels=spec.get("labels", {}),
+                    services=tuple(
+                        ServiceSpec(
+                            type=sv.get("type", "NodePort"),
+                            ports=tuple(int(p) for p in sv.get("ports", ())),
+                            name=sv.get("name", ""),
+                        )
+                        for sv in spec.get("services", [])
+                    ),
+                    ingress=tuple(
+                        IngressSpec(
+                            ports=tuple(int(p) for p in ig.get("ports", ())),
+                            annotations=ig.get("annotations", {}),
+                            tls_enabled=bool(ig.get("tlsEnabled", False)),
+                            cert_name=ig.get("certName", ""),
+                            use_cluster_ip=bool(ig.get("useClusterIP", False)),
+                        )
+                        for ig in spec.get("ingress", [])
+                    ),
                 )
             )
     return items
@@ -534,6 +552,8 @@ def load_serve_config(args):
     # lookoutOidc is a nested mapping, not a scalar flag: config-file only
     args.lookout_oidc = serve_doc.get("lookoutoidc")
     args.lookout_trust_proxy = bool(serve_doc.get("lookouttrustproxy", False))
+    if not getattr(args, "replicate_log", False):
+        args.replicate_log = bool(serve_doc.get("replicatelog", False))
     # Follower-to-leader proxy credential (reports proxying under a strict
     # authn chain).  Config-file only -- tokens do not belong on argv.
     # proxyBearerTokenFile wins over an inline proxyBearerToken.
@@ -587,6 +607,7 @@ def cmd_serve(args):
         binoculars_url=args.binoculars_url,
         rest_port=args.rest_port,
         algo_port=getattr(args, "algo_port", None),
+        replicate_log=getattr(args, "replicate_log", False),
         kube_lease_url=args.kube_lease_url,
         kube_lease_namespace=args.kube_lease_namespace,
         bind_host=args.bind_host,
@@ -786,6 +807,14 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         help="serve the grpc-gateway-parity REST/JSON API on this port "
         "(0 = pick a free one); the C++ client (client/cpp) targets it",
+    )
+    srv.add_argument(
+        "--replicate-log",
+        action="store_true",
+        default=False,
+        help="cross-host HA: tail the leader's event log into this "
+        "replica's local log over gRPC (no shared volume); followers "
+        "reject writes with UNAVAILABLE and report not-ready on /ready",
     )
     srv.add_argument(
         "--algo-port",

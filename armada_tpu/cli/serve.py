@@ -63,10 +63,13 @@ class ControlPlaneProcess:
     rest_gateway: object = None
     algo_port: Optional[int] = None
     _algo_server: object = None
+    replicator: object = None
 
     def stop(self) -> None:
         self._stop.set()
         self._scheduler_thread.join(timeout=10)
+        if self.replicator is not None:
+            self.replicator.stop()
         for p in self._pipelines:
             p.stop()
         self._grpc_server.stop(1).wait()
@@ -118,6 +121,7 @@ def start_control_plane(
     advertised_address: Optional[str] = None,
     proxy_bearer_token: Optional[str] = None,
     algo_port: Optional[int] = None,
+    replicate_log: bool = False,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -170,8 +174,20 @@ def start_control_plane(
         start_positions=lookoutdb.positions("lookout"),
     )
 
-    queues = QueueRepository(db)
-    submit_server = SubmitServer(db, publisher, queues, config)
+    # Queue CRUD is event-sourced onto "$control-plane" so replicated
+    # deployments converge on queue config by replay (cross-host HA).
+    queues = QueueRepository(db, publisher=publisher)
+    # Cross-host HA write gate: None = we may write (we hold the log of
+    # record), else the leader's address -> UNAVAILABLE.  `leader` is
+    # constructed below; the closure binds late.  The SAME gate sits on the
+    # Publisher itself (the choke point every append path shares -- submit,
+    # queue CRUD, ExecutorApi reports, ExecutorAdmin events); SubmitServer
+    # additionally checks it first so followers answer UNAVAILABLE before
+    # any local-state error.
+    _write_gate = (lambda: leader.leader_address()) if replicate_log else None
+    submit_server = SubmitServer(
+        db, publisher, queues, config, write_gate=_write_gate
+    )
     event_api = EventApi(eventdb)
     from armada_tpu.server.controlplane import ControlPlaneServer
 
@@ -209,6 +225,8 @@ def start_control_plane(
             if leader_id
             else StandaloneLeaderController()
         )
+    if replicate_log:
+        publisher.write_gate = _write_gate
     from armada_tpu.scheduler.metrics import SchedulerMetrics
     from armada_tpu.scheduler.reports import (
         LeaderProxyingReports,
@@ -285,6 +303,7 @@ def start_control_plane(
         lookout_queries=LookoutQueries(lookoutdb),
         reports=reports_query,
         control_plane=control_plane,
+        replication_log=log if replicate_log else None,
         address=f"{bind_host}:{port}",
         authenticator=authenticator,
     )
@@ -303,6 +322,27 @@ def start_control_plane(
             advertised_address = f"{advertise_host}:{bound_port}"
         leader.set_advertised_address(advertised_address)
         reports_query.set_self_address(advertised_address)
+
+    replicator = None
+    if replicate_log:
+        from armada_tpu.eventlog.replicator import LogReplicator
+        from armada_tpu.rpc.client import ReplicationClient
+
+        def _replication_client(addr: str):
+            # same credential the reports proxy uses for follower->leader
+            # hops (tokens come from config, never argv)
+            return ReplicationClient(
+                addr,
+                principal=f"replica:{leader_id or 'standalone'}",
+                bearer_token=proxy_bearer_token,
+            )
+
+        replicator = LogReplicator(
+            log,
+            leader_address=leader.leader_address,
+            client_factory=_replication_client,
+        )
+        replicator.start()
 
     scheduler_pipeline.start()
     event_pipeline.start()
@@ -358,6 +398,19 @@ def start_control_plane(
                     pname,
                 )
             )
+        if replicate_log:
+            # /ready gates on leadership: followers are healthy but NOT
+            # ready, so the k8s Service only routes to the log of record
+            # (the manifest's readinessProbe; liveness stays /health).
+            def _ready():
+                addr = leader.leader_address()
+                return (
+                    None
+                    if addr is None
+                    else f"follower (leader at {addr or 'unknown'})"
+                )
+
+            health_server.ready_checker = _ready
         startup.mark_complete()
 
     lookout_web = None
@@ -454,6 +507,7 @@ def start_control_plane(
         rest_gateway=rest_gateway,
         algo_port=algo_bound,
         _algo_server=algo_server,
+        replicator=replicator,
     )
 
 

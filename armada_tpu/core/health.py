@@ -141,6 +141,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(204)
             else:
                 self._respond(503, err.encode())
+        elif path == "/ready":
+            # Readiness is liveness + the optional gate (e.g. leadership in
+            # replicated deployments: followers stay out of the k8s Service
+            # so writes only ever reach the log of record).
+            err = srv.checker.check()
+            if err is None and srv.ready_checker is not None:
+                err = srv.ready_checker()
+            if err is None:
+                self._respond(204)
+            else:
+                self._respond(503, err.encode())
         elif path == "/debug/pprof/profile" and srv.profiling:
             qs = parse_qs(parsed.query)
             try:
@@ -184,6 +195,9 @@ class HealthServer:
 
     def __init__(self, port: int = 0, profiling: bool = False, host: str = "127.0.0.1"):
         self.checker = MultiChecker()
+        # Optional () -> error-or-None gate behind /ready (readiness can be
+        # stricter than liveness: a healthy follower is alive but not ready).
+        self.ready_checker = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -74,6 +74,30 @@ class NodeSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServiceSpec:
+    """A per-job Service the executor materialises next to the pod
+    (pkg/api/submit.proto ServiceConfig: NodePort | Headless).  `name` ""
+    derives one from the job id."""
+
+    type: str = "NodePort"
+    ports: tuple[int, ...] = ()
+    name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class IngressSpec:
+    """A per-job Ingress exposing service ports over the network
+    (pkg/api/submit.proto IngressConfig; materialised like
+    executor/util/kubernetes_object.go ExtractIngresses)."""
+
+    ports: tuple[int, ...] = ()
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    tls_enabled: bool = False
+    cert_name: str = ""
+    use_cluster_ip: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class JobSpec:
     """A job as the scheduler sees it (jobdb/job.go scheduling-relevant subset).
 
@@ -103,6 +127,10 @@ class JobSpec:
     namespace: str = "default"
     annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # Network objects materialised with the pod (submit.proto ingress:9 /
+    # services:10); the scheduler never reads these.
+    services: tuple[ServiceSpec, ...] = ()
+    ingress: tuple[IngressSpec, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -42,6 +42,19 @@ class PublishedRef(NamedTuple):
     offset: int
 
 
+class NotLeader(RuntimeError):
+    """Publish rejected: this replica's log is a replica, not the log of
+    record (cross-host HA).  Carries the leader's advertised address; the
+    gRPC layer maps it to the retryable UNAVAILABLE."""
+
+    def __init__(self, leader_address: str = ""):
+        super().__init__(
+            "not the leader"
+            + (f"; leader at {leader_address}" if leader_address else "")
+        )
+        self.leader_address = leader_address
+
+
 class Publisher:
     """Routes EventSequences to log partitions; the only write path to the log."""
 
@@ -54,9 +67,19 @@ class Publisher:
         self._log = log
         self._max_events = max_events_per_message
         self._clock = clock
+        # Replicated deployments (serve --replicate-log): () -> None (may
+        # write) | leader address (must not).  Checked on EVERY publish --
+        # this is the single choke point, so a follower's ExecutorApi /
+        # ExecutorAdmin / queue-CRUD handlers can never append locally and
+        # fork the log their replicator is tailing.
+        self.write_gate = None
 
     def publish(self, sequences: Iterable[pb.EventSequence]) -> list[PublishedRef]:
         """Append sequences (chunked) to their jobset partitions, then fsync."""
+        if self.write_gate is not None:
+            leader = self.write_gate()
+            if leader is not None:
+                raise NotLeader(leader)
         refs: list[PublishedRef] = []
         for seq in sequences:
             key = jobset_key(seq.queue, seq.jobset)

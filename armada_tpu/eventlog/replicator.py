@@ -1,0 +1,172 @@
+"""Cross-host event-log replication: followers tail the leader's log.
+
+The reference survives a node loss because its durable state lives in
+Pulsar + Postgres, off the scheduler hosts (leader.go:112-190 only elects;
+state is remote).  This repo's log is host-local (native/eventlog.cc), so a
+replicated deployment WITHOUT shared storage needs the follower to carry
+its own copy: `LogReplicator` tails every partition of the leader's log
+over the LogReplication gRPC service into the follower's local log.
+
+Records are byte-framed with offset == byte position, so appending the
+same records in the same order reproduces IDENTICAL offsets -- after
+takeover the follower's ingest pipelines resume from their own committed
+consumer positions against a log that is a byte-for-byte prefix-equal
+copy of the leader's.
+
+Replication is asynchronous (the tail of Pulsar-style geo-replication,
+not synchronous quorum writes): an event the leader committed but had not
+yet streamed when it died is lost with the leader's disk.  The window is
+one poll interval (~50ms); deployments that cannot tolerate it need
+shared/remote storage for the log itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from armada_tpu.eventlog.log import EventLog
+
+log = logging.getLogger("armada.replicator")
+
+
+class ReplicationDiverged(RuntimeError):
+    """The local log is not a prefix of the leader's (e.g. this replica
+    previously led and accepted writes the current leader never saw).
+    Automatic repair would silently drop committed local records -- an
+    operator must pick a survivor (wipe this replica's data dir)."""
+
+
+class LogReplicator:
+    """Tail the current leader's log into `local` (all partitions).
+
+    `leader_address` returns the address to tail: None/"" = no leader to
+    follow right now (we ARE the leader, or an election gap) -- the
+    replicator idles and re-resolves.  `client_factory(address)` returns an
+    object with `tail_log(partition, from_offset, follow, idle_timeout_s)`
+    yielding LogRecord messages and a `close()` (rpc.client.ReplicationClient).
+    """
+
+    def __init__(
+        self,
+        local: EventLog,
+        leader_address: Callable[[], Optional[str]],
+        client_factory,
+        poll_interval_s: float = 0.2,
+        idle_timeout_s: float = 5.0,
+    ):
+        self.local = local
+        self._leader_address = leader_address
+        self._client_factory = client_factory
+        self._poll = poll_interval_s
+        self._idle = idle_timeout_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # partition -> replicated end offset (observability/tests)
+        self.replicated_to: dict[int, int] = {
+            p: local.end_offset(p) for p in range(local.num_partitions)
+        }
+        self.diverged = threading.Event()
+
+    def start(self) -> None:
+        for p in range(self.local.num_partitions):
+            t = threading.Thread(
+                target=self._run_partition, args=(p,), daemon=True,
+                name=f"log-replicator-p{p}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # ------------------------------------------------------------------------
+
+    def _run_partition(self, partition: int) -> None:
+        while not self._stop.is_set():
+            address = None
+            try:
+                address = self._leader_address()
+            except Exception:
+                pass
+            if not address:
+                # we lead (None) or nobody does (""): nothing to tail
+                self._stop.wait(self._poll)
+                continue
+            try:
+                self._tail_once(partition, address)
+            except ReplicationDiverged:
+                self.diverged.set()
+                log.error(
+                    "partition %d: local log diverged from leader %s -- "
+                    "replication halted (operator action required)",
+                    partition,
+                    address,
+                )
+                return
+            except Exception as e:
+                log.warning(
+                    "partition %d: tail of %s failed (%s); retrying",
+                    partition,
+                    address,
+                    e,
+                )
+                self._stop.wait(self._poll)
+
+    def _tail_once(self, partition: int, address: str) -> None:
+        client = self._client_factory(address)
+        try:
+            start = self.local.end_offset(partition)
+            info = client.get_log_info()
+            leader_end = list(info.end_offsets)[partition]
+            if start > leader_end:
+                # local log is LONGER than the leader's: we hold committed
+                # records the leader never saw (e.g. this replica led once)
+                raise ReplicationDiverged(
+                    f"partition {partition}: local end {start} beyond "
+                    f"leader end {leader_end}"
+                )
+            for record in client.tail_log(
+                partition,
+                from_offset=start,
+                follow=True,
+                idle_timeout_s=self._idle,
+            ):
+                if self._stop.is_set():
+                    return
+                local_end = self.local.end_offset(partition)
+                if record.offset != local_end:
+                    # Gap (leader compacted?) or overlap mismatch: either
+                    # way the byte-prefix property is broken.
+                    raise ReplicationDiverged(
+                        f"partition {partition}: leader streams offset "
+                        f"{record.offset}, local end is {local_end}"
+                    )
+                self.local.append(partition, record.key, record.payload)
+                self.replicated_to[partition] = self.local.end_offset(
+                    partition
+                )
+        except Exception as e:
+            # A local end offset that is not a record BOUNDARY in the
+            # leader's log makes the leader's read fail with its corrupt-
+            # record error: that is divergence (mismatched histories), not
+            # a transient stream failure.
+            if "corrupt record" in str(e):
+                raise ReplicationDiverged(
+                    f"partition {partition}: local end is not a record "
+                    f"boundary in the leader's log ({e})"
+                ) from e
+            raise
+        finally:
+            client.close()
+
+    def caught_up_to(self, end_offsets: dict[int, int]) -> bool:
+        """True when every partition has replicated at least to the given
+        end offsets (test/drain helper)."""
+        return all(
+            self.local.end_offset(p) >= off for p, off in end_offsets.items()
+        )

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Mapping, Optional
 
 from armada_tpu.core.resources import ResourceList, ResourceListFactory
-from armada_tpu.core.types import JobSpec, Toleration
+from armada_tpu.core.types import IngressSpec, JobSpec, ServiceSpec, Toleration
 from armada_tpu.events import events_pb2 as pb
 
 
@@ -52,6 +52,20 @@ def job_spec_to_proto(job: JobSpec) -> pb.JobSpec:
         namespace=job.namespace,
         annotations=dict(job.annotations),
         labels=dict(job.labels),
+        services=[
+            pb.ServiceSpec(type=sv.type, ports=list(sv.ports), name=sv.name)
+            for sv in job.services
+        ],
+        ingress=[
+            pb.IngressSpec(
+                ports=list(ig.ports),
+                annotations=dict(ig.annotations),
+                tls_enabled=ig.tls_enabled,
+                cert_name=ig.cert_name,
+                use_cluster_ip=ig.use_cluster_ip,
+            )
+            for ig in job.ingress
+        ],
     )
 
 
@@ -84,4 +98,22 @@ def job_spec_from_proto(
         namespace=msg.namespace or "default",
         annotations=dict(msg.annotations),
         labels=dict(msg.labels),
+        services=tuple(
+            ServiceSpec(
+                type=sv.type or "NodePort",
+                ports=tuple(int(x) for x in sv.ports),
+                name=sv.name,
+            )
+            for sv in msg.services
+        ),
+        ingress=tuple(
+            IngressSpec(
+                ports=tuple(int(x) for x in ig.ports),
+                annotations=dict(ig.annotations),
+                tls_enabled=ig.tls_enabled,
+                cert_name=ig.cert_name,
+                use_cluster_ip=ig.use_cluster_ip,
+            )
+            for ig in msg.ingress
+        ),
     )

@@ -30,6 +30,11 @@ class _Pod:
     start_at: float
     finish_at: float
     log: list = dataclasses.field(default_factory=list)
+    # Materialised network objects (spec.services / spec.ingress): names the
+    # fake "created", and port -> synthesized address for exposed ports.
+    services: list = dataclasses.field(default_factory=list)
+    ingresses: list = dataclasses.field(default_factory=list)
+    addresses: dict = dataclasses.field(default_factory=dict)
 
 
 class FakeClusterContext:
@@ -92,6 +97,25 @@ class FakeClusterContext:
             )
         self._allocated[node_id] += req
         runtime = self._runtime_of(spec)
+        # Materialise the job's network objects like the kube adapter does
+        # (executor/util/kubernetes_object.go): one Service per ServiceSpec,
+        # one Ingress per IngressSpec, ingress ports resolving to
+        # synthesized per-job hosts.
+        services, ingresses, addresses = [], [], {}
+        next_node_port = 30000 + (abs(hash(run_id)) % 1000)
+        for i, sv in enumerate(getattr(spec, "services", ()) or ()):
+            services.append(sv.name or f"armada-{run_id}-svc{i}")
+        for i, ig in enumerate(getattr(spec, "ingress", ()) or ()):
+            ingresses.append(f"armada-{run_id}-ing{i}")
+            for port in ig.ports:
+                addresses[int(port)] = f"{job_id}-{port}.fake.local"
+        for sv in getattr(spec, "services", ()) or ():
+            if sv.type == "NodePort":
+                for port in sv.ports:
+                    addresses.setdefault(
+                        int(port), f"{node_id}:{next_node_port}"
+                    )
+                    next_node_port += 1
         self._pods[run_id] = _Pod(
             state=PodState(
                 run_id=run_id,
@@ -105,7 +129,18 @@ class FakeClusterContext:
             start_at=self.now + self._start_delay,
             finish_at=self.now + self._start_delay + runtime,
             log=[f"[t={self.now:.1f}] pod created for job {job_id} on {node_id}"],
+            services=services,
+            ingresses=ingresses,
+            addresses=addresses,
         )
+        for name in services:
+            self._pods[run_id].log.append(
+                f"[t={self.now:.1f}] service {name} created"
+            )
+        for name in ingresses:
+            self._pods[run_id].log.append(
+                f"[t={self.now:.1f}] ingress {name} created"
+            )
 
     def delete_pod(self, run_id: str) -> None:
         pod = self._pods.pop(run_id, None)
@@ -160,6 +195,19 @@ class FakeClusterContext:
     def get_pod(self, run_id: str) -> Optional[PodState]:
         pod = self._pods.get(run_id)
         return pod.state if pod else None
+
+    def pod_network(self, run_id: str) -> dict[int, str]:
+        """port -> reachable address of the run's exposed ports (ingress
+        hosts + NodePort bindings) -- the payload behind the executor's
+        StandaloneIngressInfo report.  {} = nothing exposed."""
+        pod = self._pods.get(run_id)
+        return dict(pod.addresses) if pod else {}
+
+    def pod_network_objects(self, run_id: str) -> tuple[list, list]:
+        """(service names, ingress names) the fake materialised -- cleanup
+        and kind-e2e assertions."""
+        pod = self._pods.get(run_id)
+        return (list(pod.services), list(pod.ingresses)) if pod else ([], [])
 
     # --- simulation controls ------------------------------------------------
 

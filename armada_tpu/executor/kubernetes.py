@@ -98,6 +98,7 @@ class KubernetesClusterContext:
         pool_label: str = "armada-tpu.io/pool",
         default_pool: str = "default",
         default_image: str = "busybox:latest",
+        ingress_host_suffix: str = "jobs.local",
         timeout_s: float = 30.0,
         executor_id: str = "",
         namespaces: Optional[Sequence[str]] = None,
@@ -119,10 +120,18 @@ class KubernetesClusterContext:
         self.pool_label = pool_label
         self.default_pool = default_pool
         self.default_image = default_image
+        # Host pattern for per-job ingress rules: {job_id}-{port}.{suffix}
+        # (the reference's executor ingress config supplies the suffix/
+        # annotations, internal/executor/configuration IngressConfiguration).
+        self.ingress_host_suffix = ingress_host_suffix
         self._timeout = timeout_s
         self._lock = threading.Lock()
         # run_id -> (namespace, pod name); rebuilt from labels on relisting.
         self._pods: dict[str, tuple[str, str]] = {}
+        # run_id -> {"services": [(ns, name)], "ingresses": [(ns, name)],
+        # "addresses": {port: host}} -- the job's materialised network
+        # objects (kubernetes_object.go ExtractServices/ExtractIngresses).
+        self._net: dict[str, dict] = {}
         if base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if client_cert_file:
@@ -190,13 +199,218 @@ class KubernetesClusterContext:
         manifest = self._pod_manifest(
             name, run_id, job_id, queue, jobset, spec, node_id
         )
+        pod_uid = ""
         try:
-            self._request("POST", f"/api/v1/namespaces/{namespace}/pods", manifest)
+            created = self._request(
+                "POST", f"/api/v1/namespaces/{namespace}/pods", manifest
+            )
+            pod_uid = created.get("metadata", {}).get("uid", "")
         except KubeApiError as e:
             if e.status != 409:  # already exists: idempotent resubmit
                 raise
+            # resubmit / crash recovery: fetch the live pod's uid so the
+            # network objects are (re)created idempotently -- the first
+            # attempt may have died between the pod POST and these
+            try:
+                existing = self._request(
+                    "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"
+                )
+                pod_uid = existing.get("metadata", {}).get("uid", "")
+            except KubeApiError:
+                pod_uid = ""
         with self._lock:
             self._pods[run_id] = (namespace, name)
+        if pod_uid and (spec.services or spec.ingress):
+            # The job's Services/Ingresses, owner-referenced to the pod so
+            # the cluster GCs them even if the executor dies mid-cleanup
+            # (kubernetes_object.go CreateOwnerReference).  A failure here
+            # must not leave a half-exposed job running against a terminal
+            # job record: unwind the pod and report the submission rejected.
+            try:
+                self._create_network_objects(
+                    namespace, name, pod_uid, run_id, job_id, queue, spec,
+                    node_id,
+                )
+            except Exception:
+                try:
+                    self.delete_pod(run_id)
+                except Exception:
+                    pass  # owner refs / relist cleanup will finish the job
+                raise
+
+    def _create_network_objects(
+        self, namespace, pod_name, pod_uid, run_id, job_id, queue, spec, node_id
+    ) -> None:
+        owner = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "name": pod_name,
+            "uid": pod_uid,
+        }
+        labels = {
+            RUN_LABEL: run_id,
+            JOB_LABEL: job_id,
+            QUEUE_LABEL: queue,
+        }
+        net = {"services": [], "ingresses": [], "addresses": {}}
+        port_service: dict[int, str] = {}
+        for i, sv in enumerate(spec.services):
+            sname = (sv.name or f"armada-{run_id.lower()}-svc{i}")[:63]
+            headless = sv.type == "Headless"
+            manifest = {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {
+                    "name": sname,
+                    "labels": labels,
+                    "ownerReferences": [owner],
+                },
+                "spec": {
+                    "type": "ClusterIP" if headless else "NodePort",
+                    **({"clusterIP": "None"} if headless else {}),
+                    # selector by run id: exactly this pod (ExtractServices
+                    # selects by the job labels the pod carries)
+                    "selector": {RUN_LABEL: run_id},
+                    "ports": [
+                        {"name": f"p{p}", "port": int(p), "targetPort": int(p)}
+                        for p in sv.ports
+                    ],
+                },
+            }
+            try:
+                resp = self._request(
+                    "POST",
+                    f"/api/v1/namespaces/{namespace}/services",
+                    manifest,
+                )
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+                resp = {}
+            net["services"].append((namespace, sname))
+            for p in sv.ports:
+                port_service[int(p)] = sname
+            if not headless:
+                for entry in resp.get("spec", {}).get("ports", ()):
+                    node_port = entry.get("nodePort")
+                    if node_port:
+                        net["addresses"].setdefault(
+                            int(entry["port"]), f"{node_id}:{node_port}"
+                        )
+        for i, ig in enumerate(spec.ingress):
+            iname = f"armada-{run_id.lower()}-ing{i}"[:63]
+            rules = []
+            tls_hosts = []
+            for p in ig.ports:
+                backend = (
+                    None if ig.use_cluster_ip else port_service.get(int(p))
+                )
+                if backend is None:
+                    # an ingress port with no declared service: expose it
+                    # via a dedicated ClusterIP service (the reference's
+                    # server-side conversion pairs ingress ports with
+                    # services before the executor sees them)
+                    backend = f"armada-{run_id.lower()}-ingsvc{i}"[:63]
+                    svc = {
+                        "apiVersion": "v1",
+                        "kind": "Service",
+                        "metadata": {
+                            "name": backend,
+                            "labels": labels,
+                            "ownerReferences": [owner],
+                        },
+                        "spec": {
+                            "selector": {RUN_LABEL: run_id},
+                            "ports": [
+                                {
+                                    "name": f"p{q}",
+                                    "port": int(q),
+                                    "targetPort": int(q),
+                                }
+                                for q in ig.ports
+                            ],
+                        },
+                    }
+                    try:
+                        self._request(
+                            "POST",
+                            f"/api/v1/namespaces/{namespace}/services",
+                            svc,
+                        )
+                    except KubeApiError as e:
+                        if e.status != 409:
+                            raise
+                    net["services"].append((namespace, backend))
+                    for q in ig.ports:
+                        port_service[int(q)] = backend
+                host = f"{job_id}-{p}.{self.ingress_host_suffix}"
+                net["addresses"][int(p)] = host
+                tls_hosts.append(host)
+                rules.append(
+                    {
+                        "host": host,
+                        "http": {
+                            "paths": [
+                                {
+                                    "path": "/",
+                                    "pathType": "Prefix",
+                                    "backend": {
+                                        "service": {
+                                            "name": backend,
+                                            "port": {"number": int(p)},
+                                        }
+                                    },
+                                }
+                            ]
+                        },
+                    }
+                )
+            manifest = {
+                "apiVersion": "networking.k8s.io/v1",
+                "kind": "Ingress",
+                "metadata": {
+                    "name": iname,
+                    "labels": labels,
+                    "annotations": dict(ig.annotations),
+                    "ownerReferences": [owner],
+                },
+                "spec": {
+                    "rules": rules,
+                    **(
+                        {
+                            "tls": [
+                                {
+                                    "hosts": tls_hosts,
+                                    "secretName": ig.cert_name
+                                    or f"{iname}-tls",
+                                }
+                            ]
+                        }
+                        if ig.tls_enabled
+                        else {}
+                    ),
+                },
+            }
+            try:
+                self._request(
+                    "POST",
+                    f"/apis/networking.k8s.io/v1/namespaces/{namespace}"
+                    "/ingresses",
+                    manifest,
+                )
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+            net["ingresses"].append((namespace, iname))
+        with self._lock:
+            self._net[run_id] = net
+
+    def pod_network(self, run_id: str) -> dict:
+        """port -> reachable address (ingress host / node:nodePort) for the
+        run -- the executor's StandaloneIngressInfo payload."""
+        with self._lock:
+            net = self._net.get(run_id)
+        return dict(net["addresses"]) if net else {}
 
     def _pod_manifest(
         self, name, run_id, job_id, queue, jobset, spec: JobSpec, node_id
@@ -267,6 +481,34 @@ class KubernetesClusterContext:
         if loc is None:
             return
         namespace, name = loc
+        # Network objects first (same-cycle reclaim ordering applies to the
+        # pod; services/ingresses hold no schedulable capacity).  Owner
+        # references make this belt-and-braces: the cluster GCs them with
+        # the pod even if these DELETEs never land.
+        with self._lock:
+            net = self._net.pop(run_id, None)
+        if net is not None:
+            # BEST EFFORT: these hold no schedulable capacity and carry
+            # owner references (the cluster GCs them with the pod), so a
+            # transient apiserver error here must never abort the
+            # executor's cancel/preempt loop before the POD delete -- the
+            # same-cycle capacity-reclaim ordering is about pods.
+            for ns, sname in net["services"]:
+                try:
+                    self._request(
+                        "DELETE", f"/api/v1/namespaces/{ns}/services/{sname}"
+                    )
+                except KubeApiError:
+                    pass
+            for ns, iname in net["ingresses"]:
+                try:
+                    self._request(
+                        "DELETE",
+                        f"/apis/networking.k8s.io/v1/namespaces/{ns}"
+                        f"/ingresses/{iname}",
+                    )
+                except KubeApiError:
+                    pass
         try:
             self._request(
                 "DELETE",

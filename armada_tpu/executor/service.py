@@ -220,6 +220,31 @@ class ExecutorService:
                 ev.job_run_running.job_id = pod.job_id
                 ev.job_run_running.run_id = pod.run_id
                 ev.job_run_running.node_id = pod.node_id
+                # Exposed ports ride along once the pod runs (reference:
+                # the executor's StandaloneIngressInfo event; lookout
+                # surfaces the addresses).
+                net = getattr(self.cluster, "pod_network", None)
+                addresses = net(pod.run_id) if net is not None else {}
+                if addresses:
+                    info = pb.Event(
+                        created_ns=now_ns,
+                        ingress_info=pb.StandaloneIngressInfo(
+                            job_id=pod.job_id,
+                            run_id=pod.run_id,
+                            addresses={
+                                int(p): a for p, a in addresses.items()
+                            },
+                        ),
+                    )
+                    self._reported[pod.run_id] = pod.phase
+                    sequences.append(
+                        pb.EventSequence(
+                            queue=pod.queue,
+                            jobset=pod.jobset,
+                            events=[ev, info],
+                        )
+                    )
+                    continue
             elif pod.phase is PodPhase.SUCCEEDED:
                 ev.job_run_succeeded.job_id = pod.job_id
                 ev.job_run_succeeded.run_id = pod.run_id

@@ -86,6 +86,21 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
         return ops.MarkJobsPreemptRequested(job_ids={ev.preempt_job.job_id})
     # control-plane events (the "$control-plane" stream; reference
     # scheduleringester ControlPlaneEventsInstructionConverter)
+    if kind == "queue_upsert":
+        e = ev.queue_upsert
+        return ops.UpsertQueues(
+            queues_by_name={
+                e.name: {
+                    "weight": float(e.weight),
+                    "cordoned": bool(e.cordoned),
+                    "owners": list(e.owners),
+                    "groups": list(e.groups),
+                    "labels": dict(e.labels),
+                }
+            }
+        )
+    if kind == "queue_delete":
+        return ops.DeleteQueues(names={ev.queue_delete.name})
     if kind == "executor_settings_upsert":
         e = ev.executor_settings_upsert
         return ops.UpsertExecutorSettings(

@@ -303,6 +303,35 @@ class InsertPartitionMarker(DbOperation):
 
 
 @dataclasses.dataclass
+class UpsertQueues(DbOperation):
+    # name -> {"weight", "cordoned", "owners", "groups", "labels"}
+    queues_by_name: dict[str, dict]
+
+    def tokens(self) -> set[str]:
+        return {f"*queue-config/{n}" for n in self.queues_by_name}
+
+    def merge(self, other: DbOperation) -> bool:
+        if isinstance(other, UpsertQueues):
+            self.queues_by_name.update(other.queues_by_name)
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class DeleteQueues(DbOperation):
+    names: set[str]
+
+    def tokens(self) -> set[str]:
+        return {f"*queue-config/{n}" for n in self.names}
+
+    def merge(self, other: DbOperation) -> bool:
+        if isinstance(other, DeleteQueues):
+            self.names |= other.names
+            return True
+        return False
+
+
+@dataclasses.dataclass
 class UpsertExecutorSettings(DbOperation):
     # name -> {"cordoned": bool, "cordon_reason": str, "set_by_user": str}
     settings_by_name: dict[str, dict]

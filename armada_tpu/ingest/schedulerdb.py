@@ -370,6 +370,33 @@ class SchedulerDb:
                 "VALUES (?, ?, ?)",
                 (op.group_id, op.partition, op.created_ns),
             )
+        elif isinstance(op, ops.UpsertQueues):
+            import json as _json
+
+            cur.executemany(
+                "INSERT INTO queues (name, weight, cordoned, owners, "
+                "groups_json, labels_json) VALUES (?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(name) DO UPDATE SET "
+                "weight = excluded.weight, cordoned = excluded.cordoned, "
+                "owners = excluded.owners, "
+                "groups_json = excluded.groups_json, "
+                "labels_json = excluded.labels_json",
+                [
+                    (
+                        name,
+                        float(q.get("weight", 1.0)),
+                        int(q.get("cordoned", False)),
+                        _json.dumps(q.get("owners", [])),
+                        _json.dumps(q.get("groups", [])),
+                        _json.dumps(q.get("labels", {})),
+                    )
+                    for name, q in op.queues_by_name.items()
+                ],
+            )
+        elif isinstance(op, ops.DeleteQueues):
+            cur.executemany(
+                "DELETE FROM queues WHERE name = ?", [(n,) for n in op.names]
+            )
         elif isinstance(op, ops.UpsertExecutorSettings):
             cur.executemany(
                 "INSERT INTO executor_settings "

@@ -104,6 +104,7 @@ class ArmadaPollJobTrigger(BaseTrigger):
         job_id: str,
         poll_interval_s: float = 5.0,
         timeout_s: float = 0.0,
+        cancel_on_cancellation: bool = True,
     ):
         self.armada_url = armada_url
         self.queue = queue
@@ -111,6 +112,7 @@ class ArmadaPollJobTrigger(BaseTrigger):
         self.job_id = job_id
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
+        self.cancel_on_cancellation = cancel_on_cancellation
 
     def serialize(self):
         """(classpath, kwargs): how Airflow persists a deferred trigger."""
@@ -123,8 +125,40 @@ class ArmadaPollJobTrigger(BaseTrigger):
                 "job_id": self.job_id,
                 "poll_interval_s": self.poll_interval_s,
                 "timeout_s": self.timeout_s,
+                "cancel_on_cancellation": self.cancel_on_cancellation,
             },
         )
+
+    def _should_cancel(self) -> bool:
+        """Distinguish 'task killed' from 'triggerer restarting/rebalancing'
+        -- Airflow cancels triggers in BOTH cases, but only the former
+        should kill the armada job.  The reference's trigger cancels when
+        the task instance is NO LONGER deferred (third_party/airflow/
+        armada/triggers.py:63-94): a rebalance keeps it DEFERRED and the
+        trigger simply resumes elsewhere.  Without an Airflow metadata DB
+        to ask (the stand-in path) the answer is unknowable: err toward
+        cancelling, matching blocking-mode on_kill; HA triggerer
+        deployments that rebalance routinely should set
+        cancel_on_cancellation=False."""
+        try:  # pragma: no cover - requires a live Airflow metadata DB
+            from airflow.models.taskinstance import TaskInstance
+            from airflow.utils.session import create_session
+            from airflow.utils.state import TaskInstanceState
+
+            with create_session() as session:
+                for ti in (
+                    session.query(TaskInstance)
+                    .filter(TaskInstance.trigger_id.isnot(None))
+                    .all()
+                ):
+                    timer = getattr(ti, "trigger", None)
+                    kwargs = getattr(timer, "kwargs", None) or {}
+                    if kwargs.get("job_id") == self.job_id:
+                        # still deferred = rebalance, the trigger resumes
+                        return ti.state != TaskInstanceState.DEFERRED
+            return True  # no owning task instance: task is gone, cancel
+        except Exception:
+            return True  # no Airflow / can't tell: keep on_kill semantics
 
     async def run(self):
         from armada_tpu.rpc.client import ArmadaClient
@@ -169,16 +203,19 @@ class ArmadaPollJobTrigger(BaseTrigger):
             # how Airflow tears down a deferred task): resume() never runs
             # and the re-created operator's on_kill has no job_id, so the
             # cancel MUST happen here or the job runs on-cluster forever --
-            # blocking mode's on_kill contract (armada.py:313).
-            try:
-                client.cancel_jobs(
-                    self.queue,
-                    self.jobset,
-                    [self.job_id],
-                    reason="airflow task killed while deferred",
-                )
-            except Exception:
-                pass  # best effort during teardown
+            # blocking mode's on_kill contract (armada.py:313).  Guarded:
+            # a triggerer restart/rebalance ALSO cancels triggers, and
+            # those jobs must live (_task_still_deferred / the opt-out).
+            if self.cancel_on_cancellation and self._should_cancel():
+                try:
+                    client.cancel_jobs(
+                        self.queue,
+                        self.jobset,
+                        [self.job_id],
+                        reason="airflow task killed while deferred",
+                    )
+                except Exception:
+                    pass  # best effort during teardown
             raise
         except Exception as e:  # polling failure -> resume() raises
             yield TriggerEvent({"job_id": self.job_id, "error": str(e)})

@@ -48,6 +48,7 @@ CREATE TABLE IF NOT EXISTS job (
   node TEXT NOT NULL DEFAULT '',
   error TEXT NOT NULL DEFAULT '',
   annotations_json TEXT NOT NULL DEFAULT '{}',
+  ingress_json TEXT NOT NULL DEFAULT '',
   spec BLOB
 );
 CREATE INDEX IF NOT EXISTS idx_job_queue_jobset ON job(queue, jobset);
@@ -101,6 +102,12 @@ class LookoutDb:
         if "usage_json" not in cols:
             self._conn.execute(
                 "ALTER TABLE job_run ADD COLUMN usage_json TEXT NOT NULL DEFAULT ''"
+            )
+        jcols = {r[1] for r in self._conn.execute("PRAGMA table_info(job)")}
+        if "ingress_json" not in jcols:
+            # pre-round-5 file DBs: ingress address reporting
+            self._conn.execute(
+                "ALTER TABLE job ADD COLUMN ingress_json TEXT NOT NULL DEFAULT ''"
             )
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
@@ -166,6 +173,13 @@ class LookoutDb:
                     json.dumps(op.get("annotations", {})),
                     op.get("spec", b""),
                 ),
+            )
+        elif kind == "job_ingress":
+            # StandaloneIngressInfo: where the executor exposed the job's
+            # ports (reference lookout shows ingress addresses per job).
+            cur.execute(
+                "UPDATE job SET ingress_json = ? WHERE job_id = ?",
+                (json.dumps(op.get("addresses", {})), op["job_id"]),
             )
         elif kind == "job_state":
             # Terminal states are sticky: late events can't resurrect a job

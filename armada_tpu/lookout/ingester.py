@@ -151,6 +151,18 @@ def lookout_converter(sequences) -> list[dict]:
                 ops.append(
                     {"kind": "job_state", "job_id": e.job_id, "state": "RUNNING", "ts": ts}
                 )
+            elif kind == "ingress_info":
+                e = ev.ingress_info
+                ops.append(
+                    {
+                        "kind": "job_ingress",
+                        "job_id": e.job_id,
+                        "addresses": {
+                            str(port): addr
+                            for port, addr in e.addresses.items()
+                        },
+                    }
+                )
             elif kind == "job_run_succeeded":
                 e = ev.job_run_succeeded
                 ops.append(

@@ -260,6 +260,8 @@ class LookoutQueries:
     def _job_row_to_dict(r) -> dict:
         d = dict(r)
         d["annotations"] = json.loads(d.pop("annotations_json", "{}"))
+        ing = d.pop("ingress_json", "")
+        d["ingress"] = json.loads(ing) if ing else {}
         d.pop("spec", None)
         return d
 

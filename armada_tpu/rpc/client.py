@@ -566,3 +566,37 @@ class ScheduleClient(_Base):
             pb.ScheduleSessionHandle(session_id=session_id),
             pb.Empty,
         )
+
+
+class ReplicationClient(_Base):
+    """Tail a replica's durable event log (armada_tpu.api.LogReplication):
+    the follower side of cross-host HA (eventlog/replicator.py)."""
+
+    def get_log_info(self):
+        return self._unary(
+            "/armada_tpu.api.LogReplication/GetLogInfo",
+            pb.LogInfoRequest(),
+            pb.LogInfoResponse,
+        )
+
+    def tail_log(
+        self,
+        partition: int,
+        from_offset: int = 0,
+        follow: bool = False,
+        idle_timeout_s: float = 0.0,
+    ):
+        call = self._channel.unary_stream(
+            "/armada_tpu.api.LogReplication/TailLog",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.LogRecord.FromString,
+        )
+        yield from call(
+            pb.TailLogRequest(
+                partition=partition,
+                from_offset=from_offset,
+                follow=follow,
+                idle_timeout_s=idle_timeout_s,
+            ),
+            metadata=self._meta,
+        )

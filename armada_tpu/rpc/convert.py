@@ -5,7 +5,7 @@ from __future__ import annotations
 from typing import Optional
 
 from armada_tpu.core.resources import ResourceListFactory
-from armada_tpu.core.types import NodeSpec, Taint, Toleration
+from armada_tpu.core.types import IngressSpec, NodeSpec, ServiceSpec, Taint, Toleration
 from armada_tpu.events import events_pb2 as epb
 from armada_tpu.rpc import rpc_pb2 as pb
 from armada_tpu.scheduler.api import (
@@ -38,6 +38,24 @@ def submit_item_from_proto(msg: pb.SubmitItem) -> JobSubmitItem:
         namespace=msg.namespace or "default",
         annotations=dict(msg.annotations),
         labels=dict(msg.labels),
+        services=tuple(
+            ServiceSpec(
+                type=sv.type or "NodePort",
+                ports=tuple(int(x) for x in sv.ports),
+                name=sv.name,
+            )
+            for sv in msg.services
+        ),
+        ingress=tuple(
+            IngressSpec(
+                ports=tuple(int(x) for x in ig.ports),
+                annotations=dict(ig.annotations),
+                tls_enabled=ig.tls_enabled,
+                cert_name=ig.cert_name,
+                use_cluster_ip=ig.use_cluster_ip,
+            )
+            for ig in msg.ingress
+        ),
     )
 
 
@@ -59,6 +77,22 @@ def submit_item_to_proto(item: JobSubmitItem) -> pb.SubmitItem:
         namespace=item.namespace,
         annotations=dict(item.annotations),
         labels=dict(item.labels),
+        services=[
+            epb.ServiceSpec(
+                type=sv.type, ports=list(sv.ports), name=sv.name
+            )
+            for sv in item.services
+        ],
+        ingress=[
+            epb.IngressSpec(
+                ports=list(ig.ports),
+                annotations=dict(ig.annotations),
+                tls_enabled=ig.tls_enabled,
+                cert_name=ig.cert_name,
+                use_cluster_ip=ig.use_cluster_ip,
+            )
+            for ig in item.ingress
+        ],
     )
 
 

@@ -10,6 +10,7 @@ service objects, mapping domain errors to canonical status codes.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -24,7 +25,7 @@ from armada_tpu.server.authn import (
     TrustedHeaderAuthenticator,
 )
 from armada_tpu.server.queues import QueueAlreadyExists, QueueNotFound
-from armada_tpu.server.submit import SubmitError
+from armada_tpu.server.submit import NotLeader, SubmitError
 
 
 def default_authenticator() -> MultiAuthenticator:
@@ -49,6 +50,10 @@ def _guard(context, fn):
     """Run fn(), translating domain errors to gRPC status codes."""
     try:
         return fn()
+    except NotLeader as e:
+        # retryable: the client re-resolves (k8s readiness keeps followers
+        # out of the Service; direct clients follow the message's address)
+        context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
     except SubmitError as e:
         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
     except AuthorizationError as e:
@@ -419,6 +424,59 @@ class _ExecutorAdminService:
         return pb.Empty()
 
 
+class _LogReplicationService:
+    """Stream the local durable log to follower replicas
+    (eventlog/replicator.py LogReplicator) -- cross-host HA without a
+    shared volume."""
+
+    def __init__(self, eventlog, auth, poll_interval_s: float = 0.05):
+        self._log = eventlog
+        self._auth = auth
+        self._poll = poll_interval_s
+
+    def GetLogInfo(self, request, context):
+        _authenticate(self._auth, context)
+        return pb.LogInfoResponse(
+            num_partitions=self._log.num_partitions,
+            end_offsets=[
+                self._log.end_offset(p)
+                for p in range(self._log.num_partitions)
+            ],
+        )
+
+    def TailLog(self, request, context):
+        _authenticate(self._auth, context)
+        partition = int(request.partition)
+        if not 0 <= partition < self._log.num_partitions:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"partition {partition} out of range",
+            )
+        offset = int(request.from_offset)
+        idle = float(request.idle_timeout_s) or 5.0
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        deadline = time.monotonic() + idle
+        while not stop.is_set():
+            batch = self._log.read(partition, offset)
+            if batch:
+                deadline = time.monotonic() + idle
+                for m in batch:
+                    yield pb.LogRecord(
+                        partition=partition,
+                        offset=m.offset,
+                        key=m.key,
+                        payload=m.payload,
+                    )
+                offset = batch[-1].next_offset
+                continue
+            if not request.follow:
+                return
+            if time.monotonic() > deadline:
+                return  # idle: follower reconnects (re-resolving the leader)
+            stop.wait(self._poll)
+
+
 class _ScheduleService:
     """The scheduling sidecar (scheduler/sidecar.py): the TPU round kernel
     behind the SchedulingAlgo boundary (scheduling_algo.go:36-41) for
@@ -485,7 +543,10 @@ class _ExecutorApiService:
 
     def ReportEvents(self, request, context):
         _authenticate(self._auth, context)
-        self._api.report_events(list(request.sequences))
+        _guard(
+            context,
+            lambda: self._api.report_events(list(request.sequences)),
+        )
         return pb.Empty()
 
 
@@ -515,6 +576,7 @@ def make_server(
     binoculars=None,
     control_plane=None,
     schedule_sidecar=None,
+    replication_log=None,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
     authenticator=None,
@@ -618,6 +680,19 @@ def make_server(
                     ),
                     "CancelOnQueue": _unary(
                         csvc.CancelOnQueue, pb.QueueScopedActionRequest
+                    ),
+                },
+            )
+        )
+    if replication_log is not None:
+        rlsvc = _LogReplicationService(replication_log, auth)
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                "armada_tpu.api.LogReplication",
+                {
+                    "GetLogInfo": _unary(rlsvc.GetLogInfo, pb.LogInfoRequest),
+                    "TailLog": _server_stream(
+                        rlsvc.TailLog, pb.TailLogRequest
                     ),
                 },
             )

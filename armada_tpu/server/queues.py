@@ -41,8 +41,33 @@ class QueueAlreadyExists(ValueError):
 
 
 class QueueRepository:
-    def __init__(self, db: SchedulerDb):
+    def __init__(self, db: SchedulerDb, publisher=None, clock=None):
+        """publisher: when set, queue CRUD is ALSO event-sourced onto the
+        "$control-plane" stream (QueueUpsert/QueueDelete) so replicas that
+        tail the log converge on the same queues table (cross-host HA; the
+        reference keeps queues in shared Postgres instead).  The direct DB
+        write stays for read-your-writes -- the ingester's re-apply of the
+        same event is an idempotent upsert."""
         self._db = db
+        self._publisher = publisher
+        self._clock = clock or __import__("time").time
+
+    def _publish(self, event) -> None:
+        if self._publisher is None:
+            return
+        from armada_tpu.events import events_pb2 as pb
+        from armada_tpu.server.controlplane import CONTROL_PLANE_JOBSET
+
+        event.created_ns = int(self._clock() * 1e9)
+        self._publisher.publish(
+            [
+                pb.EventSequence(
+                    queue="",
+                    jobset=CONTROL_PLANE_JOBSET,
+                    events=[event],
+                )
+            ]
+        )
 
     def create(self, record: QueueRecord) -> None:
         if self._db.get_queue(record.name) is not None:
@@ -67,9 +92,26 @@ class QueueRepository:
             groups=list(record.groups),
             labels=record.labels,
         )
+        from armada_tpu.events import events_pb2 as pb
+
+        self._publish(
+            pb.Event(
+                queue_upsert=pb.QueueUpsert(
+                    name=record.name,
+                    weight=record.weight,
+                    cordoned=record.cordoned,
+                    owners=list(record.owners),
+                    groups=list(record.groups),
+                    labels={k: str(v) for k, v in record.labels.items()},
+                )
+            )
+        )
 
     def delete(self, name: str) -> None:
         self._db.delete_queue(name)
+        from armada_tpu.events import events_pb2 as pb
+
+        self._publish(pb.Event(queue_delete=pb.QueueDelete(name=name)))
 
     def get(self, name: str) -> Optional[QueueRecord]:
         row = self._db.get_queue(name)

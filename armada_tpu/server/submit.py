@@ -31,6 +31,11 @@ class SubmitError(ValueError):
     pass
 
 
+# Re-exported for the gRPC layer; the canonical definition sits on the
+# Publisher (the single gated choke point for every append path).
+from armada_tpu.eventlog.publisher import NotLeader  # noqa: E402,F401
+
+
 @dataclasses.dataclass(frozen=True)
 class JobSubmitItem:
     """One job in a submission request (api.JobSubmitRequestItem)."""
@@ -49,6 +54,10 @@ class JobSubmitItem:
     namespace: str = "default"
     annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # Typed network objects (submit.proto ingress:9 / services:10), NOT
+    # annotation-smuggled: validated at submit, materialised by executors.
+    services: tuple = ()
+    ingress: tuple = ()
 
 
 def _new_job_id() -> str:
@@ -66,7 +75,13 @@ class SubmitServer:
         authorizer: Optional[ActionAuthorizer] = None,
         clock: Callable[[], float] = time.time,
         job_id_factory: Callable[[], str] = _new_job_id,
+        write_gate: Optional[Callable[[], Optional[str]]] = None,
     ):
+        """write_gate: replicated deployments only -- returns None when this
+        replica may write (it holds the log of record) or the leader's
+        address ("" = unknown) when it must not; every publishing verb
+        checks it (a follower appending locally would fork the log its
+        replicator is tailing)."""
         self._db = db
         self._publisher = publisher
         self._queues = queues
@@ -74,6 +89,7 @@ class SubmitServer:
         self._auth = authorizer or ActionAuthorizer()
         self._clock = clock
         self._job_id = job_id_factory
+        self._write_gate = write_gate
 
     # --- helpers ------------------------------------------------------------
 
@@ -83,7 +99,14 @@ class SubmitServer:
             raise SubmitError(f"queue {queue!r} does not exist")
         return record
 
+    def _check_writable(self) -> None:
+        if self._write_gate is not None:
+            leader = self._write_gate()
+            if leader is not None:
+                raise NotLeader(leader)
+
     def _publish(self, queue: str, jobset: str, events: list, user: str) -> None:
+        self._check_writable()
         self._publisher.publish(
             [
                 pb.EventSequence(
@@ -102,6 +125,7 @@ class SubmitServer:
         principal: Principal = Principal(),
     ) -> list[str]:
         """Returns the job id per item (the original id for deduped items)."""
+        self._check_writable()
         record = self._queue_or_raise(queue)
         self._auth.authorize_queue_action(
             principal, record, Permission.SUBMIT_ANY_JOBS
@@ -152,6 +176,8 @@ class SubmitServer:
                 gang_node_uniformity_label=item.gang_node_uniformity_label,
                 pools=tuple(item.pools),
                 price_band=item.price_band,
+                services=tuple(item.services),
+                ingress=tuple(item.ingress),
             )
             msg = job_spec_to_proto(spec)
             msg.annotations.update(dict(item.annotations))
@@ -182,6 +208,7 @@ class SubmitServer:
         reason: str = "",
         principal: Principal = Principal(),
     ) -> None:
+        self._check_writable()
         record = self._queue_or_raise(queue)
         self._auth.authorize_queue_action(
             principal, record, Permission.CANCEL_ANY_JOBS
@@ -212,6 +239,7 @@ class SubmitServer:
         reason: str = "",
         principal: Principal = Principal(),
     ) -> None:
+        self._check_writable()
         record = self._queue_or_raise(queue)
         self._auth.authorize_queue_action(
             principal, record, Permission.CANCEL_ANY_JOBS
@@ -244,6 +272,7 @@ class SubmitServer:
         reason: str = "",
         principal: Principal = Principal(),
     ) -> None:
+        self._check_writable()
         record = self._queue_or_raise(queue)
         self._auth.authorize_queue_action(
             principal, record, Permission.PREEMPT_ANY_JOBS
@@ -275,6 +304,7 @@ class SubmitServer:
         principal: Principal = Principal(),
     ) -> None:
         """Empty job_ids reprioritises the whole jobset."""
+        self._check_writable()
         record = self._queue_or_raise(queue)
         self._auth.authorize_queue_action(
             principal, record, Permission.REPRIORITIZE_ANY_JOBS
@@ -307,6 +337,7 @@ class SubmitServer:
 
     def create_queue(self, record, principal: Principal = Principal()) -> None:
         self._auth.authorize_action(principal, Permission.CREATE_QUEUE)
+        self._check_writable()
         if record.name.startswith("armada-"):
             # "armada-*" is reserved for system streams (e.g. the
             # armada-metrics cycle-metrics stream): user traffic must never
@@ -318,10 +349,12 @@ class SubmitServer:
 
     def update_queue(self, record, principal: Principal = Principal()) -> None:
         self._auth.authorize_action(principal, Permission.CREATE_QUEUE)
+        self._check_writable()
         self._queues.update(record)
 
     def delete_queue(self, name: str, principal: Principal = Principal()) -> None:
         self._auth.authorize_action(principal, Permission.DELETE_QUEUE)
+        self._check_writable()
         self._queues.delete(name)
 
     def get_queue(self, name: str):

@@ -65,6 +65,46 @@ def validate_submission(
         if rl.all_zero():
             raise ValidationError(f"{where}: all-zero resource request")
 
+        # Network objects (validation.validateIngresses, submit_request.go:
+        # 84-107): every ingress names >=1 port, a port has AT MOST one
+        # ingress config, and ports must be valid; services need a known
+        # type and >=1 port.
+        port_owner: dict[int, int] = {}
+        for k, ig in enumerate(getattr(item, "ingress", ()) or ()):
+            if not ig.ports:
+                raise ValidationError(
+                    f"{where}: ingress contains zero ports. Each ingress "
+                    "should have at least one port"
+                )
+            for port in ig.ports:
+                if not 0 < int(port) < 65536:
+                    raise ValidationError(
+                        f"{where}: ingress port {port} out of range"
+                    )
+                if port in port_owner:
+                    raise ValidationError(
+                        f"{where}: port {port} has two ingress "
+                        f"configurations, specified in ingress configs with "
+                        f"indexes {port_owner[port]}, {k}. Each port should "
+                        "at maximum have one ingress configuration"
+                    )
+                port_owner[port] = k
+        for sv in getattr(item, "services", ()) or ():
+            if sv.type not in ("NodePort", "Headless"):
+                raise ValidationError(
+                    f"{where}: unknown service type {sv.type!r} "
+                    "(NodePort | Headless)"
+                )
+            if not sv.ports:
+                raise ValidationError(
+                    f"{where}: service contains zero ports"
+                )
+            for port in sv.ports:
+                if not 0 < int(port) < 65536:
+                    raise ValidationError(
+                        f"{where}: service port {port} out of range"
+                    )
+
         # Gang consistency (validation.validateGangs): same declared
         # cardinality and uniformity label across members.
         if item.gang_id:

@@ -18,6 +18,8 @@ class FakeKubeApi:
         self.lock = threading.Lock()
         # (namespace, name) -> pod manifest dict (with status injected)
         self.pods: dict = {}
+        self.services: dict = {}
+        self.ingresses: dict = {}
         self.nodes: list = []
         self.logs: dict = {}  # (namespace, name) -> str
         self.requests: list = []  # (method, path) log for assertions
@@ -181,8 +183,37 @@ class FakeKubeApi:
                             self._json(409, {"message": "already exists"})
                             return
                         body["metadata"]["namespace"] = ns
+                        body["metadata"].setdefault("uid", f"uid-{name}")
                         body.setdefault("status", {"phase": "Pending"})
                         api.pods[(ns, name)] = body
+                    self._json(201, body)
+                elif len(parts) == 5 and parts[-1] == "services":
+                    ns = parts[3]
+                    name = body["metadata"]["name"]
+                    with api.lock:
+                        if (ns, name) in api.services:
+                            self._json(409, {"message": "already exists"})
+                            return
+                        # NodePort allocation like a real apiserver
+                        port_no = 30000 + len(api.services)
+                        for entry in body.get("spec", {}).get("ports", ()):
+                            if body["spec"].get("type") == "NodePort":
+                                entry.setdefault("nodePort", port_no)
+                                port_no += 1
+                        api.services[(ns, name)] = body
+                    self._json(201, body)
+                elif (
+                    len(parts) == 6
+                    and parts[:2] == ["apis", "networking.k8s.io"]
+                    and parts[-1] == "ingresses"
+                ):
+                    ns = parts[4]
+                    name = body["metadata"]["name"]
+                    with api.lock:
+                        if (ns, name) in api.ingresses:
+                            self._json(409, {"message": "already exists"})
+                            return
+                        api.ingresses[(ns, name)] = body
                     self._json(201, body)
                 else:
                     self._json(404, {"message": "not found"})
@@ -223,6 +254,22 @@ class FakeKubeApi:
                             self._json(404, {"message": "not found"})
                             return
                         del api.pods[(ns, name)]
+                    self._json(200, {})
+                elif len(parts) == 6 and parts[-2] == "services":
+                    ns, name = parts[3], parts[5]
+                    with api.lock:
+                        if (ns, name) not in api.services:
+                            self._json(404, {"message": "not found"})
+                            return
+                        del api.services[(ns, name)]
+                    self._json(200, {})
+                elif len(parts) == 7 and parts[-2] == "ingresses":
+                    ns, name = parts[4], parts[6]
+                    with api.lock:
+                        if (ns, name) not in api.ingresses:
+                            self._json(404, {"message": "not found"})
+                            return
+                        del api.ingresses[(ns, name)]
                     self._json(200, {})
                 else:
                     self._json(404, {"message": "not found"})

@@ -1,0 +1,246 @@
+"""Cross-host HA: event-log replication + leader failover (VERDICT r4 #6).
+
+The reference survives a scheduler-node loss because durable state lives in
+Pulsar/Postgres off the host; this repo's native log is host-local, so a
+replicated deployment streams it between replicas
+(eventlog/replicator.py + the LogReplication gRPC service) -- no shared
+volume.  The failover test kills the leader PROCESS AND ITS DATA DIR and
+proves the follower takes over with every replicated committed event.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+
+import grpc
+import pytest
+
+from armada_tpu.eventlog.log import EventLog
+from armada_tpu.eventlog.replicator import LogReplicator
+from armada_tpu.rpc.client import ReplicationClient
+from armada_tpu.rpc.server import make_server
+
+
+def fill(log: EventLog, n: int, tag: str) -> None:
+    for i in range(n):
+        log.append(i % log.num_partitions, f"k{i}".encode(), f"{tag}-{i}".encode())
+
+
+def logs_equal(a: EventLog, b: EventLog) -> bool:
+    for p in range(a.num_partitions):
+        if a.end_offset(p) != b.end_offset(p):
+            return False
+        ra = list(a.iter_from(p, 0))
+        rb = list(b.iter_from(p, 0))
+        if [(m.offset, m.key, m.payload) for m in ra] != [
+            (m.offset, m.key, m.payload) for m in rb
+        ]:
+            return False
+    return True
+
+
+def wait_for(predicate, timeout_s=10.0, interval=0.05):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_replicator_produces_identical_log(tmp_path):
+    leader_log = EventLog(str(tmp_path / "leader"), num_partitions=2)
+    local = EventLog(str(tmp_path / "local"), num_partitions=2)
+    fill(leader_log, 20, "pre")
+    server, port = make_server(replication_log=leader_log)
+    rep = LogReplicator(
+        local,
+        leader_address=lambda: f"127.0.0.1:{port}",
+        client_factory=ReplicationClient,
+        poll_interval_s=0.02,
+        idle_timeout_s=1.0,
+    )
+    rep.start()
+    try:
+        ends = {p: leader_log.end_offset(p) for p in range(2)}
+        assert wait_for(lambda: rep.caught_up_to(ends))
+        # live tail: records appended AFTER the stream opened arrive too
+        fill(leader_log, 15, "live")
+        ends = {p: leader_log.end_offset(p) for p in range(2)}
+        assert wait_for(lambda: rep.caught_up_to(ends))
+        assert logs_equal(leader_log, local)
+        assert not rep.diverged.is_set()
+    finally:
+        rep.stop()
+        server.stop(0)
+        leader_log.close()
+        local.close()
+
+
+def test_replicator_halts_on_divergence(tmp_path):
+    """A local log that is NOT a prefix of the leader's must halt loudly:
+    auto-repair would silently drop committed local records."""
+    leader_log = EventLog(str(tmp_path / "leader"), num_partitions=1)
+    local = EventLog(str(tmp_path / "local"), num_partitions=1)
+    fill(leader_log, 5, "a")
+    local.append(0, b"rogue", b"this-replica-once-led")
+    server, port = make_server(replication_log=leader_log)
+    rep = LogReplicator(
+        local,
+        leader_address=lambda: f"127.0.0.1:{port}",
+        client_factory=ReplicationClient,
+        poll_interval_s=0.02,
+    )
+    rep.start()
+    try:
+        assert wait_for(rep.diverged.is_set, timeout_s=5)
+    finally:
+        rep.stop()
+        server.stop(0)
+        leader_log.close()
+        local.close()
+
+
+@pytest.mark.slow
+def test_leader_failover_without_shared_storage(tmp_path):
+    """Two full control planes, kube Lease election, NO shared paths.
+    Kill the leader process and DELETE its data dir: the follower acquires
+    the lease and serves every event the leader had replicated -- then keeps
+    scheduling new work."""
+    from armada_tpu.cli.serve import run_fake_executor, start_control_plane
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.rpc.client import ArmadaClient
+    from armada_tpu.server.queues import QueueRecord
+    from tests.fake_kube_api import FakeKubeApi
+
+    kube = FakeKubeApi()
+    data_a = tmp_path / "replica-a"
+    data_b = tmp_path / "replica-b"
+    cfg = SchedulingConfig(shape_bucket=32)
+    plane_a = start_control_plane(
+        str(data_a),
+        port=0,
+        config=cfg,
+        leader_id="replica-a",
+        kube_lease_url=kube.url,
+        replicate_log=True,
+        cycle_interval_s=0.05,
+        schedule_interval_s=0.1,
+    )
+    # fast takeover: the lease duration rides the LEASE RECORD, so the
+    # holder's controller decides how long its death stalls the fleet
+    plane_a.scheduler.leader._duration = 1.0
+    plane_b = None
+    client_a = client_b = None
+    try:
+        assert wait_for(
+            lambda: plane_a.scheduler.leader.get_token().leader, timeout_s=5
+        )
+        plane_b = start_control_plane(
+            str(data_b),
+            port=0,
+            config=cfg,
+            leader_id="replica-b",
+            kube_lease_url=kube.url,
+            replicate_log=True,
+            cycle_interval_s=0.05,
+            schedule_interval_s=0.1,
+        )
+        plane_b.scheduler.leader._duration = 1.0
+
+        client_a = ArmadaClient(f"127.0.0.1:{plane_a.port}")
+        client_a.create_queue(QueueRecord("ha"))
+        job_ids = client_a.submit_jobs(
+            "ha", "set1", _items(3)
+        )
+        assert len(job_ids) == 3
+
+        # the follower rejects writes with a retryable UNAVAILABLE
+        client_b = ArmadaClient(f"127.0.0.1:{plane_b.port}")
+        assert wait_for(
+            lambda: plane_b.scheduler.leader.leader_address() is not None,
+            timeout_s=5,
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            client_b.submit_jobs("ha", "set1", _items(1))
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+
+        # wait until B replicated everything A committed
+        rep_a = ReplicationClient(f"127.0.0.1:{plane_a.port}")
+        ends_a = rep_a.get_log_info()
+        rep_a.close()
+        ends = {p: off for p, off in enumerate(ends_a.end_offsets)}
+        assert wait_for(
+            lambda: plane_b.replicator.caught_up_to(ends), timeout_s=10
+        )
+
+        # kill the leader AND its storage: nothing of A survives
+        client_a.close()
+        client_a = None
+        plane_a.stop()
+        shutil.rmtree(data_a)
+
+        # B observes the unrenewed lease for a full duration, then leads
+        assert wait_for(
+            lambda: plane_b.scheduler.leader.leader_address() is None,
+            timeout_s=15,
+            interval=0.1,
+        )
+
+        # every committed event survived: the submitted jobs are visible in
+        # B's OWN event stream (built from its replicated log)
+        seen = set()
+        for item in client_b.get_jobset_events("ha", "set1"):
+            for ev in item.sequence.events:
+                if ev.WhichOneof("event") == "submit_job":
+                    seen.add(ev.submit_job.job_id)
+        assert seen == set(job_ids)
+
+        # ... and the new leader keeps working end to end: it accepts
+        # writes and schedules onto an executor that connects to it
+        new_ids = client_b.submit_jobs("ha", "set2", _items(1))
+        assert len(new_ids) == 1
+        import threading
+
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_fake_executor,
+            args=(f"127.0.0.1:{plane_b.port}",),
+            kwargs={
+                "interval_s": 0.05,
+                "stop": stop,
+                "default_runtime_s": 0.2,
+                "config": cfg,
+            },
+            daemon=True,
+        )
+        t.start()
+        try:
+            def leased():
+                for item in client_b.get_jobset_events("ha", "set2"):
+                    for ev in item.sequence.events:
+                        if ev.WhichOneof("event") == "job_run_leased":
+                            return True
+                return False
+
+            assert wait_for(leased, timeout_s=20, interval=0.2)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+    finally:
+        if client_a is not None:
+            client_a.close()
+        if client_b is not None:
+            client_b.close()
+        if plane_b is not None:
+            plane_b.stop()
+        kube.stop()
+
+
+def _items(n):
+    from armada_tpu.server.submit import JobSubmitItem
+
+    return [
+        JobSubmitItem(resources={"cpu": "1", "memory": "1"}) for _ in range(n)
+    ]

@@ -1,0 +1,202 @@
+"""Per-job network objects: typed services + ingress (VERDICT r4 #5).
+
+Reference behavior: pkg/api/submit.proto ServiceConfig/IngressConfig,
+validation in internal/server/submit/validation/submit_request.go:84-107,
+materialisation in internal/executor/util/kubernetes_object.go, and the
+executor's StandaloneIngressInfo report surfaced by lookout.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import IngressSpec, JobSpec, ServiceSpec
+from armada_tpu.server.submit import JobSubmitItem
+from armada_tpu.server.validation import ValidationError, validate_submission
+
+CFG = SchedulingConfig(shape_bucket=32, enable_assertions=True)
+F = CFG.resource_list_factory()
+
+
+def item(**kw):
+    return JobSubmitItem(resources={"cpu": "1", "memory": "1"}, **kw)
+
+
+# ---- validation (submit_request.go:84-107) ----------------------------------
+
+
+def test_ingress_validation_rules():
+    validate_submission(
+        [item(ingress=(IngressSpec(ports=(8080,)),))], CFG
+    )
+    with pytest.raises(ValidationError, match="zero ports"):
+        validate_submission([item(ingress=(IngressSpec(),))], CFG)
+    with pytest.raises(ValidationError, match="two ingress configurations"):
+        validate_submission(
+            [
+                item(
+                    ingress=(
+                        IngressSpec(ports=(8080, 9090)),
+                        IngressSpec(ports=(9090,)),
+                    )
+                )
+            ],
+            CFG,
+        )
+    with pytest.raises(ValidationError, match="out of range"):
+        validate_submission([item(ingress=(IngressSpec(ports=(0,)),))], CFG)
+
+
+def test_service_validation_rules():
+    validate_submission(
+        [item(services=(ServiceSpec(type="Headless", ports=(9000,)),))], CFG
+    )
+    with pytest.raises(ValidationError, match="unknown service type"):
+        validate_submission(
+            [item(services=(ServiceSpec(type="LoadBalancer", ports=(1,)),))],
+            CFG,
+        )
+    with pytest.raises(ValidationError, match="zero ports"):
+        validate_submission([item(services=(ServiceSpec(),))], CFG)
+
+
+# ---- wire round trips --------------------------------------------------------
+
+
+def test_spec_round_trips_through_events_proto():
+    from armada_tpu.events.convert import job_spec_from_proto, job_spec_to_proto
+
+    spec = JobSpec(
+        id="j1",
+        queue="q",
+        jobset="s",
+        resources=F.from_mapping({"cpu": "1", "memory": "1"}),
+        services=(ServiceSpec(type="Headless", ports=(9000, 9001), name="svc"),),
+        ingress=(
+            IngressSpec(
+                ports=(8080,),
+                annotations={"nginx": "on"},
+                tls_enabled=True,
+                cert_name="cert1",
+            ),
+        ),
+    )
+    msg = job_spec_to_proto(spec)
+    back = job_spec_from_proto("j1", "q", "s", msg, F)
+    assert back.services == spec.services
+    assert back.ingress == spec.ingress
+
+
+def test_submit_item_round_trips_through_rpc_proto():
+    from armada_tpu.rpc.convert import (
+        submit_item_from_proto,
+        submit_item_to_proto,
+    )
+
+    it = item(
+        services=(ServiceSpec(ports=(7000,)),),
+        ingress=(IngressSpec(ports=(7000,), use_cluster_ip=True),),
+    )
+    back = submit_item_from_proto(submit_item_to_proto(it))
+    assert back.services == it.services
+    assert back.ingress == it.ingress
+
+
+# ---- fake cluster + end-to-end ingest → lookout ------------------------------
+
+
+def test_network_objects_flow_to_lookout(tmp_path):
+    """Submit a job with a service + ingress; once it RUNs the executor
+    reports StandaloneIngressInfo and lookout's job details carry the
+    addresses (the reference lookout's ingress panel)."""
+    from armada_tpu.ingest.pipeline import IngestionPipeline
+    from armada_tpu.lookout import LookoutDb, LookoutQueries, lookout_converter
+    from armada_tpu.server.queues import QueueRecord
+    from tests.control_plane import ControlPlane
+
+    plane = ControlPlane.build(tmp_path, runtime_s=50.0)
+    lookoutdb = LookoutDb(":memory:")
+    lookout_pipeline = IngestionPipeline(
+        plane.log, lookoutdb, lookout_converter, consumer_name="lookout"
+    )
+    try:
+        plane.queues.create(QueueRecord("teamnet"))
+        (job_id,) = plane.server.submit_jobs(
+            "teamnet",
+            "set1",
+            [
+                item(
+                    services=(ServiceSpec(type="NodePort", ports=(8080,)),),
+                    ingress=(IngressSpec(ports=(8080,)),),
+                )
+            ],
+        )
+        from armada_tpu.executor.cluster import PodPhase
+
+        cluster = plane.executors[0].cluster
+        plane.run_until(
+            lambda: any(
+                p.phase is PodPhase.RUNNING for p in cluster.pod_states()
+            ),
+            max_steps=60,
+        )
+        plane.step()  # one more cycle so the RUNNING report lands in the log
+        lookout_pipeline.run_until_caught_up()
+        details = LookoutQueries(lookoutdb).get_job_details(job_id)
+        assert details is not None
+        assert details["ingress"], "running job must expose its addresses"
+        assert "8080" in details["ingress"]
+        addr = details["ingress"]["8080"]
+        assert f"{job_id}-8080." in addr or ":" in addr
+        # the fake cluster materialised the objects next to the pod
+        run_id = next(iter(cluster._pods))
+        services, ingresses = cluster.pod_network_objects(run_id)
+        assert services and ingresses
+    finally:
+        plane.close()
+        lookoutdb.close()
+
+
+# ---- real-kube adapter against the fake apiserver ----------------------------
+
+
+def test_kube_adapter_materialises_and_cleans_network_objects():
+    from armada_tpu.executor.kubernetes import (
+        RUN_LABEL,
+        KubernetesClusterContext,
+    )
+    from tests.fake_kube_api import FakeKubeApi
+
+    api = FakeKubeApi()
+    try:
+        ctx = KubernetesClusterContext(api.url, F, pool_label="pool")
+        spec = JobSpec(
+            id="j1",
+            queue="q",
+            resources=F.from_mapping({"cpu": "1", "memory": "1"}),
+            services=(ServiceSpec(type="NodePort", ports=(8080,), name="mysvc"),),
+            ingress=(IngressSpec(ports=(9090,), tls_enabled=True),),
+        )
+        ctx.submit_pod("run-1", "j1", "q", "js", spec, "worker-1")
+        # the declared service, plus the synthesized backend for the
+        # serviceless ingress port
+        assert ("default", "mysvc") in api.services
+        synth = [k for k in api.services if k[1].startswith("armada-run-1-ingsvc")]
+        assert synth
+        svc = api.services[("default", "mysvc")]
+        assert svc["spec"]["selector"] == {RUN_LABEL: "run-1"}
+        assert svc["metadata"]["ownerReferences"][0]["name"] == "armada-run-1"
+        assert ("default", "armada-run-1-ing0") in api.ingresses
+        ing = api.ingresses[("default", "armada-run-1-ing0")]
+        rule = ing["spec"]["rules"][0]
+        assert rule["host"] == "j1-9090.jobs.local"
+        assert ing["spec"]["tls"][0]["hosts"] == ["j1-9090.jobs.local"]
+        net = ctx.pod_network("run-1")
+        assert net[9090] == "j1-9090.jobs.local"
+        assert net[8080].startswith("worker-1:30")  # allocated NodePort
+        ctx.delete_pod("run-1")
+        assert not api.services and not api.ingresses
+        assert not ctx.pod_network("run-1")
+    finally:
+        api.stop()
