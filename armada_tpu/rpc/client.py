@@ -32,23 +32,43 @@ class _Base:
         channel: Optional[grpc.Channel] = None,
         bearer_token: Optional[str] = None,
         basic_auth: Optional[tuple[str, str]] = None,
+        negotiate=None,
     ):
         """principal/groups ride trusted headers (dev chains only);
         bearer_token / basic_auth produce a standard `authorization` header
-        for OIDC / token-review / basic authenticators (pkg/client/auth)."""
+        for OIDC / token-review / basic authenticators (pkg/client/auth).
+        negotiate: kerberos/SPNEGO -- bytes (one call: AP-REQ tokens are
+        single-use, the server replay-caches them) or a zero-arg callable
+        minting a FRESH token per request (e.g. a gssapi initiator)."""
         self._channel = channel or grpc.insecure_channel(address)
-        self._meta = [(_PRINCIPAL_KEY, principal)]
+        self._static_meta = [(_PRINCIPAL_KEY, principal)]
         if groups:
-            self._meta.append((_GROUPS_KEY, ",".join(groups)))
+            self._static_meta.append((_GROUPS_KEY, ",".join(groups)))
         if bearer_token:
-            self._meta.append(("authorization", f"Bearer {bearer_token}"))
+            self._static_meta.append(
+                ("authorization", f"Bearer {bearer_token}")
+            )
         elif basic_auth:
             import base64
 
             cred = base64.b64encode(
                 f"{basic_auth[0]}:{basic_auth[1]}".encode()
             ).decode()
-            self._meta.append(("authorization", f"Basic {cred}"))
+            self._static_meta.append(("authorization", f"Basic {cred}"))
+        self._negotiate = negotiate
+
+    @property
+    def _meta(self):
+        if self._negotiate is None:
+            return self._static_meta
+        import base64
+
+        token = self._negotiate() if callable(self._negotiate) else self._negotiate
+        if isinstance(token, str):
+            token = token.encode()
+        return self._static_meta + [
+            ("authorization", "Negotiate " + base64.b64encode(token).decode())
+        ]
 
     def close(self) -> None:
         self._channel.close()

@@ -1,9 +1,9 @@
 """Authentication: resolve request credentials to a Principal.
 
 Equivalent of the reference's internal/common/auth authenticator suite --
-anonymous + basic + OIDC + kubernetes token review, composed by a multi
-authenticator (internal/common/auth/authorization.go, multi.go,
-kubernetes.go).  Authorization (permissions/ACLs) stays in server/auth.py;
+anonymous + basic + OIDC + kubernetes token review + kerberos/SPNEGO,
+composed by a multi authenticator (internal/common/auth/authorization.go,
+multi.go, kubernetes.go, configuration/types.go:42).  Authorization (permissions/ACLs) stays in server/auth.py;
 this module only answers "who is calling".
 
 Every authenticator implements `authenticate(metadata) -> Optional[Principal]`
@@ -293,6 +293,159 @@ class KubernetesTokenReviewAuthenticator:
         return principal
 
 
+class KerberosAuthenticator:
+    """SPNEGO (HTTP Negotiate) authentication -- the reference's Kerberos
+    mode (internal/common/auth/configuration/types.go:42
+    KerberosAuthenticationConfig: keytab, service principal, username/group
+    suffixes, optional LDAP group lookup).
+
+    Credentials arrive as `authorization: Negotiate <base64 SPNEGO token>`.
+    Token validation is pluggable:
+
+      * default: python-gssapi against `keytab`/`principal` (the real
+        KDC-backed path; constructing without gssapi installed raises a
+        configuration error rather than silently accepting nothing);
+      * `validator(token: bytes) -> str` override: any callable returning
+        the client principal ("user@REALM") or raising -- how tests and
+        non-GSSAPI deployments plug in.
+
+    Kerberos AP-REQ tokens are SINGLE-USE: a replay cache rejects a token
+    presented twice within `replay_ttl_s` (gokrb5's service-side replay
+    detection; without it a captured Negotiate header is a bearer token).
+    """
+
+    def __init__(
+        self,
+        keytab: str = "",
+        principal: str = "",
+        username_suffix: str = "",
+        group_name_suffix: str = "",
+        validator: Optional[Callable[[bytes], str]] = None,
+        groups_of: Optional[Callable[[str], Sequence[str]]] = None,
+        replay_ttl_s: float = 300.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        if validator is None:
+            validator = self._gssapi_validator(keytab, principal)
+        self._validate = validator
+        self._username_suffix = username_suffix
+        self._group_suffix = group_name_suffix
+        self._groups_of = groups_of
+        self._replay_ttl = replay_ttl_s
+        self._clock = clock
+        self._seen: dict[bytes, float] = {}  # token digest -> expiry
+        # gRPC serves handlers from a thread pool: the check-then-set on
+        # the replay cache must be atomic or N parallel replays all pass.
+        import threading
+
+        self._seen_lock = threading.Lock()
+
+    @staticmethod
+    def _gssapi_validator(keytab: str, principal: str):
+        try:
+            import gssapi  # noqa: F401
+        except ImportError as e:
+            raise ValueError(
+                "auth.kerberos requires the python-gssapi package (or an "
+                "injected validator); it is not installed"
+            ) from e
+
+        def validate(token: bytes) -> str:
+            import gssapi
+
+            name = (
+                gssapi.Name(
+                    principal, name_type=gssapi.NameType.hostbased_service
+                )
+                if principal
+                else None
+            )
+            # the credential store, NOT process-global KRB5_KTNAME env: an
+            # env var the container already exports would silently win over
+            # the configured keytab, and request threads must not mutate
+            # global state
+            kw = {"store": {"keytab": keytab}} if keytab else {}
+            creds = gssapi.Credentials(name=name, usage="accept", **kw)
+            ctx = gssapi.SecurityContext(creds=creds, usage="accept")
+            ctx.step(token)
+            if not ctx.complete:
+                raise AuthenticationError(
+                    "kerberos negotiation incomplete (multi-leg contexts "
+                    "are not supported over unary rpc)"
+                )
+            return str(ctx.initiator_name)
+
+        return validate
+
+    def _replayed(self, digest: bytes) -> bool:
+        now = self._clock()
+        with self._seen_lock:
+            # sweep keeps the cache bounded by the TTL window; only
+            # VALIDATED tokens are ever recorded (see authenticate), so
+            # unauthenticated garbage cannot grow it
+            if len(self._seen) > 4096:
+                self._seen = {
+                    d: exp for d, exp in self._seen.items() if exp > now
+                }
+            exp = self._seen.get(digest)
+            return exp is not None and exp > now
+
+    def _record(self, digest: bytes) -> bool:
+        """Atomically record a validated token; False = someone else
+        recorded it first (a concurrent replay of the same token)."""
+        now = self._clock()
+        with self._seen_lock:
+            exp = self._seen.get(digest)
+            if exp is not None and exp > now:
+                return False
+            self._seen[digest] = now + self._replay_ttl
+            return True
+
+    def authenticate(self, metadata: Mapping[str, str]) -> Optional[Principal]:
+        header = metadata.get(AUTH_HEADER, "")
+        if not header.lower().startswith("negotiate "):
+            return None
+        try:
+            token = base64.b64decode(
+                header[len("Negotiate "):], validate=True
+            )
+        except (binascii.Error, ValueError):
+            raise AuthenticationError("malformed Negotiate token") from None
+        digest = hashlib.sha256(token).digest()
+        if self._replayed(digest):
+            raise AuthenticationError(
+                "kerberos token replayed (AP-REQ tokens are single-use)"
+            )
+        try:
+            client = self._validate(token)
+        except AuthenticationError:
+            raise
+        except Exception as e:
+            # transient KDC/validator failures must NOT burn the token:
+            # it was never recorded, so a retry can re-present it
+            raise AuthenticationError(f"kerberos rejected: {e}") from e
+        if not self._record(digest):
+            raise AuthenticationError(
+                "kerberos token replayed (AP-REQ tokens are single-use)"
+            )
+        # "alice@REALM" -> "alice"; then the configured suffix strip
+        # (KerberosAuthenticationConfig.UserNameSuffix)
+        name = client.split("@", 1)[0]
+        if self._username_suffix and name.endswith(self._username_suffix):
+            name = name[: -len(self._username_suffix)]
+        groups: tuple = ()
+        if self._groups_of is not None:
+            groups = tuple(self._groups_of(name))
+            if self._group_suffix:
+                groups = tuple(
+                    g[: -len(self._group_suffix)]
+                    if g.endswith(self._group_suffix)
+                    else g
+                    for g in groups
+                )
+        return Principal(name=name, groups=groups)
+
+
 class MultiAuthenticator:
     """First authenticator that recognises the credentials wins (multi.go).
 
@@ -321,10 +474,12 @@ def authn_from_config(cfg: Mapping) -> MultiAuthenticator:
         oidc: {issuer: ..., audience: ..., keys: {kid: pem-or-hs256:secret},
                username_claim: sub, groups_claim: groups}
         kubernetes_token_review: {url: https://..., ca_file: ..., }
+        kerberos: {keytab: /etc/krb5.keytab, principal: HTTP/armada,
+                   username_suffix: "", group_name_suffix: ""}
         trusted_headers: true     # explicit opt-in
         anonymous: true           # allow unauthenticated as `anonymous`
 
-    Order: basic, oidc, token review, trusted headers, anonymous."""
+    Order: basic, oidc, kerberos, token review, trusted headers, anonymous."""
     chain: list[object] = []
     basic = cfg.get("basic")
     if basic:
@@ -352,6 +507,16 @@ def authn_from_config(cfg: Mapping) -> MultiAuthenticator:
                 keys=keys,
                 username_claim=oidc.get("username_claim", "sub"),
                 groups_claim=oidc.get("groups_claim", "groups"),
+            )
+        )
+    krb = cfg.get("kerberos")
+    if krb:
+        chain.append(
+            KerberosAuthenticator(
+                keytab=krb.get("keytab", krb.get("keytab_location", "")),
+                principal=krb.get("principal", krb.get("principal_name", "")),
+                username_suffix=krb.get("username_suffix", ""),
+                group_name_suffix=krb.get("group_name_suffix", ""),
             )
         )
     ktr = cfg.get("kubernetes_token_review")

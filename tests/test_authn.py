@@ -363,3 +363,175 @@ def test_gateway_rejects_unauthenticated():
             assert resp.status == 200
     finally:
         gw.stop()
+
+
+# ---- kerberos / SPNEGO (configuration/types.go:42) ---------------------------
+
+
+def _krb_chain(clock=None):
+    """A KerberosAuthenticator with an injected validator: accepts tokens of
+    the form b"krb:<principal>", rejects everything else -- the pluggable
+    seam real deployments fill with python-gssapi."""
+    from armada_tpu.server.authn import KerberosAuthenticator
+
+    def validator(token: bytes) -> str:
+        if not token.startswith(b"krb:"):
+            raise ValueError("not a kerberos token")
+        return token[4:].decode()
+
+    kw = {"clock": clock} if clock else {}
+    return KerberosAuthenticator(
+        validator=validator,
+        username_suffix="-svc",
+        groups_of=lambda user: (f"{user}-team@grp",),
+        group_name_suffix="@grp",
+        **kw,
+    )
+
+
+def _negotiate(token: bytes) -> dict:
+    import base64
+
+    return {"authorization": "Negotiate " + base64.b64encode(token).decode()}
+
+
+def test_kerberos_accepts_and_maps_principal():
+    a = _krb_chain()
+    p = a.authenticate(_negotiate(b"krb:alice-svc@EXAMPLE.COM"))
+    # realm stripped, then the configured username suffix; groups via the
+    # lookup hook with the group suffix stripped (LDAP analog)
+    assert p.name == "alice"
+    assert p.groups == ("alice-team",)
+
+
+def test_kerberos_ignores_other_credentials():
+    a = _krb_chain()
+    assert a.authenticate({"authorization": "Bearer xyz"}) is None
+    assert a.authenticate({}) is None
+
+
+def test_kerberos_rejects_forged_token():
+    from armada_tpu.server.authn import AuthenticationError
+
+    a = _krb_chain()
+    with pytest.raises(AuthenticationError, match="kerberos rejected"):
+        a.authenticate(_negotiate(b"forged-bytes"))
+    with pytest.raises(AuthenticationError, match="malformed"):
+        a.authenticate({"authorization": "Negotiate !!!not-base64!!!"})
+
+
+def test_kerberos_rejects_replayed_token():
+    """AP-REQ tokens are single-use: the same Negotiate header presented
+    twice is a replay (a captured header must not become a bearer token).
+    After the TTL window the digest ages out."""
+    from armada_tpu.server.authn import AuthenticationError
+
+    now = [1000.0]
+    a = _krb_chain(clock=lambda: now[0])
+    header = _negotiate(b"krb:alice@X")
+    assert a.authenticate(header).name == "alice"
+    with pytest.raises(AuthenticationError, match="replayed"):
+        a.authenticate(header)
+    now[0] += 301  # past replay_ttl_s
+    assert a.authenticate(header).name == "alice"
+
+
+def test_kerberos_client_negotiate_header_round_trips():
+    """rpc client -> header -> authenticator: the `negotiate` callable mints
+    a fresh token per request (single-use semantics)."""
+    from armada_tpu.rpc.client import _Base
+
+    minted = []
+
+    def mint():
+        minted.append(len(minted))
+        return f"krb:bot{len(minted)}@R".encode()
+
+    client = _Base.__new__(_Base)
+    client._static_meta = []
+    client._negotiate = mint
+    a = _krb_chain()
+    for expect in ("bot1", "bot2"):
+        meta = dict(client._meta)
+        assert a.authenticate(meta).name == expect  # fresh token each call
+
+
+def test_kerberos_config_requires_gssapi():
+    """auth.kerberos without python-gssapi must fail LOUDLY at boot, never
+    silently authenticate nothing."""
+    from armada_tpu.server.authn import authn_from_config
+
+    try:
+        import gssapi  # noqa: F401
+
+        pytest.skip("gssapi installed; the real backend is available")
+    except ImportError:
+        pass
+    with pytest.raises(ValueError, match="gssapi"):
+        authn_from_config({"kerberos": {"keytab": "/etc/krb5.keytab"}})
+
+
+def test_kerberos_concurrent_replay_single_winner():
+    """N parallel presentations of the SAME token: exactly one wins (the
+    check-then-set is atomic; gRPC serves from a 16-thread pool)."""
+    import threading
+
+    from armada_tpu.server.authn import AuthenticationError
+
+    a = _krb_chain()
+    header = _negotiate(b"krb:alice@X")
+    results = []
+
+    def attempt():
+        try:
+            a.authenticate(header)
+            results.append("ok")
+        except AuthenticationError:
+            results.append("replay")
+
+    threads = [threading.Thread(target=attempt) for _ in range(12)]
+    barrier_free = threads  # start together-ish
+    for t in barrier_free:
+        t.start()
+    for t in barrier_free:
+        t.join()
+    assert results.count("ok") == 1 and results.count("replay") == 11
+
+
+def test_kerberos_garbage_never_grows_replay_cache():
+    """Unauthenticated garbage must not populate the cache (unbounded
+    growth at request rate), and a transient validator failure must not
+    burn a valid token."""
+    from armada_tpu.server.authn import (
+        AuthenticationError,
+        KerberosAuthenticator,
+    )
+
+    flaky = [True]
+
+    def validator(token: bytes) -> str:
+        if not token.startswith(b"krb:"):
+            raise ValueError("garbage")
+        if flaky[0]:
+            flaky[0] = False
+            raise OSError("KDC unreachable")
+        return token[4:].decode()
+
+    a = KerberosAuthenticator(validator=validator)
+    for i in range(50):
+        with pytest.raises(AuthenticationError):
+            a.authenticate(_negotiate(b"garbage-%d" % i))
+    assert not a._seen  # nothing recorded for rejected tokens
+    header = _negotiate(b"krb:alice@X")
+    with pytest.raises(AuthenticationError, match="KDC unreachable"):
+        a.authenticate(header)
+    # the transient failure did not burn it: the retry succeeds
+    assert a.authenticate(header).name == "alice"
+
+
+def test_kerberos_scheme_is_case_insensitive():
+    import base64
+
+    a = _krb_chain()
+    tok = base64.b64encode(b"krb:alice@X").decode()
+    assert a.authenticate({"authorization": f"negotiate {tok}"}).name == "alice"
