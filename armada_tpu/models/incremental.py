@@ -411,6 +411,7 @@ class IncrementalBuilder:
         )
         # Gang-unit region sizing (units rebuilt wholesale each cycle).
         self._u_cap = 0
+        self._br_cap = 1
         self._u_prev_n = 0
         self._unit_cols: dict[str, np.ndarray] = {}
         # Device-visible gang ids across all regions ([G] grows with caps).
@@ -1659,7 +1660,10 @@ class IncrementalBuilder:
         r_cap = rr.cap
         u_n = len(kept_units)
         if u_n > self._u_cap:
-            self._u_cap = _pad(u_n, 64)
+            # geometric like the slabs: u_cap feeds G and the bundle sig, so
+            # every change recompiles the kernel (~17-24s through the
+            # tunnel) -- gang-heavy bursts must not cross a pad per cycle
+            self._u_cap = max(_pad(u_n, 64), _pad(int(self._u_cap * 1.5), 64))
         u_cap = self._u_cap
         u_base = s_cap + r_cap
         G = s_cap + r_cap + u_cap
@@ -1718,7 +1722,12 @@ class IncrementalBuilder:
             if row.any():
                 ban_rows.append(row)
                 uc["g_ban_row"][i] = len(ban_rows)
-        BR = _pad(len(ban_rows) + 1, 8) if ban_rows else 1
+        # monotone + geometric (like the slabs): BR feeds the problem shape,
+        # so per-cycle swings in retry-banned gang counts must not recompile
+        need_br = _pad(len(ban_rows) + 1, 8) if ban_rows else 1
+        if need_br > self._br_cap:
+            self._br_cap = max(need_br, _pad(int(self._br_cap * 1.5), 8))
+        BR = self._br_cap
         ban_mask = np.zeros((BR, N), bool)
         for i, row in enumerate(ban_rows):
             ban_mask[i + 1] = row

@@ -101,9 +101,22 @@ class RowSlab:
         self._ids_shared = False
 
     def _grow(self, need: int) -> None:
+        # GEOMETRIC growth (>=1.5x), not fixed-bucket: the slab cap IS the
+        # padded problem axis, and every cap change recompiles the round
+        # kernel + compaction + scatter programs (~17-24s each through the
+        # axon tunnel -- measured round 5: a 10k-job burst crossing a 40k
+        # bucket every 4 cycles paid ~60s/crossing, the real reason the
+        # burst cycle blew the 5s budget).  Geometric caps make crossings
+        # logarithmic in backlog growth; the bucket stays the floor and the
+        # alignment grain.
         new_cap = self.cap
         while new_cap < need:
-            new_cap += self.bucket
+            scaled = int(new_cap * 1.5)
+            new_cap = max(
+                new_cap + self.bucket,
+                # ceil-aligned so the >=1.5x guarantee actually holds
+                ((scaled + self.bucket - 1) // self.bucket) * self.bucket,
+            )
         self.req = _grow2(self.req, new_cap)
         self.ids = _grow2(self.ids, new_cap)  # fresh object: snapshots keep the old one
         self._ids_shared = False
