@@ -2,7 +2,7 @@
 // drilldown (queue -> jobsets -> jobs -> details -> logs), URL-state
 // routing, saved views, identity chip.  Capability map of the reference's
 // React lookout UI (internal/lookoutui/src/App.tsx) over the same JSON API.
-import { $, esc, fmtT, dark, meterHTML, chipsHTML, stateCell } from "./util.js";
+import { $, esc, fmtT, fmtDur, fmtCpu, fmtBytes, dark, meterHTML, chipsHTML, stateCell } from "./util.js";
 import { j, postAction, AuthRequired } from "./api.js";
 import { renderWhoami } from "./auth.js";
 import { applyHash, syncHash } from "./router.js";
@@ -38,6 +38,60 @@ async function loadOverview() {
   $("overview").innerHTML = meterHTML(d.states, total);
   $("chips").innerHTML = chipsHTML(d.states);
   $("total").textContent = total + " jobs";
+}
+
+// Jobs-table column registry (the reference UI's column picker): key ->
+// {label, sort field (if server-sortable), render}.  Visibility persists in
+// localStorage and survives reloads like the reference's column menu.
+const COLS = {
+  job_id:   {label: "job",       o: "job_id",   r: (x) => esc(x.job_id)},
+  queue:    {label: "queue",     o: "queue",    r: (x) => esc(x.queue)},
+  jobset:   {label: "jobset",    o: "jobset",   r: (x) => esc(x.jobset)},
+  state:    {label: "state",     o: "state",    r: (x) => stateCell(x.state)},
+  priority: {label: "priority",  o: "priority", num: 1, r: (x) => x.priority},
+  priority_class: {label: "priority class", r: (x) => esc(x.priority_class || "—")},
+  cpu:      {label: "cpu",    num: 1, r: (x) => fmtCpu(x.cpu_milli)},
+  memory:   {label: "memory", num: 1, r: (x) => fmtBytes(x.memory)},
+  gpu:      {label: "gpu",    num: 1, r: (x) => fmtCpu(x.gpu)},
+  gang:     {label: "gang",   r: (x) => esc(x.gang_id || "—")},
+  submitted:{label: "submitted", o: "submitted", r: (x) => fmtT(x.submitted_ns)},
+  age:      {label: "time in state", r: (x) =>
+              fmtDur(Date.now() * 1e6 - (x.last_transition_ns || x.submitted_ns))},
+  node:     {label: "node", r: (x) => esc(x.node || "—")},
+};
+const DEFAULT_COLS = ["job_id", "queue", "jobset", "state", "priority", "submitted", "node"];
+function visibleCols() {
+  try {
+    const v = JSON.parse(localStorage.getItem("lookout-cols"));
+    if (Array.isArray(v) && v.length && v.every((k) => COLS[k])) return v;
+  } catch (e) { /* fall through */ }
+  return DEFAULT_COLS;
+}
+function setVisibleCols(keys) {
+  localStorage.setItem("lookout-cols", JSON.stringify(
+    Object.keys(COLS).filter((k) => keys.includes(k))));
+}
+function wireColPicker() {
+  const btn = $("cols-btn");
+  if (!btn) return;
+  btn.onclick = () => {
+    const menu = $("cols-menu");
+    if (menu.classList.toggle("open")) {
+      const vis = visibleCols();
+      menu.innerHTML = Object.entries(COLS).map(([k, c]) =>
+        `<label><input type="checkbox" data-c="${k}"
+          ${vis.includes(k) ? "checked" : ""}> ${esc(c.label)}</label>`).join("");
+      for (const cb of menu.querySelectorAll("input")) {
+        cb.onchange = () => {
+          const keys = [...menu.querySelectorAll("input:checked")]
+            .map((x) => x.dataset.c);
+          if (!keys.length) { cb.checked = true; return; }  // never zero columns
+          setVisibleCols(keys);
+          refresh();
+        };
+      }
+    }
+  };
 }
 
 async function loadContent() {
@@ -140,18 +194,17 @@ async function loadContent() {
   }
   if (!d.jobs.length) { $("content").innerHTML = '<div class="empty">nothing matches</div>'; $("pager").innerHTML = ""; return; }
   const arrow = (f) => state.orderField === f ? (state.orderDir === "ASC" ? " ↑" : " ↓") : "";
-  $("content").innerHTML = `<table><thead><tr>
-      <th data-o="job_id">job${arrow("job_id")}</th>
-      <th data-o="queue">queue${arrow("queue")}</th>
-      <th data-o="jobset">jobset${arrow("jobset")}</th>
-      <th data-o="state">state${arrow("state")}</th>
-      <th class="num" data-o="priority">priority${arrow("priority")}</th>
-      <th data-o="submitted">submitted${arrow("submitted")}</th>
-      <th>node</th></tr></thead><tbody>` +
-    d.jobs.map((r) => `<tr data-id="${esc(r.job_id)}">
-      <td>${esc(r.job_id)}</td><td>${esc(r.queue)}</td><td>${esc(r.jobset)}</td>
-      <td>${stateCell(r.state)}</td><td class="num">${r.priority}</td>
-      <td>${fmtT(r.submitted_ns)}</td><td>${esc(r.node || "—")}</td></tr>`).join("") +
+  const cols = visibleCols();
+  $("content").innerHTML = `<table><thead><tr>` +
+    cols.map((k) => {
+      const c = COLS[k];
+      return `<th ${c.num ? 'class="num"' : ""} ${c.o ? `data-o="${c.o}"` : ""}>` +
+        `${esc(c.label)}${c.o ? arrow(c.o) : ""}</th>`;
+    }).join("") + "</tr></thead><tbody>" +
+    d.jobs.map((r) => `<tr data-id="${esc(r.job_id)}">` +
+      cols.map((k) =>
+        `<td ${COLS[k].num ? 'class="num"' : ""}>${COLS[k].r(r)}</td>`
+      ).join("") + "</tr>").join("") +
     "</tbody></table>";
   for (const th of $("content").querySelectorAll("th[data-o]")) {
     th.onclick = () => {
@@ -221,6 +274,7 @@ addEventListener("popstate", () => { applyHash(state); refresh(); });
 setInterval(() => { if ($("auto").checked && !$("details").classList.contains("open")) refresh(); }, 3000);
 
 wireViews(state, refresh);
+wireColPicker();
 loadViews();
 renderWhoami();
 applyHash(state);

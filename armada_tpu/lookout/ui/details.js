@@ -1,7 +1,7 @@
 // Job details side panel: spec fields, runs, errors, per-run log boxes,
 // and the operator actions (cancel / reprioritise -- the reference UI's
 // CancelDialog / ReprioritiseDialog) for non-terminal jobs.
-import { $, esc, fmtT, stateCell } from "./util.js";
+import { $, esc, fmtT, fmtDur, fmtCpu, fmtBytes, stateCell } from "./util.js";
 import { j, postAction } from "./api.js";
 import { openLogs, stopAllLogTimers } from "./logs.js";
 
@@ -32,16 +32,33 @@ export async function openDetails(id) {
     <dl><dt>node</dt><dd>${esc(r.node || "—")}</dd>
     <dt>leased</dt><dd>${fmtT(r.leased_ns)}</dd>
     <dt>started</dt><dd>${fmtT(r.started_ns)}</dd>
-    <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd></dl>
+    <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd>
+    <dt>queued wait</dt><dd>${fmtDur(r.started_ns && r.leased_ns
+        ? r.started_ns - r.leased_ns : 0)}</dd>
+    <dt>runtime</dt><dd>${fmtDur(r.started_ns
+        ? (r.finished_ns || Date.now() * 1e6) - r.started_ns : 0)}</dd></dl>
     ${r.error ? `<pre>${esc(r.error)}</pre>` : ""}
     <div class="logbox" id="log-${esc(r.run_id)}"></div></div>`).join("");
+  // Exposed ports (executor StandaloneIngressInfo -> lookout ingress_json):
+  // where the job's services/ingress made it reachable.
+  const netEntries = Object.entries(d.ingress || {});
+  const network = netEntries.length ? `<h2>network</h2><dl class="netrow">` +
+    netEntries.map(([port, addr]) => `<dt>port ${esc(port)}</dt>
+      <dd>${addr.includes("://")
+        ? esc(addr)
+        : `<a href="http://${esc(addr)}" target="_blank" rel="noreferrer">${esc(addr)}</a>`}</dd>`)
+      .join("") + "</dl>" : "";
   $("details").innerHTML = `<h2>${esc(d.job_id)}</h2>
     <dl><dt>state</dt><dd>${stateCell(d.state)}</dd>
     <dt>queue</dt><dd>${esc(d.queue)}</dd>
     <dt>jobset</dt><dd>${esc(d.jobset)}</dd>
-    <dt>priority</dt><dd>${d.priority}</dd>
+    <dt>priority</dt><dd>${d.priority}${d.priority_class ? ` (${esc(d.priority_class)})` : ""}</dd>
+    <dt>resources</dt><dd>cpu ${fmtCpu(d.cpu_milli)} · mem ${fmtBytes(d.memory)}${d.gpu ? ` · gpu ${fmtCpu(d.gpu)}` : ""}</dd>
+    ${d.gang_id ? `<dt>gang</dt><dd>${esc(d.gang_id)}</dd>` : ""}
     <dt>submitted</dt><dd>${fmtT(d.submitted_ns)}</dd>
+    <dt>in state since</dt><dd>${fmtT(d.last_transition_ns)} (${fmtDur(Date.now() * 1e6 - d.last_transition_ns)})</dd>
     <dt>annotations</dt><dd><pre>${esc(JSON.stringify(d.annotations || {}, null, 1))}</pre></dd></dl>
+    ${network}
     <h2>runs</h2>${runs || '<div class="empty">no runs</div>'}
     ${TERMINAL.has(d.state) ? "" : `
       <button id="act-cancel">cancel job</button>

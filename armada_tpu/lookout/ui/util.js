@@ -28,3 +28,31 @@ export function chipsHTML(states) {
 export function stateCell(s) {
   return `<span class="dot" style="background:${color(s)}"></span>${s.toLowerCase()}`;
 }
+
+// Durations + resource quantities (the reference UI's runtime/timing
+// columns and formatUtils).
+export function fmtDur(ns) {
+  if (!ns || ns < 0) return "—";
+  const s = ns / 1e9;
+  if (s < 59.5) return `${s.toFixed(s < 10 ? 1 : 0)}s`;
+  // carry the rounded remainder so 4m59.6s is "5m 0s", never "4m 60s"
+  let m = Math.floor(s / 60), rs = Math.round(s % 60);
+  if (rs === 60) { m += 1; rs = 0; }
+  if (m < 60) return `${m}m ${rs}s`;
+  const h = Math.floor(m / 60);
+  return `${h}h ${m % 60}m`;
+}
+export function fmtCpu(milli) {
+  if (!milli) return "—";
+  return milli % 1000 === 0 ? String(milli / 1000) : `${milli}m`;
+}
+// lookout stores resources in milli base units (core/resources.py atom
+// encoding; 1Gi memory = 2^30 * 1000 atoms): convert before formatting.
+export function fmtBytes(milliBytes) {
+  if (!milliBytes) return "—";
+  const b = milliBytes / 1000;
+  const units = ["B", "Ki", "Mi", "Gi", "Ti"];
+  let i = 0, v = b;
+  while (v >= 1024 && i < units.length - 1) { v /= 1024; i++; }
+  return `${v >= 10 || v === Math.round(v) ? Math.round(v) : v.toFixed(1)}${units[i]}`;
+}
