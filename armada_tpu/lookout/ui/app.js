@@ -61,15 +61,19 @@ const COLS = {
 };
 const DEFAULT_COLS = ["job_id", "queue", "jobset", "state", "priority", "submitted", "node"];
 function visibleCols() {
+  if (sessionCols) return sessionCols;
   try {
     const v = JSON.parse(localStorage.getItem("lookout-cols"));
     if (Array.isArray(v) && v.length && v.every((k) => COLS[k])) return v;
   } catch (e) { /* fall through */ }
   return DEFAULT_COLS;
 }
+let sessionCols = null;  // fallback when storage is unavailable
 function setVisibleCols(keys) {
-  localStorage.setItem("lookout-cols", JSON.stringify(
-    Object.keys(COLS).filter((k) => keys.includes(k))));
+  const v = Object.keys(COLS).filter((k) => keys.includes(k));
+  sessionCols = v;
+  try { localStorage.setItem("lookout-cols", JSON.stringify(v)); }
+  catch (e) { /* storage disabled: picker still works for this session */ }
 }
 function wireColPicker() {
   const btn = $("cols-btn");

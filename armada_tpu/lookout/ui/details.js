@@ -33,7 +33,7 @@ export async function openDetails(id) {
     <dt>leased</dt><dd>${fmtT(r.leased_ns)}</dd>
     <dt>started</dt><dd>${fmtT(r.started_ns)}</dd>
     <dt>finished</dt><dd>${fmtT(r.finished_ns)}</dd>
-    <dt>queued wait</dt><dd>${fmtDur(r.started_ns && r.leased_ns
+    <dt>startup wait</dt><dd>${fmtDur(r.started_ns && r.leased_ns
         ? r.started_ns - r.leased_ns : 0)}</dd>
     <dt>runtime</dt><dd>${fmtDur(r.started_ns
         ? (r.finished_ns || Date.now() * 1e6) - r.started_ns : 0)}</dd></dl>

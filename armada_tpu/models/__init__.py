@@ -41,7 +41,12 @@ def run_round_on_device(problem, ctx, config, device_problem=None):
         ),
     )
     result = schedule_round(device_problem, **kernel_kwargs)
-    outcome = decode_result(result, ctx)
+    # Overlapped decode (begin_decode): the compaction + its device->host
+    # copy are enqueued behind the kernel with no host sync in between, so
+    # the transfer streams as soon as the kernel finishes -- a blocking
+    # decode_result here paid one extra tunnel round trip (~65ms) per round
+    # in the serve/sidecar paths (the bench loop already did this).
+    outcome = begin_decode(result, ctx)()
 
     # Gang-txn rollback (nodedb.go:347 ScheduleManyWithTxn: a gang is one txn,
     # all-or-nothing): if a split gang's sibling placed but another sub-gang
@@ -90,7 +95,7 @@ def run_round_on_device(problem, ctx, config, device_problem=None):
         g_valid[_np.asarray(sorted(set(kill)), _np.int64)] = False
         device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
         result = schedule_round(device_problem, **kernel_kwargs)
-        outcome = decode_result(result, ctx)
+        outcome = begin_decode(result, ctx)()
     if attempts >= 4:
         # Attempt-cap backstop: never report a half-preempted running gang.
         # Force the retained members into the preempted set -- their freed
