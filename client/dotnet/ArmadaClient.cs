@@ -120,6 +120,39 @@ namespace ArmadaTpu.Client
             Call<Empty, QueueListResponse>(
                 "armada_tpu.api.Submit", "ListQueues", new Empty()).Queues;
 
+        // --- lookout surface (armada_tpu.api.Lookout: JSON-over-gRPC) -------
+
+        /// Filtered job page; queryJson is the lookout query document
+        /// ({"filters": [...], "order": {...}, "skip": n, "take": n}).
+        public string GetJobs(string queryJson) =>
+            Call<LookoutQuery, JsonResponse>("armada_tpu.api.Lookout", "GetJobs",
+                new LookoutQuery { QueryJson = queryJson }).Json;
+
+        public string GroupJobs(string queryJson) =>
+            Call<LookoutQuery, JsonResponse>("armada_tpu.api.Lookout", "GroupJobs",
+                new LookoutQuery { QueryJson = queryJson }).Json;
+
+        /// Full job details (spec fields, runs, errors, ingress addresses).
+        public string GetJobDetails(string jobId) =>
+            Call<QueueGetRequest, JsonResponse>("armada_tpu.api.Lookout",
+                "GetJobDetails", new QueueGetRequest { Name = jobId }).Json;
+
+        // --- scheduling reports (armada_tpu.api.Reports; followers proxy
+        // to the leader, UNAVAILABLE is retryable) ---------------------------
+
+        public string GetJobReport(string jobId) =>
+            Call<QueueGetRequest, JsonResponse>("armada_tpu.api.Reports",
+                "GetJobReport", new QueueGetRequest { Name = jobId }).Json;
+
+        public string GetQueueReport(string queue) =>
+            Call<QueueGetRequest, JsonResponse>("armada_tpu.api.Reports",
+                "GetQueueReport", new QueueGetRequest { Name = queue }).Json;
+
+        /// Pool scheduling report; "" = every pool.
+        public string GetPoolReport(string pool) =>
+            Call<QueueGetRequest, JsonResponse>("armada_tpu.api.Reports",
+                "GetPoolReport", new QueueGetRequest { Name = pool }).Json;
+
         // --- event surface (armada_tpu.api.Event) ---------------------------
 
         private static readonly Method<JobSetEventsRequest, JobSetEventMessage>

@@ -6,8 +6,9 @@
  * grpc-java service codegen needed, only `tools/genclients.sh OUT java`
  * for the message classes (armada_tpu.api.Rpc / armada_tpu.events.Events).
  *
- * Reference parity: client/java (pkg/api bindings); the verbs here are the
- * Submit/Event service surface armadactl exposes.
+ * Reference parity: client/java (pkg/api bindings); the verbs cover the
+ * Submit/Event service surface armadactl exposes plus the Lookout and
+ * scheduling-Reports query services (JSON-over-gRPC).
  */
 package io.armadatpu;
 
@@ -124,6 +125,54 @@ public final class ArmadaClient implements AutoCloseable {
         return call("armada_tpu.api.Submit/ListQueues",
                 Rpc.Empty.getDefaultInstance(),
                 Rpc.QueueListResponse.getDefaultInstance()).getQueuesList();
+    }
+
+    // --- lookout surface (armada_tpu.api.Lookout: JSON-over-gRPC, the
+    // reference's REST query shapes) ----------------------------------------
+
+    /** Filtered job page; {@code queryJson} is the lookout query document
+     * ({"filters": [...], "order": {...}, "skip": n, "take": n}). */
+    public String getJobs(String queryJson) {
+        return call("armada_tpu.api.Lookout/GetJobs",
+                Rpc.LookoutQuery.newBuilder().setQueryJson(queryJson).build(),
+                Rpc.JsonResponse.getDefaultInstance()).getJson();
+    }
+
+    /** Grouped counts ({"group_by": "queue"|"jobset"|"state"|"annotation",
+     * "filters": [...], "aggregates": [...]}). */
+    public String groupJobs(String queryJson) {
+        return call("armada_tpu.api.Lookout/GroupJobs",
+                Rpc.LookoutQuery.newBuilder().setQueryJson(queryJson).build(),
+                Rpc.JsonResponse.getDefaultInstance()).getJson();
+    }
+
+    /** Full job details (spec fields, runs, errors, ingress addresses). */
+    public String getJobDetails(String jobId) {
+        return call("armada_tpu.api.Lookout/GetJobDetails",
+                Rpc.QueueGetRequest.newBuilder().setName(jobId).build(),
+                Rpc.JsonResponse.getDefaultInstance()).getJson();
+    }
+
+    // --- scheduling reports (armada_tpu.api.Reports; followers proxy to
+    // the leader, UNAVAILABLE is retryable) ---------------------------------
+
+    public String getJobReport(String jobId) {
+        return call("armada_tpu.api.Reports/GetJobReport",
+                Rpc.QueueGetRequest.newBuilder().setName(jobId).build(),
+                Rpc.JsonResponse.getDefaultInstance()).getJson();
+    }
+
+    public String getQueueReport(String queue) {
+        return call("armada_tpu.api.Reports/GetQueueReport",
+                Rpc.QueueGetRequest.newBuilder().setName(queue).build(),
+                Rpc.JsonResponse.getDefaultInstance()).getJson();
+    }
+
+    /** Pool scheduling report; "" = every pool. */
+    public String getPoolReport(String pool) {
+        return call("armada_tpu.api.Reports/GetPoolReport",
+                Rpc.QueueGetRequest.newBuilder().setName(pool).build(),
+                Rpc.JsonResponse.getDefaultInstance()).getJson();
     }
 
     // --- event surface (armada_tpu.api.Event) ------------------------------

@@ -10,7 +10,7 @@
  * Reference parity: client/scala/armada-scala-client
  * (io.armadaproject.armada.ArmadaClient -- submit/cancel/reprioritize/
  * queue CRUD/events over a plaintext-or-TLS channel with optional bearer
- * metadata); this client speaks the armada-tpu Submit/Event services.
+ * metadata); this client speaks the armada-tpu Submit/Event/Lookout/Reports services.
  */
 package io.armadatpu
 
@@ -147,6 +147,56 @@ final class ArmadaClient private (channel: ManagedChannel, stubChannel: Channel)
       Rpc.Empty.getDefaultInstance,
       Rpc.QueueListResponse.getDefaultInstance
     ).getQueuesList.asScala.toSeq
+
+  // --- lookout surface (armada_tpu.api.Lookout: JSON-over-gRPC) ------------
+
+  /** Filtered job page; `queryJson` is the lookout query document. */
+  def getJobs(queryJson: String): String =
+    call(
+      "armada_tpu.api.Lookout/GetJobs",
+      Rpc.LookoutQuery.newBuilder.setQueryJson(queryJson).build,
+      Rpc.JsonResponse.getDefaultInstance
+    ).getJson
+
+  def groupJobs(queryJson: String): String =
+    call(
+      "armada_tpu.api.Lookout/GroupJobs",
+      Rpc.LookoutQuery.newBuilder.setQueryJson(queryJson).build,
+      Rpc.JsonResponse.getDefaultInstance
+    ).getJson
+
+  /** Full job details (spec fields, runs, errors, ingress addresses). */
+  def getJobDetails(jobId: String): String =
+    call(
+      "armada_tpu.api.Lookout/GetJobDetails",
+      Rpc.QueueGetRequest.newBuilder.setName(jobId).build,
+      Rpc.JsonResponse.getDefaultInstance
+    ).getJson
+
+  // --- scheduling reports (armada_tpu.api.Reports; followers proxy to the
+  // leader, UNAVAILABLE is retryable) ----------------------------------------
+
+  def getJobReport(jobId: String): String =
+    call(
+      "armada_tpu.api.Reports/GetJobReport",
+      Rpc.QueueGetRequest.newBuilder.setName(jobId).build,
+      Rpc.JsonResponse.getDefaultInstance
+    ).getJson
+
+  def getQueueReport(queue: String): String =
+    call(
+      "armada_tpu.api.Reports/GetQueueReport",
+      Rpc.QueueGetRequest.newBuilder.setName(queue).build,
+      Rpc.JsonResponse.getDefaultInstance
+    ).getJson
+
+  /** Pool scheduling report; "" = every pool. */
+  def getPoolReport(pool: String): String =
+    call(
+      "armada_tpu.api.Reports/GetPoolReport",
+      Rpc.QueueGetRequest.newBuilder.setName(pool).build,
+      Rpc.JsonResponse.getDefaultInstance
+    ).getJson
 
   // --- event surface (armada_tpu.api.Event) --------------------------------
 

@@ -92,7 +92,7 @@ def test_thin_clients_reference_only_generated_messages(generated):
     cs_refs |= set(re.findall(r"[<,]\s*(\w+)\s*[>,]", cs))
     suspects = {
         r for r in cs_refs
-        if r.endswith(("Request", "Response", "Message", "Item"))
+        if r.endswith(("Request", "Response", "Message", "Item", "Query"))
         or r in ("Queue", "Empty")
     }
     for m in sorted(suspects):
