@@ -233,9 +233,11 @@ class FairSchedulingAlgo:
         market_pools = {p.name for p in self.config.pools if p.market_driven}
         if incremental:
             # Overlay this txn's uncommitted changes onto the persistent
-            # builders (idempotent: the same deltas fire again at commit via
-            # the JobDb subscription).
-            self.feed.on_delta(txn._upserts, txn._deletes)
+            # builders.  overlay() records what it applied so the same
+            # deltas firing again (later pools' overlays, the commit
+            # subscription) skip the idempotent re-apply instead of paying
+            # for it.
+            self.feed.overlay(txn._upserts, txn._deletes)
         # The full per-job txn scans below are what the incremental feed
         # exists to avoid; they remain for the legacy path and the short-job
         # penalty (derived from retained TERMINAL jobs the feed drops).
@@ -405,8 +407,9 @@ class FairSchedulingAlgo:
             )
             if incremental:
                 # Later pools must see this pool's leases/preemptions; the
-                # overlay re-apply is O(changed) and idempotent.
-                self.feed.on_delta(txn._upserts, set())
+                # overlay registry keeps this O(this pool's changes), not
+                # O(all txn upserts so far).
+                self.feed.overlay(txn._upserts)
             stats = PoolStats(
                 pool=pool,
                 outcome=outcome,
@@ -514,7 +517,7 @@ class FairSchedulingAlgo:
                     txn, outcome, host, executor_of_node, now_ns, result, away=True
                 )
                 if incremental:
-                    self.feed.on_delta(txn._upserts, set())
+                    self.feed.overlay(txn._upserts)
                 scheduled_ids = set(outcome.scheduled)
                 if scheduled_ids:
                     queued_jobs = [
@@ -615,7 +618,7 @@ class FairSchedulingAlgo:
         """Incremental-mode market observability: the same three quantities
         as _market_observability, read off the builder columns instead of
         spec lists (the builder's runs table already reflects this pool's
-        leases and preemptions -- feed.on_delta ran before stats).
+        leases and preemptions -- feed.overlay() ran before stats).
         Realised values stay O(decisions) via txn lookups."""
         if bid_price_of is None:
             return
@@ -632,7 +635,7 @@ class FairSchedulingAlgo:
             )
         # The mega round's candidate set is the PRE-round state
         # (idealised_value.go:68-76): jobs preempted this cycle already left
-        # the builder tables (feed.on_delta ran), so they re-enter here
+        # the builder tables (feed.overlay() ran), so they re-enter here
         # explicitly -- O(preempted) txn lookups.
         preempted_specs = []
         for jid in outcome.preempted:

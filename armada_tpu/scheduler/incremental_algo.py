@@ -51,6 +51,17 @@ class IncrementalProblemFeed:
         # domain pins can be forgotten when the run ends (else the
         # note_running_gang sets grow forever).
         self._gang_of: dict[str, tuple] = {}
+        # Open-txn overlay registry: job id -> the exact (immutable) Job
+        # instance already applied mid-txn via overlay(), plus overlaid
+        # deletes.  The commit's subscriber re-fire passes the same
+        # instances, so identity lets it skip the idempotent second apply --
+        # which profiling showed was ~half the sidecar cycle's feed cost
+        # (lease_many/remove_many/apply_job all ran twice per cycle, and the
+        # per-pool overlay re-applied every earlier pool's upserts).  A job
+        # re-upserted after its overlay is a NEW instance (jobdb Jobs are
+        # immutable), so it misses the registry and re-applies correctly.
+        self._overlaid: dict[str, Job] = {}
+        self._overlaid_deletes: set[str] = set()
         self._jobdb = None
         # Builders must exist BEFORE the first delta arrives or it is lost --
         # the feed retains no job state of its own.  Configured pools are
@@ -76,6 +87,8 @@ class IncrementalProblemFeed:
         self.devcaches = {}
         self.pool_restricted = set()
         self._gang_of = {}
+        self._overlaid = {}
+        self._overlaid_deletes = set()
         for p in self.config.pools:
             self.builders[p.name] = IncrementalBuilder(self.config, p.name)
             self.devcaches[p.name] = DeviceDeltaCache()
@@ -106,15 +119,39 @@ class IncrementalProblemFeed:
     # ------------------------------------------------------------ deltas ----
 
     def on_delta(self, upserts: dict, deletes: set) -> None:
+        # The commit subscriber: skip anything overlay() already applied
+        # within the committing txn, then drop the registry (it is only
+        # meaningful inside that txn).
+        self._apply_delta(upserts, deletes, record=False)
+        self._overlaid.clear()
+        self._overlaid_deletes.clear()
+
+    def overlay(self, upserts: dict, deletes: set = frozenset()) -> None:
+        """Mid-txn application (the schedule-time overlay of the OPEN txn's
+        buffer): applies like on_delta but records each applied instance so
+        neither a later per-pool overlay nor the commit re-fire pays for it
+        again."""
+        self._apply_delta(upserts, deletes, record=True)
+
+    def _apply_delta(self, upserts: dict, deletes, record: bool) -> None:
         # Per-job submit()/lease() is one np.insert PER COLUMN PER JOB --
         # O(table) each, so a K-job commit against a 1M-row table would cost
         # O(K x table x pools).  Accumulate the batch and flush once per
         # builder (one np.insert per column total), the same shape bench.py's
         # backlog load uses.
         for job_id in deletes:
+            if job_id in self._overlaid_deletes:
+                continue
+            if record:
+                self._overlaid_deletes.add(job_id)
             self._remove_everywhere(job_id)
         pending: dict = {}
+        overlaid = self._overlaid
         for job in upserts.values():
+            if overlaid.get(job.id) is job:
+                continue
+            if record:
+                overlaid[job.id] = job
             self.apply_job(job, pending)
         self._flush(pending)
 
@@ -183,11 +220,13 @@ class IncrementalProblemFeed:
         if job.queued:
             if not job.validated:
                 return
-            spec = dataclasses.replace(
-                job.spec,
-                priority=job.priority,
-                pools=job.pools or job.spec.pools,
-            )
+            pools = job.pools or job.spec.pools
+            if job.priority == job.spec.priority and pools == job.spec.pools:
+                spec = job.spec
+            else:
+                spec = dataclasses.replace(
+                    job.spec, priority=job.priority, pools=pools
+                )
             bans = job.anti_affinity_nodes()
             if spec.pools:
                 self.pool_restricted.add(job.id)
@@ -225,7 +264,11 @@ class IncrementalProblemFeed:
         if b is None:
             return
         r = RunningJob(
-            job=dataclasses.replace(job.spec, priority=job.priority),
+            job=(
+                job.spec
+                if job.priority == job.spec.priority
+                else dataclasses.replace(job.spec, priority=job.priority)
+            ),
             node_id=run.node_id,
             priority=run.scheduled_at_priority or 0,
             away=run.pool_scheduled_away,

@@ -414,7 +414,7 @@ def test_txn_abort_resyncs_the_feed(tmp_path):
         )
     )
     txn.upsert(Job(spec=_job("j3", "qa", 2), validated=True))
-    feed.on_delta(txn._upserts, txn._deletes)  # what schedule() does
+    feed.overlay(txn._upserts, txn._deletes)  # what schedule() does
     assert len(b.jobs.key_of_id) == 2  # j1 out, j3 in
     txn.abort()
 
