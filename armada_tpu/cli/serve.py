@@ -122,6 +122,7 @@ def start_control_plane(
     proxy_bearer_token: Optional[str] = None,
     algo_port: Optional[int] = None,
     replicate_log: bool = False,
+    database_url: Optional[str] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -131,6 +132,19 @@ def start_control_plane(
     (lookoutui job log view via binoculars logs.go).  authenticator: the
     server/authn.py chain gating the gRPC services and REST gateway; None =
     dev chain (trusted headers + anonymous)."""
+    if replicate_log and database_url:
+        # Each replica ingests its own copy of the log into its own view;
+        # two replicas sharing one external database would fight over the
+        # same exactly-once consumer cursor (consumer_positions) and each
+        # silently skip the batches the other acked.  Refuse rather than
+        # corrupt -- replicated mode uses per-replica embedded views (or
+        # point each replica at its OWN database via separate configs).
+        raise ValueError(
+            "--database-url cannot be combined with --replicate-log: "
+            "replicas would share one consumer cursor and silently miss "
+            "event batches; give each replica its own database (or use "
+            "the embedded per-replica default)"
+        )
     os.makedirs(data_dir, exist_ok=True)
     config = config or SchedulingConfig()
     factory = config.resource_list_factory()
@@ -147,7 +161,9 @@ def start_control_plane(
         )
 
     log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
-    db = SchedulerDb(os.path.join(data_dir, "scheduler.db"))
+    # External scheduler DB (postgres:// via the pure-python wire driver,
+    # ingest/pgwire.py) or the embedded per-replica SQLite default.
+    db = SchedulerDb(database_url or os.path.join(data_dir, "scheduler.db"))
     eventdb = EventDb(os.path.join(data_dir, "events.db"))
     lookoutdb = LookoutDb(os.path.join(data_dir, "lookout.db"))
     publisher = Publisher(log)

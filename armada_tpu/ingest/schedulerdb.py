@@ -1,4 +1,4 @@
-"""The scheduler database: SQLite materialization of the event log.
+"""The scheduler database: materialization of the event log.
 
 Equivalent of the reference's scheduler Postgres schema + access layer
 (internal/scheduler/database/migrations/001_initialize_schema.up.sql: tables
@@ -7,14 +7,25 @@ carry a monotonic `serial` bumped on every write, so the scheduler's syncState
 fetches increments with `serial > last_seen` (scheduler.go:386).
 
 Exactly-once materialization: `SchedulerDb.store` applies a batch of
-DbOperations AND the consumer's new log positions in one SQLite transaction --
+DbOperations AND the consumer's new log positions in one transaction --
 replaying after a crash resumes from the committed position, so no event is
 applied twice (the reference gets the same from Postgres txns keyed on Pulsar
 message ids, SURVEY.md section 5 checkpoint/resume).
+
+Backends: embedded SQLite by default (`path` = filename or ":memory:"), or
+an external PostgreSQL when `path` is a `postgres://` URL -- the reference's
+deployment shape (pgx against migrations 001-023).  The PG path rides the
+self-contained wire driver in ingest/pgwire.py; statements are written in the
+SQLite dialect and mechanically translated (`?` -> `$n`, `INSERT OR IGNORE`
+-> `ON CONFLICT DO NOTHING`, INTEGER -> BIGINT / BLOB -> BYTEA in DDL), and
+the conformance suite runs the whole SchedulerDb surface against a
+wire-accurate fake server (ingest/fakepg.py) plus, when `ARMADA_PG_DSN` is
+set, a real Postgres.
 """
 
 from __future__ import annotations
 
+import re
 import sqlite3
 import threading
 from typing import Iterable, Optional
@@ -140,37 +151,232 @@ RUNS_COLUMNS = (
 )
 
 
+_PG_DDL_TYPES = (
+    (" BLOB", " BYTEA"),
+    (" INTEGER", " BIGINT"),
+    (" REAL", " DOUBLE PRECISION"),
+)
+_QMARK = re.compile(r"\?")
+_OR_IGNORE = re.compile(r"INSERT OR IGNORE INTO", re.IGNORECASE)
+
+
+def _sqlite_to_pg(sql: str) -> str:
+    """Translate one SQLite-dialect statement to PostgreSQL.  Narrow by
+    construction: this module's statements never contain a literal '?', and
+    every INSERT OR IGNORE ends in its VALUES list (so appending the
+    conflict clause is safe).  PG's upsert syntax (ON CONFLICT .. DO UPDATE
+    SET x = excluded.x) is shared with SQLite and passes through."""
+    counter = [0]
+
+    def num(_m):
+        counter[0] += 1
+        return f"${counter[0]}"
+
+    out = _QMARK.sub(num, sql)
+    if _OR_IGNORE.search(out):
+        out = _OR_IGNORE.sub("INSERT INTO", out)
+        out = out.rstrip().rstrip(";") + " ON CONFLICT DO NOTHING"
+    return out
+
+
+class _PgCursor:
+    """sqlite3.Cursor-alike over a PgConnection (translate-then-execute)."""
+
+    def __init__(self, adapter: "_PgAdapter"):
+        self._a = adapter
+        self._result = None
+
+    def execute(self, sql: str, params=()):
+        self._result = self._a._run(sql, params)
+        return self
+
+    def executemany(self, sql: str, rows):
+        self._a._run_many(sql, rows)
+        self._result = None
+        return self
+
+    def fetchone(self):
+        if self._result is None or not self._result.rows:
+            return None
+        return self._result.rows[0]
+
+    def fetchall(self):
+        return list(self._result.rows) if self._result is not None else []
+
+
+class _PgAdapter:
+    """The subset of sqlite3.Connection SchedulerDb uses, over pgwire.
+    Lazy-BEGINs before the first write so store()'s commit() is a real
+    transaction boundary; plain reads outside a txn run statement-atomic.
+
+    Transport failures (server restart/failover -- routine for an external
+    DB) drop the dead session and reconnect on next use: the in-flight
+    operation still RAISES (the ingestion pipeline retries its un-acked
+    batch, which is exactly-once by consumer positions), but the process
+    does not need a restart to resume."""
+
+    def __init__(self, dsn: str):
+        from armada_tpu.ingest.pgwire import PgError, ProtocolError
+
+        self._dsn = dsn
+        self._pg = None
+        self._translated: dict[str, str] = {}
+        self._in_txn = False
+        # hoisted once: _transport_guard wraps every statement on the
+        # ingestion hot path
+        self._PgError = PgError
+        self._transport_errors = (ProtocolError, ConnectionError, OSError)
+        self._ensure()  # connect eagerly: surface bad DSNs at startup
+
+    def _ensure(self):
+        if self._pg is None:
+            from armada_tpu.ingest.pgwire import PgConnection
+
+            self._pg = PgConnection(self._dsn)
+            self._in_txn = False
+        return self._pg
+
+    def _drop_session(self) -> None:
+        if self._pg is not None:
+            try:
+                self._pg.close()
+            except Exception:
+                pass
+        self._pg = None
+        self._in_txn = False
+
+    def _translate(self, sql: str) -> str:
+        out = self._translated.get(sql)
+        if out is None:
+            out = self._translated[sql] = _sqlite_to_pg(sql)
+        return out
+
+    @staticmethod
+    def _is_write(sql: str) -> bool:
+        head = sql.lstrip()[:6].upper()
+        return not head.startswith("SELECT")
+
+    def _maybe_begin(self, sql: str) -> None:
+        if not self._in_txn and self._is_write(sql):
+            self._ensure().execute("BEGIN")
+            self._in_txn = True
+
+    def _transport_guard(self, fn):
+        try:
+            return fn()
+        except self._transport_errors:
+            self._drop_session()
+            raise
+        except self._PgError:
+            # A server-side statement error inside the lazy txn leaves the
+            # session in aborted-transaction state; callers WITHOUT their
+            # own rollback path (store_dedup, upsert_queue, upsert_executor)
+            # would then poison every later statement with 25P02.  Roll the
+            # txn back HERE so the session stays usable; store()'s own
+            # rollback on this same exception becomes a harmless no-op.
+            self.rollback()
+            raise
+
+    def _run(self, sql: str, params=()):
+        pg_sql = self._translate(sql)
+        return self._transport_guard(
+            lambda: (
+                self._maybe_begin(pg_sql),
+                self._ensure().execute(pg_sql, tuple(params)),
+            )[1]
+        )
+
+    def _run_many(self, sql: str, rows) -> None:
+        pg_sql = self._translate(sql)
+        self._transport_guard(
+            lambda: (
+                self._maybe_begin(pg_sql),
+                self._ensure().executemany(pg_sql, rows),
+            )[1]
+        )
+
+    # sqlite3.Connection surface
+    def cursor(self) -> _PgCursor:
+        return _PgCursor(self)
+
+    def execute(self, sql: str, params=()):
+        return _PgCursor(self).execute(sql, params)
+
+    def executemany(self, sql: str, rows):
+        return _PgCursor(self).executemany(sql, rows)
+
+    def executescript(self, script: str) -> None:
+        for a, b in _PG_DDL_TYPES:
+            script = script.replace(a, b)
+        self._transport_guard(
+            lambda: self._ensure().execute_script(script)
+        )
+
+    def commit(self) -> None:
+        if self._in_txn:
+            self._transport_guard(lambda: self._ensure().execute("COMMIT"))
+            self._in_txn = False
+
+    def rollback(self) -> None:
+        if self._in_txn and self._pg is not None:
+            # A transport failure already dropped the session (and with it
+            # the server-side txn); only a live aborted txn needs the
+            # ROLLBACK on the wire.  Best-effort: if the wire dies HERE,
+            # dropping the session discards the txn just the same, and the
+            # caller's original exception must not be masked.
+            try:
+                self._pg.execute("ROLLBACK")
+            except Exception:
+                self._drop_session()
+        self._in_txn = False
+
+    def close(self) -> None:
+        self._drop_session()
+
+
 class SchedulerDb:
-    """SQLite-backed scheduler state store + ingestion sink."""
+    """Scheduler state store + ingestion sink (SQLite file / :memory:, or
+    external PostgreSQL via a postgres:// URL)."""
 
     def __init__(self, path: str = ":memory:"):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.row_factory = sqlite3.Row
+        self._dialect = (
+            "pg" if path.startswith(("postgres://", "postgresql://")) else "sqlite"
+        )
+        if self._dialect == "pg":
+            self._conn = _PgAdapter(path)
+        else:
+            self._conn = sqlite3.connect(path, check_same_thread=False)
+            self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
         self._migrate()
-        self._conn.execute("PRAGMA journal_mode=WAL")
+        if self._dialect == "sqlite":
+            self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
         self._lock = threading.Lock()
+
+    def _table_columns(self, table: str) -> set[str]:
+        if self._dialect == "sqlite":
+            return {
+                r["name"]
+                for r in self._conn.execute(
+                    f"PRAGMA table_info({table})"
+                ).fetchall()
+            }
+        res = self._conn._run(f"SELECT * FROM {table} LIMIT 0")
+        return set(res.columns)
 
     def _migrate(self) -> None:
         """Columns added after a table existed: CREATE TABLE IF NOT EXISTS is
         a no-op then, so patch the schema in place (the reference's numbered
         migrations, database/migrations/)."""
-        cols = {
-            r["name"]
-            for r in self._conn.execute("PRAGMA table_info(jobs)").fetchall()
-        }
-        if "preempt_requested" not in cols:
+        itype = "INTEGER" if self._dialect == "sqlite" else "BIGINT"
+        if "preempt_requested" not in self._table_columns("jobs"):
             self._conn.execute(
-                "ALTER TABLE jobs ADD COLUMN preempt_requested INTEGER NOT NULL DEFAULT 0"
+                f"ALTER TABLE jobs ADD COLUMN preempt_requested {itype} NOT NULL DEFAULT 0"
             )
-        run_cols = {
-            r["name"]
-            for r in self._conn.execute("PRAGMA table_info(runs)").fetchall()
-        }
-        if "running_ns" not in run_cols:
+        if "running_ns" not in self._table_columns("runs"):
             self._conn.execute(
-                "ALTER TABLE runs ADD COLUMN running_ns INTEGER NOT NULL DEFAULT 0"
+                f"ALTER TABLE runs ADD COLUMN running_ns {itype} NOT NULL DEFAULT 0"
             )
 
     def close(self) -> None:
@@ -229,14 +435,18 @@ class SchedulerDb:
     # --- op application -----------------------------------------------------
 
     def _apply(self, cur: sqlite3.Cursor, op: ops.DbOperation) -> None:
+        # Serials ride as bound parameters, never interpolated literals: the
+        # statement TEXT stays constant across batches, so the PG adapter's
+        # translate cache (and sqlite3's statement cache) actually hit.
         if isinstance(op, ops.InsertJobs):
             serial = self._next_serial(cur, "jobs")
             cols = ", ".join(JOBS_COLUMNS)
             qs = ", ".join("?" for _ in JOBS_COLUMNS)
             cur.executemany(
-                f"INSERT OR IGNORE INTO jobs ({cols}, serial) VALUES ({qs}, {serial})",
+                f"INSERT OR IGNORE INTO jobs ({cols}, serial) VALUES ({qs}, ?)",
                 [
                     tuple(row.get(c, _job_default(c)) for c in JOBS_COLUMNS)
+                    + (serial,)
                     for row in op.jobs.values()
                 ],
             )
@@ -245,9 +455,10 @@ class SchedulerDb:
             cols = ", ".join(RUNS_COLUMNS)
             qs = ", ".join("?" for _ in RUNS_COLUMNS)
             cur.executemany(
-                f"INSERT OR IGNORE INTO runs ({cols}, serial) VALUES ({qs}, {serial})",
+                f"INSERT OR IGNORE INTO runs ({cols}, serial) VALUES ({qs}, ?)",
                 [
                     tuple(row.get(c, _run_default(c)) for c in RUNS_COLUMNS)
+                    + (serial,)
                     for row in op.runs.values()
                 ],
             )
@@ -262,23 +473,26 @@ class SchedulerDb:
         elif isinstance(op, ops.MarkJobsValidated):
             serial = self._next_serial(cur, "jobs")
             cur.executemany(
-                f"UPDATE jobs SET validated = 1, pools = ?, serial = {serial} "
+                "UPDATE jobs SET validated = 1, pools = ?, serial = ? "
                 "WHERE job_id = ?",
-                [(",".join(pools), jid) for jid, pools in op.pools_by_job.items()],
+                [
+                    (",".join(pools), serial, jid)
+                    for jid, pools in op.pools_by_job.items()
+                ],
             )
         elif isinstance(op, ops.UpdateJobPriorities):
             serial = self._next_serial(cur, "jobs")
             cur.executemany(
-                f"UPDATE jobs SET priority = ?, serial = {serial} WHERE job_id = ?",
-                [(p, jid) for jid, p in op.priority_by_job.items()],
+                "UPDATE jobs SET priority = ?, serial = ? WHERE job_id = ?",
+                [(p, serial, jid) for jid, p in op.priority_by_job.items()],
             )
         elif isinstance(op, ops.UpdateJobQueuedState):
             serial = self._next_serial(cur, "jobs")
             cur.executemany(
-                f"UPDATE jobs SET queued = ?, queued_version = ?, serial = {serial} "
+                "UPDATE jobs SET queued = ?, queued_version = ?, serial = ? "
                 "WHERE job_id = ? AND queued_version < ?",
                 [
-                    (int(queued), version, jid, version)
+                    (int(queued), version, serial, jid, version)
                     for jid, (queued, version) in op.state_by_job.items()
                 ],
             )
@@ -289,12 +503,14 @@ class SchedulerDb:
                 conds.append("queued = 1")
             if op.cancel_leased:
                 conds.append("queued = 0")
-            state_cond = f"({' OR '.join(conds)})" if conds else "0"
+            # FALSE, not 0: an integer literal in boolean context is a
+            # SQLite-ism PG rejects (42804); FALSE parses on both.
+            state_cond = f"({' OR '.join(conds)})" if conds else "FALSE"
             cur.execute(
                 "UPDATE jobs SET cancel_by_jobset_requested = 1, "
-                f"serial = {serial} WHERE queue = ? AND jobset = ? AND {state_cond} "
+                f"serial = ? WHERE queue = ? AND jobset = ? AND {state_cond} "
                 "AND cancelled = 0 AND succeeded = 0 AND failed = 0",
-                (op.queue, op.jobset),
+                (serial, op.queue, op.jobset),
             )
         elif isinstance(op, (ops.MarkRunsPending, ops.MarkRunsRunning,
                              ops.MarkRunsSucceeded, ops.MarkRunsFailed,
@@ -317,16 +533,19 @@ class SchedulerDb:
                 # Record when the run started (short-job penalty window);
                 # keep the earliest timestamp on replay.
                 cur.executemany(
-                    f"UPDATE runs SET {flag} = 1{run_attempted}, serial = {serial}, "
+                    f"UPDATE runs SET {flag} = 1{run_attempted}, serial = ?, "
                     "running_ns = CASE WHEN running_ns > 0 THEN running_ns ELSE ? END "
                     "WHERE run_id = ?",
-                    [(int(op.times.get(rid, 0)), rid) for rid in op.runs],
+                    [
+                        (serial, int(op.times.get(rid, 0)), rid)
+                        for rid in op.runs
+                    ],
                 )
             else:
                 cur.executemany(
-                    f"UPDATE runs SET {flag} = 1{run_attempted}, serial = {serial} "
+                    f"UPDATE runs SET {flag} = 1{run_attempted}, serial = ? "
                     "WHERE run_id = ?",
-                    [(rid,) for rid in op.runs],
+                    [(serial, rid) for rid in op.runs],
                 )
         elif isinstance(op, ops.MarkJobsPreemptRequested):
             # Mark active runs AND persist the request on the job row: if no
@@ -335,24 +554,24 @@ class SchedulerDb:
             # dropping the request.
             serial = self._next_serial(cur, "runs")
             cur.executemany(
-                f"UPDATE runs SET preempt_requested = 1, serial = {serial} "
+                "UPDATE runs SET preempt_requested = 1, serial = ? "
                 "WHERE job_id = ? AND succeeded = 0 AND failed = 0 "
                 "AND cancelled = 0 AND preempted = 0 AND returned = 0",
-                [(jid,) for jid in op.job_ids],
+                [(serial, jid) for jid in op.job_ids],
             )
             jserial = self._next_serial(cur, "jobs")
             cur.executemany(
-                f"UPDATE jobs SET preempt_requested = 1, serial = {jserial} "
+                "UPDATE jobs SET preempt_requested = 1, serial = ? "
                 "WHERE job_id = ? AND cancelled = 0 AND succeeded = 0 AND failed = 0",
-                [(jid,) for jid in op.job_ids],
+                [(jserial, jid) for jid in op.job_ids],
             )
         elif isinstance(op, ops.UpdateJobSetPriority):
             serial = self._next_serial(cur, "jobs")
             cur.execute(
-                f"UPDATE jobs SET priority = ?, serial = {serial} "
+                "UPDATE jobs SET priority = ?, serial = ? "
                 "WHERE queue = ? AND jobset = ? "
                 "AND cancelled = 0 AND succeeded = 0 AND failed = 0",
-                (op.priority, op.queue, op.jobset),
+                (op.priority, serial, op.queue, op.jobset),
             )
         elif isinstance(op, ops.InsertJobRunErrors):
             cur.executemany(
@@ -462,7 +681,8 @@ class SchedulerDb:
                     conds.append("queued = 1")
                 if "leased" in op.job_states:
                     conds.append("queued = 0")
-                where += f" AND ({' OR '.join(conds) or '0'})"
+                # FALSE: boolean-context literal valid on both dialects
+                where += f" AND ({' OR '.join(conds) or 'FALSE'})"
             job_ids = self._filter_by_priority_class(
                 cur.execute(where, params).fetchall(), op.priority_classes
             )
@@ -493,8 +713,8 @@ class SchedulerDb:
         serial = self._next_serial(cur, "jobs")
         extra = f", {also}" if also else ""
         cur.executemany(
-            f"UPDATE jobs SET {flag} = 1{extra}, serial = {serial} WHERE job_id = ?",
-            [(jid,) for jid in job_ids],
+            f"UPDATE jobs SET {flag} = 1{extra}, serial = ? WHERE job_id = ?",
+            [(serial, jid) for jid in job_ids],
         )
 
     # --- scheduler-side reads (job_repository.go) ---------------------------
@@ -544,16 +764,37 @@ class SchedulerDb:
             (executor_id, limit),
         )
 
+    # IN lists chunked well under the wire protocol's uint16 parameter
+    # limit (pgwire Bind) and SQLite's host-parameter cap.
+    _IN_CHUNK = 8192
+
+    def _in_query(self, sql_template: str, values: list) -> list:
+        """Run `sql_template` (with an `{qs}` placeholder list) over `values`
+        in chunks.  Each chunk is PADDED to a power-of-two bucket by
+        repeating its last value -- duplicates are no-ops inside IN, and
+        bucketing keeps the distinct statement texts (and the PG adapter's
+        translate cache) bounded at ~14 per query shape instead of one per
+        list size ever seen."""
+        out: list = []
+        for lo in range(0, len(values), self._IN_CHUNK):
+            chunk = list(values[lo : lo + self._IN_CHUNK])
+            size = 1
+            while size < len(chunk):
+                size *= 2
+            chunk.extend([chunk[-1]] * (size - len(chunk)))
+            qs = ",".join("?" * size)
+            out.extend(self._query(sql_template.format(qs=qs), chunk))
+        return out
+
     def inactive_runs(self, run_ids: Iterable[str]) -> set[str]:
         """Of `run_ids`, those the scheduler no longer considers active: the
         run or its job is terminal, or the run is unknown (FindInactiveRuns)."""
         run_ids = list(run_ids)
         if not run_ids:
             return set()
-        qs = ",".join("?" for _ in run_ids)
-        rows = self._query(
-            f"SELECT r.run_id FROM runs r JOIN jobs j ON j.job_id = r.job_id "
-            f"WHERE r.run_id IN ({qs}) "
+        rows = self._in_query(
+            "SELECT r.run_id FROM runs r JOIN jobs j ON j.job_id = r.job_id "
+            "WHERE r.run_id IN ({qs}) "
             "  AND r.succeeded = 0 AND r.failed = 0 AND r.cancelled = 0 "
             "  AND r.preempted = 0 AND r.returned = 0 "
             "  AND j.cancelled = 0 AND j.succeeded = 0 AND j.failed = 0",
@@ -578,9 +819,8 @@ class SchedulerDb:
     def lookup_dedup(self, keys: list[str]) -> dict[str, str]:
         if not keys:
             return {}
-        qs = ",".join("?" for _ in keys)
-        rows = self._query(
-            f"SELECT dedup_key, job_id FROM job_dedup WHERE dedup_key IN ({qs})",
+        rows = self._in_query(
+            "SELECT dedup_key, job_id FROM job_dedup WHERE dedup_key IN ({qs})",
             keys,
         )
         return {r["dedup_key"]: r["job_id"] for r in rows}

@@ -69,13 +69,16 @@ class ControlPlane:
         config: SchedulingConfig | None = None,
         executor_specs: dict | None = None,
         runtime_s: float = 5.0,
+        db_url: str | None = None,
     ) -> "ControlPlane":
-        """executor_specs: {executor_id: (num_nodes, cpu, mem)}."""
+        """executor_specs: {executor_id: (num_nodes, cpu, mem)}.
+        db_url: external scheduler database (e.g. a postgres:// DSN); the
+        default is embedded in-memory SQLite."""
         config = config or SchedulingConfig(shape_bucket=32, enable_assertions=True)
         clock = ManualClock()
         factory = config.resource_list_factory()
         log = EventLog(str(tmp_path / "log"), num_partitions=2)
-        db = SchedulerDb(":memory:")
+        db = SchedulerDb(db_url or ":memory:")
         eventdb = EventDb(":memory:")
         publisher = Publisher(log, clock=clock)
         scheduler_pipeline = IngestionPipeline(
