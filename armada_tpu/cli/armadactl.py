@@ -528,6 +528,7 @@ _SERVE_FALLBACKS = {
     "leader_id": None,
     "advertised_address": None,
     "database_url": None,
+    "lookout_database_url": None,
 }
 
 
@@ -578,6 +579,7 @@ def load_serve_config(args):
         "leader_id": ("leaderid", str),
         "advertised_address": ("advertisedaddress", str),
         "database_url": ("databaseurl", str),
+        "lookout_database_url": ("lookoutdatabaseurl", str),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -616,6 +618,7 @@ def cmd_serve(args):
         advertised_address=args.advertised_address,
         proxy_bearer_token=getattr(args, "proxy_bearer_token", None),
         database_url=getattr(args, "database_url", None),
+        lookout_database_url=getattr(args, "lookout_database_url", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -832,6 +835,12 @@ def build_parser() -> argparse.ArgumentParser:
         "-- a FRESH database this plane owns (it bootstraps and migrates "
         "its own schema; the deployment role the reference fills with its "
         "scheduler Postgres).  Default: embedded SQLite under --data-dir",
+    )
+    srv.add_argument(
+        "--lookout-database-url",
+        help="external lookout database (postgres://...), the reference's "
+        "second Postgres -- a FRESH database this plane owns.  Default: "
+        "embedded SQLite under --data-dir",
     )
     srv.add_argument(
         "--bind-host",

@@ -123,6 +123,7 @@ def start_control_plane(
     algo_port: Optional[int] = None,
     replicate_log: bool = False,
     database_url: Optional[str] = None,
+    lookout_database_url: Optional[str] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -132,7 +133,7 @@ def start_control_plane(
     (lookoutui job log view via binoculars logs.go).  authenticator: the
     server/authn.py chain gating the gRPC services and REST gateway; None =
     dev chain (trusted headers + anonymous)."""
-    if replicate_log and database_url:
+    if replicate_log and (database_url or lookout_database_url):
         # Each replica ingests its own copy of the log into its own view;
         # two replicas sharing one external database would fight over the
         # same exactly-once consumer cursor (consumer_positions) and each
@@ -161,11 +162,13 @@ def start_control_plane(
         )
 
     log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
-    # External scheduler DB (postgres:// via the pure-python wire driver,
-    # ingest/pgwire.py) or the embedded per-replica SQLite default.
+    # External DBs (postgres:// via the pure-python wire driver,
+    # ingest/pgwire.py) or the embedded per-replica SQLite defaults.
     db = SchedulerDb(database_url or os.path.join(data_dir, "scheduler.db"))
     eventdb = EventDb(os.path.join(data_dir, "events.db"))
-    lookoutdb = LookoutDb(os.path.join(data_dir, "lookout.db"))
+    lookoutdb = LookoutDb(
+        lookout_database_url or os.path.join(data_dir, "lookout.db")
+    )
     publisher = Publisher(log)
 
     scheduler_pipeline = IngestionPipeline(

@@ -37,11 +37,14 @@ from typing import Optional
 from armada_tpu.ingest import pgwire
 
 _PLACEHOLDER = re.compile(r"\$(\d+)")
+_PG_JSON = re.compile(r"\((\w+)::json ->> '([^']+)'\)")
 
 
 def translate_pg_to_sqlite(sql: str) -> tuple[str, list[int]]:
     """$n -> ? with an order map (the repository emits only sequential
-    placeholders, but the map keeps the fake honest if that changes)."""
+    placeholders, but the map keeps the fake honest if that changes); the
+    PG json accessor `(col::json ->> 'key')` maps back to SQLite JSON1."""
+    sql = _PG_JSON.sub(r"""json_extract(\1, '$."\2"')""", sql)
     order: list[int] = []
 
     def repl(m):
@@ -281,6 +284,12 @@ class _Session:
 
     def _handle_simple(self, body: bytes) -> None:
         script = body.rstrip(b"\0").decode()
+        # Strip `--` line comments BEFORE splitting on ';' -- a semicolon
+        # inside a comment must not split a statement.  (The repositories'
+        # DDL never carries '--' inside a string literal.)
+        script = "\n".join(
+            line.split("--", 1)[0] for line in script.splitlines()
+        )
         statements = [s for s in script.split(";") if s.strip()]
         if not statements:
             self._queue(b"I", b"")

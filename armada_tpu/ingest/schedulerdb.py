@@ -25,7 +25,6 @@ set, a real Postgres.
 
 from __future__ import annotations
 
-import re
 import sqlite3
 import threading
 from typing import Iterable, Optional
@@ -151,187 +150,12 @@ RUNS_COLUMNS = (
 )
 
 
-_PG_DDL_TYPES = (
-    (" BLOB", " BYTEA"),
-    (" INTEGER", " BIGINT"),
-    (" REAL", " DOUBLE PRECISION"),
+# Statement translation + the sqlite3.Connection-alike over the wire driver
+# live in ingest/sqladapter.py, shared with the lookout store.
+from armada_tpu.ingest.sqladapter import (  # noqa: E402
+    PgAdapter as _PgAdapter,
+    is_postgres_url,
 )
-_QMARK = re.compile(r"\?")
-_OR_IGNORE = re.compile(r"INSERT OR IGNORE INTO", re.IGNORECASE)
-
-
-def _sqlite_to_pg(sql: str) -> str:
-    """Translate one SQLite-dialect statement to PostgreSQL.  Narrow by
-    construction: this module's statements never contain a literal '?', and
-    every INSERT OR IGNORE ends in its VALUES list (so appending the
-    conflict clause is safe).  PG's upsert syntax (ON CONFLICT .. DO UPDATE
-    SET x = excluded.x) is shared with SQLite and passes through."""
-    counter = [0]
-
-    def num(_m):
-        counter[0] += 1
-        return f"${counter[0]}"
-
-    out = _QMARK.sub(num, sql)
-    if _OR_IGNORE.search(out):
-        out = _OR_IGNORE.sub("INSERT INTO", out)
-        out = out.rstrip().rstrip(";") + " ON CONFLICT DO NOTHING"
-    return out
-
-
-class _PgCursor:
-    """sqlite3.Cursor-alike over a PgConnection (translate-then-execute)."""
-
-    def __init__(self, adapter: "_PgAdapter"):
-        self._a = adapter
-        self._result = None
-
-    def execute(self, sql: str, params=()):
-        self._result = self._a._run(sql, params)
-        return self
-
-    def executemany(self, sql: str, rows):
-        self._a._run_many(sql, rows)
-        self._result = None
-        return self
-
-    def fetchone(self):
-        if self._result is None or not self._result.rows:
-            return None
-        return self._result.rows[0]
-
-    def fetchall(self):
-        return list(self._result.rows) if self._result is not None else []
-
-
-class _PgAdapter:
-    """The subset of sqlite3.Connection SchedulerDb uses, over pgwire.
-    Lazy-BEGINs before the first write so store()'s commit() is a real
-    transaction boundary; plain reads outside a txn run statement-atomic.
-
-    Transport failures (server restart/failover -- routine for an external
-    DB) drop the dead session and reconnect on next use: the in-flight
-    operation still RAISES (the ingestion pipeline retries its un-acked
-    batch, which is exactly-once by consumer positions), but the process
-    does not need a restart to resume."""
-
-    def __init__(self, dsn: str):
-        from armada_tpu.ingest.pgwire import PgError, ProtocolError
-
-        self._dsn = dsn
-        self._pg = None
-        self._translated: dict[str, str] = {}
-        self._in_txn = False
-        # hoisted once: _transport_guard wraps every statement on the
-        # ingestion hot path
-        self._PgError = PgError
-        self._transport_errors = (ProtocolError, ConnectionError, OSError)
-        self._ensure()  # connect eagerly: surface bad DSNs at startup
-
-    def _ensure(self):
-        if self._pg is None:
-            from armada_tpu.ingest.pgwire import PgConnection
-
-            self._pg = PgConnection(self._dsn)
-            self._in_txn = False
-        return self._pg
-
-    def _drop_session(self) -> None:
-        if self._pg is not None:
-            try:
-                self._pg.close()
-            except Exception:
-                pass
-        self._pg = None
-        self._in_txn = False
-
-    def _translate(self, sql: str) -> str:
-        out = self._translated.get(sql)
-        if out is None:
-            out = self._translated[sql] = _sqlite_to_pg(sql)
-        return out
-
-    @staticmethod
-    def _is_write(sql: str) -> bool:
-        head = sql.lstrip()[:6].upper()
-        return not head.startswith("SELECT")
-
-    def _maybe_begin(self, sql: str) -> None:
-        if not self._in_txn and self._is_write(sql):
-            self._ensure().execute("BEGIN")
-            self._in_txn = True
-
-    def _transport_guard(self, fn):
-        try:
-            return fn()
-        except self._transport_errors:
-            self._drop_session()
-            raise
-        except self._PgError:
-            # A server-side statement error inside the lazy txn leaves the
-            # session in aborted-transaction state; callers WITHOUT their
-            # own rollback path (store_dedup, upsert_queue, upsert_executor)
-            # would then poison every later statement with 25P02.  Roll the
-            # txn back HERE so the session stays usable; store()'s own
-            # rollback on this same exception becomes a harmless no-op.
-            self.rollback()
-            raise
-
-    def _run(self, sql: str, params=()):
-        pg_sql = self._translate(sql)
-        return self._transport_guard(
-            lambda: (
-                self._maybe_begin(pg_sql),
-                self._ensure().execute(pg_sql, tuple(params)),
-            )[1]
-        )
-
-    def _run_many(self, sql: str, rows) -> None:
-        pg_sql = self._translate(sql)
-        self._transport_guard(
-            lambda: (
-                self._maybe_begin(pg_sql),
-                self._ensure().executemany(pg_sql, rows),
-            )[1]
-        )
-
-    # sqlite3.Connection surface
-    def cursor(self) -> _PgCursor:
-        return _PgCursor(self)
-
-    def execute(self, sql: str, params=()):
-        return _PgCursor(self).execute(sql, params)
-
-    def executemany(self, sql: str, rows):
-        return _PgCursor(self).executemany(sql, rows)
-
-    def executescript(self, script: str) -> None:
-        for a, b in _PG_DDL_TYPES:
-            script = script.replace(a, b)
-        self._transport_guard(
-            lambda: self._ensure().execute_script(script)
-        )
-
-    def commit(self) -> None:
-        if self._in_txn:
-            self._transport_guard(lambda: self._ensure().execute("COMMIT"))
-            self._in_txn = False
-
-    def rollback(self) -> None:
-        if self._in_txn and self._pg is not None:
-            # A transport failure already dropped the session (and with it
-            # the server-side txn); only a live aborted txn needs the
-            # ROLLBACK on the wire.  Best-effort: if the wire dies HERE,
-            # dropping the session discards the txn just the same, and the
-            # caller's original exception must not be masked.
-            try:
-                self._pg.execute("ROLLBACK")
-            except Exception:
-                self._drop_session()
-        self._in_txn = False
-
-    def close(self) -> None:
-        self._drop_session()
 
 
 class SchedulerDb:
@@ -339,9 +163,7 @@ class SchedulerDb:
     external PostgreSQL via a postgres:// URL)."""
 
     def __init__(self, path: str = ":memory:"):
-        self._dialect = (
-            "pg" if path.startswith(("postgres://", "postgresql://")) else "sqlite"
-        )
+        self._dialect = "pg" if is_postgres_url(path) else "sqlite"
         if self._dialect == "pg":
             self._conn = _PgAdapter(path)
         else:
@@ -362,8 +184,7 @@ class SchedulerDb:
                     f"PRAGMA table_info({table})"
                 ).fetchall()
             }
-        res = self._conn._run(f"SELECT * FROM {table} LIMIT 0")
-        return set(res.columns)
+        return self._conn.table_columns(table)
 
     def _migrate(self) -> None:
         """Columns added after a table existed: CREATE TABLE IF NOT EXISTS is

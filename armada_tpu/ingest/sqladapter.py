@@ -1,0 +1,216 @@
+"""Shared SQLite->PostgreSQL statement adapter.
+
+Both materialized views -- the scheduler store (ingest/schedulerdb.py) and
+the lookout store (lookout/db.py) -- write their SQL once in the SQLite
+dialect; this module adapts it to an external PostgreSQL reached through the
+self-contained wire driver (ingest/pgwire.py).  Mirrors the reference's
+deployment shape of two Postgres databases (scheduler + lookout) behind
+repository interfaces.
+
+Translation is narrow by construction (the repositories' statements are the
+only input): `?` placeholders -> `$n`, `INSERT OR IGNORE` -> `ON CONFLICT DO
+NOTHING` (every such statement ends in its VALUES list), SQLite JSON1
+`json_extract(col, '$."key"')` -> `(col::json ->> 'key')`, and DDL type
+names.  PG's upsert syntax (`ON CONFLICT .. DO UPDATE SET x = excluded.x`)
+is shared with SQLite and passes through.
+"""
+
+from __future__ import annotations
+
+import re
+
+PG_DDL_TYPES = (
+    (" BLOB", " BYTEA"),
+    (" INTEGER", " BIGINT"),
+    (" REAL", " DOUBLE PRECISION"),
+)
+_QMARK = re.compile(r"\?")
+_OR_IGNORE = re.compile(r"INSERT OR IGNORE INTO", re.IGNORECASE)
+# queries.py emits exactly this shape (annotation keys are validated against
+# the kubernetes grammar, so no quote can appear inside).
+_JSON_EXTRACT = re.compile(r"""json_extract\((\w+), '\$\."([^"']+)"'\)""")
+
+
+def sqlite_to_pg(sql: str) -> str:
+    """Translate one SQLite-dialect statement to PostgreSQL."""
+    counter = [0]
+
+    def num(_m):
+        counter[0] += 1
+        return f"${counter[0]}"
+
+    out = _JSON_EXTRACT.sub(r"(\1::json ->> '\2')", sql)
+    out = _QMARK.sub(num, out)
+    if _OR_IGNORE.search(out):
+        out = _OR_IGNORE.sub("INSERT INTO", out)
+        out = out.rstrip().rstrip(";") + " ON CONFLICT DO NOTHING"
+    return out
+
+
+class PgCursor:
+    """sqlite3.Cursor-alike over a PgConnection (translate-then-execute)."""
+
+    def __init__(self, adapter: "PgAdapter"):
+        self._a = adapter
+        self._result = None
+
+    def execute(self, sql: str, params=()):
+        self._result = self._a._run(sql, params)
+        return self
+
+    def executemany(self, sql: str, rows):
+        self._a._run_many(sql, rows)
+        self._result = None
+        return self
+
+    def fetchone(self):
+        if self._result is None or not self._result.rows:
+            return None
+        return self._result.rows[0]
+
+    def fetchall(self):
+        return list(self._result.rows) if self._result is not None else []
+
+    @property
+    def rowcount(self) -> int:
+        return self._result.rowcount if self._result is not None else -1
+
+
+class PgAdapter:
+    """The subset of sqlite3.Connection the stores use, over pgwire.
+    Lazy-BEGINs before the first write so store()'s commit() is a real
+    transaction boundary; plain reads outside a txn run statement-atomic.
+
+    Transport failures (server restart/failover -- routine for an external
+    DB) drop the dead session and reconnect on next use: the in-flight
+    operation still RAISES (the ingestion pipeline retries its un-acked
+    batch, which is exactly-once by consumer positions), but the process
+    does not need a restart to resume."""
+
+    def __init__(self, dsn: str):
+        from armada_tpu.ingest.pgwire import PgError, ProtocolError
+
+        self._dsn = dsn
+        self._pg = None
+        self._translated: dict[str, str] = {}
+        self._in_txn = False
+        # hoisted once: _transport_guard wraps every statement on the
+        # ingestion hot path
+        self._PgError = PgError
+        self._transport_errors = (ProtocolError, ConnectionError, OSError)
+        self._ensure()  # connect eagerly: surface bad DSNs at startup
+
+    def _ensure(self):
+        if self._pg is None:
+            from armada_tpu.ingest.pgwire import PgConnection
+
+            self._pg = PgConnection(self._dsn)
+            self._in_txn = False
+        return self._pg
+
+    def _drop_session(self) -> None:
+        if self._pg is not None:
+            try:
+                self._pg.close()
+            except Exception:
+                pass
+        self._pg = None
+        self._in_txn = False
+
+    def _translate(self, sql: str) -> str:
+        out = self._translated.get(sql)
+        if out is None:
+            out = self._translated[sql] = sqlite_to_pg(sql)
+        return out
+
+    @staticmethod
+    def _is_write(sql: str) -> bool:
+        head = sql.lstrip()[:6].upper()
+        return not head.startswith("SELECT")
+
+    def _maybe_begin(self, sql: str) -> None:
+        if not self._in_txn and self._is_write(sql):
+            self._ensure().execute("BEGIN")
+            self._in_txn = True
+
+    def _transport_guard(self, fn):
+        try:
+            return fn()
+        except self._transport_errors:
+            self._drop_session()
+            raise
+        except self._PgError:
+            # A server-side statement error inside the lazy txn leaves the
+            # session in aborted-transaction state; callers WITHOUT their
+            # own rollback path (dedup stores, queue/view upserts) would
+            # then poison every later statement with 25P02.  Roll the txn
+            # back HERE so the session stays usable; a caller's own
+            # rollback on this same exception becomes a harmless no-op.
+            self.rollback()
+            raise
+
+    def _run(self, sql: str, params=()):
+        pg_sql = self._translate(sql)
+        return self._transport_guard(
+            lambda: (
+                self._maybe_begin(pg_sql),
+                self._ensure().execute(pg_sql, tuple(params)),
+            )[1]
+        )
+
+    def _run_many(self, sql: str, rows) -> None:
+        pg_sql = self._translate(sql)
+        self._transport_guard(
+            lambda: (
+                self._maybe_begin(pg_sql),
+                self._ensure().executemany(pg_sql, rows),
+            )[1]
+        )
+
+    # sqlite3.Connection surface
+    def cursor(self) -> PgCursor:
+        return PgCursor(self)
+
+    def execute(self, sql: str, params=()):
+        return PgCursor(self).execute(sql, params)
+
+    def executemany(self, sql: str, rows):
+        return PgCursor(self).executemany(sql, rows)
+
+    def executescript(self, script: str) -> None:
+        for a, b in PG_DDL_TYPES:
+            script = script.replace(a, b)
+        self._transport_guard(
+            lambda: self._ensure().execute_script(script)
+        )
+
+    def commit(self) -> None:
+        if self._in_txn:
+            self._transport_guard(lambda: self._ensure().execute("COMMIT"))
+            self._in_txn = False
+
+    def rollback(self) -> None:
+        if self._in_txn and self._pg is not None:
+            # A transport failure already dropped the session (and with it
+            # the server-side txn); only a live aborted txn needs the
+            # ROLLBACK on the wire.  Best-effort: if the wire dies HERE,
+            # dropping the session discards the txn just the same, and the
+            # caller's original exception must not be masked.
+            try:
+                self._pg.execute("ROLLBACK")
+            except Exception:
+                self._drop_session()
+        self._in_txn = False
+
+    def close(self) -> None:
+        self._drop_session()
+
+    def table_columns(self, table: str) -> set[str]:
+        """Column names via an empty result's RowDescription -- works on any
+        server without information_schema round trips (the stores' in-place
+        migration probe; PRAGMA table_info stays on the sqlite side)."""
+        return set(self._run(f"SELECT * FROM {table} LIMIT 0").columns)
+
+
+def is_postgres_url(path: str) -> bool:
+    return path.startswith(("postgres://", "postgresql://"))

@@ -6,6 +6,13 @@ annotations, `job_run` per attempt, `job_error`): one wide row per job kept
 current by the ingester, so list/group/detail queries are single-table scans
 with indexes -- no joins against the scheduler's store, which serves a
 different master (the cycle).
+
+Backends: embedded SQLite by default, or an external PostgreSQL when `path`
+is a `postgres://` URL (serve --lookout-database-url) -- the reference's
+second Postgres, behind the same shared adapter as the scheduler store
+(ingest/sqladapter.py over the wire driver ingest/pgwire.py).  queries.py's
+SQL is written dialect-portable (CASE WHEN state counts, FALSE literals);
+json_extract translates to `::json ->>`.
 """
 
 from __future__ import annotations
@@ -14,6 +21,8 @@ import json
 import sqlite3
 import threading
 from typing import Iterable, Optional
+
+from armada_tpu.ingest.sqladapter import PgAdapter, is_postgres_url
 
 # Lookout job states (internal/lookoutui state enum; ingester state machine).
 JOB_STATES = (
@@ -92,26 +101,35 @@ class LookoutDb:
     """Store + ingestion sink (lookoutingester/lookoutdb/insertion.go)."""
 
     def __init__(self, path: str = ":memory:"):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.row_factory = sqlite3.Row
+        self._dialect = "pg" if is_postgres_url(path) else "sqlite"
+        if self._dialect == "pg":
+            self._conn = PgAdapter(path)
+        else:
+            self._conn = sqlite3.connect(path, check_same_thread=False)
+            self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
-        # in-place migration for file DBs created before usage reporting
-        cols = {
-            r[1] for r in self._conn.execute("PRAGMA table_info(job_run)")
-        }
-        if "usage_json" not in cols:
+        # in-place migration for DBs created before usage/ingress reporting
+        if "usage_json" not in self._table_columns("job_run"):
             self._conn.execute(
                 "ALTER TABLE job_run ADD COLUMN usage_json TEXT NOT NULL DEFAULT ''"
             )
-        jcols = {r[1] for r in self._conn.execute("PRAGMA table_info(job)")}
-        if "ingress_json" not in jcols:
+        if "ingress_json" not in self._table_columns("job"):
             # pre-round-5 file DBs: ingress address reporting
             self._conn.execute(
                 "ALTER TABLE job ADD COLUMN ingress_json TEXT NOT NULL DEFAULT ''"
             )
-        self._conn.execute("PRAGMA journal_mode=WAL")
+        if self._dialect == "sqlite":
+            self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
         self._lock = threading.Lock()
+
+    def _table_columns(self, table: str) -> set[str]:
+        if self._dialect == "sqlite":
+            return {
+                r[1]
+                for r in self._conn.execute(f"PRAGMA table_info({table})")
+            }
+        return self._conn.table_columns(table)
 
     def close(self) -> None:
         self._conn.close()

@@ -110,7 +110,9 @@ class LookoutQueries:
             if f.match == "in":
                 values = list(f.value)  # type: ignore[arg-type]
                 if not values:
-                    clauses.append("0")
+                    # FALSE: an integer literal in boolean context is a
+                    # SQLite-ism the PG backend rejects (42804)
+                    clauses.append("FALSE")
                     continue
                 qs = ",".join("?" for _ in values)
                 clauses.append(f"{col} IN ({qs})")
@@ -204,9 +206,13 @@ class LookoutQueries:
                 raise ValueError(f"unknown aggregate {agg!r}")
             if agg == "state":
                 want_states = True
+                # CASE WHEN, not SUM(state = 'X'): summing a boolean is a
+                # SQLite-ism; the CASE form parses on both dialects.
                 selects.append(
                     ", ".join(
-                        f"SUM(state = '{s}') AS n_{s.lower()}" for s in JOB_STATES
+                        f"SUM(CASE WHEN state = '{s}' THEN 1 ELSE 0 END) "
+                        f"AS n_{s.lower()}"
+                        for s in JOB_STATES
                     )
                 )
             else:

@@ -415,6 +415,34 @@ def test_preempt_requested_flow(db):
     assert jobs[0]["preempt_requested"] == 1
 
 
+def test_pipeline_survives_db_outage_exactly_once(fake_server, tmp_path):
+    """Ingestion through a dropped-and-recovered DB connection: the failed
+    batch replays (positions were never acked) and applies exactly once --
+    the external-DB outage story end to end (pipeline retry + adapter
+    reconnect + transactional consumer positions)."""
+    from armada_tpu.eventlog import EventLog, Publisher
+    from armada_tpu.ingest import scheduler_ingestion_pipeline
+
+    d = SchedulerDb(fake_server)
+    _wipe(d)
+    with EventLog(str(tmp_path / "log"), num_partitions=1) as log:
+        pub = Publisher(log)
+        pipe = scheduler_ingestion_pipeline(log, d)
+        pub.publish([seq(events=[submit("j1")])])
+        assert pipe.run_until_caught_up() > 0
+        # sever the session mid-stream; the next batch must fail...
+        d._conn._pg._sock.close()
+        pub.publish([seq(events=[submit("j2")])])
+        with pytest.raises(Exception):
+            pipe.run_once()
+        # ...and replay cleanly on the reconnected session.
+        assert pipe.run_until_caught_up() > 0
+        jobs, _ = d.fetch_job_updates(0, 0)
+        assert {r["job_id"] for r in jobs} == {"j1", "j2"}
+        assert len(jobs) == 2  # exactly once, no double-apply
+    d.close()
+
+
 def test_full_control_plane_on_postgres(tmp_path):
     """The whole stack -- submit server, ingestion pipeline, scheduler
     rounds, executor reconciliation, event watch -- on the external-DB
@@ -452,6 +480,113 @@ def test_full_control_plane_on_postgres(tmp_path):
     finally:
         plane.close()
         srv.stop()
+
+
+# --- LookoutDb conformance across backends ----------------------------------
+# The reference's SECOND Postgres (lookout PG, internal/lookout/schema);
+# exercised through the shared adapter incl. the json_extract -> ::json ->>
+# translation and the dialect-portable state-count aggregates.
+
+
+@pytest.fixture(params=_backends())
+def lookout_db(request, fake_server, tmp_path):
+    from armada_tpu.lookout import LookoutDb
+
+    if request.param == "sqlite":
+        d = LookoutDb(str(tmp_path / "l.db"))
+    elif request.param == "fakepg":
+        d = LookoutDb(fake_server)
+    else:
+        d = LookoutDb(os.environ["ARMADA_PG_DSN"])
+    if request.param != "sqlite":
+        for t in ("job", "job_run", "consumer_positions", "saved_view"):
+            d._conn.execute(f"DELETE FROM {t}")
+        d._conn.commit()
+    yield d
+    d.close()
+
+
+def _lookout_world(d):
+    d.store(
+        [
+            {
+                "kind": "insert_job",
+                "job_id": f"j{i}",
+                "queue": "qa" if i % 2 == 0 else "qb",
+                "jobset": "js1",
+                "priority": i,
+                "cpu_milli": 1000 * (i + 1),
+                "annotations": {"armadaproject.io/stage": f"s{i % 2}"},
+                "ts": 100 + i,
+            }
+            for i in range(4)
+        ]
+        + [
+            {"kind": "insert_run", "run_id": "r0", "job_id": "j0",
+             "executor": "ex", "node": "n0", "ts": 200},
+            {"kind": "run_state", "run_id": "r0", "state": "RUNNING",
+             "ts": 210},
+            {"kind": "job_state", "job_id": "j0", "state": "RUNNING",
+             "ts": 210},
+            {"kind": "job_state", "job_id": "j1", "state": "SUCCEEDED",
+             "ts": 220},
+        ],
+        next_positions={0: 9},
+    )
+
+
+def test_lookout_store_and_queries(lookout_db):
+    from armada_tpu.lookout.queries import JobFilter, JobOrder, LookoutQueries
+
+    _lookout_world(lookout_db)
+    q = LookoutQueries(lookout_db)
+    # filters: exact / startsWith / in / annotation + order + paging
+    rows = q.get_jobs([JobFilter("queue", "qa")], JobOrder("submitted"))
+    assert [r["job_id"] for r in rows] == ["j0", "j2"]
+    rows = q.get_jobs([JobFilter("job_id", "j", "startsWith")], take=2, skip=1)
+    assert len(rows) == 2
+    rows = q.get_jobs([JobFilter("state", ["RUNNING", "SUCCEEDED"], "in")])
+    assert {r["job_id"] for r in rows} == {"j0", "j1"}
+    assert q.get_jobs([JobFilter("state", [], "in")]) == []
+    rows = q.get_jobs(
+        [JobFilter("annotation", "s1", annotation_key="armadaproject.io/stage")]
+    )
+    assert {r["job_id"] for r in rows} == {"j1", "j3"}
+    assert rows[0]["annotations"] == {"armadaproject.io/stage": "s1"}
+    # grouping with state counts (the CASE WHEN aggregate) + resource sums
+    groups = q.group_jobs("queue", aggregates=("state", "cpu_milli"))
+    by = {g["group"]: g for g in groups}
+    assert by["qa"]["count"] == 2 and by["qb"]["count"] == 2
+    assert by["qa"]["states"]["RUNNING"] == 1
+    assert by["qb"]["states"]["SUCCEEDED"] == 1
+    assert by["qa"]["cpu_milli"] == 1000 + 3000
+    # grouping BY an annotation (json expression in SELECT + GROUP BY)
+    groups = q.group_jobs(
+        "annotation", annotation_key="armadaproject.io/stage"
+    )
+    assert {g["group"]: g["count"] for g in groups} == {"s0": 2, "s1": 2}
+    # details + positions
+    det = q.get_job_details("j0")
+    assert det["runs"][0]["run_id"] == "r0"
+    assert det["runs"][0]["state"] == "RUNNING"
+    assert lookout_db.positions() == {0: 9}
+
+
+def test_lookout_views_and_prune(lookout_db):
+    from armada_tpu.lookout.queries import LookoutQueries
+
+    _lookout_world(lookout_db)
+    q = LookoutQueries(lookout_db)
+    q.save_view("mine", '{"filters":[]}', now_ns=1)
+    q.save_view("mine", '{"filters":["x"]}', now_ns=2)  # upsert
+    assert q.list_views() == [{"name": "mine", "payload": '{"filters":["x"]}'}]
+    assert q.delete_view("mine") is True
+    assert q.delete_view("mine") is False
+    # prune: j1 terminal at ts 220; cutoff beyond -> deleted with its runs
+    n = lookout_db.prune(now_ns=10**12, keep_terminal_s=0.0)
+    assert n == 1
+    assert q.get_job_details("j1") is None
+    assert q.get_job_details("j0") is not None
 
 
 def test_exactly_once_restart_resume(db):
