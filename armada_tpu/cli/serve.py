@@ -490,6 +490,8 @@ def start_control_plane(
             rest_port,
             host=bind_host,
             authenticator=authenticator,
+            lookout_queries=LookoutQueries(lookoutdb),
+            reports=reports_query,
         )
 
     # Scheduling sidecar (SURVEY §7 step 5): the round kernel as a gRPC

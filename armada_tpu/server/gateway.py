@@ -22,6 +22,12 @@ Routes (reference paths):
   GET    /v1/job-set/{queue}/{jobset}?from_idx=N
          -> NDJSON stream of JobSetEventMessage (catch-up read; the
             reference's POST /v1/job-set/{queue}/{id} stream)
+  POST   /v1/jobs/list           lookout query JSON -> job rows JSON
+  POST   /v1/jobs/groups         lookout group query JSON -> groups JSON
+  GET    /v1/job/{job_id}/details -> job details JSON (runs, errors)
+  GET    /v1/reports/job/{id} | /v1/reports/queue/{name} |
+         /v1/reports/pool[/{name}] -> scheduling-report JSON
+         (the reference's lookout REST API / queryapi + reports/server.go)
 
 Identity resolves through the same authenticator chain the gRPC transport
 uses (server/authn.py): basic / OIDC bearer / kubernetes token review /
@@ -184,6 +190,43 @@ class _Handler(BaseHTTPRequestHandler):
             _, ok = self._guard(lambda: srv.create_queue(record, principal))
             if ok:
                 self._proto(pb.Empty())
+        elif path in ("/v1/jobs/list", "/v1/jobs/groups"):
+            # lookout query surface (the reference's lookout REST API /
+            # queryapi, exposed over grpc-gateway there): body is the same
+            # query JSON the Lookout gRPC service takes.
+            if gw.lookout_queries is None:
+                self._error(404, "no lookout store behind this gateway")
+                return
+            from armada_tpu.lookout.queries import JobFilter, JobOrder
+
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                q = json.loads(
+                    (self.rfile.read(length) if length else b"{}") or b"{}"
+                )
+                if not isinstance(q, dict):
+                    raise ValueError("query body must be a JSON object")
+                filters = [JobFilter(**f) for f in q.get("filters", [])]
+                if path == "/v1/jobs/list":
+                    order = JobOrder(**q["order"]) if q.get("order") else None
+                    out = gw.lookout_queries.get_jobs(
+                        filters,
+                        order,
+                        skip=int(q.get("skip", 0)),
+                        take=int(q.get("take", 100)),
+                    )
+                else:
+                    out = gw.lookout_queries.group_jobs(
+                        q.get("group_by", "state"),
+                        filters,
+                        aggregates=tuple(q.get("aggregates", ("state",))),
+                        take=int(q.get("take", 100)),
+                        annotation_key=q.get("annotation_key", ""),
+                    )
+            except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+                self._error(400, f"bad query: {e}")
+                return
+            self._send(200, json.dumps(out).encode())
         else:
             self._error(404, f"no route {path}")
 
@@ -236,6 +279,47 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, f"queue {name!r} not found")
             else:
                 self._proto(convert.queue_to_proto(record))
+        elif path.startswith("/v1/job/") and path.endswith("/details"):
+            if gw.lookout_queries is None:
+                self._error(404, "no lookout store behind this gateway")
+                return
+            job_id = path[len("/v1/job/") : -len("/details")]
+            details = gw.lookout_queries.get_job_details(job_id)
+            if details is None:
+                self._error(404, f"job {job_id!r} not found")
+            else:
+                self._send(200, json.dumps(details).encode())
+        elif path.startswith("/v1/reports/"):
+            # scheduling-reports forensics (reports/server.go; followers
+            # proxy to the leader and surface UNAVAILABLE as retryable 503)
+            if gw.reports is None:
+                self._error(404, "no reports repository behind this gateway")
+                return
+            from armada_tpu.scheduler.reports import ReportsUnavailable
+
+            rest = path[len("/v1/reports/") :].split("/", 1)
+            kind = rest[0]
+            name = rest[1] if len(rest) > 1 else ""
+            try:
+                if kind == "job" and name:
+                    report = gw.reports.job_report(name)
+                    if report is None:
+                        self._error(404, f"no report for job {name!r}")
+                        return
+                elif kind == "queue" and name:
+                    report = gw.reports.queue_report(name)
+                elif kind == "pool":
+                    report = gw.reports.pool_report(name or None)
+                else:
+                    self._error(
+                        404, "expected /v1/reports/{job|queue}/{name} or "
+                        "/v1/reports/pool[/{name}]"
+                    )
+                    return
+            except ReportsUnavailable as e:
+                self._error(503, str(e))
+                return
+            self._send(200, json.dumps(report).encode())
         elif path.startswith("/v1/job-set/"):
             rest = path[len("/v1/job-set/") :].split("/")
             if len(rest) != 2 or not all(rest):
@@ -291,11 +375,20 @@ class RestGateway:
         port: int = 0,
         host: str = "127.0.0.1",
         authenticator=None,
+        lookout_queries=None,
+        reports=None,
     ):
+        """lookout_queries: lookout.queries.LookoutQueries -- exposes the
+        jobs query surface (the reference's lookout REST API / queryapi);
+        reports: SchedulingReportsRepository or its leader-proxying wrapper
+        -- the scheduling-reports forensics surface.  Either None = those
+        routes answer 404 (gateway without a lookout store)."""
         from armada_tpu.rpc.server import default_authenticator
 
         self.submit_server = submit_server
         self.event_api = event_api
+        self.lookout_queries = lookout_queries
+        self.reports = reports
         self.authenticator = (
             authenticator if authenticator is not None else default_authenticator()
         )

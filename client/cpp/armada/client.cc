@@ -81,6 +81,15 @@ HttpResponse Client::Request(const std::string& method, const std::string& path,
   return resp;
 }
 
+std::string Client::CallRaw(const std::string& method, const std::string& path,
+                            const std::string& body) {
+  HttpResponse resp = Request(method, path, body);
+  if (resp.status < 200 || resp.status >= 300) {
+    throw ClientError{resp.status, resp.body};
+  }
+  return resp.body;
+}
+
 std::string Client::CallJson(const std::string& method, const std::string& path,
                              const google::protobuf::Message* request) {
   std::string body;
@@ -89,11 +98,7 @@ std::string Client::CallJson(const std::string& method, const std::string& path,
         google::protobuf::util::MessageToJsonString(*request, &body);
     if (!status.ok()) throw ClientError{0, "request encode failed"};
   }
-  HttpResponse resp = Request(method, path, body);
-  if (resp.status < 200 || resp.status >= 300) {
-    throw ClientError{resp.status, resp.body};
-  }
-  return resp.body;
+  return CallRaw(method, path, body);
 }
 
 void Client::Call(const std::string& method, const std::string& path,
@@ -164,6 +169,32 @@ void Client::ReprioritizeJobs(
     const armada_tpu::api::ReprioritizeJobsRequest& request) {
   armada_tpu::api::Empty empty;
   Call("POST", "/v1/job/reprioritize", &request, &empty);
+}
+
+std::string Client::GetJobs(const std::string& query_json) {
+  return CallRaw("POST", "/v1/jobs/list", query_json);
+}
+
+std::string Client::GroupJobs(const std::string& query_json) {
+  return CallRaw("POST", "/v1/jobs/groups", query_json);
+}
+
+std::string Client::GetJobDetails(const std::string& job_id) {
+  return CallJson("GET", "/v1/job/" + job_id + "/details", nullptr);
+}
+
+std::string Client::GetJobReport(const std::string& job_id) {
+  return CallJson("GET", "/v1/reports/job/" + job_id, nullptr);
+}
+
+std::string Client::GetQueueReport(const std::string& queue) {
+  return CallJson("GET", "/v1/reports/queue/" + queue, nullptr);
+}
+
+std::string Client::GetPoolReport(const std::string& pool) {
+  return CallJson(
+      "GET", pool.empty() ? "/v1/reports/pool" : "/v1/reports/pool/" + pool,
+      nullptr);
 }
 
 std::vector<armada_tpu::api::JobSetEventMessage> Client::GetJobSetEvents(

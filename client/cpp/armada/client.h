@@ -55,6 +55,18 @@ class Client {
   std::vector<armada_tpu::api::JobSetEventMessage> GetJobSetEvents(
       const std::string& queue, const std::string& jobset, long from_idx = 0);
 
+  // --- lookout + scheduling reports ----------------------------------------
+  // The query surfaces (reference lookout REST API / queryapi + scheduling
+  // reports, internal/scheduler/reports/server.go).  Queries and results
+  // are the gateway's JSON shapes (docs/clients.md); returned verbatim so
+  // callers pick their own JSON library.
+  std::string GetJobs(const std::string& query_json);       // rows array
+  std::string GroupJobs(const std::string& query_json);     // groups array
+  std::string GetJobDetails(const std::string& job_id);     // object
+  std::string GetJobReport(const std::string& job_id);      // object
+  std::string GetQueueReport(const std::string& queue);     // array
+  std::string GetPoolReport(const std::string& pool = "");  // object
+
   // Identity headers (x-armada-principal / x-armada-groups).
   void SetPrincipal(std::string principal, std::string groups = "") {
     principal_ = std::move(principal);
@@ -64,6 +76,9 @@ class Client {
  private:
   HttpResponse Request(const std::string& method, const std::string& path,
                        const std::string& body);
+  // Request + non-2xx -> ClientError; returns the raw response body.
+  std::string CallRaw(const std::string& method, const std::string& path,
+                      const std::string& body);
   std::string CallJson(const std::string& method, const std::string& path,
                        const google::protobuf::Message* request);
   void Call(const std::string& method, const std::string& path,

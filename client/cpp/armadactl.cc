@@ -6,6 +6,10 @@
 //   armadactl-cpp HOST PORT submit QUEUE JOBSET CPU MEMORY [N]
 //   armadactl-cpp HOST PORT cancel QUEUE JOBSET JOB_ID
 //   armadactl-cpp HOST PORT events QUEUE JOBSET        (prints one kind/line)
+//   armadactl-cpp HOST PORT jobs QUEUE                 (lookout rows JSON)
+//   armadactl-cpp HOST PORT describe-job JOB_ID        (details JSON)
+//   armadactl-cpp HOST PORT queue-report QUEUE         (scheduling report)
+//   armadactl-cpp HOST PORT job-report JOB_ID
 
 #include <cstdio>
 #include <cstdlib>
@@ -67,6 +71,24 @@ int main(int argc, char** argv) {
                       field ? field->name().c_str() : "?");
         }
       }
+    } else if (verb == "jobs" && argc >= 5) {
+      // lookout query surface: filter by queue, results as raw JSON
+      // (escape the argument -- raw interpolation would let quotes in a
+      // queue name malform or alter the query)
+      std::string escaped;
+      for (char c : std::string(argv[4])) {
+        if (c == '"' || c == '\\') escaped += '\\';
+        escaped += c;
+      }
+      std::string q = std::string("{\"filters\":[{\"field\":\"queue\",") +
+                      "\"value\":\"" + escaped + "\"}]}";
+      std::printf("%s\n", client.GetJobs(q).c_str());
+    } else if (verb == "describe-job" && argc >= 5) {
+      std::printf("%s\n", client.GetJobDetails(argv[4]).c_str());
+    } else if (verb == "queue-report" && argc >= 5) {
+      std::printf("%s\n", client.GetQueueReport(argv[4]).c_str());
+    } else if (verb == "job-report" && argc >= 5) {
+      std::printf("%s\n", client.GetJobReport(argv[4]).c_str());
     } else {
       std::fprintf(stderr, "unknown verb %s\n", verb.c_str());
       return 2;
