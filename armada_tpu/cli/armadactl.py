@@ -658,6 +658,7 @@ def cmd_executor(args):
             interval_s=args.interval,
             default_runtime_s=args.default_runtime,
             binoculars_port=args.binoculars_port,
+            cordon_labels=dict(args.cordon_label or ()),
             metrics_port=args.metrics_port,
             kubernetes_url=args.kubernetes,
             kubernetes_in_cluster=args.in_cluster,
@@ -674,11 +675,38 @@ def cmd_executor(args):
     return 0
 
 
+def _key_value(arg: str) -> tuple:
+    """argparse type for KEY=VALUE flags: a clean usage error, not a
+    traceback, when '=' is missing."""
+    key, sep, value = arg.partition("=")
+    if not sep or not key:
+        raise argparse.ArgumentTypeError(f"expected KEY=VALUE, got {arg!r}")
+    return key, value
+
+
 def with_closed(client, fn):
     try:
         return fn(client)
     finally:
         client.close()
+
+
+def cmd_version(args):
+    """Version information (the reference's armadactl version,
+    internal/armadactl/version.go: version + runtime)."""
+    import platform
+
+    import armada_tpu
+
+    print(f"armadactl-tpu version:\t{armada_tpu.__version__}")
+    print(f"Python version:\t{platform.python_version()}")
+    try:
+        import jax
+
+        print(f"JAX version:\t{jax.__version__}")
+    except ImportError:
+        pass
+    return 0
 
 
 # --- wiring ------------------------------------------------------------------
@@ -762,6 +790,9 @@ def build_parser() -> argparse.ArgumentParser:
     dj = sub.add_parser("describe-job", help="full job details incl. runs")
     dj.add_argument("job_id")
     dj.set_defaults(fn=cmd_describe_job)
+
+    v = sub.add_parser("version", help="print version information")
+    v.set_defaults(fn=cmd_version)
 
     srv = sub.add_parser("serve", help="run the control plane")
     srv.add_argument(
@@ -886,6 +917,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ex.add_argument(
         "--binoculars-port", type=int, help="host a logs/cordon service on this port"
+    )
+    ex.add_argument(
+        "--cordon-label",
+        action="append",
+        type=_key_value,
+        metavar="KEY=VALUE",
+        help="audit label applied on every cordon; <user> in key/value "
+        "templates to the caller's principal (binoculars cordon.go "
+        "AdditionalLabels; repeatable)"
     )
     ex.add_argument(
         "--metrics-port",

@@ -544,6 +544,7 @@ def run_fake_executor(
     config: Optional[SchedulingConfig] = None,
     default_runtime_s: float = 10.0,
     binoculars_port: Optional[int] = None,
+    cordon_labels: Optional[dict] = None,
     metrics_port: Optional[int] = None,
     kubernetes_url: Optional[str] = None,
     kubernetes_in_cluster: bool = False,
@@ -650,7 +651,8 @@ def run_fake_executor(
         from armada_tpu.rpc.server import make_server
 
         binoculars_server, bport = make_server(
-            binoculars=Binoculars(cluster), address=f"127.0.0.1:{binoculars_port}"
+            binoculars=Binoculars(cluster, cordon_labels=cordon_labels),
+            address=f"127.0.0.1:{binoculars_port}",
         )
         print(f"binoculars (logs/cordon) on 127.0.0.1:{bport}")
     metrics = None

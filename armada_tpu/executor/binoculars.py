@@ -15,8 +15,17 @@ from armada_tpu.executor.cluster import ClusterContext
 
 
 class Binoculars:
-    def __init__(self, cluster: ClusterContext):
+    def __init__(
+        self,
+        cluster: ClusterContext,
+        cordon_labels: Optional[dict] = None,
+    ):
+        """cordon_labels: audit labels applied with every cordon, with
+        `<user>` in keys/values replaced by the caller's principal (the
+        reference's CordonConfiguration.AdditionalLabels + templateLabels,
+        cordon.go:23,63-71)."""
         self._cluster = cluster
+        self._cordon_labels = dict(cordon_labels or {})
 
     def logs(self, job_id: str = "", run_id: str = "") -> str:
         """Log text of the job's (latest) pod; raises KeyError if unknown."""
@@ -29,5 +38,14 @@ class Binoculars:
             raise KeyError(f"no pod for job {job_id}")
         return self._cluster.pod_logs(pods[-1].run_id)
 
-    def cordon(self, node_id: str, cordoned: bool = True) -> None:
-        self._cluster.cordon_node(node_id, cordoned)
+    def cordon(
+        self, node_id: str, cordoned: bool = True, user: str = ""
+    ) -> None:
+        labels = {
+            k.replace("<user>", user): v.replace("<user>", user)
+            for k, v in self._cordon_labels.items()
+        }
+        if labels and cordoned:
+            self._cluster.cordon_node(node_id, cordoned, labels=labels)
+        else:
+            self._cluster.cordon_node(node_id, cordoned)

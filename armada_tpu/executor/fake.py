@@ -249,12 +249,19 @@ class FakeClusterContext:
             raise KeyError(f"no pod for run {run_id}")
         return "\n".join(pod.log)
 
-    def cordon_node(self, node_id: str, cordoned: bool = True) -> None:
-        """Mark a node (un)schedulable (binoculars cordon.go); the change
-        propagates to the scheduler with the next snapshot."""
+    def cordon_node(
+        self, node_id: str, cordoned: bool = True, labels: Optional[dict] = None
+    ) -> None:
+        """Mark a node (un)schedulable + merge audit labels (binoculars
+        cordon.go strategic-merge patch); the change propagates to the
+        scheduler with the next snapshot."""
         import dataclasses as _dc
 
         node = self._nodes.get(node_id)
         if node is None:
             raise KeyError(f"unknown node {node_id}")
-        self._nodes[node_id] = _dc.replace(node, unschedulable=cordoned)
+        merged = dict(node.labels)
+        merged.update(labels or {})
+        self._nodes[node_id] = _dc.replace(
+            node, unschedulable=cordoned, labels=merged
+        )

@@ -24,6 +24,7 @@ import ssl
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Optional, Sequence
 
@@ -164,12 +165,19 @@ class KubernetesClusterContext:
 
     # --- http ----------------------------------------------------------------
 
-    def _request(self, method: str, path: str, body=None, raw: bool = False):
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body=None,
+        raw: bool = False,
+        content_type: str = "application/json",
+    ):
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         if body is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
         try:
@@ -703,6 +711,41 @@ class KubernetesClusterContext:
                 )
             )
         return nodes
+
+    def cordon_node(
+        self, node_id: str, cordoned: bool = True, labels: Optional[dict] = None
+    ) -> None:
+        """Patch node schedulability (+ audit labels) -- the reference's
+        binoculars cordon (internal/binoculars/service/cordon.go:47-90:
+        strategic-merge patch of spec.unschedulable and
+        metadata.labels)."""
+        name = node_id
+        if self.node_id_label:
+            # node ids may come from a label, not the k8s object name: a
+            # labelSelector query fetches at most the one match (never the
+            # multi-MB full node list of a large cluster)
+            selector = urllib.parse.quote(f"{self.node_id_label}={node_id}")
+            items = self._request(
+                "GET", f"/api/v1/nodes?labelSelector={selector}"
+            ).get("items", [])
+            if items:
+                name = items[0]["metadata"]["name"]
+        patch: dict = {"spec": {"unschedulable": bool(cordoned)}}
+        if labels:
+            patch["metadata"] = {"labels": dict(labels)}
+        try:
+            self._request(
+                "PATCH",
+                f"/api/v1/nodes/{name}",
+                patch,
+                content_type="application/strategic-merge-patch+json",
+            )
+        except KubeApiError as e:
+            if e.status == 404:
+                # contract shared with the fake context + Binoculars.logs:
+                # unknown ids raise KeyError -> gRPC NOT_FOUND
+                raise KeyError(f"unknown node {node_id}") from e
+            raise
 
     # --- binoculars (logs.go:39-43) ------------------------------------------
 

@@ -320,9 +320,12 @@ class _ReportsService:
 class _BinocularsService:
     """Logs + Cordon next to the cluster (internal/binoculars)."""
 
-    def __init__(self, binoculars, auth):
+    def __init__(self, binoculars, auth, authorizer=None):
+        from armada_tpu.server.auth import ActionAuthorizer
+
         self._b = binoculars
         self._auth = auth
+        self._authz = authorizer or ActionAuthorizer()
 
     def Logs(self, request, context):
         _authenticate(self._auth, context)
@@ -333,9 +336,21 @@ class _BinocularsService:
         return pb.LogsResponse(log=text)
 
     def Cordon(self, request, context):
-        _authenticate(self._auth, context)
+        # Cordon is a privileged node mutation: the reference gates it on
+        # the CordonNodes permission (cordon.go:48-51 -> PermissionDenied).
+        from armada_tpu.server.auth import AuthorizationError, Permission
+
+        principal = _authenticate(self._auth, context)
         try:
-            self._b.cordon(request.node_id, cordoned=not request.uncordon)
+            self._authz.authorize_action(principal, Permission.CORDON_NODES)
+        except AuthorizationError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+        try:
+            self._b.cordon(
+                request.node_id,
+                cordoned=not request.uncordon,
+                user=principal.name,
+            )
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         return pb.Empty()
@@ -574,6 +589,7 @@ def make_server(
     lookout_queries=None,
     reports=None,
     binoculars=None,
+    binoculars_authorizer=None,
     control_plane=None,
     schedule_sidecar=None,
     replication_log=None,
@@ -645,7 +661,7 @@ def make_server(
             )
         )
     if binoculars is not None:
-        bsvc = _BinocularsService(binoculars, auth)
+        bsvc = _BinocularsService(binoculars, auth, binoculars_authorizer)
         handlers.append(
             grpc.method_handlers_generic_handler(
                 "armada_tpu.api.Binoculars",

@@ -125,7 +125,17 @@ class FakeKubeApi:
                         self._json(200, lease)
                     return
                 if parsed.path == "/api/v1/nodes":
-                    self._json(200, {"items": list(api.nodes)})
+                    items = list(api.nodes)
+                    qs = parse_qs(parsed.query)
+                    for sel in qs.get("labelSelector", []):
+                        for term in sel.split(","):
+                            k, _, v = term.partition("=")
+                            items = [
+                                n
+                                for n in items
+                                if n["metadata"].get("labels", {}).get(k) == v
+                            ]
+                    self._json(200, {"items": items})
                 elif parsed.path == "/api/v1/pods":
                     with api.lock:
                         pods = list(api.pods.values())
@@ -240,6 +250,40 @@ class FakeKubeApi:
                         body["metadata"]["resourceVersion"] = str(api._rv)
                         api.leases[lk] = body
                     self._json(200, body)
+                    return
+                self._json(404, {"message": "not found"})
+
+            def do_PATCH(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                api.requests.append(("PATCH", parsed.path))
+                parts = parsed.path.strip("/").split("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length)) if length else {}
+                # strategic-merge node patch (cordon: spec.unschedulable +
+                # metadata.labels)
+                if len(parts) == 4 and parts[2] == "nodes":
+                    name = parts[3]
+                    with api.lock:
+                        node = next(
+                            (
+                                n
+                                for n in api.nodes
+                                if n["metadata"]["name"] == name
+                            ),
+                            None,
+                        )
+                        if node is None:
+                            self._json(404, {"message": "not found"})
+                            return
+                        if "unschedulable" in body.get("spec", {}):
+                            node.setdefault("spec", {})["unschedulable"] = (
+                                body["spec"]["unschedulable"]
+                            )
+                        for k, v in (
+                            body.get("metadata", {}).get("labels", {}).items()
+                        ):
+                            node["metadata"].setdefault("labels", {})[k] = v
+                    self._json(200, node)
                     return
                 self._json(404, {"message": "not found"})
 

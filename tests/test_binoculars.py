@@ -89,3 +89,82 @@ def test_cordon_stops_new_placements(stack):
 
     with pytest.raises(grpc.RpcError):
         client.cordon("no-such-node")
+
+
+def test_cordon_audit_labels_template_user(tmp_path):
+    """Configured cordon labels land on the node with `<user>` templated to
+    the authenticated principal (cordon.go AdditionalLabels +
+    templateLabels:63-71); uncordon does not re-apply them."""
+    cp = ControlPlane.build(tmp_path)
+    cluster = cp.executors[0].cluster
+    server, port = make_server(
+        binoculars=Binoculars(
+            cluster,
+            cordon_labels={"armadaproject.io/cordoned-by": "<user>"},
+        )
+    )
+    client = BinocularsClient(f"127.0.0.1:{port}", principal="ops-alice")
+    try:
+        node_id = cluster.node_specs()[0].id
+        client.cordon(node_id)
+        node = next(n for n in cluster.node_specs() if n.id == node_id)
+        assert node.unschedulable
+        assert node.labels["armadaproject.io/cordoned-by"] == "ops-alice"
+        client.uncordon(node_id)
+        node = next(n for n in cluster.node_specs() if n.id == node_id)
+        assert not node.unschedulable
+    finally:
+        client.close()
+        server.stop(None)
+        cp.close()
+
+
+def test_cordon_requires_permission(tmp_path):
+    """A closed authorizer rejects cordon for principals lacking
+    CORDON_NODES (cordon.go:48-51 -> PermissionDenied) and admits one that
+    has it."""
+    from armada_tpu.server.auth import ActionAuthorizer, Permission, Principal
+    from armada_tpu.server.authn import MultiAuthenticator
+
+    class _Static:
+        def __init__(self, principal):
+            self._p = principal
+
+        def authenticate(self, meta):
+            return self._p
+
+    cp = ControlPlane.build(tmp_path)
+    cluster = cp.executors[0].cluster
+    node_id = cluster.node_specs()[0].id
+
+    def serve_as(principal):
+        return make_server(
+            binoculars=Binoculars(cluster),
+            binoculars_authorizer=ActionAuthorizer(open_by_default=False),
+            authenticator=MultiAuthenticator([_Static(principal)]),
+        )
+
+    server, port = serve_as(Principal(name="nobody"))
+    client = BinocularsClient(f"127.0.0.1:{port}")
+    try:
+        with pytest.raises(grpc.RpcError) as e:
+            client.cordon(node_id)
+        assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+    finally:
+        client.close()
+        server.stop(None)
+    server, port = serve_as(
+        Principal(
+            name="ops", permissions=frozenset({Permission.CORDON_NODES})
+        )
+    )
+    client = BinocularsClient(f"127.0.0.1:{port}")
+    try:
+        client.cordon(node_id)
+        assert next(
+            n for n in cluster.node_specs() if n.id == node_id
+        ).unschedulable
+    finally:
+        client.close()
+        server.stop(None)
+        cp.close()

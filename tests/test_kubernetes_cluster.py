@@ -264,3 +264,21 @@ def test_queue_usage_scrapes_pod_requests(kube, ctx):
     # qa: one pending + one running pod, 2 cpu each; qb's pod is terminal
     assert usage["qa"][cpu_i] == 2 * parse_quantity("2")
     assert "qb" not in usage
+
+
+def test_cordon_node_patches_unschedulable_and_labels(kube, ctx):
+    """cordon_node issues the reference's strategic-merge node patch
+    (binoculars cordon.go:47-90): spec.unschedulable plus audit labels."""
+    kube.add_node("worker-1")
+    ctx.cordon_node(
+        "worker-1", labels={"armadaproject.io/cordoned-by": "ops"}
+    )
+    (n,) = ctx.node_specs()
+    assert n.unschedulable
+    assert n.labels["armadaproject.io/cordoned-by"] == "ops"
+    ctx.cordon_node("worker-1", cordoned=False)
+    (n,) = ctx.node_specs()
+    assert not n.unschedulable
+    # labels persist as the audit trail (reference keeps them too)
+    assert n.labels["armadaproject.io/cordoned-by"] == "ops"
+    assert ("PATCH", "/api/v1/nodes/worker-1") in kube.requests
