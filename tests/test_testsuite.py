@@ -1,5 +1,6 @@
 """Testsuite runner + load tester against a live in-process topology."""
 
+import os
 import threading
 
 import pytest
@@ -105,3 +106,24 @@ def test_load_test_cli(topo, capsys):
     assert rc == 0, out
     assert "submitted 50 jobs" in out
     assert "50 succeeded, 0 failed" in out
+
+
+@pytest.mark.skipif(
+    os.environ.get("ARMADA_PERF_TESTSUITE") != "1",
+    reason="perf tier: set ARMADA_PERF_TESTSUITE=1 (reference testcases/performance)",
+)
+def test_performance_specs_run_to_completion(topo, capsys):
+    """The reference's performance tier (submit_1x1K / submit_10x100):
+    1000 jobs per spec through the full stack, with the runner's per-event
+    latency summary as the measurement."""
+    rc = main(
+        [
+            "--url",
+            f"127.0.0.1:{topo.port}",
+            "testsuite",
+            "testdata/testsuite/performance",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "submit-1x1K" in out and "PASS" in out
