@@ -156,3 +156,12 @@ def test_serve_restart_recovers_state(tmp_path, capsys):
         assert kinds.count("submit_job") == 1
     finally:
         p2.stop()
+
+
+def test_version_verb(capsys):
+    """armadactl version (the reference's version.go)."""
+    from armada_tpu.cli.armadactl import main
+
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "armadactl-tpu version" in out and "Python version" in out

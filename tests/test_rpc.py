@@ -241,3 +241,65 @@ def test_gateway_malformed_body_is_a_400():
             assert e.code == 400
     finally:
         gw.stop()
+
+
+def test_gateway_jobs_query_bad_bodies_are_400():
+    """POST /v1/jobs/list with valid-JSON non-object bodies (list, null,
+    scalar) must answer 400, never drop the connection; without a lookout
+    store the route is a clean 404."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from armada_tpu.lookout import LookoutDb, LookoutQueries
+    from armada_tpu.server.gateway import RestGateway
+
+    class _StubServer:
+        pass
+
+    db = LookoutDb(":memory:")
+    gw = RestGateway(
+        _StubServer(), _StubServer(), port=0,
+        lookout_queries=LookoutQueries(db),
+    )
+    try:
+        for body in (b"[]", b"null", b'"x"', b"42"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/jobs/list",
+                method="POST",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError(f"expected 400 for body {body!r}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, (body, e.code)
+        # a well-formed query against the empty store answers []
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/v1/jobs/list",
+            method="POST",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert json.loads(resp.read()) == []
+    finally:
+        gw.stop()
+        db.close()
+    # no lookout store behind the gateway: 404, not a crash
+    gw = RestGateway(_StubServer(), _StubServer(), port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/v1/jobs/list",
+            method="POST",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        gw.stop()
