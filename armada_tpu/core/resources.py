@@ -102,6 +102,12 @@ class ResourceListFactory:
             raise ValueError("resolutions must match names")
         if any(r <= 0 for r in self.resolutions):
             raise ValueError(f"resolutions must be positive: {self.resolutions}")
+        # name -> axis position; tuple.index is an O(R) scan and the proto
+        # conversion path resolves names per resource per job (frozen
+        # dataclass, so the cache rides object.__setattr__)
+        object.__setattr__(
+            self, "index_map", {n: i for i, n in enumerate(self.names)}
+        )
 
     @staticmethod
     def from_config(resource_types: Sequence[tuple[str, "str | int"]]) -> "ResourceListFactory":
@@ -114,16 +120,20 @@ class ResourceListFactory:
         return len(self.names)
 
     def index_of(self, name: str) -> int:
-        return self.names.index(name)
+        idx = self.index_map.get(name)
+        if idx is None:  # keep tuple.index's exception type
+            raise ValueError(f"unknown resource name: {name!r}")
+        return idx
 
     def from_mapping(self, quantities: Mapping[str, "str | int | float"]) -> "ResourceList":
         vec = np.zeros(len(self.names), dtype=np.int64)
         for name, q in quantities.items():
-            if name not in self.names:
+            idx = self.index_map.get(name)
+            if idx is None:
                 # Unsupported resources are dropped, as in the reference factory
                 # (resource_list_factory.go FromJobResourceListIgnoreUnknown).
                 continue
-            vec[self.index_of(name)] = parse_quantity(q)
+            vec[idx] = parse_quantity(q)
         return ResourceList(self, vec)
 
     def zero(self) -> "ResourceList":

@@ -1,13 +1,16 @@
 """Event schema (protobuf) + conversion helpers.
 
 `events_pb2` is regenerated from events.proto with protoc when the .proto is
-newer than the generated module (protoc is part of the baked toolchain).
+newer than the generated module (protoc is part of the baked toolchain; when
+the binary is absent, the pure-python subset compiler in `_minigen` produces
+an equivalent module).
 """
 
 from __future__ import annotations
 
 import fcntl
 import os
+import shutil
 import subprocess
 import tempfile
 
@@ -22,11 +25,22 @@ if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN)
         fcntl.flock(_lockf, fcntl.LOCK_EX)
         if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN):
             with tempfile.TemporaryDirectory(dir=_HERE) as _tmp:
-                subprocess.run(
-                    ["protoc", "-I", _HERE, f"--python_out={_tmp}", _PROTO],
-                    check=True,
-                )
-                os.replace(os.path.join(_tmp, "events_pb2.py"), _GEN)
+                _tmp_gen = os.path.join(_tmp, "events_pb2.py")
+                if shutil.which("protoc"):
+                    subprocess.run(
+                        ["protoc", "-I", _HERE, f"--python_out={_tmp}", _PROTO],
+                        check=True,
+                    )
+                else:
+                    from armada_tpu.events import _minigen
+
+                    with open(_tmp_gen, "w") as _f:
+                        _f.write(
+                            _minigen.generate_pb2_source(
+                                _PROTO, "events.proto", "events_pb2"
+                            )
+                        )
+                os.replace(_tmp_gen, _GEN)
 
 from armada_tpu.events import events_pb2  # noqa: E402
 

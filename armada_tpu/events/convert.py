@@ -28,9 +28,16 @@ def resources_from_proto(
 ) -> ResourceList:
     rl = factory.zero()
     atoms = rl.atoms
-    for name, milli in msg.milli.items():
-        if name in factory.names:
-            atoms[factory.index_of(name)] = milli
+    idx_of = factory.index_map.get
+    # Key iteration + __getitem__ stay on the native map container;
+    # `.items()` routes through the MutableMapping ABC machinery, which is
+    # most of this function's cost on the sidecar's per-cycle 1k-submit
+    # conversion path.
+    milli = msg.milli
+    for name in milli:
+        idx = idx_of(name)
+        if idx is not None:
+            atoms[idx] = milli[name]
     return rl
 
 
@@ -77,6 +84,10 @@ def job_spec_from_proto(
     factory: ResourceListFactory,
     submit_time: float = 0.0,
 ) -> JobSpec:
+    # The collection fields are empty on the vast majority of jobs crossing
+    # the sidecar boundary; len()-guarding skips the per-field container ->
+    # dict/tuple conversion machinery (~a third of the conversion cost on
+    # the per-cycle 1k-submit batch).
     return JobSpec(
         id=job_id,
         queue=queue,
@@ -85,19 +96,21 @@ def job_spec_from_proto(
         priority=int(msg.priority),
         submit_time=submit_time,
         resources=resources_from_proto(msg.resources, factory),
-        node_selector=dict(msg.node_selector),
+        node_selector=dict(msg.node_selector) if len(msg.node_selector) else {},
         tolerations=tuple(
             Toleration(key=t.key, operator=t.operator or "Equal", value=t.value, effect=t.effect)
             for t in msg.tolerations
-        ),
+        )
+        if len(msg.tolerations)
+        else (),
         gang_id=msg.gang_id,
         gang_cardinality=int(msg.gang_cardinality) or 1,
         gang_node_uniformity_label=msg.gang_node_uniformity_label,
-        pools=tuple(msg.pools),
+        pools=tuple(msg.pools) if len(msg.pools) else (),
         price_band=msg.price_band,
         namespace=msg.namespace or "default",
-        annotations=dict(msg.annotations),
-        labels=dict(msg.labels),
+        annotations=dict(msg.annotations) if len(msg.annotations) else {},
+        labels=dict(msg.labels) if len(msg.labels) else {},
         services=tuple(
             ServiceSpec(
                 type=sv.type or "NodePort",
@@ -105,7 +118,9 @@ def job_spec_from_proto(
                 name=sv.name,
             )
             for sv in msg.services
-        ),
+        )
+        if len(msg.services)
+        else (),
         ingress=tuple(
             IngressSpec(
                 ports=tuple(int(x) for x in ig.ports),
@@ -115,5 +130,7 @@ def job_spec_from_proto(
                 use_cluster_ip=ig.use_cluster_ip,
             )
             for ig in msg.ingress
-        ),
+        )
+        if len(msg.ingress)
+        else (),
     )

@@ -37,6 +37,12 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 PROTOCOL_VERSION = 196608  # 3.0
 
+# Per-recv bound during startup/TLS/auth, which a healthy server answers in
+# milliseconds.  Query-path reads use the DSN's socket_timeout (default 300s:
+# long server-side scans are legitimate; a hung SERVER is caught by
+# keepalive + this bound on the next connect).
+_AUTH_TIMEOUT_S = 60.0
+
 # type OIDs (pg_type.dat)
 OID_BOOL = 16
 OID_BYTEA = 17
@@ -139,7 +145,7 @@ def parse_dsn(dsn: str) -> dict:
         "sslmode": sslmode,
         "sslrootcert": opts.get("sslrootcert", ""),
         "connect_timeout": float(opts.get("connect_timeout", 10.0)),
-        "socket_timeout": float(opts.get("socket_timeout", 60.0)),
+        "socket_timeout": float(opts.get("socket_timeout", 300.0)),
     }
 
 
@@ -251,8 +257,12 @@ class PgConnection:
         # not block forever -- the caller holds SchedulerDb's lock, so an
         # unbounded recv would wedge the whole control plane.  The timeout
         # is per recv/send call (bytes flowing reset it); keepalive kills
-        # truly dead sessions under long idle.
-        self._sock.settimeout(p["socket_timeout"])
+        # truly dead sessions under long idle.  Startup/auth answers in
+        # milliseconds on a healthy server, so it gets a tight 60s bound;
+        # the QUERY path gets the (configurable) 300s default -- a legit
+        # server-side scan that stays silent past 60s used to drop the
+        # session and loop the ingestion batch.
+        self._sock.settimeout(min(p["socket_timeout"], _AUTH_TIMEOUT_S))
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
         # The extended protocol sends several tiny messages per statement
         # and the server answers nothing until Sync: with Nagle on, each
@@ -270,6 +280,7 @@ class PgConnection:
         self.parameters: dict[str, str] = {}
         self.txn_status = b"I"
         self._startup()
+        self._sock.settimeout(p["socket_timeout"])
 
     @staticmethod
     def _negotiate_tls(

@@ -31,8 +31,27 @@ _OR_IGNORE = re.compile(r"INSERT OR IGNORE INTO", re.IGNORECASE)
 _JSON_EXTRACT = re.compile(r"""json_extract\((\w+), '\$\."([^"']+)"'\)""")
 
 
+_QUOTED_LITERAL = re.compile(r"'(?:[^']|'')*'|\"[^\"]*\"")
+
+
+class SqlDialectError(ValueError):
+    """A statement the adapter refuses to translate or classify.  This is a
+    SERVER-side defect (a query shape the adapter doesn't cover), not bad
+    client input -- HTTP surfaces must map it to 500, not 400."""
+
+
 def sqlite_to_pg(sql: str) -> str:
     """Translate one SQLite-dialect statement to PostgreSQL."""
+    # The blanket `?` -> `$n` substitution below cannot tell a placeholder
+    # from a literal question mark.  No current repository statement embeds
+    # one, so refuse any that does -- silently renumbering every later
+    # placeholder would bind parameters to the wrong columns.
+    for m in _QUOTED_LITERAL.finditer(sql):
+        if "?" in m.group(0):
+            raise SqlDialectError(
+                f"'?' inside a quoted literal defeats placeholder "
+                f"numbering; rewrite the statement to bind it: {sql!r}"
+            )
     counter = [0]
 
     def num(_m):
@@ -123,10 +142,43 @@ class PgAdapter:
             out = self._translated[sql] = sqlite_to_pg(sql)
         return out
 
-    @staticmethod
-    def _is_write(sql: str) -> bool:
-        head = sql.lstrip()[:6].upper()
-        return not head.startswith("SELECT")
+    # Read shapes never lazy-BEGIN: a txn opened for a pure read would sit
+    # idle-in-transaction until the next commit() and block PG vacuum.
+    # WITH is deliberately ABSENT from both lists: PostgreSQL allows
+    # data-modifying CTEs (WITH ... DELETE/INSERT ... RETURNING), so the
+    # leading verb alone cannot classify it -- it falls through to the loud
+    # SqlDialectError below until a real statement needs it.
+    _READ_PREFIXES = ("SELECT", "EXPLAIN", "VALUES", "SHOW", "TABLE")
+    _WRITE_PREFIXES = (
+        "INSERT",
+        "UPDATE",
+        "DELETE",
+        "REPLACE",
+        "CREATE",
+        "DROP",
+        "ALTER",
+        "TRUNCATE",
+        "BEGIN",
+        "COMMIT",
+        "ROLLBACK",
+        "SET",
+        "GRANT",
+        "REVOKE",
+        "VACUUM",
+        "ANALYZE",
+        "COPY",
+    )
+
+    @classmethod
+    def _is_write(cls, sql: str) -> bool:
+        head = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
+        if head.startswith(cls._READ_PREFIXES):
+            return False
+        if head.startswith(cls._WRITE_PREFIXES):
+            return True
+        # Unknown verb: fail loudly rather than guess.  Treating it as a
+        # write would silently wrap a future read shape in a lazy txn.
+        raise SqlDialectError(f"unclassified SQL statement prefix: {head!r}")
 
     def _maybe_begin(self, sql: str) -> None:
         if not self._in_txn and self._is_write(sql):

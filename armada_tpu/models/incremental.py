@@ -77,6 +77,23 @@ class _SortedTable:
     shift positions, and rebuilding a 1M-entry dict per cycle would cost the
     second the whole design is buying back.  Removal tombstones via `alive`;
     compaction runs when tombstones pass 25%.
+
+    LSM layout (the round-6 O(delta) rework): physical rows [0, sorted_n)
+    are the sorted BASE; rows [sorted_n, n) are the OVERLAY -- recent
+    inserts kept in the same key order among themselves, with ``ov_pos[j]``
+    = the base slot row ``sorted_n + j`` sorts before (its searchsorted-left
+    position, computed once at insert time).  Every column is ONE plain
+    ndarray over the whole [0, n) space (with geometric slack capacity), so
+    consumers keep gathering/scalar-indexing rows directly; only ORDER needs
+    the two-region interleave, which ``live_rows()`` produces in O(live).
+    ``insert_batch`` therefore costs O(batch·log n + overlay) -- not the
+    full-table np.insert per column (~130MB of memcpy per 1k-row batch at
+    1M rows, the dominant host cost of the sidecar's steady cycle) -- and
+    the overlay folds into the base only when it exceeds
+    ``max(2048, sorted_n // 16)`` rows: one vectorized merge per ~16 cycles,
+    amortized O(delta) per cycle.  ``copied_rows`` counts every full-width
+    row the table copies (merge/compact/growth) so tests can pin the
+    amortized bound without timing.
     """
 
     _SORT_COLS = ("qi", "npc", "prio", "sub", "ids")
@@ -90,8 +107,10 @@ class _SortedTable:
         with_atoms: bool = False,
     ):
         self.R = num_resources
-        self.n = 0
+        self.n = 0  # total physical rows: base + overlay
+        self.sorted_n = 0  # rows [0, sorted_n) are the sorted base
         self.dead = 0
+        self.cap = cap
         assert sort_cols[0] == "qi" and sort_cols[-1] == "ids"
         self.sort_cols = tuple(sort_cols)
         self.ids = np.zeros((cap,), _ID_DTYPE)
@@ -110,24 +129,47 @@ class _SortedTable:
         self.atoms: Optional[np.ndarray] = (
             np.zeros((cap, num_resources), np.int64) if with_atoms else None
         )
+        # overlay row j (physical row sorted_n + j) belongs at base slot
+        # ov_pos[j]; non-decreasing because the overlay is key-sorted
+        self.ov_pos = np.zeros((0,), np.int64)
         # id -> sort_cols[:-1] column values: enough to re-find the row by
         # binary search; also the membership test.
         self.key_of_id: dict[bytes, tuple] = {}
+        # full-width rows copied by merges/compactions/growth (test guard)
+        self.copied_rows = 0
+        self._live_cache: Optional[np.ndarray] = None
 
     def _cols(self):
         return ("ids", "qi", "npc", "prio", "sub", "alive") + self._extra
 
+    def _mat_cols(self):
+        """All physical arrays, matrix columns included."""
+        cols = [getattr(self, c) for c in self._cols()]
+        cols.append(self.req)
+        if self.atoms is not None:
+            cols.append(self.atoms)
+        return cols
+
     def __contains__(self, jid: bytes) -> bool:
         return jid in self.key_of_id
 
-    def _locate(self, jid: bytes) -> Optional[int]:
-        key = self.key_of_id.get(jid)
-        if key is None:
-            return None
-        lo, hi = 0, self.n
+    def _ensure_cap(self, need: int) -> None:
+        if need <= self.cap:
+            return
+        new_cap = max(need, self.cap * 2, 1024)
+        for c in self._cols():
+            setattr(self, c, _grow(getattr(self, c), new_cap))
+        self.req = _grow(self.req, new_cap)
+        if self.atoms is not None:
+            self.atoms = _grow(self.atoms, new_cap)
+        self.copied_rows += self.n
+        self.cap = new_cap
+
+    def _find_in_region(self, rlo: int, rhi: int, key: tuple) -> Optional[int]:
+        """Live row with this full key (sort key + id) in [rlo, rhi)."""
+        lo, hi = rlo, rhi
         for col, v in zip(
-            [getattr(self, c) for c in self.sort_cols],
-            key + (jid,),
+            [getattr(self, c) for c in self.sort_cols], key
         ):
             a = col[lo:hi]
             # The probe MUST match the column dtype: searchsorted with e.g. a
@@ -145,16 +187,15 @@ class _SortedTable:
                 return row
         return None
 
-    def _position(self, row: Mapping) -> int:
-        lo, hi = 0, self.n
-        for c in self.sort_cols:
-            col, v = getattr(self, c), row[c]
-            a = col[lo:hi]
-            v = a.dtype.type(v)  # see _locate: dtype mismatch copies the column
-            lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
-                np.searchsorted(a, v, "right")
-            )
-        return lo
+    def _locate(self, jid: bytes) -> Optional[int]:
+        key = self.key_of_id.get(jid)
+        if key is None:
+            return None
+        probe = key + (jid,)
+        row = self._find_in_region(0, self.sorted_n, probe)
+        if row is None and self.n > self.sorted_n:
+            row = self._find_in_region(self.sorted_n, self.n, probe)
+        return row
 
     def insert_batch(
         self,
@@ -162,8 +203,9 @@ class _SortedTable:
         reqs: list[np.ndarray],
         atoms: Optional[list[np.ndarray]] = None,
     ) -> None:
-        """rows: per-row dict of every column value (ids as bytes); one
-        np.insert per column for the whole batch."""
+        """rows: per-row dict of every column value (ids as bytes).  O(batch
+        log n) position search + one small np.insert per column on the
+        OVERLAY region only; the base never copies here."""
         if not rows:
             return
         scols = self.sort_cols
@@ -173,46 +215,104 @@ class _SortedTable:
         )
         rows = [rows[i] for i in order]
         reqs = [reqs[i] for i in order]
+        k = len(rows)
+        self._live_cache = None
         if self.n == 0:
             # Bulk-load fast path (initial backlog fill): the sorted batch IS
-            # the table.
-            pos = np.zeros((len(rows),), np.int64)
+            # the (base) table.
+            self._ensure_cap(k)
+            for c in self._cols():
+                col = getattr(self, c)
+                col[:k] = np.array(
+                    [r.get(c, True if c == "alive" else 0) for r in rows],
+                    col.dtype,
+                )
+            self.req[:k] = np.stack(reqs)
+            if self.atoms is not None:
+                self.atoms[:k] = (
+                    np.stack([atoms[i] for i in order])
+                    if atoms is not None
+                    else 0
+                )
+            self.n = self.sorted_n = k
         else:
-            # Same probe as _position, on locally-bound columns via the
+            # Per-row binary refinement on locally-bound columns via the
             # ndarray method: the numpy dispatch wrappers dominate at the
             # per-cycle ~1k-lease batch against big tables (see remove_many).
-            n = self.n
+            sn = self.sorted_n
             cols = [getattr(self, c) for c in scols]
             dtypes = [c.dtype.type for c in cols]
-            pos = np.empty((len(rows),), np.int64)
+            base_pos = np.empty((k,), np.int64)
+            ov_ins = np.empty((k,), np.int64)
+            ov_pos = self.ov_pos
             for i, r in enumerate(rows):
-                lo, hi = 0, n
+                lo, hi = 0, sn
                 for col, dt, c in zip(cols, dtypes, scols):
                     a = col[lo:hi]
                     v = dt(r[c])
                     left = int(a.searchsorted(v, "left"))
                     hi = lo + int(a.searchsorted(v, "right"))
                     lo = lo + left
-                pos[i] = lo
-        live = slice(0, self.n)
-        for c in self._cols():
-            cur = getattr(self, c)
-            vals = np.array(
-                [r.get(c, True if c == "alive" else 0) for r in rows],
-                cur.dtype,
+                base_pos[i] = lo
+                # slot within the key-sorted overlay: rows at other base
+                # positions order by position; the run SHARING this base gap
+                # (common: a queue tail absorbing several cycles of arrivals)
+                # needs the key refinement, but only over that run
+                olo = int(ov_pos.searchsorted(lo, "left"))
+                ohi = int(ov_pos.searchsorted(lo, "right"))
+                if olo != ohi:
+                    plo, phi = sn + olo, sn + ohi
+                    for col, dt, c in zip(cols, dtypes, scols):
+                        a = col[plo:phi]
+                        v = dt(r[c])
+                        left = int(a.searchsorted(v, "left"))
+                        phi = plo + int(a.searchsorted(v, "right"))
+                        plo = plo + left
+                    ov_ins[i] = plo - sn
+                else:
+                    ov_ins[i] = olo
+            self._ensure_cap(self.n + k)
+            end = self.n
+            for c in self._cols():
+                col = getattr(self, c)
+                vals = np.array(
+                    [r.get(c, True if c == "alive" else 0) for r in rows],
+                    col.dtype,
+                )
+                col[sn : end + k] = np.insert(col[sn:end], ov_ins, vals)
+            self.req[sn : end + k] = np.insert(
+                self.req[sn:end], ov_ins, np.stack(reqs), axis=0
             )
-            setattr(self, c, np.insert(cur[live], pos, vals))
-        self.req = np.insert(self.req[live], pos, np.stack(reqs), axis=0)
-        if self.atoms is not None:
-            vals = (
-                np.stack([atoms[i] for i in order])
-                if atoms is not None
-                else np.zeros((len(rows), self.R), np.int64)
-            )
-            self.atoms = np.insert(self.atoms[live], pos, vals, axis=0)
-        self.n += len(rows)
+            if self.atoms is not None:
+                vals = (
+                    np.stack([atoms[i] for i in order])
+                    if atoms is not None
+                    else np.zeros((k, self.R), np.int64)
+                )
+                self.atoms[sn : end + k] = np.insert(
+                    self.atoms[sn:end], ov_ins, vals, axis=0
+                )
+            self.ov_pos = np.insert(ov_pos, ov_ins, base_pos)
+            self.n += k
+            if self.n - self.sorted_n > max(2048, self.sorted_n // 16):
+                self._merge_overlay()
         for r in rows:
             self.key_of_id[r["ids"]] = tuple(r[c] for c in scols[:-1])
+
+    def _merge_overlay(self) -> None:
+        """Fold the overlay into the base: one vectorized np.insert per
+        column at the precomputed positions (no re-search)."""
+        k = self.n - self.sorted_n
+        if not k:
+            return
+        sn = self.sorted_n
+        self._live_cache = None
+        for col in self._mat_cols():
+            merged = np.insert(col[:sn], self.ov_pos, col[sn : self.n], axis=0)
+            col[: self.n] = merged
+        self.copied_rows += self.n
+        self.sorted_n = self.n
+        self.ov_pos = np.zeros((0,), np.int64)
 
     def remove(self, jid: bytes) -> Optional[dict]:
         """Tombstone the row; returns its column values (qi + extras + req
@@ -225,6 +325,7 @@ class _SortedTable:
         info = {c: getattr(self, c)[row] for c in ("qi",) + self._extra}
         info["req"] = self.req[row].copy()
         self.alive[row] = False
+        self._live_cache = None
         self.dead += 1
         if self.dead > max(1024, self.n // 4):
             self.compact()
@@ -236,7 +337,11 @@ class _SortedTable:
         (the numpy dispatch wrappers are most of remove()'s cost for the
         per-cycle ~1k-decision feedback at 1M rows) and the compaction
         check runs once for the whole batch."""
-        n = self.n
+        regions = (
+            ((0, self.sorted_n), (self.sorted_n, self.n))
+            if self.n > self.sorted_n
+            else ((0, self.sorted_n),)
+        )
         cols = [getattr(self, c) for c in self.sort_cols]
         dtypes = [c.dtype.type for c in cols]
         alive = self.alive
@@ -244,22 +349,26 @@ class _SortedTable:
         extra_cols = {c: getattr(self, c) for c in extra}
         pop_key = self.key_of_id.pop
         out = []
+        removed = 0
         for jid in jids:
             key = pop_key(jid, None)
             if key is None:
                 out.append(None)
                 continue
-            lo, hi = 0, n
-            for col, dt, v in zip(cols, dtypes, key + (jid,)):
-                a = col[lo:hi]
-                v = dt(v)
-                left = int(a.searchsorted(v, "left"))
-                hi = lo + int(a.searchsorted(v, "right"))
-                lo = lo + left
             row = None
-            for r in range(lo, hi):
-                if alive[r]:
-                    row = r
+            for rlo, rhi in regions:
+                lo, hi = rlo, rhi
+                for col, dt, v in zip(cols, dtypes, key + (jid,)):
+                    a = col[lo:hi]
+                    v = dt(v)
+                    left = int(a.searchsorted(v, "left"))
+                    hi = lo + int(a.searchsorted(v, "right"))
+                    lo = lo + left
+                for r in range(lo, hi):
+                    if alive[r]:
+                        row = r
+                        break
+                if row is not None:
                     break
             if row is None:
                 out.append(None)
@@ -268,12 +377,16 @@ class _SortedTable:
             info["req"] = self.req[row].copy()
             alive[row] = False
             self.dead += 1
+            removed += 1
             out.append(info)
+        if removed:
+            self._live_cache = None
         if self.dead > max(1024, self.n // 4):
             self.compact()
         return out
 
     def compact(self) -> None:
+        self._merge_overlay()
         keep = self.alive[: self.n]
         kept = int(keep.sum())
         for c in self._cols():
@@ -282,11 +395,53 @@ class _SortedTable:
         self.req = self.req[: self.n][keep]
         if self.atoms is not None:
             self.atoms = self.atoms[: self.n][keep]
-        self.n = kept
+        self.copied_rows += kept
+        self.n = self.sorted_n = self.cap = kept
         self.dead = 0
+        self._live_cache = None
 
     def live_rows(self) -> np.ndarray:
-        return np.flatnonzero(self.alive[: self.n])
+        """Live physical rows in KEY order (no longer ascending once an
+        overlay exists -- every consumer gathers column values by row, so
+        only the order is load-bearing).  Cached until the next mutation;
+        treat the result as read-only."""
+        out = self._live_cache
+        if out is not None:
+            return out
+        base_live = np.flatnonzero(self.alive[: self.sorted_n])
+        if self.n == self.sorted_n:
+            out = base_live
+        else:
+            ov_live = np.flatnonzero(self.alive[self.sorted_n : self.n])
+            ins = np.searchsorted(base_live, self.ov_pos[ov_live], "left")
+            out = np.insert(base_live, ins, self.sorted_n + ov_live)
+        self._live_cache = out
+        return out
+
+    def rank_of_key(self, probe: tuple) -> int:
+        """Count of live rows whose full sort key precedes `probe` (which
+        includes the id), restricted to probe's queue -- the builder's
+        virtual-rank primitive, summed over both regions."""
+        total = 0
+        qv = probe[0]
+        for rlo, rhi in ((0, self.sorted_n), (self.sorted_n, self.n)):
+            if rlo == rhi:
+                continue
+            qcol = self.qi[rlo:rhi]
+            q_lo = rlo + int(np.searchsorted(qcol, qcol.dtype.type(qv), "left"))
+            lo, hi = q_lo, rlo + int(
+                np.searchsorted(qcol, qcol.dtype.type(qv), "right")
+            )
+            for col, v in zip(
+                [getattr(self, c) for c in self.sort_cols[1:]], probe[1:]
+            ):
+                a = col[lo:hi]
+                v = a.dtype.type(v)
+                lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
+                    np.searchsorted(a, v, "right")
+                )
+            total += int(self.alive[q_lo:lo].sum())
+        return total
 
 
 class IncrementalBuilder:
@@ -464,6 +619,11 @@ class IncrementalBuilder:
         # totals/scale/caps and drop their runs, matching the legacy builder
         # which only ever sees snapshot nodes (problem.py run_list filter).
         self.node_present = np.zeros((0,), bool)
+        # Last set_nodes snapshot (strong refs, so object identity is a
+        # sound sameness proxy): the steady cycle re-presents the SAME
+        # NodeSpec instances (executor snapshots only change on executor
+        # sync), and the full 50k-node Python diff costs ~100ms/cycle.
+        self._last_nodes: Optional[list] = None
         self._retype_needed = False
         # Node-derived tensors are identical between cycles unless the fleet
         # changed; cache them keyed on an epoch so assemble() can hand back
@@ -526,6 +686,24 @@ class IncrementalBuilder:
         """Full node snapshot for this pool, diffed against current state.
         Node indices are stable for the life of the builder (run rows key on
         them); removed nodes become !ok tombstones."""
+        # Identity fast path: NodeSpecs are immutable snapshot rows, so the
+        # same instances in the same order mean the same outcome as last
+        # cycle's diff.  An `is`-walk over 50k nodes is ~2ms; the full diff
+        # below (dict probes + per-node compares) is ~100ms.
+        prev = self._last_nodes
+        if prev is not None and len(prev) == len(nodes):
+            for a, b in zip(prev, nodes):
+                if a is not b:
+                    break
+            else:
+                if self._retype_needed:
+                    self._retype_nodes()
+                self._flush_pending_runs()
+                return
+        # Recorded only once the diff below COMPLETES: a mid-diff raise (one
+        # malformed NodeSpec) must not arm the fast path, or every retry with
+        # the same instances would silently skip repairing half-applied state.
+        self._last_nodes = None
         seen = set()
         changed = False
         new_rows: list[NodeSpec] = []
@@ -578,6 +756,7 @@ class IncrementalBuilder:
             changed = True
         if changed:
             self._node_epoch += 1
+        self._last_nodes = list(nodes)
         if self._retype_needed:
             self._retype_nodes()
         self._flush_pending_runs()
@@ -1466,13 +1645,13 @@ class IncrementalBuilder:
             run_ids_vec=rt.ids[run_rows],
             # lazy: materialized only by a round that actually preempted
             # (models._iter_partial_gangs); eager per-member locates would
-            # tax every assemble for a rarely-consumed mapping
+            # tax every assemble for a rarely-consumed mapping.  run_rows is
+            # in KEY order (not ascending) since the table grew its overlay
+            # region, so the row -> axis-position map is a dict, built when
+            # the thunk fires.
             running_gangs=lambda: self._running_gang_ctx_groups(
-                lambda row: (
-                    int(pos)
-                    if (pos := np.searchsorted(run_rows, row)) < nr
-                    and run_rows[pos] == row
-                    else None
+                lambda row, _m={int(r): i for i, r in enumerate(run_rows)}: (
+                    _m.get(int(row))
                 )
             ),
         )
@@ -2470,60 +2649,52 @@ class IncrementalBuilder:
         """Market-order rank of a slow-path unit among the queue's live
         fast-table rows: the count of singles whose (-price, sub, id) key
         strictly precedes the unit's.  Bands are contiguous in the stored
-        (qi, band, sub, id) order, so this is O(bands) binary searches."""
+        (qi, band, sub, id) order within each table region (base + overlay),
+        so this is O(bands) binary searches per region."""
         jt = self.jobs
-        qv = jt.qi.dtype.type(qi)
-        q_lo = int(np.searchsorted(jt.qi[: jt.n], qv, "left"))
-        q_hi = int(np.searchsorted(jt.qi[: jt.n], qv, "right"))
-        if q_lo == q_hi:
-            return 0
         # The table is f32; a raw-f64 probe (e.g. 4.7) would never equal its
         # own band's entry and mis-rank the unit (CLAUDE.md parity: f32
         # score arithmetic, raw f64 flips near-ties).
         price = float(np.float32(price))
-        band_col = jt.band[q_lo:q_hi]
         count = 0
-        for bi in range(len(self.bands)):
-            b_lo = q_lo + int(np.searchsorted(band_col, np.int32(bi), "left"))
-            b_hi = q_lo + int(np.searchsorted(band_col, np.int32(bi), "right"))
-            if b_lo == b_hi:
+        for rlo, rhi in ((0, jt.sorted_n), (jt.sorted_n, jt.n)):
+            if rlo == rhi:
                 continue
-            p = float(prices[qi, bi])
-            if p > price:
-                count += int(jt.alive[b_lo:b_hi].sum())
-            elif p == price:
-                lo, hi = b_lo, b_hi
-                for col, v in (
-                    (jt.sub, lead.submit_time),
-                    (jt.ids, lead.id.encode()),
-                ):
-                    a = col[lo:hi]
-                    v = a.dtype.type(v)  # dtype mismatch copies the column
-                    lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
-                        np.searchsorted(a, v, "right")
-                    )
-                count += int(jt.alive[b_lo:lo].sum())
+            qcol = jt.qi[rlo:rhi]
+            qv = qcol.dtype.type(qi)
+            q_lo = rlo + int(np.searchsorted(qcol, qv, "left"))
+            q_hi = rlo + int(np.searchsorted(qcol, qv, "right"))
+            if q_lo == q_hi:
+                continue
+            band_col = jt.band[q_lo:q_hi]
+            for bi in range(len(self.bands)):
+                b_lo = q_lo + int(np.searchsorted(band_col, np.int32(bi), "left"))
+                b_hi = q_lo + int(np.searchsorted(band_col, np.int32(bi), "right"))
+                if b_lo == b_hi:
+                    continue
+                p = float(prices[qi, bi])
+                if p > price:
+                    count += int(jt.alive[b_lo:b_hi].sum())
+                elif p == price:
+                    lo, hi = b_lo, b_hi
+                    for col, v in (
+                        (jt.sub, lead.submit_time),
+                        (jt.ids, lead.id.encode()),
+                    ):
+                        a = col[lo:hi]
+                        v = a.dtype.type(v)  # dtype mismatch copies the column
+                        lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
+                            np.searchsorted(a, v, "right")
+                        )
+                    count += int(jt.alive[b_lo:lo].sum())
         return count
 
     def _virtual_rank(self, qi: int, pc_priority: int, lead: JobSpec) -> int:
         """Rank of a slow-path unit among the queue's live fast-table rows:
-        where it would sit in the sorted order."""
-        jt = self.jobs
-        qv = jt.qi.dtype.type(qi)
-        q_lo = int(np.searchsorted(jt.qi[: jt.n], qv, "left"))
-        lo, hi = q_lo, int(np.searchsorted(jt.qi[: jt.n], qv, "right"))
-        for col, v in (
-            (jt.npc, -pc_priority),
-            (jt.prio, lead.priority),
-            (jt.sub, lead.submit_time),
-            (jt.ids, lead.id.encode()),
-        ):
-            a = col[lo:hi]
-            v = a.dtype.type(v)  # dtype mismatch copies the column
-            lo, hi = lo + int(np.searchsorted(a, v, "left")), lo + int(
-                np.searchsorted(a, v, "right")
-            )
-        return int(self.jobs.alive[q_lo:lo].sum())
+        where it would sit in the sorted order (summed over base + overlay)."""
+        return self.jobs.rank_of_key(
+            (qi, -pc_priority, lead.priority, lead.submit_time, lead.id.encode())
+        )
 
 
 class DeviceProblemCache:

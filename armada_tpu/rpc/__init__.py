@@ -8,6 +8,7 @@ functionally identical to generated code.
 """
 
 import os
+import shutil
 import subprocess
 import tempfile
 
@@ -21,29 +22,45 @@ import armada_tpu.events  # noqa: F401,E402
 
 if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN):
     with tempfile.TemporaryDirectory() as _tmp:
-        subprocess.run(
-            [
-                "protoc",
-                "-I",
-                _HERE,
-                "-I",
-                _EVENTS_DIR,
-                f"--python_out={_tmp}",
-                _PROTO,
-            ],
-            check=True,
-        )
         src_path = os.path.join(_tmp, "rpc_pb2.py")
-        with open(src_path) as f:
-            src = f.read()
-        # protoc emits a sibling absolute import; our generated modules live in
-        # different packages, so point it at the real location.
-        src = src.replace(
-            "import events_pb2 as events__pb2",
-            "from armada_tpu.events import events_pb2 as events__pb2",
-        )
-        with open(src_path, "w") as f:
-            f.write(src)
+        if shutil.which("protoc"):
+            subprocess.run(
+                [
+                    "protoc",
+                    "-I",
+                    _HERE,
+                    "-I",
+                    _EVENTS_DIR,
+                    f"--python_out={_tmp}",
+                    _PROTO,
+                ],
+                check=True,
+            )
+            with open(src_path) as f:
+                src = f.read()
+            # protoc emits a sibling absolute import; our generated modules
+            # live in different packages, so point it at the real location.
+            src = src.replace(
+                "import events_pb2 as events__pb2",
+                "from armada_tpu.events import events_pb2 as events__pb2",
+            )
+            with open(src_path, "w") as f:
+                f.write(src)
+        else:
+            from armada_tpu.events import _minigen
+
+            with open(src_path, "w") as f:
+                f.write(
+                    _minigen.generate_pb2_source(
+                        _PROTO,
+                        "rpc.proto",
+                        "rpc_pb2",
+                        import_lines=(
+                            "from armada_tpu.events import "
+                            "events_pb2 as events__pb2\n"
+                        ),
+                    )
+                )
         os.replace(src_path, _GEN)
 
 from armada_tpu.rpc import rpc_pb2  # noqa: E402

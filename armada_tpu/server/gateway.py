@@ -37,17 +37,34 @@ trusted headers / anonymous, per the gateway's configured chain.
 from __future__ import annotations
 
 import json
+import sqlite3
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from google.protobuf import json_format
 
+from armada_tpu.ingest.pgwire import PgError, ProtocolError
+from armada_tpu.ingest.sqladapter import SqlDialectError
 from armada_tpu.rpc import convert, rpc_pb2 as pb
 from armada_tpu.server.auth import AuthorizationError, Principal
 from armada_tpu.server.authn import AuthenticationError
 from armada_tpu.server.queues import QueueAlreadyExists, QueueNotFound
 from armada_tpu.server.submit import SubmitError
+
+# Store/backend failures behind the lookout + reports query routes (external
+# PG via pgwire, embedded sqlite): a gateway must answer 500 in the
+# grpc-gateway error shape, not drop the connection with a traceback --
+# HTTP clients (the C++ client, curl pipelines) treat a severed keep-alive
+# socket as a transport bug, not a server-side query failure.
+_BACKEND_ERRORS = (
+    PgError,
+    ProtocolError,
+    SqlDialectError,
+    sqlite3.OperationalError,
+    sqlite3.DatabaseError,
+    ConnectionError,
+)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -97,6 +114,13 @@ class _Handler(BaseHTTPRequestHandler):
             if getattr(self, "_responded", False):
                 raise
             self._error(401, f"unauthenticated: {e}")
+        except _BACKEND_ERRORS as e:
+            # before the ValueError clause: SqlDialectError IS a ValueError,
+            # but an untranslatable server-side query shape is a 500, not
+            # the client's bad request
+            if getattr(self, "_responded", False):
+                raise
+            self._error(500, f"backend error: {type(e).__name__}: {e}")
         except (_Handler._BadRequest, ValueError) as e:
             if getattr(self, "_responded", False):
                 raise

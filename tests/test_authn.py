@@ -168,6 +168,7 @@ def test_oidc_hs256_claims():
 
 
 def test_oidc_rs256_roundtrip():
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 

@@ -10,6 +10,7 @@ generated bindings; the JVM/.NET toolchains to COMPILE them are not in this
 image, so compilation is the user-side step documented in each build file.)
 """
 
+import shutil
 import subprocess
 import sys
 from pathlib import Path
@@ -17,6 +18,12 @@ from pathlib import Path
 import pytest
 
 ROOT = Path(__file__).resolve().parent.parent
+
+# genclients.sh drives the real protoc (the _minigen fallback only emits
+# python); without the binary the pipeline itself cannot run
+pytestmark = pytest.mark.skipif(
+    shutil.which("protoc") is None, reason="protoc not on PATH"
+)
 
 
 @pytest.fixture(scope="module")

@@ -303,3 +303,50 @@ def test_gateway_jobs_query_bad_bodies_are_400():
             assert e.code == 404
     finally:
         gw.stop()
+
+
+def test_minigen_fallback_compiles_both_protos():
+    """The protoc-absent fallback (events/_minigen.py) must compile BOTH
+    repo protos -- rpc.proto includes a message-valued map
+    (map<string, ResourceAtoms>), which once crashed the regen branch at
+    import.  Generated modules register descriptors in the default pool, so
+    the round-trip runs in a fresh interpreter."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    import armada_tpu
+
+    root = os.path.dirname(os.path.dirname(armada_tpu.__file__))
+    script = textwrap.dedent(
+        """
+        import os, sys, tempfile
+        sys.path.insert(0, %r)
+        from armada_tpu.events import _minigen
+        d = tempfile.mkdtemp()
+        pkg = os.path.join(d, "mgtest")
+        os.makedirs(pkg)
+        open(os.path.join(pkg, "__init__.py"), "w").close()
+        ev = os.path.join(%r, "armada_tpu", "events", "events.proto")
+        rp = os.path.join(%r, "armada_tpu", "rpc", "rpc.proto")
+        with open(os.path.join(pkg, "events_pb2.py"), "w") as f:
+            f.write(_minigen.generate_pb2_source(ev, "events.proto", "events_pb2"))
+        with open(os.path.join(pkg, "rpc_pb2.py"), "w") as f:
+            f.write(_minigen.generate_pb2_source(
+                rp, "rpc.proto", "rpc_pb2",
+                import_lines="from mgtest import events_pb2 as events__pb2\\n"))
+        sys.path.insert(0, d)
+        from mgtest import rpc_pb2 as pb
+        m = pb.ExecutorSnapshot()
+        m.queue_usage["qa"].atoms["cpu"] = 5
+        m.node_of_run["r1"] = "n1"
+        m2 = pb.ExecutorSnapshot.FromString(m.SerializeToString())
+        assert m2.queue_usage["qa"].atoms["cpu"] == 5
+        assert m2.node_of_run["r1"] == "n1"
+        """
+    ) % (root, root, root)
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True
+    )
+    assert r.returncode == 0, r.stderr[-2000:]

@@ -1,0 +1,168 @@
+"""Profile the sidecar's steady direct cycle on CPU: wall-clock sync/round
+splits over warmed cycles, then a cProfile of 3 more -- the methodology
+behind docs/bench.md's round-6 host-side ablation (whole-cycle differencing
+is useless when the CPU kernel's variance exceeds the host-side trim being
+measured).  Scale knobs: PJOBS, PNODES, PQUEUES, PRUNS, PBURST; e.g.
+PJOBS=1000000 PNODES=50000 PRUNS=25000 python tools/sidecar_profile.py."""
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def main():
+    jobs = int(os.environ.get("PJOBS", 200_000))
+    nodes = int(os.environ.get("PNODES", 10_000))
+    queues = int(os.environ.get("PQUEUES", 64))
+    runs = int(os.environ.get("PRUNS", nodes // 2))
+    burst = int(os.environ.get("PBURST", 1_000))
+
+    import dataclasses
+
+    from armada_tpu.events.convert import job_spec_to_proto
+    from armada_tpu.models.synthetic import synthetic_world
+    from armada_tpu.rpc import rpc_pb2 as pb
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+    from armada_tpu.scheduler.sidecar import ScheduleSidecar
+
+    t0 = time.perf_counter()
+    config, nodes_l, queues_l, specs, running, spec_factory = (
+        bench.synthetic_world(
+            num_nodes=nodes,
+            num_jobs=jobs,
+            num_queues=queues,
+            num_runs=runs,
+            seed=7,
+            shape_bucket=max(8192, 4 * burst),
+        )
+        if hasattr(bench, "synthetic_world")
+        else synthetic_world(
+            num_nodes=nodes,
+            num_jobs=jobs,
+            num_queues=queues,
+            num_runs=runs,
+            seed=7,
+            shape_bucket=max(8192, 4 * burst),
+        )
+    )
+    config = dataclasses.replace(
+        config,
+        incremental_problem_build=True,
+        maximum_scheduling_rate=1e9,
+        maximum_per_queue_scheduling_rate=1e9,
+        maximum_scheduling_burst=burst,
+        maximum_per_queue_scheduling_burst=burst,
+    )
+    now0 = 10**12
+    clock = [now0]
+    sidecar = ScheduleSidecar(config, clock_ns=lambda: clock[0])
+    sid = sidecar.create_session("prof")
+    session = sidecar.session(sid)
+
+    def state_of_spec(s):
+        return pb.JobState(
+            job_id=s.id,
+            queue=s.queue,
+            jobset="bench",
+            spec=job_spec_to_proto(s),
+            priority=s.priority,
+            queued=True,
+            validated=True,
+            submit_time=s.submit_time,
+        )
+
+    def state_of_run(r, i):
+        m = state_of_spec(r.job)
+        m.queued = False
+        pc = config.priority_class(r.job.priority_class)
+        m.run.MergeFrom(
+            pb.JobRunState(
+                run_id=f"run{i:08d}",
+                node_id=r.node_id,
+                node_name=r.node_id,
+                pool="default",
+                scheduled_at_priority=pc.priority,
+                has_scheduled_at_priority=True,
+                running=True,
+                running_ns=now0 - 10**9,
+            )
+        )
+        return m
+
+    n_ex = 10
+    per = (len(nodes_l) + n_ex - 1) // n_ex
+    executors = [
+        ExecutorSnapshot(
+            id=f"ex{e}",
+            pool="default",
+            nodes=tuple(nodes_l[e * per : (e + 1) * per]),
+            last_update_ns=now0,
+        )
+        for e in range(n_ex)
+    ]
+    session.apply_sync(executors=executors, queues=queues_l)
+    chunk = 50_000
+    for lo in range(0, len(specs), chunk):
+        sidecar.handle_sync(
+            pb.SyncStateRequest(
+                session_id=sid,
+                jobs=[state_of_spec(s) for s in specs[lo : lo + chunk]],
+            )
+        )
+    for lo in range(0, len(running), chunk):
+        sidecar.handle_sync(
+            pb.SyncStateRequest(
+                session_id=sid,
+                jobs=[
+                    state_of_run(r, lo + i)
+                    for i, r in enumerate(running[lo : lo + chunk])
+                ],
+            )
+        )
+    print(f"setup {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    def cycle():
+        clock[0] += 10**9
+        fresh = spec_factory(burst, clock[0] / 1e9)
+        states = [state_of_spec(s) for s in fresh]
+        t = time.perf_counter()
+        sidecar.handle_sync(pb.SyncStateRequest(session_id=sid, jobs=states))
+        t_sync = time.perf_counter() - t
+        t = time.perf_counter()
+        resp = sidecar.handle_round(
+            pb.ScheduleRoundRequest(session_id=sid, now_ns=clock[0])
+        )
+        t_round = time.perf_counter() - t
+        return t_sync, t_round, len(resp.scheduled)
+
+    # warm-up
+    for _ in range(2):
+        cycle()
+    times = []
+    for _ in range(3):
+        times.append(cycle())
+    for ts, tr, n in times:
+        print(f"sync {ts:.3f}s round {tr:.3f}s total {ts+tr:.3f}s sched {n}",
+              file=sys.stderr)
+
+    pr = cProfile.Profile()
+    pr.enable()
+    for _ in range(3):
+        cycle()
+    pr.disable()
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()
