@@ -593,6 +593,11 @@ def load_serve_config(args):
 def cmd_serve(args):
     from armada_tpu.cli.serve import start_control_plane
 
+    if getattr(args, "no_pipeline", False):
+        # Every pipelined call site reads the env per call, so this flips
+        # the whole plane (scheduler loop, sidecar sessions) to the
+        # sequential cycle order.
+        os.environ["ARMADA_PIPELINE"] = "0"
     config, authenticator = load_serve_config(args)
     plane = start_control_plane(
         data_dir=args.data_dir,
@@ -872,6 +877,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="external lookout database (postgres://...), the reference's "
         "second Postgres -- a FRESH database this plane owns.  Default: "
         "embedded SQLite under --data-dir",
+    )
+    srv.add_argument(
+        "--no-pipeline",
+        action="store_true",
+        default=False,
+        help="disable the shadow-pipelined steady cycle (sets "
+        "ARMADA_PIPELINE=0 process-wide): decision-independent host work "
+        "runs sequentially after the kernel instead of in its shadow -- "
+        "the A/B + bisection escape hatch; decisions are identical either "
+        "way",
     )
     srv.add_argument(
         "--bind-host",

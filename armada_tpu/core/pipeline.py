@@ -1,0 +1,46 @@
+"""The steady-cycle pipeline switch.
+
+The shadow-pipelined cycle (round 7) hides decision-independent host work
+behind the device round: while the kernel and its result transfer are in
+flight, the drivers run (a) the previous cycle's decision-dependent but
+problem-independent bookkeeping and (b) the next cycle's decision-
+independent feed -- proto->Job conversion, submit-side table inserts, and
+the slab upload of new-submit rows (IncrementalBuilder.prefetch_content).
+Decisions are bit-identical either way: the pipeline only reorders work
+that neither reads the round's output nor feeds its problem -- the
+soundness boundary pinned by tests/test_pipeline.py.
+
+``ARMADA_PIPELINE=0`` is the escape hatch (A/B measurement, bisection):
+every pipelined call site degrades to the sequential order.  The env var is
+read per call so a test can flip it with monkeypatch; ``serve
+--no-pipeline`` sets it process-wide.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pipeline_enabled() -> bool:
+    """True unless ARMADA_PIPELINE=0: shadow-pipeline the steady cycle."""
+    return os.environ.get("ARMADA_PIPELINE", "1") != "0"
+
+
+def prefetch_worthwhile() -> bool:
+    """Whether the slab content prefetch pays for itself.
+
+    The prefetch trades an extra device scatter pass for moving its upload
+    off the round's critical path.  On a real accelerator the scatter is
+    device-side microseconds and the H2D transfer overlaps host work (the
+    tunnel is the scarce resource); on the XLA:CPU fallback the "device" IS
+    the host -- the extra pass costs real milliseconds per cycle (measured
+    ~96ms at 200k jobs, round 7) with no tunnel to hide.  Default:
+    accelerator backends only.  ARMADA_PIPELINE_PREFETCH=1/0 overrides
+    (tests pin the scatter path on CPU with 1; 0 isolates the prefetch in
+    a TPU A/B)."""
+    env = os.environ.get("ARMADA_PIPELINE_PREFETCH")
+    if env is not None:
+        return env != "0"
+    import jax
+
+    return jax.default_backend() != "cpu"

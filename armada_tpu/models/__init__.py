@@ -18,12 +18,19 @@ from armada_tpu.models.problem import (
 from armada_tpu.models.fair_scheduler import schedule_round, RoundResult
 
 
-def run_round_on_device(problem, ctx, config, device_problem=None):
+def run_round_on_device(problem, ctx, config, device_problem=None, shadow_work=()):
     """(result, outcome): run the jitted round on a built problem and decode,
     including the gang-txn rollback loop.  Shared by the from-scratch path
     (run_scheduling_round) and the incremental-builder path
     (scheduler/incremental_algo.py); `device_problem` lets callers supply
-    cached device buffers (models.incremental.DeviceProblemCache)."""
+    cached device buffers (models.incremental.DeviceProblemCache).
+
+    `shadow_work`: zero-arg callables run between the decode dispatch and
+    the blocking fetch -- the KERNEL SHADOW.  Anything that neither reads
+    this round's outcome nor mutates what decode still needs is sound here
+    (submit-side table inserts and prefetch_content are; the ctx id
+    snapshots are copy-on-write precisely for this).  The thunks run ONCE,
+    before the first decode -- gang-rollback re-runs never repeat them."""
     import jax.numpy as jnp
     import numpy as _np
 
@@ -46,7 +53,10 @@ def run_round_on_device(problem, ctx, config, device_problem=None):
     # the transfer streams as soon as the kernel finishes -- a blocking
     # decode_result here paid one extra tunnel round trip (~65ms) per round
     # in the serve/sidecar paths (the bench loop already did this).
-    outcome = begin_decode(result, ctx)()
+    finish = begin_decode(result, ctx)
+    for work in shadow_work:
+        work()
+    outcome = finish()
 
     # Gang-txn rollback (nodedb.go:347 ScheduleManyWithTxn: a gang is one txn,
     # all-or-nothing): if a split gang's sibling placed but another sub-gang

@@ -581,6 +581,14 @@ class IncrementalBuilder:
         # Bundle sequencing for the single DeviceDeltaCache consumer (a
         # skipped bundle forces its full-upload fallback).
         self._bundle_seq = 0
+        # Shadow-pipeline prefetch state (prefetch_content): the sig of the
+        # last emitted bundle, and how much of each slab's dirty log was
+        # already shipped to the device mid-cycle.  Shipped rows stay in the
+        # dirty log (the gq splice must treat them as moved) but drop out of
+        # the next bundle's scatter payload.
+        self._last_sig: Optional[tuple] = None
+        self._shipped_sg = 0
+        self._shipped_rr = 0
         # Market: g_price is a function of per-slot (queue, band) and the
         # per-cycle price table; a price MOVE invalidates every slot's price
         # at once, so it bumps an epoch in the bundle sig and rides the
@@ -789,28 +797,35 @@ class IncrementalBuilder:
                 self._indexed.add(k)
                 self._retype_needed = True
 
-    def _single_row(self, spec: JobSpec) -> tuple[dict, np.ndarray]:
+    def _single_row(self, spec: JobSpec) -> dict:
         pc = self.config.priority_class(spec.priority_class)
-        req = (
-            self.factory.ceil_units(spec.resources.atoms).astype(np.float32)
-            if spec.resources is not None
-            else np.zeros((self.R,), np.float32)
-        )
-        return (
-            {
-                "ids": spec.id.encode(),
-                "qi": self.queue_by_name[spec.queue],
-                "npc": -pc.priority,
-                "prio": spec.priority,
-                "sub": spec.submit_time,
-                "level": self.level_of_priority[pc.priority],
-                "pc": self.pc_index[pc.name],
-                "key": self.kidx.key_of(spec, self.config.node_id_label),
-                "band": self._band(spec.price_band),
-                "hasres": spec.resources is not None,
-            },
-            req,
-        )
+        return {
+            "ids": spec.id.encode(),
+            "qi": self.queue_by_name[spec.queue],
+            "npc": -pc.priority,
+            "prio": spec.priority,
+            "sub": spec.submit_time,
+            "level": self.level_of_priority[pc.priority],
+            "pc": self.pc_index[pc.name],
+            "key": self.kidx.key_of(spec, self.config.node_id_label),
+            "band": self._band(spec.price_band),
+            "hasres": spec.resources is not None,
+        }
+
+    def _batch_reqs(self, res_list: Sequence) -> np.ndarray:
+        """Vectorized ceil_units over a batch of ResourceLists (None =
+        zero request): ONE numpy pass for the whole batch instead of three
+        numpy ops per job -- the submit/lease feed's row-building loop was
+        ~100ms/cycle at 1k-job bursts (round-6 cProfile), about half of it
+        per-job numpy dispatch."""
+        if not res_list:
+            return np.zeros((0, self.R), np.float32)
+        zero = np.zeros((self.R,), np.int64)
+        A = np.stack(
+            [zero if res is None else res.atoms for res in res_list]
+        ).astype(np.int64, copy=False)
+        res_v = np.asarray(self.factory.resolutions, np.int64)
+        return (-((-A) // res_v[None, :])).astype(np.float32)
 
     def submit(self, spec: JobSpec, banned_nodes: Sequence[str] = ()) -> None:
         """A queued job entered (or re-entered) the backlog.  `spec.priority`
@@ -867,7 +882,7 @@ class IncrementalBuilder:
         self, specs: Sequence[JobSpec], banned: Optional[Mapping] = None
     ) -> None:
         """Batched submit: one np.insert for the whole batch."""
-        rows, reqs = [], []
+        rows, resl = [], []
         atoms: Optional[list] = [] if self.market else None
         for spec in specs:
             if spec.pools and self.pool not in spec.pools:
@@ -889,9 +904,8 @@ class IncrementalBuilder:
             jid = spec.id.encode()
             if jid in self.jobs:
                 self._release_single(self.jobs.remove(jid))
-            row, req = self._single_row(spec)
-            rows.append(row)
-            reqs.append(req)
+            rows.append(self._single_row(spec))
+            resl.append(spec.resources)
             if atoms is not None:
                 atoms.append(
                     np.asarray(spec.resources.atoms, np.int64)
@@ -900,11 +914,12 @@ class IncrementalBuilder:
                 )
         if not rows:
             return
+        reqs_arr = self._batch_reqs(resl)
+        reqs = list(reqs_arr)
         slots = self._sg.alloc(len(rows))
         for r, s in zip(rows, slots):
             r["slot"] = s
         self.jobs.insert_batch(rows, reqs, atoms)
-        reqs_arr = np.stack(reqs)
         qis = np.array([r["qi"] for r in rows], np.int64)
         pcs = np.array([r["pc"] for r in rows], np.int64)
         self._sg.write_batch(
@@ -985,7 +1000,7 @@ class IncrementalBuilder:
     def lease_many(self, rs: Sequence[RunningJob]) -> None:
         """Batched lease: one np.insert on the run table for the whole
         cycle's placements (a per-lease insert is O(run table) each)."""
-        rows, reqs = [], []
+        rows, resl = [], []
         atoms: Optional[list] = [] if self.market else None
         for r in rs:
             ni = self.node_index.get(r.node_id)
@@ -1006,11 +1021,6 @@ class IncrementalBuilder:
             else:
                 level = self.level_of_priority[pc.priority]
                 preemptible = pc.preemptible
-            req = (
-                self.factory.ceil_units(r.job.resources.atoms).astype(np.float32)
-                if r.job.resources is not None
-                else np.zeros((self.R,), np.float32)
-            )
             jid = r.job.id.encode()
             if jid in self.runs:
                 self._release_run(self.runs.remove(jid))
@@ -1033,7 +1043,7 @@ class IncrementalBuilder:
                     "pok": (not r.job.pools) or (self.pool in r.job.pools),
                 }
             )
-            reqs.append(req)
+            resl.append(r.job.resources)
             if atoms is not None:
                 atoms.append(
                     np.asarray(r.job.resources.atoms, np.int64)
@@ -1042,11 +1052,12 @@ class IncrementalBuilder:
                 )
         if not rows:
             return
+        reqs_arr = self._batch_reqs(resl)
+        reqs = list(reqs_arr)
         slots = self._rr.alloc(len(rows))
         for r, s in zip(rows, slots):
             r["slot"] = s
         self.runs.insert_batch(rows, reqs, atoms)
-        reqs_arr = np.stack(reqs)
         qis = np.array([r["qi"] for r in rows], np.int64)
         pcs = np.array([r["pc"] for r in rows], np.int64)
         self._rr.write_batch(
@@ -1067,6 +1078,18 @@ class IncrementalBuilder:
         self.running_gang_specs.pop(job_id, None)
         self._pending_runs.pop(job_id, None)
         self._release_run(self.runs.remove(job_id.encode()))
+
+    def unlease_if_present(self, job_id: str, jid_b: Optional[bytes] = None) -> None:
+        """Feed hot-path unlease: O(1) dict membership checks first, so the
+        common case -- a fresh submit that was never leased in this pool --
+        skips the encode + run-table probe the JobDb feed otherwise pays
+        per builder per upsert (scheduler/incremental_algo.apply_job)."""
+        if (
+            (jid_b if jid_b is not None else job_id.encode()) in self.runs
+            or job_id in self._pending_runs
+            or job_id in self.running_gang_specs
+        ):
+            self.unlease(job_id)
 
     def _flush_pending_runs(self) -> None:
         ready = [
@@ -1674,6 +1697,140 @@ class IncrementalBuilder:
         self._stable_smalls[name] = arr
         return arr
 
+    def _single_content_cols(self, i_sing: np.ndarray, prices) -> dict:
+        """Gang-axis content rows for singles-region slots `i_sing` -- the
+        ONE extraction both assemble_delta's bundle and prefetch_content
+        share, so the prefetched bytes are bit-identical to what the cycle
+        bundle would have scattered."""
+        sg = self._sg
+        n = i_sing.shape[0]
+        if prices is not None:
+            # per-slot price is a pure function of (queue, band); stale
+            # content at free slots is g_absent so any value is harmless
+            sing_price = prices[
+                sg.queue[i_sing].astype(np.int64), sg.band[i_sing].astype(np.int64)
+            ]
+        else:
+            sing_price = np.zeros((n,), np.float32)
+        valid = sg.valid[i_sing]
+        return {
+            "g_req": sg.req[i_sing],
+            "g_card": np.ones((n,), np.int32),
+            "g_level": sg.level[i_sing],
+            "g_queue": sg.queue[i_sing],
+            "g_key": sg.key[i_sing],
+            "g_pc": sg.pc[i_sing],
+            "g_run": np.full((n,), -1, np.int32),
+            "g_valid": valid,
+            "g_absent": ~valid,
+            "g_price": sing_price,
+            "g_spot_price": sing_price,
+            "g_ban_row": np.zeros((n,), np.int32),
+        }
+
+    def _run_content_cols(
+        self, rr_dirty: np.ndarray, s_cap: int, prices
+    ) -> tuple[dict, dict]:
+        """Run-axis rows + their evictee-region projection for run slots
+        `rr_dirty` (shared by assemble_delta and prefetch_content)."""
+        rr = self._rr
+        if prices is not None:
+            ev_price = prices[
+                rr.queue[rr_dirty].astype(np.int64), rr.band[rr_dirty].astype(np.int64)
+            ]
+        else:
+            ev_price = np.zeros((rr_dirty.shape[0],), np.float32)
+        rr_valid_rows = rr.valid[rr_dirty]
+        rr_preempt_rows = rr.preempt[rr_dirty]
+        ev_valid_rows = rr_valid_rows & rr_preempt_rows
+        rr_cols = {
+            "run_req": rr.req[rr_dirty],
+            "run_node": rr.node[rr_dirty],
+            "run_level": rr.level[rr_dirty],
+            "run_queue": rr.queue[rr_dirty],
+            "run_pc": rr.pc[rr_dirty],
+            "run_preemptible": rr_preempt_rows,
+            "run_gang": np.where(
+                ev_valid_rows, (s_cap + rr_dirty).astype(np.int32), np.int32(-1)
+            ),
+            "run_valid": rr_valid_rows,
+        }
+        ev_cols = {
+            "g_req": rr.req[rr_dirty],
+            "g_level": rr.level[rr_dirty],
+            "g_queue": rr.queue[rr_dirty],
+            "g_pc": rr.pc[rr_dirty],
+            "g_run": rr_dirty.astype(np.int32),
+            "g_valid": ev_valid_rows,
+            "g_absent": ~ev_valid_rows,
+            "g_price": ev_price,
+            "g_spot_price": ev_price,
+        }
+        return rr_cols, ev_cols
+
+    def prefetch_content(self, devcache) -> int:
+        """Shadow-pipeline stage (b): ship decision-INDEPENDENT dirty slot
+        rows (new submits, caller-synced leases) to the device NOW -- while
+        the current round's kernel and result transfer occupy the tunnel --
+        so the next assemble_delta's bundle only carries lease/evict rows
+        that genuinely had to wait for decode.
+
+        The dependency classification this encodes (ISSUE 3): slot CONTENT
+        is final the moment the table mutation lands and may ship any time
+        before the next assemble; candidate ORDER, queue tensors, demand
+        shares and scalars are functions of the whole post-decision state
+        and only ever ship with assemble_delta's bundle.  Shipping content
+        early is bit-neutral -- the device ends the next apply identical to
+        materialize() either way (tests/test_pipeline.py pins it).
+
+        Returns the number of rows shipped (0 = skipped).  Skips -- and the
+        rows simply ride the next bundle or its full-upload fallback --
+        when: the pool is market-driven (per-slot prices are a per-cycle
+        function of the bid table, not final until assemble); no bundle was
+        emitted yet; slab/node/price epochs moved since the last bundle
+        (the next apply full-uploads anyway, and a scatter against the old
+        shapes would silently drop rows); or the device cache is not
+        exactly at the last bundle's state."""
+        if self.market or self._last_sig is None:
+            return 0
+        sg, rr = self._sg, self._rr
+        new_sg = sg.dirty_log[self._shipped_sg :]
+        new_rr = rr.dirty_log[self._shipped_rr :]
+        if not new_sg and not new_rr:
+            return 0
+        s_cap, r_cap = sg.cap, rr.cap
+        sig = (
+            s_cap + r_cap + self._u_cap,
+            r_cap,
+            self._last_sig[2],  # N: node_epoch match implies the same pad
+            self._last_sig[3],  # Q: content rows never reshape the queue axis
+            sg.epoch,
+            rr.epoch,
+            self._u_cap,
+            self._node_epoch,
+            self._price_epoch,
+        )
+        if sig != self._last_sig:
+            return 0
+        i_sing = np.unique(np.asarray(new_sg, np.int64))
+        rr_d = np.unique(np.asarray(new_rr, np.int64))
+        rr_cols, ev_cols = self._run_content_cols(rr_d, s_cap, None)
+        ok = devcache.scatter_content(
+            sig=sig,
+            seq=self._bundle_seq,
+            ev_base=s_cap,
+            sg_idx=i_sing,
+            sg_cols=self._single_content_cols(i_sing, None),
+            rr_idx=rr_d,
+            rr_cols=rr_cols,
+            ev_cols=ev_cols,
+        )
+        if not ok:
+            return 0
+        self._shipped_sg = len(sg.dirty_log)
+        self._shipped_rr = len(rr.dirty_log)
+        return int(i_sing.shape[0] + rr_d.shape[0])
+
     def assemble_delta(
         self,
         *,
@@ -1999,21 +2156,40 @@ class IncrementalBuilder:
         S_slots = max(1, min(max(nreal_candidates, 1), burst_cfg))
 
         # --- dirty extraction -------------------------------------------------
-        sg_dirty = (
-            np.unique(np.asarray(sg.dirty_log, np.int64))
+        # Two views of each dirty log: ALL dirtied slots (the gq splice and
+        # any order accounting must treat a prefetched slot as moved), and
+        # the PAYLOAD suffix -- rows not already shipped mid-cycle by
+        # prefetch_content.  A slot both prefetched and re-dirtied later
+        # appears in the suffix and re-ships (content wins by last write).
+        sg_log = (
+            np.asarray(sg.dirty_log, np.int64)
             if sg.dirty_log
             else np.zeros((0,), np.int64)
         )
+        sg_dirty_all = np.unique(sg_log)
+        sg_dirty = (
+            np.unique(sg_log[self._shipped_sg :])
+            if self._shipped_sg
+            else sg_dirty_all
+        )
         sg.dirty_log.clear()
+        self._shipped_sg = 0
         unit_dirty = np.arange(u_base, u_base + max(u_n, self._u_prev_n), dtype=np.int64)
         self._u_prev_n = u_n
         sg_idx = np.concatenate([sg_dirty, unit_dirty])
-        rr_dirty = (
-            np.unique(np.asarray(rr.dirty_log, np.int64))
+        rr_log = (
+            np.asarray(rr.dirty_log, np.int64)
             if rr.dirty_log
             else np.zeros((0,), np.int64)
         )
+        rr_dirty_all = np.unique(rr_log)
+        rr_dirty = (
+            np.unique(rr_log[self._shipped_rr :])
+            if self._shipped_rr
+            else rr_dirty_all
+        )
         rr.dirty_log.clear()
+        self._shipped_rr = 0
 
         # --- gq splice: rebuild the order vector ON DEVICE from last cycle's
         # (slab.DeltaBundle.gq_splice) instead of re-uploading 4MB.  Sound
@@ -2030,8 +2206,13 @@ class IncrementalBuilder:
         L1 = int(nreal_candidates)
         if prev_gq is not None and prev_gq.shape[0] == G:
             dirty_slot = np.zeros((G,), bool)
-            dirty_slot[sg_idx[sg_idx < G]] = True  # singles + units regions
-            ev_dirty = s_cap + rr_dirty
+            # ALL dirtied slots, prefetched or not: a prefetched slot's
+            # content is on device but its ORDER position may have moved
+            # (release + re-alloc keeps the id), so it must not count as a
+            # splice survivor.
+            dirty_slot[sg_dirty_all[sg_dirty_all < G]] = True
+            dirty_slot[unit_dirty[unit_dirty < G]] = True
+            ev_dirty = s_cap + rr_dirty_all
             dirty_slot[ev_dirty[ev_dirty < G]] = True  # evictee projection
             prev_real = prev_gq[:L0]
             in_new = np.zeros((G,), bool)
@@ -2074,67 +2255,15 @@ class IncrementalBuilder:
         i_unit = sg_idx[is_unit] - u_base
         k = sg_idx.shape[0]
 
-        def sg_field(name, sing_vals, dtype):
-            out = np.zeros((k,) + sing_vals.shape[1:], dtype)
+        def sg_field(name, sing_vals):
+            out = np.zeros((k,) + sing_vals.shape[1:], uc[name].dtype)
             out[~is_unit] = sing_vals
             out[is_unit] = uc[name][i_unit]
             return out
 
-        if prices is not None:
-            # per-slot price is a pure function of (queue, band); stale
-            # content at free slots is g_absent so any value is harmless
-            sing_price = prices[
-                sg.queue[i_sing].astype(np.int64), sg.band[i_sing].astype(np.int64)
-            ]
-            ev_price = prices[
-                rr.queue[rr_dirty].astype(np.int64), rr.band[rr_dirty].astype(np.int64)
-            ]
-        else:
-            sing_price = np.zeros((i_sing.shape[0],), np.float32)
-            ev_price = np.zeros((rr_dirty.shape[0],), np.float32)
-        sg_valid_rows = sg.valid[i_sing]
-        sg_cols = {
-            "g_req": sg_field("g_req", sg.req[i_sing], np.float32),
-            "g_card": sg_field("g_card", np.ones((i_sing.shape[0],), np.int32), np.int32),
-            "g_level": sg_field("g_level", sg.level[i_sing], np.int32),
-            "g_queue": sg_field("g_queue", sg.queue[i_sing], np.int32),
-            "g_key": sg_field("g_key", sg.key[i_sing], np.int32),
-            "g_pc": sg_field("g_pc", sg.pc[i_sing], np.int32),
-            "g_run": sg_field("g_run", np.full((i_sing.shape[0],), -1, np.int32), np.int32),
-            "g_valid": sg_field("g_valid", sg_valid_rows, bool),
-            "g_absent": sg_field("g_absent", ~sg_valid_rows, bool),
-            "g_price": sg_field("g_price", sing_price, np.float32),
-            "g_spot_price": sg_field("g_spot_price", sing_price, np.float32),
-            "g_ban_row": sg_field(
-                "g_ban_row", np.zeros((i_sing.shape[0],), np.int32), np.int32
-            ),
-        }
-        rr_valid_rows = rr.valid[rr_dirty]
-        rr_preempt_rows = rr.preempt[rr_dirty]
-        ev_valid_rows = rr_valid_rows & rr_preempt_rows
-        rr_cols = {
-            "run_req": rr.req[rr_dirty],
-            "run_node": rr.node[rr_dirty],
-            "run_level": rr.level[rr_dirty],
-            "run_queue": rr.queue[rr_dirty],
-            "run_pc": rr.pc[rr_dirty],
-            "run_preemptible": rr_preempt_rows,
-            "run_gang": np.where(
-                ev_valid_rows, (s_cap + rr_dirty).astype(np.int32), np.int32(-1)
-            ),
-            "run_valid": rr_valid_rows,
-        }
-        ev_cols = {
-            "g_req": rr.req[rr_dirty],
-            "g_level": rr.level[rr_dirty],
-            "g_queue": rr.queue[rr_dirty],
-            "g_pc": rr.pc[rr_dirty],
-            "g_run": rr_dirty.astype(np.int32),
-            "g_valid": ev_valid_rows,
-            "g_absent": ~ev_valid_rows,
-            "g_price": ev_price,
-            "g_spot_price": ev_price,
-        }
+        sc = self._single_content_cols(i_sing, prices)
+        sg_cols = {name: sg_field(name, vals) for name, vals in sc.items()}
+        rr_cols, ev_cols = self._run_content_cols(rr_dirty, s_cap, prices)
 
         fulls = {
             # omitted when the splice carries the order (a few KB vs 4MB)
@@ -2288,6 +2417,7 @@ class IncrementalBuilder:
         )
         seq = self._bundle_seq
         self._bundle_seq += 1
+        self._last_sig = sig
         bundle = DeltaBundle(
             sig=sig,
             seq=seq,

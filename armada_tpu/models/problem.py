@@ -1326,6 +1326,9 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
         return None
     buf_dev, fcap, ecap = d
     buf = np.asarray(buf_dev)
+    from armada_tpu.models.xfer import TRANSFER_STATS
+
+    TRANSFER_STATS.count_down(buf.nbytes)
     n_slots, iterations, termination, _sched_count, spot_bits, n_failed, n_pre, n_res = (
         int(v) for v in buf[:_COMPACT_HEADER]
     )

@@ -41,6 +41,8 @@ import functools
 
 import numpy as np
 
+from armada_tpu.models.xfer import TRANSFER_STATS
+
 _ID_DTYPE = "S48"
 
 # Dirty-index buckets: scatter index vectors are padded to these sizes so the
@@ -303,6 +305,7 @@ class DeviceDeltaCache:
         self._seq = None
         self._prev = None
         self.splice_applies = 0  # cycles where gq rode the device splice
+        self.content_prefetches = 0  # scatter_content applications
         # host-object identity of what is currently on device, per field;
         # node tensors also keep their device copy for reuse across full
         # uploads (the fleet rarely changes).
@@ -321,6 +324,7 @@ class DeviceDeltaCache:
             ):
                 out.append(self._node_dev[name])
             else:
+                TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
                 dev = jnp.asarray(arr)
                 if name in _NODE_FIELDS:
                     self._node_dev[name] = dev
@@ -360,6 +364,7 @@ class DeviceDeltaCache:
         for name, arr in bundle.fulls.items():
             if self._host_ids.get(name) is arr:
                 continue  # unchanged object, device copy is current
+            TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
             if name in _NODE_FIELDS:
                 # keep the reusable device copy current, else a later full
                 # upload would resurrect a stale buffer via _node_dev
@@ -383,6 +388,11 @@ class DeviceDeltaCache:
             self.splice_applies += 1
         else:
             gq_args = ()
+        for arr in (sg_idx, rr_idx, *gq_args):
+            TRANSFER_STATS.count_up(arr.nbytes)
+        for cols in (sg_cols, rr_cols, ev_cols):
+            for arr in cols.values():
+                TRANSFER_STATS.count_up(arr.nbytes)
         if _APPLY is None:
             _APPLY = _make_apply()
         self._prev = _APPLY(
@@ -390,3 +400,58 @@ class DeviceDeltaCache:
             gq_args, ev_base=bundle.ev_base, splice=splice,
         )
         return self._prev
+
+    def scatter_content(
+        self, *, sig, seq, ev_base, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols
+    ) -> bool:
+        """Content-only prefetch: scatter already-final slot rows into the
+        device problem WITHOUT a cycle bundle -- the shadow-pipeline's
+        stage (b) (ISSUE 3): new-submit rows ship while the kernel and its
+        result transfer are in flight, so the next assemble's bundle only
+        carries lease/evict-dependent rows.
+
+        This is the decision-INDEPENDENT half of the delta stream: order
+        vectors, queue tensors, demand shares and scalars (the `fulls` +
+        gq splice) are decision-dependent and only ever ship with
+        assemble_delta's bundle.  A content scatter never consumes a seq --
+        the next bundle continues the chain, and the builder excludes the
+        prefetched rows from its payload (incremental.prefetch_content).
+
+        Guards: the caller must target the exact device state its last
+        bundle produced -- same sig (shapes/epochs) and the very next seq
+        (`seq` = the seq the NEXT bundle will carry).  Anything else (slab
+        growth, a skipped bundle, a fresh cache) returns False and the rows
+        simply ride the next bundle or its full-upload fallback."""
+        global _APPLY
+
+        if (
+            self._prev is None
+            or self._sig != sig
+            or self._seq is None
+            or seq != self._seq + 1
+        ):
+            return False
+        G = self._prev.g_req.shape[0]
+        RJ = self._prev.run_req.shape[0]
+        kg = _pad_bucket(sg_idx.shape[0])
+        kr = _pad_bucket(rr_idx.shape[0])
+        sg_pad = np.full((kg,), G, np.int32)
+        sg_pad[: sg_idx.shape[0]] = sg_idx
+        rr_pad = np.full((kr,), RJ, np.int32)
+        rr_pad[: rr_idx.shape[0]] = rr_idx
+        sg_cols = {n: _pad_rows(sg_cols[n], kg) for n in _SG_FIELDS}
+        rr_cols = {n: _pad_rows(rr_cols[n], kr) for n in _RR_FIELDS}
+        ev_cols = {n: _pad_rows(ev_cols[n], kr) for n in _EV_FIELDS}
+        for arr in (sg_pad, rr_pad):
+            TRANSFER_STATS.count_up(arr.nbytes)
+        for cols in (sg_cols, rr_cols, ev_cols):
+            for arr in cols.values():
+                TRANSFER_STATS.count_up(arr.nbytes)
+        if _APPLY is None:
+            _APPLY = _make_apply()
+        self._prev = _APPLY(
+            self._prev, sg_pad, sg_cols, rr_pad, rr_cols, ev_cols, {},
+            (), ev_base=ev_base, splice=False,
+        )
+        self.content_prefetches += 1
+        return True

@@ -1,0 +1,48 @@
+"""Per-cycle device-transfer accounting.
+
+The axon TPU tunnel's economics (~0.1s fixed latency per transfer, ~16MB/s
+up, ~6MB/s down) make PER-CYCLE TRANSFER COUNT AND BYTES the end-to-end
+lever -- a regression that doubles the upload payload is invisible in a
+CPU-only run's wall clock but fatal on the real tunnel.  These counters
+make that legible without a TPU: the slab delta cache counts every
+host->device array it ships (slab.DeviceDeltaCache), the compact decode
+counts its device->host fetch (problem._fetch_compact), and bench.py /
+tools/sidecar_profile.py report the per-cycle numbers.
+
+Counters are process-global and single-threaded like the cycle itself;
+``reset()`` at cycle start, ``snapshot()`` at cycle end.
+"""
+
+from __future__ import annotations
+
+
+class TransferStats:
+    __slots__ = ("up_transfers", "up_bytes", "down_transfers", "down_bytes")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.up_transfers = 0
+        self.up_bytes = 0
+        self.down_transfers = 0
+        self.down_bytes = 0
+
+    def count_up(self, nbytes: int) -> None:
+        self.up_transfers += 1
+        self.up_bytes += int(nbytes)
+
+    def count_down(self, nbytes: int) -> None:
+        self.down_transfers += 1
+        self.down_bytes += int(nbytes)
+
+    def snapshot(self) -> dict:
+        return {
+            "up_transfers": self.up_transfers,
+            "up_bytes": self.up_bytes,
+            "down_transfers": self.down_transfers,
+            "down_bytes": self.down_bytes,
+        }
+
+
+TRANSFER_STATS = TransferStats()

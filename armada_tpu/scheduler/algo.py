@@ -23,6 +23,7 @@ import uuid
 from typing import Callable, Optional, Sequence
 
 from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
 from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 from armada_tpu.jobdb.job import Job, JobRun
 from armada_tpu.jobdb.jobdb import WriteTxn
@@ -199,16 +200,31 @@ class FairSchedulingAlgo:
         executors: Sequence[ExecutorSnapshot],
         now_ns: Optional[int] = None,
         quarantined_nodes: frozenset = frozenset(),
+        shadow_work: Optional[list] = None,
     ) -> SchedulerResult:
         """quarantined_nodes: node ids excluded for high failure rates
         (README.md:28; scheduler/quarantine.py) -- treated like cordoned
-        nodes: running jobs keep counting, nothing new lands."""
+        nodes: running jobs keep counting, nothing new lands.
+
+        shadow_work: zero-arg callables the caller wants run in a kernel
+        shadow (decision-independent host work -- the shadow pipeline's
+        stage (a)/(b)); drained in the first device round's shadow, or
+        inline before returning when no round runs.  Decisions are
+        identical either way -- shadow thunks must not read this cycle's
+        outcome or mutate its problem inputs."""
         now_ns = self._clock_ns() if now_ns is None else now_ns
+        pending_shadow = list(shadow_work or [])
+
+        def drain_shadow():
+            while pending_shadow:
+                pending_shadow.pop(0)()
+
         result = SchedulerResult()
         if self.config.disable_scheduling:
             # Incident brake (config disableScheduling): an EMPTY result, not
             # a skipped cycle, so metrics/reports cadence continues
             # (scheduling_algo.go:116 returns an empty SchedulerResult).
+            drain_shadow()
             return result
 
         healthy = self._healthy_executors(executors, now_ns)
@@ -374,11 +390,26 @@ class FairSchedulingAlgo:
                     queue_penalty=penalty_by_pool.get(pool),
                 )
                 pview = bundle.stats_view()
+                # Kernel shadow: the caller's deferred thunks plus the OTHER
+                # pools' decision-independent slab prefetch (their submit
+                # overlays are already final; this pool's bundle just
+                # applied, so it is skipped) ride this round's kernel +
+                # result transfer.
+                shadow = [drain_shadow]
+                if (
+                    pipeline_enabled()
+                    and len(self.feed.builders) > 1
+                    and prefetch_worthwhile()
+                ):
+                    shadow.append(
+                        lambda p=pool: self.feed.prefetch_content(skip_pool=p)
+                    )
                 res, outcome = run_round_on_device(
                     pview,
                     ctx,
                     self.config,
                     device_problem=self.feed.devcache_for(pool).apply(bundle),
+                    shadow_work=shadow,
                 )
                 if self.collect_stats:
                     collect_round_stats(res, pview, ctx, self.config, outcome)
@@ -547,6 +578,9 @@ class FairSchedulingAlgo:
                 banned_nodes,
             )
 
+        # No device round ran (or the legacy path): the caller's thunks
+        # still execute exactly once, just without a shadow to hide in.
+        drain_shadow()
         return result
 
     def _market_observability(

@@ -116,6 +116,22 @@ class IncrementalProblemFeed:
     def devcache_for(self, pool: str) -> DeviceDeltaCache:
         return self.devcaches[pool]
 
+    def prefetch_content(self, skip_pool: str = None) -> int:
+        """Shadow-pipeline stage (b): ship every builder's decision-
+        independent dirty rows to its device cache now (see
+        IncrementalBuilder.prefetch_content for the soundness boundary and
+        skip conditions).  Called from a kernel shadow (the running pool is
+        skipped -- its bundle already applied) or right after a commit so
+        the upload overlaps the caller's inter-cycle work."""
+        shipped = 0
+        for pool, b in self.builders.items():
+            if pool == skip_pool:
+                continue
+            cache = self.devcaches.get(pool)
+            if cache is not None:
+                shipped += b.prefetch_content(cache)
+        return shipped
+
     # ------------------------------------------------------------ deltas ----
 
     def on_delta(self, upserts: dict, deletes: set) -> None:
@@ -233,8 +249,12 @@ class IncrementalProblemFeed:
             else:
                 self.pool_restricted.discard(job.id)
             self._purge_pending(pending, job.id, leases_too=True)
+            jid_b = job.id.encode()
             for name, b in self.builders.items():
-                b.unlease(job.id)
+                # Guarded: a fresh submit was never leased anywhere, so the
+                # per-builder probe degrades to O(1) dict checks (the feed
+                # hot loop -- ~100ms/cycle of the round-6 profile).
+                b.unlease_if_present(job.id, jid_b)
                 submits, ban_map, _, _ = self._pending_for(pending, name)
                 submits[spec.id] = spec
                 if bans:
@@ -248,15 +268,16 @@ class IncrementalProblemFeed:
         for name in self.builders:
             self._pending_for(pending, name)[3][job.id] = True
         self._purge_pending(pending, job.id, leases_too=True)
+        jid_b = job.id.encode()
         if run is None or run.in_terminal_state():
             for b in self.builders.values():
-                b.unlease(job.id)
+                b.unlease_if_present(job.id, jid_b)
             self._forget_gang(job.id)
             return
         pool = run.pool or "default"
         for name, b in self.builders.items():
             if name != pool:
-                b.unlease(job.id)
+                b.unlease_if_present(job.id, jid_b)
         # Existing builders only: creating one here would skip builder_for's
         # one-time JobDb backfill and permanently hide the queued backlog
         # from a late-discovered pool (the algo creates builders WITH a txn).

@@ -30,6 +30,8 @@ import time
 from typing import Callable, Iterable, Optional, Sequence
 
 from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.logging import get_logger
+from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
 from armada_tpu.events import events_pb2 as pb
 from armada_tpu.eventlog.publisher import Publisher, wait_for_markers
 from armada_tpu.ingest.schedulerdb import SchedulerDb
@@ -46,6 +48,8 @@ from armada_tpu.scheduler.submitcheck import SubmitChecker
 MAX_RETRIES_EXCEEDED = "maxRetriesExceeded"
 PREEMPTED_REASON = "preempted"
 LEASE_EXPIRED = "leaseExpired"
+
+_log = get_logger(__name__)
 
 
 @dataclasses.dataclass
@@ -307,6 +311,25 @@ class Scheduler:
             if self.config.enable_assertions:
                 txn.assert_invariants()
             txn.commit()
+            feed = getattr(self.algo, "feed", None)
+            if (
+                schedule
+                and feed is not None
+                and pipeline_enabled()
+                and prefetch_worthwhile()
+            ):
+                # Shadow-pipeline stage (b): the commit's subscriber fire
+                # just applied this cycle's decisions to the builders; start
+                # their slab upload NOW so the transfer overlaps the
+                # inter-cycle idle and the next cycle's sync instead of
+                # serializing inside the next device apply.  Best-effort:
+                # the txn is COMMITTED -- a device error here must not
+                # reach the except below, whose cursor rewind assumes the
+                # cycle did not commit (the rows ride the next bundle).
+                try:
+                    feed.prefetch_content()
+                except Exception:
+                    _log.warning("content prefetch failed", exc_info=True)
             return result
         except BaseException:
             txn.abort()

@@ -31,6 +31,8 @@ import uuid
 from typing import Optional, Sequence
 
 from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.logging import get_logger
+from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
 from armada_tpu.core.types import Queue
 from armada_tpu.events.convert import job_spec_from_proto
 from armada_tpu.jobdb.job import Job, JobRun
@@ -40,6 +42,8 @@ from armada_tpu.scheduler.providers import most_specific_bid
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 
 FAILED_SAMPLE_CAP = 1000
+
+_log = get_logger(__name__)
 
 
 class UnknownSession(KeyError):
@@ -215,6 +219,25 @@ class ScheduleSession:
                         [_job_from_state(m, self.factory) for m in jobs]
                     )
                 txn.commit()
+                if (
+                    self.feed is not None
+                    and pipeline_enabled()
+                    and prefetch_worthwhile()
+                ):
+                    # Shadow-pipeline stage (b): the commit just landed these
+                    # caller-asserted rows in the builders -- start their
+                    # slab upload NOW, so the tunnel transfer overlaps the
+                    # rest of the sync and the next round's assemble instead
+                    # of serializing inside its device apply.  Best-effort:
+                    # the mirror COMMITTED, so a device error here must not
+                    # fail the sync (the caller would wrongly retry state
+                    # that applied); the rows just ride the next bundle.
+                    try:
+                        self.feed.prefetch_content()
+                    except Exception:
+                        _log.warning(
+                            "sync content prefetch failed", exc_info=True
+                        )
             if executors is not None:
                 self.executors = list(executors)
             if queues is not None:
@@ -241,33 +264,44 @@ class ScheduleSession:
     ) -> SchedulerResult:
         with self._lock:
             txn = self.jobdb.write_txn()
+            now = now_ns or self._clock_ns()
+
+            def sweep():
+                # Sweep synced terminal jobs once they leave the short-job
+                # penalty window (immediately when no penalty is
+                # configured): only ids from _terminal_synced, O(tracked),
+                # never a backlog scan.  Decision-independent (terminal
+                # jobs can neither schedule nor preempt, and builders only
+                # see txn deletes at commit), so the pipelined round runs
+                # it in the kernel shadow; final mirror state is identical
+                # either way (tests/test_pipeline.py).
+                window = int(
+                    max(
+                        self.config.short_job_penalty_cutoffs().values(),
+                        default=0.0,
+                    )
+                    * 1e9
+                )
+                expired = [
+                    jid
+                    for jid, ns in self._terminal_synced.items()
+                    if ns == 0 or now - ns >= window
+                ]
+                if expired:
+                    txn.delete(expired)
+                    for jid in expired:
+                        self._terminal_synced.pop(jid, None)
+
+            pipelined = pipeline_enabled()
             result = self.algo.schedule(
                 txn,
                 self.executors,
                 now_ns=now_ns or None,
                 quarantined_nodes=frozenset(quarantined),
+                shadow_work=[sweep] if pipelined else None,
             )
-            # Sweep synced terminal jobs once they leave the short-job
-            # penalty window (immediately when no penalty is configured):
-            # only ids from _terminal_synced, O(tracked), never a backlog
-            # scan.
-            now = now_ns or self._clock_ns()
-            window = int(
-                max(
-                    self.config.short_job_penalty_cutoffs().values(),
-                    default=0.0,
-                )
-                * 1e9
-            )
-            expired = [
-                jid
-                for jid, ns in self._terminal_synced.items()
-                if ns == 0 or now - ns >= window
-            ]
-            if expired:
-                txn.delete(expired)
-                for jid in expired:
-                    self._terminal_synced.pop(jid, None)
+            if not pipelined:
+                sweep()
             # Commit the mirror like the in-process scheduler commits its
             # jobDb: later rounds must see this round's leases.  The caller
             # re-asserting job state via SyncState is idempotent on top.

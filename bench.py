@@ -264,8 +264,14 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
     legacy_build = os.environ.get("ARMADA_BENCH_LEGACY_BUILD") == "1"
     devcache = DeviceProblemCache() if legacy_build else DeviceDeltaCache()
 
+    from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
+    from armada_tpu.models.xfer import TRANSFER_STATS
+
+    do_prefetch = not legacy_build and prefetch_worthwhile()
+
     def cycle(t_now):
         nonlocal kw
+        TRANSFER_STATS.reset()
         t_start = time.perf_counter()
         trace = os.environ.get("ARMADA_BENCH_TRACE") == "1"
         if legacy_build:
@@ -289,11 +295,16 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
         result = schedule_round(dev, **kw)
         # Overlapped decode (default): the compaction + its device->host copy
         # are enqueued BEHIND the kernel without a host sync, and the cycle's
-        # decision-independent work (next submits) runs while kernel +
-        # transfer are in flight -- each avoided sync/fetch round trip costs
-        # ~0.1s on the axon tunnel.  ARMADA_BENCH_NO_OVERLAP=1 restores the
-        # blocking flow for A/B (its keys split upload+kernel vs decode).
-        overlap = os.environ.get("ARMADA_BENCH_NO_OVERLAP") != "1"
+        # decision-independent work (next submits + their slab prefetch)
+        # runs while kernel + transfer are in flight -- each avoided
+        # sync/fetch round trip costs ~0.1s on the axon tunnel.
+        # ARMADA_BENCH_NO_OVERLAP=1 (or the global ARMADA_PIPELINE=0
+        # escape hatch) restores the blocking sequential flow for A/B (its
+        # keys split upload+kernel vs decode).
+        overlap = (
+            pipeline_enabled()
+            and os.environ.get("ARMADA_BENCH_NO_OVERLAP") != "1"
+        )
         if overlap:
             t_disp0 = time.perf_counter()
             finish = begin_decode(result, ctx)
@@ -302,11 +313,18 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
             for s in fresh:
                 spec_of[s.id] = s
             builder.submit_many(fresh)
+            # Shadow-pipeline stage (b): ship the fresh submits' slab rows
+            # while the kernel + result transfer hold the tunnel, so the
+            # next cycle's device apply only carries lease/evict rows.
+            prefetched = (
+                builder.prefetch_content(devcache) if do_prefetch else 0
+            )
             t_kernel = time.perf_counter()  # dispatch + overlapped submits
             if trace:
                 print(
                     f"bench-trace: dispatch={t_disp - t_disp0:.4f} "
-                    f"submits={t_kernel - t_disp:.4f}",
+                    f"submits={t_kernel - t_disp:.4f} "
+                    f"prefetched_rows={prefetched}",
                     file=sys.stderr,
                 )
             if trace:
@@ -364,6 +382,10 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
                 "assemble_s": round(t_asm - t_start, 4),
                 "upload_kernel_s": round(t_kernel - t_asm, 4),
                 "decode_apply_s": round(t_end - t_kernel, 4),
+                # Per-cycle device-transfer counters (models/xfer.py): the
+                # tunnel's fixed per-transfer latency makes COUNT the e2e
+                # lever, so payload regressions stay legible without a TPU.
+                **TRANSFER_STATS.snapshot(),
             },
             len(outcome.scheduled),
         )
@@ -535,6 +557,8 @@ def _sidecar_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
 
 
 def main():
+    from armada_tpu.core.pipeline import pipeline_enabled as _pipeline_enabled
+
     watchdog = _arm_watchdog()
     platform, init_err = _ready_backend()
     # Persistent XLA cache: warm starts skip the 15-40s kernel compile
@@ -578,6 +602,8 @@ def main():
         "loadavg_1m": round(load_end[0], 2),
         "loadavg_1m_before_e2e": round(load_start[0], 2),
         "cpu_count": os.cpu_count(),
+        # ARMADA_PIPELINE=0 is the sequential A/B arm (shadow pipeline off).
+        "pipeline": int(_pipeline_enabled()),
         **parts,
     }
     if burst != 1_000:

@@ -110,6 +110,9 @@ _FAST_PICKS_OVERRIDE = {
     "tests/test_decode_compact.py": 1,
     "tests/test_slab_delta.py": 1,
     "tests/test_parallel_sharding.py": 1,
+    # 2 representatives + the explicitly-marked ARMADA_PIPELINE=0 parity
+    # guard (the sequential escape hatch must not rot out of the fast tier).
+    "tests/test_pipeline.py": 2,
 }
 # Never in the fast tier (opt-in external deps / native builds).
 _FAST_EXCLUDE_MODULES = {

@@ -129,29 +129,69 @@ def main():
         )
     print(f"setup {time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
+    from armada_tpu.models.xfer import TRANSFER_STATS
+
     def cycle():
         clock[0] += 10**9
         fresh = spec_factory(burst, clock[0] / 1e9)
         states = [state_of_spec(s) for s in fresh]
+        TRANSFER_STATS.reset()
         t = time.perf_counter()
         sidecar.handle_sync(pb.SyncStateRequest(session_id=sid, jobs=states))
         t_sync = time.perf_counter() - t
+        xs_sync = TRANSFER_STATS.snapshot()
         t = time.perf_counter()
         resp = sidecar.handle_round(
             pb.ScheduleRoundRequest(session_id=sid, now_ns=clock[0])
         )
         t_round = time.perf_counter() - t
-        return t_sync, t_round, len(resp.scheduled)
+        xs = TRANSFER_STATS.snapshot()
+        xs["sync_up_transfers"] = xs_sync["up_transfers"]
+        xs["sync_up_bytes"] = xs_sync["up_bytes"]
+        return t_sync, t_round, len(resp.scheduled), xs
 
-    # warm-up
+    # Pipeline A/B over the SAME live session (the sidecar reads
+    # ARMADA_PIPELINE / ARMADA_PIPELINE_PREFETCH per call): warmed cycles
+    # per arm, with per-cycle device-transfer counters split by phase -- on
+    # the real tunnel, upload work counted in the SYNC phase overlaps the
+    # caller's cycle instead of the round's critical path, so the
+    # sync-vs-round split is the number to watch even on a CPU host.
+    # Arms: pipelined+prefetch (the TPU-shaped config, scatter forced on),
+    # pipelined (CPU default: shadow order only), sequential.  The
+    # operator's own env is restored afterwards so the cProfile below
+    # measures the configuration that was asked for.
+    env0 = {
+        k: os.environ.get(k)
+        for k in ("ARMADA_PIPELINE", "ARMADA_PIPELINE_PREFETCH")
+    }
     for _ in range(2):
         cycle()
-    times = []
-    for _ in range(3):
-        times.append(cycle())
-    for ts, tr, n in times:
-        print(f"sync {ts:.3f}s round {tr:.3f}s total {ts+tr:.3f}s sched {n}",
-              file=sys.stderr)
+    for arm, label in (
+        (("1", "1"), "pipelined+prefetch"),
+        (("1", None), "pipelined"),
+        (("0", "0"), "sequential"),
+    ):
+        os.environ["ARMADA_PIPELINE"] = arm[0]
+        if arm[1] is None:
+            os.environ.pop("ARMADA_PIPELINE_PREFETCH", None)
+        else:
+            os.environ["ARMADA_PIPELINE_PREFETCH"] = arm[1]
+        cycle()  # settle the arm (first cycle pays any carried-over state)
+        for _ in range(3):
+            ts, tr, n, xs = cycle()
+            print(
+                f"[{label}] sync {ts:.3f}s round {tr:.3f}s total "
+                f"{ts+tr:.3f}s sched {n} | sync-up "
+                f"{xs['sync_up_transfers']}x/{xs['sync_up_bytes']/1e6:.2f}MB "
+                f"cycle-up {xs['up_transfers']}x/{xs['up_bytes']/1e6:.2f}MB "
+                f"down {xs['down_transfers']}x/{xs['down_bytes']/1e6:.3f}MB",
+                file=sys.stderr,
+            )
+    for k, v in env0.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
 
     pr = cProfile.Profile()
     pr.enable()
