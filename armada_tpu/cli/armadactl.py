@@ -529,6 +529,8 @@ _SERVE_FALLBACKS = {
     "advertised_address": None,
     "database_url": None,
     "lookout_database_url": None,
+    # None -> start_control_plane resolves ARMADA_WATCHDOG_S or 120s.
+    "watchdog_s": None,
 }
 
 
@@ -580,6 +582,7 @@ def load_serve_config(args):
         "advertised_address": ("advertisedaddress", str),
         "database_url": ("databaseurl", str),
         "lookout_database_url": ("lookoutdatabaseurl", str),
+        "watchdog_s": ("watchdogs", float),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -624,6 +627,7 @@ def cmd_serve(args):
         proxy_bearer_token=getattr(args, "proxy_bearer_token", None),
         database_url=getattr(args, "database_url", None),
         lookout_database_url=getattr(args, "lookout_database_url", None),
+        watchdog_s=getattr(args, "watchdog_s", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -633,11 +637,27 @@ def cmd_serve(args):
     if plane.rest_gateway is not None:
         print(f"REST gateway on http://127.0.0.1:{plane.rest_gateway.port}/v1/")
     print(f"state in {args.data_dir}")
+    # Graceful drain on SIGTERM (the k8s/systemd stop signal): reject new
+    # RPCs immediately, give in-flight ones a real drain window (an
+    # executor's lease call or a sidecar round mid-flight completes instead
+    # of surfacing as a spurious UNAVAILABLE during every rollout).
+    import signal
+    import threading as _threading
+
+    term = _threading.Event()
     try:
-        plane.wait()
+        signal.signal(signal.SIGTERM, lambda *_: term.set())
+    except ValueError:
+        pass  # not the main thread (embedded use): no signal handling
+    try:
+        # until SIGTERM or the scheduler loop itself exits
+        while not term.is_set() and not plane.wait(1.0):
+            pass
+        print("shutting down (draining in-flight RPCs)")
+        plane.stop(grace_s=10.0)
     except KeyboardInterrupt:
         print("shutting down")
-        plane.stop()
+        plane.stop()  # idempotent: safe even if the drain was interrupted
     return 0
 
 
@@ -833,6 +853,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--profiling",
         action="store_true",
         help="expose /debug/pprof/* on the health port",
+    )
+    srv.add_argument(
+        "--watchdog-s",
+        type=float,
+        help="device-round watchdog deadline in seconds: a hung/erroring "
+        "device round fails over to the CPU backend from host tables "
+        "(default 120; 0 disables; /healthz reports the degradation state)",
     )
     srv.add_argument(
         "--lookout-port",

@@ -1,0 +1,46 @@
+"""Bounded exponential backoff with full jitter.
+
+Every retry loop that talks to a peer which may be DOWN (the eventlog
+follower tailing a dead leader, the ingestion pipeline replaying a batch
+against a restarting database, the pgwire adapter reconnecting) must not
+spin hot OR retry in lockstep: fixed sleeps synchronize every waiter onto
+the recovering peer at the same instant.  This is the AWS-style
+full-jitter schedule -- delay_n = uniform(0, min(cap, base * 2**n)) -- with
+a floor so a jittered delay never degenerates to a busy loop.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+class Backoff:
+    """One retry loop's schedule; not thread-safe (one loop, one instance)."""
+
+    def __init__(
+        self,
+        base_s: float = 0.2,
+        cap_s: float = 30.0,
+        floor_s: float = 0.05,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.floor_s = min(float(floor_s), float(base_s))
+        self.attempts = 0
+        self._rng = rng or random.Random()
+
+    def reset(self) -> None:
+        self.attempts = 0
+
+    def next_delay(self) -> float:
+        """The delay before the NEXT attempt; advances the attempt count.
+        Callers log the delay and then sleep/wait it themselves (the log
+        line must precede the wait it describes)."""
+        # exponent clamped: 2.0**1024 overflows float, and a sustained
+        # outage (a down DB for an hour) really does reach four-digit
+        # attempt counts -- the cap dominates long before 2**60 anyway
+        ceiling = min(self.cap_s, self.base_s * (2.0 ** min(self.attempts, 60)))
+        self.attempts += 1
+        return max(self.floor_s, self._rng.uniform(0.0, ceiling))

@@ -1,0 +1,113 @@
+"""Fault-injection harness for resilience drills and tests.
+
+The production failure modes this repo must survive -- the axon tunnel
+hanging mid-round (it wedged for ALL of round 2), a severed pgwire socket,
+an event-log publish failure, an executor pod-submit rejection -- are all
+rare and environment-dependent, so the code paths that handle them rot
+unless they can be triggered on demand.  ``ARMADA_FAULT`` injects them:
+
+    ARMADA_FAULT=<site>:<mode>[:<after_n>][,<site>:<mode>[:<after_n>]...]
+
+* ``site``  -- an injection point name (see the catalogue below).
+* ``mode``  -- ``error`` (raise) or ``hang`` (block, bounded by
+  ``ARMADA_FAULT_HANG_S``, default 120s -- long enough that only a watchdog
+  recovers, short enough that abandoned test threads drain).
+* ``after_n`` -- skip the first N checks of that site, fire on check N+1.
+  Each entry fires ONCE and then disarms (counters are process-global), so
+  a drill injects a deterministic single fault and the system's recovery is
+  observable: ``chaos_cycle.py`` and the tests assert convergence after it.
+
+Sites wired in this repo (docs/operations.md has the operator catalogue):
+
+    device_round     the device scheduling round (models.run_round_on_device
+                     worker: dispatch + fetch) -- hang simulates the tunnel
+                     wedge, error simulates an XLA failure
+    pgwire           the external-PostgreSQL adapter's statement path
+                     (ingest/sqladapter.py) -- fires as a severed socket
+    eventlog_publish the event-log publisher (eventlog/publisher.py), before
+                     any append so the failure is all-or-nothing
+    executor_submit  the executor's pod submission (executor/service.py)
+
+Checks are env-driven per call (monkeypatch-friendly) and cost one dict
+lookup when ``ARMADA_FAULT`` is unset.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class FaultInjected(RuntimeError):
+    """An ``error``-mode injected fault.  Subclasses RuntimeError so device
+    sites are handled exactly like a real XLA runtime error."""
+
+
+_lock = threading.Lock()
+# (site, mode, after_n) -> number of checks seen / whether it already fired.
+_counts: dict[tuple, int] = {}
+_fired: set[tuple] = set()
+
+
+def reset_counters() -> None:
+    """Forget check counts and fired state (tests/drills re-arm)."""
+    with _lock:
+        _counts.clear()
+        _fired.clear()
+
+
+def _parse(spec: str):
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            continue  # malformed entries are ignored, not fatal
+        site, mode = parts[0], parts[1]
+        try:
+            after_n = int(parts[2]) if len(parts) > 2 else 0
+        except ValueError:
+            continue
+        yield site, mode, after_n
+
+
+def active(site: str):
+    """The mode to fire for `site` on THIS check, or None.  Advances the
+    per-entry check counter; one-shot (an entry never fires twice)."""
+    spec = os.environ.get("ARMADA_FAULT")
+    if not spec:
+        return None
+    for s, mode, after_n in _parse(spec):
+        if s != site:
+            continue
+        key = (s, mode, after_n)
+        with _lock:
+            if key in _fired:
+                continue
+            n = _counts.get(key, 0)
+            _counts[key] = n + 1
+            if n < after_n:
+                continue
+            _fired.add(key)
+        return mode
+    return None
+
+
+def check(site: str, exc: type = FaultInjected) -> None:
+    """Fire the armed fault for `site`, if any: mode ``error`` raises
+    ``exc`` (default FaultInjected), mode ``hang`` blocks for
+    ARMADA_FAULT_HANG_S seconds (a bounded stand-in for the tunnel wedge:
+    only an external watchdog observes it as a timeout; the hung thread
+    eventually drains so tests do not leak forever-threads)."""
+    mode = active(site)
+    if mode is None:
+        return
+    if mode == "hang":
+        budget = float(os.environ.get("ARMADA_FAULT_HANG_S", 120.0))
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            time.sleep(min(0.05, budget))
+        return
+    raise exc(f"injected fault at {site!r}")

@@ -141,6 +141,27 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(204)
             else:
                 self._respond(503, err.encode())
+        elif path == "/healthz":
+            # Structured liveness: the same checker verdict as /health plus
+            # the DEGRADATION state (device backend, consecutive failures,
+            # last fallback reason -- core/watchdog).  A plane running on
+            # the CPU failover is degraded-but-HEALTHY: liveness must not
+            # flip (restarting it would not fix the tunnel), the operator
+            # reads the device block instead (docs/operations.md runbook).
+            import json
+
+            err = srv.checker.check()
+            body = {"healthy": err is None, "error": err}
+            if srv.device_status is not None:
+                try:
+                    body["device"] = srv.device_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["device"] = {"error": str(exc)}
+            self._respond(
+                200 if err is None else 503,
+                (json.dumps(body) + "\n").encode(),
+                ctype="application/json",
+            )
         elif path == "/ready":
             # Readiness is liveness + the optional gate (e.g. leadership in
             # replicated deployments: followers stay out of the k8s Service
@@ -198,6 +219,9 @@ class HealthServer:
         # Optional () -> error-or-None gate behind /ready (readiness can be
         # stricter than liveness: a healthy follower is alive but not ready).
         self.ready_checker = None
+        # Optional () -> dict: the device-degradation block /healthz embeds
+        # (serve wires core/watchdog.supervisor().snapshot here).
+        self.device_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -41,6 +41,13 @@ def prefetch_worthwhile() -> bool:
     env = os.environ.get("ARMADA_PIPELINE_PREFETCH")
     if env is not None:
         return env != "0"
+    from armada_tpu.core.watchdog import supervisor
+
+    if supervisor().degraded:
+        # Device loss (core/watchdog): data lives on XLA:CPU regardless of
+        # what backend jax reports, so the scatter pass is pure host cost
+        # with no tunnel to hide it -- same economics as the cpu branch.
+        return False
     import jax
 
     return jax.default_backend() != "cpu"

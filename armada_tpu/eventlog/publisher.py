@@ -80,6 +80,13 @@ class Publisher:
             leader = self.write_gate()
             if leader is not None:
                 raise NotLeader(leader)
+        # Fault drill (core/faults): BEFORE any append, so an injected
+        # publish failure is all-or-nothing -- the scheduler's
+        # abort-on-publish-failure discipline (txn abort + cursor rewind)
+        # is what the drill exercises, not partial-append recovery.
+        from armada_tpu.core import faults
+
+        faults.check("eventlog_publish")
         refs: list[PublishedRef] = []
         for seq in sequences:
             key = jobset_key(seq.queue, seq.jobset)

@@ -87,6 +87,13 @@ class LogReplicator:
     # ------------------------------------------------------------------------
 
     def _run_partition(self, partition: int) -> None:
+        from armada_tpu.core.backoff import Backoff
+
+        # Bounded exponential backoff + jitter on tail failures: a dead
+        # leader must not be hammered at poll frequency by every partition
+        # thread of every follower in lockstep; cap keeps takeover lag
+        # bounded once the peer returns.
+        backoff = Backoff(base_s=self._poll, cap_s=30.0)
         while not self._stop.is_set():
             address = None
             try:
@@ -99,6 +106,7 @@ class LogReplicator:
                 continue
             try:
                 self._tail_once(partition, address)
+                backoff.reset()
             except ReplicationDiverged:
                 self.diverged.set()
                 log.error(
@@ -109,13 +117,17 @@ class LogReplicator:
                 )
                 return
             except Exception as e:
+                delay = backoff.next_delay()
                 log.warning(
-                    "partition %d: tail of %s failed (%s); retrying",
+                    "partition %d: tail of %s failed (%s); attempt %d, "
+                    "retrying in %.2fs",
                     partition,
                     address,
                     e,
+                    backoff.attempts,
+                    delay,
                 )
-                self._stop.wait(self._poll)
+                self._stop.wait(delay)
 
     def _tail_once(self, partition: int, address: str) -> None:
         client = self._client_factory(address)

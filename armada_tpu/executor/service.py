@@ -169,6 +169,13 @@ class ExecutorService:
                 self._factory,
             )
             try:
+                # Fault drill (core/faults): an injected pod-submit error
+                # must ride the SAME rejection path as a real apiserver
+                # refusal -- terminal run error event, suppression in
+                # _rejected, no capacity leak.
+                from armada_tpu.core import faults
+
+                faults.check("executor_submit")
                 self.cluster.submit_pod(
                     lease.run_id,
                     lease.job_id,

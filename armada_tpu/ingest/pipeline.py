@@ -91,23 +91,31 @@ class IngestionPipeline:
     def _loop(self) -> None:
         from armada_tpu.core.logging import get_logger, log_context
 
-        backoff = self._poll_interval
         with log_context(consumer=self.consumer_name):
-            self._loop_inner(get_logger(__name__), backoff)
+            self._loop_inner(get_logger(__name__))
 
-    def _loop_inner(self, log, backoff) -> None:
+    def _loop_inner(self, log) -> None:
+        from armada_tpu.core.backoff import Backoff
+
+        # Jittered exponential backoff on batch failures (a restarting
+        # external DB would otherwise see every pipeline retry in lockstep
+        # at the same instant); positions were not acked, so the batch
+        # replays exactly-once when the store recovers.
+        backoff = Backoff(base_s=self._poll_interval, cap_s=5.0)
         while not self._stop.is_set():
             try:
                 n = self.run_once()
-                backoff = self._poll_interval
+                backoff.reset()
             except Exception:  # noqa: BLE001 - service thread must survive
+                delay = backoff.next_delay()
                 log.exception(
-                    "ingestion pipeline %s: batch failed; retrying",
+                    "ingestion pipeline %s: batch failed (attempt %d); "
+                    "retrying in %.2fs",
                     self.consumer_name,
+                    backoff.attempts,
+                    delay,
                 )
-                # positions were not acked: the batch replays after backoff
-                self._stop.wait(min(backoff, 5.0))
-                backoff = min(backoff * 2, 5.0)
+                self._stop.wait(delay)
                 continue
             if n == 0:
                 self._stop.wait(self._poll_interval)

@@ -18,6 +18,7 @@ is shared with SQLite and passes through.
 from __future__ import annotations
 
 import re
+import time
 
 PG_DDL_TYPES = (
     (" BLOB", " BYTEA"),
@@ -117,14 +118,51 @@ class PgAdapter:
         # ingestion hot path
         self._PgError = PgError
         self._transport_errors = (ProtocolError, ConnectionError, OSError)
+        self._connected_once = False
         self._ensure()  # connect eagerly: surface bad DSNs at startup
+
+    # RE-connect attempts per _ensure call (first connect stays fail-fast:
+    # a bad DSN must surface at startup, not after 4 jittered retries).
+    _RECONNECT_ATTEMPTS = 4
 
     def _ensure(self):
         if self._pg is None:
             from armada_tpu.ingest.pgwire import PgConnection
 
-            self._pg = PgConnection(self._dsn)
+            if not self._connected_once:
+                self._pg = PgConnection(self._dsn)
+            else:
+                # Reconnect after a dropped session: bounded exponential
+                # backoff with jitter, so every adapter in the process does
+                # not hammer a restarting server in lockstep; attempts are
+                # capped and the last transport error propagates (the
+                # ingestion pipeline's own retry loop takes over from
+                # there, exactly-once by consumer positions).
+                from armada_tpu.core.backoff import Backoff
+
+                backoff = Backoff(base_s=0.2, cap_s=5.0)
+                import logging
+
+                log = logging.getLogger("armada.pgwire")
+                for attempt in range(self._RECONNECT_ATTEMPTS):
+                    try:
+                        self._pg = PgConnection(self._dsn)
+                        break
+                    except self._transport_errors as e:
+                        if attempt + 1 >= self._RECONNECT_ATTEMPTS:
+                            raise
+                        delay = backoff.next_delay()
+                        log.warning(
+                            "pg reconnect attempt %d/%d failed (%s); "
+                            "retrying in %.2fs",
+                            attempt + 1,
+                            self._RECONNECT_ATTEMPTS,
+                            e,
+                            delay,
+                        )
+                        time.sleep(delay)
             self._in_txn = False
+            self._connected_once = True
         return self._pg
 
     def _drop_session(self) -> None:
@@ -187,6 +225,12 @@ class PgAdapter:
 
     def _transport_guard(self, fn):
         try:
+            # Fault drill (core/faults): an injected severed socket rides
+            # the REAL transport-error path below -- session dropped,
+            # in-flight operation raises, caller replays its un-acked batch.
+            from armada_tpu.core import faults
+
+            faults.check("pgwire", exc=ConnectionError)
             return fn()
         except self._transport_errors:
             self._drop_session()

@@ -18,24 +18,67 @@ from armada_tpu.models.problem import (
 from armada_tpu.models.fair_scheduler import schedule_round, RoundResult
 
 
-def run_round_on_device(problem, ctx, config, device_problem=None, shadow_work=()):
+class _ShadowOnce:
+    """Shadow thunks with run-once accounting across a watchdog failover:
+    the device attempt and the CPU re-run share one cursor, so a thunk that
+    already STARTED in the abandoned worker is never re-entered (a torn
+    re-run would double-apply host mutations; skipping is safe because
+    shadow work is decision-independent and self-healing -- unshipped rows
+    ride the next bundle, unswept terminals sweep next round).  The cursor
+    advance is locked: an abandoned worker that UNWEDGES while the failover
+    thread is draining must not be handed the same thunk (each index is
+    claimed under the lock; the thunk itself runs outside it)."""
+
+    def __init__(self, thunks):
+        import threading
+
+        self._thunks = list(thunks)
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def run_pending(self) -> None:
+        while True:
+            with self._lock:
+                if self._next >= len(self._thunks):
+                    return
+                fn = self._thunks[self._next]
+                self._next += 1
+            fn()
+
+
+def run_round_on_device(
+    problem, ctx, config, device_problem=None, shadow_work=(), host_problem=None
+):
     """(result, outcome): run the jitted round on a built problem and decode,
     including the gang-txn rollback loop.  Shared by the from-scratch path
     (run_scheduling_round) and the incremental-builder path
     (scheduler/incremental_algo.py); `device_problem` lets callers supply
-    cached device buffers (models.incremental.DeviceProblemCache).
+    cached device buffers (models.incremental.DeviceProblemCache /
+    slab.DeviceDeltaCache) -- or a ZERO-ARG CALLABLE producing them, which
+    moves the device apply/upload inside the watchdog deadline too (a hung
+    scatter is a device loss exactly like a hung kernel).
 
     `shadow_work`: zero-arg callables run between the decode dispatch and
     the blocking fetch -- the KERNEL SHADOW.  Anything that neither reads
     this round's outcome nor mutates what decode still needs is sound here
     (submit-side table inserts and prefetch_content are; the ctx id
     snapshots are copy-on-write precisely for this).  The thunks run ONCE,
-    before the first decode -- gang-rollback re-runs never repeat them."""
-    import jax.numpy as jnp
-    import numpy as _np
+    before the first decode -- gang-rollback re-runs never repeat them, and
+    a watchdog failover resumes after the last thunk that started.
 
-    if device_problem is None:
-        device_problem = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    `host_problem`: the host-array ground truth for CPU failover (a
+    SchedulingProblem or a thunk building one, e.g. DeltaBundle.materialize).
+    When the device round times out (core/watchdog deadline) or dies on an
+    XLA error, the SAME round re-runs on the explicit XLA:CPU backend from
+    these host tables -- sound because the problem is fully assembled
+    host-side and decisions commit only after decode (the abort-on-publish
+    discipline already guarantees no partial commit).  Defaults to
+    `problem` when that is a real SchedulingProblem."""
+    from armada_tpu.core import faults
+    from armada_tpu.core.watchdog import RoundTimeout, run_with_deadline, supervisor
+
+    import jax.numpy as jnp
+
     kernel_kwargs = dict(
         num_levels=len(ctx.ladder) + 2,
         max_slots=ctx.max_slots,
@@ -47,6 +90,88 @@ def run_round_on_device(problem, ctx, config, device_problem=None, shadow_work=(
             and not bool(problem.market)
         ),
     )
+    shadow = _ShadowOnce(shadow_work)
+
+    def build_device_problem():
+        dp = device_problem() if callable(device_problem) else device_problem
+        if dp is None:
+            dp = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+        return dp
+
+    sup = supervisor()
+    if sup.degraded:
+        # Degraded steady state: rounds target the explicit CPU backend
+        # (slab caches were reset and route uploads there via
+        # watchdog.data_device()); no watchdog thread -- the host cannot
+        # hang on itself -- and no device fault check (the device sites
+        # model the ACCELERATOR boundary, which is out of the loop here).
+        import jax
+
+        with jax.default_device(jax.devices("cpu")[0]):
+            return _round_body(
+                build_device_problem(), ctx, config, kernel_kwargs, shadow
+            )
+
+    deadline = sup.deadline_s()
+    if deadline <= 0:
+        # Watchdog disabled (tests/bench default): the original inline path.
+        faults.check("device_round")
+        return _round_body(
+            build_device_problem(), ctx, config, kernel_kwargs, shadow
+        )
+
+    def _device_attempt():
+        faults.check("device_round")
+        return _round_body(
+            build_device_problem(), ctx, config, kernel_kwargs, shadow
+        )
+
+    try:
+        from jax.errors import JaxRuntimeError as _XlaError
+    except ImportError:  # older jax: the jaxlib name
+        from jaxlib.xla_extension import XlaRuntimeError as _XlaError
+    try:
+        out = run_with_deadline(_device_attempt, deadline)
+        sup.record_success()
+        return out
+    except (RoundTimeout, _XlaError, faults.FaultInjected) as e:
+        # RoundTimeout = tunnel wedge (thread abandoned); XlaRuntimeError =
+        # the backend died under us; FaultInjected = a drill.  Deliberately
+        # NARROW: a generic RuntimeError out of decode/rollback is a host
+        # code bug -- degrading on it would hide the bug behind a
+        # spuriously-working CPU re-run (and drop every device cache for
+        # nothing), so it propagates untouched.
+        sup.record_failure(f"{type(e).__name__}: {e}")
+        hp = host_problem() if callable(host_problem) else host_problem
+        if hp is None and hasattr(problem, "_fields"):
+            hp = problem
+        if hp is None:
+            raise  # no host tables to fail over from (legacy caller)
+        return _run_round_cpu_failover(hp, ctx, config, kernel_kwargs, shadow)
+
+
+def _run_round_cpu_failover(host_problem, ctx, config, kernel_kwargs, shadow):
+    """Re-run the SAME round on the explicit XLA:CPU backend from host
+    tables.  The device caches were reset by the supervisor's failure hooks
+    (stale device state must never be consulted again); this path re-uploads
+    the full problem to CPU memory -- a memcpy, not a tunnel transfer."""
+    import jax
+    import numpy as _np
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        dp = SchedulingProblem(
+            *(jax.device_put(_np.asarray(a), cpu) for a in host_problem)
+        )
+        return _round_body(dp, ctx, config, kernel_kwargs, shadow)
+
+
+def _round_body(device_problem, ctx, config, kernel_kwargs, shadow):
+    """One complete round against already-device-resident tensors: kernel,
+    overlapped decode + shadow work, and the gang-txn rollback loop."""
+    import jax.numpy as jnp
+    import numpy as _np
+
     result = schedule_round(device_problem, **kernel_kwargs)
     # Overlapped decode (begin_decode): the compaction + its device->host
     # copy are enqueued behind the kernel with no host sync in between, so
@@ -54,8 +179,7 @@ def run_round_on_device(problem, ctx, config, device_problem=None, shadow_work=(
     # decode_result here paid one extra tunnel round trip (~65ms) per round
     # in the serve/sidecar paths (the bench loop already did this).
     finish = begin_decode(result, ctx)
-    for work in shadow_work:
-        work()
+    shadow.run_pending()
     outcome = finish()
 
     # Gang-txn rollback (nodedb.go:347 ScheduleManyWithTxn: a gang is one txn,

@@ -589,6 +589,10 @@ class IncrementalBuilder:
         self._last_sig: Optional[tuple] = None
         self._shipped_sg = 0
         self._shipped_rr = 0
+        # Bumped by invalidate_prefetch() (device loss / promotion): an
+        # in-flight prefetch from an ABANDONED watchdog worker must never
+        # mark rows shipped against a device state that was reset under it.
+        self._prefetch_gen = 0
         # Market: g_price is a function of per-slot (queue, band) and the
         # per-cycle price table; a price MOVE invalidates every slot's price
         # at once, so it bumps an epoch in the bundle sig and rides the
@@ -1793,6 +1797,7 @@ class IncrementalBuilder:
         exactly at the last bundle's state."""
         if self.market or self._last_sig is None:
             return 0
+        gen = self._prefetch_gen
         sg, rr = self._sg, self._rr
         new_sg = sg.dirty_log[self._shipped_sg :]
         new_rr = rr.dirty_log[self._shipped_rr :]
@@ -1825,11 +1830,26 @@ class IncrementalBuilder:
             rr_cols=rr_cols,
             ev_cols=ev_cols,
         )
-        if not ok:
+        if not ok or gen != self._prefetch_gen:
+            # gen moved: invalidate_prefetch() ran while the scatter was in
+            # flight (device loss mid-prefetch) -- the devcache was replaced
+            # or reset, so these rows must STAY in the next bundle's payload.
             return 0
         self._shipped_sg = len(sg.dirty_log)
         self._shipped_rr = len(rr.dirty_log)
         return int(i_sing.shape[0] + rr_d.shape[0])
+
+    def invalidate_prefetch(self) -> None:
+        """Explicit device-loss invalidation (core/watchdog reset hooks):
+        forget that any dirty rows were shipped -- they re-enter the next
+        bundle's payload (harmless superset: the reset device cache
+        full-uploads anyway) -- and disarm prefetching until a new bundle
+        establishes a device state to scatter against.  The gen bump
+        defeats the in-flight-prefetch race (see prefetch_content)."""
+        self._last_sig = None
+        self._shipped_sg = 0
+        self._shipped_rr = 0
+        self._prefetch_gen += 1
 
     def assemble_delta(
         self,

@@ -306,15 +306,46 @@ class DeviceDeltaCache:
         self._prev = None
         self.splice_applies = 0  # cycles where gq rode the device splice
         self.content_prefetches = 0  # scatter_content applications
+        self.resets = 0  # explicit device-loss/promotion resets
         # host-object identity of what is currently on device, per field;
         # node tensors also keep their device copy for reuse across full
         # uploads (the fleet rarely changes).
         self._host_ids: dict = {}
         self._node_dev: dict = {}
 
-    def _full_upload(self, problem):
+    def reset(self) -> None:
+        """Explicit device-state invalidation (device loss / re-promotion,
+        core/watchdog reset hooks): drop EVERYTHING that refers to device
+        buffers -- the resident problem, the seq chain, and the reusable
+        node-tensor copies -- so the next apply() is a full upload to the
+        backend the supervisor now targets.  The sig/seq guards would make
+        most stale paths silent no-ops anyway; the explicit reset makes the
+        invalidation a guarantee rather than a property of guard coverage
+        (and frees buffers pinned on a dead backend)."""
+        self._sig = None
+        self._seq = None
+        self._prev = None
+        self._host_ids = {}
+        self._node_dev = {}
+        self.resets += 1
+
+    @staticmethod
+    def _to_device(arr):
+        """Upload one host array to the current data device: the default
+        backend, or the explicit CPU device while the supervisor is degraded
+        (core/watchdog.data_device) -- the delta cache keeps its O(delta)
+        scatter economics during CPU-failover operation."""
+        import jax
         import jax.numpy as jnp
 
+        from armada_tpu.core.watchdog import data_device
+
+        dev = data_device()
+        if dev is None:
+            return jnp.asarray(arr)
+        return jax.device_put(np.asarray(arr), dev)
+
+    def _full_upload(self, problem):
         out = []
         for name, arr in zip(problem._fields, problem):
             if (
@@ -325,7 +356,7 @@ class DeviceDeltaCache:
                 out.append(self._node_dev[name])
             else:
                 TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
-                dev = jnp.asarray(arr)
+                dev = self._to_device(arr)
                 if name in _NODE_FIELDS:
                     self._node_dev[name] = dev
                 out.append(dev)
@@ -358,8 +389,6 @@ class DeviceDeltaCache:
         sg_cols = {n: _pad_rows(bundle.sg_cols[n], kg) for n in _SG_FIELDS}
         rr_cols = {n: _pad_rows(bundle.rr_cols[n], kr) for n in _RR_FIELDS}
         ev_cols = {n: _pad_rows(bundle.ev_cols[n], kr) for n in _EV_FIELDS}
-        import jax.numpy as jnp
-
         fulls = {}
         for name, arr in bundle.fulls.items():
             if self._host_ids.get(name) is arr:
@@ -368,7 +397,7 @@ class DeviceDeltaCache:
             if name in _NODE_FIELDS:
                 # keep the reusable device copy current, else a later full
                 # upload would resurrect a stale buffer via _node_dev
-                dev = jnp.asarray(np.asarray(arr))
+                dev = self._to_device(np.asarray(arr))
                 self._node_dev[name] = dev
                 fulls[name] = dev
             else:

@@ -40,7 +40,17 @@ class _Base:
         negotiate: kerberos/SPNEGO -- bytes (one call: AP-REQ tokens are
         single-use, the server replay-caches them) or a zero-arg callable
         minting a FRESH token per request (e.g. a gssapi initiator)."""
-        self._channel = channel or grpc.insecure_channel(address)
+        if channel is None:
+            # Mirror the server's transport hardening: the default 4MB
+            # receive cap would reject a large lease/queue response the
+            # server is now allowed to send, and client-side keepalive
+            # keeps long idle watches alive across NATs/proxies.
+            from armada_tpu.rpc.transport import channel_options
+
+            channel = grpc.insecure_channel(
+                address, options=channel_options()
+            )
+        self._channel = channel
         self._static_meta = [(_PRINCIPAL_KEY, principal)]
         if groups:
             self._static_meta.append((_GROUPS_KEY, ",".join(groups)))

@@ -1,0 +1,59 @@
+"""Shared gRPC channel options for servers AND clients (VERDICT #6).
+
+A standalone module so pure-client processes (executors, armadactl, the
+sidecar's callers) never import the server module graph just to build a
+channel.  The two sides must agree on the message cap -- raising only the
+server's send limit still breaks a >4MB lease batch on the client's
+receive side -- so both read the same knobs:
+
+* ``ARMADA_GRPC_MAX_MSG_MB`` (default 64): max send/receive message size.
+  gRPC's stock 4MB receive cap rejects a large lease batch at reference
+  scale.
+* ``ARMADA_GRPC_KEEPALIVE_S`` (default 300): keepalive ping period for
+  long-lived idle streams (an event watch, the replication tail) crossing
+  NATs/proxies that silently drop idle TCP flows.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def _max_message_bytes(max_message_mb: Optional[int]) -> int:
+    if max_message_mb is None:
+        try:
+            max_message_mb = int(os.environ.get("ARMADA_GRPC_MAX_MSG_MB", 64))
+        except ValueError:
+            max_message_mb = 64
+    return int(max_message_mb) * 1024 * 1024
+
+
+def _keepalive_ms(keepalive_time_s: Optional[float]) -> int:
+    if keepalive_time_s is None:
+        try:
+            keepalive_time_s = float(
+                os.environ.get("ARMADA_GRPC_KEEPALIVE_S", 300.0)
+            )
+        except ValueError:
+            keepalive_time_s = 300.0
+    return int(keepalive_time_s * 1000)
+
+
+def channel_options(
+    max_message_mb: Optional[int] = None,
+    keepalive_time_s: Optional[float] = None,
+    keepalive_timeout_s: float = 20.0,
+) -> list:
+    """Options valid on EITHER side: message caps + keepalive pings."""
+    max_bytes = _max_message_bytes(max_message_mb)
+    return [
+        ("grpc.max_send_message_length", max_bytes),
+        ("grpc.max_receive_message_length", max_bytes),
+        ("grpc.keepalive_time_ms", _keepalive_ms(keepalive_time_s)),
+        ("grpc.keepalive_timeout_ms", int(keepalive_timeout_s * 1000)),
+        ("grpc.keepalive_permit_without_calls", 1),
+        # Streams sit idle for minutes between events: data-less pings are
+        # legitimate, not abuse.
+        ("grpc.http2.max_pings_without_data", 0),
+    ]

@@ -404,11 +404,23 @@ class FairSchedulingAlgo:
                     shadow.append(
                         lambda p=pool: self.feed.prefetch_content(skip_pool=p)
                     )
+                # Thunk, not a value: the device apply/upload runs inside
+                # the watchdog deadline (a hung scatter IS a device loss),
+                # and materialize() is the host-table ground truth the CPU
+                # failover re-runs from.  Both close over live slab state,
+                # which is unmutated until the decisions apply below.
+                # EARLY-bound (default args, cache resolved NOW): an
+                # abandoned watchdog worker that unwedges later must only
+                # ever touch the cache object of ITS round -- by then the
+                # orphaned garbage the reset hook replaced -- never the
+                # live cache or a later iteration's bundle.
+                devcache = self.feed.devcache_for(pool)
                 res, outcome = run_round_on_device(
                     pview,
                     ctx,
                     self.config,
-                    device_problem=self.feed.devcache_for(pool).apply(bundle),
+                    device_problem=lambda dc=devcache, b_=bundle: dc.apply(b_),
+                    host_problem=bundle.materialize,
                     shadow_work=shadow,
                 )
                 if self.collect_stats:

@@ -70,6 +70,30 @@ class IncrementalProblemFeed:
         for p in config.pools:
             self.builders[p.name] = IncrementalBuilder(config, p.name)
             self.devcaches[p.name] = DeviceDeltaCache()
+        # Device-loss resilience (core/watchdog): a backend transition
+        # (failover to CPU, re-promotion to the device) must drop every
+        # device-resident cache this feed owns.  Held weakly -- a closed
+        # control plane's feed is garbage, not a leak in the hook registry.
+        from armada_tpu.core.watchdog import add_reset_hook
+
+        add_reset_hook(self.reset_device_state)
+
+    def reset_device_state(self) -> None:
+        """Drop device-resident problem state after a device loss or
+        re-promotion: REPLACE each pool's DeviceDeltaCache and invalidate
+        the builders' prefetch bookkeeping so already-shipped rows re-enter
+        the next bundle.  Replacement, never mutation of the live object:
+        this hook can fire from the RE-PROBE thread (promotion) while a
+        round is mid-apply in the scheduler thread, and from a watchdog
+        worker that unwedges later -- both still hold the OLD cache, which
+        stays internally consistent and simply becomes garbage; every
+        future cycle fetches the fresh cache, whose empty state forces the
+        full-upload fallback to the supervisor's current backend.  Host
+        tables are untouched."""
+        for pool in list(self.devcaches):
+            self.devcaches[pool] = DeviceDeltaCache()
+        for b in self.builders.values():
+            b.invalidate_prefetch()
 
     def attach(self, jobdb) -> None:
         self._jobdb = jobdb

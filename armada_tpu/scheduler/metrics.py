@@ -96,6 +96,30 @@ class SchedulerMetrics:
             "Nodes currently excluded for high failure rates",
             registry=registry,
         )
+        # Device-loss degradation state (core/watchdog): dashboards alert on
+        # device_healthy == 0 (rounds running on the CPU failover) and on
+        # device_fallbacks increasing (each is one lost round re-run).
+        self.device_healthy = Gauge(
+            "armada_scheduler_device_healthy",
+            "1 while scheduling rounds target the accelerator backend, "
+            "0 while degraded to the CPU failover",
+            registry=registry,
+        )
+        self.device_consecutive_failures = Gauge(
+            "armada_scheduler_device_consecutive_failures",
+            "Device round failures since the last healthy round",
+            registry=registry,
+        )
+        self.device_fallbacks = Gauge(
+            "armada_scheduler_device_fallbacks",
+            "Device rounds that failed over to the CPU backend (monotonic)",
+            registry=registry,
+        )
+        self.device_promotions = Gauge(
+            "armada_scheduler_device_promotions",
+            "Re-promotions back to the accelerator backend (monotonic)",
+            registry=registry,
+        )
         # Executor-reported ACTUAL usage (reference metrics.go:387-395 +
         # commonmetrics QueueUsedDesc "queue_resource_used"): what pods are
         # consuming, as opposed to what the scheduler allocated.
@@ -141,6 +165,16 @@ class SchedulerMetrics:
         )
 
     # --- hooks called by the Scheduler --------------------------------------
+
+    def observe_device(self, snapshot: dict) -> None:
+        """Publish the watchdog supervisor's degradation state
+        (core/watchdog.DeviceSupervisor.snapshot), once per cycle."""
+        self.device_healthy.set(0.0 if snapshot.get("backend") == "cpu" else 1.0)
+        self.device_consecutive_failures.set(
+            float(snapshot.get("consecutive_failures", 0))
+        )
+        self.device_fallbacks.set(float(snapshot.get("fallbacks", 0)))
+        self.device_promotions.set(float(snapshot.get("promotions", 0)))
 
     def observe_executor_usage(self, executors, factory) -> None:
         """Publish executor-reported per-queue usage (metrics.go:387-395).

@@ -231,6 +231,9 @@ class Scheduler:
         duration = time.monotonic() - start
         if self.metrics is not None:
             self.metrics.observe_cycle(result, duration, now=self._clock())
+            from armada_tpu.core.watchdog import supervisor
+
+            self.metrics.observe_device(supervisor().snapshot())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
         return result
@@ -780,12 +783,35 @@ class Scheduler:
     ) -> None:
         """Tick cycles until `stop` (a threading.Event) is set: a full
         scheduling round every schedule_interval, cheap reconcile cycles in
-        between (cyclePeriod/schedulePeriod, config/scheduler/config.yaml:1-3)."""
+        between (cyclePeriod/schedulePeriod, config/scheduler/config.yaml:1-3).
+
+        A failed cycle must not kill the loop: the cycle already aborted its
+        txn and rewound its fetch cursors (no partial commit), so the next
+        attempt re-derives everything -- a transient publish/DB failure
+        costs retries with bounded jittered backoff, not the service (the
+        reference's Run keeps cycling on cycle errors, scheduler.go:142).
+        KeyboardInterrupt/SystemExit still propagate."""
+        from armada_tpu.core.backoff import Backoff
+
+        backoff = Backoff(base_s=max(cycle_interval_s, 0.05), cap_s=30.0)
         last_schedule = 0.0
         while not stop.is_set():
             start = self._clock()
             do_schedule = start - last_schedule >= schedule_interval_s
-            self.cycle(schedule=do_schedule)
+            try:
+                self.cycle(schedule=do_schedule)
+            except Exception:  # noqa: BLE001 - the loop must survive
+                delay = backoff.next_delay()
+                _log.exception(
+                    "scheduler cycle failed (attempt %d); retrying in %.2fs",
+                    backoff.attempts,
+                    delay,
+                )
+                # last_schedule stays: a failed scheduling cycle retries
+                # scheduling at the next tick, not a schedule_interval later.
+                stop.wait(delay)
+                continue
+            backoff.reset()
             if do_schedule:
                 last_schedule = start
             elapsed = self._clock() - start

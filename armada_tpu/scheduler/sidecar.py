@@ -327,7 +327,15 @@ def _stats_of(result: SchedulerResult) -> str:
             entry["idealised_values"] = s.idealised_values
             entry["realised_values"] = s.realised_values
         pools.append(entry)
-    return json.dumps({"pools": pools}, default=float)
+    # Degradation state rides the stats JSON so an EXTERNAL control plane
+    # (the sidecar's whole audience) sees a CPU-failover round without
+    # scraping this process's /healthz: backend, consecutive failures,
+    # last fallback reason (core/watchdog).
+    from armada_tpu.core.watchdog import supervisor
+
+    return json.dumps(
+        {"pools": pools, "device": supervisor().snapshot()}, default=float
+    )
 
 
 class ScheduleSidecar:

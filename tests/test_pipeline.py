@@ -432,3 +432,99 @@ def test_parity_scenario_under_sequential_escape_hatch(monkeypatch):
     result = s.schedule_round(now_ns=NOW_NS)
     assert {job.id: run.node_id for job, run in result.scheduled} == in_sched
     assert {job.id for job, _ in result.preempted} == in_pre
+
+
+# --- 5. device-loss mid-cycle -------------------------------------------------
+
+
+def test_device_loss_mid_cycle_invalidates_prefetch(monkeypatch):
+    """Device-loss resilience x the pipeline: an injected device loss
+    mid-cycle provably invalidates the prefetch/scatter state (the replaced
+    DeviceDeltaCache refuses stale scatters, the builder's shipped-row
+    bookkeeping resets), and every cycle's decisions -- including the ones
+    after the loss -- are bit-equal to the sequential ARMADA_PIPELINE=0
+    path with no faults."""
+    from armada_tpu.core import faults, watchdog
+    from armada_tpu.models import run_round_on_device
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+    saved_hooks = list(watchdog._reset_hooks)
+    watchdog._reset_hooks.clear()
+
+    def run_script(pipelined: bool, inject: bool):
+        faults.reset_counters()
+        watchdog.reset_supervisor()
+        monkeypatch.setenv("ARMADA_REPROBE_INTERVAL_S", "0")
+        monkeypatch.setenv("ARMADA_PIPELINE", "1" if pipelined else "0")
+        monkeypatch.setenv(
+            "ARMADA_PIPELINE_PREFETCH", "1" if pipelined else "0"
+        )
+        monkeypatch.setenv("ARMADA_WATCHDOG_S", "60")
+        if inject:
+            # after_n=1: the SECOND cycle's round dies -- after cycle 1's
+            # tail already prefetched rows to the device cache
+            monkeypatch.setenv("ARMADA_FAULT", "device_round:error:1")
+        else:
+            monkeypatch.delenv("ARMADA_FAULT", raising=False)
+        cfg = make_config()
+        F, nodes, queues = make_world(cfg)
+        feed = IncrementalProblemFeed(cfg)
+        b = feed.builder_for("default")
+        b.set_queues(queues)
+        b.set_nodes(nodes)
+        spec_of = {}
+        nid = [0]
+
+        def submit(n, queue="q0"):
+            specs = [make_job(F, nid[0] + i, queue) for i in range(n)]
+            nid[0] += n
+            for s in specs:
+                spec_of[s.id] = s
+            b.submit_many(specs)
+
+        submit(16)
+        decisions = []
+        prefetched_before_loss = 0
+        for cycle in range(4):
+            bundle, ctx = b.assemble_delta()
+            devcache = feed.devcache_for("default")
+            _, outcome = run_round_on_device(
+                bundle.stats_view(),
+                ctx,
+                cfg,
+                device_problem=lambda dc=devcache, b_=bundle: dc.apply(b_),
+                host_problem=bundle.materialize,
+            )
+            if inject and cycle == 1:
+                # the loss just happened: supervisor degraded, cache
+                # replaced (refuses any scatter), prefetch disarmed
+                assert watchdog.supervisor().degraded
+                assert feed.devcaches["default"]._prev is None
+                assert b._last_sig is None and b._shipped_sg == 0
+                assert b.prefetch_content(feed.devcaches["default"]) == 0
+            decisions.append(
+                (sorted(outcome.scheduled.items()), sorted(outcome.preempted))
+            )
+            apply_decisions(b, spec_of, outcome)
+            submit(4, f"q{cycle % 3}")
+            if pipelined:
+                shipped = b.prefetch_content(feed.devcaches["default"])
+                if inject and cycle == 0:
+                    prefetched_before_loss = shipped
+        if inject:
+            assert prefetched_before_loss > 0, (
+                "the loss must land AFTER a real prefetch shipped rows"
+            )
+        return decisions
+
+    try:
+        faulted = run_script(pipelined=True, inject=True)
+        sequential = run_script(pipelined=False, inject=False)
+        assert faulted == sequential, (
+            "post-loss decisions must be bit-equal to the sequential path"
+        )
+        assert any(sched for sched, _ in sequential)
+    finally:
+        faults.reset_counters()
+        watchdog.reset_supervisor()
+        watchdog._reset_hooks[:] = saved_hooks

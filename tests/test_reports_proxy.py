@@ -115,9 +115,17 @@ def test_follower_replica_proxies_reports_to_leader(tmp_path):
         report = client_b.get_job_report(jid)
         assert report["outcome"] == "scheduled"
         assert report == client_a.get_job_report(jid)
-        # pool + queue reports proxy too
-        assert client_b.get_pool_report() == client_a.get_pool_report()
-        assert client_b.get_queue_report("qa") == client_a.get_queue_report("qa")
+        # pool + queue reports proxy too.  The leader RE-RECORDS these every
+        # scheduling cycle (0.5s) with a fresh `time` stamp, so back-to-back
+        # reads race the cycle cadence -- retry until both reads land inside
+        # one inter-cycle window (equality is the steady-state property).
+        assert _wait(
+            lambda: client_b.get_pool_report() == client_a.get_pool_report()
+        ), "pool report proxy never agreed with the leader"
+        assert _wait(
+            lambda: client_b.get_queue_report("qa")
+            == client_a.get_queue_report("qa")
+        ), "queue report proxy never agreed with the leader"
     finally:
         stop_exec.set()
         if exec_thread is not None:

@@ -38,6 +38,36 @@ def topo(tmp_path):
         daemon=True,
     )
     agent.start()
+    # Warm the plane before any spec runs: the FIRST scheduling cycle
+    # compiles the round kernel -- seconds of GIL-heavy tracing during
+    # which the agent's poll cadence can stretch past the fake pods' 0.3s
+    # runtime, so a spec racing the compile can observe a pod skip its
+    # brief 'running' phase entirely (assigned -> succeeded between
+    # polls) and miss an expected event.  One drained warmup job makes
+    # every spec start against a warm kernel.
+    import time as _time
+
+    from armada_tpu.rpc.client import ArmadaClient
+    from armada_tpu.server import JobSubmitItem, QueueRecord
+
+    warm = ArmadaClient(f"127.0.0.1:{plane.port}")
+    warm.create_queue(QueueRecord("warmup", weight=1.0))
+    warm.submit_jobs(
+        "warmup", "warm", [JobSubmitItem(resources={"cpu": "1", "memory": "1"})]
+    )
+    deadline = _time.monotonic() + 120.0
+    while _time.monotonic() < deadline:
+        kinds = {
+            ev.WhichOneof("event")
+            for e in warm.get_jobset_events("warmup", "warm")
+            for ev in e.sequence.events
+        }
+        if "job_succeeded" in kinds:
+            break
+        _time.sleep(0.1)
+    else:
+        raise AssertionError("warmup job did not succeed within 120s")
+    warm.close()
     yield plane
     stop.set()
     agent.join(timeout=5)

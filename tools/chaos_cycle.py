@@ -1,0 +1,215 @@
+"""Chaos driver: N steady scheduling cycles with one randomly injected
+fault, asserting convergence.
+
+The drill the device-loss resilience work exists for, runnable anywhere
+(no TPU needed -- the "device" is whatever jax's default backend is):
+
+  1. build a steady-state incremental world (builder + device delta cache),
+  2. pick a random cycle and a random fault (`device_round:hang` or
+     `device_round:error`, via ARMADA_FAULT) and arm the round watchdog,
+  3. run N cycles through models.run_round_on_device -- the faulted cycle
+     must complete on the CPU failover within the deadline,
+  4. re-run the identical cycle script fault-free and assert every cycle's
+     scheduled/preempted decisions are BIT-EQUAL,
+  5. let the (stubbed-healthy) re-probe promote back to the device and
+     assert the post-promotion cycles also match.
+
+Exit code 0 + one JSON line on success; non-zero with the mismatch on
+failure.  Knobs: --cycles, --seed, --burst, --jobs/--nodes (world size),
+--prefetch (exercise the pipeline's scatter prefetch around the loss).
+
+    python tools/chaos_cycle.py --cycles 8 --seed 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_world(cfg, num_nodes, num_queues):
+    from armada_tpu.core.types import NodeSpec, Queue
+
+    F = cfg.resource_list_factory()
+    nodes = [
+        NodeSpec(
+            id=f"n{i}",
+            pool="default",
+            total_resources=F.from_mapping({"cpu": "16", "memory": "64"}),
+        )
+        for i in range(num_nodes)
+    ]
+    queues = [Queue(f"q{i}", weight=1.0 + i) for i in range(num_queues)]
+    return F, nodes, queues
+
+
+def run_script(
+    *, cycles, seed, jobs0, burst, num_nodes, num_queues, fault, fault_cycle,
+    prefetch, deadline_s=30.0,
+):
+    """One deterministic multi-cycle run; returns per-cycle decision lists.
+    `fault` is None (clean replay) or "hang"/"error" injected at
+    `fault_cycle`."""
+    from armada_tpu.core import faults, watchdog
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, RunningJob
+    from armada_tpu.models import run_round_on_device
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+
+    faults.reset_counters()
+    sup = watchdog.reset_supervisor()
+    os.environ["ARMADA_REPROBE_INTERVAL_S"] = "0.05"
+    os.environ["ARMADA_WATCHDOG_S"] = str(deadline_s)
+    os.environ["ARMADA_FAULT_HANG_S"] = "60"
+    # the re-probe must see a healthy backend (this host's default jax
+    # platform IS the device under test) without paying a subprocess per
+    # poll in a drill loop
+    sup._probe = lambda timeout_s: (True, "chaos-stub")
+    if fault:
+        # after_n = number of device-round checks before the injected cycle
+        os.environ["ARMADA_FAULT"] = f"device_round:{fault}:{fault_cycle}"
+    else:
+        os.environ.pop("ARMADA_FAULT", None)
+    os.environ["ARMADA_PIPELINE_PREFETCH"] = "1" if prefetch else "0"
+
+    cfg = SchedulingConfig(
+        shape_bucket=64,
+        priority_classes={
+            "low": PriorityClass("low", priority=100, preemptible=True),
+            "high": PriorityClass("high", priority=1000, preemptible=False),
+        },
+        default_priority_class="high",
+        maximum_scheduling_burst=max(burst, 8),
+    )
+    F, nodes, queues = build_world(cfg, num_nodes, num_queues)
+    feed = IncrementalProblemFeed(cfg)
+    b = feed.builder_for("default")
+    b.set_queues(queues)
+    b.set_nodes(nodes)
+    rng = random.Random(seed)
+    spec_of = {}
+    nid = [0]
+
+    def submit(n):
+        specs = []
+        for _ in range(n):
+            i = nid[0]
+            nid[0] += 1
+            specs.append(
+                JobSpec(
+                    id=f"j{i}",
+                    queue=f"q{rng.randrange(num_queues)}",
+                    priority_class="low" if rng.random() < 0.4 else "high",
+                    submit_time=float(i),
+                    resources=F.from_mapping(
+                        {"cpu": str(rng.randrange(1, 5)), "memory": "1"}
+                    ),
+                )
+            )
+        for s in specs:
+            spec_of[s.id] = s
+        b.submit_many(specs)
+
+    submit(jobs0)
+    decisions = []
+    for _cycle in range(cycles):
+        bundle, ctx = b.assemble_delta()
+        devcache = feed.devcache_for("default")
+        _, outcome = run_round_on_device(
+            bundle.stats_view(),
+            ctx,
+            cfg,
+            device_problem=lambda dc=devcache, b_=bundle: dc.apply(b_),
+            host_problem=bundle.materialize,
+        )
+        decisions.append(
+            (sorted(outcome.scheduled.items()), sorted(outcome.preempted))
+        )
+        b.remove_many(outcome.scheduled.keys())
+        b.lease_many(
+            [
+                RunningJob(job=spec_of[jid], node_id=node)
+                for jid, node in outcome.scheduled.items()
+            ]
+        )
+        for jid in outcome.preempted:
+            b.unlease(jid)
+        submit(burst)
+        if prefetch:
+            b.prefetch_content(feed.devcaches["default"])
+    return decisions, sup
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cycles", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=int(time.time()) % 10_000)
+    ap.add_argument("--jobs", type=int, default=40, help="initial backlog")
+    ap.add_argument("--burst", type=int, default=8, help="submits per cycle")
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--queues", type=int, default=3)
+    ap.add_argument(
+        "--prefetch",
+        action="store_true",
+        help="exercise the pipeline's content prefetch around the loss",
+    )
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    fault = rng.choice(["error", "hang"])
+    fault_cycle = rng.randrange(1, max(2, args.cycles - 1))
+    common = dict(
+        # hang drills ride a tight deadline so the drill stays fast; it
+        # still dwarfs any legit CPU round at this world size
+        deadline_s=3.0 if fault == "hang" else 30.0,
+        cycles=args.cycles,
+        seed=args.seed,
+        jobs0=args.jobs,
+        burst=args.burst,
+        num_nodes=args.nodes,
+        num_queues=args.queues,
+        prefetch=args.prefetch,
+    )
+    t0 = time.monotonic()
+    chaotic, sup = run_script(fault=fault, fault_cycle=fault_cycle, **common)
+    chaos_s = time.monotonic() - t0
+    snap = sup.snapshot()
+    # convergence half 1: the supervisor recovered (stubbed-healthy probe)
+    deadline = time.monotonic() + 10.0
+    while sup.degraded and time.monotonic() < deadline:
+        time.sleep(0.05)
+    promoted = not sup.degraded
+
+    clean, _ = run_script(fault=None, fault_cycle=0, **common)
+
+    ok = chaotic == clean and snap["fallbacks"] >= 1 and promoted
+    line = {
+        "tool": "chaos_cycle",
+        "ok": ok,
+        "seed": args.seed,
+        "cycles": args.cycles,
+        "fault": f"device_round:{fault}@cycle{fault_cycle}",
+        "prefetch": bool(args.prefetch),
+        "fallbacks": snap["fallbacks"],
+        "promoted": promoted,
+        "decisions_equal": chaotic == clean,
+        "scheduled_total": sum(len(s) for s, _ in clean),
+        "chaos_run_s": round(chaos_s, 2),
+    }
+    if not ok and chaotic != clean:
+        for i, (a, b) in enumerate(zip(chaotic, clean)):
+            if a != b:
+                line["first_divergent_cycle"] = i
+                break
+    print(json.dumps(line))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
