@@ -1,0 +1,23 @@
+"""Correctness tooling: armada-lint (static) + tsan (dynamic race harness).
+
+The reference Armada leans on Go's toolchain -- `go vet` and the `-race`
+detector run in CI over the whole tree -- while this Python/JAX rebuild's
+hard-won constraints (CLAUDE.md) were enforced only by prose and reviewer
+memory.  This package turns them into machine-checked rules:
+
+* :mod:`armada_tpu.analysis.lint` -- an AST-based analyzer (stdlib ``ast``,
+  no dependencies) with a registry of repo-specific rules: kernel-economics
+  rules scoped to ``armada_tpu/models/``, host rules (dtype-coerced
+  searchsorted probes, backoff-not-fixed-sleep retries, transport
+  hardening), and event-sourcing rules (cursor/`queued_version` write
+  discipline).  ``tools/lint.py`` is the CI entrypoint; the whole tree
+  self-hosts clean.
+* :mod:`armada_tpu.analysis.tsan` -- instrumented ``threading.Lock``
+  wrappers that record acquisition order and flag lock-order inversions,
+  plus generation guards on device-resident caches that turn zombie-worker
+  writes (an abandoned watchdog thread scribbling on reset state) into
+  recorded violations.  Armed by ``ARMADA_TSAN=1``; the pipeline/faults
+  equality suites run under it.
+
+docs/lint.md catalogues every rule and the measured cost that motivated it.
+"""

@@ -1,0 +1,836 @@
+"""armada-lint: AST rules for this repo's hard-won constraints.
+
+Every rule here encodes a constraint that was PAID for -- a measured
+regression, a debugging session, or a parity break (CLAUDE.md; docs/lint.md
+has the catalogue with the numbers).  The analyzer is stdlib-``ast`` only,
+so it runs anywhere the repo does, with no new dependencies.
+
+Suppressions are per-line and must carry a reason::
+
+    x = jnp.argmin(masked)  # lint: allow(full-argmin) -- [B]-block, not [N]
+
+The comment may sit on any line the flagged statement spans, or on the line
+directly above it.  ``allow(rule-a, rule-b)`` suppresses several rules at
+once; an allow WITHOUT a reason is itself a violation
+(``allow-missing-reason``), so the tree stays self-documenting.
+
+Entry points: :func:`lint_source` (one buffer, used by the fixture tests),
+:func:`lint_file`, :func:`lint_tree` (the CI walk; ``tools/lint.py`` wraps
+it).  Rules register through :func:`rule`; each declares a path scope so
+kernel rules never fire on host code and vice versa.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+# --------------------------------------------------------------------------
+# findings + suppressions
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    end_line: int = 0  # last line of the flagged statement (0 = same as line)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# `# lint: allow(rule-a, rule-b) -- reason`
+_ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*([A-Za-z0-9_\-, ]+?)\s*\)\s*(?:--\s*(\S.*))?$"
+)
+
+
+def _parse_suppressions(lines: list[str]) -> tuple[dict, list]:
+    """Per-line allow map {lineno: set(rules)} + findings for reasonless
+    allows.  Line numbers are 1-based to match ast."""
+    allows: dict[int, set] = {}
+    bad: list[tuple[int, str]] = []
+    for i, text in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append((i, ", ".join(sorted(rules))))
+            continue
+        allows.setdefault(i, set()).update(rules)
+    return allows, bad
+
+
+# --------------------------------------------------------------------------
+# source model
+# --------------------------------------------------------------------------
+
+class Source:
+    """One parsed buffer: tree + parent links + suppression map."""
+
+    def __init__(self, text: str, relpath: str):
+        self.text = text
+        self.relpath = relpath.replace(os.sep, "/")
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)
+        self.allows, self.reasonless_allows = _parse_suppressions(self.lines)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def suppressed(self, rule_name: str, node: ast.AST) -> bool:
+        """An allow on any line the node spans, or in the comment block
+        sitting DIRECTLY above the flagged line (blank/comment lines only
+        in between) -- never across intervening code, so an allow cannot
+        leak onto the next statement."""
+        lo = getattr(node, "lineno", 0)
+        hi = getattr(node, "end_lineno", lo) or lo
+        for line in range(max(1, lo), hi + 1):
+            if rule_name in self.allows.get(line, ()):
+                return True
+        m = lo - 1
+        while m >= 1:
+            text = self.lines[m - 1].strip()
+            if text and not text.startswith("#"):
+                break
+            if rule_name in self.allows.get(m, ()):
+                return True
+            m -= 1
+        return False
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintRule:
+    name: str
+    summary: str
+    scope: Callable[[str], bool]
+    check: Callable[[Source], Iterable[Finding]]
+
+
+RULES: list[LintRule] = []
+
+
+def anywhere(_relpath: str) -> bool:
+    return True
+
+
+def under(*prefixes: str) -> Callable[[str], bool]:
+    return lambda p: p.startswith(prefixes)
+
+
+def in_files(*files: str) -> Callable[[str], bool]:
+    fset = set(files)
+    return lambda p: p in fset
+
+
+def rule(name: str, summary: str, scope: Callable[[str], bool] = anywhere):
+    def deco(fn):
+        RULES.append(LintRule(name, summary, scope, fn))
+        return fn
+
+    return deco
+
+
+def rule_names() -> list[str]:
+    return [r.name for r in RULES]
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> str:
+    """`a.b.c` for Attribute/Name chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_full_slice(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Slice)
+        and node.lower is None
+        and node.upper is None
+        and node.step is None
+    )
+
+
+def _calls_in(node: ast.AST, names: set) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _dotted(sub.func) in names:
+            yield sub
+
+
+def _finding(src: Source, name: str, node: ast.AST, msg: str) -> Finding:
+    return Finding(
+        name,
+        src.relpath,
+        node.lineno,
+        node.col_offset,
+        msg,
+        end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+    )
+
+
+def _at_scatter(call: ast.Call):
+    """(subscript, index, method) when `call` is `<x>.at[<index>].<method>(...)`,
+    else None.  Matches any scatter method (set/add/mul/min/max/...)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    sub = f.value
+    if not (
+        isinstance(sub, ast.Subscript)
+        and isinstance(sub.value, ast.Attribute)
+        and sub.value.attr == "at"
+    ):
+        return None
+    return sub, sub.slice, f.attr
+
+
+# --------------------------------------------------------------------------
+# kernel rules (armada_tpu/models/)
+# --------------------------------------------------------------------------
+
+_MODELS = under("armada_tpu/models/")
+
+
+def _is_static_loop_var(fn, tree, name: str) -> bool:
+    """True if `name` is the target of a `for name in range(...)` in the
+    enclosing scope -- a trace-time python int, i.e. a static unroll."""
+    scope = fn if fn is not None else tree
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.For)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == name
+            and isinstance(node.iter, ast.Call)
+            and _dotted(node.iter.func) in ("range", "reversed")
+        ):
+            return True
+    return False
+
+
+@rule(
+    "axis1-scatter",
+    "axis-1 vector-index scatter (`.at[:, idx].set`) copies the whole "
+    "buffer on XLA:CPU (~128us for [S,N], measured round 3); keep caches "
+    "FLAT with leading-dim index vectors",
+    scope=_MODELS,
+)
+def _axis1_scatter(src: Source):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _at_scatter(node)
+        if hit is None:
+            continue
+        _sub, index, _method = hit
+        if not isinstance(index, ast.Tuple) or not index.elts:
+            continue
+        if not _is_full_slice(index.elts[0]):
+            continue
+        # `.at[:, 0]` (constant scalar lane) keeps the copy bounded, and a
+        # python loop variable over range() is a static unroll -- each
+        # unrolled step is a constant lane too.  A vector/traced index is
+        # the measured full-buffer copy.
+        fn = src.enclosing_function(node)
+        for elt in index.elts[1:]:
+            if _is_full_slice(elt) or isinstance(elt, ast.Constant):
+                continue
+            if isinstance(elt, ast.Name) and _is_static_loop_var(
+                fn, src.tree, elt.id
+            ):
+                continue
+            yield _finding(
+                src,
+                "axis1-scatter",
+                node,
+                "axis-1 vector-index scatter copies the whole buffer on "
+                "XLA:CPU; restructure the cache flat with a leading-dim "
+                "index vector (CLAUDE.md round-3 kernel economics)",
+            )
+            break
+
+
+@rule(
+    "full-argmin",
+    "argmin/argmax in the round kernel is a SCALAR loop on XLA:CPU "
+    "(~190us at N=51k); use the blocked-minima path ([N/B] row + one [B] "
+    "block) or annotate the scanned axis",
+    scope=in_files("armada_tpu/models/fair_scheduler.py"),
+)
+def _full_argmin(src: Source):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("argmin", "argmax"):
+            yield _finding(
+                src,
+                "full-argmin",
+                node,
+                f"{f.attr} in the round kernel: XLA:CPU lowers it to a "
+                "scalar loop -- use the blocked-minima pattern for [N]-sized "
+                "operands, or allow() naming the (small) axis scanned",
+            )
+
+
+@rule(
+    "f64-score",
+    "f64 creeping into kernel score arithmetic flips near-ties against the "
+    "sequential oracle (parity lesson: f32 score/cost arithmetic is part of "
+    "the reference semantics)",
+    scope=in_files("armada_tpu/models/fair_scheduler.py"),
+)
+def _f64_score(src: Source):
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            yield _finding(
+                src,
+                "f64-score",
+                node,
+                "float64 in the round kernel: score/cost arithmetic must "
+                "stay f32 (raw f64 flips near-ties vs the oracle); integral "
+                "capacity math belongs in the host builder, not here",
+            )
+        elif isinstance(node, ast.Constant) and node.value == "float64":
+            yield _finding(
+                src,
+                "f64-score",
+                node,
+                "'float64' dtype string in the round kernel (see f64-score)",
+            )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id == "float"
+        ):
+            yield _finding(
+                src,
+                "f64-score",
+                node,
+                "astype(float) is float64 in the round kernel (see f64-score)",
+            )
+
+
+@rule(
+    "fetch-not-barrier",
+    "jax.block_until_ready can return EARLY over the axon tunnel (round-5 "
+    "measured): production sync must be a real scalar fetch "
+    "(copy_to_host_async + np.asarray), never a bare barrier",
+    scope=under("armada_tpu/"),
+)
+def _fetch_not_barrier(src: Source):
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            yield _finding(
+                src,
+                "fetch-not-barrier",
+                node,
+                "block_until_ready is not a reliable barrier over the axon "
+                "tunnel (it returned early, round 5 -- docs/bench.md): "
+                "synchronize with an actual device->host fetch of a scalar "
+                "or the compact result instead",
+            )
+
+
+# --------------------------------------------------------------------------
+# host rules
+# --------------------------------------------------------------------------
+
+def _name_assigned_from_call(fn: Optional[ast.AST], tree: ast.AST, name: str) -> bool:
+    """True if `name` is (re)bound from a Call in the enclosing function (or
+    module when `fn` is None) -- the repo's coercion idiom is
+    `v = col.dtype.type(v)` / `v = dt(v)`, always a Call."""
+    scope = fn if fn is not None else tree
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return True
+                if isinstance(tgt, ast.Tuple) and any(
+                    isinstance(e, ast.Name) and e.id == name for e in tgt.elts
+                ):
+                    return True
+    return False
+
+
+@rule(
+    "searchsorted-dtype",
+    "np.searchsorted with a probe whose dtype mismatches the column "
+    "promotes-and-COPIES the whole column (~230us/call at 300k rows, "
+    "round 2); coerce with `col.dtype.type(v)`",
+)
+def _searchsorted_dtype(src: Source):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "searchsorted"):
+            continue
+        base = _dotted(f.value)
+        if base in ("np", "numpy", "jnp"):
+            probe = node.args[1] if len(node.args) > 1 else None
+        else:
+            probe = node.args[0] if node.args else None  # col.searchsorted(v)
+        if probe is None:
+            continue
+        # Calls (any coercion/cast), constants, subscripts of same-table
+        # arrays and binops on them are same-dtype by construction; a bare
+        # Name is only trusted when the enclosing scope rebinds it from a
+        # Call (the `v = dt(v)` idiom).
+        if isinstance(probe, ast.Name):
+            if _name_assigned_from_call(
+                src.enclosing_function(node), src.tree, probe.id
+            ):
+                continue
+        elif not isinstance(probe, ast.Attribute):
+            continue
+        yield _finding(
+            src,
+            "searchsorted-dtype",
+            node,
+            "searchsorted probe is not visibly dtype-coerced: a mismatched "
+            "probe promotes-and-copies the whole column -- wrap it in "
+            "`col.dtype.type(...)` (or allow() stating why dtypes match)",
+        )
+
+
+@rule(
+    "fixed-sleep-retry",
+    "a constant time.sleep inside a retry loop (loop body containing "
+    "try/except) synchronizes every waiter onto the recovering peer; use "
+    "core/backoff.Backoff (full jitter)",
+)
+def _fixed_sleep_retry(src: Source):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        has_try = any(
+            isinstance(sub, ast.Try)
+            for stmt in node.body
+            for sub in ast.walk(stmt)
+        )
+        if not has_try:
+            continue  # poll loop, not a retry loop
+        for stmt in node.body:
+            for call in _calls_in(stmt, {"time.sleep", "sleep"}):
+                if call.args and isinstance(call.args[0], ast.Constant):
+                    yield _finding(
+                        src,
+                        "fixed-sleep-retry",
+                        call,
+                        "constant sleep in a retry loop retries in lockstep "
+                        "with every other waiter -- use "
+                        "core/backoff.Backoff.next_delay() (full jitter)",
+                    )
+
+
+@rule(
+    "bare-except",
+    "`except:` swallows KeyboardInterrupt/SystemExit and hides the "
+    "exception type from the reader; name the exception (Exception at "
+    "broadest) or re-raise",
+)
+def _bare_except(src: Source):
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield _finding(
+                src,
+                "bare-except",
+                node,
+                "bare `except:` also catches KeyboardInterrupt/SystemExit; "
+                "catch Exception (or narrower) instead",
+            )
+
+
+@rule(
+    "wallclock-event-order",
+    "wall-clock reads (time.time / datetime.now) in event-sourced modules: "
+    "event order comes from the log sequence; wall clocks skew across "
+    "hosts and move backwards",
+    scope=under("armada_tpu/eventlog/", "armada_tpu/jobdb/", "armada_tpu/events/"),
+)
+def _wallclock_event_order(src: Source):
+    bad = {
+        "time.time",
+        "time.time_ns",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in bad:
+            yield _finding(
+                src,
+                "wallclock-event-order",
+                node,
+                "wall-clock call in an event-sourced module: ordering must "
+                "come from the log sequence (use the injected clock for "
+                "timestamps, time.monotonic for intervals)",
+            )
+
+
+@rule(
+    "grpc-options",
+    "gRPC channels/servers built without the shared transport options "
+    "(rpc.transport): raising limits on only one side still breaks >4MB "
+    "lease batches (round-8 lesson, tests/test_rpc.py pins both sides)",
+    scope=under("armada_tpu/"),
+)
+def _grpc_options(src: Source):
+    if src.relpath in (
+        "armada_tpu/rpc/transport.py",  # defines the options
+    ):
+        return
+    targets = {
+        "grpc.insecure_channel",
+        "grpc.secure_channel",
+        "grpc.server",
+        "grpc.aio.insecure_channel",
+        "grpc.aio.secure_channel",
+        "grpc.aio.server",
+    }
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call) and _dotted(node.func) in targets):
+            continue
+        ok = False
+        for kw in node.keywords:
+            if kw.arg == "options":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Call) and _dotted(sub.func).split(
+                        "."
+                    )[-1] in ("server_options", "channel_options"):
+                        ok = True
+        if not ok:
+            yield _finding(
+                src,
+                "grpc-options",
+                node,
+                "gRPC channel/server without options=server_options()/"
+                "channel_options(): message caps + keepalive must match on "
+                "BOTH sides (rpc/transport.py)",
+            )
+
+
+@rule(
+    "thread-no-daemon",
+    "threading.Thread without an explicit daemon= : a wedged non-daemon "
+    "thread (the axon tunnel hang) blocks interpreter exit forever",
+    scope=under("armada_tpu/"),
+)
+def _thread_no_daemon(src: Source):
+    for node in ast.walk(src.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _dotted(node.func) in ("threading.Thread", "Thread")
+        ):
+            continue
+        if not any(kw.arg == "daemon" for kw in node.keywords):
+            yield _finding(
+                src,
+                "thread-no-daemon",
+                node,
+                "threading.Thread without explicit daemon=: a thread wedged "
+                "on a dead backend must not block process exit -- say "
+                "daemon=True, or daemon=False with an allow() explaining "
+                "the join discipline",
+            )
+
+
+@rule(
+    "lock-held-sleep",
+    "time.sleep while holding a lock: every other thread (including the "
+    "watchdog's failover path) stalls behind the sleeper",
+)
+def _lock_held_sleep(src: Source):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.With):
+            continue
+        holds_lock = any(
+            "lock" in _dotted(item.context_expr).lower()
+            or (
+                isinstance(item.context_expr, ast.Call)
+                and "lock" in _dotted(item.context_expr.func).lower()
+            )
+            for item in node.items
+        )
+        if not holds_lock:
+            continue
+        for stmt in node.body:
+            for call in _calls_in(stmt, {"time.sleep"}):
+                yield _finding(
+                    src,
+                    "lock-held-sleep",
+                    call,
+                    "sleeping while holding a lock stalls every waiter "
+                    "(the watchdog failover path contends these locks); "
+                    "sleep outside the critical section",
+                )
+
+
+@rule(
+    "mutable-default-arg",
+    "mutable default argument ([], {}, set()): shared across calls, a "
+    "classic aliasing bug",
+)
+def _mutable_default_arg(src: Source):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and _dotted(default.func) in ("list", "dict", "set")
+            ):
+                yield _finding(
+                    src,
+                    "mutable-default-arg",
+                    default,
+                    "mutable default argument is shared across calls; "
+                    "default to None and construct inside",
+                )
+
+
+# --------------------------------------------------------------------------
+# event-sourcing rules
+# --------------------------------------------------------------------------
+
+# DB fetch cursors may only advance with a committed JobDb txn
+# (scheduler/scheduler.py _cycle: cursors0 save + abort rewind); consumer
+# positions commit transactionally with their batch (ingest/pipeline.py).
+_CURSOR_FIELDS = {"_jobs_serial", "_runs_serial"}
+_CURSOR_OWNERS = {"armada_tpu/scheduler/scheduler.py"}
+_POSITION_OWNERS = {
+    "armada_tpu/eventlog/publisher.py",  # Consumer.ack / reset
+    "armada_tpu/ingest/pipeline.py",  # ack only after the store committed
+}
+
+
+@rule(
+    "cursor-outside-txn",
+    "DB fetch cursors (_jobs_serial/_runs_serial) and consumer positions "
+    "may only move inside the txn-commit helpers; an out-of-band write "
+    "skips or replays batches",
+    scope=under("armada_tpu/"),
+)
+def _cursor_outside_txn(src: Source):
+    for node in ast.walk(src.tree):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and sub.attr in _CURSOR_FIELDS
+                    and src.relpath not in _CURSOR_OWNERS
+                ):
+                    yield _finding(
+                        src,
+                        "cursor-outside-txn",
+                        node,
+                        f"write to fetch cursor `{sub.attr}` outside "
+                        "scheduler/scheduler.py: cursors only advance with "
+                        "a committed txn (abort must rewind them)",
+                    )
+        # consumer-position advance: Consumer.ack()/positions mutation
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "ack"
+                and "consumer" in _dotted(f.value).lower()
+                and src.relpath not in _POSITION_OWNERS
+            ):
+                yield _finding(
+                    src,
+                    "cursor-outside-txn",
+                    node,
+                    "consumer position ack outside the ingestion pipeline: "
+                    "positions commit transactionally with their batch "
+                    "(ingest/pipeline.py)",
+                )
+
+
+_QV_OWNERS = {
+    "armada_tpu/jobdb/job.py",  # the lease/requeue transition helpers
+    "armada_tpu/ingest/schedulerdb.py",  # version-guarded UPDATE
+    "armada_tpu/ingest/dbops.py",  # row merge carries the version
+    "armada_tpu/scheduler/reconciliation.py",  # version-guard row merge
+}
+
+
+@rule(
+    "queued-version-write",
+    "queued_version written outside the lease path: queued/lease state is "
+    "guarded by queued_version (the lease event carries "
+    "update_sequence_number); an out-of-band bump desyncs requeue "
+    "protection",
+    scope=under("armada_tpu/"),
+)
+def _queued_version_write(src: Source):
+    if src.relpath in _QV_OWNERS:
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "queued_version":
+                    yield _finding(
+                        src,
+                        "queued-version-write",
+                        node,
+                        "queued_version passed outside the jobdb/ingest "
+                        "lease path: the version guard only stays sound "
+                        "when every bump rides a lease/requeue transition",
+                    )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in tgts:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "queued_version":
+                    yield _finding(
+                        src,
+                        "queued-version-write",
+                        node,
+                        "direct queued_version attribute write: Jobs are "
+                        "immutable; versions move via the jobdb transition "
+                        "helpers only",
+                    )
+
+
+# --------------------------------------------------------------------------
+# engine
+# --------------------------------------------------------------------------
+
+def lint_source(text: str, relpath: str) -> list[Finding]:
+    """Lint one buffer as if it lived at `relpath` (rule scoping applies).
+    Returns findings sorted by (line, col, rule); suppressed findings are
+    dropped, reasonless allows surface as `allow-missing-reason`."""
+    try:
+        src = Source(text, relpath)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "syntax-error",
+                relpath.replace(os.sep, "/"),
+                e.lineno or 0,
+                e.offset or 0,
+                f"file does not parse: {e.msg}",
+            )
+        ]
+    out: list[Finding] = []
+    for line, rules in src.reasonless_allows:
+        out.append(
+            Finding(
+                "allow-missing-reason",
+                src.relpath,
+                line,
+                0,
+                f"allow({rules}) without a reason: write "
+                "`# lint: allow(rule) -- why this site is exempt`",
+            )
+        )
+    for r in RULES:
+        if not r.scope(src.relpath):
+            continue
+        for f in r.check(src):
+            node = ast.AST()  # suppression check wants a node-like span
+            node.lineno = f.line
+            node.end_lineno = f.end_line or f.line
+            if not src.suppressed(f.rule, node):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def lint_file(path: str, root: str) -> list[Finding]:
+    rel = os.path.relpath(path, root)
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), rel)
+
+
+# Walk exclusions: generated protobuf modules (not authored here), fixture
+# files (deliberate true positives), payload/test data, VCS internals.
+EXCLUDE_DIRS = {
+    ".git",
+    "__pycache__",
+    ".pytest_cache",
+    "node_modules",
+    "testdata",
+}
+EXCLUDE_REL = ("tests/lint_fixtures",)
+EXCLUDE_FILE_PATTERNS = ("_pb2.py", "_pb2_grpc.py")
+
+
+def iter_python_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+        dirnames[:] = sorted(
+            d
+            for d in dirnames
+            if d not in EXCLUDE_DIRS
+            and not (rel_dir + "/" + d if rel_dir != "." else d).startswith(
+                EXCLUDE_REL
+            )
+        )
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            if any(name.endswith(pat) for pat in EXCLUDE_FILE_PATTERNS):
+                continue
+            yield os.path.join(dirpath, name)
+
+
+def lint_tree(root: str) -> tuple[int, list[Finding]]:
+    """(files scanned, findings) over every authored .py under `root`."""
+    findings: list[Finding] = []
+    n = 0
+    for path in iter_python_files(root):
+        n += 1
+        findings.extend(lint_file(path, root))
+    return n, findings

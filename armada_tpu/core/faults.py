@@ -35,8 +35,9 @@ lookup when ``ARMADA_FAULT`` is unset.
 from __future__ import annotations
 
 import os
-import threading
 import time
+
+from armada_tpu.analysis.tsan import make_lock
 
 
 class FaultInjected(RuntimeError):
@@ -44,7 +45,7 @@ class FaultInjected(RuntimeError):
     sites are handled exactly like a real XLA runtime error."""
 
 
-_lock = threading.Lock()
+_lock = make_lock("faults.state")
 # (site, mode, after_n) -> number of checks seen / whether it already fired.
 _counts: dict[tuple, int] = {}
 _fired: set[tuple] = set()

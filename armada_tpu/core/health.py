@@ -26,6 +26,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from armada_tpu.analysis.tsan import make_lock
+
 
 def sample_profile(seconds: float, interval_s: float = 0.01) -> str:
     """Statistical profile of EVERY thread in the process: sample
@@ -93,7 +95,7 @@ class MultiChecker:
     """Joins constituent checkers; unhealthy if any is (multi_checker.go)."""
 
     def __init__(self, *checkers):
-        self._lock = threading.Lock()
+        self._lock = make_lock("health.multi_checker")
         self._checkers = list(checkers)
 
     def add(self, checker) -> None:

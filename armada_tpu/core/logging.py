@@ -91,10 +91,16 @@ def _ensure_configured() -> None:
         root.propagate = False
 
 
-def spawn_with_context(target: Callable, *args, **kwargs) -> threading.Thread:
+def spawn_with_context(
+    target: Callable, *args, daemon: bool = True, **kwargs
+) -> threading.Thread:
     """threading.Thread whose body runs under the CURRENT logging context
     (contextvars are per-thread; the reference's armadacontext rides Go's
-    ctx through goroutines, this is the Python analog)."""
+    ctx through goroutines, this is the Python analog).  Daemon by default:
+    a spawned worker wedged on a dead backend must never block process
+    exit; pass daemon=False only with an explicit join discipline."""
     ctx = contextvars.copy_context()
-    t = threading.Thread(target=lambda: ctx.run(target, *args, **kwargs))
+    t = threading.Thread(
+        target=lambda: ctx.run(target, *args, **kwargs), daemon=daemon
+    )
     return t

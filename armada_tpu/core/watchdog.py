@@ -39,6 +39,7 @@ import time
 import weakref
 from typing import Callable, Optional
 
+from armada_tpu.analysis.tsan import make_lock
 from armada_tpu.core.logging import get_logger
 
 _log = get_logger(__name__)
@@ -106,7 +107,7 @@ def run_with_deadline(fn: Callable, deadline_s: float, what: str = "device round
 # detach long-lived feeds from failover notifications.  Weak references:
 # a closed control plane's feed must not be kept alive by the registry.
 _reset_hooks: list = []
-_hooks_lock = threading.Lock()
+_hooks_lock = make_lock("watchdog.reset_hooks")
 
 
 def add_reset_hook(fn: Callable[[], None]) -> None:
@@ -139,7 +140,7 @@ class DeviceSupervisor:
     """Process-wide device-backend health state."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("watchdog.supervisor")
         self.backend = "device"  # "device" = default jax backend
         self.consecutive_failures = 0
         self.fallbacks = 0

@@ -13,14 +13,15 @@ import fcntl
 import os
 import struct
 import subprocess
-import threading
 from typing import Iterator, NamedTuple
+
+from armada_tpu.analysis.tsan import make_lock
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_HERE, "_eventlog.so")
 _SRC = os.path.join(_HERE, os.pardir, "native", "eventlog.cc")
 
-_build_lock = threading.Lock()
+_build_lock = make_lock("eventlog.native_build")
 _lib = None
 
 

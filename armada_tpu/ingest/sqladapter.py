@@ -181,11 +181,8 @@ class PgAdapter:
         return out
 
     # Read shapes never lazy-BEGIN: a txn opened for a pure read would sit
-    # idle-in-transaction until the next commit() and block PG vacuum.
-    # WITH is deliberately ABSENT from both lists: PostgreSQL allows
-    # data-modifying CTEs (WITH ... DELETE/INSERT ... RETURNING), so the
-    # leading verb alone cannot classify it -- it falls through to the loud
-    # SqlDialectError below until a real statement needs it.
+    # idle-in-transaction until the next commit() and block PG vacuum (a
+    # read misclassified as write leaks an idle-in-transaction session).
     _READ_PREFIXES = ("SELECT", "EXPLAIN", "VALUES", "SHOW", "TABLE")
     _WRITE_PREFIXES = (
         "INSERT",
@@ -207,11 +204,30 @@ class PgAdapter:
         "COPY",
     )
 
+    # WITH cannot be classified by the leading verb alone: PostgreSQL
+    # allows data-modifying CTEs (WITH d AS (DELETE ... RETURNING) SELECT),
+    # so the presence of ANY DML keyword anywhere in the statement (quoted
+    # literals stripped -- a literal may legitimately contain "DELETE")
+    # makes it a write; otherwise a plain read body (SELECT/VALUES/TABLE)
+    # classifies it as a read.  Word-bounded so identifiers like
+    # `deleted_at` never match.
+    _CTE_DML = re.compile(r"\b(INSERT|UPDATE|DELETE|MERGE)\b", re.IGNORECASE)
+    _CTE_READ = re.compile(r"\b(SELECT|VALUES|TABLE)\b", re.IGNORECASE)
+
     @classmethod
     def _is_write(cls, sql: str) -> bool:
         head = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
         if head.startswith(cls._READ_PREFIXES):
             return False
+        if head == "WITH" or head.startswith("WITH("):
+            body = _QUOTED_LITERAL.sub("''", sql)
+            if cls._CTE_DML.search(body):
+                return True
+            if cls._CTE_READ.search(body):
+                return False
+            raise SqlDialectError(
+                f"CTE statement with no classifiable body verb: {sql!r}"
+            )
         if head.startswith(cls._WRITE_PREFIXES):
             return True
         # Unknown verb: fail loudly rather than guess.  Treating it as a

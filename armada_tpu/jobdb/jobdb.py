@@ -17,7 +17,6 @@ half-applied commit.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Iterable, Optional
 
 try:
@@ -25,9 +24,11 @@ try:
 except ImportError:  # not in every toolchain; same-semantics local subset
     from armada_tpu.jobdb._sortedlist import SortedKeyList
 
+from armada_tpu.analysis.tsan import make_lock
 from armada_tpu.core.config import SchedulingConfig
 from armada_tpu.core.ordering import scheduling_order_key
 from armada_tpu.jobdb.job import Job, JobRun
+
 
 def _order_key(config: SchedulingConfig) -> Callable[[Job], tuple]:
     def key(job: Job) -> tuple:
@@ -68,10 +69,10 @@ class JobDb:
         self._queued: dict[str, SortedKeyList] = {}
         self._unvalidated: set[str] = set()
         self._order = order_key or _order_key(self.config)
-        self._writer = threading.Lock()
+        self._writer = make_lock("jobdb.writer")
         # Guards in-place index mutation during _apply against concurrent
         # reader iteration (readers snapshot under this lock).
-        self._state = threading.Lock()
+        self._state = make_lock("jobdb.state")
         # Commit subscribers: fn(upserts: dict[str, Job], deletes: set[str]),
         # called after each committed txn -- the delta feed for the
         # incremental problem builder (scheduler/incremental_algo.py), the

@@ -30,11 +30,11 @@ class _ShadowOnce:
     claimed under the lock; the thunk itself runs outside it)."""
 
     def __init__(self, thunks):
-        import threading
+        from armada_tpu.analysis.tsan import make_lock
 
         self._thunks = list(thunks)
         self._next = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("models.shadow_once")
 
     def run_pending(self) -> None:
         while True:

@@ -44,6 +44,7 @@ from typing import Callable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from armada_tpu.analysis.tsan import check_generation as _tsan_check_gen
 from armada_tpu.core.config import SchedulingConfig
 from armada_tpu.core.keys import (
     NodeTypeIndex,
@@ -258,8 +259,9 @@ class _SortedTable:
                 # positions order by position; the run SHARING this base gap
                 # (common: a queue tail absorbing several cycles of arrivals)
                 # needs the key refinement, but only over that run
-                olo = int(ov_pos.searchsorted(lo, "left"))
-                ohi = int(ov_pos.searchsorted(lo, "right"))
+                lo_t = ov_pos.dtype.type(lo)
+                olo = int(ov_pos.searchsorted(lo_t, "left"))
+                ohi = int(ov_pos.searchsorted(lo_t, "right"))
                 if olo != ohi:
                     plo, phi = sn + olo, sn + ohi
                     for col, dt, c in zip(cols, dtypes, scols):
@@ -1835,6 +1837,11 @@ class IncrementalBuilder:
             # flight (device loss mid-prefetch) -- the devcache was replaced
             # or reset, so these rows must STAY in the next bundle's payload.
             return 0
+        # Race harness (ARMADA_TSAN=1): marking rows shipped is only sound
+        # under the generation the scatter began under -- if the guard above
+        # ever regresses, this records the zombie write instead of letting
+        # it silently drop rows from the next bundle.
+        _tsan_check_gen("builder.prefetch_mark", gen, self._prefetch_gen)
         self._shipped_sg = len(sg.dirty_log)
         self._shipped_rr = len(rr.dirty_log)
         return int(i_sing.shape[0] + rr_d.shape[0])

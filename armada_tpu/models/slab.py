@@ -41,6 +41,7 @@ import functools
 
 import numpy as np
 
+from armada_tpu.analysis.tsan import GenerationGuard
 from armada_tpu.models.xfer import TRANSFER_STATS
 
 _ID_DTYPE = "S48"
@@ -312,6 +313,11 @@ class DeviceDeltaCache:
         # uploads (the fleet rarely changes).
         self._host_ids: dict = {}
         self._node_dev: dict = {}
+        # Race harness (analysis/tsan, ARMADA_TSAN=1): every mutation must
+        # commit under the generation it began under; reset() bumps.  A
+        # zombie watchdog worker finishing a scatter after a device-loss
+        # reset is recorded as a violation instead of silently racing.
+        self._tsan = GenerationGuard("devcache")
 
     def reset(self) -> None:
         """Explicit device-state invalidation (device loss / re-promotion,
@@ -322,6 +328,7 @@ class DeviceDeltaCache:
         most stale paths silent no-ops anyway; the explicit reset makes the
         invalidation a guarantee rather than a property of guard coverage
         (and frees buffers pinned on a dead backend)."""
+        self._tsan.bump()
         self._sig = None
         self._seq = None
         self._prev = None
@@ -367,6 +374,7 @@ class DeviceDeltaCache:
     def apply(self, bundle: DeltaBundle):
         global _APPLY
 
+        tok = self._tsan.begin()
         if (
             self._sig != bundle.sig
             or self._prev is None
@@ -375,7 +383,9 @@ class DeviceDeltaCache:
         ):
             self._sig = bundle.sig
             self._seq = bundle.seq
-            return self._full_upload(bundle.materialize())
+            problem = bundle.materialize()
+            self._tsan.commit(tok, "apply/full-upload")
+            return self._full_upload(problem)
         self._seq = bundle.seq
 
         G = self._prev.g_req.shape[0]
@@ -424,6 +434,7 @@ class DeviceDeltaCache:
                 TRANSFER_STATS.count_up(arr.nbytes)
         if _APPLY is None:
             _APPLY = _make_apply()
+        self._tsan.commit(tok, "apply/scatter")
         self._prev = _APPLY(
             self._prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls,
             gq_args, ev_base=bundle.ev_base, splice=splice,
@@ -453,6 +464,7 @@ class DeviceDeltaCache:
         simply ride the next bundle or its full-upload fallback."""
         global _APPLY
 
+        tok = self._tsan.begin()
         if (
             self._prev is None
             or self._sig != sig
@@ -478,6 +490,7 @@ class DeviceDeltaCache:
                 TRANSFER_STATS.count_up(arr.nbytes)
         if _APPLY is None:
             _APPLY = _make_apply()
+        self._tsan.commit(tok, "scatter_content")
         self._prev = _APPLY(
             self._prev, sg_pad, sg_cols, rr_pad, rr_cols, ev_cols, {},
             (), ev_base=ev_base, splice=False,

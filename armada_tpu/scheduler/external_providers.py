@@ -59,7 +59,13 @@ class _PollingClient:
         channel: Optional[grpc.Channel] = None,
         timeout_s: float = 10.0,
     ):
-        self._channel = channel or grpc.insecure_channel(address)
+        if channel is None:
+            # Shared transport hardening (rpc/transport.py): caps/keepalive
+            # must match the serving side or >4MB responses still break.
+            from armada_tpu.rpc.transport import channel_options
+
+            channel = grpc.insecure_channel(address, options=channel_options())
+        self._channel = channel
         self._call = self._channel.unary_unary(
             method,
             request_serializer=lambda m: m.SerializeToString(),
@@ -193,7 +199,11 @@ def serve_providers(
     """
     from concurrent import futures
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    from armada_tpu.rpc.server import server_options
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=4), options=server_options()
+    )
     handlers = []
     if bid_prices is not None:
 

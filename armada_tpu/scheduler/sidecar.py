@@ -25,7 +25,6 @@ corrected by the next sync.
 from __future__ import annotations
 
 import json
-import threading
 import time
 import uuid
 from typing import Optional, Sequence
@@ -40,6 +39,8 @@ from armada_tpu.jobdb.jobdb import JobDb
 from armada_tpu.scheduler.algo import FairSchedulingAlgo, SchedulerResult
 from armada_tpu.scheduler.providers import most_specific_bid
 from armada_tpu.scheduler.executors import ExecutorSnapshot
+
+from armada_tpu.analysis.tsan import make_lock
 
 FAILED_SAMPLE_CAP = 1000
 
@@ -179,7 +180,7 @@ class ScheduleSession:
             bid_prices=self.bids if market else None,
             feed=self.feed,
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("sidecar.session")
 
     # ----------------------------------------------------------- syncing ----
     # One SyncState request applies ATOMICALLY with respect to rounds: the
@@ -345,7 +346,7 @@ class ScheduleSidecar:
         self.default_config = default_config
         self._clock_ns = clock_ns or (lambda: int(time.time() * 1e9))
         self._sessions: dict[str, ScheduleSession] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("sidecar.service")
 
     def create_session(
         self, session_id: str = "", config_yaml: str = ""

@@ -30,6 +30,23 @@ _last_module = [None]
 
 
 @pytest.fixture(autouse=True)
+def _tsan_violations_fail_tests():
+    """ARMADA_TSAN=1 (analysis/tsan): any lock-order inversion or
+    generation-stale write recorded during a test FAILS it -- the race
+    harness turns zombie-worker races into red tests instead of debugging
+    sessions.  Zero-cost no-op when the harness is disarmed."""
+    from armada_tpu.analysis import tsan
+
+    if not tsan.enabled():
+        yield
+        return
+    tsan.reset()
+    yield
+    found = tsan.take_violations()
+    assert not found, "tsan violations:\n" + "\n".join(found)
+
+
+@pytest.fixture(autouse=True)
 def _bound_xla_mappings(request):
     """Drop compiled executables at each module boundary.
 
@@ -81,6 +98,9 @@ _FAST_MODULES = {
     "tests/test_metric_events.py",
     "tests/test_submit_brake.py",
     "tests/test_lookout.py",
+    # armada-lint self-hosting gate: the fast tier IS the CI path that
+    # keeps the tree lint-clean (tools/lint.py; docs/lint.md).
+    "tests/test_lint.py",
 }
 # How many representative tests each remaining module contributes.
 _FAST_PICKS = 2
@@ -113,6 +133,9 @@ _FAST_PICKS_OVERRIDE = {
     # 2 representatives + the explicitly-marked ARMADA_PIPELINE=0 parity
     # guard (the sequential escape hatch must not rot out of the fast tier).
     "tests/test_pipeline.py": 2,
+    # first 4 = the cheap in-process race-harness drills (the subprocess
+    # pipeline/faults-under-ARMADA_TSAN=1 leg stays full-tier only).
+    "tests/test_tsan.py": 4,
 }
 # Never in the fast tier (opt-in external deps / native builds).
 _FAST_EXCLUDE_MODULES = {

@@ -1,0 +1,15 @@
+# Fixture for rule `axis1-scatter` (linted under armada_tpu/models/).
+# One true positive (marked TP) + near misses the rule must NOT flag.
+
+
+def update_cache(cache, idx, rows, scalar_row):
+    cache = cache.at[:, idx].set(rows)  # TP
+    # near-miss: constant scalar lane keeps the copy bounded
+    cache = cache.at[:, 0].set(scalar_row)
+    # near-miss: leading-dim (flat) index vector -- the prescribed layout
+    cache = cache.at[idx].set(rows)
+    # near-miss: static unroll -- python loop var over range() is a
+    # trace-time constant lane
+    for i in range(4):
+        cache = cache.at[:, i].set(scalar_row)
+    return cache

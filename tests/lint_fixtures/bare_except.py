@@ -1,0 +1,16 @@
+# Fixture for rule `bare-except`.
+
+
+def best_effort(fn):
+    try:
+        fn()
+    except:  # TP
+        pass
+
+
+def best_effort_named(fn):
+    # near-miss: Exception does not swallow KeyboardInterrupt/SystemExit
+    try:
+        fn()
+    except Exception:
+        pass

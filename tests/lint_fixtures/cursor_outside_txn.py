@@ -1,0 +1,16 @@
+# Fixture for rule `cursor-outside-txn` (linted under armada_tpu/, i.e.
+# NOT in the scheduler/ingest owner files).
+
+
+class SidecarShortcut:
+    def skip_ahead(self, rows):
+        self._jobs_serial = max(r["serial"] for r in rows)  # TP
+
+    def remember_highwater(self, rows):
+        # near-miss: a differently-named local highwater is not a cursor
+        self._jobs_highwater = max(r["serial"] for r in rows)
+
+    def drain(self, consumer, batch, store):
+        # near-miss: store-then-ack through the pipeline is allowed only in
+        # the owner module; the fixture's ack is on a non-consumer object
+        store.ack(batch)

@@ -1,0 +1,11 @@
+# Fixture for rule `f64-score` (linted as armada_tpu/models/fair_scheduler.py).
+import jax.numpy as jnp
+
+
+def score_rows(score, req):
+    widened = score.astype(jnp.float64)  # TP
+    # near-miss: f32 is the kernel's score dtype
+    ok = score.astype(jnp.float32)
+    # near-miss: int64 capacity math is exact and allowed
+    units = req.astype(jnp.int64)
+    return widened + ok.sum() + units.sum()

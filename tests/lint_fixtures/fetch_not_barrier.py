@@ -1,0 +1,9 @@
+# Fixture for rule `fetch-not-barrier` (linted under armada_tpu/).
+import numpy as np
+
+
+def wait_for_round(result, jax):
+    jax.block_until_ready(result)  # TP
+    # near-miss: a real device->host scalar fetch is the reliable barrier
+    sentinel = np.asarray(result.termination)
+    return sentinel

@@ -1,0 +1,28 @@
+# Fixture for rule `fixed-sleep-retry`.
+import time
+
+from armada_tpu.core.backoff import Backoff
+
+
+def reconnect(connect, poll_interval_s):
+    while True:
+        try:
+            return connect()
+        except ConnectionError:
+            time.sleep(0.5)  # TP
+
+
+def reconnect_jittered(connect):
+    # near-miss: the prescribed fix -- jittered delay from core/backoff
+    backoff = Backoff()
+    while True:
+        try:
+            return connect()
+        except ConnectionError:
+            time.sleep(backoff.next_delay())
+
+
+def poll(done, poll_interval_s):
+    # near-miss: a poll loop (no try/except) may sleep a fixed interval
+    while not done():
+        time.sleep(poll_interval_s)

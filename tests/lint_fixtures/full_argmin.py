@@ -1,0 +1,12 @@
+# Fixture for rule `full-argmin` (linted as armada_tpu/models/fair_scheduler.py).
+import jax.numpy as jnp
+
+
+def pick_node(masked, bm):
+    node = jnp.argmin(masked).astype(jnp.int32)  # TP
+    # near-miss: an annotated small-axis pick is the documented escape
+    # lint: allow(full-argmin) -- [NB] block-minima row (fixture)
+    b = jnp.argmin(bm).astype(jnp.int32)
+    # near-miss: min is a vector reduce, not the scalar-loop argmin
+    lo = jnp.min(masked)
+    return node, b, lo

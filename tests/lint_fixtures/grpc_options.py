@@ -1,0 +1,13 @@
+# Fixture for rule `grpc-options` (linted under armada_tpu/).
+import grpc
+
+from armada_tpu.rpc.transport import channel_options
+
+
+def dial(address):
+    return grpc.insecure_channel(address)  # TP
+
+
+def dial_hardened(address):
+    # near-miss: the shared transport options keep both sides' caps equal
+    return grpc.insecure_channel(address, options=channel_options())

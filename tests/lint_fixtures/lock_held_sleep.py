@@ -1,0 +1,14 @@
+# Fixture for rule `lock-held-sleep`.
+import time
+
+
+def drain_holding(lock, interval_s):
+    with lock:
+        time.sleep(interval_s)  # TP
+
+
+def drain_outside(lock, interval_s, step):
+    # near-miss: sleep outside the critical section
+    with lock:
+        step()
+    time.sleep(interval_s)

@@ -1,0 +1,19 @@
+# Fixture for rule `mutable-default-arg`.
+
+
+def collect(item, acc=[]):  # TP
+    acc.append(item)
+    return acc
+
+
+def collect_fresh(item, acc=None):
+    # near-miss: the None-default idiom
+    if acc is None:
+        acc = []
+    acc.append(item)
+    return acc
+
+
+def collect_tuple(item, acc=()):
+    # near-miss: immutable default
+    return acc + (item,)

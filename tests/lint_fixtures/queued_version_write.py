@@ -1,0 +1,16 @@
+# Fixture for rule `queued-version-write` (linted under armada_tpu/, i.e.
+# NOT in the jobdb/ingest lease-path owner files).
+
+
+def force_requeue(job, Job):
+    return Job(id=job.id, queued=True, queued_version=job.queued_version + 1)  # TP
+
+
+def read_version(job):
+    # near-miss: READS are free; the lease event carries the version
+    return job.queued_version
+
+
+def with_priority(job, Job):
+    # near-miss: other keywords on the same constructor are fine
+    return Job(id=job.id, priority=5)

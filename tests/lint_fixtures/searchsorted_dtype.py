@@ -1,0 +1,14 @@
+# Fixture for rule `searchsorted-dtype`.
+import numpy as np
+
+
+def rank_of(col, probe_value, other_rows):
+    pos = np.searchsorted(col, probe_value)  # TP
+    # near-miss: the coercion idiom -- probe rebound from a Call
+    v = col.dtype.type(probe_value)
+    pos2 = np.searchsorted(col, v)
+    # near-miss: inline coercion call
+    pos3 = col.searchsorted(np.int64(7), "left")
+    # near-miss: same-table subscript probe (same dtype by construction)
+    pos4 = np.searchsorted(col, other_rows[:-1])
+    return pos, pos2, pos3, pos4

@@ -1,0 +1,22 @@
+# Fixture for rule `thread-no-daemon` (linted under armada_tpu/).
+import threading
+
+
+def start_worker(loop):
+    t = threading.Thread(target=loop)  # TP
+    t.start()
+    return t
+
+
+def start_worker_daemon(loop):
+    # near-miss: explicit daemon decision
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+def start_worker_joined(loop):
+    # near-miss: daemon=False is fine when EXPLICIT (join discipline stated)
+    # lint: allow(thread-no-daemon) -- fixture: joined in stop()
+    t = threading.Thread(target=loop, daemon=False)
+    return t

@@ -1,0 +1,18 @@
+# Fixture for rule `wallclock-event-order` (linted under armada_tpu/eventlog/).
+import time
+
+
+def stamp_event(event):
+    event.ts = time.time()  # TP
+    return event
+
+
+def wait_budget(deadline_s):
+    # near-miss: monotonic is for intervals, not ordering
+    start = time.monotonic()
+    return time.monotonic() - start < deadline_s
+
+
+def make_consumer(consume, clock=time.time):
+    # near-miss: an injectable clock DEFAULT is a reference, not a call
+    return consume(clock)

@@ -155,6 +155,56 @@ def test_backoff_bounded_and_jittered():
     assert 0.01 <= bo.next_delay() <= 1.0
 
 
+@pytest.mark.fast
+def test_backoff_schedule_exact_with_seeded_rng():
+    """The schedule is AWS full jitter: delay_n = uniform(0, min(cap,
+    base*2^n)) with a floor -- pinned draw-for-draw against a twin RNG."""
+    import random
+
+    bo = Backoff(base_s=0.2, cap_s=3.0, floor_s=0.05, rng=random.Random(7))
+    twin = random.Random(7)
+    for n in range(12):
+        ceiling = min(3.0, 0.2 * (2.0**n))
+        assert bo.next_delay() == max(0.05, twin.uniform(0.0, ceiling))
+    # floor clamps to base: floor_s > base_s must not invert the schedule
+    assert Backoff(base_s=0.1, floor_s=0.5).floor_s == 0.1
+    # FULL jitter: post-cap draws still vary (lockstep retry is the bug
+    # this class exists to prevent)
+    bo2 = Backoff(base_s=1.0, cap_s=64.0, floor_s=0.0, rng=random.Random(3))
+    bo2.attempts = 10
+    assert len({bo2.next_delay() for _ in range(8)}) > 1
+
+
+@pytest.mark.fast
+def test_fault_after_n_arming_independent_per_entry(monkeypatch):
+    """after_n counters key on the FULL (site, mode, after_n) entry: two
+    entries on the same site arm independently, in spec order, each
+    one-shot; reset_counters() re-arms everything."""
+    monkeypatch.setenv("ARMADA_FAULT", "s:error:1,s:hang:3")
+    # check 1: error sees count 0 (<1), hang sees count 0 (<3)
+    assert faults.active("s") is None
+    # check 2: error reaches its after_n and fires (hang untouched -- the
+    # matching entry short-circuits the scan)
+    assert faults.active("s") == "error"
+    # checks 3-4 advance only the hang entry (error is spent)
+    assert faults.active("s") is None
+    assert faults.active("s") is None
+    # check 5: hang has now seen 3 free passes and fires; then it's spent
+    assert faults.active("s") == "hang"
+    assert faults.active("s") is None
+    # malformed entries (bad after_n, missing mode) are ignored, not fatal
+    faults.reset_counters()
+    monkeypatch.setenv("ARMADA_FAULT", "s:error:nope,junk,s2:error")
+    assert faults.active("s") is None
+    assert faults.active("s2") == "error"
+    # reset_counters re-arms a spent entry
+    monkeypatch.setenv("ARMADA_FAULT", "s3:error")
+    assert faults.active("s3") == "error"
+    assert faults.active("s3") is None
+    faults.reset_counters()
+    assert faults.active("s3") == "error"
+
+
 def test_reprobe_promotes_after_n_healthy(monkeypatch):
     sup = watchdog.supervisor()
     sup.configure(deadline_s=60.0, reprobe_interval_s=0.02, healthy_checks=2)

@@ -246,6 +246,38 @@ def test_placeholder_translation():
     assert order == [1, 0]
 
 
+def test_is_write_classification():
+    """Verb classification gates the lazy BEGIN: a read misclassified as a
+    write leaks an idle-in-transaction session that blocks vacuum; an
+    unknown verb must raise rather than guess."""
+    from armada_tpu.ingest.sqladapter import PgAdapter, SqlDialectError
+
+    w = PgAdapter._is_write
+    # plain reads never lazy-BEGIN -- incl. the VALUES/TABLE shapes
+    assert w("SELECT * FROM jobs") is False
+    assert w("  values (1), (2)") is False
+    assert w("TABLE jobs") is False
+    assert w("EXPLAIN SELECT 1") is False
+    # writes open the txn
+    assert w("INSERT INTO jobs VALUES (?)") is True
+    assert w("UPDATE jobs SET queued = FALSE") is True
+    assert w("DELETE FROM jobs WHERE job_id = ?") is True
+    # CTE-leading statements classify by their body, not the WITH:
+    assert w("WITH t AS (SELECT 1) SELECT * FROM t") is False
+    assert w("WITH RECURSIVE t AS (SELECT 1) TABLE t") is False
+    assert w("WITH t AS (SELECT 1) INSERT INTO jobs SELECT * FROM t") is True
+    # a data-modifying CTE is a write even when the body reads
+    assert w("WITH d AS (DELETE FROM jobs RETURNING job_id) SELECT * FROM d") is True
+    # DML keywords inside quoted literals / as identifier prefixes don't count
+    assert w("WITH t AS (SELECT 'please DELETE me') SELECT * FROM t") is False
+    assert w("WITH t AS (SELECT deleted_at FROM jobs) SELECT * FROM t") is False
+    # unknown verbs fail loudly (never guess a txn boundary)
+    with pytest.raises(SqlDialectError):
+        w("FROBNICATE jobs")
+    with pytest.raises(SqlDialectError):
+        w("WITH t AS (FROBNICATE) FROBNICATE")
+
+
 # --- SchedulerDb conformance across backends --------------------------------
 
 

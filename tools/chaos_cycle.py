@@ -56,12 +56,23 @@ def run_script(
     """One deterministic multi-cycle run; returns per-cycle decision lists.
     `fault` is None (clean replay) or "hang"/"error" injected at
     `fault_cycle`."""
+    from armada_tpu.analysis import tsan
     from armada_tpu.core import faults, watchdog
     from armada_tpu.core.config import PriorityClass, SchedulingConfig
     from armada_tpu.core.types import JobSpec, RunningJob
     from armada_tpu.models import run_round_on_device
     from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
 
+    # The FAULTED leg arms the race harness (analysis/tsan): the watchdog
+    # failover is exactly where zombie-worker races live.  The harness then
+    # STAYS armed through the promoted-wait and the clean replay -- an
+    # abandoned hang-mode worker can unwedge long after its own leg, and a
+    # late generation-stale scatter must still be recorded; main() harvests
+    # violations only after both legs.
+    if fault:
+        os.environ["ARMADA_TSAN"] = "1"
+        tsan.enable()
+        tsan.reset()
     faults.reset_counters()
     sup = watchdog.reset_supervisor()
     os.environ["ARMADA_REPROBE_INTERVAL_S"] = "0.05"
@@ -188,7 +199,20 @@ def main() -> int:
 
     clean, _ = run_script(fault=None, fault_cycle=0, **common)
 
-    ok = chaotic == clean and snap["fallbacks"] >= 1 and promoted
+    # Harvest AFTER both legs: the harness stayed armed, so a zombie worker
+    # unwedging during the promoted-wait or the clean replay still lands in
+    # the gate (tsan is record-only -- it cannot perturb the clean leg).
+    from armada_tpu.analysis import tsan
+
+    tsan_found = tsan.take_violations()
+    tsan.disable()
+
+    ok = (
+        chaotic == clean
+        and snap["fallbacks"] >= 1
+        and promoted
+        and not tsan_found
+    )
     line = {
         "tool": "chaos_cycle",
         "ok": ok,
@@ -201,7 +225,10 @@ def main() -> int:
         "decisions_equal": chaotic == clean,
         "scheduled_total": sum(len(s) for s, _ in clean),
         "chaos_run_s": round(chaos_s, 2),
+        "tsan_violations": len(tsan_found),
     }
+    if tsan_found:
+        line["tsan_detail"] = tsan_found[:5]
     if not ok and chaotic != clean:
         for i, (a, b) in enumerate(zip(chaotic, clean)):
             if a != b:

@@ -1,0 +1,82 @@
+"""armada-lint CI entrypoint: the whole tree must pass.
+
+Runs every registered rule (armada_tpu/analysis/lint.py; docs/lint.md is
+the catalogue) over all authored Python in the repo.  Exit 0 = clean;
+exit 1 = unsuppressed violations, printed one per line as
+``path:line:col: [rule] message``.
+
+    python tools/lint.py                # human output
+    python tools/lint.py --json         # ONE JSON line (bench/ops tooling)
+    python tools/lint.py --list-rules   # rule names + one-line summaries
+    python tools/lint.py path.py ...    # restrict to specific files
+
+The fast test tier runs this via tests/test_lint.py (the self-hosting
+gate), so a new violation fails CI the same cycle it lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from armada_tpu.analysis import lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files to lint (default: repo)")
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="one JSON line: {ok, files, violations, findings[]}",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    if args.list_rules:
+        for r in lint.RULES:
+            print(f"{r.name}: {r.summary}")
+        return 0
+
+    if args.paths:
+        findings = []
+        n = 0
+        for p in args.paths:
+            n += 1
+            findings.extend(lint.lint_file(os.path.abspath(p), root))
+    else:
+        n, findings = lint.lint_tree(root)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "tool": "armada_lint",
+                    "ok": not findings,
+                    "files": n,
+                    "rules": len(lint.RULES),
+                    "violations": len(findings),
+                    "findings": [f.as_dict() for f in findings],
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format())
+        print(
+            f"armada-lint: {n} files, {len(lint.RULES)} rules, "
+            f"{len(findings)} violation(s)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
