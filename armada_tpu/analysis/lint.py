@@ -512,6 +512,53 @@ def _wallclock_event_order(src: Source):
             )
 
 
+_SLO_MODULES = (
+    "armada_tpu/ops/metrics.py",
+    "armada_tpu/scheduler/slo.py",
+)
+
+
+def _slo_scope(p: str) -> bool:
+    return p.startswith("armada_tpu/loadgen/") or p in _SLO_MODULES
+
+
+@rule(
+    "slo-wallclock",
+    "clock reads in the SLO/loadgen modules outside the named mono_now() "
+    "helper: SLO latency math must ride ONE monotonic source -- wall "
+    "clocks skew and step backwards, and two clock sources in one "
+    "latency subtraction produce negative or fictional tails",
+    scope=_slo_scope,
+)
+def _slo_wallclock(src: Source):
+    banned = {
+        "time.time",
+        "time.time_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call) and _dotted(node.func) in banned):
+            continue
+        fn = src.enclosing_function(node)
+        if fn is not None and fn.name == "mono_now":
+            continue  # the single sanctioned definition site
+        yield _finding(
+            src,
+            "slo-wallclock",
+            node,
+            "clock read in an SLO/loadgen module: route every timestamp "
+            "through ops/metrics.mono_now() (the one monotonic source); "
+            "wall clocks here turn latency histograms into fiction",
+        )
+
+
 @rule(
     "grpc-options",
     "gRPC channels/servers built without the shared transport options "

@@ -384,6 +384,37 @@ def cmd_load_test(args):
     return 0
 
 
+def cmd_soak(args):
+    """Standing soak drill (tools/soak.py semantics, in-process plane):
+    sustained open-loop traffic for a wall-clock window, streaming SLO
+    report as one JSON line; optional mid-soak fault (chaos-under-load)."""
+    import json as _json
+
+    from armada_tpu.loadgen.soak import SoakConfig, run_soak_cli
+
+    overrides = {}
+    if args.window is not None:
+        overrides["window_s"] = args.window
+    if args.rate is not None:
+        overrides["target_eps"] = args.rate
+    if args.nodes is not None:
+        overrides["num_nodes"] = args.nodes
+    if args.queues is not None:
+        overrides["num_queues"] = args.queues
+    report = run_soak_cli(
+        SoakConfig.from_env(
+            process=args.process,
+            seed=args.seed,
+            fault=args.fault,
+            fault_at_frac=args.fault_at,
+            watchdog_s=args.watchdog_s,
+            **overrides,
+        )
+    )
+    print(_json.dumps(report, default=float))
+    return 0 if report.get("ok") else 1
+
+
 def _binoculars_call(args, fn):
     """Binoculars lives NEXT TO each executor (its --binoculars-port), not on
     the control plane; translate the inevitable wrong-URL mistake."""
@@ -942,6 +973,26 @@ def build_parser() -> argparse.ArgumentParser:
     lt = sub.add_parser("load-test", help="run a load-test spec")
     lt.add_argument("file")
     lt.set_defaults(fn=cmd_load_test)
+
+    sk = sub.add_parser(
+        "soak",
+        help="standing soak drill: open-loop traffic + streaming SLO JSON "
+        "(chaos-under-load via --fault)",
+    )
+    sk.add_argument("--window", type=float, default=None, help="window seconds")
+    sk.add_argument("--rate", type=float, default=None, help="target events/s")
+    sk.add_argument(
+        "--process", choices=("poisson", "bursty", "ramp"), default="poisson"
+    )
+    sk.add_argument("--seed", type=int, default=0)
+    sk.add_argument("--nodes", type=int, default=None)
+    sk.add_argument("--queues", type=int, default=None)
+    sk.add_argument(
+        "--fault", default=None, help="ARMADA_FAULT entry armed mid-soak"
+    )
+    sk.add_argument("--fault-at", type=float, default=0.5, dest="fault_at")
+    sk.add_argument("--watchdog-s", type=float, default=5.0, dest="watchdog_s")
+    sk.set_defaults(fn=cmd_soak)
 
     ex = sub.add_parser(
         "executor",

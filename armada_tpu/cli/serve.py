@@ -432,8 +432,13 @@ def start_control_plane(
 
         health_server = HealthServer(health_port, profiling=profiling, host=bind_host)
         # /healthz embeds the device-degradation block (backend,
-        # consecutive failures, last fallback reason) next to liveness.
+        # consecutive failures, last fallback reason) next to liveness,
+        # plus the streaming SLO percentiles (cycle latency, TTFL,
+        # ingest->visible lag -- scheduler/slo.py).
         health_server.device_status = supervisor().snapshot
+        from armada_tpu.scheduler.slo import recorder as _slo_recorder
+
+        health_server.slo_status = _slo_recorder().snapshot
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(

@@ -159,6 +159,15 @@ class _Handler(BaseHTTPRequestHandler):
                     body["device"] = srv.device_status()
                 except Exception as exc:  # noqa: BLE001
                     body["device"] = {"error": str(exc)}
+            if srv.slo_status is not None:
+                # Streaming SLO block (scheduler/slo.py): cycle-latency /
+                # TTFL / ingest-lag percentiles, so an operator reads tail
+                # latency from the same endpoint that reports degradation
+                # (docs/operations.md soak runbook).
+                try:
+                    body["slo"] = srv.slo_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["slo"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -224,6 +233,9 @@ class HealthServer:
         # Optional () -> dict: the device-degradation block /healthz embeds
         # (serve wires core/watchdog.supervisor().snapshot here).
         self.device_status = None
+        # Optional () -> dict: the streaming SLO block (serve wires
+        # scheduler/slo.recorder().snapshot here).
+        self.slo_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -1,0 +1,45 @@
+"""Sustained-traffic soak subsystem: open-loop load + streaming SLOs.
+
+The north star is "heavy traffic from millions of users"; every number the
+repo had before this package was a one-shot bench or an isolated fault
+drill.  ``loadgen`` closes that gap with three parts:
+
+* :mod:`armada_tpu.loadgen.arrivals` -- deterministic, seeded OPEN-LOOP
+  arrival processes (Poisson / bursty / ramp).  Open-loop means event times
+  are fixed in advance: a scheduler that falls behind faces a growing due
+  backlog, exactly like production traffic (closed-loop generators that
+  wait for the system self-throttle and hide saturation).
+* :mod:`armada_tpu.loadgen.workload` + :mod:`armada_tpu.loadgen.lifecycle`
+  -- a seeded submit/cancel/reprioritise/gang mix over N queues, with
+  per-job lifecycle tracking (double-lease and dropped-job detection, the
+  invariants chaos-under-load must not break).
+* :mod:`armada_tpu.loadgen.soak` -- the driver: a real in-process control
+  plane (SubmitServer -> eventlog -> ingest -> scheduler -> fake
+  executors), a wall-clock window of sustained traffic, optional mid-soak
+  ``ARMADA_FAULT`` arming, and one JSON report built from the streaming SLO
+  layer (scheduler/slo.py).
+
+Clock discipline: armada-lint's ``slo-wallclock`` rule bans wall-clock
+reads in this package -- every latency timestamp is ops/metrics.mono_now().
+"""
+
+from armada_tpu.loadgen.arrivals import (
+    ArrivalProcess,
+    BurstyArrivals,
+    PoissonArrivals,
+    RampArrivals,
+    make_arrivals,
+)
+from armada_tpu.loadgen.lifecycle import LifecycleTracker
+from armada_tpu.loadgen.workload import MixConfig, WorkloadGenerator
+
+__all__ = [
+    "ArrivalProcess",
+    "PoissonArrivals",
+    "BurstyArrivals",
+    "RampArrivals",
+    "make_arrivals",
+    "MixConfig",
+    "WorkloadGenerator",
+    "LifecycleTracker",
+]

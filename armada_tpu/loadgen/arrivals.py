@@ -1,0 +1,133 @@
+"""Open-loop arrival processes: deterministic, seeded event timetables.
+
+An arrival process is an iterator of absolute event times (seconds since
+the soak's start).  The driver polls :meth:`ArrivalProcess.due_until` with
+the current relative time and applies however many events have come due --
+the times never depend on how fast the system drains them (OPEN loop), so
+saturation shows up as a due backlog + rising latency instead of silently
+stretching the timetable.
+
+Determinism: given (class, params, seed), the full timetable is a pure
+function -- two runs see bit-identical arrival times, which is what lets
+the chaos harness replay the same traffic with and without a fault.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+class ArrivalProcess:
+    """Base: a monotone stream of event times, consumed by due_until()."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._next_t = 0.0
+        self._primed = False
+        self.emitted = 0
+
+    # subclasses: the gap to the next event, drawn at absolute time t
+    def _gap(self, t: float) -> float:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _advance(self) -> None:
+        self._next_t += max(self._gap(self._next_t), 1e-9)
+
+    def peek(self) -> float:
+        if not self._primed:
+            self._advance()
+            self._primed = True
+        return self._next_t
+
+    def due_until(self, t_rel: float, cap: int = 1_000_000) -> int:
+        """Number of events with arrival time <= t_rel (advances the
+        stream).  `cap` bounds one poll so a long stall cannot ask for an
+        unbounded batch in a single call."""
+        n = 0
+        while n < cap and self.peek() <= t_rel:
+            n += 1
+            self.emitted += 1
+            self._advance()
+        return n
+
+
+class PoissonArrivals(ArrivalProcess):
+    """Homogeneous Poisson process at `rate_eps` events/s."""
+
+    def __init__(self, rate_eps: float, seed: int = 0):
+        if rate_eps <= 0:
+            raise ValueError("rate_eps must be > 0")
+        super().__init__(seed)
+        self.rate_eps = float(rate_eps)
+
+    def _gap(self, t: float) -> float:
+        return self._rng.expovariate(self.rate_eps)
+
+
+class BurstyArrivals(ArrivalProcess):
+    """On/off modulated Poisson: `burst_eps` during the on-window of every
+    `period_s`, `base_eps` otherwise (duty = on fraction).  The mean rate is
+    duty*burst + (1-duty)*base; the bursts are what stress slab growth and
+    the due-backlog drain."""
+
+    def __init__(
+        self,
+        base_eps: float,
+        burst_eps: float,
+        period_s: float = 10.0,
+        duty: float = 0.2,
+        seed: int = 0,
+    ):
+        if base_eps <= 0 or burst_eps <= 0 or period_s <= 0:
+            raise ValueError("rates and period must be > 0")
+        if not (0.0 < duty < 1.0):
+            raise ValueError("duty must be in (0, 1)")
+        super().__init__(seed)
+        self.base_eps = float(base_eps)
+        self.burst_eps = float(burst_eps)
+        self.period_s = float(period_s)
+        self.duty = float(duty)
+
+    def _gap(self, t: float) -> float:
+        in_burst = (t % self.period_s) < self.duty * self.period_s
+        return self._rng.expovariate(self.burst_eps if in_burst else self.base_eps)
+
+
+class RampArrivals(ArrivalProcess):
+    """Linear ramp from `rate0_eps` to `rate1_eps` over `ramp_s`, constant
+    after -- the warm-up / traffic-growth shape.  Gaps are drawn at the
+    instantaneous rate (adequate for ramps much longer than 1/rate)."""
+
+    def __init__(
+        self, rate0_eps: float, rate1_eps: float, ramp_s: float, seed: int = 0
+    ):
+        if rate0_eps <= 0 or rate1_eps <= 0 or ramp_s <= 0:
+            raise ValueError("rates and ramp_s must be > 0")
+        super().__init__(seed)
+        self.rate0_eps = float(rate0_eps)
+        self.rate1_eps = float(rate1_eps)
+        self.ramp_s = float(ramp_s)
+
+    def rate_at(self, t: float) -> float:
+        if t >= self.ramp_s:
+            return self.rate1_eps
+        f = t / self.ramp_s
+        return self.rate0_eps + f * (self.rate1_eps - self.rate0_eps)
+
+    def _gap(self, t: float) -> float:
+        return self._rng.expovariate(self.rate_at(t))
+
+
+def make_arrivals(process: str, rate_eps: float, seed: int = 0) -> ArrivalProcess:
+    """Factory for the CLI/bench knobs: `poisson`, `bursty` (4x bursts at
+    20% duty around the target mean), `ramp` (10% -> 190% of target over
+    half the nominal window, mean ~= target)."""
+    if process == "poisson":
+        return PoissonArrivals(rate_eps, seed=seed)
+    if process == "bursty":
+        # duty*burst + (1-duty)*base == rate_eps with burst = 4x base
+        base = rate_eps / (1.0 + 0.2 * 3.0)
+        return BurstyArrivals(base, 4.0 * base, period_s=10.0, duty=0.2, seed=seed)
+    if process == "ramp":
+        return RampArrivals(0.1 * rate_eps, 1.9 * rate_eps, ramp_s=30.0, seed=seed)
+    raise ValueError(f"unknown arrival process {process!r}")

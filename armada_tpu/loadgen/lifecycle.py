@@ -1,0 +1,136 @@
+"""Per-job lifecycle tracking: the invariants a soak must not break.
+
+The tracker consumes the event stream (server/eventapi watch batches) for
+the soak's jobsets and maintains a tiny per-job state machine.  Two
+violation classes are the acceptance gates for chaos-under-load:
+
+* **double lease** -- a ``job_run_leased`` for a job whose previous run is
+  still active (no terminal run event / requeue in between).  This is the
+  failure device-loss failover + ingestion-lag bugs produce (the round-8
+  ``_awaiting_ack`` lesson): the same job running twice.
+* **dropped job** -- a submitted job the system lost track of: at drain
+  time it is neither terminal nor visible as queued/leased in the
+  scheduler DB.
+
+Everything else (terminal counts, first-lease timing cross-check) is
+reporting.  Timestamps are mono_now() -- lint rule ``slo-wallclock``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from armada_tpu.ops.metrics import mono_now
+
+# run-state transitions that end the active lease
+_RUN_ENDING = {
+    "job_run_cancelled",
+    "job_run_preempted",
+    "job_run_errors",  # terminal or lease_returned: either way not active
+}
+_JOB_TERMINAL = {"job_succeeded", "job_errors", "cancelled_job"}
+
+
+@dataclasses.dataclass
+class JobTrack:
+    queue: str
+    submit_t: float
+    active_run: Optional[str] = None
+    lease_count: int = 0
+    first_lease_t: Optional[float] = None
+    requeued: bool = False
+    terminal: Optional[str] = None  # event kind that ended it
+
+
+class LifecycleTracker:
+    def __init__(self):
+        self.jobs: dict[str, JobTrack] = {}
+        self.violations: list[str] = []
+        self.events_seen = 0
+
+    # ----------------------------------------------------------- feeding ----
+
+    def note_submitted(self, queue: str, job_ids, t: Optional[float] = None) -> None:
+        t0 = mono_now() if t is None else t
+        for jid in job_ids:
+            # dedup re-submits return the original id; keep the first track
+            self.jobs.setdefault(jid, JobTrack(queue=queue, submit_t=t0))
+
+    def observe_sequence(self, seq) -> None:
+        """One pb.EventSequence from the jobset's event stream."""
+        t = mono_now()
+        for ev in seq.events:
+            kind = ev.WhichOneof("event")
+            body = getattr(ev, kind, None) if kind else None
+            jid = getattr(body, "job_id", "") if body is not None else ""
+            if not jid or jid not in self.jobs:
+                continue
+            self.events_seen += 1
+            track = self.jobs[jid]
+            if kind == "job_run_leased":
+                if track.active_run is not None:
+                    self.violations.append(
+                        f"double lease: job {jid} leased run "
+                        f"{body.run_id} while run {track.active_run} active"
+                    )
+                if track.terminal is not None:
+                    self.violations.append(
+                        f"lease after terminal: job {jid} ({track.terminal}) "
+                        f"leased run {body.run_id}"
+                    )
+                track.active_run = body.run_id
+                track.lease_count += 1
+                track.requeued = False
+                if track.first_lease_t is None:
+                    track.first_lease_t = t
+            elif kind == "job_requeued":
+                track.active_run = None
+                track.requeued = True
+            elif kind in _RUN_ENDING:
+                run_id = getattr(body, "run_id", "")
+                if track.active_run is not None and run_id in ("", track.active_run):
+                    track.active_run = None
+            elif kind in _JOB_TERMINAL:
+                if track.terminal is not None and kind != track.terminal:
+                    # two different terminal outcomes for one job is the
+                    # resurrection bug class (zombie row merges)
+                    self.violations.append(
+                        f"double terminal: job {jid} {track.terminal} then {kind}"
+                    )
+                track.terminal = kind
+                track.active_run = None
+
+    # ---------------------------------------------------------- reporting ---
+
+    def check_dropped(self, db_states: dict) -> None:
+        """`db_states`: job_id -> state string from the scheduler DB
+        (queued/leased/succeeded/failed/cancelled).  A submitted job absent
+        from BOTH the observed-terminal set and the DB was dropped."""
+        for jid, track in self.jobs.items():
+            if track.terminal is None and jid not in db_states:
+                self.violations.append(
+                    f"dropped: job {jid} (queue {track.queue}) never became "
+                    "visible in the scheduler DB and never terminated"
+                )
+
+    def summary(self) -> dict:
+        leased = sum(1 for t in self.jobs.values() if t.lease_count > 0)
+        out = {
+            "tracked": len(self.jobs),
+            "leased": leased,
+            "events_seen": self.events_seen,
+            "violations": len(self.violations),
+        }
+        for kind in sorted(_JOB_TERMINAL):
+            out[kind] = sum(1 for t in self.jobs.values() if t.terminal == kind)
+        return out
+
+    def ttfl_values(self) -> list:
+        """Observed submit->first-lease latencies (the loadgen-side
+        cross-check of the serving path's own TTFL histogram)."""
+        return [
+            t.first_lease_t - t.submit_t
+            for t in self.jobs.values()
+            if t.first_lease_t is not None
+        ]

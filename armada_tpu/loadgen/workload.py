@@ -1,0 +1,180 @@
+"""Seeded workload mix: what each due arrival event *is*.
+
+Turns a count of due arrivals (from loadgen/arrivals) into concrete
+operations against the submit surface: single-job submits, gang submits,
+cancels and reprioritisations of previously-submitted jobs -- all drawn
+from one seeded RNG, so the traffic a soak run applies is a deterministic
+function of (MixConfig, seed) even though the *times* come from a separate
+arrival process.  Cancel/reprioritise targets are sampled from the
+generator's own live-id pool, which the driver feeds back from the submit
+responses (ids are server-assigned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional, Sequence
+
+from armada_tpu.server.submit import JobSubmitItem
+
+
+@dataclasses.dataclass(frozen=True)
+class MixConfig:
+    """The event mix.  Weights need not sum to 1 (normalised); a cancel or
+    reprioritise with no live target degrades to a submit, so the achieved
+    mix converges to the configured one once the pool warms up."""
+
+    submit_weight: float = 0.85
+    cancel_weight: float = 0.05
+    reprioritize_weight: float = 0.10
+    # Fraction of submit events that open a gang (the whole gang rides ONE
+    # arrival event: gangs are atomic from the submitter's perspective).
+    gang_fraction: float = 0.05
+    gang_size_min: int = 2
+    gang_size_max: int = 4
+    num_queues: int = 4
+    queue_prefix: str = "soak"
+    jobset: str = "soak"
+    # Job shapes drawn uniformly (cpu, memory) -- small relative to the
+    # node shape so the fake cluster turns jobs over.
+    cpu_choices: Sequence[str] = ("1", "2", "4")
+    memory_choices: Sequence[str] = ("1", "2")
+    priority_levels: int = 4
+
+
+@dataclasses.dataclass
+class SubmitOp:
+    queue: str
+    items: list  # JobSubmitItem
+    gang: bool = False
+
+
+@dataclasses.dataclass
+class CancelOp:
+    queue: str
+    job_ids: list
+
+
+@dataclasses.dataclass
+class ReprioritizeOp:
+    queue: str
+    job_ids: list
+    priority: int
+
+
+class WorkloadGenerator:
+    """Deterministic op stream.  One `next_ops(n)` call consumes n arrival
+    events; the driver applies the returned ops in order and feeds the
+    submit responses back via `note_submitted`."""
+
+    def __init__(self, mix: MixConfig, seed: int = 0):
+        self.mix = mix
+        self._rng = random.Random(seed)
+        self.queues = [
+            f"{mix.queue_prefix}-{i}" for i in range(mix.num_queues)
+        ]
+        # Per-queue live candidate ids for cancel/reprioritise targeting.
+        # "Live" is from the generator's view (submitted, not yet cancelled
+        # by us): a target that already finished server-side is fine -- a
+        # cancel of a terminal job is a legal no-op the plane must absorb.
+        self._live: dict[str, list] = {q: [] for q in self.queues}
+        self._gang_seq = 0
+        self.counts = {"submit": 0, "gang_jobs": 0, "cancel": 0, "reprioritize": 0}
+
+    # ------------------------------------------------------------ feeding ---
+
+    def note_submitted(self, queue: str, job_ids: Sequence[str]) -> None:
+        self._live[queue].extend(job_ids)
+
+    def live_count(self) -> int:
+        return sum(len(v) for v in self._live.values())
+
+    # ---------------------------------------------------------- generating --
+
+    def _item(self) -> JobSubmitItem:
+        rng = self._rng
+        return JobSubmitItem(
+            resources={
+                "cpu": rng.choice(self.mix.cpu_choices),
+                "memory": rng.choice(self.mix.memory_choices),
+            },
+            priority=rng.randrange(self.mix.priority_levels),
+        )
+
+    def _pick_targets(self, rng: random.Random, k_max: int = 8):
+        """(queue, ids) from the live pool, or None when the pool is cold."""
+        candidates = [q for q in self.queues if self._live[q]]
+        if not candidates:
+            return None
+        q = rng.choice(candidates)
+        pool = self._live[q]
+        k = min(len(pool), 1 + rng.randrange(k_max))
+        # Sample WITHOUT replacement and remove: each id is targeted at most
+        # once, so the lifecycle tracker can treat our cancels as definitive.
+        idxs = sorted(rng.sample(range(len(pool)), k), reverse=True)
+        ids = [pool[i] for i in idxs]
+        for i in idxs:
+            pool.pop(i)
+        return q, ids
+
+    def next_ops(self, n_events: int) -> list:
+        """Consume n arrival events; returns a list of ops.  Multiple
+        consecutive submit events to the same queue coalesce into one
+        SubmitOp (one wire batch), which is how a real client at high
+        event rates batches too."""
+        mix = self.mix
+        rng = self._rng
+        total_w = mix.submit_weight + mix.cancel_weight + mix.reprioritize_weight
+        ops: list = []
+        pending: dict[str, SubmitOp] = {}
+
+        def flush_pending():
+            for op in pending.values():
+                ops.append(op)
+            pending.clear()
+
+        for _ in range(n_events):
+            r = rng.random() * total_w
+            if r >= mix.submit_weight:
+                kind = "cancel" if r < mix.submit_weight + mix.cancel_weight else "reprioritize"
+                hit = self._pick_targets(rng)
+                if hit is not None:
+                    flush_pending()  # preserve op order around mutations
+                    q, ids = hit
+                    if kind == "cancel":
+                        ops.append(CancelOp(q, ids))
+                        self.counts["cancel"] += 1
+                    else:
+                        ops.append(
+                            ReprioritizeOp(
+                                q, ids, rng.randrange(mix.priority_levels)
+                            )
+                        )
+                        self.counts["reprioritize"] += 1
+                    continue
+                # cold pool: degrade to a submit (the arrival still happened)
+            q = rng.choice(self.queues)
+            if rng.random() < mix.gang_fraction:
+                size = rng.randint(mix.gang_size_min, mix.gang_size_max)
+                self._gang_seq += 1
+                gid = f"gang-{self._gang_seq}"
+                items = []
+                for _m in range(size):
+                    it = self._item()
+                    items.append(
+                        dataclasses.replace(
+                            it, gang_id=gid, gang_cardinality=size
+                        )
+                    )
+                flush_pending()
+                ops.append(SubmitOp(q, items, gang=True))
+                self.counts["gang_jobs"] += size
+            else:
+                op = pending.get(q)
+                if op is None:
+                    op = pending[q] = SubmitOp(q, [])
+                op.items.append(self._item())
+            self.counts["submit"] += 1
+        flush_pending()
+        return ops

@@ -163,6 +163,20 @@ class SchedulerMetrics:
             ["queue", "state"],
             registry=registry,
         )
+        # Streaming SLO percentiles (scheduler/slo.py LogHistograms): the
+        # standing-load latency distributions -- cycle latency (split by
+        # device degradation state), time-to-first-lease, ingest->visible
+        # lag -- as labelled quantile gauges, refreshed every cycle.
+        self.slo_latency = g(
+            "armada_scheduler_slo_latency_seconds",
+            "Streaming SLO latency percentiles (log-bucketed histograms)",
+            ["metric", "quantile"],
+        )
+        self.slo_count = g(
+            "armada_scheduler_slo_observations",
+            "Sample count behind each SLO latency histogram",
+            ["metric"],
+        )
 
     # --- hooks called by the Scheduler --------------------------------------
 
@@ -175,6 +189,18 @@ class SchedulerMetrics:
         )
         self.device_fallbacks.set(float(snapshot.get("fallbacks", 0)))
         self.device_promotions.set(float(snapshot.get("promotions", 0)))
+
+    def observe_slo(self, snapshot: dict) -> None:
+        """Publish the SLO recorder's histogram snapshot
+        (scheduler/slo.SLORecorder.snapshot), once per cycle."""
+        for metric, summary in snapshot.items():
+            if not isinstance(summary, dict) or not summary.get("count"):
+                continue
+            self.slo_count.labels(metric).set(float(summary["count"]))
+            for q in ("p50", "p90", "p95", "p99"):
+                v = summary.get(q + "_s")
+                if v is not None:
+                    self.slo_latency.labels(metric, q).set(v)
 
     def observe_executor_usage(self, executors, factory) -> None:
         """Publish executor-reported per-queue usage (metrics.go:387-395).

@@ -226,17 +226,71 @@ class Scheduler:
         self._cycle_seq = getattr(self, "_cycle_seq", 0) + 1
         # Context fields ride every log line this cycle emits, in any
         # component (armadacontext parity, armada_context.go).
+        from armada_tpu.core.watchdog import supervisor as _supervisor
+
+        sup0 = _supervisor()
+        fallbacks0 = sup0.snapshot()["fallbacks"]
+        degraded0 = sup0.degraded
         with log_context(cycle=self._cycle_seq, scheduling=schedule):
             result = self._cycle(schedule)
         duration = time.monotonic() - start
+        # A cycle counts as degraded if it RAN degraded at any point:
+        # degraded BEFORE (a promotion can land mid-cycle while the round
+        # still runs on the CPU failover), a fallback DURING (the fallback
+        # delta -- a drill-speed re-probe can promote back before the
+        # failed-over round even returns), or degraded AFTER.  Post-cycle
+        # state alone misfiles exactly the cycles the failover window
+        # exists to measure.
+        sup = _supervisor()
+        degraded = (
+            degraded0
+            or sup.degraded
+            or sup.snapshot()["fallbacks"] > fallbacks0
+        )
+        self._observe_slo(result, duration, degraded)
         if self.metrics is not None:
             self.metrics.observe_cycle(result, duration, now=self._clock())
             from armada_tpu.core.watchdog import supervisor
 
             self.metrics.observe_device(supervisor().snapshot())
+            self.metrics.observe_slo(self._slo().snapshot())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
         return result
+
+    @staticmethod
+    def _slo():
+        from armada_tpu.scheduler.slo import recorder
+
+        return recorder()
+
+    def _observe_slo(
+        self, result: CycleResult, duration_s: float, degraded: bool = False
+    ) -> None:
+        """Feed the streaming SLO layer (scheduler/slo.py): cycle latency
+        (scheduling cycles only; reconcile ticks are a different
+        distribution), ingest->visible lag for tracked submits that became
+        visible this cycle, TTFL for first leases, and forget jobs that
+        terminated without ever leasing (cancel-before-lease, validation
+        failure) so the tracking maps stay bounded."""
+        rec = self._slo()
+        if result.scheduled:
+            rec.observe_cycle(duration_s, degraded=degraded)
+        if result.synced_jobs:
+            rec.note_visible(result.synced_jobs)
+        sched = result.scheduler_result
+        if sched is not None and sched.scheduled:
+            rec.note_leased([job.id for job, _run in sched.scheduled])
+        if rec.pending_lease_count() and result.published:
+            ended = [
+                getattr(getattr(ev, kind), "job_id", "")
+                for seq in result.published
+                for ev in seq.events
+                for kind in (ev.WhichOneof("event"),)
+                if kind in ("cancelled_job", "job_errors")
+            ]
+            if ended:
+                rec.forget([jid for jid in ended if jid])
 
     def _cycle(self, schedule: bool = True) -> CycleResult:
         result = CycleResult()

@@ -263,6 +263,14 @@ class ScheduleSession:
     def schedule_round(
         self, now_ns: Optional[int] = None, quarantined=frozenset()
     ) -> SchedulerResult:
+        from armada_tpu.core.watchdog import supervisor
+        from armada_tpu.ops.metrics import mono_now
+        from armada_tpu.scheduler.slo import recorder as slo_recorder
+
+        t_start = mono_now()
+        sup0 = supervisor()
+        fallbacks0 = sup0.snapshot()["fallbacks"]
+        degraded0 = sup0.degraded
         with self._lock:
             txn = self.jobdb.write_txn()
             now = now_ns or self._clock_ns()
@@ -307,6 +315,19 @@ class ScheduleSession:
             # jobDb: later rounds must see this round's leases.  The caller
             # re-asserting job state via SyncState is idempotent on top.
             txn.commit()
+            # Sidecar rounds feed the same streaming cycle-latency SLO as
+            # the in-process scheduler (TTFL/ingest-lag stay caller-side:
+            # the caller owns submit timing across the boundary).  Degraded
+            # = before OR fallback-delta OR after: a drill-speed re-probe
+            # can promote back before the failed-over round returns, and a
+            # promotion can land mid-round (scheduler.cycle's rule).
+            sup = supervisor()
+            slo_recorder().observe_cycle(
+                mono_now() - t_start,
+                degraded=degraded0
+                or sup.degraded
+                or sup.snapshot()["fallbacks"] > fallbacks0,
+            )
             return result
 
 
@@ -333,9 +354,18 @@ def _stats_of(result: SchedulerResult) -> str:
     # scraping this process's /healthz: backend, consecutive failures,
     # last fallback reason (core/watchdog).
     from armada_tpu.core.watchdog import supervisor
+    from armada_tpu.scheduler.slo import recorder as slo_recorder
 
     return json.dumps(
-        {"pools": pools, "device": supervisor().snapshot()}, default=float
+        {
+            "pools": pools,
+            "device": supervisor().snapshot(),
+            # Streaming SLO percentiles (cycle latency split healthy/
+            # degraded): the external control plane reads its scheduling
+            # tail latency from the same response it already parses.
+            "slo": slo_recorder().snapshot(),
+        },
+        default=float,
     )
 
 

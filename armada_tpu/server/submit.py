@@ -150,6 +150,7 @@ class SubmitServer:
         factory = self._config.resource_list_factory()
         events: list[pb.Event] = []
         job_ids: list[str] = []
+        new_ids: list[str] = []
         new_dedup: dict[str, str] = {}
         for item in items:
             if item.client_id:
@@ -159,6 +160,7 @@ class SubmitServer:
                     continue
             job_id = self._job_id()
             job_ids.append(job_id)
+            new_ids.append(job_id)
             if item.client_id:
                 new_dedup[dedup_keys[item.client_id]] = job_id
             spec = JobSpec(
@@ -194,6 +196,12 @@ class SubmitServer:
 
         if events:
             self._publish(queue, jobset, events, principal.name)
+            # SLO clock start: submit ACCEPTED (publish succeeded).  Only
+            # genuinely-new ids -- a deduped re-submit is not a new arrival
+            # and must not reset its original's time-to-first-lease.
+            from armada_tpu.scheduler.slo import recorder
+
+            recorder().note_submitted(new_ids)
         if new_dedup:
             self._db.store_dedup(new_dedup)
         return job_ids

@@ -556,6 +556,55 @@ def _sidecar_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
     }
 
 
+def _soak_bench() -> dict:
+    """ARMADA_BENCH_SOAK (default on; =0 skips): a short sustained-traffic
+    window through the full serving stack (armada_tpu/loadgen/soak.py) --
+    submit/cancel/reprioritise churn via SubmitServer -> eventlog -> ingest
+    -> scheduler -> fake executors -- with the streaming SLO layer's
+    p50/p95/p99 cycle latency, time-to-first-lease and ingest->visible lag
+    folded into the bench line as soak_* keys.  The soak world is small and
+    independent of the 1M-row arms above (it measures the SERVING loop's
+    latency distribution, not peak problem scale); ARMADA_BENCH_SOAK_S /
+    ARMADA_BENCH_SOAK_RATE downscale further for CPU hosts."""
+    import tempfile
+
+    from armada_tpu.loadgen.soak import SoakConfig, run_soak
+
+    window_s = float(os.environ.get("ARMADA_BENCH_SOAK_S", 45.0))
+    rate = float(os.environ.get("ARMADA_BENCH_SOAK_RATE", 200.0))
+    print(
+        f"bench: soak arm ({window_s:.0f}s window @ {rate:.0f} events/s)",
+        file=sys.stderr,
+    )
+    cfg = SoakConfig(
+        window_s=window_s,
+        target_eps=rate,
+        drain_s=min(10.0, window_s / 4),
+        seed=7,
+    )
+    with tempfile.TemporaryDirectory(prefix="armada-bench-soak-") as d:
+        report = run_soak(cfg, d)
+    out = {
+        "soak_window_s": report["window_s"],
+        "soak_eps": report["achieved_eps"],
+        "soak_target_eps": report["target_eps"],
+        "soak_cycles": report["schedule_cycles"],
+        "soak_ok": report["ok"],
+    }
+    for key in (
+        "cycle_p50_s",
+        "cycle_p95_s",
+        "cycle_p99_s",
+        "ttfl_p50_s",
+        "ttfl_p95_s",
+        "ttfl_p99_s",
+        "ingest_lag_p99_s",
+    ):
+        if key in report:
+            out["soak_" + key] = report[key]
+    return out
+
+
 def main():
     from armada_tpu.core.pipeline import pipeline_enabled as _pipeline_enabled
 
@@ -662,6 +711,8 @@ def main():
                 num_jobs, num_nodes, num_queues, num_runs, repeats, burst
             )
         )
+    if os.environ.get("ARMADA_BENCH_SOAK", "1") != "0":
+        line.update(_soak_bench())
     if init_err is not None:
         line["backend_fallback"] = init_err
     watchdog.cancel()

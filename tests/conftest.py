@@ -101,6 +101,10 @@ _FAST_MODULES = {
     # armada-lint self-hosting gate: the fast tier IS the CI path that
     # keeps the tree lint-clean (tools/lint.py; docs/lint.md).
     "tests/test_lint.py",
+    # soak-subsystem units: histogram-vs-numpy-oracle exactness + the
+    # loadgen arrival/mix/lifecycle machinery (no kernel compiles).
+    "tests/test_slo_metrics.py",
+    "tests/test_loadgen.py",
 }
 # How many representative tests each remaining module contributes.
 _FAST_PICKS = 2
@@ -136,6 +140,11 @@ _FAST_PICKS_OVERRIDE = {
     # first 4 = the cheap in-process race-harness drills (the subprocess
     # pipeline/faults-under-ARMADA_TSAN=1 leg stays full-tier only).
     "tests/test_tsan.py": 4,
+    # first test = the chaos-under-load smoke (mid-soak device hang: no
+    # SLO gap, no tsan violations, nothing dropped/double-leased) -- the
+    # soak subsystem's acceptance gate; the clean window + subprocess
+    # JSON-contract legs stay full-tier.
+    "tests/test_soak.py": 1,
 }
 # Never in the fast tier (opt-in external deps / native builds).
 _FAST_EXCLUDE_MODULES = {

@@ -1,0 +1,21 @@
+# Fixture for rule `slo-wallclock` (linted under armada_tpu/loadgen/).
+import time
+
+
+def record_latency(hist, t0):
+    hist.record(time.time() - t0)  # TP
+
+
+def mono_now():
+    # near-miss: the single sanctioned definition site for the helper
+    return time.monotonic()
+
+
+def record_latency_ok(hist, t0):
+    # near-miss: latency math through the named helper
+    hist.record(mono_now() - t0)
+
+
+def pace(interval_s):
+    # near-miss: sleeping is pacing, not reading a clock
+    time.sleep(interval_s)

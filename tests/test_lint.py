@@ -36,6 +36,7 @@ RULE_FIXTURES = {
         "wallclock_event_order.py",
         "armada_tpu/eventlog/fixture.py",
     ),
+    "slo-wallclock": ("slo_wallclock.py", "armada_tpu/loadgen/fixture.py"),
     "grpc-options": ("grpc_options.py", "armada_tpu/fixture.py"),
     "thread-no-daemon": ("thread_no_daemon.py", "armada_tpu/fixture.py"),
     "lock-held-sleep": ("lock_held_sleep.py", "fixture.py"),

@@ -1,0 +1,249 @@
+"""loadgen units: arrival processes, the seeded workload mix, the
+lifecycle tracker's double-lease / dropped-job detection."""
+
+from __future__ import annotations
+
+import pytest
+
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.loadgen.arrivals import (
+    BurstyArrivals,
+    PoissonArrivals,
+    RampArrivals,
+    make_arrivals,
+)
+from armada_tpu.loadgen.lifecycle import LifecycleTracker
+from armada_tpu.loadgen.workload import (
+    CancelOp,
+    MixConfig,
+    ReprioritizeOp,
+    SubmitOp,
+    WorkloadGenerator,
+)
+
+
+# ------------------------------------------------------------- arrivals ----
+
+
+def _timeline(proc, horizon_s, step_s=0.5):
+    counts = []
+    t = 0.0
+    while t < horizon_s:
+        t += step_s
+        counts.append(proc.due_until(t))
+    return counts
+
+
+def test_poisson_is_deterministic_and_near_rate():
+    a = _timeline(PoissonArrivals(50.0, seed=3), 200.0)
+    b = _timeline(PoissonArrivals(50.0, seed=3), 200.0)
+    assert a == b  # bit-identical timetable per seed
+    total = sum(a)
+    assert abs(total - 50.0 * 200.0) < 0.05 * 50.0 * 200.0  # ~5 sigma
+    c = _timeline(PoissonArrivals(50.0, seed=4), 200.0)
+    assert a != c  # the seed is the only source of variation
+
+
+def test_open_loop_backlog_survives_a_stall():
+    """A driver stall does not stretch the timetable: everything that came
+    due during the stall is returned at the next poll (open loop)."""
+    p = PoissonArrivals(100.0, seed=1)
+    before = p.due_until(1.0)
+    stalled = p.due_until(11.0)  # 10s stall
+    assert abs((before + stalled) - 1100) < 250
+    assert stalled > 800
+
+
+def test_due_until_cap_bounds_one_poll():
+    p = PoissonArrivals(1000.0, seed=0)
+    n = p.due_until(10.0, cap=100)
+    assert n == 100
+    assert p.due_until(10.0) > 0  # remainder still due
+
+
+def test_bursty_mean_rate_and_burstiness():
+    proc = BurstyArrivals(25.0, 100.0, period_s=10.0, duty=0.2, seed=5)
+    counts = _timeline(proc, 400.0, step_s=1.0)
+    mean_rate = sum(counts) / 400.0
+    assert abs(mean_rate - 40.0) < 8.0  # duty*burst + (1-duty)*base = 40
+    # on-window seconds are visibly hotter than off-window seconds
+    on = [c for i, c in enumerate(counts) if i % 10 == 0]
+    off = [c for i, c in enumerate(counts) if 3 <= i % 10 <= 8]
+    assert sum(on) / len(on) > 2.0 * sum(off) / len(off)
+
+
+def test_ramp_rate_grows():
+    proc = RampArrivals(10.0, 190.0, ramp_s=60.0, seed=2)
+    counts = _timeline(proc, 60.0, step_s=1.0)
+    early, late = sum(counts[:15]), sum(counts[-15:])
+    assert late > 3.0 * early
+
+
+def test_make_arrivals_factory():
+    assert isinstance(make_arrivals("poisson", 10.0), PoissonArrivals)
+    assert isinstance(make_arrivals("bursty", 10.0), BurstyArrivals)
+    assert isinstance(make_arrivals("ramp", 10.0), RampArrivals)
+    with pytest.raises(ValueError):
+        make_arrivals("constant", 10.0)
+
+
+# ------------------------------------------------------------- workload ----
+
+
+def _drain(gen, n, feed_ids=True):
+    """Apply n events; simulate the server assigning (unique) ids."""
+    ops = gen.next_ops(n)
+    seq = getattr(gen, "_test_id_seq", 0)
+    for op in ops:
+        if isinstance(op, SubmitOp) and feed_ids:
+            ids = [f"{op.queue}-j{seq + i}" for i in range(len(op.items))]
+            seq += len(op.items)
+            gen.note_submitted(op.queue, ids)
+    gen._test_id_seq = seq
+    return ops
+
+
+def test_workload_mix_is_deterministic():
+    mix = MixConfig(num_queues=3)
+    a, b = WorkloadGenerator(mix, seed=9), WorkloadGenerator(mix, seed=9)
+    for _ in range(5):
+        ops_a, ops_b = _drain(a, 200), _drain(b, 200)
+        assert [type(o).__name__ for o in ops_a] == [
+            type(o).__name__ for o in ops_b
+        ]
+    assert a.counts == b.counts
+
+
+def test_workload_mix_ratios_converge():
+    mix = MixConfig(num_queues=4, gang_fraction=0.1)
+    gen = WorkloadGenerator(mix, seed=1)
+    for _ in range(20):
+        _drain(gen, 500)
+    total = sum(gen.counts.values()) - gen.counts["gang_jobs"]
+    assert total == 20 * 500
+    assert 0.75 < gen.counts["submit"] / total < 0.95
+    assert 0.02 < gen.counts["cancel"] / total < 0.10
+    assert 0.05 < gen.counts["reprioritize"] / total < 0.16
+    assert gen.counts["gang_jobs"] > 0
+
+
+def test_gang_submits_are_well_formed():
+    mix = MixConfig(num_queues=2, gang_fraction=1.0)
+    gen = WorkloadGenerator(mix, seed=0)
+    ops = _drain(gen, 20)
+    gangs = [op for op in ops if isinstance(op, SubmitOp) and op.gang]
+    assert gangs
+    seen_ids = set()
+    for op in gangs:
+        gid = op.items[0].gang_id
+        assert gid and gid not in seen_ids  # fresh id per gang
+        seen_ids.add(gid)
+        assert all(it.gang_id == gid for it in op.items)
+        assert all(it.gang_cardinality == len(op.items) for it in op.items)
+        assert (
+            mix.gang_size_min <= len(op.items) <= mix.gang_size_max
+        )
+
+
+def test_cancel_targets_are_never_reused():
+    mix = MixConfig(
+        num_queues=1, submit_weight=0.5, cancel_weight=0.5, reprioritize_weight=0.0
+    )
+    gen = WorkloadGenerator(mix, seed=4)
+    targeted = []
+    for _ in range(30):
+        for op in _drain(gen, 50):
+            if isinstance(op, CancelOp):
+                targeted.extend(op.job_ids)
+    assert targeted
+    assert len(targeted) == len(set(targeted))
+
+
+def test_cold_pool_degrades_to_submit():
+    mix = MixConfig(
+        num_queues=1, submit_weight=0.0, cancel_weight=1.0, reprioritize_weight=0.0
+    )
+    gen = WorkloadGenerator(mix, seed=0)
+    ops = gen.next_ops(5)  # nothing live yet: every cancel degrades
+    assert all(isinstance(op, SubmitOp) for op in ops)
+    assert gen.counts["submit"] == 5 and gen.counts["cancel"] == 0
+
+
+# ------------------------------------------------------------ lifecycle ----
+
+
+def _seq(*events):
+    return pb.EventSequence(queue="q", jobset="s", events=list(events))
+
+
+def _leased(jid, rid):
+    return pb.Event(job_run_leased=pb.JobRunLeased(job_id=jid, run_id=rid))
+
+
+def test_tracker_normal_flow_no_violations():
+    tr = LifecycleTracker()
+    tr.note_submitted("q", ["j1"])
+    tr.observe_sequence(
+        _seq(
+            _leased("j1", "r1"),
+            pb.Event(job_succeeded=pb.JobSucceeded(job_id="j1")),
+        )
+    )
+    assert tr.violations == []
+    assert tr.summary()["leased"] == 1
+    assert tr.summary()["job_succeeded"] == 1
+    assert tr.ttfl_values() and tr.ttfl_values()[0] >= 0
+
+
+def test_tracker_detects_double_lease():
+    tr = LifecycleTracker()
+    tr.note_submitted("q", ["j1"])
+    tr.observe_sequence(_seq(_leased("j1", "r1"), _leased("j1", "r2")))
+    assert len(tr.violations) == 1
+    assert "double lease" in tr.violations[0]
+
+
+def test_tracker_requeue_then_lease_is_legal():
+    tr = LifecycleTracker()
+    tr.note_submitted("q", ["j1"])
+    tr.observe_sequence(
+        _seq(
+            _leased("j1", "r1"),
+            pb.Event(
+                job_requeued=pb.JobRequeued(job_id="j1", update_sequence_number=1)
+            ),
+            _leased("j1", "r2"),
+        )
+    )
+    assert tr.violations == []
+    assert tr.jobs["j1"].lease_count == 2
+
+
+def test_tracker_lease_after_terminal_is_a_violation():
+    tr = LifecycleTracker()
+    tr.note_submitted("q", ["j1"])
+    tr.observe_sequence(
+        _seq(
+            pb.Event(cancelled_job=pb.CancelledJob(job_id="j1")),
+            _leased("j1", "r1"),
+        )
+    )
+    assert any("lease after terminal" in v for v in tr.violations)
+
+
+def test_tracker_dropped_job_detection():
+    tr = LifecycleTracker()
+    tr.note_submitted("q", ["gone", "queued-fine", "done"])
+    tr.observe_sequence(
+        _seq(pb.Event(job_succeeded=pb.JobSucceeded(job_id="done")))
+    )
+    tr.check_dropped({"queued-fine": "queued"})
+    assert len(tr.violations) == 1
+    assert "dropped: job gone" in tr.violations[0]
+
+
+def test_tracker_ignores_foreign_jobs():
+    tr = LifecycleTracker()
+    tr.note_submitted("q", ["mine"])
+    tr.observe_sequence(_seq(_leased("other", "r1")))
+    assert tr.events_seen == 0 and tr.violations == []

@@ -1,0 +1,224 @@
+"""ops/metrics (LogHistogram + registry) and scheduler/slo (SLORecorder).
+
+The histogram's percentile math is pinned EXACTLY against an independent
+vectorized numpy oracle: both sides map values through the same edge array
+(the histogram via its streaming counts, the oracle via one vectorized
+searchsorted + sort), so the assertion is float equality, not approx.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from armada_tpu.ops.metrics import Counter, LogHistogram, MetricsRegistry, mono_now
+from armada_tpu.scheduler.slo import SLORecorder, recorder, reset_recorder
+
+
+def oracle_quantile(values: np.ndarray, hist: LogHistogram, q: float) -> float:
+    """Independent numpy implementation of the histogram's rank-based
+    percentile: bucket every value (vectorized), sort, take the bucket of
+    the ceil(q*n)-th smallest sample, answer its upper edge."""
+    idx = np.minimum(
+        # lint: allow(searchsorted-dtype) -- f64 values into the f64 edges array; the oracle must not coerce
+        np.searchsorted(hist.edges, values, side="left"),
+        len(hist.edges) - 1,
+    )
+    order = np.sort(idx)
+    rank = min(int(np.ceil(q * len(values))), len(values))
+    return float(hist.edges[order[rank - 1]])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_histogram_percentiles_match_numpy_oracle_exactly(seed):
+    rng = np.random.default_rng(seed)
+    # lognormal latencies spanning the bucket range + deliberate edge hits
+    values = np.concatenate(
+        [
+            rng.lognormal(mean=-3.0, sigma=2.0, size=5000),
+            np.array([1e-4, 1e-3, 0.5, 1.0, 9_999.0]),
+        ]
+    )
+    h = LogHistogram("t")
+    for v in values:
+        h.record(v)
+    assert h.count == len(values)
+    assert h.vmin == float(values.min()) and h.vmax == float(values.max())
+    assert h.total == pytest.approx(float(values.sum()), rel=1e-9)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 0.999, 1.0):
+        assert h.quantile(q) == oracle_quantile(values, h, q), q
+
+
+def test_histogram_quantile_is_within_resolution_of_true_percentile():
+    rng = np.random.default_rng(7)
+    values = rng.lognormal(mean=-1.0, sigma=1.0, size=20_000)
+    h = LogHistogram("t")
+    for v in values:
+        h.record(v)
+    for q in (0.5, 0.95, 0.99):
+        true = float(np.quantile(values, q, method="inverted_cdf"))
+        est = h.quantile(q)
+        # upper-edge semantics: est >= true, within one growth factor
+        assert true <= est <= true * 2 ** 0.125 * (1 + 1e-12)
+
+
+def test_histogram_clamps_never_drops():
+    h = LogHistogram("t", lo=1e-3, hi=10.0)
+    for v in (0.0, 1e-9, 1e-3, 5.0, 10.0, 1e6):
+        h.record(v)
+    assert h.count == 6
+    assert int(h.counts.sum()) == 6
+    assert h.quantile(1.0) == float(h.edges[-1])  # overflow clamped
+    assert h.quantile(0.0) == 0.0  # exact tracked min
+
+
+def test_histogram_empty_and_reset():
+    h = LogHistogram("t")
+    assert h.quantile(0.5) is None
+    assert h.snapshot() == {"count": 0}
+    h.record(0.25)
+    assert h.snapshot()["count"] == 1
+    h.reset()
+    assert h.snapshot() == {"count": 0}
+
+
+def test_histogram_merge_equals_union():
+    rng = np.random.default_rng(3)
+    a_vals = rng.lognormal(size=500)
+    b_vals = rng.lognormal(size=700)
+    a, b, u = LogHistogram("a"), LogHistogram("b"), LogHistogram("u")
+    for v in a_vals:
+        a.record(v)
+        u.record(v)
+    for v in b_vals:
+        b.record(v)
+        u.record(v)
+    a.merge(b)
+    assert a.count == u.count
+    assert np.array_equal(a.counts, u.counts)
+    for q in (0.5, 0.99):
+        assert a.quantile(q) == u.quantile(q)
+
+
+def test_histogram_rejects_nan_and_negative_as_zero():
+    h = LogHistogram("t")
+    h.record(float("nan"))
+    h.record(-5.0)
+    assert h.count == 2
+    assert h.vmax == 0.0
+
+
+def test_registry_get_or_create_and_type_guard():
+    reg = MetricsRegistry("test")
+    h1 = reg.histogram("lat")
+    h2 = reg.histogram("lat")
+    assert h1 is h2
+    reg.counter("n").inc(3)
+    assert reg.snapshot()["n"] == 3
+    with pytest.raises(TypeError):
+        reg.gauge("lat")
+    reg.reset()
+    assert reg.snapshot()["n"] == 0
+
+
+def test_mono_now_is_monotonic():
+    a = mono_now()
+    b = mono_now()
+    assert b >= a
+
+
+def test_slo_recorder_ttfl_and_ingest_lag_flow():
+    rec = SLORecorder()
+    t0 = mono_now() - 0.5  # submitted half a second ago
+    rec.note_submitted(["j1", "j2", "j3"], t=t0)
+    assert rec.snapshot()["jobs_submitted"] == 3
+    rec.note_visible(["j1", "j2", "unknown"])
+    snap = rec.snapshot()
+    assert snap["ingest_visible_lag_s"]["count"] == 2
+    assert snap["ingest_visible_lag_s"]["min_s"] >= 0.5
+    rec.note_leased(["j1"])
+    rec.note_leased(["j1"])  # second lease of the same job: no double count
+    snap = rec.snapshot()
+    assert snap["time_to_first_lease_s"]["count"] == 1
+    assert snap["jobs_first_leased"] == 1
+    # j2 cancelled before leasing; j3 terminal: both leave the maps
+    rec.forget(["j2", "j3"])
+    assert rec.pending_lease_count() == 0
+
+
+def test_slo_recorder_tracking_is_bounded():
+    rec = SLORecorder(track_cap=2)
+    rec.note_submitted(["a", "b", "c", "d"])
+    assert rec.pending_lease_count() == 2
+    assert rec.snapshot()["tracking_overflow"] == 2
+    assert rec.snapshot()["jobs_submitted"] == 4
+
+
+def test_slo_recorder_cycle_split_by_degradation():
+    rec = SLORecorder()
+    rec.observe_cycle(0.1, degraded=False)
+    rec.observe_cycle(2.0, degraded=True)
+    snap = rec.snapshot()
+    assert snap["cycle_latency_s"]["count"] == 1
+    assert snap["cycle_latency_degraded_s"]["count"] == 1
+
+
+def test_global_recorder_reset():
+    reset_recorder()
+    r1 = recorder()
+    r1.note_submitted(["x"])
+    assert recorder() is r1
+    r2 = reset_recorder()
+    assert r2 is not r1
+    assert r2.pending_lease_count() == 0
+
+
+def test_healthz_embeds_slo_block():
+    from armada_tpu.core.health import HealthServer, StartupCompleteChecker
+
+    srv = HealthServer(port=0)
+    try:
+        startup = StartupCompleteChecker()
+        srv.checker.add(startup)
+        startup.mark_complete()
+        rec = SLORecorder()
+        rec.observe_cycle(0.2, degraded=False)
+        srv.slo_status = rec.snapshot
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ).read()
+        )
+        assert body["healthy"] is True
+        assert body["slo"]["cycle_latency_s"]["count"] == 1
+    finally:
+        srv.stop()
+
+
+def test_scheduler_metrics_export_slo_gauges():
+    from prometheus_client import CollectorRegistry
+
+    from armada_tpu.scheduler.metrics import SchedulerMetrics
+
+    reg = CollectorRegistry()
+    m = SchedulerMetrics(registry=reg)
+    rec = SLORecorder()
+    rec.observe_cycle(0.25, degraded=False)
+    rec.note_submitted(["j"], t=mono_now() - 1.0)
+    rec.note_leased(["j"])
+    m.observe_slo(rec.snapshot())
+    sample = reg.get_sample_value(
+        "armada_scheduler_slo_latency_seconds",
+        {"metric": "cycle_latency_s", "quantile": "p50"},
+    )
+    assert sample is not None and sample > 0
+    assert (
+        reg.get_sample_value(
+            "armada_scheduler_slo_observations",
+            {"metric": "time_to_first_lease_s"},
+        )
+        == 1.0
+    )

@@ -170,6 +170,14 @@ def main() -> int:
         action="store_true",
         help="exercise the pipeline's content prefetch around the loss",
     )
+    ap.add_argument(
+        "--soak",
+        action="store_true",
+        help="additionally run a short soak window with the same fault "
+        "armed mid-window (failover measured UNDER LOAD as a latency "
+        "distribution; armada_tpu/loadgen/soak.py; ARMADA_SOAK_WINDOW_S "
+        "downscales)",
+    )
     args = ap.parse_args()
 
     rng = random.Random(args.seed)
@@ -207,11 +215,31 @@ def main() -> int:
     tsan_found = tsan.take_violations()
     tsan.disable()
 
+    soak_report = None
+    if args.soak:
+        # The soak leg runs AFTER tsan harvest state is captured for the
+        # replay legs: run_soak re-arms/reset the harness itself for its
+        # own fault window and reports its own tsan_violations.
+        import tempfile
+
+        from armada_tpu.loadgen.soak import SoakConfig, run_soak
+
+        cfg = SoakConfig.from_env(
+            window_s=float(os.environ.get("ARMADA_SOAK_WINDOW_S", 30.0)),
+            target_eps=float(os.environ.get("ARMADA_SOAK_RATE", 100.0)),
+            seed=args.seed,
+            fault=f"device_round:{fault}",
+            watchdog_s=8.0,
+        )
+        with tempfile.TemporaryDirectory(prefix="chaos-soak-") as d:
+            soak_report = run_soak(cfg, d)
+
     ok = (
         chaotic == clean
         and snap["fallbacks"] >= 1
         and promoted
         and not tsan_found
+        and (soak_report is None or soak_report["ok"])
     )
     line = {
         "tool": "chaos_cycle",
@@ -229,6 +257,23 @@ def main() -> int:
     }
     if tsan_found:
         line["tsan_detail"] = tsan_found[:5]
+    if soak_report is not None:
+        line["soak"] = {
+            k: soak_report[k]
+            for k in (
+                "ok",
+                "window_s",
+                "achieved_eps",
+                "violations",
+                "degraded_cycles",
+                "cycle_p50_s",
+                "cycle_p99_s",
+            )
+            if k in soak_report
+        }
+        line["soak"]["degraded_p99_s"] = soak_report.get("slo_degraded", {}).get(
+            "p99_s"
+        )
     if not ok and chaotic != clean:
         for i, (a, b) in enumerate(zip(chaotic, clean)):
             if a != b:
