@@ -790,6 +790,38 @@ def _queued_version_write(src: Source):
                     )
 
 
+# The one sanctioned tmp+fsync+rename implementation.
+_STATEFILE_OWNER = "armada_tpu/core/statefile.py"
+
+
+@rule(
+    "atomic-state-file",
+    "os.replace/os.rename outside core/statefile.py: a hand-rolled "
+    "atomic-write keeps missing a step (file fsync, DIRECTORY fsync, "
+    "checksum) -- every cursor/snapshot/election file write rides the "
+    "shared helper",
+    scope=under("armada_tpu/"),
+)
+def _atomic_state_file(src: Source):
+    if src.relpath == _STATEFILE_OWNER:
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "os.replace",
+            "os.rename",
+        ):
+            yield _finding(
+                src,
+                "atomic-state-file",
+                node,
+                "hand-rolled atomic rename: durable state files (cursors, "
+                "snapshots, election records) go through core/statefile.py "
+                "(tmp + fsync + rename + directory fsync, checksummed "
+                "envelope for snapshots) -- the pre-refactor lease write "
+                "missed the directory fsync",
+            )
+
+
 # --------------------------------------------------------------------------
 # engine
 # --------------------------------------------------------------------------

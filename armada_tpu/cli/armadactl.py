@@ -408,6 +408,7 @@ def cmd_soak(args):
             fault=args.fault,
             fault_at_frac=args.fault_at,
             watchdog_s=args.watchdog_s,
+            crash_at_frac=getattr(args, "crash", None),
             **overrides,
         )
     )
@@ -468,6 +469,24 @@ def cmd_cordon_executor(args):
 def cmd_executor_settings_rm(args):
     _client(args).delete_executor_settings(args.executor)
     print(f"deleted settings for executor {args.executor}")
+    return 0
+
+
+def cmd_checkpoint(args):
+    """Trigger a durable snapshot of the plane's materialized state, or
+    (--status) read the durability block: newest snapshot identity/age,
+    fence, epoch, replication lag (scheduler/checkpoint.py)."""
+    import json
+
+    client = _client(args)
+    if args.status:
+        print(json.dumps(client.checkpoint_status(), indent=2, sort_keys=True))
+        return 0
+    info = client.trigger_checkpoint()
+    print(
+        f"checkpoint written: {info['path']} "
+        f"(fence total {info['fenced_offset_total']}, epoch {info['epoch']})"
+    )
     return 0
 
 
@@ -562,6 +581,10 @@ _SERVE_FALLBACKS = {
     "lookout_database_url": None,
     # None -> start_control_plane resolves ARMADA_WATCHDOG_S or 120s.
     "watchdog_s": None,
+    # Periodic checkpoint cadence (scheduler/checkpoint.py): serve defaults
+    # to 300s so every deployment gets bounded-replay restarts; 0 disables
+    # (tests and embedded planes construct with the library default, off).
+    "checkpoint_interval": 300.0,
 }
 
 
@@ -614,6 +637,7 @@ def load_serve_config(args):
         "database_url": ("databaseurl", str),
         "lookout_database_url": ("lookoutdatabaseurl", str),
         "watchdog_s": ("watchdogs", float),
+        "checkpoint_interval": ("checkpointinterval", float),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -659,6 +683,7 @@ def cmd_serve(args):
         database_url=getattr(args, "database_url", None),
         lookout_database_url=getattr(args, "lookout_database_url", None),
         watchdog_s=getattr(args, "watchdog_s", None),
+        checkpoint_interval_s=getattr(args, "checkpoint_interval", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -893,6 +918,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 120; 0 disables; /healthz reports the degradation state)",
     )
     srv.add_argument(
+        "--checkpoint-interval",
+        type=float,
+        dest="checkpoint_interval",
+        help="periodic durable-snapshot cadence in seconds (bounded-replay "
+        "restarts; default 300, 0 disables; `armadactl checkpoint` "
+        "triggers one on demand)",
+    )
+    srv.add_argument(
         "--lookout-port",
         type=int,
         help="host the lookout web UI on this port (0 = pick a free one)",
@@ -992,6 +1025,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sk.add_argument("--fault-at", type=float, default=0.5, dest="fault_at")
     sk.add_argument("--watchdog-s", type=float, default=5.0, dest="watchdog_s")
+    sk.add_argument(
+        "--crash",
+        nargs="?",
+        const=0.5,
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="mid-soak kill/restart leg (checkpoint -> wipe store -> "
+        "snapshot restore + suffix replay); RTO in restart_recovery_s",
+    )
     sk.set_defaults(fn=cmd_soak)
 
     ex = sub.add_parser(
@@ -1106,6 +1149,18 @@ def build_parser() -> argparse.ArgumentParser:
     cn.add_argument("node")
     cn.add_argument("--uncordon", action="store_true")
     cn.set_defaults(fn=cmd_cordon_node)
+
+    ck = sub.add_parser(
+        "checkpoint",
+        help="trigger a durable snapshot of the serving plane (bounded-"
+        "replay restarts), or --status for the durability block",
+    )
+    ck.add_argument(
+        "--status",
+        action="store_true",
+        help="print durability status JSON instead of triggering",
+    )
+    ck.set_defaults(fn=cmd_checkpoint)
 
     return p
 

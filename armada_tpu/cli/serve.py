@@ -64,6 +64,8 @@ class ControlPlaneProcess:
     algo_port: Optional[int] = None
     _algo_server: object = None
     replicator: object = None
+    checkpoint_manager: object = None
+    restore_info: object = None
     # This plane's watchdog arming token; disarmed on stop() (see
     # start_control_plane).
     _watchdog_token: object = None
@@ -144,6 +146,7 @@ def start_control_plane(
     database_url: Optional[str] = None,
     lookout_database_url: Optional[str] = None,
     watchdog_s: Optional[float] = None,
+    checkpoint_interval_s: Optional[float] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -204,6 +207,28 @@ def start_control_plane(
     lookoutdb = LookoutDb(
         lookout_database_url or os.path.join(data_dir, "lookout.db")
     )
+    # Bounded-replay restart (scheduler/checkpoint.py): load the newest
+    # valid snapshot into the scheduler store BEFORE the ingestion pipelines
+    # read their start positions, so they replay only the log suffix past
+    # the snapshot fence.  Fast-forward only -- a store already at/past the
+    # fence keeps its own (newer) state; corrupt snapshots fall back to the
+    # previous one, then to full replay.
+    from armada_tpu.scheduler.checkpoint import CheckpointManager, maybe_restore
+
+    checkpointer = CheckpointManager(os.path.join(data_dir, "checkpoints"))
+    restore_info = maybe_restore(db, checkpointer)
+    if restore_info.get("restored"):
+        logging.getLogger("armada.serve").info(
+            "restored scheduler store from checkpoint %s",
+            restore_info.get("path"),
+        )
+    if checkpoint_interval_s is None:
+        try:
+            checkpoint_interval_s = float(
+                os.environ.get("ARMADA_CHECKPOINT_S", 0.0)
+            )
+        except ValueError:
+            checkpoint_interval_s = 0.0
     publisher = Publisher(log)
 
     scheduler_pipeline = IngestionPipeline(
@@ -281,6 +306,14 @@ def start_control_plane(
         )
     if replicate_log:
         publisher.write_gate = _write_gate
+    if leader_id:
+        # Epoch fence on the single append choke point: a deposed leader's
+        # publish is rejected the moment the election record carries a
+        # higher generation, independent of how stale its own leadership
+        # view is.  The scheduler stamps the held epoch each leader cycle.
+        gen_peek = getattr(leader, "current_generation", None)
+        if gen_peek is not None:
+            publisher.epoch_source = gen_peek
     from armada_tpu.scheduler.metrics import SchedulerMetrics
     from armada_tpu.scheduler.reports import (
         LeaderProxyingReports,
@@ -345,6 +378,13 @@ def start_control_plane(
         metrics=metrics,
         reports=reports,
     )
+    scheduler.checkpointer = checkpointer
+    scheduler.checkpoint_interval_s = checkpoint_interval_s or 0.0
+    # armadactl checkpoint rides the ExecutorAdmin surface: trigger + status
+    # resolve against THIS plane's scheduler (plane-local state, not
+    # event-sourced -- a snapshot of a replica is that replica's affair).
+    control_plane.checkpoint_trigger = scheduler.checkpoint
+    control_plane.checkpoint_status = scheduler.durability_status
     executor_api = ExecutorApi(db, publisher, factory)
 
     from armada_tpu.rpc.server import make_server
@@ -391,12 +431,30 @@ def start_control_plane(
                 bearer_token=proxy_bearer_token,
             )
 
+        def _min_acked() -> dict:
+            # The LOWEST committed consumer position per partition across
+            # every local materialized view: the safety bound for
+            # divergence truncation (a suffix no view has read can be
+            # dropped without orphaning state).
+            out = {p: None for p in range(num_partitions)}
+            for positions in (
+                db.positions("scheduler"),
+                eventdb.positions("events"),
+                lookoutdb.positions("lookout"),
+            ):
+                for p in range(num_partitions):
+                    pos = positions.get(p, 0)
+                    out[p] = pos if out[p] is None else min(out[p], pos)
+            return {p: (v or 0) for p, v in out.items()}
+
         replicator = LogReplicator(
             log,
             leader_address=leader.leader_address,
             client_factory=_replication_client,
+            min_acked=_min_acked,
         )
         replicator.start()
+        scheduler.replication_status = replicator.status
 
     scheduler_pipeline.start()
     event_pipeline.start()
@@ -439,6 +497,7 @@ def start_control_plane(
         from armada_tpu.scheduler.slo import recorder as _slo_recorder
 
         health_server.slo_status = _slo_recorder().snapshot
+        health_server.durability_status = scheduler.durability_status
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(
@@ -580,6 +639,8 @@ def start_control_plane(
         algo_port=algo_bound,
         _algo_server=algo_server,
         replicator=replicator,
+        checkpoint_manager=checkpointer,
+        restore_info=restore_info,
         _watchdog_token=_watchdog_token,
     )
 

@@ -9,9 +9,11 @@ unless they can be triggered on demand.  ``ARMADA_FAULT`` injects them:
     ARMADA_FAULT=<site>:<mode>[:<after_n>][,<site>:<mode>[:<after_n>]...]
 
 * ``site``  -- an injection point name (see the catalogue below).
-* ``mode``  -- ``error`` (raise) or ``hang`` (block, bounded by
+* ``mode``  -- ``error`` (raise), ``hang`` (block, bounded by
   ``ARMADA_FAULT_HANG_S``, default 120s -- long enough that only a watchdog
-  recovers, short enough that abandoned test threads drain).
+  recovers, short enough that abandoned test threads drain), or ``exit``
+  (``os._exit(137)``: a REAL crash, no atexit/finally -- only meaningful in
+  subprocess drills, where the parent observes the kill and restarts).
 * ``after_n`` -- skip the first N checks of that site, fire on check N+1.
   Each entry fires ONCE and then disarms (counters are process-global), so
   a drill injects a deterministic single fault and the system's recovery is
@@ -27,6 +29,17 @@ Sites wired in this repo (docs/operations.md has the operator catalogue):
     eventlog_publish the event-log publisher (eventlog/publisher.py), before
                      any append so the failure is all-or-nothing
     executor_submit  the executor's pod submission (executor/service.py)
+    ingest_ack       the ingestion pipeline, between the batch's
+                     transactional commit and the in-memory cursor ack
+                     (ingest/pipeline.py) -- the crash window the
+                     exactly-once design exists for
+    snapshot_write   the checkpoint writer, before any file is written
+                     (scheduler/checkpoint.py) -- a crash mid-snapshot must
+                     leave recovery falling back to the previous snapshot
+    leader_promote   the scheduler's promotion branch, after winning the
+                     election and before the recovery fence completes
+                     (scheduler/scheduler.py) -- promotion must re-run
+                     idempotently on the next cycle
 
 Checks are env-driven per call (monkeypatch-friendly) and cost one dict
 lookup when ``ARMADA_FAULT`` is unset.
@@ -111,4 +124,9 @@ def check(site: str, exc: type = FaultInjected) -> None:
         while time.monotonic() < deadline:
             time.sleep(min(0.05, budget))
         return
+    if mode == "exit":
+        # A real kill: no exception handlers, no finally blocks, no atexit
+        # -- exactly what a power loss looks like to the durable state on
+        # disk.  137 = SIGKILL's conventional exit status.
+        os._exit(137)
     raise exc(f"injected fault at {site!r}")

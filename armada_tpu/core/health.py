@@ -168,6 +168,14 @@ class _Handler(BaseHTTPRequestHandler):
                     body["slo"] = srv.slo_status()
                 except Exception as exc:  # noqa: BLE001
                     body["slo"] = {"error": str(exc)}
+            if srv.durability_status is not None:
+                # Durability block (scheduler/checkpoint.py + replicator):
+                # snapshot age/fence, current epoch, replication lag --
+                # the RPO/RTO signals of the recovery runbook.
+                try:
+                    body["durability"] = srv.durability_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["durability"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -236,6 +244,10 @@ class HealthServer:
         # Optional () -> dict: the streaming SLO block (serve wires
         # scheduler/slo.recorder().snapshot here).
         self.slo_status = None
+        # Optional () -> dict: the durability block (serve wires
+        # Scheduler.durability_status: snapshot age/fence, epoch,
+        # replication lag).
+        self.durability_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -76,6 +76,12 @@ def _load_lib() -> ctypes.CDLL:
         lib.el_flush.argtypes = [ctypes.c_void_p]
         lib.el_reset.restype = ctypes.c_int
         lib.el_reset.argtypes = [ctypes.c_void_p]
+        lib.el_truncate.restype = ctypes.c_int
+        lib.el_truncate.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int64,
+        ]
         _lib = lib
     return _lib
 
@@ -157,6 +163,16 @@ class EventLog:
         self._check_open()
         if self._lib.el_reset(self._handle) != 0:
             raise OSError("event log reset failed")
+
+    def truncate(self, partition: int, offset: int) -> None:
+        """Drop everything at/after `offset` in one partition (divergence
+        recovery -- eventlog/replicator.py).  `offset` must be a record
+        boundary at or before the current end."""
+        self._check_open()
+        if self._lib.el_truncate(self._handle, partition, offset) != 0:
+            raise OSError(
+                f"truncate of partition {partition} to {offset} failed"
+            )
 
     def read(
         self,

@@ -55,6 +55,23 @@ class NotLeader(RuntimeError):
         self.leader_address = leader_address
 
 
+class DeposedEpoch(NotLeader):
+    """Publish rejected by the epoch fence: the election record has moved
+    past the generation this process last led at -- a successor exists, so
+    appending here would fork the log its replicator is about to become a
+    follower of.  Subclasses NotLeader so transports keep answering the
+    retryable UNAVAILABLE."""
+
+    def __init__(self, held: int, current: int):
+        super().__init__()
+        self.args = (
+            f"deposed: publishing at epoch {held} but the election record "
+            f"is at {current}",
+        )
+        self.held = held
+        self.current = current
+
+
 class Publisher:
     """Routes EventSequences to log partitions; the only write path to the log."""
 
@@ -73,13 +90,34 @@ class Publisher:
         # ExecutorAdmin / queue-CRUD handlers can never append locally and
         # fork the log their replicator is tailing.
         self.write_gate = None
+        # Epoch fence (elected deployments): `epoch_source` peeks the
+        # election record's monotonic generation; `set_epoch` records the
+        # generation this process last held leadership at (the scheduler
+        # stamps it every leader cycle).  A publish whose held epoch is
+        # older than the record's current one is from a DEPOSED leader --
+        # rejected even if the write_gate's cached leadership view has not
+        # caught up yet.  Both the address gate and the epoch fence sit on
+        # this one choke point every append path shares.
+        self.epoch_source = None
+        self._epoch: Optional[int] = None
 
-    def publish(self, sequences: Iterable[pb.EventSequence]) -> list[PublishedRef]:
-        """Append sequences (chunked) to their jobset partitions, then fsync."""
+    def set_epoch(self, generation: int) -> None:
+        """Record the election generation this process currently leads at."""
+        self._epoch = int(generation)
+
+    def _check_fences(self) -> None:
         if self.write_gate is not None:
             leader = self.write_gate()
             if leader is not None:
                 raise NotLeader(leader)
+        if self.epoch_source is not None and self._epoch is not None:
+            current = int(self.epoch_source())
+            if current > self._epoch:
+                raise DeposedEpoch(self._epoch, current)
+
+    def publish(self, sequences: Iterable[pb.EventSequence]) -> list[PublishedRef]:
+        """Append sequences (chunked) to their jobset partitions, then fsync."""
+        self._check_fences()
         # Fault drill (core/faults): BEFORE any append, so an injected
         # publish failure is all-or-nothing -- the scheduler's
         # abort-on-publish-failure discipline (txn abort + cursor rewind)
@@ -107,6 +145,7 @@ class Publisher:
 
     def publish_markers(self, group_id: Optional[str] = None) -> str:
         """Write one PartitionMarker to every partition; returns the group id."""
+        self._check_fences()  # markers are appends too: same fences
         group_id = group_id or uuid.uuid4().hex
         now_ns = int(self._clock() * 1e9)
         for part in range(self._log.num_partitions):

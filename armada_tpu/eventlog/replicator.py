@@ -18,6 +18,17 @@ not synchronous quorum writes): an event the leader committed but had not
 yet streamed when it died is lost with the leader's disk.  The window is
 one poll interval (~50ms); deployments that cannot tolerate it need
 shared/remote storage for the log itself.
+
+Divergence (this replica's log is not a prefix of the current leader's --
+the classic cause: we led once, accepted writes, lost the election, and
+the new leader never saw our tail) is repaired AUTOMATICALLY when it is
+safe: the follower truncates its log back to the last common prefix with
+the leader and resumes tailing, PROVIDED no local consumer has acked past
+the cut (the dropped suffix was never consumed into a local view, so
+truncation erases nothing observable).  If a consumer HAS read into the
+divergent suffix, truncation would leave views built from records the new
+lineage never had -- replication halts and an operator picks a survivor
+(docs/operations.md has the truncate-vs-wipe decision table).
 """
 
 from __future__ import annotations
@@ -33,10 +44,10 @@ log = logging.getLogger("armada.replicator")
 
 
 class ReplicationDiverged(RuntimeError):
-    """The local log is not a prefix of the leader's (e.g. this replica
-    previously led and accepted writes the current leader never saw).
-    Automatic repair would silently drop committed local records -- an
-    operator must pick a survivor (wipe this replica's data dir)."""
+    """The local log is not a prefix of the leader's.  Recovered by
+    truncating to the last common prefix when the divergent suffix is
+    unacked; otherwise replication halts for operator action (automatic
+    repair would silently drop records a local view already consumed)."""
 
 
 class LogReplicator:
@@ -47,6 +58,11 @@ class LogReplicator:
     replicator idles and re-resolves.  `client_factory(address)` returns an
     object with `tail_log(partition, from_offset, follow, idle_timeout_s)`
     yielding LogRecord messages and a `close()` (rpc.client.ReplicationClient).
+
+    `min_acked` (optional) returns, per partition, the LOWEST consumer
+    position any local materialized view has committed -- the safety bound
+    for divergence truncation.  Without it, divergence always halts (the
+    pre-truncation behavior).
     """
 
     def __init__(
@@ -56,18 +72,27 @@ class LogReplicator:
         client_factory,
         poll_interval_s: float = 0.2,
         idle_timeout_s: float = 5.0,
+        min_acked: Optional[Callable[[], dict[int, int]]] = None,
     ):
         self.local = local
         self._leader_address = leader_address
         self._client_factory = client_factory
         self._poll = poll_interval_s
         self._idle = idle_timeout_s
+        self._min_acked = min_acked
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # partition -> replicated end offset (observability/tests)
         self.replicated_to: dict[int, int] = {
             p: local.end_offset(p) for p in range(local.num_partitions)
         }
+        # Durability gauges (serve /healthz + prometheus): last known leader
+        # end per partition and the monotonic instant each partition was
+        # last caught up to it.
+        self.leader_ends: dict[int, int] = {}
+        self._caught_up_at: dict[int, float] = {}
+        self.records_replicated = 0
+        self.truncations = 0
         self.diverged = threading.Event()
 
     def start(self) -> None:
@@ -107,13 +132,18 @@ class LogReplicator:
             try:
                 self._tail_once(partition, address)
                 backoff.reset()
-            except ReplicationDiverged:
+            except ReplicationDiverged as e:
+                if self._recover_divergence(partition, address, e):
+                    backoff.reset()
+                    continue
                 self.diverged.set()
                 log.error(
-                    "partition %d: local log diverged from leader %s -- "
-                    "replication halted (operator action required)",
+                    "partition %d: local log diverged from leader %s and "
+                    "the divergent suffix is acked -- replication halted "
+                    "(operator action required): %s",
                     partition,
                     address,
+                    e,
                 )
                 return
             except Exception as e:
@@ -135,6 +165,16 @@ class LogReplicator:
             start = self.local.end_offset(partition)
             info = client.get_log_info()
             leader_end = list(info.end_offsets)[partition]
+            self.leader_ends[partition] = leader_end
+            if start >= leader_end:
+                self._caught_up_at[partition] = time.monotonic()
+            elif partition not in self._caught_up_at:
+                # First observation of being BEHIND with no catch-up ever
+                # recorded (fresh replica against a long leader log): start
+                # the lag clock NOW, or lag_s would read 0.0 for the whole
+                # hours-long initial catch-up -- exactly when the
+                # "takeover would lose this window" alert matters most.
+                self._caught_up_at[partition] = time.monotonic()
             if start > leader_end:
                 # local log is LONGER than the leader's: we hold committed
                 # records the leader never saw (e.g. this replica led once)
@@ -159,9 +199,11 @@ class LogReplicator:
                         f"{record.offset}, local end is {local_end}"
                     )
                 self.local.append(partition, record.key, record.payload)
-                self.replicated_to[partition] = self.local.end_offset(
-                    partition
-                )
+                self.records_replicated += 1
+                new_end = self.local.end_offset(partition)
+                self.replicated_to[partition] = new_end
+                if new_end >= leader_end:
+                    self._caught_up_at[partition] = time.monotonic()
         except Exception as e:
             # A local end offset that is not a record BOUNDARY in the
             # leader's log makes the leader's read fail with its corrupt-
@@ -175,6 +217,101 @@ class LogReplicator:
             raise
         finally:
             client.close()
+
+    # --- divergence recovery -------------------------------------------------
+
+    def _common_prefix(self, partition: int, client) -> int:
+        """Largest offset up to which local and leader logs hold identical
+        records.  Walks both logs record-by-record from 0 -- O(log size),
+        paid only on the rare divergence event, and exact (no trust in
+        offsets alone: payloads are compared)."""
+        common = 0
+        local_iter = self.local.iter_from(partition, 0)
+        for theirs in client.tail_log(
+            partition, from_offset=0, follow=False, idle_timeout_s=0.5
+        ):
+            ours = next(local_iter, None)
+            if ours is None:
+                break  # local is a strict prefix: everything local matches
+            if (
+                ours.offset != theirs.offset
+                or ours.key != theirs.key
+                or ours.payload != theirs.payload
+            ):
+                break
+            common = ours.next_offset
+        return common
+
+    def _recover_divergence(
+        self, partition: int, address: str, cause: ReplicationDiverged
+    ) -> bool:
+        """Truncate the local partition back to the last common prefix with
+        the leader IF no local consumer acked past it; returns True when
+        replication may resume.  Conservative on any error: halt."""
+        if self._min_acked is None:
+            return False
+        try:
+            client = self._client_factory(address)
+            try:
+                common = self._common_prefix(partition, client)
+            finally:
+                client.close()
+            acked = int(self._min_acked().get(partition, 0))
+        except Exception as e:  # noqa: BLE001 - recovery must fail CLOSED
+            log.warning(
+                "partition %d: divergence recovery probe failed (%s); halting",
+                partition,
+                e,
+            )
+            return False
+        if acked > common:
+            log.error(
+                "partition %d: local views consumed to %d but the common "
+                "prefix with the leader ends at %d -- truncation would "
+                "orphan consumed state",
+                partition,
+                acked,
+                common,
+            )
+            return False
+        dropped = self.local.end_offset(partition) - common
+        self.local.truncate(partition, common)
+        self.replicated_to[partition] = common
+        self.truncations += 1
+        log.warning(
+            "partition %d: diverged from leader %s (%s); truncated %d "
+            "unacked bytes back to common prefix %d and resuming",
+            partition,
+            address,
+            cause,
+            dropped,
+            common,
+        )
+        return True
+
+    # --- observability -------------------------------------------------------
+
+    def status(self) -> dict:
+        """Replication-lag block for /healthz + the prometheus gauges:
+        bytes behind the last known leader end, seconds since each
+        partition was last caught up, totals."""
+        now = time.monotonic()
+        lag_bytes = 0
+        lag_s = 0.0
+        for p in range(self.local.num_partitions):
+            leader_end = self.leader_ends.get(p)
+            if leader_end is not None:
+                lag_bytes += max(0, leader_end - self.local.end_offset(p))
+            seen = self._caught_up_at.get(p)
+            if seen is not None:
+                lag_s = max(lag_s, now - seen)
+        return {
+            "lag_bytes": lag_bytes,
+            "lag_s": round(lag_s, 3),
+            "records_replicated": self.records_replicated,
+            "truncations": self.truncations,
+            "diverged": self.diverged.is_set(),
+        }
 
     def caught_up_to(self, end_offsets: dict[int, int]) -> bool:
         """True when every partition has replicated at least to the given

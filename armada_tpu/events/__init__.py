@@ -40,6 +40,9 @@ if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN)
                                 _PROTO, "events.proto", "events_pb2"
                             )
                         )
+                # lint: allow(atomic-state-file) -- generated CODE module,
+                # not durable state: must stay plainly importable, and a
+                # lost regen just re-runs on the next import.
                 os.replace(_tmp_gen, _GEN)
 
 from armada_tpu.events import events_pb2  # noqa: E402

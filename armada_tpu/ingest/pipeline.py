@@ -48,6 +48,8 @@ class IngestionPipeline:
 
     def run_once(self) -> int:
         """One consume->convert->store->ack round; returns #sequences applied."""
+        from armada_tpu.core import faults
+
         batch = self._consumer.poll()
         if not batch.sequences:
             return 0
@@ -57,6 +59,13 @@ class IngestionPipeline:
             consumer=self.consumer_name,
             next_positions=batch.next_positions,
         )
+        # Crash drill: die between the batch's transactional commit (data +
+        # cursor advance together) and the in-memory ack.  Exactly-once must
+        # hold EITHER WAY: a restarted pipeline resumes from the store's
+        # committed positions, and a surviving in-process consumer that
+        # re-polls the same batch re-stores it idempotently (INSERT OR
+        # IGNORE / monotonic marks) with the same cursor values.
+        faults.check("ingest_ack")
         self._consumer.ack(batch.next_positions)
         return len(batch.sequences)
 

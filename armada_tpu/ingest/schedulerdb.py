@@ -149,6 +149,33 @@ RUNS_COLUMNS = (
     "pool", "scheduled_at_priority", "pool_scheduled_away", "leased",
 )
 
+# Full column lists per table, in a FIXED order, for the checkpoint
+# subsystem's export/restore (scheduler/checkpoint.py).  Explicit columns
+# (never SELECT *) so a snapshot's row tuples stay stable across dialects
+# and future column additions append rather than silently reorder.
+SNAPSHOT_TABLES: dict[str, tuple[str, ...]] = {
+    "jobs": JOBS_COLUMNS + ("serial",),
+    "runs": (
+        "run_id", "job_id", "created_ns", "executor", "node_id", "node_name",
+        "pool", "scheduled_at_priority", "pool_scheduled_away", "leased",
+        "pending", "running", "succeeded", "failed", "cancelled", "preempted",
+        "returned", "run_attempted", "preempt_requested", "running_ns",
+        "serial",
+    ),
+    "job_run_errors": ("run_id", "job_id", "reason", "message", "terminal"),
+    "markers": ("group_id", "partition", "created_ns"),
+    "executors": ("executor_id", "snapshot", "last_updated_ns"),
+    "executor_settings": (
+        "executor_id", "cordoned", "cordon_reason", "set_by_user",
+    ),
+    "consumer_positions": ("consumer", "partition", "position"),
+    "serials": ("name", "value"),
+    "job_dedup": ("dedup_key", "job_id"),
+    "queues": (
+        "name", "weight", "cordoned", "owners", "groups_json", "labels_json",
+    ),
+}
+
 
 # Statement translation + the sqlite3.Connection-alike over the wire driver
 # live in ingest/sqladapter.py, shared with the lookout store.
@@ -245,6 +272,48 @@ class SchedulerDb:
         thread's uncommitted (potentially rolled-back) transaction."""
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
+
+    # --- checkpoint export/restore (scheduler/checkpoint.py) ----------------
+
+    def export_snapshot(self) -> dict[str, list[tuple]]:
+        """A consistent dump of every materialized table as plain tuples in
+        SNAPSHOT_TABLES order.  Taken under the store lock, so it sits on a
+        batch boundary of the exactly-once ingestion sink: the dumped
+        consumer_positions rows ARE the eventlog fence the rest of the dump
+        reflects -- restoring the dump and replaying the log from those
+        positions reproduces exactly the post-suffix state."""
+        with self._lock:
+            out: dict[str, list[tuple]] = {}
+            for table, cols in SNAPSHOT_TABLES.items():
+                rows = self._conn.execute(
+                    f"SELECT {', '.join(cols)} FROM {table}"
+                ).fetchall()
+                out[table] = [
+                    tuple(row[i] for i in range(len(cols))) for row in rows
+                ]
+            return out
+
+    def restore_snapshot(self, dump: dict[str, list[tuple]]) -> None:
+        """Replace all materialized state with `dump` in ONE transaction: a
+        failure mid-restore rolls back to the pre-restore state, never to a
+        half-loaded store."""
+        with self._lock:
+            cur = self._conn.cursor()
+            try:
+                for table, cols in SNAPSHOT_TABLES.items():
+                    cur.execute(f"DELETE FROM {table}")
+                    rows = dump.get(table, [])
+                    if rows:
+                        qs = ", ".join("?" for _ in cols)
+                        cur.executemany(
+                            f"INSERT INTO {table} ({', '.join(cols)}) "
+                            f"VALUES ({qs})",
+                            rows,
+                        )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
 
     def positions(self, consumer: str = "scheduler") -> dict[int, int]:
         rows = self._query(

@@ -64,6 +64,14 @@ class SoakConfig:
     fault_at_frac: float = 0.5
     watchdog_s: float = 5.0  # round deadline while a fault is configured
     db_url: Optional[str] = None  # external scheduler DB (pgwire DSN)
+    # Mid-soak kill/restart leg (crash-under-load): at this fraction of the
+    # window, checkpoint, fire the ingest_ack crash window (a batch commits
+    # but its in-memory ack dies), abandon the whole serving world WITHOUT
+    # drain, and rebuild it from the data dir (checkpoint restore + log
+    # suffix replay).  Recovery time lands in the restart_recovery_s SLO
+    # histogram (RTO); LifecycleTracker then pins zero dropped/double-leased
+    # jobs ACROSS the restart.  None = no crash leg.
+    crash_at_frac: Optional[float] = None
 
     @staticmethod
     def from_env(**overrides) -> "SoakConfig":
@@ -108,9 +116,15 @@ def run_soak_cli(cfg: "SoakConfig") -> dict:
 
 class SoakWorld:
     """The in-process serving stack (tests/control_plane.py wiring, real
-    clocks).  Owned by run_soak; close() releases the stores."""
+    clocks).  Owned by run_soak; close() releases the stores.
 
-    def __init__(self, cfg: SoakConfig, data_dir: str):
+    `resume=True` rebuilds the plane from the SAME data dir after a kill:
+    the scheduler store restores from the newest checkpoint when it is
+    behind the fence, the ingestion pipelines resume from the store's
+    committed consumer positions (bounded suffix replay), and queue
+    creation skips queues the restored store already holds."""
+
+    def __init__(self, cfg: SoakConfig, data_dir: str, resume: bool = False):
         from armada_tpu.eventlog import EventLog
         from armada_tpu.eventlog.publisher import Publisher
         from armada_tpu.executor import ExecutorService, FakeClusterContext
@@ -142,11 +156,35 @@ class SoakWorld:
         factory = self.config.resource_list_factory()
         os.makedirs(data_dir, exist_ok=True)
         self.log = EventLog(os.path.join(data_dir, "log"), num_partitions=2)
-        self.db = SchedulerDb(cfg.db_url or ":memory:")
+        # The crash leg needs a store that SURVIVES the kill: file-backed
+        # SQLite in the data dir (the event log already is).  Plain soaks
+        # keep the in-memory default -- durability is not what they measure.
+        durable = cfg.crash_at_frac is not None
+        self.db = SchedulerDb(
+            cfg.db_url
+            or (os.path.join(data_dir, "scheduler.db") if durable else ":memory:")
+        )
+        self.checkpoints = None
+        if durable:
+            from armada_tpu.scheduler.checkpoint import (
+                CheckpointManager,
+                maybe_restore,
+            )
+
+            self.checkpoints = CheckpointManager(
+                os.path.join(data_dir, "checkpoints")
+            )
+            self.restore_info = (
+                maybe_restore(self.db, self.checkpoints) if resume else None
+            )
         self.eventdb = EventDb(":memory:")
         self.publisher = Publisher(self.log)
         self.scheduler_pipeline = IngestionPipeline(
-            self.log, self.db, convert_sequences, consumer_name="scheduler"
+            self.log,
+            self.db,
+            convert_sequences,
+            consumer_name="scheduler",
+            start_positions=self.db.positions("scheduler"),
         )
         self.event_pipeline = IngestionPipeline(
             self.log, self.eventdb, event_sink_converter, consumer_name="events"
@@ -192,8 +230,14 @@ class SoakWorld:
         self.executor = ExecutorService(
             "soak-ex", "default", self.cluster, self.executor_api, factory
         )
+        if self.checkpoints is not None:
+            self.scheduler.checkpointer = self.checkpoints
+        existing = (
+            {r["name"] for r in self.db.list_queues()} if resume else set()
+        )
         for i in range(cfg.num_queues):
-            self.server.create_queue(QueueRecord(f"soak-{i}", weight=1.0))
+            if f"soak-{i}" not in existing:
+                self.server.create_queue(QueueRecord(f"soak-{i}", weight=1.0))
 
     def ingest(self) -> None:
         self.scheduler_pipeline.run_until_caught_up()
@@ -241,6 +285,53 @@ def _apply_ops(world: SoakWorld, gen: WorkloadGenerator, tracker: LifecycleTrack
     return submitted
 
 
+def _crash_restart(cfg: SoakConfig, data_dir: str, world: SoakWorld, rec):
+    """The kill/restart leg: checkpoint, fire the committed-but-unacked
+    ingest crash window, abandon the world without drain, rebuild from the
+    data dir (snapshot restore + bounded suffix replay), and record
+    kill -> first-completed-scheduling-cycle as an RTO sample.  Returns
+    (new_world, rto_s, sequences_replayed)."""
+    from armada_tpu.core import faults as _faults
+
+    world.scheduler.checkpoint()
+    # Crash window drill under load: the next ingestion batch COMMITS (data
+    # + cursor in one txn) and dies before the in-memory ack -- exactly the
+    # window the exactly-once design covers.  One-shot; restored below.
+    prev_fault = os.environ.get("ARMADA_FAULT")
+    os.environ["ARMADA_FAULT"] = "ingest_ack:error"
+    try:
+        world.ingest()
+    except _faults.FaultInjected:
+        pass
+    finally:
+        if prev_fault is None:
+            os.environ.pop("ARMADA_FAULT", None)
+        else:
+            os.environ["ARMADA_FAULT"] = prev_fault
+    t_kill = mono_now()
+    # "Kill": no drain, no final cycle -- everything durable is already on
+    # disk (the log fsyncs per publish, the store commits per batch);
+    # close() just releases handles so the drill does not leak fds.
+    world.close()
+    # The kill takes the MATERIALIZED VIEW with it (the cliff checkpoints
+    # exist for: a wiped/corrupt store used to mean full-log replay).  The
+    # event log survives; the rebuilt plane must restore the snapshot and
+    # replay only the suffix past its fence.
+    for suffix in ("", "-wal", "-shm"):
+        try:
+            os.remove(os.path.join(data_dir, "scheduler.db" + suffix))
+        except FileNotFoundError:
+            pass
+    new_world = SoakWorld(cfg, data_dir, resume=True)
+    new_world.executor.run_once()
+    replayed = new_world.scheduler_pipeline.run_until_caught_up()
+    new_world.event_pipeline.run_until_caught_up()
+    new_world.scheduler.cycle(schedule=True)
+    rto_s = mono_now() - t_kill
+    rec.observe_restart(rto_s)
+    return new_world, rto_s, replayed
+
+
 def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
     """Run one soak window; returns the JSON-able report.
 
@@ -253,6 +344,17 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
     from armada_tpu.core import faults, watchdog
     from armada_tpu.scheduler import slo
 
+    if cfg.crash_at_frac is not None and cfg.db_url:
+        # The kill/restart leg wipes the local scheduler.db to exercise
+        # snapshot restore; an external store would survive the "kill" with
+        # its cursors intact, maybe_restore would (correctly) skip, and the
+        # drill would fail spuriously while testing nothing.  Refuse, like
+        # serve refuses --replicate-log with external DBs.
+        raise ValueError(
+            "crash_at_frac cannot be combined with db_url: the kill/restart "
+            "drill wipes the embedded scheduler store to exercise "
+            "checkpoint restore; an external database survives the kill"
+        )
     rec = slo.reset_recorder()
     faults.reset_counters()
     sup = watchdog.reset_supervisor()
@@ -275,10 +377,15 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
     # until then.
     os.environ.pop("ARMADA_WATCHDOG_S", None)
     tsan_was_enabled = tsan.enabled()
-    if cfg.fault:
+    chaos = bool(cfg.fault) or cfg.crash_at_frac is not None
+    if chaos:
+        # Both chaos legs (device fault, kill/restart) run with the race
+        # harness armed: failover and restart are where zombie-writer races
+        # live.
         os.environ["ARMADA_TSAN"] = "1"
         tsan.enable()
         tsan.reset()
+    if cfg.fault:
         os.environ.setdefault("ARMADA_FAULT_HANG_S", "60")
         os.environ.setdefault("ARMADA_REPROBE_INTERVAL_S", "0.05")
         if stub_probe:
@@ -311,6 +418,10 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
         t0 = mono_now()
         fault_at = cfg.fault_at_frac * cfg.window_s
         fault_armed = False
+        crash_at_s = (cfg.crash_at_frac or 0.0) * cfg.window_s
+        crashed = False
+        rto_s = None
+        replayed_after_crash = 0
         next_cycle = 0.0
         last_schedule = -1e9
         last_tick = 0.0
@@ -319,6 +430,25 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
             now_rel = mono_now() - t0
             if now_rel >= cfg.window_s:
                 break
+            if (
+                cfg.crash_at_frac is not None
+                and not crashed
+                and now_rel >= crash_at_s
+            ):
+                world, rto_s, replayed_after_crash = _crash_restart(
+                    cfg, data_dir, world, rec
+                )
+                crashed = True
+                cycles += 1
+                sched_cycles += 1
+                last_tick = mono_now() - t0
+                _log.info(
+                    "soak: kill/restart at t=%.1fs, RTO %.3fs (%d sequences "
+                    "replayed past the fence)",
+                    now_rel,
+                    rto_s,
+                    replayed_after_crash,
+                )
             if cfg.fault and not fault_armed and now_rel >= fault_at:
                 # One-shot entry; fires on the next device-round check.  The
                 # round deadline arms WITH the fault: a soak's warm-up cycles
@@ -375,7 +505,7 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
             promoted = not sup.degraded
 
         tracker.check_dropped(world.job_states())
-        tsan_found = tsan.take_violations() if cfg.fault else []
+        tsan_found = tsan.take_violations() if chaos else []
 
         slo_snap = rec.snapshot()
         events_total = sum(gen.counts.values()) - gen.counts["gang_jobs"]
@@ -419,6 +549,24 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
             report["tsan_violations"] = len(tsan_found)
             if tsan_found:
                 report["tsan_detail"] = tsan_found[:5]
+        if cfg.crash_at_frac is not None:
+            report["crash"] = {
+                "at_s": round(crash_at_s, 1),
+                "rto_s": round(rto_s, 3) if rto_s is not None else None,
+                "replayed_sequences": replayed_after_crash,
+                "restored_from_checkpoint": bool(
+                    (getattr(world, "restore_info", None) or {}).get(
+                        "restored"
+                    )
+                ),
+            }
+            restart_hist = slo_snap.get("restart_recovery_s", {})
+            for p in ("p50_s", "p95_s", "p99_s"):
+                if p in restart_hist:
+                    report[f"restart_{p}"] = restart_hist[p]
+            report.setdefault("tsan_violations", len(tsan_found))
+            if tsan_found:
+                report.setdefault("tsan_detail", tsan_found[:5])
         if tracker.violations:
             report["violation_detail"] = tracker.violations[:10]
         report["ok"] = bool(
@@ -432,6 +580,12 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
                 not cfg.fault
                 or (report["device_state"]["fallbacks"] >= 1 and promoted)
             )
+            # a configured kill/restart leg must actually restart (RTO
+            # recorded) AND restore from the checkpoint it wrote
+            and (
+                cfg.crash_at_frac is None
+                or (crashed and report["crash"]["restored_from_checkpoint"])
+            )
         )
         return report
     finally:
@@ -441,13 +595,12 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-        if cfg.fault:
-            if not tsan_was_enabled:
-                # Leave the race harness the way we found it: an armed-but-
-                # unharvested tsan would change every later test's behavior.
-                tsan.disable()
-            if stub_probe:
-                # Drop the always-healthy probe stub with the supervisor it
-                # was installed on; later device-loss tests must pay real
-                # (subprocess) probes again.
-                watchdog.reset_supervisor()
+        if chaos and not tsan_was_enabled:
+            # Leave the race harness the way we found it: an armed-but-
+            # unharvested tsan would change every later test's behavior.
+            tsan.disable()
+        if cfg.fault and stub_probe:
+            # Drop the always-healthy probe stub with the supervisor it
+            # was installed on; later device-loss tests must pay real
+            # (subprocess) probes again.
+            watchdog.reset_supervisor()

@@ -236,6 +236,23 @@ int el_flush(void* h) {
   return rc;
 }
 
+// Truncate one partition to `offset` (divergence recovery: a follower
+// drops an unacked suffix back to the last common prefix with the leader).
+// `offset` must be <= the current end; the caller is responsible for it
+// being a record boundary (the open-time recovery scan would truncate a
+// mid-record cut anyway, but the in-memory end would briefly disagree).
+int el_truncate(void* h, int part, int64_t offset) {
+  Log* log = (Log*)h;
+  if (part < 0 || part >= (int)log->parts.size()) return -1;
+  Partition& p = log->parts[part];
+  std::lock_guard<std::mutex> lock(p.mu);
+  if (offset < 0 || offset > p.end) return -1;
+  if (ftruncate(p.fd, offset) != 0) return -1;
+  if (fsync(p.fd) != 0) return -1;
+  p.end = offset;
+  return 0;
+}
+
 // Truncate every partition to zero (test helper / dev reset).
 int el_reset(void* h) {
   Log* log = (Log*)h;

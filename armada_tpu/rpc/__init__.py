@@ -61,6 +61,9 @@ if not os.path.exists(_GEN) or os.path.getmtime(_PROTO) > os.path.getmtime(_GEN)
                         ),
                     )
                 )
+        # lint: allow(atomic-state-file) -- generated CODE module, not durable
+        # state: it must stay plainly importable (no checksum envelope), and
+        # a lost regen just re-runs on the next import.
         os.replace(src_path, _GEN)
 
 from armada_tpu.rpc import rpc_pb2  # noqa: E402

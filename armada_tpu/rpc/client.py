@@ -344,6 +344,31 @@ class ArmadaClient(_Base):
             pb.Empty,
         )
 
+    # --- checkpoints (armadactl checkpoint; scheduler/checkpoint.py) --------
+
+    def trigger_checkpoint(self) -> dict:
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/TriggerCheckpoint",
+            pb.Empty(),
+            pb.CheckpointTriggerResponse,
+        )
+        return {
+            "path": resp.path,
+            "created_ns": resp.created_ns,
+            "epoch": resp.epoch,
+            "fenced_offset_total": resp.fenced_offset_total,
+        }
+
+    def checkpoint_status(self) -> dict:
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/CheckpointStatus",
+            pb.Empty(),
+            pb.CheckpointStatusResponse,
+        )
+        return json.loads(resp.status_json)
+
     # --- scheduling reports -------------------------------------------------
 
     def get_job_report(self, job_id: str) -> dict:

@@ -413,6 +413,27 @@ class _ExecutorAdminService:
         )
         return pb.Empty()
 
+    def TriggerCheckpoint(self, request, context):
+        principal = _authenticate(self._auth, context)
+        info = _guard(
+            context, lambda: self._cp.trigger_checkpoint(principal)
+        )
+        return pb.CheckpointTriggerResponse(
+            path=info.get("path", ""),
+            created_ns=int(info.get("created_ns", 0)),
+            epoch=int(info.get("epoch", 0)),
+            fenced_offset_total=sum(info.get("fence", {}).values()),
+        )
+
+    def CheckpointStatus(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        status = _guard(
+            context, lambda: self._cp.get_checkpoint_status(principal)
+        )
+        return pb.CheckpointStatusResponse(status_json=_json.dumps(status))
+
     def PreemptOnQueue(self, request, context):
         principal = _authenticate(self._auth, context)
         _guard(
@@ -726,6 +747,12 @@ def make_server(
                     ),
                     "CancelOnQueue": _unary(
                         csvc.CancelOnQueue, pb.QueueScopedActionRequest
+                    ),
+                    "TriggerCheckpoint": _unary(
+                        csvc.TriggerCheckpoint, pb.Empty
+                    ),
+                    "CheckpointStatus": _unary(
+                        csvc.CheckpointStatus, pb.Empty
                     ),
                 },
             )

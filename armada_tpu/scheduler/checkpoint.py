@@ -1,0 +1,284 @@
+"""Durable checkpoints of the materialized scheduling plane.
+
+The reference outsources durability to Pulsar: the log is the source of
+truth and every store is a rebuildable view (docs/system_overview.md:62-99),
+so a scheduler restart is bounded by Postgres, not by log length.  This
+repo OWNS its event log, and a fresh replica (or a wiped view) used to pay
+full-log replay from offset zero.  A checkpoint bounds that: a periodic
+consistent snapshot of the scheduler's materialized plane -- JobDb source
+rows (jobs/runs), consumer cursors, queue definitions, executor settings,
+dedup keys, short-job-penalty bookkeeping -- fenced to the exact eventlog
+positions it reflects.  Restart = load newest valid snapshot + replay only
+the log suffix past the fence.
+
+Consistency: `SchedulerDb.export_snapshot` dumps under the store lock, the
+same lock the exactly-once ingestion sink commits batches + cursor advances
+under -- so every dump sits on a batch boundary and its own
+consumer_positions rows ARE the fence.  No pause of the pipelines needed.
+
+Failure containment (the "never to wrong state" ladder):
+  * writes are atomic + checksummed (core/statefile.py): a crash
+    mid-snapshot leaves a stale tmp file, never a half-written snapshot
+  * a corrupt/truncated newest snapshot falls back to the previous one
+  * no valid snapshot at all falls back to full replay
+  * restore refuses to move a store BACKWARD: if the live DB's cursors are
+    already past the snapshot fence, the snapshot is stale and skipped
+
+Snapshot payloads are pickled, but contain ONLY builtin types (dicts,
+lists, tuples, str/int/float/bytes/None) -- no class identity to rot
+across versions; `version` gates format changes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import time
+from typing import Callable, Optional
+
+from armada_tpu.core.logging import get_logger
+from armada_tpu.core.statefile import CorruptStateFile, read_blob, write_blob
+
+SNAPSHOT_VERSION = 1
+_NAME_RE = re.compile(r"^ckpt-(\d{8})\.snap$")
+
+_log = get_logger(__name__)
+
+
+def snapshot_plane(
+    db,
+    scheduler=None,
+    epoch: int = 0,
+    clock: Callable[[], float] = time.time,
+) -> dict:
+    """Build one snapshot payload from a SchedulerDb (+ an optional
+    diagnostic record of the Scheduler loop's cursors and retained-terminal
+    set at snapshot time -- see the note below; restore re-derives both)."""
+    dump = db.export_snapshot()
+    fence = {
+        int(part): int(pos)
+        for consumer, part, pos in dump.get("consumer_positions", [])
+        if consumer == "scheduler"
+    }
+    payload = {
+        "version": SNAPSHOT_VERSION,
+        "created_ns": int(clock() * 1e9),
+        "epoch": int(epoch),
+        "fence": fence,
+        "db": dump,
+    }
+    if scheduler is not None:
+        # DIAGNOSTIC block only -- no restore path consumes it.  A restarted
+        # Scheduler re-derives its fetch cursors from the restored rows'
+        # serial columns and rebuilds the retained-terminal set via
+        # apply_rows; this records what the loop held at snapshot time so a
+        # snapshot can be debugged offline.
+        payload["scheduler"] = {
+            "jobs_serial": scheduler._jobs_serial,
+            "runs_serial": scheduler._runs_serial,
+            "retained_terminal": sorted(scheduler._retained_terminal),
+        }
+    return payload
+
+
+class CheckpointManager:
+    """Versioned, checksummed, atomically-written snapshot files in one
+    directory, newest-first recovery with corrupt-fallback."""
+
+    def __init__(self, directory: str, keep: int = 2):
+        from armada_tpu.analysis.tsan import make_lock
+
+        self.directory = directory
+        self.keep = max(1, keep)
+        os.makedirs(directory, exist_ok=True)
+        # Snapshots skipped during the last load (path, reason): surfaced in
+        # status() so an operator sees silent corruption before the day the
+        # LAST good snapshot is needed.
+        self.skipped: list[tuple[str, str]] = []
+        # Serializes concurrent writers (the run loop's periodic trigger vs
+        # an armadactl RPC trigger): without it both compute the same seq
+        # from paths() and interleave into the same tmp file -- a corrupt
+        # newest snapshot exactly when the operator deliberately asked for
+        # one.  In-process only, matching the design (one plane per
+        # directory; followers never snapshot).
+        self._write_lock = make_lock("checkpoint.write")
+
+    # ------------------------------------------------------------- paths ----
+
+    def paths(self) -> list[str]:
+        """Snapshot files, oldest first."""
+        try:
+            names = sorted(
+                n for n in os.listdir(self.directory) if _NAME_RE.match(n)
+            )
+        except FileNotFoundError:
+            return []
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _next_seq(self) -> int:
+        paths = self.paths()
+        if not paths:
+            return 1
+        return int(_NAME_RE.match(os.path.basename(paths[-1])).group(1)) + 1
+
+    # ------------------------------------------------------------- write ----
+
+    def write(self, payload: dict) -> str:
+        """Serialize + atomically write one snapshot; prunes old files down
+        to `keep`.  The fault site fires BEFORE any write so an injected
+        crash-mid-snapshot is all-or-nothing at the file level (a real torn
+        write is covered by the statefile checksum instead)."""
+        from armada_tpu.core import faults
+
+        faults.check("snapshot_write")
+        with self._write_lock:
+            return self._write_locked(payload)
+
+    def _write_locked(self, payload: dict) -> str:
+        from armada_tpu.core.statefile import write_json
+
+        path = os.path.join(
+            self.directory, f"ckpt-{self._next_seq():08d}.snap"
+        )
+        write_blob(
+            path,
+            pickle.dumps(payload, protocol=4),
+            version=SNAPSHOT_VERSION,
+        )
+        # Tiny sidecar metadata so status() (polled by /healthz and the
+        # prometheus gauges) never has to deserialize a multi-MB snapshot.
+        # Purely advisory: recovery (load_newest) walks the real files.
+        write_json(
+            os.path.join(self.directory, "LATEST.json"),
+            {
+                "path": path,
+                "created_ns": payload["created_ns"],
+                "epoch": payload.get("epoch", 0),
+                "fence": {str(k): v for k, v in payload.get("fence", {}).items()},
+                "jobs": len(payload["db"].get("jobs", [])),
+            },
+        )
+        for old in self.paths()[: -self.keep]:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        return path
+
+    # -------------------------------------------------------------- load ----
+
+    def load_newest(self) -> Optional[tuple[dict, str]]:
+        """Newest valid snapshot (payload, path), falling back past corrupt
+        or partial files; None = no usable snapshot (caller does full
+        replay).  Never raises on bad files -- a corrupt snapshot must
+        degrade recovery time, not prevent recovery."""
+        self.skipped = []
+        for path in reversed(self.paths()):
+            try:
+                version, blob = read_blob(path)
+                if version != SNAPSHOT_VERSION:
+                    raise CorruptStateFile(
+                        f"unsupported snapshot version {version}"
+                    )
+                payload = pickle.loads(blob)
+                if (
+                    not isinstance(payload, dict)
+                    or payload.get("version") != SNAPSHOT_VERSION
+                    or "db" not in payload
+                ):
+                    raise CorruptStateFile("payload shape mismatch")
+            except FileNotFoundError:
+                continue
+            except (CorruptStateFile, pickle.UnpicklingError, EOFError,
+                    AttributeError, ValueError) as e:
+                _log.warning("skipping corrupt snapshot %s: %s", path, e)
+                self.skipped.append((path, str(e)))
+                continue
+            return payload, path
+        return None
+
+    # ------------------------------------------------------------ status ----
+
+    def status(self, clock: Callable[[], float] = time.time) -> dict:
+        """The durability block /healthz and `armadactl checkpoint --status`
+        report: newest snapshot identity, age, fence, epoch.  Reads only the
+        sidecar LATEST.json (written with every snapshot) -- never the
+        snapshot itself, which can be multi-MB and is polled per scrape."""
+        from armada_tpu.core.statefile import read_json
+
+        out: dict = {
+            "directory": self.directory,
+            "count": len(self.paths()),
+            "skipped": [
+                {"path": p, "reason": r} for p, r in self.skipped
+            ],
+        }
+        try:
+            meta = read_json(os.path.join(self.directory, "LATEST.json"))
+        except (FileNotFoundError, CorruptStateFile):
+            out["snapshot"] = None
+            return out
+        fence = {int(k): int(v) for k, v in meta.get("fence", {}).items()}
+        out["snapshot"] = {
+            "path": meta.get("path", ""),
+            "created_ns": meta.get("created_ns", 0),
+            "age_s": round(
+                max(0.0, clock() - meta.get("created_ns", 0) / 1e9), 3
+            ),
+            "epoch": meta.get("epoch", 0),
+            "fence": fence,
+            "fenced_offset_total": sum(fence.values()),
+            "jobs": meta.get("jobs", 0),
+        }
+        return out
+
+
+def restore_plane(payload: dict, db) -> None:
+    """Load a snapshot payload into a SchedulerDb (one transaction)."""
+    db.restore_snapshot(payload["db"])
+
+
+def maybe_restore(db, manager: CheckpointManager) -> dict:
+    """Boot-time restore policy: load the newest valid snapshot and restore
+    it ONLY when it is ahead of the live store (fast-forward only).
+
+    A store whose scheduler-consumer cursors are at/past the snapshot fence
+    in every partition already reflects everything the snapshot holds --
+    restoring would move committed state BACKWARD (and the ingestion
+    exactly-once guard would then skip the re-replayed suffix).  A fresh
+    store (no cursors) restores; a store strictly behind the fence
+    restores; anything else keeps the live store and lets normal suffix
+    replay run from its own cursors.
+    """
+    loaded = manager.load_newest()
+    if loaded is None:
+        return {"restored": False, "reason": "no usable snapshot"}
+    payload, path = loaded
+    fence = {int(k): int(v) for k, v in payload.get("fence", {}).items()}
+    live = db.positions("scheduler")
+    fresh = not live
+    ahead = any(live.get(p, 0) > pos for p, pos in fence.items())
+    strictly_behind = any(live.get(p, 0) < pos for p, pos in fence.items())
+    if ahead or not (fresh or strictly_behind):
+        return {
+            "restored": False,
+            "path": path,
+            "reason": "live store at/past snapshot fence",
+            "fence": fence,
+            "live_positions": live,
+        }
+    restore_plane(payload, db)
+    _log.info(
+        "restored scheduler store from %s (fence %s, epoch %d)",
+        path,
+        fence,
+        payload.get("epoch", 0),
+    )
+    return {
+        "restored": True,
+        "path": path,
+        "fence": fence,
+        "epoch": payload.get("epoch", 0),
+        "created_ns": payload["created_ns"],
+    }

@@ -168,6 +168,15 @@ class KubernetesLeaseLeaderController:
             return None
         return self._last_seen_address or ""
 
+    def current_generation(self) -> int:
+        """Read-only epoch peek from the same observed election state
+        leader_address() uses (no apiserver round trip on the publish path;
+        staleness is bounded by the cycle interval, and validate_token's
+        apiserver re-check still backstops the fence)."""
+        if self._observed is None:
+            return 0
+        return int(self._observed[2])
+
     def _spec(self, transitions: int) -> dict:
         return {
             "holderIdentity": self._holder,

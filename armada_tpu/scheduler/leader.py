@@ -44,6 +44,12 @@ class LeaderController(Protocol):
         locally), the leader's advertised address when another holder does,
         and "" when another holder is known but advertised no address."""
 
+    def current_generation(self) -> int:
+        """READ-ONLY peek at the election record's fencing generation
+        (monotonic epoch).  Must not acquire/renew -- the publisher's epoch
+        fence reads it on every publish to reject writes from a deposed
+        leader without waiting for the next cycle's validate_token."""
+
 
 class StandaloneLeaderController:
     """Always leader (leader.go StandaloneLeaderController:64)."""
@@ -56,6 +62,9 @@ class StandaloneLeaderController:
 
     def leader_address(self) -> Optional[str]:
         return None  # we ARE the leader
+
+    def current_generation(self) -> int:
+        return 0  # no elections, no epochs
 
 
 class FileLeaseLeaderController:
@@ -105,6 +114,13 @@ class FileLeaseLeaderController:
             return ""  # expired foreign lease: election gap, retry
         return lease.get("address") or ""
 
+    def current_generation(self) -> int:
+        """The record's fencing generation, whoever holds it (0 before any
+        election).  Read-only: a deposed leader peeking here must not renew
+        itself back into authority."""
+        lease = self._locked(self._read)
+        return int(lease["generation"]) if lease else 0
+
     # --- lease file access (always under flock) -----------------------------
 
     def _locked(self, fn):
@@ -121,12 +137,12 @@ class FileLeaseLeaderController:
             return None
 
     def _write(self, lease: dict) -> None:
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(lease, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path)
+        # Election records are durable state files: the shared helper adds
+        # the directory fsync a hand-rolled tmp+rename misses
+        # (core/statefile.py; armada-lint atomic-state-file).
+        from armada_tpu.core import statefile
+
+        statefile.write_json(self._path, lease)
 
     # --- LeaderController ---------------------------------------------------
 

@@ -177,6 +177,41 @@ class SchedulerMetrics:
             "Sample count behind each SLO latency histogram",
             ["metric"],
         )
+        # Durability gauges (scheduler/checkpoint.py + eventlog/replicator):
+        # dashboards alert on snapshot age past the cadence (RPO drifting),
+        # replication lag growing (takeover would lose that window), and an
+        # epoch bump (a failover happened).
+        self.snapshot_age = Gauge(
+            "armada_durability_snapshot_age_seconds",
+            "Age of the newest valid checkpoint snapshot",
+            registry=registry,
+        )
+        self.snapshot_fenced_offset = Gauge(
+            "armada_durability_fenced_offset_total",
+            "Sum of the newest snapshot's eventlog fence offsets (restart "
+            "replays only the suffix past this)",
+            registry=registry,
+        )
+        self.durability_epoch = Gauge(
+            "armada_durability_epoch",
+            "Current leader-election fencing generation (monotonic epoch)",
+            registry=registry,
+        )
+        self.replication_lag_bytes = Gauge(
+            "armada_replication_lag_bytes",
+            "Event-log bytes the local replica trails the leader by",
+            registry=registry,
+        )
+        self.replication_lag_seconds = Gauge(
+            "armada_replication_lag_seconds",
+            "Seconds since every partition was last caught up to the leader",
+            registry=registry,
+        )
+        self.replication_records = Gauge(
+            "armada_replication_records_replicated_total",
+            "Event-log records replicated from leaders (monotonic)",
+            registry=registry,
+        )
 
     # --- hooks called by the Scheduler --------------------------------------
 
@@ -201,6 +236,24 @@ class SchedulerMetrics:
                 v = summary.get(q + "_s")
                 if v is not None:
                     self.slo_latency.labels(metric, q).set(v)
+
+    def observe_durability(self, status: dict) -> None:
+        """Publish the scheduler's durability block
+        (Scheduler.durability_status), once per cycle."""
+        self.durability_epoch.set(float(status.get("epoch", 0)))
+        snap = (status.get("checkpoint") or {}).get("snapshot")
+        if snap:
+            self.snapshot_age.set(float(snap.get("age_s", 0.0)))
+            self.snapshot_fenced_offset.set(
+                float(snap.get("fenced_offset_total", 0))
+            )
+        rep = status.get("replication")
+        if isinstance(rep, dict) and "lag_bytes" in rep:
+            self.replication_lag_bytes.set(float(rep["lag_bytes"]))
+            self.replication_lag_seconds.set(float(rep["lag_s"]))
+            self.replication_records.set(
+                float(rep.get("records_replicated", 0))
+            )
 
     def observe_executor_usage(self, executors, factory) -> None:
         """Publish executor-reported per-queue usage (metrics.go:387-395).

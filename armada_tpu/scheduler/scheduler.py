@@ -135,6 +135,17 @@ class Scheduler:
         # Terminal jobs kept in the JobDb for the short-job penalty window
         # (scheduler.go:436-447); swept in sync_state once the window lapses.
         self._retained_terminal: set = set()
+        # Durable checkpoints (scheduler/checkpoint.py): serve wires a
+        # CheckpointManager + interval; the run loop snapshots the
+        # materialized plane while leading, and `armadactl checkpoint`
+        # triggers one on demand through the same method.
+        self.checkpointer = None
+        self.checkpoint_interval_s: float = 0.0
+        self._last_checkpoint_mono: float = 0.0
+        self.last_checkpoint: Optional[dict] = None
+        # Replicated deployments: serve points this at the LogReplicator's
+        # status() so the durability block carries replication lag.
+        self.replication_status = None
 
     def now_ns(self) -> int:
         return int(self._clock() * 1e9)
@@ -254,6 +265,7 @@ class Scheduler:
 
             self.metrics.observe_device(supervisor().snapshot())
             self.metrics.observe_slo(self._slo().snapshot())
+            self.metrics.observe_durability(self.durability_status())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
         return result
@@ -308,7 +320,23 @@ class Scheduler:
                 self._was_leader = False
                 txn.commit()
                 return result
+            # Epoch fence: the publisher rejects publishes stamped with an
+            # older generation than the election record's current one, so a
+            # deposed leader's in-flight cycle cannot append after a
+            # successor was elected -- even between our validate_token and
+            # the actual append (eventlog/publisher.py set_epoch).
+            set_epoch = getattr(self.publisher, "set_epoch", None)
+            if set_epoch is not None:
+                set_epoch(token.generation)
             if not self._was_leader:
+                # Crash drill: die mid-promotion (after winning the
+                # election, before the recovery fence completes).  The
+                # cycle's except rewinds cursors and aborts the txn;
+                # _was_leader stays False, so the next cycle re-runs the
+                # whole promotion -- promotion must be idempotent.
+                from armada_tpu.core import faults
+
+                faults.check("leader_promote")
                 # Leadership acquired (first cycle or follower -> leader):
                 # replay everything already published -- possibly by the
                 # previous leader -- before taking decisions
@@ -827,6 +855,90 @@ class Scheduler:
                 ),
             )
 
+    # --- durable checkpoints (scheduler/checkpoint.py) ----------------------
+
+    def checkpoint(self) -> dict:
+        """Snapshot the materialized plane NOW; returns the written
+        checkpoint's identity.  Safe from any thread (the export runs under
+        the store lock, on an ingestion batch boundary): the armadactl
+        trigger calls this from an RPC worker while the loop runs."""
+        if self.checkpointer is None:
+            raise RuntimeError("no checkpoint directory configured")
+        from armada_tpu.scheduler.checkpoint import snapshot_plane
+
+        epoch = 0
+        gen = getattr(self.leader, "current_generation", None)
+        if gen is not None:
+            try:
+                epoch = gen()
+            except Exception:  # noqa: BLE001 - a flaky peek must not block snapshots
+                epoch = 0
+        payload = snapshot_plane(
+            self.db, scheduler=self, epoch=epoch, clock=self._clock
+        )
+        path = self.checkpointer.write(payload)
+        self._last_checkpoint_mono = time.monotonic()
+        self.last_checkpoint = {
+            "path": path,
+            "created_ns": payload["created_ns"],
+            "fence": payload["fence"],
+            "epoch": epoch,
+        }
+        _log.info(
+            "checkpoint written: %s (fence total %d, epoch %d)",
+            path,
+            sum(payload["fence"].values()),
+            epoch,
+        )
+        return self.last_checkpoint
+
+    def _maybe_checkpoint(self, leader: bool) -> None:
+        """Interval-triggered checkpoint from the run loop.  Leader-only:
+        follower stores trail replication anyway, and two replicas
+        snapshotting shared storage would race.  Failures are logged and
+        retried next interval -- a broken disk must not take the loop down
+        with the next cycle's work."""
+        if (
+            self.checkpointer is None
+            or self.checkpoint_interval_s <= 0
+            or not leader
+        ):
+            return
+        if (
+            time.monotonic() - self._last_checkpoint_mono
+            < self.checkpoint_interval_s
+        ):
+            return
+        try:
+            self.checkpoint()
+        except Exception:  # noqa: BLE001 - keep cycling; next interval retries
+            # Mark the attempt so a persistently failing disk retries at the
+            # interval cadence, not every cycle.
+            self._last_checkpoint_mono = time.monotonic()
+            _log.exception("periodic checkpoint failed")
+
+    def durability_status(self) -> dict:
+        """The /healthz durability block + prometheus gauge source: newest
+        snapshot age/fence/epoch plus this process's current election epoch.
+        Cheap (sidecar metadata only)."""
+        out: dict = {"epoch": 0}
+        gen = getattr(self.leader, "current_generation", None)
+        if gen is not None:
+            try:
+                out["epoch"] = gen()
+            except Exception:  # noqa: BLE001 - peek failure is not unhealth
+                pass
+        if self.checkpointer is not None:
+            out["checkpoint"] = self.checkpointer.status(clock=self._clock)
+        if self.last_checkpoint is not None:
+            out["last_checkpoint"] = self.last_checkpoint
+        if self.replication_status is not None:
+            try:
+                out["replication"] = self.replication_status()
+            except Exception as exc:  # noqa: BLE001 - observability only
+                out["replication"] = {"error": str(exc)}
+        return out
+
     # --- service loop (scheduler.go Run:142) --------------------------------
 
     def run(
@@ -853,7 +965,7 @@ class Scheduler:
             start = self._clock()
             do_schedule = start - last_schedule >= schedule_interval_s
             try:
-                self.cycle(schedule=do_schedule)
+                result = self.cycle(schedule=do_schedule)
             except Exception:  # noqa: BLE001 - the loop must survive
                 delay = backoff.next_delay()
                 _log.exception(
@@ -866,6 +978,7 @@ class Scheduler:
                 stop.wait(delay)
                 continue
             backoff.reset()
+            self._maybe_checkpoint(result.leader)
             if do_schedule:
                 last_schedule = start
             elapsed = self._clock() - start

@@ -48,6 +48,12 @@ class SLORecorder:
         self.cycle_degraded = self.registry.histogram("cycle_latency_degraded_s")
         self.ttfl = self.registry.histogram("time_to_first_lease_s")
         self.ingest_lag = self.registry.histogram("ingest_visible_lag_s")
+        # RTO: crash (or kill) -> the restarted plane's first completed
+        # scheduling cycle.  Fed by the crash drills (loadgen/soak kill leg,
+        # chaos_cycle --crash) and by serve restarts that restore from a
+        # checkpoint -- recovery time is an SLO distribution, not a
+        # pass/fail drill.
+        self.restart = self.registry.histogram("restart_recovery_s")
         self.submitted = self.registry.counter("jobs_submitted")
         self.leased = self.registry.counter("jobs_first_leased")
         self.track_overflow = self.registry.counter("tracking_overflow")
@@ -104,6 +110,10 @@ class SLORecorder:
             for jid in job_ids:
                 self._await_visible.pop(jid, None)
                 self._await_lease.pop(jid, None)
+
+    def observe_restart(self, duration_s: float) -> None:
+        """One crash-to-serving recovery (RTO sample)."""
+        self.restart.record(duration_s)
 
     def observe_cycle(self, duration_s: float, degraded: Optional[bool] = None) -> None:
         """One scheduling cycle's wall time.  ``degraded`` defaults to the

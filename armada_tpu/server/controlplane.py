@@ -40,6 +40,12 @@ class ControlPlaneServer:
         self._publisher = publisher
         self._auth = authorizer or ActionAuthorizer()
         self._clock = clock
+        # Checkpoint verbs (armadactl checkpoint): plane-LOCAL hooks wired
+        # by serve -- a snapshot is one replica's recovery artifact, so
+        # these are the single exception to "every verb publishes an
+        # event".  None = this plane has no checkpoint surface.
+        self.checkpoint_trigger: Optional[Callable[[], dict]] = None
+        self.checkpoint_status: Optional[Callable[[], dict]] = None
 
     def _publish(self, event: pb.Event, user: str) -> None:
         event.created_ns = int(self._clock() * 1e9)
@@ -98,6 +104,28 @@ class ControlPlaneServer:
             ),
             principal.name,
         )
+
+    # --- checkpoints (scheduler/checkpoint.py; plane-local) -----------------
+
+    def trigger_checkpoint(self, principal: Principal = Principal()) -> dict:
+        """Snapshot the plane's materialized state now; returns the written
+        checkpoint's identity.  Operator-gated like the cordon verbs."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        if self.checkpoint_trigger is None:
+            raise SubmitError("this plane has no checkpoint surface")
+        return self.checkpoint_trigger()
+
+    def get_checkpoint_status(
+        self, principal: Principal = Principal()
+    ) -> dict:
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        if self.checkpoint_status is None:
+            raise SubmitError("this plane has no checkpoint surface")
+        return self.checkpoint_status()
 
     # --- mass actions (executor.go PreemptOnExecutor / CancelOnExecutor) ----
 

@@ -605,6 +605,99 @@ def _soak_bench() -> dict:
     return out
 
 
+def _restart_bench() -> dict:
+    """ARMADA_BENCH_RESTART (default on; =0 skips): bounded-replay restart
+    cost (scheduler/checkpoint.py).  Builds a serving store from a synthetic
+    event backlog, checkpoints, appends a suffix of further events, wipes
+    the store, and times snapshot-restore + suffix-only replay -- the RTO
+    path `serve` runs after a crash.  Replayed-sequence counts ride along
+    so a regression in the FENCE (replaying more than the suffix) is
+    legible without timing.  ARMADA_BENCH_RESTART_EVENTS downscales."""
+    import tempfile
+    import uuid
+
+    from armada_tpu.eventlog import EventLog
+    from armada_tpu.eventlog.publisher import Publisher
+    from armada_tpu.events import events_pb2 as pb
+    from armada_tpu.ingest.converter import convert_sequences
+    from armada_tpu.ingest.pipeline import IngestionPipeline
+    from armada_tpu.ingest.schedulerdb import SchedulerDb
+    from armada_tpu.scheduler.checkpoint import (
+        CheckpointManager,
+        maybe_restore,
+        snapshot_plane,
+    )
+
+    n_base = int(os.environ.get("ARMADA_BENCH_RESTART_EVENTS", 20_000))
+    n_suffix = max(1, n_base // 10)
+
+    def _submit_batch(publisher, lo, n):
+        seqs = []
+        for i in range(lo, lo + n):
+            seqs.append(
+                pb.EventSequence(
+                    queue=f"rq{i % 8}",
+                    jobset="restart-bench",
+                    events=[
+                        pb.Event(
+                            created_ns=i + 1,
+                            submit_job=pb.SubmitJob(
+                                job_id=uuid.uuid4().hex,
+                                spec=pb.JobSpec(priority_class="default"),
+                            ),
+                        )
+                    ],
+                )
+            )
+        publisher.publish(seqs)
+
+    with tempfile.TemporaryDirectory(prefix="armada-bench-restart-") as d:
+        log = EventLog(os.path.join(d, "log"), num_partitions=2)
+        db = SchedulerDb(os.path.join(d, "scheduler.db"))
+        publisher = Publisher(log)
+        pipe = IngestionPipeline(
+            log, db, convert_sequences, consumer_name="scheduler"
+        )
+        _submit_batch(publisher, 0, n_base)
+        pipe.run_until_caught_up()
+        mgr = CheckpointManager(os.path.join(d, "checkpoints"))
+        t0 = time.perf_counter()
+        mgr.write(snapshot_plane(db))
+        snapshot_s = time.perf_counter() - t0
+        _submit_batch(publisher, n_base, n_suffix)
+        db.close()
+        os.remove(os.path.join(d, "scheduler.db"))
+        t0 = time.perf_counter()
+        db2 = SchedulerDb(os.path.join(d, "scheduler.db"))
+        restored = maybe_restore(db2, mgr)
+        pipe2 = IngestionPipeline(
+            log,
+            db2,
+            convert_sequences,
+            consumer_name="scheduler",
+            start_positions=db2.positions("scheduler"),
+        )
+        replayed = pipe2.run_until_caught_up()
+        restart_s = time.perf_counter() - t0
+        jobs_after = len(db2.fetch_job_updates(0, 0)[0])
+        db2.close()
+        log.close()
+    print(
+        f"bench: restart arm snapshot {snapshot_s:.3f}s, restore+replay "
+        f"{restart_s:.3f}s ({replayed}/{n_base + n_suffix} sequences "
+        f"replayed)",
+        file=sys.stderr,
+    )
+    return {
+        "restart_replay_s": round(restart_s, 4),
+        "restart_snapshot_s": round(snapshot_s, 4),
+        "restart_replayed_sequences": replayed,
+        "restart_total_sequences": n_base + n_suffix,
+        "restart_restored": bool(restored.get("restored")),
+        "restart_jobs": jobs_after,
+    }
+
+
 def main():
     from armada_tpu.core.pipeline import pipeline_enabled as _pipeline_enabled
 
@@ -713,6 +806,8 @@ def main():
         )
     if os.environ.get("ARMADA_BENCH_SOAK", "1") != "0":
         line.update(_soak_bench())
+    if os.environ.get("ARMADA_BENCH_RESTART", "1") != "0":
+        line.update(_restart_bench())
     if init_err is not None:
         line["backend_fallback"] = init_err
     watchdog.cancel()

@@ -1,0 +1,27 @@
+# Fixture for rule `atomic-state-file` (linted under armada_tpu/).
+import os
+
+from armada_tpu.core import statefile
+
+
+def save_cursor_bad(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # TP
+
+
+def save_cursor_ok(path, obj):
+    # near-miss: the shared helper owns the whole atomic sequence
+    statefile.write_json(path, obj)
+
+
+def prune_old(path):
+    # near-miss: deletion is not an atomic-write pattern
+    os.remove(path)
+
+
+def relocate_within_python(paths, idx):
+    # near-miss: a list method named like the os call
+    paths.replace = None
+    return paths

@@ -89,6 +89,29 @@ jobs:
     )
 
 
+def test_cli_checkpoint_trigger_and_status(plane, capsys):
+    """`armadactl checkpoint` + `--status`: the operator trigger for
+    durable snapshots (scheduler/checkpoint.py) through the real gRPC
+    surface."""
+    import json
+    import os
+
+    assert ctl(plane, "checkpoint") == 0
+    out = capsys.readouterr().out
+    assert "checkpoint written" in out and "ckpt-" in out
+    # the snapshot file exists and is the manager's newest
+    loaded = plane.checkpoint_manager.load_newest()
+    assert loaded is not None
+    payload, path = loaded
+    assert os.path.exists(path) and payload["db"]["consumer_positions"] is not None
+
+    assert ctl(plane, "checkpoint", "--status") == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["epoch"] == 0
+    assert status["checkpoint"]["snapshot"]["path"] == path
+    assert status["checkpoint"]["count"] >= 1
+
+
 def test_cli_cancel_and_reprioritize(plane, tmp_path, capsys):
     ctl(plane, "queue", "create", "ops")
     sub = tmp_path / "job.yaml"

@@ -123,6 +123,31 @@ def test_fault_hang_is_bounded(monkeypatch):
     assert 0.15 <= time.monotonic() - t0 < 5.0
 
 
+def test_fault_exit_mode_is_a_real_kill():
+    """`exit` mode dies like a power loss: no handlers, no finally, exit
+    status 137 -- only meaningful in subprocess crash drills, where the
+    parent observes the kill and restarts."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['ARMADA_FAULT'] = 'siteX:exit'\n"
+        "from armada_tpu.core import faults\n"
+        "try:\n"
+        "    faults.check('siteX')\n"
+        "finally:\n"
+        "    print('finally-ran')\n"
+        "print('survived')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=120
+    )
+    assert proc.returncode == 137
+    assert b"survived" not in proc.stdout
+    assert b"finally-ran" not in proc.stdout  # _exit skips unwinding
+
+
 def test_run_with_deadline():
     assert watchdog.run_with_deadline(lambda: 42, 5.0) == 42
     with pytest.raises(ValueError):
@@ -631,18 +656,22 @@ def test_scheduler_run_loop_survives_cycle_failure(monkeypatch):
 
     calls = []
 
-    class FakeScheduler:
-        from armada_tpu.scheduler.scheduler import Scheduler as _S
+    from armada_tpu.scheduler.scheduler import CycleResult, Scheduler
 
+    class FakeScheduler:
         _clock = staticmethod(time.time)
+        # the loop's post-cycle checkpoint hook: real method, disabled
+        # config (checkpointer=None short-circuits)
+        checkpointer = None
+        checkpoint_interval_s = 0.0
+        _maybe_checkpoint = Scheduler._maybe_checkpoint
 
         def cycle(self, schedule=True):
             calls.append(schedule)
             if len(calls) < 3:
                 raise Boom("transient")
             stop.set()
-
-    from armada_tpu.scheduler.scheduler import Scheduler
+            return CycleResult()
 
     stop = threading.Event()
     fake = FakeScheduler()

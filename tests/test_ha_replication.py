@@ -79,8 +79,9 @@ def test_replicator_produces_identical_log(tmp_path):
 
 
 def test_replicator_halts_on_divergence(tmp_path):
-    """A local log that is NOT a prefix of the leader's must halt loudly:
-    auto-repair would silently drop committed local records."""
+    """Without acked-position knowledge (min_acked=None), a local log that
+    is NOT a prefix of the leader's must halt loudly: auto-repair would
+    silently drop committed local records."""
     leader_log = EventLog(str(tmp_path / "leader"), num_partitions=1)
     local = EventLog(str(tmp_path / "local"), num_partitions=1)
     fill(leader_log, 5, "a")
@@ -95,6 +96,79 @@ def test_replicator_halts_on_divergence(tmp_path):
     rep.start()
     try:
         assert wait_for(rep.diverged.is_set, timeout_s=5)
+    finally:
+        rep.stop()
+        server.stop(0)
+        leader_log.close()
+        local.close()
+
+
+def test_divergence_truncates_unacked_suffix_and_resumes(tmp_path):
+    """The classic failover divergence: this replica led once, kept an
+    UNACKED tail the new leader never saw.  With min_acked wired, the
+    replicator truncates back to the last common prefix and resumes
+    tailing -- no operator wipe, no halt."""
+    leader_log = EventLog(str(tmp_path / "leader"), num_partitions=1)
+    local = EventLog(str(tmp_path / "local"), num_partitions=1)
+    # shared history, then a local-only suffix (our deposed-leader tail)
+    for i in range(4):
+        payload = f"shared-{i}".encode()
+        leader_log.append(0, b"k", payload)
+        local.append(0, b"k", payload)
+    acked_at = local.end_offset(0)
+    local.append(0, b"k", b"local-only-unstreamed-tail")
+    # the new leader moved on with ITS own suffix
+    fill(leader_log, 3, "new-lineage")
+    server, port = make_server(replication_log=leader_log)
+    rep = LogReplicator(
+        local,
+        leader_address=lambda: f"127.0.0.1:{port}",
+        client_factory=ReplicationClient,
+        poll_interval_s=0.02,
+        idle_timeout_s=1.0,
+        min_acked=lambda: {0: acked_at},  # views never read past the prefix
+    )
+    rep.start()
+    try:
+        ends = {0: leader_log.end_offset(0)}
+        assert wait_for(lambda: rep.caught_up_to(ends), timeout_s=10)
+        assert logs_equal(leader_log, local)
+        assert rep.truncations == 1
+        assert not rep.diverged.is_set()
+        status = rep.status()
+        assert status["truncations"] == 1 and not status["diverged"]
+        assert status["lag_bytes"] == 0
+    finally:
+        rep.stop()
+        server.stop(0)
+        leader_log.close()
+        local.close()
+
+
+def test_divergence_with_acked_suffix_still_halts(tmp_path):
+    """A divergent suffix a local view ALREADY CONSUMED cannot be
+    truncated away (the view would hold state the new lineage never had):
+    replication must halt for the operator's truncate-vs-wipe decision."""
+    leader_log = EventLog(str(tmp_path / "leader"), num_partitions=1)
+    local = EventLog(str(tmp_path / "local"), num_partitions=1)
+    for i in range(2):
+        payload = f"shared-{i}".encode()
+        leader_log.append(0, b"k", payload)
+        local.append(0, b"k", payload)
+    local.append(0, b"k", b"local-only-but-CONSUMED")
+    fill(leader_log, 2, "new-lineage")
+    server, port = make_server(replication_log=leader_log)
+    rep = LogReplicator(
+        local,
+        leader_address=lambda: f"127.0.0.1:{port}",
+        client_factory=ReplicationClient,
+        poll_interval_s=0.02,
+        min_acked=lambda: {0: local.end_offset(0)},  # consumed to the end
+    )
+    rep.start()
+    try:
+        assert wait_for(rep.diverged.is_set, timeout_s=5)
+        assert rep.truncations == 0
     finally:
         rep.stop()
         server.stop(0)

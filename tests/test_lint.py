@@ -46,6 +46,10 @@ RULE_FIXTURES = {
         "queued_version_write.py",
         "armada_tpu/fixture.py",
     ),
+    "atomic-state-file": (
+        "atomic_state_file.py",
+        "armada_tpu/fixture.py",
+    ),
 }
 
 

@@ -621,6 +621,45 @@ def test_lookout_views_and_prune(lookout_db):
     assert q.get_job_details("j0") is not None
 
 
+def test_exactly_once_under_injected_ack_crash(db, tmp_path, monkeypatch):
+    """Crash injected BETWEEN the batch's transactional commit and the
+    in-memory cursor ack (ARMADA_FAULT=ingest_ack -- the window the
+    exactly-once design exists for), on every store backend.  Both recovery
+    shapes must hold: a RESTARTED pipeline resumes from the store's
+    committed positions and replays nothing; a SURVIVING consumer re-polls
+    the same batch and re-stores it idempotently with the same cursors."""
+    from armada_tpu.core import faults
+    from armada_tpu.eventlog import EventLog, Publisher
+    from armada_tpu.ingest import scheduler_ingestion_pipeline
+
+    _wipe(db)
+    with EventLog(str(tmp_path / "log-ack"), num_partitions=1) as log:
+        pub = Publisher(log)
+        pipe = scheduler_ingestion_pipeline(log, db)
+        pub.publish([seq(events=[submit("a1")])])
+        faults.reset_counters()
+        monkeypatch.setenv("ARMADA_FAULT", "ingest_ack:error")
+        with pytest.raises(faults.FaultInjected):
+            pipe.run_once()
+        monkeypatch.delenv("ARMADA_FAULT")
+        committed = db.positions("scheduler")
+        assert committed[0] > 0  # the batch + cursor landed in one txn
+        # restart shape: a fresh pipeline resumes PAST the crashed batch
+        pipe2 = scheduler_ingestion_pipeline(log, db)
+        assert pipe2.run_until_caught_up() == 0
+        # survivor shape: the old consumer's in-memory position is stale;
+        # its re-poll re-stores the batch idempotently
+        assert pipe.run_until_caught_up() == 1
+        jobs, _ = db.fetch_job_updates(0, 0)
+        assert [r["job_id"] for r in jobs] == ["a1"]  # exactly once
+        assert db.positions("scheduler") == committed
+        # later events flow normally through either consumer
+        pub.publish([seq(events=[submit("a2")])])
+        assert pipe2.run_until_caught_up() == 1
+        jobs, _ = db.fetch_job_updates(0, 0)
+        assert {r["job_id"] for r in jobs} == {"a1", "a2"}
+
+
 def test_exactly_once_restart_resume(db):
     """A crash between apply and position-commit cannot double-apply: ops +
     positions land in ONE transaction (store), so replay from the committed

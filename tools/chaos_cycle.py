@@ -178,6 +178,14 @@ def main() -> int:
         "distribution; armada_tpu/loadgen/soak.py; ARMADA_SOAK_WINDOW_S "
         "downscales)",
     )
+    ap.add_argument(
+        "--crash",
+        action="store_true",
+        help="additionally run the kill/restart drill under load: mid-soak "
+        "checkpoint -> wipe the materialized store -> rebuild from snapshot "
+        "+ log-suffix replay; asserts zero dropped/double-leased jobs, zero "
+        "tsan violations, and reports RTO (restart_recovery_s)",
+    )
     args = ap.parse_args()
 
     rng = random.Random(args.seed)
@@ -234,12 +242,28 @@ def main() -> int:
         with tempfile.TemporaryDirectory(prefix="chaos-soak-") as d:
             soak_report = run_soak(cfg, d)
 
+    crash_report = None
+    if args.crash:
+        import tempfile
+
+        from armada_tpu.loadgen.soak import SoakConfig, run_soak
+
+        ccfg = SoakConfig.from_env(
+            window_s=float(os.environ.get("ARMADA_SOAK_WINDOW_S", 20.0)),
+            target_eps=float(os.environ.get("ARMADA_SOAK_RATE", 100.0)),
+            seed=args.seed,
+            crash_at_frac=0.5,
+        )
+        with tempfile.TemporaryDirectory(prefix="chaos-crash-") as d:
+            crash_report = run_soak(ccfg, d)
+
     ok = (
         chaotic == clean
         and snap["fallbacks"] >= 1
         and promoted
         and not tsan_found
         and (soak_report is None or soak_report["ok"])
+        and (crash_report is None or crash_report["ok"])
     )
     line = {
         "tool": "chaos_cycle",
@@ -274,6 +298,13 @@ def main() -> int:
         line["soak"]["degraded_p99_s"] = soak_report.get("slo_degraded", {}).get(
             "p99_s"
         )
+    if crash_report is not None:
+        line["crash"] = {
+            "ok": crash_report["ok"],
+            "violations": crash_report["violations"],
+            "tsan_violations": crash_report.get("tsan_violations", 0),
+            **(crash_report.get("crash") or {}),
+        }
     if not ok and chaotic != clean:
         for i, (a, b) in enumerate(zip(chaotic, clean)):
             if a != b:

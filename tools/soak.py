@@ -56,6 +56,17 @@ def main() -> int:
         help="round deadline while a fault is armed",
     )
     ap.add_argument(
+        "--crash",
+        nargs="?",
+        const=0.5,
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="mid-soak kill/restart leg: checkpoint, wipe the materialized "
+        "store, rebuild from snapshot + log-suffix replay at FRAC of the "
+        "window (default 0.5); RTO lands in restart_recovery_s",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="JSON-line output (the default; kept for bench.py symmetry)",
@@ -81,6 +92,7 @@ def main() -> int:
             fault=args.fault,
             fault_at_frac=args.fault_at,
             watchdog_s=args.watchdog_s,
+            crash_at_frac=args.crash,
             **overrides,
         )
     )
