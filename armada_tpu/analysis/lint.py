@@ -515,6 +515,10 @@ def _wallclock_event_order(src: Source):
 _SLO_MODULES = (
     "armada_tpu/ops/metrics.py",
     "armada_tpu/scheduler/slo.py",
+    # The cycle-trace recorder: span timestamps feed the same latency
+    # surfaces (stage histograms, bench stage_*_s, Perfetto timelines), so
+    # a second clock source here would skew every correlated view.
+    "armada_tpu/ops/trace.py",
 )
 
 

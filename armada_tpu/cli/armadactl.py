@@ -490,6 +490,47 @@ def cmd_checkpoint(args):
     return 0
 
 
+def cmd_trace(args):
+    """Dump the plane's cycle traces (ops/trace.py ring) as Chrome
+    trace-event JSON: `armadactl trace -o cycle.json`, open in Perfetto.
+    The conversion runs client-side off the wire's offset-form span trees,
+    so the same exporter (ops/trace.chrome_trace) serves this verb,
+    tools/trace_dump.py and the tests."""
+    import json
+
+    from armada_tpu.ops.trace import chrome_trace, top_spans
+
+    client = _client(args)
+    try:
+        dump = client.dump_trace()
+    finally:
+        client.close()
+    traces = dump.get("traces", [])
+    if args.summary:
+        if not traces:
+            print("no cycle traces recorded yet")
+            return 0
+        t = traces[-1]
+        print(f"trace {t.get('trace_id')} kind={t.get('kind')} "
+              f"duration={t.get('duration_s', 0):.4f}s")
+        for s in top_spans(t.get("root", {}), n=15):
+            print(f"  {s['dur_s']:9.4f}s {'  ' * s['depth']}{s['name']}")
+        return 0
+    doc = dump if args.raw else chrome_trace(traces)
+    text = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(
+            f"wrote {len(traces)} cycle trace(s) to {args.out} "
+            "(open in https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+    else:
+        print(text)
+    return 0
+
+
 def _reject_mismatched_scope_flags(args, states_flag: bool = False) -> bool:
     """A filter flag that does not apply to the chosen target must ERROR,
     not silently widen a mass destructive action past the operator's
@@ -1161,6 +1202,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="print durability status JSON instead of triggering",
     )
     ck.set_defaults(fn=cmd_checkpoint)
+
+    tr = sub.add_parser(
+        "trace",
+        help="dump the serving plane's last cycles as Chrome trace-event "
+        "JSON (load in Perfetto/chrome://tracing); --summary for the "
+        "last cycle's top spans",
+    )
+    tr.add_argument(
+        "--summary",
+        action="store_true",
+        help="print the /healthz-style top-span summary instead of the "
+        "full Chrome trace JSON",
+    )
+    tr.add_argument(
+        "--raw",
+        action="store_true",
+        help="print the raw offset-form span trees (the wire shape) "
+        "instead of Chrome trace JSON",
+    )
+    tr.add_argument(
+        "-o",
+        "--out",
+        default="",
+        help="write to a file instead of stdout",
+    )
+    tr.set_defaults(fn=cmd_trace)
 
     return p
 

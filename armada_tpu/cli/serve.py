@@ -498,6 +498,9 @@ def start_control_plane(
 
         health_server.slo_status = _slo_recorder().snapshot
         health_server.durability_status = scheduler.durability_status
+        from armada_tpu.ops.trace import recorder as _trace_recorder
+
+        health_server.trace_status = _trace_recorder().healthz_block
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(

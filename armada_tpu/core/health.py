@@ -176,6 +176,14 @@ class _Handler(BaseHTTPRequestHandler):
                     body["durability"] = srv.durability_status()
                 except Exception as exc:  # noqa: BLE001
                     body["durability"] = {"error": str(exc)}
+            if srv.trace_status is not None:
+                # Trace block (ops/trace.py): the last cycle's identity +
+                # top spans -- the at-a-glance "where did the cycle go"
+                # before reaching for armadactl trace + Perfetto.
+                try:
+                    body["trace"] = srv.trace_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["trace"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -248,6 +256,9 @@ class HealthServer:
         # Scheduler.durability_status: snapshot age/fence, epoch,
         # replication lag).
         self.durability_status = None
+        # Optional () -> dict: the cycle-trace block (serve wires
+        # ops/trace.recorder().healthz_block: last cycle's top spans).
+        self.trace_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -85,8 +85,20 @@ def run_with_deadline(fn: Callable, deadline_s: float, what: str = "device round
     pass work whose host-side mutations are safe to abandon mid-flight
     (see models.run_round_on_device for the exact discipline)."""
     box: dict = {}
+    # Cycle-trace adoption (ops/trace.py): the worker's spans (kernel
+    # dispatch, fetch, shadow thunks) nest under the CALLER's open span,
+    # exactly like the inline path -- without this they'd flatten onto the
+    # cycle root and double-count as stages while the caller's round span
+    # covers the same wall time.  The handle carries the owning trace so
+    # an ABANDONED worker that unwedges after its cycle finalized records
+    # nothing (the recorder's zombie guard).
+    from armada_tpu.ops.trace import recorder as _trace_recorder
+
+    _rec = _trace_recorder()
+    _trace_handle = _rec.capture() if _rec.enabled else None
 
     def _worker():
+        _rec.adopt(_trace_handle)
         try:
             box["result"] = fn()
         except BaseException as e:  # noqa: BLE001 - transported to caller

@@ -37,13 +37,17 @@ class _ShadowOnce:
         self._lock = make_lock("models.shadow_once")
 
     def run_pending(self) -> None:
+        from armada_tpu.ops.trace import recorder as _trace
+
         while True:
             with self._lock:
                 if self._next >= len(self._thunks):
                     return
                 fn = self._thunks[self._next]
+                idx = self._next
                 self._next += 1
-            fn()
+            with _trace().span("shadow_thunk", index=idx):
+                fn()
 
 
 def run_round_on_device(
@@ -141,13 +145,23 @@ def run_round_on_device(
         # code bug -- degrading on it would hide the bug behind a
         # spuriously-working CPU re-run (and drop every device cache for
         # nothing), so it propagates untouched.
-        sup.record_failure(f"{type(e).__name__}: {e}")
+        reason = f"{type(e).__name__}: {e}"
+        sup.record_failure(reason)
         hp = host_problem() if callable(host_problem) else host_problem
         if hp is None and hasattr(problem, "_fields"):
             hp = problem
         if hp is None:
             raise  # no host tables to fail over from (legacy caller)
-        return _run_round_cpu_failover(hp, ctx, config, kernel_kwargs, shadow)
+        # Failover attribution (ops/trace.py): tag the CYCLE that paid the
+        # failover window -- the same cycle the SLO layer's fallback-delta
+        # rule files as degraded -- and record the re-run as its own span.
+        from armada_tpu.ops.trace import recorder as _trace
+
+        _trace().annotate(degraded=True, failover_reason=reason[:300])
+        with _trace().span("cpu_failover", reason=reason[:300]):
+            return _run_round_cpu_failover(
+                hp, ctx, config, kernel_kwargs, shadow
+            )
 
 
 def _run_round_cpu_failover(host_problem, ctx, config, kernel_kwargs, shadow):
@@ -172,15 +186,24 @@ def _round_body(device_problem, ctx, config, kernel_kwargs, shadow):
     import jax.numpy as jnp
     import numpy as _np
 
-    result = schedule_round(device_problem, **kernel_kwargs)
+    from armada_tpu.ops.trace import recorder as _trace
+
+    trace = _trace()
+    with trace.span("kernel_dispatch"):
+        result = schedule_round(device_problem, **kernel_kwargs)
     # Overlapped decode (begin_decode): the compaction + its device->host
     # copy are enqueued behind the kernel with no host sync in between, so
     # the transfer streams as soon as the kernel finishes -- a blocking
     # decode_result here paid one extra tunnel round trip (~65ms) per round
     # in the serve/sidecar paths (the bench loop already did this).
-    finish = begin_decode(result, ctx)
-    shadow.run_pending()
-    outcome = finish()
+    with trace.span("decode_dispatch"):
+        finish = begin_decode(result, ctx)
+    with trace.span("shadow"):
+        shadow.run_pending()
+    # The fetch span is where kernel + transfer latency surfaces: the
+    # dispatch spans above are async enqueues, this is the blocking wait.
+    with trace.span("fetch_decode"):
+        outcome = finish()
 
     # Gang-txn rollback (nodedb.go:347 ScheduleManyWithTxn: a gang is one txn,
     # all-or-nothing): if a split gang's sibling placed but another sub-gang
@@ -225,11 +248,12 @@ def _round_body(device_problem, ctx, config, kernel_kwargs, shadow):
         if not kill:
             break
         attempts += 1
-        g_valid = _np.asarray(device_problem.g_valid).copy()
-        g_valid[_np.asarray(sorted(set(kill)), _np.int64)] = False
-        device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
-        result = schedule_round(device_problem, **kernel_kwargs)
-        outcome = begin_decode(result, ctx)()
+        with trace.span("gang_rerun", attempt=attempts, killed=len(set(kill))):
+            g_valid = _np.asarray(device_problem.g_valid).copy()
+            g_valid[_np.asarray(sorted(set(kill)), _np.int64)] = False
+            device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
+            result = schedule_round(device_problem, **kernel_kwargs)
+            outcome = begin_decode(result, ctx)()
     if attempts >= 4:
         # Attempt-cap backstop: never report a half-preempted running gang.
         # Force the retained members into the preempted set -- their freed

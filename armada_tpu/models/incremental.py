@@ -57,6 +57,7 @@ from armada_tpu.models.problem import (
     SchedulingProblem,
     _pad,
 )
+from armada_tpu.ops.trace import recorder as _trace
 
 _INF = np.float32(3.0e38)
 _ID_DTYPE = "S48"
@@ -263,37 +264,64 @@ class _SortedTable:
         reqs: list[np.ndarray],
         atoms: Optional[list[np.ndarray]] = None,
     ) -> None:
-        """rows: per-row dict of every column value (ids as bytes).  O(batch
-        log n) position search + one small np.insert per column on the
-        OVERLAY region only; the base never copies here."""
+        """rows: per-row dict of every column value (ids as bytes).  Thin
+        adapter over :meth:`insert_batch_cols` (kept for the small-batch
+        callers -- the run table's lease_many, the gang path); the hot
+        submit feed builds columns directly and skips the dicts."""
         if not rows:
             return
-        scols = self.sort_cols
-        order = sorted(
-            range(len(rows)),
-            key=lambda i: tuple(rows[i][c] for c in scols),
+        cols = {
+            c: [r.get(c, True if c == "alive" else 0) for r in rows]
+            for c in self._cols()
+        }
+        self.insert_batch_cols(
+            cols,
+            np.stack(reqs),
+            np.stack(atoms) if atoms is not None else None,
         )
-        rows = [rows[i] for i in order]
-        reqs = [reqs[i] for i in order]
-        k = len(rows)
+
+    def insert_batch_cols(
+        self,
+        cols: Mapping,
+        reqs: np.ndarray,
+        atoms: Optional[np.ndarray] = None,
+    ) -> None:
+        """Columnar insert: ``cols`` maps every column name (``_cols()``)
+        to a length-k sequence, ``reqs`` is [k, R].  O(batch log n)
+        position search + one small np.insert per column on the OVERLAY
+        region only; the base never copies here.
+
+        The round-12 vectorization of the submit feed's row build
+        (docs/bench.md r12): the per-row dict construction, the python
+        tuple-key sort and the per-column list comprehensions were ~40% of
+        submit_many at 1k-spec batches; columns arrive as flat lists, the
+        sort is one np.lexsort, and each column materializes with a single
+        np.asarray + fancy-index."""
+        scols = self.sort_cols
+        typed = {
+            c: np.asarray(cols[c], getattr(self, c).dtype)
+            for c in self._cols()
+        }
+        k = typed["ids"].shape[0]
+        if k == 0:
+            return
+        # np.lexsort keys: LAST key is primary, so feed the sort columns
+        # reversed.  Stable, like the python sorted() it replaces.
+        order = np.lexsort(tuple(typed[c] for c in reversed(scols)))
+        typed = {c: v[order] for c, v in typed.items()}
+        reqs = np.asarray(reqs, np.float32)[order]
+        if atoms is not None:
+            atoms = np.asarray(atoms, np.int64)[order]
         self._live_cache = None
         if self.n == 0:
             # Bulk-load fast path (initial backlog fill): the sorted batch IS
             # the (base) table.
             self._ensure_cap(k)
             for c in self._cols():
-                col = getattr(self, c)
-                col[:k] = np.array(
-                    [r.get(c, True if c == "alive" else 0) for r in rows],
-                    col.dtype,
-                )
-            self.req[:k] = np.stack(reqs)
+                getattr(self, c)[:k] = typed[c]
+            self.req[:k] = reqs
             if self.atoms is not None:
-                self.atoms[:k] = (
-                    np.stack([atoms[i] for i in order])
-                    if atoms is not None
-                    else 0
-                )
+                self.atoms[:k] = atoms if atoms is not None else 0
             self.n = self.sorted_n = k
         else:
             # Batched binary refinement (_lex_equal_ranges): the probe batch
@@ -302,13 +330,10 @@ class _SortedTable:
             # searchsorted calls instead of ~10 scalar dispatches per row
             # (measured 15.5 -> 9.0ms per 1k-row batch at 1M rows, r10).
             sn = self.sorted_n
-            cols = [getattr(self, c) for c in scols]
-            vals_by_col = [
-                np.asarray([r[c] for r in rows], col.dtype)
-                for c, col in zip(scols, cols)
-            ]
+            base_cols = [getattr(self, c) for c in scols]
+            vals_by_col = [typed[c] for c in scols]
             base_pos, _ = _lex_equal_ranges(
-                cols,
+                base_cols,
                 vals_by_col,
                 np.zeros((k,), np.int64),
                 np.full((k,), sn, np.int64),
@@ -324,7 +349,7 @@ class _SortedTable:
             need = np.flatnonzero(olo != ohi)
             if need.size:
                 plo, _ = _lex_equal_ranges(
-                    cols,
+                    base_cols,
                     [v[need] for v in vals_by_col],
                     sn + olo[need],
                     sn + ohi[need],
@@ -334,29 +359,28 @@ class _SortedTable:
             end = self.n
             for c in self._cols():
                 col = getattr(self, c)
-                vals = np.array(
-                    [r.get(c, True if c == "alive" else 0) for r in rows],
-                    col.dtype,
-                )
-                col[sn : end + k] = np.insert(col[sn:end], ov_ins, vals)
+                col[sn : end + k] = np.insert(col[sn:end], ov_ins, typed[c])
             self.req[sn : end + k] = np.insert(
-                self.req[sn:end], ov_ins, np.stack(reqs), axis=0
+                self.req[sn:end], ov_ins, reqs, axis=0
             )
             if self.atoms is not None:
-                vals = (
-                    np.stack([atoms[i] for i in order])
-                    if atoms is not None
-                    else np.zeros((k, self.R), np.int64)
-                )
                 self.atoms[sn : end + k] = np.insert(
-                    self.atoms[sn:end], ov_ins, vals, axis=0
+                    self.atoms[sn:end],
+                    ov_ins,
+                    atoms
+                    if atoms is not None
+                    else np.zeros((k, self.R), np.int64),
+                    axis=0,
                 )
             self.ov_pos = np.insert(ov_pos, ov_ins, base_pos)
             self.n += k
             if self.n - self.sorted_n > max(2048, self.sorted_n // 16):
                 self._merge_overlay()
-        for r in rows:
-            self.key_of_id[r["ids"]] = tuple(r[c] for c in scols[:-1])
+        # key_of_id values stay python-typed (tolist), matching the scalar
+        # insert path -- _find_in_region coerces probes per column anyway.
+        key_lists = [typed[c].tolist() for c in scols[:-1]]
+        for jid, *key in zip(typed["ids"].tolist(), *key_lists):
+            self.key_of_id[jid] = tuple(key)
 
     def _merge_overlay(self) -> None:
         """Fold the overlay into the base: one vectorized np.insert per
@@ -572,6 +596,9 @@ class IncrementalBuilder:
         self.level_of_priority = {p: i + 2 for i, p in enumerate(self.ladder)}
         self.pc_names = sorted(config.priority_classes)
         self.pc_index = {name: i for i, name in enumerate(self.pc_names)}
+        # priority-class name -> (npc, level, pc_index): the submit feed's
+        # per-spec resolution, memoized (classes are config-immutable).
+        self._pc_row_memo: dict[str, tuple] = {}
 
         self.kidx = SchedulingKeyIndex()
         self._indexed = set(config.indexed_node_labels)
@@ -884,21 +911,6 @@ class IncrementalBuilder:
                 self._indexed.add(k)
                 self._retype_needed = True
 
-    def _single_row(self, spec: JobSpec) -> dict:
-        pc = self.config.priority_class(spec.priority_class)
-        return {
-            "ids": spec.id.encode(),
-            "qi": self.queue_by_name[spec.queue],
-            "npc": -pc.priority,
-            "prio": spec.priority,
-            "sub": spec.submit_time,
-            "level": self.level_of_priority[pc.priority],
-            "pc": self.pc_index[pc.name],
-            "key": self.kidx.key_of(spec, self.config.node_id_label),
-            "band": self._band(spec.price_band),
-            "hasres": spec.resources is not None,
-        }
-
     def _batch_reqs(self, res_list: Sequence) -> np.ndarray:
         """Vectorized ceil_units over a batch of ResourceLists (None =
         zero request): ONE numpy pass for the whole batch instead of three
@@ -968,15 +980,56 @@ class IncrementalBuilder:
     def submit_many(
         self, specs: Sequence[JobSpec], banned: Optional[Mapping] = None
     ) -> None:
-        """Batched submit: one np.insert for the whole batch."""
-        rows, resl = [], []
+        with _trace().span("submit_many", pool=self.pool, n=len(specs)):
+            self._submit_many(specs, banned)
+
+    def _pc_row(self, name: str) -> tuple:
+        """(npc, level, pc_index) for a priority-class name, memoized --
+        the per-spec priority_class() resolution was a visible slice of the
+        submit feed's row build (docs/bench.md r12)."""
+        hit = self._pc_row_memo.get(name)
+        if hit is None:
+            pc = self.config.priority_class(name)
+            hit = self._pc_row_memo[name] = (
+                -pc.priority,
+                self.level_of_priority[pc.priority],
+                self.pc_index[pc.name],
+            )
+        return hit
+
+    def _submit_many(
+        self, specs: Sequence[JobSpec], banned: Optional[Mapping] = None
+    ) -> None:
+        """Batched submit: one np.insert for the whole batch.
+
+        Row building is COLUMNAR (round 12): flat per-column lists feed
+        insert_batch_cols directly -- no per-spec dict, no python tuple
+        sort -- which halved the ~15ms/1k-batch row build the trace
+        surfaced at 200k rows (docs/bench.md r12)."""
+        k0 = len(specs)
+        c_ids: list = []
+        c_qi: list = []
+        c_npc: list = []
+        c_prio: list = []
+        c_sub: list = []
+        c_level: list = []
+        c_pc: list = []
+        c_key: list = []
+        c_band: list = []
+        c_hasres: list = []
+        resl: list = []
         atoms: Optional[list] = [] if self.market else None
+        queue_by_name = self.queue_by_name
+        kidx_key_of = self.kidx.key_of
+        node_id_label = self.config.node_id_label
+        band_of = self._band
+        jobs = self.jobs
         for spec in specs:
             if spec.pools and self.pool not in spec.pools:
                 continue
             self._note_selector_labels(spec)
             bans = (banned or {}).get(spec.id, ())
-            if spec.queue not in self.queue_by_name:
+            if spec.queue not in queue_by_name:
                 self._unknown_queue[spec.id] = (spec, tuple(bans))
                 continue
             # a resubmit may switch paths (gained/lost gang or bans)
@@ -986,12 +1039,22 @@ class IncrementalBuilder:
                 self.gang_jobs[spec.id] = spec
                 if bans:
                     self.banned[spec.id] = tuple(bans)
-                self._release_single(self.jobs.remove(spec.id.encode()))
+                self._release_single(jobs.remove(spec.id.encode()))
                 continue
             jid = spec.id.encode()
-            if jid in self.jobs:
-                self._release_single(self.jobs.remove(jid))
-            rows.append(self._single_row(spec))
+            if jid in jobs:
+                self._release_single(jobs.remove(jid))
+            npc, level, pci = self._pc_row(spec.priority_class)
+            c_ids.append(jid)
+            c_qi.append(queue_by_name[spec.queue])
+            c_npc.append(npc)
+            c_prio.append(spec.priority)
+            c_sub.append(spec.submit_time)
+            c_level.append(level)
+            c_pc.append(pci)
+            c_key.append(kidx_key_of(spec, node_id_label))
+            c_band.append(band_of(spec.price_band))
+            c_hasres.append(spec.resources is not None)
             resl.append(spec.resources)
             if atoms is not None:
                 atoms.append(
@@ -999,29 +1062,44 @@ class IncrementalBuilder:
                     if spec.resources is not None
                     else np.zeros((self.R,), np.int64)
                 )
-        if not rows:
+        if not c_ids:
             return
         reqs_arr = self._batch_reqs(resl)
-        reqs = list(reqs_arr)
-        slots = self._sg.alloc(len(rows))
-        for r, s in zip(rows, slots):
-            r["slot"] = s
-        self.jobs.insert_batch(rows, reqs, atoms)
-        qis = np.array([r["qi"] for r in rows], np.int64)
-        pcs = np.array([r["pc"] for r in rows], np.int64)
+        slots = self._sg.alloc(len(c_ids))
+        jobs.insert_batch_cols(
+            {
+                "ids": c_ids,
+                "qi": c_qi,
+                "npc": c_npc,
+                "prio": c_prio,
+                "sub": c_sub,
+                "alive": np.ones((len(c_ids),), bool),
+                "level": c_level,
+                "pc": c_pc,
+                "key": c_key,
+                "band": c_band,
+                "slot": slots,
+                "hasres": c_hasres,
+            },
+            reqs_arr,
+            np.stack(atoms) if atoms else None,
+        )
+        ids_arr = np.asarray(c_ids, _ID_DTYPE)
+        qis = np.asarray(c_qi, np.int64)
+        pcs = np.asarray(c_pc, np.int64)
         self._sg.write_batch(
             slots,
-            [r["ids"] for r in rows],
+            ids_arr,
             reqs_arr,
-            level=np.array([r["level"] for r in rows], np.int32),
+            level=np.asarray(c_level, np.int32),
             queue=qis.astype(np.int32),
-            key=np.array([r["key"] for r in rows], np.int32),
+            key=np.asarray(c_key, np.int32),
             pc=pcs.astype(np.int32),
-            band=np.array([r["band"] for r in rows], np.int32),
+            band=np.asarray(c_band, np.int32),
         )
         self._ensure_g_ids()
         self._own_g_ids()
-        self._g_ids[slots] = np.array([r["ids"] for r in rows], _ID_DTYPE)
+        self._g_ids[slots] = ids_arr
         np.add.at(
             self._demand_sg,
             (qis, pcs),
@@ -1037,6 +1115,10 @@ class IncrementalBuilder:
         self._release_single(self.jobs.remove(job_id.encode()))
 
     def remove_many(self, job_ids: Sequence[str]) -> None:
+        with _trace().span("remove_many", pool=self.pool, n=len(job_ids)):
+            self._remove_many(job_ids)
+
+    def _remove_many(self, job_ids: Sequence[str]) -> None:
         """Batched remove() for the cycle's decision feedback (~1k scheduled
         jobs leave the backlog per cycle): one table pass + ONE vectorized
         demand update instead of per-job numpy scalar ops -- the builder
@@ -1085,6 +1167,10 @@ class IncrementalBuilder:
         self.lease_many([r])
 
     def lease_many(self, rs: Sequence[RunningJob]) -> None:
+        with _trace().span("lease_many", pool=self.pool, n=len(rs)):
+            self._lease_many(rs)
+
+    def _lease_many(self, rs: Sequence[RunningJob]) -> None:
         """Batched lease: one np.insert on the run table for the whole
         cycle's placements (a per-lease insert is O(run table) each)."""
         rows, resl = [], []
@@ -1332,6 +1418,22 @@ class IncrementalBuilder:
         return perm
 
     def assemble(
+        self,
+        *,
+        global_tokens=None,
+        queue_tokens=None,
+        queue_penalty: Optional[Mapping] = None,
+        away_mode: bool = False,
+    ) -> tuple[SchedulingProblem, HostContext]:
+        with _trace().span("assemble", pool=self.pool, dense=True):
+            return self._assemble(
+                global_tokens=global_tokens,
+                queue_tokens=queue_tokens,
+                queue_penalty=queue_penalty,
+                away_mode=away_mode,
+            )
+
+    def _assemble(
         self,
         *,
         global_tokens=None,
@@ -1856,6 +1958,10 @@ class IncrementalBuilder:
         return rr_cols, ev_cols
 
     def prefetch_content(self, devcache) -> int:
+        with _trace().span("prefetch_content", pool=self.pool):
+            return self._prefetch_content(devcache)
+
+    def _prefetch_content(self, devcache) -> int:
         """Shadow-pipeline stage (b): ship decision-INDEPENDENT dirty slot
         rows (new submits, caller-synced leases) to the device NOW -- while
         the current round's kernel and result transfer occupy the tunnel --
@@ -1940,6 +2046,20 @@ class IncrementalBuilder:
         self._prefetch_gen += 1
 
     def assemble_delta(
+        self,
+        *,
+        global_tokens=None,
+        queue_tokens=None,
+        queue_penalty: Optional[Mapping] = None,
+    ):
+        with _trace().span("assemble", pool=self.pool):
+            return self._assemble_delta(
+                global_tokens=global_tokens,
+                queue_tokens=queue_tokens,
+                queue_penalty=queue_penalty,
+            )
+
+    def _assemble_delta(
         self,
         *,
         global_tokens=None,

@@ -43,6 +43,7 @@ import numpy as np
 
 from armada_tpu.analysis.tsan import GenerationGuard
 from armada_tpu.models.xfer import TRANSFER_STATS
+from armada_tpu.ops.trace import recorder as _trace
 
 _ID_DTYPE = "S48"
 
@@ -383,63 +384,71 @@ class DeviceDeltaCache:
         ):
             self._sig = bundle.sig
             self._seq = bundle.seq
-            problem = bundle.materialize()
-            self._tsan.commit(tok, "apply/full-upload")
-            return self._full_upload(problem)
+            with _trace().span("devcache_apply", full_upload=True):
+                problem = bundle.materialize()
+                self._tsan.commit(tok, "apply/full-upload")
+                return self._full_upload(problem)
         self._seq = bundle.seq
 
-        G = self._prev.g_req.shape[0]
-        RJ = self._prev.run_req.shape[0]
-        kg = _pad_bucket(bundle.sg_idx.shape[0])
-        kr = _pad_bucket(bundle.rr_idx.shape[0])
-        sg_idx = np.full((kg,), G, np.int32)
-        sg_idx[: bundle.sg_idx.shape[0]] = bundle.sg_idx
-        rr_idx = np.full((kr,), RJ, np.int32)
-        rr_idx[: bundle.rr_idx.shape[0]] = bundle.rr_idx
-        sg_cols = {n: _pad_rows(bundle.sg_cols[n], kg) for n in _SG_FIELDS}
-        rr_cols = {n: _pad_rows(bundle.rr_cols[n], kr) for n in _RR_FIELDS}
-        ev_cols = {n: _pad_rows(bundle.ev_cols[n], kr) for n in _EV_FIELDS}
-        fulls = {}
-        for name, arr in bundle.fulls.items():
-            if self._host_ids.get(name) is arr:
-                continue  # unchanged object, device copy is current
-            TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
-            if name in _NODE_FIELDS:
-                # keep the reusable device copy current, else a later full
-                # upload would resurrect a stale buffer via _node_dev
-                dev = self._to_device(np.asarray(arr))
-                self._node_dev[name] = dev
-                fulls[name] = dev
+        with _trace().span(
+            "devcache_apply",
+            full_upload=False,
+            sg_rows=int(bundle.sg_idx.shape[0]),
+            rr_rows=int(bundle.rr_idx.shape[0]),
+            splice=bundle.gq_splice is not None,
+        ):
+            G = self._prev.g_req.shape[0]
+            RJ = self._prev.run_req.shape[0]
+            kg = _pad_bucket(bundle.sg_idx.shape[0])
+            kr = _pad_bucket(bundle.rr_idx.shape[0])
+            sg_idx = np.full((kg,), G, np.int32)
+            sg_idx[: bundle.sg_idx.shape[0]] = bundle.sg_idx
+            rr_idx = np.full((kr,), RJ, np.int32)
+            rr_idx[: bundle.rr_idx.shape[0]] = bundle.rr_idx
+            sg_cols = {n: _pad_rows(bundle.sg_cols[n], kg) for n in _SG_FIELDS}
+            rr_cols = {n: _pad_rows(bundle.rr_cols[n], kr) for n in _RR_FIELDS}
+            ev_cols = {n: _pad_rows(bundle.ev_cols[n], kr) for n in _EV_FIELDS}
+            fulls = {}
+            for name, arr in bundle.fulls.items():
+                if self._host_ids.get(name) is arr:
+                    continue  # unchanged object, device copy is current
+                TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
+                if name in _NODE_FIELDS:
+                    # keep the reusable device copy current, else a later full
+                    # upload would resurrect a stale buffer via _node_dev
+                    dev = self._to_device(np.asarray(arr))
+                    self._node_dev[name] = dev
+                    fulls[name] = dev
+                else:
+                    fulls[name] = np.asarray(arr)
+                self._host_ids[name] = arr
+            splice = bundle.gq_splice is not None
+            if splice:
+                rem, ins, vals = bundle.gq_splice
+                kq = _pad_bucket(max(rem.shape[0], ins.shape[0]))
+                rem_pos = np.full((kq,), G, np.int32)
+                rem_pos[: rem.shape[0]] = rem
+                ins_pos = np.full((kq,), G, np.int32)
+                ins_pos[: ins.shape[0]] = ins
+                ins_val = np.zeros((kq,), np.int32)
+                ins_val[: ins.shape[0]] = vals
+                gq_args = (rem_pos, ins_pos, ins_val)
+                self.splice_applies += 1
             else:
-                fulls[name] = np.asarray(arr)
-            self._host_ids[name] = arr
-        splice = bundle.gq_splice is not None
-        if splice:
-            rem, ins, vals = bundle.gq_splice
-            kq = _pad_bucket(max(rem.shape[0], ins.shape[0]))
-            rem_pos = np.full((kq,), G, np.int32)
-            rem_pos[: rem.shape[0]] = rem
-            ins_pos = np.full((kq,), G, np.int32)
-            ins_pos[: ins.shape[0]] = ins
-            ins_val = np.zeros((kq,), np.int32)
-            ins_val[: ins.shape[0]] = vals
-            gq_args = (rem_pos, ins_pos, ins_val)
-            self.splice_applies += 1
-        else:
-            gq_args = ()
-        for arr in (sg_idx, rr_idx, *gq_args):
-            TRANSFER_STATS.count_up(arr.nbytes)
-        for cols in (sg_cols, rr_cols, ev_cols):
-            for arr in cols.values():
+                gq_args = ()
+            for arr in (sg_idx, rr_idx, *gq_args):
                 TRANSFER_STATS.count_up(arr.nbytes)
-        if _APPLY is None:
-            _APPLY = _make_apply()
-        self._tsan.commit(tok, "apply/scatter")
-        self._prev = _APPLY(
-            self._prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls,
-            gq_args, ev_base=bundle.ev_base, splice=splice,
-        )
-        return self._prev
+            for cols in (sg_cols, rr_cols, ev_cols):
+                for arr in cols.values():
+                    TRANSFER_STATS.count_up(arr.nbytes)
+            if _APPLY is None:
+                _APPLY = _make_apply()
+            self._tsan.commit(tok, "apply/scatter")
+            self._prev = _APPLY(
+                self._prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls,
+                gq_args, ev_base=bundle.ev_base, splice=splice,
+            )
+            return self._prev
 
     def scatter_content(
         self, *, sig, seq, ev_base, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols
@@ -472,28 +481,33 @@ class DeviceDeltaCache:
             or seq != self._seq + 1
         ):
             return False
-        G = self._prev.g_req.shape[0]
-        RJ = self._prev.run_req.shape[0]
-        kg = _pad_bucket(sg_idx.shape[0])
-        kr = _pad_bucket(rr_idx.shape[0])
-        sg_pad = np.full((kg,), G, np.int32)
-        sg_pad[: sg_idx.shape[0]] = sg_idx
-        rr_pad = np.full((kr,), RJ, np.int32)
-        rr_pad[: rr_idx.shape[0]] = rr_idx
-        sg_cols = {n: _pad_rows(sg_cols[n], kg) for n in _SG_FIELDS}
-        rr_cols = {n: _pad_rows(rr_cols[n], kr) for n in _RR_FIELDS}
-        ev_cols = {n: _pad_rows(ev_cols[n], kr) for n in _EV_FIELDS}
-        for arr in (sg_pad, rr_pad):
-            TRANSFER_STATS.count_up(arr.nbytes)
-        for cols in (sg_cols, rr_cols, ev_cols):
-            for arr in cols.values():
+        with _trace().span(
+            "scatter_content",
+            sg_rows=int(sg_idx.shape[0]),
+            rr_rows=int(rr_idx.shape[0]),
+        ):
+            G = self._prev.g_req.shape[0]
+            RJ = self._prev.run_req.shape[0]
+            kg = _pad_bucket(sg_idx.shape[0])
+            kr = _pad_bucket(rr_idx.shape[0])
+            sg_pad = np.full((kg,), G, np.int32)
+            sg_pad[: sg_idx.shape[0]] = sg_idx
+            rr_pad = np.full((kr,), RJ, np.int32)
+            rr_pad[: rr_idx.shape[0]] = rr_idx
+            sg_cols = {n: _pad_rows(sg_cols[n], kg) for n in _SG_FIELDS}
+            rr_cols = {n: _pad_rows(rr_cols[n], kr) for n in _RR_FIELDS}
+            ev_cols = {n: _pad_rows(ev_cols[n], kr) for n in _EV_FIELDS}
+            for arr in (sg_pad, rr_pad):
                 TRANSFER_STATS.count_up(arr.nbytes)
-        if _APPLY is None:
-            _APPLY = _make_apply()
-        self._tsan.commit(tok, "scatter_content")
-        self._prev = _APPLY(
-            self._prev, sg_pad, sg_cols, rr_pad, rr_cols, ev_cols, {},
-            (), ev_base=ev_base, splice=False,
-        )
-        self.content_prefetches += 1
-        return True
+            for cols in (sg_cols, rr_cols, ev_cols):
+                for arr in cols.values():
+                    TRANSFER_STATS.count_up(arr.nbytes)
+            if _APPLY is None:
+                _APPLY = _make_apply()
+            self._tsan.commit(tok, "scatter_content")
+            self._prev = _APPLY(
+                self._prev, sg_pad, sg_cols, rr_pad, rr_cols, ev_cols, {},
+                (), ev_base=ev_base, splice=False,
+            )
+            self.content_prefetches += 1
+            return True

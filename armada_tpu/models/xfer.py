@@ -11,9 +11,17 @@ tools/sidecar_profile.py report the per-cycle numbers.
 
 Counters are process-global and single-threaded like the cycle itself;
 ``reset()`` at cycle start, ``snapshot()`` at cycle end.
+
+Each counted transfer also lands as an instant event in the active cycle
+trace (ops/trace.py) with its byte count, so a Perfetto timeline shows
+WHERE in the cycle each tunnel round trip happened -- the counters stay
+the aggregate contract, the trace is the correlated view of the same
+stream (no-op outside an armed cycle).
 """
 
 from __future__ import annotations
+
+from armada_tpu.ops.trace import recorder as _trace
 
 
 class TransferStats:
@@ -31,10 +39,12 @@ class TransferStats:
     def count_up(self, nbytes: int) -> None:
         self.up_transfers += 1
         self.up_bytes += int(nbytes)
+        _trace().note("xfer_up", bytes=int(nbytes))
 
     def count_down(self, nbytes: int) -> None:
         self.down_transfers += 1
         self.down_bytes += int(nbytes)
+        _trace().note("xfer_down", bytes=int(nbytes))
 
     def snapshot(self) -> dict:
         return {

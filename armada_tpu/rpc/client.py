@@ -21,6 +21,7 @@ from armada_tpu.server.submit import JobSubmitItem
 
 _PRINCIPAL_KEY = "x-armada-principal"
 _GROUPS_KEY = "x-armada-groups"
+_TRACE_KEY = "x-armada-trace-id"
 
 
 class _Base:
@@ -83,13 +84,16 @@ class _Base:
     def close(self) -> None:
         self._channel.close()
 
-    def _unary(self, path: str, req, resp_cls):
+    def _unary(self, path: str, req, resp_cls, extra_metadata=()):
         call = self._channel.unary_unary(
             path,
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_cls.FromString,
         )
-        return call(req, metadata=self._meta)
+        meta = self._meta
+        if extra_metadata:
+            meta = list(meta) + list(extra_metadata)
+        return call(req, metadata=meta)
 
 
 class ArmadaClient(_Base):
@@ -369,6 +373,20 @@ class ArmadaClient(_Base):
         )
         return json.loads(resp.status_json)
 
+    # --- cycle traces (armadactl trace; ops/trace.py) -----------------------
+
+    def dump_trace(self) -> dict:
+        """The plane's last N cycle span trees (offset form); feed to
+        ops/trace.chrome_trace for a Perfetto-loadable file."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DumpTrace",
+            pb.Empty(),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
     # --- scheduling reports -------------------------------------------------
 
     def get_job_report(self, job_id: str) -> dict:
@@ -548,7 +566,24 @@ class ScheduleClient(_Base):
     """Client for the scheduling sidecar (armada_tpu.api.Schedule): mirror
     job/executor/queue state into a server-side session, then drive rounds.
     The reference-Go-colocation client would be generated from rpc.proto;
-    this is the same wire surface from python."""
+    this is the same wire surface from python.
+
+    Cycle tracing (ops/trace.py): when the CALLER has an active cycle
+    trace, sync/round calls propagate its trace id as gRPC metadata and
+    ``schedule_round`` grafts the server's returned round spans under the
+    RPC span -- one stitched cross-process tree, no clock agreement needed
+    (spans travel as offsets)."""
+
+    @staticmethod
+    def _active_trace():
+        """(recorder, trace_id) when a cycle trace is open, else (None, "")."""
+        from armada_tpu.ops.trace import recorder
+
+        rec = recorder()
+        active = rec.active()
+        if active is None or not rec.enabled:
+            return None, ""
+        return rec, active.trace_id
 
     def create_session(
         self, session_id: str = "", config_yaml: str = ""
@@ -597,7 +632,17 @@ class ScheduleClient(_Base):
                 )
             for queue, items in by_queue.items():
                 msg.bids.queues.append(pb.QueueBids(queue=queue, bids=items))
-        self._unary("/armada_tpu.api.Schedule/SyncState", msg, pb.Empty)
+        rec, tid = self._active_trace()
+        if rec is None:
+            self._unary("/armada_tpu.api.Schedule/SyncState", msg, pb.Empty)
+            return
+        with rec.span("rpc_sync_state", session=session_id):
+            self._unary(
+                "/armada_tpu.api.Schedule/SyncState",
+                msg,
+                pb.Empty,
+                extra_metadata=((_TRACE_KEY, tid),),
+            )
 
     def schedule_round(
         self,
@@ -605,15 +650,36 @@ class ScheduleClient(_Base):
         now_ns: int = 0,
         quarantined_node_ids=(),
     ) -> "pb.ScheduleRoundResponse":
-        return self._unary(
-            "/armada_tpu.api.Schedule/ScheduleRound",
-            pb.ScheduleRoundRequest(
-                session_id=session_id,
-                now_ns=now_ns,
-                quarantined_node_ids=list(quarantined_node_ids),
-            ),
-            pb.ScheduleRoundResponse,
+        req = pb.ScheduleRoundRequest(
+            session_id=session_id,
+            now_ns=now_ns,
+            quarantined_node_ids=list(quarantined_node_ids),
         )
+        rec, tid = self._active_trace()
+        if rec is None:
+            return self._unary(
+                "/armada_tpu.api.Schedule/ScheduleRound",
+                req,
+                pb.ScheduleRoundResponse,
+            )
+        with rec.span("rpc_schedule_round", session=session_id):
+            resp = self._unary(
+                "/armada_tpu.api.Schedule/ScheduleRound",
+                req,
+                pb.ScheduleRoundResponse,
+                extra_metadata=((_TRACE_KEY, tid),),
+            )
+            # Stitch: the server shipped its round's span tree because we
+            # sent a trace id; graft it under this RPC span.
+            import json
+
+            try:
+                remote = json.loads(resp.pool_stats_json or "{}").get("trace")
+            except ValueError:
+                remote = None
+            if remote:
+                rec.graft(remote)
+        return resp
 
     def close_session(self, session_id: str) -> None:
         self._unary(

@@ -46,6 +46,16 @@ def _authenticate(auth, context) -> Principal:
         context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
 
 
+def _trace_id_of(context) -> str:
+    """The caller's cycle-trace id, when it chose to propagate one
+    (ops/trace.py cross-process stitching).  Plain metadata, never trusted
+    for anything but labelling."""
+    for k, v in context.invocation_metadata() or ():
+        if k.lower() == "x-armada-trace-id":
+            return str(v)[:64]
+    return ""
+
+
 def _guard(context, fn):
     """Run fn(), translating domain errors to gRPC status codes."""
     try:
@@ -434,6 +444,13 @@ class _ExecutorAdminService:
         )
         return pb.CheckpointStatusResponse(status_json=_json.dumps(status))
 
+    def DumpTrace(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        doc = _guard(context, lambda: self._cp.dump_trace(principal))
+        return pb.JsonResponse(json=_json.dumps(doc))
+
     def PreemptOnQueue(self, request, context):
         principal = _authenticate(self._auth, context)
         _guard(
@@ -551,13 +568,17 @@ class _ScheduleService:
 
     def SyncState(self, request, context):
         _authenticate(self._auth, context)
-        self._session_guard(context, lambda: self._sidecar.handle_sync(request))
+        tid = _trace_id_of(context)
+        self._session_guard(
+            context, lambda: self._sidecar.handle_sync(request, trace_id=tid)
+        )
         return pb.Empty()
 
     def ScheduleRound(self, request, context):
         _authenticate(self._auth, context)
+        tid = _trace_id_of(context)
         return self._session_guard(
-            context, lambda: self._sidecar.handle_round(request)
+            context, lambda: self._sidecar.handle_round(request, trace_id=tid)
         )
 
     def CloseSession(self, request, context):
@@ -754,6 +775,7 @@ def make_server(
                     "CheckpointStatus": _unary(
                         csvc.CheckpointStatus, pb.Empty
                     ),
+                    "DumpTrace": _unary(csvc.DumpTrace, pb.Empty),
                 },
             )
         )

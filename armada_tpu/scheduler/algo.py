@@ -33,6 +33,7 @@ from armada_tpu.models import (
     run_round_on_device,
     run_scheduling_round,
 )
+from armada_tpu.ops.trace import recorder as _trace
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 from armada_tpu.scheduler.ratelimit import SchedulingRateLimiters
 
@@ -415,14 +416,17 @@ class FairSchedulingAlgo:
                 # orphaned garbage the reset hook replaced -- never the
                 # live cache or a later iteration's bundle.
                 devcache = self.feed.devcache_for(pool)
-                res, outcome = run_round_on_device(
-                    pview,
-                    ctx,
-                    self.config,
-                    device_problem=lambda dc=devcache, b_=bundle: dc.apply(b_),
-                    host_problem=bundle.materialize,
-                    shadow_work=shadow,
-                )
+                with _trace().span("round", pool=pool):
+                    res, outcome = run_round_on_device(
+                        pview,
+                        ctx,
+                        self.config,
+                        device_problem=lambda dc=devcache, b_=bundle: dc.apply(
+                            b_
+                        ),
+                        host_problem=bundle.materialize,
+                        shadow_work=shadow,
+                    )
                 if self.collect_stats:
                     collect_round_stats(res, pview, ctx, self.config, outcome)
             else:
@@ -430,24 +434,31 @@ class FairSchedulingAlgo:
                     continue
                 num_queued, num_running = len(queued_jobs), len(running)
                 g_tokens, q_tokens = round_tokens()
-                outcome = run_scheduling_round(
-                    self.config,
-                    pool=pool,
-                    nodes=pool_nodes,
-                    queues=pool_queues(pool),
-                    queued_jobs=queued_jobs,
-                    running=running,
-                    collect_stats=self.collect_stats,
-                    bid_price_of=bid_price_of,
-                    global_tokens=g_tokens,
-                    queue_tokens=q_tokens,
-                    banned_nodes=banned_nodes,
-                    queue_penalty=penalty_by_pool.get(pool),
-                )
+                with _trace().span("round", pool=pool, legacy=True):
+                    outcome = run_scheduling_round(
+                        self.config,
+                        pool=pool,
+                        nodes=pool_nodes,
+                        queues=pool_queues(pool),
+                        queued_jobs=queued_jobs,
+                        running=running,
+                        collect_stats=self.collect_stats,
+                        bid_price_of=bid_price_of,
+                        global_tokens=g_tokens,
+                        queue_tokens=q_tokens,
+                        banned_nodes=banned_nodes,
+                        queue_penalty=penalty_by_pool.get(pool),
+                    )
             consume_round(outcome)
-            self._apply_outcome(
-                txn, outcome, pool, executor_of_node, now_ns, result
-            )
+            with _trace().span(
+                "apply_outcome",
+                pool=pool,
+                scheduled=len(outcome.scheduled),
+                preempted=len(outcome.preempted),
+            ):
+                self._apply_outcome(
+                    txn, outcome, pool, executor_of_node, now_ns, result
+                )
             if incremental:
                 # Later pools must see this pool's leases/preemptions; the
                 # overlay registry keeps this O(this pool's changes), not
@@ -530,31 +541,33 @@ class FairSchedulingAlgo:
                 if not host_nodes or not away_jobs:
                     continue
                 g_tokens, q_tokens = round_tokens()
-                outcome = run_scheduling_round(
-                    self.config,
-                    pool=host,
-                    nodes=host_nodes,
-                    queues=pool_queues(host),
-                    queued_jobs=[
-                        dataclasses.replace(j, pools=(host,)) for j in away_jobs
-                    ],
-                    running=(
-                        self.feed.running_of(host, txn)
-                        if incremental
-                        else host_running(host)
-                    ),
-                    collect_stats=False,
-                    bid_price_of=(
-                        _pool_pricer(host)
-                        if self.bid_prices is not None
-                        else None
-                    ),
-                    away_mode=True,
-                    global_tokens=g_tokens,
-                    queue_tokens=q_tokens,
-                    banned_nodes=banned_nodes,
-                    queue_penalty=penalty_by_pool.get(host),
-                )
+                with _trace().span("away_round", host=host, home=home_pool):
+                    outcome = run_scheduling_round(
+                        self.config,
+                        pool=host,
+                        nodes=host_nodes,
+                        queues=pool_queues(host),
+                        queued_jobs=[
+                            dataclasses.replace(j, pools=(host,))
+                            for j in away_jobs
+                        ],
+                        running=(
+                            self.feed.running_of(host, txn)
+                            if incremental
+                            else host_running(host)
+                        ),
+                        collect_stats=False,
+                        bid_price_of=(
+                            _pool_pricer(host)
+                            if self.bid_prices is not None
+                            else None
+                        ),
+                        away_mode=True,
+                        global_tokens=g_tokens,
+                        queue_tokens=q_tokens,
+                        banned_nodes=banned_nodes,
+                        queue_penalty=penalty_by_pool.get(host),
+                    )
                 consume_round(outcome)
                 self._apply_outcome(
                     txn, outcome, host, executor_of_node, now_ns, result, away=True

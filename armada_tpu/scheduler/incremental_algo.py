@@ -28,6 +28,7 @@ from armada_tpu.core.types import RunningJob
 from armada_tpu.jobdb.job import Job
 from armada_tpu.models.incremental import IncrementalBuilder
 from armada_tpu.models.slab import DeviceDeltaCache
+from armada_tpu.ops.trace import recorder as _trace
 
 
 class IncrementalProblemFeed:
@@ -179,21 +180,27 @@ class IncrementalProblemFeed:
         # O(K x table x pools).  Accumulate the batch and flush once per
         # builder (one np.insert per column total), the same shape bench.py's
         # backlog load uses.
-        for job_id in deletes:
-            if job_id in self._overlaid_deletes:
-                continue
-            if record:
-                self._overlaid_deletes.add(job_id)
-            self._remove_everywhere(job_id)
-        pending: dict = {}
-        overlaid = self._overlaid
-        for job in upserts.values():
-            if overlaid.get(job.id) is job:
-                continue
-            if record:
-                overlaid[job.id] = job
-            self.apply_job(job, pending)
-        self._flush(pending)
+        with _trace().span(
+            "feed_apply",
+            upserts=len(upserts),
+            deletes=len(deletes),
+            overlay=record,
+        ):
+            for job_id in deletes:
+                if job_id in self._overlaid_deletes:
+                    continue
+                if record:
+                    self._overlaid_deletes.add(job_id)
+                self._remove_everywhere(job_id)
+            pending: dict = {}
+            overlaid = self._overlaid
+            for job in upserts.values():
+                if overlaid.get(job.id) is job:
+                    continue
+                if record:
+                    overlaid[job.id] = job
+                self.apply_job(job, pending)
+            self._flush(pending)
 
     def _pending_for(
         self, pending: dict, pool: str
@@ -216,6 +223,9 @@ class IncrementalProblemFeed:
                 leases.pop(job_id, None)
 
     def _flush(self, pending: dict) -> None:
+        # Per-op spans (submit_many/remove_many/lease_many) live inside the
+        # builder methods themselves, so the trace attributes this cost
+        # wherever the feed runs -- serve, sidecar, or bench.
         for pool, (submits, bans, leases, removals) in pending.items():
             b = self.builders.get(pool)
             if b is None:

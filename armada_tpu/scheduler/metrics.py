@@ -177,6 +177,20 @@ class SchedulerMetrics:
             "Sample count behind each SLO latency histogram",
             ["metric"],
         )
+        # Per-stage cycle latency (ops/trace.py stage histograms): where a
+        # cycle's time goes, as the same labelled-quantile-gauge shape as
+        # the SLO block, so dashboards attribute a cycle_latency regression
+        # to a stage without a trace capture.  A stage = a DIRECT child of
+        # a cycle root: sync_state/transitions/schedule/event_publish/
+        # commit for scheduler cycles (assemble/round/kernel spans nest
+        # INSIDE schedule -- the watchdog worker adopts the caller's span,
+        # so they never double-count as stages), feed_apply/assemble/
+        # round/apply_outcome for sidecar rounds.
+        self.cycle_stage_latency = g(
+            "armada_cycle_stage_seconds",
+            "Per-stage cycle latency percentiles (trace-span histograms)",
+            ["stage", "quantile"],
+        )
         # Durability gauges (scheduler/checkpoint.py + eventlog/replicator):
         # dashboards alert on snapshot age past the cadence (RPO drifting),
         # replication lag growing (takeover would lose that window), and an
@@ -236,6 +250,19 @@ class SchedulerMetrics:
                 v = summary.get(q + "_s")
                 if v is not None:
                     self.slo_latency.labels(metric, q).set(v)
+
+    def observe_trace(self, stage_snapshot: dict) -> None:
+        """Publish the trace recorder's per-stage latency snapshot
+        (ops/trace.TraceRecorder.stage_snapshot), once per cycle.  Keys
+        arrive as ``stage.<name>`` (plus the whole-cycle ``cycle``)."""
+        for key, summary in stage_snapshot.items():
+            if not isinstance(summary, dict) or not summary.get("count"):
+                continue
+            stage = key.split(".", 1)[1] if key.startswith("stage.") else key
+            for q in ("p50", "p90", "p95", "p99"):
+                v = summary.get(q + "_s")
+                if v is not None:
+                    self.cycle_stage_latency.labels(stage, q).set(v)
 
     def observe_durability(self, status: dict) -> None:
         """Publish the scheduler's durability block

@@ -242,8 +242,20 @@ class Scheduler:
         sup0 = _supervisor()
         fallbacks0 = sup0.snapshot()["fallbacks"]
         degraded0 = sup0.degraded
+        from armada_tpu.ops.trace import recorder as _trace_recorder
+
         with log_context(cycle=self._cycle_seq, scheduling=schedule):
-            result = self._cycle(schedule)
+            # Cycle trace root (ops/trace.py): every span the cycle's
+            # components open -- feed apply, assemble, slab scatters, the
+            # round's kernel/fetch/failover, publish -- lands under this
+            # tree; the ring keeps the last N for armadactl trace/healthz.
+            with _trace_recorder().cycle(
+                "scheduler_cycle",
+                kind="cycle",
+                seq=self._cycle_seq,
+                scheduling=schedule,
+            ):
+                result = self._cycle(schedule)
         duration = time.monotonic() - start
         # A cycle counts as degraded if it RAN degraded at any point:
         # degraded BEFORE (a promotion can land mid-cycle while the round
@@ -265,6 +277,7 @@ class Scheduler:
 
             self.metrics.observe_device(supervisor().snapshot())
             self.metrics.observe_slo(self._slo().snapshot())
+            self.metrics.observe_trace(_trace_recorder().stage_snapshot())
             self.metrics.observe_durability(self.durability_status())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
@@ -305,13 +318,17 @@ class Scheduler:
                 rec.forget([jid for jid in ended if jid])
 
     def _cycle(self, schedule: bool = True) -> CycleResult:
+        from armada_tpu.ops.trace import recorder as _trace
+
+        trace = _trace()
         result = CycleResult()
         # Fetch cursors only advance with a COMMITTED txn: an aborted cycle
         # must re-fetch the same rows next time or their transitions are lost.
         cursors0 = (self._jobs_serial, self._runs_serial)
         txn = self.jobdb.write_txn()
         try:
-            touched = self.sync_state(txn)
+            with trace.span("sync_state"):
+                touched = self.sync_state(txn)
             result.synced_jobs = touched
 
             token: LeaderToken = self.leader.get_token()
@@ -355,10 +372,11 @@ class Scheduler:
 
             # Refresh the submit checker's fleet BEFORE the update messages:
             # the requeue anti-affinity gate (_fail_or_requeue) consults it.
-            self._refresh_checker_fleet(now_ns)
-            self._generate_update_messages(txn, touched, builder, now_ns)
-            self._validate_jobs(txn, builder, now_ns)
-            self._expire_executor_jobs(txn, builder, now_ns)
+            with trace.span("transitions", touched=len(touched)):
+                self._refresh_checker_fleet(now_ns)
+                self._generate_update_messages(txn, touched, builder, now_ns)
+                self._validate_jobs(txn, builder, now_ns)
+                self._expire_executor_jobs(txn, builder, now_ns)
 
             if schedule:
                 quarantined = self.node_quarantine.quarantined(now_ns)
@@ -368,12 +386,13 @@ class Scheduler:
                     self.metrics.observe_executor_usage(
                         executors, self.config.resource_list_factory()
                     )
-                sched = self.algo.schedule(
-                    txn,
-                    executors,
-                    now_ns,
-                    quarantined_nodes=quarantined,
-                )
+                with trace.span("schedule"):
+                    sched = self.algo.schedule(
+                        txn,
+                        executors,
+                        now_ns,
+                        quarantined_nodes=quarantined,
+                    )
                 result.scheduler_result = sched
                 result.scheduled = True
                 self._events_from_scheduler_result(sched, builder, now_ns)
@@ -390,12 +409,18 @@ class Scheduler:
                     self._was_leader = False
                     result.leader = False
                     return result
-                self.publisher.publish(sequences)
+                with trace.span(
+                    "event_publish",
+                    sequences=len(sequences),
+                    events=sum(len(s.events) for s in sequences),
+                ):
+                    self.publisher.publish(sequences)
             result.published = sequences
 
             if self.config.enable_assertions:
                 txn.assert_invariants()
-            txn.commit()
+            with trace.span("commit"):
+                txn.commit()
             feed = getattr(self.algo, "feed", None)
             if (
                 schedule

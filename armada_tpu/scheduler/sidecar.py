@@ -195,6 +195,25 @@ class ScheduleSession:
         executors: Optional[Sequence[ExecutorSnapshot]] = None,
         queues: Optional[Sequence[Queue]] = None,
         bids: Optional[dict] = None,
+        trace_id: str = "",
+    ) -> None:
+        from armada_tpu.ops.trace import recorder as trace_recorder
+
+        # The caller's cycle is sync + round: the sync half gets its own
+        # ring entry (kind "sync") under the caller's trace id so the two
+        # stitch by id in a dump (tools/sidecar_profile.py reads the split
+        # from exactly these entries).
+        with trace_recorder().cycle(
+            "sidecar_sync",
+            trace_id=trace_id,
+            kind="sync",
+            jobs=len(jobs),
+            deletes=len(deletes),
+        ):
+            self._apply_sync_locked(jobs, deletes, executors, queues, bids)
+
+    def _apply_sync_locked(
+        self, jobs, deletes, executors, queues, bids
     ) -> None:
         with self._lock:
             if jobs or deletes:
@@ -261,17 +280,27 @@ class ScheduleSession:
     # ------------------------------------------------------------ rounds ----
 
     def schedule_round(
-        self, now_ns: Optional[int] = None, quarantined=frozenset()
+        self,
+        now_ns: Optional[int] = None,
+        quarantined=frozenset(),
+        trace_id: str = "",
     ) -> SchedulerResult:
         from armada_tpu.core.watchdog import supervisor
         from armada_tpu.ops.metrics import mono_now
+        from armada_tpu.ops.trace import recorder as trace_recorder
         from armada_tpu.scheduler.slo import recorder as slo_recorder
 
         t_start = mono_now()
         sup0 = supervisor()
         fallbacks0 = sup0.snapshot()["fallbacks"]
         degraded0 = sup0.degraded
-        with self._lock:
+        # The round's cycle trace carries the CALLER's trace id when one
+        # arrived over the gRPC metadata (rpc/server.py): the caller grafts
+        # the returned spans under its RPC span, yielding one stitched
+        # cross-process tree (tests/test_trace.py pins it).
+        with trace_recorder().cycle(
+            "sidecar_round", trace_id=trace_id, kind="round", session=self.id
+        ), self._lock:
             txn = self.jobdb.write_txn()
             now = now_ns or self._clock_ns()
 
@@ -331,7 +360,7 @@ class ScheduleSession:
             return result
 
 
-def _stats_of(result: SchedulerResult) -> str:
+def _stats_of(result: SchedulerResult, trace: Optional[dict] = None) -> str:
     pools = []
     for s in result.pools:
         entry = {
@@ -356,17 +385,19 @@ def _stats_of(result: SchedulerResult) -> str:
     from armada_tpu.core.watchdog import supervisor
     from armada_tpu.scheduler.slo import recorder as slo_recorder
 
-    return json.dumps(
-        {
-            "pools": pools,
-            "device": supervisor().snapshot(),
-            # Streaming SLO percentiles (cycle latency split healthy/
-            # degraded): the external control plane reads its scheduling
-            # tail latency from the same response it already parses.
-            "slo": slo_recorder().snapshot(),
-        },
-        default=float,
-    )
+    doc = {
+        "pools": pools,
+        "device": supervisor().snapshot(),
+        # Streaming SLO percentiles (cycle latency split healthy/
+        # degraded): the external control plane reads its scheduling
+        # tail latency from the same response it already parses.
+        "slo": slo_recorder().snapshot(),
+    }
+    if trace is not None:
+        # The round's span tree (offset form, ops/trace.Span.to_dict): the
+        # caller grafts it under its RPC span for one stitched timeline.
+        doc["trace"] = trace
+    return json.dumps(doc, default=float)
 
 
 class ScheduleSidecar:
@@ -421,7 +452,7 @@ class ScheduleSidecar:
     # (proto-level entry points used by the gRPC service; kept here so the
     # service class in rpc/server.py stays a thin auth + status-code shim)
 
-    def handle_sync(self, msg) -> None:
+    def handle_sync(self, msg, trace_id: str = "") -> None:
         s = self.session(msg.session_id)
         executors = None
         if msg.set_executors:
@@ -445,17 +476,34 @@ class ScheduleSidecar:
             executors=executors,
             queues=queues,
             bids=bids,
+            trace_id=trace_id,
         )
 
-    def handle_round(self, msg):
+    def handle_round(self, msg, trace_id: str = ""):
+        from armada_tpu.ops.trace import recorder as trace_recorder
         from armada_tpu.rpc import rpc_pb2 as pb
 
         s = self.session(msg.session_id)
         result = s.schedule_round(
             now_ns=int(msg.now_ns) or None,
             quarantined=frozenset(msg.quarantined_node_ids),
+            trace_id=trace_id,
         )
-        resp = pb.ScheduleRoundResponse(pool_stats_json=_stats_of(result))
+        # The round's finished trace (it just closed): ship its span tree
+        # back only when the caller ASKED to stitch (sent a trace id) --
+        # an untraced caller pays zero response bytes for it.
+        trace_doc = None
+        if trace_id:
+            rec = trace_recorder()
+            for t in reversed(rec.last()):
+                if t.trace_id == trace_id and t.kind == "round":
+                    d = t.root.to_dict(t.root.t0)
+                    d.setdefault("args", {})["pid"] = t.pid
+                    trace_doc = d
+                    break
+        resp = pb.ScheduleRoundResponse(
+            pool_stats_json=_stats_of(result, trace=trace_doc)
+        )
         for job, run in result.scheduled:
             resp.scheduled.append(
                 pb.RoundLease(

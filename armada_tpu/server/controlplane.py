@@ -127,6 +127,20 @@ class ControlPlaneServer:
             raise SubmitError("this plane has no checkpoint surface")
         return self.checkpoint_status()
 
+    # --- cycle traces (ops/trace.py; plane-local like checkpoints) ----------
+
+    def dump_trace(self, principal: Principal = Principal()) -> dict:
+        """The last N cycles' span trees in offset form (armadactl trace
+        converts to Chrome trace JSON client-side).  Plane-LOCAL like the
+        checkpoint verbs: a trace is one replica's own timeline.  Gated on
+        the operator permission -- span args carry queue/pool names."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        from armada_tpu.ops.trace import recorder
+
+        return recorder().dump()
+
     # --- mass actions (executor.go PreemptOnExecutor / CancelOnExecutor) ----
 
     def preempt_on_executor(

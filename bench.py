@@ -266,10 +266,37 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
 
     from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
     from armada_tpu.models.xfer import TRANSFER_STATS
+    from armada_tpu.ops.trace import recorder as trace_recorder
 
     do_prefetch = not legacy_build and prefetch_worthwhile()
+    # Trace-derived stage splits (ops/trace.py): armed by default so the
+    # headline JSON carries stage_*_s keys -- the "legible without a TPU"
+    # per-stage regression surface; ARMADA_BENCH_TRACE=0 disarms both the
+    # spans and the keys.
+    stages_on = os.environ.get("ARMADA_BENCH_TRACE", "") != "0"
+    rec = trace_recorder()
 
     def cycle(t_now):
+        """One measured cycle; the trace cycle wraps _cycle_body via a
+        real `with` so an exception can never leak an open cycle trace."""
+        if not stages_on:
+            return _cycle_body(t_now)
+        with rec.cycle("bench_cycle", kind="bench"):
+            total, parts, n_sched = _cycle_body(t_now)
+        # Trace-derived per-stage splits (ops/trace.py): the SAME span
+        # names the serving plane records, so a bench stage regression
+        # maps 1:1 onto a production trace (ARMADA_BENCH_TRACE=0 drops
+        # these keys).
+        parts = dict(parts)
+        parts.update(
+            {
+                f"stage_{name}_s": round(dur, 4)
+                for name, dur in rec.last_stages().items()
+            }
+        )
+        return total, parts, n_sched
+
+    def _cycle_body(t_now):
         nonlocal kw
         TRANSFER_STATS.reset()
         t_start = time.perf_counter()
@@ -277,7 +304,8 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
         if legacy_build:
             problem, ctx = builder.assemble()
             t_asm = time.perf_counter()
-            dev = devcache.put(problem)
+            with rec.span("devcache_apply", full_upload=True):
+                dev = devcache.put(problem)
         else:
             bundle, ctx = builder.assemble_delta()
             t_asm = time.perf_counter()
@@ -292,7 +320,8 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
             max_slots=ctx.max_slots,
             slot_width=ctx.slot_width,
         )
-        result = schedule_round(dev, **kw)
+        with rec.span("kernel_dispatch"):
+            result = schedule_round(dev, **kw)
         # Overlapped decode (default): the compaction + its device->host copy
         # are enqueued BEHIND the kernel without a host sync, and the cycle's
         # decision-independent work (next submits + their slab prefetch)
@@ -307,12 +336,13 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
         )
         if overlap:
             t_disp0 = time.perf_counter()
-            finish = begin_decode(result, ctx)
+            with rec.span("decode_dispatch"):
+                finish = begin_decode(result, ctx)
             t_disp = time.perf_counter()
             fresh = spec_factory(burst, t_now)
             for s in fresh:
                 spec_of[s.id] = s
-            builder.submit_many(fresh)
+            builder.submit_many(fresh)  # carries its own trace span
             # Shadow-pipeline stage (b): ship the fresh submits' slab rows
             # while the kernel + result transfer hold the tunnel, so the
             # next cycle's device apply only carries lease/evict rows.
@@ -336,9 +366,10 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
                 # the axon tunnel (docs/bench.md round 5); a scalar fetch
                 # genuinely waits (and adds one ~65ms transfer, so the
                 # traced cycle is slightly slower than the untraced one)
-                int(result.n_slots)
-                t_drain = time.perf_counter()
-                outcome = finish()
+                with rec.span("fetch_decode", scalar_barrier=True):
+                    int(result.n_slots)
+                    t_drain = time.perf_counter()
+                    outcome = finish()
                 t_decode = time.perf_counter()
                 print(
                     f"bench-trace: drain={t_drain - t_kernel:.4f} "
@@ -346,23 +377,26 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
                     file=sys.stderr,
                 )
             else:
-                outcome = finish()
+                with rec.span("fetch_decode"):
+                    outcome = finish()
         else:
-            jax.block_until_ready(result)
-            t_kernel = time.perf_counter()
-            outcome = decode_result(result, ctx)
+            with rec.span("fetch_decode"):
+                jax.block_until_ready(result)
+                t_kernel = time.perf_counter()
+                outcome = decode_result(result, ctx)
         # Feed the decisions back (part of the measured cycle: the reference
         # applies SchedulerResult to the jobDb inside its 5s budget too).
         t_apply0 = time.perf_counter()
-        builder.remove_many(outcome.scheduled.keys())
-        leases = []
-        for jid, nid in outcome.scheduled.items():
-            spec = spec_of.pop(jid, None)
-            if spec is not None:
-                leases.append(RunningJob(job=spec, node_id=nid))
-        builder.lease_many(leases)
-        for jid in outcome.preempted:
-            builder.unlease(jid)
+        with rec.span("apply", scheduled=len(outcome.scheduled)):
+            builder.remove_many(outcome.scheduled.keys())
+            leases = []
+            for jid, nid in outcome.scheduled.items():
+                spec = spec_of.pop(jid, None)
+                if spec is not None:
+                    leases.append(RunningJob(job=spec, node_id=nid))
+            builder.lease_many(leases)
+            for jid in outcome.preempted:
+                builder.unlease(jid)
         if trace:
             print(
                 f"bench-trace: apply={time.perf_counter() - t_apply0:.4f}",
@@ -374,7 +408,7 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
             fresh = spec_factory(burst, t_now)
             for s in fresh:
                 spec_of[s.id] = s
-            builder.submit_many(fresh)
+            builder.submit_many(fresh)  # carries its own trace span
         t_end = time.perf_counter()
         return (
             t_end - t_start,

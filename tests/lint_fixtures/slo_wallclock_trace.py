@@ -1,0 +1,21 @@
+# Fixture for rule `slo-wallclock`'s extended scope: ops/trace.py (the
+# cycle-trace recorder).  Linted under armada_tpu/ops/trace.py -- span
+# timestamps feed the stage histograms and the Perfetto timeline, so a
+# second clock source here skews every correlated view.
+import time
+
+from armada_tpu.ops.metrics import mono_now
+
+
+def open_span_bad(spans):
+    spans.append(time.perf_counter())  # TP
+
+
+def open_span_ok(spans):
+    # near-miss: span timestamps through the one sanctioned helper
+    spans.append(mono_now())
+
+
+def ring_gutter(events, gap_us):
+    # near-miss: arithmetic on recorded offsets reads no clock at all
+    return [e + gap_us for e in events]

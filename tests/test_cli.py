@@ -89,6 +89,43 @@ jobs:
     )
 
 
+def test_cli_trace_dump_and_summary(plane, capsys):
+    """`armadactl trace`: Chrome trace-event JSON for REAL serving-plane
+    cycles over the gRPC ExecutorAdmin verb -- the acceptance surface for
+    the round-12 tracing tentpole.  Schema-checks every event the way
+    Perfetto's importer does (name/ph/ts/pid/tid, dur on completes)."""
+    import json
+
+    # let the plane tick a few traced cycles
+    deadline = time.time() + 30
+    from armada_tpu.ops.trace import recorder
+
+    while time.time() < deadline and not any(
+        t.kind == "cycle" for t in recorder().last()
+    ):
+        time.sleep(0.05)
+    assert ctl(plane, "trace") == 0
+    doc = json.loads(capsys.readouterr().out)
+    evs = doc["traceEvents"]
+    assert evs, "a ticking plane must have recorded cycles"
+    for ev in evs:
+        assert {"name", "ph", "pid", "tid"} <= set(ev)
+        if ev["ph"] == "X":
+            assert ev["dur"] > 0 and "ts" in ev
+    names = {e["name"] for e in evs}
+    assert "scheduler_cycle" in names and "sync_state" in names
+
+    assert ctl(plane, "trace", "--summary") == 0
+    out = capsys.readouterr().out
+    assert "trace " in out and "duration=" in out
+
+    assert ctl(plane, "trace", "--raw") == 0
+    raw = json.loads(capsys.readouterr().out)
+    assert raw["traces"] and raw["traces"][-1]["root"]["name"] in (
+        "scheduler_cycle",
+    )
+
+
 def test_cli_checkpoint_trigger_and_status(plane, capsys):
     """`armadactl checkpoint` + `--status`: the operator trigger for
     durable snapshots (scheduler/checkpoint.py) through the real gRPC

@@ -80,6 +80,25 @@ def test_rule_true_positive_and_near_miss(rule):
     )
 
 
+def test_slo_wallclock_scope_covers_trace_module():
+    """Round-12 scope extension: ops/trace.py (the cycle-trace recorder)
+    is inside slo-wallclock's scope -- its own TP + near-miss fixture pair
+    pins the rule fires there and only on the marked line."""
+    path = os.path.join(FIXTURES, "slo_wallclock_trace.py")
+    with open(path) as fh:
+        text = fh.read()
+    tp_lines = [
+        i for i, line in enumerate(text.splitlines(), 1) if "# TP" in line
+    ]
+    assert len(tp_lines) == 1
+    findings = lint.lint_source(text, "armada_tpu/ops/trace.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("slo-wallclock", tp_lines[0])
+    ], "; ".join(f.format() for f in findings)
+    # ... and the SAME buffer under a path outside the scope stays clean
+    assert lint.lint_source(text, "armada_tpu/ops/other.py") == []
+
+
 def test_selfhost_whole_tree_clean():
     """The CI gate: zero unsuppressed violations over the repo."""
     n, findings = lint.lint_tree(REPO)

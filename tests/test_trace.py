@@ -1,0 +1,426 @@
+"""Cycle tracing (ops/trace.py): the correlated span timeline, pinned.
+
+1. *Recorder mechanics*: span nesting, ring eviction, the zero-allocation
+   off path, cross-thread attachment, per-thread active cycles.
+2. *Chrome export*: every emitted event carries the fields Perfetto's JSON
+   importer requires; instants/completes/metadata all appear; offset-form
+   (wire) dumps convert identically.
+3. *Cross-process stitching*: a traced caller driving the sidecar over
+   REAL gRPC gets one tree -- its RPC span with the server's round spans
+   grafted beneath, same trace id on both sides' ring entries.
+4. *Failover attribution*: an injected device_round hang is attributed to
+   the cycle that paid it (root tagged degraded + failover_reason, a
+   cpu_failover span present) -- the trace answer to "which cycle was the
+   failover window".
+5. *Bit-neutrality*: the pipeline bit-equality scenario runs with tracing
+   explicitly ARMED and stays bit-equal (the recorder only reads clocks).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from armada_tpu.ops import trace as trace_mod
+from armada_tpu.ops.trace import chrome_trace, reset_recorder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder(monkeypatch):
+    monkeypatch.delenv("ARMADA_TRACE", raising=False)
+    rec = reset_recorder()
+    yield rec
+    reset_recorder()
+
+
+# --- 1. recorder mechanics ---------------------------------------------------
+
+
+def test_span_nesting_and_args(_fresh_recorder):
+    rec = _fresh_recorder
+    with rec.cycle("cyc", seq=7):
+        with rec.span("outer", pool="default"):
+            with rec.span("inner"):
+                pass
+            rec.note("tick", bytes=42)
+        with rec.span("second"):
+            pass
+    (t,) = rec.last()
+    assert t.root.name == "cyc" and t.root.args == {"seq": 7}
+    assert [c.name for c in t.root.children] == ["outer", "second"]
+    outer = t.root.children[0]
+    assert [c.name for c in outer.children] == ["inner", "tick"]
+    assert outer.children[1].args == {"bytes": 42}
+    assert outer.dur_s >= outer.children[0].dur_s >= 0.0
+
+
+def test_ring_eviction(monkeypatch):
+    rec = reset_recorder(ring=3)
+    for i in range(5):
+        with rec.cycle("cyc", n=i):
+            pass
+    assert [t.root.args["n"] for t in rec.last()] == [2, 3, 4]
+
+
+def test_disabled_and_idle_are_shared_noop(monkeypatch, _fresh_recorder):
+    rec = _fresh_recorder
+    # no active cycle: spans are the SHARED no-op object (zero allocation)
+    assert rec.span("x") is trace_mod._NOOP
+    monkeypatch.setenv("ARMADA_TRACE", "0")
+    assert rec.cycle("x") is trace_mod._NOOP
+    with rec.cycle("x"):
+        assert rec.span("y") is trace_mod._NOOP
+    assert not rec.last()
+
+
+def test_cross_thread_spans_attach_to_cycle_root(_fresh_recorder):
+    rec = _fresh_recorder
+    with rec.cycle("cyc"):
+
+        def worker():
+            with rec.span("worker_span"):
+                pass
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        t.join()
+    (trace,) = rec.last()
+    names = [c.name for c in trace.root.children]
+    assert "worker_span" in names
+
+
+def test_zombie_worker_spans_dropped_after_finalize(_fresh_recorder):
+    """The recorder's zombie guard (the devcache GenerationGuard idea): a
+    watchdog-abandoned worker that unwedges AFTER its cycle finalized must
+    neither grow the finalized ring entry nor charge span counts to
+    whatever unrelated cycle is primary by then."""
+    rec = _fresh_recorder
+    handle = []
+    with rec.cycle("cyc"):
+        with rec.span("round"):
+            handle.append(rec.capture())  # what run_with_deadline captures
+    (old,) = rec.last()
+    n0, round_children0 = old.span_count, len(old.root.children[0].children)
+
+    def zombie():
+        rec.adopt(handle[0])
+        with rec.span("late_kernel"):
+            pass
+        rec.note("late_xfer", bytes=1)
+
+    # ...while a NEW unrelated cycle is live
+    with rec.cycle("next_cycle") as fresh:
+        t = threading.Thread(target=zombie, daemon=True)
+        t.start()
+        t.join()
+        assert fresh.span_count == 1, "zombie must not charge the new cycle"
+    assert old.span_count == n0
+    assert len(old.root.children[0].children) == round_children0
+    names = {c.name for c in rec.last()[-1].root.children}
+    assert "late_kernel" not in names and "late_xfer" not in names
+
+
+def test_nested_cycle_degrades_to_span(_fresh_recorder):
+    rec = _fresh_recorder
+    with rec.cycle("outer"):
+        with rec.cycle("inner"):  # same thread: degrades to a span
+            pass
+    assert [t.root.name for t in rec.last()] == ["outer"]
+    (t,) = rec.last()
+    assert [c.name for c in t.root.children] == ["inner"]
+    assert rec.nested_cycles == 1
+
+
+def test_stage_histograms_and_last_stages(_fresh_recorder):
+    rec = _fresh_recorder
+    with rec.cycle("cyc"):
+        with rec.span("stage_a"):
+            pass
+        with rec.span("stage_a"):  # same stage twice: accumulates
+            pass
+        with rec.span("stage_b"):
+            pass
+    stages = rec.last_stages()
+    assert set(stages) == {"stage_a", "stage_b"}
+    snap = rec.stage_snapshot()
+    assert snap["stage.stage_a"]["count"] == 1  # one cycle's accumulation
+    assert snap["cycle"]["count"] == 1
+    block = rec.healthz_block()
+    assert block["cycles"] == 1 and block["kind"] == "cyc"
+    assert {s["name"] for s in block["top_spans"]} == {"stage_a", "stage_b"}
+
+
+def test_annotate_tags_active_root(_fresh_recorder):
+    rec = _fresh_recorder
+    with rec.cycle("cyc"):
+        with rec.span("deep"):
+            rec.annotate(degraded=True, failover_reason="drill")
+    (t,) = rec.last()
+    assert t.root.args["degraded"] is True
+    assert t.root.args["failover_reason"] == "drill"
+
+
+def test_transfer_counters_ride_the_trace(_fresh_recorder):
+    from armada_tpu.models.xfer import TRANSFER_STATS
+
+    rec = _fresh_recorder
+    TRANSFER_STATS.reset()
+    with rec.cycle("cyc"):
+        TRANSFER_STATS.count_up(1234)
+        TRANSFER_STATS.count_down(99)
+    (t,) = rec.last()
+    notes = {c.name: c.args for c in t.root.children}
+    assert notes["xfer_up"] == {"bytes": 1234}
+    assert notes["xfer_down"] == {"bytes": 99}
+    # counters themselves are unchanged by the trace ride-along
+    assert TRANSFER_STATS.up_bytes == 1234 and TRANSFER_STATS.down_bytes == 99
+
+
+# --- 2. Chrome trace-event export -------------------------------------------
+
+
+def _assert_perfetto_schema(doc: dict) -> None:
+    assert "traceEvents" in doc
+    assert doc["traceEvents"], "export must emit events"
+    for ev in doc["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(ev), ev
+        if ev["ph"] == "X":
+            assert "ts" in ev and "dur" in ev and ev["dur"] > 0
+        elif ev["ph"] == "i":
+            assert "ts" in ev and ev.get("s") == "t"
+        else:
+            assert ev["ph"] == "M", f"unexpected phase {ev['ph']}"
+    json.dumps(doc)  # JSON-serializable end to end
+
+
+def test_chrome_trace_schema(_fresh_recorder):
+    rec = _fresh_recorder
+    for i in range(2):
+        with rec.cycle("cyc", n=i):
+            with rec.span("stage"):
+                rec.note("instant", bytes=1)
+    doc = chrome_trace(rec.last())
+    _assert_perfetto_schema(doc)
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert phases == {"X", "i", "M"}
+    # both cycles share the timeline, separated by the gutter
+    xs = [e for e in doc["traceEvents"] if e["name"] == "cyc"]
+    assert len(xs) == 2 and xs[1]["ts"] > xs[0]["ts"] + xs[0]["dur"]
+    # every non-metadata event is trace-id-labelled for correlation
+    for ev in doc["traceEvents"]:
+        if ev["ph"] != "M":
+            assert ev["args"]["trace_id"]
+
+
+def test_chrome_trace_from_wire_form(_fresh_recorder):
+    """The offset-form dump (armadactl trace --raw, the RPC shape) converts
+    through the SAME exporter as live CycleTrace objects."""
+    rec = _fresh_recorder
+    with rec.cycle("cyc"):
+        with rec.span("stage"):
+            pass
+    dump = json.loads(json.dumps(rec.dump()))  # wire round trip
+    doc = chrome_trace(dump["traces"])
+    _assert_perfetto_schema(doc)
+    assert {"cyc", "stage"} <= {e["name"] for e in doc["traceEvents"]}
+
+
+# --- 3. cross-process stitching over the sidecar boundary --------------------
+
+
+def test_sidecar_round_stitches_one_tree(_fresh_recorder):
+    from tests.test_pipeline import NOW_NS, make_config, make_job, make_world
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.rpc.client import ScheduleClient, job_state_of
+    from armada_tpu.rpc.server import make_server
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+    from armada_tpu.scheduler.sidecar import ScheduleSidecar
+
+    cfg = make_config(incremental_problem_build=True)
+    F, nodes, queues = make_world(cfg)
+    sidecar = ScheduleSidecar(cfg, clock_ns=lambda: NOW_NS)
+    server, port = make_server(schedule_sidecar=sidecar)
+    client = ScheduleClient(f"127.0.0.1:{port}")
+    rec = _fresh_recorder
+    try:
+        sid = client.create_session("t")
+        with rec.cycle("caller_cycle"):
+            client.sync_state(
+                sid,
+                jobs=[
+                    job_state_of(
+                        Job(spec=make_job(F, i, "q0"), queued=True, validated=True)
+                    )
+                    for i in range(6)
+                ],
+                executors=[
+                    ExecutorSnapshot(
+                        id="ex1",
+                        pool="default",
+                        nodes=tuple(nodes),
+                        last_update_ns=NOW_NS,
+                    )
+                ],
+                queues=queues,
+                factory=F,
+            )
+            resp = client.schedule_round(sid, now_ns=NOW_NS)
+        assert len(resp.scheduled) > 0
+    finally:
+        server.stop(0)
+        client.close()
+
+    caller = rec.last()[-1]
+    assert caller.root.name == "caller_cycle"
+    # the caller's tree: exactly the two RPC spans at the top level -- the
+    # server's cycles did NOT nest as siblings (per-thread active cycles)
+    assert [c.name for c in caller.root.children] == [
+        "rpc_sync_state",
+        "rpc_schedule_round",
+    ]
+    rpc = caller.root.children[1]
+    # ...with the server's round spans grafted BENEATH the RPC span
+    (grafted,) = rpc.children
+    assert grafted.name == "sidecar_round" and grafted.args.get("remote")
+    sub = set()
+
+    def walk(s):
+        sub.add(s.name)
+        for c in s.children:
+            walk(c)
+
+    walk(grafted)
+    assert {"round", "kernel_dispatch", "fetch_decode", "apply_outcome"} <= sub
+    # remote spans sit INSIDE the RPC span's window after re-basing
+    assert grafted.t0 >= rpc.t0 and grafted.dur_s <= rpc.dur_s + 1e-6
+
+    # both sides' ring entries carry the SAME trace id (the stitch key)
+    kinds = {(t.kind, t.trace_id) for t in rec.last()}
+    assert ("round", caller.trace_id) in kinds
+    assert ("sync", caller.trace_id) in kinds
+
+    # and the whole stitched tree exports as valid Perfetto JSON (client
+    # and server share a pid in this in-process topology, so the track
+    # split itself is pinned by test_grafted_remote_gets_own_track)
+    doc = chrome_trace([caller])
+    _assert_perfetto_schema(doc)
+    assert grafted.args.get("pid") == caller.pid
+
+
+def test_grafted_remote_gets_own_track(_fresh_recorder):
+    """A grafted subtree from a genuinely different process (distinct pid)
+    renders on its own Perfetto process track, descendants included."""
+    rec = _fresh_recorder
+    remote_pid = 424242
+    with rec.cycle("client"):
+        with rec.span("rpc"):
+            rec.graft(
+                {
+                    "name": "server_round",
+                    "off_s": 0.001,
+                    "dur_s": 0.002,
+                    "args": {"pid": remote_pid},
+                    "children": [
+                        {"name": "kernel", "off_s": 0.0015, "dur_s": 0.0005}
+                    ],
+                }
+            )
+    doc = chrome_trace(rec.last())
+    _assert_perfetto_schema(doc)
+    by_name = {
+        e["name"]: e for e in doc["traceEvents"] if e["ph"] != "M"
+    }
+    assert by_name["client"]["pid"] == by_name["rpc"]["pid"] != remote_pid
+    assert by_name["server_round"]["pid"] == remote_pid
+    assert by_name["kernel"]["pid"] == remote_pid, "descendants inherit"
+    meta = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert f"armada-remote-{remote_pid}" in meta
+
+
+# --- 4. failover-cycle attribution -------------------------------------------
+
+
+def test_failover_cycle_attribution(monkeypatch, _fresh_recorder):
+    """Under ARMADA_FAULT=device_round:hang, the cycle that paid the
+    watchdog deadline + CPU re-run carries the attribution: root tagged
+    degraded with the reason, a cpu_failover span in its tree."""
+    from tests.test_faults import make_config, make_job, make_world
+    from armada_tpu.core import faults, watchdog
+    from armada_tpu.models import run_scheduling_round
+
+    faults.reset_counters()
+    watchdog.reset_supervisor()
+    saved_hooks = list(watchdog._reset_hooks)
+    watchdog._reset_hooks.clear()
+    monkeypatch.setenv("ARMADA_REPROBE_INTERVAL_S", "0")
+    monkeypatch.setenv("ARMADA_WATCHDOG_S", "1.0")
+    monkeypatch.setenv("ARMADA_FAULT", "device_round:hang")
+    monkeypatch.setenv("ARMADA_FAULT_HANG_S", "8")
+    try:
+        cfg = make_config()
+        F, nodes, queues = make_world(cfg)
+        jobs = [make_job(F, i) for i in range(8)]
+        rec = _fresh_recorder
+        with rec.cycle("drill_cycle"):
+            out = run_scheduling_round(
+                cfg,
+                pool="default",
+                nodes=nodes,
+                queues=queues,
+                queued_jobs=jobs,
+                collect_stats=False,
+            )
+        assert out.scheduled, "failover round must still schedule"
+        (t,) = rec.last()
+        assert t.root.args["degraded"] is True
+        assert "RoundTimeout" in t.root.args["failover_reason"]
+        names = set()
+
+        def walk(s):
+            names.add(s.name)
+            for c in s.children:
+                walk(c)
+
+        walk(t.root)
+        assert "cpu_failover" in names
+        # the re-run's kernel spans sit under the failover span
+        failover = next(
+            c for c in t.root.children if c.name == "cpu_failover"
+        )
+        sub = set()
+        walk2 = lambda s: (sub.add(s.name), [walk2(c) for c in s.children])  # noqa: E731
+        walk2(failover)
+        assert "kernel_dispatch" in sub and "fetch_decode" in sub
+    finally:
+        faults.reset_counters()
+        watchdog.reset_supervisor()
+        watchdog._reset_hooks[:] = saved_hooks
+
+
+# --- 5. tracing-armed bit-equality -------------------------------------------
+
+
+@pytest.mark.fast
+def test_pipeline_bit_equality_with_tracing_armed(monkeypatch):
+    """The pipeline bit-equality scenario with tracing explicitly ARMED:
+    the recorder must be decision-neutral (it only reads clocks and
+    appends spans), so pipelined == sequential still holds span-for-span
+    instrumented."""
+    from tests.test_pipeline import _sidecar_scenario
+
+    monkeypatch.setenv("ARMADA_TRACE", "1")
+    reset_recorder()
+    a = _sidecar_scenario(monkeypatch, True, True, seed=1)
+    b = _sidecar_scenario(monkeypatch, False, True, seed=1)
+    assert a[0] == b[0], "per-round decisions diverged under tracing"
+    assert a[1] == b[1], "final mirror state diverged under tracing"
+    assert any(sched for sched, _ in a[0]), "scenario must schedule"
+    # ...and the armed run actually recorded round cycles
+    rec = trace_mod.recorder()
+    assert any(t.kind == "round" for t in rec.last())

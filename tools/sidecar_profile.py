@@ -1,9 +1,22 @@
-"""Profile the sidecar's steady direct cycle on CPU: wall-clock sync/round
-splits over warmed cycles, then a cProfile of 3 more -- the methodology
-behind docs/bench.md's round-6 host-side ablation (whole-cycle differencing
-is useless when the CPU kernel's variance exceeds the host-side trim being
+"""Profile the sidecar's steady direct cycle on CPU: sync/round splits over
+warmed cycles, then a cProfile of 3 more -- the methodology behind
+docs/bench.md's round-6 host-side ablation (whole-cycle differencing is
+useless when the CPU kernel's variance exceeds the host-side trim being
 measured).  Scale knobs: PJOBS, PNODES, PQUEUES, PRUNS, PBURST; e.g.
-PJOBS=1000000 PNODES=50000 PRUNS=25000 python tools/sidecar_profile.py."""
+PJOBS=1000000 PNODES=50000 PRUNS=25000 python tools/sidecar_profile.py.
+
+The sync/round split is read from the CYCLE TRACE ring (ops/trace.py):
+each handle_sync/handle_round records a cycle tree rooted at the SESSION
+methods (apply_sync/schedule_round), and this tool reports those root
+durations -- the same stage-split source of truth bench.py's stage_*_s
+keys, /healthz's trace block and `armadactl trace` read, instead of a
+second set of ad-hoc timers that could drift from it.  Scope note: the
+session roots exclude the thin wire shims around them -- handle_sync's
+executor/queue/bid proto parsing (jobs convert INSIDE apply_sync, which
+dominates) and handle_round's response assembly (~1k RoundLease appends
++ stats JSON).  Those slices still show in the cProfile section below;
+the r6-era perf_counter numbers included them, so per-cycle totals here
+read a few ms lower than that baseline at equal cost."""
 import cProfile
 import io
 import os
@@ -130,21 +143,36 @@ def main():
     print(f"setup {time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
     from armada_tpu.models.xfer import TRANSFER_STATS
+    from armada_tpu.ops.trace import recorder as trace_recorder
+
+    rec = trace_recorder()
+    if not rec.enabled:
+        print(
+            "warning: ARMADA_TRACE=0 disables cycle tracing -- the "
+            "sync/round splits below will read 0",
+            file=sys.stderr,
+        )
+
+    def _ring_duration(kind: str) -> float:
+        """Root duration of the newest ring entry of this kind -- the
+        trace-span timing of the call that just returned."""
+        for t in reversed(rec.last()):
+            if t.kind == kind:
+                return t.root.dur_s
+        return 0.0
 
     def cycle():
         clock[0] += 10**9
         fresh = spec_factory(burst, clock[0] / 1e9)
         states = [state_of_spec(s) for s in fresh]
         TRANSFER_STATS.reset()
-        t = time.perf_counter()
         sidecar.handle_sync(pb.SyncStateRequest(session_id=sid, jobs=states))
-        t_sync = time.perf_counter() - t
+        t_sync = _ring_duration("sync")
         xs_sync = TRANSFER_STATS.snapshot()
-        t = time.perf_counter()
         resp = sidecar.handle_round(
             pb.ScheduleRoundRequest(session_id=sid, now_ns=clock[0])
         )
-        t_round = time.perf_counter() - t
+        t_round = _ring_duration("round")
         xs = TRANSFER_STATS.snapshot()
         xs["sync_up_transfers"] = xs_sync["up_transfers"]
         xs["sync_up_bytes"] = xs_sync["up_bytes"]

@@ -1,0 +1,129 @@
+"""Dump cycle traces as Chrome trace-event JSON (Perfetto-loadable).
+
+Two modes, one exporter (ops/trace.chrome_trace -- the same function
+`armadactl trace` uses, so there is exactly ONE Chrome-JSON writer):
+
+* ``--from-json FILE``: convert a raw offset-form dump (the output of
+  ``armadactl trace --raw``, or a saved ``dump()``) into Chrome JSON.
+* no input: run a small synthetic traced steady cycle IN-PROCESS (scale
+  knobs PJOBS/PNODES/PQUEUES/PBURST, defaults tiny) and dump its trace --
+  the zero-infrastructure way to see the span timeline of this build.
+
+Usage:
+    python tools/trace_dump.py -o cycle.json            # synthetic capture
+    python tools/trace_dump.py --from-json raw.json -o cycle.json
+    armadactl trace --raw | python tools/trace_dump.py --from-json - -o c.json
+
+Open the output at https://ui.perfetto.dev or chrome://tracing.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synthetic_capture(cycles: int = 3) -> dict:
+    """Run a few traced steady cycles over a synthetic world; returns the
+    raw dump (offset form)."""
+    from armada_tpu.core.types import RunningJob
+    from armada_tpu.models import decode_result, schedule_round
+    from armada_tpu.models.incremental import IncrementalBuilder
+    from armada_tpu.models.slab import DeviceDeltaCache
+    from armada_tpu.models.synthetic import synthetic_world
+    from armada_tpu.ops.trace import reset_recorder
+
+    jobs = int(os.environ.get("PJOBS", 2_000))
+    nodes = int(os.environ.get("PNODES", 200))
+    queues = int(os.environ.get("PQUEUES", 8))
+    burst = int(os.environ.get("PBURST", 100))
+    config, nodes_l, queues_l, specs, running, spec_factory = synthetic_world(
+        num_nodes=nodes,
+        num_jobs=jobs,
+        num_queues=queues,
+        num_runs=nodes // 2,
+        seed=7,
+    )
+    rec = reset_recorder()
+    builder = IncrementalBuilder(config, "default", queues_l)
+    builder.set_nodes(nodes_l)
+    builder.submit_many(specs)
+    for r in running:
+        builder.lease(r)
+    spec_of = {s.id: s for s in specs}
+    devcache = DeviceDeltaCache()
+    for i in range(cycles):
+        with rec.cycle("steady_cycle", kind="cycle", n=i):
+            bundle, ctx = builder.assemble_delta()
+            dev = devcache.apply(bundle)
+            with rec.span("kernel_dispatch"):
+                result = schedule_round(
+                    dev,
+                    num_levels=len(ctx.ladder) + 2,
+                    max_slots=ctx.max_slots,
+                    slot_width=ctx.slot_width,
+                )
+            with rec.span("fetch_decode"):
+                outcome = decode_result(result, ctx)
+            with rec.span("apply", scheduled=len(outcome.scheduled)):
+                builder.remove_many(outcome.scheduled.keys())
+                leases = [
+                    RunningJob(job=spec_of[jid], node_id=nid)
+                    for jid, nid in outcome.scheduled.items()
+                    if jid in spec_of
+                ]
+                builder.lease_many(leases)
+            fresh = spec_factory(burst, 100.0 + i)
+            for s in fresh:
+                spec_of[s.id] = s
+            builder.submit_many(fresh)  # carries its own trace span
+    return rec.dump()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--from-json",
+        default="",
+        help="raw offset-form dump to convert ('-' = stdin); omit to run "
+        "a synthetic traced capture in-process",
+    )
+    ap.add_argument("-o", "--out", default="", help="output file (default stdout)")
+    ap.add_argument(
+        "--cycles", type=int, default=3, help="synthetic cycles to capture"
+    )
+    args = ap.parse_args()
+
+    if args.from_json:
+        if args.from_json == "-":
+            dump = json.load(sys.stdin)
+        else:
+            with open(args.from_json, "r", encoding="utf-8") as fh:
+                dump = json.load(fh)
+    else:
+        dump = synthetic_capture(args.cycles)
+
+    from armada_tpu.ops.trace import chrome_trace
+
+    doc = chrome_trace(dump.get("traces", []))
+    text = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(
+            f"wrote {len(dump.get('traces', []))} trace(s), "
+            f"{len(doc['traceEvents'])} events to {args.out} "
+            "(open in https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
