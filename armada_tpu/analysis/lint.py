@@ -794,6 +794,54 @@ def _queued_version_write(src: Source):
                     )
 
 
+# Sanctioned owners of explicit device placement: the slab caches and the
+# mesh subsystem place problem arrays WITH their shardings; everywhere else
+# a bare device_put re-places the array onto one device -- for a node-axis-
+# sharded slab that is a silent full gather onto one chip's HBM + tunnel.
+_MESH_OWNERS = ("armada_tpu/parallel/",)
+_MESH_OWNER_FILES = {"armada_tpu/models/slab.py"}
+
+
+def _mesh_gather_scope(p: str) -> bool:
+    return (
+        p.startswith("armada_tpu/")
+        and not p.startswith(_MESH_OWNERS)
+        and p not in _MESH_OWNER_FILES
+    )
+
+
+@rule(
+    "mesh-gather",
+    "jax.device_put / .addressable_data on problem arrays outside the slab "
+    "cache + parallel/ owners: a bare placement silently GATHERS a node-"
+    "axis-sharded slab onto one chip (mesh serving plane, round 12)",
+    scope=_mesh_gather_scope,
+)
+def _mesh_gather(src: Source):
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) == "jax.device_put":
+            yield _finding(
+                src,
+                "mesh-gather",
+                node,
+                "explicit device placement outside models/slab.py + "
+                "parallel/: on the mesh serving plane this gathers a "
+                "sharded slab onto one device -- route uploads through the "
+                "device cache (DeviceDeltaCache/MeshDeviceDeltaCache), or "
+                "allow() stating why the placement is mesh-safe",
+            )
+        elif isinstance(node, ast.Attribute) and node.attr == "addressable_data":
+            yield _finding(
+                src,
+                "mesh-gather",
+                node,
+                ".addressable_data() reads ONE shard of a sharded array -- "
+                "on the serving path that is a partial (wrong) view of the "
+                "slab; fetch through the compact decode, or allow() naming "
+                "the single-device invariant",
+            )
+
+
 # The one sanctioned tmp+fsync+rename implementation.
 _STATEFILE_OWNER = "armada_tpu/core/statefile.py"
 

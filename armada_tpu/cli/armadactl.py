@@ -622,6 +622,8 @@ _SERVE_FALLBACKS = {
     "lookout_database_url": None,
     # None -> start_control_plane resolves ARMADA_WATCHDOG_S or 120s.
     "watchdog_s": None,
+    # None -> start_control_plane resolves ARMADA_MESH (0 = single device).
+    "mesh": None,
     # Periodic checkpoint cadence (scheduler/checkpoint.py): serve defaults
     # to 300s so every deployment gets bounded-replay restarts; 0 disables
     # (tests and embedded planes construct with the library default, off).
@@ -679,6 +681,7 @@ def load_serve_config(args):
         "lookout_database_url": ("lookoutdatabaseurl", str),
         "watchdog_s": ("watchdogs", float),
         "checkpoint_interval": ("checkpointinterval", float),
+        "mesh": ("mesh", int),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -725,6 +728,7 @@ def cmd_serve(args):
         lookout_database_url=getattr(args, "lookout_database_url", None),
         watchdog_s=getattr(args, "watchdog_s", None),
         checkpoint_interval_s=getattr(args, "checkpoint_interval", None),
+        mesh_devices=getattr(args, "mesh", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -957,6 +961,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="device-round watchdog deadline in seconds: a hung/erroring "
         "device round fails over to the CPU backend from host tables "
         "(default 120; 0 disables; /healthz reports the degradation state)",
+    )
+    srv.add_argument(
+        "--mesh",
+        type=int,
+        help="run the steady cycle sharded over this many accelerator "
+        "devices (the mesh serving plane, parallel/serving.py): slabs are "
+        "node-axis-sharded, chip loss degrades to a smaller mesh before "
+        "the CPU failover rung (default 0 = single device; ARMADA_MESH "
+        "env; /healthz reports the mesh block)",
     )
     srv.add_argument(
         "--checkpoint-interval",

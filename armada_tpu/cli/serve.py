@@ -147,6 +147,7 @@ def start_control_plane(
     lookout_database_url: Optional[str] = None,
     watchdog_s: Optional[float] = None,
     checkpoint_interval_s: Optional[float] = None,
+    mesh_devices: Optional[int] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -187,6 +188,20 @@ def start_control_plane(
             watchdog_s = float(os.environ.get("ARMADA_WATCHDOG_S", 120.0))
         except ValueError:
             watchdog_s = 120.0
+
+    # Mesh serving plane (serve --mesh N / ARMADA_MESH; parallel/serving.py):
+    # armed BEFORE the feed builds its device caches, so every slab is
+    # node-axis-sharded from the first upload and the builders align their
+    # pad buckets to the mesh multiple.  Chip loss degrades to a smaller
+    # mesh (one slab re-shard) before the watchdog's CPU failover rung.
+    from armada_tpu.parallel.serving import mesh_serving
+
+    if mesh_devices is None:
+        try:
+            mesh_devices = int(os.environ.get("ARMADA_MESH", "0"))
+        except ValueError:
+            mesh_devices = 0
+    mesh_serving().configure(mesh_devices)
 
     # Persist XLA compilations: a restarted replica re-pays 15-20s of kernel
     # compile otherwise (ARMADA_COMPILE_CACHE overrides the location; "0"
@@ -494,6 +509,8 @@ def start_control_plane(
         # plus the streaming SLO percentiles (cycle latency, TTFL,
         # ingest->visible lag -- scheduler/slo.py).
         health_server.device_status = supervisor().snapshot
+        if mesh_serving().enabled():
+            health_server.mesh_status = mesh_serving().snapshot
         from armada_tpu.scheduler.slo import recorder as _slo_recorder
 
         health_server.slo_status = _slo_recorder().snapshot

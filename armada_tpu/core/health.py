@@ -159,6 +159,15 @@ class _Handler(BaseHTTPRequestHandler):
                     body["device"] = srv.device_status()
                 except Exception as exc:  # noqa: BLE001
                     body["device"] = {"error": str(exc)}
+            if srv.mesh_status is not None:
+                # Mesh serving block (parallel/serving.py): configured vs
+                # currently-served device count, degrade-ladder history --
+                # a plane serving on a halved mesh is degraded-but-healthy
+                # exactly like the CPU-failover rung below it.
+                try:
+                    body["mesh"] = srv.mesh_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["mesh"] = {"error": str(exc)}
             if srv.slo_status is not None:
                 # Streaming SLO block (scheduler/slo.py): cycle-latency /
                 # TTFL / ingest-lag percentiles, so an operator reads tail
@@ -249,6 +258,9 @@ class HealthServer:
         # Optional () -> dict: the device-degradation block /healthz embeds
         # (serve wires core/watchdog.supervisor().snapshot here).
         self.device_status = None
+        # Optional () -> dict: the mesh serving block (serve --mesh wires
+        # parallel/serving.mesh_serving().snapshot here).
+        self.mesh_status = None
         # Optional () -> dict: the streaming SLO block (serve wires
         # scheduler/slo.recorder().snapshot here).
         self.slo_status = None

@@ -133,6 +133,15 @@ def add_reset_hook(fn: Callable[[], None]) -> None:
         _reset_hooks.append(ref)
 
 
+def fire_reset_hooks() -> None:
+    """Public form of the reset-hook broadcast, for OTHER backend-shaped
+    transitions than the supervisor's own device<->cpu flips: the mesh
+    serving ladder (parallel/serving.py) fires it on every degrade/restore
+    rung, so device caches drop state sharded over a mesh that no longer
+    exists exactly as they drop state on an unreachable device."""
+    _fire_reset_hooks()
+
+
 def _fire_reset_hooks() -> None:
     with _hooks_lock:
         hooks = list(_reset_hooks)

@@ -80,6 +80,7 @@ def run_round_on_device(
     `problem` when that is a real SchedulingProblem."""
     from armada_tpu.core import faults
     from armada_tpu.core.watchdog import RoundTimeout, run_with_deadline, supervisor
+    from armada_tpu.parallel.serving import mesh_serving
 
     import jax.numpy as jnp
 
@@ -95,11 +96,28 @@ def run_round_on_device(
         ),
     )
     shadow = _ShadowOnce(shadow_work)
+    mesh_sv = mesh_serving()
 
     def build_device_problem():
         dp = device_problem() if callable(device_problem) else device_problem
         if dp is None:
-            dp = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+            # Mesh serving plane (parallel/serving.py): from-scratch rounds
+            # (legacy path, away rounds) shard onto the current mesh too,
+            # so every round the plane runs sees the same backend shape.
+            # Incremental rounds arrive pre-sharded via MeshDeviceDeltaCache.
+            # While the supervisor is degraded to CPU the mesh is out of
+            # the loop entirely (the CPU rung sits BELOW the ladder).
+            mesh = (
+                mesh_sv.serving_mesh()
+                if mesh_sv.enabled() and not supervisor().degraded
+                else None
+            )
+            if mesh is not None:
+                from armada_tpu.parallel.mesh import shard_problem
+
+                dp = shard_problem(problem, mesh)
+            else:
+                dp = SchedulingProblem(*(jnp.asarray(a) for a in problem))
         return dp
 
     sup = supervisor()
@@ -134,6 +152,10 @@ def run_round_on_device(
         from jax.errors import JaxRuntimeError as _XlaError
     except ImportError:  # older jax: the jaxlib name
         from jaxlib.xla_extension import XlaRuntimeError as _XlaError
+    if mesh_sv.enabled() and mesh_sv.device_count():
+        from armada_tpu.ops.trace import recorder as _trace
+
+        _trace().annotate(mesh_devices=mesh_sv.device_count())
     try:
         out = run_with_deadline(_device_attempt, deadline)
         sup.record_success()
@@ -146,22 +168,75 @@ def run_round_on_device(
         # spuriously-working CPU re-run (and drop every device cache for
         # nothing), so it propagates untouched.
         reason = f"{type(e).__name__}: {e}"
-        sup.record_failure(reason)
-        hp = host_problem() if callable(host_problem) else host_problem
+        try:
+            hp = host_problem() if callable(host_problem) else host_problem
+        except BaseException:
+            # The materialize thunk itself failed mid-failover: still
+            # record the DEVICE loss (degrade + reset hooks + re-probe) so
+            # subsequent cycles do not re-attempt the wedged backend at a
+            # full watchdog deadline each, then let the host error surface.
+            sup.record_failure(reason)
+            raise
         if hp is None and hasattr(problem, "_fields"):
             hp = problem
         if hp is None:
+            sup.record_failure(reason)
             raise  # no host tables to fail over from (legacy caller)
+        from armada_tpu.ops.trace import recorder as _trace
+
+        # Mesh degrade ladder (parallel/serving.py) BEFORE the CPU rung:
+        # chip loss re-runs the SAME round on a halved mesh from host
+        # tables (the reset hooks just replaced every device cache, so the
+        # next cycle's apply is one full slab upload re-sharded onto the
+        # smaller mesh).  The supervisor never records a failure for a
+        # rung that recovers on-device -- the backend is still "device".
+        # While the supervisor is ALREADY degraded to CPU this round never
+        # ran on the mesh (build_device_problem skipped it), so a failure
+        # here is a CPU-rung failure: walking the ladder would re-target
+        # the accelerator the supervisor marked down and misfile the loss.
+        while mesh_sv.enabled() and not sup.degraded:
+            smaller = mesh_sv.degrade(reason)
+            if smaller is None:
+                break
+            n = int(smaller.devices.size)
+            _trace().annotate(mesh_degraded=True, mesh_devices=n)
+            try:
+                with _trace().span(
+                    "mesh_degrade_rerun", devices=n, reason=reason[:300]
+                ):
+                    out = run_with_deadline(
+                        lambda m=smaller: _run_round_on_mesh(
+                            hp, ctx, config, kernel_kwargs, shadow, m
+                        ),
+                        deadline,
+                        what=f"mesh round ({n} devices)",
+                    )
+                sup.record_success()
+                return out
+            except (RoundTimeout, _XlaError, faults.FaultInjected) as e2:
+                reason = f"{type(e2).__name__}: {e2}"
+                continue
         # Failover attribution (ops/trace.py): tag the CYCLE that paid the
         # failover window -- the same cycle the SLO layer's fallback-delta
         # rule files as degraded -- and record the re-run as its own span.
-        from armada_tpu.ops.trace import recorder as _trace
-
+        sup.record_failure(reason)
         _trace().annotate(degraded=True, failover_reason=reason[:300])
         with _trace().span("cpu_failover", reason=reason[:300]):
             return _run_round_cpu_failover(
                 hp, ctx, config, kernel_kwargs, shadow
             )
+
+
+def _run_round_on_mesh(host_problem, ctx, config, kernel_kwargs, shadow, mesh):
+    """Re-run the SAME round sharded over a (smaller) mesh from host
+    tables -- the degrade-ladder rung between full mesh and CPU failover.
+    The device caches were reset by the ladder's hooks; this path pays one
+    full sharded upload, and the next cycle's cache apply re-shards too."""
+    from armada_tpu.parallel.mesh import shard_problem
+
+    return _round_body(
+        shard_problem(host_problem, mesh), ctx, config, kernel_kwargs, shadow
+    )
 
 
 def _run_round_cpu_failover(host_problem, ctx, config, kernel_kwargs, shadow):
@@ -175,6 +250,8 @@ def _run_round_cpu_failover(host_problem, ctx, config, kernel_kwargs, shadow):
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
         dp = SchedulingProblem(
+            # lint: allow(mesh-gather) -- explicit CPU failover: the caches
+            # were reset, nothing sharded survives; host tables re-upload
             *(jax.device_put(_np.asarray(a), cpu) for a in host_problem)
         )
         return _round_body(dp, ctx, config, kernel_kwargs, shadow)

@@ -59,6 +59,23 @@ from armada_tpu.models.problem import (
 )
 from armada_tpu.ops.trace import recorder as _trace
 
+
+def _node_bucket(bucket: int) -> int:
+    """Node-axis pad bucket: min(bucket, 1024) -- the kernel scans O(Q) per
+    iteration and node churn is rare, so the node axis takes a smaller
+    bucket than the job axis (round-2 lesson) -- rounded up to the mesh
+    serving shard multiple (parallel/serving.mesh_axis_multiple, 1 when
+    mesh serving is off) so a node-axis-sharded slab ALWAYS divides the
+    mesh: divisibility is a build-time property, never a mid-serve
+    ValueError out of _check_divisible."""
+    nb = min(bucket, 1024)
+    from armada_tpu.parallel.serving import mesh_axis_multiple
+
+    mult = mesh_axis_multiple()
+    if mult > 1:
+        nb = ((nb + mult - 1) // mult) * mult
+    return nb
+
 _INF = np.float32(3.0e38)
 _ID_DTYPE = "S48"
 
@@ -1454,7 +1471,7 @@ class IncrementalBuilder:
         # kernel's candidate scan is O(Q) per iteration, so a 1M-scale job
         # bucket must never inflate the queue axis.
         qbucket = min(bucket, 256)
-        nbucket = min(bucket, 1024)
+        nbucket = _node_bucket(bucket)
         Qreal = len(self.queue_names)
         Nreal = len(self.node_ids)
         N = _pad(Nreal, nbucket)
@@ -2092,7 +2109,7 @@ class IncrementalBuilder:
         cfg = self.config
         R = self.R
         qbucket = min(cfg.shape_bucket, 256)
-        nbucket = min(cfg.shape_bucket, 1024)
+        nbucket = _node_bucket(cfg.shape_bucket)
         Qreal = len(self.queue_names)
         Nreal = len(self.node_ids)
         N = _pad(Nreal, nbucket)

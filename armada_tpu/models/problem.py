@@ -1297,6 +1297,19 @@ def _dispatch_compact(result, ctx: HostContext):
 
     if not isinstance(result.g_state, jax.Array):
         return None
+    sharding = getattr(result.g_state, "sharding", None)
+    mesh_shape = getattr(getattr(sharding, "mesh", None), "shape", None)
+    if mesh_shape is not None and sum(1 for v in mesh_shape.values() if v > 1) >= 2:
+        # XLA:CPU GSPMD (jax 0.4.37) miscompiles cross-jit reductions over
+        # arrays partitioned on one mesh axis and REPLICATED on another:
+        # the per-device partial sums all-reduce over BOTH axes, so every
+        # compact-header scalar comes back x(replicated-axis size) --
+        # caught by test_parallel_sharding's 2D (nodes x jobs) mesh, where
+        # n_slots/n_failed arrived x node_shards.  Per-shard values are
+        # correct (direct np.asarray reads are fine), so fall back to the
+        # full pull.  The serving mesh is nodes x 1 (one >1 axis) and
+        # keeps the compact path.
+        return None
     G = int(result.g_state.shape[0])
     RJ = int(result.run_evicted.shape[0])
     fcap = min(G, _COMPACT_FCAP)

@@ -240,10 +240,18 @@ _EV_FIELDS = (
 )
 
 
-def _make_apply():
+def _make_apply(out_shardings=None):
+    """The jitted scatter program.  `out_shardings` (a SchedulingProblem
+    pytree of NamedShardings) pins the output layout for the mesh cache
+    (parallel/mesh_slab.py): without it GSPMD may elect to gather the
+    sharded slab while scattering replicated update rows into it."""
     import jax
 
-    @functools.partial(jax.jit, static_argnames=("ev_base", "splice"))
+    jit_kwargs = dict(static_argnames=("ev_base", "splice"))
+    if out_shardings is not None:
+        jit_kwargs["out_shardings"] = out_shardings
+
+    @functools.partial(jax.jit, **jit_kwargs)
     def apply_delta(
         prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls, gq_args,
         *, ev_base, splice,
@@ -337,12 +345,13 @@ class DeviceDeltaCache:
         self._node_dev = {}
         self.resets += 1
 
-    @staticmethod
-    def _to_device(arr):
+    def _to_device(self, arr, name=None):
         """Upload one host array to the current data device: the default
         backend, or the explicit CPU device while the supervisor is degraded
         (core/watchdog.data_device) -- the delta cache keeps its O(delta)
-        scatter economics during CPU-failover operation."""
+        scatter economics during CPU-failover operation.  `name` is the
+        problem field (None for unnamed payloads); the mesh cache overrides
+        this to place each field with its slab sharding."""
         import jax
         import jax.numpy as jnp
 
@@ -352,6 +361,19 @@ class DeviceDeltaCache:
         if dev is None:
             return jnp.asarray(arr)
         return jax.device_put(np.asarray(arr), dev)
+
+    def _count_up(self, arr, name=None) -> None:
+        """Per-field upload accounting hook; the mesh cache overrides it to
+        report per-chip bytes for node-axis-sharded fields."""
+        TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
+
+    def _apply_fn(self):
+        """The jitted scatter program this cache scatters with; the mesh
+        cache overrides it with a sharding-pinned compile."""
+        global _APPLY
+        if _APPLY is None:
+            _APPLY = _make_apply()
+        return _APPLY
 
     def _full_upload(self, problem):
         out = []
@@ -363,8 +385,8 @@ class DeviceDeltaCache:
             ):
                 out.append(self._node_dev[name])
             else:
-                TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
-                dev = self._to_device(arr)
+                self._count_up(arr, name)
+                dev = self._to_device(arr, name)
                 if name in _NODE_FIELDS:
                     self._node_dev[name] = dev
                 out.append(dev)
@@ -373,8 +395,6 @@ class DeviceDeltaCache:
         return self._prev
 
     def apply(self, bundle: DeltaBundle):
-        global _APPLY
-
         tok = self._tsan.begin()
         if (
             self._sig != bundle.sig
@@ -412,11 +432,11 @@ class DeviceDeltaCache:
             for name, arr in bundle.fulls.items():
                 if self._host_ids.get(name) is arr:
                     continue  # unchanged object, device copy is current
-                TRANSFER_STATS.count_up(np.asarray(arr).nbytes)
+                self._count_up(arr, name)
                 if name in _NODE_FIELDS:
                     # keep the reusable device copy current, else a later full
                     # upload would resurrect a stale buffer via _node_dev
-                    dev = self._to_device(np.asarray(arr))
+                    dev = self._to_device(np.asarray(arr), name)
                     self._node_dev[name] = dev
                     fulls[name] = dev
                 else:
@@ -441,10 +461,8 @@ class DeviceDeltaCache:
             for cols in (sg_cols, rr_cols, ev_cols):
                 for arr in cols.values():
                     TRANSFER_STATS.count_up(arr.nbytes)
-            if _APPLY is None:
-                _APPLY = _make_apply()
             self._tsan.commit(tok, "apply/scatter")
-            self._prev = _APPLY(
+            self._prev = self._apply_fn()(
                 self._prev, sg_idx, sg_cols, rr_idx, rr_cols, ev_cols, fulls,
                 gq_args, ev_base=bundle.ev_base, splice=splice,
             )
@@ -471,8 +489,6 @@ class DeviceDeltaCache:
         (`seq` = the seq the NEXT bundle will carry).  Anything else (slab
         growth, a skipped bundle, a fresh cache) returns False and the rows
         simply ride the next bundle or its full-upload fallback."""
-        global _APPLY
-
         tok = self._tsan.begin()
         if (
             self._prev is None
@@ -502,10 +518,8 @@ class DeviceDeltaCache:
             for cols in (sg_cols, rr_cols, ev_cols):
                 for arr in cols.values():
                     TRANSFER_STATS.count_up(arr.nbytes)
-            if _APPLY is None:
-                _APPLY = _make_apply()
             self._tsan.commit(tok, "scatter_content")
-            self._prev = _APPLY(
+            self._prev = self._apply_fn()(
                 self._prev, sg_pad, sg_cols, rr_pad, rr_cols, ev_cols, {},
                 (), ev_base=ev_base, splice=False,
             )

@@ -25,7 +25,10 @@ from armada_tpu.ops.trace import recorder as _trace
 
 
 class TransferStats:
-    __slots__ = ("up_transfers", "up_bytes", "down_transfers", "down_bytes")
+    __slots__ = (
+        "up_transfers", "up_bytes", "down_transfers", "down_bytes",
+        "up_chip_bytes", "up_sharded_transfers",
+    )
 
     def __init__(self):
         self.reset()
@@ -35,11 +38,23 @@ class TransferStats:
         self.up_bytes = 0
         self.down_transfers = 0
         self.down_bytes = 0
+        # Mesh serving (parallel/mesh_slab.py): a node-axis-sharded upload
+        # lands nbytes/shards per chip.  up_chip_bytes accumulates the
+        # per-chip share (== up_bytes when nothing is sharded), so the
+        # single-chip HBM/tunnel pressure stays legible on a mesh.
+        self.up_chip_bytes = 0
+        self.up_sharded_transfers = 0
 
-    def count_up(self, nbytes: int) -> None:
+    def count_up(self, nbytes: int, shards: int = 1) -> None:
         self.up_transfers += 1
         self.up_bytes += int(nbytes)
-        _trace().note("xfer_up", bytes=int(nbytes))
+        per_chip = (int(nbytes) + shards - 1) // shards if shards > 1 else int(nbytes)
+        self.up_chip_bytes += per_chip
+        if shards > 1:
+            self.up_sharded_transfers += 1
+            _trace().note("xfer_up", bytes=int(nbytes), shards=int(shards))
+        else:
+            _trace().note("xfer_up", bytes=int(nbytes))
 
     def count_down(self, nbytes: int) -> None:
         self.down_transfers += 1
@@ -47,12 +62,16 @@ class TransferStats:
         _trace().note("xfer_down", bytes=int(nbytes))
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "up_transfers": self.up_transfers,
             "up_bytes": self.up_bytes,
             "down_transfers": self.down_transfers,
             "down_bytes": self.down_bytes,
         }
+        if self.up_sharded_transfers:
+            out["up_chip_bytes"] = self.up_chip_bytes
+            out["up_sharded_transfers"] = self.up_sharded_transfers
+        return out
 
 
 TRANSFER_STATS = TransferStats()

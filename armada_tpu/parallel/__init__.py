@@ -14,16 +14,26 @@ from armada_tpu.parallel.mesh import (
     AXIS_NODES,
     AXIS_JOBS,
     make_mesh,
+    pad_problem,
     problem_shardings,
     shard_problem,
     sharded_schedule_round,
+)
+from armada_tpu.parallel.serving import (
+    mesh_axis_multiple,
+    mesh_serving,
+    reset_mesh_serving,
 )
 
 __all__ = [
     "AXIS_NODES",
     "AXIS_JOBS",
     "make_mesh",
+    "pad_problem",
     "problem_shardings",
     "shard_problem",
     "sharded_schedule_round",
+    "mesh_axis_multiple",
+    "mesh_serving",
+    "reset_mesh_serving",
 ]

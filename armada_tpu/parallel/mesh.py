@@ -125,6 +125,10 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
 
 
 def _check_divisible(problem: SchedulingProblem, mesh: Mesh) -> None:
+    """Internal invariant check (post-pad): a trip here is a build bug, not
+    an operator configuration problem -- `shard_problem` pads and the
+    serving-path builders align their slab buckets to the mesh multiple
+    (models/incremental._node_bucket)."""
     n_shards = mesh.shape[AXIS_NODES]
     j_shards = mesh.shape[AXIS_JOBS]
     N = problem.node_total.shape[0]
@@ -133,13 +137,95 @@ def _check_divisible(problem: SchedulingProblem, mesh: Mesh) -> None:
     for size, shards, name in ((N, n_shards, "nodes"), (G, j_shards, "gangs"), (RJ, j_shards, "runs")):
         if size % shards:
             raise ValueError(
-                f"{name} axis {size} not divisible by its {shards} mesh shards; "
-                f"raise SchedulingConfig.shape_bucket to a multiple of the mesh"
+                f"{name} axis {size} not divisible by its {shards} mesh shards "
+                f"after padding -- pad_problem missed an axis (build bug)"
             )
 
 
-def shard_problem(problem: SchedulingProblem, mesh: Mesh) -> SchedulingProblem:
-    """Place a (host or device) problem onto the mesh with the round shardings."""
+# Axis membership for pad_problem.  Everything not listed (queue tensors,
+# scalars, compat, gq offsets) is replicated and never padded.
+_NODE_AX0 = ("node_total", "node_type", "node_ok")
+_RUN_AX0 = (
+    "run_req", "run_node", "run_level", "run_queue", "run_pc",
+    "run_preemptible", "run_gang", "run_valid",
+)
+_GANG_AX0 = (
+    "g_req", "g_card", "g_level", "g_queue", "g_key", "g_pc", "g_order",
+    "g_run", "g_valid", "g_absent", "g_price", "g_spot_price", "g_ban_row",
+    "gq_gang",
+)
+# Pad lanes must be INERT: absent gangs (kernel state 3, decode-invisible),
+# invalid runs, unschedulable zero-capacity nodes -- the exact values the
+# builders already use for their own bucket padding, so a padded round is
+# bit-identical to the unpadded one (tests/test_mesh_serving.py pins it).
+_PAD_VALUE = {"g_absent": True, "g_key": -1, "g_run": -1, "run_gang": -1}
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult if mult > 1 else n
+
+
+def pad_problem(
+    problem: SchedulingProblem, node_multiple: int = 1, job_multiple: int = 1
+) -> SchedulingProblem:
+    """Pad the node/gang/run axes up to shard multiples with inert lanes.
+
+    Returns `problem` unchanged when the axes already divide.  Operates on
+    host arrays (np.asarray); callers shard the result.  Padded lanes can
+    never influence decisions: padded nodes are node_ok=False with zero
+    capacity (same as the builders' bucket padding), padded gang slots are
+    g_absent (kernel state 3, which decode ignores), padded run slots are
+    run_valid=False.  Decode bounds (ctx.num_real_*) predate the pad, so
+    compact fetch and failed/evicted scans never see the new lanes."""
+    N = problem.node_total.shape[0]
+    G = problem.g_req.shape[0]
+    RJ = problem.run_req.shape[0]
+    N2 = _round_up(N, node_multiple)
+    G2 = _round_up(G, job_multiple)
+    RJ2 = _round_up(RJ, job_multiple)
+    if (N2, G2, RJ2) == (N, G, RJ):
+        return problem
+    out = {}
+    for name, arr in zip(problem._fields, problem):
+        arr = np.asarray(arr)
+        if name in _NODE_AX0:
+            target = N2
+        elif name in _RUN_AX0:
+            target = RJ2
+        elif name in _GANG_AX0:
+            target = G2
+        elif name == "ban_mask" and N2 != N:
+            # rows follow the ban table, columns follow the node axis; a
+            # padded node is never banned (node_ok already excludes it)
+            grown = np.zeros((arr.shape[0], N2), arr.dtype)
+            grown[:, :N] = arr
+            out[name] = grown
+            continue
+        else:
+            out[name] = arr
+            continue
+        if target != arr.shape[0]:
+            pad = np.full(
+                (target - arr.shape[0],) + arr.shape[1:],
+                _PAD_VALUE.get(name, 0),
+                arr.dtype,
+            )
+            arr = np.concatenate([arr, pad], axis=0)
+        out[name] = arr
+    return SchedulingProblem(**out)
+
+
+def shard_problem(
+    problem: SchedulingProblem, mesh: Mesh, pad: bool = True
+) -> SchedulingProblem:
+    """Place a (host or device) problem onto the mesh with the round
+    shardings, padding non-divisible axes with inert lanes first (pad=True;
+    a mid-serve ValueError on an odd axis helped nobody -- the round-11
+    `_check_divisible` raise is now an internal post-pad assertion)."""
+    if pad:
+        problem = pad_problem(
+            problem, mesh.shape[AXIS_NODES], mesh.shape[AXIS_JOBS]
+        )
     _check_divisible(problem, mesh)
     shardings = problem_shardings(mesh)
     return SchedulingProblem(

@@ -416,7 +416,12 @@ class FairSchedulingAlgo:
                 # orphaned garbage the reset hook replaced -- never the
                 # live cache or a later iteration's bundle.
                 devcache = self.feed.devcache_for(pool)
-                with _trace().span("round", pool=pool):
+                # Mesh serving: the round span carries the device count the
+                # resident slab is sharded over (0/absent = single device),
+                # so a Perfetto timeline shows which ladder rung served it.
+                mesh_n = getattr(devcache, "mesh_devices", 0)
+                span_kw = {"mesh_devices": mesh_n} if mesh_n else {}
+                with _trace().span("round", pool=pool, **span_kw):
                     res, outcome = run_round_on_device(
                         pview,
                         ctx,

@@ -31,6 +31,23 @@ from armada_tpu.models.slab import DeviceDeltaCache
 from armada_tpu.ops.trace import recorder as _trace
 
 
+def new_device_cache() -> DeviceDeltaCache:
+    """The feed's device-cache factory: a node-axis-sharded mesh cache when
+    the mesh serving plane is armed (serve --mesh / ARMADA_MESH;
+    parallel/serving.py), else the plain single-device DeviceDeltaCache.
+    Consulted at EVERY cache (re)build -- feed init, resync, late pool
+    discovery, and the watchdog/mesh reset hooks -- so a ladder step
+    (degrade to a smaller mesh, CPU failover, re-promotion) re-shards the
+    next full upload onto whatever the supervisor currently targets."""
+    from armada_tpu.parallel.serving import mesh_serving
+
+    if mesh_serving().enabled():
+        from armada_tpu.parallel.mesh_slab import MeshDeviceDeltaCache
+
+        return MeshDeviceDeltaCache()
+    return DeviceDeltaCache()
+
+
 class IncrementalProblemFeed:
     """Per-pool IncrementalBuilders + device caches, fed from JobDb commits.
 
@@ -70,7 +87,7 @@ class IncrementalProblemFeed:
         # from the JobDb in builder_for.
         for p in config.pools:
             self.builders[p.name] = IncrementalBuilder(config, p.name)
-            self.devcaches[p.name] = DeviceDeltaCache()
+            self.devcaches[p.name] = new_device_cache()
         # Device-loss resilience (core/watchdog): a backend transition
         # (failover to CPU, re-promotion to the device) must drop every
         # device-resident cache this feed owns.  Held weakly -- a closed
@@ -92,7 +109,7 @@ class IncrementalProblemFeed:
         full-upload fallback to the supervisor's current backend.  Host
         tables are untouched."""
         for pool in list(self.devcaches):
-            self.devcaches[pool] = DeviceDeltaCache()
+            self.devcaches[pool] = new_device_cache()
         for b in self.builders.values():
             b.invalidate_prefetch()
 
@@ -116,7 +133,7 @@ class IncrementalProblemFeed:
         self._overlaid_deletes = set()
         for p in self.config.pools:
             self.builders[p.name] = IncrementalBuilder(self.config, p.name)
-            self.devcaches[p.name] = DeviceDeltaCache()
+            self.devcaches[p.name] = new_device_cache()
         if self._jobdb is not None:
             pending = {}
             for job in self._jobdb.read_txn().all_jobs():
@@ -128,7 +145,7 @@ class IncrementalProblemFeed:
         if b is None:
             b = IncrementalBuilder(self.config, pool)
             self.builders[pool] = b
-            self.devcaches[pool] = DeviceDeltaCache()
+            self.devcaches[pool] = new_device_cache()
             if txn is not None:
                 # Late pool discovery (a node snapshot introduced a pool not
                 # in config): one-time backfill scan.

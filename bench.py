@@ -205,10 +205,12 @@ def _kernel_bench(num_gangs, num_nodes, num_queues, repeats, burst=1_000):
     return min(times)
 
 
-def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
+def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=False):
     """Full steady-state cycle: deltas -> assemble -> upload -> kernel ->
     decode, over the incremental builder (models/incremental.py).  Returns
-    (cycle_s, breakdown dict, scheduled count)."""
+    (cycle_s, breakdown dict, scheduled count).  mesh=True runs the SAME
+    cycle on the mesh serving plane (node-axis-sharded slab +
+    MeshDeviceDeltaCache; caller must have armed parallel/serving first)."""
     import dataclasses
 
     from armada_tpu.core.types import RunningJob
@@ -262,7 +264,12 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
     # Slot-stable slab deltas by default (O(deltas) upload per cycle); the
     # legacy dense rebuild+full-upload path stays behind a knob for A/B.
     legacy_build = os.environ.get("ARMADA_BENCH_LEGACY_BUILD") == "1"
-    devcache = DeviceProblemCache() if legacy_build else DeviceDeltaCache()
+    if mesh:
+        from armada_tpu.parallel.mesh_slab import MeshDeviceDeltaCache
+
+        devcache = MeshDeviceDeltaCache()
+    else:
+        devcache = DeviceProblemCache() if legacy_build else DeviceDeltaCache()
 
     from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
     from armada_tpu.models.xfer import TRANSFER_STATS
@@ -426,12 +433,23 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
 
     # warm-up cycle compiles the kernel at these shapes
     cycle(100.0)
+    # The warm-up cycle carries the ONE full sharded slab upload (steady
+    # cycles scatter replicated delta rows, counted shards=1), so the
+    # per-chip upload-pressure keys only exist in ITS stats -- capture them
+    # before the first measured cycle's reset wipes them.
+    warm_chip_xfer = {
+        k: v
+        for k, v in TRANSFER_STATS.snapshot().items()
+        if k in ("up_chip_bytes", "up_sharded_transfers")
+    }
     best, best_parts, scheduled = None, None, 0
     for rep in range(repeats):
         total, parts, n_sched = cycle(200.0 + rep)
         if best is None or total < best:
             best, best_parts, scheduled = total, parts, n_sched
     assert scheduled > 0, "e2e cycle scheduled nothing"
+    for k, v in warm_chip_xfer.items():
+        best_parts.setdefault(k, v)
     return best, best_parts, scheduled
 
 
@@ -588,6 +606,74 @@ def _sidecar_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst):
         "sidecar_setup_s": round(setup_s, 1),
         "sidecar_scheduled_per_cycle": scheduled,
     }
+
+
+def _mesh_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, platform):
+    """ARMADA_BENCH_MESH=N: the e2e steady cycle on the mesh serving plane
+    (node-axis-sharded slab, sharded kernel round, compact decode from
+    sharded outputs) over min(N, visible) devices.  Adds mesh_cycle_s /
+    mesh_devices to the one-line JSON; a 5M-jobs x 200k-nodes scale axis --
+    the backlog a single chip's slab cannot hold -- runs only on a REAL
+    mesh (accelerator platform; ARMADA_BENCH_MESH_SCALE=0 skips it)."""
+    import jax as _jax
+
+    try:
+        n = int(os.environ.get("ARMADA_BENCH_MESH", "0"))
+    except ValueError:
+        n = 0
+    avail = len(_jax.devices())
+    if n > avail:
+        print(
+            f"bench: mesh arm requested {n} devices, {avail} visible",
+            file=sys.stderr,
+        )
+        n = avail
+    if n < 2:
+        return {"mesh_devices": 0, "mesh_skipped": f"{avail} device(s) visible"}
+    from armada_tpu.parallel.serving import mesh_serving
+
+    mesh_serving().configure(n)
+    out = {"mesh_devices": n}
+    try:
+        print(f"bench: mesh arm over {n} devices", file=sys.stderr)
+        cycle_s, parts, scheduled = _e2e_bench(
+            num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=True
+        )
+        out["mesh_cycle_s"] = round(cycle_s, 4)
+        out["mesh_scheduled_per_cycle"] = scheduled
+        for key in ("up_chip_bytes", "up_sharded_transfers"):
+            if key in parts:
+                out[f"mesh_{key}"] = parts[key]
+        if (
+            platform != "cpu"
+            and os.environ.get("ARMADA_BENCH_MESH_SCALE", "1") != "0"
+        ):
+            # The scale axis only a mesh can represent: 4x nodes, 5x jobs.
+            # Virtual CPU "meshes" share one socket and would measure
+            # nothing but collective overhead at a 40x bigger problem, so
+            # this leg is real-accelerator only.
+            scale_jobs = int(os.environ.get("ARMADA_BENCH_MESH_SCALE_JOBS", 5_000_000))
+            scale_nodes = int(os.environ.get("ARMADA_BENCH_MESH_SCALE_NODES", 200_000))
+            print(
+                f"bench: mesh scale axis {scale_jobs} x {scale_nodes}",
+                file=sys.stderr,
+            )
+            scale_s, _, scale_sched = _e2e_bench(
+                scale_jobs,
+                scale_nodes,
+                num_queues,
+                scale_nodes // 2,
+                repeats=max(1, repeats // 3),
+                burst=burst,
+                mesh=True,
+            )
+            out["mesh_scale_cycle_s"] = round(scale_s, 4)
+            out["mesh_scale_jobs"] = scale_jobs
+            out["mesh_scale_nodes"] = scale_nodes
+            out["mesh_scale_scheduled_per_cycle"] = scale_sched
+    finally:
+        mesh_serving().configure(0)
+    return out
 
 
 def _soak_bench() -> dict:
@@ -836,6 +922,13 @@ def main():
         line.update(
             _sidecar_bench(
                 num_jobs, num_nodes, num_queues, num_runs, repeats, burst
+            )
+        )
+    if os.environ.get("ARMADA_BENCH_MESH", "0") not in ("", "0"):
+        line.update(
+            _mesh_bench(
+                num_jobs, num_nodes, num_queues, num_runs, repeats, burst,
+                platform,
             )
         )
     if os.environ.get("ARMADA_BENCH_SOAK", "1") != "0":

@@ -105,6 +105,10 @@ _FAST_MODULES = {
     # loadgen arrival/mix/lifecycle machinery (no kernel compiles).
     "tests/test_slo_metrics.py",
     "tests/test_loadgen.py",
+    # mesh serving plane: kernel compiles, but all at tiny bucket-64 shapes
+    # on the 8-device virtual mesh (~30s whole); the fast tier must carry
+    # BOTH the churn equality (burst incl.) and the degrade-ladder drill.
+    "tests/test_mesh_serving.py",
 }
 # How many representative tests each remaining module contributes.
 _FAST_PICKS = 2

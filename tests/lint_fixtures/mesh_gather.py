@@ -1,0 +1,14 @@
+# Fixture for rule `mesh-gather` (linted under armada_tpu/scheduler/).
+import jax
+import jax.numpy as jnp
+
+
+def reupload_problem(problem, cpu):
+    moved = jax.device_put(problem.node_total, cpu)  # TP
+    # near-miss: jnp.asarray leaves placement to the backend default --
+    # it never re-places (or gathers) an already-sharded slab array
+    local = jnp.asarray(problem.node_total)
+    # near-miss: addressable_shards (plural, shard metadata) is the test
+    # suite's inspection surface, not a single-shard data read
+    shapes = {s.data.shape for s in problem.node_total.addressable_shards}
+    return moved, local, shapes

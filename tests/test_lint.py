@@ -50,6 +50,7 @@ RULE_FIXTURES = {
         "atomic_state_file.py",
         "armada_tpu/fixture.py",
     ),
+    "mesh-gather": ("mesh_gather.py", "armada_tpu/scheduler/fixture.py"),
 }
 
 

@@ -51,16 +51,18 @@ def build_world(cfg, num_nodes, num_queues):
 
 def run_script(
     *, cycles, seed, jobs0, burst, num_nodes, num_queues, fault, fault_cycle,
-    prefetch, deadline_s=30.0,
+    prefetch, deadline_s=30.0, mesh=0,
 ):
     """One deterministic multi-cycle run; returns per-cycle decision lists.
     `fault` is None (clean replay) or "hang"/"error" injected at
-    `fault_cycle`."""
+    `fault_cycle`.  `mesh` >= 2 arms the mesh serving plane (the chip-loss
+    drill: the faulted cycle must degrade to a SMALLER mesh, never CPU)."""
     from armada_tpu.analysis import tsan
     from armada_tpu.core import faults, watchdog
     from armada_tpu.core.config import PriorityClass, SchedulingConfig
     from armada_tpu.core.types import JobSpec, RunningJob
     from armada_tpu.models import run_round_on_device
+    from armada_tpu.parallel.serving import reset_mesh_serving
     from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
 
     # The FAULTED leg arms the race harness (analysis/tsan): the watchdog
@@ -82,6 +84,10 @@ def run_script(
     # platform IS the device under test) without paying a subprocess per
     # poll in a drill loop
     sup._probe = lambda timeout_s: (True, "chaos-stub")
+    ms = reset_mesh_serving()
+    if mesh:
+        ms.configure(mesh)
+        ms._probe = lambda timeout_s: (True, "chaos-stub")
     if fault:
         # after_n = number of device-round checks before the injected cycle
         os.environ["ARMADA_FAULT"] = f"device_round:{fault}:{fault_cycle}"
@@ -154,7 +160,7 @@ def run_script(
         submit(burst)
         if prefetch:
             b.prefetch_content(feed.devcaches["default"])
-    return decisions, sup
+    return decisions, sup, ms
 
 
 def main() -> int:
@@ -186,15 +192,38 @@ def main() -> int:
         "+ log-suffix replay; asserts zero dropped/double-leased jobs, zero "
         "tsan violations, and reports RTO (restart_recovery_s)",
     )
+    ap.add_argument(
+        "--mesh",
+        type=int,
+        default=0,
+        help="arm the mesh serving plane over N (virtual) devices: the "
+        "chip-loss drill -- the faulted cycle must degrade to a SMALLER "
+        "mesh (never CPU: supervisor fallbacks stay 0), re-shard, restore "
+        "to the full mesh, and every cycle's decisions must stay bit-equal "
+        "to the clean replay (docs/multichip.md runbook)",
+    )
     args = ap.parse_args()
+
+    if args.mesh:
+        # The drill must run anywhere: give the CPU platform enough virtual
+        # devices to host the mesh (only effective before the first jax
+        # import; harmless when a real accelerator backend is the default).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.mesh}"
+            ).strip()
 
     rng = random.Random(args.seed)
     fault = rng.choice(["error", "hang"])
     fault_cycle = rng.randrange(1, max(2, args.cycles - 1))
     common = dict(
         # hang drills ride a tight deadline so the drill stays fast; it
-        # still dwarfs any legit CPU round at this world size
-        deadline_s=3.0 if fault == "hang" else 30.0,
+        # still dwarfs any legit CPU round at this world size.  Mesh mode
+        # keeps the full deadline: the degrade rerun compiles a fresh
+        # sharded kernel, which a 3s deadline would misread as a second
+        # loss and walk the whole ladder down to CPU.
+        deadline_s=3.0 if fault == "hang" and not args.mesh else 30.0,
         cycles=args.cycles,
         seed=args.seed,
         jobs0=args.jobs,
@@ -202,18 +231,38 @@ def main() -> int:
         num_nodes=args.nodes,
         num_queues=args.queues,
         prefetch=args.prefetch,
+        mesh=args.mesh,
     )
     t0 = time.monotonic()
-    chaotic, sup = run_script(fault=fault, fault_cycle=fault_cycle, **common)
+    chaotic, sup, ms = run_script(fault=fault, fault_cycle=fault_cycle, **common)
     chaos_s = time.monotonic() - t0
     snap = sup.snapshot()
-    # convergence half 1: the supervisor recovered (stubbed-healthy probe)
-    deadline = time.monotonic() + 10.0
-    while sup.degraded and time.monotonic() < deadline:
-        time.sleep(0.05)
-    promoted = not sup.degraded
+    mesh_snap = ms.snapshot()
+    if args.mesh:
+        # convergence half 1 (mesh mode): the faulted cycle stepped DOWN the
+        # mesh ladder (never to CPU) and the stubbed-healthy probe restores
+        # the full mesh.
+        deadline = time.monotonic() + 10.0
+        while (
+            ms.snapshot()["devices"] < args.mesh
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        promoted = ms.snapshot()["devices"] == args.mesh
+        mesh_ok = (
+            mesh_snap["degrades"] >= 1
+            and snap["fallbacks"] == 0
+            and not sup.degraded
+        )
+    else:
+        # convergence half 1: the supervisor recovered (stubbed-healthy probe)
+        deadline = time.monotonic() + 10.0
+        while sup.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        promoted = not sup.degraded
+        mesh_ok = True
 
-    clean, _ = run_script(fault=None, fault_cycle=0, **common)
+    clean, _, _ = run_script(fault=None, fault_cycle=0, **common)
 
     # Harvest AFTER both legs: the harness stayed armed, so a zombie worker
     # unwedging during the promoted-wait or the clean replay still lands in
@@ -259,7 +308,7 @@ def main() -> int:
 
     ok = (
         chaotic == clean
-        and snap["fallbacks"] >= 1
+        and (snap["fallbacks"] >= 1 if not args.mesh else mesh_ok)
         and promoted
         and not tsan_found
         and (soak_report is None or soak_report["ok"])
@@ -279,6 +328,13 @@ def main() -> int:
         "chaos_run_s": round(chaos_s, 2),
         "tsan_violations": len(tsan_found),
     }
+    if args.mesh:
+        line["mesh"] = {
+            "requested": args.mesh,
+            "degrades": mesh_snap["degrades"],
+            "restored": promoted,
+            "cpu_fallbacks": snap["fallbacks"],
+        }
     if tsan_found:
         line["tsan_detail"] = tsan_found[:5]
     if soak_report is not None:

@@ -6,17 +6,121 @@ __graft_entry__.dryrun_multichip's tiny-shape compile check.
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/multichip_scale.py [out.json]
 
+Round 12 adds the STEADY-CYCLE leg: the same delta-driven cycle the
+serving plane runs (incremental builder -> slab delta -> sharded scatter
+apply -> sharded kernel -> compact decode) through the mesh serving
+subsystem's MeshDeviceDeltaCache, A/B'd against the single-device
+DeviceDeltaCache per cycle -- cold full-problem rounds alone say nothing
+about the path production takes.  ARMADA_SCALE_STEADY_{JOBS,NODES,CYCLES}
+downscale.
+
 On the virtual CPU mesh the numbers measure CORRECTNESS + compiled
 collective overhead on one physical socket (expect slower than single);
 on a real v5e-8 the same program's node-axis reductions ride ICI.
-docs/bench.md carries the analysis.
+docs/bench.md + docs/multichip.md carry the analysis.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+
+def _steady_cycle_ab() -> dict:
+    """Delta-driven steady cycles: MeshDeviceDeltaCache (8-dev node-sharded
+    slab) vs DeviceDeltaCache, decisions compared exactly every cycle."""
+    from armada_tpu.core.types import RunningJob
+    from armada_tpu.models import decode_result, schedule_round
+    from armada_tpu.models.incremental import IncrementalBuilder
+    from armada_tpu.models.slab import DeviceDeltaCache
+    from armada_tpu.models.synthetic import synthetic_world
+    from armada_tpu.models.xfer import TRANSFER_STATS
+    from armada_tpu.parallel.mesh_slab import MeshDeviceDeltaCache
+    from armada_tpu.parallel.serving import mesh_serving
+
+    num_jobs = int(os.environ.get("ARMADA_SCALE_STEADY_JOBS", 100_000))
+    num_nodes = int(os.environ.get("ARMADA_SCALE_STEADY_NODES", 5_000))
+    cycles = int(os.environ.get("ARMADA_SCALE_STEADY_CYCLES", 3))
+    burst = 500
+    mesh_serving().configure(8)
+    try:
+        config, nodes, queues, specs, running, spec_factory = synthetic_world(
+            num_nodes=num_nodes,
+            num_jobs=num_jobs,
+            num_queues=32,
+            num_runs=num_nodes // 2,
+            seed=11,
+        )
+
+        def build():
+            b = IncrementalBuilder(config, "default", queues)
+            b.set_nodes(nodes)
+            b.submit_many(specs)
+            for r in running:
+                b.lease(r)
+            return b
+
+        arms = {
+            "single": (build(), DeviceDeltaCache()),
+            "mesh": (build(), MeshDeviceDeltaCache()),
+        }
+        spec_of = {s.id: s for s in specs}
+        identical = True
+        times = {"single": [], "mesh": []}
+        xfer = {}
+        for cyc in range(cycles + 1):  # cycle 0 = compile + full upload
+            outs = {}
+            for arm, (b, cache) in arms.items():
+                TRANSFER_STATS.reset()
+                t0 = time.perf_counter()
+                bundle, ctx = b.assemble_delta()
+                dev = cache.apply(bundle)
+                res = schedule_round(
+                    dev,
+                    num_levels=len(ctx.ladder) + 2,
+                    max_slots=ctx.max_slots,
+                    slot_width=ctx.slot_width,
+                )
+                outs[arm] = decode_result(res, ctx)
+                if cyc > 0:
+                    times[arm].append(time.perf_counter() - t0)
+                    xfer[arm] = TRANSFER_STATS.snapshot()
+            a, m = outs["single"], outs["mesh"]
+            if a.scheduled != m.scheduled or a.preempted != m.preempted:
+                identical = False
+                print(f"steady cycle {cyc} DIVERGED", file=sys.stderr)
+            fresh = spec_factory(burst, 1000.0 + cyc)
+            for s in fresh:
+                spec_of[s.id] = s
+            for arm, (b, _cache) in arms.items():
+                b.remove_many(a.scheduled.keys())
+                b.lease_many(
+                    [
+                        RunningJob(job=spec_of[j], node_id=n)
+                        for j, n in a.scheduled.items()
+                        if j in spec_of
+                    ]
+                )
+                for jid in a.preempted:
+                    b.unlease(jid)
+                b.submit_many(fresh)
+        out = {
+            "shape": {"num_jobs": num_jobs, "num_nodes": num_nodes, "burst": burst},
+            "cycles": cycles,
+            "identical": identical,
+            "xfer_single": xfer.get("single", {}),
+            "xfer_mesh": xfer.get("mesh", {}),
+        }
+        # cycles=0 runs only the compile/upload cycle (equality still
+        # checked) -- no timed steady cycles to report.
+        if times["single"] and times["mesh"]:
+            out["cycle_single_s"] = round(min(times["single"]), 4)
+            out["cycle_mesh_s"] = round(min(times["mesh"]), 4)
+        return out
+    finally:
+        mesh_serving().configure(0)
 
 
 def main(out_path: str = "MULTICHIP_SCALE.json") -> int:
@@ -122,11 +226,23 @@ def main(out_path: str = "MULTICHIP_SCALE.json") -> int:
             flush=True,
         )
 
+    # --- steady cycle (the serving plane's actual path) --------------------
+    print("steady-cycle A/B (delta-driven, mesh slab cache)...", flush=True)
+    steady = _steady_cycle_ab()
+    identical = identical and steady["identical"]
+    print(
+        f"steady cycle: identical={steady['identical']} "
+        f"single={steady.get('cycle_single_s', 'n/a')}s "
+        f"mesh={steady.get('cycle_mesh_s', 'n/a')}s",
+        flush=True,
+    )
+
     n_devices = 8
     doc = {
         "shape": shape,
         "devices": n_devices,
         "identical": identical,
+        "steady_cycle": steady,
         "scheduled": int(np.asarray(single.scheduled_count)),
         "iterations": int(np.asarray(single.iterations)),
         "single_phases_s": {
