@@ -1,0 +1,1152 @@
+"""Intra-procedural dataflow for armada-lint v2: def-use + provenance.
+
+The costliest hard-won constraints in CLAUDE.md are *semantic*, not
+syntactic -- "nothing computed in the while-loop body from a gathered row"
+(a 6x regression), "big arrays must not thread through cond/switch branch
+returns", "jit programs scattering into sharded slabs must pin
+out_shardings" (round 12's silent slab gather).  A per-node AST matcher
+cannot express "is this value derived from X"; this module can, cheaply:
+
+* a per-function CFG (basic blocks over the statement list, with loop
+  back-edges, branch joins and try-handler edges);
+* a forward fixpoint over a small provenance lattice -- each value carries
+  a set of tags, joined by union at control-flow merges;
+* a one-hop call summary for module-local helpers (the callee is analyzed
+  once per distinct argument-tag signature; calls *inside* the callee are
+  treated generically, so analysis depth is bounded by construction);
+* resolution of jax higher-order callables: `lax.while_loop`/`fori_loop`
+  bodies, `lax.cond`/`switch` branches and `jax.jit`-traced functions are
+  resolved through local def-use (including the repo's `body =
+  make_body(...)` idiom, via the helper's returned inner def).
+
+Tags (the lattice is the powerset of these, ordered by inclusion):
+
+``gather``   read through a dynamically-indexed gather (``x[i]`` with a
+             traced index, ``jnp.take``, ``dynamic_slice``).  KILLED by
+             reductions (``sum``/``min``/``argmin``/...) -- an argmin
+             *result* is a fresh scalar, not a gathered row.
+``carry``    derived from the analyzed function's own parameters (the loop
+             carry, or a jit-traced function's operands).
+``ext``      derived from the closure/module environment -- loop-INVARIANT
+             from the body's point of view.
+``whole``    whole-buffer provenance: the value IS one of the big input
+             buffers (a carry field, a closure table), possibly scattered
+             into.  Preserved only by shape-preserving whole-buffer ops
+             (``.at[...].set/add``, ``jnp.where``/``select``, ``astype``,
+             ``reshape``, broadcast subscripts ``[:, None]``); killed by
+             element arithmetic, reductions, and real subscripts -- so a
+             freshly computed [N] row is NOT whole, which is exactly the
+             sanctioned "pass rows out of the switch" idiom.
+``py``       trace-time python static (shapes, ``range`` counts, constants).
+             A gathered scalar times a static int is index arithmetic, not
+             a hoisting hazard; rules use this to tell tables from shapes.
+``shard``    mesh-sharded provenance: the value flowed through an explicit
+             placement (``jax.device_put(x, sharding)``), a sharding
+             constructor (``NamedSharding``/``PositionalSharding``) or the
+             repo's sharding factories (``problem_shardings``/
+             ``shard_problem``).  Sticky through arithmetic, selects,
+             scatters and generic calls -- a derived view of a sharded
+             slab is still sharded; the unpinned-out-shardings rule keys
+             on it.
+
+Approximations are deliberate and documented where they matter: scatter
+results carry the BASE buffer's provenance (the scattered value does not
+taint the buffer -- rules inspect scatter sites directly), attribute reads
+inherit the object's tags, and unknown calls union their argument tags
+minus ``whole``/``py``.  The engine is stdlib-``ast`` only and makes no
+attempt at inter-procedural soundness beyond the one-hop summaries --
+rules built on it trade completeness for zero-dependency speed, and every
+rule is pinned by a true-positive + syntactic-twin fixture so lattice
+regressions fail in tests/test_dataflow.py or tests/test_lint.py, not in
+review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+GATHER = "gather"
+CARRY = "carry"
+EXT = "ext"
+WHOLE = "whole"
+PY = "py"
+SHARD = "shard"
+
+EMPTY: frozenset = frozenset()
+_ARRAYISH = frozenset({GATHER, CARRY, EXT, WHOLE, SHARD})
+
+# Bounded work: fixpoint passes per function and helper-summary depth.
+_MAX_PASSES = 40
+_MAX_DEPTH = 6
+
+
+def dotted(node: ast.AST) -> str:
+    """`a.b.c` for Attribute/Name chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def at_scatter(call: ast.Call):
+    """(base_expr, index_expr, method) when `call` is
+    `<base>.at[<index>].<method>(...)`, else None."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    sub = f.value
+    if not (
+        isinstance(sub, ast.Subscript)
+        and isinstance(sub.value, ast.Attribute)
+        and sub.value.attr == "at"
+    ):
+        return None
+    return sub.value.value, sub.slice, f.attr
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+# Call classification by final name component (jnp.sum, x.sum, np.sum all
+# behave the same for provenance purposes).
+_REDUCERS = {
+    "sum", "min", "max", "argmin", "argmax", "any", "all", "mean", "prod",
+    "nonzero", "count_nonzero", "segment_min", "segment_max", "segment_sum",
+}
+_WHERE_LIKE = {"where", "select"}
+_WHOLE_PRESERVING = {"astype", "reshape", "copy"}
+_GATHER_ADDERS = {"take", "take_along_axis", "dynamic_slice", "dynamic_slice_in_dim"}
+# Sharding constructors/factories: results carry SHARD.  `device_put` adds
+# it only when an explicit placement argument is visible at the call.
+_SHARD_MAKERS = {
+    "NamedSharding", "PositionalSharding", "problem_shardings", "shard_problem",
+}
+_PY_KEEPERS = {"range", "len", "reversed", "enumerate", "int", "bool", "abs"}
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+_LOOP_CALLS = {
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+}
+_BRANCH_CALLS = {"jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch"}
+
+
+# --------------------------------------------------------------------------
+# CFG
+# --------------------------------------------------------------------------
+
+class _CFG:
+    """Basic blocks of statements + successor edges.  Block 0 is entry;
+    the virtual exit has no block (returns record into the analysis)."""
+
+    def __init__(self) -> None:
+        self.blocks: list[list[ast.stmt]] = []
+        self.succ: list[set[int]] = []
+
+    def new(self) -> int:
+        self.blocks.append([])
+        self.succ.append(set())
+        return len(self.blocks) - 1
+
+    def edge(self, a: int, b: int) -> None:
+        self.succ[a].add(b)
+
+
+def _build_cfg(body: list[ast.stmt]) -> _CFG:
+    cfg = _CFG()
+    entry = cfg.new()
+
+    # (header_block, after_block) per enclosing loop, for continue/break.
+    loop_stack: list[tuple[int, int]] = []
+
+    def emit(stmts: list[ast.stmt], cur: int) -> int:
+        """Append stmts starting at block `cur`; return the live exit block
+        (a fresh empty block when flow falls through)."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                cfg.blocks[cur].append(stmt)  # evaluates the test
+                then_b = cfg.new()
+                cfg.edge(cur, then_b)
+                then_end = emit(stmt.body, then_b)
+                join = cfg.new()
+                cfg.edge(then_end, join)
+                if stmt.orelse:
+                    else_b = cfg.new()
+                    cfg.edge(cur, else_b)
+                    cfg.edge(emit(stmt.orelse, else_b), join)
+                else:
+                    cfg.edge(cur, join)
+                cur = join
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                header = cfg.new()
+                cfg.edge(cur, header)
+                cfg.blocks[header].append(stmt)  # test / target binding
+                after = cfg.new()
+                body_b = cfg.new()
+                cfg.edge(header, body_b)
+                cfg.edge(header, after)
+                loop_stack.append((header, after))
+                body_end = emit(stmt.body, body_b)
+                loop_stack.pop()
+                cfg.edge(body_end, header)  # back edge
+                if stmt.orelse:
+                    else_b = cfg.new()
+                    cfg.edge(header, else_b)
+                    cfg.edge(emit(stmt.orelse, else_b), after)
+                cur = after
+            elif isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                body_b = cfg.new()
+                cfg.edge(cur, body_b)
+                body_end = emit(stmt.body, body_b)
+                join = cfg.new()
+                for handler in stmt.handlers:
+                    h_b = cfg.new()
+                    # an exception may fire anywhere in the body: edge from
+                    # both the entry and the exit of the protected region
+                    cfg.edge(body_b, h_b)
+                    cfg.edge(body_end, h_b)
+                    cfg.edge(emit(handler.body, h_b), join)
+                if stmt.orelse:
+                    else_b = cfg.new()
+                    cfg.edge(body_end, else_b)
+                    cfg.edge(emit(stmt.orelse, else_b), join)
+                else:
+                    cfg.edge(body_end, join)
+                if stmt.finalbody:
+                    fin_b = cfg.new()
+                    cfg.edge(join, fin_b)
+                    join = emit(stmt.finalbody, fin_b)
+                cur = join
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                cfg.blocks[cur].append(stmt)  # evaluates context exprs
+                cur = emit(stmt.body, cur)
+            elif isinstance(stmt, (ast.Break, ast.Continue)):
+                if loop_stack:
+                    header, after = loop_stack[-1]
+                    cfg.edge(cur, after if isinstance(stmt, ast.Break) else header)
+                cur = cfg.new()  # dead fallthrough
+            elif isinstance(stmt, (ast.Return, ast.Raise)):
+                cfg.blocks[cur].append(stmt)
+                cur = cfg.new()  # dead fallthrough
+            else:
+                cfg.blocks[cur].append(stmt)
+        return cur
+
+    emit(body, entry)
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# analysis records
+# --------------------------------------------------------------------------
+
+class ScatterSite:
+    """One `<base>.at[<index>].<method>(<value>)` occurrence."""
+
+    __slots__ = ("call", "base", "index", "method", "base_tags", "index_tags", "value_tags")
+
+    def __init__(self, call, base, index, method, base_tags, index_tags, value_tags):
+        self.call = call
+        self.base = base
+        self.index = index
+        self.method = method
+        self.base_tags = base_tags
+        self.index_tags = index_tags
+        self.value_tags = value_tags
+
+
+class BranchSite:
+    """One lax.cond/lax.switch call with its resolved branch analyses."""
+
+    __slots__ = ("call", "branches")
+
+    def __init__(self, call, branches):
+        self.call = call
+        self.branches = branches  # list[FunctionAnalysis]
+
+
+class LoopSite:
+    """One lax.while_loop/fori_loop call with its resolved body analyses."""
+
+    __slots__ = ("call", "bodies")
+
+    def __init__(self, call, bodies):
+        self.call = call
+        self.bodies = bodies  # list[FunctionAnalysis]
+
+
+class JitSite:
+    """One jax.jit application (decorator or direct call).
+
+    `out_shardings`: True (kwarg present), False (definitely absent), or
+    None (a ``**kwargs`` splat hides the call signature statically)."""
+
+    __slots__ = ("node", "fn", "analysis", "out_shardings")
+
+    def __init__(self, node, fn, analysis, out_shardings):
+        self.node = node
+        self.fn = fn
+        self.analysis = analysis
+        self.out_shardings = out_shardings
+
+
+# --------------------------------------------------------------------------
+# per-function analysis
+# --------------------------------------------------------------------------
+
+class FunctionAnalysis:
+    """CFG + fixpoint + annotation for one function (or module) body.
+
+    After construction: `tags(node)` answers provenance for any expression
+    node in this function or its nested defs; `scatters`, `branch_sites`
+    and `returns` hold the recorded sites; `exit_env` is the name->tags
+    environment at function exit (tests pin the lattice through it)."""
+
+    def __init__(
+        self,
+        ma: "ModuleAnalysis",
+        fn,  # ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda | ast.Module
+        seeds: Optional[dict] = None,
+        closure: Optional[dict] = None,
+        depth: int = 0,
+    ):
+        self.ma = ma
+        self.fn = fn
+        self.depth = depth
+        self.closure = dict(closure or {})
+        self.node_tags: dict[int, frozenset] = {}
+        self.scatters: list[ScatterSite] = []
+        self.branch_sites: list[BranchSite] = []
+        self.returns: list[tuple[ast.AST, Optional[ast.AST], frozenset]] = []
+        self.return_tags: frozenset = EMPTY
+        self.children: dict[int, "FunctionAnalysis"] = {}
+        self.def_site_env: dict[int, dict] = {}
+        self._local_defs: dict[str, list] = {}
+
+        if isinstance(fn, ast.Module):
+            body = fn.body
+            params: list[str] = []
+        elif isinstance(fn, ast.Lambda):
+            ret = ast.Return(value=fn.body)
+            ast.copy_location(ret, fn.body)
+            body = [ret]
+            params = [a.arg for a in _all_args(fn.args)]
+        else:
+            body = fn.body
+            params = [a.arg for a in _all_args(fn.args)]
+
+        self._collect_local_defs(body)
+        init_env: dict[str, frozenset] = {}
+        seeds = seeds or {}
+        for p in params:
+            init_env[p] = frozenset(seeds.get(p, {CARRY, WHOLE}))
+        self._run(body, init_env)
+
+    # ----------------------------------------------------------- queries ---
+
+    def tags(self, node: ast.AST) -> frozenset:
+        t = self.node_tags.get(id(node))
+        if t is not None:
+            return t
+        for child in self.children.values():
+            t = child.tags(node)
+            if t:
+                return t
+        return EMPTY
+
+    def tree(self) -> Iterable["FunctionAnalysis"]:
+        """This analysis + every nested-def analysis, depth-first."""
+        yield self
+        for child in self.children.values():
+            yield from child.tree()
+
+    def all_scatters(self) -> Iterable[ScatterSite]:
+        for fa in self.tree():
+            yield from fa.scatters
+
+    def all_branch_sites(self) -> Iterable[BranchSite]:
+        for fa in self.tree():
+            yield from fa.branch_sites
+
+    def name_tags(self, name: str) -> frozenset:
+        return self.exit_env.get(name, EMPTY)
+
+    # ----------------------------------------------------- def resolution ---
+
+    def _collect_local_defs(self, body: list[ast.stmt]) -> None:
+        """Name -> candidate def nodes / aliases, flow-insensitively, for
+        resolving callables passed to jax control-flow primitives."""
+
+        def scan(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._local_defs.setdefault(stmt.name, []).append(stmt)
+                    continue  # do not descend into nested scopes
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            self._local_defs.setdefault(tgt.id, []).append(stmt.value)
+                # descend into compound-statement bodies only (same scope)
+                if isinstance(
+                    stmt,
+                    (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With, ast.AsyncWith, ast.Try),
+                ):
+                    for field in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, field, None)
+                        if sub:
+                            scan(sub)
+                    for handler in getattr(stmt, "handlers", []):
+                        scan(handler.body)
+
+        scan(body)
+
+    def resolve_callables(self, expr: ast.AST, _seen=None) -> list[tuple[ast.AST, "FunctionAnalysis | None"]]:
+        """Candidate (def node, defining analysis) pairs for a callable
+        expression: a direct def/lambda, a Name bound to one, or a Name
+        bound to a call of a module-local factory (one hop through its
+        `return <inner def>`)."""
+        if _seen is None:
+            _seen = set()
+        out: list[tuple[ast.AST, Optional[FunctionAnalysis]]] = []
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return [(expr, self)]
+        if isinstance(expr, ast.Name):
+            if expr.id in _seen:
+                return out
+            _seen.add(expr.id)
+            for cand in self._local_defs.get(expr.id, []):
+                if isinstance(cand, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append((cand, self))
+                elif isinstance(cand, ast.Name):
+                    out.extend(self.resolve_callables(cand, _seen))
+                elif isinstance(cand, ast.Call):
+                    out.extend(self._resolve_factory(cand))
+            if not out and self.ma.parent_of(self) is not None:
+                out.extend(self.ma.parent_of(self).resolve_callables(expr, _seen))
+            if not out:
+                mod_def = self.ma.module_defs.get(expr.id)
+                if mod_def is not None:
+                    out.append((mod_def, self.ma.module_fa))
+        return out
+
+    def resolve_callable_list(self, expr: ast.AST) -> list[tuple[ast.AST, "FunctionAnalysis | None"]]:
+        """For lax.switch's branch list: a literal [f, g, ...] or a Name
+        bound to one."""
+        exprs: list[ast.AST] = []
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            exprs = list(expr.elts)
+        elif isinstance(expr, ast.Name):
+            for cand in self._local_defs.get(expr.id, []):
+                if isinstance(cand, (ast.List, ast.Tuple)):
+                    exprs.extend(cand.elts)
+        out = []
+        for e in exprs:
+            out.extend(self.resolve_callables(e))
+        return out
+
+    def _resolve_factory(self, call: ast.Call):
+        """`body = make_body(...)` -> make_body's `return <inner def>`."""
+        fname = dotted(call.func)
+        target = self.ma.module_defs.get(fname)
+        if target is None:
+            return []
+        factory_fa = self.ma.function_analysis(target)
+        out = []
+        for ret_node, value, _tags in factory_fa.returns:
+            if isinstance(value, ast.Name):
+                for cand, fa in factory_fa.resolve_callables(value):
+                    out.append((cand, fa))
+            elif isinstance(value, (ast.FunctionDef, ast.Lambda)):
+                out.append((value, factory_fa))
+        return out
+
+    # ---------------------------------------------------------- fixpoint ---
+
+    def _run(self, body: list[ast.stmt], init_env: dict) -> None:
+        cfg = _build_cfg(body)
+        n = len(cfg.blocks)
+        in_env: list[Optional[dict]] = [None] * n
+        in_env[0] = dict(init_env)
+        work = [0]
+        passes = 0
+        while work and passes < _MAX_PASSES * n:
+            passes += 1
+            b = work.pop()
+            env = dict(in_env[b] or {})
+            for stmt in cfg.blocks[b]:
+                self._exec(stmt, env, record=False)
+            for s in cfg.succ[b]:
+                merged = _join(in_env[s], env)
+                if merged is not None:
+                    in_env[s] = merged
+                    if s not in work:
+                        work.append(s)
+        # annotation pass: record node tags + sites with converged envs
+        exit_env: dict[str, frozenset] = {}
+        for b in range(n):
+            env = dict(in_env[b] or {})
+            for stmt in cfg.blocks[b]:
+                self._exec(stmt, env, record=True)
+            if not cfg.succ[b]:
+                _join_into(exit_env, env)
+        self.exit_env = exit_env
+        self.return_tags = frozenset().union(*(t for _, _, t in self.returns)) if self.returns else EMPTY
+
+    # ------------------------------------------------------- statements ----
+
+    def _exec(self, stmt: ast.stmt, env: dict, record: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[stmt.name] = EMPTY
+            if record and self.depth < _MAX_DEPTH:
+                self._child(stmt, env)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            env[stmt.name] = EMPTY
+            if record and self.depth < _MAX_DEPTH:
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._child(sub, env)
+            return
+        if isinstance(stmt, ast.Assign):
+            val = self._eval(stmt.value, env, record)
+            for tgt in stmt.targets:
+                self._bind(tgt, val, env, record)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                val = self._eval(stmt.value, env, record)
+                self._bind(stmt.target, val, env, record)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            val = self._eval(stmt.value, env, record)
+            if isinstance(stmt.target, ast.Name):
+                old = env.get(stmt.target.id, EMPTY)
+                env[stmt.target.id] = _arith(old | val)
+            else:
+                self._bind(stmt.target, val, env, record)
+            return
+        if isinstance(stmt, ast.Return):
+            t = self._eval(stmt.value, env, record) if stmt.value is not None else EMPTY
+            if record:
+                self.returns.append((stmt, stmt.value, t))
+            return
+        if isinstance(stmt, (ast.While, ast.If)):
+            self._eval(stmt.test, env, record)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._bind(stmt.target, self._iter_tags(stmt.iter, env, record), env, record)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                t = self._eval(item.context_expr, env, record)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, t, env, record)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env, record)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env, record)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    env.pop(tgt.id, None)
+            return
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                name = (alias.asname or alias.name).split(".")[0]
+                env[name] = EMPTY  # modules/callables carry no provenance
+            return
+        # Global/Nonlocal/Pass: no provenance effect.
+
+    def _child(self, fn, env: dict) -> None:
+        """Eagerly analyze a nested def in the env at its def site; these
+        children answer tags() for nodes inside nested scopes (cond/switch
+        branches, helper closures) under THIS analysis's seeds."""
+        self.def_site_env[id(fn)] = dict(env)
+        if id(fn) not in self.children:
+            self.children[id(fn)] = FunctionAnalysis(
+                self.ma, fn,
+                seeds={a.arg: frozenset({EXT, WHOLE}) for a in _all_args(fn.args)},
+                closure=_closure_of(env, self.closure),
+                depth=self.depth + 1,
+            )
+            self.ma._register(self.children[id(fn)], self)
+
+    def _iter_tags(self, it: ast.AST, env: dict, record: bool) -> frozenset:
+        t = self._eval(it, env, record)
+        if isinstance(it, ast.Call) and _last(dotted(it.func)) in _PY_KEEPERS:
+            return frozenset({PY})
+        if isinstance(it, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) for e in it.elts
+        ):
+            return frozenset({PY})
+        return t - {WHOLE}  # iterating a buffer yields rows, not the buffer
+
+    def _bind(self, tgt: ast.AST, val: frozenset, env: dict, record: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = val
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._bind(e, val, env, record)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, val, env, record)
+        elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            # a store into a container/attribute merges provenance into the
+            # root name (def-use continues through the mutated object)
+            if isinstance(tgt, ast.Subscript):
+                self._eval(tgt.slice, env, record)
+            root = tgt
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            if isinstance(root, ast.Name):
+                env[root.id] = env.get(root.id, EMPTY) | (val - {WHOLE})
+
+    # ------------------------------------------------------- expressions ---
+
+    def _eval(self, node: ast.AST, env: dict, record: bool) -> frozenset:
+        t = self._eval_inner(node, env, record)
+        if record:
+            self.node_tags[id(node)] = t
+        return t
+
+    def _eval_inner(self, node: ast.AST, env: dict, record: bool) -> frozenset:
+        if isinstance(node, ast.Constant):
+            return frozenset({PY})
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            if node.id in self.closure:
+                return self.closure[node.id]
+            if node.id in self.ma.module_env:
+                return self.ma.module_env[node.id]
+            return frozenset({EXT})
+        if isinstance(node, ast.Attribute):
+            base = self._eval(node.value, env, record)
+            if node.attr in _SHAPE_ATTRS:
+                return frozenset({PY})
+            return base
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node, env, record)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env, record)
+            right = self._eval(node.right, env, record)
+            return _arith(left | right)
+        if isinstance(node, ast.BoolOp):
+            u = frozenset().union(*(self._eval(v, env, record) for v in node.values))
+            return _arith(u)
+        if isinstance(node, ast.Compare):
+            u = self._eval(node.left, env, record)
+            for c in node.comparators:
+                u = u | self._eval(c, env, record)
+            return _arith(u)
+        if isinstance(node, ast.UnaryOp):
+            return _arith(self._eval(node.operand, env, record))
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, record)
+            # like jnp.where: a whole-buffer pick stays whole
+            return self._eval(node.body, env, record) | self._eval(node.orelse, env, record)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, record)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            if not node.elts:
+                return EMPTY
+            return frozenset().union(*(self._eval(e, env, record) for e in node.elts))
+        if isinstance(node, ast.Dict):
+            parts = [self._eval(v, env, record) for v in node.values if v is not None]
+            for k in node.keys:
+                if k is not None:
+                    self._eval(k, env, record)
+            return frozenset().union(*parts) if parts else EMPTY
+        if isinstance(node, ast.NamedExpr):  # walrus: binds AND yields
+            val = self._eval(node.value, env, record)
+            self._bind(node.target, val, env, record)
+            return val
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env, record)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            inner = dict(env)
+            for gen in node.generators:
+                self._bind(gen.target, self._iter_tags(gen.iter, inner, record), inner, record)
+                for cond in gen.ifs:
+                    self._eval(cond, inner, record)
+            if isinstance(node, ast.DictComp):
+                self._eval(node.key, inner, record)
+                return self._eval(node.value, inner, record)
+            return self._eval(node.elt, inner, record)
+        if isinstance(node, ast.Lambda):
+            return EMPTY
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self._eval(v, env, record)
+            return frozenset({PY})
+        if isinstance(node, ast.FormattedValue):
+            return self._eval(node.value, env, record)
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._eval(part, env, record)
+            return EMPTY
+        # conservative default
+        u = EMPTY
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                u = u | self._eval(child, env, record)
+        return u
+
+    def _index_parts(self, index: ast.AST) -> list[ast.AST]:
+        return list(index.elts) if isinstance(index, ast.Tuple) else [index]
+
+    def _index_static(self, part: ast.AST, env: dict) -> bool:
+        """A trace-time-static index component: constants, python-static
+        names/arithmetic, or slices of those."""
+        if isinstance(part, ast.Constant):
+            return True
+        if isinstance(part, ast.Slice):
+            return all(
+                p is None or self._index_static(p, env)
+                for p in (part.lower, part.upper, part.step)
+            )
+        if isinstance(part, ast.UnaryOp):
+            return self._index_static(part.operand, env)
+        if isinstance(part, ast.BinOp):
+            return self._index_static(part.left, env) and self._index_static(part.right, env)
+        if isinstance(part, ast.Name):
+            return PY in env.get(part.id, self.closure.get(part.id, EMPTY))
+        return False
+
+    def _index_broadcast(self, part: ast.AST) -> bool:
+        """A pure broadcast component (`:` or None) -- keeps WHOLE."""
+        if isinstance(part, ast.Slice):
+            return part.lower is None and part.upper is None and part.step is None
+        return isinstance(part, ast.Constant) and part.value is None
+
+    def _eval_subscript(self, node: ast.Subscript, env: dict, record: bool) -> frozenset:
+        base = self._eval(node.value, env, record)
+        self._eval(node.slice, env, record)
+        parts = self._index_parts(node.slice)
+        if all(self._index_broadcast(p) for p in parts):
+            return base  # [:, None]-style reshape: same buffer
+        t = base - {WHOLE}
+        if not all(self._index_static(p, env) for p in parts):
+            t = (t | {GATHER}) - {PY}
+        return t
+
+    def _eval_call(self, call: ast.Call, env: dict, record: bool) -> frozenset:
+        fname = dotted(call.func)
+        last = _last(fname) if fname else ""
+
+        # `<base>.at[idx].method(value)` -- the scatter form.  Result keeps
+        # the BASE buffer's provenance (incl. WHOLE); the scattered value /
+        # index do not taint the buffer.  Rules inspect the site directly.
+        sc = at_scatter(call)
+        if sc is not None:
+            base_e, index_e, method = sc
+            base_t = self._eval(base_e, env, record)
+            index_t = self._eval(index_e, env, record)
+            value_t = EMPTY
+            for a in call.args:
+                value_t = value_t | self._eval(a, env, record)
+            for kw in call.keywords:
+                self._eval(kw.value, env, record)
+            if record:
+                self.scatters.append(
+                    ScatterSite(call, base_e, index_e, method, base_t, index_t, value_t)
+                )
+            return base_t
+
+        arg_tags = [self._eval(a, env, record) for a in call.args]
+        kw_tags = [self._eval(kw.value, env, record) for kw in call.keywords]
+        u = frozenset().union(EMPTY, *arg_tags, *kw_tags)
+        # method receiver (`row.sum()`, `t.take(idx)`): the result derives
+        # from the receiver too -- module receivers (jnp.sum) carry EMPTY
+        recv = (
+            self._eval(call.func.value, env, record)
+            if isinstance(call.func, ast.Attribute)
+            else EMPTY
+        )
+
+        # jax control flow
+        if fname in _LOOP_CALLS and record and self.depth < _MAX_DEPTH:
+            idx = 1 if fname.endswith("while_loop") else 2
+            bodies = self._loop_body_analyses(call, idx, env)
+            self.ma._loop_sites.append(LoopSite(call, bodies))
+        if fname in _BRANCH_CALLS:
+            # Branches resolve in BOTH passes: the fixpoint must use the
+            # same transfer function as annotation, or a cond result that
+            # crosses a basic-block boundary converges under-tainted
+            # (WHOLE stripped) and the branch-provenance rules go blind.
+            branches = (
+                self._branch_analyses(call, env, arg_tags)
+                if self.depth < _MAX_DEPTH
+                else []
+            )
+            if branches:
+                if record:
+                    self.branch_sites.append(BranchSite(call, branches))
+                return frozenset().union(EMPTY, *(b.return_tags for b in branches))
+            return _generic_call(u)
+
+        # sharding provenance (checked before the helper summary so the
+        # repo's own shard_problem keeps its canonical meaning)
+        if last in _SHARD_MAKERS:
+            return (u | {SHARD}) - {PY}
+        if last == "device_put":
+            placed = bool(
+                len(call.args) >= 2
+                and not (
+                    isinstance(call.args[1], ast.Constant)
+                    and call.args[1].value is None
+                )
+            ) or any(kw.arg in ("device", "sharding") for kw in call.keywords)
+            base_t = arg_tags[0] if arg_tags else EMPTY
+            return (base_t | {SHARD}) - {PY} if placed else base_t
+
+        # provenance-aware builtins
+        if last in _REDUCERS:
+            return (u | recv) - {GATHER, WHOLE, PY}
+        if last in _WHERE_LIKE:
+            return u | recv  # whole-buffer select keeps whole
+        if last in _WHOLE_PRESERVING:
+            return recv | (u - {WHOLE, PY})
+        if last in _GATHER_ADDERS:
+            return (((u | recv) | {GATHER}) - {WHOLE}) - {PY}
+        if last in _PY_KEEPERS:
+            return frozenset({PY})
+
+        # one-hop summary for module-local helpers (summary analyses run at
+        # _MAX_DEPTH, so calls INSIDE a summarized callee stay generic)
+        if self.depth < _MAX_DEPTH:
+            target = self.ma.module_defs.get(fname)
+            if target is not None:
+                kw_map = {
+                    kw.arg: t
+                    for kw, t in zip(call.keywords, kw_tags)
+                    if kw.arg is not None
+                }
+                summary = self.ma.call_summary(target, arg_tags, kw_map)
+                if summary is not None:
+                    return summary
+
+        # generic call: union of arguments (and the receiver, for methods),
+        # minus whole/py -- the result is a new value
+        return _generic_call(u | recv)
+
+    def _branch_analyses(self, call: ast.Call, env: dict, arg_tags: list) -> list:
+        fname = dotted(call.func)
+        if fname.endswith("cond"):
+            cands = []
+            for arg in call.args[1:3]:
+                cands.extend(self.resolve_callables(arg))
+            op_tags = arg_tags[3:]
+        else:  # switch
+            cands = self.resolve_callable_list(call.args[1]) if len(call.args) > 1 else []
+            op_tags = arg_tags[2:]
+        out = []
+        for fn, owner in cands:
+            params = _all_args(getattr(fn, "args", None))
+            seeds = {
+                p.arg: (op_tags[i] if i < len(op_tags) else EMPTY)
+                for i, p in enumerate(params)
+            }
+            fa = self.ma.analyze_resolved(
+                fn, owner if owner is not None else self, seeds=seeds, env_hint=env
+            )
+            if fa is not None:
+                out.append(fa)
+        return out
+
+    def _loop_body_analyses(self, call: ast.Call, body_idx: int, env: dict) -> list:
+        if len(call.args) <= body_idx:
+            return []
+        out = []
+        for fn, owner in self.resolve_callables(call.args[body_idx]):
+            args = _all_args(getattr(fn, "args", None)) if getattr(fn, "args", None) else []
+            seeds = {}
+            for i, a in enumerate(args):
+                if body_idx == 2 and i == 0:  # fori_loop index operand
+                    seeds[a.arg] = EMPTY
+                else:
+                    seeds[a.arg] = frozenset({CARRY, WHOLE})
+            fa = self.ma.analyze_resolved(fn, owner or self, seeds=seeds, env_hint=env)
+            if fa is not None:
+                out.append(fa)
+        return out
+
+
+def _all_args(args: Optional[ast.arguments]) -> list[ast.arg]:
+    if args is None:
+        return []
+    out = list(getattr(args, "posonlyargs", [])) + list(args.args) + list(args.kwonlyargs)
+    if args.vararg:
+        out.append(args.vararg)
+    if args.kwarg:
+        out.append(args.kwarg)
+    return out
+
+
+def _closure_of(env: dict, outer_closure: dict) -> dict:
+    c = dict(outer_closure)
+    c.update(env)
+    return c
+
+
+def _arith(tags: frozenset) -> frozenset:
+    """Element arithmetic: a NEW buffer (whole dropped); python-static only
+    when every operand was python-static."""
+    t = tags - {WHOLE}
+    if t & _ARRAYISH:
+        t = t - {PY}
+    return t
+
+
+def _generic_call(tags: frozenset) -> frozenset:
+    return (tags - {WHOLE}) - {PY}
+
+
+def _join(a: Optional[dict], b: dict) -> Optional[dict]:
+    """Union-join b into a copy of a; None when nothing changed."""
+    if a is None:
+        return dict(b)
+    changed = False
+    out = dict(a)
+    for k, v in b.items():
+        old = out.get(k)
+        if old is None:
+            out[k] = v
+            changed = True
+        elif not v <= old:
+            out[k] = old | v
+            changed = True
+    return out if changed else None
+
+
+def _join_into(acc: dict, env: dict) -> None:
+    for k, v in env.items():
+        acc[k] = acc.get(k, EMPTY) | v
+
+
+# --------------------------------------------------------------------------
+# module analysis
+# --------------------------------------------------------------------------
+
+class ModuleAnalysis:
+    """One parsed module: module env + on-demand function analyses + the
+    resolved jax control-flow sites rules iterate."""
+
+    def __init__(self, tree: ast.Module, relpath: str = "<module>"):
+        self.tree = tree
+        self.relpath = relpath
+        self.module_defs: dict[str, ast.AST] = {}
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_defs[stmt.name] = stmt
+        self._fa_cache: dict = {}
+        self._summary_cache: dict = {}
+        self._in_progress: set = set()
+        self._parents: dict[int, FunctionAnalysis] = {}
+        self._loop_sites: list[LoopSite] = []
+        self.module_env: dict[str, frozenset] = {}
+        self.module_fa: Optional[FunctionAnalysis] = None
+        # module pass: binds module-level names (constants -> PY, imports ->
+        # empty) and eagerly analyzes top-level defs as children
+        self.module_fa = FunctionAnalysis(self, tree, seeds={}, closure={})
+        self._register(self.module_fa, None)
+        self.module_env = self.module_fa.exit_env
+
+    # bookkeeping -----------------------------------------------------------
+
+    def _register(self, fa: FunctionAnalysis, parent: Optional[FunctionAnalysis]) -> None:
+        if parent is not None:
+            self._parents[id(fa)] = parent
+
+    def parent_of(self, fa: FunctionAnalysis) -> Optional[FunctionAnalysis]:
+        return self._parents.get(id(fa))
+
+    # analyses --------------------------------------------------------------
+
+    def function_analysis(self, fn, seeds: Optional[dict] = None) -> FunctionAnalysis:
+        """Analyze a module-level def with generic seeds (params = ext+whole
+        unless overridden)."""
+        key = (id(fn), _seed_key(seeds))
+        fa = self._fa_cache.get(key)
+        if fa is None:
+            if key in self._in_progress:
+                return None  # recursion: caller falls back to generic
+            self._in_progress.add(key)
+            try:
+                default = {a.arg: frozenset({EXT, WHOLE}) for a in _all_args(getattr(fn, "args", None))}
+                if seeds:
+                    default.update({k: frozenset(v) for k, v in seeds.items()})
+                fa = FunctionAnalysis(self, fn, seeds=default, closure={})
+                self._fa_cache[key] = fa
+                self._register(fa, getattr(self, "module_fa", None))
+            finally:
+                self._in_progress.discard(key)
+        return fa
+
+    def analyze_resolved(self, fn, owner: FunctionAnalysis, seeds: dict, env_hint: Optional[dict]) -> Optional[FunctionAnalysis]:
+        """Analyze a resolved callable in its defining context: closure =
+        the env snapshot at its def site (falling back to the call-site env
+        for same-scope defs)."""
+        key = (id(fn), _seed_key(seeds), id(owner))
+        fa = self._fa_cache.get(key)
+        if fa is not None:
+            return fa
+        if key in self._in_progress or len(self._in_progress) > 64:
+            return None
+        closure = owner.def_site_env.get(id(fn))
+        if closure is None:
+            closure = env_hint if env_hint is not None else owner.exit_env
+        closure = _closure_of(closure, owner.closure)
+        self._in_progress.add(key)
+        try:
+            fa = FunctionAnalysis(
+                self, fn, seeds=seeds, closure=closure, depth=owner.depth + 1
+            )
+            self._fa_cache[key] = fa
+            self._register(fa, owner)
+        finally:
+            self._in_progress.discard(key)
+        return fa
+
+    def call_summary(self, fn, arg_tags: list, kw_map: dict) -> Optional[frozenset]:
+        """One-hop return-tag summary of a module-local helper, memoized by
+        (callee, argument-tag signature)."""
+        sig = (
+            id(fn),
+            tuple(tuple(sorted(t)) for t in arg_tags),
+            tuple(sorted((k, tuple(sorted(v))) for k, v in kw_map.items())),
+        )
+        if sig in self._summary_cache:
+            return self._summary_cache[sig]
+        if sig in self._in_progress:
+            return None
+        self._in_progress.add(sig)
+        try:
+            params = _all_args(getattr(fn, "args", None))
+            seeds: dict = {}
+            for i, p in enumerate(params):
+                seeds[p.arg] = arg_tags[i] if i < len(arg_tags) else EMPTY
+            for name, tags in kw_map.items():
+                if any(p.arg == name for p in params):
+                    seeds[name] = tags
+            fa = FunctionAnalysis(self, fn, seeds=seeds, closure={}, depth=_MAX_DEPTH)
+            result = fa.return_tags
+            self._summary_cache[sig] = result
+            return result
+        finally:
+            self._in_progress.discard(sig)
+
+    # site iterators --------------------------------------------------------
+
+    def loop_sites(self) -> list[LoopSite]:
+        """Every lax.while_loop/fori_loop call in the module with resolved
+        body analyses (body params seeded as the loop carry).  Deduplicated
+        by call node -- enclosing functions analyzed under several seed
+        signatures register the same site more than once."""
+        seen: set[int] = set()
+        out = []
+        for site in self._loop_sites:
+            if id(site.call) in seen:
+                continue
+            seen.add(id(site.call))
+            out.append(site)
+        return out
+
+    def jit_sites(self) -> list[JitSite]:
+        """Every jax.jit application: decorated defs and direct calls.
+        The traced function is analyzed with params = carry+whole (its
+        operands ARE the big buffers)."""
+        out: list[JitSite] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    shard = _jit_out_shardings(deco)
+                    if shard is _NOT_JIT:
+                        continue
+                    fa = self._traced_fa(node)
+                    out.append(JitSite(deco, node, fa, shard))
+            elif isinstance(node, ast.Call):
+                if dotted(node.func) in ("jax.jit", "jit") and node.args:
+                    shard = _kwarg_state(node)
+                    for fn, _owner in self.module_fa.resolve_callables(node.args[0]):
+                        fa = self._traced_fa(fn)
+                        out.append(JitSite(node, fn, fa, shard))
+        return out
+
+    def _traced_fa(self, fn) -> Optional[FunctionAnalysis]:
+        seeds = {a.arg: frozenset({CARRY, WHOLE}) for a in _all_args(getattr(fn, "args", None))}
+        owner = None
+        # find the defining analysis so closures resolve
+        for fa in self.module_fa.tree():
+            if id(fn) in fa.def_site_env or fn in getattr(fa.fn, "body", []):
+                owner = fa
+                break
+        if owner is None:
+            owner = self.module_fa
+        return self.analyze_resolved(fn, owner, seeds=seeds, env_hint=None)
+
+
+_NOT_JIT = object()
+
+
+def _kwarg_state(call: ast.Call):
+    """True/False/None out_shardings visibility for a call node."""
+    state: object = False
+    for kw in call.keywords:
+        if kw.arg == "out_shardings":
+            if isinstance(kw.value, ast.Constant) and kw.value.value is None:
+                return False
+            return True
+        if kw.arg is None:  # **splat hides the signature
+            state = None
+    return state
+
+
+def _jit_out_shardings(deco: ast.AST):
+    """Classify a decorator: _NOT_JIT, or the out_shardings state of a jit
+    application (`@jax.jit`, `@jax.jit(...)`,
+    `@functools.partial(jax.jit, ...)`)."""
+    if isinstance(deco, (ast.Name, ast.Attribute)):
+        return False if dotted(deco) in ("jax.jit", "jit") else _NOT_JIT
+    if not isinstance(deco, ast.Call):
+        return _NOT_JIT
+    fname = dotted(deco.func)
+    if fname in ("jax.jit", "jit"):
+        return _kwarg_state(deco)
+    if _last(fname) == "partial" and deco.args and dotted(deco.args[0]) in ("jax.jit", "jit"):
+        return _kwarg_state(deco)
+    return _NOT_JIT
+
+
+def _seed_key(seeds: Optional[dict]):
+    if not seeds:
+        return ()
+    return tuple(sorted((k, tuple(sorted(v))) for k, v in seeds.items()))
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+def analyze(tree: ast.Module, relpath: str = "<module>") -> ModuleAnalysis:
+    return ModuleAnalysis(tree, relpath)
+
+
+def of(src) -> ModuleAnalysis:
+    """Memoized per-Source analysis (lint rules share one pass per file)."""
+    ma = getattr(src, "_dataflow", None)
+    if ma is None:
+        ma = analyze(src.tree, getattr(src, "relpath", "<module>"))
+        src._dataflow = ma
+    return ma

@@ -24,9 +24,13 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import Callable, Iterable, Optional
+
+from armada_tpu.analysis import dataflow as _df
 
 # --------------------------------------------------------------------------
 # findings + suppressions
@@ -54,13 +58,27 @@ _ALLOW_RE = re.compile(
 )
 
 
-def _parse_suppressions(lines: list[str]) -> tuple[dict, list]:
-    """Per-line allow map {lineno: set(rules)} + findings for reasonless
-    allows.  Line numbers are 1-based to match ast."""
+def _comment_lines(text: str) -> list[tuple[int, str]]:
+    """(lineno, text) for real COMMENT tokens only: an allow marker inside
+    a string literal is data, not a suppression (and must not pollute the
+    --stats census).  Falls back to a raw line scan if tokenization fails
+    -- callers have already ast-parsed the buffer, so that is rare."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        return [(t.start[0], t.string) for t in toks if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return list(enumerate(text.splitlines(), start=1))
+
+
+def _parse_suppressions(text: str) -> tuple[dict, list, list]:
+    """Per-line allow map {lineno: set(rules)}, findings for reasonless
+    allows, and (lineno, rules, reason) records for the suppression census
+    (tools/lint.py --stats).  Line numbers are 1-based to match ast."""
     allows: dict[int, set] = {}
     bad: list[tuple[int, str]] = []
-    for i, text in enumerate(lines, start=1):
-        m = _ALLOW_RE.search(text)
+    records: list[tuple[int, frozenset, str]] = []
+    for i, comment in _comment_lines(text):
+        m = _ALLOW_RE.search(comment)
         if not m:
             continue
         rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
@@ -69,7 +87,8 @@ def _parse_suppressions(lines: list[str]) -> tuple[dict, list]:
             bad.append((i, ", ".join(sorted(rules))))
             continue
         allows.setdefault(i, set()).update(rules)
-    return allows, bad
+        records.append((i, frozenset(rules), reason))
+    return allows, bad, records
 
 
 # --------------------------------------------------------------------------
@@ -84,7 +103,7 @@ class Source:
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = text.splitlines()
         self.tree = ast.parse(text)
-        self.allows, self.reasonless_allows = _parse_suppressions(self.lines)
+        self.allows, self.reasonless_allows, _ = _parse_suppressions(text)
         self._parents: dict[ast.AST, ast.AST] = {}
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
@@ -875,6 +894,276 @@ def _atomic_state_file(src: Source):
 
 
 # --------------------------------------------------------------------------
+# dataflow rules (armada-lint v2)
+#
+# These query the provenance lattice in analysis/dataflow.py instead of
+# matching node shapes: every one of them separates a true positive from a
+# syntactically IDENTICAL near miss (tests/test_lint.py pins the twin-shape
+# property), which is exactly what the per-node rules above cannot do.
+# --------------------------------------------------------------------------
+
+_KERNEL_DF = under("armada_tpu/models/", "armada_tpu/parallel/")
+
+# The hoisting/copy hazards are arithmetic, not boolean masking: the
+# kernel's sanctioned fit gates (`static_ok & p.node_ok & ~banned`) are
+# bitwise ops over gathered rows and stay exempt by construction.
+_ARITH_OPS = (
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+    ast.MatMult,
+)
+
+
+def _loop_body_analyses(ma) -> Iterable:
+    """Every resolved while/fori body analysis (+ their nested defs)."""
+    for site in ma.loop_sites():
+        for body in site.bodies:
+            yield from body.tree()
+
+
+@rule(
+    "gathered-row-compute",
+    "arithmetic inside a lax.while_loop/fori_loop body combining a gathered "
+    "row with a whole loop-invariant buffer, with no carry dependence: XLA "
+    "cannot hoist it and recomputes O(N) work per iteration (a single "
+    "in-loop mask multiply cost 6x, round 1) -- precompute the [G,R] table "
+    "outside and gather one row",
+    scope=_KERNEL_DF,
+)
+def _gathered_row_compute(src: Source):
+    if "while_loop" not in src.text and "fori_loop" not in src.text:
+        return
+    ma = _df.of(src)
+    seen: set = set()
+    for fa in _loop_body_analyses(ma):
+        fn = fa.fn
+        root = fn if not isinstance(fn, ast.Lambda) else fn.body
+        for node in ast.walk(root):
+            if not (
+                isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH_OPS)
+            ):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            lt, rt = fa.tags(node.left), fa.tags(node.right)
+            if _df.CARRY in (lt | rt):
+                continue  # depends on loop state: not precomputable
+            for g, w in ((lt, rt), (rt, lt)):
+                # PY on the gathered side = index arithmetic (key % S),
+                # not a table recompute.
+                if (
+                    _df.GATHER in g
+                    and _df.PY not in g
+                    and _df.WHOLE in w
+                    and _df.EXT in w
+                ):
+                    seen.add(key)
+                    yield _finding(
+                        src,
+                        "gathered-row-compute",
+                        node,
+                        "in-loop arithmetic combines a gathered row with a "
+                        "whole loop-invariant buffer and no carry "
+                        "dependence: XLA cannot hoist it -- precompute the "
+                        "combined table outside the loop and gather one "
+                        "row (CLAUDE.md: the 6x mask-multiply lesson)",
+                    )
+                    break
+
+
+@rule(
+    "branch-return-array",
+    "a lax.cond/switch branch returns a value with whole-buffer provenance: "
+    "threading big arrays through BRANCH RETURNS copies them per iteration "
+    "(round-3 measured) -- pass rows out and write back outside the switch",
+    scope=_KERNEL_DF,
+)
+def _branch_return_array(src: Source):
+    if "lax.cond" not in src.text and "lax.switch" not in src.text:
+        return
+    ma = _df.of(src)
+    seen: set = set()
+    sites = []
+    for fa in ma.module_fa.tree():
+        sites.extend(fa.branch_sites)
+    for fa in _loop_body_analyses(ma):
+        sites.extend(fa.branch_sites)
+    for site in sites:
+        key = (site.call.lineno, site.call.col_offset)
+        if key in seen:
+            continue
+        for br in site.branches:
+            if _df.WHOLE not in br.return_tags:
+                continue
+            seen.add(key)
+            name = getattr(br.fn, "name", "<lambda>")
+            yield _finding(
+                src,
+                "branch-return-array",
+                site.call,
+                f"branch `{name}` returns a whole input buffer through "
+                "lax.cond/switch: branch returns copy the buffer per "
+                "iteration -- return the touched row(s) and write back "
+                "outside the switch (CLAUDE.md round-3 kernel economics)",
+            )
+            break
+
+
+@rule(
+    "inloop-scatter-gathered-key",
+    "an in-loop `.at[...].set/add` into a loop-INVARIANT whole buffer whose "
+    "index is tainted by the gathered candidate: each iteration builds a "
+    "fresh O(N) copy (the ban-mask lesson) -- ride a precomputed row table "
+    "(`ban_mask[BR,N]` + a `g_ban_row[G]` gather) instead",
+    scope=_KERNEL_DF,
+)
+def _inloop_scatter_gathered_key(src: Source):
+    if "while_loop" not in src.text and "fori_loop" not in src.text:
+        return
+    ma = _df.of(src)
+    seen: set = set()
+    for fa in _loop_body_analyses(ma):
+        for sc in fa.scatters:
+            key = (sc.call.lineno, sc.call.col_offset)
+            if key in seen:
+                continue
+            if (
+                _df.GATHER in sc.index_tags
+                and _df.CARRY not in sc.base_tags
+                and _df.WHOLE in sc.base_tags
+            ):
+                seen.add(key)
+                yield _finding(
+                    src,
+                    "inloop-scatter-gathered-key",
+                    sc.call,
+                    "in-loop scatter into a loop-invariant buffer keyed on "
+                    "the gathered candidate: XLA materializes a fresh "
+                    "full-buffer copy every iteration -- precompute the "
+                    "row table outside and gather (carry-state scatters "
+                    "with reduced indices stay exempt)",
+                )
+
+
+def _jit_bound_names(src: Source, site) -> set:
+    """Names a `jax.jit(f)` result is bound to, or the decorated def name."""
+    names: set = set()
+    if site.fn is not None and site.node in getattr(
+        site.fn, "decorator_list", ()
+    ):
+        names.add(site.fn.name)  # decorated def: callers use its own name
+    elif isinstance(site.node, ast.Call):
+        parent = src.parent(site.node)
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+@rule(
+    "unpinned-out-shardings",
+    "a jax.jit program is fed a mesh-sharded value but the jit call does "
+    "not pin out_shardings: GSPMD left to choose may GATHER the sharded "
+    "slab onto one chip while scattering into it (round 12's silent slab "
+    "gather) -- pin the output layout (slab._make_apply(out_shardings=...))",
+    scope=under("armada_tpu/"),
+)
+def _unpinned_out_shardings(src: Source):
+    text = src.text
+    if "jit" not in text:
+        return
+    if "shard" not in text and "device_put" not in text:
+        return  # no sharding vocabulary: nothing can carry SHARD
+    ma = _df.of(src)
+    module_fa = ma.module_fa
+    for site in ma.jit_sites():
+        if site.out_shardings is not False:
+            continue  # pinned, or a **kwargs splat decides at runtime
+        sharded = False
+        # (a) the traced body itself reads a sharded closure/global
+        if site.analysis is not None and any(
+            _df.SHARD in t for t in site.analysis.node_tags.values()
+        ):
+            sharded = True
+        # (b) a module-local call site feeds the program a sharded operand
+        if not sharded:
+            names = _jit_bound_names(src, site)
+            callers = []
+            if isinstance(site.node, ast.Call):
+                parent = src.parent(site.node)
+                if isinstance(parent, ast.Call) and parent.func is site.node:
+                    callers.append(parent)  # jax.jit(f)(args) immediately
+            if names:
+                for node in ast.walk(src.tree):
+                    if (
+                        isinstance(node, ast.Call)
+                        and _dotted(node.func) in names
+                    ):
+                        callers.append(node)
+            for call in callers:
+                args = list(call.args) + [kw.value for kw in call.keywords]
+                if any(_df.SHARD in module_fa.tags(a) for a in args):
+                    sharded = True
+                    break
+        if sharded:
+            yield _finding(
+                src,
+                "unpinned-out-shardings",
+                site.node,
+                "jit program flows a mesh-sharded value without "
+                "out_shardings: GSPMD may gather the sharded slab onto one "
+                "chip (round-12 lesson; see parallel/mesh_slab.py) -- pin "
+                "the output shardings, or allow() stating why propagation "
+                "from the operands is the intended layout",
+            )
+
+
+_THREAD_SPAWNERS = {"threading.Thread", "Thread", "_thread.start_new_thread"}
+
+
+@rule(
+    "unmade-lock",
+    "a raw threading.Lock()/RLock() constructed in a module that spawns "
+    "threads: locks in threaded code route through tsan.make_lock (named) "
+    "so the ARMADA_TSAN race harness sees the ordering -- a raw lock is "
+    "invisible to it",
+    scope=lambda p: p.startswith("armada_tpu/")
+    and p != "armada_tpu/analysis/tsan.py",
+)
+def _unmade_lock(src: Source):
+    if "threading" not in src.text:
+        return
+    spawns = False
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _THREAD_SPAWNERS or name.rsplit(".", 1)[-1] == (
+                "ThreadPoolExecutor"
+            ):
+                spawns = True
+                break
+    if not spawns:
+        return  # single-threaded module: a plain Lock has nothing to race
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "threading.Lock",
+            "threading.RLock",
+            "Lock",
+            "RLock",
+        ):
+            yield _finding(
+                src,
+                "unmade-lock",
+                node,
+                "raw lock in a thread-spawning module: construct it with "
+                "tsan.make_lock('<name>') so the dynamic race harness "
+                "(ARMADA_TSAN=1) records its ordering; plain-Lock "
+                "semantics when disarmed, ~one attribute check armed",
+            )
+
+
+# --------------------------------------------------------------------------
 # engine
 # --------------------------------------------------------------------------
 
@@ -965,3 +1254,18 @@ def lint_tree(root: str) -> tuple[int, list[Finding]]:
         n += 1
         findings.extend(lint_file(path, root))
     return n, findings
+
+
+def suppression_census(root: str) -> list[tuple[str, int, str, str]]:
+    """Every reasoned `# lint: allow(...)` in the tree as (relpath, line,
+    rule, reason) rows -- the raw material for `tools/lint.py --stats`, so
+    stale allows stay visible instead of accumulating silently."""
+    rows: list[tuple[str, int, str, str]] = []
+    for path in iter_python_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as fh:
+            _, _, records = _parse_suppressions(fh.read())
+        for line, rules, reason in records:
+            for r in sorted(rules):
+                rows.append((rel, line, r, reason))
+    return rows

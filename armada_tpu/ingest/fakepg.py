@@ -34,6 +34,7 @@ import struct
 import threading
 from typing import Optional
 
+from armada_tpu.analysis import tsan
 from armada_tpu.ingest import pgwire
 
 _PLACEHOLDER = re.compile(r"\$(\d+)")
@@ -429,7 +430,7 @@ class FakePostgresServer:
         self.users = users or {"armada": "hunter2"}
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         self._conn.isolation_level = None  # explicit BEGIN/COMMIT only
-        self._lock = threading.Lock()
+        self._lock = tsan.make_lock("fakepg.conn")
         self.in_txn = False
         self._listener: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []

@@ -233,6 +233,11 @@ def shard_problem(
     )
 
 
+# lint: allow(unpinned-out-shardings) -- deliberate: operand shardings
+# propagate through the while-loop (shard_problem pre-shards every input)
+# and the OUTPUTS are pulled back replicated for host decode (slots/
+# states/flags are small; callers re-shard alloc for the next round).  The
+# measured gather hazard is the SCATTER program, pinned in mesh_slab.py.
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "num_levels", "max_slots", "slot_width", "max_iterations"),

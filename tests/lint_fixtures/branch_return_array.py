@@ -1,0 +1,26 @@
+# Fixture for rule `branch-return-array` (linted under armada_tpu/models/).
+# The twin call is syntactically IDENTICAL to the TP; the branches behind
+# it return a freshly computed ROW (the sanctioned rows-out idiom), not the
+# whole buffer -- only return-value provenance separates the two calls.
+import jax
+
+
+def commit(alloc, row, node, hit):
+    def on_hit(a):
+        return a.at[node].add(row)
+
+    def on_miss(a):
+        return a
+
+    alloc = jax.lax.cond(hit, on_hit, on_miss, alloc)  # TP
+
+    def hit_row(a):
+        return a[node] + row
+
+    def miss_row(a):
+        return a[node]
+
+    new_row = jax.lax.cond(hit, hit_row, miss_row, alloc)  # twin
+    # rows out: the write-back happens OUTSIDE the switch, once
+    alloc = alloc.at[node].set(new_row)
+    return alloc

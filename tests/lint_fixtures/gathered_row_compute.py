@@ -1,0 +1,19 @@
+# Fixture for rule `gathered-row-compute` (linted under armada_tpu/models/).
+# The twin line is syntactically IDENTICAL to the TP (same normalized AST;
+# tests/test_lint.py asserts it) -- only provenance separates them, which
+# is exactly what the per-node engine could not express.
+import jax
+
+
+def run(table, mask, pre, carry0):
+    # `pre` stands for the sanctioned idiom: combine the invariant tables
+    # OUTSIDE the loop (pre = table * mask at build time), gather one row.
+    def body(c):
+        i, acc = c
+        row = table[i] * mask  # TP
+        # The twin line below: a precomputed-table gather scaled by loop
+        # CARRY state -- carry-dependent, unhoistable, not a finding.
+        out = pre[i] * acc  # twin
+        return (i + 1, acc + row[0] + out[0])
+
+    return jax.lax.while_loop(lambda c: c[0] < 64, body, carry0)

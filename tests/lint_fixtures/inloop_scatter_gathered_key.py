@@ -1,0 +1,19 @@
+# Fixture for rule `inloop-scatter-gathered-key` (linted under
+# armada_tpu/models/).  The twin scatter is syntactically IDENTICAL to
+# the true positive; its index is a REDUCED pick (argmin: a fresh scalar,
+# not a gathered row) and its base is loop carry state -- the sanctioned
+# commit pattern.
+import jax
+import jax.numpy as jnp
+
+
+def run(ban_mask, cand_tab, scores, carry0):
+    def body(c):
+        i, acc, done = c
+        cand = cand_tab[i]
+        banned = ban_mask.at[cand].set(True)  # TP
+        slot = jnp.argmin(scores * acc)
+        acc2 = acc.at[slot].set(True)  # twin
+        return (i + 1, acc2, done | banned[0])
+
+    return jax.lax.while_loop(lambda c: ~c[2], body, carry0)

@@ -1,0 +1,23 @@
+# Fixture for rule `unmade-lock` (linted under armada_tpu/ingest/).  The
+# rule is module-contextual: tests/test_lint.py also lints this buffer with
+# the thread spawn removed and asserts the SAME Lock line goes clean -- a
+# per-node matcher cannot condition on the rest of the module.
+import threading
+
+from armada_tpu.analysis import tsan
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()  # TP
+        # near-miss: the instrumented constructor the race harness sees
+        self._stats_lock = tsan.make_lock("fixture.stats")
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)  # spawn-marker
+        t.start()
+        return t
+
+    def _run(self):
+        with self._lock:
+            pass

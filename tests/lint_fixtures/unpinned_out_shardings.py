@@ -1,0 +1,31 @@
+# Fixture for rule `unpinned-out-shardings` (linted under
+# armada_tpu/parallel/).  The twin jit is built IDENTICALLY to the TP; the
+# value flowing through it is an unsharded staging buffer, so pinning buys
+# nothing -- only operand provenance separates the two sites.
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def scatter(buf, ix, rs):
+    return buf.at[ix].set(rs)
+
+
+def scatter2(buf, ix, rs):
+    return buf.at[ix].set(rs)
+
+
+apply_fn = jax.jit(scatter)  # TP
+stage_fn = jax.jit(scatter2)  # twin
+
+
+def run(mesh, table, idx, rows):
+    sh = NamedSharding(mesh, PartitionSpec("nodes"))
+    slab = jax.device_put(table, sh)
+    host = jax.device_put(table)
+    # near-miss: the same sharded slab through a PINNED program
+    pinned = jax.jit(scatter, out_shardings=sh)
+    return (
+        apply_fn(slab, idx, rows),
+        stage_fn(host, idx, rows),
+        pinned(slab, idx, rows),
+    )

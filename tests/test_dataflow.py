@@ -1,0 +1,375 @@
+"""The dataflow engine itself (analysis/dataflow.py), independent of any
+lint rule: def-use + provenance-lattice behavior pinned on the exact
+binding shapes the rules walk through (walrus, augmented assign, tuple
+unpack, comprehensions, closure capture) plus the jax-site resolution
+(loop bodies, cond/switch branches, jit applications).  A rule bug and an
+engine bug must fail DIFFERENT tests -- rules are pinned in
+tests/test_lint.py against fixtures, the lattice is pinned here against
+`exit_env`/`tags()` directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+
+from armada_tpu.analysis import dataflow as df
+
+G, C, E, W, P, S = df.GATHER, df.CARRY, df.EXT, df.WHOLE, df.PY, df.SHARD
+
+
+def analyze(src: str) -> df.ModuleAnalysis:
+    return df.analyze(ast.parse(textwrap.dedent(src)))
+
+
+def fn_exit(src: str, name: str = "f", seeds=None) -> dict:
+    """exit_env of a module-level def analyzed with default seeds
+    (params = {ext, whole}) unless overridden."""
+    ma = analyze(src)
+    fa = ma.function_analysis(ma.module_defs[name], seeds=seeds)
+    return fa.exit_env
+
+
+# ---------------------------------------------------------------- binding --
+
+
+def test_param_seed_and_simple_assign():
+    env = fn_exit("def f(t):\n    x = t\n    return x\n")
+    assert env["x"] == frozenset({E, W})
+
+
+def test_constant_is_python_static():
+    env = fn_exit("def f(t):\n    k = 3\n    s = t.shape\n")
+    assert env["k"] == frozenset({P})
+    assert env["s"] == frozenset({P})  # shape/ndim/size/dtype reads
+
+
+def test_walrus_binds_and_yields():
+    env = fn_exit("def f(t, i):\n    y = (x := t[i]) + 1\n")
+    # the walrus target gets the gathered value; the enclosing arithmetic
+    # result keeps the gather taint but is a fresh (non-whole) value
+    assert G in env["x"] and W not in env["x"]
+    assert G in env["y"] and W not in env["y"]
+
+
+def test_augmented_assign_unions_and_drops_whole():
+    env = fn_exit("def f(t, i):\n    acc = 0\n    acc += t[i]\n")
+    assert G in env["acc"]
+    assert W not in env["acc"]  # += is element arithmetic, a new buffer
+    assert P not in env["acc"]  # arrayish operand absorbs the static int
+
+
+def test_tuple_unpack_spreads_tags():
+    env = fn_exit("def f(c):\n    i, acc = c\n    a, *rest = c\n")
+    for name in ("i", "acc", "a", "rest"):
+        assert env[name] == frozenset({E, W}), name
+
+
+def test_comprehension_iterates_rows_not_buffer():
+    env = fn_exit("def f(t):\n    out = [r + 1 for r in t]\n")
+    # iterating a buffer yields rows (whole dropped), then arithmetic
+    assert W not in env["out"] and E in env["out"]
+
+
+def test_comprehension_over_range_is_static():
+    env = fn_exit("def f(t):\n    ks = [k * 2 for k in range(4)]\n")
+    assert env["ks"] == frozenset({P})
+
+
+def test_closure_capture_reads_outer_binding():
+    ma = analyze(
+        """
+        def f(t):
+            pre = t * 2
+            def g(i):
+                return pre
+            return g
+        """
+    )
+    fa = ma.function_analysis(ma.module_defs["f"])
+    (g_fa,) = [c for c in fa.tree() if c is not fa]
+    # `pre` inside g resolves through the def-site closure snapshot:
+    # element arithmetic on the param -- ext taint, whole dropped
+    assert g_fa.return_tags == frozenset({E})
+
+
+def test_module_bindings_and_unbound_names():
+    ma = analyze("K = 3\ndef f(i):\n    return (K, UNKNOWN)\n")
+    fa = ma.function_analysis(ma.module_defs["f"])
+    # a module constant is python-static through the module env; a name
+    # bound NOWHERE (an undeclared global) defaults to ext provenance
+    assert fa.return_tags == frozenset({P, E})
+
+
+# ---------------------------------------------------- lattice transforms --
+
+
+def test_subscript_gather_vs_static_vs_broadcast():
+    env = fn_exit(
+        """
+        def f(t, i):
+            row = t[i]       # dynamic index: gather, not whole
+            head = t[0]      # static index: a row, no gather
+            col = t[:, None] # pure broadcast: still the same buffer
+        """
+    )
+    assert env["row"] == frozenset({E, G})
+    assert env["head"] == frozenset({E})
+    assert env["col"] == frozenset({E, W})
+
+
+def test_reduction_kills_gather_and_whole():
+    env = fn_exit(
+        """
+        def f(t, i):
+            row = t[i]
+            s = row.sum()
+            m = t.argmin()
+        """
+    )
+    assert env["s"] == frozenset({E})
+    assert env["m"] == frozenset({E})
+
+
+def test_where_preserves_whole_but_generic_call_does_not():
+    env = fn_exit(
+        """
+        import jax.numpy as jnp
+        def f(t, m):
+            kept = jnp.where(m, t, 0)
+            lost = jnp.roll(t, 1)
+        """
+    )
+    assert W in env["kept"]
+    assert W not in env["lost"]
+
+
+def test_take_adds_gather():
+    env = fn_exit(
+        "import jax.numpy as jnp\ndef f(t, idx):\n    r = jnp.take(t, idx)\n"
+    )
+    assert G in env["r"] and W not in env["r"]
+
+
+def test_branch_join_unions_tags():
+    env = fn_exit(
+        """
+        def f(t, i, flag):
+            if flag:
+                x = t[i]
+            else:
+                x = 1
+        """
+    )
+    assert env["x"] == frozenset({E, G, P})
+
+
+def test_loop_fixpoint_accumulates_through_back_edge():
+    env = fn_exit(
+        """
+        def f(t, i):
+            acc = 0
+            k = i
+            while k < 4:
+                acc = acc + t[k]
+                k = k + 1
+        """
+    )
+    # acc starts python-static; the gathered add only reaches the exit env
+    # through the loop back edge, so this pins fixpoint convergence
+    assert G in env["acc"] and P in env["acc"]
+
+
+def test_static_index_loop_is_not_a_gather():
+    env = fn_exit(
+        """
+        def f(t):
+            acc = 0
+            k = 0
+            while k < 4:
+                acc = acc + t[k]
+                k = k + 1
+        """
+    )
+    # a python-static counter index is trace-time indexing (an unrolled
+    # range walk), not a dynamic gather
+    assert G not in env["acc"]
+
+
+def test_one_hop_call_summary_propagates_argument_tags():
+    ma = analyze(
+        """
+        def pick(t, i):
+            return t[i]
+        def f(t, i):
+            r = pick(t, i)
+            return r
+        """
+    )
+    fa = ma.function_analysis(ma.module_defs["f"])
+    assert G in fa.name_tags("r")
+
+
+def test_shard_sticky_through_arithmetic_and_scatter():
+    env = fn_exit(
+        """
+        import jax
+        def f(t, sharding, rows, idx):
+            placed = jax.device_put(t, sharding)
+            derived = placed * 2
+            scattered = placed.at[idx].set(rows)
+        """
+    )
+    assert S in env["placed"] and S in env["derived"] and S in env["scattered"]
+
+
+def test_device_put_without_placement_is_not_shard():
+    env = fn_exit("import jax\ndef f(t):\n    x = jax.device_put(t)\n")
+    assert S not in env["x"]
+
+
+# ------------------------------------------------------------- jax sites --
+
+
+def test_while_loop_body_resolved_with_carry_seeds():
+    ma = analyze(
+        """
+        import jax
+        def f(table, carry0):
+            def body(c):
+                i, acc = c
+                return (i + 1, acc + table[i])
+            return jax.lax.while_loop(lambda c: c[0] < 4, body, carry0)
+        """
+    )
+    sites = ma.loop_sites()
+    assert len(sites) == 1
+    (body_fa,) = sites[0].bodies
+    # the carry param carries CARRY; the closure table read carries EXT
+    assert C in body_fa.name_tags("acc")
+    assert G in body_fa.return_tags and C in body_fa.return_tags
+
+
+def test_factory_idiom_resolves_inner_def():
+    ma = analyze(
+        """
+        import jax
+        def make_body(table):
+            def body(c):
+                return c + table[c]
+            return body
+        def f(table, carry0):
+            body = make_body(table)
+            return jax.lax.while_loop(lambda c: c < 4, body, carry0)
+        """
+    )
+    sites = ma.loop_sites()
+    assert len(sites) == 1 and len(sites[0].bodies) == 1
+
+
+def test_cond_branch_sites_record_return_tags():
+    ma = analyze(
+        """
+        import jax
+        def f(t, hit, row):
+            def on_hit(x):
+                return x
+            def on_miss(x):
+                return x[0]
+            return jax.lax.cond(hit, on_hit, on_miss, t)
+        """
+    )
+    fa = ma.function_analysis(ma.module_defs["f"])
+    (site,) = list(fa.all_branch_sites())
+    by_name = {getattr(b.fn, "name", "?"): b.return_tags for b in site.branches}
+    assert W in by_name["on_hit"]  # returns the operand buffer itself
+    assert W not in by_name["on_miss"]  # returns a row of it
+
+
+def test_cond_result_keeps_whole_across_block_split():
+    """The fixpoint and annotation passes must share ONE transfer function
+    for cond/switch results: a statement-level branch between the cond
+    binding and its use splits basic blocks, so the use reads the
+    CONVERGED env -- if the fixpoint stripped WHOLE (the old generic-call
+    approximation), the exact anti-pattern branch-provenance rules exist
+    for went invisible."""
+    ma = analyze(
+        """
+        import jax
+        def f(table, carry0, p, flag):
+            def upd(a):
+                return a
+            def body(c):
+                i, acc = c
+                row = jax.lax.cond(p, lambda a: a, upd, table)
+                if flag:
+                    pass
+                y = table[i] * row
+                return (i + 1, acc + y[0])
+            return jax.lax.while_loop(lambda c: c[0] < 4, body, carry0)
+        """
+    )
+    (site,) = ma.loop_sites()
+    (body_fa,) = site.bodies
+    assert W in body_fa.name_tags("row")
+    assert G in body_fa.name_tags("y") and W not in body_fa.name_tags("y")
+
+
+def test_scatter_sites_record_base_index_value_tags():
+    ma = analyze(
+        """
+        import jax
+        def f(table, i, rows):
+            def body(c):
+                cand = table[c]
+                return table.at[cand].set(rows)
+            return jax.lax.while_loop(lambda c: c < 4, body, 0)
+        """
+    )
+    (site,) = ma.loop_sites()
+    (body_fa,) = site.bodies
+    (sc,) = list(body_fa.all_scatters())
+    assert sc.method == "set"
+    assert G in sc.index_tags  # indexed by the gathered candidate
+    assert W in sc.base_tags and E in sc.base_tags
+
+
+def test_jit_sites_decorator_call_and_partial_forms():
+    ma = analyze(
+        """
+        import functools
+        import jax
+
+        @jax.jit
+        def a(x):
+            return x
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def b(x):
+            return x
+
+        @functools.partial(jax.jit, out_shardings=LAYOUT)
+        def c(x):
+            return x
+
+        d = jax.jit(a, out_shardings=None)
+
+        def e(x, **kw):
+            return jax.jit(a, **kw)
+        """
+    )
+    by_fn = {}
+    for site in ma.jit_sites():
+        by_fn.setdefault(getattr(site.fn, "name", "?"), site.out_shardings)
+    assert by_fn["a"] is False  # bare decorator, then jit(a, out_shardings=None)
+    assert by_fn["b"] is False  # partial without the kwarg
+    assert by_fn["c"] is True  # pinned
+    # the **kw splat form: statically undecidable, reported as None
+    assert None in {s.out_shardings for s in ma.jit_sites()}
+
+
+def test_lint_source_memoizes_one_analysis_per_source():
+    from armada_tpu.analysis import lint
+
+    src = lint.Source("import jax\nx = 1\n", "armada_tpu/models/m.py")
+    assert df.of(src) is df.of(src)

@@ -51,12 +51,40 @@ RULE_FIXTURES = {
         "armada_tpu/fixture.py",
     ),
     "mesh-gather": ("mesh_gather.py", "armada_tpu/scheduler/fixture.py"),
+    # dataflow-backed rules (armada-lint v2): each TP has a syntactic twin
+    # in the same fixture -- see test_dataflow_rules_beat_syntax below
+    "gathered-row-compute": (
+        "gathered_row_compute.py",
+        "armada_tpu/models/fixture.py",
+    ),
+    "branch-return-array": (
+        "branch_return_array.py",
+        "armada_tpu/models/fixture.py",
+    ),
+    "inloop-scatter-gathered-key": (
+        "inloop_scatter_gathered_key.py",
+        "armada_tpu/models/fixture.py",
+    ),
+    "unpinned-out-shardings": (
+        "unpinned_out_shardings.py",
+        "armada_tpu/parallel/fixture.py",
+    ),
+    "unmade-lock": ("unmade_lock.py", "armada_tpu/ingest/fixture.py"),
 }
 
+# The four value-flow rules whose fixtures carry a `# twin` line: a
+# statement with the SAME normalized AST as the TP that must stay clean.
+TWIN_RULES = [
+    "gathered-row-compute",
+    "branch-return-array",
+    "inloop-scatter-gathered-key",
+    "unpinned-out-shardings",
+]
 
-def test_registry_has_at_least_12_rules_all_pinned():
+
+def test_registry_has_at_least_22_rules_all_pinned():
     names = lint.rule_names()
-    assert len(names) >= 12
+    assert len(names) >= 22
     assert len(names) == len(set(names))
     # every registered rule has a fixture, every fixture a registered rule
     assert set(RULE_FIXTURES) == set(names)
@@ -79,6 +107,67 @@ def test_rule_true_positive_and_near_miss(rule):
         f"{fname}: expected exactly the marked TP, got "
         + "; ".join(f.format() for f in findings)
     )
+
+
+def _normalized_stmt(tree: "object", lineno: int) -> str:
+    """The statement starting at `lineno`, with every Name identifier and
+    Constant value scrubbed -- two statements with equal normalized dumps
+    are indistinguishable to any per-node (syntactic) matcher."""
+    import ast
+
+    target = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt) and node.lineno == lineno:
+            target = node
+            break
+    assert target is not None, f"no statement at line {lineno}"
+    import copy
+
+    clone = copy.deepcopy(target)
+    for node in ast.walk(clone):
+        if isinstance(node, ast.Name):
+            node.id = "_"
+        elif isinstance(node, ast.Constant):
+            node.value = 0
+    return ast.dump(clone, annotate_fields=False, include_attributes=False)
+
+
+@pytest.mark.parametrize("rule", TWIN_RULES)
+def test_dataflow_rules_beat_syntax(rule):
+    """The v2 claim, asserted by construction: the TP and its twin have
+    IDENTICAL normalized ASTs (so no node-shape rule -- the whole v1
+    engine -- could separate them), yet only the TP is flagged."""
+    import ast
+
+    fname, relpath = RULE_FIXTURES[rule]
+    with open(os.path.join(FIXTURES, fname)) as fh:
+        text = fh.read()
+    lines = text.splitlines()
+    tp = [i for i, l in enumerate(lines, 1) if "# TP" in l]
+    twin = [i for i, l in enumerate(lines, 1) if "# twin" in l]
+    assert len(tp) == 1 and len(twin) == 1, fname
+    tree = ast.parse(text)
+    assert _normalized_stmt(tree, tp[0]) == _normalized_stmt(tree, twin[0]), (
+        f"{fname}: TP and twin must be syntactically identical after "
+        "normalization -- otherwise a per-node matcher could separate them"
+    )
+    findings = lint.lint_source(text, relpath)
+    assert [(f.rule, f.line) for f in findings] == [(rule, tp[0])]
+
+
+def test_unmade_lock_is_module_contextual():
+    """unmade-lock's twin is the MODULE, not a line: the identical Lock
+    statement goes clean once the module spawns no threads -- context no
+    per-node matcher sees."""
+    fname, relpath = RULE_FIXTURES["unmade-lock"]
+    with open(os.path.join(FIXTURES, fname)) as fh:
+        text = fh.read()
+    assert lint.lint_source(text, relpath), "sanity: TP fires with threads"
+    threadless = "\n".join(
+        l for l in text.splitlines() if "spawn-marker" not in l
+    )
+    assert "threading.Lock()" in threadless
+    assert lint.lint_source(threadless, relpath) == []
 
 
 def test_slo_wallclock_scope_covers_trace_module():
@@ -188,6 +277,68 @@ def test_cli_json_mode():
     doc = json.loads(lines[0])
     assert doc["ok"] is True and doc["violations"] == 0
     assert doc["rules"] >= 12 and doc["files"] > 150
+
+
+def test_cli_diff_mode_restricts_the_walk():
+    """--diff lints only files changed vs a ref (+ untracked): the scope
+    is a subset of the full walk and a clean tree still exits 0."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "lint.py"),
+            "--diff",
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip())
+    assert doc["ok"] is True
+    full = sum(1 for _ in lint.iter_python_files(REPO))
+    assert 0 <= doc["files"] <= full
+
+
+def test_cli_stats_census():
+    """--stats prints the suppression census: every reasoned allow shows
+    up under its rule so stale exemptions stay visible."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), "--stats"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    # the kernel's blocked-minima allows are permanent census residents
+    assert "full-argmin" in out.stdout
+    assert "fair_scheduler.py" in out.stdout
+    rows = lint.suppression_census(REPO)
+    assert rows and all(reason for _, _, _, reason in rows)
+
+
+def test_cli_jobs_parallel_matches_serial():
+    """--jobs N fans per-file analysis over processes; the result set is
+    the same (the self-host gate stays meaningful under parallelism)."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "lint.py"),
+            "--jobs",
+            "2",
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip())
+    assert doc["ok"] is True and doc["violations"] == 0
+    assert doc["files"] > 150
 
 
 def test_cli_flags_violations_nonzero(tmp_path):

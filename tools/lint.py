@@ -9,6 +9,10 @@ exit 1 = unsuppressed violations, printed one per line as
     python tools/lint.py --json         # ONE JSON line (bench/ops tooling)
     python tools/lint.py --list-rules   # rule names + one-line summaries
     python tools/lint.py path.py ...    # restrict to specific files
+    python tools/lint.py --diff         # only files changed vs HEAD
+    python tools/lint.py --diff main    # ... vs an arbitrary git ref
+    python tools/lint.py --stats        # suppression census (rule -> allows)
+    python tools/lint.py --jobs 4       # parallel per-file analysis
 
 The fast test tier runs this via tests/test_lint.py (the self-hosting
 gate), so a new violation fails CI the same cycle it lands.
@@ -17,13 +21,92 @@ gate), so a new violation fails CI the same cycle it lands.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from armada_tpu.analysis import lint  # noqa: E402
+
+
+def _walk_paths(root: str) -> list[str]:
+    return list(lint.iter_python_files(root))
+
+
+def _diff_paths(root: str, ref: str) -> list[str]:
+    """Authored .py files changed vs `ref` (plus untracked), filtered by
+    the same exclusions as the full walk -- the cheap pre-commit scope.
+    Diffs against merge-base(ref, HEAD), not ref itself: on a branch
+    behind `ref`, two-dot `git diff ref` would also surface every file
+    ref changed that the branch never touched."""
+    mb = subprocess.run(
+        ["git", "merge-base", ref, "HEAD"],
+        capture_output=True,
+        text=True,
+        cwd=root,
+    )
+    if mb.returncode != 0:
+        raise SystemExit(
+            f"armada-lint: --diff {ref}: {mb.stderr.strip() or 'git merge-base failed'}"
+        )
+    base = mb.stdout.strip()
+    changed = subprocess.run(
+        ["git", "diff", "--name-only", base, "--", "*.py"],
+        capture_output=True,
+        text=True,
+        cwd=root,
+        check=True,
+    ).stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+        capture_output=True,
+        text=True,
+        cwd=root,
+        check=True,
+    ).stdout.splitlines()
+    # Reuse the walk's exclusion decisions exactly: intersect with it.
+    walk = {
+        os.path.relpath(p, root).replace(os.sep, "/") for p in _walk_paths(root)
+    }
+    out = []
+    for rel in sorted(set(changed) | set(untracked)):
+        rel = rel.strip().replace(os.sep, "/")
+        if rel in walk and os.path.exists(os.path.join(root, rel)):
+            out.append(os.path.join(root, rel))
+    return out
+
+
+def _lint_paths(paths: list[str], root: str, jobs: int) -> list:
+    if jobs > 1 and len(paths) > 1:
+        import multiprocessing
+
+        worker = functools.partial(lint.lint_file, root=root)
+        with multiprocessing.Pool(jobs) as pool:
+            per_file = pool.map(worker, paths, chunksize=8)
+        findings = [f for fs in per_file for f in fs]
+    else:
+        findings = []
+        for p in paths:
+            findings.extend(lint.lint_file(p, root))
+    return findings
+
+
+def _print_stats(root: str) -> None:
+    """The suppression census: rule -> count -> reasons, so stale allows
+    are visible (remove the site, the row disappears)."""
+    rows = lint.suppression_census(root)
+    by_rule: dict[str, list] = {}
+    for rel, line, rule_name, reason in rows:
+        by_rule.setdefault(rule_name, []).append((rel, line, reason))
+    print(f"armada-lint: {len(rows)} reasoned allow(s), {len(by_rule)} rule(s)")
+    for rule_name in sorted(by_rule, key=lambda r: (-len(by_rule[r]), r)):
+        sites = by_rule[rule_name]
+        print(f"\n{rule_name}: {len(sites)} allow(s)")
+        for rel, line, reason in sites:
+            print(f"  {rel}:{line}: {reason}")
 
 
 def main(argv=None) -> int:
@@ -37,6 +120,27 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue"
     )
+    ap.add_argument(
+        "--diff",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help="lint only files changed vs a git ref (default HEAD) "
+        "plus untracked files -- the cheap pre-commit scope",
+    )
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="print the suppression census (rule -> count -> reasons)",
+    )
+    ap.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help="parallel per-file analysis processes (default 1)",
+    )
     args = ap.parse_args(argv)
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -46,14 +150,19 @@ def main(argv=None) -> int:
             print(f"{r.name}: {r.summary}")
         return 0
 
+    if args.stats:
+        _print_stats(root)
+        return 0
+
     if args.paths:
-        findings = []
-        n = 0
-        for p in args.paths:
-            n += 1
-            findings.extend(lint.lint_file(os.path.abspath(p), root))
+        paths = [os.path.abspath(p) for p in args.paths]
+    elif args.diff is not None:
+        paths = _diff_paths(root, args.diff)
     else:
-        n, findings = lint.lint_tree(root)
+        paths = _walk_paths(root)
+    n = len(paths)
+    findings = _lint_paths(paths, root, args.jobs)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.json:
         print(
@@ -79,4 +188,10 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `lint.py --stats | head` closes the pipe early; that is the
+        # reader's prerogative, not an error.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
