@@ -331,6 +331,49 @@ def cmd_report(args):
     return 0
 
 
+def cmd_explain(args):
+    """`armadactl explain <job-id>`: why the job wasn't scheduled -- the
+    reason code the explain pass attributed (models/explain.py catalogue:
+    shape-infeasible / capacity-blocked / fairness-capped / gang-partial /
+    round-terminated), answered on any replica via the reports proxy.
+    Without a job id: per-pool forensics (reason histograms + per-resource
+    fragmentation indices from the latest attributed round)."""
+
+    def go(c):
+        if args.job_id:
+            r = c.get_job_report(args.job_id)
+            print(f"job: {args.job_id}")
+            for k in ("outcome", "reason", "pool", "queue", "node", "priority"):
+                if r.get(k) is not None:
+                    print(f"{k}: {r[k]}")
+            for k, v in r.items():
+                if k.startswith("preemptor_"):
+                    print(f"{k}: {v}")
+        else:
+            for pool, r in c.get_pool_report(args.pool or "").items():
+                exp = (r or {}).get("explain")
+                if not exp:
+                    print(
+                        f"{pool}: no explain pass recorded yet (arm "
+                        f"`serve --explain-interval` or "
+                        f"ARMADA_EXPLAIN_INTERVAL)"
+                    )
+                    continue
+                counts = exp.get("counts", {})
+                line = " ".join(f"{k}={v}" for k, v in counts.items() if v)
+                print(f"{pool}: {line or 'every queued job placed'}")
+                for res, fr in exp.get("fragmentation", {}).items():
+                    if fr.get("free"):
+                        print(
+                            f"  {res}: free={fr['free']} "
+                            f"largest_fit={fr['largest_request']} "
+                            f"fragmentation={fr['index']}"
+                        )
+
+    with_closed(_client(args), go)
+    return 0
+
+
 def cmd_testsuite(args):
     import glob
     import os as _os
@@ -628,6 +671,9 @@ _SERVE_FALLBACKS = {
     # to 300s so every deployment gets bounded-replay restarts; 0 disables
     # (tests and embedded planes construct with the library default, off).
     "checkpoint_interval": 300.0,
+    # None -> start_control_plane arms the explain pass every 10th round
+    # (models/explain.py); 0 disables.  ARMADA_EXPLAIN_INTERVAL overrides.
+    "explain_interval": None,
 }
 
 
@@ -682,6 +728,7 @@ def load_serve_config(args):
         "watchdog_s": ("watchdogs", float),
         "checkpoint_interval": ("checkpointinterval", float),
         "mesh": ("mesh", int),
+        "explain_interval": ("explaininterval", int),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -729,6 +776,7 @@ def cmd_serve(args):
         watchdog_s=getattr(args, "watchdog_s", None),
         checkpoint_interval_s=getattr(args, "checkpoint_interval", None),
         mesh_devices=getattr(args, "mesh", None),
+        explain_interval=getattr(args, "explain_interval", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -980,6 +1028,14 @@ def build_parser() -> argparse.ArgumentParser:
         "triggers one on demand)",
     )
     srv.add_argument(
+        "--explain-interval",
+        type=int,
+        dest="explain_interval",
+        help="unschedulable-reason attribution cadence in rounds "
+        "(models/explain.py; default 10 = every 10th round of each pool, 0 "
+        "disables; `armadactl explain <job-id>` reads the codes)",
+    )
+    srv.add_argument(
         "--lookout-port",
         type=int,
         help="host the lookout web UI on this port (0 = pick a free one)",
@@ -1052,6 +1108,15 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument("--queue")
     rep.add_argument("--pool")
     rep.set_defaults(fn=cmd_report)
+
+    ex = sub.add_parser(
+        "explain",
+        help="why wasn't my job scheduled: reason codes + capacity "
+        "forensics (models/explain.py)",
+    )
+    ex.add_argument("job_id", nargs="?", help="job id; omit for per-pool forensics")
+    ex.add_argument("--pool", help="restrict the pool forensics view")
+    ex.set_defaults(fn=cmd_explain)
 
     ts = sub.add_parser("testsuite", help="run declarative e2e test specs")
     ts.add_argument("path", nargs="+", help="spec files or directories")

@@ -69,6 +69,11 @@ class ControlPlaneProcess:
     # This plane's watchdog arming token; disarmed on stop() (see
     # start_control_plane).
     _watchdog_token: object = None
+    # This plane's explain-default arming token (models/explain.py
+    # arm_default); disarmed on stop() so in-process embedders/tests after
+    # the plane keep the library default (0 = off), and overlapping plane
+    # lifetimes never corrupt each other's cadence.
+    _explain_token: Optional[int] = None
     _stopped: bool = False
 
     def stop(self, grace_s: float = 1.0) -> None:
@@ -86,6 +91,10 @@ class ControlPlaneProcess:
             from armada_tpu.core.watchdog import supervisor as _supervisor
 
             _supervisor().disarm(self._watchdog_token)
+        if self._explain_token is not None:
+            from armada_tpu.models import explain as _explain
+
+            _explain.disarm_default(self._explain_token)
         if self.replicator is not None:
             self.replicator.stop()
         for p in self._pipelines:
@@ -148,6 +157,7 @@ def start_control_plane(
     watchdog_s: Optional[float] = None,
     checkpoint_interval_s: Optional[float] = None,
     mesh_devices: Optional[int] = None,
+    explain_interval: Optional[int] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -518,6 +528,9 @@ def start_control_plane(
         from armada_tpu.ops.trace import recorder as _trace_recorder
 
         health_server.trace_status = _trace_recorder().healthz_block
+        # Last explain-pass attribution per pool (models/explain.py via the
+        # reports repository): reason counts + fragmentation forensics.
+        health_server.explain_status = reports.explain_summary
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(
@@ -600,6 +613,9 @@ def start_control_plane(
             # cancel/reprioritise from the UI ride the same SubmitServer
             # (and therefore the same queue ACLs) as the gRPC verbs
             submit=submit_server,
+            # job details carry the scheduler's why-(not)-scheduled report
+            # (explain reason codes); follower replicas proxy to the leader
+            reports=reports_query,
         )
 
     rest_gateway = None
@@ -639,6 +655,20 @@ def start_control_plane(
     # and stop in any order (HA tests kill the leader while the follower
     # serves on); stop() disarms only THIS plane's registration.
     _watchdog_token = supervisor().arm(watchdog_s)
+    # Unschedulable-reason attribution (models/explain.py): serve arms the
+    # explain pass on a cadence by default (every 10th round of EACH
+    # pool -- per-pool counters, so no pool aliases out of attribution) so
+    # every deployment answers "why wasn't my job scheduled" with a reason
+    # code; 0 disables.  ARMADA_EXPLAIN_INTERVAL (the drill/test override)
+    # wins over this default inside explain_interval().  Armed LAST --
+    # after every fallible startup step -- so a failed start never leaks
+    # the serve default into a library embedder (stop() disarms it).
+    from armada_tpu.models import explain as _explain
+
+    _explain_token = _explain.arm_default(
+        10 if explain_interval is None else explain_interval
+    )
+
     return ControlPlaneProcess(
         port=bound_port,
         scheduler=scheduler,
@@ -662,6 +692,7 @@ def start_control_plane(
         checkpoint_manager=checkpointer,
         restore_info=restore_info,
         _watchdog_token=_watchdog_token,
+        _explain_token=_explain_token,
     )
 
 

@@ -193,6 +193,14 @@ class _Handler(BaseHTTPRequestHandler):
                     body["trace"] = srv.trace_status()
                 except Exception as exc:  # noqa: BLE001
                     body["trace"] = {"error": str(exc)}
+            if srv.explain_status is not None:
+                # Explain block (models/explain.py via the reports repo):
+                # last unschedulable-reason attribution per pool -- reason
+                # counts, fragmentation indices, per-key table.
+                try:
+                    body["explain"] = srv.explain_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["explain"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -271,6 +279,9 @@ class HealthServer:
         # Optional () -> dict: the cycle-trace block (serve wires
         # ops/trace.recorder().healthz_block: last cycle's top spans).
         self.trace_status = None
+        # Optional () -> dict: last explain-pass attribution per pool
+        # (serve wires SchedulingReportsRepository.explain_summary).
+        self.explain_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -337,6 +337,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if details is None:
                     self._json({"error": f"no job {job_id}"}, 404)
                 else:
+                    # why-(not)-scheduled forensics (scheduler/reports +
+                    # the explain pass's reason codes).  Best-effort: a
+                    # follower that cannot reach the leader still serves
+                    # the lookout rows.
+                    from armada_tpu.scheduler.reports import try_job_report
+
+                    report = try_job_report(srv.reports, job_id)
+                    if report is not None:
+                        details["scheduling_report"] = report
                     self._json(details)
             elif path == "/api/logs":
                 if srv.logs_of is None:
@@ -502,6 +511,7 @@ class LookoutWebUI:
         oidc=None,
         submit=None,
         trust_proxy: bool = False,
+        reports=None,
     ):
         # `submit`: a server.submit.SubmitServer enabling the UI's operator
         # actions (cancel / reprioritise, the reference UI's dialogs); None
@@ -512,6 +522,10 @@ class LookoutWebUI:
         self.queries = queries
         self.logs_of = logs_of
         self.submit = submit
+        # Optional SchedulingReportsRepository (or its leader-proxying
+        # wrapper): job details gain the scheduler's why-(not)-scheduled
+        # report, incl. the explain pass's reason codes (models/explain.py).
+        self.reports = reports
         self.authenticator = authenticator
         self.trust_proxy = trust_proxy
         if oidc is not None and authenticator is None:

@@ -51,7 +51,8 @@ class _ShadowOnce:
 
 
 def run_round_on_device(
-    problem, ctx, config, device_problem=None, shadow_work=(), host_problem=None
+    problem, ctx, config, device_problem=None, shadow_work=(),
+    host_problem=None, explain_enabled=True,
 ):
     """(result, outcome): run the jitted round on a built problem and decode,
     including the gang-txn rollback loop.  Shared by the from-scratch path
@@ -97,6 +98,17 @@ def run_round_on_device(
     )
     shadow = _ShadowOnce(shadow_work)
     mesh_sv = mesh_serving()
+    # ONE cadence tick per scheduling round, decided here: the failover /
+    # mesh-degrade ladder re-enters _round_body for the SAME round, and the
+    # committed (degraded) re-run must keep the attribution the device
+    # attempt was armed for.  Away rounds pass explain_enabled=False and
+    # never TICK: their outcome.explain is discarded by the away apply, and
+    # a tick here would halve/drift the host pool's advertised cadence.
+    explain_armed = False
+    if explain_enabled:
+        from armada_tpu.models import explain as _explain_mod
+
+        explain_armed = _explain_mod.explain_due(getattr(ctx, "pool", ""))
 
     def build_device_problem():
         dp = device_problem() if callable(device_problem) else device_problem
@@ -131,7 +143,8 @@ def run_round_on_device(
 
         with jax.default_device(jax.devices("cpu")[0]):
             return _round_body(
-                build_device_problem(), ctx, config, kernel_kwargs, shadow
+                build_device_problem(), ctx, config, kernel_kwargs, shadow,
+                explain_armed,
             )
 
     deadline = sup.deadline_s()
@@ -139,13 +152,15 @@ def run_round_on_device(
         # Watchdog disabled (tests/bench default): the original inline path.
         faults.check("device_round")
         return _round_body(
-            build_device_problem(), ctx, config, kernel_kwargs, shadow
+            build_device_problem(), ctx, config, kernel_kwargs, shadow,
+            explain_armed,
         )
 
     def _device_attempt():
         faults.check("device_round")
         return _round_body(
-            build_device_problem(), ctx, config, kernel_kwargs, shadow
+            build_device_problem(), ctx, config, kernel_kwargs, shadow,
+            explain_armed,
         )
 
     try:
@@ -206,7 +221,8 @@ def run_round_on_device(
                 ):
                     out = run_with_deadline(
                         lambda m=smaller: _run_round_on_mesh(
-                            hp, ctx, config, kernel_kwargs, shadow, m
+                            hp, ctx, config, kernel_kwargs, shadow, m,
+                            explain_armed,
                         ),
                         deadline,
                         what=f"mesh round ({n} devices)",
@@ -223,11 +239,13 @@ def run_round_on_device(
         _trace().annotate(degraded=True, failover_reason=reason[:300])
         with _trace().span("cpu_failover", reason=reason[:300]):
             return _run_round_cpu_failover(
-                hp, ctx, config, kernel_kwargs, shadow
+                hp, ctx, config, kernel_kwargs, shadow, explain_armed
             )
 
 
-def _run_round_on_mesh(host_problem, ctx, config, kernel_kwargs, shadow, mesh):
+def _run_round_on_mesh(
+    host_problem, ctx, config, kernel_kwargs, shadow, mesh, explain_armed=False
+):
     """Re-run the SAME round sharded over a (smaller) mesh from host
     tables -- the degrade-ladder rung between full mesh and CPU failover.
     The device caches were reset by the ladder's hooks; this path pays one
@@ -235,11 +253,14 @@ def _run_round_on_mesh(host_problem, ctx, config, kernel_kwargs, shadow, mesh):
     from armada_tpu.parallel.mesh import shard_problem
 
     return _round_body(
-        shard_problem(host_problem, mesh), ctx, config, kernel_kwargs, shadow
+        shard_problem(host_problem, mesh), ctx, config, kernel_kwargs, shadow,
+        explain_armed,
     )
 
 
-def _run_round_cpu_failover(host_problem, ctx, config, kernel_kwargs, shadow):
+def _run_round_cpu_failover(
+    host_problem, ctx, config, kernel_kwargs, shadow, explain_armed=False
+):
     """Re-run the SAME round on the explicit XLA:CPU backend from host
     tables.  The device caches were reset by the supervisor's failure hooks
     (stale device state must never be consulted again); this path re-uploads
@@ -254,15 +275,21 @@ def _run_round_cpu_failover(host_problem, ctx, config, kernel_kwargs, shadow):
             # were reset, nothing sharded survives; host tables re-upload
             *(jax.device_put(_np.asarray(a), cpu) for a in host_problem)
         )
-        return _round_body(dp, ctx, config, kernel_kwargs, shadow)
+        return _round_body(
+            dp, ctx, config, kernel_kwargs, shadow, explain_armed
+        )
 
 
-def _round_body(device_problem, ctx, config, kernel_kwargs, shadow):
+def _round_body(
+    device_problem, ctx, config, kernel_kwargs, shadow, explain_armed=False
+):
     """One complete round against already-device-resident tensors: kernel,
-    overlapped decode + shadow work, and the gang-txn rollback loop."""
+    overlapped decode + shadow work, the gang-txn rollback loop, and (on
+    its cadence) the explain pass."""
     import jax.numpy as jnp
     import numpy as _np
 
+    from armada_tpu.models import explain as _explain
     from armada_tpu.ops.trace import recorder as _trace
 
     trace = _trace()
@@ -275,6 +302,16 @@ def _round_body(device_problem, ctx, config, kernel_kwargs, shadow):
     # in the serve/sidecar paths (the bench loop already did this).
     with trace.span("decode_dispatch"):
         finish = begin_decode(result, ctx)
+    # Explain pass (models/explain.py): dispatched BEHIND the decode
+    # compaction so its device compute and device->host copy ride the
+    # decode shadow; the blocking fetch happens after the outcome, off the
+    # decision path.  ONE extra transfer, only on explain rounds.
+    exp_dispatched = None
+    if explain_armed:
+        with trace.span("explain_dispatch"):
+            exp_dispatched = _explain.dispatch_explain(
+                device_problem, result, ctx
+            )
     with trace.span("shadow"):
         shadow.run_pending()
     # The fetch span is where kernel + transfer latency surfaces: the
@@ -331,12 +368,23 @@ def _round_body(device_problem, ctx, config, kernel_kwargs, shadow):
             device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
             result = schedule_round(device_problem, **kernel_kwargs)
             outcome = begin_decode(result, ctx)()
+    if attempts and explain_armed:
+        # Attribution must describe the FINAL (post-rollback) round, so the
+        # shadow-dispatched buffer is stale -- re-dispatch ONCE here rather
+        # than per re-run attempt (each abandoned dispatch would still pay
+        # its O(KxN) pass + async copy on the tunnel).
+        exp_dispatched = _explain.dispatch_explain(device_problem, result, ctx)
     if attempts >= 4:
         # Attempt-cap backstop: never report a half-preempted running gang.
         # Force the retained members into the preempted set -- their freed
         # capacity goes unused this cycle (under-scheduling is safe,
         # half-gangs are not).
         _force_preempt_partials(ctx, outcome)
+    if exp_dispatched is not None:
+        with trace.span("explain_fetch"):
+            outcome.explain = _explain.finish_explain(
+                exp_dispatched, ctx, outcome
+            )
     outcome.pool_totals = ctx.pool_total_atoms
     return result, outcome
 
@@ -443,7 +491,11 @@ def run_scheduling_round(
         banned_nodes=banned_nodes,
         queue_penalty=queue_penalty,
     )
-    result, outcome = run_round_on_device(problem, ctx, config)
+    result, outcome = run_round_on_device(
+        # away rounds: attribution is a HOME-round signal (the away apply
+        # discards outcome.explain) -- don't tick the host pool's cadence
+        problem, ctx, config, explain_enabled=not away_mode
+    )
     if collect_stats:
         collect_round_stats(result, problem, ctx, config, outcome)
     return outcome

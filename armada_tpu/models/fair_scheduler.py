@@ -88,6 +88,11 @@ class RoundResult(NamedTuple):
     # Market pools: bid of the gang whose placement crossed the spot cutoff
     # (queue_scheduler.go:135-150); -1 = not set.
     spot_price: jax.Array  # f32
+    # Queues deactivated mid-round by per-queue burst / per-(queue, PC) cap
+    # trips (constraints.go gate_queue); consumed by the explain pass
+    # (models/explain.py) to attribute still-pending jobs to
+    # `fairness-capped` rather than `round-terminated`.
+    q_killed: jax.Array  # bool[Q]
 
 
 # Header slots of the packed decode buffer (see compact_result).
@@ -1500,4 +1505,5 @@ def _schedule_round_jit(
         termination=termination,
         scheduled_count=carry.sched_count,
         spot_price=carry.spot_price,
+        q_killed=carry.q_killed,
     )

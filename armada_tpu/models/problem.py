@@ -217,6 +217,10 @@ class RoundOutcome:
     # re-runs without the doomed gangs to roll them back (the reference's
     # gang-txn rollback, nodedb.go:347).
     unwound_groups: frozenset = frozenset()
+    # Unschedulable-reason attribution (models/explain.py ExplainOutcome):
+    # populated on explain-cadence rounds (ARMADA_EXPLAIN_INTERVAL), None
+    # otherwise.  Feeds reports, metrics, /healthz and bench.
+    explain: Optional[object] = None
 
 
 def pc_queue_caps(config, pc_names, factory, total_pool) -> np.ndarray:

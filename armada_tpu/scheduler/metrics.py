@@ -96,6 +96,25 @@ class SchedulerMetrics:
             "Nodes currently excluded for high failure rates",
             registry=registry,
         )
+        # Explain-pass attribution (models/explain.py): per-queue
+        # unschedulable-job counts by dominant reason, refreshed on explain
+        # cycles (ARMADA_EXPLAIN_INTERVAL); label sets not reported by the
+        # latest pass are removed so a drained queue stops exporting.
+        self.unschedulable_jobs = g(
+            "armada_scheduler_unschedulable_jobs",
+            "Jobs a scheduling round left unplaced, by dominant reason "
+            "(shape-infeasible / capacity-blocked / fairness-capped / "
+            "gang-partial / round-terminated)",
+            ["pool", "queue", "reason"],
+        )
+        self.fragmentation_index = g(
+            "armada_scheduler_fragmentation_index",
+            "1 - largest single-node free block / total free capacity, "
+            "per resource (0 = one node could absorb all free capacity)",
+            ["pool", "resource"],
+        )
+        self._unsched_labels: set = set()
+        self._frag_labels: set = set()
         # Device-loss degradation state (core/watchdog): dashboards alert on
         # device_healthy == 0 (rounds running on the CPU failover) and on
         # device_fallbacks increasing (each is one lost round re-run).
@@ -304,6 +323,44 @@ class SchedulerMetrics:
                 pass
         self._used_labels = seen
 
+    def _observe_explain(self, pool: str, explain) -> None:
+        """Publish one pool's explain attribution (models/explain.py):
+        per-(queue, reason) unschedulable counts + per-resource
+        fragmentation indices.  Stale (pool, queue, reason) series from a
+        previous pass are removed, mirroring observe_executor_usage."""
+        seen = set()
+        for qname, reasons in explain.queue_counts.items():
+            for reason, n in reasons.items():
+                labels = (pool, qname, reason)
+                seen.add(labels)
+                self.unschedulable_jobs.labels(*labels).set(float(n))
+        for labels in {
+            l for l in self._unsched_labels if l[0] == pool
+        } - seen:
+            try:
+                self.unschedulable_jobs.remove(*labels)
+            except KeyError:
+                pass
+        self._unsched_labels = {
+            l for l in self._unsched_labels if l[0] != pool
+        } | seen
+        fseen = set()
+        for resource, frag in explain.fragmentation.items():
+            fseen.add((pool, resource))
+            self.fragmentation_index.labels(pool, resource).set(
+                float(frag.get("index", 0.0))
+            )
+        for labels in {
+            l for l in self._frag_labels if l[0] == pool
+        } - fseen:
+            try:
+                self.fragmentation_index.remove(*labels)
+            except KeyError:
+                pass
+        self._frag_labels = {
+            l for l in self._frag_labels if l[0] != pool
+        } | fseen
+
     def observe_cycle(self, result, duration_s: float, now: Optional[float] = None) -> None:
         """`result` is a CycleResult; records cycle time + decisions + shares."""
         if self._state_reset_interval_s > 0:
@@ -356,6 +413,9 @@ class SchedulerMetrics:
                 )
                 error += abs(qs["adjusted_fair_share"] - qs["actual_share"])
             self.fairness_error.labels(stats.pool).set(error)
+            explain = getattr(stats.outcome, "explain", None)
+            if explain is not None:
+                self._observe_explain(stats.pool, explain)
             for prio, share in stats.outcome.indicative_shares.items():
                 self.indicative_share.labels(stats.pool, str(prio)).set(share)
             if stats.market:

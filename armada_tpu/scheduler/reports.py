@@ -11,6 +11,7 @@ to it (scheduled where / failed why / preempted), in bounded LRU caches.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from typing import Optional
@@ -23,11 +24,32 @@ class SchedulingReportsRepository:
         self._pool_reports: dict[str, dict] = {}
         self._job_reports: collections.OrderedDict[str, dict] = collections.OrderedDict()
         self._max_jobs = max_job_reports
+        # Last explain pass per pool (models/explain.py summary()): the
+        # /healthz `explain` block and the pool-report forensics.  Explain
+        # runs on a cadence (ARMADA_EXPLAIN_INTERVAL), so this holds the
+        # most recent attribution, stamped with its cycle time.
+        self._explain: dict[str, dict] = {}
 
     # --- recording (called by the Scheduler after algo.schedule) ------------
 
     def record_cycle(self, scheduler_result, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
+        # Preemptor attribution (the reference's job report names the
+        # preempting job, reports/repository.go preemptedJobReport): record
+        # the first job this cycle scheduled onto the preempted run's node.
+        # Co-location, not proven causation -- the newcomer may have landed
+        # on pre-existing free capacity while the eviction came from
+        # fair-share rebalancing elsewhere -- so the reason text says
+        # "scheduled onto the freed node", never "preempted by".
+        preemptor_of_node: dict[str, tuple] = {}
+        if scheduler_result.preempted:  # steady cycles preempt nothing
+            for job, run in scheduler_result.scheduled:
+                if run.node_id not in preemptor_of_node:
+                    preemptor_of_node[run.node_id] = (
+                        job.id,
+                        job.queue,
+                        run.scheduled_at_priority,
+                    )
         with self._lock:
             for job, run in scheduler_result.scheduled:
                 self._put_job(
@@ -42,27 +64,61 @@ class SchedulingReportsRepository:
                     },
                 )
             for job, run in scheduler_result.preempted:
-                self._put_job(
-                    job.id,
-                    {
-                        "time": now,
-                        "outcome": "preempted",
-                        "node": run.node_id,
-                        "queue": job.queue,
-                        "reason": "fair-share or oversubscription eviction",
-                    },
-                )
+                report = {
+                    "time": now,
+                    "outcome": "preempted",
+                    "node": run.node_id,
+                    "queue": job.queue,
+                    "reason": "fair-share or oversubscription eviction",
+                }
+                preemptor = preemptor_of_node.get(run.node_id)
+                if preemptor is not None and preemptor[0] != job.id:
+                    pj, pq, pp = preemptor
+                    report["preemptor_job"] = pj
+                    report["preemptor_queue"] = pq
+                    report["preemptor_priority"] = pp
+                    report["reason"] = (
+                        "fair-share or oversubscription eviction; queue "
+                        f"{pq!r} scheduled onto the freed node at priority "
+                        f"{pp} this cycle"
+                    )
+                self._put_job(job.id, report)
             for stats in scheduler_result.pools:
                 o = stats.outcome
+                explain = getattr(o, "explain", None)
                 # Bounded like the reference's
                 # maxJobSchedulingContextsPerExecutor (config.yaml:107): a
                 # round can retire a whole unfeasible key class (~the entire
                 # backlog in o.failed); decoding more ids than the LRU can
                 # hold burns seconds per cycle for entries that would evict
                 # each other anyway.
-                import itertools
-
+                covered: set = set()
+                if explain is not None:
+                    # Explain cycles carry per-job reason codes (lazy pairs,
+                    # same bounded decode discipline).
+                    for job_id, reason in itertools.islice(
+                        explain.iter_job_reasons(), self._max_jobs
+                    ):
+                        covered.add(job_id)
+                        self._put_job(
+                            job_id,
+                            {
+                                "time": now,
+                                "outcome": "failed",
+                                "pool": stats.pool,
+                                "reason": reason,
+                            },
+                        )
+                # Failed jobs the pass did not pair (decode-time gang
+                # unwinds landed in o.failed after the device scan; failed
+                # gangs past the fcap) still get the generic report --
+                # explain cycles must never answer FEWER jobs than plain
+                # ones.  The scan examines at most _max_jobs ids (the same
+                # bound the generic branch always had: LazyJobIds makes a
+                # full walk O(backlog)).
                 for job_id in itertools.islice(o.failed, self._max_jobs):
+                    if job_id in covered:
+                        continue
                     self._put_job(
                         job_id,
                         {
@@ -73,7 +129,7 @@ class SchedulingReportsRepository:
                             "matched the job's scheduling key this round",
                         },
                     )
-                self._pool_reports[stats.pool] = {
+                pool_report = {
                     "time": now,
                     "num_nodes": stats.num_nodes,
                     "num_queued": stats.num_queued,
@@ -84,13 +140,47 @@ class SchedulingReportsRepository:
                     "iterations": o.num_iterations,
                     "termination": o.termination,
                 }
+                if explain is not None:
+                    summary = explain.summary()
+                    pool_report["explain"] = {**summary, "attributed_at": now}
+                    self._explain[stats.pool] = {"time": now, **summary}
+                elif stats.pool in self._explain:
+                    # keep the last attribution visible, stamped with the
+                    # cycle it was COMPUTED at -- a stale histogram must
+                    # never read as current next to pool_report["time"]
+                    block = self._explain[stats.pool]
+                    pool_report["explain"] = {
+                        **{k: v for k, v in block.items() if k != "time"},
+                        "attributed_at": block["time"],
+                    }
+                self._pool_reports[stats.pool] = pool_report
                 for qname, qs in o.queue_stats.items():
-                    self._queue_reports[(stats.pool, qname)] = {
+                    qr = {
                         "time": now,
                         "pool": stats.pool,
                         "queue": qname,
                         **qs,
                     }
+                    # Fairness headroom: how much share the queue could still
+                    # claim before its (demand-capped) fair share gates it --
+                    # the aggregate ROADMAP items 2/4/5 read.
+                    qr["fairness_headroom"] = max(
+                        0.0,
+                        qs.get("adjusted_fair_share", 0.0)
+                        - qs.get("actual_share", 0.0),
+                    )
+                    if explain is not None:
+                        qr["unschedulable"] = dict(
+                            explain.queue_counts.get(qname, {})
+                        )
+                    self._queue_reports[(stats.pool, qname)] = qr
+
+    def explain_summary(self) -> dict:
+        """Last explain attribution per pool (the /healthz `explain` block):
+        reason counts, fragmentation indices, per-key table, stamped with
+        the cycle time it was computed at."""
+        with self._lock:
+            return {pool: dict(block) for pool, block in self._explain.items()}
 
     def _put_job(self, job_id: str, report: dict) -> None:
         self._job_reports[job_id] = report
@@ -115,6 +205,20 @@ class SchedulingReportsRepository:
             if pool is not None:
                 return {pool: self._pool_reports.get(pool, {})}
             return dict(self._pool_reports)
+
+
+def try_job_report(reports, job_id: str) -> Optional[dict]:
+    """Best-effort job report for read surfaces that must keep answering
+    when the reports backend cannot (a follower cut off from the leader
+    behind LeaderProxyingReports): the report, or None on a miss OR any
+    backend error.  Shared by the lookout web UI and the REST gateway's
+    job-details attachment."""
+    if reports is None:
+        return None
+    try:
+        return reports.job_report(job_id)
+    except Exception:  # noqa: BLE001 -- proxy outage: serve without it
+        return None
 
 
 class ReportsUnavailable(Exception):

@@ -28,6 +28,9 @@ Routes (reference paths):
   GET    /v1/reports/job/{id} | /v1/reports/queue/{name} |
          /v1/reports/pool[/{name}] -> scheduling-report JSON
          (the reference's lookout REST API / queryapi + reports/server.go)
+  GET    /v1/reports/explain/{job-id} -> unschedulable-reason code JSON;
+         /v1/reports/explain -> per-pool explain forensics (reason
+         histograms + fragmentation; models/explain.py)
 
 Identity resolves through the same authenticator chain the gRPC transport
 uses (server/authn.py): basic / OIDC bearer / kubernetes token review /
@@ -312,6 +315,14 @@ class _Handler(BaseHTTPRequestHandler):
             if details is None:
                 self._error(404, f"job {job_id!r} not found")
             else:
+                # scheduler forensics next to the lookout rows (incl. the
+                # explain pass's reason codes); best-effort -- a follower
+                # cut off from the leader still answers.
+                from armada_tpu.scheduler.reports import try_job_report
+
+                report = try_job_report(gw.reports, job_id)
+                if report is not None:
+                    details["scheduling_report"] = report
                 self._send(200, json.dumps(details).encode())
         elif path.startswith("/v1/reports/"):
             # scheduling-reports forensics (reports/server.go; followers
@@ -334,10 +345,44 @@ class _Handler(BaseHTTPRequestHandler):
                     report = gw.reports.queue_report(name)
                 elif kind == "pool":
                     report = gw.reports.pool_report(name or None)
+                elif kind == "explain" and name:
+                    # `armadactl explain <job-id>` end to end: the latest
+                    # explain-pass reason code for one job
+                    # (models/explain.py catalogue) -- recorded in the job
+                    # report on explain-cadence rounds.
+                    report = gw.reports.job_report(name)
+                    if report is None:
+                        self._error(
+                            404,
+                            f"no scheduling report for job {name!r} (not "
+                            "seen by a round yet, or evicted from the "
+                            "bounded report cache)",
+                        )
+                        return
+                    report = {
+                        "job_id": name,
+                        "outcome": report.get("outcome"),
+                        "reason": report.get("reason"),
+                        **{
+                            k: v
+                            for k, v in report.items()
+                            if k.startswith("preemptor_") or k in ("node", "pool", "queue", "time")
+                        },
+                    }
+                elif kind == "explain":
+                    # pool-level forensics: the explain block of every
+                    # pool's latest attributed round (reason histograms +
+                    # fragmentation indices); rides pool_report so it
+                    # leader-proxies like every other report query.
+                    report = {
+                        pool: r.get("explain", {})
+                        for pool, r in gw.reports.pool_report(None).items()
+                    }
                 else:
                     self._error(
-                        404, "expected /v1/reports/{job|queue}/{name} or "
-                        "/v1/reports/pool[/{name}]"
+                        404, "expected /v1/reports/{job|queue}/{name}, "
+                        "/v1/reports/pool[/{name}] or "
+                        "/v1/reports/explain[/{job-id}]"
                     )
                     return
             except ReportsUnavailable as e:

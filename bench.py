@@ -15,7 +15,8 @@ vs_baseline = 5.0 / value  (x times faster than the reference's round budget).
 Env knobs for local runs: ARMADA_BENCH_JOBS, ARMADA_BENCH_NODES,
 ARMADA_BENCH_QUEUES, ARMADA_BENCH_REPEATS, ARMADA_BENCH_RUNS,
 ARMADA_BENCH_BURST (per-cycle placement cap + arrival count -- the
-mass-placement datapoint, docs/bench.md).
+mass-placement datapoint, docs/bench.md); ARMADA_BENCH_EXPLAIN=0 skips
+the explain-pass measurement (explain_s + explain_counts keys).
 
 The JSON carries host-load context (loadavg / cpu_count): the round-3
 driver number was captured against a rogue CPU-pinned pytest (VERDICT r3
@@ -205,7 +206,10 @@ def _kernel_bench(num_gangs, num_nodes, num_queues, repeats, burst=1_000):
     return min(times)
 
 
-def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=False):
+def _e2e_bench(
+    num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=False,
+    measure_explain=True,
+):
     """Full steady-state cycle: deltas -> assemble -> upload -> kernel ->
     decode, over the incremental builder (models/incremental.py).  Returns
     (cycle_s, breakdown dict, scheduled count).  mesh=True runs the SAME
@@ -282,6 +286,7 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=F
     # spans and the keys.
     stages_on = os.environ.get("ARMADA_BENCH_TRACE", "") != "0"
     rec = trace_recorder()
+    _last_round: dict = {}
 
     def cycle(t_now):
         """One measured cycle; the trace cycle wraps _cycle_body via a
@@ -417,6 +422,9 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=F
                 spec_of[s.id] = s
             builder.submit_many(fresh)  # carries its own trace span
         t_end = time.perf_counter()
+        # Kept for the post-loop explain-pass measurement (outside the
+        # timed cycle): round-final device tensors + decode ctx.
+        _last_round.update(dev=dev, result=result, ctx=ctx)
         return (
             t_end - t_start,
             {
@@ -450,6 +458,39 @@ def _e2e_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=F
     assert scheduled > 0, "e2e cycle scheduled nothing"
     for k, v in warm_chip_xfer.items():
         best_parts.setdefault(k, v)
+    # Explain pass (models/explain.py; ARMADA_BENCH_EXPLAIN=0 skips): the
+    # unschedulable-reason attribution over the LAST measured round's slab,
+    # timed dispatch->fetch at steady state (first run pays the one-off jit
+    # compile) -- explain_s is the full off-critical-path cost of an
+    # explain-cadence round, and explain_transfers pins the ONE extra
+    # device->host transfer the pass is allowed.
+    if (
+        measure_explain
+        and os.environ.get("ARMADA_BENCH_EXPLAIN", "1") != "0"
+        and _last_round
+    ):
+        from armada_tpu.models import explain as _explain
+
+        t_explain, out = None, None
+        for _ in range(2):
+            TRANSFER_STATS.reset()
+            t0 = time.perf_counter()
+            out = _explain.finish_explain(
+                _explain.dispatch_explain(
+                    _last_round["dev"], _last_round["result"],
+                    _last_round["ctx"],
+                ),
+                _last_round["ctx"],
+            )
+            t_explain = time.perf_counter() - t0
+        if out is not None:
+            best_parts["explain_s"] = round(t_explain, 4)
+            best_parts["explain_counts"] = {
+                k: v for k, v in out.counts.items() if v
+            }
+            best_parts["explain_transfers"] = TRANSFER_STATS.snapshot()[
+                "down_transfers"
+            ]
     return best, best_parts, scheduled
 
 
@@ -637,7 +678,8 @@ def _mesh_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, platf
     try:
         print(f"bench: mesh arm over {n} devices", file=sys.stderr)
         cycle_s, parts, scheduled = _e2e_bench(
-            num_jobs, num_nodes, num_queues, num_runs, repeats, burst, mesh=True
+            num_jobs, num_nodes, num_queues, num_runs, repeats, burst,
+            mesh=True, measure_explain=False,
         )
         out["mesh_cycle_s"] = round(cycle_s, 4)
         out["mesh_scheduled_per_cycle"] = scheduled
@@ -666,6 +708,7 @@ def _mesh_bench(num_jobs, num_nodes, num_queues, num_runs, repeats, burst, platf
                 repeats=max(1, repeats // 3),
                 burst=burst,
                 mesh=True,
+                measure_explain=False,
             )
             out["mesh_scale_cycle_s"] = round(scale_s, 4)
             out["mesh_scale_jobs"] = scale_jobs
@@ -873,6 +916,7 @@ def main():
             num_runs,
             repeats=max(1, repeats // 3),
             burst=b10k,
+            measure_explain=False,  # the headline arm already measured it
         )
         print(
             f"bench: burst10k cycle {burst10k_s:.4f}s "
