@@ -1045,6 +1045,65 @@ def _inloop_scatter_gathered_key(src: Source):
                 )
 
 
+@rule(
+    "commit-scatter-gathered-old",
+    "an in-loop commit scatter keyed on gathered candidates re-reads its own "
+    "base buffer at the gathered lanes (`x.at[idx].set(where(ok, v, "
+    "x[idx]))`): batched dummy lanes sharing a real index race the true "
+    "write (the round-3 double-placed gang 0) -- scatter a CONSTANT value "
+    "with dummy lanes pushed out of range and mode='drop'",
+    scope=_KERNEL_DF,
+)
+def _commit_scatter_gathered_old(src: Source):
+    if "while_loop" not in src.text and "fori_loop" not in src.text:
+        return
+    ma = _df.of(src)
+    seen: set = set()
+    for fa in _loop_body_analyses(ma):
+        for sc in fa.scatters:
+            if sc.method != "set":
+                continue
+            key = (sc.call.lineno, sc.call.col_offset)
+            if key in seen:
+                continue
+            if (
+                _df.GATHER not in sc.index_tags
+                or _df.CARRY not in sc.base_tags
+            ):
+                continue
+            base_name = _dotted(sc.base)
+            if not base_name:
+                continue
+            for arg in sc.call.args:
+                hit = None
+                for node in ast.walk(arg):
+                    # the old-value read: a gather of the SCATTERED base
+                    # itself, indexed by tainted (gathered) lanes
+                    if (
+                        isinstance(node, ast.Subscript)
+                        and _dotted(node.value) == base_name
+                        and _df.CARRY in fa.tags(node.value)
+                        and _df.GATHER in fa.tags(node.slice)
+                    ):
+                        hit = node
+                        break
+                if hit is not None:
+                    seen.add(key)
+                    yield _finding(
+                        src,
+                        "commit-scatter-gathered-old",
+                        sc.call,
+                        "commit scatter keyed on gathered candidates reads "
+                        "its own base back at the scattered lanes: with "
+                        "batched lanes, masked-out dummies sharing a real "
+                        "index race the true write -- scatter a constant "
+                        "with mode='drop' and out-of-range dummy indices "
+                        "(single-lane scalar commits carry a reasoned "
+                        "allow: one lane cannot lane-race)",
+                    )
+                    break
+
+
 def _jit_bound_names(src: Source, site) -> set:
     """Names a `jax.jit(f)` result is bound to, or the decorated def name."""
     names: set = set()

@@ -320,11 +320,14 @@ def cmd_report(args):
                 if not r:
                     print(f"{pool}: no rounds recorded")
                     continue
+                ki = r.get("kernel_iters")
                 print(
                     f"{pool}: nodes={r['num_nodes']} queued={r['num_queued']} "
                     f"running={r['num_running']} scheduled={r['scheduled']} "
                     f"preempted={r['preempted']} failed={r['failed']} "
-                    f"iterations={r['iterations']} termination={r['termination']}"
+                    f"iterations={r['iterations']}"
+                    + (f" kernel_iters={ki}" if ki else "")
+                    + f" termination={r['termination']}"
                 )
 
     with_closed(_client(args), go)
@@ -747,6 +750,12 @@ def cmd_serve(args):
         # the whole plane (scheduler loop, sidecar sessions) to the
         # sequential cycle order.
         os.environ["ARMADA_PIPELINE"] = "0"
+    if getattr(args, "commit_k", None) is not None:
+        # schedule_round resolves ARMADA_COMMIT_K per call OUTSIDE its jit
+        # boundary, so one env set arms every round this plane runs
+        # (scheduler loop, sidecar sessions, mesh reruns) with compile
+        # caches keyed on the resolved K.
+        os.environ["ARMADA_COMMIT_K"] = str(args.commit_k)
     config, authenticator = load_serve_config(args)
     plane = start_control_plane(
         data_dir=args.data_dir,
@@ -1078,6 +1087,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="external lookout database (postgres://...), the reference's "
         "second Postgres -- a FRESH database this plane owns.  Default: "
         "embedded SQLite under --data-dir",
+    )
+    srv.add_argument(
+        "--commit-k",
+        type=int,
+        dest="commit_k",
+        help="arm the conflict-free multi-commit kernel: up to K certified-"
+        "independent placements commit per while-loop iteration (sets "
+        "ARMADA_COMMIT_K process-wide, so the scheduler loop, sidecar "
+        "sessions and mesh rounds all compile the same body; default 1 = "
+        "the single-commit kernel; decisions are bit-identical at any K)",
     )
     srv.add_argument(
         "--no-pipeline",

@@ -369,6 +369,10 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
             "ARMADA_TSAN",
             "ARMADA_FAULT_HANG_S",
             "ARMADA_REPROBE_INTERVAL_S",
+            # The armed multi-commit width rides through the drill (and its
+            # kill/restart resume) untouched, so soak/chaos legs exercise
+            # the configuration the operator armed, not a silent K=1.
+            "ARMADA_COMMIT_K",
         )
     }
     os.environ.pop("ARMADA_FAULT", None)
@@ -529,6 +533,11 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
                 for k in ("backend", "fallbacks", "promotions")
             },
         }
+        from armada_tpu.models.fair_scheduler import resolve_commit_k
+
+        # the ARMED multi-commit width (schedule_round may clamp the
+        # effective K to the queue-axis width per pool)
+        report["commit_k"] = resolve_commit_k()
         # Flat headline keys (the bench-JSON soak_* shape).
         for name, src in (
             ("cycle", slo_snap.get("cycle_latency_s", {})),

@@ -96,6 +96,14 @@ def run_round_on_device(
             and not bool(problem.market)
         ),
     )
+    if bool(problem.market):
+        # Market rounds bypass multi-commit DYNAMICALLY inside the body
+        # (bid order + spot crossing are order-dependent), but an armed
+        # ARMADA_COMMIT_K would still compile and pay the K-body's
+        # certification tables every trip with zero possible commits --
+        # force the single-commit compile for market pools, like
+        # prefer_large above (non-market pools keep the env resolution).
+        kernel_kwargs["commit_k"] = 1
     shadow = _ShadowOnce(shadow_work)
     mesh_sv = mesh_serving()
     # ONE cadence tick per scheduling round, decided here: the failover /
@@ -318,6 +326,18 @@ def _round_body(
     # dispatch spans above are async enqueues, this is the blocking wait.
     with trace.span("fetch_decode"):
         outcome = finish()
+    # Iteration-count legibility (ARMADA_COMMIT_K): the round span carries
+    # the physical trip count next to the logical one, so a multi-commit
+    # regression (certification truncating to 1) is visible in any trace
+    # without a TPU.  Values ride the compact decode buffer -- no extra
+    # transfer.
+    if outcome.kernel_iters:
+        trace.annotate(
+            kernel_iters=outcome.kernel_iters,
+            commits_per_iter=round(
+                outcome.num_iterations / outcome.kernel_iters, 2
+            ),
+        )
 
     # Gang-txn rollback (nodedb.go:347 ScheduleManyWithTxn: a gang is one txn,
     # all-or-nothing): if a split gang's sibling placed but another sub-gang

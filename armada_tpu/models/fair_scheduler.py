@@ -93,10 +93,16 @@ class RoundResult(NamedTuple):
     # (models/explain.py) to attribute still-pending jobs to
     # `fairness-capped` rather than `round-terminated`.
     q_killed: jax.Array  # bool[Q]
+    # Physical while-loop body applications.  `iterations` stays the LOGICAL
+    # sequential step count (bit-identical at any commit_k/batch_k -- it
+    # feeds TERM_MAX_ITER); kernel_iters is the observability counter the
+    # multi-commit work shrinks (commits_per_iter = iterations/kernel_iters).
+    # Excluded from the bit-equality contract the parity suites pin.
+    kernel_iters: jax.Array  # i32
 
 
 # Header slots of the packed decode buffer (see compact_result).
-_COMPACT_HEADER = 8
+_COMPACT_HEADER = 9
 
 
 @functools.partial(jax.jit, static_argnames=("fcap", "ecap"))
@@ -112,8 +118,8 @@ def compact_result(result: RoundResult, num_real_gangs, num_real_runs, *, fcap: 
     key-retirement rounds) and falls back to the full pull.
 
     Layout (i32): [n_slots, iterations, termination, sched_count,
-    spot_price_bits, n_failed, n_pre, n_res] ++ slot_gang[S] ++
-    slot_nodes[S*W] ++ slot_counts[S*W] ++ failed_idx[fcap] ++
+    spot_price_bits, n_failed, n_pre, n_res, kernel_iters] ++ slot_gang[S]
+    ++ slot_nodes[S*W] ++ slot_counts[S*W] ++ failed_idx[fcap] ++
     pre_idx[ecap] ++ res_idx[ecap].
     """
     g = result.g_state
@@ -144,6 +150,7 @@ def compact_result(result: RoundResult, num_real_gangs, num_real_runs, *, fcap: 
             n_failed,
             n_pre,
             n_res,
+            result.kernel_iters.astype(jnp.int32),
         ]
     )
     return jnp.concatenate(
@@ -178,6 +185,7 @@ class _Carry(NamedTuple):
     float_used: jax.Array  # f32[R] pool-level floating usage
     new_blocked: jax.Array
     iterations: jax.Array
+    kernel_iters: jax.Array  # physical body applications (see RoundResult)
     done: jax.Array
     termination: jax.Array
     spot_price: jax.Array  # f32; -1 = unset
@@ -271,6 +279,7 @@ def _make_place_iteration(
     cache_slots: int = 0,
     max_iterations: int = 0,
     batch_k: int = 1,
+    commit_k: int = 1,
 ):
     """prefer_large is a STATIC flag (like check_keys): the default compile
     carries none of the alternate-ordering work.  q_budget is the per-queue
@@ -294,7 +303,42 @@ def _make_place_iteration(
     Anything unprovable cuts the batch and defers to the next iteration, so
     the batch commits a certified PREFIX of the sequential order or
     nothing; decisions are bit-identical at any batch_k.  Requires
-    cache_slots == 0 and not prefer_large (enforced by schedule_round)."""
+    cache_slots == 0 and not prefer_large (enforced by schedule_round).
+
+    commit_k > 1 appends the CONFLICT-FREE MULTI-COMMIT extension
+    (ARMADA_COMMIT_K): unlike batch_k's serial replay (K sub-picks, each
+    with its own argmin/cond chain -- K times the op count, the measured
+    r3 dead end), this takes the top-K queue heads in ONE ordered
+    selection (lax.top_k over the same order keys the argmin reads; ties
+    break to the lower index, matching argmin) and certifies the set
+    non-interacting with vectorized [K]/[KxK] checks whose op count is
+    CONSTANT in K:
+      * pairwise-distinct queues by construction (top_k ranks), so no
+        pick perturbs another's fair-share row -- and each placed queue's
+        NEXT candidate cost is proven to not precede any later pick
+        (strictly greater, or equal with a higher queue index: the exact
+        argmin tie-break), using the sequential association
+        ((q_alloc + req) + penalty) + next_req;
+      * singles only -- gangs, evictees, banned (retry anti-affinity)
+        candidates and market rounds truncate (their replay semantics are
+        order-dependent; they run as exact heads next iteration);
+      * pairwise-distinct nodes among the extension picks, no clean-fit
+        flip and no newly-dominating score at any earlier pick's node
+        (alloc deltas are [KxK]-checked against fit and the first-argmin
+        tie-break), so every pick's node choice equals the sequential
+        re-derivation;
+      * caps/burst/float walked in commit order with the sequential f32
+        accumulation; a pick that WOULD trip a gate truncates so the gate
+        (and its new_blocked/q_killed/termination side effects) fires
+        next iteration.
+    The certified prefix commits in ONE batched scatter per table
+    (constant-value / distinct-lane `mode='drop'` scatters, dummy lanes
+    pushed out of range -- never a gathered-old-value race).  commit_k=1
+    compiles the existing body; decisions are bit-identical at any K
+    (only RoundResult.kernel_iters differs).  Works with the cached-fit
+    body (the maintenance pass re-derives at every committed node);
+    requires batch_k == 1 and not prefer_large (enforced by
+    schedule_round)."""
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
     Q = p.q_weight.shape[0]
@@ -638,6 +682,9 @@ def _make_place_iteration(
         spot_price = jnp.where(crossed, p.g_spot_price[g], c.spot_price)
         float_used = c.float_used + jnp.where(new_sched, req_float_tot, 0.0)
         q_sched = c.q_sched.at[qstar].add(jnp.where(new_sched, card, 0))
+        # lint: allow(commit-scatter-gathered-old) -- single scalar lane
+        # (the head pick): one lane cannot lane-race; the rule targets
+        # batched dummy-lane commits
         run_rescheduled = c.run_rescheduled.at[run_safe].set(
             jnp.where(is_evictee & placed, True, c.run_rescheduled[run_safe])
         )
@@ -657,6 +704,8 @@ def _make_place_iteration(
 
         # --- gang state + unfeasible-key registration ---------------------------
         failed_fit = attempt & ~feasible
+        # lint: allow(commit-scatter-gathered-old) -- single scalar lane
+        # (the head pick): one lane cannot lane-race
         g_state = c.g_state.at[g].set(
             jnp.where(placed, 1, jnp.where(failed_fit, 2, c.g_state[g]))
         )
@@ -664,6 +713,8 @@ def _make_place_iteration(
         # cursor skip drops them as they reach a queue head, and the post-loop
         # sweep in schedule_round marks them failed for reporting.
         register = failed_fit & (card == 1) & (key >= 0) & jnp.bool_(check_keys)
+        # lint: allow(commit-scatter-gathered-old) -- single scalar lane
+        # (the head pick's key registration): one lane cannot lane-race
         key_bad = c.key_bad.at[jnp.maximum(key, 0)].set(
             jnp.where(register, True, c.key_bad[jnp.maximum(key, 0)])
         )
@@ -678,6 +729,251 @@ def _make_place_iteration(
         # An inactive step keeps done as-is: flipping it would misreport a
         # max-iterations exit as exhaustion.
         done = jnp.where(active, ~any_q & ~advanced, c.done)
+
+        extra_iters = jnp.int32(0)
+        touched_nodes = nodes_w
+        if commit_k > 1 or batch_k > 1:
+            # Shared next-candidate cursor tables for BOTH batching shapes
+            # (they are mutually exclusive compiles, so one definition
+            # keeps the load-bearing parked semantics from drifting):
+            # the cursor parks on any undecided entry (in_r & ~skippable);
+            # nn[q, i] = first parked window index at-or-after i (W =
+            # none); a window that reaches past the queue tail proves
+            # nothing hides beyond it.
+            parked = in_r & ~skippable
+            nn = jnp.full((Q, W + 1), W, jnp.int32)
+            for i in range(W - 1, -1, -1):
+                nn = nn.at[:, i].set(jnp.where(parked[:, i], i, nn[:, i + 1]))
+            tail_known = ~in_r[:, W - 1]
+        if commit_k > 1:
+            # --- conflict-free multi-commit extension (see docstring) --------
+            # Vectorized over the K-1 extension lanes: every check below is
+            # one op with a [E]/[E,E] axis, so the body's op count stays
+            # CONSTANT in K (the batch_k replay's failure mode).
+            E = commit_k - 1
+            S_cap = slot_gang.shape[0]
+            iota_e = jnp.arange(E, dtype=jnp.int32)
+            iota_k = jnp.arange(E + 1, dtype=jnp.int32)
+
+            # (1) ordered top-K queues by the head's own order key.  top_k is
+            # stable (equal keys -> lower index first), matching the argmin
+            # tie-break; rank 0 IS the head queue qstar.
+            _, topq = jax.lax.top_k(-order_key, E + 1)
+            topq = topq.astype(jnp.int32)
+            qe = topq[1:]  # [E] extension queues (pairwise distinct)
+            keye = order_key[qe]
+            ge = cand[qe]
+            card_e = p.g_card[ge]
+            run_e = p.g_run[ge]
+            level_e = p.g_level[ge]
+            key_e = p.g_key[ge]
+            pc_e = p.g_pc[ge]
+            ban_e = p.g_ban_row[ge]
+            req_e = p.g_req[ge]  # [E, R]; card 1 => per-member == total
+            reqn_e = g_req_node[ge]
+            flt_e = g_float_tot[ge]
+
+            # (2) batch gate: the head must have placed (its commit above is
+            # the exact sequential step); market rounds are out (bid order +
+            # spot crossing replay is order-dependent); and no queue may
+            # have skipped past its whole window -- a hidden candidate could
+            # surface mid-batch and outrank a pick.
+            hidden = jnp.any((nskip >= W) & (q_head < p.q_len))
+            batch_ok = placed & ~p.market & ~hidden
+
+            # (3) eligibility: certified picks are non-evictee, unbanned
+            # singles with a live order key; everything else truncates and
+            # runs as an exact head next iteration.
+            elig = (keye < _INF) & (card_e == 1) & (run_e < 0) & (ban_e == 0)
+
+            # (4) caps/burst/float in commit order.  Distinct queues mean the
+            # per-queue gates see no intra-batch accumulation; the global
+            # accumulators replicate the sequential f32 association exactly
+            # (an unrolled E-step scalar chain -- E adds, not E iterations).
+            okc = []
+            run_res, run_flt = sched_res, float_used
+            for i in range(E):
+                nxt_res = run_res + req_e[i]
+                nxt_flt = run_flt + flt_e[i]
+                ci = (
+                    ((sched_count + i + 1) <= p.global_burst)
+                    & jnp.all(nxt_res <= p.round_cap)
+                    & jnp.all(nxt_flt <= p.float_total + 1e-3)
+                )
+                if max_iterations > 0:
+                    ci &= (c.iterations + 1 + i) < max_iterations
+                okc.append(ci)
+                run_res, run_flt = nxt_res, nxt_flt
+            ok_caps = jnp.stack(okc)
+            ok_caps &= (q_sched[qe] + 1) <= p.perq_burst[qe]
+            ok_caps &= jnp.all(
+                q_alloc_pc[qe, pc_e] + req_e <= p.pc_queue_cap[pc_e], axis=1
+            )
+
+            # (5) queue-order certification: after each batch queue's head
+            # commits, its NEXT candidate's proposed cost must not precede
+            # any later pick.  Next candidates come from the shared
+            # parked/nn/tail_known tables above.
+            qk = jnp.concatenate([qstar[None], qe])  # [K] batch queues
+            npos = nn[qk, jnp.minimum(pos[qk] + 1, W)]
+            np_safe = jnp.minimum(npos, W - 1)
+            g_next = wg[qk, np_safe]
+            next_tot = p.g_req[g_next] * p.g_card[g_next][:, None].astype(
+                jnp.float32
+            )
+            # head's commit is already in q_alloc; extension rows add their
+            # own -- the sequential ((q_alloc + req) + penalty) + next_req
+            # association either way.
+            own_req = jnp.concatenate(
+                [jnp.zeros((1, R), jnp.float32), req_e], axis=0
+            )
+            row_k = q_alloc[qk] + own_req
+            nk = weighted_drf_cost(
+                (row_k + p.q_penalty[qk]) + next_tot,
+                p.total_pool, p.drf_mult, p.q_weight[qk],
+            )
+            next_new = p.g_run[g_next] < 0
+            allowed = (
+                ~(next_new & (new_blocked | q_killed[qk]))
+                & (p.q_weight[qk] > 0)
+            )
+            nk = jnp.where(allowed, nk, _INF)
+            nk = jnp.where(
+                npos < W, nk, jnp.where(tail_known[qk], _INF, -_INF)
+            )
+            prior_k = iota_k[:, None] <= iota_e[None, :]  # j commits before e
+            ok_pair = (nk[:, None] > keye[None, :]) | (
+                (nk[:, None] == keye[None, :]) & (qk[:, None] > qe[None, :])
+            )
+            ok_order = jnp.all(ok_pair | ~prior_k, axis=0)  # [E]
+
+            # (6) fit + node choice per pick against the post-head slab --
+            # the same masked-score first-argmin the cached and general
+            # single paths compute, via the blocked [NB]+[B] pair.
+            static_e = jnp.where(
+                (key_e >= 0)[:, None],
+                p.compat[jnp.maximum(key_e, 0)][:, p.node_type],
+                True,
+            )
+            okn_e = static_e & p.node_ok[None, :]
+            fit0_e = okn_e & _fit_row(alloc[0][None, :, :], reqn_e[:, None, :])
+            fitl_e = okn_e & _fit_row(alloc[level_e], reqn_e[:, None, :])
+            score_lvls = node_packing_score(alloc, p.inv_scale)  # [P1, N]
+            use_clean_e = jnp.any(fit0_e, axis=1)
+            msel = jnp.where(
+                use_clean_e[:, None],
+                jnp.where(fit0_e, score_lvls[0][None, :], _INF),
+                jnp.where(fitl_e, score_lvls[level_e], _INF),
+            )
+            bm_e = jnp.min(msel.reshape(E, NB, B), axis=2)
+            # lint: allow(full-argmin) -- [NB] blocked rows x [B] in-block:
+            # the sanctioned two-level pick, vectorized over the E lanes
+            b_e = jnp.argmin(bm_e, axis=1).astype(jnp.int32)
+            blk = jnp.take_along_axis(
+                msel.reshape(E, NB, B), b_e[:, None, None], axis=1
+            )[:, 0]
+            # lint: allow(full-argmin) -- [B]-length in-block pick
+            j_in = jnp.argmin(blk, axis=1).astype(jnp.int32)
+            node_e = b_e * B + j_in
+            score_e = jnp.take_along_axis(msel, node_e[:, None], axis=1)[:, 0]
+            found_e = score_e < _INF
+            lvl_sel_e = jnp.where(use_clean_e, 0, level_e)
+
+            # (7) conflict certification with CUMULATIVE prior deltas: for
+            # pick e, every earlier extension pick k (the head's lanes are
+            # already in `alloc`, so the tables above see them exactly)
+            # subtracts its request at its node.  Same-node STACKING is the
+            # dominant best-fit pattern (consecutive same-shape picks pack
+            # the same fullest node until it fills) and certifies exactly:
+            # the node's score only drops, so it stays the first argmin
+            # while it still fits.  Requirements per pick e:
+            #   * no clean-fit flip at any prior node (use_clean provably
+            #     unchanged -- a flip means a node just filled; truncate);
+            #   * pick e's own node still fits under the cumulative delta
+            #     (sequential re-derivation lands on the same node);
+            #   * no OTHER prior node's post-commit score wins pick e's
+            #     first-argmin against its own ADJUSTED score (strictly
+            #     lower, or equal at a lower node index).
+            nj_safe = jnp.clip(node_e, 0, N - 1)
+            prior_f = (iota_e[:, None] > iota_e[None, :]).astype(
+                jnp.float32
+            )  # [e, k]: pick k commits before pick e
+            samen = (node_e[:, None] == node_e[None, :]).astype(
+                jnp.float32
+            )  # [j, k]: picks sharing a node
+            cum0 = jnp.einsum("ek,jk,kr->ejr", prior_f, samen, reqn_e)
+            adj0 = alloc[0][nj_safe][None, :, :] - cum0
+            post_fit0 = okn_e[:, nj_safe] & _fit_row(adj0, reqn_e[:, None, :])
+            flip0 = fit0_e[:, nj_safe] & ~post_fit0  # [E(e), E(j)]
+            applies = prior_f * (
+                lvl_sel_e[:, None] <= level_e[None, :]
+            ).astype(jnp.float32)
+            cum_sel = jnp.einsum("ek,jk,kr->ejr", applies, samen, reqn_e)
+            adj_sel = alloc[lvl_sel_e][:, nj_safe] - cum_sel  # [E, E, R]
+            adj_fit = okn_e[:, nj_safe] & _fit_row(adj_sel, reqn_e[:, None, :])
+            adj_score = node_packing_score(adj_sel, p.inv_scale)  # [E, E]
+            # pick e's own adjusted row is the (e, j=e) diagonal: cum_sel
+            # there sums every prior at n_e with lvl_sel_e[e] in range --
+            # exactly what the sequential recompute would see.
+            diag = jnp.arange(E, dtype=jnp.int32)
+            self_fit = adj_fit[diag, diag]
+            self_score = adj_score[diag, diag]
+            beats = adj_fit & (
+                (adj_score < self_score[:, None])
+                | (
+                    (adj_score == self_score[:, None])
+                    & (node_e[None, :] < node_e[:, None])
+                )
+            )
+            self_pair = node_e[:, None] == node_e[None, :]
+            prior_e = iota_e[None, :] < iota_e[:, None]
+            conflict = jnp.where(self_pair, flip0, flip0 | beats)
+            ok_nodes = self_fit & ~jnp.any(conflict & prior_e, axis=1)
+
+            # (8) the certified prefix
+            raw_ok = batch_ok & elig & ok_caps & ok_order & ok_nodes & found_e
+            ok_e = jnp.cumprod(raw_ok.astype(jnp.int32)).astype(bool)
+            okf = ok_e.astype(jnp.float32)
+            n_ext = jnp.sum(ok_e.astype(jnp.int32))
+
+            # (9) ONE batched commit per table: constant-value /
+            # distinct-lane scatters, dummy lanes pushed out of range with
+            # mode='drop' -- never a gathered-old-value write.
+            commit_nodes = jnp.where(ok_e, node_e, N)
+            lv_c = jnp.arange(num_levels, dtype=jnp.int32)
+            lm_c = (lv_c[:, None] <= level_e[None, :]).astype(jnp.float32)
+            # lint: allow(axis1-scatter) -- the multi-commit's own alloc
+            # update ([E] certified lanes into [P1,N,R]), the batched twin
+            # of the head commit above
+            alloc = alloc.at[:, commit_nodes, :].add(
+                -lm_c[:, :, None] * (reqn_e * okf[:, None])[None, :, :],
+                mode="drop",
+            )
+            qe_ok = jnp.where(ok_e, qe, Q)
+            q_alloc = q_alloc.at[qe_ok].add(req_e, mode="drop")
+            q_alloc_pc = q_alloc_pc.at[qe_ok, pc_e].add(req_e, mode="drop")
+            q_sched = q_sched.at[qe_ok].add(1, mode="drop")
+            sched_count = sched_count + n_ext
+            # sequential-association accumulators (they feed ordering
+            # comparisons in later iterations)
+            for i in range(E):
+                sched_res = sched_res + req_e[i] * okf[i]
+                float_used = float_used + flt_e[i] * okf[i]
+                spot_res = spot_res + req_e[i] * okf[i]
+            g_state = g_state.at[jnp.where(ok_e, ge, G)].set(1, mode="drop")
+            sidx = jnp.where(ok_e, cursor + iota_e, S_cap)
+            ext_nodes_w = (
+                jnp.full((E, slot_width), N, jnp.int32).at[:, 0].set(node_e)
+            )
+            ext_counts_w = (
+                jnp.zeros((E, slot_width), jnp.int32).at[:, 0].set(1)
+            )
+            slot_gang = slot_gang.at[sidx].set(ge, mode="drop")
+            slot_nodes = slot_nodes.at[sidx].set(ext_nodes_w, mode="drop")
+            slot_counts = slot_counts.at[sidx].set(ext_counts_w, mode="drop")
+            cursor = cursor + n_ext
+            extra_iters = n_ext
+            touched_nodes = jnp.concatenate([nodes_w, commit_nodes])
 
         # --- cache maintenance --------------------------------------------------
         fitc_clean, fitc_lvl, score_c = c.fitc_clean, c.fitc_lvl, c.score_c
@@ -699,9 +995,11 @@ def _make_place_iteration(
             cslot_key = cslot_key.at[wslot].set(key, mode="drop")
             cslot_lvl = cslot_lvl.at[wslot].set(level, mode="drop")
             cslot_req = cslot_req.at[wslot].set(req_node, mode="drop")
-            # 2. exact re-derivation at the <=slot_width nodes the commit
-            # touched (unplaced iterations recompute unchanged values: no-op).
-            tn = nodes_w  # [W], N = unused sentinel (pushed out of range below)
+            # 2. exact re-derivation at every node this iteration's commits
+            # touched -- the head's <=slot_width lanes plus the multi-commit
+            # extension's certified lanes (unplaced iterations recompute
+            # unchanged values: no-op).
+            tn = touched_nodes  # [W(+E)], N = unused sentinel (dropped below)
             tn_safe = jnp.clip(tn, 0, N - 1)
             a_rows = alloc[:, tn_safe, :]  # [P1, W, R]
             sc_rows = jnp.sum(a_rows * p.inv_scale[None, None, :], axis=-1)  # [P1, W]
@@ -741,7 +1039,6 @@ def _make_place_iteration(
             bmc_clean = bmc_clean.at[bpidx].set(bm0_t, mode="drop")
             bmc_lvl = bmc_lvl.at[bpidx].set(bml_t, mode="drop")
 
-        extra_iters = jnp.int32(0)
         if batch_k > 1:
             # --- certified pick-chain extension (see docstring) --------------
             # After the head commit, SIMULATE the sequential loop's next
@@ -784,15 +1081,8 @@ def _make_place_iteration(
                 ~((~wev) & (c.new_blocked | c.q_killed[:, None]))
                 & (p.q_weight > 0)[:, None]
             )
-            parked = in_r & ~skippable
-            # next parked (cursor) window index at-or-after i (W = none)
-            nn = jnp.full((Q, W + 1), W, jnp.int32)
-            for i in range(W - 1, -1, -1):
-                nn = nn.at[:, i].set(
-                    jnp.where(parked[:, i], i, nn[:, i + 1])
-                )
-            # window reaches past the queue tail: nothing hides beyond it
-            tail_known = ~in_r[:, W - 1]
+            # parked/nn/tail_known come from the shared tables above the
+            # commit_k block (one definition for both batching shapes)
 
             # simulation state
             sim_row = q_alloc  # post-head [Q, R]; value-identical to what
@@ -1146,6 +1436,7 @@ def _make_place_iteration(
             float_used=float_used,
             new_blocked=new_blocked,
             iterations=c.iterations + active.astype(jnp.int32) + extra_iters,
+            kernel_iters=c.kernel_iters + active.astype(jnp.int32),
             done=done,
             termination=termination,
             spot_price=spot_price,
@@ -1243,6 +1534,7 @@ def schedule_round(
     cache_slots: int = -1,
     unroll: int = -1,
     batch_k: int = -1,
+    commit_k: int = -1,
 ) -> RoundResult:
     """Run one full scheduling round on device.
 
@@ -1257,6 +1549,11 @@ def schedule_round(
     unroll; tail steps past done self-disable via the body's active gate),
     but grouping them lets XLA fuse/overlap the many small per-iteration ops
     whose fixed latencies dominate the accelerator round.
+    commit_k (-1 = env ARMADA_COMMIT_K, default 1) arms the conflict-free
+    multi-commit extension: up to commit_k certified-independent placements
+    commit per while-loop iteration, shrinking the trip count itself (see
+    _make_place_iteration).  Decisions are bit-identical at any K; commit_k=1
+    compiles the single-commit body -- the A/B and escape hatch.
     """
     G = p.g_req.shape[0]
     N, R = p.node_total.shape
@@ -1307,6 +1604,18 @@ def schedule_round(
         batch_k = int(env) if env is not None else 1
     if cache_slots > 0 or prefer_large:
         batch_k = 1
+    if commit_k < 0:
+        commit_k = resolve_commit_k()
+    # prefer_large re-ranks every queue per placement (within-budget uses
+    # CURRENT cost), which the distinct-queue certification does not model;
+    # a single queue cannot batch.  The multi-commit extension and the
+    # batch_k replay are mutually exclusive shapes of the same iteration --
+    # commit_k (the supported one) wins.
+    commit_k = max(1, min(commit_k, Q))
+    if prefer_large:
+        commit_k = 1
+    if commit_k > 1:
+        batch_k = 1
     if max_iterations <= 0:
         # every iteration either decides a gang (<= G), advances a cursor
         # (<= G total across the round), or is the final no-op
@@ -1321,14 +1630,30 @@ def schedule_round(
         cache_slots=cache_slots,
         unroll=unroll,
         batch_k=batch_k,
+        commit_k=commit_k,
     )
+
+
+def resolve_commit_k() -> int:
+    """The env-resolved multi-commit width (ARMADA_COMMIT_K, default 1 --
+    the single-commit body), floored at 1 so reporters never echo a
+    nonsensical 0/negative arm.  Resolved OUTSIDE every jit boundary (the
+    schedule_round discipline: compiles key on the resolved value), and
+    exported so mesh/serve/bench report the ARMED K without re-parsing.
+    schedule_round additionally clamps the effective K to the problem's
+    queue-axis width (and market/prefer-large rounds force 1)."""
+    env = _os.environ.get("ARMADA_COMMIT_K")
+    try:
+        return max(1, int(env)) if env else 1
+    except ValueError:
+        return 1
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "num_levels", "max_slots", "slot_width", "max_iterations", "prefer_large",
-        "cache_slots", "unroll", "batch_k",
+        "cache_slots", "unroll", "batch_k", "commit_k",
     ),
 )
 def _schedule_round_jit(
@@ -1342,6 +1667,7 @@ def _schedule_round_jit(
     cache_slots: int,
     unroll: int,
     batch_k: int,
+    commit_k: int,
 ) -> RoundResult:
     """The fully-resolved compile: schedule_round (the public wrapper)
     resolves platform/env-derived statics OUTSIDE the jit boundary, so the
@@ -1413,6 +1739,7 @@ def _schedule_round_jit(
         float_used=float_used0,
         new_blocked=jnp.bool_(False),
         iterations=jnp.int32(0),
+        kernel_iters=jnp.int32(0),
         done=jnp.bool_(False),
         termination=jnp.int32(TERM_EXHAUSTED),
         spot_price=jnp.float32(-1.0),
@@ -1445,7 +1772,7 @@ def _schedule_round_jit(
     body = _make_place_iteration(
         p, num_levels, slot_width, check_keys=True,
         prefer_large=prefer_large, q_budget=q_budget, cache_slots=cache_slots,
-        max_iterations=max_iterations, batch_k=batch_k,
+        max_iterations=max_iterations, batch_k=batch_k, commit_k=commit_k,
     )
     if unroll > 1:
         inner = body
@@ -1506,4 +1833,5 @@ def _schedule_round_jit(
         scheduled_count=carry.sched_count,
         spot_price=carry.spot_price,
         q_killed=carry.q_killed,
+        kernel_iters=carry.kernel_iters,
     )

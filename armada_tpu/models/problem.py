@@ -195,6 +195,11 @@ class RoundOutcome:
     failed: list  # job ids attempted and unschedulable this round
     num_iterations: int
     termination: str
+    # Physical while-loop trips (RoundResult.kernel_iters): num_iterations /
+    # kernel_iters = average certified commits per iteration under the
+    # multi-commit kernel (ARMADA_COMMIT_K); equal when K=1.  0 = unknown
+    # (synthetic outcomes).
+    kernel_iters: int = 0
     # queue name -> {weight, fair_share, adjusted_fair_share, actual_share,
     # demand_share} (feeds cycle metrics + reports; the reference's
     # QueueSchedulingContext numbers, cycle_metrics.go:71-170).
@@ -1346,9 +1351,10 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
     from armada_tpu.models.xfer import TRANSFER_STATS
 
     TRANSFER_STATS.count_down(buf.nbytes)
-    n_slots, iterations, termination, _sched_count, spot_bits, n_failed, n_pre, n_res = (
-        int(v) for v in buf[:_COMPACT_HEADER]
-    )
+    (
+        n_slots, iterations, termination, _sched_count, spot_bits, n_failed,
+        n_pre, n_res, kernel_iters,
+    ) = (int(v) for v in buf[:_COMPACT_HEADER])
     if n_failed > fcap or n_pre > ecap or n_res > ecap:
         return None
     spot = float(np.int32(spot_bits).view(np.float32))
@@ -1376,7 +1382,7 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
 
     return (
         n_slots, slot_gang, slot_nodes, slot_counts, g2, pre_idx, res_idx,
-        state_of, iterations, termination, spot,
+        state_of, iterations, termination, spot, kernel_iters,
     )
 
 
@@ -1412,7 +1418,7 @@ def decode_result(result, ctx: HostContext, _dispatched=None) -> RoundOutcome:
     if compact is not None:
         (
             n_slots, slot_gang, slot_nodes, slot_counts, g2, pre_idx, res_idx,
-            state_of, iterations, termination, spot,
+            state_of, iterations, termination, spot, kernel_iters,
         ) = compact
     else:
         g_state = np.asarray(result.g_state)
@@ -1433,6 +1439,7 @@ def decode_result(result, ctx: HostContext, _dispatched=None) -> RoundOutcome:
         g2 = np.flatnonzero(np.asarray(g_state[: ctx.num_real_gangs]) == 2)
         state_of = lambda gi: int(g_state[gi])  # noqa: E731
         iterations = int(result.iterations)
+        kernel_iters = int(result.kernel_iters)
         termination = int(result.termination)
         spot = float(result.spot_price)
 
@@ -1507,6 +1514,7 @@ def decode_result(result, ctx: HostContext, _dispatched=None) -> RoundOutcome:
         rescheduled=rescheduled,
         failed=failed,
         num_iterations=iterations,
+        kernel_iters=kernel_iters,
         termination=_TERMINATIONS[termination],
         spot_price=spot if spot >= 0 else None,
         unwound_groups=frozenset(unwound),

@@ -240,9 +240,15 @@ def shard_problem(
 # measured gather hazard is the SCATTER program, pinned in mesh_slab.py.
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "num_levels", "max_slots", "slot_width", "max_iterations"),
+    static_argnames=(
+        "mesh", "num_levels", "max_slots", "slot_width", "max_iterations",
+        "commit_k",
+    ),
 )
-def _sharded_round(problem, *, mesh, num_levels, max_slots, slot_width, max_iterations):
+def _sharded_round(
+    problem, *, mesh, num_levels, max_slots, slot_width, max_iterations,
+    commit_k,
+):
     # Inputs arrive pre-sharded (shard_problem); jit propagates their shardings
     # through the while-loop and GSPMD inserts the collectives.  Outputs are
     # pulled back replicated: everything the host decodes is small ([S,W] slots,
@@ -254,6 +260,7 @@ def _sharded_round(problem, *, mesh, num_levels, max_slots, slot_width, max_iter
         max_slots=max_slots,
         slot_width=slot_width,
         max_iterations=max_iterations,
+        commit_k=commit_k,
     )
 
 
@@ -265,6 +272,7 @@ def sharded_schedule_round(
     max_slots: int,
     slot_width: int,
     max_iterations: int = 0,
+    commit_k: int = -1,
 ):
     """Run one scheduling round SPMD over the mesh.
 
@@ -272,6 +280,13 @@ def sharded_schedule_round(
     numerically identical (the kernel is deterministic and sharding only
     distributes the reductions).
     """
+    from armada_tpu.models.fair_scheduler import resolve_commit_k
+
+    if commit_k < 0:
+        # Resolved OUTSIDE the jit boundary like every schedule_round
+        # static: _sharded_round's compile cache must key on the value an
+        # env override resolves TO, never silently reuse a stale trace.
+        commit_k = resolve_commit_k()
     problem = shard_problem(problem, mesh)
     with mesh:
         return _sharded_round(
@@ -281,4 +296,5 @@ def sharded_schedule_round(
             max_slots=max_slots,
             slot_width=slot_width,
             max_iterations=max_iterations,
+            commit_k=commit_k,
         )

@@ -371,6 +371,10 @@ def _stats_of(result: SchedulerResult, trace: Optional[dict] = None) -> str:
             "scheduled": len(s.outcome.scheduled),
             "preempted": len(s.outcome.preempted),
             "termination": s.outcome.termination,
+            "iterations": s.outcome.num_iterations,
+            # physical while-loop trips under the multi-commit kernel
+            # (ARMADA_COMMIT_K); == iterations at K=1
+            "kernel_iters": getattr(s.outcome, "kernel_iters", 0),
             "queue_stats": s.outcome.queue_stats,
         }
         if s.market:

@@ -17,6 +17,9 @@ ARMADA_BENCH_QUEUES, ARMADA_BENCH_REPEATS, ARMADA_BENCH_RUNS,
 ARMADA_BENCH_BURST (per-cycle placement cap + arrival count -- the
 mass-placement datapoint, docs/bench.md); ARMADA_BENCH_EXPLAIN=0 skips
 the explain-pass measurement (explain_s + explain_counts keys).
+ARMADA_COMMIT_K arms the multi-commit kernel for every arm; the JSON
+echoes it (commit_k) next to the trip counters (kernel_iters /
+round_iters / burst10k_iters -- docs/bench.md r15).
 
 The JSON carries host-load context (loadavg / cpu_count): the round-3
 driver number was captured against a rogue CPU-pinned pytest (VERDICT r3
@@ -431,6 +434,13 @@ def _e2e_bench(
                 "assemble_s": round(t_asm - t_start, 4),
                 "upload_kernel_s": round(t_kernel - t_asm, 4),
                 "decode_apply_s": round(t_end - t_kernel, 4),
+                # Iteration-count legibility (ARMADA_COMMIT_K): physical
+                # while-loop trips vs logical sequential steps -- the
+                # multi-commit win (and its certification truncation rate,
+                # round_iters/kernel_iters) measurable on the CPU fallback
+                # without a TPU.  Rides the compact decode buffer: free.
+                "kernel_iters": outcome.kernel_iters,
+                "round_iters": outcome.num_iterations,
                 # Per-cycle device-transfer counters (models/xfer.py): the
                 # tunnel's fixed per-transfer latency makes COUNT the e2e
                 # lever, so payload regressions stay legible without a TPU.
@@ -909,7 +919,7 @@ def main():
     if b10k_env not in ("", "0") and burst == 1_000:
         b10k = int(os.environ.get("ARMADA_BENCH_BURST10K_N", 10_000))
         print(f"bench: burst-{b10k} placement-throughput arm", file=sys.stderr)
-        burst10k_s, _, b10k_sched = _e2e_bench(
+        burst10k_s, b10k_parts, b10k_sched = _e2e_bench(
             num_jobs,
             num_nodes,
             num_queues,
@@ -944,10 +954,21 @@ def main():
         "pipeline": int(_pipeline_enabled()),
         **parts,
     }
+    # The armed multi-commit width (models/fair_scheduler.py): K=1 is the
+    # single-commit body; the iteration keys above only move when K > 1.
+    from armada_tpu.models.fair_scheduler import resolve_commit_k
+
+    line["commit_k"] = resolve_commit_k()
     if burst != 1_000:
         line["burst"] = burst
     if burst10k_s is not None:
         line["burst10k_cycle_s"] = round(burst10k_s, 4)
+        # The burst arm is where the trip count dominates (10k placements):
+        # burst10k_iters is the headline evidence for the multi-commit
+        # kernel, legible on the CPU fallback.
+        if b10k_parts and b10k_parts.get("kernel_iters"):
+            line["burst10k_iters"] = b10k_parts["kernel_iters"]
+            line["burst10k_round_iters"] = b10k_parts["round_iters"]
     # Device-loss degradation state (core/watchdog): all-healthy runs show
     # backend=device with zero fallbacks; a mid-bench device loss is
     # legible right in the record instead of only in stderr.

@@ -1,0 +1,19 @@
+# Fixture for rule `commit-scatter-gathered-old` (linted under
+# armada_tpu/models/).  The twin line is syntactically IDENTICAL to the
+# true positive after normalization; its where-fallback gathers a
+# loop-INVARIANT row table (the sanctioned pass-rows idiom), not the
+# scattered carry buffer itself -- only dataflow provenance (and base
+# identity) separates them.
+import jax
+import jax.numpy as jnp
+
+
+def run(cand_tab, rows, carry0):
+    def body(c):
+        i, state, other, done = c
+        idx = cand_tab[i]
+        state = state.at[idx].set(jnp.where(done, 1, state[idx]))  # TP
+        other = other.at[idx].set(jnp.where(done, 1, rows[idx]))  # twin
+        return (i + 1, state, other, done | (idx < 0))
+
+    return jax.lax.while_loop(lambda c: ~c[3], body, carry0)

@@ -95,3 +95,14 @@ def _queue_of(sim, jid):
 def _jobset_of(sim, jid):
     tmpl = sim.templates[sim.job_template[jid]].template
     return tmpl.job_set
+
+
+def test_golden_traces_with_commit_k_armed(monkeypatch):
+    """One full golden pass with ARMADA_COMMIT_K=8 armed (round 15).  The
+    golden config runs prefer-large ordering, which schedule_round forces
+    back to the single-commit body -- so this pins two things: arming the
+    knob can never corrupt a prefer-large round (the force works), and the
+    reference's own published traces survive a plane-wide K=8 arm."""
+    monkeypatch.setenv("ARMADA_COMMIT_K", "8")
+    for path in GOLDEN:
+        test_golden_trace(path)

@@ -65,6 +65,10 @@ RULE_FIXTURES = {
         "inloop_scatter_gathered_key.py",
         "armada_tpu/models/fixture.py",
     ),
+    "commit-scatter-gathered-old": (
+        "commit_scatter_gathered_old.py",
+        "armada_tpu/models/fixture.py",
+    ),
     "unpinned-out-shardings": (
         "unpinned_out_shardings.py",
         "armada_tpu/parallel/fixture.py",
@@ -72,12 +76,13 @@ RULE_FIXTURES = {
     "unmade-lock": ("unmade_lock.py", "armada_tpu/ingest/fixture.py"),
 }
 
-# The four value-flow rules whose fixtures carry a `# twin` line: a
+# The value-flow rules whose fixtures carry a `# twin` line: a
 # statement with the SAME normalized AST as the TP that must stay clean.
 TWIN_RULES = [
     "gathered-row-compute",
     "branch-return-array",
     "inloop-scatter-gathered-key",
+    "commit-scatter-gathered-old",
     "unpinned-out-shardings",
 ]
 

@@ -272,6 +272,17 @@ def test_mesh_steady_cycle_bit_equal_over_churn(seed):
     assert total > 10  # the churn actually scheduled work
 
 
+def test_mesh_churn_bit_equal_with_commit_k_armed(monkeypatch):
+    """The mesh equality suite with the multi-commit kernel armed
+    (round 15, ARMADA_COMMIT_K=8): sharded vs single-device cycles stay
+    bit-equal cycle-by-cycle when both arms compile the K=8 body -- the
+    [E,N] certification tables ride the node-axis sharding like the fit
+    masks do."""
+    monkeypatch.setenv("ARMADA_COMMIT_K", "8")
+    total = run_churn_ab(0)
+    assert total > 10
+
+
 # --- 2. the degrade ladder ---------------------------------------------------
 
 

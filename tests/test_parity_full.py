@@ -820,3 +820,40 @@ def _mkjob(jid, q, cpu, sub):
         id=jid, queue=q, priority_class="low", submit_time=sub,
         resources=F.from_mapping({"cpu": str(cpu), "memory": "1"}),
     )
+
+
+# --- multi-commit kernel (ARMADA_COMMIT_K, round 15) -------------------------
+
+
+@pytest.mark.parametrize("commit_k", [1, 4, 8])
+@pytest.mark.parametrize("seed", [6, 14, 27])
+def test_multi_commit_conflict_heavy_parity(seed, commit_k, monkeypatch):
+    """The armed multi-commit kernel against the independent oracle on
+    conflict-heavy worlds: few nodes (every pick contends for the same
+    best-fit targets, exercising the same-node stacking certification),
+    gangs interleaved with singletons (gang heads truncate the batch),
+    at K in {1, 4, 8}.  _compare asserts scheduled-set, preempted-set and
+    per-queue-count equality; each K matching the oracle pins cross-K
+    equality transitively."""
+    monkeypatch.setenv("ARMADA_COMMIT_K", str(commit_k))
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=30, num_jobs=250, num_running=0, gangs=4
+    )
+    _compare(CFG, nodes, queues, jobs, running, seed=seed)
+
+
+@pytest.mark.parametrize("commit_k", [4, 8])
+@pytest.mark.parametrize("seed", [5, 17])
+def test_multi_commit_eviction_preempted_set_parity(seed, commit_k, monkeypatch):
+    """Eviction rounds with the multi-commit kernel armed: evictee slots
+    bypass certification (they truncate the batch), and the preempted /
+    rescheduled sets must still match the oracle exactly."""
+    import dataclasses
+
+    monkeypatch.setenv("ARMADA_COMMIT_K", str(commit_k))
+    cfg = dataclasses.replace(CFG, protected_fraction_of_fair_share=0.0)
+    nodes, queues, jobs, running = world(
+        seed, num_nodes=120, num_jobs=150, num_running=60, gangs=0
+    )
+    outcome = _compare(cfg, nodes, queues, jobs, running, seed=seed)
+    assert outcome.rescheduled or outcome.preempted

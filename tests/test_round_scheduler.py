@@ -358,6 +358,8 @@ def test_certified_pick_chain_is_bit_exact():
         for bk in (4, 8):
             got = sr(dev, **kw, batch_k=bk)
             for name in base._fields:
+                if name == "kernel_iters":
+                    continue  # the observability counter batching SHRINKS
                 np.testing.assert_array_equal(
                     np.asarray(getattr(base, name)),
                     np.asarray(getattr(got, name)),
@@ -423,6 +425,8 @@ def test_pick_chain_bit_exact_with_evictions_and_market():
         )
         a, b = sr(dev, **kw, batch_k=1), sr(dev, **kw, batch_k=8)
         for name in a._fields:
+            if name == "kernel_iters":
+                continue  # the observability counter batching SHRINKS
             np.testing.assert_array_equal(
                 np.asarray(getattr(a, name)),
                 np.asarray(getattr(b, name)),
@@ -464,3 +468,215 @@ def test_pick_chain_bit_exact_with_evictions_and_market():
     r = both(market_cfg, nodes, queues, jobs, running,
              bid=lambda j: prices[j.queue])
     assert float(r.spot_price) >= 0  # the crossing actually replayed
+
+
+# --- conflict-free multi-commit kernel (ARMADA_COMMIT_K, round 15) ----------
+
+
+def _assert_rounds_bit_equal(a, b, label):
+    for name in a._fields:
+        if name == "kernel_iters":
+            continue  # the observability counter multi-commit SHRINKS
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)),
+            np.asarray(getattr(b, name)),
+            err_msg=f"{label}: diverged on {name}",
+        )
+
+
+def test_multi_commit_bit_exact_both_cache_modes():
+    """The conflict-free multi-commit extension must be bit-identical to the
+    single-commit body at every K, under BOTH compile shapes (the uncached
+    TPU body and the per-key-fit-cache CPU body -- the maintenance pass must
+    re-derive every committed node, not just the head's)."""
+    import jax.numpy as jnp
+
+    from armada_tpu.models.fair_scheduler import schedule_round as sr
+    from armada_tpu.models.problem import SchedulingProblem
+    from armada_tpu.models.synthetic import synthetic_problem
+
+    problem, meta = synthetic_problem(
+        num_nodes=400, num_gangs=4000, num_queues=16, num_runs=300,
+        global_burst=250, perq_burst=60, seed=0, max_gang_cardinality=3,
+    )
+    dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    kw = dict(
+        num_levels=meta["num_levels"], max_slots=meta["max_slots"],
+        slot_width=meta["slot_width"],
+    )
+    for cs in (0, 16):
+        base = sr(dev, **kw, cache_slots=cs, commit_k=1)
+        for ck in (2, 4, 8):
+            got = sr(dev, **kw, cache_slots=cs, commit_k=ck)
+            _assert_rounds_bit_equal(base, got, f"cache_slots={cs} K={ck}")
+
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+def test_multi_commit_adversarial_conflict_seeds(seed):
+    """Conflict-heavy shapes aimed at every certification clause:
+    many jobs contending for ONE node (same-node stacking + fill
+    truncation), one queue dominating the top-K (distinct-queue
+    truncation -- the DRF monopoly), gangs interleaved with singletons,
+    and an eviction pass (evictees bypass multi-commit).  Scheduled-set
+    and preempted-set equality ride full RoundResult equality at
+    K in {1, 4, 8}."""
+    import jax.numpy as jnp
+
+    from armada_tpu.models import build_problem
+    from armada_tpu.models.fair_scheduler import schedule_round as sr
+    from armada_tpu.models.problem import SchedulingProblem
+
+    rng = np.random.default_rng(seed)
+    cfg = make_config()
+    # ONE big node + a handful of tiny ones: best-fit funnels every pick
+    # onto the big node until it fills.
+    nodes = [node(cfg, "big", cpu="64", memory="256Gi")] + [
+        node(cfg, f"n{i}", cpu="2", memory="8Gi") for i in range(6)
+    ]
+    queues = [Queue(f"q{i}", 1.0) for i in range(4)]
+    jobs = []
+    for i in range(90):
+        # queue 0 dominates: weight-equal but 3x the jobs, so the argmin
+        # repeatedly returns to it (the monopoly the distinct-queue
+        # certification must truncate on, exactly)
+        qn = "q0" if i % 2 == 0 else f"q{int(rng.integers(1, 4))}"
+        jobs.append(
+            job(cfg, f"j{i:03d}", qn, cpu=str(int(rng.choice([1, 2]))),
+                submit_time=float(i))
+        )
+    for g in range(6):
+        for m in range(3):
+            jobs.append(
+                JobSpec(
+                    f"g{g}m{m}", f"q{g % 4}", priority_class="p1",
+                    submit_time=100.0 + g,
+                    resources=rl(cfg, cpu="2", memory="128Mi"),
+                    gang_id=f"gang{g}", gang_cardinality=3,
+                )
+            )
+    running = [
+        RunningJob(
+            job=job(cfg, f"r{i:02d}", f"q{int(rng.integers(4))}", cpu="2",
+                    pc="p0"),
+            node_id="big" if i % 3 == 0 else f"n{int(rng.integers(6))}",
+        )
+        for i in range(12)
+    ]
+    for evict in (False, True):
+        c = (
+            dataclasses.replace(cfg, protected_fraction_of_fair_share=0.0)
+            if evict
+            else cfg
+        )
+        problem, ctx = build_problem(
+            c, pool="default", nodes=nodes, queues=queues,
+            queued_jobs=jobs, running=running,
+        )
+        dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+        kw = dict(
+            num_levels=len(ctx.ladder) + 2, max_slots=ctx.max_slots,
+            slot_width=ctx.slot_width,
+        )
+        base = sr(dev, **kw, commit_k=1)
+        for ck in (4, 8):
+            got = sr(dev, **kw, commit_k=ck)
+            _assert_rounds_bit_equal(
+                base, got, f"seed={seed} evict={evict} K={ck}"
+            )
+        if evict:
+            assert bool(np.asarray(base.run_evicted).any())
+
+
+def test_multi_commit_market_rounds_bypass():
+    """Market rounds (bid ordering + spot crossing) bypass the extension:
+    decisions stay bit-identical AND the trip count does not move."""
+    import jax.numpy as jnp
+
+    from armada_tpu.core.config import PoolConfig
+    from armada_tpu.models import build_problem
+    from armada_tpu.models.fair_scheduler import schedule_round as sr
+    from armada_tpu.models.problem import SchedulingProblem
+
+    cfg = dataclasses.replace(
+        make_config(),
+        pools=(PoolConfig("default", market_driven=True, spot_price_cutoff=0.1),),
+    )
+    nodes = [node(cfg, f"n{i}", cpu="8", memory="32Gi") for i in range(8)]
+    queues = [Queue(f"q{i}", 1.0) for i in range(4)]
+    prices = {f"q{i}": float(1 + i) for i in range(4)}
+    jobs = [
+        job(cfg, f"j{i:03d}", f"q{i % 4}", cpu="1", submit_time=float(i))
+        for i in range(60)
+    ]
+    problem, ctx = build_problem(
+        cfg, pool="default", nodes=nodes, queues=queues, queued_jobs=jobs,
+        bid_price_of=lambda j: prices[j.queue],
+    )
+    dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    kw = dict(
+        num_levels=len(ctx.ladder) + 2, max_slots=ctx.max_slots,
+        slot_width=ctx.slot_width,
+    )
+    base = sr(dev, **kw, commit_k=1)
+    got = sr(dev, **kw, commit_k=8)
+    _assert_rounds_bit_equal(base, got, "market K=8")
+    assert int(got.kernel_iters) == int(base.kernel_iters)
+    assert float(base.spot_price) >= 0  # the crossing actually happened
+
+
+def test_multi_commit_shrinks_burst_iterations():
+    """The acceptance number: a burst of contending singles across queues
+    must cut the physical trip count >= 2x at K=8 (iterations stays the
+    logical, bit-identical count)."""
+    import jax.numpy as jnp
+
+    from armada_tpu.models.fair_scheduler import schedule_round as sr
+    from armada_tpu.models.problem import SchedulingProblem
+    from armada_tpu.models.synthetic import synthetic_problem
+
+    problem, meta = synthetic_problem(
+        num_nodes=400, num_gangs=8000, num_queues=32, num_runs=0,
+        global_burst=2000, perq_burst=2000, seed=3, max_gang_cardinality=1,
+    )
+    dev = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    kw = dict(
+        num_levels=meta["num_levels"], max_slots=meta["max_slots"],
+        slot_width=meta["slot_width"],
+    )
+    base = sr(dev, **kw, commit_k=1)
+    got = sr(dev, **kw, commit_k=8)
+    _assert_rounds_bit_equal(base, got, "burst K=8")
+    k1, k8 = int(base.kernel_iters), int(got.kernel_iters)
+    assert int(base.iterations) == int(got.iterations) == k1
+    assert 2 * k8 <= k1, f"trip count {k1} -> {k8}: less than the 2x floor"
+
+
+def test_commit_k_env_resolution_and_outcome_counters():
+    """ARMADA_COMMIT_K resolves outside the jit boundary per call, and the
+    decoded RoundOutcome carries kernel_iters (the compact buffer's ninth
+    header slot) so bench/reports/spans read it without a transfer."""
+    import os
+
+    cfg = make_config()
+    nodes = [node(cfg, f"n{i}", cpu="8", memory="32Gi") for i in range(4)]
+    queues = [Queue(f"q{i}", 1.0) for i in range(4)]
+    jobs = [
+        job(cfg, f"j{i:02d}", f"q{i % 4}", cpu="1", submit_time=float(i))
+        for i in range(40)
+    ]
+    prev = os.environ.get("ARMADA_COMMIT_K")
+    try:
+        os.environ["ARMADA_COMMIT_K"] = "8"
+        armed = run_round(cfg, nodes, queues, jobs)
+        os.environ["ARMADA_COMMIT_K"] = "1"
+        plain = run_round(cfg, nodes, queues, jobs)
+    finally:
+        if prev is None:
+            os.environ.pop("ARMADA_COMMIT_K", None)
+        else:
+            os.environ["ARMADA_COMMIT_K"] = prev
+    assert armed.scheduled == plain.scheduled
+    assert sorted(armed.failed) == sorted(plain.failed)
+    assert armed.num_iterations == plain.num_iterations
+    assert 0 < armed.kernel_iters < plain.kernel_iters
+    assert plain.kernel_iters == plain.num_iterations
