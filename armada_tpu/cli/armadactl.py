@@ -536,6 +536,29 @@ def cmd_checkpoint(args):
     return 0
 
 
+def cmd_quarantine(args):
+    """Round-verification verdict + device quarantine scoreboard
+    (models/verify.py + scheduler/quarantine.py), or --clear to re-admit
+    a device an operator has serviced/replaced -- the ONE way out of a
+    verification quarantine."""
+    import json
+
+    client = _client(args)
+    if args.clear:
+        out = client.quarantine_clear(args.device)
+        cleared = out.get("cleared", [])
+        if cleared:
+            print(
+                "cleared quarantine for: " + ", ".join(cleared)
+                + " (next healthy re-probe may promote)"
+            )
+        else:
+            print("nothing to clear")
+        return 0
+    print(json.dumps(client.quarantine_status(), indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_trace(args):
     """Dump the plane's cycle traces (ops/trace.py ring) as Chrome
     trace-event JSON: `armadactl trace -o cycle.json`, open in Perfetto.
@@ -677,6 +700,9 @@ _SERVE_FALLBACKS = {
     # None -> start_control_plane arms the explain pass every 10th round
     # (models/explain.py); 0 disables.  ARMADA_EXPLAIN_INTERVAL overrides.
     "explain_interval": None,
+    # None -> start_control_plane arms round-output verification
+    # (models/verify.py) ON; --no-verify disarms.  ARMADA_VERIFY overrides.
+    "verify": None,
 }
 
 
@@ -732,6 +758,7 @@ def load_serve_config(args):
         "checkpoint_interval": ("checkpointinterval", float),
         "mesh": ("mesh", int),
         "explain_interval": ("explaininterval", int),
+        "verify": ("verify", bool),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -786,6 +813,7 @@ def cmd_serve(args):
         checkpoint_interval_s=getattr(args, "checkpoint_interval", None),
         mesh_devices=getattr(args, "mesh", None),
         explain_interval=getattr(args, "explain_interval", None),
+        verify_rounds=getattr(args, "verify", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -1043,6 +1071,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="unschedulable-reason attribution cadence in rounds "
         "(models/explain.py; default 10 = every 10th round of each pool, 0 "
         "disables; `armadactl explain <job-id>` reads the codes)",
+    )
+    srv.add_argument(
+        "--no-verify",
+        action="store_const",
+        const=False,
+        dest="verify",
+        default=None,
+        help="disable round-output verification (models/verify.py; serve "
+        "arms it ON by default: conservation invariants + a compact-buffer "
+        "fingerprint certify every device round before its decisions "
+        "commit, one extra ~64B transfer per round; a violation re-runs "
+        "the SAME round down the failover ladder and feeds the device "
+        "quarantine -- see `armadactl quarantine`)",
     )
     srv.add_argument(
         "--lookout-port",
@@ -1325,6 +1366,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="write to a file instead of stdout",
     )
     tr.set_defaults(fn=cmd_trace)
+
+    qr = sub.add_parser(
+        "quarantine",
+        help="show the round-verification verdict + device quarantine "
+        "scoreboard, or --clear [device] to re-admit quarantined "
+        "devices (docs/operations.md silent-corruption runbook)",
+    )
+    qr.add_argument(
+        "device",
+        nargs="?",
+        default="",
+        help="device id to clear (with --clear); empty = all",
+    )
+    qr.add_argument(
+        "--clear",
+        action="store_true",
+        help="clear the quarantine + strike windows so the next healthy "
+        "re-probe may promote back to the accelerator",
+    )
+    qr.set_defaults(fn=cmd_quarantine)
 
     return p
 

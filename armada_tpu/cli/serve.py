@@ -74,6 +74,9 @@ class ControlPlaneProcess:
     # the plane keep the library default (0 = off), and overlapping plane
     # lifetimes never corrupt each other's cadence.
     _explain_token: Optional[int] = None
+    # This plane's round-verification arming token (models/verify.py
+    # arm_default); disarmed on stop() like the explain token above.
+    _verify_token: Optional[int] = None
     _stopped: bool = False
 
     def stop(self, grace_s: float = 1.0) -> None:
@@ -95,6 +98,10 @@ class ControlPlaneProcess:
             from armada_tpu.models import explain as _explain
 
             _explain.disarm_default(self._explain_token)
+        if self._verify_token is not None:
+            from armada_tpu.models import verify as _verify
+
+            _verify.disarm_default(self._verify_token)
         if self.replicator is not None:
             self.replicator.stop()
         for p in self._pipelines:
@@ -158,6 +165,7 @@ def start_control_plane(
     checkpoint_interval_s: Optional[float] = None,
     mesh_devices: Optional[int] = None,
     explain_interval: Optional[int] = None,
+    verify_rounds: Optional[bool] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -531,6 +539,11 @@ def start_control_plane(
         # Last explain-pass attribution per pool (models/explain.py via the
         # reports repository): reason counts + fragmentation forensics.
         health_server.explain_status = reports.explain_summary
+        # Round-verification block (models/verify.py): last verdict,
+        # per-site failure census, device quarantine scoreboard.
+        from armada_tpu.models.verify import healthz_block as _verify_block
+
+        health_server.verify_status = _verify_block
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(
@@ -668,6 +681,18 @@ def start_control_plane(
     _explain_token = _explain.arm_default(
         10 if explain_interval is None else explain_interval
     )
+    # Round-output verification (models/verify.py): serve arms it ON by
+    # default -- the serving plane is exactly where a silently-corrupted
+    # round becomes a durable fact (event-sourcing makes decisions
+    # irreversible once published).  ARMADA_VERIFY (the drill/test
+    # override) wins over this default inside verify_enabled();
+    # --no-verify disarms for planes that cannot afford the extra
+    # transfer.  Token-armed LAST like the explain default above.
+    from armada_tpu.models import verify as _verify
+
+    _verify_token = _verify.arm_default(
+        True if verify_rounds is None else bool(verify_rounds)
+    )
 
     return ControlPlaneProcess(
         port=bound_port,
@@ -693,6 +718,7 @@ def start_control_plane(
         restore_info=restore_info,
         _watchdog_token=_watchdog_token,
         _explain_token=_explain_token,
+        _verify_token=_verify_token,
     )
 
 

@@ -40,6 +40,16 @@ Sites wired in this repo (docs/operations.md has the operator catalogue):
                      election and before the recovery fence completes
                      (scheduler/scheduler.py) -- promotion must re-run
                      idempotently on the next cycle
+    round_corrupt    SILENT device corruption of a scheduling round, with
+                     the corruption class as the mode: ``header`` perturbs
+                     the compact header's scheduled_count scalar on
+                     device, ``lane`` overwrites a placement lane with an
+                     out-of-range node (models/verify.maybe_corrupt_result),
+                     ``bytes`` flips a bit in the FETCHED compact buffer
+                     (models/problem._fetch_compact -- transfer
+                     corruption).  Only observable when round verification
+                     is armed (ARMADA_VERIFY): the whole point of the
+                     drill is that an unverified plane would commit it.
 
 Checks are env-driven per call (monkeypatch-friendly) and cost one dict
 lookup when ``ARMADA_FAULT`` is unset.
@@ -87,14 +97,23 @@ def _parse(spec: str):
         yield site, mode, after_n
 
 
-def active(site: str):
+def active(site: str, modes=None):
     """The mode to fire for `site` on THIS check, or None.  Advances the
-    per-entry check counter; one-shot (an entry never fires twice)."""
+    per-entry check counter; one-shot (an entry never fires twice).
+
+    `modes` restricts which entry modes THIS check point consumes: sites
+    whose modes live at different code points (round_corrupt's `header`/
+    `lane` fire device-side in models/__init__, `bytes` fires at the
+    fetched-transfer boundary in models/problem.py) must not advance or
+    burn each other's entries -- a filtered-out entry is left untouched
+    for its own check point."""
     spec = os.environ.get("ARMADA_FAULT")
     if not spec:
         return None
     for s, mode, after_n in _parse(spec):
         if s != site:
+            continue
+        if modes is not None and mode not in modes:
             continue
         key = (s, mode, after_n)
         with _lock:

@@ -201,6 +201,17 @@ class _Handler(BaseHTTPRequestHandler):
                     body["explain"] = srv.explain_status()
                 except Exception as exc:  # noqa: BLE001
                     body["explain"] = {"error": str(exc)}
+            if srv.verify_status is not None:
+                # Round-verification block (models/verify.py +
+                # scheduler/quarantine.py): last verdict, per-site failure
+                # census, the device quarantine scoreboard.  A plane with
+                # quarantined devices is degraded-but-HEALTHY like the CPU
+                # failover below it -- the operator reads this block and
+                # clears via `armadactl quarantine --clear`.
+                try:
+                    body["verify"] = srv.verify_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["verify"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -282,6 +293,10 @@ class HealthServer:
         # Optional () -> dict: last explain-pass attribution per pool
         # (serve wires SchedulingReportsRepository.explain_summary).
         self.explain_status = None
+        # Optional () -> dict: the round-verification block (serve wires
+        # models/verify.healthz_block: last verdict, failure census,
+        # device quarantine scoreboard).
+        self.verify_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

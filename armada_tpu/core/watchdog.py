@@ -142,6 +142,39 @@ def fire_reset_hooks() -> None:
     _fire_reset_hooks()
 
 
+# Promotion gate: an optional () -> Optional[str] veto consulted before ANY
+# re-promotion toward the accelerator (DeviceSupervisor.promote and the mesh
+# restore, parallel/serving.MeshServing.restore).  Registered by the device
+# quarantine (scheduler/quarantine.device_quarantine): a device whose rounds
+# keep failing output verification must not be re-promoted by a healthy
+# matmul probe -- a probe cannot see silent corruption.  Module-level like
+# the reset hooks, so reset_supervisor() cannot silently detach it.
+_promotion_gate: Optional[Callable[[], Optional[str]]] = None
+
+
+def set_promotion_gate(fn: Optional[Callable[[], Optional[str]]]) -> None:
+    global _promotion_gate
+    _promotion_gate = fn
+
+
+def promotion_blocked() -> Optional[str]:
+    """The gate's veto reason, or None (no gate / not blocked).  A raising
+    gate never blocks -- quarantine must not be able to wedge recovery --
+    but the fail-open is LOGGED loudly: silently re-promoting a device the
+    gate was holding down would invert the gate's purpose."""
+    gate = _promotion_gate
+    if gate is None:
+        return None
+    try:
+        return gate()
+    except Exception:
+        _log.error(
+            "promotion gate raised; failing OPEN (promotion allowed)",
+            exc_info=True,
+        )
+        return None
+
+
 def _fire_reset_hooks() -> None:
     with _hooks_lock:
         hooks = list(_reset_hooks)
@@ -270,12 +303,23 @@ class DeviceSupervisor:
         with self._lock:
             self.consecutive_failures = 0
 
-    def promote(self) -> None:
+    def promote(self) -> bool:
         """Re-promote rounds to the device backend; device caches were
-        reset, so the next cycle rides one full slab re-upload."""
+        reset, so the next cycle rides one full slab re-upload.  Returns
+        False (and stays degraded) while the promotion gate vetoes --
+        a quarantined device is only re-admitted by operator clear
+        (scheduler/quarantine.py); the re-probe loop keeps polling so the
+        clear takes effect on the next healthy probe."""
+        blocked = promotion_blocked()
+        if blocked:
+            _log.warning(
+                "device backend probes healthy but promotion is blocked: %s",
+                blocked,
+            )
+            return False
         with self._lock:
             if self.backend == "device":
-                return
+                return True
             self.backend = "device"
             self.consecutive_failures = 0
             self.promotions += 1
@@ -284,6 +328,7 @@ class DeviceSupervisor:
             "one full slab re-upload)"
         )
         _fire_reset_hooks()
+        return True
 
     # ----------------------------------------------------------- reprobe ----
 
@@ -314,9 +359,10 @@ class DeviceSupervisor:
                 _log.info(
                     "device re-probe healthy (%s): %d/%d", detail, healthy, need
                 )
-                if healthy >= need:
-                    self.promote()
+                if healthy >= need and self.promote():
                     break
+                # gate-blocked (quarantine): keep polling at the probe
+                # cadence so an operator clear promotes on the next pass
             else:
                 healthy = 0
                 _log.info("device re-probe still failing: %s", detail)

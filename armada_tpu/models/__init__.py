@@ -147,6 +147,9 @@ def run_round_on_device(
         # watchdog.data_device()); no watchdog thread -- the host cannot
         # hang on itself -- and no device fault check (the device sites
         # model the ACCELERATOR boundary, which is out of the loop here).
+        # A RoundVerificationError here propagates UNTOUCHED: the CPU rung
+        # is the trusted floor, so a wrong answer on it escalates loudly
+        # instead of looping the ladder (models/verify.py).
         import jax
 
         with jax.default_device(jax.devices("cpu")[0]):
@@ -155,42 +158,28 @@ def run_round_on_device(
                 explain_armed,
             )
 
-    deadline = sup.deadline_s()
-    if deadline <= 0:
-        # Watchdog disabled (tests/bench default): the original inline path.
-        faults.check("device_round")
-        return _round_body(
-            build_device_problem(), ctx, config, kernel_kwargs, shadow,
-            explain_armed,
-        )
-
-    def _device_attempt():
-        faults.check("device_round")
-        return _round_body(
-            build_device_problem(), ctx, config, kernel_kwargs, shadow,
-            explain_armed,
-        )
+    from armada_tpu.models.verify import RoundVerificationError
 
     try:
         from jax.errors import JaxRuntimeError as _XlaError
     except ImportError:  # older jax: the jaxlib name
         from jaxlib.xla_extension import XlaRuntimeError as _XlaError
-    if mesh_sv.enabled() and mesh_sv.device_count():
+
+    deadline = sup.deadline_s()
+
+    def _failover(e):
+        """Mesh degrade ladder + CPU rung for a failed device attempt --
+        shared by the watchdog path (hang/XLA error/drill/verification)
+        and the inline path (verification only: nothing hangs there, the
+        round completed with a WRONG answer).  Verification failures
+        additionally feed the per-device quarantine score
+        (scheduler/quarantine.py) -- N strikes stop the re-probe loops
+        from re-promoting the device until operator clear."""
         from armada_tpu.ops.trace import recorder as _trace
 
-        _trace().annotate(mesh_devices=mesh_sv.device_count())
-    try:
-        out = run_with_deadline(_device_attempt, deadline)
-        sup.record_success()
-        return out
-    except (RoundTimeout, _XlaError, faults.FaultInjected) as e:
-        # RoundTimeout = tunnel wedge (thread abandoned); XlaRuntimeError =
-        # the backend died under us; FaultInjected = a drill.  Deliberately
-        # NARROW: a generic RuntimeError out of decode/rollback is a host
-        # code bug -- degrading on it would hide the bug behind a
-        # spuriously-working CPU re-run (and drop every device cache for
-        # nothing), so it propagates untouched.
         reason = f"{type(e).__name__}: {e}"
+        if isinstance(e, RoundVerificationError):
+            _quarantine_strike(mesh_sv, sup, reason)
         try:
             hp = host_problem() if callable(host_problem) else host_problem
         except BaseException:
@@ -204,9 +193,7 @@ def run_round_on_device(
             hp = problem
         if hp is None:
             sup.record_failure(reason)
-            raise  # no host tables to fail over from (legacy caller)
-        from armada_tpu.ops.trace import recorder as _trace
-
+            raise e  # no host tables to fail over from (legacy caller)
         # Mesh degrade ladder (parallel/serving.py) BEFORE the CPU rung:
         # chip loss re-runs the SAME round on a halved mesh from host
         # tables (the reset hooks just replaced every device cache, so the
@@ -224,21 +211,31 @@ def run_round_on_device(
             n = int(smaller.devices.size)
             _trace().annotate(mesh_degraded=True, mesh_devices=n)
             try:
+                fn = lambda m=smaller: _run_round_on_mesh(  # noqa: E731
+                    hp, ctx, config, kernel_kwargs, shadow, m, explain_armed,
+                )
                 with _trace().span(
                     "mesh_degrade_rerun", devices=n, reason=reason[:300]
                 ):
-                    out = run_with_deadline(
-                        lambda m=smaller: _run_round_on_mesh(
-                            hp, ctx, config, kernel_kwargs, shadow, m,
-                            explain_armed,
-                        ),
-                        deadline,
-                        what=f"mesh round ({n} devices)",
+                    # The inline (no-watchdog) path re-runs inline too: a
+                    # verification failure proved the answer wrong, not
+                    # the backend wedged, so no deadline thread exists.
+                    out = (
+                        run_with_deadline(
+                            fn, deadline, what=f"mesh round ({n} devices)"
+                        )
+                        if deadline > 0
+                        else fn()
                     )
                 sup.record_success()
                 return out
-            except (RoundTimeout, _XlaError, faults.FaultInjected) as e2:
+            except (
+                RoundTimeout, _XlaError, faults.FaultInjected,
+                RoundVerificationError,
+            ) as e2:
                 reason = f"{type(e2).__name__}: {e2}"
+                if isinstance(e2, RoundVerificationError):
+                    _quarantine_strike(mesh_sv, sup, reason, mesh=smaller)
                 continue
         # Failover attribution (ops/trace.py): tag the CYCLE that paid the
         # failover window -- the same cycle the SLO layer's fallback-delta
@@ -246,9 +243,78 @@ def run_round_on_device(
         sup.record_failure(reason)
         _trace().annotate(degraded=True, failover_reason=reason[:300])
         with _trace().span("cpu_failover", reason=reason[:300]):
+            # A verification failure ON THIS RUNG propagates out: decisions
+            # that disagree with the conservation invariants on the CPU
+            # backend mean the corruption is host-side or systemic --
+            # looping would commit to never answering.
             return _run_round_cpu_failover(
                 hp, ctx, config, kernel_kwargs, shadow, explain_armed
             )
+
+    if deadline <= 0:
+        # Watchdog disabled (tests/bench default): the original inline
+        # path.  Hangs cannot be caught here (nothing watches the clock),
+        # but a verification failure CAN -- the round completed, with a
+        # wrong answer -- so the silent-corruption defense works without
+        # the watchdog armed.
+        faults.check("device_round")
+        try:
+            return _round_body(
+                build_device_problem(), ctx, config, kernel_kwargs, shadow,
+                explain_armed,
+            )
+        except RoundVerificationError as e:
+            return _failover(e)
+
+    def _device_attempt():
+        faults.check("device_round")
+        return _round_body(
+            build_device_problem(), ctx, config, kernel_kwargs, shadow,
+            explain_armed,
+        )
+
+    if mesh_sv.enabled() and mesh_sv.device_count():
+        from armada_tpu.ops.trace import recorder as _trace
+
+        _trace().annotate(mesh_devices=mesh_sv.device_count())
+    try:
+        out = run_with_deadline(_device_attempt, deadline)
+        sup.record_success()
+        return out
+    except (
+        RoundTimeout, _XlaError, faults.FaultInjected, RoundVerificationError,
+    ) as e:
+        # RoundTimeout = tunnel wedge (thread abandoned); XlaRuntimeError =
+        # the backend died under us; FaultInjected = a drill;
+        # RoundVerificationError = the round-output certification caught a
+        # silently-wrong answer (models/verify.py).  Deliberately NARROW:
+        # a generic RuntimeError out of decode/rollback is a host code bug
+        # -- degrading on it would hide the bug behind a spuriously-working
+        # CPU re-run (and drop every device cache for nothing), so it
+        # propagates untouched.
+        return _failover(e)
+
+
+def _quarantine_strike(mesh_sv, sup, reason: str, mesh=None) -> None:
+    """Record one verification strike against the devices that produced
+    the bad round (scheduler/quarantine.DeviceQuarantine).  Safe to touch
+    jax here: a VERIFICATION failure means the backend answered (wrongly)
+    -- it is not wedged, unlike the timeout path, which never strikes."""
+    from armada_tpu.scheduler.quarantine import device_quarantine
+
+    devices: list = []
+    try:
+        if mesh is None and mesh_sv.enabled() and not sup.degraded:
+            mesh = mesh_sv.serving_mesh()
+        if mesh is not None:
+            devices = [str(d) for d in mesh.devices.flat]
+        else:
+            import jax
+
+            devices = [str(jax.devices()[0])]
+    except Exception:  # device enumeration must never mask the failover
+        devices = ["default-device"]
+    device_quarantine().record_strikes(devices, reason)
 
 
 def _run_round_on_mesh(
@@ -298,11 +364,19 @@ def _round_body(
     import numpy as _np
 
     from armada_tpu.models import explain as _explain
+    from armada_tpu.models import verify as _verify
     from armada_tpu.ops.trace import recorder as _trace
 
     trace = _trace()
+    pool = getattr(ctx, "pool", "")
     with trace.span("kernel_dispatch"):
         result = schedule_round(device_problem, **kernel_kwargs)
+    # round_corrupt drill (core/faults): device-side header/lane corruption
+    # injected BEFORE the compact dispatch, so both the decode transfer and
+    # the verification pass see the corrupted state -- exactly like a real
+    # silently-wrong device result.  One dict lookup when unarmed.
+    result = _verify.maybe_corrupt_result(result)
+    verify_armed = _verify.verify_enabled()
     # Overlapped decode (begin_decode): the compaction + its device->host
     # copy are enqueued behind the kernel with no host sync in between, so
     # the transfer streams as soon as the kernel finishes -- a blocking
@@ -310,6 +384,18 @@ def _round_body(
     # in the serve/sidecar paths (the bench loop already did this).
     with trace.span("decode_dispatch"):
         finish = begin_decode(result, ctx)
+    # Round verification (models/verify.py): dispatched BEHIND the decode
+    # compaction so the invariant pass and its device->host copy ride the
+    # decode shadow; the verdict is checked between the compact FETCH and
+    # the host decode, so a corrupted round never reaches decode's loops
+    # (RoundVerificationError -> run_round_on_device's failover ladder).
+    # ONE extra transfer per verified round.
+    ver_dispatched = None
+    if verify_armed:
+        with trace.span("verify_dispatch"):
+            ver_dispatched = _verify.dispatch_verify(
+                device_problem, result, finish.dispatched, ctx
+            )
     # Explain pass (models/explain.py): dispatched BEHIND the decode
     # compaction so its device compute and device->host copy ride the
     # decode shadow; the blocking fetch happens after the outcome, off the
@@ -325,6 +411,10 @@ def _round_body(
     # The fetch span is where kernel + transfer latency surfaces: the
     # dispatch spans above are async enqueues, this is the blocking wait.
     with trace.span("fetch_decode"):
+        if ver_dispatched is not None:
+            finish.fetch()  # blocking compact fetch (stashes the raw bytes)
+            with trace.span("verify_fetch"):
+                _verify.finish_verify(ver_dispatched, ctx, pool=pool)
         outcome = finish()
     # Iteration-count legibility (ARMADA_COMMIT_K): the round span carries
     # the physical trip count next to the logical one, so a multi-commit
@@ -387,7 +477,20 @@ def _round_body(
             g_valid[_np.asarray(sorted(set(kill)), _np.int64)] = False
             device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
             result = schedule_round(device_problem, **kernel_kwargs)
-            outcome = begin_decode(result, ctx)()
+            fin = begin_decode(result, ctx)
+            if verify_armed:
+                # Every attempt's state is verified between its fetch and
+                # its decode -- a corrupted re-run must not steer the
+                # rollback loop (or crash its decode) any more than the
+                # first attempt may.
+                vd = _verify.dispatch_verify(
+                    device_problem, result, fin.dispatched, ctx
+                )
+                if vd is not None:
+                    fin.fetch()
+                    with trace.span("verify_fetch"):
+                        _verify.finish_verify(vd, ctx, pool=pool)
+            outcome = fin()
     if attempts and explain_armed:
         # Attribution must describe the FINAL (post-rollback) round, so the
         # shadow-dispatched buffer is stale -- re-dispatch ONCE here rather

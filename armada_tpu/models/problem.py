@@ -27,6 +27,7 @@ Layout conventions (see SURVEY.md section 7 "Tensor reformulation"):
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -168,6 +169,13 @@ class HostContext:
     # members or loses all (the reference evicts the remains of partially
     # evicted gangs and re-schedules them as one all-or-nothing unit).
     running_gangs: dict = dataclasses.field(default_factory=dict)
+    # The compact decode buffer EXACTLY as this round's fetch received it
+    # (stashed by _fetch_compact, overwritten per round; None on the
+    # full-pull fallback).  Round verification (models/verify.py)
+    # re-derives the fingerprint from these bytes -- the device-computed
+    # fold rides a separate buffer, so transfer truncation/bit-flips in
+    # either transfer surface as a mismatch instead of a committed round.
+    last_compact_np: Optional[np.ndarray] = None
 
     def members_of(self, gi: int) -> list:
         """Member job ids of gang `gi` under either representation."""
@@ -1344,6 +1352,7 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
     from armada_tpu.models.fair_scheduler import _COMPACT_HEADER
 
     d = dispatched if dispatched is not None else _dispatch_compact(result, ctx)
+    ctx.last_compact_np = None
     if d is None:
         return None
     buf_dev, fcap, ecap = d
@@ -1351,6 +1360,18 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
     from armada_tpu.models.xfer import TRANSFER_STATS
 
     TRANSFER_STATS.count_down(buf.nbytes)
+    if os.environ.get("ARMADA_FAULT"):
+        # round_corrupt `bytes` drill (core/faults): flip a bit in the
+        # buffer AS RECEIVED -- decode and the verification fingerprint
+        # must both see the corrupted copy, exactly like real transfer
+        # corruption.  Slot 3 (sched_count) is decode-inert, so only the
+        # fingerprint cross-check can catch it.
+        from armada_tpu.core import faults as _faults
+
+        if _faults.active("round_corrupt", modes=("bytes",)):
+            buf = buf.copy()
+            buf[min(3, buf.size - 1)] ^= np.int32(1 << 20)
+    ctx.last_compact_np = buf
     (
         n_slots, iterations, termination, _sched_count, spot_bits, n_failed,
         n_pre, n_res, kernel_iters,
@@ -1393,7 +1414,15 @@ def begin_decode(result, ctx: HostContext):
     host sync + a fresh fetch round trip (each costs ~0.1s on the axon
     tunnel).  Returns a zero-arg callable producing the RoundOutcome; any
     decision-independent host work run between the two overlaps the kernel
-    and the transfer."""
+    and the transfer.
+
+    The returned callable carries two attributes for round verification
+    (models/verify.py): ``finish.dispatched`` is the compact dispatch
+    handle (the verification kernel fingerprints the SAME device buffer
+    the decode transfer carries), and ``finish.fetch()`` performs JUST the
+    blocking compact fetch (idempotent, one transfer however often it is
+    called) -- the verification verdict runs between that fetch and the
+    decode, so a corrupted round never reaches the host decode loops."""
     dispatched = _dispatch_compact(result, ctx)
     if dispatched is not None:
         try:
@@ -1401,20 +1430,41 @@ def begin_decode(result, ctx: HostContext):
         except (AttributeError, RuntimeError):
             pass  # backend without async copies: finish() fetches normally
 
-    def finish() -> RoundOutcome:
-        return decode_result(result, ctx, _dispatched=dispatched)
+    box: dict = {}
 
+    def fetch():
+        if "v" not in box:
+            box["v"] = _fetch_compact(result, ctx, dispatched=dispatched)
+        return box["v"]
+
+    def finish() -> RoundOutcome:
+        return decode_result(result, ctx, _dispatched=dispatched, _fetched=fetch())
+
+    finish.dispatched = dispatched
+    finish.fetch = fetch
     return finish
 
 
-def decode_result(result, ctx: HostContext, _dispatched=None) -> RoundOutcome:
+_UNFETCHED = object()  # decode_result sentinel: None is a real fetch result
+
+
+def decode_result(
+    result, ctx: HostContext, _dispatched=None, _fetched=_UNFETCHED
+) -> RoundOutcome:
     """Map device tensors back to job/node ids (the reference's SchedulerResult).
 
     Decode stays O(decisions) on the wire too: when the result lives on
     device, a jitted compaction packs failed/evicted indices + placement
     slots into one small buffer (fair_scheduler.compact_result) so the
-    tunnel transfer is ~100KB instead of the [G] g_state pull."""
-    compact = _fetch_compact(result, ctx, dispatched=_dispatched)
+    tunnel transfer is ~100KB instead of the [G] g_state pull.
+    `_fetched` lets begin_decode hand over an already-fetched compact
+    tuple (the verification flow fetches first, checks the verdict, then
+    decodes) without paying or counting a second transfer."""
+    compact = (
+        _fetched
+        if _fetched is not _UNFETCHED
+        else _fetch_compact(result, ctx, dispatched=_dispatched)
+    )
     if compact is not None:
         (
             n_slots, slot_gang, slot_nodes, slot_counts, g2, pre_idx, res_idx,

@@ -172,12 +172,23 @@ class MeshServing:
         # caller back the full mesh that just failed.
         return self._mesh_for(new_n)
 
-    def restore(self) -> None:
+    def restore(self) -> bool:
         """Back to the full configured mesh (probe-driven or operator);
-        device caches re-shard on their next apply via the reset hooks."""
+        device caches re-shard on their next apply via the reset hooks.
+        Returns False (staying on the smaller rung) while the watchdog
+        promotion gate vetoes -- a quarantined chip must not rejoin the
+        mesh until the operator clears it (scheduler/quarantine.py)."""
+        from armada_tpu.core.watchdog import promotion_blocked
+
+        blocked = promotion_blocked()
+        if blocked:
+            _log.warning(
+                "mesh probes healthy but restore is blocked: %s", blocked
+            )
+            return False
         with self._lock:
             if self._requested < 2 or self._active >= self._requested:
-                return
+                return True
             self._active = self._requested
             self.restores += 1
         _log.warning(
@@ -187,6 +198,7 @@ class MeshServing:
         from armada_tpu.core.watchdog import fire_reset_hooks
 
         fire_reset_hooks()
+        return True
 
     # ------------------------------------------------------------ reprobe ---
 
@@ -222,9 +234,10 @@ class MeshServing:
             if ok:
                 healthy += 1
                 _log.info("mesh re-probe healthy (%s): %d/%d", detail, healthy, need)
-                if healthy >= need:
-                    self.restore()
+                if healthy >= need and self.restore():
                     break
+                # gate-blocked (quarantine): keep polling so an operator
+                # clear restores on the next healthy pass
             else:
                 healthy = 0
                 _log.info("mesh re-probe still failing: %s", detail)

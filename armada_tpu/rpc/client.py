@@ -387,6 +387,32 @@ class ArmadaClient(_Base):
         )
         return json.loads(resp.json)
 
+    # --- device quarantine (armadactl quarantine; scheduler/quarantine.py) --
+
+    def quarantine_status(self) -> dict:
+        """The round-verification ledger + device quarantine scoreboard
+        (the same block /healthz embeds)."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/QuarantineStatus",
+            pb.Empty(),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def quarantine_clear(self, device: str = "") -> dict:
+        """Clear the device quarantine (one device, or all when empty);
+        the next healthy re-probe may then promote back."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/QuarantineClear",
+            pb.QueueGetRequest(name=device),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
     # --- scheduling reports -------------------------------------------------
 
     def get_job_report(self, job_id: str) -> dict:

@@ -451,6 +451,25 @@ class _ExecutorAdminService:
         doc = _guard(context, lambda: self._cp.dump_trace(principal))
         return pb.JsonResponse(json=_json.dumps(doc))
 
+    def QuarantineStatus(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        block = _guard(
+            context, lambda: self._cp.quarantine_status(principal)
+        )
+        return pb.JsonResponse(json=_json.dumps(block))
+
+    def QuarantineClear(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        out = _guard(
+            context,
+            lambda: self._cp.quarantine_clear(request.name, principal),
+        )
+        return pb.JsonResponse(json=_json.dumps(out))
+
     def PreemptOnQueue(self, request, context):
         principal = _authenticate(self._auth, context)
         _guard(
@@ -776,6 +795,12 @@ def make_server(
                         csvc.CheckpointStatus, pb.Empty
                     ),
                     "DumpTrace": _unary(csvc.DumpTrace, pb.Empty),
+                    "QuarantineStatus": _unary(
+                        csvc.QuarantineStatus, pb.Empty
+                    ),
+                    "QuarantineClear": _unary(
+                        csvc.QuarantineClear, pb.QueueGetRequest
+                    ),
                 },
             )
         )

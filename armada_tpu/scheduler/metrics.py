@@ -115,6 +115,25 @@ class SchedulerMetrics:
         )
         self._unsched_labels: set = set()
         self._frag_labels: set = set()
+        # Round-output verification (models/verify.py): cumulative failure
+        # counts per invariant/fingerprint site, and the device quarantine
+        # scoreboard (scheduler/quarantine.py).  Quarantine label sets no
+        # longer present (operator clear) are removed, like the explain
+        # series above -- a cleared device must stop exporting its gauge.
+        self.round_verification_failures = g(
+            "armada_round_verification_failures_total",
+            "Scheduling rounds that failed output verification, by the "
+            "invariant or fingerprint site that caught them (monotonic)",
+            ["site"],
+        )
+        self.device_quarantined = g(
+            "armada_device_quarantined",
+            "1 while the device is quarantined by round verification "
+            "(excluded from re-promotion until `armadactl quarantine "
+            "--clear`)",
+            ["device"],
+        )
+        self._quarantine_labels: set = set()
         # Device-loss degradation state (core/watchdog): dashboards alert on
         # device_healthy == 0 (rounds running on the CPU failover) and on
         # device_fallbacks increasing (each is one lost round re-run).
@@ -282,6 +301,26 @@ class SchedulerMetrics:
                 v = summary.get(q + "_s")
                 if v is not None:
                     self.cycle_stage_latency.labels(stage, q).set(v)
+
+    def observe_verify(self, block: dict) -> None:
+        """Publish the round-verification ledger + quarantine scoreboard
+        (models/verify.healthz_block), once per cycle.  Failure counters
+        are cumulative process totals exported as-is; quarantine gauges
+        for devices no longer on the scoreboard are removed."""
+        for site, n in (block.get("failures_by_site") or {}).items():
+            self.round_verification_failures.labels(site).set(float(n))
+        seen = set()
+        quarantined = (block.get("quarantine") or {}).get("quarantined") or {}
+        for device in quarantined:
+            labels = (device,)
+            seen.add(labels)
+            self.device_quarantined.labels(*labels).set(1.0)
+        for labels in self._quarantine_labels - seen:
+            try:
+                self.device_quarantined.remove(*labels)
+            except KeyError:
+                pass
+        self._quarantine_labels = seen
 
     def observe_durability(self, status: dict) -> None:
         """Publish the scheduler's durability block

@@ -276,6 +276,9 @@ class Scheduler:
             from armada_tpu.core.watchdog import supervisor
 
             self.metrics.observe_device(supervisor().snapshot())
+            from armada_tpu.models.verify import healthz_block as _verify_block
+
+            self.metrics.observe_verify(_verify_block())
             self.metrics.observe_slo(self._slo().snapshot())
             self.metrics.observe_trace(_trace_recorder().stage_snapshot())
             self.metrics.observe_durability(self.durability_status())

@@ -141,6 +141,35 @@ class ControlPlaneServer:
 
         return recorder().dump()
 
+    # --- device quarantine (scheduler/quarantine.py; plane-local) -----------
+
+    def quarantine_status(self, principal: Principal = Principal()) -> dict:
+        """The round-verification ledger + device quarantine scoreboard
+        (the same block /healthz embeds).  Plane-LOCAL like the checkpoint
+        verbs: a quarantine is one replica's view of its own accelerator."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        from armada_tpu.models.verify import healthz_block
+
+        return healthz_block()
+
+    def quarantine_clear(
+        self, device: str = "", principal: Principal = Principal()
+    ) -> dict:
+        """Operator clear: forget quarantine + strike windows for `device`
+        (or every device when empty), so the next healthy re-probe may
+        promote back to the accelerator.  The ONE way out of a
+        verification quarantine -- a chip that corrupts results does not
+        heal by waiting (docs/operations.md runbook)."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        from armada_tpu.scheduler.quarantine import device_quarantine
+
+        cleared = device_quarantine().clear(device)
+        return {"cleared": cleared}
+
     # --- mass actions (executor.go PreemptOnExecutor / CancelOnExecutor) ----
 
     def preempt_on_executor(

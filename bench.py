@@ -16,7 +16,10 @@ Env knobs for local runs: ARMADA_BENCH_JOBS, ARMADA_BENCH_NODES,
 ARMADA_BENCH_QUEUES, ARMADA_BENCH_REPEATS, ARMADA_BENCH_RUNS,
 ARMADA_BENCH_BURST (per-cycle placement cap + arrival count -- the
 mass-placement datapoint, docs/bench.md); ARMADA_BENCH_EXPLAIN=0 skips
-the explain-pass measurement (explain_s + explain_counts keys).
+the explain-pass measurement (explain_s + explain_counts keys);
+ARMADA_BENCH_VERIFY=0 skips the round-verification measurement
+(verify_s + verify_transfers keys -- the extra transfer count the
+certification pass is allowed, models/verify.py).
 ARMADA_COMMIT_K arms the multi-commit kernel for every arm; the JSON
 echoes it (commit_k) next to the trip counters (kernel_iters /
 round_iters / burst10k_iters -- docs/bench.md r15).
@@ -499,6 +502,47 @@ def _e2e_bench(
                 k: v for k, v in out.counts.items() if v
             }
             best_parts["explain_transfers"] = TRANSFER_STATS.snapshot()[
+                "down_transfers"
+            ]
+    # Round verification (models/verify.py; ARMADA_BENCH_VERIFY=0 skips):
+    # the conservation-invariant + fingerprint certification over the LAST
+    # measured round's slab, timed dispatch->verdict at steady state (first
+    # run pays the one-off jit compile).  verify_s is the full cost an
+    # armed round adds off the critical path, and verify_transfers pins
+    # the ONE extra device->host transfer the pass is allowed -- the
+    # compact fetch it cross-checks is the round's own, fetched OUTSIDE
+    # the timed window here exactly as it is in production.
+    if (
+        measure_explain
+        and os.environ.get("ARMADA_BENCH_VERIFY", "1") != "0"
+        and _last_round
+    ):
+        from armada_tpu.models import verify as _verify
+        from armada_tpu.models.problem import _dispatch_compact, _fetch_compact
+
+        t_verify, verdict = None, None
+        for _ in range(2):
+            d = _dispatch_compact(
+                _last_round["result"], _last_round["ctx"]
+            )
+            if d is None:
+                break
+            _fetch_compact(
+                _last_round["result"], _last_round["ctx"], dispatched=d
+            )
+            TRANSFER_STATS.reset()
+            t0 = time.perf_counter()
+            vd = _verify.dispatch_verify(
+                _last_round["dev"], _last_round["result"], d,
+                _last_round["ctx"],
+            )
+            if vd is None:
+                break
+            verdict = _verify.finish_verify(vd, _last_round["ctx"])
+            t_verify = time.perf_counter() - t0
+        if verdict is not None:
+            best_parts["verify_s"] = round(t_verify, 4)
+            best_parts["verify_transfers"] = TRANSFER_STATS.snapshot()[
                 "down_transfers"
             ]
     return best, best_parts, scheduled

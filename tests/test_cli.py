@@ -149,6 +149,30 @@ def test_cli_checkpoint_trigger_and_status(plane, capsys):
     assert status["checkpoint"]["count"] >= 1
 
 
+def test_cli_quarantine_status_and_clear(plane, capsys):
+    """`armadactl quarantine` + `--clear`: the operator's only way out of
+    a round-verification quarantine (models/verify.py +
+    scheduler/quarantine.py) through the real gRPC surface."""
+    import json
+
+    from armada_tpu.scheduler.quarantine import reset_device_quarantine
+
+    dq = reset_device_quarantine(strikes=1)
+    try:
+        dq.record_strikes(["chip0"], "cli drill")
+        assert ctl(plane, "quarantine") == 0
+        block = json.loads(capsys.readouterr().out)
+        assert "chip0" in block["quarantine"]["quarantined"]
+        assert "failures_by_site" in block
+        assert ctl(plane, "quarantine", "--clear") == 0
+        assert "chip0" in capsys.readouterr().out
+        assert dq.quarantined() == {}
+        assert ctl(plane, "quarantine", "--clear") == 0
+        assert "nothing to clear" in capsys.readouterr().out
+    finally:
+        reset_device_quarantine()
+
+
 def test_cli_cancel_and_reprioritize(plane, tmp_path, capsys):
     ctl(plane, "queue", "create", "ops")
     sub = tmp_path / "job.yaml"

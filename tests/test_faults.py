@@ -230,6 +230,32 @@ def test_fault_after_n_arming_independent_per_entry(monkeypatch):
     assert faults.active("s3") == "error"
 
 
+def test_round_corrupt_mode_filter_and_after_n(monkeypatch):
+    """The round_corrupt site's modes live at DIFFERENT check points
+    (header/lane fire device-side in models/__init__, bytes fires at the
+    fetched-transfer boundary in models/problem): a filtered check must
+    neither consume nor advance another mode's entry, and after_n counts
+    only the checks the filter admits."""
+    monkeypatch.setenv(
+        "ARMADA_FAULT", "round_corrupt:bytes:1,round_corrupt:header"
+    )
+    # device-side check point: skips the bytes entry without touching its
+    # counter, fires the header entry one-shot
+    assert faults.active("round_corrupt", modes=("header", "lane")) == "header"
+    assert faults.active("round_corrupt", modes=("header", "lane")) is None
+    # bytes check point: first admitted check is its free pass (after_n=1,
+    # untouched by the two filtered header-side checks above)
+    assert faults.active("round_corrupt", modes=("bytes",)) is None
+    assert faults.active("round_corrupt", modes=("bytes",)) == "bytes"
+    assert faults.active("round_corrupt", modes=("bytes",)) is None
+    # an unfiltered check (modes=None) still sees any pending entry: after
+    # a reset the bytes entry is on its free pass, so header fires first,
+    # then the re-armed bytes entry on its second admitted check
+    faults.reset_counters()
+    assert faults.active("round_corrupt") == "header"
+    assert faults.active("round_corrupt") == "bytes"
+
+
 def test_reprobe_promotes_after_n_healthy(monkeypatch):
     sup = watchdog.supervisor()
     sup.configure(deadline_s=60.0, reprobe_interval_s=0.02, healthy_checks=2)

@@ -49,21 +49,30 @@ def build_world(cfg, num_nodes, num_queues):
     return F, nodes, queues
 
 
+CORRUPT_MODES = ("header", "lane", "bytes")
+
+
 def run_script(
     *, cycles, seed, jobs0, burst, num_nodes, num_queues, fault, fault_cycle,
     prefetch, deadline_s=30.0, mesh=0,
 ):
     """One deterministic multi-cycle run; returns per-cycle decision lists.
-    `fault` is None (clean replay) or "hang"/"error" injected at
-    `fault_cycle`.  `mesh` >= 2 arms the mesh serving plane (the chip-loss
-    drill: the faulted cycle must degrade to a SMALLER mesh, never CPU)."""
+    `fault` is None (clean replay), "hang"/"error" (device loss) or a
+    round_corrupt mode ("header"/"lane"/"bytes": silent corruption, which
+    ONLY round verification can catch -- ARMADA_VERIFY is armed and the
+    device quarantine threshold drops to 1 strike so the drill also
+    exercises the promotion gate), injected at `fault_cycle`.  `mesh` >= 2
+    arms the mesh serving plane (the chip-loss drill: the faulted cycle
+    must degrade to a SMALLER mesh, never CPU)."""
     from armada_tpu.analysis import tsan
     from armada_tpu.core import faults, watchdog
     from armada_tpu.core.config import PriorityClass, SchedulingConfig
     from armada_tpu.core.types import JobSpec, RunningJob
     from armada_tpu.models import run_round_on_device
+    from armada_tpu.models.verify import reset_verify_state
     from armada_tpu.parallel.serving import reset_mesh_serving
     from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+    from armada_tpu.scheduler.quarantine import reset_device_quarantine
 
     # The FAULTED leg arms the race harness (analysis/tsan): the watchdog
     # failover is exactly where zombie-worker races live.  The harness then
@@ -88,7 +97,20 @@ def run_script(
     if mesh:
         ms.configure(mesh)
         ms._probe = lambda timeout_s: (True, "chaos-stub")
-    if fault:
+    corrupt = fault in CORRUPT_MODES
+    if corrupt or os.environ.get("ARMADA_CHAOS_VERIFY"):
+        # The corruption drill's whole point: verification armed for BOTH
+        # legs (the clean replay certifies green), 1 strike quarantines so
+        # the drill exercises the promotion gate too.
+        os.environ["ARMADA_VERIFY"] = "1"
+        reset_verify_state()
+        reset_device_quarantine(strikes=1)
+    if corrupt:
+        # after_n counts that site's checks: one per cycle for the
+        # device-side legs (maybe_corrupt_result) AND for the fetched-bytes
+        # leg (one compact fetch per cycle in this gang-free world).
+        os.environ["ARMADA_FAULT"] = f"round_corrupt:{fault}:{fault_cycle}"
+    elif fault:
         # after_n = number of device-round checks before the injected cycle
         os.environ["ARMADA_FAULT"] = f"device_round:{fault}:{fault_cycle}"
     else:
@@ -177,6 +199,17 @@ def main() -> int:
         help="exercise the pipeline's content prefetch around the loss",
     )
     ap.add_argument(
+        "--corrupt",
+        action="store_true",
+        help="the silent-corruption drill (ISSUE 13): inject a random "
+        "round_corrupt fault (header scalar / placement lane / fetched "
+        "bytes) mid-drill with round verification armed -- verification "
+        "must catch it before decode, the failover re-run must be "
+        "bit-equal to the clean replay, the 1-strike quarantine must "
+        "BLOCK re-promotion until cleared, and the post-clear probe must "
+        "promote (docs/operations.md silent-corruption runbook)",
+    )
+    ap.add_argument(
         "--soak",
         action="store_true",
         help="additionally run a short soak window with the same fault "
@@ -232,7 +265,15 @@ def main() -> int:
             ).strip()
 
     rng = random.Random(args.seed)
-    fault = rng.choice(["error", "hang"])
+    if args.corrupt and args.mesh:
+        print("--corrupt and --mesh are separate drills; pick one", file=sys.stderr)
+        return 2
+    if args.corrupt:
+        fault = rng.choice(list(CORRUPT_MODES))
+        # both legs arm verification (the clean replay certifies green)
+        os.environ["ARMADA_CHAOS_VERIFY"] = "1"
+    else:
+        fault = rng.choice(["error", "hang"])
     fault_cycle = rng.randrange(1, max(2, args.cycles - 1))
     common = dict(
         # hang drills ride a tight deadline so the drill stays fast; it
@@ -271,6 +312,27 @@ def main() -> int:
             and snap["fallbacks"] == 0
             and not sup.degraded
         )
+    elif args.corrupt:
+        # convergence half 1 (corruption drill): verification caught the
+        # silently-wrong round (fallbacks >= 1 via the ladder), the
+        # 1-strike quarantine must HOLD the stubbed-healthy re-probe down,
+        # and only the operator clear releases promotion.
+        from armada_tpu.core.watchdog import promotion_blocked
+        from armada_tpu.models.verify import verify_state
+        from armada_tpu.scheduler.quarantine import device_quarantine
+
+        verify_snap = verify_state().snapshot()
+        time.sleep(0.5)  # ~10 stub-probe cycles: promotion must NOT happen
+        held = sup.degraded and promotion_blocked() is not None
+        quarantined = sorted(device_quarantine().quarantined())
+        device_quarantine().clear()
+        deadline = time.monotonic() + 10.0
+        while sup.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        promoted = not sup.degraded
+        mesh_ok = (
+            verify_snap["failures"] >= 1 and held and bool(quarantined)
+        )
     else:
         # convergence half 1: the supervisor recovered (stubbed-healthy probe)
         deadline = time.monotonic() + 10.0
@@ -298,11 +360,15 @@ def main() -> int:
 
         from armada_tpu.loadgen.soak import SoakConfig, run_soak
 
+        # A corrupt-mode string is not a device_round MODE: the soak leg
+        # always drills a real device fault (the corruption drill itself
+        # is the replay legs' job above).
+        soak_fault = "error" if args.corrupt else fault
         cfg = SoakConfig.from_env(
             window_s=float(os.environ.get("ARMADA_SOAK_WINDOW_S", 30.0)),
             target_eps=float(os.environ.get("ARMADA_SOAK_RATE", 100.0)),
             seed=args.seed,
-            fault=f"device_round:{fault}",
+            fault=f"device_round:{soak_fault}",
             watchdog_s=8.0,
         )
         with tempfile.TemporaryDirectory(prefix="chaos-soak-") as d:
@@ -326,17 +392,19 @@ def main() -> int:
     ok = (
         chaotic == clean
         and (snap["fallbacks"] >= 1 if not args.mesh else mesh_ok)
+        and (not args.corrupt or mesh_ok)
         and promoted
         and not tsan_found
         and (soak_report is None or soak_report["ok"])
         and (crash_report is None or crash_report["ok"])
     )
+    fault_site = "round_corrupt" if args.corrupt else "device_round"
     line = {
         "tool": "chaos_cycle",
         "ok": ok,
         "seed": args.seed,
         "cycles": args.cycles,
-        "fault": f"device_round:{fault}@cycle{fault_cycle}",
+        "fault": f"{fault_site}:{fault}@cycle{fault_cycle}",
         "prefetch": bool(args.prefetch),
         "fallbacks": snap["fallbacks"],
         "promoted": promoted,
@@ -356,6 +424,14 @@ def main() -> int:
             "degrades": mesh_snap["degrades"],
             "restored": promoted,
             "cpu_fallbacks": snap["fallbacks"],
+        }
+    if args.corrupt:
+        line["corrupt"] = {
+            "caught": verify_snap["failures"] >= 1,
+            "sites": sorted(verify_snap["failures_by_site"]),
+            "quarantined": quarantined,
+            "promotion_held": held,
+            "promoted_after_clear": promoted,
         }
     if tsan_found:
         line["tsan_detail"] = tsan_found[:5]
