@@ -1178,6 +1178,138 @@ def _unpinned_out_shardings(src: Source):
             )
 
 
+_POOL_STATE_FACTORIES = {"builder_for": "builder", "devcache_for": "devcache"}
+_POOL_STATE_MUTATORS = {
+    "submit", "submit_many", "remove", "remove_many", "lease", "lease_many",
+    "unlease", "unlease_if_present", "set_nodes", "set_queues",
+    "assemble_delta", "apply", "scatter_content", "prefetch_content",
+    "invalidate_prefetch", "note_running_gang", "forget_running_gang",
+}
+
+
+def _pool_fn_stmts(fn) -> list:
+    """The function's statements in document order, excluding nested defs
+    (different scope, different dispatch windows)."""
+    out: list = []
+
+    def walk(stmts):
+        for st in stmts:
+            out.append(st)
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(st, field, None)
+                if inner and not isinstance(
+                    st, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    walk(inner)
+            for h in getattr(st, "handlers", ()) or ():
+                walk(h.body)
+
+    walk(fn.body)
+    return out
+
+
+@rule(
+    "pool-dispatch-mutation",
+    "host-side mutation of a pool's builder/devcache between its round "
+    "DISPATCH (dispatch_round_on_device) and its FETCH (the finish call): "
+    "the in-flight round's failover ground truth (bundle.materialize) "
+    "closes over live builder state, so a mid-flight mutation makes a "
+    "mesh/CPU re-run solve a DIFFERENT problem than the round it replaces "
+    "-- the cross-pool zombie-write hazard class (round 17)",
+    scope=under("armada_tpu/"),
+)
+def _pool_dispatch_mutation(src: Source):
+    # Scope note: this models the SOLO dispatch API only.  The windowed
+    # dispatch_pool_rounds flow (a list of finishes consumed in a zip
+    # loop) is beyond intra-statement def-use; the dynamic equality
+    # suites cover it (docs/lint.md ledger states the boundary).
+    if "dispatch_round_on_device" not in src.text:
+        return
+    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+    fns = [
+        n
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in fns:
+        # value-flow per function: name -> frozenset of (kind, key) pool
+        # sources (derived transitively from builder_for/devcache_for
+        # calls, key = the normalized pool argument), plus the open
+        # dispatch windows (finish handle name -> the sources its dispatch
+        # call closed over).
+        bindings: dict = {}
+        open_dispatch: dict = {}
+
+        def expr_sources(node) -> frozenset:
+            out: set = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out |= bindings.get(sub.id, frozenset())
+            return frozenset(out)
+
+        for st in _pool_fn_stmts(fn):
+            # (1) a finish call closes its dispatch window
+            for sub in ast.walk(st):
+                if isinstance(sub, ast.Call):
+                    name = None
+                    if isinstance(sub.func, ast.Name):
+                        name = sub.func.id
+                    elif (
+                        isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "finish"
+                        and isinstance(sub.func.value, ast.Name)
+                    ):
+                        name = sub.func.value.id
+                    if name in open_dispatch:
+                        open_dispatch.pop(name, None)
+            exposed = frozenset().union(*open_dispatch.values()) if open_dispatch else frozenset()
+            # (2) mutations of an in-flight pool's state
+            if exposed:
+                for sub in ast.walk(st):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _POOL_STATE_MUTATORS
+                        and expr_sources(sub.func.value) & exposed
+                    ):
+                        yield _finding(
+                            src,
+                            "pool-dispatch-mutation",
+                            sub,
+                            "builder/devcache state of a DISPATCHED pool "
+                            "round mutated before its fetch: the failover "
+                            "ladder's materialize() would re-run a "
+                            "different problem -- commit mutations after "
+                            "the finish call, or route them through "
+                            "another pool's state",
+                        )
+                        break
+            # (3) binding propagation (rebinding clears)
+            if isinstance(st, ast.Assign) and st.value is not None:
+                srcs: frozenset = frozenset()
+                val = st.value
+                if isinstance(val, ast.Call):
+                    last = _dotted(val.func).rsplit(".", 1)[-1]
+                    if last in _POOL_STATE_FACTORIES:
+                        key = ast.dump(val.args[0]) if val.args else "<kw>"
+                        srcs = frozenset(
+                            {(_POOL_STATE_FACTORIES[last], key)}
+                        )
+                    elif last == "dispatch_round_on_device":
+                        for tgt in st.targets:
+                            if isinstance(tgt, ast.Name):
+                                open_dispatch[tgt.id] = expr_sources(val)
+                        srcs = frozenset()
+                    else:
+                        srcs = expr_sources(val)
+                else:
+                    srcs = expr_sources(val)
+                for tgt in st.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            bindings[sub.id] = srcs
+
+
 _THREAD_SPAWNERS = {"threading.Thread", "Thread", "_thread.start_new_thread"}
 
 

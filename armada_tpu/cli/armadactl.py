@@ -783,6 +783,11 @@ def cmd_serve(args):
         # (scheduler loop, sidecar sessions, mesh reruns) with compile
         # caches keyed on the resolved K.
         os.environ["ARMADA_COMMIT_K"] = str(args.commit_k)
+    if getattr(args, "pool_parallel", False):
+        # Read per cycle (core/pipeline.pool_parallel_enabled), so one env
+        # set arms the scheduler loop AND sidecar sessions; per-cycle
+        # certification still decides serial vs parallel each cycle.
+        os.environ["ARMADA_POOL_PARALLEL"] = "1"
     config, authenticator = load_serve_config(args)
     plane = start_control_plane(
         data_dir=args.data_dir,
@@ -1148,6 +1153,20 @@ def build_parser() -> argparse.ArgumentParser:
         "runs sequentially after the kernel instead of in its shadow -- "
         "the A/B + bisection escape hatch; decisions are identical either "
         "way",
+    )
+    srv.add_argument(
+        "--pool-parallel",
+        action="store_true",
+        default=False,
+        dest="pool_parallel",
+        help="arm pool-parallel serving (sets ARMADA_POOL_PARALLEL=1 "
+        "process-wide): eligible pools' rounds dispatch through the device "
+        "before any fetch, and shape-matched small pools stack into one "
+        "kernel launch -- multi-tenant cycle wall clock ~max(pool) instead "
+        "of ~sum(pools).  Decisions are bit-identical to the serial loop; "
+        "cycles that cannot certify pool independence (multi-pool jobs, "
+        "binding rate limits, market pools) fall back to the serial order "
+        "automatically (see /healthz `pools` and docs/operations.md)",
     )
     srv.add_argument(
         "--bind-host",

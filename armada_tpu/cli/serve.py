@@ -544,6 +544,13 @@ def start_control_plane(
         from armada_tpu.models.verify import healthz_block as _verify_block
 
         health_server.verify_status = _verify_block
+        # Pool-parallel serving scoreboard (scheduler/pool_serving.py):
+        # parallel vs serial-fallback cycles, stacked launches, per-pool
+        # round seconds -- wired unconditionally (the block reports
+        # enabled=false under the serial default, which is itself signal).
+        from armada_tpu.scheduler.pool_serving import pool_serving_stats
+
+        health_server.pools_status = lambda: pool_serving_stats().snapshot()
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(

@@ -212,6 +212,15 @@ class _Handler(BaseHTTPRequestHandler):
                     body["verify"] = srv.verify_status()
                 except Exception as exc:  # noqa: BLE001
                     body["verify"] = {"error": str(exc)}
+            if srv.pools_status is not None:
+                # Pool-parallel serving block (scheduler/pool_serving.py):
+                # parallel vs serial-fallback cycle counts, stacked-launch
+                # totals, last overlap ratio and per-pool round seconds --
+                # how the multi-tenant cycle is actually being served.
+                try:
+                    body["pools"] = srv.pools_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["pools"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -297,6 +306,9 @@ class HealthServer:
         # models/verify.healthz_block: last verdict, failure census,
         # device quarantine scoreboard).
         self.verify_status = None
+        # Optional () -> dict: pool-parallel serving scoreboard (serve
+        # wires scheduler/pool_serving.pool_serving_stats().snapshot).
+        self.pools_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -26,6 +26,21 @@ def pipeline_enabled() -> bool:
     return os.environ.get("ARMADA_PIPELINE", "1") != "0"
 
 
+def pool_parallel_enabled() -> bool:
+    """Pool-parallel serving armed (round 17)?  ``ARMADA_POOL_PARALLEL=1``
+    / ``serve --pool-parallel`` restructures the multi-pool cycle into
+    dispatch/fetch phases (pool B's upload + kernel dispatch fire while
+    pool A's fetch is in flight) and stacks shape-matched small pools into
+    one kernel launch.  Default OFF in the library/tests -- the serial
+    per-pool loop -- because arming it is a *throughput* choice; decisions
+    are bit-identical either way, but only when the cycle's pools are
+    certified independent (scheduler/algo.py falls back to the serial
+    order per-cycle whenever they are not: shared queued candidates,
+    armed rate limiters, market pools).  Read per call so tests flip it
+    with monkeypatch (the ARMADA_PIPELINE discipline)."""
+    return os.environ.get("ARMADA_POOL_PARALLEL", "0") not in ("0", "")
+
+
 def prefetch_worthwhile() -> bool:
     """Whether the slab content prefetch pays for itself.
 

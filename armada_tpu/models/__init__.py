@@ -7,6 +7,8 @@ reference's PreemptingQueueScheduler -> QueueScheduler -> GangScheduler -> NodeD
 pipeline (internal/scheduler/scheduling/*.go).
 """
 
+import dataclasses as _dataclasses
+
 from armada_tpu.models.problem import (
     begin_decode,
     SchedulingProblem,
@@ -50,6 +52,195 @@ class _ShadowOnce:
                 fn()
 
 
+def _xla_error_type():
+    try:
+        from jax.errors import JaxRuntimeError as _XlaError
+    except ImportError:  # older jax: the jaxlib name
+        from jaxlib.xla_extension import XlaRuntimeError as _XlaError
+    return _XlaError
+
+
+def _ladder_errors() -> tuple:
+    """The DELIBERATELY NARROW error classes that walk the failover ladder:
+    RoundTimeout = tunnel wedge (thread abandoned); XlaRuntimeError = the
+    backend died under us; FaultInjected = a drill; RoundVerificationError
+    = the round-output certification caught a silently-wrong answer
+    (models/verify.py).  A generic RuntimeError out of decode/rollback is a
+    host code bug -- degrading on it would hide the bug behind a
+    spuriously-working CPU re-run (and drop every device cache for
+    nothing), so it propagates untouched."""
+    from armada_tpu.core import faults
+    from armada_tpu.core.watchdog import RoundTimeout
+    from armada_tpu.models.verify import RoundVerificationError
+
+    return (
+        RoundTimeout, _xla_error_type(), faults.FaultInjected,
+        RoundVerificationError,
+    )
+
+
+def _round_env(problem, ctx, config, shadow_work, explain_enabled):
+    """The per-round prologue shared by run_round_on_device and the
+    phase-split pool-parallel dispatchers: resolved kernel statics, the
+    run-once shadow cursor, mesh/supervisor singletons, and the ONE explain
+    cadence tick this scheduling round gets (the failover / mesh-degrade
+    ladder re-enters the round body for the SAME round, and the committed
+    re-run must keep the attribution the device attempt was armed for.
+    Away rounds pass explain_enabled=False and never TICK: their
+    outcome.explain is discarded by the away apply, and a tick here would
+    halve/drift the host pool's advertised cadence)."""
+    from armada_tpu.core.watchdog import supervisor
+    from armada_tpu.parallel.serving import mesh_serving
+
+    kernel_kwargs = dict(
+        num_levels=len(ctx.ladder) + 2,
+        max_slots=ctx.max_slots,
+        slot_width=ctx.slot_width,
+        # Static flag (not a tensor): the default compile carries none of the
+        # alternate-ordering work.  Market pools keep bid ordering.
+        prefer_large=bool(
+            config.enable_prefer_large_job_ordering
+            and not bool(problem.market)
+        ),
+    )
+    if bool(problem.market):
+        # Market rounds bypass multi-commit DYNAMICALLY inside the body
+        # (bid order + spot crossing are order-dependent), but an armed
+        # ARMADA_COMMIT_K would still compile and pay the K-body's
+        # certification tables every trip with zero possible commits --
+        # force the single-commit compile for market pools, like
+        # prefer_large above (non-market pools keep the env resolution).
+        kernel_kwargs["commit_k"] = 1
+    shadow = _ShadowOnce(shadow_work)
+    explain_armed = False
+    if explain_enabled:
+        from armada_tpu.models import explain as _explain_mod
+
+        explain_armed = _explain_mod.explain_due(getattr(ctx, "pool", ""))
+    return kernel_kwargs, shadow, mesh_serving(), supervisor(), explain_armed
+
+
+def _build_device_problem(problem, device_problem, mesh_sv, sup):
+    """Resolve the device-resident problem for one round: the caller's
+    cached buffers (value or thunk), else a fresh upload -- sharded onto
+    the serving mesh for from-scratch rounds (legacy path, away rounds) so
+    every round the plane runs sees the same backend shape.  Incremental
+    rounds arrive pre-sharded via MeshDeviceDeltaCache.  While the
+    supervisor is degraded to CPU the mesh is out of the loop entirely
+    (the CPU rung sits BELOW the ladder)."""
+    import jax.numpy as jnp
+
+    dp = device_problem() if callable(device_problem) else device_problem
+    if dp is None:
+        mesh = (
+            mesh_sv.serving_mesh()
+            if mesh_sv.enabled() and not sup.degraded
+            else None
+        )
+        if mesh is not None:
+            from armada_tpu.parallel.mesh import shard_problem
+
+            dp = shard_problem(problem, mesh)
+        else:
+            dp = SchedulingProblem(*(jnp.asarray(a) for a in problem))
+    return dp
+
+
+def _failover_ladder(
+    e, *, problem, ctx, config, kernel_kwargs, shadow, explain_armed,
+    host_problem, mesh_sv, sup, deadline,
+):
+    """Mesh degrade ladder + CPU rung for a failed device attempt --
+    shared by the watchdog path (hang/XLA error/drill/verification), the
+    inline path (verification only: nothing hangs there, the round
+    completed with a WRONG answer), and the pool-parallel phase-split
+    finishers (a failed pool walks the ladder ALONE -- the other pools'
+    already-committed or still-in-flight rounds are untouched, which is
+    what bounds a verification failure's blast radius to one pool).
+    Verification failures additionally feed the per-device quarantine
+    score (scheduler/quarantine.py) -- N strikes stop the re-probe loops
+    from re-promoting the device until operator clear."""
+    from armada_tpu.core import faults
+    from armada_tpu.core.watchdog import RoundTimeout, run_with_deadline
+    from armada_tpu.models.verify import RoundVerificationError
+    from armada_tpu.ops.trace import recorder as _trace
+
+    _XlaError = _xla_error_type()
+    reason = f"{type(e).__name__}: {e}"
+    if isinstance(e, RoundVerificationError):
+        _quarantine_strike(mesh_sv, sup, reason)
+    try:
+        hp = host_problem() if callable(host_problem) else host_problem
+    except BaseException:
+        # The materialize thunk itself failed mid-failover: still
+        # record the DEVICE loss (degrade + reset hooks + re-probe) so
+        # subsequent cycles do not re-attempt the wedged backend at a
+        # full watchdog deadline each, then let the host error surface.
+        sup.record_failure(reason)
+        raise
+    if hp is None and hasattr(problem, "_fields"):
+        hp = problem
+    if hp is None:
+        sup.record_failure(reason)
+        raise e  # no host tables to fail over from (legacy caller)
+    # Mesh degrade ladder (parallel/serving.py) BEFORE the CPU rung:
+    # chip loss re-runs the SAME round on a halved mesh from host
+    # tables (the reset hooks just replaced every device cache, so the
+    # next cycle's apply is one full slab upload re-sharded onto the
+    # smaller mesh).  The supervisor never records a failure for a
+    # rung that recovers on-device -- the backend is still "device".
+    # While the supervisor is ALREADY degraded to CPU this round never
+    # ran on the mesh (_build_device_problem skipped it), so a failure
+    # here is a CPU-rung failure: walking the ladder would re-target
+    # the accelerator the supervisor marked down and misfile the loss.
+    while mesh_sv.enabled() and not sup.degraded:
+        smaller = mesh_sv.degrade(reason)
+        if smaller is None:
+            break
+        n = int(smaller.devices.size)
+        _trace().annotate(mesh_degraded=True, mesh_devices=n)
+        try:
+            fn = lambda m=smaller: _run_round_on_mesh(  # noqa: E731
+                hp, ctx, config, kernel_kwargs, shadow, m, explain_armed,
+            )
+            with _trace().span(
+                "mesh_degrade_rerun", devices=n, reason=reason[:300]
+            ):
+                # The inline (no-watchdog) path re-runs inline too: a
+                # verification failure proved the answer wrong, not
+                # the backend wedged, so no deadline thread exists.
+                out = (
+                    run_with_deadline(
+                        fn, deadline, what=f"mesh round ({n} devices)"
+                    )
+                    if deadline > 0
+                    else fn()
+                )
+            sup.record_success()
+            return out
+        except (
+            RoundTimeout, _XlaError, faults.FaultInjected,
+            RoundVerificationError,
+        ) as e2:
+            reason = f"{type(e2).__name__}: {e2}"
+            if isinstance(e2, RoundVerificationError):
+                _quarantine_strike(mesh_sv, sup, reason, mesh=smaller)
+            continue
+    # Failover attribution (ops/trace.py): tag the CYCLE that paid the
+    # failover window -- the same cycle the SLO layer's fallback-delta
+    # rule files as degraded -- and record the re-run as its own span.
+    sup.record_failure(reason)
+    _trace().annotate(degraded=True, failover_reason=reason[:300])
+    with _trace().span("cpu_failover", reason=reason[:300]):
+        # A verification failure ON THIS RUNG propagates out: decisions
+        # that disagree with the conservation invariants on the CPU
+        # backend mean the corruption is host-side or systemic --
+        # looping would commit to never answering.
+        return _run_round_cpu_failover(
+            hp, ctx, config, kernel_kwargs, shadow, explain_armed
+        )
+
+
 def run_round_on_device(
     problem, ctx, config, device_problem=None, shadow_work=(),
     host_problem=None, explain_enabled=True,
@@ -80,67 +271,13 @@ def run_round_on_device(
     discipline already guarantees no partial commit).  Defaults to
     `problem` when that is a real SchedulingProblem."""
     from armada_tpu.core import faults
-    from armada_tpu.core.watchdog import RoundTimeout, run_with_deadline, supervisor
-    from armada_tpu.parallel.serving import mesh_serving
+    from armada_tpu.core.watchdog import run_with_deadline
+    from armada_tpu.models.verify import RoundVerificationError
 
-    import jax.numpy as jnp
-
-    kernel_kwargs = dict(
-        num_levels=len(ctx.ladder) + 2,
-        max_slots=ctx.max_slots,
-        slot_width=ctx.slot_width,
-        # Static flag (not a tensor): the default compile carries none of the
-        # alternate-ordering work.  Market pools keep bid ordering.
-        prefer_large=bool(
-            config.enable_prefer_large_job_ordering
-            and not bool(problem.market)
-        ),
+    kernel_kwargs, shadow, mesh_sv, sup, explain_armed = _round_env(
+        problem, ctx, config, shadow_work, explain_enabled
     )
-    if bool(problem.market):
-        # Market rounds bypass multi-commit DYNAMICALLY inside the body
-        # (bid order + spot crossing are order-dependent), but an armed
-        # ARMADA_COMMIT_K would still compile and pay the K-body's
-        # certification tables every trip with zero possible commits --
-        # force the single-commit compile for market pools, like
-        # prefer_large above (non-market pools keep the env resolution).
-        kernel_kwargs["commit_k"] = 1
-    shadow = _ShadowOnce(shadow_work)
-    mesh_sv = mesh_serving()
-    # ONE cadence tick per scheduling round, decided here: the failover /
-    # mesh-degrade ladder re-enters _round_body for the SAME round, and the
-    # committed (degraded) re-run must keep the attribution the device
-    # attempt was armed for.  Away rounds pass explain_enabled=False and
-    # never TICK: their outcome.explain is discarded by the away apply, and
-    # a tick here would halve/drift the host pool's advertised cadence.
-    explain_armed = False
-    if explain_enabled:
-        from armada_tpu.models import explain as _explain_mod
 
-        explain_armed = _explain_mod.explain_due(getattr(ctx, "pool", ""))
-
-    def build_device_problem():
-        dp = device_problem() if callable(device_problem) else device_problem
-        if dp is None:
-            # Mesh serving plane (parallel/serving.py): from-scratch rounds
-            # (legacy path, away rounds) shard onto the current mesh too,
-            # so every round the plane runs sees the same backend shape.
-            # Incremental rounds arrive pre-sharded via MeshDeviceDeltaCache.
-            # While the supervisor is degraded to CPU the mesh is out of
-            # the loop entirely (the CPU rung sits BELOW the ladder).
-            mesh = (
-                mesh_sv.serving_mesh()
-                if mesh_sv.enabled() and not supervisor().degraded
-                else None
-            )
-            if mesh is not None:
-                from armada_tpu.parallel.mesh import shard_problem
-
-                dp = shard_problem(problem, mesh)
-            else:
-                dp = SchedulingProblem(*(jnp.asarray(a) for a in problem))
-        return dp
-
-    sup = supervisor()
     if sup.degraded:
         # Degraded steady state: rounds target the explicit CPU backend
         # (slab caches were reset and route uploads there via
@@ -154,102 +291,19 @@ def run_round_on_device(
 
         with jax.default_device(jax.devices("cpu")[0]):
             return _round_body(
-                build_device_problem(), ctx, config, kernel_kwargs, shadow,
-                explain_armed,
+                _build_device_problem(problem, device_problem, mesh_sv, sup),
+                ctx, config, kernel_kwargs, shadow, explain_armed,
             )
-
-    from armada_tpu.models.verify import RoundVerificationError
-
-    try:
-        from jax.errors import JaxRuntimeError as _XlaError
-    except ImportError:  # older jax: the jaxlib name
-        from jaxlib.xla_extension import XlaRuntimeError as _XlaError
 
     deadline = sup.deadline_s()
 
     def _failover(e):
-        """Mesh degrade ladder + CPU rung for a failed device attempt --
-        shared by the watchdog path (hang/XLA error/drill/verification)
-        and the inline path (verification only: nothing hangs there, the
-        round completed with a WRONG answer).  Verification failures
-        additionally feed the per-device quarantine score
-        (scheduler/quarantine.py) -- N strikes stop the re-probe loops
-        from re-promoting the device until operator clear."""
-        from armada_tpu.ops.trace import recorder as _trace
-
-        reason = f"{type(e).__name__}: {e}"
-        if isinstance(e, RoundVerificationError):
-            _quarantine_strike(mesh_sv, sup, reason)
-        try:
-            hp = host_problem() if callable(host_problem) else host_problem
-        except BaseException:
-            # The materialize thunk itself failed mid-failover: still
-            # record the DEVICE loss (degrade + reset hooks + re-probe) so
-            # subsequent cycles do not re-attempt the wedged backend at a
-            # full watchdog deadline each, then let the host error surface.
-            sup.record_failure(reason)
-            raise
-        if hp is None and hasattr(problem, "_fields"):
-            hp = problem
-        if hp is None:
-            sup.record_failure(reason)
-            raise e  # no host tables to fail over from (legacy caller)
-        # Mesh degrade ladder (parallel/serving.py) BEFORE the CPU rung:
-        # chip loss re-runs the SAME round on a halved mesh from host
-        # tables (the reset hooks just replaced every device cache, so the
-        # next cycle's apply is one full slab upload re-sharded onto the
-        # smaller mesh).  The supervisor never records a failure for a
-        # rung that recovers on-device -- the backend is still "device".
-        # While the supervisor is ALREADY degraded to CPU this round never
-        # ran on the mesh (build_device_problem skipped it), so a failure
-        # here is a CPU-rung failure: walking the ladder would re-target
-        # the accelerator the supervisor marked down and misfile the loss.
-        while mesh_sv.enabled() and not sup.degraded:
-            smaller = mesh_sv.degrade(reason)
-            if smaller is None:
-                break
-            n = int(smaller.devices.size)
-            _trace().annotate(mesh_degraded=True, mesh_devices=n)
-            try:
-                fn = lambda m=smaller: _run_round_on_mesh(  # noqa: E731
-                    hp, ctx, config, kernel_kwargs, shadow, m, explain_armed,
-                )
-                with _trace().span(
-                    "mesh_degrade_rerun", devices=n, reason=reason[:300]
-                ):
-                    # The inline (no-watchdog) path re-runs inline too: a
-                    # verification failure proved the answer wrong, not
-                    # the backend wedged, so no deadline thread exists.
-                    out = (
-                        run_with_deadline(
-                            fn, deadline, what=f"mesh round ({n} devices)"
-                        )
-                        if deadline > 0
-                        else fn()
-                    )
-                sup.record_success()
-                return out
-            except (
-                RoundTimeout, _XlaError, faults.FaultInjected,
-                RoundVerificationError,
-            ) as e2:
-                reason = f"{type(e2).__name__}: {e2}"
-                if isinstance(e2, RoundVerificationError):
-                    _quarantine_strike(mesh_sv, sup, reason, mesh=smaller)
-                continue
-        # Failover attribution (ops/trace.py): tag the CYCLE that paid the
-        # failover window -- the same cycle the SLO layer's fallback-delta
-        # rule files as degraded -- and record the re-run as its own span.
-        sup.record_failure(reason)
-        _trace().annotate(degraded=True, failover_reason=reason[:300])
-        with _trace().span("cpu_failover", reason=reason[:300]):
-            # A verification failure ON THIS RUNG propagates out: decisions
-            # that disagree with the conservation invariants on the CPU
-            # backend mean the corruption is host-side or systemic --
-            # looping would commit to never answering.
-            return _run_round_cpu_failover(
-                hp, ctx, config, kernel_kwargs, shadow, explain_armed
-            )
+        return _failover_ladder(
+            e, problem=problem, ctx=ctx, config=config,
+            kernel_kwargs=kernel_kwargs, shadow=shadow,
+            explain_armed=explain_armed, host_problem=host_problem,
+            mesh_sv=mesh_sv, sup=sup, deadline=deadline,
+        )
 
     if deadline <= 0:
         # Watchdog disabled (tests/bench default): the original inline
@@ -260,8 +314,8 @@ def run_round_on_device(
         faults.check("device_round")
         try:
             return _round_body(
-                build_device_problem(), ctx, config, kernel_kwargs, shadow,
-                explain_armed,
+                _build_device_problem(problem, device_problem, mesh_sv, sup),
+                ctx, config, kernel_kwargs, shadow, explain_armed,
             )
         except RoundVerificationError as e:
             return _failover(e)
@@ -269,8 +323,8 @@ def run_round_on_device(
     def _device_attempt():
         faults.check("device_round")
         return _round_body(
-            build_device_problem(), ctx, config, kernel_kwargs, shadow,
-            explain_armed,
+            _build_device_problem(problem, device_problem, mesh_sv, sup),
+            ctx, config, kernel_kwargs, shadow, explain_armed,
         )
 
     if mesh_sv.enabled() and mesh_sv.device_count():
@@ -281,18 +335,464 @@ def run_round_on_device(
         out = run_with_deadline(_device_attempt, deadline)
         sup.record_success()
         return out
-    except (
-        RoundTimeout, _XlaError, faults.FaultInjected, RoundVerificationError,
-    ) as e:
-        # RoundTimeout = tunnel wedge (thread abandoned); XlaRuntimeError =
-        # the backend died under us; FaultInjected = a drill;
-        # RoundVerificationError = the round-output certification caught a
-        # silently-wrong answer (models/verify.py).  Deliberately NARROW:
-        # a generic RuntimeError out of decode/rollback is a host code bug
-        # -- degrading on it would hide the bug behind a spuriously-working
-        # CPU re-run (and drop every device cache for nothing), so it
-        # propagates untouched.
+    except _ladder_errors() as e:
         return _failover(e)
+
+
+def dispatch_round_on_device(
+    problem, ctx, config, device_problem=None, shadow_work=(),
+    host_problem=None, explain_enabled=True,
+):
+    """Phase-split run_round_on_device (pool-parallel serving, round 17):
+    dispatch NOW -- devcache apply, kernel, compaction, verify/explain
+    enqueues, shadow thunks -- and return a zero-arg ``finish()`` ->
+    (result, outcome) that performs the blocking fetch, verification
+    verdict, decode and the gang-rollback loop LATER.  Between dispatch
+    and finish the caller may dispatch OTHER pools' rounds: the device
+    executes the kernels back to back while the transfers and host-side
+    assembles overlap, which is what turns a P-pool cycle's wall clock
+    from ~sum(pools) into ~max(pool) on the tunnel.
+
+    Error semantics match run_round_on_device exactly, scoped to THIS
+    round: a dispatch failure walks the failover ladder immediately (the
+    returned finish hands back the committed re-run); a finish failure
+    (timeout, XLA death, drill, RoundVerificationError) walks the ladder
+    at finish time -- other pools' rounds are untouched.  Decisions are
+    bit-identical to the serial path: the split only reorders asynchronous
+    enqueues that never read another round's output (the PR-2 dependency
+    discipline), pinned by tests/test_pool_parallel.py."""
+    env = _round_env(problem, ctx, config, shadow_work, explain_enabled)
+    return _dispatch_one(problem, ctx, config, device_problem, host_problem, env)
+
+
+def _dispatch_one(
+    problem, ctx, config, device_problem, host_problem, env,
+    on_dispatch_failover=None,
+):
+    """dispatch_round_on_device with a precomputed _round_env (the explain
+    cadence tick happens in _round_env -- exactly once per round, so paths
+    that may fall back between dispatch strategies resolve it first).
+    `on_dispatch_failover` fires when the DISPATCH phase walks the ladder
+    (the fallback count moves before any finish runs -- pool-parallel
+    degraded attribution needs the exact pool)."""
+    from armada_tpu.core import faults
+    from armada_tpu.core.watchdog import run_with_deadline
+    from armada_tpu.models.verify import RoundVerificationError
+
+    kernel_kwargs, shadow, mesh_sv, sup, explain_armed = env
+
+    def _failover(e, deadline):
+        return _failover_ladder(
+            e, problem=problem, ctx=ctx, config=config,
+            kernel_kwargs=kernel_kwargs, shadow=shadow,
+            explain_armed=explain_armed, host_problem=host_problem,
+            mesh_sv=mesh_sv, sup=sup, deadline=deadline,
+        )
+
+    if sup.degraded:
+        # CPU steady state: the "device" IS the host, there is nothing to
+        # overlap a dispatch against -- run the whole round inline now
+        # (same semantics as run_round_on_device's degraded branch) and
+        # hand back the completed answer.
+        import jax
+
+        with jax.default_device(jax.devices("cpu")[0]):
+            out = _round_body(
+                _build_device_problem(problem, device_problem, mesh_sv, sup),
+                ctx, config, kernel_kwargs, shadow, explain_armed,
+            )
+        return lambda: out
+
+    deadline = sup.deadline_s()
+
+    if deadline <= 0:
+        # Inline path (tests/bench default): dispatch errors propagate like
+        # run_round_on_device's inline branch; only a verification failure
+        # at finish walks the ladder.
+        faults.check("device_round")
+        handle = _dispatch_body(
+            _build_device_problem(problem, device_problem, mesh_sv, sup),
+            ctx, config, kernel_kwargs, shadow, explain_armed,
+        )
+
+        def finish_inline():
+            try:
+                return _finish_body(handle)
+            except RoundVerificationError as e:
+                return _failover(e, 0.0)
+
+        return finish_inline
+
+    def _dispatch_attempt():
+        faults.check("device_round")
+        return _dispatch_body(
+            _build_device_problem(problem, device_problem, mesh_sv, sup),
+            ctx, config, kernel_kwargs, shadow, explain_armed,
+        )
+
+    try:
+        handle = run_with_deadline(
+            _dispatch_attempt, deadline, what="round dispatch"
+        )
+    except _ladder_errors() as e:
+        if on_dispatch_failover is not None:
+            on_dispatch_failover()
+        out = _failover(e, deadline)
+        return lambda: out
+
+    def finish():
+        try:
+            out = run_with_deadline(
+                lambda: _finish_body(handle), deadline, what="round fetch"
+            )
+            sup.record_success()
+            return out
+        except _ladder_errors() as e:
+            return _failover(e, deadline)
+
+    return finish
+
+
+@_dataclasses.dataclass
+class PoolRoundSpec:
+    """One pool's round inputs for dispatch_pool_rounds -- the same five
+    arguments its run_round_on_device call would take."""
+
+    problem: object  # stats_view / SchedulingProblem (host side)
+    ctx: object  # HostContext
+    device_problem: object = None  # cached device buffers, or a thunk
+    host_problem: object = None  # CPU-failover ground truth (thunk ok)
+    shadow_work: tuple = ()
+    explain_enabled: bool = True
+
+
+def dispatch_pool_rounds(specs, config, allow_stacking=True):
+    """Dispatch MANY pools' rounds through the device before ANY fetch --
+    the pool-parallel cycle's device phase (scheduler/algo.py windows).
+
+    Returns ``(finishes, stacked_launches, stacked_pools,
+    dispatch_failed)``: ``finishes[i]()`` ->
+    (result, outcome) for specs[i], to be called IN POOL ORDER (the caller
+    decodes/applies serially, preserving the serial loop's cross-pool
+    apply order exactly).  Pools whose device problems match in EVERY
+    array shape/dtype (and compile statics) batch into ONE stacked kernel
+    launch with a leading pool axis (fair_scheduler.schedule_round_stacked
+    + begin_decode_stacked + verify.dispatch_verify_stacked: one launch,
+    one compact fetch, one verify fetch for the whole group) --
+    ``stacked_launches`` counts them.  Shape matching is exact because
+    compat/ban tables key on REAL content; `shape_bucket` quantization is
+    what makes matches common for small tenants.  ``dispatch_failed`` is
+    the set of spec indices whose DISPATCH already walked the failover
+    ladder (their finishes return the committed re-run) -- the caller's
+    per-pool degraded attribution needs it, because the fallback count
+    moved before any finish ran.
+
+    Stacking is skipped (pipelined dispatch only) when: the supervisor is
+    degraded (CPU inline), a serving mesh is armed (jnp.stack over
+    NamedSharded slabs would gather them -- the round-12 hazard; pipelined
+    dispatch composes with the mesh instead), or ARMADA_FAULT is set (the
+    round_corrupt drill lanes are solo-shaped).  A pool whose stacked
+    dispatch or finish fails walks the SAME per-pool failover ladder as
+    the solo path -- re-run solo from its own host tables, blast radius
+    one pool."""
+    import os as _os
+
+    from armada_tpu.core import faults
+    from armada_tpu.core.watchdog import run_with_deadline, supervisor
+    from armada_tpu.ops.trace import recorder as _trace
+    from armada_tpu.parallel.serving import mesh_serving
+
+    sup = supervisor()
+    mesh_sv = mesh_serving()
+    envs = [
+        _round_env(s.problem, s.ctx, config, s.shadow_work, s.explain_enabled)
+        for s in specs
+    ]
+    can_stack = (
+        allow_stacking
+        and len(specs) > 1
+        and not sup.degraded
+        and not mesh_sv.enabled()
+        and not _os.environ.get("ARMADA_FAULT")
+    )
+    finishes: list = [None] * len(specs)
+    dispatch_failed: set = set()
+    if not can_stack:
+        for i, s in enumerate(specs):
+            finishes[i] = _dispatch_one(
+                s.problem, s.ctx, config, s.device_problem, s.host_problem,
+                envs[i],
+                on_dispatch_failover=lambda i=i: dispatch_failed.add(i),
+            )
+        return finishes, 0, 0, dispatch_failed
+
+    deadline = sup.deadline_s()
+    errors = _ladder_errors()
+
+    def _fail(i, e):
+        s = specs[i]
+        kk, shadow, _, _, explain_armed = envs[i]
+        out = _failover_ladder(
+            e, problem=s.problem, ctx=s.ctx, config=config, kernel_kwargs=kk,
+            shadow=shadow, explain_armed=explain_armed,
+            host_problem=s.host_problem, mesh_sv=mesh_sv, sup=sup,
+            deadline=deadline,
+        )
+        return lambda: out
+
+    # Phase 1: build every pool's device problem (the O(delta) devcache
+    # scatters), each under its own deadline/blast radius.
+    dps: list = [None] * len(specs)
+    for i, s in enumerate(specs):
+
+        def _build(s=s, env=envs[i]):
+            faults.check("device_round")
+            return _build_device_problem(s.problem, s.device_problem, env[2], env[3])
+
+        if deadline <= 0:
+            # inline discipline (run_round_on_device's no-watchdog branch):
+            # build/dispatch errors propagate -- laddering a host/XLA bug
+            # here would mask it behind a spuriously-working CPU re-run
+            dps[i] = _build()
+            continue
+        try:
+            dps[i] = run_with_deadline(
+                _build, deadline, what="pool round dispatch"
+            )
+        except errors as e:
+            finishes[i] = _fail(i, e)
+            dispatch_failed.add(i)
+
+    # Phase 2: group by (compile statics, exact array shapes/dtypes);
+    # insertion order keeps groups in first-member pool order.
+    groups: dict = {}
+    for i in range(len(specs)):
+        if finishes[i] is not None:
+            continue
+        kk = envs[i][0]
+        # shape + dtype OBJECTS (hashable) -- stringifying 30+ dtypes per
+        # pool per cycle measurably taxed the steady cycle
+        key = (
+            tuple(sorted(kk.items())),
+            tuple((a.shape, a.dtype) for a in dps[i]),
+        )
+        groups.setdefault(key, []).append(i)
+
+    stacked_launches = 0
+    stacked_pools = 0
+    for _key, idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            finishes[i] = _dispatch_one(
+                specs[i].problem, specs[i].ctx, config, dps[i],
+                specs[i].host_problem, envs[i],
+                on_dispatch_failover=lambda i=i: dispatch_failed.add(i),
+            )
+            continue
+        if deadline <= 0:
+            # inline discipline: stacked dispatch errors propagate too
+            group_finishes = _dispatch_stacked_group(
+                idxs, specs, envs, dps, config, deadline, mesh_sv, sup, _key
+            )
+        else:
+            try:
+                group_finishes = run_with_deadline(
+                    lambda idxs=idxs, key=_key: _dispatch_stacked_group(
+                        idxs, specs, envs, dps, config, deadline, mesh_sv,
+                        sup, key,
+                    ),
+                    deadline,
+                    what="stacked pool dispatch",
+                )
+            except errors as e:
+                for i in idxs:
+                    finishes[i] = _fail(i, e)
+                    dispatch_failed.add(i)
+                continue
+        stacked_launches += 1
+        stacked_pools += len(idxs)
+        for i, fin in zip(idxs, group_finishes):
+            finishes[i] = fin
+    if stacked_launches:
+        _trace().annotate(pools_stacked_launches=stacked_launches)
+    return finishes, stacked_launches, stacked_pools, dispatch_failed
+
+
+_STACK_PROBLEMS = None
+# (group key) -> (per-pool dp tuples, stacked problem).  Steady-state
+# cycles present the SAME device problem objects every cycle (the
+# devcache's no-op apply keeps _prev untouched), so the stack copy can be
+# reused by identity.  Entries hold strong refs, which is what makes the
+# identity check ABA-safe (a cached object cannot be freed and its id
+# reused while the entry lives); staleness is bounded by the size cap and
+# the watchdog reset hook (device loss must drop buffers pinned on a dead
+# backend).
+_STACK_CACHE: dict = {}
+_STACK_CACHE_CAP = 8
+_STACK_HOOKED = False
+
+
+def _stack_problems(key, dps):
+    """Stack P device problems along a new leading pool axis as ONE jitted
+    program -- the eager form was one XLA dispatch per field (~0.45ms each
+    on CPU x 30+ fields = the stacking win, erased) -- memoized by operand
+    IDENTITY so mostly-idle steady cycles skip even that.  Device-side
+    copies, never a tunnel transfer."""
+    global _STACK_PROBLEMS, _STACK_HOOKED
+    if not _STACK_HOOKED:
+        from armada_tpu.core.watchdog import add_reset_hook
+
+        add_reset_hook(_STACK_CACHE.clear)
+        _STACK_HOOKED = True
+    dps = tuple(dps)
+    hit = _STACK_CACHE.get(key)
+    if hit is not None and len(hit[0]) == len(dps) and all(
+        a is b for a, b in zip(hit[0], dps)
+    ):
+        return hit[1]
+    if _STACK_PROBLEMS is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _stack(*trees):
+            return jax.tree_util.tree_map(
+                lambda *lanes: jnp.stack(lanes), *trees
+            )
+
+        _STACK_PROBLEMS = _stack
+    stacked = _STACK_PROBLEMS(*dps)
+    if len(_STACK_CACHE) >= _STACK_CACHE_CAP:
+        _STACK_CACHE.clear()
+    _STACK_CACHE[key] = (dps, stacked)
+    return stacked
+
+
+def _dispatch_stacked_group(
+    idxs, specs, envs, dps, config, deadline, mesh_sv, sup, group_key=None
+):
+    """ONE stacked launch for a shape-matched pool group: stack the
+    device-resident problems along a leading pool axis (device-side
+    copies, no tunnel transfer), run the vmapped round, dispatch the
+    stacked compaction + verification, and hand back per-pool finish
+    callables that share the two fetched buffers."""
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from armada_tpu.core.watchdog import run_with_deadline
+    from armada_tpu.models import verify as _verify
+    from armada_tpu.models.fair_scheduler import schedule_round_stacked
+    from armada_tpu.models.problem import begin_decode_stacked
+    from armada_tpu.ops.trace import recorder as _trace
+
+    trace = _trace()
+    kk = envs[idxs[0]][0]
+    ctxs = [specs[i].ctx for i in idxs]
+    stacked = _stack_problems(group_key, [dps[i] for i in idxs])
+    with trace.span("kernel_dispatch", stacked=len(idxs)):
+        result = schedule_round_stacked(stacked, **kk)
+    verify_armed = _verify.verify_enabled()
+    with trace.span("decode_dispatch", stacked=len(idxs)):
+        fins = begin_decode_stacked(result, ctxs)
+    if fins is None:
+        # No device result to stack-decode (host-array backend): solo
+        # dispatch per lane -- correctness over amortization.
+        return [
+            _dispatch_one(
+                specs[i].problem, specs[i].ctx, config,
+                SchedulingProblem(*(a[j] for a in stacked)),
+                specs[i].host_problem, envs[i],
+            )
+            for j, i in enumerate(idxs)
+        ]
+    ver_buf = None
+    if verify_armed:
+        with trace.span("verify_dispatch", stacked=len(idxs)):
+            ver_buf = _verify.dispatch_verify_stacked(
+                stacked, result, fins[0].dispatched[0], ctxs
+            )
+    vbox: dict = {}
+
+    def ver_rows() -> _np.ndarray:
+        if "v" not in vbox:
+            arr = _np.asarray(ver_buf)
+            from armada_tpu.models.xfer import TRANSFER_STATS
+
+            TRANSFER_STATS.count_down(arr.nbytes)
+            vbox["v"] = arr
+        return vbox["v"]
+
+    from armada_tpu.models.problem import lane_slice
+
+    out = []
+    for j, i in enumerate(idxs):
+        s = specs[i]
+        ctx = s.ctx
+        pool = getattr(ctx, "pool", "")
+        explain_armed = envs[i][4]
+        fin = fins[j]
+        # Lane views resolve LAZILY through one jitted slice program
+        # (problem.lane_slice): eager per-field slices cost ~0.6ms of XLA
+        # dispatch each on CPU, and most rounds never touch the lanes
+        # (decode rides the compact tuple; dp lanes only serve the
+        # rollback / verify-rerun / explain paths).
+        lane_result = lambda j=j: lane_slice(result, j)  # noqa: E731
+        ver_check = None
+        if ver_buf is not None:
+
+            def ver_check(j=j, ctx=ctx, pool=pool, fin=fin):
+                fin.fetch()  # this pool's compact row (one shared transfer)
+                with _trace().span("verify_fetch", stacked=True):
+                    _verify.verdict_of(ver_rows()[j], ctx, pool=pool)
+
+        exp_dispatched = None
+        if explain_armed:
+            from armada_tpu.models import explain as _explain
+
+            with trace.span("explain_dispatch", pool=pool):
+                exp_dispatched = _explain.dispatch_explain(
+                    lane_slice(stacked, j), lane_result(), ctx,
+                )
+        handle = _RoundHandle(
+            (lambda j=j: lane_slice(stacked, j)),
+            ctx, config, kk, lane_result, fin, ver_check, exp_dispatched,
+            explain_armed, verify_armed, pool,
+        )
+        envs[i][1].run_pending()  # this spec's shadow thunks ride the stack
+
+        def finish(handle=handle, i=i, s=s):
+            from armada_tpu.models.verify import RoundVerificationError
+
+            def ladder(e):
+                kk_i, shadow_i, _, _, explain_i = envs[i]
+                return _failover_ladder(
+                    e, problem=s.problem, ctx=s.ctx, config=config,
+                    kernel_kwargs=kk_i, shadow=shadow_i,
+                    explain_armed=explain_i, host_problem=s.host_problem,
+                    mesh_sv=mesh_sv, sup=sup, deadline=deadline,
+                )
+
+            if deadline <= 0:
+                # inline discipline (run_round_on_device's no-watchdog
+                # branch): only a verification failure walks the ladder --
+                # a host/XLA error out of the fetch is a code bug and
+                # propagates untouched
+                try:
+                    return _finish_body(handle)
+                except RoundVerificationError as e:
+                    return ladder(e)
+            try:
+                res = run_with_deadline(
+                    lambda: _finish_body(handle), deadline,
+                    what="stacked round fetch",
+                )
+                sup.record_success()
+                return res
+            except _ladder_errors() as e:
+                return ladder(e)
+
+        out.append(finish)
+    return out
 
 
 def _quarantine_strike(mesh_sv, sup, reason: str, mesh=None) -> None:
@@ -354,15 +854,53 @@ def _run_round_cpu_failover(
         )
 
 
-def _round_body(
-    device_problem, ctx, config, kernel_kwargs, shadow, explain_armed=False
-):
-    """One complete round against already-device-resident tensors: kernel,
-    overlapped decode + shadow work, the gang-txn rollback loop, and (on
-    its cadence) the explain pass."""
-    import jax.numpy as jnp
-    import numpy as _np
+class _RoundHandle:
+    """Everything a dispatched round's finish phase needs -- the seam the
+    pool-parallel cycle splits run_round_on_device at.  `device_problem`
+    may be a thunk (stacked lanes slice lazily: the rollback / partial-gang
+    paths are the only consumers, and most rounds never take them)."""
 
+    __slots__ = (
+        "device_problem", "ctx", "config", "kernel_kwargs", "result",
+        "finish", "ver_check", "exp_dispatched", "explain_armed",
+        "verify_armed", "pool", "_dp",
+    )
+
+    def __init__(
+        self, device_problem, ctx, config, kernel_kwargs, result, finish,
+        ver_check, exp_dispatched, explain_armed, verify_armed, pool,
+    ):
+        self.device_problem = device_problem
+        self.ctx = ctx
+        self.config = config
+        self.kernel_kwargs = kernel_kwargs
+        self.result = result
+        self.finish = finish
+        self.ver_check = ver_check
+        self.exp_dispatched = exp_dispatched
+        self.explain_armed = explain_armed
+        self.verify_armed = verify_armed
+        self.pool = pool
+        self._dp = None
+
+    def dp(self):
+        if self._dp is None:
+            self._dp = (
+                self.device_problem()
+                if callable(self.device_problem)
+                else self.device_problem
+            )
+        return self._dp
+
+
+def _dispatch_body(
+    device_problem, ctx, config, kernel_kwargs, shadow, explain_armed=False
+) -> _RoundHandle:
+    """The round's DISPATCH half: kernel + compaction + verify/explain
+    enqueues and the shadow thunks -- everything asynchronous.  Nothing
+    here blocks on the device; the blocking waits live in _finish_body,
+    which is what lets the pool-parallel cycle fire every pool's dispatch
+    before any pool's fetch."""
     from armada_tpu.models import explain as _explain
     from armada_tpu.models import verify as _verify
     from armada_tpu.ops.trace import recorder as _trace
@@ -390,12 +928,19 @@ def _round_body(
     # the host decode, so a corrupted round never reaches decode's loops
     # (RoundVerificationError -> run_round_on_device's failover ladder).
     # ONE extra transfer per verified round.
-    ver_dispatched = None
+    ver_check = None
     if verify_armed:
         with trace.span("verify_dispatch"):
             ver_dispatched = _verify.dispatch_verify(
                 device_problem, result, finish.dispatched, ctx
             )
+        if ver_dispatched is not None:
+
+            def ver_check():
+                finish.fetch()  # blocking compact fetch (stashes raw bytes)
+                with _trace().span("verify_fetch"):
+                    _verify.finish_verify(ver_dispatched, ctx, pool=pool)
+
     # Explain pass (models/explain.py): dispatched BEHIND the decode
     # compaction so its device compute and device->host copy ride the
     # decode shadow; the blocking fetch happens after the outcome, off the
@@ -408,14 +953,38 @@ def _round_body(
             )
     with trace.span("shadow"):
         shadow.run_pending()
+    return _RoundHandle(
+        device_problem, ctx, config, kernel_kwargs, result, finish,
+        ver_check, exp_dispatched, explain_armed, verify_armed, pool,
+    )
+
+
+def _finish_body(h: _RoundHandle):
+    """The round's FETCH half: the blocking verify/compact waits, decode,
+    the gang-txn rollback loop, and (on its cadence) the explain fetch."""
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from armada_tpu.models import explain as _explain
+    from armada_tpu.models import verify as _verify
+    from armada_tpu.ops.trace import recorder as _trace
+
+    trace = _trace()
+    ctx, config, kernel_kwargs = h.ctx, h.config, h.kernel_kwargs
+    pool = h.pool
+    # Stacked lanes hand the result as a THUNK (one jitted lane slice);
+    # it stays unresolved unless the rollback loop replaces it or a
+    # consumer needs arrays -- steady rounds with collect_stats off never
+    # pay the slice.  Callers that read the returned result resolve it
+    # with callable() (collect_round_stats' contract).
+    result = h.result
+    exp_dispatched = h.exp_dispatched
     # The fetch span is where kernel + transfer latency surfaces: the
     # dispatch spans above are async enqueues, this is the blocking wait.
     with trace.span("fetch_decode"):
-        if ver_dispatched is not None:
-            finish.fetch()  # blocking compact fetch (stashes the raw bytes)
-            with trace.span("verify_fetch"):
-                _verify.finish_verify(ver_dispatched, ctx, pool=pool)
-        outcome = finish()
+        if h.ver_check is not None:
+            h.ver_check()
+        outcome = h.finish()
     # Iteration-count legibility (ARMADA_COMMIT_K): the round span carries
     # the physical trip count next to the logical one, so a multi-commit
     # regression (certification truncating to 1) is visible in any trace
@@ -468,17 +1037,19 @@ def _round_body(
         # exactly like the reference's failed unit (pinned members that lost
         # their node doom the unit).  Golden trace: "Preempted Gang Job"
         # (testdata/golden/, ref simulator_test.go).
-        kill.extend(_partial_running_gangs(ctx, device_problem, outcome))
+        kill.extend(_partial_running_gangs(ctx, h.dp, outcome))
         if not kill:
             break
         attempts += 1
         with trace.span("gang_rerun", attempt=attempts, killed=len(set(kill))):
+            device_problem = h.dp()
             g_valid = _np.asarray(device_problem.g_valid).copy()
             g_valid[_np.asarray(sorted(set(kill)), _np.int64)] = False
             device_problem = device_problem._replace(g_valid=jnp.asarray(g_valid))
+            h._dp = device_problem
             result = schedule_round(device_problem, **kernel_kwargs)
             fin = begin_decode(result, ctx)
-            if verify_armed:
+            if h.verify_armed:
                 # Every attempt's state is verified between its fetch and
                 # its decode -- a corrupted re-run must not steer the
                 # rollback loop (or crash its decode) any more than the
@@ -491,12 +1062,14 @@ def _round_body(
                     with trace.span("verify_fetch"):
                         _verify.finish_verify(vd, ctx, pool=pool)
             outcome = fin()
-    if attempts and explain_armed:
+    if attempts and h.explain_armed:
         # Attribution must describe the FINAL (post-rollback) round, so the
         # shadow-dispatched buffer is stale -- re-dispatch ONCE here rather
         # than per re-run attempt (each abandoned dispatch would still pay
         # its O(KxN) pass + async copy on the tunnel).
-        exp_dispatched = _explain.dispatch_explain(device_problem, result, ctx)
+        if callable(result):
+            result = result()
+        exp_dispatched = _explain.dispatch_explain(h.dp(), result, ctx)
     if attempts >= 4:
         # Attempt-cap backstop: never report a half-preempted running gang.
         # Force the retained members into the preempted set -- their freed
@@ -510,6 +1083,20 @@ def _round_body(
             )
     outcome.pool_totals = ctx.pool_total_atoms
     return result, outcome
+
+
+def _round_body(
+    device_problem, ctx, config, kernel_kwargs, shadow, explain_armed=False
+):
+    """One complete round against already-device-resident tensors: kernel,
+    overlapped decode + shadow work, the gang-txn rollback loop, and (on
+    its cadence) the explain pass -- dispatch and finish back to back (the
+    serial path; the pool-parallel cycle interleaves the halves)."""
+    return _finish_body(
+        _dispatch_body(
+            device_problem, ctx, config, kernel_kwargs, shadow, explain_armed
+        )
+    )
 
 
 def _iter_partial_gangs(ctx, outcome):
@@ -540,15 +1127,17 @@ def _iter_partial_gangs(ctx, outcome):
             yield ris, retained
 
 
-def _partial_running_gangs(ctx, device_problem, outcome) -> list:
-    """Evictee-slot gang indices to invalidate for the cascade re-run."""
+def _partial_running_gangs(ctx, dp_thunk, outcome) -> list:
+    """Evictee-slot gang indices to invalidate for the cascade re-run.
+    `dp_thunk` resolves the device problem lazily -- stacked lanes slice on
+    demand, and most rounds preempt nothing, so the slice never happens."""
     import numpy as _np
 
     run_gang = None
     kill: list = []
     for ris, _retained in _iter_partial_gangs(ctx, outcome):
         if run_gang is None:
-            run_gang = _np.asarray(device_problem.run_gang)
+            run_gang = _np.asarray(dp_thunk().run_gang)
         for ri in ris:
             gi = int(run_gang[ri])
             if gi >= 0:
@@ -567,7 +1156,11 @@ def _force_preempt_partials(ctx, outcome) -> None:
 def collect_round_stats(result, problem, ctx, config, outcome) -> None:
     """Attach per-queue share stats (and indicative shares) to the outcome --
     an extra device->host transfer + host-side DRF recompute, so callers skip
-    it when neither metrics nor reports consume it."""
+    it when neither metrics nor reports consume it.  `result` may be a
+    zero-arg thunk (a stacked round's lazy lane slice): resolved here, the
+    one consumer that actually reads the arrays."""
+    if callable(result):
+        result = result()
     from armada_tpu.models.problem import queue_stats_from_result
 
     outcome.queue_stats = queue_stats_from_result(result, problem, ctx)
@@ -627,6 +1220,9 @@ def run_scheduling_round(
 __all__ = [
     "run_scheduling_round",
     "run_round_on_device",
+    "dispatch_round_on_device",
+    "dispatch_pool_rounds",
+    "PoolRoundSpec",
     "collect_round_stats",
     "SchedulingProblem",
     "HostContext",

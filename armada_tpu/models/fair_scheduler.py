@@ -1556,9 +1556,99 @@ def schedule_round(
     compiles the single-commit body -- the A/B and escape hatch.
     """
     G = p.g_req.shape[0]
-    N, R = p.node_total.shape
     Q = p.q_weight.shape[0]
-    C = p.pc_queue_cap.shape[0]
+    statics = _resolve_round_statics(
+        compat_rows=p.compat.shape[0],
+        G=G,
+        Q=Q,
+        max_iterations=max_iterations,
+        prefer_large=prefer_large,
+        cache_slots=cache_slots,
+        unroll=unroll,
+        batch_k=batch_k,
+        commit_k=commit_k,
+    )
+    return _schedule_round_jit(
+        p,
+        num_levels=num_levels,
+        max_slots=max_slots,
+        slot_width=slot_width,
+        **statics,
+    )
+
+
+def schedule_round_stacked(
+    p: SchedulingProblem,
+    *,
+    num_levels: int,
+    max_slots: int,
+    slot_width: int,
+    max_iterations: int = 0,
+    prefer_large: bool = False,
+    cache_slots: int = -1,
+    unroll: int = -1,
+    batch_k: int = -1,
+    commit_k: int = -1,
+) -> RoundResult:
+    """Run P independent pools' rounds as ONE kernel launch (round 17).
+
+    `p` is a SchedulingProblem whose every field carries a leading pool
+    axis: lane i is pool i's padded problem, all lanes bucket-identical in
+    shape (the caller groups pools by exact array shapes -- compat/ban
+    tables key on REAL content, so sig equality is not enough).  The body
+    is ``jax.vmap`` over the solo jit: while_loop batching runs lanes in
+    lockstep until every lane's cond clears, masking finished lanes'
+    carries with select, so each lane's decisions are bit-identical to a
+    solo ``schedule_round`` on its slice -- pinned by
+    tests/test_pool_parallel.py against the serial loop.  The win is
+    dispatch-count economics: P small pools cost ONE launch whose trip
+    count is max(lane trips), not sum -- the multi-tenant analog of the
+    commit_k trip-count work (and, over the axon tunnel, one upload + one
+    compact fetch amortize the ~0.1s/transfer latency across the stack).
+
+    Statics resolve exactly like schedule_round (shared helper), from the
+    per-lane shapes -- a stacked compile keys on the same resolved values
+    a solo lane would.
+    """
+    P = p.g_req.shape[0]
+    assert P >= 1 and p.q_weight.ndim == 2, "expected a [P, ...] stacked problem"
+    G = p.g_req.shape[1]
+    Q = p.q_weight.shape[1]
+    statics = _resolve_round_statics(
+        compat_rows=p.compat.shape[1],
+        G=G,
+        Q=Q,
+        max_iterations=max_iterations,
+        prefer_large=prefer_large,
+        cache_slots=cache_slots,
+        unroll=unroll,
+        batch_k=batch_k,
+        commit_k=commit_k,
+    )
+    return _schedule_round_stacked_jit(
+        p,
+        num_levels=num_levels,
+        max_slots=max_slots,
+        slot_width=slot_width,
+        **statics,
+    )
+
+
+def _resolve_round_statics(
+    *,
+    compat_rows: int,
+    G: int,
+    Q: int,
+    max_iterations: int,
+    prefer_large: bool,
+    cache_slots: int,
+    unroll: int,
+    batch_k: int,
+    commit_k: int,
+) -> dict:
+    """Resolve the platform/env-derived compile statics OUTSIDE the jit
+    boundary -- shared by schedule_round and schedule_round_stacked so a
+    stacked lane compiles the exact body its solo twin would."""
     if cache_slots < 0:
         # The per-key fit caches exist to dodge XLA:CPU's scalar-loop argmin
         # ([N] argmin at 51k nodes is ~190us there); a real TPU has a vector
@@ -1573,10 +1663,10 @@ def schedule_round(
         # compile: cache 0 + batch 8).
         env = _os.environ.get("ARMADA_CACHE_SLOTS")
         if env is not None:
-            cache_slots = min(int(env), p.compat.shape[0])
+            cache_slots = min(int(env), compat_rows)
         else:
             cache_slots = (
-                min(64, p.compat.shape[0])
+                min(64, compat_rows)
                 if jax.default_backend() == "cpu"
                 else 0
             )
@@ -1620,11 +1710,7 @@ def schedule_round(
         # every iteration either decides a gang (<= G), advances a cursor
         # (<= G total across the round), or is the final no-op
         max_iterations = 2 * G + Q + 8
-    return _schedule_round_jit(
-        p,
-        num_levels=num_levels,
-        max_slots=max_slots,
-        slot_width=slot_width,
+    return dict(
         max_iterations=max_iterations,
         prefer_large=prefer_large,
         cache_slots=cache_slots,
@@ -1632,6 +1718,45 @@ def schedule_round(
         batch_k=batch_k,
         commit_k=commit_k,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_levels", "max_slots", "slot_width", "max_iterations", "prefer_large",
+        "cache_slots", "unroll", "batch_k", "commit_k",
+    ),
+)
+def _schedule_round_stacked_jit(
+    p: SchedulingProblem,
+    *,
+    num_levels: int,
+    max_slots: int,
+    slot_width: int,
+    max_iterations: int,
+    prefer_large: bool,
+    cache_slots: int,
+    unroll: int,
+    batch_k: int,
+    commit_k: int,
+) -> RoundResult:
+    """vmap of the solo round over the leading pool axis: one XLA program,
+    P lockstep lanes.  The inner call is the already-jitted solo entry --
+    under trace it inlines, so both compiles share cached lowering work."""
+    return jax.vmap(
+        lambda lane: _schedule_round_jit(
+            lane,
+            num_levels=num_levels,
+            max_slots=max_slots,
+            slot_width=slot_width,
+            max_iterations=max_iterations,
+            prefer_large=prefer_large,
+            cache_slots=cache_slots,
+            unroll=unroll,
+            batch_k=batch_k,
+            commit_k=commit_k,
+        )
+    )(p)
 
 
 def resolve_commit_k() -> int:

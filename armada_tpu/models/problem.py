@@ -1349,8 +1349,6 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
     overflowed (fall back to the full-array pull) or the result is not a
     device RoundResult.
     """
-    from armada_tpu.models.fair_scheduler import _COMPACT_HEADER
-
     d = dispatched if dispatched is not None else _dispatch_compact(result, ctx)
     ctx.last_compact_np = None
     if d is None:
@@ -1371,6 +1369,18 @@ def _fetch_compact(result, ctx: HostContext, dispatched=None):
         if _faults.active("round_corrupt", modes=("bytes",)):
             buf = buf.copy()
             buf[min(3, buf.size - 1)] ^= np.int32(1 << 20)
+    return _parse_compact(buf, ctx, fcap, ecap)
+
+
+def _parse_compact(buf: np.ndarray, ctx: HostContext, fcap: int, ecap: int):
+    """Decode-input tuple from an already-fetched compact buffer (one pool's
+    row).  Shared by the solo fetch above and the stacked fetch
+    (begin_decode_stacked), which pulls ALL pools' rows in one transfer and
+    parses each at its pool's decode turn.  Stashes the exact bytes on the
+    ctx (HostContext.last_compact_np) for the verification fingerprint
+    cross-check (models/verify.py)."""
+    from armada_tpu.models.fair_scheduler import _COMPACT_HEADER
+
     ctx.last_compact_np = buf
     (
         n_slots, iterations, termination, _sched_count, spot_bits, n_failed,
@@ -1443,6 +1453,123 @@ def begin_decode(result, ctx: HostContext):
     finish.dispatched = dispatched
     finish.fetch = fetch
     return finish
+
+
+_COMPACT_STACKED = None
+
+
+def _compact_stacked():
+    """jit(vmap(compact_result)) on first use -- the module stays importable
+    without a jax backend (the begin_decode discipline)."""
+    global _COMPACT_STACKED
+    if _COMPACT_STACKED is None:
+        import functools
+
+        import jax
+
+        from armada_tpu.models.fair_scheduler import compact_result
+
+        @functools.partial(jax.jit, static_argnames=("fcap", "ecap"))
+        def _stacked(result, gangs, runs, *, fcap, ecap):
+            return jax.vmap(
+                lambda r, g, n: compact_result(r, g, n, fcap=fcap, ecap=ecap)
+            )(result, gangs, runs)
+
+        _COMPACT_STACKED = _stacked
+    return _COMPACT_STACKED
+
+
+def begin_decode_stacked(result, ctxs: list):
+    """begin_decode for a STACKED round (pool-parallel serving, round 17):
+    `result` is a RoundResult whose every field carries a leading pool axis
+    (fair_scheduler.schedule_round_stacked); `ctxs[i]` is pool i's
+    HostContext.  ONE vmapped compaction and ONE [P, L] device->host
+    transfer replace P separate compact fetches -- on the axon tunnel each
+    transfer pays ~0.1s fixed latency, so the stack amortizes the decode
+    leg the way the stacked launch amortizes the kernel leg.
+
+    Returns a list of per-pool finish callables with begin_decode's API
+    (``finish()`` -> RoundOutcome, ``finish.fetch()`` = the blocking fetch
+    of THIS pool's row -- first caller pays the one shared transfer --
+    ``finish.dispatched`` = the shared (buffer, fcap, ecap) handle), or
+    None when the result is not a device RoundResult (the caller falls
+    back to per-pool begin_decode on sliced lanes).  The stacked path
+    never runs under a serving mesh (pool-parallel stacking is
+    single-device; parallel/serving.py), so the GSPMD reduction gate in
+    _dispatch_compact does not arise here.
+    """
+    import jax
+
+    if not isinstance(result.g_state, jax.Array):
+        return None
+    G = int(result.g_state.shape[1])
+    RJ = int(result.run_evicted.shape[1])
+    fcap = min(G, _COMPACT_FCAP)
+    ecap = min(RJ, _COMPACT_ECAP) if RJ else 0
+    gangs = np.asarray([c.num_real_gangs for c in ctxs], np.int32)
+    runs = np.asarray([c.num_real_runs for c in ctxs], np.int32)
+    buf = _compact_stacked()(result, gangs, runs, fcap=fcap, ecap=ecap)
+    try:
+        buf.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass  # backend without async copies: the fetch blocks normally
+
+    box: dict = {}
+
+    def fetch_all() -> np.ndarray:
+        if "all" not in box:
+            arr = np.asarray(buf)
+            from armada_tpu.models.xfer import TRANSFER_STATS
+
+            TRANSFER_STATS.count_down(arr.nbytes)
+            box["all"] = arr
+        return box["all"]
+
+    finishes = []
+    for i, ctx in enumerate(ctxs):
+
+        def fetch(i=i, ctx=ctx):
+            if i not in box:
+                box[i] = _parse_compact(fetch_all()[i], ctx, fcap, ecap)
+            return box[i]
+
+        def finish(i=i, ctx=ctx, fetch=fetch) -> RoundOutcome:
+            fetched = fetch()
+            # The compact tuple carries everything decode needs; the lane
+            # slice of the stacked result materializes ONLY on the cap-
+            # overflow fallback (eager per-field slices cost ~0.6ms of XLA
+            # dispatch each on CPU -- 17 fields x P lanes of them erased
+            # the stacking win before this was lazy).
+            lane = None if fetched is not None else lane_slice(result, i)
+            return decode_result(lane, ctx, _fetched=fetched)
+
+        finish.dispatched = (buf, fcap, ecap)
+        finish.fetch = fetch
+        finish.stacked_index = i
+        finishes.append(finish)
+    return finishes
+
+
+_LANE_SLICE = None
+
+
+def lane_slice(tree, i: int):
+    """Slice lane `i` out of a stacked pytree (RoundResult /
+    SchedulingProblem) as ONE jitted program instead of one eager XLA
+    dispatch per field -- the per-field form cost ~0.6ms x fields x lanes
+    on the CPU backend."""
+    global _LANE_SLICE
+    if _LANE_SLICE is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("i",))
+        def _slice(t, *, i):
+            return jax.tree_util.tree_map(lambda a: a[i], t)
+
+        _LANE_SLICE = _slice
+    return _LANE_SLICE(tree, i=i)
 
 
 _UNFETCHED = object()  # decode_result sentinel: None is a real fetch result

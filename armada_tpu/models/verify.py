@@ -490,6 +490,66 @@ def dispatch_verify(device_problem, result, compact_dispatched, ctx):
     return buf
 
 
+_KERNEL_STACKED = None
+
+
+def _kernel_stacked():
+    global _KERNEL_STACKED
+    if _KERNEL_STACKED is None:
+        import jax
+
+        _KERNEL_STACKED = jax.jit(jax.vmap(_verify_kernel_impl))
+    return _KERNEL_STACKED
+
+
+def dispatch_verify_stacked(device_problem, result, compact_buf, ctxs):
+    """Verification for a STACKED round (pool-parallel serving, round 17):
+    vmap the invariant kernel over the pool lanes of the stacked problem /
+    result / compact buffer -- ONE [P, _VHEADER] buffer, ONE extra
+    device->host transfer for the whole stack (the begin_decode_stacked
+    economics).  `compact_buf` is the stacked [P, L] compact device buffer;
+    each lane's fingerprint folds over exactly the row its decode transfer
+    carries.  Returns the device buffer or None (host-array result / no
+    compact buffer).  Per-lane verdicts come from ``finish_verify`` on the
+    fetched rows (models.__init__ fetches once and verdicts per pool)."""
+    import jax
+
+    if not isinstance(result.g_state, jax.Array):
+        return None
+    if compact_buf is None:
+        return None
+    buf = _kernel_stacked()(
+        device_problem.node_total,
+        device_problem.node_ok,
+        device_problem.node_axes,
+        device_problem.run_req,
+        device_problem.run_node,
+        device_problem.run_queue,
+        device_problem.run_valid,
+        device_problem.g_req,
+        device_problem.g_card,
+        device_problem.g_queue,
+        device_problem.g_run,
+        result.g_state,
+        result.slot_gang,
+        result.slot_nodes,
+        result.slot_counts,
+        result.n_slots,
+        result.run_evicted,
+        result.run_rescheduled,
+        result.alloc[:, 0],
+        result.q_alloc,
+        result.scheduled_count,
+        compact_buf,
+        np.asarray([c.num_real_gangs for c in ctxs], np.int32),
+    )
+    try:
+        buf.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass  # backend without async copies: the fetch blocks normally
+    return buf
+
+
 def host_fingerprint(buf: np.ndarray) -> tuple:
     """(xor, sum) folds over a host i32 buffer, matching the device folds
     bit-for-bit (i32 wraparound on the sum)."""
@@ -510,6 +570,13 @@ def finish_verify(dispatched, ctx, pool: str = "") -> dict:
     from armada_tpu.models.xfer import TRANSFER_STATS
 
     TRANSFER_STATS.count_down(buf.nbytes)
+    return verdict_of(buf, ctx, pool=pool)
+
+
+def verdict_of(buf: np.ndarray, ctx, pool: str = "") -> dict:
+    """The host-side verdict over one pool's ALREADY-FETCHED i32[_VHEADER]
+    row -- finish_verify's tail, split out so the stacked path can fetch
+    all pools' rows in one transfer and verdict each at its pool's turn."""
     state = verify_state()
 
     if buf.shape[0] != _VHEADER or int(buf[_H_VERSION]) != _VERSION:

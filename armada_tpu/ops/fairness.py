@@ -72,9 +72,28 @@ def fair_shares(weights, constrained_demand_share, *, max_iterations: int = 10) 
     iteration-order subtleties: the uncapped share update uses the *previous*
     iteration's spare shares, and the loop breaks after the uncapped update when all
     queues have achieved demand.
+
+    JITTED (round 17): the eager path closed over weights/cds inside the
+    while_loop body, embedding fresh constant arrays in the jaxpr every
+    call -- jax's primitive cache missed and XLA recompiled the loop on
+    EVERY invocation (~49ms/call measured on the CPU host; with
+    queue_stats_from_result calling this once per pool per cycle, an
+    8-pool cycle burned ~0.4s pure recompilation).  As traced arguments
+    they key the compile cache on shape only; inside an enclosing jit
+    (the round kernel) the inner jit inlines as before.
     """
-    weights = jnp.asarray(weights, jnp.float32)
-    cds = jnp.asarray(constrained_demand_share, jnp.float32)
+    return _fair_shares_jit(
+        jnp.asarray(weights, jnp.float32),
+        jnp.asarray(constrained_demand_share, jnp.float32),
+        max_iterations=max_iterations,
+    )
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("max_iterations",))
+def _fair_shares_jit(weights, cds, *, max_iterations: int) -> FairShares:
     weight_sum = jnp.sum(weights)
     fair_share = jnp.where(weight_sum > 0, weights / jnp.where(weight_sum > 0, weight_sum, 1.0), 0.0)
 

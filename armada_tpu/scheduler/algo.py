@@ -23,16 +23,23 @@ import uuid
 from typing import Callable, Optional, Sequence
 
 from armada_tpu.core.config import SchedulingConfig
-from armada_tpu.core.pipeline import pipeline_enabled, prefetch_worthwhile
+from armada_tpu.core.pipeline import (
+    pipeline_enabled,
+    pool_parallel_enabled,
+    prefetch_worthwhile,
+)
 from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 from armada_tpu.jobdb.job import Job, JobRun
 from armada_tpu.jobdb.jobdb import WriteTxn
 from armada_tpu.models import (
+    PoolRoundSpec,
     RoundOutcome,
     collect_round_stats,
+    dispatch_pool_rounds,
     run_round_on_device,
     run_scheduling_round,
 )
+from armada_tpu.ops.metrics import mono_now
 from armada_tpu.ops.trace import recorder as _trace
 from armada_tpu.scheduler.executors import ExecutorSnapshot
 from armada_tpu.scheduler.ratelimit import SchedulingRateLimiters
@@ -45,6 +52,13 @@ class PoolStats:
     num_nodes: int
     num_queued: int
     num_running: int
+    # Per-pool round observability (round 17): wall seconds of THIS pool's
+    # round (prepare+dispatch share+fetch+apply) and whether it paid a
+    # failover window (fallback-count delta across the round, the
+    # degraded-attribution rule) -- feeds SLORecorder.observe_pool_round
+    # so a slow tenant is visible behind its neighbours.
+    round_s: float = 0.0
+    degraded: bool = False
     # Market pools only (cycle_metrics.go:534,455,456): configured-shape
     # prices, the per-queue idealised ("boundary-less cluster") values, and
     # the realised values of what actually scheduled -- idealised minus
@@ -364,13 +378,176 @@ class FairSchedulingAlgo:
             if by_queue:
                 self.rate_limiters.consume(by_queue)
 
+        def commit_outcome(
+            pool, outcome, *, num_queued, num_running, pool_nodes,
+            market_b=None, running=(), bid_price_of=None, round_s=0.0,
+            degraded=False,
+        ):
+            """The common per-pool tail -- consume, apply, overlay, stats --
+            shared by the serial loop and the pool-parallel window's fetch
+            phase.  ALWAYS called in pool-list order: the cross-pool apply
+            order (and so the event order) is identical in every mode."""
+            nonlocal queued_jobs
+            consume_round(outcome)
+            with _trace().span(
+                "apply_outcome",
+                pool=pool,
+                scheduled=len(outcome.scheduled),
+                preempted=len(outcome.preempted),
+            ):
+                self._apply_outcome(
+                    txn, outcome, pool, executor_of_node, now_ns, result
+                )
+            if incremental:
+                # Later pools must see this pool's leases/preemptions; the
+                # overlay registry keeps this O(this pool's changes), not
+                # O(all txn upserts so far).  (Under the pool-parallel
+                # window this is additionally a certified no-op on the
+                # OTHER window pools' tables -- pools_independent -- and it
+                # fires in the same order as the serial loop regardless.)
+                self.feed.overlay(txn._upserts)
+            stats = PoolStats(
+                pool=pool,
+                outcome=outcome,
+                num_nodes=len(pool_nodes),
+                num_queued=num_queued,
+                num_running=num_running,
+                round_s=round_s,
+                degraded=degraded,
+            )
+            pool_cfg = next(
+                (p for p in self.config.pools if p.name == pool), None
+            )
+            if pool_cfg is not None and pool_cfg.market_driven:
+                stats.market = True
+                if incremental:
+                    self._market_observability_columnar(
+                        stats, pool, pool_nodes, txn, market_b, outcome,
+                        bid_price_of,
+                    )
+                else:
+                    self._market_observability(
+                        stats, pool, pool_nodes, pool_queues(pool),
+                        queued_jobs, running, outcome, bid_price_of,
+                    )
+            result.pools.append(stats)
+            # Jobs scheduled in this pool are no longer queued for later pools.
+            scheduled_ids = set(outcome.scheduled)
+            if scheduled_ids:
+                queued_jobs = [
+                    j for j in queued_jobs if j.id not in scheduled_ids
+                ]
+
+        # --- pool-parallel serving (round 17, ARMADA_POOL_PARALLEL) ----------
+        # Consecutive eligible pools form a WINDOW whose rounds all dispatch
+        # through the device before any fetch (pool B's delta upload + kernel
+        # dispatch fire while pool A's transfer is in flight), and
+        # shape-matched window pools batch into ONE stacked kernel launch
+        # (models.dispatch_pool_rounds).  Decisions stay bit-identical to the
+        # serial loop: fetch/decode/apply runs strictly in pool-list order,
+        # and the window only forms when the cycle CERTIFIES independence --
+        #   * every queued job restricted to exactly one pool
+        #     (feed.pools_independent(): pool A's apply then provably cannot
+        #     touch pool B's assembled problem -- leases land in A's builder
+        #     only, removes target ids B never held);
+        #   * rate-limiter tokens provably NON-BINDING for the whole window
+        #     (armed buckets make pool B's token reading depend on pool A's
+        #     consumption; when every windowed pool's tokens minus the
+        #     window's worst-case prior consumption still exceed its whole
+        #     backlog, the caps cannot trip in either order and the reading
+        #     difference is decision-inert);
+        #   * non-market pools only (market observability reads builder
+        #     state between rounds).
+        # Anything else drains the window and runs serially -- a per-cycle
+        # decision (a tenant submitting a multi-pool job just flips the next
+        # cycle back to serial; scheduler/pool_serving.py counts it).
+        from armada_tpu.core.watchdog import supervisor as _supervisor
+
+        pool_parallel_armed = (
+            pool_parallel_enabled() and incremental and len(pools) > 1
+        )
+        pool_parallel_ok = (
+            pool_parallel_armed and self.feed.pools_independent()
+        )
+        window: list = []  # prepared, undispatched eligible pool rounds
+        window_demand = [0]  # queued members across the open window
+        pool_round_s: dict = {}
+        cycle_stacked = [0, 0]  # launches, pools covered
+        parallel_used = [False]
+        pools_t0 = mono_now()
+
+        def finish_window_round(entry, fin, deg0, fb_seen, failed) -> None:
+            pool = entry["pool"]
+            sup = _supervisor()
+            t0 = mono_now()
+            with _trace().span("round", pool=pool, parallel=True):
+                res, outcome = fin()
+            if self.collect_stats:
+                collect_round_stats(
+                    res, entry["pview"], entry["ctx"], self.config, outcome
+                )
+            dt = mono_now() - t0 + entry["prep_s"]
+            pool_round_s[pool] = dt
+            # Degraded-attribution rule across the WINDOW: deg0/fb_seen were
+            # snapshotted BEFORE the dispatch phase (a drill-speed re-probe
+            # can promote back before any fetch returns -- the round-10
+            # misfiling); dispatch-phase failovers are attributed exactly
+            # via the dispatch_failed set, finish-phase ones via the
+            # fallback-count delta since the previous finish.
+            fb_now = sup.fallbacks
+            commit_outcome(
+                pool,
+                outcome,
+                num_queued=entry["num_queued"],
+                num_running=entry["num_running"],
+                pool_nodes=entry["pool_nodes"],
+                round_s=dt,
+                degraded=deg0 or failed or fb_now > fb_seen[0],
+            )
+            fb_seen[0] = fb_now
+
+        def flush_window() -> None:
+            if not window:
+                return
+            entries = list(window)
+            window.clear()
+            window_demand[0] = 0
+            specs = [e["spec"] for e in entries]
+            sup = _supervisor()
+            deg0 = sup.degraded
+            t0 = mono_now()
+            finishes, stacked, stacked_pools, dispatch_failed = (
+                dispatch_pool_rounds(specs, self.config)
+            )
+            share = (mono_now() - t0) / len(entries)
+            # baseline AFTER dispatch: dispatch-phase fallbacks are already
+            # attributed per pool via dispatch_failed, so only finish-phase
+            # deltas ride the counter.
+            fb_seen = [sup.fallbacks]
+            cycle_stacked[0] += stacked
+            cycle_stacked[1] += stacked_pools
+            if len(entries) >= 2:
+                parallel_used[0] = True
+            for i, (e, fin) in enumerate(zip(entries, finishes)):
+                e["prep_s"] += share
+                finish_window_round(
+                    e, fin, deg0, fb_seen, i in dispatch_failed
+                )
+
         for pool in pools:
             pool_nodes = [n for n in nodes if n.pool == pool]
             if not pool_nodes:
                 continue
+            window_eligible = pool_parallel_ok and pool not in market_pools
+            if not window_eligible:
+                # Ineligible pool ahead: every windowed round fetches and
+                # applies NOW, so this pool's prepare sees exactly the state
+                # the serial loop would have shown it.
+                flush_window()
             bid_price_of = _pool_pricer(pool) if self.bid_prices is not None else None
             running = running_by_pool.get(pool, [])
             if incremental:
+                prep_t0 = mono_now()
                 b = self.feed.builder_for(pool, txn)
                 # Market prices are re-read from the provider every cycle;
                 # the builder's _prices() snapshot uses this callable.
@@ -382,6 +559,29 @@ class FairSchedulingAlgo:
                 if not num_queued and not num_running:
                     continue
                 g_tokens, q_tokens = round_tokens()
+                if window_eligible:
+                    # Token certification: this pool's burst caps must stay
+                    # non-binding even if every EARLIER window pool schedules
+                    # its entire backlog first (serial tokens >= this
+                    # parallel reading minus that worst case).  num_queued
+                    # counts gang MEMBERS, the unit the caps count.  A
+                    # failure drains the window and runs this pool serially.
+                    cum = window_demand[0]
+                    tokens_ok = (
+                        g_tokens is None or g_tokens - cum >= num_queued
+                    ) and (
+                        q_tokens is None
+                        or all(
+                            v - cum >= num_queued for v in q_tokens.values()
+                        )
+                    )
+                    if not tokens_ok:
+                        window_eligible = False
+                        flush_window()
+                        # The flush consumed the windowed pools' tokens;
+                        # re-read so this pool's serial round sees exactly
+                        # what the serial loop would have handed it.
+                        g_tokens, q_tokens = round_tokens()
                 # Slot-stable slab deltas: O(deltas) device upload per cycle
                 # (models/slab.py); the round runs on the device-resident
                 # problem the cache keeps current by scatter.
@@ -391,6 +591,47 @@ class FairSchedulingAlgo:
                     queue_penalty=penalty_by_pool.get(pool),
                 )
                 pview = bundle.stats_view()
+                # Thunk, not a value: the device apply/upload runs inside
+                # the watchdog deadline (a hung scatter IS a device loss),
+                # and materialize() is the host-table ground truth the CPU
+                # failover re-runs from.  Both close over live slab state,
+                # which is unmutated until the decisions apply below.
+                # EARLY-bound (default args, cache resolved NOW): an
+                # abandoned watchdog worker that unwedges later must only
+                # ever touch the cache object of ITS round -- by then the
+                # orphaned garbage the reset hook replaced -- never the
+                # live cache or a later iteration's bundle.
+                devcache = self.feed.devcache_for(pool)
+                if window_eligible:
+                    # Window prepare: dispatch is deferred to the flush so
+                    # shape-matched pools can stack into one launch; the
+                    # spec mirrors the serial run_round_on_device call
+                    # exactly.  The cross-pool content prefetch thunk is
+                    # omitted here -- every window pool's bundle uploads at
+                    # this flush anyway, and prefetch is bit-neutral by
+                    # design (tests/test_pipeline.py).
+                    window_demand[0] += num_queued
+                    window.append(
+                        dict(
+                            pool=pool,
+                            pview=pview,
+                            ctx=ctx,
+                            num_queued=num_queued,
+                            num_running=num_running,
+                            pool_nodes=pool_nodes,
+                            prep_s=mono_now() - prep_t0,
+                            spec=PoolRoundSpec(
+                                problem=pview,
+                                ctx=ctx,
+                                device_problem=(
+                                    lambda dc=devcache, b_=bundle: dc.apply(b_)
+                                ),
+                                host_problem=bundle.materialize,
+                                shadow_work=(drain_shadow,),
+                            ),
+                        )
+                    )
+                    continue
                 # Kernel shadow: the caller's deferred thunks plus the OTHER
                 # pools' decision-independent slab prefetch (their submit
                 # overlays are already final; this pool's bundle just
@@ -405,22 +646,14 @@ class FairSchedulingAlgo:
                     shadow.append(
                         lambda p=pool: self.feed.prefetch_content(skip_pool=p)
                     )
-                # Thunk, not a value: the device apply/upload runs inside
-                # the watchdog deadline (a hung scatter IS a device loss),
-                # and materialize() is the host-table ground truth the CPU
-                # failover re-runs from.  Both close over live slab state,
-                # which is unmutated until the decisions apply below.
-                # EARLY-bound (default args, cache resolved NOW): an
-                # abandoned watchdog worker that unwedges later must only
-                # ever touch the cache object of ITS round -- by then the
-                # orphaned garbage the reset hook replaced -- never the
-                # live cache or a later iteration's bundle.
-                devcache = self.feed.devcache_for(pool)
                 # Mesh serving: the round span carries the device count the
                 # resident slab is sharded over (0/absent = single device),
                 # so a Perfetto timeline shows which ladder rung served it.
                 mesh_n = getattr(devcache, "mesh_devices", 0)
                 span_kw = {"mesh_devices": mesh_n} if mesh_n else {}
+                sup = _supervisor()
+                deg0 = sup.degraded
+                fb0 = sup.fallbacks  # plain counter read: snapshot() takes the lock
                 with _trace().span("round", pool=pool, **span_kw):
                     res, outcome = run_round_on_device(
                         pview,
@@ -434,11 +667,24 @@ class FairSchedulingAlgo:
                     )
                 if self.collect_stats:
                     collect_round_stats(res, pview, ctx, self.config, outcome)
+                dt = mono_now() - prep_t0  # prepare + round + stats
+                pool_round_s[pool] = dt
+                commit_outcome(
+                    pool, outcome, num_queued=num_queued,
+                    num_running=num_running, pool_nodes=pool_nodes,
+                    market_b=b, running=running, bid_price_of=bid_price_of,
+                    round_s=dt,
+                    degraded=deg0 or sup.fallbacks > fb0,
+                )
             else:
                 if not queued_jobs and not running:
                     continue
                 num_queued, num_running = len(queued_jobs), len(running)
                 g_tokens, q_tokens = round_tokens()
+                sup = _supervisor()
+                deg0 = sup.degraded
+                fb0 = sup.fallbacks  # plain counter read: snapshot() takes the lock
+                t0 = mono_now()
                 with _trace().span("round", pool=pool, legacy=True):
                     outcome = run_scheduling_round(
                         self.config,
@@ -454,49 +700,34 @@ class FairSchedulingAlgo:
                         banned_nodes=banned_nodes,
                         queue_penalty=penalty_by_pool.get(pool),
                     )
-            consume_round(outcome)
-            with _trace().span(
-                "apply_outcome",
-                pool=pool,
-                scheduled=len(outcome.scheduled),
-                preempted=len(outcome.preempted),
-            ):
-                self._apply_outcome(
-                    txn, outcome, pool, executor_of_node, now_ns, result
+                dt = mono_now() - t0
+                pool_round_s[pool] = dt
+                commit_outcome(
+                    pool, outcome, num_queued=num_queued,
+                    num_running=num_running, pool_nodes=pool_nodes,
+                    running=running, bid_price_of=bid_price_of, round_s=dt,
+                    degraded=deg0 or sup.fallbacks > fb0,
                 )
-            if incremental:
-                # Later pools must see this pool's leases/preemptions; the
-                # overlay registry keeps this O(this pool's changes), not
-                # O(all txn upserts so far).
-                self.feed.overlay(txn._upserts)
-            stats = PoolStats(
-                pool=pool,
-                outcome=outcome,
-                num_nodes=len(pool_nodes),
-                num_queued=num_queued,
-                num_running=num_running,
+        flush_window()
+        if pool_round_s:
+            # Cycle-level pool observability: the overlap ratio (sum of
+            # per-pool round seconds over the pool section's wall clock --
+            # ~1.0 serial, > 1.0 when dispatches overlapped fetches) rides
+            # the cycle root span; the pool_serving ledger feeds /healthz
+            # and bench.
+            from armada_tpu.scheduler.pool_serving import pool_serving_stats
+
+            wall = max(mono_now() - pools_t0, 1e-9)
+            overlap = sum(pool_round_s.values()) / wall
+            _trace().annotate(pool_overlap_ratio=round(overlap, 3))
+            pool_serving_stats().record_cycle(
+                parallel=parallel_used[0],
+                armed=pool_parallel_armed,
+                pool_round_s=pool_round_s,
+                stacked_launches=cycle_stacked[0],
+                stacked_pools=cycle_stacked[1],
+                overlap_ratio=overlap,
             )
-            pool_cfg = next(
-                (p for p in self.config.pools if p.name == pool), None
-            )
-            if pool_cfg is not None and pool_cfg.market_driven:
-                stats.market = True
-                if incremental:
-                    self._market_observability_columnar(
-                        stats, pool, pool_nodes, txn, b, outcome, bid_price_of
-                    )
-                else:
-                    self._market_observability(
-                        stats, pool, pool_nodes, pool_queues(pool), queued_jobs,
-                        running, outcome, bid_price_of,
-                    )
-            result.pools.append(stats)
-            # Jobs scheduled in this pool are no longer queued for later pools.
-            scheduled_ids = set(outcome.scheduled)
-            if scheduled_ids:
-                queued_jobs = [
-                    j for j in queued_jobs if j.id not in scheduled_ids
-                ]
 
         # Away pass (scheduling_algo.go:216-283, nodePools:282): a pool's
         # still-queued jobs borrow nodes FROM its configured away_pools, at the

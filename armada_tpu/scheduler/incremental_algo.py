@@ -65,6 +65,17 @@ class IncrementalProblemFeed:
         # queued job ids with an explicit pools restriction: the away pass's
         # candidate set (scheduling_algo.go:216-283) without a backlog scan.
         self.pool_restricted: set[str] = set()
+        # Pool-parallel certification sets (round 17): a queued job with NO
+        # pools restriction sits in EVERY builder's backlog, and one listing
+        # >= 2 pools sits in each of them -- either makes two pools' rounds
+        # order-dependent (pool A scheduling it changes pool B's problem),
+        # so the cycle must stay serial.  Both empty <=> every queued job
+        # is restricted to exactly one pool <=> all backlogs are pairwise
+        # disjoint <=> dispatching pool B before pool A's apply is
+        # bit-neutral (pools_independent()).  Same lifecycle as
+        # pool_restricted: queued adds, lease/terminal removes.
+        self.unrestricted_queued: set[str] = set()
+        self.multi_pool_queued: set[str] = set()
         # running gang membership: job id -> (pool, queue, gang id), so gang
         # domain pins can be forgotten when the run ends (else the
         # note_running_gang sets grow forever).
@@ -128,6 +139,8 @@ class IncrementalProblemFeed:
         self.builders = {}
         self.devcaches = {}
         self.pool_restricted = set()
+        self.unrestricted_queued = set()
+        self.multi_pool_queued = set()
         self._gang_of = {}
         self._overlaid = {}
         self._overlaid_deletes = set()
@@ -259,6 +272,8 @@ class IncrementalProblemFeed:
 
     def _remove_everywhere(self, job_id: str) -> None:
         self.pool_restricted.discard(job_id)
+        self.unrestricted_queued.discard(job_id)
+        self.multi_pool_queued.discard(job_id)
         for b in self.builders.values():
             b.remove(job_id)
             b.unlease(job_id)
@@ -297,8 +312,15 @@ class IncrementalProblemFeed:
             bans = job.anti_affinity_nodes()
             if spec.pools:
                 self.pool_restricted.add(job.id)
+                self.unrestricted_queued.discard(job.id)
+                if len(spec.pools) >= 2:
+                    self.multi_pool_queued.add(job.id)
+                else:
+                    self.multi_pool_queued.discard(job.id)
             else:
                 self.pool_restricted.discard(job.id)
+                self.multi_pool_queued.discard(job.id)
+                self.unrestricted_queued.add(job.id)
             self._purge_pending(pending, job.id, leases_too=True)
             jid_b = job.id.encode()
             for name, b in self.builders.items():
@@ -315,6 +337,8 @@ class IncrementalProblemFeed:
             return
         # leased / running
         self.pool_restricted.discard(job.id)
+        self.unrestricted_queued.discard(job.id)
+        self.multi_pool_queued.discard(job.id)
         run = job.latest_run
         for name in self.builders:
             self._pending_for(pending, name)[3][job.id] = True
@@ -353,6 +377,16 @@ class IncrementalProblemFeed:
             self._flush(pending)
 
     # ------------------------------------------------------------ queries ---
+
+    def pools_independent(self) -> bool:
+        """Every queued job restricted to exactly ONE pool -- all builders'
+        backlogs pairwise disjoint, so the pools' rounds commute: pool A's
+        apply only removes ids pool B never held (its overlay is a no-op on
+        B's tables) and preemptions only touch A's own run table.  The
+        pool-parallel cycle (scheduler/algo.py) requires this to dispatch
+        pool B before pool A's decisions land; two O(1) set checks per
+        cycle."""
+        return not self.unrestricted_queued and not self.multi_pool_queued
 
     def running_of(self, pool: str, txn) -> list[RunningJob]:
         """RunningJob views of the pool's leased set, reconstructed from the

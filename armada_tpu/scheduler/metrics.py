@@ -134,6 +134,18 @@ class SchedulerMetrics:
             ["device"],
         )
         self._quarantine_labels: set = set()
+        # Per-pool round latency (round 17, pool-parallel serving): the
+        # slow-tenant gauge -- labelled quantiles from the SLO recorder's
+        # per-pool histograms (scheduler/slo.py observe_pool_round).  Label
+        # sets for pools the recorder no longer reports are removed, like
+        # the explain series above.
+        self.pool_cycle_seconds = g(
+            "armada_scheduler_pool_cycle_seconds",
+            "Per-pool scheduling-round latency percentiles (one pool's "
+            "dispatch through apply within a cycle)",
+            ["pool", "quantile"],
+        )
+        self._pool_cycle_labels: set = set()
         # Device-loss degradation state (core/watchdog): dashboards alert on
         # device_healthy == 0 (rounds running on the CPU failover) and on
         # device_fallbacks increasing (each is one lost round re-run).
@@ -279,7 +291,27 @@ class SchedulerMetrics:
 
     def observe_slo(self, snapshot: dict) -> None:
         """Publish the SLO recorder's histogram snapshot
-        (scheduler/slo.SLORecorder.snapshot), once per cycle."""
+        (scheduler/slo.SLORecorder.snapshot), once per cycle.  The "pools"
+        sub-block (per-pool round histograms, round 17) exports as
+        armada_scheduler_pool_cycle_seconds{pool,quantile}; stale pool
+        label sets are removed."""
+        pools = snapshot.get("pools")
+        if isinstance(pools, dict):
+            seen = set()
+            for pool, summary in pools.items():
+                if not isinstance(summary, dict) or not summary.get("count"):
+                    continue
+                for q in ("p50", "p90", "p95", "p99"):
+                    v = summary.get(q + "_s")
+                    if v is not None:
+                        seen.add((pool, q))
+                        self.pool_cycle_seconds.labels(pool, q).set(v)
+            for labels in self._pool_cycle_labels - seen:
+                try:
+                    self.pool_cycle_seconds.remove(*labels)
+                except KeyError:
+                    pass
+            self._pool_cycle_labels = seen
         for metric, summary in snapshot.items():
             if not isinstance(summary, dict) or not summary.get("count"):
                 continue

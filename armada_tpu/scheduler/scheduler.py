@@ -304,6 +304,17 @@ class Scheduler:
         rec = self._slo()
         if result.scheduled:
             rec.observe_cycle(duration_s, degraded=degraded)
+            # Per-pool round latency (round 17): one cycle number spanning
+            # all pools hides a slow tenant -- each PoolStats carries its
+            # own round seconds + the per-round fallback-delta degraded
+            # flag (scheduler/algo.py), recorded into per-pool histograms.
+            sched_pools = getattr(result.scheduler_result, "pools", None)
+            if sched_pools:
+                for ps in sched_pools:
+                    if ps.round_s:
+                        rec.observe_pool_round(
+                            ps.pool, ps.round_s, degraded=ps.degraded
+                        )
         if result.synced_jobs:
             rec.note_visible(result.synced_jobs)
         sched = result.scheduler_result

@@ -357,6 +357,14 @@ class ScheduleSession:
                 or sup.degraded
                 or sup.snapshot()["fallbacks"] > fallbacks0,
             )
+            # Per-pool round latency rides the same recorder (round 17):
+            # the algo stamps each PoolStats with its round seconds + the
+            # per-round fallback-delta degraded flag.
+            for ps in result.pools:
+                if ps.round_s:
+                    slo_recorder().observe_pool_round(
+                        ps.pool, ps.round_s, degraded=ps.degraded
+                    )
             return result
 
 

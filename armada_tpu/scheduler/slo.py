@@ -34,7 +34,7 @@ from __future__ import annotations
 from typing import Iterable, Optional
 
 from armada_tpu.analysis.tsan import make_lock
-from armada_tpu.ops.metrics import MetricsRegistry, mono_now
+from armada_tpu.ops.metrics import LogHistogram, MetricsRegistry, mono_now
 
 # A job submitted but untracked because the map was full: counted so a soak
 # reading 0 dropped jobs can trust it (the harness asserts this stays 0).
@@ -62,6 +62,16 @@ class SLORecorder:
         # on first sync visibility, _await_lease into ttfl on first lease.
         self._await_visible: dict[str, float] = {}
         self._await_lease: dict[str, float] = {}
+        # Per-pool ROUND latency (round 17): one cycle latency spanning all
+        # pools hides a slow tenant behind its neighbours -- each pool's
+        # round (dispatch through apply) records into its own histogram,
+        # with the fallback-delta degraded-attribution rule applied PER
+        # POOL (the pool whose round paid the failover window files as
+        # degraded, not the whole cycle).  Bounded like the tracking maps:
+        # past the cap new pools count into track_overflow, never silently.
+        self._pool_rounds: dict[str, LogHistogram] = {}
+        self._pool_degraded: dict[str, int] = {}
+        self.pool_cap = 512
         self._lock = make_lock("slo.recorder")
 
     # ---------------------------------------------------------- writers ----
@@ -125,6 +135,26 @@ class SLORecorder:
             degraded = supervisor().degraded
         (self.cycle_degraded if degraded else self.cycle).record(duration_s)
 
+    def observe_pool_round(
+        self, pool: str, duration_s: float, degraded: bool = False
+    ) -> None:
+        """One pool's scheduling-round wall time within a cycle (fed from
+        SchedulerResult.pools by Scheduler._observe_slo and the sidecar)."""
+        with self._lock:
+            h = self._pool_rounds.get(pool)
+            if h is None:
+                if len(self._pool_rounds) >= self.pool_cap:
+                    self.track_overflow.inc()
+                    return
+                h = self._pool_rounds[pool] = LogHistogram(
+                    name=f"pool_round_s.{pool}"
+                )
+            if degraded:
+                self._pool_degraded[pool] = (
+                    self._pool_degraded.get(pool, 0) + 1
+                )
+        h.record(duration_s)
+
     # ---------------------------------------------------------- readers ----
 
     def pending_lease_count(self) -> int:
@@ -134,12 +164,24 @@ class SLORecorder:
         """The /healthz / sidecar / bench JSON block."""
         snap = self.registry.snapshot()
         snap["awaiting_first_lease"] = len(self._await_lease)
+        with self._lock:
+            pools = {
+                pool: {
+                    **h.snapshot(),
+                    "degraded_rounds": self._pool_degraded.get(pool, 0),
+                }
+                for pool, h in self._pool_rounds.items()
+            }
+        if pools:
+            snap["pools"] = pools
         return snap
 
     def reset(self) -> None:
         with self._lock:
             self._await_visible.clear()
             self._await_lease.clear()
+            self._pool_rounds.clear()
+            self._pool_degraded.clear()
         self.registry.reset()
 
 

@@ -1,0 +1,24 @@
+# Fixture for rule `pool-dispatch-mutation` (linted under
+# armada_tpu/scheduler/).  The twin line is syntactically IDENTICAL to the
+# true positive after normalization; it mutates a DIFFERENT pool's builder
+# (bound from builder_for with another pool key), which is exactly what the
+# pool-parallel window does legitimately -- only value-flow provenance (the
+# receiver's derivation from the SAME builder_for call the dispatched
+# round's bundle came from) separates them.
+from armada_tpu.models import dispatch_round_on_device
+
+
+def cycle(feed, specs, config):
+    b = feed.builder_for("gpu")
+    other = feed.builder_for("cpu")
+    bundle, bctx = b.assemble_delta()
+    fin = dispatch_round_on_device(
+        bundle.stats_view(),
+        bctx,
+        config,
+        host_problem=bundle.materialize,
+    )
+    b.submit_many(specs)  # TP
+    other.submit_many(specs)  # twin
+    res, outcome = fin()
+    return res, outcome

@@ -74,6 +74,10 @@ RULE_FIXTURES = {
         "armada_tpu/parallel/fixture.py",
     ),
     "unmade-lock": ("unmade_lock.py", "armada_tpu/ingest/fixture.py"),
+    "pool-dispatch-mutation": (
+        "pool_dispatch_mutation.py",
+        "armada_tpu/scheduler/fixture.py",
+    ),
 }
 
 # The value-flow rules whose fixtures carry a `# twin` line: a
@@ -84,6 +88,7 @@ TWIN_RULES = [
     "inloop-scatter-gathered-key",
     "commit-scatter-gathered-old",
     "unpinned-out-shardings",
+    "pool-dispatch-mutation",
 ]
 
 

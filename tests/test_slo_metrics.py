@@ -198,6 +198,44 @@ def test_healthz_embeds_slo_block():
         srv.stop()
 
 
+def test_healthz_embeds_pools_block():
+    """Round 17: the pool-parallel serving scoreboard rides /healthz as
+    the `pools` block (serve wires pool_serving_stats().snapshot)."""
+    from armada_tpu.core.health import HealthServer, StartupCompleteChecker
+    from armada_tpu.scheduler.pool_serving import (
+        pool_serving_stats,
+        reset_pool_serving_stats,
+    )
+
+    reset_pool_serving_stats()
+    pool_serving_stats().record_cycle(
+        parallel=True,
+        armed=True,
+        pool_round_s={"gpu": 0.01, "cpu": 0.02},
+        stacked_launches=1,
+        stacked_pools=2,
+        overlap_ratio=1.4,
+    )
+    srv = HealthServer(port=0)
+    try:
+        startup = StartupCompleteChecker()
+        srv.checker.add(startup)
+        startup.mark_complete()
+        srv.pools_status = lambda: pool_serving_stats().snapshot()
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ).read()
+        )
+        assert body["pools"]["parallel_cycles"] == 1
+        assert body["pools"]["stacked_launches"] == 1
+        assert body["pools"]["last_overlap_ratio"] == 1.4
+        assert body["pools"]["last_round_s"]["gpu"] == 0.01
+    finally:
+        srv.stop()
+        reset_pool_serving_stats()
+
+
 def test_scheduler_metrics_export_slo_gauges():
     from prometheus_client import CollectorRegistry
 
@@ -221,4 +259,60 @@ def test_scheduler_metrics_export_slo_gauges():
             {"metric": "time_to_first_lease_s"},
         )
         == 1.0
+    )
+
+
+def test_slo_per_pool_round_histograms():
+    """Round 17: per-pool round latency rides its own histograms (the
+    slow-tenant signal), with the degraded-attribution rule applied per
+    ROUND; snapshot exposes them under "pools", reset clears them."""
+    rec = SLORecorder()
+    rec.observe_pool_round("gpu", 0.2)
+    rec.observe_pool_round("gpu", 0.4, degraded=True)
+    rec.observe_pool_round("cpu", 0.05)
+    snap = rec.snapshot()
+    assert snap["pools"]["gpu"]["count"] == 2
+    assert snap["pools"]["gpu"]["degraded_rounds"] == 1
+    assert snap["pools"]["cpu"]["degraded_rounds"] == 0
+    assert snap["pools"]["cpu"]["p50_s"] <= snap["pools"]["gpu"]["p50_s"]
+    rec.reset()
+    assert "pools" not in rec.snapshot()
+
+
+def test_scheduler_metrics_export_pool_cycle_gauges_with_stale_removal():
+    """armada_scheduler_pool_cycle_seconds{pool,quantile} exports the
+    per-pool histograms; a pool the recorder stops reporting is removed
+    (the stale-label discipline every labelled gauge here follows)."""
+    from prometheus_client import CollectorRegistry
+
+    from armada_tpu.scheduler.metrics import SchedulerMetrics
+
+    reg = CollectorRegistry()
+    m = SchedulerMetrics(registry=reg)
+    rec = SLORecorder()
+    rec.observe_pool_round("gpu", 0.2)
+    m.observe_slo(rec.snapshot())
+    assert (
+        reg.get_sample_value(
+            "armada_scheduler_pool_cycle_seconds",
+            {"pool": "gpu", "quantile": "p50"},
+        )
+        is not None
+    )
+    rec2 = SLORecorder()
+    rec2.observe_pool_round("cpu", 0.1)
+    m.observe_slo(rec2.snapshot())
+    assert (
+        reg.get_sample_value(
+            "armada_scheduler_pool_cycle_seconds",
+            {"pool": "gpu", "quantile": "p50"},
+        )
+        is None
+    ), "stale pool series must be removed"
+    assert (
+        reg.get_sample_value(
+            "armada_scheduler_pool_cycle_seconds",
+            {"pool": "cpu", "quantile": "p50"},
+        )
+        is not None
     )

@@ -185,6 +185,133 @@ def run_script(
     return decisions, sup, ms
 
 
+def run_pool_script(
+    *, cycles, seed, pools, jobs0, burst, fault, fault_cycle,
+    deadline_s=30.0, parallel=True,
+):
+    """The pool-parallel drill leg (round 17): a P-tenant world driven
+    through FairSchedulingAlgo with ARMADA_POOL_PARALLEL armed, one
+    injected device fault mid-window -- the faulted pool must walk the
+    failover ladder ALONE, every cycle's decisions must equal the serial
+    clean replay, and no job may lease twice."""
+    from armada_tpu.analysis import tsan
+    from armada_tpu.core import faults, watchdog
+    from armada_tpu.core.config import PoolConfig, PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, NodeSpec, Queue
+    from armada_tpu.jobdb.job import Job
+    from armada_tpu.jobdb.jobdb import JobDb
+    from armada_tpu.scheduler.algo import FairSchedulingAlgo
+    from armada_tpu.scheduler.executors import ExecutorSnapshot
+    from armada_tpu.scheduler.incremental_algo import IncrementalProblemFeed
+    from armada_tpu.scheduler.pool_serving import (
+        pool_serving_stats,
+        reset_pool_serving_stats,
+    )
+
+    if fault:
+        os.environ["ARMADA_TSAN"] = "1"
+        tsan.enable()
+        tsan.reset()
+    faults.reset_counters()
+    sup = watchdog.reset_supervisor()
+    reset_pool_serving_stats()
+    os.environ["ARMADA_REPROBE_INTERVAL_S"] = "0.05"
+    os.environ["ARMADA_WATCHDOG_S"] = str(deadline_s)
+    sup._probe = lambda timeout_s: (True, "chaos-stub")
+    os.environ["ARMADA_POOL_PARALLEL"] = "1" if parallel else "0"
+    if fault:
+        os.environ["ARMADA_FAULT"] = f"device_round:{fault}:{fault_cycle}"
+    else:
+        os.environ.pop("ARMADA_FAULT", None)
+
+    now_ns = 1_000_000_000_000
+    cfg = SchedulingConfig(
+        shape_bucket=32,
+        priority_classes={
+            "low": PriorityClass("low", priority=100, preemptible=True),
+            "high": PriorityClass("high", priority=1000, preemptible=False),
+        },
+        default_priority_class="high",
+        maximum_scheduling_burst=max(burst, 8),
+        incremental_problem_build=True,
+        pools=tuple(PoolConfig(f"cp{i}") for i in range(pools)),
+        maximum_scheduling_rate=0.0,
+        maximum_per_queue_scheduling_rate=0.0,
+    )
+    F = cfg.resource_list_factory()
+    jdb = JobDb(cfg)
+    feed = IncrementalProblemFeed(cfg)
+    feed.attach(jdb)
+    executors = [
+        ExecutorSnapshot(
+            id=f"cex{p}",
+            pool=f"cp{p}",
+            last_update_ns=now_ns,
+            nodes=tuple(
+                NodeSpec(
+                    id=f"cn{p}-{k}",
+                    pool=f"cp{p}",
+                    total_resources=F.from_mapping(
+                        {"cpu": "8", "memory": "32"}
+                    ),
+                )
+                for k in range(3)
+            ),
+        )
+        for p in range(pools)
+    ]
+    algo = FairSchedulingAlgo(
+        cfg,
+        queues=lambda: [Queue(f"cq{i}", 1.0 + i) for i in range(3)],
+        clock_ns=lambda: now_ns,
+        feed=feed,
+    )
+    rng = random.Random(seed)
+    nid = [0]
+
+    def submit(txn, n):
+        for _ in range(n):
+            i = nid[0]
+            nid[0] += 1
+            pool = f"cp{i % pools}"
+            spec = JobSpec(
+                id=f"cj{i:05d}",
+                queue=f"cq{rng.randrange(3)}",
+                priority_class="low" if rng.random() < 0.4 else "high",
+                submit_time=float(i),
+                pools=(pool,),
+                resources=F.from_mapping(
+                    {"cpu": str(rng.randrange(1, 4)), "memory": "1"}
+                ),
+            )
+            txn.upsert(
+                Job(spec=spec, queued=True, validated=True, pools=(pool,))
+            )
+
+    decisions = []
+    leased_ever: set = set()
+    violations = 0
+    for _cycle in range(cycles):
+        txn = jdb.write_txn()
+        submit(txn, jobs0 if _cycle == 0 else burst)
+        result = algo.schedule(txn, executors, now_ns)
+        txn.commit()
+        cycle_dec = [
+            (
+                ps.pool,
+                sorted(ps.outcome.scheduled.items()),
+                sorted(ps.outcome.preempted),
+            )
+            for ps in result.pools
+        ]
+        decisions.append(cycle_dec)
+        for job, _run in result.scheduled:
+            if job.id in leased_ever:
+                violations += 1  # double-lease: the drill's hard failure
+            leased_ever.add(job.id)
+    return decisions, sup, pool_serving_stats().snapshot(), violations
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--cycles", type=int, default=8)
@@ -235,6 +362,19 @@ def main() -> int:
         "and the soak/crash legs -- so chip-loss convergence is exercised "
         "under the configuration serve would arm, not a silent K=1 "
         "(default: inherit the environment)",
+    )
+    ap.add_argument(
+        "--pools",
+        type=int,
+        default=0,
+        dest="pools",
+        help="additionally run the pool-parallel drill leg (round 17): an "
+        "N-tenant world through FairSchedulingAlgo with "
+        "ARMADA_POOL_PARALLEL armed and a device fault injected into one "
+        "pool's round mid-window -- the faulted pool walks the failover "
+        "ladder alone, decisions must be bit-equal to a SERIAL clean "
+        "replay, zero dropped/double-leased jobs, zero tsan violations "
+        "(docs/operations.md pool-parallel runbook)",
     )
     ap.add_argument(
         "--mesh",
@@ -389,6 +529,48 @@ def main() -> int:
         with tempfile.TemporaryDirectory(prefix="chaos-crash-") as d:
             crash_report = run_soak(ccfg, d)
 
+    pool_report = None
+    if args.pools:
+        pfc = rng.randrange(1, max(2, args.cycles * args.pools - 1))
+        pool_common = dict(
+            cycles=args.cycles,
+            seed=args.seed,
+            pools=args.pools,
+            jobs0=args.jobs,
+            burst=args.burst,
+        )
+        chaotic_p, psup, pstats, pviol = run_pool_script(
+            fault="error", fault_cycle=pfc, parallel=True, **pool_common
+        )
+        deadline = time.monotonic() + 10.0
+        while psup.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        p_promoted = not psup.degraded
+        clean_p, _, _, cviol = run_pool_script(
+            fault=None, fault_cycle=0, parallel=False, **pool_common
+        )
+        pool_tsan = tsan.take_violations()
+        tsan.disable()
+        pool_report = {
+            "ok": (
+                chaotic_p == clean_p
+                and psup.snapshot()["fallbacks"] >= 1
+                and p_promoted
+                and pviol == 0
+                and cviol == 0
+                and not pool_tsan
+                and pstats["parallel_cycles"] >= 1
+            ),
+            "pools": args.pools,
+            "decisions_equal_serial": chaotic_p == clean_p,
+            "fallbacks": psup.snapshot()["fallbacks"],
+            "promoted": p_promoted,
+            "double_leased": pviol + cviol,
+            "parallel_cycles": pstats["parallel_cycles"],
+            "stacked_launches": pstats["stacked_launches"],
+            "tsan_violations": len(pool_tsan),
+        }
+
     ok = (
         chaotic == clean
         and (snap["fallbacks"] >= 1 if not args.mesh else mesh_ok)
@@ -397,6 +579,7 @@ def main() -> int:
         and not tsan_found
         and (soak_report is None or soak_report["ok"])
         and (crash_report is None or crash_report["ok"])
+        and (pool_report is None or pool_report["ok"])
     )
     fault_site = "round_corrupt" if args.corrupt else "device_round"
     line = {
@@ -459,6 +642,8 @@ def main() -> int:
             "tsan_violations": crash_report.get("tsan_violations", 0),
             **(crash_report.get("crash") or {}),
         }
+    if pool_report is not None:
+        line["pools"] = pool_report
     if not ok and chaotic != clean:
         for i, (a, b) in enumerate(zip(chaotic, clean)):
             if a != b:
