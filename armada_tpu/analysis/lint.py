@@ -1310,6 +1310,102 @@ def _pool_dispatch_mutation(src: Source):
                             bindings[sub.id] = srcs
 
 
+@rule(
+    "shard-foreign-cursor",
+    "a shard's sink store carrying consumer_positions rows derived from "
+    "ANOTHER shard's poll: each shard of the partition-parallel ingest "
+    "plane owns a disjoint partition set and must commit ONLY its own "
+    "cursor rows -- a foreign-cursor store acks partitions whose data "
+    "lives in a different transaction, so a crash between the two stores "
+    "silently skips that shard's batch on restart (round 18)",
+    scope=under("armada_tpu/"),
+)
+def _shard_foreign_cursor(src: Source):
+    # Value-flow per function: positions values are tagged with the shard
+    # expression whose poll produced them (`X.poll_raw(...)` /
+    # `X._poll_raw(...)` / `X.consumer.poll()` -> owner X); a store through
+    # `Y.sink.store(..., next_positions=P)` is flagged when P carries
+    # shard tags that do NOT include Y.  Untagged positions (dict
+    # literals, parameters) stay clean -- provenance unknown is not a
+    # violation, it is the inline single-shard shape.
+    if "next_positions" not in src.text or ".store" not in src.text:
+        return
+    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+
+    def _owner_key(expr: ast.AST) -> Optional[str]:
+        """The shard expression a poll/store hangs off: for
+        `A.consumer.poll` / `A._consumer.poll` / `A.sink.store` the owner
+        is A; for `X.poll_raw` it is X."""
+        return ast.dump(expr, annotate_fields=False, include_attributes=False)
+
+    for fn in (
+        n
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ):
+        bindings: dict = {}
+
+        def expr_tags(node) -> frozenset:
+            out: set = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out |= bindings.get(sub.id, frozenset())
+            return frozenset(out)
+
+        for st in _pool_fn_stmts(fn):
+            # (1) stores: receiver shard vs the positions' provenance
+            for sub in ast.walk(st):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("store", "store_plan")
+                    and isinstance(sub.func.value, ast.Attribute)
+                    and sub.func.value.attr in ("sink", "_sink")
+                ):
+                    continue
+                receiver = _owner_key(sub.func.value.value)
+                for kw in sub.keywords:
+                    if kw.arg != "next_positions":
+                        continue
+                    tags = expr_tags(kw.value)
+                    if tags and receiver not in tags:
+                        yield _finding(
+                            src,
+                            "shard-foreign-cursor",
+                            sub,
+                            "next_positions derived from a different "
+                            "shard's poll: cursor rows must commit in the "
+                            "SAME transaction as their shard's data -- "
+                            "ack through the shard that polled them",
+                        )
+            # (2) binding propagation: poll results carry their shard tag
+            if isinstance(st, ast.Assign) and st.value is not None:
+                tags: frozenset = frozenset()
+                val = st.value
+                if isinstance(val, ast.Call) and isinstance(
+                    val.func, ast.Attribute
+                ):
+                    attr = val.func.attr
+                    owner: Optional[ast.AST] = None
+                    if attr in ("poll_raw", "_poll_raw", "poll"):
+                        owner = val.func.value
+                        if isinstance(owner, ast.Attribute) and owner.attr in (
+                            "consumer",
+                            "_consumer",
+                        ):
+                            owner = owner.value
+                    if owner is not None:
+                        tags = frozenset({_owner_key(owner)})
+                    else:
+                        tags = expr_tags(val)
+                else:
+                    tags = expr_tags(val)
+                for tgt in st.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            bindings[sub.id] = tags
+
+
 _THREAD_SPAWNERS = {"threading.Thread", "Thread", "_thread.start_new_thread"}
 
 

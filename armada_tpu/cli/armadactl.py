@@ -455,6 +455,7 @@ def cmd_soak(args):
             fault_at_frac=args.fault_at,
             watchdog_s=args.watchdog_s,
             crash_at_frac=getattr(args, "crash", None),
+            ingest_shards=getattr(args, "ingest_shards", None),
             **overrides,
         )
     )
@@ -703,6 +704,12 @@ _SERVE_FALLBACKS = {
     # None -> start_control_plane arms round-output verification
     # (models/verify.py) ON; --no-verify disarms.  ARMADA_VERIFY overrides.
     "verify": None,
+    # None -> start_control_plane resolves ARMADA_INGEST_SHARDS (1 = the
+    # serial ingestion pipeline).
+    "ingest_shards": None,
+    # None -> EventLog adopts an existing log's persisted width, else
+    # ARMADA_LOG_PARTITIONS, else 4.
+    "log_partitions": None,
 }
 
 
@@ -759,6 +766,8 @@ def load_serve_config(args):
         "mesh": ("mesh", int),
         "explain_interval": ("explaininterval", int),
         "verify": ("verify", bool),
+        "ingest_shards": ("ingestshards", int),
+        "log_partitions": ("logpartitions", int),
     }
     for attr, (key, cast) in mapping.items():
         if getattr(args, attr) is None:
@@ -819,6 +828,8 @@ def cmd_serve(args):
         mesh_devices=getattr(args, "mesh", None),
         explain_interval=getattr(args, "explain_interval", None),
         verify_rounds=getattr(args, "verify", None),
+        ingest_shards=getattr(args, "ingest_shards", None),
+        num_partitions=getattr(args, "log_partitions", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
     if plane.health_server is not None:
@@ -1091,6 +1102,25 @@ def build_parser() -> argparse.ArgumentParser:
         "quarantine -- see `armadactl quarantine`)",
     )
     srv.add_argument(
+        "--ingest-shards",
+        type=int,
+        dest="ingest_shards",
+        help="partition-parallel ingestion (ingest/shards.py): run each "
+        "materialized view's ingester as this many shard workers over "
+        "disjoint log partitions, with the proto->DbOps converter offloaded "
+        "to subprocesses (default 1 = the serial pipeline; "
+        "ARMADA_INGEST_SHARDS env; capped at --log-partitions)",
+    )
+    srv.add_argument(
+        "--log-partitions",
+        type=int,
+        dest="log_partitions",
+        help="event-log partition count for a FRESH --data-dir (default 4; "
+        "ARMADA_LOG_PARTITIONS env).  A permanent property of the log: it "
+        "keys the jobset->partition routing, is persisted in the log "
+        "directory, and a mismatched value on an existing log is refused",
+    )
+    srv.add_argument(
         "--lookout-port",
         type=int,
         help="host the lookout web UI on this port (0 = pick a free one)",
@@ -1232,6 +1262,14 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="FRAC",
         help="mid-soak kill/restart leg (checkpoint -> wipe store -> "
         "snapshot restore + suffix replay); RTO in restart_recovery_s",
+    )
+    sk.add_argument(
+        "--ingest-shards",
+        type=int,
+        default=None,
+        dest="ingest_shards",
+        help="partition-parallel ingestion width for the soak world "
+        "(ingest/shards.py); default: ARMADA_INGEST_SHARDS or 1 (serial)",
     )
     sk.set_defaults(fn=cmd_soak)
 

@@ -142,7 +142,7 @@ def start_control_plane(
     cycle_interval_s: float = 1.0,
     schedule_interval_s: float = 5.0,
     leader_id: Optional[str] = None,
-    num_partitions: int = 4,
+    num_partitions: Optional[int] = None,
     metrics_port: Optional[int] = None,
     health_port: Optional[int] = None,
     profiling: bool = False,
@@ -166,6 +166,7 @@ def start_control_plane(
     mesh_devices: Optional[int] = None,
     explain_interval: Optional[int] = None,
     verify_rounds: Optional[bool] = None,
+    ingest_shards: Optional[int] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -232,7 +233,21 @@ def start_control_plane(
             cache_dir or os.path.join(data_dir, "jax_cache")
         )
 
+    # Log width (serve --log-partitions / ARMADA_LOG_PARTITIONS): a PERMANENT
+    # property of a log directory -- EventLog persists it in META on first
+    # create, adopts it when unspecified, and refuses a mismatch (the
+    # jobset->partition routing would silently change otherwise).
+    if num_partitions is None:
+        try:
+            num_partitions = (
+                int(os.environ["ARMADA_LOG_PARTITIONS"])
+                if "ARMADA_LOG_PARTITIONS" in os.environ
+                else None
+            )
+        except ValueError:
+            num_partitions = None
     log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
+    num_partitions = log.num_partitions
     # External DBs (postgres:// via the pure-python wire driver,
     # ingest/pgwire.py) or the embedded per-replica SQLite defaults.
     db = SchedulerDb(database_url or os.path.join(data_dir, "scheduler.db"))
@@ -264,27 +279,39 @@ def start_control_plane(
             checkpoint_interval_s = 0.0
     publisher = Publisher(log)
 
-    scheduler_pipeline = IngestionPipeline(
-        log,
-        db,
-        convert_sequences,
-        consumer_name="scheduler",
-        start_positions=db.positions("scheduler"),
-    )
-    event_pipeline = IngestionPipeline(
-        log,
-        eventdb,
-        event_sink_converter,
-        consumer_name="events",
-        start_positions=eventdb.positions("events"),
-    )
-    lookout_pipeline = IngestionPipeline(
-        log,
-        lookoutdb,
-        lookout_converter,
-        consumer_name="lookout",
-        start_positions=lookoutdb.positions("lookout"),
-    )
+    # Partition-parallel ingestion (serve --ingest-shards /
+    # ARMADA_INGEST_SHARDS; ingest/shards.py): N shard workers per view,
+    # each owning a disjoint partition set with its own consumer cursor
+    # rows and store leg.  1 (the default) keeps the serial pipeline.
+    from armada_tpu.ingest import PartitionedIngestionPipeline, resolve_num_shards
+
+    ingest_shards = min(resolve_num_shards(ingest_shards), num_partitions)
+
+    def _pipeline(sink, converter, consumer):
+        if ingest_shards > 1:
+            return PartitionedIngestionPipeline(
+                log,
+                sink,
+                converter,
+                consumer_name=consumer,
+                num_shards=ingest_shards,
+                start_positions=sink.positions(consumer),
+            )
+        return IngestionPipeline(
+            log,
+            sink,
+            converter,
+            consumer_name=consumer,
+            start_positions=sink.positions(consumer),
+        )
+
+    scheduler_pipeline = _pipeline(db, convert_sequences, "scheduler")
+    event_pipeline = _pipeline(eventdb, event_sink_converter, "events")
+    lookout_pipeline = _pipeline(lookoutdb, lookout_converter, "lookout")
+    # Publish wakeups: idle pipelines sleep until their partitions get data
+    # instead of burning the fixed 0.05s poll.
+    for _p in (scheduler_pipeline, event_pipeline, lookout_pipeline):
+        publisher.add_wakeup(_p.notify)
 
     # Queue CRUD is event-sourced onto "$control-plane" so replicated
     # deployments converge on queue config by replay (cross-host HA).
@@ -551,6 +578,15 @@ def start_control_plane(
         from armada_tpu.scheduler.pool_serving import pool_serving_stats
 
         health_server.pools_status = lambda: pool_serving_stats().snapshot()
+        # Ingest-plane block (ingest/stats.py): per-consumer events/s +
+        # per-partition lag, shard counts, abandoned-thread census.
+        from armada_tpu.ingest.stats import registry as _ingest_stats
+
+        health_server.ingest_status = lambda: {
+            "shards_configured": ingest_shards,
+            "log_partitions": num_partitions,
+            "consumers": _ingest_stats().snapshot(),
+        }
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(

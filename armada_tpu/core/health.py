@@ -221,6 +221,15 @@ class _Handler(BaseHTTPRequestHandler):
                     body["pools"] = srv.pools_status()
                 except Exception as exc:  # noqa: BLE001
                     body["pools"] = {"error": str(exc)}
+            if srv.ingest_status is not None:
+                # Ingest-plane block (ingest/stats.py): per-consumer
+                # events/s and per-partition lag, shard counts, abandoned
+                # threads -- whether the materialized views keep up with
+                # the log, per view.
+                try:
+                    body["ingest"] = srv.ingest_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["ingest"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -309,6 +318,9 @@ class HealthServer:
         # Optional () -> dict: pool-parallel serving scoreboard (serve
         # wires scheduler/pool_serving.pool_serving_stats().snapshot).
         self.pools_status = None
+        # Optional () -> dict: ingest-plane block (serve wires
+        # ingest/stats.registry().snapshot plus shard/partition config).
+        self.ingest_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

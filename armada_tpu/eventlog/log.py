@@ -13,7 +13,7 @@ import fcntl
 import os
 import struct
 import subprocess
-from typing import Iterator, NamedTuple
+from typing import Iterator, NamedTuple, Optional
 
 from armada_tpu.analysis.tsan import make_lock
 
@@ -99,22 +99,32 @@ class Message(NamedTuple):
 class EventLog:
     """A durable partitioned append-only log (thread-safe appends)."""
 
-    def __init__(self, directory: str, num_partitions: int = 4):
+    DEFAULT_PARTITIONS = 4
+
+    def __init__(self, directory: str, num_partitions: Optional[int] = None):
         self._lib = _load_lib()
         os.makedirs(directory, exist_ok=True)
         # The partition count is a permanent property of a log (it keys the
         # jobset -> partition routing); persist it and reject mismatched opens
         # rather than silently hiding partitions or re-routing keys.
+        # num_partitions=None ADOPTS an existing log's persisted count (the
+        # restart path: `serve` without --log-partitions must reopen a log
+        # created at any width), falling back to DEFAULT_PARTITIONS only for
+        # a fresh directory.
         meta_path = os.path.join(directory, "META")
         if os.path.exists(meta_path):
             with open(meta_path) as f:
                 existing = int(f.read().strip())
-            if existing != num_partitions:
+            if num_partitions is None:
+                num_partitions = existing
+            elif existing != num_partitions:
                 raise ValueError(
                     f"event log at {directory} has {existing} partitions; "
                     f"requested {num_partitions}"
                 )
         else:
+            if num_partitions is None:
+                num_partitions = self.DEFAULT_PARTITIONS
             with open(meta_path, "w") as f:
                 f.write(str(num_partitions))
         self._handle = self._lib.el_open(directory.encode(), num_partitions)
@@ -174,18 +184,22 @@ class EventLog:
                 f"truncate of partition {partition} to {offset} failed"
             )
 
-    def read(
+    def read_raw(
         self,
         partition: int,
         offset: int,
         max_bytes: int = 1 << 20,
         max_msgs: int = 1 << 30,
-    ) -> list[Message]:
-        """Read whole records from `offset`; empty list means caught up."""
+    ) -> tuple[bytes, int]:
+        """Whole records from `offset` with their framing intact, plus the
+        next read offset.  The zero-framing read for shard workers
+        (ingest/shards.py): the Python record walk moves to whoever consumes
+        the buffer (a converter subprocess), off this thread's GIL.  Empty
+        bytes means caught up."""
         self._check_open()
         end = self.end_offset(partition)
         if offset >= end:
-            return []  # caught up: skip the buffer allocation entirely
+            return b"", offset  # caught up: skip the buffer allocation
         max_bytes = min(max_bytes, end - offset)
         while True:
             buf = ctypes.create_string_buffer(max_bytes)
@@ -210,9 +224,19 @@ class EventLog:
                 )
             if n < 0:
                 raise OSError(f"read from partition {partition} failed")
-            break
+            return buf.raw[:n], next_off.value
+
+    def read(
+        self,
+        partition: int,
+        offset: int,
+        max_bytes: int = 1 << 20,
+        max_msgs: int = 1 << 30,
+    ) -> list[Message]:
+        """Read whole records from `offset`; empty list means caught up."""
+        data, next_off = self.read_raw(partition, offset, max_bytes, max_msgs)
+        n = len(data)
         out: list[Message] = []
-        data = buf.raw[:n]
         pos = 0
         rec_off = offset
         while pos < n:
@@ -223,7 +247,7 @@ class EventLog:
             out.append(Message(partition, rec_off, rec_off + total, key, payload))
             pos += total
             rec_off += total
-        assert rec_off == next_off.value
+        assert n == 0 or rec_off == next_off
         return out
 
     def iter_from(self, partition: int, offset: int) -> Iterator[Message]:

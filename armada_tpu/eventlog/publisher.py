@@ -100,6 +100,30 @@ class Publisher:
         # this one choke point every append path shares.
         self.epoch_source = None
         self._epoch: Optional[int] = None
+        # Publish wakeups (round 18): consumers register a callback fired
+        # AFTER the batch is durably appended+fsynced, with the set of
+        # partitions touched -- the ingestion pipelines' replacement for
+        # their fixed idle poll (a shard sleeps until its partitions have
+        # data, and wakes the instant they do).  Callbacks must be cheap and
+        # non-raising; a slow hook would sit on every publish path.
+        self._wakeups: list[Callable[[set], None]] = []
+
+    def add_wakeup(self, hook: Callable[[set], None]) -> None:
+        """Register a post-publish hook: hook(partitions_touched)."""
+        self._wakeups.append(hook)
+
+    def remove_wakeup(self, hook: Callable[[set], None]) -> None:
+        try:
+            self._wakeups.remove(hook)
+        except ValueError:
+            pass
+
+    def _fire_wakeups(self, partitions: set) -> None:
+        for hook in self._wakeups:
+            try:
+                hook(partitions)
+            except Exception:  # noqa: BLE001 - a broken consumer must not
+                pass  # fail the publish (the data is already durable)
 
     def set_epoch(self, generation: int) -> None:
         """Record the election generation this process currently leads at."""
@@ -141,6 +165,8 @@ class Publisher:
                 off = self._log.append(part, key, chunk.SerializeToString())
                 refs.append(PublishedRef(part, off))
         self._log.flush()
+        if refs:
+            self._fire_wakeups({r.partition for r in refs})
         return refs
 
     def publish_markers(self, group_id: Optional[str] = None) -> str:
@@ -163,6 +189,7 @@ class Publisher:
             )
             self._log.append(part, MARKER_KEY, seq.SerializeToString())
         self._log.flush()
+        self._fire_wakeups(set(range(self._log.num_partitions)))
         return group_id
 
     def _chunks(self, seq: pb.EventSequence) -> Iterable[pb.EventSequence]:
@@ -196,19 +223,30 @@ class Consumer:
     IngestionPipeline (internal/common/ingest/ingestion_pipeline.go:40-79).
     """
 
-    def __init__(self, log: EventLog, positions: Optional[dict[int, int]] = None):
+    def __init__(
+        self,
+        log: EventLog,
+        positions: Optional[dict[int, int]] = None,
+        partitions: Optional[Sequence[int]] = None,
+    ):
+        """`partitions`: restrict this consumer to a subset of the log's
+        partitions (a shard of the partition-parallel ingestion plane,
+        ingest/shards.py); None = all of them (the serial pipeline)."""
         self._log = log
-        self.positions: dict[int, int] = {
-            p: 0 for p in range(log.num_partitions)
-        }
+        self.partitions: tuple[int, ...] = tuple(
+            range(log.num_partitions) if partitions is None else partitions
+        )
+        self.positions: dict[int, int] = {p: 0 for p in self.partitions}
         if positions:
-            self.positions.update(positions)
+            self.positions.update(
+                {p: v for p, v in positions.items() if p in self.positions}
+            )
 
     def poll(self, max_bytes_per_partition: int = 1 << 22) -> ConsumedBatch:
         sequences: list[pb.EventSequence] = []
         messages: list[Message] = []
         next_positions = dict(self.positions)
-        for part in range(self._log.num_partitions):
+        for part in self.partitions:
             batch = self._log.read(
                 part, self.positions[part], max_bytes=max_bytes_per_partition
             )
@@ -225,7 +263,7 @@ class Consumer:
     def caught_up(self) -> bool:
         return all(
             self.positions[p] >= self._log.end_offset(p)
-            for p in range(self._log.num_partitions)
+            for p in self.partitions
         )
 
 

@@ -9,6 +9,10 @@ and exactly-once positioning.
 from armada_tpu.ingest.converter import convert_sequences
 from armada_tpu.ingest.pipeline import IngestionPipeline
 from armada_tpu.ingest.schedulerdb import SchedulerDb
+from armada_tpu.ingest.shards import (
+    PartitionedIngestionPipeline,
+    resolve_num_shards,
+)
 
 
 def scheduler_ingestion_pipeline(
@@ -26,7 +30,9 @@ def scheduler_ingestion_pipeline(
 
 __all__ = [
     "IngestionPipeline",
+    "PartitionedIngestionPipeline",
     "SchedulerDb",
     "convert_sequences",
+    "resolve_num_shards",
     "scheduler_ingestion_pipeline",
 ]

@@ -1,0 +1,8 @@
+"""Stand-in __main__ for ingest converter workers.
+
+Worker processes re-prepare the parent's __main__ module at startup; when
+the parent is a driver script (bench.py imports jax at module level) or a
+<stdin> main, that preparation is respectively expensive and impossible.
+ingest/shards._convert_pool points spec-less mains here instead: importing
+this module is free and side-effect-less by construction.  Keep it that way.
+"""

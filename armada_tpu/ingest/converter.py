@@ -44,7 +44,12 @@ def _convert_event(seq: pb.EventSequence, ev: pb.Event):
                     "jobset": seq.jobset,
                     "priority": int(e.spec.priority),
                     "submitted_ns": int(ev.created_ns),
-                    "spec": e.spec.SerializeToString(),
+                    # deterministic: map-field entry order is otherwise
+                    # process-dependent, and the partition-parallel plane
+                    # converts in WORKER processes -- the stored blob must
+                    # be byte-identical to the serial pipeline's
+                    # (test_ingest_shards pins materialized bit-equality)
+                    "spec": e.spec.SerializeToString(deterministic=True),
                 }
             }
         )

@@ -406,7 +406,12 @@ def append_db_operation(ops: list[DbOperation], op: DbOperation) -> None:
     """Append with merge-past-commuting-ops (dbops.go AppendDbOperation):
     scan from the tail, merging into the first same-shaped op reachable
     without crossing a non-commuting op; if none, append at the end (an op
-    never moves unless it merges -- order stays stable)."""
+    never moves unless it merges -- order stays stable).
+
+    One-shot compatibility surface; batch conversion goes through
+    :func:`merge_ops`, which carries the token cache across appends (an
+    op's token set is re-derived here on every conflict check, which is
+    O(batch) per append against a merged mega-op)."""
     for i in range(len(ops) - 1, -1, -1):
         if ops[i].merge(op):
             return
@@ -415,8 +420,40 @@ def append_db_operation(ops: list[DbOperation], op: DbOperation) -> None:
     ops.append(op)
 
 
+def _disjoint(a: set, b: set) -> bool:
+    # isdisjoint iterates its ARGUMENT: always hand it the smaller side, so
+    # a one-job op checked against a 100k-job merged op costs O(1), not
+    # O(batch).
+    return a.isdisjoint(b) if len(a) <= len(b) else b.isdisjoint(a)
+
+
 def merge_ops(sequences_ops: list[DbOperation]) -> list[DbOperation]:
+    """Fold a converted batch into few, large ops (same semantics as
+    repeated :func:`append_db_operation`, measured-linear instead of
+    quadratic): the merged token set of every op in `out` is maintained
+    INCREMENTALLY -- every merge() implementation is additive (set/dict
+    union), so merged tokens = union of absorbed tokens -- instead of
+    re-derived via tokens() on each conflict check, which made a 10k-
+    sequence batch cost 250M string ops (78s) before round 18."""
     out: list[DbOperation] = []
+    toks: list[set[str]] = []  # cached merged token set per out[i]
+    wild: list[bool] = []  # cached "has wildcard token" per out[i]
     for op in sequences_ops:
-        append_db_operation(out, op)
+        new_tokens = op.tokens()
+        new_wild = any(t.startswith("*") for t in new_tokens)
+        placed = False
+        for i in range(len(out) - 1, -1, -1):
+            if out[i].merge(op):
+                toks[i] |= new_tokens
+                wild[i] = wild[i] or new_wild
+                placed = True
+                break
+            # can_be_applied_before, against the cache: any shared token or
+            # any wildcard on either side blocks reordering.
+            if new_wild or wild[i] or not _disjoint(new_tokens, toks[i]):
+                break
+        if not placed:
+            out.append(op)
+            toks.append(set(new_tokens))
+            wild.append(new_wild)
     return out

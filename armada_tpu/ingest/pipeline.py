@@ -39,12 +39,31 @@ class IngestionPipeline:
         poll_interval: float = 0.05,
     ):
         self.consumer_name = consumer_name
+        self._log = log
         self._consumer = Consumer(log, positions=start_positions)
         self._sink = sink
         self._converter = converter
         self._poll_interval = poll_interval
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Publisher wakeup (Publisher.add_wakeup -> notify): the idle loop
+        # sleeps on this instead of burning the fixed poll interval; the
+        # interval remains the fallback for writers that bypass the
+        # publisher (the log replicator on follower replicas).
+        self._wakeup = threading.Event()
+        self._abandoned = 0
+        from armada_tpu.ingest.stats import RateEstimator
+
+        self._rate = RateEstimator()
+        self._total_events = 0
+        self._total_sequences = 0
+        # One stable bound-method object: the stats registry unregisters by
+        # identity, and `self.snapshot` creates a fresh object per access.
+        self._stats_snapshot = self.snapshot
+
+    def notify(self, partitions: set) -> None:
+        """Publisher-side wakeup hook (any partition: one consumer)."""
+        self._wakeup.set()
 
     def run_once(self) -> int:
         """One consume->convert->store->ack round; returns #sequences applied."""
@@ -67,6 +86,10 @@ class IngestionPipeline:
         # IGNORE / monotonic marks) with the same cursor values.
         faults.check("ingest_ack")
         self._consumer.ack(batch.next_positions)
+        self._total_sequences += len(batch.sequences)
+        n_events = sum(len(s.events) for s in batch.sequences)
+        self._total_events += n_events
+        self._rate.record(n_events)
         return len(batch.sequences)
 
     def run_until_caught_up(self, max_rounds: int = 1_000_000) -> int:
@@ -83,27 +106,75 @@ class IngestionPipeline:
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("pipeline already started")
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        from armada_tpu.ingest.stats import registry as stats_registry
+
+        # A FRESH stop event per start, captured by the loop: an abandoned
+        # (timed-out) thread from a previous start keeps observing ITS
+        # event -- still set -- and exits when it unwedges, instead of
+        # being resurrected by this clear.
+        self._stop = threading.Event()
+        stats_registry().register(self.consumer_name, self._stats_snapshot)
+        self._thread = threading.Thread(
+            target=self._loop,
+            args=(self._stop,),
+            daemon=True,
+            name=f"ingest-{self.consumer_name}",
+        )
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Bounded join (the watchdog's abandon discipline): a store wedged
+        on a dead database must not block SIGTERM drain forever -- log the
+        abandon and let the daemon thread die with the process.  Positions
+        were not acked, so nothing is lost either way."""
+        from armada_tpu.core.logging import get_logger
+        from armada_tpu.ingest.stats import registry as stats_registry
+
         self._stop.set()
+        self._wakeup.set()
         if self._thread is not None:
-            self._thread.join()
+            self._thread.join(timeout=max(0.0, timeout_s))
+            if self._thread.is_alive():
+                self._abandoned += 1
+                get_logger(__name__).warning(
+                    "ingestion pipeline %s did not stop within %.1fs; "
+                    "abandoning the thread",
+                    self.consumer_name,
+                    timeout_s,
+                )
             self._thread = None
+        stats_registry().unregister(self.consumer_name, self._stats_snapshot)
 
     def alive(self) -> bool:
         """True while the background loop is running (feeds health checks)."""
         return self._thread is not None and self._thread.is_alive()
 
-    def _loop(self) -> None:
+    def snapshot(self) -> dict:
+        """The /healthz `ingest` block entry for this consumer (the serial
+        shape of PartitionedIngestionPipeline.snapshot)."""
+        lag = {
+            p: max(0, self._log.end_offset(p) - self._consumer.positions[p])
+            for p in self._consumer.partitions
+        }
+        return {
+            "shards": 1,
+            "alive": self.alive() if self._thread is not None else None,
+            "offload": False,
+            "events_per_s": round(self._rate.value(), 1),
+            "total_events": self._total_events,
+            "total_sequences": self._total_sequences,
+            "lag_bytes": {str(p): v for p, v in sorted(lag.items())},
+            "lag_total": sum(lag.values()),
+            "abandoned_threads": self._abandoned,
+        }
+
+    def _loop(self, stop: threading.Event) -> None:
         from armada_tpu.core.logging import get_logger, log_context
 
         with log_context(consumer=self.consumer_name):
-            self._loop_inner(get_logger(__name__))
+            self._loop_inner(get_logger(__name__), stop)
 
-    def _loop_inner(self, log) -> None:
+    def _loop_inner(self, log, stop: threading.Event) -> None:
         from armada_tpu.core.backoff import Backoff
 
         # Jittered exponential backoff on batch failures (a restarting
@@ -111,11 +182,13 @@ class IngestionPipeline:
         # at the same instant); positions were not acked, so the batch
         # replays exactly-once when the store recovers.
         backoff = Backoff(base_s=self._poll_interval, cap_s=5.0)
-        while not self._stop.is_set():
+        while not stop.is_set():
             try:
                 n = self.run_once()
                 backoff.reset()
             except Exception:  # noqa: BLE001 - service thread must survive
+                if stop.is_set():
+                    break  # teardown (a closing sink), not a failure
                 delay = backoff.next_delay()
                 log.exception(
                     "ingestion pipeline %s: batch failed (attempt %d); "
@@ -124,7 +197,11 @@ class IngestionPipeline:
                     backoff.attempts,
                     delay,
                 )
-                self._stop.wait(delay)
+                stop.wait(delay)
                 continue
             if n == 0:
-                self._stop.wait(self._poll_interval)
+                # Idle: sleep on the publish wakeup with the poll interval
+                # as the fallback (replicated followers append without the
+                # publisher, so the timeout still bounds their lag).
+                self._wakeup.wait(self._poll_interval)
+                self._wakeup.clear()

@@ -72,6 +72,11 @@ class SoakConfig:
     # histogram (RTO); LifecycleTracker then pins zero dropped/double-leased
     # jobs ACROSS the restart.  None = no crash leg.
     crash_at_frac: Optional[float] = None
+    # Partition-parallel ingestion (ingest/shards.py): run the soak world's
+    # ingesters as this many shard workers.  None = ARMADA_INGEST_SHARDS
+    # (the serve knob) or 1; the run's save/restore carries the armed value
+    # through the fault/crash legs like ARMADA_COMMIT_K.
+    ingest_shards: Optional[int] = None
 
     @staticmethod
     def from_env(**overrides) -> "SoakConfig":
@@ -148,6 +153,8 @@ class SoakWorld:
         )
         from armada_tpu.server.queues import QueueRecord
 
+        from armada_tpu.ingest import resolve_num_shards
+
         self.config = SchedulingConfig(
             shape_bucket=64,
             incremental_problem_build=True,
@@ -155,7 +162,14 @@ class SoakWorld:
         )
         factory = self.config.resource_list_factory()
         os.makedirs(data_dir, exist_ok=True)
-        self.log = EventLog(os.path.join(data_dir, "log"), num_partitions=2)
+        self.ingest_shards = resolve_num_shards(cfg.ingest_shards)
+        # The partition count is permanent per data dir (crash legs reopen
+        # it): widen only when sharding is requested from the start.
+        self.log = EventLog(
+            os.path.join(data_dir, "log"),
+            num_partitions=max(2, self.ingest_shards),
+        )
+        self.ingest_shards = min(self.ingest_shards, self.log.num_partitions)
         # The crash leg needs a store that SURVIVES the kill: file-backed
         # SQLite in the data dir (the event log already is).  Plain soaks
         # keep the in-memory default -- durability is not what they measure.
@@ -179,16 +193,35 @@ class SoakWorld:
             )
         self.eventdb = EventDb(":memory:")
         self.publisher = Publisher(self.log)
-        self.scheduler_pipeline = IngestionPipeline(
-            self.log,
-            self.db,
-            convert_sequences,
-            consumer_name="scheduler",
-            start_positions=self.db.positions("scheduler"),
-        )
-        self.event_pipeline = IngestionPipeline(
-            self.log, self.eventdb, event_sink_converter, consumer_name="events"
-        )
+        if self.ingest_shards > 1:
+            from armada_tpu.ingest import PartitionedIngestionPipeline
+
+            self.scheduler_pipeline = PartitionedIngestionPipeline(
+                self.log,
+                self.db,
+                convert_sequences,
+                consumer_name="scheduler",
+                num_shards=self.ingest_shards,
+                start_positions=self.db.positions("scheduler"),
+            )
+            self.event_pipeline = PartitionedIngestionPipeline(
+                self.log,
+                self.eventdb,
+                event_sink_converter,
+                consumer_name="events",
+                num_shards=self.ingest_shards,
+            )
+        else:
+            self.scheduler_pipeline = IngestionPipeline(
+                self.log,
+                self.db,
+                convert_sequences,
+                consumer_name="scheduler",
+                start_positions=self.db.positions("scheduler"),
+            )
+            self.event_pipeline = IngestionPipeline(
+                self.log, self.eventdb, event_sink_converter, consumer_name="events"
+            )
         self.queues = QueueRepository(self.db)
         self.server = SubmitServer(self.db, self.publisher, self.queues, self.config)
         self.event_api = EventApi(self.eventdb)
@@ -373,6 +406,9 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
             # kill/restart resume) untouched, so soak/chaos legs exercise
             # the configuration the operator armed, not a silent K=1.
             "ARMADA_COMMIT_K",
+            # Likewise the armed ingest-shard count (the rebuilt post-crash
+            # world must re-shard identically).
+            "ARMADA_INGEST_SHARDS",
         )
     }
     os.environ.pop("ARMADA_FAULT", None)
@@ -538,6 +574,7 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
         # the ARMED multi-commit width (schedule_round may clamp the
         # effective K to the queue-axis width per pool)
         report["commit_k"] = resolve_commit_k()
+        report["ingest_shards"] = world.ingest_shards
         # Flat headline keys (the bench-JSON soak_* shape).
         for name, src in (
             ("cycle", slo_snap.get("cycle_latency_s", {})),

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import sqlite3
-import threading
 from typing import Iterable, Optional
 
 from armada_tpu.ingest.sqladapter import PgAdapter, is_postgres_url
@@ -101,6 +100,7 @@ class LookoutDb:
     """Store + ingestion sink (lookoutingester/lookoutdb/insertion.go)."""
 
     def __init__(self, path: str = ":memory:"):
+        self._path = path
         self._dialect = "pg" if is_postgres_url(path) else "sqlite"
         if self._dialect == "pg":
             self._conn = PgAdapter(path)
@@ -121,7 +121,19 @@ class LookoutDb:
         if self._dialect == "sqlite":
             self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
-        self._lock = threading.Lock()
+        # tsan-instrumented (round 18): the partition-parallel ingest plane
+        # makes this the multi-writer choke point for the lookout view.
+        from armada_tpu.analysis.tsan import make_lock
+
+        self._lock = make_lock("lookoutdb.store")
+
+    def shard_sink(self) -> "LookoutDb":
+        """Per-shard store leg (ingest/shards.py): external PG gets its own
+        wire connection; embedded SQLite shares this one (same file, same
+        write lock -- a second connection only adds busy-retry churn)."""
+        if self._dialect == "pg":
+            return LookoutDb(self._path)
+        return self
 
     def _table_columns(self, table: str) -> set[str]:
         if self._dialect == "sqlite":

@@ -34,7 +34,10 @@ def lookout_converter(sequences) -> list[dict]:
                         "gpu": int(milli.get("nvidia.com/gpu", 0)),
                         "gang_id": e.spec.gang_id,
                         "annotations": dict(e.spec.annotations),
-                        "spec": e.spec.SerializeToString(),
+                        # deterministic: stable bytes across the sharded
+                        # plane's converter subprocesses (see
+                        # ingest/converter.py)
+                        "spec": e.spec.SerializeToString(deterministic=True),
                         "ts": ts,
                     }
                 )

@@ -276,6 +276,25 @@ class SchedulerMetrics:
             "Event-log records replicated from leaders (monotonic)",
             registry=registry,
         )
+        # Ingest-plane gauges (round 18, ingest/stats.py): per-consumer
+        # apply rate and per-partition lag.  Lag is in log BYTES -- the
+        # honest unit (positions are byte offsets); bytes track events 1:1
+        # for a steady record-size mix.  Stale label sets (a stopped view,
+        # a shrunk partition set) are removed like the explain series.
+        self.ingest_lag = g(
+            "armada_ingest_lag_bytes",
+            "Unapplied event-log backlog per consumer view and partition "
+            "(bytes of log the view's committed cursor trails by)",
+            ["consumer", "partition"],
+        )
+        self.ingest_rate = g(
+            "armada_ingest_events_per_second",
+            "Events applied per second by each consumer view "
+            "(exponentially decayed rate)",
+            ["consumer"],
+        )
+        self._ingest_lag_labels: set = set()
+        self._ingest_rate_labels: set = set()
 
     # --- hooks called by the Scheduler --------------------------------------
 
@@ -320,6 +339,33 @@ class SchedulerMetrics:
                 v = summary.get(q + "_s")
                 if v is not None:
                     self.slo_latency.labels(metric, q).set(v)
+
+    def observe_ingest(self, consumers: dict) -> None:
+        """Publish the ingest stats registry's snapshot
+        (ingest/stats.registry().snapshot), once per cycle; stale
+        consumer/partition label sets are removed."""
+        lag_seen = set()
+        rate_seen = set()
+        for consumer, snap in consumers.items():
+            if not isinstance(snap, dict) or "events_per_s" not in snap:
+                continue
+            rate_seen.add((consumer,))
+            self.ingest_rate.labels(consumer).set(float(snap["events_per_s"]))
+            for part, lag in (snap.get("lag_bytes") or {}).items():
+                lag_seen.add((consumer, str(part)))
+                self.ingest_lag.labels(consumer, str(part)).set(float(lag))
+        for labels in self._ingest_lag_labels - lag_seen:
+            try:
+                self.ingest_lag.remove(*labels)
+            except KeyError:
+                pass
+        for labels in self._ingest_rate_labels - rate_seen:
+            try:
+                self.ingest_rate.remove(*labels)
+            except KeyError:
+                pass
+        self._ingest_lag_labels = lag_seen
+        self._ingest_rate_labels = rate_seen
 
     def observe_trace(self, stage_snapshot: dict) -> None:
         """Publish the trace recorder's per-stage latency snapshot

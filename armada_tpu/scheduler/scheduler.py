@@ -282,6 +282,9 @@ class Scheduler:
             self.metrics.observe_slo(self._slo().snapshot())
             self.metrics.observe_trace(_trace_recorder().stage_snapshot())
             self.metrics.observe_durability(self.durability_status())
+            from armada_tpu.ingest.stats import registry as _ingest_stats
+
+            self.metrics.observe_ingest(_ingest_stats().snapshot())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
         return result

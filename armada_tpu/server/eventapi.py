@@ -57,7 +57,11 @@ class EventDb:
         self._conn.executescript(_SCHEMA)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
-        self._lock = threading.Lock()
+        # tsan-instrumented (round 18): shard store legs of the partition-
+        # parallel ingest plane serialize here.
+        from armada_tpu.analysis.tsan import make_lock
+
+        self._lock = make_lock("eventdb.store")
         self._retention_s = retention_s
 
     def close(self) -> None:
@@ -171,7 +175,9 @@ def event_sink_converter(sequences: list) -> list:
                 seq.queue,
                 seq.jobset,
                 created,
-                zlib.compress(trimmed.SerializeToString()),
+                # deterministic: stable bytes across the sharded plane's
+                # converter subprocesses (see ingest/converter.py)
+                zlib.compress(trimmed.SerializeToString(deterministic=True)),
             )
         )
     return rows

@@ -1110,6 +1110,170 @@ def _restart_bench() -> dict:
     }
 
 
+def _ingest_bench() -> dict:
+    """ARMADA_BENCH_INGEST (default on; =0 skips): ingest-throughput A/B --
+    the serial IngestionPipeline vs the partition-parallel plane
+    (ingest/shards.py, ARMADA_BENCH_INGEST_SHARDS workers, default 8) over
+    the same pre-published full-lifecycle backlog (submit/validate/lease/
+    assign/run/succeed per job: the steady serving mix, production-shaped
+    time-ordered job ids).  Drained state is checked bit-equal (serials
+    excluded, as everywhere).  Best of ARMADA_BENCH_INGEST_REPEATS sharded
+    drains rides the record (page-cache variance; the serial leg is flat).
+    ARMADA_BENCH_INGEST_JOBS downscales.  NOTE: the speedup needs real
+    cores -- a 1-CPU host reports ~1x by construction."""
+    import tempfile
+    import uuid
+
+    from armada_tpu.eventlog import EventLog
+    from armada_tpu.eventlog.publisher import Publisher
+    from armada_tpu.events import events_pb2 as pb
+    from armada_tpu.ingest import (
+        IngestionPipeline,
+        PartitionedIngestionPipeline,
+        SchedulerDb,
+        convert_sequences,
+    )
+
+    n_jobs = int(os.environ.get("ARMADA_BENCH_INGEST_JOBS", 60_000))
+    shards = int(os.environ.get("ARMADA_BENCH_INGEST_SHARDS", 8))
+    repeats = int(os.environ.get("ARMADA_BENCH_INGEST_REPEATS", 2))
+    partitions = max(shards, 8)
+    base_ms = int(time.time() * 1e3)
+
+    def _id(i: int) -> str:
+        # the production job-id shape (server/submit.py): time-prefixed,
+        # so PK b-tree inserts are append-ish instead of random
+        return f"{base_ms + i:013x}-{uuid.uuid4().hex[:12]}"
+
+    def _seqs():
+        out = []
+        for i in range(n_jobs):
+            jid, rid = _id(i), _id(i)
+            out.append(
+                pb.EventSequence(
+                    queue=f"iq{i % 8}",
+                    jobset=f"ijs{i % 512}",
+                    events=[
+                        pb.Event(
+                            created_ns=i + 1,
+                            submit_job=pb.SubmitJob(
+                                job_id=jid,
+                                spec=pb.JobSpec(priority_class="default"),
+                            ),
+                        ),
+                        pb.Event(
+                            job_validated=pb.JobValidated(
+                                job_id=jid, pools=["default"]
+                            )
+                        ),
+                        pb.Event(
+                            job_run_leased=pb.JobRunLeased(
+                                job_id=jid,
+                                run_id=rid,
+                                executor_id="e1",
+                                node_id="n1",
+                                pool="default",
+                                scheduled_at_priority=1000,
+                                update_sequence_number=1,
+                            )
+                        ),
+                        pb.Event(
+                            job_run_assigned=pb.JobRunAssigned(
+                                job_id=jid, run_id=rid
+                            )
+                        ),
+                        pb.Event(
+                            job_run_running=pb.JobRunRunning(
+                                job_id=jid, run_id=rid
+                            )
+                        ),
+                        pb.Event(
+                            job_run_succeeded=pb.JobRunSucceeded(
+                                job_id=jid, run_id=rid
+                            )
+                        ),
+                        pb.Event(job_succeeded=pb.JobSucceeded(job_id=jid)),
+                    ],
+                )
+            )
+        return out
+
+    def _canon(db):
+        jobs, runs = db.fetch_job_updates(0, 0)
+        return (
+            sorted(
+                tuple(r[c] for c in r.keys() if c != "serial") for r in jobs
+            ),
+            sorted(
+                tuple(r[c] for c in r.keys() if c != "serial") for r in runs
+            ),
+        )
+
+    total_events = n_jobs * 7
+    with tempfile.TemporaryDirectory(prefix="armada-bench-ingest-") as d:
+        log = EventLog(os.path.join(d, "log"), num_partitions=partitions)
+        Publisher(log).publish(_seqs())
+
+        db_serial = SchedulerDb(os.path.join(d, "serial.db"))
+        t0 = time.perf_counter()
+        IngestionPipeline(
+            log, db_serial, convert_sequences, consumer_name="scheduler"
+        ).run_until_caught_up()
+        serial_s = time.perf_counter() - t0
+
+        # Warm the converter pool OUTSIDE the measurement (one-time spawn).
+        warm = SchedulerDb(":memory:")
+        PartitionedIngestionPipeline(
+            log, warm, convert_sequences, "scheduler", num_shards=shards
+        ).run_until_caught_up()
+        warm.close()
+
+        best_s = None
+        db_sharded = None
+        for trial in range(max(1, repeats)):
+            if db_sharded is not None:
+                db_sharded.close()
+            db_sharded = SchedulerDb(os.path.join(d, f"sharded{trial}.db"))
+            pipe = PartitionedIngestionPipeline(
+                log,
+                db_sharded,
+                convert_sequences,
+                "scheduler",
+                num_shards=shards,
+            )
+            pipe.start()
+            t0 = time.perf_counter()
+            while sum(pipe.lag().values()):
+                time.sleep(0.003)
+            t = time.perf_counter() - t0
+            pipe.stop()
+            best_s = t if best_s is None else min(best_s, t)
+        equal = _canon(db_serial) == _canon(db_sharded)
+        db_serial.close()
+        db_sharded.close()
+        log.close()
+    serial_eps = total_events / serial_s
+    sharded_eps = total_events / best_s
+    if not equal:
+        print(
+            "bench: INGEST ARM DIVERGED (ingest_equal=false)", file=sys.stderr
+        )
+    print(
+        f"bench: ingest x{shards} shards {serial_eps:,.0f} -> "
+        f"{sharded_eps:,.0f} events/s ({serial_s:.2f}s -> {best_s:.2f}s, "
+        f"{sharded_eps / serial_eps:.2f}x, {total_events} events)",
+        file=sys.stderr,
+    )
+    return {
+        "ingest_events_per_s": round(sharded_eps),
+        "ingest_serial_events_per_s": round(serial_eps),
+        "ingest_speedup": round(sharded_eps / serial_eps, 2),
+        "ingest_shards": shards,
+        "ingest_events": total_events,
+        "ingest_equal": equal,
+    }
+
+
 def main():
     from armada_tpu.core.pipeline import pipeline_enabled as _pipeline_enabled
 
@@ -1241,6 +1405,8 @@ def main():
         line.update(_pools_bench())
     if os.environ.get("ARMADA_BENCH_RESTART", "1") != "0":
         line.update(_restart_bench())
+    if os.environ.get("ARMADA_BENCH_INGEST", "1") != "0":
+        line.update(_ingest_bench())
     if init_err is not None:
         line["backend_fallback"] = init_err
     watchdog.cancel()

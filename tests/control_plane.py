@@ -77,16 +77,35 @@ class ControlPlane:
         config = config or SchedulingConfig(shape_bucket=32, enable_assertions=True)
         clock = ManualClock()
         factory = config.resource_list_factory()
-        log = EventLog(str(tmp_path / "log"), num_partitions=2)
+        # ARMADA_INGEST_SHARDS arms the partition-parallel ingest plane for
+        # the whole harness (chaos_cycle --ingest-shards rides this), so
+        # the integration suites exercise the sharded path when armed.
+        from armada_tpu.ingest import resolve_num_shards
+
+        shards = resolve_num_shards()
+        log = EventLog(str(tmp_path / "log"), num_partitions=max(2, shards))
+        shards = min(shards, log.num_partitions)
         db = SchedulerDb(db_url or ":memory:")
         eventdb = EventDb(":memory:")
         publisher = Publisher(log, clock=clock)
-        scheduler_pipeline = IngestionPipeline(
-            log, db, convert_sequences, consumer_name="scheduler"
-        )
-        event_pipeline = IngestionPipeline(
-            log, eventdb, event_sink_converter, consumer_name="events"
-        )
+        if shards > 1:
+            from armada_tpu.ingest import PartitionedIngestionPipeline
+
+            scheduler_pipeline = PartitionedIngestionPipeline(
+                log, db, convert_sequences, consumer_name="scheduler",
+                num_shards=shards,
+            )
+            event_pipeline = PartitionedIngestionPipeline(
+                log, eventdb, event_sink_converter, consumer_name="events",
+                num_shards=shards,
+            )
+        else:
+            scheduler_pipeline = IngestionPipeline(
+                log, db, convert_sequences, consumer_name="scheduler"
+            )
+            event_pipeline = IngestionPipeline(
+                log, eventdb, event_sink_converter, consumer_name="events"
+            )
         queues = QueueRepository(db)
         server = SubmitServer(db, publisher, queues, config, clock=clock)
         jobdb = JobDb(config)

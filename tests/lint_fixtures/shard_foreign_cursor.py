@@ -1,0 +1,17 @@
+# Fixture for rule `shard-foreign-cursor` (linted under
+# armada_tpu/ingest/).  The twin line is syntactically IDENTICAL to the
+# true positive after normalization; it stores a batch through the SAME
+# shard whose poll produced the positions -- exactly what every shard of
+# the partition-parallel pipeline does.  Only value-flow provenance (which
+# shard's poll the next_positions derive from) separates the two: the TP
+# acks ANOTHER shard's partitions in this shard's transaction, so a crash
+# between the two shards' stores silently skips a batch on restart.
+
+
+def drain(shard, sibling, consumer):
+    buffers, nxt = shard.poll_raw(shard.positions)
+    buffers2, nxt2 = sibling.poll_raw(sibling.positions)
+    shard.sink.store(buffers, consumer, next_positions=nxt2)  # TP
+    sibling.sink.store(buffers2, consumer, next_positions=nxt2)  # twin
+    shard.sink.store(buffers, consumer, next_positions=nxt)  # near miss: own poll
+    shard.sink.store(buffers, consumer, next_positions={0: 0})  # near miss: literal

@@ -1,0 +1,585 @@
+"""Partition-parallel ingestion (ingest/shards.py): the ISSUE 15 contract.
+
+The parity pin: draining the SAME event log through the serial
+IngestionPipeline and through PartitionedIngestionPipeline (any shard
+count, inline or subprocess conversion, with or without a mid-drain
+per-shard ingest_ack crash + restart) must materialize bit-equal scheduler
+state -- raw serial columns excluded, as everywhere (batching differs, so
+serial VALUES legitimately diverge; see tests/test_restart_recovery.py).
+Plus the control-plane barrier (a queue sweep sees every event published
+before it, across all partitions), the publisher wakeup hook, the bounded
+stop() abandon discipline, and the log's partition-count adoption."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from armada_tpu.eventlog import EventLog, Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.ingest import (
+    IngestionPipeline,
+    PartitionedIngestionPipeline,
+    SchedulerDb,
+    convert_sequences,
+)
+from armada_tpu.ingest import shards as shards_mod
+from armada_tpu.loadgen.workload import (
+    CancelOp,
+    MixConfig,
+    ReprioritizeOp,
+    SubmitOp,
+    WorkloadGenerator,
+)
+from armada_tpu.server.queues import QueueRecord
+from tests.control_plane import ControlPlane
+
+SHARDS = 4
+
+
+def _materialized(db: SchedulerDb) -> dict:
+    """Every materialized table as canonical tuples, serial columns and the
+    serials counter table scrubbed (the bit-equality surface)."""
+    from armada_tpu.ingest.schedulerdb import SNAPSHOT_TABLES
+
+    snap = db.export_snapshot()
+    out = {}
+    for table, cols in SNAPSHOT_TABLES.items():
+        if table == "serials":
+            continue
+        rows = snap[table]
+        if "serial" in cols:
+            i = cols.index("serial")
+            rows = [r[:i] + r[i + 1 :] for r in rows]
+        out[table] = sorted(rows)
+    return out
+
+
+def _churn_plane(tmp_path, seed: int) -> ControlPlane:
+    """A world with real submit/cancel/reprioritise/gang churn + scheduling
+    cycles, so the log carries the full production event mix (leases, run
+    transitions, requeues, errors)."""
+    plane = ControlPlane.build(tmp_path)
+    jobset = f"shards-{seed}"
+    mix = MixConfig(
+        num_queues=2,
+        queue_prefix=f"sh{seed}",
+        jobset=jobset,
+        gang_fraction=0.15,
+    )
+    gen = WorkloadGenerator(mix, seed=seed)
+    for q in gen.queues:
+        plane.server.create_queue(QueueRecord(q))
+    for _ in range(6):
+        for op in gen.next_ops(10):
+            if isinstance(op, SubmitOp):
+                ids = plane.server.submit_jobs(op.queue, jobset, op.items)
+                gen.note_submitted(op.queue, ids)
+            elif isinstance(op, CancelOp):
+                plane.server.cancel_jobs(
+                    op.queue, jobset, op.job_ids, reason="churn"
+                )
+            elif isinstance(op, ReprioritizeOp):
+                plane.server.reprioritize_jobs(
+                    op.queue, jobset, op.priority, job_ids=op.job_ids
+                )
+        plane.step()
+    plane.ingest()
+    return plane
+
+
+def _serial_replay(log) -> SchedulerDb:
+    db = SchedulerDb(":memory:")
+    IngestionPipeline(
+        log, db, convert_sequences, consumer_name="scheduler"
+    ).run_until_caught_up()
+    return db
+
+
+@pytest.mark.parametrize(
+    "seed,mode", [(0, "process"), (1, "inline"), (2, "inline")]
+)
+def test_sharded_replay_bit_equal_serial_over_churn(
+    tmp_path, monkeypatch, seed, mode
+):
+    """Serial vs sharded drains of the same churned log materialize
+    identical state; seed 0 additionally routes conversion through the
+    subprocess pool (the production sharded shape)."""
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", str(SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = _churn_plane(tmp_path, seed)
+    try:
+        db_serial = _serial_replay(plane.log)
+        db_sharded = SchedulerDb(":memory:")
+        pipe = PartitionedIngestionPipeline(
+            plane.log,
+            db_sharded,
+            convert_sequences,
+            consumer_name="scheduler",
+            num_shards=SHARDS,
+            convert_mode=mode,
+        )
+        n = pipe.run_until_caught_up()
+        assert n > 0
+        assert _materialized(db_serial) == _materialized(db_sharded)
+        assert db_serial.positions("scheduler") == db_sharded.positions(
+            "scheduler"
+        )
+        db_serial.close()
+        db_sharded.close()
+    finally:
+        plane.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_exactly_once_under_per_shard_crash(tmp_path, monkeypatch, seed):
+    """The satellite drill: ingest_ack fires in ONE shard mid-drain (its
+    batch COMMITTED, the in-memory ack died), the pipeline is restarted
+    from the store's committed positions, and the final state is bit-equal
+    to the serial drain -- under the tsan race harness."""
+    from armada_tpu.analysis import tsan
+    from armada_tpu.core import faults
+
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", str(SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = _churn_plane(tmp_path, seed)
+    tsan_was = tsan.enabled()
+    monkeypatch.setenv("ARMADA_TSAN", "1")
+    tsan.enable()
+    tsan.reset()
+    try:
+        db_serial = _serial_replay(plane.log)
+        db_sharded = SchedulerDb(":memory:")
+        faults.reset_counters()
+        # after_n=1: the crash lands mid-drain, past the first batch.
+        monkeypatch.setenv("ARMADA_FAULT", "ingest_ack:error:1")
+        pipe = PartitionedIngestionPipeline(
+            plane.log,
+            db_sharded,
+            convert_sequences,
+            consumer_name="scheduler",
+            num_shards=SHARDS,
+            convert_mode="inline",
+        )
+        with pytest.raises(faults.FaultInjected):
+            pipe.run_until_caught_up()
+        monkeypatch.delenv("ARMADA_FAULT")
+        # The crashed shard's batch is committed but unacked: a RESTARTED
+        # plane resumes from the store's positions and must not double-
+        # apply it.
+        pipe2 = PartitionedIngestionPipeline(
+            plane.log,
+            db_sharded,
+            convert_sequences,
+            consumer_name="scheduler",
+            num_shards=SHARDS,
+            start_positions=db_sharded.positions("scheduler"),
+            convert_mode="inline",
+        )
+        pipe2.run_until_caught_up()
+        assert _materialized(db_serial) == _materialized(db_sharded)
+        violations = tsan.take_violations()
+        assert not violations, "\n".join(violations)
+        db_serial.close()
+        db_sharded.close()
+    finally:
+        if not tsan_was:
+            tsan.disable()
+        plane.close()
+
+
+def test_control_plane_jobset_constant_matches_server():
+    """shards.py duplicates the reserved stream name by value (workers must
+    not import the server package); this pins the two never diverge."""
+    from armada_tpu.server.controlplane import CONTROL_PLANE_JOBSET
+
+    assert shards_mod.CONTROL_PLANE_JOBSET == CONTROL_PLANE_JOBSET
+
+
+def _submit_event(jid: str) -> pb.Event:
+    return pb.Event(
+        created_ns=1,
+        submit_job=pb.SubmitJob(job_id=jid, spec=pb.JobSpec()),
+    )
+
+
+def test_control_plane_barrier_orders_sweep_after_all_partitions(tmp_path):
+    """A CancelOnQueue sweep published AFTER submits spread over every
+    partition must see all of them at apply time, even though the sweep's
+    shard could otherwise race ahead of its siblings."""
+    log = EventLog(str(tmp_path / "log"), num_partitions=8)
+    pub = Publisher(log)
+    pub.publish(
+        [
+            pb.EventSequence(
+                queue="cq", jobset=f"js{i}", events=[_submit_event(f"cjob{i}")]
+            )
+            for i in range(64)
+        ]
+    )
+    pub.publish(
+        [
+            pb.EventSequence(
+                queue="",
+                jobset=shards_mod.CONTROL_PLANE_JOBSET,
+                events=[
+                    pb.Event(
+                        created_ns=5,
+                        cancel_on_queue=pb.CancelOnQueue(name="cq"),
+                    )
+                ],
+            )
+        ]
+    )
+    db = SchedulerDb(":memory:")
+    pipe = PartitionedIngestionPipeline(
+        log,
+        db,
+        convert_sequences,
+        consumer_name="scheduler",
+        num_shards=4,
+        convert_mode="inline",
+    )
+    pipe.run_until_caught_up()
+    jobs, _ = db.fetch_job_updates(0, 0)
+    assert len(jobs) == 64
+    assert all(r["cancel_requested"] == 1 for r in jobs)
+    db.close()
+    log.close()
+
+
+def test_control_plane_barrier_threaded(tmp_path):
+    """Same guarantee with background shard threads: the barrier waits on
+    sibling COMMITS instead of driving them inline."""
+    log = EventLog(str(tmp_path / "log"), num_partitions=8)
+    pub = Publisher(log)
+    pub.publish(
+        [
+            pb.EventSequence(
+                queue="tq", jobset=f"js{i}", events=[_submit_event(f"tjob{i}")]
+            )
+            for i in range(64)
+        ]
+    )
+    pub.publish(
+        [
+            pb.EventSequence(
+                queue="",
+                jobset=shards_mod.CONTROL_PLANE_JOBSET,
+                events=[
+                    pb.Event(
+                        created_ns=5,
+                        cancel_on_queue=pb.CancelOnQueue(name="tq"),
+                    )
+                ],
+            )
+        ]
+    )
+    db = SchedulerDb(":memory:")
+    pipe = PartitionedIngestionPipeline(
+        log,
+        db,
+        convert_sequences,
+        consumer_name="scheduler",
+        num_shards=4,
+        convert_mode="inline",
+    )
+    pub.add_wakeup(pipe.notify)
+    pipe.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            jobs, _ = db.fetch_job_updates(0, 0)
+            if len(jobs) == 64 and all(
+                r["cancel_requested"] == 1 for r in jobs
+            ):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("sweep did not converge under threads")
+    finally:
+        pipe.stop()
+        db.close()
+        log.close()
+
+
+def test_threaded_barrier_resyncs_sibling_partition_cursor(tmp_path):
+    """Regression: the fence drains the control shard's OTHER owned
+    partitions past its prefetch read cursor; the loop must resync ALL
+    owned partitions afterward or it re-reads (and re-applies) the drained
+    span AFTER the sweep and commits that cursor backward.  Tiny poll
+    batches force the multi-batch window the bug needs."""
+    from armada_tpu.eventlog.publisher import jobset_key, partition_for_key
+
+    log = EventLog(str(tmp_path / "log"), num_partitions=4)
+    pub = Publisher(log)
+    # Deterministic trigger: every submit lands on the control shard's
+    # SIBLING partition (same shard, different partition -- chosen by key
+    # hash), and the control record is ALONE on the control partition, so
+    # the sweep is detected on the very first 2KB poll round while the
+    # sibling still holds ~20 undrained batches -- exactly the window
+    # where the fence drains past the prefetch cursor.
+    control = shards_mod.control_partition_of(log)
+    sibling = (control + 2) % 4
+    seqs = []
+    i = 0
+    while len(seqs) < 400:
+        jobset = f"js{i}"
+        i += 1
+        if partition_for_key(jobset_key("rq", jobset), 4) != sibling:
+            continue
+        seqs.append(
+            pb.EventSequence(
+                queue="rq",
+                jobset=jobset,
+                events=[_submit_event(f"rjob{len(seqs)}")],
+            )
+        )
+    pub.publish(seqs)
+    pub.publish(
+        [
+            pb.EventSequence(
+                queue="",
+                jobset=shards_mod.CONTROL_PLANE_JOBSET,
+                events=[
+                    pb.Event(
+                        created_ns=5,
+                        cancel_on_queue=pb.CancelOnQueue(name="rq"),
+                    )
+                ],
+            )
+        ]
+    )
+    db = SchedulerDb(":memory:")
+    pipe = PartitionedIngestionPipeline(
+        log,
+        db,
+        convert_sequences,
+        consumer_name="scheduler",
+        num_shards=2,  # control shard owns 2 partitions
+        convert_mode="inline",
+        max_bytes_per_partition=2048,
+    )
+    pipe.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and sum(pipe.lag().values()):
+            time.sleep(0.02)
+        assert sum(pipe.lag().values()) == 0
+    finally:
+        pipe.stop()
+    jobs, _ = db.fetch_job_updates(0, 0)
+    assert len(jobs) == 400
+    # the barrier guarantee: every submit published before the sweep is
+    # swept (NOTE this is deliberately STRONGER than a one-poll serial
+    # drain, where submits in partitions after the sweep's apply later)
+    assert all(r["cancel_requested"] == 1 for r in jobs)
+    # cursors ended exactly at the log end (never regressed); partitions
+    # that never carried data never get cursor rows
+    assert db.positions("scheduler") == {
+        p: log.end_offset(p) for p in range(4) if log.end_offset(p)
+    }
+    # ... and nothing was re-read: 401 published sequences, 401 processed.
+    # The pre-fix loop re-read the span the fence had drained past the
+    # prefetch cursor and re-applied it after the sweep.
+    assert pipe.total_sequences == 401
+    db.close()
+    log.close()
+
+
+def test_wakeup_hook_beats_the_poll_interval(tmp_path):
+    """With a deliberately huge poll interval, a publish still becomes
+    visible promptly through the publisher wakeup hook -- the fixed idle
+    poll is a fallback, not the latency floor."""
+    log = EventLog(str(tmp_path / "log"), num_partitions=4)
+    pub = Publisher(log)
+    db = SchedulerDb(":memory:")
+    pipe = PartitionedIngestionPipeline(
+        log,
+        db,
+        convert_sequences,
+        consumer_name="scheduler",
+        num_shards=2,
+        poll_interval=30.0,
+        convert_mode="inline",
+    )
+    pub.add_wakeup(pipe.notify)
+    pipe.start()
+    try:
+        time.sleep(0.2)  # let the shards reach their idle wait
+        t0 = time.monotonic()
+        pub.publish(
+            [
+                pb.EventSequence(
+                    queue="wq", jobset="wjs", events=[_submit_event("wake-1")]
+                )
+            ]
+        )
+        while time.monotonic() - t0 < 5.0:
+            jobs, _ = db.fetch_job_updates(0, 0)
+            if jobs:
+                break
+            time.sleep(0.005)
+        latency = time.monotonic() - t0
+        assert jobs and jobs[0]["job_id"] == "wake-1"
+        assert latency < 5.0  # far under the 30s poll interval
+    finally:
+        pipe.stop()
+        db.close()
+        log.close()
+
+
+class _WedgedSink:
+    """A sink whose store never returns (a dead database mid-call)."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def store(self, batch, consumer="x", next_positions=None):
+        self.entered.set()
+        self.release.wait(30.0)
+
+    def positions(self, consumer="x"):
+        return {}
+
+
+@pytest.mark.parametrize("cls", ["serial", "sharded"])
+def test_stop_abandons_wedged_store_thread(tmp_path, cls):
+    """The satellite fix: stop() joins with a timeout and ABANDONS a store
+    wedged past it (the watchdog discipline) instead of hanging SIGTERM
+    drain forever."""
+    log = EventLog(str(tmp_path / "log"), num_partitions=2)
+    Publisher(log).publish(
+        [pb.EventSequence(queue="q", jobset="j", events=[_submit_event("w1")])]
+    )
+    sink = _WedgedSink()
+    if cls == "serial":
+        pipe = IngestionPipeline(log, sink, convert_sequences, "wedge")
+    else:
+        pipe = PartitionedIngestionPipeline(
+            log,
+            sink,
+            convert_sequences,
+            "wedge",
+            num_shards=2,
+            convert_mode="inline",
+        )
+    pipe.start()
+    assert sink.entered.wait(10.0)
+    t0 = time.monotonic()
+    pipe.stop(timeout_s=0.3)
+    assert time.monotonic() - t0 < 5.0  # did not wait for the wedged store
+    assert not pipe.alive()
+    assert pipe.snapshot()["abandoned_threads"] >= 1
+    sink.release.set()  # drain the zombie so the test process stays clean
+    log.close()
+
+
+def test_eventlog_partition_adoption_and_mismatch(tmp_path):
+    """num_partitions=None adopts the persisted width (the serve restart
+    path); an explicit mismatch still refuses."""
+    path = str(tmp_path / "log")
+    log = EventLog(path, num_partitions=6)
+    log.close()
+    adopted = EventLog(path)  # no explicit count: adopt META
+    assert adopted.num_partitions == 6
+    adopted.close()
+    with pytest.raises(ValueError, match="6 partitions"):
+        EventLog(path, num_partitions=8)
+
+
+def test_render_store_plan_matches_store(tmp_path):
+    """render_scheduler_ops + store_plan == store for the full renderable
+    op mix (the worker-side path is the same SQL by construction; this
+    pins it stays that way)."""
+    from armada_tpu.ingest.schedulerdb import render_scheduler_ops
+
+    events = [
+        _submit_event("p1"),
+        pb.Event(job_validated=pb.JobValidated(job_id="p1", pools=["d"])),
+        pb.Event(
+            job_run_leased=pb.JobRunLeased(
+                job_id="p1",
+                run_id="r1",
+                executor_id="e1",
+                node_id="n1",
+                pool="d",
+                scheduled_at_priority=10,
+                update_sequence_number=1,
+            )
+        ),
+        pb.Event(job_run_running=pb.JobRunRunning(job_id="p1", run_id="r1")),
+        pb.Event(
+            job_run_errors=pb.JobRunErrors(
+                job_id="p1",
+                run_id="r1",
+                errors=[pb.Error(reason="oom", message="x", terminal=True)],
+            )
+        ),
+        pb.Event(job_succeeded=pb.JobSucceeded(job_id="p1")),
+        pb.Event(
+            queue_upsert=pb.QueueUpsert(name="qq", weight=2.0)
+        ),
+    ]
+    ops_batch = convert_sequences(
+        [pb.EventSequence(queue="q", jobset="js", events=events)]
+    )
+    plan = render_scheduler_ops(ops_batch)
+    assert plan is not None
+    db_a = SchedulerDb(":memory:")
+    db_a.store(ops_batch, next_positions={0: 10})
+    db_b = SchedulerDb(":memory:")
+    db_b.store_plan(plan, next_positions={0: 10})
+    assert _materialized(db_a) == _materialized(db_b)
+    # ... and the columnar pipe packing round-trips the plan exactly
+    unpacked = shards_mod._unpack_plan(shards_mod._pack_plan(plan))
+    db_c = SchedulerDb(":memory:")
+    db_c.store_plan(unpacked, next_positions={0: 10})
+    assert _materialized(db_a) == _materialized(db_c)
+    db_a.close()
+    db_b.close()
+    db_c.close()
+
+
+def test_unrenderable_sweep_falls_back_to_ops(tmp_path):
+    """A batch holding an apply-time-membership op (CancelOnQueue) renders
+    to None -- the shard ships raw ops and the sink applies them
+    in-transaction instead."""
+    from armada_tpu.ingest import dbops
+    from armada_tpu.ingest.schedulerdb import render_scheduler_ops
+
+    batch = [
+        dbops.InsertJobs(jobs={"z1": {"job_id": "z1", "queue": "q", "jobset": "j"}}),
+        dbops.CancelOnQueue(queue="q"),
+    ]
+    assert render_scheduler_ops(batch) is None
+
+
+def test_sharded_world_end_to_end(tmp_path, monkeypatch):
+    """The whole control plane driven with sharded ingesters (the
+    chaos_cycle --ingest-shards shape): jobs submit, lease and finish
+    through PartitionedIngestionPipeline."""
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", "2")
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = ControlPlane.build(tmp_path)
+    try:
+        assert isinstance(
+            plane.scheduler_pipeline, PartitionedIngestionPipeline
+        )
+        from armada_tpu.server.submit import JobSubmitItem
+
+        plane.server.create_queue(QueueRecord("swq"))
+        plane.server.submit_jobs(
+            "swq",
+            "js",
+            [JobSubmitItem(resources={"cpu": "1", "memory": "1"})],
+        )
+        plane.run_until(
+            lambda: "succeeded" in plane.job_states().values(), max_steps=40
+        )
+    finally:
+        plane.close()

@@ -78,6 +78,10 @@ RULE_FIXTURES = {
         "pool_dispatch_mutation.py",
         "armada_tpu/scheduler/fixture.py",
     ),
+    "shard-foreign-cursor": (
+        "shard_foreign_cursor.py",
+        "armada_tpu/ingest/fixture.py",
+    ),
 }
 
 # The value-flow rules whose fixtures carry a `# twin` line: a
@@ -89,6 +93,7 @@ TWIN_RULES = [
     "commit-scatter-gathered-old",
     "unpinned-out-shardings",
     "pool-dispatch-mutation",
+    "shard-foreign-cursor",
 ]
 
 

@@ -386,6 +386,17 @@ def main() -> int:
         "to the full mesh, and every cycle's decisions must stay bit-equal "
         "to the clean replay (docs/multichip.md runbook)",
     )
+    ap.add_argument(
+        "--ingest-shards",
+        type=int,
+        default=None,
+        dest="ingest_shards",
+        help="arm the partition-parallel ingest plane (ARMADA_INGEST_SHARDS, "
+        "ingest/shards.py) for EVERY leg -- faulted run, clean replay, and "
+        "the soak/crash legs (their env save/restore keeps it armed) -- so "
+        "convergence is exercised against the sharded ingesters, not a "
+        "silent serial pipeline (default: inherit the environment)",
+    )
     args = ap.parse_args()
 
     if args.commit_k is not None:
@@ -393,6 +404,8 @@ def main() -> int:
         # so both replay legs and the soak/crash sub-drills (whose env
         # save/restore keeps ARMADA_COMMIT_K intact) compile the armed K.
         os.environ["ARMADA_COMMIT_K"] = str(args.commit_k)
+    if args.ingest_shards is not None:
+        os.environ["ARMADA_INGEST_SHARDS"] = str(args.ingest_shards)
 
     if args.mesh:
         # The drill must run anywhere: give the CPU platform enough virtual
@@ -601,6 +614,10 @@ def main() -> int:
     # the multi-commit width every leg compiled with (bit-equality above
     # therefore covers the armed kernel, not just K=1)
     line["commit_k"] = resolve_commit_k()
+    from armada_tpu.ingest import resolve_num_shards
+
+    # the ingest-shard width every leg ran with (--ingest-shards / env)
+    line["ingest_shards"] = resolve_num_shards()
     if args.mesh:
         line["mesh"] = {
             "requested": args.mesh,

@@ -77,6 +77,16 @@ def main() -> int:
         "environment",
     )
     ap.add_argument(
+        "--ingest-shards",
+        type=int,
+        default=None,
+        dest="ingest_shards",
+        help="partition-parallel ingestion width (ingest/shards.py; sets "
+        "ARMADA_INGEST_SHARDS for the window incl. the fault/crash legs "
+        "via the drill's env save/restore); default: inherit the "
+        "environment (1 = serial)",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="JSON-line output (the default; kept for bench.py symmetry)",
@@ -84,6 +94,8 @@ def main() -> int:
     args = ap.parse_args()
     if args.commit_k is not None:
         os.environ["ARMADA_COMMIT_K"] = str(args.commit_k)
+    if args.ingest_shards is not None:
+        os.environ["ARMADA_INGEST_SHARDS"] = str(args.ingest_shards)
 
     # Tests force CPU; a standalone run uses whatever backend is healthy.
     from armada_tpu.loadgen.soak import SoakConfig, run_soak_cli
