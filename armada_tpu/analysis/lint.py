@@ -1406,6 +1406,124 @@ def _shard_foreign_cursor(src: Source):
                             bindings[sub.id] = tags
 
 
+@rule(
+    "store-shard-foreign-write",
+    "a store-shard write executed through ANOTHER shard's database handle: "
+    "each store shard file/schema owns a disjoint partition set, so a batch "
+    "written through a foreign handle lands rows in a file future ingestion "
+    "never updates (and whose cursor fence commits elsewhere) -- route "
+    "every write through the shard_sink/shard_store handle of the shard "
+    "index that produced the payload (round 19)",
+    scope=under("armada_tpu/"),
+)
+def _store_shard_foreign_write(src: Source):
+    # Value-flow per function: a handle bound from `X.shard_sink(K, n)` /
+    # `X.shard_store(K)` is tagged with its shard-index expression K; a
+    # value bound from a subscript (per-shard batch/plan/position
+    # collections, `plans[K]`) carries the index tag too.  A `.store` /
+    # `.store_plan` / `.execute` through a tagged handle whose payload
+    # carries ONLY different-index tags is flagged.  Untagged payloads
+    # (parameters, literals) stay clean -- provenance unknown is not a
+    # violation, it is the single-store shape.
+    if "shard_sink" not in src.text and "shard_store" not in src.text:
+        return
+    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+
+    def _key(expr: ast.AST) -> str:
+        return ast.dump(expr, annotate_fields=False, include_attributes=False)
+
+    def _handle_index(call: ast.AST) -> Optional[str]:
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("shard_sink", "shard_store")
+            and call.args
+        ):
+            return _key(call.args[0])
+        return None
+
+    for fn in (
+        n
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ):
+        handles: dict = {}  # name -> frozenset of shard-index keys
+        data: dict = {}  # name -> frozenset of shard-index keys
+
+        def data_tags(node) -> frozenset:
+            out: set = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out |= data.get(sub.id, frozenset())
+            return frozenset(out)
+
+        def _own_exprs(st):
+            # the statement's OWN expressions: nested statements get their
+            # own document-order turn (checking them here would run the
+            # write check before their preceding bindings land)
+            for field, value in ast.iter_fields(st):
+                if field in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                for node in value if isinstance(value, list) else [value]:
+                    if isinstance(node, ast.AST) and not isinstance(
+                        node, ast.stmt
+                    ):
+                        yield from ast.walk(node)
+
+        for st in _pool_fn_stmts(fn):
+            # (1) writes: handle shard index vs the payload's provenance
+            for sub in _own_exprs(st):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("store", "store_plan", "execute")
+                ):
+                    continue
+                recv = sub.func.value
+                hidx = _handle_index(recv)
+                if hidx is not None:
+                    rtags = frozenset({hidx})
+                elif isinstance(recv, ast.Name):
+                    rtags = handles.get(recv.id, frozenset())
+                else:
+                    rtags = frozenset()
+                if not rtags:
+                    continue
+                ptags: set = set()
+                for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                    ptags |= data_tags(arg)
+                if ptags and rtags.isdisjoint(ptags):
+                    yield _finding(
+                        src,
+                        "store-shard-foreign-write",
+                        sub,
+                        "payload derived from a different shard index than "
+                        "this handle's shard_sink/shard_store index: the "
+                        "rows land in a file that shard's ingestion and "
+                        "cursor fence never touch -- write through the "
+                        "producing shard's own handle",
+                    )
+            # (2) binding propagation: handles carry their index expression,
+            # subscripted per-shard collections carry theirs
+            if isinstance(st, ast.Assign) and st.value is not None:
+                val = st.value
+                hidx = _handle_index(val)
+                if hidx is not None:
+                    for tgt in st.targets:
+                        for s2 in ast.walk(tgt):
+                            if isinstance(s2, ast.Name):
+                                handles[s2.id] = frozenset({hidx})
+                    continue
+                if isinstance(val, ast.Subscript):
+                    tags = frozenset({_key(val.slice)})
+                else:
+                    tags = data_tags(val)
+                for tgt in st.targets:
+                    for s2 in ast.walk(tgt):
+                        if isinstance(s2, ast.Name):
+                            data[s2.id] = tags
+
+
 _THREAD_SPAWNERS = {"threading.Thread", "Thread", "_thread.start_new_thread"}
 
 

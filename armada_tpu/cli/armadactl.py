@@ -456,6 +456,7 @@ def cmd_soak(args):
             watchdog_s=args.watchdog_s,
             crash_at_frac=getattr(args, "crash", None),
             ingest_shards=getattr(args, "ingest_shards", None),
+            store_shards=getattr(args, "store_shards", None),
             **overrides,
         )
     )
@@ -707,6 +708,9 @@ _SERVE_FALLBACKS = {
     # None -> start_control_plane resolves ARMADA_INGEST_SHARDS (1 = the
     # serial ingestion pipeline).
     "ingest_shards": None,
+    # None -> start_control_plane resolves ARMADA_STORE_SHARDS (1 = the
+    # single-writer materialized stores).
+    "store_shards": None,
     # None -> EventLog adopts an existing log's persisted width, else
     # ARMADA_LOG_PARTITIONS, else 4.
     "log_partitions": None,
@@ -767,6 +771,7 @@ def load_serve_config(args):
         "explain_interval": ("explaininterval", int),
         "verify": ("verify", bool),
         "ingest_shards": ("ingestshards", int),
+        "store_shards": ("storeshards", int),
         "log_partitions": ("logpartitions", int),
     }
     for attr, (key, cast) in mapping.items():
@@ -829,6 +834,7 @@ def cmd_serve(args):
         explain_interval=getattr(args, "explain_interval", None),
         verify_rounds=getattr(args, "verify", None),
         ingest_shards=getattr(args, "ingest_shards", None),
+        store_shards=getattr(args, "store_shards", None),
         num_partitions=getattr(args, "log_partitions", None),
     )
     print(f"armada-tpu control plane listening on {args.bind_host}:{plane.port}")
@@ -1112,6 +1118,18 @@ def build_parser() -> argparse.ArgumentParser:
         "ARMADA_INGEST_SHARDS env; capped at --log-partitions)",
     )
     srv.add_argument(
+        "--store-shards",
+        type=int,
+        dest="store_shards",
+        help="sharded materialized stores (ingest/storeunion.py): give each "
+        "ingest shard its own store leg -- one SQLite file (or PG schema) "
+        "per store shard owning a disjoint partition set -- behind one "
+        "union read surface (default 1 = the single-writer stores; "
+        "ARMADA_STORE_SHARDS env).  Width is PERMANENT per store "
+        "directory; --ingest-shards must be a multiple (it defaults to "
+        "this value when unset)",
+    )
+    srv.add_argument(
         "--log-partitions",
         type=int,
         dest="log_partitions",
@@ -1270,6 +1288,15 @@ def build_parser() -> argparse.ArgumentParser:
         dest="ingest_shards",
         help="partition-parallel ingestion width for the soak world "
         "(ingest/shards.py); default: ARMADA_INGEST_SHARDS or 1 (serial)",
+    )
+    sk.add_argument(
+        "--store-shards",
+        type=int,
+        default=None,
+        dest="store_shards",
+        help="sharded materialized store width for the soak world "
+        "(ingest/storeunion.py; the ingest width rounds up to a multiple); "
+        "default: ARMADA_STORE_SHARDS or 1 (one writer)",
     )
     sk.set_defaults(fn=cmd_soak)
 

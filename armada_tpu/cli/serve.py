@@ -167,6 +167,7 @@ def start_control_plane(
     explain_interval: Optional[int] = None,
     verify_rounds: Optional[bool] = None,
     ingest_shards: Optional[int] = None,
+    store_shards: Optional[int] = None,
 ) -> ControlPlaneProcess:
     """health_port: serve /health liveness (+ /debug/pprof/* when
     `profiling`) on this port, 0 = pick a free one (common/health,
@@ -248,13 +249,52 @@ def start_control_plane(
             num_partitions = None
     log = EventLog(os.path.join(data_dir, "eventlog"), num_partitions=num_partitions)
     num_partitions = log.num_partitions
+    # Sharded materialized stores (serve --store-shards /
+    # ARMADA_STORE_SHARDS; ingest/storeunion.py): W store legs -- one
+    # SQLite file (or PG schema) per store shard, each owning a disjoint
+    # partition set -- behind one union read surface.  Width is PERMANENT
+    # per store directory (STORE_META adoption); 0/1 keeps the plain
+    # single-writer stores.  The event store (events.db) is partition-keyed
+    # already and stays single-file.
+    if store_shards is None:
+        try:
+            store_shards = int(os.environ.get("ARMADA_STORE_SHARDS", "0"))
+        except ValueError:
+            store_shards = 0
+    store_shards = max(0, store_shards)
+    if store_shards > num_partitions:
+        # Refuse BEFORE creating shard files -- width is permanent per
+        # store directory, and partitions route p % W, so W > P would
+        # leave shards that can never own a partition.
+        raise ValueError(
+            f"--store-shards {store_shards} exceeds the log's "
+            f"{num_partitions} partitions"
+        )
     # External DBs (postgres:// via the pure-python wire driver,
     # ingest/pgwire.py) or the embedded per-replica SQLite defaults.
-    db = SchedulerDb(database_url or os.path.join(data_dir, "scheduler.db"))
+    if store_shards > 1:
+        from armada_tpu.ingest.storeunion import (
+            ShardedLookoutDb,
+            ShardedSchedulerDb,
+        )
+
+        db = ShardedSchedulerDb(
+            database_url or os.path.join(data_dir, "store-shards"),
+            num_shards=store_shards,
+            num_partitions=num_partitions,
+        )
+        lookoutdb = ShardedLookoutDb(
+            lookout_database_url
+            or os.path.join(data_dir, "lookout-shards"),
+            num_shards=store_shards,
+            num_partitions=num_partitions,
+        )
+    else:
+        db = SchedulerDb(database_url or os.path.join(data_dir, "scheduler.db"))
+        lookoutdb = LookoutDb(
+            lookout_database_url or os.path.join(data_dir, "lookout.db")
+        )
     eventdb = EventDb(os.path.join(data_dir, "events.db"))
-    lookoutdb = LookoutDb(
-        lookout_database_url or os.path.join(data_dir, "lookout.db")
-    )
     # Bounded-replay restart (scheduler/checkpoint.py): load the newest
     # valid snapshot into the scheduler store BEFORE the ingestion pipelines
     # read their start positions, so they replay only the log suffix past
@@ -285,7 +325,23 @@ def start_control_plane(
     # rows and store leg.  1 (the default) keeps the serial pipeline.
     from armada_tpu.ingest import PartitionedIngestionPipeline, resolve_num_shards
 
+    ingest_shards_explicit = (
+        ingest_shards is not None or "ARMADA_INGEST_SHARDS" in os.environ
+    )
     ingest_shards = min(resolve_num_shards(ingest_shards), num_partitions)
+    if store_shards > 1:
+        # Store shard = partition % W, ingest shard = partition % N: an
+        # ingest shard's partitions all land in ONE store file only when W
+        # divides N (the batch must stay one transaction).  An unspecified
+        # ingest width follows the store width.
+        if not ingest_shards_explicit:
+            ingest_shards = store_shards
+        if ingest_shards % store_shards != 0:
+            raise ValueError(
+                f"--ingest-shards {ingest_shards} must be a multiple of "
+                f"--store-shards {store_shards} (each ingest shard's "
+                "partition set must live in one store shard)"
+            )
 
     def _pipeline(sink, converter, consumer):
         if ingest_shards > 1:
@@ -584,6 +640,7 @@ def start_control_plane(
 
         health_server.ingest_status = lambda: {
             "shards_configured": ingest_shards,
+            "store_shards": store_shards if store_shards > 1 else 1,
             "log_partitions": num_partitions,
             "consumers": _ingest_stats().snapshot(),
         }

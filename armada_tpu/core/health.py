@@ -224,8 +224,10 @@ class _Handler(BaseHTTPRequestHandler):
             if srv.ingest_status is not None:
                 # Ingest-plane block (ingest/stats.py): per-consumer
                 # events/s and per-partition lag, shard counts, abandoned
-                # threads -- whether the materialized views keep up with
-                # the log, per view.
+                # threads, and per-shard store-leg write latency
+                # (`store_write`, round 19 sharded stores) -- whether the
+                # materialized views keep up with the log, per view, and
+                # whether the store legs commit in parallel or convoy.
                 try:
                     body["ingest"] = srv.ingest_status()
                 except Exception as exc:  # noqa: BLE001

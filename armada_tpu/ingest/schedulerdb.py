@@ -26,6 +26,7 @@ set, a real Postgres.
 from __future__ import annotations
 
 import sqlite3
+from itertools import repeat as _repeat
 from typing import Iterable, NamedTuple, Optional
 
 from armada_tpu.analysis.tsan import make_lock
@@ -191,6 +192,73 @@ from armada_tpu.ingest.sqladapter import (  # noqa: E402
 )
 
 
+class SerialAllocator:
+    """Globally-ordered serial allocation across the shard files of ONE
+    sharded store (ingest/storeunion.py).
+
+    The scheduler's incremental fetch is a single int cursor per domain
+    (`serial > last_seen`, advanced to the max serial seen) -- sound against
+    one writer because serial allocation and commit serialize under the same
+    store lock.  Shard files commit CONCURRENTLY, so two invariants must be
+    re-established process-side:
+
+      * uniqueness/order: one shared counter hands out serials across all
+        shards (each shard records its own allocations in its local
+        `serials` table, so a reopen re-seeds the counter from the max
+        across shards);
+      * read safety: a shard can commit serial 101 while serial 100 is
+        still in another shard's open transaction -- a reader that advances
+        its cursor to 101 would then silently skip 100 forever.  The
+        allocator tracks in-flight (allocated, not yet committed) serials
+        per domain and exposes `horizon()` = the largest serial S such that
+        no serial <= S is still in flight; union reads clamp
+        `serial <= horizon` so the max-advance cursor contract survives.
+
+    A discarded (rolled-back) serial is a permanent gap: it is removed from
+    the in-flight set and never appears in any shard, so the horizon passes
+    it and replayed batches allocate fresh serials.
+    """
+
+    _DOMAINS = ("jobs", "runs")
+
+    def __init__(self):
+        self._lock = make_lock("schedulerdb.serial_alloc")
+        self._next = {d: 1 for d in self._DOMAINS}
+        self._inflight: dict[str, set[int]] = {d: set() for d in self._DOMAINS}
+
+    def seed(self, name: str, value: int) -> None:
+        """Raise the counter past a persisted high-water mark (shard open /
+        snapshot restore).  Never lowers it."""
+        with self._lock:
+            nxt = self._next.setdefault(name, 1)
+            if value + 1 > nxt:
+                self._next[name] = value + 1
+
+    def allocate(self, name: str) -> int:
+        with self._lock:
+            v = self._next.setdefault(name, 1)
+            self._next[name] = v + 1
+            self._inflight.setdefault(name, set()).add(v)
+            return v
+
+    def committed(self, serials: Iterable[tuple[str, int]]) -> None:
+        with self._lock:
+            for name, v in serials:
+                self._inflight.get(name, set()).discard(v)
+
+    # A rolled-back serial leaves a permanent gap; same bookkeeping.
+    discarded = committed
+
+    def horizon(self, name: str) -> int:
+        """Largest serial safe to advance a fetch cursor past: every serial
+        <= horizon is either committed in some shard or a permanent gap."""
+        with self._lock:
+            infl = self._inflight.get(name)
+            if infl:
+                return min(infl) - 1
+            return self._next.get(name, 1) - 1
+
+
 # --- op rendering (round 18) -------------------------------------------------
 # A DbOperation rendered to (SQL, parameter rows) with the serial's insertion
 # point parameterized -- serials are allocated inside the store transaction,
@@ -206,8 +274,14 @@ from armada_tpu.ingest.sqladapter import (  # noqa: E402
 class PlanStmt(NamedTuple):
     domain: Optional[str]  # serials-table counter to allocate, or None
     sql: str
-    params: object  # list of row tuples when `many`, else one params tuple
-    serial_pos: int  # index where the allocated serial slots into each row
+    # `many` statements carry COLUMNAR params: a TUPLE of per-column lists
+    # (one pass at render, one zip at execute -- the serial splices in as an
+    # itertools.repeat column instead of per-row tuple surgery, and the
+    # subprocess pipe packs/unpacks them without a transpose).  A LIST of
+    # row tuples is still accepted for compatibility.  Non-`many`
+    # statements carry one params tuple.
+    params: object
+    serial_pos: int  # column index where the allocated serial slots in
     many: bool
 
 
@@ -241,27 +315,29 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
     """Render one op, or None when it needs the live tables to resolve."""
     t = type(op)
     if t is ops.InsertJobs:
+        rows = list(op.jobs.values())
         return [
             PlanStmt(
                 "jobs",
                 _SQL_INSERT_JOBS,
-                [
-                    tuple(row.get(c, d) for c, d in _JOBS_COL_DEFAULTS)
-                    for row in op.jobs.values()
-                ],
+                tuple(
+                    [row.get(c, d) for row in rows]
+                    for c, d in _JOBS_COL_DEFAULTS
+                ),
                 len(JOBS_COLUMNS),
                 True,
             )
         ]
     if t is ops.InsertRuns:
+        rows = list(op.runs.values())
         return [
             PlanStmt(
                 "runs",
                 _SQL_INSERT_RUNS,
-                [
-                    tuple(row.get(c, d) for c, d in _RUNS_COL_DEFAULTS)
-                    for row in op.runs.values()
-                ],
+                tuple(
+                    [row.get(c, d) for row in rows]
+                    for c, d in _RUNS_COL_DEFAULTS
+                ),
                 len(RUNS_COLUMNS),
                 True,
             )
@@ -272,7 +348,7 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
             PlanStmt(
                 "jobs",
                 f"UPDATE jobs SET {flag} = 1{extra}, serial = ? WHERE job_id = ?",
-                [(jid,) for jid in op.job_ids],
+                (list(op.job_ids),),
                 0,
                 True,
             )
@@ -285,7 +361,7 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
                 "runs",
                 f"UPDATE runs SET {flag} = 1{run_attempted}, serial = ? "
                 "WHERE run_id = ?",
-                [(rid,) for rid in op.runs],
+                (list(op.runs),),
                 0,
                 True,
             )
@@ -293,13 +369,14 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
     if t is ops.MarkRunsRunning:
         # Record when the run started (short-job penalty window); keep the
         # earliest timestamp on replay.
+        rids = list(op.runs)
         return [
             PlanStmt(
                 "runs",
                 "UPDATE runs SET running = 1, run_attempted = 1, serial = ?, "
                 "running_ns = CASE WHEN running_ns > 0 THEN running_ns ELSE ? END "
                 "WHERE run_id = ?",
-                [(int(op.times.get(rid, 0)), rid) for rid in op.runs],
+                ([int(op.times.get(rid, 0)) for rid in rids], rids),
                 0,
                 True,
             )
@@ -310,10 +387,10 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
                 "jobs",
                 "UPDATE jobs SET validated = 1, pools = ?, serial = ? "
                 "WHERE job_id = ?",
-                [
-                    (",".join(pools), jid)
-                    for jid, pools in op.pools_by_job.items()
-                ],
+                (
+                    [",".join(p) for p in op.pools_by_job.values()],
+                    list(op.pools_by_job),
+                ),
                 1,
                 True,
             )
@@ -323,21 +400,24 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
             PlanStmt(
                 "jobs",
                 "UPDATE jobs SET priority = ?, serial = ? WHERE job_id = ?",
-                [(p, jid) for jid, p in op.priority_by_job.items()],
+                (list(op.priority_by_job.values()), list(op.priority_by_job)),
                 1,
                 True,
             )
         ]
     if t is ops.UpdateJobQueuedState:
+        versions = [v for (_q, v) in op.state_by_job.values()]
         return [
             PlanStmt(
                 "jobs",
                 "UPDATE jobs SET queued = ?, queued_version = ?, serial = ? "
                 "WHERE job_id = ? AND queued_version < ?",
-                [
-                    (int(queued), version, jid, version)
-                    for jid, (queued, version) in op.state_by_job.items()
-                ],
+                (
+                    [int(q) for (q, _v) in op.state_by_job.values()],
+                    versions,
+                    list(op.state_by_job),
+                    versions,
+                ),
                 2,
                 True,
             )
@@ -367,14 +447,14 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
         # run exists yet (job still queued, or the lease materializes
         # later), the scheduler acts on the job flag instead of silently
         # dropping the request.
-        rows = [(jid,) for jid in op.job_ids]
+        ids = list(op.job_ids)
         return [
             PlanStmt(
                 "runs",
                 "UPDATE runs SET preempt_requested = 1, serial = ? "
                 "WHERE job_id = ? AND succeeded = 0 AND failed = 0 "
                 "AND cancelled = 0 AND preempted = 0 AND returned = 0",
-                rows,
+                (ids,),
                 0,
                 True,
             ),
@@ -382,7 +462,7 @@ def render_op(op: ops.DbOperation) -> Optional[list[PlanStmt]]:
                 "jobs",
                 "UPDATE jobs SET preempt_requested = 1, serial = ? "
                 "WHERE job_id = ? AND cancelled = 0 AND succeeded = 0 AND failed = 0",
-                list(rows),
+                (list(ids),),
                 0,
                 True,
             ),
@@ -519,13 +599,34 @@ class SchedulerDb:
     """Scheduler state store + ingestion sink (SQLite file / :memory:, or
     external PostgreSQL via a postgres:// URL)."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(
+        self,
+        path: str = ":memory:",
+        serial_allocator: Optional[SerialAllocator] = None,
+        pg_schema: Optional[str] = None,
+    ):
         self._path = path
         self._dialect = "pg" if is_postgres_url(path) else "sqlite"
         if self._dialect == "pg":
-            self._conn = _PgAdapter(path)
+            # pg_schema pins this store's tables into a per-shard schema
+            # (ingest/storeunion.py); the session SQL replays on every
+            # reconnect so a dropped session never falls back to public.
+            session_sql = ()
+            if pg_schema:
+                session_sql = (
+                    f"CREATE SCHEMA IF NOT EXISTS {pg_schema}",
+                    f"SET search_path TO {pg_schema}",
+                )
+            self._conn = _PgAdapter(path, session_sql=session_sql)
         else:
-            self._conn = sqlite3.connect(path, check_same_thread=False)
+            if pg_schema:
+                raise ValueError("pg_schema requires a postgres:// URL")
+            # 512 cached prepared statements (default 128): the store's own
+            # ~30 texts plus the power-of-two IN buckets of every read shape
+            # must all stay resident across batches for executemany reuse.
+            self._conn = sqlite3.connect(
+                path, check_same_thread=False, cached_statements=512
+            )
             self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
         self._migrate()
@@ -554,6 +655,15 @@ class SchedulerDb:
         # makes this the multi-writer choke point -- every shard's store leg
         # serializes here, and the race harness must see the ordering.
         self._lock = make_lock("schedulerdb.store")
+        # Sharded-store serial discipline (round 19): when this store is one
+        # shard file of a ShardedSchedulerDb, serials come from the shared
+        # allocator (globally ordered across shards) and this store's local
+        # `serials` rows record its own high-water mark for reopen seeding.
+        self._alloc = serial_allocator
+        self._txn_serials: list[tuple[str, int]] = []
+        if serial_allocator is not None:
+            for name, value in self._query("SELECT name, value FROM serials"):
+                serial_allocator.seed(str(name), int(value))
 
     def _table_columns(self, table: str) -> set[str]:
         if self._dialect == "sqlite":
@@ -612,6 +722,19 @@ class SchedulerDb:
     # --- serials ------------------------------------------------------------
 
     def _next_serial(self, cur: sqlite3.Cursor, name: str) -> int:
+        if self._alloc is not None:
+            # Shard-file mode: the shared allocator orders serials across
+            # every shard of the store; this shard's own allocations are
+            # monotonic, so a plain last-write upsert records the local
+            # high-water mark (reopen seeds the allocator from it).
+            serial = self._alloc.allocate(name)
+            self._txn_serials.append((name, serial))
+            cur.execute(
+                "INSERT INTO serials(name, value) VALUES (?, ?) "
+                "ON CONFLICT(name) DO UPDATE SET value = excluded.value",
+                (name, serial),
+            )
+            return serial
         cur.execute(
             "INSERT INTO serials(name, value) VALUES (?, 1) "
             "ON CONFLICT(name) DO UPDATE SET value = value + 1",
@@ -619,6 +742,17 @@ class SchedulerDb:
         )
         row = cur.execute("SELECT value FROM serials WHERE name = ?", (name,)).fetchone()
         return int(row[0])
+
+    def _serials_settled(self, committed: bool) -> None:
+        """Tell the shared allocator this transaction's serials landed (or
+        became permanent gaps).  The window between the DB commit and this
+        call only HOLDS BACK the union read horizon -- safe direction."""
+        if self._alloc is not None and self._txn_serials:
+            if committed:
+                self._alloc.committed(self._txn_serials)
+            else:
+                self._alloc.discarded(self._txn_serials)
+            self._txn_serials = []
 
     # --- ingestion sink -----------------------------------------------------
 
@@ -657,8 +791,10 @@ class SchedulerDb:
                         (consumer, part, pos),
                     )
                 self._conn.commit()
+                self._serials_settled(committed=True)
             except BaseException:
                 self._conn.rollback()
+                self._serials_settled(committed=False)
                 raise
 
     def _query(self, sql: str, params=()) -> list[sqlite3.Row]:
@@ -708,6 +844,12 @@ class SchedulerDb:
             except BaseException:
                 self._conn.rollback()
                 raise
+            if self._alloc is not None:
+                # A restored serials table may sit past the allocator's
+                # counter (snapshot from a longer-lived plane); re-seed so
+                # fresh allocations stay globally monotonic.
+                for row in cur.execute("SELECT name, value FROM serials"):
+                    self._alloc.seed(str(row[0]), int(row[1]))
 
     def positions(self, consumer: str = "scheduler") -> dict[int, int]:
         rows = self._query(
@@ -722,23 +864,40 @@ class SchedulerDb:
         """Run rendered statements, allocating serials in-transaction.
         Serials ride as bound parameters, never interpolated literals: the
         statement TEXT stays constant across batches, so the PG adapter's
-        translate cache (and sqlite3's statement cache) actually hit."""
+        translate cache (and sqlite3's statement cache) actually hit.
+        Columnar `many` params stream through ONE zip -- the serial joins as
+        a repeat() column instead of per-row tuple slicing (the r19
+        one-pass packing; ~6% of the single-writer leg)."""
+        lazy_rows = self._dialect == "sqlite"  # pgwire chunks via len()
         for st in plan:
-            if st.domain is None:
-                if st.many:
-                    cur.executemany(st.sql, st.params)
-                else:
-                    cur.execute(st.sql, st.params)
-                continue
-            serial = self._next_serial(cur, st.domain)
+            serial = (
+                self._next_serial(cur, st.domain)
+                if st.domain is not None
+                else None
+            )
             pos = st.serial_pos
-            if st.many:
-                cur.executemany(
-                    st.sql, [r[:pos] + (serial,) + r[pos:] for r in st.params]
-                )
-            else:
+            if not st.many:
                 p = st.params
-                cur.execute(st.sql, p[:pos] + (serial,) + p[pos:])
+                if serial is not None:
+                    p = p[:pos] + (serial,) + p[pos:]
+                cur.execute(st.sql, p)
+                continue
+            params = st.params
+            if isinstance(params, tuple):  # columnar: per-column sequences
+                if serial is None:
+                    rows = zip(*params)
+                else:
+                    n = len(params[0]) if params else 0
+                    rows = zip(
+                        *params[:pos], _repeat(serial, n), *params[pos:]
+                    )
+                cur.executemany(st.sql, rows if lazy_rows else list(rows))
+            elif serial is None:
+                cur.executemany(st.sql, params)
+            else:
+                cur.executemany(
+                    st.sql, [r[:pos] + (serial,) + r[pos:] for r in params]
+                )
 
     def store_plan(
         self,
@@ -763,21 +922,33 @@ class SchedulerDb:
                         (consumer, part, pos),
                     )
                 self._conn.commit()
+                self._serials_settled(committed=True)
             except BaseException:
                 self._conn.rollback()
+                self._serials_settled(committed=False)
                 raise
 
     # Shipped to shard converter subprocesses by dotted name
     # (ingest/shards.py): must stay a module-level function.
     plan_renderer = staticmethod(render_scheduler_ops)
 
-    def shard_sink(self) -> "SchedulerDb":
+    # Sharded stores own their shard sinks for the store's lifetime (the
+    # pipeline must not close them in stop()); the plain store's PG sinks
+    # are per-pipeline throwaways.
+    shard_sinks_owned_by_store = False
+
+    def shard_sink(
+        self, shard_index: int = 0, num_shards: int = 1
+    ) -> "SchedulerDb":
         """The store leg for ONE shard of the partition-parallel ingest
         plane.  External PG: a dedicated wire connection, so shard store
         transactions pipeline server-side instead of queueing on one
         socket.  Embedded SQLite: the shared connection (same file, same
         write lock -- a second connection only adds busy-retry churn);
-        the tsan-guarded store lock serializes shard commits."""
+        the tsan-guarded store lock serializes shard commits.  The plain
+        store ignores (shard_index, num_shards) -- every shard funnels into
+        the one writer; ShardedSchedulerDb routes shard k to store file
+        k % width (ingest/storeunion.py)."""
         if self._dialect == "pg":
             return SchedulerDb(self._path)
         return self

@@ -168,10 +168,12 @@ def _pack_plan(plan) -> list[tuple]:
     """Columnar transform for the pipe: pickling a plan as 100k+ small row
     tuples costs ~0.4s of main-process GIL to unpickle; as a handful of
     per-column lists it is a few big C-speed loads + one zip per statement
-    (measured ~3x cheaper on the receiving side)."""
+    (measured ~3x cheaper on the receiving side).  Renderers now emit
+    columnar tuples natively (schedulerdb.PlanStmt) -- those ship as-is;
+    legacy row-list params still get transposed here."""
     packed = []
     for st in plan:
-        if st.many and st.params:
+        if st.many and st.params and not isinstance(st.params, tuple):
             packed.append(
                 (st.domain, st.sql, tuple(zip(*st.params)), st.serial_pos, True)
             )
@@ -187,9 +189,11 @@ def _unpack_plan(packed: list[tuple]):
 
     plan = []
     for domain, sql, params, serial_pos, many in packed:
-        if many and params:
-            params = list(zip(*params))
-        elif many:
+        # Columnar tuples pass straight through -- _execute_plan streams
+        # them row-wise via one zip; only legacy row lists need no work
+        # here either, so everything is passthrough now that renderers are
+        # columnar.  (Empty many-params normalize to an empty list.)
+        if many and not params:
             params = []
         plan.append(PlanStmt(domain, sql, params, serial_pos, many))
     return plan
@@ -289,6 +293,19 @@ class _Shard:
         self.positions = {p: start_positions.get(p, 0) for p in self.partitions}
         self.wakeup = threading.Event()
         self.thread: Optional[threading.Thread] = None
+        # Store-leg write latency (this shard's sink transactions): feeds
+        # /healthz's per-shard block and the
+        # armada_ingest_store_write_seconds{consumer,shard} gauge.  Written
+        # only by this shard's thread; read racily by snapshot() (floats --
+        # a torn read shows a stale value, never corruption).
+        self.store_writes = 0
+        self.store_s_total = 0.0
+        self.store_last_s = 0.0
+
+    def _note_store_write(self, dt: float) -> None:
+        self.store_writes += 1
+        self.store_s_total += dt
+        self.store_last_s = dt
 
     # ------------------------------------------------------------ polling --
 
@@ -368,6 +385,7 @@ class _Shard:
     def _store_converted(self, result: tuple, nxt: dict[int, int]) -> int:
         kind, payload, n_seqs, n_events = result
         pipe = self.pipeline
+        t0 = time.perf_counter()
         if kind == "plan":
             self.sink.store_plan(
                 payload, consumer=pipe.consumer_name, next_positions=nxt
@@ -376,6 +394,7 @@ class _Shard:
             self.sink.store(
                 payload, consumer=pipe.consumer_name, next_positions=nxt
             )
+        self._note_store_write(time.perf_counter() - t0)
         pipe.rate.record(n_events)
         pipe.note_counts(n_seqs, n_events)
         return n_seqs
@@ -432,11 +451,13 @@ class _Shard:
             ]
             n_events = sum(len(s.events) for s in sequences)
             nxt = {part: segment[-1][2]}
+            t0 = time.perf_counter()
             self.sink.store(
                 pipe.converter(sequences),
                 consumer=pipe.consumer_name,
                 next_positions=nxt,
             )
+            self._note_store_write(time.perf_counter() - t0)
             faults.check("ingest_ack")
             self._ack(nxt)
             pipe.rate.record(n_events)
@@ -599,7 +620,9 @@ class PartitionedIngestionPipeline:
                 self,
                 k,
                 [p for p in range(log.num_partitions) if p % self.num_shards == k],
-                sink.shard_sink() if hasattr(sink, "shard_sink") else sink,
+                sink.shard_sink(k, self.num_shards)
+                if hasattr(sink, "shard_sink")
+                else sink,
                 start_positions,
             )
             for k in range(self.num_shards)
@@ -607,9 +630,14 @@ class PartitionedIngestionPipeline:
         # Shard sinks WE created (external PG: one wire connection each;
         # embedded stores return the shared sink) are closed on stop() --
         # otherwise every pipeline lifecycle leaks N server-side sessions.
-        self._owned_sinks = [
-            s.sink for s in self.shards if s.sink is not sink
-        ]
+        # Sharded stores (ingest/storeunion.py) OWN their shard legs for
+        # the store's lifetime -- a pipeline restart reuses the same files,
+        # so stop() must not close them.
+        self._owned_sinks = (
+            []
+            if getattr(sink, "shard_sinks_owned_by_store", False)
+            else [s.sink for s in self.shards if s.sink is not sink]
+        )
         # One stable bound-method object: the stats registry unregisters by
         # identity.  Registration happens in start() (serving pipelines);
         # synchronously-driven pipelines never register.
@@ -842,6 +870,21 @@ class PartitionedIngestionPipeline:
             out.update(shard.lag())
         return out
 
+    def store_write_stats(self) -> dict[str, dict]:
+        """Per-shard store-leg write latency: {shard: {writes, avg_s,
+        last_s}}.  Shards sharing one store file (plain embedded sink)
+        still report separately -- the spread is what shows a single-writer
+        convoy vs a sharded store's parallel legs."""
+        out: dict[str, dict] = {}
+        for shard in self.shards:
+            n = shard.store_writes
+            out[str(shard.idx)] = {
+                "writes": n,
+                "avg_s": round(shard.store_s_total / n, 6) if n else 0.0,
+                "last_s": round(shard.store_last_s, 6),
+            }
+        return out
+
     def snapshot(self) -> dict:
         """The /healthz `ingest` block entry for this consumer."""
         lag = self.lag()
@@ -856,6 +899,7 @@ class PartitionedIngestionPipeline:
             "lag_total": sum(lag.values()),
             "abandoned_threads": self._abandoned,
             "control_partition": self.control_partition,
+            "store_write": self.store_write_stats(),
         }
 
     def _disable_offload(self, exc: BaseException) -> None:

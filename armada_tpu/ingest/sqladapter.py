@@ -107,10 +107,15 @@ class PgAdapter:
     batch, which is exactly-once by consumer positions), but the process
     does not need a restart to resume."""
 
-    def __init__(self, dsn: str):
+    def __init__(self, dsn: str, session_sql: tuple = ()):
         from armada_tpu.ingest.pgwire import PgError, ProtocolError
 
         self._dsn = dsn
+        # Statements replayed raw on EVERY (re)connect, before any caller
+        # statement -- the store-shard schema pin (CREATE SCHEMA IF NOT
+        # EXISTS / SET search_path) rides here.  Executed outside any
+        # transaction so session-scoped settings survive a later rollback.
+        self._session_sql = tuple(session_sql)
         self._pg = None
         self._translated: dict[str, str] = {}
         self._in_txn = False
@@ -163,6 +168,8 @@ class PgAdapter:
                         time.sleep(delay)
             self._in_txn = False
             self._connected_once = True
+            for stmt in self._session_sql:
+                self._pg.execute(stmt)
         return self._pg
 
     def _drop_session(self) -> None:

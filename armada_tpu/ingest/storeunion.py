@@ -1,0 +1,593 @@
+"""Sharded materialized stores: one file (or PG schema) per store shard,
+one logical read surface (round 19).
+
+Round 18 sharded the ingest PIPELINE and then measured the next wall
+exactly: every shard's rendered SQL plan still funnels through ONE store
+writer (~0.78s per 140k row-ops pre-repair), so shard workers past ~8 buy
+nothing.  This module applies the same share-nothing decomposition one
+layer down.  Each store shard is a full :class:`SchedulerDb` /
+:class:`LookoutDb` over its own SQLite file (``schedulerdb.shard-<k>.sqlite``;
+per-shard PG schemas on an external server), holding ONLY its partition
+set's rows; the consumer-cursor fence stays per-(consumer, partition) and
+commits inside the owning shard's transaction, exactly as before -- the
+exactly-once argument is unchanged, just W-way parallel.
+
+Routing is a pure function of the event-log partition: partition p lives
+in store shard ``p % num_shards``.  Ingest shard k (of N) therefore maps to
+store shard ``k % W`` -- sound only when W divides N (every partition an
+ingest shard owns lands in one file, so its batch stays one transaction);
+``shard_sink`` enforces it.  Jobs are partition-owned (the publisher keys
+by (queue, jobset)), so no row ever spans shards; '$control-plane' rows
+(queues, executor settings, markers' control rows, dedup) land in the
+control partition's shard, which doubles as the GLOBALS shard for the
+store's own direct verbs (upsert_queue and friends) so a row never has two
+homes.
+
+Reads go through a union: SQLite ATTACHes every shard file to one reader
+connection and presents TEMP VIEWs named exactly like the base tables
+(UNION ALL over shards), so the inherited query surface -- JobDb mirror
+loads, checkpoint export, replicator min_acked, lookout REST -- runs
+unchanged.  External PG gets schema-qualified UNION ALL views in the
+public schema (built once, CREATE OR REPLACE).
+
+Serial discipline: shard files commit CONCURRENTLY, so the single-cursor
+``fetch_job_updates`` contract (advance to max serial seen) needs the
+shared :class:`SerialAllocator` -- globally ordered allocation plus a
+committed HORIZON that union reads clamp to (``serial <= horizon``), so a
+cursor can never advance past a serial still sitting in another shard's
+open transaction.  See schedulerdb.SerialAllocator for the full argument.
+
+Width is PERMANENT per store directory (same doctrine as the event log's
+partition count): ``STORE_META.json`` records it, ``num_shards=None``
+adopts, a mismatch refuses.  SQLite's compiled SQLITE_MAX_ATTACHED default
+is 10, which bounds the embedded width.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+from typing import Optional
+
+from armada_tpu.analysis.tsan import make_lock
+from armada_tpu.core import statefile
+from armada_tpu.eventlog.publisher import partition_for_key
+from armada_tpu.ingest.schedulerdb import (
+    SNAPSHOT_TABLES,
+    SchedulerDb,
+    SerialAllocator,
+)
+from armada_tpu.ingest.shards import _CONTROL_KEY
+from armada_tpu.ingest.sqladapter import PgAdapter, is_postgres_url
+from armada_tpu.lookout.db import LookoutDb
+
+_META_NAME = "STORE_META.json"
+
+# SQLite compiles SQLITE_MAX_ATTACHED=10 by default; the reader holds one
+# ATTACH per shard file.
+_MAX_SQLITE_SHARDS = 10
+
+
+def _load_meta_pg(
+    conn,
+    meta_table: str,
+    num_shards: Optional[int],
+    num_partitions: Optional[int],
+) -> tuple[int, int]:
+    """The PG variant of width persistence: a public meta table instead of
+    STORE_META.json, same adopt-or-refuse semantics."""
+    conn.execute(
+        f"CREATE TABLE IF NOT EXISTS {meta_table} "
+        "(key TEXT PRIMARY KEY, value BIGINT NOT NULL)"
+    )
+    conn.commit()
+    rows = conn.execute(f"SELECT key, value FROM {meta_table}").fetchall()
+    meta = {str(r["key"]): int(r["value"]) for r in rows}
+    if meta:
+        w, p = meta["num_shards"], meta["num_partitions"]
+        if num_shards is not None and num_shards != w:
+            raise ValueError(
+                f"store was created with num_shards={w}; refusing "
+                f"num_shards={num_shards} (width is permanent)"
+            )
+        if num_partitions is not None and num_partitions != p:
+            raise ValueError(
+                f"store was created for num_partitions={p}; refusing "
+                f"num_partitions={num_partitions}"
+            )
+        return w, p
+    if num_shards is None or num_partitions is None:
+        raise ValueError(
+            "no store-shard meta rows: a fresh sharded store needs "
+            "explicit num_shards and num_partitions"
+        )
+    conn.executemany(
+        f"INSERT INTO {meta_table} (key, value) VALUES (?, ?)",
+        [("num_shards", num_shards), ("num_partitions", num_partitions)],
+    )
+    conn.commit()
+    return num_shards, num_partitions
+
+
+def _pg_union_views(
+    conn,
+    tables: dict[str, tuple[str, ...]],
+    schemas: list[str],
+) -> None:
+    """Public-schema UNION ALL views over the per-shard schemas.  CREATE OR
+    REPLACE fails loudly if a base TABLE of the same name already exists in
+    public -- a database that previously held a plain (unsharded) store
+    must be migrated, not silently shadowed."""
+    for table, cols in tables.items():
+        collist = ", ".join(cols)
+        union = " UNION ALL ".join(
+            f"SELECT {collist} FROM {schema}.{table}" for schema in schemas
+        )
+        conn.execute(f"CREATE OR REPLACE VIEW {table} AS {union}")
+    conn.commit()
+
+
+def _load_meta(
+    store_dir: str, num_shards: Optional[int], num_partitions: Optional[int]
+) -> tuple[int, int]:
+    """Adopt-or-refuse width persistence (the event log's META doctrine):
+    a store directory's shard count and its log's partition count are
+    PERMANENT -- rows were routed by them, and reopening wider would strand
+    every row in the wrong file."""
+    path = os.path.join(store_dir, _META_NAME)
+    if os.path.exists(path):
+        meta = statefile.read_json(path)
+        w, p = int(meta["num_shards"]), int(meta["num_partitions"])
+        if num_shards is not None and num_shards != w:
+            raise ValueError(
+                f"store dir {store_dir} was created with num_shards={w}; "
+                f"refusing num_shards={num_shards} (width is permanent)"
+            )
+        if num_partitions is not None and num_partitions != p:
+            raise ValueError(
+                f"store dir {store_dir} was created for num_partitions={p}; "
+                f"refusing num_partitions={num_partitions}"
+            )
+        return w, p
+    if num_shards is None or num_partitions is None:
+        raise ValueError(
+            f"no {_META_NAME} in {store_dir}: a fresh sharded store needs "
+            "explicit num_shards and num_partitions"
+        )
+    os.makedirs(store_dir, exist_ok=True)
+    statefile.write_json(
+        path, {"num_shards": num_shards, "num_partitions": num_partitions}
+    )
+    return num_shards, num_partitions
+
+
+def _union_reader(
+    shard_paths: list[str], tables: dict[str, tuple[str, ...]]
+) -> sqlite3.Connection:
+    """One :memory: connection ATTACHing every shard file, with TEMP VIEWs
+    named like the base tables so inherited query SQL runs verbatim.  TEMP
+    objects resolve before attached schemas, and the :memory: main schema
+    is empty, so the views ARE the tables from the reader's point of view."""
+    conn = sqlite3.connect(":memory:", check_same_thread=False)
+    conn.row_factory = sqlite3.Row
+    for k, path in enumerate(shard_paths):
+        conn.execute(f"ATTACH DATABASE ? AS s{k}", (path,))
+    for table, cols in tables.items():
+        collist = ", ".join(cols)
+        union = " UNION ALL ".join(
+            f"SELECT {collist} FROM s{k}.{table}"
+            for k in range(len(shard_paths))
+        )
+        conn.execute(f"CREATE TEMP VIEW {table} AS {union}")
+    return conn
+
+
+def _min_merge_positions(rows, out: dict) -> None:
+    """Fold (consumer, partition, position) rows taking the MIN on
+    conflict: a duplicated cursor can only appear through a routed restore,
+    and the lower fence replays idempotently while the higher one skips."""
+    for consumer, part, pos in rows:
+        key = (consumer, int(part))
+        pos = int(pos)
+        if key not in out or pos < out[key]:
+            out[key] = pos
+
+
+class ShardedSchedulerDb(SchedulerDb):
+    """W shard files behind the plain SchedulerDb query surface.
+
+    The object itself is a READER (plus the globals shard's direct verbs);
+    ingestion writes go through ``shard_sink(k, n)``, which hands each
+    ingest shard the store shard that owns its partitions.  ``store`` /
+    ``store_plan`` on the union raise: a cross-partition batch cannot be
+    one single-file transaction, and nothing in the plane needs it.
+    """
+
+    shard_sinks_owned_by_store = True
+
+    _PG_SCHEMA_FMT = "armada_shard_{k}"
+    _PG_META_TABLE = "armada_store_shard_meta"
+
+    def __init__(
+        self,
+        path: str,
+        num_shards: Optional[int] = None,
+        num_partitions: Optional[int] = None,
+    ):
+        self._path = path
+        self._alloc = SerialAllocator()
+        self._txn_serials: list[tuple[str, int]] = []
+        if is_postgres_url(path):
+            self._dialect = "pg"
+            # The reader session keeps the default search_path (public),
+            # where the union views live.
+            self._conn = PgAdapter(path)
+            self.num_shards, self.num_partitions = _load_meta_pg(
+                self._conn, self._PG_META_TABLE, num_shards, num_partitions
+            )
+            self._stores = [
+                SchedulerDb(
+                    path,
+                    serial_allocator=self._alloc,
+                    pg_schema=self._PG_SCHEMA_FMT.format(k=k),
+                )
+                for k in range(self.num_shards)
+            ]
+            _pg_union_views(
+                self._conn,
+                SNAPSHOT_TABLES,
+                [
+                    self._PG_SCHEMA_FMT.format(k=k)
+                    for k in range(self.num_shards)
+                ],
+            )
+        else:
+            self._dialect = "sqlite"
+            self.num_shards, self.num_partitions = _load_meta(
+                path, num_shards, num_partitions
+            )
+            if self.num_shards > _MAX_SQLITE_SHARDS:
+                raise ValueError(
+                    f"num_shards={self.num_shards} exceeds SQLite's ATTACH "
+                    f"limit ({_MAX_SQLITE_SHARDS})"
+                )
+            shard_paths = [
+                os.path.join(path, f"schedulerdb.shard-{k}.sqlite")
+                for k in range(self.num_shards)
+            ]
+            # Each shard is a full SchedulerDb (schema, WAL pragmas, its own
+            # tsan-named store lock) sharing ONE allocator; opening them
+            # seeds the allocator from every shard's serial high-water mark.
+            self._stores = [
+                SchedulerDb(p, serial_allocator=self._alloc)
+                for p in shard_paths
+            ]
+            self._conn = _union_reader(shard_paths, SNAPSHOT_TABLES)
+        self._control_shard = (
+            partition_for_key(_CONTROL_KEY, self.num_partitions)
+            % self.num_shards
+        )
+        self._lock = make_lock("schedulerdb.union")
+
+    # --- topology -----------------------------------------------------------
+
+    @property
+    def globals_store(self) -> SchedulerDb:
+        """The shard holding every non-partition-owned row: queue CRUD
+        (event-sourced through the control partition's barrier) and the
+        store's direct verbs must agree on ONE home or a queue could exist
+        in two files and a delete in one would resurrect via the union."""
+        return self._stores[self._control_shard]
+
+    def shard_store(self, store_shard: int) -> SchedulerDb:
+        return self._stores[store_shard]
+
+    def store_shard_of_partition(self, partition: int) -> int:
+        return partition % self.num_shards
+
+    def shard_sink(
+        self, shard_index: int = 0, num_shards: int = 1
+    ) -> SchedulerDb:
+        if num_shards % self.num_shards != 0:
+            raise ValueError(
+                f"ingest shard count {num_shards} is not a multiple of the "
+                f"store width {self.num_shards}: an ingest shard's partition "
+                "set would span store files and its batch could not commit "
+                "as one transaction"
+            )
+        return self._stores[shard_index % self.num_shards]
+
+    def close(self) -> None:
+        self._conn.close()
+        for s in self._stores:
+            s.close()
+
+    # --- writes -------------------------------------------------------------
+
+    def store(self, *a, **kw):  # noqa: D102 - contract documented above
+        raise RuntimeError(
+            "ShardedSchedulerDb is a union reader; ingestion writes go "
+            "through shard_sink(k, n)"
+        )
+
+    store_plan = store
+
+    def store_dedup(self, mapping: dict[str, str]) -> None:
+        self.globals_store.store_dedup(mapping)
+
+    def upsert_queue(self, name: str, *a, **kw) -> None:
+        self.globals_store.upsert_queue(name, *a, **kw)
+
+    def delete_queue(self, name: str) -> None:
+        self.globals_store.delete_queue(name)
+
+    def upsert_executor(
+        self, executor_id: str, snapshot: bytes, now_ns: int
+    ) -> None:
+        self.globals_store.upsert_executor(executor_id, snapshot, now_ns)
+
+    # --- serial-clamped reads -----------------------------------------------
+
+    def fetch_job_updates(self, jobs_serial: int, runs_serial: int):
+        """The single-cursor incremental fetch, clamped to the allocator's
+        committed horizon: serial 101 can be committed (and visible in the
+        union) while 100 still sits in another shard's open transaction --
+        advancing the cursor to 101 would skip 100 forever.  Every serial
+        <= horizon is committed somewhere or a permanent gap, so the
+        max-advance contract survives verbatim."""
+        jh = self._alloc.horizon("jobs")
+        rh = self._alloc.horizon("runs")
+        jobs = self._query(
+            "SELECT * FROM jobs WHERE serial > ? AND serial <= ? "
+            "ORDER BY serial",
+            (jobs_serial, jh),
+        )
+        runs = self._query(
+            "SELECT * FROM runs WHERE serial > ? AND serial <= ? "
+            "ORDER BY serial",
+            (runs_serial, rh),
+        )
+        return jobs, runs
+
+    def max_serials(self) -> tuple[int, int]:
+        """Cursor START values must also respect the horizon -- the raw
+        per-shard serials rows include in-flight allocations."""
+        return self._alloc.horizon("jobs"), self._alloc.horizon("runs")
+
+    # --- positions / checkpoint ---------------------------------------------
+
+    def positions(self, consumer: str = "scheduler") -> dict[int, int]:
+        merged: dict[tuple[str, int], int] = {}
+        _min_merge_positions(
+            (
+                (consumer, r["partition"], r["position"])
+                for r in self._query(
+                    "SELECT partition, position FROM consumer_positions "
+                    "WHERE consumer = ?",
+                    (consumer,),
+                )
+            ),
+            merged,
+        )
+        return {part: pos for (_c, part), pos in merged.items()}
+
+    def export_snapshot(self) -> dict[str, list[tuple]]:
+        """Per-shard dumps merged into ONE plain-SchedulerDb-shaped dump.
+
+        Each shard dumps under its own lock with consumer_positions first,
+        so every (consumer, partition) fence is consistent with that
+        partition's data rows (partition-owned -- both live in the same
+        dump).  Cross-shard there is no ordering to preserve: partitions
+        are disjoint, and replay per partition starts at its own fence.
+        consumer_positions merge MIN-on-conflict (the skew-safe direction)
+        and serials merge per-name MAX (the allocator's reopen seed)."""
+        dumps = [s.export_snapshot() for s in self._stores]
+        out: dict[str, list[tuple]] = {}
+        pos: dict[tuple[str, int], int] = {}
+        for d in dumps:
+            _min_merge_positions(d.get("consumer_positions", []), pos)
+        out["consumer_positions"] = [
+            (c, part, p) for (c, part), p in sorted(pos.items())
+        ]
+        ser: dict[str, int] = {}
+        for d in dumps:
+            for name, value in d.get("serials", []):
+                if int(value) > ser.get(name, 0):
+                    ser[name] = int(value)
+        for table in SNAPSHOT_TABLES:
+            if table in ("consumer_positions", "serials"):
+                continue
+            rows: list[tuple] = []
+            for d in dumps:
+                rows.extend(d.get(table, []))
+            out[table] = rows
+        out["serials"] = sorted(ser.items())
+        # Metadata rider: consumers iterate SNAPSHOT_TABLES, so extra keys
+        # pass through restore untouched; a different-width restore target
+        # re-routes rows anyway.
+        out["__store_shards__"] = self.num_shards
+        return out
+
+    def restore_snapshot(self, dump: dict[str, list[tuple]]) -> None:
+        """Route the merged dump back onto THIS store's width.
+
+        Rows must land in the file future ingestion will write (updates are
+        ``WHERE job_id = ?`` against the owning shard), so routing recomputes
+        each row's partition exactly like the publisher: jobs by
+        (queue, jobset) key, runs/errors via the jobs dump's job_id map,
+        markers/positions by their partition column, globals to the globals
+        shard.  Serials restore as the global max into EVERY shard (seed
+        takes the max anyway).  Each shard restores in ONE transaction; a
+        crash between shards re-restores from the same checkpoint on the
+        next start (restore is idempotent from a fixed dump)."""
+        from armada_tpu.eventlog.publisher import jobset_key
+
+        shard_dumps: list[dict[str, list[tuple]]] = [
+            {t: [] for t in SNAPSHOT_TABLES} for _ in range(self.num_shards)
+        ]
+        cols = {t: c for t, c in SNAPSHOT_TABLES.items()}
+
+        def col(table: str, name: str) -> int:
+            return cols[table].index(name)
+
+        j_queue, j_jobset = col("jobs", "queue"), col("jobs", "jobset")
+        j_id = col("jobs", "job_id")
+        job_shard: dict[str, int] = {}
+        for row in dump.get("jobs", []):
+            part = partition_for_key(
+                jobset_key(str(row[j_queue]), str(row[j_jobset])),
+                self.num_partitions,
+            )
+            k = part % self.num_shards
+            job_shard[str(row[j_id])] = k
+            shard_dumps[k]["jobs"].append(row)
+        for table in ("runs", "job_run_errors"):
+            jpos = col(table, "job_id")
+            for row in dump.get(table, []):
+                k = job_shard.get(str(row[jpos]), self._control_shard)
+                shard_dumps[k][table].append(row)
+        ppos = col("markers", "partition")
+        for row in dump.get("markers", []):
+            shard_dumps[int(row[ppos]) % self.num_shards]["markers"].append(row)
+        cpos = col("consumer_positions", "partition")
+        merged: dict[tuple[str, int], int] = {}
+        _min_merge_positions(
+            ((r[0], r[cpos], r[2]) for r in dump.get("consumer_positions", [])),
+            merged,
+        )
+        for (consumer, part), p in sorted(merged.items()):
+            shard_dumps[part % self.num_shards]["consumer_positions"].append(
+                (consumer, part, p)
+            )
+        for table in ("executors", "executor_settings", "job_dedup", "queues"):
+            shard_dumps[self._control_shard][table] = list(dump.get(table, []))
+        ser = {
+            str(name): int(value) for name, value in dump.get("serials", [])
+        }
+        serial_rows = sorted(ser.items())
+        for sd in shard_dumps:
+            sd["serials"] = list(serial_rows)
+        for store, sd in zip(self._stores, shard_dumps):
+            store.restore_snapshot(sd)
+
+
+class ShardedLookoutDb(LookoutDb):
+    """W lookout shard files behind the plain LookoutDb query surface.
+    Same topology as :class:`ShardedSchedulerDb` minus the serial
+    machinery (lookout has no serial cursor -- the REST layer reads the
+    union directly)."""
+
+    shard_sinks_owned_by_store = True
+
+    _TABLES: dict[str, tuple[str, ...]] = {
+        "job": (
+            "job_id", "queue", "jobset", "namespace", "state", "priority",
+            "priority_class", "cpu_milli", "memory", "gpu", "gang_id",
+            "submitted_ns", "last_transition_ns", "latest_run_id", "node",
+            "error", "annotations_json", "ingress_json", "spec",
+        ),
+        "job_run": (
+            "run_id", "job_id", "executor", "node", "state", "leased_ns",
+            "pending_ns", "started_ns", "finished_ns", "error", "usage_json",
+        ),
+        "consumer_positions": ("consumer", "partition", "position"),
+        "saved_view": ("name", "payload", "updated_ns"),
+    }
+
+    _PG_SCHEMA_FMT = "armada_lookout_shard_{k}"
+    _PG_META_TABLE = "armada_lookout_shard_meta"
+
+    def __init__(
+        self,
+        path: str,
+        num_shards: Optional[int] = None,
+        num_partitions: Optional[int] = None,
+    ):
+        self._path = path
+        if is_postgres_url(path):
+            self._dialect = "pg"
+            self._conn = PgAdapter(path)
+            self.num_shards, self.num_partitions = _load_meta_pg(
+                self._conn, self._PG_META_TABLE, num_shards, num_partitions
+            )
+            self._stores = [
+                LookoutDb(path, pg_schema=self._PG_SCHEMA_FMT.format(k=k))
+                for k in range(self.num_shards)
+            ]
+            _pg_union_views(
+                self._conn,
+                self._TABLES,
+                [
+                    self._PG_SCHEMA_FMT.format(k=k)
+                    for k in range(self.num_shards)
+                ],
+            )
+        else:
+            self._dialect = "sqlite"
+            self.num_shards, self.num_partitions = _load_meta(
+                path, num_shards, num_partitions
+            )
+            if self.num_shards > _MAX_SQLITE_SHARDS:
+                raise ValueError(
+                    f"num_shards={self.num_shards} exceeds SQLite's ATTACH "
+                    f"limit ({_MAX_SQLITE_SHARDS})"
+                )
+            shard_paths = [
+                os.path.join(path, f"lookoutdb.shard-{k}.sqlite")
+                for k in range(self.num_shards)
+            ]
+            self._stores = [LookoutDb(p) for p in shard_paths]
+            self._conn = _union_reader(shard_paths, self._TABLES)
+        self._control_shard = (
+            partition_for_key(_CONTROL_KEY, self.num_partitions)
+            % self.num_shards
+        )
+        self._lock = make_lock("lookoutdb.union")
+
+    @property
+    def globals_store(self) -> LookoutDb:
+        return self._stores[self._control_shard]
+
+    def shard_sink(
+        self, shard_index: int = 0, num_shards: int = 1
+    ) -> LookoutDb:
+        if num_shards % self.num_shards != 0:
+            raise ValueError(
+                f"ingest shard count {num_shards} is not a multiple of the "
+                f"store width {self.num_shards}"
+            )
+        return self._stores[shard_index % self.num_shards]
+
+    def close(self) -> None:
+        self._conn.close()
+        for s in self._stores:
+            s.close()
+
+    def store(self, *a, **kw):  # noqa: D102
+        raise RuntimeError(
+            "ShardedLookoutDb is a union reader; ingestion writes go "
+            "through shard_sink(k, n)"
+        )
+
+    def positions(self, consumer: str = "lookout") -> dict[int, int]:
+        merged: dict[tuple[str, int], int] = {}
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT partition, position FROM consumer_positions "
+                "WHERE consumer = ?",
+                (consumer,),
+            ).fetchall()
+        _min_merge_positions(
+            ((consumer, r["partition"], r["position"]) for r in rows), merged
+        )
+        return {part: pos for (_c, part), pos in merged.items()}
+
+    def execute(self, sql: str, params=()) -> int:
+        # Saved views and other small non-ingestion writes have no
+        # partition: they live in the globals shard, one home per row.
+        return self.globals_store.execute(sql, params)
+
+    def prune(self, now_ns: int, keep_terminal_s: float) -> int:
+        return sum(
+            s.prune(now_ns, keep_terminal_s) for s in self._stores
+        )

@@ -77,6 +77,12 @@ class SoakConfig:
     # (the serve knob) or 1; the run's save/restore carries the armed value
     # through the fault/crash legs like ARMADA_COMMIT_K.
     ingest_shards: Optional[int] = None
+    # Sharded materialized store (ingest/storeunion.py): each ingest shard
+    # leg writes its own SQLite file behind the union reader.  None =
+    # ARMADA_STORE_SHARDS (the serve knob) or 1; >1 forces file-backed
+    # storage and rounds the ingest width up to a multiple (each worker's
+    # partition set must live in one store shard).
+    store_shards: Optional[int] = None
 
     @staticmethod
     def from_env(**overrides) -> "SoakConfig":
@@ -163,6 +169,19 @@ class SoakWorld:
         factory = self.config.resource_list_factory()
         os.makedirs(data_dir, exist_ok=True)
         self.ingest_shards = resolve_num_shards(cfg.ingest_shards)
+        store_shards = cfg.store_shards
+        if store_shards is None:
+            try:
+                store_shards = int(os.environ.get("ARMADA_STORE_SHARDS", "0"))
+            except ValueError:
+                store_shards = 0
+        self.store_shards = store_shards if store_shards and store_shards > 1 else 1
+        if self.store_shards > 1:
+            # store shard = partition % W, ingest shard = partition % N:
+            # round the ingest width up so W divides N (each worker's
+            # partition set must land in ONE store file).
+            self.ingest_shards = max(self.ingest_shards, self.store_shards)
+            self.ingest_shards += (-self.ingest_shards) % self.store_shards
         # The partition count is permanent per data dir (crash legs reopen
         # it): widen only when sharding is requested from the start.
         self.log = EventLog(
@@ -174,10 +193,26 @@ class SoakWorld:
         # SQLite in the data dir (the event log already is).  Plain soaks
         # keep the in-memory default -- durability is not what they measure.
         durable = cfg.crash_at_frac is not None
-        self.db = SchedulerDb(
-            cfg.db_url
-            or (os.path.join(data_dir, "scheduler.db") if durable else ":memory:")
-        )
+        if self.store_shards > 1:
+            # Per-shard store files live in the data dir (always file-
+            # backed -- the union reader has no :memory: form), so the
+            # crash leg's kill/rebuild reopens the same width.
+            from armada_tpu.ingest.storeunion import ShardedSchedulerDb
+
+            self.db = ShardedSchedulerDb(
+                cfg.db_url or os.path.join(data_dir, "store-shards"),
+                num_shards=self.store_shards,
+                num_partitions=self.log.num_partitions,
+            )
+        else:
+            self.db = SchedulerDb(
+                cfg.db_url
+                or (
+                    os.path.join(data_dir, "scheduler.db")
+                    if durable
+                    else ":memory:"
+                )
+            )
         self.checkpoints = None
         if durable:
             from armada_tpu.scheduler.checkpoint import (
@@ -355,6 +390,13 @@ def _crash_restart(cfg: SoakConfig, data_dir: str, world: SoakWorld, rec):
             os.remove(os.path.join(data_dir, "scheduler.db" + suffix))
         except FileNotFoundError:
             pass
+    # Sharded store: wipe the per-shard files too (the rebuild recreates
+    # the dir at the same width -- cfg carries it through the restart).
+    shard_dir = os.path.join(data_dir, "store-shards")
+    if os.path.isdir(shard_dir):
+        import shutil
+
+        shutil.rmtree(shard_dir)
     new_world = SoakWorld(cfg, data_dir, resume=True)
     new_world.executor.run_once()
     replayed = new_world.scheduler_pipeline.run_until_caught_up()
@@ -409,6 +451,9 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
             # Likewise the armed ingest-shard count (the rebuilt post-crash
             # world must re-shard identically).
             "ARMADA_INGEST_SHARDS",
+            # ... and the store-shard width (permanent per store dir -- a
+            # post-crash rebuild at a different width would be refused).
+            "ARMADA_STORE_SHARDS",
         )
     }
     os.environ.pop("ARMADA_FAULT", None)
@@ -575,6 +620,7 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
         # effective K to the queue-axis width per pool)
         report["commit_k"] = resolve_commit_k()
         report["ingest_shards"] = world.ingest_shards
+        report["store_shards"] = world.store_shards
         # Flat headline keys (the bench-JSON soak_* shape).
         for name, src in (
             ("cycle", slo_snap.get("cycle_latency_s", {})),

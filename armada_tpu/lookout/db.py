@@ -99,12 +99,23 @@ CREATE TABLE IF NOT EXISTS saved_view (
 class LookoutDb:
     """Store + ingestion sink (lookoutingester/lookoutdb/insertion.go)."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", pg_schema: Optional[str] = None):
         self._path = path
         self._dialect = "pg" if is_postgres_url(path) else "sqlite"
         if self._dialect == "pg":
-            self._conn = PgAdapter(path)
+            # pg_schema pins this store's tables into a per-shard schema
+            # (ingest/storeunion.py); replayed on every reconnect so a
+            # dropped session never falls back to public.
+            session_sql = ()
+            if pg_schema:
+                session_sql = (
+                    f"CREATE SCHEMA IF NOT EXISTS {pg_schema}",
+                    f"SET search_path TO {pg_schema}",
+                )
+            self._conn = PgAdapter(path, session_sql=session_sql)
         else:
+            if pg_schema:
+                raise ValueError("pg_schema requires a postgres:// URL")
             self._conn = sqlite3.connect(path, check_same_thread=False)
             self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
@@ -127,10 +138,18 @@ class LookoutDb:
 
         self._lock = make_lock("lookoutdb.store")
 
-    def shard_sink(self) -> "LookoutDb":
+    # Sharded stores (ingest/storeunion.py) own their shard sinks for the
+    # store's lifetime; the plain store's PG sinks are pipeline throwaways.
+    shard_sinks_owned_by_store = False
+
+    def shard_sink(
+        self, shard_index: int = 0, num_shards: int = 1
+    ) -> "LookoutDb":
         """Per-shard store leg (ingest/shards.py): external PG gets its own
         wire connection; embedded SQLite shares this one (same file, same
-        write lock -- a second connection only adds busy-retry churn)."""
+        write lock -- a second connection only adds busy-retry churn).  The
+        plain store ignores (shard_index, num_shards); ShardedLookoutDb
+        routes shard k to file k % width."""
         if self._dialect == "pg":
             return LookoutDb(self._path)
         return self

@@ -157,6 +157,12 @@ class CheckpointManager:
                 "epoch": payload.get("epoch", 0),
                 "fence": {str(k): v for k, v in payload.get("fence", {}).items()},
                 "jobs": len(payload["db"].get("jobs", [])),
+                # Sharded-store dumps carry a width rider (the snapshot is a
+                # MERGED dump; restore re-routes onto the target width, so
+                # this is advisory provenance, not a restore constraint).
+                "store_shards": int(
+                    payload["db"].get("__store_shards__", 1) or 1
+                ),
             },
         )
         for old in self.paths()[: -self.keep]:
@@ -230,6 +236,7 @@ class CheckpointManager:
             "fence": fence,
             "fenced_offset_total": sum(fence.values()),
             "jobs": meta.get("jobs", 0),
+            "store_shards": meta.get("store_shards", 1),
         }
         return out
 

@@ -293,8 +293,19 @@ class SchedulerMetrics:
             "(exponentially decayed rate)",
             ["consumer"],
         )
+        # Per-shard store-leg write latency (round 19, sharded materialized
+        # stores): the spread across shards is what distinguishes a
+        # single-writer convoy (every shard reports the same queueing
+        # latency) from genuinely parallel store legs.
+        self.ingest_store_write = g(
+            "armada_ingest_store_write_seconds",
+            "Average store-transaction latency per consumer view and "
+            "ingest shard (the shard's transactional store leg)",
+            ["consumer", "shard"],
+        )
         self._ingest_lag_labels: set = set()
         self._ingest_rate_labels: set = set()
+        self._ingest_store_labels: set = set()
 
     # --- hooks called by the Scheduler --------------------------------------
 
@@ -346,6 +357,7 @@ class SchedulerMetrics:
         consumer/partition label sets are removed."""
         lag_seen = set()
         rate_seen = set()
+        store_seen = set()
         for consumer, snap in consumers.items():
             if not isinstance(snap, dict) or "events_per_s" not in snap:
                 continue
@@ -354,6 +366,13 @@ class SchedulerMetrics:
             for part, lag in (snap.get("lag_bytes") or {}).items():
                 lag_seen.add((consumer, str(part)))
                 self.ingest_lag.labels(consumer, str(part)).set(float(lag))
+            for shard, stats in (snap.get("store_write") or {}).items():
+                if not isinstance(stats, dict) or not stats.get("writes"):
+                    continue
+                store_seen.add((consumer, str(shard)))
+                self.ingest_store_write.labels(consumer, str(shard)).set(
+                    float(stats.get("avg_s", 0.0))
+                )
         for labels in self._ingest_lag_labels - lag_seen:
             try:
                 self.ingest_lag.remove(*labels)
@@ -364,8 +383,14 @@ class SchedulerMetrics:
                 self.ingest_rate.remove(*labels)
             except KeyError:
                 pass
+        for labels in self._ingest_store_labels - store_seen:
+            try:
+                self.ingest_store_write.remove(*labels)
+            except KeyError:
+                pass
         self._ingest_lag_labels = lag_seen
         self._ingest_rate_labels = rate_seen
+        self._ingest_store_labels = store_seen
 
     def observe_trace(self, stage_snapshot: dict) -> None:
         """Publish the trace recorder's per-stage latency snapshot

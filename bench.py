@@ -1119,8 +1119,12 @@ def _ingest_bench() -> dict:
     time-ordered job ids).  Drained state is checked bit-equal (serials
     excluded, as everywhere).  Best of ARMADA_BENCH_INGEST_REPEATS sharded
     drains rides the record (page-cache variance; the serial leg is flat).
-    ARMADA_BENCH_INGEST_JOBS downscales.  NOTE: the speedup needs real
-    cores -- a 1-CPU host reports ~1x by construction."""
+    A third arm repeats the sharded drain with the STORE sharded too
+    (ARMADA_BENCH_INGEST_STORE_SHARDS, default = worker count; =0 skips;
+    must divide the worker count) -- the ingest_store_* keys are the
+    shared-writer-vs-per-shard-file A/B.  ARMADA_BENCH_INGEST_JOBS
+    downscales.  NOTE: the speedup needs real cores -- a 1-CPU host
+    reports ~1x by construction."""
     import tempfile
     import uuid
 
@@ -1249,6 +1253,55 @@ def _ingest_bench() -> dict:
             pipe.stop()
             best_s = t if best_s is None else min(best_s, t)
         equal = _canon(db_serial) == _canon(db_sharded)
+
+        # Third arm: shard the STORE too (ingest/storeunion.py) -- each
+        # pipeline worker drains into its own SQLite file instead of
+        # funnelling every batch through the one shared writer.  Same
+        # log, same worker count; the delta is purely the store leg.
+        store_shards = int(
+            os.environ.get("ARMADA_BENCH_INGEST_STORE_SHARDS", shards)
+        )
+        if store_shards > 1 and shards % store_shards:
+            # each worker's partition set must land in ONE store file
+            print(
+                f"bench: ingest store arm needs store shards to divide the "
+                f"{shards} workers; using {shards}",
+                file=sys.stderr,
+            )
+            store_shards = shards
+        store_s = None
+        store_equal = None
+        if store_shards > 1:
+            from armada_tpu.ingest.storeunion import ShardedSchedulerDb
+
+            db_store = None
+            for trial in range(max(1, repeats)):
+                if db_store is not None:
+                    db_store.close()
+                # fresh dir per trial: width is permanent per store dir,
+                # and a re-drain over a populated store would measure the
+                # exactly-once skip, not the write path
+                db_store = ShardedSchedulerDb(
+                    os.path.join(d, f"store{trial}"),
+                    num_shards=store_shards,
+                    num_partitions=partitions,
+                )
+                pipe = PartitionedIngestionPipeline(
+                    log,
+                    db_store,
+                    convert_sequences,
+                    "scheduler",
+                    num_shards=shards,
+                )
+                pipe.start()
+                t0 = time.perf_counter()
+                while sum(pipe.lag().values()):
+                    time.sleep(0.003)
+                t = time.perf_counter() - t0
+                pipe.stop()
+                store_s = t if store_s is None else min(store_s, t)
+            store_equal = _canon(db_serial) == _canon(db_store)
+            db_store.close()
         db_serial.close()
         db_sharded.close()
         log.close()
@@ -1264,7 +1317,7 @@ def _ingest_bench() -> dict:
         f"{sharded_eps / serial_eps:.2f}x, {total_events} events)",
         file=sys.stderr,
     )
-    return {
+    out = {
         "ingest_events_per_s": round(sharded_eps),
         "ingest_serial_events_per_s": round(serial_eps),
         "ingest_speedup": round(sharded_eps / serial_eps, 2),
@@ -1272,6 +1325,29 @@ def _ingest_bench() -> dict:
         "ingest_events": total_events,
         "ingest_equal": equal,
     }
+    if store_s is not None:
+        store_eps = total_events / store_s
+        if not store_equal:
+            print(
+                "bench: INGEST STORE ARM DIVERGED (ingest_store_equal=false)",
+                file=sys.stderr,
+            )
+        print(
+            f"bench: ingest x{store_shards} STORE shards "
+            f"{sharded_eps:,.0f} -> {store_eps:,.0f} events/s "
+            f"({best_s:.2f}s -> {store_s:.2f}s, "
+            f"{store_eps / sharded_eps:.2f}x over the shared writer)",
+            file=sys.stderr,
+        )
+        out.update(
+            {
+                "ingest_store_events_per_s": round(store_eps),
+                "ingest_store_shards": store_shards,
+                "ingest_store_speedup": round(store_eps / sharded_eps, 2),
+                "ingest_store_equal": store_equal,
+            }
+        )
+    return out
 
 
 def main():

@@ -83,9 +83,31 @@ class ControlPlane:
         from armada_tpu.ingest import resolve_num_shards
 
         shards = resolve_num_shards()
+        # ARMADA_STORE_SHARDS additionally shards the materialized store
+        # (ingest/storeunion.py; chaos_cycle --store-shards rides this):
+        # one SQLite file per store shard under tmp_path, the ingest width
+        # raised to a multiple so every shard's partitions live in one file.
+        import os as _os
+
+        try:
+            store_shards = int(_os.environ.get("ARMADA_STORE_SHARDS", "0"))
+        except ValueError:
+            store_shards = 0
+        if store_shards > 1:
+            shards = max(shards, store_shards)
+            shards += (-shards) % store_shards
         log = EventLog(str(tmp_path / "log"), num_partitions=max(2, shards))
         shards = min(shards, log.num_partitions)
-        db = SchedulerDb(db_url or ":memory:")
+        if store_shards > 1:
+            from armada_tpu.ingest.storeunion import ShardedSchedulerDb
+
+            db = ShardedSchedulerDb(
+                db_url or str(tmp_path / "store-shards"),
+                num_shards=store_shards,
+                num_partitions=log.num_partitions,
+            )
+        else:
+            db = SchedulerDb(db_url or ":memory:")
         eventdb = EventDb(":memory:")
         publisher = Publisher(log, clock=clock)
         if shards > 1:

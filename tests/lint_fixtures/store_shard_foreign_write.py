@@ -1,0 +1,25 @@
+# Fixture for rule `store-shard-foreign-write` (linted under
+# armada_tpu/ingest/).  The twin line is syntactically IDENTICAL to the
+# true positive after normalization; it writes each per-shard plan through
+# the handle of the SAME shard index that produced it -- exactly what the
+# partition-parallel store legs do.  Only value-flow provenance (which
+# shard index the handle was opened for vs which index the payload came
+# from) separates the two: the TP drains EVERY shard's plan through shard
+# 0's file, landing rows where that shard's ingestion and cursor fence
+# never look.
+
+
+def flush(db, plans, positions, n):
+    sink = db.shard_sink(0, n)
+    for k in range(n):
+        plan = plans[k]
+        sink.store_plan(plan, next_positions=positions[k])  # TP
+    for k in range(n):
+        sink = db.shard_sink(k, n)
+        plan = plans[k]
+        sink.store_plan(plan, next_positions=positions[k])  # twin
+    own = db.shard_sink(0, n)
+    plan0 = plans[0]
+    own.store_plan(plan0, next_positions=positions[0])  # near miss: same index
+    def _flush_one(sink2, batch):
+        sink2.store(batch, consumer="x")  # near miss: untagged payload

@@ -82,6 +82,10 @@ RULE_FIXTURES = {
         "shard_foreign_cursor.py",
         "armada_tpu/ingest/fixture.py",
     ),
+    "store-shard-foreign-write": (
+        "store_shard_foreign_write.py",
+        "armada_tpu/ingest/fixture.py",
+    ),
 }
 
 # The value-flow rules whose fixtures carry a `# twin` line: a
@@ -94,6 +98,7 @@ TWIN_RULES = [
     "unpinned-out-shardings",
     "pool-dispatch-mutation",
     "shard-foreign-cursor",
+    "store-shard-foreign-write",
 ]
 
 
@@ -332,6 +337,20 @@ def test_cli_stats_census():
     assert "fair_scheduler.py" in out.stdout
     rows = lint.suppression_census(REPO)
     assert rows and all(reason for _, _, _, reason in rows)
+    # every censused allow names a REGISTERED rule -- an allow referencing
+    # a renamed/deleted rule is a stale exemption nothing enforces (the
+    # round-19 store-shard rule rename hazard: an allow for a rule that no
+    # longer exists suppresses nothing and rots silently)
+    names = set(lint.rule_names())
+    stale = [
+        (p, ln, r)
+        for p, ln, r, _ in rows
+        if r not in names
+        # the engine's own docstring demonstrates the allow syntax with
+        # placeholder rule names; everything else must name a real rule
+        and p != "armada_tpu/analysis/lint.py"
+    ]
+    assert not stale, f"allows for unregistered rules: {stale}"
 
 
 def test_cli_jobs_parallel_matches_serial():

@@ -1,0 +1,429 @@
+"""Sharded materialized stores (ingest/storeunion.py): the round-19 contract.
+
+The parity pin: draining the SAME churned event log into the plain
+single-writer SchedulerDb (serial pipeline) and into a ShardedSchedulerDb
+(partition-parallel pipeline, one store file per store shard) must
+materialize bit-equal state through the union read surface -- raw serial
+columns excluded, as everywhere (allocation order differs across concurrent
+shard commits; see tests/test_ingest_shards.py).  Plus the per-shard crash
+drill (a committed-but-unacked batch in ONE shard's file must not
+double-apply on restart), checkpoint export/restore across shard files
+(including a width-changing restore, which re-routes every row), the
+committed-horizon clamp that keeps the single-cursor fetch sound, width
+permanence, and globals routing (one home per non-partition-owned row)."""
+
+from __future__ import annotations
+
+import pytest
+
+from armada_tpu.eventlog import EventLog, Publisher
+from armada_tpu.events import events_pb2 as pb
+from armada_tpu.ingest import (
+    IngestionPipeline,
+    PartitionedIngestionPipeline,
+    SchedulerDb,
+    convert_sequences,
+)
+from armada_tpu.ingest.schedulerdb import SerialAllocator
+from armada_tpu.ingest.storeunion import ShardedLookoutDb, ShardedSchedulerDb
+from armada_tpu.lookout import LookoutDb, lookout_converter
+from armada_tpu.server.queues import QueueRecord
+from tests.control_plane import ControlPlane
+from tests.test_ingest_shards import _churn_plane, _materialized, _serial_replay
+
+STORE_SHARDS = 2
+INGEST_SHARDS = 4  # must be a multiple of STORE_SHARDS
+
+
+def _sharded_db(tmp_path, name="store-shards", shards=STORE_SHARDS, parts=4):
+    return ShardedSchedulerDb(
+        str(tmp_path / name), num_shards=shards, num_partitions=parts
+    )
+
+
+def _sharded_drain(
+    log, db, consumer="scheduler", converter=convert_sequences, resume=False
+):
+    pipe = PartitionedIngestionPipeline(
+        log,
+        db,
+        converter,
+        consumer_name=consumer,
+        num_shards=INGEST_SHARDS,
+        convert_mode="inline",
+        start_positions=db.positions(consumer) if resume else None,
+    )
+    return pipe.run_until_caught_up()
+
+
+# --------------------------------------------------------------- equality ----
+
+
+@pytest.mark.parametrize("seed,mode", [(0, "process"), (1, "inline"), (2, "inline")])
+def test_sharded_store_bit_equal_serial_over_churn(
+    tmp_path, monkeypatch, seed, mode
+):
+    """The satellite equality pin, under the tsan race harness: serial
+    single-writer vs W-file sharded store over real churn; seed 0
+    additionally routes conversion through the subprocess pool (the
+    production sharded shape: columnar plans land via store_plan in each
+    shard's own file)."""
+    from armada_tpu.analysis import tsan
+
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", str(INGEST_SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = _churn_plane(tmp_path, seed)
+    tsan_was = tsan.enabled()
+    monkeypatch.setenv("ARMADA_TSAN", "1")
+    tsan.enable()
+    tsan.reset()
+    try:
+        db_serial = _serial_replay(plane.log)
+        db_sharded = _sharded_db(
+            tmp_path, parts=plane.log.num_partitions
+        )
+        pipe = PartitionedIngestionPipeline(
+            plane.log,
+            db_sharded,
+            convert_sequences,
+            consumer_name="scheduler",
+            num_shards=INGEST_SHARDS,
+            convert_mode=mode,
+        )
+        n = pipe.run_until_caught_up()
+        assert n > 0
+        assert _materialized(db_serial) == _materialized(db_sharded)
+        assert db_serial.positions("scheduler") == db_sharded.positions(
+            "scheduler"
+        )
+        # the union fetch surface agrees row-for-row with the plain store
+        # (serial VALUES differ; compare the job identity + state columns)
+        def fetch_ids(db):
+            jobs, runs = db.fetch_job_updates(0, 0)
+            return (
+                sorted((r["job_id"], r["queued"], r["succeeded"]) for r in jobs),
+                sorted((r["run_id"], r["job_id"]) for r in runs),
+            )
+
+        assert fetch_ids(db_serial) == fetch_ids(db_sharded)
+        violations = tsan.take_violations()
+        assert not violations, "\n".join(violations)
+        db_serial.close()
+        db_sharded.close()
+    finally:
+        if not tsan_was:
+            tsan.disable()
+        plane.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_exactly_once_under_per_shard_store_crash(tmp_path, monkeypatch, seed):
+    """The satellite crash drill: ingest_ack fires in ONE shard mid-drain --
+    its batch is COMMITTED in that shard's own file, the in-memory ack died.
+    A restarted pipeline resumes from the store's per-shard committed
+    cursors and must not double-apply; final state bit-equal to serial,
+    under tsan."""
+    from armada_tpu.analysis import tsan
+    from armada_tpu.core import faults
+
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", str(INGEST_SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = _churn_plane(tmp_path, seed)
+    tsan_was = tsan.enabled()
+    monkeypatch.setenv("ARMADA_TSAN", "1")
+    tsan.enable()
+    tsan.reset()
+    try:
+        db_serial = _serial_replay(plane.log)
+        db_sharded = _sharded_db(tmp_path, parts=plane.log.num_partitions)
+        faults.reset_counters()
+        monkeypatch.setenv("ARMADA_FAULT", "ingest_ack:error:1")
+        pipe = PartitionedIngestionPipeline(
+            plane.log,
+            db_sharded,
+            convert_sequences,
+            consumer_name="scheduler",
+            num_shards=INGEST_SHARDS,
+            convert_mode="inline",
+        )
+        with pytest.raises(faults.FaultInjected):
+            pipe.run_until_caught_up()
+        monkeypatch.delenv("ARMADA_FAULT")
+        # The crashed shard's cursor rows live in ITS OWN file and committed
+        # with the batch; the union MIN-merge hands the restart exactly the
+        # per-partition resume points.
+        resumed = db_sharded.positions("scheduler")
+        pipe2 = PartitionedIngestionPipeline(
+            plane.log,
+            db_sharded,
+            convert_sequences,
+            consumer_name="scheduler",
+            num_shards=INGEST_SHARDS,
+            start_positions=resumed,
+            convert_mode="inline",
+        )
+        pipe2.run_until_caught_up()
+        assert _materialized(db_serial) == _materialized(db_sharded)
+        violations = tsan.take_violations()
+        assert not violations, "\n".join(violations)
+        db_serial.close()
+        db_sharded.close()
+    finally:
+        if not tsan_was:
+            tsan.disable()
+        plane.close()
+
+
+# ------------------------------------------------------------- checkpoint ----
+
+
+def test_checkpoint_roundtrip_across_shard_files(tmp_path, monkeypatch):
+    """Snapshot a sharded store, restore onto a DIFFERENT width, and get
+    the same materialized state: export merges per-shard dumps
+    (consumer_positions MIN, serials MAX), restore re-routes every row by
+    the publisher's partition function onto the target's files."""
+    from armada_tpu.scheduler.checkpoint import (
+        CheckpointManager,
+        maybe_restore,
+        snapshot_plane,
+    )
+
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", str(INGEST_SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = _churn_plane(tmp_path, 0)
+    try:
+        src = _sharded_db(tmp_path, "src", parts=plane.log.num_partitions)
+        _sharded_drain(plane.log, src)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.write(snapshot_plane(src))
+        st = mgr.status()
+        assert st["snapshot"]["store_shards"] == STORE_SHARDS
+        # restore onto width 4 (re-routed) and onto the plain store
+        dst4 = _sharded_db(
+            tmp_path, "dst4", shards=4, parts=plane.log.num_partitions
+        )
+        info = maybe_restore(dst4, mgr)
+        assert info["restored"]
+        assert _materialized(src) == _materialized(dst4)
+        assert src.positions("scheduler") == dst4.positions("scheduler")
+        dst_plain = SchedulerDb(":memory:")
+        assert maybe_restore(dst_plain, mgr)["restored"]
+        assert _materialized(src) == _materialized(dst_plain)
+        # fast-forward-only: a second maybe_restore on the live target skips
+        info2 = maybe_restore(dst4, mgr)
+        assert not info2["restored"]
+        # and the restored sharded store keeps ingesting: drain the same log
+        # again from the restored cursors -- exactly-once, nothing reapplies
+        n = _sharded_drain(plane.log, dst4, resume=True)
+        assert n == 0
+        assert _materialized(src) == _materialized(dst4)
+        # serial allocation resumes past the restored high-water mark
+        jh, rh = dst4.max_serials()
+        assert jh >= src.max_serials()[0]
+        src.close()
+        dst4.close()
+        dst_plain.close()
+    finally:
+        plane.close()
+
+
+# ----------------------------------------------------- horizon / routing ----
+
+
+def test_horizon_clamps_fetch_past_inflight_serial(tmp_path):
+    """Serial 101 committed in one shard while 100 sits in another shard's
+    open transaction: the cursor must NOT advance past 99 or the eventual
+    commit of 100 is skipped forever.  The allocator's horizon is that
+    clamp; this drives it through real shard sinks."""
+    db = _sharded_db(tmp_path, parts=4)
+    s0 = db.shard_sink(0, STORE_SHARDS)
+    s1 = db.shard_sink(1, STORE_SHARDS)
+    from armada_tpu.ingest import dbops
+
+    def job_batch(jid):
+        return [
+            dbops.InsertJobs(
+                jobs={jid: {"job_id": jid, "queue": "q", "jobset": "j"}}
+            )
+        ]
+
+    s0.store(job_batch("h1"), next_positions={0: 1})
+    # simulate shard 1 holding an open txn: allocate without committing
+    pending = db._alloc.allocate("jobs")
+    s0.store(job_batch("h2"), next_positions={0: 2})
+    jobs, _ = db.fetch_job_updates(0, 0)
+    # h2's serial is past the in-flight one -- the clamp hides it for now
+    assert [r["job_id"] for r in jobs] == ["h1"]
+    assert db.max_serials()[0] == pending - 1
+    db._alloc.committed([("jobs", pending)])
+    jobs, _ = db.fetch_job_updates(0, 0)
+    assert sorted(r["job_id"] for r in jobs) == ["h1", "h2"]
+    db.close()
+
+
+def test_globals_have_one_home(tmp_path):
+    """Queue CRUD and dedup land in the globals (control) shard only, and
+    are visible through the union -- a row with two homes would resurrect
+    through the union after a one-file delete."""
+    db = _sharded_db(tmp_path, parts=4)
+    db.upsert_queue("gq", weight=2.0)
+    db.store_dedup({"cid-1": "job-1"})
+    occupied = [
+        k
+        for k, s in enumerate(db._stores)
+        if s._query("SELECT COUNT(*) AS c FROM queues")[0]["c"]
+    ]
+    assert occupied == [db._control_shard]
+    assert [r["name"] for r in db._query("SELECT name FROM queues")] == ["gq"]
+    db.delete_queue("gq")
+    assert db._query("SELECT name FROM queues") == []
+    db.close()
+
+
+def test_width_is_permanent_and_adopted(tmp_path):
+    """STORE_META doctrine: reopen with num_shards=None adopts; an explicit
+    mismatch refuses; a fresh dir without widths refuses."""
+    db = _sharded_db(tmp_path, parts=4)
+    db.close()
+    adopted = ShardedSchedulerDb(str(tmp_path / "store-shards"))
+    assert (adopted.num_shards, adopted.num_partitions) == (STORE_SHARDS, 4)
+    adopted.close()
+    with pytest.raises(ValueError, match="permanent"):
+        ShardedSchedulerDb(
+            str(tmp_path / "store-shards"), num_shards=8, num_partitions=4
+        )
+    with pytest.raises(ValueError, match="fresh sharded store"):
+        ShardedSchedulerDb(str(tmp_path / "fresh-dir"))
+
+
+def test_divisibility_and_union_write_refusals(tmp_path):
+    """shard_sink refuses an ingest width the store width does not divide
+    (the batch could not commit as one transaction); store/store_plan on
+    the union object refuse outright."""
+    db = _sharded_db(tmp_path, parts=4)
+    with pytest.raises(ValueError, match="not a multiple"):
+        db.shard_sink(0, 3)
+    with pytest.raises(RuntimeError, match="union reader"):
+        db.store([], next_positions={})
+    with pytest.raises(RuntimeError, match="union reader"):
+        db.store_plan([], next_positions={})
+    db.close()
+
+
+# ----------------------------------------------------------------- lookout ----
+
+
+def test_sharded_lookout_matches_serial(tmp_path, monkeypatch):
+    """The lookout view through sharded files equals the serial drain on
+    the queryable surface (job + job_run rows)."""
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = _churn_plane(tmp_path, 1)
+    try:
+        serial = LookoutDb(":memory:")
+        IngestionPipeline(
+            plane.log, serial, lookout_converter, consumer_name="lookout"
+        ).run_until_caught_up()
+        sharded = ShardedLookoutDb(
+            str(tmp_path / "lookout-shards"),
+            num_shards=STORE_SHARDS,
+            num_partitions=plane.log.num_partitions,
+        )
+        _sharded_drain(
+            plane.log, sharded, consumer="lookout", converter=lookout_converter
+        )
+
+        def rows(db, sql):
+            return sorted(tuple(r) for r in db.query(sql))
+
+        for sql in (
+            "SELECT job_id, queue, jobset, state, priority FROM job",
+            "SELECT run_id, job_id, state FROM job_run",
+        ):
+            assert rows(serial, sql) == rows(sharded, sql)
+        assert serial.positions("lookout") == sharded.positions("lookout")
+        # saved views are globals: execute routes to the globals shard and
+        # reads resolve through the union
+        sharded.execute(
+            "INSERT INTO saved_view (name, payload, updated_ns) "
+            "VALUES (?, ?, ?)",
+            ("v1", "{}", 1),
+        )
+        assert rows(sharded, "SELECT name FROM saved_view") == [("v1",)]
+        serial.close()
+        sharded.close()
+    finally:
+        plane.close()
+
+
+# ------------------------------------------------------------- end to end ----
+
+
+def test_sharded_store_world_end_to_end(tmp_path, monkeypatch):
+    """The whole control plane on sharded stores (the serve --store-shards
+    shape): jobs submit, lease and finish with every materialized write
+    landing in a per-shard file."""
+    monkeypatch.setenv("ARMADA_STORE_SHARDS", str(STORE_SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_SHARDS", str(INGEST_SHARDS))
+    monkeypatch.setenv("ARMADA_INGEST_CONVERT", "inline")
+    plane = ControlPlane.build(tmp_path)
+    try:
+        assert isinstance(plane.db, ShardedSchedulerDb)
+        from armada_tpu.server.submit import JobSubmitItem
+
+        plane.server.create_queue(QueueRecord("ssq"))
+        plane.server.submit_jobs(
+            "ssq",
+            "js",
+            [JobSubmitItem(resources={"cpu": "1", "memory": "1"})],
+        )
+        plane.run_until(
+            lambda: "succeeded" in plane.job_states().values(), max_steps=40
+        )
+        # every shard file carries real rows or cursors; none is a stray
+        parts = {
+            db_part
+            for db_part in plane.db.positions("scheduler")
+        }
+        assert parts  # cursors committed through shard files
+    finally:
+        plane.close()
+
+
+def test_serial_allocator_reopen_seeds_from_all_shards(tmp_path):
+    """Reopening a sharded store seeds ONE allocator from every shard's
+    high-water mark -- new serials always land past everything on disk."""
+    db = _sharded_db(tmp_path, parts=4)
+    from armada_tpu.ingest import dbops
+
+    for k, jid in ((0, "r1"), (1, "r2")):
+        db.shard_sink(k, STORE_SHARDS).store(
+            [
+                dbops.InsertJobs(
+                    jobs={jid: {"job_id": jid, "queue": "q", "jobset": "j"}}
+                )
+            ],
+            next_positions={k: 1},
+        )
+    hi = db.max_serials()[0]
+    db.close()
+    db2 = ShardedSchedulerDb(str(tmp_path / "store-shards"))
+    assert db2._alloc.allocate("jobs") == hi + 1
+    db2._alloc.discarded([("jobs", hi + 1)])
+    db2.close()
+
+
+def test_serial_allocator_horizon_unit():
+    """The allocator's clamp algebra, independent of any store."""
+    alloc = SerialAllocator()
+    a = alloc.allocate("jobs")
+    b = alloc.allocate("jobs")
+    c = alloc.allocate("jobs")
+    assert (a, b, c) == (1, 2, 3)
+    alloc.committed([("jobs", b)])
+    assert alloc.horizon("jobs") == a - 1  # a still in flight
+    alloc.discarded([("jobs", a)])  # rollback: permanent gap
+    assert alloc.horizon("jobs") == b  # c in flight
+    alloc.committed([("jobs", c)])
+    assert alloc.horizon("jobs") == c
+    alloc.seed("jobs", 10)
+    assert alloc.allocate("jobs") == 11

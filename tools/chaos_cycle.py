@@ -397,6 +397,16 @@ def main() -> int:
         "convergence is exercised against the sharded ingesters, not a "
         "silent serial pipeline (default: inherit the environment)",
     )
+    ap.add_argument(
+        "--store-shards",
+        type=int,
+        default=None,
+        dest="store_shards",
+        help="arm the sharded materialized store (ARMADA_STORE_SHARDS, "
+        "ingest/storeunion.py) for EVERY leg -- per-shard SQLite files "
+        "behind the union reader; the ingest width rounds up to a "
+        "multiple (default: inherit the environment)",
+    )
     args = ap.parse_args()
 
     if args.commit_k is not None:
@@ -406,6 +416,10 @@ def main() -> int:
         os.environ["ARMADA_COMMIT_K"] = str(args.commit_k)
     if args.ingest_shards is not None:
         os.environ["ARMADA_INGEST_SHARDS"] = str(args.ingest_shards)
+    if args.store_shards is not None:
+        # Width is permanent per store dir; setting it here means every
+        # leg's fresh temp world builds at the armed width.
+        os.environ["ARMADA_STORE_SHARDS"] = str(args.store_shards)
 
     if args.mesh:
         # The drill must run anywhere: give the CPU platform enough virtual
@@ -618,6 +632,13 @@ def main() -> int:
 
     # the ingest-shard width every leg ran with (--ingest-shards / env)
     line["ingest_shards"] = resolve_num_shards()
+    # the store-shard width (0/absent env = the single shared writer)
+    try:
+        line["store_shards"] = max(
+            1, int(os.environ.get("ARMADA_STORE_SHARDS", "1"))
+        )
+    except ValueError:
+        line["store_shards"] = 1
     if args.mesh:
         line["mesh"] = {
             "requested": args.mesh,

@@ -87,6 +87,15 @@ def main() -> int:
         "environment (1 = serial)",
     )
     ap.add_argument(
+        "--store-shards",
+        type=int,
+        default=None,
+        dest="store_shards",
+        help="sharded materialized store width (ingest/storeunion.py; sets "
+        "ARMADA_STORE_SHARDS for the window; the ingest width rounds up to "
+        "a multiple); default: inherit the environment (1 = one writer)",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="JSON-line output (the default; kept for bench.py symmetry)",
@@ -96,6 +105,8 @@ def main() -> int:
         os.environ["ARMADA_COMMIT_K"] = str(args.commit_k)
     if args.ingest_shards is not None:
         os.environ["ARMADA_INGEST_SHARDS"] = str(args.ingest_shards)
+    if args.store_shards is not None:
+        os.environ["ARMADA_STORE_SHARDS"] = str(args.store_shards)
 
     # Tests force CPU; a standalone run uses whatever backend is healthy.
     from armada_tpu.loadgen.soak import SoakConfig, run_soak_cli
