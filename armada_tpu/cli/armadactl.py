@@ -447,6 +447,10 @@ def cmd_soak(args):
         overrides["num_nodes"] = args.nodes
     if args.queues is not None:
         overrides["num_queues"] = args.queues
+    if getattr(args, "node_types", None) is not None:
+        overrides["node_types"] = tuple(
+            t.strip() for t in args.node_types.split(",") if t.strip()
+        )
     report = run_soak_cli(
         SoakConfig.from_env(
             process=args.process,
@@ -1297,6 +1301,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="sharded materialized store width for the soak world "
         "(ingest/storeunion.py; the ingest width rounds up to a multiple); "
         "default: ARMADA_STORE_SHARDS or 1 (one writer)",
+    )
+    sk.add_argument(
+        "--node-types",
+        default=None,
+        dest="node_types",
+        metavar="T1,T2,...",
+        help="heterogeneous soak fleet: comma-separated node types assigned "
+        "round-robin across the fake nodes, with a fraction of submits "
+        "carrying node-type throughput maps (loadgen/workload.py); "
+        "default: ARMADA_SOAK_NODE_TYPES or homogeneous",
     )
     sk.set_defaults(fn=cmd_soak)
 

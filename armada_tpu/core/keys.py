@@ -36,6 +36,12 @@ class NodeType:
 
     taints: tuple[Taint, ...]
     indexed_labels: tuple[tuple[str, str], ...]  # sorted (label, value) pairs
+    # Hardware type (NodeSpec.node_type, executor-reported): two nodes with
+    # identical taints/labels but different hardware are NOT interchangeable
+    # once any job declares per-type scores, so the hardware axis is part of
+    # node-type identity.  "" (the default) keeps single-type worlds on the
+    # exact pre-hetero identities.
+    hw_type: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +61,13 @@ class SchedulingKey:
     # node domain (gang_scheduler.go NodeUniformity): a domain-restricted
     # gang must never retire the unrestricted jobs' key class.
     uniformity: tuple[str, str] = ("", "")
+    # Per-node-type effective-throughput map (JobSpec.node_type_scores,
+    # sorted).  Part of key identity because the key must determine EVERY
+    # placement-relevant property: the per-key fit cache and commit_k's head
+    # certification key on it, and a type-sensitive job sharing a key class
+    # with an insensitive twin would poison both (docs/lint.md ledger:
+    # "key must absorb the type axis").  () = type-insensitive.
+    type_scores: tuple[tuple[str, float], ...] = ()
 
 
 class NodeTypeIndex:
@@ -69,7 +82,7 @@ class NodeTypeIndex:
         labels = tuple(
             (k, node.labels[k]) for k in self.indexed_labels if k in node.labels
         )
-        nt = NodeType(tuple(node.taints), labels)
+        nt = NodeType(tuple(node.taints), labels, node.node_type)
         tid = self._ids.get(nt)
         if tid is None:
             tid = len(self.types)
@@ -103,6 +116,7 @@ def class_signature(job: JobSpec, node_id_label: str) -> tuple:
         tuple(job.tolerations),
         job.priority_class,
         job.priority,
+        tuple(job.node_type_scores),
     )
 
 
@@ -140,7 +154,11 @@ class SchedulingKeyIndex:
         tolerations = tuple(job.tolerations)
         bans = tuple(sorted(banned_nodes)) if banned_nodes else ()
         uni = tuple(uniformity)
-        probe = (resources, selector, tolerations, job.priority_class, job.priority, bans, uni)
+        tscores = tuple(job.node_type_scores)
+        probe = (
+            resources, selector, tolerations, job.priority_class, job.priority,
+            bans, uni, tscores,
+        )
         kid = self._ids.get(probe)
         if kid is None:
             kid = len(self.keys)
@@ -153,6 +171,7 @@ class SchedulingKeyIndex:
                     priority=job.priority,
                     banned_nodes=bans,
                     uniformity=uni,
+                    type_scores=tscores,
                 )
             )
             self._ids[probe] = kid
@@ -162,17 +181,40 @@ class SchedulingKeyIndex:
         return len(self.keys)
 
 
+def type_feasible(key: SchedulingKey, nt: NodeType) -> bool:
+    """Does the key's type-score map admit hardware type `nt.hw_type`?
+
+    A NONEMPTY map is a whitelist with weights (Gavel-style: a job has a
+    throughput on each type it can run on): hardware types absent from the
+    map, or mapped to a throughput <= 0, are infeasible.  An empty map (the
+    default) admits every type.
+    """
+    if not key.type_scores:
+        return True
+    for name, thr in key.type_scores:
+        if name == nt.hw_type:
+            return thr > 0
+    return False
+
+
 def static_fit_matrix(
     keys: Sequence[SchedulingKey],
     types: Sequence[NodeType],
+    *,
+    pre_type: bool = False,
 ) -> np.ndarray:
     """bool[K, T]: does job-class k statically fit node-class t?
 
     Static fit = tolerations cover the type's blocking taints AND the selector is
     satisfied by the type's indexed labels (nodematching.go NodeTypeJobRequirementsMet
-    :127 + StaticJobRequirementsMet:161).  Callers must index every label referenced
+    :127 + StaticJobRequirementsMet:161) AND the key's node-type-score map admits
+    the type's hardware (`type_feasible`).  Callers must index every label referenced
     by a selector (the problem builder does, via labels_referenced_by_selectors);
     a selector naming an unindexed label never matches.
+
+    pre_type=True skips the hardware-type gate -- the explain pass's
+    type-mismatch partition needs "would this fit if the type map admitted
+    everything" to tell type-gated infeasibility from shape infeasibility.
     """
     out = np.zeros((len(keys), len(types)), dtype=bool)
     type_labels = [dict(nt.indexed_labels) for nt in types]
@@ -181,9 +223,72 @@ def static_fit_matrix(
         for ti, nt in enumerate(types):
             if not taints_tolerated(nt.taints, key.tolerations):
                 continue
-            if selector_matches(sel, type_labels[ti]):
+            if not selector_matches(sel, type_labels[ti]):
+                continue
+            if pre_type or type_feasible(key, nt):
                 out[ki, ti] = True
     return out
+
+
+# Packing scores live in [0, R] (per-resource terms are alloc/scale <= 1);
+# a bias of 1024 per unit of (1/throughput - 1) tiers nodes by declared
+# throughput (types differing >= ~1% in 1/throughput never lose to packing)
+# while equal-throughput types still pack best-fit.  Power of two: the
+# f32 add `score + bias` the kernel and the sequential oracle both perform
+# stays exactly mirrorable.
+TYPE_BIAS_SCALE = 1024.0
+
+
+def type_score_tables(
+    keys: Sequence[SchedulingKey],
+    types: Sequence[NodeType],
+    K: int,
+    T: int,
+    *,
+    row_bucket: int = 8,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The kernel's per-type score-adjust tables, padded to (K, T).
+
+    Returns (key_type_row i32[K], type_bias f32[TR, T]):
+
+    - `key_type_row[k]` = the bias row of key k; row 0 is the all-zero
+      insensitive row, so every key with an empty type-score map (and every
+      padded key slot) shares it and TR == 1 means "no sensitive key in
+      this problem" -- the structural switch the kernel uses to compile the
+      exact pre-hetero body.
+    - `type_bias[r, t]` = (1/throughput - 1) * TYPE_BIAS_SCALE for hardware
+      types the row's map names feasibly; 0 elsewhere (infeasible types are
+      excluded by the compat gate, never by bias).  Computed in f32.
+
+    Distinct nonempty maps intern distinct rows; TR pads to `row_bucket`
+    past 1 so a newly interned map mid-steady-state rarely changes the
+    compiled shape (the compat-table discipline).
+    """
+    rows: dict[tuple, int] = {}
+    key_type_row = np.zeros((K,), np.int32)
+    for ki, key in enumerate(keys):
+        if not key.type_scores:
+            continue
+        row = rows.get(key.type_scores)
+        if row is None:
+            row = len(rows) + 1
+            rows[key.type_scores] = row
+        key_type_row[ki] = row
+    if not rows:
+        return key_type_row, np.zeros((1, T), np.float32)
+    TR = ((len(rows) + 1 + row_bucket - 1) // row_bucket) * row_bucket
+    type_bias = np.zeros((TR, T), np.float32)
+    hw_of = [nt.hw_type for nt in types]
+    for tscores, row in rows.items():
+        by_name = dict(tscores)
+        for ti, hw in enumerate(hw_of):
+            thr = by_name.get(hw)
+            if thr is not None and thr > 0:
+                type_bias[row, ti] = np.float32(
+                    (np.float32(1.0) / np.float32(thr) - np.float32(1.0))
+                    * np.float32(TYPE_BIAS_SCALE)
+                )
+    return key_type_row, type_bias
 
 
 def labels_referenced_by_selectors(

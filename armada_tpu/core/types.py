@@ -13,6 +13,51 @@ from typing import Mapping, Optional, Sequence
 
 from armada_tpu.core.resources import ResourceList
 
+# Node label the executor reports hardware type under (mirrors the
+# armada-tpu.io/pool label idiom) and the submit-side annotation carrying a
+# job's per-type throughput map ("v5e=2.0,v4=1"; parse_node_type_scores).
+NODE_TYPE_LABEL = "armada-tpu.io/node-type"
+NODE_TYPE_SCORES_ANNOTATION = "armada-tpu.io/node-type-scores"
+
+
+def parse_node_type_scores(text: str) -> tuple[tuple[str, float], ...]:
+    """Parse the node-type-scores annotation into the canonical sorted
+    ((type, throughput), ...) tuple JobSpec carries.
+
+    Sorted so that equal maps written in different orders produce the SAME
+    scheduling key (core/keys.class_signature folds the tuple verbatim).
+    Raises ValueError on malformed entries -- submit validation turns that
+    into a client-facing rejection.
+    """
+    text = (text or "").strip()
+    if not text:
+        return ()
+    out: dict[str, float] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"node-type-scores entry {part!r}: expected <type>=<throughput>"
+            )
+        try:
+            thr = float(val.strip())
+        except ValueError:
+            raise ValueError(
+                f"node-type-scores entry {part!r}: throughput is not a number"
+            ) from None
+        if thr <= 0:
+            raise ValueError(
+                f"node-type-scores entry {part!r}: throughput must be > 0"
+            )
+        if name in out:
+            raise ValueError(f"node-type-scores: duplicate type {name!r}")
+        out[name] = thr
+    return tuple(sorted(out.items()))
+
 
 @dataclasses.dataclass(frozen=True)
 class Taint:
@@ -71,6 +116,11 @@ class NodeSpec:
     taints: tuple[Taint, ...] = ()
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
     unschedulable: bool = False
+    # Hardware type reported by the executor (the NODE_TYPE_LABEL node label,
+    # e.g. "v5e" / "v4" / "cpu"); "" = the untyped default, so existing
+    # single-type worlds are unchanged.  Folds into core/keys.NodeType so the
+    # static fit matrix and the kernel's per-type score tables see it.
+    node_type: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +181,12 @@ class JobSpec:
     # services:10); the scheduler never reads these.
     services: tuple[ServiceSpec, ...] = ()
     ingress: tuple[IngressSpec, ...] = ()
+    # Per-node-type effective-throughput map, sorted ((type, throughput), ...)
+    # (Gavel, arXiv:2008.09213): a NONEMPTY map restricts the job to the named
+    # types (absent/<=0 = infeasible there) and biases placement toward
+    # higher-throughput types.  () = type-insensitive (every existing world).
+    # Folds into the scheduling key -- see core/keys.SchedulingKey.type_scores.
+    node_type_scores: tuple[tuple[str, float], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -73,6 +73,10 @@ def job_spec_to_proto(job: JobSpec) -> pb.JobSpec:
             )
             for ig in job.ingress
         ],
+        node_type_scores=[
+            pb.NodeTypeScore(node_type=t, throughput=thr)
+            for t, thr in job.node_type_scores
+        ],
     )
 
 
@@ -132,5 +136,12 @@ def job_spec_from_proto(
             for ig in msg.ingress
         )
         if len(msg.ingress)
+        else (),
+        # sorted: the canonical order class_signature folds (the submit side
+        # already sorts; replay from an older writer must agree)
+        node_type_scores=tuple(
+            sorted((x.node_type, float(x.throughput)) for x in msg.node_type_scores)
+        )
+        if len(msg.node_type_scores)
         else (),
     )

@@ -29,7 +29,7 @@ import urllib.request
 from typing import Optional, Sequence
 
 from armada_tpu.core.resources import ResourceListFactory, format_quantity
-from armada_tpu.core.types import JobSpec, NodeSpec, Taint
+from armada_tpu.core.types import NODE_TYPE_LABEL, JobSpec, NodeSpec, Taint
 from armada_tpu.executor.cluster import PodPhase, PodState
 
 RUN_LABEL = "armada-tpu.io/run-id"
@@ -708,6 +708,7 @@ class KubernetesClusterContext:
                     labels=labels,
                     taints=taints,
                     unschedulable=bool(spec.get("unschedulable", False)),
+                    node_type=labels.get(NODE_TYPE_LABEL, ""),
                 )
             )
         return nodes

@@ -83,6 +83,12 @@ class SoakConfig:
     # storage and rounds the ingest width up to a multiple (each worker's
     # partition set must live in one store shard).
     store_shards: Optional[int] = None
+    # Heterogeneous fleet: hardware types assigned round-robin across the
+    # soak nodes (() = every node untyped, the pre-heterogeneity world) and
+    # the fraction of submits carrying a node-type throughput map over
+    # those types (loadgen/workload.MixConfig.type_sensitive_fraction).
+    node_types: tuple = ()
+    type_sensitive_fraction: float = 0.3
 
     @staticmethod
     def from_env(**overrides) -> "SoakConfig":
@@ -93,6 +99,11 @@ class SoakConfig:
             num_nodes=int(os.environ.get("ARMADA_SOAK_NODES", 8)),
             num_queues=int(os.environ.get("ARMADA_SOAK_QUEUES", 4)),
             db_url=os.environ.get("ARMADA_SOAK_DSN") or None,
+            node_types=tuple(
+                t.strip()
+                for t in os.environ.get("ARMADA_SOAK_NODE_TYPES", "").split(",")
+                if t.strip()
+            ),
         )
         kw.update(overrides)
         return SoakConfig(**kw)
@@ -289,6 +300,13 @@ class SoakWorld:
                 total_resources=factory.from_mapping(
                     {"cpu": cfg.node_cpu, "memory": cfg.node_memory}
                 ),
+                # round-robin so every configured type has capacity (the
+                # rebuild after a crash leg recreates the same assignment)
+                node_type=(
+                    cfg.node_types[i % len(cfg.node_types)]
+                    if cfg.node_types
+                    else ""
+                ),
             )
             for i in range(cfg.num_nodes)
         ]
@@ -479,7 +497,15 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
     world = SoakWorld(cfg, data_dir)
     jobset = f"soak-{cfg.seed}"
     arrivals = make_arrivals(cfg.process, cfg.target_eps, seed=cfg.seed)
-    mix = MixConfig(num_queues=cfg.num_queues, gang_fraction=cfg.gang_fraction, jobset=jobset)
+    mix = MixConfig(
+        num_queues=cfg.num_queues,
+        gang_fraction=cfg.gang_fraction,
+        jobset=jobset,
+        node_types=cfg.node_types,
+        type_sensitive_fraction=(
+            cfg.type_sensitive_fraction if cfg.node_types else 0.0
+        ),
+    )
     gen = WorkloadGenerator(mix, seed=cfg.seed)
     tracker = LifecycleTracker()
     event_cursors = {q: 0 for q in gen.queues}

@@ -41,6 +41,14 @@ class MixConfig:
     cpu_choices: Sequence[str] = ("1", "2", "4")
     memory_choices: Sequence[str] = ("1", "2")
     priority_levels: int = 4
+    # Heterogeneous-fleet mix: this fraction of submits carries a node-type
+    # throughput map drawn over `node_types` (riding the submit annotation,
+    # so the soak exercises the full parse -> key -> kernel-bias path).
+    # Empty node_types or 0.0 = every job type-insensitive (the default
+    # mix, bit-identical to pre-heterogeneity runs).
+    type_sensitive_fraction: float = 0.0
+    node_types: Sequence[str] = ()
+    throughput_choices: Sequence[float] = (0.5, 1.0, 2.0, 4.0)
 
 
 @dataclasses.dataclass
@@ -94,12 +102,29 @@ class WorkloadGenerator:
 
     def _item(self) -> JobSubmitItem:
         rng = self._rng
+        annotations = {}
+        mix = self.mix
+        if mix.node_types and rng.random() < mix.type_sensitive_fraction:
+            # Whitelist of 1..all fleet types with per-type throughputs; at
+            # least one type stays admitted so the job is schedulable (the
+            # SubmitChecker unknown-type rejection has its own unit drill).
+            k = 1 + rng.randrange(len(mix.node_types))
+            chosen = rng.sample(list(mix.node_types), k)
+            from armada_tpu.core.types import NODE_TYPE_SCORES_ANNOTATION
+
+            annotations[NODE_TYPE_SCORES_ANNOTATION] = ",".join(
+                f"{t}={rng.choice(mix.throughput_choices)}" for t in chosen
+            )
+            self.counts["type_sensitive"] = (
+                self.counts.get("type_sensitive", 0) + 1
+            )
         return JobSubmitItem(
             resources={
                 "cpu": rng.choice(self.mix.cpu_choices),
                 "memory": rng.choice(self.mix.memory_choices),
             },
             priority=rng.randrange(self.mix.priority_levels),
+            annotations=annotations,
         )
 
     def _pick_targets(self, rng: random.Random, k_max: int = 8):

@@ -36,12 +36,20 @@ Reason codes (the catalogue; docs/observability.md):
                          resource cap / iteration budget) while round-final
                          capacity could still hold the job -- a genuinely
                          early stop, not exhaustion.
+  ``type-mismatch``      the key would fit an empty node if its node-type
+                         whitelist (JobSpec.node_type_scores) were ignored,
+                         but every node of an admitted hardware type is too
+                         small / tainted / selector-excluded.  Splits the
+                         old shape-infeasible bucket: resubmitting with a
+                         wider type map CAN help, resubmitting a true
+                         shape-infeasible job cannot.
 
-``shape-infeasible``, ``capacity-blocked`` and ``gang-partial`` partition
-the *failed* set (g_state == 2); all five can appear for still-pending
-jobs (g_state == 0), which are reported in the queue/pool histograms but
-are not in ``RoundOutcome.failed``, mirroring the kernel's semantics
-(gated gangs keep their chance next round).
+``shape-infeasible``, ``capacity-blocked``, ``gang-partial`` and
+``type-mismatch`` partition the *failed* set (g_state == 2); all reasons
+can appear for still-pending jobs (g_state == 0), which are reported in
+the queue/pool histograms but are not in ``RoundOutcome.failed``,
+mirroring the kernel's semantics (gated gangs keep their chance next
+round).
 
 Transfer economics (the CLAUDE.md constraint): the whole result packs into
 ONE i32 buffer fetched in ONE device->host transfer (~90KB at the default
@@ -81,7 +89,8 @@ REASON_CAPACITY = 2
 REASON_FAIRNESS = 3
 REASON_GANG = 4
 REASON_TERMINATED = 5
-NUM_REASONS = 6
+REASON_TYPE = 6
+NUM_REASONS = 7
 REASON_NAMES = (
     "none",
     "shape-infeasible",
@@ -89,17 +98,18 @@ REASON_NAMES = (
     "fairness-capped",
     "gang-partial",
     "round-terminated",
+    "type-mismatch",
 )
 # The reasons that partition RoundOutcome.failed (g_state == 2).
-FAILED_REASONS = (REASON_SHAPE, REASON_CAPACITY, REASON_GANG)
+FAILED_REASONS = (REASON_SHAPE, REASON_CAPACITY, REASON_GANG, REASON_TYPE)
 
 # Packed-buffer caps; module-level so tests can shrink them to force the
 # truncation paths (mirrors problem._COMPACT_FCAP).
 _EXPLAIN_KCAP = 4096
 _EXPLAIN_FCAP = 8192
 
-_HEADER = 8  # [version, n_keys, n_failed_gangs, n_failed_jobs, Q, R, 0, 0]
-_VERSION = 1
+_HEADER = 8  # [version, n_keys, n_failed_gangs, n_failed_jobs, Q, R, T, 0]
+_VERSION = 2  # v2: type-mismatch reason + per-type fragmentation rows
 
 
 def explain_interval() -> int:
@@ -194,6 +204,7 @@ def _kernel():
 
 def _explain_kernel_impl(
     compat,
+    compat_pre_type,
     node_type,
     node_ok,
     node_total,
@@ -222,13 +233,14 @@ def _explain_kernel_impl(
     the round kernel does not arise.
 
     Layout (i32): [version, n_keys, n_failed_gangs, n_failed_jobs, Q, R,
-    0, 0] ++ counts_failed[NUM_REASONS] ++ counts_pending[NUM_REASONS] ++
+    T, 0] ++ counts_failed[NUM_REASONS] ++ counts_pending[NUM_REASONS] ++
     queue_counts[Q*NUM_REASONS] ++ key_id[kcap] ++ key_reason[kcap] ++
     key_count[kcap] ++ failed_idx[fcap] ++ failed_reason[fcap] ++
-    frag_free_bits[R] ++ frag_max_bits[R].  ``failed_idx``/
-    ``failed_reason`` come from the ascending nonzero scan of the SAME
-    failed mask compact_result packs (real & g_state == 2), so the host
-    expands gang -> job ids lazily without a second transfer.
+    frag_free_bits[R] ++ frag_max_bits[R] ++ type_frag_free_bits[T*R] ++
+    type_frag_max_bits[T*R].  ``failed_idx``/``failed_reason`` come from
+    the ascending nonzero scan of the SAME failed mask compact_result
+    packs (real & g_state == 2), so the host expands gang -> job ids
+    lazily without a second transfer.
     """
     import jax
     import jax.numpy as jnp
@@ -270,17 +282,22 @@ def _explain_kernel_impl(
     # the working set stays [K, N], never [K, N, R].  `fits_now` is the same
     # check against round-final FREE capacity: a pending key that fits no
     # node NOW is blocked by allocations regardless of why the round
-    # stopped.
+    # stopped.  ``fits_empty_pre`` re-runs the empty-fleet check with the
+    # node-type whitelist gate REMOVED (compat_pre_type, core/keys
+    # static_fit_matrix(pre_type=True)): a key feasible pre-type but not
+    # post-type is a type mismatch, not a shape infeasibility.
     fits_empty = compat[:, node_type] & node_ok[None, :]  # [K, N]
+    fits_empty_pre = compat_pre_type[:, node_type] & node_ok[None, :]
     fits_now = fits_empty
     for ri in range(R):
-        fits_empty = fits_empty & (
-            node_total[:, ri][None, :] >= req_node_k[:, ri][:, None]
-        )
+        size_ok = node_total[:, ri][None, :] >= req_node_k[:, ri][:, None]
+        fits_empty = fits_empty & size_ok
+        fits_empty_pre = fits_empty_pre & size_ok
         fits_now = fits_now & (
             free[:, ri][None, :] >= req_node_k[:, ri][:, None]
         )
     shape_ok = jnp.any(fits_empty, axis=1)  # [K]
+    shape_ok_pre = jnp.any(fits_empty_pre, axis=1)  # [K]
     now_ok = jnp.any(fits_now, axis=1)  # [K]
 
     # Shape-infeasibility is TIME-INVARIANT, so it dominates every dynamic
@@ -290,7 +307,12 @@ def _explain_kernel_impl(
     # oversized candidate itself without ever marking it failed).  Pending
     # attribution order: fairness gate (the queue was deactivated first),
     # then blocked-by-allocations-now, then a genuinely early stop.
-    shape_bad_g = keyed & ~shape_ok[ksafe]
+    shape_bad_g = keyed & ~shape_ok_pre[ksafe]
+    # Feasible ignoring the type whitelist, infeasible under it: the
+    # whitelist is what blocks.  Both are time-invariant static facts, so
+    # both dominate the dynamic reasons; true shape dominates type (a job
+    # too big for EVERY node is not helped by widening its type map).
+    type_bad_g = keyed & ~shape_ok[ksafe]
     now_blocked_g = keyed & ~now_ok[ksafe]
     reason_g = jnp.where(
         failed | pending,
@@ -298,15 +320,19 @@ def _explain_kernel_impl(
             shape_bad_g,
             REASON_SHAPE,
             jnp.where(
-                failed,
+                type_bad_g,
+                REASON_TYPE,
                 jnp.where(
-                    (g_card > 1) | ~g_valid, REASON_GANG, REASON_CAPACITY
-                ),
-                jnp.where(
-                    q_killed[g_queue],
-                    REASON_FAIRNESS,
+                    failed,
                     jnp.where(
-                        now_blocked_g, REASON_CAPACITY, REASON_TERMINATED
+                        (g_card > 1) | ~g_valid, REASON_GANG, REASON_CAPACITY
+                    ),
+                    jnp.where(
+                        q_killed[g_queue],
+                        REASON_FAIRNESS,
+                        jnp.where(
+                            now_blocked_g, REASON_CAPACITY, REASON_TERMINATED
+                        ),
                     ),
                 ),
             ),
@@ -362,6 +388,14 @@ def _explain_kernel_impl(
     frag_free = jnp.sum(free, axis=0)
     frag_max = jnp.max(free, axis=0)
 
+    # Per-hardware-type fragmentation: the same forensics split by the
+    # node's static type id (one scatter-add + scatter-max over [N, R] --
+    # a shattered accelerator pool hides inside healthy aggregate numbers
+    # when the CPU tier holds most of the free capacity).
+    T = compat_pre_type.shape[1]
+    type_frag_free = jnp.zeros((T, R), jnp.float32).at[node_type].add(free)
+    type_frag_max = jnp.zeros((T, R), jnp.float32).at[node_type].max(free)
+
     header = jnp.stack(
         [
             jnp.int32(_VERSION),
@@ -370,7 +404,7 @@ def _explain_kernel_impl(
             n_failed_jobs.astype(jnp.int32),
             jnp.int32(Q),
             jnp.int32(R),
-            jnp.int32(0),
+            jnp.int32(T),
             jnp.int32(0),
         ]
     )
@@ -390,6 +424,8 @@ def _explain_kernel_impl(
             failed_reason_out.astype(jnp.int32),
             bits(frag_free),
             bits(frag_max),
+            bits(type_frag_free.reshape(-1)),
+            bits(type_frag_max.reshape(-1)),
         ]
     )
 
@@ -415,6 +451,9 @@ class ExplainOutcome:
     queue_counts: dict  # queue name -> {reason name: job count}
     key_reasons: list  # [{"key": int, "reason": str, "jobs": int}]
     fragmentation: dict  # resource -> {free, largest_request, index} (atoms)
+    # hw type -> {resource -> {free, largest_request, index}}; {} on
+    # single-type fleets (the aggregate row says the same thing).
+    fragmentation_by_type: dict = dataclasses.field(default_factory=dict)
     truncated_keys: bool = False
     job_reasons_complete: bool = True
     _failed_idx: Optional[np.ndarray] = None
@@ -436,7 +475,7 @@ class ExplainOutcome:
 
     def summary(self) -> dict:
         """The JSON-ready block reports / healthz / bench share."""
-        return {
+        out = {
             "counts": dict(self.counts),
             "failed_counts": dict(self.failed_counts),
             "pending_counts": dict(self.pending_counts),
@@ -446,6 +485,12 @@ class ExplainOutcome:
             "keys": list(self.key_reasons),
             "truncated_keys": self.truncated_keys,
         }
+        if self.fragmentation_by_type:
+            out["fragmentation_by_type"] = {
+                t: {name: dict(vals) for name, vals in row.items()}
+                for t, row in self.fragmentation_by_type.items()
+            }
+        return out
 
 
 def _mesh_blocked(arr) -> bool:
@@ -475,6 +520,7 @@ def dispatch_explain(device_problem, result, ctx):
     fcap = min(G, _EXPLAIN_FCAP)
     buf = _kernel()(
         device_problem.compat,
+        device_problem.compat_pre_type,
         device_problem.node_type,
         device_problem.node_ok,
         device_problem.node_total,
@@ -513,8 +559,8 @@ def finish_explain(dispatched, ctx, outcome=None) -> Optional[ExplainOutcome]:
     from armada_tpu.models.xfer import TRANSFER_STATS
 
     TRANSFER_STATS.count_down(buf.nbytes)
-    version, n_keys, n_failed_gangs, n_failed_jobs, Q, R = (
-        int(v) for v in buf[:6]
+    version, n_keys, n_failed_gangs, n_failed_jobs, Q, R, T = (
+        int(v) for v in buf[:7]
     )
     if version != _VERSION:
         return None
@@ -538,6 +584,10 @@ def finish_explain(dispatched, ctx, outcome=None) -> Optional[ExplainOutcome]:
     frag_free = buf[off : off + R].view(np.float32)
     off += R
     frag_max = buf[off : off + R].view(np.float32)
+    off += R
+    type_frag_free = buf[off : off + T * R].view(np.float32).reshape(T, R)
+    off += T * R
+    type_frag_max = buf[off : off + T * R].view(np.float32).reshape(T, R)
 
     failed_counts = {
         REASON_NAMES[r]: int(failed_vec[r]) for r in range(1, NUM_REASONS)
@@ -577,22 +627,41 @@ def finish_explain(dispatched, ctx, outcome=None) -> Optional[ExplainOutcome]:
     ]
 
     factory = ctx.config.resource_list_factory()
-    fragmentation = {}
-    for ri, name in enumerate(factory.names):
-        if ri >= R:
-            break
-        free_units = float(frag_free[ri])
-        max_units = float(frag_max[ri])
-        res = factory.resolutions[ri]
-        fragmentation[name] = {
-            "free": int(round(free_units * res)),
-            "largest_request": int(round(max_units * res)),
-            # 1 - largest contiguous block / total free: 0 = one node could
-            # absorb all free capacity, ->1 = free capacity is shattered.
-            "index": (
-                round(1.0 - max_units / free_units, 6) if free_units > 0 else 0.0
-            ),
-        }
+
+    def frag_row(free_vec, max_vec):
+        row = {}
+        for ri, name in enumerate(factory.names):
+            if ri >= R:
+                break
+            free_units = float(free_vec[ri])
+            max_units = float(max_vec[ri])
+            res = factory.resolutions[ri]
+            row[name] = {
+                "free": int(round(free_units * res)),
+                "largest_request": int(round(max_units * res)),
+                # 1 - largest contiguous block / total free: 0 = one node
+                # could absorb all free capacity, ->1 = shattered.
+                "index": (
+                    round(1.0 - max_units / free_units, 6)
+                    if free_units > 0
+                    else 0.0
+                ),
+            }
+        return row
+
+    fragmentation = frag_row(frag_free, frag_max)
+    # Device rows beyond the real type count are bucket padding (all-zero);
+    # name rows by the host-side hardware-type registry.  Single-type fleets
+    # skip the split -- the aggregate row already says it.
+    type_names = list(getattr(ctx, "type_names", ()) or ())
+    fragmentation_by_type = {}
+    if len(type_names) > 1:
+        for ti, tname in enumerate(type_names):
+            if ti >= T:
+                break
+            fragmentation_by_type[tname or "untyped"] = frag_row(
+                type_frag_free[ti], type_frag_max[ti]
+            )
 
     live = failed_idx >= 0
     out = ExplainOutcome(
@@ -602,6 +671,7 @@ def finish_explain(dispatched, ctx, outcome=None) -> Optional[ExplainOutcome]:
         queue_counts=queue_counts,
         key_reasons=keys,
         fragmentation=fragmentation,
+        fragmentation_by_type=fragmentation_by_type,
         truncated_keys=n_keys > kcap,
         job_reasons_complete=n_failed_gangs <= fcap,
         _failed_idx=failed_idx[live],

@@ -352,6 +352,16 @@ def _make_place_iteration(
     g_float_tot = (
         p.g_req * (1.0 - p.node_axes)[None, :]
     ) * p.g_card[:, None].astype(jnp.float32)  # [G, R] floating total per gang
+    # Heterogeneity (per-node-type throughput bias): a STATIC shape switch --
+    # TR == 1 means no type-sensitive key exists and the body below compiles
+    # bit-identical to the pre-hetero kernel.  When armed, the per-node bias
+    # table is precomputed here ([TR, N], loop-invariant) and the body does
+    # ONE row gather through the already-gathered key -- the ban_mask
+    # discipline; any in-loop compute from the gathered row would defeat
+    # XLA's invariant hoisting.
+    hetero = int(p.type_bias.shape[0]) > 1
+    if hetero:
+        type_bias_nodes = p.type_bias[:, p.node_type]  # [TR, N]
     if prefer_large:
         # itemSize = unweighted gang cost x queue weight (queue_scheduler.go:518
         # -- a highly-weighted queue's gangs "look larger"); [G], gathered.
@@ -473,6 +483,12 @@ def _make_place_iteration(
         #      full [N,R] member-capacity chains once per (key % S) slot.
         #   2. general (gangs, banned, keyless): the original full path.
         static_ok = jnp.where(key >= 0, p.compat[jnp.maximum(key, 0)][p.node_type], True)
+        if hetero:
+            # Bias row of the candidate's key (row 0 = insensitive/keyless);
+            # one invariant-table gather, like ban_mask.
+            trow = jnp.where(
+                key >= 0, p.key_type_row[jnp.maximum(key, 0)], 0
+            )
         # Pool-level floating capacity (evictee slots already counted at init).
         float_ok = is_evictee | jnp.all(
             c.float_used + req_float_tot <= p.float_total + 1e-3
@@ -606,6 +622,10 @@ def _make_place_iteration(
             cap_sel = jnp.where(use_clean, cap_clean, cap_lvl)
             alloc_sel = jnp.where(use_clean, alloc_clean, alloc_lvl)
             score = node_packing_score(alloc_sel, p.inv_scale)
+            if hetero:
+                # One gathered row of the precomputed [TR, N] table; the
+                # f32 add is mirrored by the sequential oracle.
+                score = score + type_bias_nodes[trow]
             fit_feasible = jnp.sum(cap_sel) >= card
 
             def single_branch(_):
@@ -631,6 +651,11 @@ def _make_place_iteration(
             cacheable = (
                 (card == 1) & (~is_evictee) & (key >= 0) & (p.g_ban_row[g] == 0)
             )
+            if hetero:
+                # score_c is a per-LEVEL table shared across cache slots; a
+                # per-key bias cannot bake into it.  Type-sensitive
+                # candidates take the general path (exact, biased) instead.
+                cacheable &= trow == 0
             branch = jnp.where(is_evictee, 0, jnp.where(cacheable, 1, 2))
             branches = [evictee_path, cached_single_path, general_path]
         else:
@@ -785,6 +810,20 @@ def _make_place_iteration(
             # singles with a live order key; everything else truncates and
             # runs as an exact head next iteration.
             elig = (keye < _INF) & (card_e == 1) & (run_e < 0) & (ban_e == 0)
+            if hetero:
+                # Type-sensitive extension candidates truncate: the
+                # same-node-stacking proof in (7) reasons about the UNBIASED
+                # packing score, and a per-key node offset can flip the
+                # first-argmin between lanes of different keys.  The head
+                # lane is the exact biased path, so sensitive picks run
+                # solo-head next iteration (bit-exact, just fewer commits
+                # per trip on sensitive-heavy mixes).
+                elig &= (
+                    jnp.where(
+                        key_e >= 0, p.key_type_row[jnp.maximum(key_e, 0)], 0
+                    )
+                    == 0
+                )
 
             # (4) caps/burst/float in commit order.  Distinct queues mean the
             # per-queue gates see no intra-batch accumulation; the global
@@ -1170,6 +1209,16 @@ def _make_place_iteration(
                 reqn_j = wreq_node[qj, i_safe]
                 flt_j = wfloat[qj, i_safe]
                 pin_j = wpin[qj, i_safe]
+                if hetero:
+                    # this pick's bias row ([N], row 0 for keyless) -- the
+                    # replay mirrors the head path's (score) + bias add
+                    tb_j = type_bias_nodes[
+                        jnp.where(
+                            key_j >= 0,
+                            p.key_type_row[jnp.maximum(key_j, 0)],
+                            0,
+                        )
+                    ]
                 ok &= card_j == 1  # gang heads defer to the full path
                 # running caps/bursts incl. same-queue repeats in this chain
                 prevq = ex_placed & (ex_queue == qj) & ~ex_evs
@@ -1215,8 +1264,12 @@ def _make_place_iteration(
                     okn = static_j & p.node_ok & ~p.ban_mask[ban_j]
                     f0 = okn & _fit_row(alloc[0], reqn_j[None, :])
                     fl = okn & _fit_row(alloc[lvl_j], reqn_j[None, :])
-                    m0 = jnp.where(f0, score_all[0], _INF)
-                    ml = jnp.where(fl, score_all[lvl_j], _INF)
+                    s0, sl_ = score_all[0], score_all[lvl_j]
+                    if hetero:
+                        s0 = s0 + tb_j
+                        sl_ = sl_ + tb_j
+                    m0 = jnp.where(f0, s0, _INF)
+                    ml = jnp.where(fl, sl_, _INF)
                     return f0, fl, m0, ml, jnp.sum(f0).astype(jnp.int32)
 
                 def cached(_):
@@ -1273,11 +1326,10 @@ def _make_place_iteration(
                     & fsel[tn_safe]  # static/ok/ban masks are node-stable
                     & ex_placed
                 )
-                sc_t = jnp.where(
-                    fit_t,
-                    jnp.sum(adjs * p.inv_scale[None, :], axis=-1),
-                    _INF,
-                )
+                base_t = jnp.sum(adjs * p.inv_scale[None, :], axis=-1)
+                if hetero:
+                    base_t = base_t + tb_j[tn_safe]
+                sc_t = jnp.where(fit_t, base_t, _INF)
                 t_best_score = jnp.min(sc_t)
                 t_best_node = jnp.min(
                     jnp.where(sc_t == t_best_score, t_nodes, N)

@@ -50,6 +50,7 @@ from armada_tpu.core.keys import (
     NodeTypeIndex,
     SchedulingKeyIndex,
     static_fit_matrix,
+    type_score_tables,
 )
 from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 from armada_tpu.models.problem import (
@@ -622,6 +623,8 @@ class IncrementalBuilder:
         self.ntidx = NodeTypeIndex(self._indexed)
         self._compat: Optional[np.ndarray] = None
         self._compat_dims = (0, 0)
+        self._type_tables_cache: Optional[tuple] = None
+        self._type_tables_dims = (0, 0)
 
         self.jobs = _SortedTable(
             self.R,
@@ -909,6 +912,8 @@ class IncrementalBuilder:
             self.node_type[i] = self.ntidx.type_of(n)
         self._compat = None
         self._compat_dims = (0, 0)
+        self._type_tables_cache = None
+        self._type_tables_dims = (0, 0)
         self._retype_needed = False
         self._node_epoch += 1
 
@@ -1374,6 +1379,27 @@ class IncrementalBuilder:
             self._compat_dims = real
         return self._compat
 
+    def _type_tables(self) -> tuple:
+        """(type_bias f32[TR,T], key_type_row i32[K], compat_pre_type bool[K,T])
+        padded to the SAME bucketed dims as _compat_matrix (the kernel gathers
+        all three through the same key/type ids); cached and invalidated on
+        the same (real K, real T) as the compat rebuild."""
+        real = (len(self.kidx), len(self.ntidx))
+        if self._type_tables_cache is None or self._type_tables_dims != real:
+            K = _pad(max(1, real[0]), 32)
+            T = _pad(max(1, real[1]), 32)
+            pre = np.zeros((K, T), bool)
+            if real[0] and real[1]:
+                pre[: real[0], : real[1]] = static_fit_matrix(
+                    self.kidx.keys, self.ntidx.types, pre_type=True
+                )
+            key_type_row, type_bias = type_score_tables(
+                self.kidx.keys, self.ntidx.types, K, T
+            )
+            self._type_tables_cache = (type_bias, key_type_row, pre)
+            self._type_tables_dims = real
+        return self._type_tables_cache
+
     def _prices(self) -> Optional[np.ndarray]:
         """f32[Q, B] bid-price table for market pools, refreshed per cycle
         (prices move between cycles; jobs only store their band index)."""
@@ -1788,6 +1814,7 @@ class IncrementalBuilder:
             raise ValueError(f"gang cardinality {max_card} exceeds the supported 10k")
         W = max(1, min(max_card, N))
         S_slots = max(1, min(nreal_g, burst_cfg))
+        type_bias, key_type_row, compat_pre_type = self._type_tables()
 
         problem = SchedulingProblem(
             node_total=node_total,
@@ -1836,6 +1863,9 @@ class IncrementalBuilder:
             spot_cutoff=self.spot_cutoff,
             ban_mask=ban_mask,
             g_ban_row=g_ban_row,
+            type_bias=type_bias,
+            key_type_row=key_type_row,
+            compat_pre_type=compat_pre_type,
         )
 
         gang_ids_vec = np.zeros((nreal_g,), _ID_DTYPE)
@@ -1863,6 +1893,7 @@ class IncrementalBuilder:
             pc_names=list(self.pc_names),
             max_slots=S_slots,
             slot_width=W,
+            type_names=[nt.hw_type for nt in self.ntidx.types],
             q_demand_raw=q_demand_raw,
             pool_total_atoms={
                 name: int(round(float(total_pool64[i]) * self.factory.resolutions[i]))
@@ -2509,6 +2540,7 @@ class IncrementalBuilder:
         sc = self._single_content_cols(i_sing, prices)
         sg_cols = {name: sg_field(name, vals) for name, vals in sc.items()}
         rr_cols, ev_cols = self._run_content_cols(rr_dirty, s_cap, prices)
+        type_bias, key_type_row, compat_pre_type = self._type_tables()
 
         fulls = {
             # omitted when the splice carries the order (a few KB vs 4MB)
@@ -2519,6 +2551,9 @@ class IncrementalBuilder:
             "q_cds": q_cds,
             "q_penalty": self._stable("q_penalty", q_penalty),
             "compat": self._compat_matrix(),
+            "type_bias": type_bias,
+            "key_type_row": key_type_row,
+            "compat_pre_type": compat_pre_type,
             "total_pool": total_pool,
             "drf_mult": drf_mult,
             "inv_scale": nc["inv_scale"],
@@ -2645,6 +2680,9 @@ class IncrementalBuilder:
                         uc["g_ban_row"],
                     ]
                 ),
+                type_bias=fulls["type_bias"],
+                key_type_row=fulls["key_type_row"],
+                compat_pre_type=fulls["compat_pre_type"],
             )
 
         sig = (
@@ -2702,6 +2740,7 @@ class IncrementalBuilder:
             pc_names=list(self.pc_names),
             max_slots=S_slots,
             slot_width=W,
+            type_names=[nt.hw_type for nt in self.ntidx.types],
             q_demand_raw=q_demand_raw,
             pool_total_atoms={
                 name: int(round(float(total_pool64[i]) * self.factory.resolutions[i]))

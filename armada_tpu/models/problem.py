@@ -40,6 +40,7 @@ from armada_tpu.core.keys import (
     class_signature,
     labels_referenced_by_selectors,
     static_fit_matrix,
+    type_score_tables,
 )
 from armada_tpu.core.types import JobSpec, NodeSpec, Queue, RunningJob
 
@@ -125,6 +126,18 @@ class SchedulingProblem(NamedTuple):
     # candidate would defeat XLA's invariant hoisting (see CLAUDE.md).
     ban_mask: np.ndarray  # bool[BR, N]
     g_ban_row: np.ndarray  # i32[G]
+    # Heterogeneity (per-node-type throughput scoring, Gavel arXiv:2008.09213):
+    # `type_bias[key_type_row[key], t]` is the packing-score adjustment of a
+    # candidate with scheduling key `key` on a node of static type t -- the
+    # exact ban_mask discipline: dense tables precomputed OUTSIDE the round
+    # loop, one invariant-table gather in-loop.  Row 0 of type_bias is the
+    # all-zero insensitive row; TR == 1 (no type-sensitive key anywhere) is
+    # the structural switch that compiles the exact pre-hetero kernel body.
+    # `compat_pre_type` is the static fit WITHOUT the hardware-type gate --
+    # the explain pass partitions type-mismatch vs shape-infeasible with it.
+    type_bias: np.ndarray  # f32[TR, T]
+    key_type_row: np.ndarray  # i32[K]
+    compat_pre_type: np.ndarray  # bool[K, T]
 
 
 @dataclasses.dataclass
@@ -169,6 +182,12 @@ class HostContext:
     # members or loses all (the reference evicts the remains of partially
     # evicted gangs and re-schedules them as one all-or-nothing unit).
     running_gangs: dict = dataclasses.field(default_factory=dict)
+    # Static node-type id -> hardware type name ("" = the untyped default)
+    # for the REAL types of this round's NodeTypeIndex; explain's per-type
+    # fragmentation merges the device's per-static-type rows onto hardware
+    # types through it (several static types share one hw_type whenever
+    # taints/labels differ within the hardware class).
+    type_names: list = dataclasses.field(default_factory=list)
     # The compact decode buffer EXACTLY as this round's fetch received it
     # (stashed by _fetch_compact, overwritten per round; None on the
     # full-pull fallback).  Round verification (models/verify.py)
@@ -1026,8 +1045,13 @@ def build_problem(
     K = max(1, len(kidx))
     T = max(1, len(ntidx))
     compat = np.zeros((K, T), bool)
+    compat_pre_type = np.zeros((K, T), bool)
     if len(kidx) and len(ntidx):
         compat[: len(kidx), : len(ntidx)] = static_fit_matrix(kidx.keys, ntidx.types)
+        compat_pre_type[: len(kidx), : len(ntidx)] = static_fit_matrix(
+            kidx.keys, ntidx.types, pre_type=True
+        )
+    key_type_row, type_bias = type_score_tables(kidx.keys, ntidx.types, K, T)
 
     # --- pool totals, DRF, caps -------------------------------------------------
     float_total = np.zeros((R,), np.float32)
@@ -1221,6 +1245,9 @@ def build_problem(
         ),
         ban_mask=ban_mask,
         g_ban_row=g_ban_row,
+        type_bias=type_bias,
+        key_type_row=key_type_row,
+        compat_pre_type=compat_pre_type,
     )
     ctx = HostContext(
         config=config,
@@ -1249,6 +1276,7 @@ def build_problem(
             for tag, ris in running_gang_groups.items()
             if len(ris) > 1
         },
+        type_names=[nt.hw_type for nt in ntidx.types],
     )
     return problem, ctx
 

@@ -224,7 +224,10 @@ class DeltaBundle:
 
 # Node-axis fields: identity-cached (same array objects across cycles while
 # the fleet is unchanged), re-uploaded only on node-epoch change.
-_NODE_FIELDS = ("node_total", "node_type", "node_ok", "compat")
+_NODE_FIELDS = (
+    "node_total", "node_type", "node_ok", "compat",
+    "type_bias", "key_type_row", "compat_pre_type",
+)
 
 _SG_FIELDS = (
     "g_req", "g_card", "g_level", "g_queue", "g_key", "g_pc", "g_run",

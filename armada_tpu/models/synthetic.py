@@ -156,6 +156,7 @@ def synthetic_problem(
     num_resources: int = 4,
     num_keys: int = 16,
     num_node_types: int = 8,
+    type_sensitive_frac: float = 0.0,
     max_gang_cardinality: int = 1,
     global_burst: int = 1_000,
     perq_burst: int = 1_000,
@@ -195,6 +196,33 @@ def synthetic_problem(
     # Static fit: most keys fit most types; a few restrictive keys.
     compat = rng.random((num_keys, num_node_types)) < 0.9
     compat[0] = True  # the common key
+    # Heterogeneity (type_sensitive_frac > 0): a fraction of keys declare a
+    # per-type throughput profile -- their compat additionally whitelists the
+    # profiled types and their bias row tiers them by 1/throughput (the
+    # builder-side semantics of core/keys.type_score_tables, synthesized
+    # directly in table form here).  key 0 stays the insensitive common key.
+    compat_pre_type = compat.copy()
+    key_type_row = np.zeros((num_keys,), np.int32)
+    type_bias = np.zeros((1, num_node_types), np.float32)
+    if type_sensitive_frac > 0 and num_keys > 1 and num_node_types > 1:
+        sens = np.where(rng.random(num_keys - 1) < type_sensitive_frac)[0] + 1
+        if sens.shape[0]:
+            TR = int(sens.shape[0]) + 1
+            type_bias = np.zeros((TR, num_node_types), np.float32)
+            for row, ki in enumerate(sens, start=1):
+                key_type_row[ki] = row
+                admits = rng.random(num_node_types) < 0.6
+                admits[rng.integers(0, num_node_types)] = True
+                thr = rng.choice([0.5, 1.0, 2.0, 4.0], size=num_node_types)
+                type_bias[row] = np.where(
+                    admits,
+                    (
+                        (np.float32(1.0) / thr.astype(np.float32) - np.float32(1.0))
+                        * np.float32(1024.0)
+                    ),
+                    0.0,
+                ).astype(np.float32)
+                compat[ki] &= admits
 
     # Gangs: skewed queue popularity (zipf-ish), small requests.
     g_queue = np.zeros((G,), np.int32)
@@ -314,6 +342,9 @@ def synthetic_problem(
         spot_cutoff=np.float32(_INF),
         ban_mask=np.zeros((1, N), bool),
         g_ban_row=np.zeros((G,), np.int32),
+        type_bias=type_bias,
+        key_type_row=key_type_row,
+        compat_pre_type=compat_pre_type,
     )
     meta = dict(
         num_levels=3,

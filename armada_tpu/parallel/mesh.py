@@ -121,6 +121,11 @@ def problem_shardings(mesh: Mesh) -> SchedulingProblem:
         # ban rows follow the node axis; the row-index vector follows gangs
         ban_mask=s(None, AXIS_NODES),
         g_ban_row=jobsax,
+        # type tables are small ([TR,T]/[K]/[K,T]) and gathered through the
+        # already-gathered key every iteration; replicated like compat.
+        type_bias=repl,
+        key_type_row=repl,
+        compat_pre_type=repl,
     )
 
 

@@ -137,6 +137,7 @@ def node_to_proto(n: NodeSpec) -> pb.Node:
         taints=[epb.Taint(key=t.key, value=t.value, effect=t.effect) for t in n.taints],
         labels=dict(n.labels),
         unschedulable=n.unschedulable,
+        node_type=n.node_type,
     )
 
 
@@ -153,6 +154,7 @@ def node_from_proto(msg: pb.Node, factory: ResourceListFactory) -> NodeSpec:
         taints=tuple(Taint(t.key, t.value, t.effect or "NoSchedule") for t in msg.taints),
         labels=dict(msg.labels),
         unschedulable=msg.unschedulable,
+        node_type=msg.node_type,
     )
 
 

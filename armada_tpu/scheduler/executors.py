@@ -92,6 +92,7 @@ def _node_to_dict(n: NodeSpec) -> dict:
         "taints": [[t.key, t.value, t.effect] for t in n.taints],
         "labels": dict(n.labels),
         "unschedulable": n.unschedulable,
+        "node_type": n.node_type,
     }
 
 
@@ -108,4 +109,5 @@ def _node_from_dict(d: dict, factory: ResourceListFactory) -> NodeSpec:
         taints=tuple(Taint(k, v, e) for k, v, e in d.get("taints", ())),
         labels=d.get("labels", {}),
         unschedulable=bool(d.get("unschedulable", False)),
+        node_type=d.get("node_type", ""),
     )

@@ -104,7 +104,7 @@ class SchedulerMetrics:
             "armada_scheduler_unschedulable_jobs",
             "Jobs a scheduling round left unplaced, by dominant reason "
             "(shape-infeasible / capacity-blocked / fairness-capped / "
-            "gang-partial / round-terminated)",
+            "gang-partial / round-terminated / type-mismatch)",
             ["pool", "queue", "reason"],
         )
         self.fragmentation_index = g(
@@ -113,8 +113,18 @@ class SchedulerMetrics:
             "per resource (0 = one node could absorb all free capacity)",
             ["pool", "resource"],
         )
+        # Per-hardware-type split of the same index; only exported on
+        # mixed fleets (a shattered accelerator tier hides inside healthy
+        # aggregate numbers when the CPU tier holds most free capacity).
+        self.type_fragmentation_index = g(
+            "armada_scheduler_type_fragmentation_index",
+            "Fragmentation index split by hardware node type "
+            "(armada-tpu.io/node-type); exported on mixed fleets only",
+            ["pool", "node_type", "resource"],
+        )
         self._unsched_labels: set = set()
         self._frag_labels: set = set()
+        self._type_frag_labels: set = set()
         # Round-output verification (models/verify.py): cumulative failure
         # counts per invariant/fingerprint site, and the device quarantine
         # scoreboard (scheduler/quarantine.py).  Quarantine label sets no
@@ -502,6 +512,26 @@ class SchedulerMetrics:
         self._frag_labels = {
             l for l in self._frag_labels if l[0] != pool
         } | fseen
+        tseen = set()
+        for tname, row in getattr(
+            explain, "fragmentation_by_type", {}
+        ).items():
+            for resource, frag in row.items():
+                labels = (pool, tname, resource)
+                tseen.add(labels)
+                self.type_fragmentation_index.labels(*labels).set(
+                    float(frag.get("index", 0.0))
+                )
+        for labels in {
+            l for l in self._type_frag_labels if l[0] == pool
+        } - tseen:
+            try:
+                self.type_fragmentation_index.remove(*labels)
+            except KeyError:
+                pass
+        self._type_frag_labels = {
+            l for l in self._type_frag_labels if l[0] != pool
+        } | tseen
 
     def observe_cycle(self, result, duration_s: float, now: Optional[float] = None) -> None:
         """`result` is a CycleResult; records cycle time + decisions + shares."""

@@ -79,6 +79,10 @@ class SubmitChecker:
                     tuple(int(a) for a in n.total_resources.atoms),
                     n.taints,
                     tuple(sorted(n.labels.items())),
+                    # a retyped node changes which whitelisted jobs fit; a
+                    # fingerprint without it would serve stale verdicts
+                    # (the round-5 lesson: ONE identity, core/keys)
+                    n.node_type,
                 )
                 for pool, nodes in pools.items()
                 for n in nodes
@@ -198,6 +202,26 @@ class SubmitChecker:
                 "no executor cluster provides "
                 + (f"pools {list(lead.pools)}" if lead.pools else "any nodes"),
             )
+
+        # A node-type whitelist naming ONLY types the fleet doesn't have can
+        # never schedule: reject with the names, not the generic no-fit
+        # reason (and never an IndexError out of the compat matrix --
+        # static_fit_matrix gates by type name, so an unknown name is an
+        # all-false row, which this check turns into words).
+        fleet_types = {
+            n.node_type
+            for p in candidate_pools
+            for n in self._pools[p]
+        }
+        for clead, _count in classes:
+            named = {t for t, thr in clead.node_type_scores if thr > 0}
+            if named and not (named & fleet_types):
+                return CheckResult(
+                    False,
+                    f"node-type-scores restricts to node types "
+                    f"{sorted(named)}, but no such node exists (fleet has "
+                    f"{sorted(t or '(untyped)' for t in fleet_types)})",
+                )
 
         # Per-class node-bound and floating request vectors.
         class_reqs = []

@@ -17,7 +17,12 @@ import uuid
 from typing import Callable, Mapping, Optional, Sequence
 
 from armada_tpu.core.config import SchedulingConfig
-from armada_tpu.core.types import JobSpec, Toleration
+from armada_tpu.core.types import (
+    NODE_TYPE_SCORES_ANNOTATION,
+    JobSpec,
+    Toleration,
+    parse_node_type_scores,
+)
 from armada_tpu.eventlog.publisher import Publisher
 from armada_tpu.events import events_pb2 as pb
 from armada_tpu.events.convert import job_spec_to_proto
@@ -180,6 +185,12 @@ class SubmitServer:
                 price_band=item.price_band,
                 services=tuple(item.services),
                 ingress=tuple(item.ingress),
+                # already validated; the typed field is what the events
+                # proto and the scheduling key carry (the annotation stays
+                # a pod-payload passthrough)
+                node_type_scores=parse_node_type_scores(
+                    dict(item.annotations).get(NODE_TYPE_SCORES_ANNOTATION, "")
+                ),
             )
             msg = job_spec_to_proto(spec)
             msg.annotations.update(dict(item.annotations))

@@ -10,6 +10,10 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import (
+    NODE_TYPE_SCORES_ANNOTATION,
+    parse_node_type_scores,
+)
 
 
 class ValidationError(ValueError):
@@ -104,6 +108,18 @@ def validate_submission(
                     raise ValidationError(
                         f"{where}: service port {port} out of range"
                     )
+
+        # Node-type scores annotation must parse (types named but unknown to
+        # a fleet are SubmitChecker's call -- it knows the executors; a
+        # malformed map is rejected here, before anything publishes).
+        raw_scores = (getattr(item, "annotations", {}) or {}).get(
+            NODE_TYPE_SCORES_ANNOTATION
+        )
+        if raw_scores:
+            try:
+                parse_node_type_scores(raw_scores)
+            except ValueError as e:
+                raise ValidationError(f"{where}: {e}") from None
 
         # Gang consistency (validation.validateGangs): same declared
         # cardinality and uniformity label across members.

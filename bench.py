@@ -21,7 +21,11 @@ per-pool knobs); ARMADA_BENCH_EXPLAIN=0 skips
 the explain-pass measurement (explain_s + explain_counts keys);
 ARMADA_BENCH_VERIFY=0 skips the round-verification measurement
 (verify_s + verify_transfers keys -- the extra transfer count the
-certification pass is allowed, models/verify.py).
+certification pass is allowed, models/verify.py);
+ARMADA_BENCH_HETERO=0 skips the heterogeneous-fleet kernel A/B
+(hetero_* keys: 4 node types, ~30% type-sensitive keys, per-iteration
+cost vs the insensitive body -- the type-bias gather must stay off the
+sequential chain).
 ARMADA_COMMIT_K arms the multi-commit kernel for every arm; the JSON
 echoes it (commit_k) next to the trip counters (kernel_iters /
 round_iters / burst10k_iters -- docs/bench.md r15).
@@ -1110,6 +1114,80 @@ def _restart_bench() -> dict:
     }
 
 
+def _hetero_bench(num_gangs, num_nodes, num_queues, repeats, burst) -> dict:
+    """ARMADA_BENCH_HETERO (default on; =0 skips): heterogeneity-aware
+    kernel A/B at the headline shape -- the SAME synthetic round with 4
+    node types, ~30% of scheduling keys carrying a per-type throughput
+    profile (type_bias rows gathered in-loop, models/fair_scheduler.py),
+    vs the type-insensitive baseline at identical array shapes.  The
+    per-iteration ratio is the evidence that the bias gather stays OFF the
+    sequential chain (precomputed [TR,T] table + one row gather, the
+    ban_mask pattern); a regression here means in-loop compute crept onto
+    a gathered row.  ARMADA_BENCH_HETERO_TYPES / _FRAC reshape the fleet."""
+    n_types = int(os.environ.get("ARMADA_BENCH_HETERO_TYPES", 4))
+    frac = float(os.environ.get("ARMADA_BENCH_HETERO_FRAC", 0.3))
+
+    def _arm(sensitive_frac: float):
+        problem, meta = synthetic_problem(
+            num_nodes=num_nodes,
+            num_gangs=num_gangs,
+            num_queues=num_queues,
+            num_runs=num_nodes // 2,
+            num_node_types=n_types,
+            type_sensitive_frac=sensitive_frac,
+            global_burst=burst,
+            perq_burst=burst,
+            seed=7,
+            node_pad_to=len(jax.devices()),
+        )
+        kw = dict(
+            num_levels=meta["num_levels"],
+            max_slots=meta["max_slots"],
+            slot_width=meta["slot_width"],
+        )
+        dev = jax.device_put(
+            SchedulingProblem(*(jnp.asarray(a) for a in problem))
+        )
+        result = schedule_round(dev, **kw)  # compile + warm up
+        jax.block_until_ready(result)
+        scheduled = int(result.scheduled_count)
+        iters = int(result.kernel_iters)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(schedule_round(dev, **kw))
+            times.append(time.perf_counter() - t0)
+        return min(times), scheduled, iters, int(problem.type_bias.shape[0])
+
+    base_s, base_sched, base_iters, base_tr = _arm(0.0)
+    het_s, het_sched, het_iters, het_tr = _arm(frac)
+    assert base_tr == 1, "baseline arm unexpectedly carries bias rows"
+    assert het_tr > 1, (
+        "hetero arm compiled the insensitive body -- no sensitive keys drawn"
+    )
+    assert het_sched > 0, "hetero round scheduled nothing"
+    out = {
+        "hetero_kernel_s": round(het_s, 4),
+        "hetero_base_kernel_s": round(base_s, 4),
+        "hetero_scheduled": het_sched,
+        "hetero_types": n_types,
+        "hetero_bias_rows": het_tr,
+    }
+    # Normalize by trip count: the arms place different sets (the bias
+    # re-ranks nodes and the whitelist narrows feasibility), so wall-clock
+    # alone conflates per-iteration cost with trip count.
+    if base_iters and het_iters:
+        out["hetero_per_iter_ratio"] = round(
+            (het_s / het_iters) / (base_s / base_iters), 3
+        )
+    print(
+        f"bench: hetero kernel {het_s:.4f}s vs base {base_s:.4f}s "
+        f"(per-iter ratio {out.get('hetero_per_iter_ratio')})",
+        file=sys.stderr,
+    )
+    return out
+
+
 def _ingest_bench() -> dict:
     """ARMADA_BENCH_INGEST (default on; =0 skips): ingest-throughput A/B --
     the serial IngestionPipeline vs the partition-parallel plane
@@ -1483,6 +1561,10 @@ def main():
         line.update(_restart_bench())
     if os.environ.get("ARMADA_BENCH_INGEST", "1") != "0":
         line.update(_ingest_bench())
+    if os.environ.get("ARMADA_BENCH_HETERO", "1") != "0":
+        line.update(
+            _hetero_bench(num_jobs, num_nodes, num_queues, repeats, burst)
+        )
     if init_err is not None:
         line["backend_fallback"] = init_err
     watchdog.cancel()

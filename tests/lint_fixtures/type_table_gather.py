@@ -1,0 +1,23 @@
+# Fixture for rule `gathered-row-compute`, heterogeneity-era costume
+# (linted under armada_tpu/models/): the per-type bias table must be
+# combined at BUILD time (core/keys.type_score_tables folds (1/thr - 1)
+# * TYPE_BIAS_SCALE into type_bias rows) and only GATHERED in the loop.
+# Scaling the gathered bias row in-loop is the same invariant-hoisting
+# defeat the rule exists for.  The twin line is syntactically IDENTICAL
+# (tests/test_lint.py asserts the normalized ASTs match) -- only
+# provenance separates them.
+import jax
+
+
+def run(type_bias, thr, pre, carry0):
+    # `pre` stands for the sanctioned idiom: the throughput scaling lives
+    # in the precomputed [TR,T] table; the body gathers one row by trow.
+    def body(c):
+        trow, score = c
+        row = type_bias[trow] * thr  # TP
+        # The twin: a precomputed-bias-row gather scaled by the loop CARRY
+        # score -- carry-dependent, unhoistable, not a finding.
+        out = pre[trow] * score  # twin
+        return (trow + 1, score + row[0] + out[0])
+
+    return jax.lax.while_loop(lambda c: c[0] < 64, body, carry0)

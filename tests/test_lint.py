@@ -209,6 +209,29 @@ def test_slo_wallclock_scope_covers_trace_module():
     assert lint.lint_source(text, "armada_tpu/ops/other.py") == []
 
 
+def test_gathered_row_compute_covers_type_tables():
+    """Round-20 ledger row: the per-type throughput bias must be folded
+    into type_bias rows at BUILD time (core/keys.type_score_tables) and
+    only gathered in the while-loop body -- scaling the GATHERED bias row
+    in-loop is the classic hoisting defeat in its heterogeneity costume,
+    and the rule must catch it while the carry-scaled twin stays clean."""
+    import ast
+
+    path = os.path.join(FIXTURES, "type_table_gather.py")
+    with open(path) as fh:
+        text = fh.read()
+    lines = text.splitlines()
+    tp = [i for i, l in enumerate(lines, 1) if "# TP" in l]
+    twin = [i for i, l in enumerate(lines, 1) if "# twin" in l]
+    assert len(tp) == 1 and len(twin) == 1
+    tree = ast.parse(text)
+    assert _normalized_stmt(tree, tp[0]) == _normalized_stmt(tree, twin[0])
+    findings = lint.lint_source(text, "armada_tpu/models/fixture.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("gathered-row-compute", tp[0])
+    ], "; ".join(f.format() for f in findings)
+
+
 def test_selfhost_whole_tree_clean():
     """The CI gate: zero unsuppressed violations over the repo."""
     n, findings = lint.lint_tree(REPO)

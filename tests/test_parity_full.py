@@ -342,14 +342,28 @@ class _Oracle:
                 tuple(req_units(j.resources)),
                 tuple(sorted(j.node_selector.items())),
                 pc.name,
+                # the type axis is part of key identity (core/keys lesson:
+                # a type-sensitive job must never retire/share a class with
+                # an insensitive twin)
+                tuple(j.node_type_scores),
             )
 
-        def fit_nodes(req, level, card, clean):
+        def fit_nodes(req, level, card, clean, tscores=()):
             """(feasible, [(node_id, count)]): best-fit spread at `level`
-            against clean (level-0) or urgency allocatable."""
+            against clean (level-0) or urgency allocatable.  A nonempty
+            type-score map is a whitelist (nodes of unnamed/<=0 types are
+            infeasible) and biases the packing score by
+            (1/throughput - 1) * 1024 per admitted node -- an independent
+            transcription of the Gavel-style semantics, in the same f32
+            arithmetic the kernel's precomputed bias tables use."""
             fit_level = 0 if clean else level
+            thr_of = dict(tscores)
             caps = []
             for n in self.nodes:
+                if tscores:
+                    thr = thr_of.get(n.node_type)
+                    if thr is None or thr <= 0:
+                        continue  # whitelist: type not admitted
                 free = self._allocatable(n.id, fit_level)
                 if np.all(free >= req):
                     per = int(
@@ -361,7 +375,17 @@ class _Oracle:
                         if np.any(req > 0)
                         else card
                     )
-                    caps.append((self._score(n.id, fit_level), self.node_idx[n.id], n.id, min(per, card)))
+                    score = self._score(n.id, fit_level)
+                    if tscores:
+                        bias = np.float32(
+                            (
+                                np.float32(1.0) / np.float32(thr_of[n.node_type])
+                                - np.float32(1.0)
+                            )
+                            * np.float32(1024.0)
+                        )
+                        score = float(np.float32(score) + bias)
+                    caps.append((score, self.node_idx[n.id], n.id, min(per, card)))
             if sum(c[3] for c in caps) < card:
                 return False, []
             caps.sort()
@@ -436,9 +460,12 @@ class _Oracle:
                 q_blocked.add(q)
                 continue
             level = self.level_of[pc.priority]
-            feasible, spread = fit_nodes(req, level, card, clean=True)
+            tscores = lead.node_type_scores
+            feasible, spread = fit_nodes(req, level, card, clean=True,
+                                         tscores=tscores)
             if not feasible:
-                feasible, spread = fit_nodes(req, level, card, clean=False)
+                feasible, spread = fit_nodes(req, level, card, clean=False,
+                                             tscores=tscores)
             if not feasible:
                 if card == 1:
                     dead_keys.add(job_key(lead))
@@ -595,6 +622,58 @@ def world(seed, num_nodes=200, num_jobs=300, num_queues=5, gangs=6,
     return nodes, queues, jobs, running
 
 
+def hetero_world(seed, types=("v4", "v5e", "v6"), sensitive_frac=0.4, **kw):
+    """world() re-dressed as a mixed fleet: nodes carry hardware types
+    (plus some untyped ""), and a fraction of units -- gangs uniformly --
+    carry per-type throughput maps, including the occasional map naming
+    only a type the fleet lacks (whitelist-infeasible on both sides)."""
+    import dataclasses
+
+    nodes, queues, jobs, running = world(seed, **kw)
+    rng = np.random.default_rng(seed + 77)
+    pool = list(types) + [""]
+    nodes = [
+        dataclasses.replace(n, node_type=pool[int(rng.integers(len(pool)))])
+        for n in nodes
+    ]
+
+    def draw_map():
+        if rng.random() < 0.05:
+            return (("v9", 2.0),)  # names no fleet type: never places
+        k = 1 + int(rng.integers(len(types)))
+        chosen = rng.choice(len(types), size=k, replace=False)
+        return tuple(
+            sorted(
+                (types[int(c)], float(rng.choice([0.5, 1.0, 2.0, 4.0])))
+                for c in chosen
+            )
+        )
+
+    gang_maps: dict = {}
+    out_jobs = []
+    for j in jobs:
+        if j.gang_id:
+            # members must stay uniform (one key class per gang)
+            if j.gang_id not in gang_maps:
+                gang_maps[j.gang_id] = (
+                    draw_map() if rng.random() < sensitive_frac else ()
+                )
+            ts = gang_maps[j.gang_id]
+        else:
+            ts = draw_map() if rng.random() < sensitive_frac else ()
+        out_jobs.append(
+            dataclasses.replace(j, node_type_scores=ts) if ts else j
+        )
+    out_running = []
+    for r in running:
+        if rng.random() < sensitive_frac / 2:
+            r = dataclasses.replace(
+                r, job=dataclasses.replace(r.job, node_type_scores=draw_map())
+            )
+        out_running.append(r)
+    return nodes, queues, out_jobs, out_running
+
+
 def _compare(cfg, nodes, queues, jobs, running, prices=None, seed=None):
     oracle = _Oracle(cfg, nodes, queues, jobs, running, prices=prices)
     o_sched, o_preempted, _ = oracle.run()
@@ -655,6 +734,36 @@ def test_away_runs_preempted_by_home_jobs(seed):
         away_frac=1.0,
     )
     _compare(CFG, nodes, queues, jobs, running, seed=seed)
+
+
+@pytest.mark.parametrize("seed", list(range(1, 11)))
+def test_hetero_type_bias_parity(seed):
+    """Mixed fleet at hundreds of nodes: whitelists gate feasibility and
+    the (1/throughput - 1) * 1024 bias re-ranks nodes; the oracle carries
+    its own transcription of both, so scheduled/preempted set equality
+    cross-checks the kernel's precomputed [TR,N] bias-table gather."""
+    nodes, queues, jobs, running = hetero_world(seed)
+    assert any(j.node_type_scores for j in jobs)  # the axis is exercised
+    outcome = _compare(CFG, nodes, queues, jobs, running, seed=seed)
+    sensitive = {j.id for j in jobs if j.node_type_scores}
+    assert sensitive & set(outcome.scheduled), (
+        "no type-sensitive job placed -- the biased path never ran"
+    )
+
+
+@pytest.mark.parametrize("seed", [2, 9, 17, 31])
+def test_hetero_eviction_preemption_parity(seed):
+    """Fair-share eviction over a mixed fleet: evictees take the pinned
+    bias-free path, new sensitive units the biased path -- the preempted
+    set must still match the oracle exactly."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, protected_fraction_of_fair_share=0.0)
+    nodes, queues, jobs, running = hetero_world(
+        seed, num_nodes=120, num_jobs=150, num_running=60, gangs=0
+    )
+    outcome = _compare(cfg, nodes, queues, jobs, running, seed=seed)
+    assert outcome.rescheduled or outcome.preempted
 
 
 @pytest.mark.parametrize("seed", [4, 8, 15, 16])

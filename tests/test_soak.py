@@ -176,3 +176,8 @@ def test_armadactl_soak_parser_wiring():
     args = build_parser().parse_args(["soak", "--crash"])
     assert args.crash == 0.5
     assert build_parser().parse_args(["soak"]).crash is None
+    # heterogeneous-fleet leg: the flag overrides ARMADA_SOAK_NODE_TYPES;
+    # absent (None) means from_env's default survives
+    args = build_parser().parse_args(["soak", "--node-types", "v4, v5e"])
+    assert args.node_types == "v4, v5e"
+    assert build_parser().parse_args(["soak"]).node_types is None

@@ -407,6 +407,16 @@ def main() -> int:
         "behind the union reader; the ingest width rounds up to a "
         "multiple (default: inherit the environment)",
     )
+    ap.add_argument(
+        "--node-types",
+        default=None,
+        dest="node_types",
+        metavar="T1,T2,...",
+        help="run the soak/crash legs on a heterogeneous fleet: "
+        "comma-separated node types round-robined across the fake nodes, "
+        "with type-sensitive submits in the mix (ARMADA_SOAK_NODE_TYPES; "
+        "default: inherit the environment)",
+    )
     args = ap.parse_args()
 
     if args.commit_k is not None:
@@ -420,6 +430,10 @@ def main() -> int:
         # Width is permanent per store dir; setting it here means every
         # leg's fresh temp world builds at the armed width.
         os.environ["ARMADA_STORE_SHARDS"] = str(args.store_shards)
+    if args.node_types is not None:
+        # The soak/crash legs read SoakConfig.from_env; their env
+        # save/restore keeps the heterogeneous fleet armed across restarts.
+        os.environ["ARMADA_SOAK_NODE_TYPES"] = args.node_types
 
     if args.mesh:
         # The drill must run anywhere: give the CPU platform enough virtual
