@@ -1524,6 +1524,126 @@ def _store_shard_foreign_write(src: Source):
                             data[s2.id] = tags
 
 
+@rule(
+    "dlq-cursor-same-txn",
+    "a dead-letter quarantine whose cursor advance rides a DIFFERENT "
+    "record's positions (or none at all): the DLQ row and the consumer "
+    "cursor must commit in the SAME shard store transaction -- a crash "
+    "between them either loses the poison record for good (cursor past "
+    "it, no DLQ row) or re-quarantines it forever (row committed, cursor "
+    "behind; round 21)",
+    scope=under("armada_tpu/"),
+)
+def _dlq_cursor_same_txn(src: Source):
+    # Value-flow per function: a value bound from a DeadLetter(...) /
+    # make_dead_letter(...) construction is a ROW and carries the name
+    # tags of the record fields it was built from; the next_positions
+    # argument of a `store_dead_letters` call must share at least one tag
+    # with the quarantined rows (the same record's partition/offset).
+    # Disjoint provenance = the cursor advances for a different record
+    # than the one being quarantined.  A rows-carrying call with NO
+    # next_positions (or an empty dict literal) splits the quarantine and
+    # the cursor advance into two transactions.  Untraced rows
+    # (parameters -- the pure-delegation shape) stay clean: provenance
+    # unknown is not a violation.
+    if "store_dead_letters" not in src.text:
+        return
+    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+
+    _ROW_CTORS = ("DeadLetter", "make_dead_letter")
+
+    def _own_exprs(st):
+        for field, value in ast.iter_fields(st):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            for node in value if isinstance(value, list) else [value]:
+                if isinstance(node, ast.AST) and not isinstance(node, ast.stmt):
+                    yield from ast.walk(node)
+
+    for fn in (
+        n
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ):
+        bindings: dict = {}  # name -> frozenset of provenance tags
+        rowtags: dict = {}  # name -> frozenset (only names bound from a row ctor)
+
+        def tags(node) -> frozenset:
+            out: set = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out |= bindings.get(sub.id, frozenset({sub.id}))
+            return frozenset(out)
+
+        def row_tags(node) -> frozenset:
+            out: set = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out |= rowtags.get(sub.id, frozenset())
+            return frozenset(out)
+
+        for st in _pool_fn_stmts(fn):
+            # (1) quarantine calls: rows provenance vs cursor provenance
+            for sub in _own_exprs(st):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "store_dead_letters"
+                    and sub.args
+                ):
+                    continue
+                rt = row_tags(sub.args[0])
+                if not rt:
+                    continue  # untraced rows: the delegation shape
+                np_kw = next(
+                    (k for k in sub.keywords if k.arg == "next_positions"),
+                    None,
+                )
+                if np_kw is None or (
+                    isinstance(np_kw.value, ast.Dict) and not np_kw.value.keys
+                ):
+                    yield _finding(
+                        src,
+                        "dlq-cursor-same-txn",
+                        sub,
+                        "quarantine without a cursor advance in the same "
+                        "store transaction: pass the record's "
+                        "next_positions to store_dead_letters so the DLQ "
+                        "row and the cursor commit atomically",
+                    )
+                    continue
+                pt = tags(np_kw.value)
+                if pt and rt.isdisjoint(pt):
+                    yield _finding(
+                        src,
+                        "dlq-cursor-same-txn",
+                        sub,
+                        "next_positions derived from a different record "
+                        "than the quarantined rows: the cursor must "
+                        "advance past exactly the record whose DLQ row "
+                        "commits in this transaction",
+                    )
+            # (2) binding propagation: row constructions carry their
+            # record-field tags; everything else unions its names' tags
+            if isinstance(st, ast.Assign) and st.value is not None:
+                val = st.value
+                is_row = any(
+                    isinstance(c, ast.Call)
+                    and _dotted(c.func).rsplit(".", 1)[-1] in _ROW_CTORS
+                    for c in ast.walk(val)
+                )
+                t = tags(val)
+                rtag = t if is_row else row_tags(val)
+                for tgt in st.targets:
+                    for s2 in ast.walk(tgt):
+                        if isinstance(s2, ast.Name):
+                            bindings[s2.id] = t
+                            if rtag:
+                                rowtags[s2.id] = rtag
+                            else:
+                                rowtags.pop(s2.id, None)
+
+
 _THREAD_SPAWNERS = {"threading.Thread", "Thread", "_thread.start_new_thread"}
 
 

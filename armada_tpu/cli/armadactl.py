@@ -565,6 +565,47 @@ def cmd_quarantine(args):
     return 0
 
 
+def cmd_dlq(args):
+    """Dead-letter quarantine verbs (ingest/dlq.py): poison records the
+    ingest plane isolated after bounded retries.  `replay` re-publishes
+    the raw bytes -- run it AFTER fixing whatever made the record poison;
+    `discard` is the explicit give-up (and the approval verb for a halted
+    control-plane record)."""
+    import json
+
+    client = _client(args)
+    cmd = getattr(args, "dlq_cmd", None) or "status"
+    if cmd == "status":
+        print(json.dumps(client.dlq_status(), indent=2, sort_keys=True))
+        return 0
+    if cmd == "list":
+        rows = client.dlq_list(args.selector)
+        print(json.dumps(rows, indent=2, sort_keys=True))
+        return 0
+    if cmd == "show":
+        print(json.dumps(client.dlq_show(args.selector), indent=2, sort_keys=True))
+        return 0
+    if cmd == "replay":
+        out = client.dlq_replay(args.selector)
+        print(
+            f"replayed {out['replayed']} record(s) "
+            f"({out['rows_marked']} row(s) marked)"
+        )
+        return 0
+    if cmd == "discard":
+        out = client.dlq_discard(args.selector)
+        if out.get("control_skip_approved"):
+            print(
+                "approved control-plane skip for "
+                f"{out['consumer']} p{out['partition']}@{out['record_offset']}"
+                " (the halted shard quarantines it on its next pass)"
+            )
+        else:
+            print(f"marked {out['rows_marked']} row(s) discarded")
+        return 0
+    raise SystemExit(f"unknown dlq subcommand {cmd!r}")
+
+
 def cmd_trace(args):
     """Dump the plane's cycle traces (ops/trace.py ring) as Chrome
     trace-event JSON: `armadactl trace -o cycle.json`, open in Perfetto.
@@ -1484,6 +1525,51 @@ def build_parser() -> argparse.ArgumentParser:
         "re-probe may promote back to the accelerator",
     )
     qr.set_defaults(fn=cmd_quarantine)
+
+    dl = sub.add_parser(
+        "dlq",
+        help="dead-letter quarantine: status / list / show / replay / "
+        "discard poison records isolated by the ingest plane "
+        "(docs/operations.md poison-record runbook)",
+    )
+    dlsub = dl.add_subparsers(dest="dlq_cmd")
+    dls = dlsub.add_parser(
+        "status", help="quarantine census + pending control-plane halts"
+    )
+    dls.set_defaults(fn=cmd_dlq, dlq_cmd="status")
+    dll = dlsub.add_parser("list", help="quarantined rows (no payloads)")
+    dll.add_argument(
+        "selector",
+        nargs="?",
+        default="",
+        help="consumer[:partition[:offset]]; empty = everything",
+    )
+    dll.set_defaults(fn=cmd_dlq, dlq_cmd="list")
+    dlw = dlsub.add_parser(
+        "show", help="one full row, payload base64-encoded"
+    )
+    dlw.add_argument("selector", help="consumer:partition:offset")
+    dlw.set_defaults(fn=cmd_dlq, dlq_cmd="show")
+    dlr = dlsub.add_parser(
+        "replay",
+        help="re-publish matching dead rows' raw bytes (run AFTER fixing "
+        "the poison's cause; re-application is idempotent)",
+    )
+    dlr.add_argument(
+        "selector",
+        nargs="?",
+        default="",
+        help="consumer[:partition[:offset]]; empty = every dead row",
+    )
+    dlr.set_defaults(fn=cmd_dlq, dlq_cmd="replay")
+    dld = dlsub.add_parser(
+        "discard",
+        help="approve a pending control-plane skip, or mark quarantined "
+        "rows discarded (the explicit give-up)",
+    )
+    dld.add_argument("selector", help="consumer[:partition[:offset]]")
+    dld.set_defaults(fn=cmd_dlq, dlq_cmd="discard")
+    dl.set_defaults(fn=cmd_dlq, dlq_cmd="status")
 
     return p
 

@@ -501,6 +501,15 @@ def start_control_plane(
     # event-sourced -- a snapshot of a replica is that replica's affair).
     control_plane.checkpoint_trigger = scheduler.checkpoint
     control_plane.checkpoint_status = scheduler.durability_status
+    # armadactl dlq rides the same plane-local surface: the dead-letter
+    # tables live in THIS replica's materialized stores; replay re-publishes
+    # through the shared log (idempotent re-application makes that safe).
+    from armada_tpu.ingest.dlq import DlqAdmin
+
+    dlq_admin = DlqAdmin(
+        log, {"scheduler": db, "events": eventdb, "lookout": lookoutdb}
+    )
+    control_plane.dlq_admin = dlq_admin
     executor_api = ExecutorApi(db, publisher, factory)
 
     from armada_tpu.rpc.server import make_server
@@ -644,6 +653,9 @@ def start_control_plane(
             "log_partitions": num_partitions,
             "consumers": _ingest_stats().snapshot(),
         }
+        # Dead-letter block (ingest/dlq.py): quarantine census, batch
+        # retries, pending control-plane halts, per-store row counts.
+        health_server.dlq_status = dlq_admin.status
         startup = StartupCompleteChecker()
         health_server.checker.add(startup)
         health_server.checker.add(

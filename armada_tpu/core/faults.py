@@ -40,6 +40,14 @@ Sites wired in this repo (docs/operations.md has the operator catalogue):
                      election and before the recovery fence completes
                      (scheduler/scheduler.py) -- promotion must re-run
                      idempotently on the next cycle
+    convert_record   a poison RECORD in the ingest plane (ingest/dlq.py):
+                     the first fire latches the triggering batch's first
+                     raw payload as STICKY poison -- every later convert
+                     of that payload raises deterministically, modelling a
+                     record that fails on every retry (a one-shot fault
+                     would succeed on retry and never exercise the
+                     dead-letter path).  ``dlq.reset_poison()`` clears the
+                     latch; ``after_n`` counts conversion batches.
     round_corrupt    SILENT device corruption of a scheduling round, with
                      the corruption class as the mode: ``header`` perturbs
                      the compact header's scheduled_count scalar on
@@ -95,6 +103,17 @@ def _parse(spec: str):
         except ValueError:
             continue
         yield site, mode, after_n
+
+
+def armed(site: str) -> bool:
+    """True when ANY entry for `site` is present in ARMADA_FAULT, without
+    advancing counters or consuming one-shot state.  The cheap outer gate
+    for sites whose check itself has a cost (ingest/dlq.py re-serializes
+    payloads only when the poison drill is armed)."""
+    spec = os.environ.get("ARMADA_FAULT")
+    if not spec:
+        return False
+    return any(s == site for s, _mode, _n in _parse(spec))
 
 
 def active(site: str, modes=None):

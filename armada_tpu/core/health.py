@@ -232,6 +232,16 @@ class _Handler(BaseHTTPRequestHandler):
                     body["ingest"] = srv.ingest_status()
                 except Exception as exc:  # noqa: BLE001
                     body["ingest"] = {"error": str(exc)}
+            if srv.dlq_status is not None:
+                # Dead-letter block (ingest/dlq.py): quarantined-record
+                # and batch-retry census plus pending control-plane halts.
+                # A nonzero control_halts entry means a shard is parked
+                # waiting for an operator verdict (`armadactl dlq`) -- the
+                # plane is degraded-but-HEALTHY, like quarantine above.
+                try:
+                    body["dlq"] = srv.dlq_status()
+                except Exception as exc:  # noqa: BLE001
+                    body["dlq"] = {"error": str(exc)}
             self._respond(
                 200 if err is None else 503,
                 (json.dumps(body) + "\n").encode(),
@@ -323,6 +333,10 @@ class HealthServer:
         # Optional () -> dict: ingest-plane block (serve wires
         # ingest/stats.registry().snapshot plus shard/partition config).
         self.ingest_status = None
+        # Optional () -> dict: dead-letter block (serve wires
+        # ingest/dlq.DlqAdmin.status: quarantine census, batch retries,
+        # pending control-plane halts, per-store row counts).
+        self.dlq_status = None
         self.profiling = profiling
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -9,9 +9,10 @@ so a crash between store and ack cannot double-apply.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Callable, Protocol
+from typing import Callable, Optional, Protocol
 
 from armada_tpu.eventlog import Consumer, EventLog
 from armada_tpu.events import events_pb2 as pb
@@ -20,6 +21,17 @@ from armada_tpu.events import events_pb2 as pb
 class Sink(Protocol):
     def store(self, batch_ops, consumer: str, next_positions: dict[int, int]) -> None:
         ...
+
+
+def ingest_retries(default: int = 3) -> Optional[int]:
+    """Full-batch retries before the loop escalates to poison isolation
+    (ingest/dlq.py).  ARMADA_INGEST_RETRIES overrides; <= 0 = unbounded,
+    the pre-round-21 wedge-prone behavior kept as an escape hatch."""
+    try:
+        n = int(os.environ.get("ARMADA_INGEST_RETRIES", default))
+    except ValueError:
+        return default
+    return None if n <= 0 else n
 
 
 class IngestionPipeline:
@@ -72,6 +84,12 @@ class IngestionPipeline:
         batch = self._consumer.poll()
         if not batch.sequences:
             return 0
+        # Poison drill hook (ARMADA_FAULT=convert_record): armed-only -- the
+        # production cost is one falsy check.
+        from armada_tpu.ingest import dlq
+
+        if dlq.poison_armed():
+            dlq.poison_check([m.payload for m in batch.messages])
         converted = self._converter(batch.sequences)
         self._sink.store(
             converted,
@@ -176,12 +194,20 @@ class IngestionPipeline:
 
     def _loop_inner(self, log, stop: threading.Event) -> None:
         from armada_tpu.core.backoff import Backoff
+        from armada_tpu.ingest import dlq
 
         # Jittered exponential backoff on batch failures (a restarting
         # external DB would otherwise see every pipeline retry in lockstep
         # at the same instant); positions were not acked, so the batch
-        # replays exactly-once when the store recovers.
-        backoff = Backoff(base_s=self._poll_interval, cap_s=5.0)
+        # replays exactly-once when the store recovers.  The schedule is
+        # BOUNDED: exhaustion escalates to poison isolation (ingest/dlq.py)
+        # instead of wedging behind one bad record forever; isolation
+        # itself preserves retry-forever for environmental faults.
+        backoff = Backoff(
+            base_s=self._poll_interval,
+            cap_s=5.0,
+            max_attempts=ingest_retries(),
+        )
         while not stop.is_set():
             try:
                 n = self.run_once()
@@ -189,6 +215,7 @@ class IngestionPipeline:
             except Exception:  # noqa: BLE001 - service thread must survive
                 if stop.is_set():
                     break  # teardown (a closing sink), not a failure
+                dlq.registry().note_batch_retry(self.consumer_name)
                 delay = backoff.next_delay()
                 log.exception(
                     "ingestion pipeline %s: batch failed (attempt %d); "
@@ -197,6 +224,11 @@ class IngestionPipeline:
                     backoff.attempts,
                     delay,
                 )
+                if backoff.exhausted():
+                    progressed = self._isolate(log)
+                    backoff.reset()
+                    if progressed:
+                        continue
                 stop.wait(delay)
                 continue
             if n == 0:
@@ -205,3 +237,37 @@ class IngestionPipeline:
                 # publisher, so the timeout still bounds their lag).
                 self._wakeup.wait(self._poll_interval)
                 self._wakeup.clear()
+
+    def _isolate(self, log) -> bool:
+        """Bounded retries exhausted: hand the stuck batch to the poison
+        isolation engine.  Returns True when it made progress (stored good
+        runs and/or quarantined poison) -- the loop then resumes without
+        the backoff sleep.  A sink without a dead-letter surface keeps the
+        plain retry-forever loop."""
+        from armada_tpu.ingest import dlq
+
+        if not hasattr(self._sink, "store_dead_letters"):
+            return False
+        try:
+            out = dlq.isolate_batch(
+                log_=self._log,
+                sink=self._sink,
+                converter=self._converter,
+                consumer=self.consumer_name,
+                partitions=self._consumer.partitions,
+                positions=dict(self._consumer.positions),
+            )
+        except Exception:  # noqa: BLE001 - isolation is best-effort;
+            log.exception(  # the retry loop survives either way
+                "ingestion pipeline %s: poison isolation failed; "
+                "keeping plain retries",
+                self.consumer_name,
+            )
+            return False
+        if out.new_positions:
+            self._consumer.ack(out.new_positions)
+        if out.applied_sequences:
+            self._total_sequences += out.applied_sequences
+            self._total_events += out.applied_events
+            self._rate.record(out.applied_events)
+        return out.progressed

@@ -137,6 +137,24 @@ CREATE TABLE IF NOT EXISTS queues (
   groups_json TEXT NOT NULL DEFAULT '[]',
   labels_json TEXT NOT NULL DEFAULT '{}'
 );
+
+-- Poison-record quarantine (ingest/dlq.py): raw bytes + provenance of
+-- records the ingest plane isolated after bounded retries.  Quarantine
+-- rows commit IN THE SAME TRANSACTION as the cursor advance that skips
+-- them (dlq.commit_dead_letters).  record_offset, not offset: reserved
+-- word in PostgreSQL.
+CREATE TABLE IF NOT EXISTS dead_letters (
+  consumer TEXT NOT NULL,
+  partition INTEGER NOT NULL,
+  record_offset INTEGER NOT NULL,
+  rec_key BLOB NOT NULL,
+  payload BLOB NOT NULL,
+  stage TEXT NOT NULL,
+  error TEXT NOT NULL,
+  created_ns INTEGER NOT NULL,
+  status TEXT NOT NULL DEFAULT 'dead',
+  PRIMARY KEY (consumer, partition, record_offset)
+);
 """
 
 JOBS_COLUMNS = (
@@ -180,6 +198,12 @@ SNAPSHOT_TABLES: dict[str, tuple[str, ...]] = {
     "job_dedup": ("dedup_key", "job_id"),
     "queues": (
         "name", "weight", "cordoned", "owners", "groups_json", "labels_json",
+    ),
+    # After consumer_positions in dump order (it sits above), so a dead
+    # letter landing mid-dump is on the replay side of the fence.
+    "dead_letters": (
+        "consumer", "partition", "record_offset", "rec_key", "payload",
+        "stage", "error", "created_ns", "status",
     ),
 }
 
@@ -857,6 +881,43 @@ class SchedulerDb:
             (consumer,),
         )
         return {int(r["partition"]): int(r["position"]) for r in rows}
+
+    # --- dead-letter quarantine (ingest/dlq.py) -----------------------------
+
+    def store_dead_letters(
+        self,
+        rows,
+        consumer: str = "scheduler",
+        next_positions: Optional[dict[int, int]] = None,
+    ) -> None:
+        """Quarantine poison records + advance the cursor past them in ONE
+        transaction (the store/store_plan exactly-once shape)."""
+        from armada_tpu.ingest import dlq
+
+        dlq.commit_dead_letters(
+            self._conn, self._lock, rows, consumer, next_positions
+        )
+
+    def list_dead_letters(self, consumer=None, status=None) -> list[dict]:
+        from armada_tpu.ingest import dlq
+
+        return dlq.list_rows(self._conn, self._lock, consumer, status)
+
+    def get_dead_letter(self, consumer, partition, record_offset):
+        from armada_tpu.ingest import dlq
+
+        return dlq.get_row(
+            self._conn, self._lock, consumer, partition, record_offset
+        )
+
+    def mark_dead_letter(
+        self, consumer, partition=None, record_offset=None, status="dead"
+    ) -> int:
+        from armada_tpu.ingest import dlq
+
+        return dlq.mark_rows(
+            self._conn, self._lock, status, consumer, partition, record_offset
+        )
 
     # --- op application -----------------------------------------------------
 

@@ -356,6 +356,16 @@ class _Shard:
         already in flight when this returns -- the threaded loop polls its
         NEXT batch while this one converts."""
         pipe = self.pipeline
+        # Poison drill hook (ARMADA_FAULT=convert_record): MUST run host-side
+        # -- the forkserver workers carry their own fault/latch state, so a
+        # subprocess fire would never stick.  Armed-only: one falsy check in
+        # production.
+        from armada_tpu.ingest import dlq
+
+        if dlq.poison_armed():
+            dlq.poison_check(
+                [p for buf in buffers for p in _frame_payloads(buf)]
+            )
         if pipe.offload:
             fut = pipe.pool.submit(
                 _worker_convert,
@@ -429,6 +439,13 @@ class _Shard:
         applied = 0
         part = pipe.control_partition
         records = _frame_records(buf, base_offset)
+        # Poison drill hook: the barrier path converts inline, so the latch
+        # check lives here (a poison CONTROL record halts this shard loudly
+        # in isolation -- never auto-skipped).
+        from armada_tpu.ingest import dlq
+
+        if dlq.poison_armed():
+            dlq.poison_check([payload for (_k, payload, _o) in records])
         i = 0
         while i < len(records):
             is_control = records[i][0] == _CONTROL_KEY
@@ -765,10 +782,19 @@ class PartitionedIngestionPipeline:
         from armada_tpu.core.backoff import Backoff
         from armada_tpu.core.logging import get_logger, log_context
 
+        from armada_tpu.ingest import dlq
+        from armada_tpu.ingest.pipeline import ingest_retries
+
         log = get_logger(__name__)
         # Jittered exponential backoff on batch failures, per shard -- a
         # restarting external DB must not see every shard retry in lockstep.
-        backoff = Backoff(base_s=self.poll_interval, cap_s=5.0)
+        # BOUNDED: exhaustion escalates to poison isolation (ingest/dlq.py)
+        # instead of wedging the shard behind one bad record forever.
+        backoff = Backoff(
+            base_s=self.poll_interval,
+            cap_s=5.0,
+            max_attempts=ingest_retries(),
+        )
         # One-deep prefetch: while `pending` converts (in a worker process),
         # this thread polls and submits the NEXT batch, so the sink lock
         # never idles waiting on conversion.  `read_pos` runs ahead of the
@@ -831,6 +857,7 @@ class PartitionedIngestionPipeline:
                         # a fence wait or a closing sink raises by design;
                         # a clean SIGTERM must not page on ERROR logs.
                         break
+                    dlq.registry().note_batch_retry(self.consumer_name)
                     delay = backoff.next_delay()
                     log.exception(
                         "ingestion shard %s/%d: batch failed (attempt %d); "
@@ -840,10 +867,56 @@ class PartitionedIngestionPipeline:
                         backoff.attempts,
                         delay,
                     )
+                    if backoff.exhausted():
+                        made_progress = self._isolate_shard(shard, log)
+                        backoff.reset()
+                        # Isolation committed positions through the shard's
+                        # own sink txns; the prefetch cursor must follow.
+                        read_pos = dict(shard.positions)
+                        if made_progress:
+                            continue
                     stop.wait(delay)
                     continue
             # A pending batch at stop is simply dropped: its positions were
             # never acked, so a restarted pipeline replays it exactly-once.
+
+    def _isolate_shard(self, shard: _Shard, log) -> bool:
+        """Bounded retries exhausted on one shard: hand its stuck batch to
+        the poison isolation engine (ingest/dlq.py).  Runs inline on the
+        shard's own thread against the shard's own sink leg, so the DLQ row
+        and cursor advance share the shard's transaction (the r19 fence
+        discipline).  stop_at_control=True: a HEALTHY control record ends
+        isolation -- the barrier path owns its ordering; a POISON control
+        record halts this shard loudly (never auto-skipped)."""
+        from armada_tpu.ingest import dlq
+
+        if not hasattr(shard.sink, "store_dead_letters"):
+            return False
+        try:
+            out = dlq.isolate_batch(
+                log_=self.log,
+                sink=shard.sink,
+                converter=self.converter,
+                consumer=self.consumer_name,
+                partitions=shard.partitions,
+                positions=dict(shard.positions),
+                renderer=self.renderer,
+                stop_at_control=True,
+            )
+        except Exception:  # noqa: BLE001 - isolation is best-effort;
+            log.exception(  # the retry loop survives either way
+                "ingestion shard %s/%d: poison isolation failed; "
+                "keeping plain retries",
+                self.consumer_name,
+                shard.idx,
+            )
+            return False
+        if out.new_positions:
+            shard._ack(out.new_positions)
+        if out.applied_sequences:
+            self.rate.record(out.applied_events)
+            self.note_counts(out.applied_sequences, out.applied_events)
+        return out.progressed
 
     # --------------------------------------------------------- accounting --
 

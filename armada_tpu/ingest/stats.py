@@ -15,11 +15,14 @@ timestamps.  All clocks are monotonic (ops/metrics.mono_now).
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Callable, Optional
 
 from armada_tpu.analysis.tsan import make_lock
 from armada_tpu.ops.metrics import mono_now
+
+log = logging.getLogger(__name__)
 
 
 class RateEstimator:
@@ -51,10 +54,19 @@ class IngestStatsRegistry:
     def __init__(self):
         self._lock = make_lock("ingest.stats")
         self._sources: dict[str, Callable[[], dict]] = {}
+        # Snapshot exceptions per view: counted (the metrics layer exports
+        # armada_ingest_stats_errors_total{consumer}) and logged once per
+        # registered view -- a broken snapshot used to be swallowed
+        # entirely, so a view could misreport forever in silence.
+        self._errors: dict[str, int] = {}
+        self._logged: set[str] = set()
 
     def register(self, consumer: str, snapshot_fn: Callable[[], dict]) -> None:
         with self._lock:
             self._sources[consumer] = snapshot_fn
+            # A re-registered (restarted) view gets one fresh log line if it
+            # breaks again; the error count keeps accumulating.
+            self._logged.discard(consumer)
 
     def unregister(self, consumer: str, snapshot_fn: Callable[[], dict]) -> None:
         """Remove `consumer` only if it still points at `snapshot_fn` -- a
@@ -62,6 +74,10 @@ class IngestStatsRegistry:
         with self._lock:
             if self._sources.get(consumer) is snapshot_fn:
                 del self._sources[consumer]
+
+    def error_counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._errors)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -72,6 +88,17 @@ class IngestStatsRegistry:
                 out[consumer] = fn()
             except Exception as exc:  # noqa: BLE001 - one broken view must
                 out[consumer] = {"error": str(exc)}  # not hide the others
+                with self._lock:
+                    self._errors[consumer] = self._errors.get(consumer, 0) + 1
+                    first = consumer not in self._logged
+                    self._logged.add(consumer)
+                if first:
+                    log.exception(
+                        "ingest stats snapshot failed for view %r "
+                        "(logged once per registration; "
+                        "armada_ingest_stats_errors_total counts repeats)",
+                        consumer,
+                    )
         return out
 
 

@@ -311,6 +311,39 @@ class ShardedSchedulerDb(SchedulerDb):
         )
 
     store_plan = store
+    # Quarantine writes are shard-transactional too: the DLQ row must
+    # commit with the cursor advance in the owning shard's own file.
+    store_dead_letters = store
+
+    def mark_dead_letter(
+        self, consumer, partition=None, record_offset=None, status="dead"
+    ) -> int:
+        """Status updates route to the shard owning the row's partition
+        (the union's attached schemas are writable, but a write through
+        the reader would bypass the shard's store lock)."""
+        if partition is not None:
+            return self._stores[
+                int(partition) % self.num_shards
+            ].mark_dead_letter(consumer, partition, record_offset, status)
+        return sum(
+            s.mark_dead_letter(consumer, None, record_offset, status)
+            for s in self._stores
+        )
+
+    def list_dead_letters(self, consumer=None, status=None) -> list[dict]:
+        """Union read across shards (rows live in the shard owning their
+        partition); re-sorted so the merged listing matches a plain
+        store's ordering."""
+        out: list[dict] = []
+        for s in self._stores:
+            out.extend(s.list_dead_letters(consumer, status))
+        out.sort(key=lambda r: (r["consumer"], r["partition"], r["record_offset"]))
+        return out
+
+    def get_dead_letter(self, consumer, partition, record_offset):
+        return self._stores[
+            int(partition) % self.num_shards
+        ].get_dead_letter(consumer, partition, record_offset)
 
     def store_dedup(self, mapping: dict[str, str]) -> None:
         self.globals_store.store_dedup(mapping)
@@ -449,6 +482,11 @@ class ShardedSchedulerDb(SchedulerDb):
         ppos = col("markers", "partition")
         for row in dump.get("markers", []):
             shard_dumps[int(row[ppos]) % self.num_shards]["markers"].append(row)
+        dpos = col("dead_letters", "partition")
+        for row in dump.get("dead_letters", []):
+            shard_dumps[int(row[dpos]) % self.num_shards][
+                "dead_letters"
+            ].append(row)
         cpos = col("consumer_positions", "partition")
         merged: dict[tuple[str, int], int] = {}
         _min_merge_positions(
@@ -492,6 +530,10 @@ class ShardedLookoutDb(LookoutDb):
         ),
         "consumer_positions": ("consumer", "partition", "position"),
         "saved_view": ("name", "payload", "updated_ns"),
+        "dead_letters": (
+            "consumer", "partition", "record_offset", "rec_key", "payload",
+            "stage", "error", "created_ns", "status",
+        ),
     }
 
     _PG_SCHEMA_FMT = "armada_lookout_shard_{k}"
@@ -568,6 +610,32 @@ class ShardedLookoutDb(LookoutDb):
             "ShardedLookoutDb is a union reader; ingestion writes go "
             "through shard_sink(k, n)"
         )
+
+    store_dead_letters = store
+
+    def mark_dead_letter(
+        self, consumer, partition=None, record_offset=None, status="dead"
+    ) -> int:
+        if partition is not None:
+            return self._stores[
+                int(partition) % self.num_shards
+            ].mark_dead_letter(consumer, partition, record_offset, status)
+        return sum(
+            s.mark_dead_letter(consumer, None, record_offset, status)
+            for s in self._stores
+        )
+
+    def list_dead_letters(self, consumer=None, status=None) -> list[dict]:
+        out: list[dict] = []
+        for s in self._stores:
+            out.extend(s.list_dead_letters(consumer, status))
+        out.sort(key=lambda r: (r["consumer"], r["partition"], r["record_offset"]))
+        return out
+
+    def get_dead_letter(self, consumer, partition, record_offset):
+        return self._stores[
+            int(partition) % self.num_shards
+        ].get_dead_letter(consumer, partition, record_offset)
 
     def positions(self, consumer: str = "lookout") -> dict[int, int]:
         merged: dict[tuple[str, int], int] = {}

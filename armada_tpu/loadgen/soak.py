@@ -713,6 +713,13 @@ def run_soak(cfg: SoakConfig, data_dir: str, stub_probe: bool = True) -> dict:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        if cfg.fault and cfg.fault.startswith("convert_record"):
+            # The poison drill's latch is STICKY by design (the fault is
+            # one-shot, the latched payload keeps failing); a soak that
+            # armed it must not leak it into later tests.
+            from armada_tpu.ingest import dlq as _dlq
+
+            _dlq.reset_poison()
         if chaos and not tsan_was_enabled:
             # Leave the race harness the way we found it: an armed-but-
             # unharvested tsan would change every later test's behavior.

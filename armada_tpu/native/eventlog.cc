@@ -75,8 +75,18 @@ constexpr uint32_t kMaxPayload = 1u << 30;
 constexpr uint32_t kMaxKey = 1u << 20;
 
 // Validate the record at `off` in fd of size `size`. Returns record total
-// length, or -1 if truncated/corrupt. With verify_crc, the body is read and
-// checksummed too (used by the open-time recovery scan and by el_read).
+// length, or a negative classification:
+//   -1  TORN -- the record is incomplete at the end of the region (header
+//       does not fit, the declared length runs past `size`, or a CRC-failed
+//       record that ends EXACTLY at `size`).  A crash mid-append tears the
+//       FINAL record only: pwrite lays down a contiguous prefix and the
+//       unfsynced tail sectors may not all have landed, but everything it
+//       tears sits at the end of the file.
+//   -2  CORRUPT -- an invalid record with more data after it (a mid-log CRC
+//       mismatch, or insane declared lengths whose claimed extent fits
+//       inside the region).  This is disk damage, never crash residue.
+// With verify_crc, the body is read and checksummed too (used by the
+// open-time recovery scan and by el_read).
 int64_t record_len_at(int fd, int64_t off, int64_t size, bool verify_crc) {
   if (off + (int64_t)(kHeader + kTrailer) > size) return -1;
   uint8_t hdr[kHeader];
@@ -85,7 +95,13 @@ int64_t record_len_at(int fd, int64_t off, int64_t size, bool verify_crc) {
   memcpy(&paylen, hdr, 4);
   memcpy(&keylen, hdr + 4, 4);
   int64_t total = kHeader + keylen + paylen + kTrailer;
-  if (paylen > kMaxPayload || keylen > kMaxKey) return -1;
+  if (paylen > kMaxPayload || keylen > kMaxKey) {
+    // Insane lengths whose claimed extent still runs past the region end
+    // look exactly like a torn partial header at the tail (arbitrary
+    // bytes where a header never finished landing); a claimed extent
+    // that FITS inside the region is damage.
+    return off + total > size ? -1 : -2;
+  }
   if (off + total > size) return -1;
   if (verify_crc) {
     std::vector<uint8_t> body(keylen + paylen + kTrailer);
@@ -95,7 +111,7 @@ int64_t record_len_at(int fd, int64_t off, int64_t size, bool verify_crc) {
     uint32_t stored;
     memcpy(&stored, body.data() + keylen + paylen, 4);
     if (crc32(body.data(), keylen, body.data() + keylen, paylen) != stored)
-      return -1;
+      return off + total == size ? -1 : -2;
   }
   return total;
 }
@@ -119,13 +135,28 @@ void* el_open(const char* dir, int num_partitions) {
     }
     struct stat st;
     fstat(fd, &st);
-    // Crash recovery: walk records from 0, verifying checksums; truncate at
-    // the first torn or corrupt record.
+    // Crash recovery: walk records from 0, verifying checksums.  A TORN
+    // final record (crash mid-append) is truncated away -- the publisher
+    // never acked it, so dropping it loses nothing.  A CORRUPT record
+    // with data after it is disk damage: acked records would silently
+    // vanish if we truncated here, so the open FAILS loudly instead
+    // (operator restores from a replica or checkpoint; docs/operations.md).
     int64_t off = 0;
+    int64_t total = 0;
     while (off < st.st_size) {
-      int64_t total = record_len_at(fd, off, st.st_size, /*verify_crc=*/true);
+      total = record_len_at(fd, off, st.st_size, /*verify_crc=*/true);
       if (total < 0) break;
       off += total;
+    }
+    if (total == -2) {
+      fprintf(stderr,
+              "eventlog: corrupt record (not a torn tail) in %s at offset "
+              "%lld; refusing to open\n",
+              path.c_str(), (long long)off);
+      close(fd);
+      for (int j = 0; j < k; j++) close(log->parts[j].fd);
+      delete log;
+      return nullptr;
     }
     if (off < st.st_size) {
       if (ftruncate(fd, off) != 0) { /* keep going; end still caps reads */

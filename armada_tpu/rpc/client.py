@@ -413,6 +413,67 @@ class ArmadaClient(_Base):
         )
         return json.loads(resp.json)
 
+    # --- dead letters (armadactl dlq; ingest/dlq.py) ------------------------
+
+    def dlq_status(self) -> dict:
+        """Quarantine census + pending control-plane halts (the /healthz
+        ``dlq`` block plus per-store row counts)."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DlqStatus",
+            pb.Empty(),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def dlq_list(self, selector: str = "") -> list:
+        """Quarantined rows matching 'consumer[:partition[:offset]]'
+        (payload omitted; sizes only)."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DlqList",
+            pb.QueueGetRequest(name=selector),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def dlq_show(self, selector: str) -> dict:
+        """One full dead-letter row (key/payload base64-encoded); the
+        selector must be a full consumer:partition:offset triple."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DlqShow",
+            pb.QueueGetRequest(name=selector),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def dlq_replay(self, selector: str = "") -> dict:
+        """Re-publish matching dead rows' raw bytes and mark them
+        replayed.  Run only after fixing the poison's cause."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DlqReplay",
+            pb.QueueGetRequest(name=selector),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
+    def dlq_discard(self, selector: str) -> dict:
+        """Approve a pending control-plane skip or mark rows discarded."""
+        import json
+
+        resp = self._unary(
+            "/armada_tpu.api.ExecutorAdmin/DlqDiscard",
+            pb.QueueGetRequest(name=selector),
+            pb.JsonResponse,
+        )
+        return json.loads(resp.json)
+
     # --- scheduling reports -------------------------------------------------
 
     def get_job_report(self, job_id: str) -> dict:

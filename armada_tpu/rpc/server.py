@@ -470,6 +470,53 @@ class _ExecutorAdminService:
         )
         return pb.JsonResponse(json=_json.dumps(out))
 
+    # Dead-letter verbs (ingest/dlq.py): the selector rides
+    # QueueGetRequest.name ('consumer[:partition[:offset]]'), the JSON
+    # document rides JsonResponse -- no proto changes, same shape as the
+    # quarantine verbs.
+    def DlqStatus(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        out = _guard(context, lambda: self._cp.dlq_status(principal))
+        return pb.JsonResponse(json=_json.dumps(out))
+
+    def DlqList(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        out = _guard(
+            context, lambda: self._cp.dlq_list(request.name, principal)
+        )
+        return pb.JsonResponse(json=_json.dumps(out))
+
+    def DlqShow(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        out = _guard(
+            context, lambda: self._cp.dlq_show(request.name, principal)
+        )
+        return pb.JsonResponse(json=_json.dumps(out))
+
+    def DlqReplay(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        out = _guard(
+            context, lambda: self._cp.dlq_replay(request.name, principal)
+        )
+        return pb.JsonResponse(json=_json.dumps(out))
+
+    def DlqDiscard(self, request, context):
+        import json as _json
+
+        principal = _authenticate(self._auth, context)
+        out = _guard(
+            context, lambda: self._cp.dlq_discard(request.name, principal)
+        )
+        return pb.JsonResponse(json=_json.dumps(out))
+
     def PreemptOnQueue(self, request, context):
         principal = _authenticate(self._auth, context)
         _guard(
@@ -800,6 +847,13 @@ def make_server(
                     ),
                     "QuarantineClear": _unary(
                         csvc.QuarantineClear, pb.QueueGetRequest
+                    ),
+                    "DlqStatus": _unary(csvc.DlqStatus, pb.Empty),
+                    "DlqList": _unary(csvc.DlqList, pb.QueueGetRequest),
+                    "DlqShow": _unary(csvc.DlqShow, pb.QueueGetRequest),
+                    "DlqReplay": _unary(csvc.DlqReplay, pb.QueueGetRequest),
+                    "DlqDiscard": _unary(
+                        csvc.DlqDiscard, pb.QueueGetRequest
                     ),
                 },
             )

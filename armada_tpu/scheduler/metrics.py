@@ -316,6 +316,24 @@ class SchedulerMetrics:
         self._ingest_lag_labels: set = set()
         self._ingest_rate_labels: set = set()
         self._ingest_store_labels: set = set()
+        # Poison-record quarantine (round 21, ingest/dlq.py): dead-letter
+        # and batch-retry counts are process-cumulative registry totals
+        # exported as gauges (the registry is the source of truth; a
+        # restart legitimately resets them, like verification failures).
+        self.ingest_dead_letters = g(
+            "armada_ingest_dead_letters_total",
+            "Records quarantined to the dead-letter store per consumer "
+            "view and partition (process-cumulative)",
+            ["consumer", "partition"],
+        )
+        self.ingest_batch_retries = g(
+            "armada_ingest_batch_retries_total",
+            "Failed ingest batch attempts per consumer view "
+            "(process-cumulative; spikes precede poison isolation)",
+            ["consumer"],
+        )
+        self._ingest_dead_labels: set = set()
+        self._ingest_retry_labels: set = set()
 
     # --- hooks called by the Scheduler --------------------------------------
 
@@ -401,6 +419,34 @@ class SchedulerMetrics:
         self._ingest_lag_labels = lag_seen
         self._ingest_rate_labels = rate_seen
         self._ingest_store_labels = store_seen
+
+    def observe_dlq(self, snapshot: dict) -> None:
+        """Publish the dead-letter registry's snapshot
+        (ingest/dlq.registry().snapshot), once per cycle; stale label sets
+        (a reset registry) are removed like the ingest series."""
+        dead_seen = set()
+        retry_seen = set()
+        by_part = snapshot.get("dead_letters_by_partition") or {}
+        for consumer, parts in by_part.items():
+            for part, n in parts.items():
+                labels = (consumer, str(part))
+                dead_seen.add(labels)
+                self.ingest_dead_letters.labels(*labels).set(float(n))
+        for consumer, n in (snapshot.get("batch_retries") or {}).items():
+            retry_seen.add((consumer,))
+            self.ingest_batch_retries.labels(consumer).set(float(n))
+        for labels in self._ingest_dead_labels - dead_seen:
+            try:
+                self.ingest_dead_letters.remove(*labels)
+            except KeyError:
+                pass
+        for labels in self._ingest_retry_labels - retry_seen:
+            try:
+                self.ingest_batch_retries.remove(*labels)
+            except KeyError:
+                pass
+        self._ingest_dead_labels = dead_seen
+        self._ingest_retry_labels = retry_seen
 
     def observe_trace(self, stage_snapshot: dict) -> None:
         """Publish the trace recorder's per-stage latency snapshot

@@ -285,6 +285,9 @@ class Scheduler:
             from armada_tpu.ingest.stats import registry as _ingest_stats
 
             self.metrics.observe_ingest(_ingest_stats().snapshot())
+            from armada_tpu.ingest.dlq import registry as _dlq_registry
+
+            self.metrics.observe_dlq(_dlq_registry().snapshot())
         if self.reports is not None and result.scheduler_result is not None:
             self.reports.record_cycle(result.scheduler_result, now=self._clock())
         return result

@@ -46,6 +46,12 @@ class ControlPlaneServer:
         # event".  None = this plane has no checkpoint surface.
         self.checkpoint_trigger: Optional[Callable[[], dict]] = None
         self.checkpoint_status: Optional[Callable[[], dict]] = None
+        # Dead-letter verbs (armadactl dlq): plane-LOCAL like checkpoints --
+        # a quarantined record is one replica's artifact (its store's DLQ
+        # table); replay re-publishes through the shared log, and
+        # idempotent re-application makes that safe.  serve wires an
+        # ingest/dlq.DlqAdmin here; None = no dead-letter surface.
+        self.dlq_admin: Optional[object] = None
 
     def _publish(self, event: pb.Event, user: str) -> None:
         event.created_ns = int(self._clock() * 1e9)
@@ -126,6 +132,57 @@ class ControlPlaneServer:
         if self.checkpoint_status is None:
             raise SubmitError("this plane has no checkpoint surface")
         return self.checkpoint_status()
+
+    # --- dead letters (ingest/dlq.py; plane-local like checkpoints) ---------
+
+    def _dlq(self):
+        if self.dlq_admin is None:
+            raise SubmitError("this plane has no dead-letter surface")
+        return self.dlq_admin
+
+    def dlq_status(self, principal: Principal = Principal()) -> dict:
+        """Quarantine census + pending control-plane halts (the /healthz
+        ``dlq`` block plus per-store row counts)."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        return self._dlq().status()
+
+    def dlq_list(
+        self, selector: str = "", principal: Principal = Principal()
+    ) -> list:
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        return self._dlq().list(selector)
+
+    def dlq_show(self, selector: str, principal: Principal = Principal()) -> dict:
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        return self._dlq().show(selector)
+
+    def dlq_replay(
+        self, selector: str = "", principal: Principal = Principal()
+    ) -> dict:
+        """Re-publish matching dead rows' raw bytes to their original
+        partitions (once per original record) and mark them replayed.
+        Event-sourcing idempotency makes re-application safe; run only
+        after fixing whatever made the record poison."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        return self._dlq().replay(selector)
+
+    def dlq_discard(
+        self, selector: str, principal: Principal = Principal()
+    ) -> dict:
+        """Approve a pending control-plane skip (the halt verdict) or mark
+        quarantined rows discarded -- the operator's explicit give-up."""
+        self._auth.authorize_action(
+            principal, Permission.UPDATE_EXECUTOR_SETTINGS
+        )
+        return self._dlq().discard(selector)
 
     # --- cycle traces (ops/trace.py; plane-local like checkpoints) ----------
 

@@ -45,6 +45,21 @@ CREATE TABLE IF NOT EXISTS stream_cursors (
   next_idx INTEGER NOT NULL,
   PRIMARY KEY (queue, jobset)
 );
+
+-- Poison-record quarantine (ingest/dlq.py): same shape as the scheduler
+-- store's table; the DLQ row and cursor advance share one transaction.
+CREATE TABLE IF NOT EXISTS dead_letters (
+  consumer TEXT NOT NULL,
+  partition INTEGER NOT NULL,
+  record_offset INTEGER NOT NULL,
+  rec_key BLOB NOT NULL,
+  payload BLOB NOT NULL,
+  stage TEXT NOT NULL,
+  error TEXT NOT NULL,
+  created_ns INTEGER NOT NULL,
+  status TEXT NOT NULL DEFAULT 'dead',
+  PRIMARY KEY (consumer, partition, record_offset)
+);
 """
 
 
@@ -115,6 +130,41 @@ class EventDb:
             except BaseException:
                 self._conn.rollback()
                 raise
+
+    # --- dead-letter quarantine (ingest/dlq.py) -----------------------------
+
+    def store_dead_letters(
+        self,
+        rows,
+        consumer: str = "events",
+        next_positions: Optional[dict[int, int]] = None,
+    ) -> None:
+        from armada_tpu.ingest import dlq
+
+        dlq.commit_dead_letters(
+            self._conn, self._lock, rows, consumer, next_positions
+        )
+
+    def list_dead_letters(self, consumer=None, status=None) -> list[dict]:
+        from armada_tpu.ingest import dlq
+
+        return dlq.list_rows(self._conn, self._lock, consumer, status)
+
+    def get_dead_letter(self, consumer, partition, record_offset):
+        from armada_tpu.ingest import dlq
+
+        return dlq.get_row(
+            self._conn, self._lock, consumer, partition, record_offset
+        )
+
+    def mark_dead_letter(
+        self, consumer, partition=None, record_offset=None, status="dead"
+    ) -> int:
+        from armada_tpu.ingest import dlq
+
+        return dlq.mark_rows(
+            self._conn, self._lock, status, consumer, partition, record_offset
+        )
 
     def positions(self, consumer: str = "events") -> dict[int, int]:
         with self._lock:

@@ -1,0 +1,45 @@
+# Fixture for rule `dlq-cursor-same-txn` (linted under armada_tpu/ingest/).
+# The twin line is syntactically IDENTICAL to the true positive after
+# normalization; it quarantines a row with the cursor advance of the SAME
+# record -- exactly what ingest/dlq.py's quarantine path does, so the DLQ
+# insert and the consumer cursor commit in one shard transaction.  Only
+# value-flow provenance (which record the next_positions derive from)
+# separates the two: the TP advances the cursor for a DIFFERENT record
+# than the one being quarantined, so a crash between the two transactions
+# either loses the poison record for good or re-quarantines it forever.
+
+
+def DeadLetter(*args):  # stand-in row constructor (the rule's anchor)
+    return args
+
+
+def quarantine_split(sink, consumer, rec, other):
+    part, off, key, payload, next_off = rec
+    xpart, xoff, xkey, xpayload, xnext = other
+    row = DeadLetter(part, off, key, payload, "convert", "err", 0)
+    cursor = {xpart: xnext}
+    sink.store_dead_letters([row], consumer=consumer, next_positions=cursor)  # TP
+
+
+def quarantine_atomic(sink, consumer, rec, other):
+    part, off, key, payload, next_off = rec
+    xpart, xoff, xkey, xpayload, xnext = other
+    row = DeadLetter(part, off, key, payload, "convert", "err", 0)
+    cursor = {part: next_off}
+    sink.store_dead_letters([row], consumer=consumer, next_positions=cursor)  # twin
+
+
+def delegation(sink, consumer, rows, positions):
+    # near miss: untraced rows (the pure-delegation shape) -- provenance
+    # unknown is not a violation
+    sink.store_dead_letters(rows, consumer=consumer, next_positions=positions)
+
+
+def quarantine_inline(sink, consumer, rec):
+    # near miss: the real dlq.py shape, cursor dict built inline from the
+    # same record's fields
+    part, off, key, payload, next_off = rec
+    row = DeadLetter(part, off, key, payload, "store", "err", 0)
+    sink.store_dead_letters(
+        [row], consumer=consumer, next_positions={part: next_off}
+    )

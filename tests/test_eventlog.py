@@ -171,10 +171,53 @@ def test_corrupt_body_detected(tmp_path):
         b = f.read(1)
         f.seek(10)
         f.write(bytes([b[0] ^ 0xFF]))
-    # Reopen: the recovery scan checksums records, so the log truncates at the
-    # corruption instead of serving garbage.
+    # Reopen: MID-LOG corruption (more data after the bad record) is disk
+    # damage, not crash residue -- silently truncating would vanish acked
+    # records, so the open fails loudly instead (operator restores from a
+    # replica or checkpoint).
+    with pytest.raises(OSError, match="failed to open"):
+        EventLog(path, num_partitions=1)
+
+
+def test_torn_final_record_truncated_at_byte_boundary(tmp_path):
+    """A crash mid-append tears the FINAL record at an arbitrary byte
+    boundary; reopen must truncate exactly it and keep every prior record
+    (the round-21 distinction: torn tail repairs, mid-log damage halts)."""
+    path = str(tmp_path / "log")
     with EventLog(path, num_partitions=1) as log:
-        assert log.end_offset(0) == 0
+        log.append(0, b"k", b"first-record")
+        good_end = log.end_offset(0)
+        log.append(0, b"k", b"second-record-that-tears")
+        torn_end = log.end_offset(0)
+        log.flush()
+    fpath = os.path.join(path, "p0.log")
+    # Cut inside the last record's payload: the header is intact and sane,
+    # but the declared extent runs past EOF.
+    with open(fpath, "r+b") as f:
+        f.truncate(torn_end - 7)
+    with EventLog(path, num_partitions=1) as log:
+        assert log.end_offset(0) == good_end
+        assert [m.payload for m in log.read(0, 0)] == [b"first-record"]
+        log.append(0, b"k", b"after-repair")
+        assert [m.payload for m in log.read(0, 0)] == [
+            b"first-record",
+            b"after-repair",
+        ]
+    # A cut that leaves the full length but scrambles the final record's
+    # trailing CRC bytes is the same crash shape (unordered sector loss):
+    # still a tail repair, not a halt.
+    with EventLog(path, num_partitions=1) as log:
+        log.append(0, b"k", b"crc-torn")
+        end = log.end_offset(0)
+        log.flush()
+    with open(fpath, "r+b") as f:
+        f.seek(end - 2)
+        f.write(b"\x00\x00")
+    with EventLog(path, num_partitions=1) as log:
+        assert [m.payload for m in log.read(0, 0)] == [
+            b"first-record",
+            b"after-repair",
+        ]
 
 
 def test_publish_does_not_mutate_input(tmp_path):

@@ -86,6 +86,10 @@ RULE_FIXTURES = {
         "store_shard_foreign_write.py",
         "armada_tpu/ingest/fixture.py",
     ),
+    "dlq-cursor-same-txn": (
+        "dlq_cursor_same_txn.py",
+        "armada_tpu/ingest/fixture.py",
+    ),
 }
 
 # The value-flow rules whose fixtures carry a `# twin` line: a
@@ -99,6 +103,7 @@ TWIN_RULES = [
     "pool-dispatch-mutation",
     "shard-foreign-cursor",
     "store-shard-foreign-write",
+    "dlq-cursor-same-txn",
 ]
 
 

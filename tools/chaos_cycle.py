@@ -312,6 +312,219 @@ def run_pool_script(
     return decisions, sup, pool_serving_stats().snapshot(), violations
 
 
+def _dlq_materialized(db) -> dict:
+    """Materialized-state equality surface for the poison drill.
+
+    dead_letters is EXCLUDED (the poisoned arm carries 'replayed' rows the
+    clean arm never saw), consumer_positions too (the replay appends the
+    raw record back to the log, so the poisoned cursor ends further), and
+    serials/serial columns as everywhere (batching differs)."""
+    from armada_tpu.ingest.schedulerdb import SNAPSHOT_TABLES
+
+    snap = db.export_snapshot()
+    out = {}
+    for table, cols in SNAPSHOT_TABLES.items():
+        if table in ("serials", "dead_letters", "consumer_positions"):
+            continue
+        rows = snap.get(table, [])
+        if "serial" in cols:
+            i = cols.index("serial")
+            rows = [r[:i] + r[i + 1 :] for r in rows]
+        out[table] = sorted(rows)
+    return out
+
+
+def _poison_world(log, rng) -> None:
+    """Publish a deterministic churny mix across queues/jobsets."""
+    from armada_tpu.eventlog.publisher import Publisher
+    from armada_tpu.events import events_pb2 as pb
+
+    pub = Publisher(log)
+    jid = 0
+    for i in range(40):
+        events = []
+        for _ in range(rng.randrange(1, 4)):
+            events.append(
+                pb.Event(
+                    created_ns=i + 1,
+                    submit_job=pb.SubmitJob(
+                        job_id=f"pz-{jid:05d}", spec=pb.JobSpec()
+                    ),
+                )
+            )
+            jid += 1
+        pub.publish(
+            [
+                pb.EventSequence(
+                    queue=f"pq{rng.randrange(3)}",
+                    jobset=f"pjs{rng.randrange(4)}",
+                    events=events,
+                )
+            ]
+        )
+
+
+def _poison_arm(d, log, rng, sharded: bool) -> dict:
+    """One arm of the poison drill: clean drain -> poisoned drain (fault
+    armed, bounded retries escalate to bisection) -> operator replay ->
+    suffix drain -> bit-equality against the never-poisoned state."""
+    from armada_tpu.core import faults
+    from armada_tpu.ingest import dlq
+    from armada_tpu.ingest.converter import convert_sequences
+    from armada_tpu.ingest.pipeline import IngestionPipeline
+    from armada_tpu.ingest.schedulerdb import SchedulerDb
+    from armada_tpu.ingest.shards import PartitionedIngestionPipeline
+    from armada_tpu.ingest.storeunion import ShardedSchedulerDb
+
+    tag = "sharded" if sharded else "serial"
+    parts = log.num_partitions
+
+    def caught_up(store, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            pos = store.positions("scheduler")
+            if all(pos.get(p, 0) >= log.end_offset(p) for p in range(parts)):
+                return True
+            time.sleep(0.02)
+        return False
+
+    # Clean arm FIRST (the fault env is still disarmed): the never-poisoned
+    # ground truth over the original log contents.
+    clean = SchedulerDb(os.path.join(d, f"clean-{tag}.sqlite"))
+    IngestionPipeline(
+        log, clean, convert_sequences, "scheduler"
+    ).run_until_caught_up()
+    want = _dlq_materialized(clean)
+
+    # Store-shard width rides the env (--store-shards); only the sharded
+    # ingest arm can drive the union store (serial store() raises on it by
+    # design), and the width must divide the ingest width.
+    store_w = 1
+    if sharded:
+        try:
+            store_w = max(1, int(os.environ.get("ARMADA_STORE_SHARDS", "1")))
+        except ValueError:
+            store_w = 1
+        store_w = max(w for w in (1, 2, 4) if w <= min(store_w, parts))
+    if store_w > 1:
+        poisoned = ShardedSchedulerDb(
+            os.path.join(d, f"poisoned-{tag}"),
+            num_shards=store_w,
+            num_partitions=parts,
+        )
+    else:
+        poisoned = SchedulerDb(os.path.join(d, f"poisoned-{tag}.sqlite"))
+
+    dlq.reset_poison()
+    faults.reset_counters()
+    os.environ["ARMADA_FAULT"] = "convert_record:raise"
+    try:
+        if sharded:
+            pipe = PartitionedIngestionPipeline(
+                log,
+                poisoned,
+                convert_sequences,
+                "scheduler",
+                num_shards=parts,
+                convert_mode="inline",
+                poll_interval=0.02,
+            )
+        else:
+            pipe = IngestionPipeline(
+                log, poisoned, convert_sequences, "scheduler",
+                poll_interval=0.02,
+            )
+        pipe.start()
+        # Wedge-proof half: with the poison latched, bounded retries must
+        # escalate to bisection and the shard drains PAST the poison
+        # offset to the log end.
+        drained = caught_up(poisoned)
+        dead = poisoned.list_dead_letters(consumer="scheduler", status="dead")
+
+        # Operator fix: disarm the fault, clear the latch, replay the
+        # quarantined raw bytes back through the log.
+        os.environ.pop("ARMADA_FAULT", None)
+        dlq.reset_poison()
+        replay = dlq.DlqAdmin(log, {"scheduler": poisoned}).replay("scheduler")
+        redrained = caught_up(poisoned)
+        pipe.stop()
+    finally:
+        os.environ.pop("ARMADA_FAULT", None)
+        dlq.reset_poison()
+
+    got = _dlq_materialized(poisoned)
+    equal = got == want
+    return {
+        "ok": bool(
+            drained
+            and redrained
+            and len(dead) >= 1
+            and replay.get("replayed", 0) >= 1
+            and equal
+        ),
+        "arm": tag,
+        "store_shards": store_w,
+        "drained_past_poison": drained,
+        "dead_letters": len(dead),
+        "replayed": replay.get("replayed", 0),
+        "state_equal_after_replay": equal,
+    }
+
+
+def run_poison_drill(seed: int) -> dict:
+    """The --poison leg: 3 seeds x (serial + sharded ingest), under tsan.
+
+    Asserts per seed/arm: the pipeline never wedges on a poison record
+    (bounded retries -> bisection -> per-record quarantine, cursor past the
+    poison), >=1 dead letter lands, `dlq replay` + a suffix drain restores
+    bit-equality with a never-poisoned drain of the same log."""
+    import tempfile
+
+    from armada_tpu.analysis import tsan
+    from armada_tpu.eventlog.log import EventLog
+    from armada_tpu.ingest import dlq
+
+    save = {
+        k: os.environ.get(k)
+        for k in ("ARMADA_FAULT", "ARMADA_INGEST_RETRIES")
+    }
+    os.environ["ARMADA_INGEST_RETRIES"] = "2"
+    tsan.enable()
+    tsan.reset()
+    dlq.reset_registry()
+    arms = []
+    try:
+        for s in (seed, seed + 1, seed + 2):
+            with tempfile.TemporaryDirectory(prefix="chaos-poison-") as d:
+                log = EventLog(os.path.join(d, "log"), num_partitions=4)
+                _poison_world(log, random.Random(s))
+                for sharded in (False, True):
+                    rep = _poison_arm(d, log, random.Random(s), sharded)
+                    rep["seed"] = s
+                    arms.append(rep)
+                log.close()
+    finally:
+        for k, v in save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        dlq.reset_poison()
+    violations = tsan.take_violations()
+    tsan.disable()
+    reg = dlq.registry().snapshot()
+    return {
+        "ok": bool(arms)
+        and all(a["ok"] for a in arms)
+        and not violations,
+        "seeds": 3,
+        "dead_letters_total": reg["dead_letters_total"],
+        "batch_retries": sum((reg.get("batch_retries") or {}).values()),
+        "tsan_violations": len(violations),
+        "arms": arms,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--cycles", type=int, default=8)
@@ -406,6 +619,18 @@ def main() -> int:
         "ingest/storeunion.py) for EVERY leg -- per-shard SQLite files "
         "behind the union reader; the ingest width rounds up to a "
         "multiple (default: inherit the environment)",
+    )
+    ap.add_argument(
+        "--poison",
+        action="store_true",
+        help="additionally run the poison-record drill (ISSUE 19): arm "
+        "ARMADA_FAULT=convert_record with bounded retries "
+        "(ARMADA_INGEST_RETRIES=2) over 3 seeded synthetic logs, serial "
+        "AND sharded ingest arms, under tsan -- the pipeline must drain "
+        "PAST the poison (bisection quarantines exactly the bad record, "
+        "cursor advances, no wedge), and `dlq replay` + a suffix drain "
+        "must restore bit-equality with a never-poisoned drain "
+        "(docs/operations.md dead-letter runbook)",
     )
     ap.add_argument(
         "--node-types",
@@ -612,6 +837,10 @@ def main() -> int:
             "tsan_violations": len(pool_tsan),
         }
 
+    poison_report = None
+    if args.poison:
+        poison_report = run_poison_drill(args.seed)
+
     ok = (
         chaotic == clean
         and (snap["fallbacks"] >= 1 if not args.mesh else mesh_ok)
@@ -621,6 +850,7 @@ def main() -> int:
         and (soak_report is None or soak_report["ok"])
         and (crash_report is None or crash_report["ok"])
         and (pool_report is None or pool_report["ok"])
+        and (poison_report is None or poison_report["ok"])
     )
     fault_site = "round_corrupt" if args.corrupt else "device_round"
     line = {
@@ -696,6 +926,8 @@ def main() -> int:
         }
     if pool_report is not None:
         line["pools"] = pool_report
+    if poison_report is not None:
+        line["poison"] = poison_report
     if not ok and chaotic != clean:
         for i, (a, b) in enumerate(zip(chaotic, clean)):
             if a != b:
