@@ -1,4 +1,4 @@
-"""Intra-procedural dataflow for armada-lint v2: def-use + provenance.
+"""Interprocedural dataflow for armada-lint v3: def-use + provenance.
 
 The costliest hard-won constraints in CLAUDE.md are *semantic*, not
 syntactic -- "nothing computed in the while-loop body from a gathered row"
@@ -11,9 +11,24 @@ cannot express "is this value derived from X"; this module can, cheaply:
   back-edges, branch joins and try-handler edges);
 * a forward fixpoint over a small provenance lattice -- each value carries
   a set of tags, joined by union at control-flow merges;
-* a one-hop call summary for module-local helpers (the callee is analyzed
-  once per distinct argument-tag signature; calls *inside* the callee are
-  treated generically, so analysis depth is bounded by construction);
+* memoized multi-hop call summaries (the callee is analyzed once per
+  distinct argument-tag signature; summary chains are bounded by a hop
+  budget, ``_MAX_SUMMARY_HOPS``, and cycles fall back to the generic
+  transfer via the in-progress guard -- so analysis cost is bounded by
+  construction, not by the call graph's depth);
+* a package-wide module registry (``project_module``) resolving
+  ``import``/``from ... import`` targets inside the repository so
+  summaries survive MODULE boundaries; each consulted module is keyed by
+  its content hash and recorded as a dependency (``dep_hashes``) so the
+  CLI's ``--cache`` can invalidate soundly;
+* field-sensitive ``self.*`` (and any dotted-chain) attribute provenance:
+  a bound field reads back its assigned tags flow-sensitively within a
+  function, and cross-method per-class field maps (``class_field_tags``)
+  answer reads of fields some OTHER method of the class assigned;
+* container-element flow: ``lst.append(v)`` / ``extend`` / ``update`` et
+  al. merge the value's tags into the receiver binding, so a "list of
+  finish closures" built in a loop and consumed later carries the
+  closures' provenance (the exact shape that defeated the v2 def-use);
 * resolution of jax higher-order callables: `lax.while_loop`/`fori_loop`
   bodies, `lax.cond`/`switch` branches and `jax.jit`-traced functions are
   resolved through local def-use (including the repo's `body =
@@ -48,22 +63,34 @@ Tags (the lattice is the powerset of these, ordered by inclusion):
              scatters and generic calls -- a derived view of a sharded
              slab is still sharded; the unpinned-out-shardings rule keys
              on it.
+``reduced``  produced by an ASSOCIATION-SENSITIVE reduction (``jnp.sum``,
+             ``cumsum``, ``mean``, ``dot``/``matmul``/``einsum``, the
+             segment sums): XLA may tree-reduce these, so their f32 result
+             depends on grouping.  Sticky through arithmetic and generic
+             calls; NOT set by association-exact reductions
+             (``min``/``max``/``argmin``/``any``/``all``).  The
+             vectorized-accumulator-ordering rule keys on it (the r15
+             "sequential f32 association" constraint).
 
 Approximations are deliberate and documented where they matter: scatter
 results carry the BASE buffer's provenance (the scattered value does not
 taint the buffer -- rules inspect scatter sites directly), attribute reads
-inherit the object's tags, and unknown calls union their argument tags
-minus ``whole``/``py``.  The engine is stdlib-``ast`` only and makes no
-attempt at inter-procedural soundness beyond the one-hop summaries --
-rules built on it trade completeness for zero-dependency speed, and every
-rule is pinned by a true-positive + syntactic-twin fixture so lattice
-regressions fail in tests/test_dataflow.py or tests/test_lint.py, not in
-review.
+of UNASSIGNED fields inherit the object's tags, unknown calls union their
+argument tags minus ``whole``/``py``, container-element tags merge into
+the container (per-element precision is not kept), and cross-method class
+field maps are flow-INSENSITIVE unions built after the module pass (the
+module pass itself sees only the flow-sensitive local bindings).  Rules
+built on the engine trade completeness for zero-dependency stdlib-``ast``
+speed, and every rule is pinned by a true-positive + syntactic-twin
+fixture so lattice regressions fail in tests/test_dataflow.py or
+tests/test_lint.py, not in review.
 """
 
 from __future__ import annotations
 
 import ast
+import hashlib
+import os
 from typing import Iterable, Optional
 
 GATHER = "gather"
@@ -72,13 +99,17 @@ EXT = "ext"
 WHOLE = "whole"
 PY = "py"
 SHARD = "shard"
+REDUCED = "reduced"
 
 EMPTY: frozenset = frozenset()
-_ARRAYISH = frozenset({GATHER, CARRY, EXT, WHOLE, SHARD})
+_ARRAYISH = frozenset({GATHER, CARRY, EXT, WHOLE, SHARD, REDUCED})
 
-# Bounded work: fixpoint passes per function and helper-summary depth.
+# Bounded work: fixpoint passes per function, nested-def depth, and the
+# summary-chain hop budget (cycles bail to generic via the in-progress
+# guard well before the cap matters).
 _MAX_PASSES = 40
 _MAX_DEPTH = 6
+_MAX_SUMMARY_HOPS = 3
 
 
 def dotted(node: ast.AST) -> str:
@@ -118,6 +149,20 @@ def _last(name: str) -> str:
 _REDUCERS = {
     "sum", "min", "max", "argmin", "argmax", "any", "all", "mean", "prod",
     "nonzero", "count_nonzero", "segment_min", "segment_max", "segment_sum",
+}
+# Association-SENSITIVE reductions: XLA may tree-reduce them, so the f32
+# result depends on grouping.  min/max/argmin/any/all are association-exact
+# and deliberately absent.  cumsum/cumprod are shape-preserving (not in
+# _REDUCERS) but every element is a grouped partial reduction.
+_ASSOC_REDUCERS = {
+    "sum", "mean", "prod", "dot", "matmul", "einsum", "tensordot", "vdot",
+    "segment_sum",
+}
+_CUMULATIVE = {"cumsum", "cumulative_sum", "cumprod"}
+# Container mutators: the value's tags merge into the receiver binding
+# (list-of-closures flow; per-element precision is not kept).
+_CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "appendleft",
 }
 _WHERE_LIKE = {"where", "select"}
 _WHOLE_PRESERVING = {"astype", "reshape", "copy"}
@@ -315,10 +360,12 @@ class FunctionAnalysis:
         seeds: Optional[dict] = None,
         closure: Optional[dict] = None,
         depth: int = 0,
+        hops: int = 0,
     ):
         self.ma = ma
         self.fn = fn
         self.depth = depth
+        self.hops = hops  # summary-chain position: gates further summaries
         self.closure = dict(closure or {})
         self.node_tags: dict[int, frozenset] = {}
         self.scatters: list[ScatterSite] = []
@@ -511,6 +558,7 @@ class FunctionAnalysis:
             if record and self.depth < _MAX_DEPTH:
                 for sub in stmt.body:
                     if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.ma.note_method(stmt.name, sub)
                         self._child(sub, env)
             return
         if isinstance(stmt, ast.Assign):
@@ -579,6 +627,7 @@ class FunctionAnalysis:
                 seeds={a.arg: frozenset({EXT, WHOLE}) for a in _all_args(fn.args)},
                 closure=_closure_of(env, self.closure),
                 depth=self.depth + 1,
+                hops=self.hops,
             )
             self.ma._register(self.children[id(fn)], self)
 
@@ -602,9 +651,16 @@ class FunctionAnalysis:
             self._bind(tgt.value, val, env, record)
         elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
             # a store into a container/attribute merges provenance into the
-            # root name (def-use continues through the mutated object)
+            # root name (def-use continues through the mutated object); an
+            # Attribute-chain target ALSO binds its dotted key so later
+            # reads of exactly that field are flow-sensitively precise
+            # (field-sensitive self.* provenance)
             if isinstance(tgt, ast.Subscript):
                 self._eval(tgt.slice, env, record)
+            elif isinstance(tgt, ast.Attribute):
+                d = dotted(tgt)
+                if d:
+                    env[d] = val
             root = tgt
             while isinstance(root, (ast.Subscript, ast.Attribute)):
                 root = root.value
@@ -634,6 +690,21 @@ class FunctionAnalysis:
             base = self._eval(node.value, env, record)
             if node.attr in _SHAPE_ATTRS:
                 return frozenset({PY})
+            d = dotted(node)
+            if d:
+                # flow-sensitive field binding from this function
+                if d in env:
+                    return env[d]
+                if d in self.closure:
+                    return self.closure[d]
+            # cross-method class field map: a field some OTHER method of
+            # this class assigned (built after the module pass)
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                cls = self.ma.method_class(self.fn)
+                if cls is not None:
+                    ft = self.ma.class_field_tags(cls).get(node.attr)
+                    if ft:
+                        return ft
             return base
         if isinstance(node, ast.Subscript):
             return self._eval_subscript(node, env, record)
@@ -811,9 +882,28 @@ class FunctionAnalysis:
             base_t = arg_tags[0] if arg_tags else EMPTY
             return (base_t | {SHARD}) - {PY} if placed else base_t
 
+        # container mutators: the element's tags merge into the receiver
+        # binding (list-of-closures flow); the call itself returns None
+        if (
+            isinstance(call.func, ast.Attribute)
+            and last in _CONTAINER_MUTATORS
+            and not fname.startswith(("jnp.", "np.", "jax.", "lax.", "math."))
+        ):
+            key = dotted(call.func.value)
+            if key:
+                env[key] = env.get(key, recv) | (u - {PY})
+            return EMPTY
+
         # provenance-aware builtins
         if last in _REDUCERS:
-            return (u | recv) - {GATHER, WHOLE, PY}
+            t = (u | recv) - {GATHER, WHOLE, PY}
+            if last in _ASSOC_REDUCERS:
+                t = t | {REDUCED}
+            return t
+        if last in _CUMULATIVE or last in _ASSOC_REDUCERS:
+            # cumsum-style (shape-preserving partial sums) and the
+            # contraction ops (dot/matmul/einsum) that _REDUCERS omits
+            return _generic_call(u | recv) | {REDUCED}
         if last in _WHERE_LIKE:
             return u | recv  # whole-buffer select keeps whole
         if last in _WHOLE_PRESERVING:
@@ -823,19 +913,32 @@ class FunctionAnalysis:
         if last in _PY_KEEPERS:
             return frozenset({PY})
 
-        # one-hop summary for module-local helpers (summary analyses run at
-        # _MAX_DEPTH, so calls INSIDE a summarized callee stay generic)
-        if self.depth < _MAX_DEPTH:
+        # multi-hop summary for module-local and imported project helpers
+        # (summary analyses run at _MAX_DEPTH so jax-site/nested-def
+        # resolution stays off inside them; the HOP budget is what lets a
+        # summarized callee's own calls summarize in turn)
+        if self.hops < _MAX_SUMMARY_HOPS and fname:
+            kw_map = {
+                kw.arg: t
+                for kw, t in zip(call.keywords, kw_tags)
+                if kw.arg is not None
+            }
             target = self.ma.module_defs.get(fname)
             if target is not None:
-                kw_map = {
-                    kw.arg: t
-                    for kw, t in zip(call.keywords, kw_tags)
-                    if kw.arg is not None
-                }
-                summary = self.ma.call_summary(target, arg_tags, kw_map)
+                summary = self.ma.call_summary(
+                    target, arg_tags, kw_map, hops=self.hops + 1
+                )
                 if summary is not None:
                     return summary
+            else:
+                imported = self.ma.imported_def(fname)
+                if imported is not None:
+                    target_ma, target_fn = imported
+                    summary = target_ma.call_summary(
+                        target_fn, arg_tags, kw_map, hops=self.hops + 1
+                    )
+                    if summary is not None:
+                        return summary
 
         # generic call: union of arguments (and the receiver, for methods),
         # minus whole/py -- the result is a new value
@@ -957,11 +1060,31 @@ class ModuleAnalysis:
         self._loop_sites: list[LoopSite] = []
         self.module_env: dict[str, frozenset] = {}
         self.module_fa: Optional[FunctionAnalysis] = None
+        # project modules consulted via imported_def (relpaths; dep_hashes
+        # closes this transitively for the CLI's --cache key)
+        self.deps: set[str] = set()
+        self._method_class_by_id: dict[int, str] = {}
+        self._class_fields: dict[str, dict[str, frozenset]] = {}
+        self._fields_ready = False
+        # import maps MUST exist before the module pass: _eval_call chases
+        # imported summaries while top-level defs analyze
+        self._import_from: dict[str, tuple[str, str]] = {}
+        self._import_mod: dict[str, str] = {}
+        self._collect_imports(tree)
         # module pass: binds module-level names (constants -> PY, imports ->
         # empty) and eagerly analyzes top-level defs as children
         self.module_fa = FunctionAnalysis(self, tree, seeds={}, closure={})
         self._register(self.module_fa, None)
         self.module_env = self.module_fa.exit_env
+        self._build_class_fields()
+        if self._class_fields:
+            # second pass: `self.X` reads now see the cross-method field
+            # map (the first pass recorded their tags before it existed)
+            self._loop_sites.clear()
+            self.module_fa = FunctionAnalysis(self, tree, seeds={}, closure={})
+            self._register(self.module_fa, None)
+            self.module_env = self.module_fa.exit_env
+            self._build_class_fields()
 
     # bookkeeping -----------------------------------------------------------
 
@@ -971,6 +1094,111 @@ class ModuleAnalysis:
 
     def parent_of(self, fa: FunctionAnalysis) -> Optional[FunctionAnalysis]:
         return self._parents.get(id(fa))
+
+    # classes ---------------------------------------------------------------
+
+    def note_method(self, classname: str, fn) -> None:
+        self._method_class_by_id[id(fn)] = classname
+
+    def method_class(self, fn) -> Optional[str]:
+        return self._method_class_by_id.get(id(fn))
+
+    def class_field_tags(self, classname: str) -> dict:
+        """Flow-insensitive union of `self.X = ...` bindings across every
+        method of the class (empty until the first module pass completes)."""
+        return self._class_fields.get(classname, {})
+
+    def _build_class_fields(self) -> None:
+        fields: dict[str, dict[str, frozenset]] = {}
+        for fa in self.module_fa.tree():
+            cls = self._method_class_by_id.get(id(fa.fn))
+            if cls is None:
+                continue
+            for k, v in fa.exit_env.items():
+                if k.startswith("self.") and "." not in k[5:]:
+                    d = fields.setdefault(cls, {})
+                    d[k[5:]] = d.get(k[5:], EMPTY) | v
+        self._class_fields = fields
+        self._fields_ready = True
+
+    # imports ---------------------------------------------------------------
+
+    def _package(self) -> Optional[str]:
+        rp = self.relpath
+        if not rp.endswith(".py"):
+            return None
+        parts = rp[:-3].replace(os.sep, "/").split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts[:-1])
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        pkg = self._package()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    if alias.asname:
+                        self._import_mod[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        self._import_mod[root] = root
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level:
+                    if pkg is None:
+                        continue
+                    parts = pkg.split(".") if pkg else []
+                    cut = stmt.level - 1
+                    if cut:
+                        if cut > len(parts):
+                            continue
+                        parts = parts[:-cut] if cut else parts
+                    base = ".".join(parts)
+                    mod = base + "." + stmt.module if stmt.module else base
+                    if not mod:
+                        continue
+                else:
+                    mod = stmt.module or ""
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    self._import_from[alias.asname or alias.name] = (mod, alias.name)
+
+    def imported_def(self, fname: str):
+        """(ModuleAnalysis, def node) for a callable imported from another
+        PROJECT module: `helper(...)` via `from m import helper`, or
+        `m.helper(...)` via `import m` / `from pkg import m`.  None when
+        the target lives outside the project root (stdlib, jax, numpy) or
+        sits on an import cycle (caller falls back to the generic call)."""
+        if "." not in fname:
+            ent = self._import_from.get(fname)
+            if ent is None:
+                return None
+            modname, orig = ent
+            pm = project_module(modname)
+            if pm is None:
+                return None
+            self.deps.add(pm.relpath)
+            fn = pm.module_defs.get(orig)
+            return (pm, fn) if fn is not None else None
+        head, func = fname.rsplit(".", 1)
+        modname = None
+        if head in self._import_mod:
+            modname = self._import_mod[head]
+        elif head in self._import_from:
+            m, orig = self._import_from[head]
+            modname = m + "." + orig if m else orig
+        elif "." in head:
+            root = head.split(".")[0]
+            if self._import_mod.get(root) == root:
+                modname = head  # `import a.b` then `a.b.helper(...)`
+        if modname is None:
+            return None
+        pm = project_module(modname)
+        if pm is None:
+            return None
+        self.deps.add(pm.relpath)
+        fn = pm.module_defs.get(func)
+        return (pm, fn) if fn is not None else None
 
     # analyses --------------------------------------------------------------
 
@@ -1011,7 +1239,8 @@ class ModuleAnalysis:
         self._in_progress.add(key)
         try:
             fa = FunctionAnalysis(
-                self, fn, seeds=seeds, closure=closure, depth=owner.depth + 1
+                self, fn, seeds=seeds, closure=closure, depth=owner.depth + 1,
+                hops=owner.hops,
             )
             self._fa_cache[key] = fa
             self._register(fa, owner)
@@ -1019,13 +1248,19 @@ class ModuleAnalysis:
             self._in_progress.discard(key)
         return fa
 
-    def call_summary(self, fn, arg_tags: list, kw_map: dict) -> Optional[frozenset]:
-        """One-hop return-tag summary of a module-local helper, memoized by
-        (callee, argument-tag signature)."""
+    def call_summary(self, fn, arg_tags: list, kw_map: dict, hops: int = 1) -> Optional[frozenset]:
+        """Return-tag summary of a module-local (or project-imported)
+        helper, memoized by (callee, argument-tag signature, hop position).
+        Summary analyses run at _MAX_DEPTH -- no nested-def or jax-site
+        resolution inside them -- but carry the caller's hop position, so a
+        summarized callee's OWN helper calls summarize in turn until the
+        _MAX_SUMMARY_HOPS budget runs out.  Tag sort is key=repr: marker
+        tuples (helper_flow_args) and plain strings share the sets."""
         sig = (
             id(fn),
-            tuple(tuple(sorted(t)) for t in arg_tags),
-            tuple(sorted((k, tuple(sorted(v))) for k, v in kw_map.items())),
+            hops,
+            tuple(tuple(sorted(t, key=repr)) for t in arg_tags),
+            tuple(sorted(((k, tuple(sorted(v, key=repr))) for k, v in kw_map.items()), key=repr)),
         )
         if sig in self._summary_cache:
             return self._summary_cache[sig]
@@ -1040,7 +1275,9 @@ class ModuleAnalysis:
             for name, tags in kw_map.items():
                 if any(p.arg == name for p in params):
                     seeds[name] = tags
-            fa = FunctionAnalysis(self, fn, seeds=seeds, closure={}, depth=_MAX_DEPTH)
+            fa = FunctionAnalysis(
+                self, fn, seeds=seeds, closure={}, depth=_MAX_DEPTH, hops=hops
+            )
             result = fa.return_tags
             self._summary_cache[sig] = result
             return result
@@ -1132,7 +1369,139 @@ def _jit_out_shardings(deco: ast.AST):
 def _seed_key(seeds: Optional[dict]):
     if not seeds:
         return ()
-    return tuple(sorted((k, tuple(sorted(v))) for k, v in seeds.items()))
+    return tuple(sorted(((k, tuple(sorted(v, key=repr))) for k, v in seeds.items()), key=repr))
+
+
+# --------------------------------------------------------------------------
+# project registry (cross-module summaries + --cache invalidation keys)
+# --------------------------------------------------------------------------
+
+_PROJECT_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_PM_CACHE: dict = {}  # modname -> (content_hash, ModuleAnalysis | None)
+_PM_BUILDING: set = set()
+_HASHES: dict = {}  # relpath -> content hash of the analyzed bytes
+
+
+def set_project_root(root: str) -> None:
+    """Point the cross-module resolver at a different tree (tests)."""
+    global _PROJECT_ROOT
+    _PROJECT_ROOT = os.path.abspath(root)
+    _PM_CACHE.clear()
+    _PM_BUILDING.clear()
+    _HASHES.clear()
+
+
+def content_hash(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def project_module(modname: str) -> Optional[ModuleAnalysis]:
+    """ModuleAnalysis for a dotted module name under the project root,
+    keyed by content hash (a re-read after the file changed re-analyzes).
+    None for modules outside the root, unparsable files, and import
+    cycles (the in-progress guard -- callers fall back to generic)."""
+    if not modname or modname.startswith("."):
+        return None
+    base = os.path.join(_PROJECT_ROOT, *modname.split("."))
+    path = base + ".py"
+    if not os.path.isfile(path):
+        path = os.path.join(base, "__init__.py")
+        if not os.path.isfile(path):
+            return None
+    try:
+        h = content_hash(path)
+    except OSError:
+        return None
+    cached = _PM_CACHE.get(modname)
+    if cached is not None and cached[0] == h:
+        return cached[1]
+    if modname in _PM_BUILDING:
+        return None
+    _PM_BUILDING.add(modname)
+    try:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            _PM_CACHE[modname] = (h, None)
+            return None
+        rel = os.path.relpath(path, _PROJECT_ROOT)
+        ma = ModuleAnalysis(tree, rel)
+        _PM_CACHE[modname] = (h, ma)
+        _HASHES[rel] = h
+        return ma
+    finally:
+        _PM_BUILDING.discard(modname)
+
+
+def dep_hashes(ma: ModuleAnalysis) -> dict:
+    """relpath -> content hash for every project module this analysis
+    consulted, TRANSITIVELY (the CLI --cache entry is stale when any of
+    these changes, not just the linted file itself)."""
+    by_rel = {
+        m.relpath: m
+        for _h, m in _PM_CACHE.values()
+        if m is not None
+    }
+    out: dict = {}
+    work = list(ma.deps)
+    seen: set = set()
+    while work:
+        rel = work.pop()
+        if rel in seen:
+            continue
+        seen.add(rel)
+        h = _HASHES.get(rel)
+        if h is not None:
+            out[rel] = h
+        dep_ma = by_rel.get(rel)
+        if dep_ma is not None:
+            work.extend(dep_ma.deps)
+    return out
+
+
+def helper_flow_args(ma: ModuleAnalysis, call: ast.Call) -> Optional[list]:
+    """Which of `call`'s argument EXPRESSIONS flow into the callee's return
+    value.  The callee (module-local or project-imported) is summarized
+    with unique per-parameter marker tags; markers surviving into the
+    return map back to the call's argument expressions.  None when the
+    callee is unresolvable -- rules fall back to their local handling.
+
+    This is the re-homing facility for the value-flow ingest rules: a
+    binding `x = normalize(positions)` lets a rule union its own domain
+    tags over `positions` instead of losing provenance at the helper."""
+    fname = dotted(call.func)
+    if not fname:
+        return None
+    target = ma.module_defs.get(fname)
+    target_ma = ma
+    if target is None:
+        imp = ma.imported_def(fname)
+        if imp is None:
+            return None
+        target_ma, target = imp
+    params = _all_args(getattr(target, "args", None))
+    if not params:
+        return None
+    exprs: dict = {}
+    for i, a in enumerate(call.args):
+        if i < len(params):
+            exprs[params[i].arg] = a
+    for kw in call.keywords:
+        if kw.arg is not None:
+            exprs[kw.arg] = kw.value
+    arg_tags = [frozenset({("param", p.arg)}) for p in params]
+    summary = target_ma.call_summary(target, arg_tags, {}, hops=1)
+    if summary is None:
+        return None
+    out = []
+    for tag in summary:
+        if isinstance(tag, tuple) and len(tag) == 2 and tag[0] == "param":
+            e = exprs.get(tag[1])
+            if e is not None:
+                out.append(e)
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -1208,22 +1208,29 @@ def _pool_fn_stmts(fn) -> list:
     return out
 
 
+_DISPATCH_OPENERS = ("dispatch_round_on_device", "dispatch_pool_rounds")
+
+
 @rule(
     "pool-dispatch-mutation",
     "host-side mutation of a pool's builder/devcache between its round "
-    "DISPATCH (dispatch_round_on_device) and its FETCH (the finish call): "
-    "the in-flight round's failover ground truth (bundle.materialize) "
-    "closes over live builder state, so a mid-flight mutation makes a "
-    "mesh/CPU re-run solve a DIFFERENT problem than the round it replaces "
-    "-- the cross-pool zombie-write hazard class (round 17)",
+    "DISPATCH (dispatch_round_on_device, or the windowed "
+    "dispatch_pool_rounds) and its FETCH (the finish call / the loop that "
+    "consumes the finishes): the in-flight round's failover ground truth "
+    "(bundle.materialize) closes over live builder state, so a mid-flight "
+    "mutation makes a mesh/CPU re-run solve a DIFFERENT problem than the "
+    "round it replaces -- the cross-pool zombie-write hazard class "
+    "(round 17)",
     scope=under("armada_tpu/"),
 )
 def _pool_dispatch_mutation(src: Source):
-    # Scope note: this models the SOLO dispatch API only.  The windowed
-    # dispatch_pool_rounds flow (a list of finishes consumed in a zip
-    # loop) is beyond intra-statement def-use; the dynamic equality
-    # suites cover it (docs/lint.md ledger states the boundary).
-    if "dispatch_round_on_device" not in src.text:
+    # Covers BOTH dispatch shapes: the solo dispatch_round_on_device handle
+    # and the windowed dispatch_pool_rounds list-of-finishes (container
+    # flow through `window.append` + inlining of nested-local-def calls
+    # like flush_window, so the window list built in the enclosing scope
+    # and dispatched inside the helper shares one value-flow state).
+    text = src.text
+    if all(op not in text for op in _DISPATCH_OPENERS):
         return
     _df.of(src)  # share the module's one dataflow pass (memoized per Source)
     fns = [
@@ -1231,6 +1238,7 @@ def _pool_dispatch_mutation(src: Source):
         for n in ast.walk(src.tree)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
     ]
+    seen_sites: set = set()
     for fn in fns:
         # value-flow per function: name -> frozenset of (kind, key) pool
         # sources (derived transitively from builder_for/devcache_for
@@ -1239,6 +1247,13 @@ def _pool_dispatch_mutation(src: Source):
         # call closed over).
         bindings: dict = {}
         open_dispatch: dict = {}
+        local_defs = {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        findings: list = []
 
         def expr_sources(node) -> frozenset:
             out: set = set()
@@ -1247,8 +1262,22 @@ def _pool_dispatch_mutation(src: Source):
                     out |= bindings.get(sub.id, frozenset())
             return frozenset(out)
 
-        for st in _pool_fn_stmts(fn):
-            # (1) a finish call closes its dispatch window
+        def step(st, inline_stack: frozenset) -> None:
+            # (0) a For consuming an open window's finishes closes it (the
+            # windowed fetch loop: `for e, fin in zip(entries, finishes)`),
+            # and the loop targets inherit the iterated sources
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                iter_names = {
+                    n.id for n in ast.walk(st.iter) if isinstance(n, ast.Name)
+                }
+                for h in [h for h in open_dispatch if h in iter_names]:
+                    open_dispatch.pop(h, None)
+                srcs = expr_sources(st.iter)
+                for sub in ast.walk(st.target):
+                    if isinstance(sub, ast.Name):
+                        bindings[sub.id] = srcs
+            # (1) a finish call closes its dispatch window (direct call,
+            # `.finish()`, or an indexed handle `finishes[i]()`)
             for sub in ast.walk(st):
                 if isinstance(sub, ast.Call):
                     name = None
@@ -1260,9 +1289,17 @@ def _pool_dispatch_mutation(src: Source):
                         and isinstance(sub.func.value, ast.Name)
                     ):
                         name = sub.func.value.id
+                    elif isinstance(sub.func, ast.Subscript) and isinstance(
+                        sub.func.value, ast.Name
+                    ):
+                        name = sub.func.value.id
                     if name in open_dispatch:
                         open_dispatch.pop(name, None)
-            exposed = frozenset().union(*open_dispatch.values()) if open_dispatch else frozenset()
+            exposed = (
+                frozenset().union(*open_dispatch.values())
+                if open_dispatch
+                else frozenset()
+            )
             # (2) mutations of an in-flight pool's state
             if exposed:
                 for sub in ast.walk(st):
@@ -1272,21 +1309,43 @@ def _pool_dispatch_mutation(src: Source):
                         and sub.func.attr in _POOL_STATE_MUTATORS
                         and expr_sources(sub.func.value) & exposed
                     ):
-                        yield _finding(
-                            src,
-                            "pool-dispatch-mutation",
-                            sub,
-                            "builder/devcache state of a DISPATCHED pool "
-                            "round mutated before its fetch: the failover "
-                            "ladder's materialize() would re-run a "
-                            "different problem -- commit mutations after "
-                            "the finish call, or route them through "
-                            "another pool's state",
-                        )
+                        site = (sub.lineno, sub.col_offset)
+                        if site not in seen_sites:
+                            seen_sites.add(site)
+                            findings.append(
+                                _finding(
+                                    src,
+                                    "pool-dispatch-mutation",
+                                    sub,
+                                    "builder/devcache state of a DISPATCHED "
+                                    "pool round mutated before its fetch: "
+                                    "the failover ladder's materialize() "
+                                    "would re-run a different problem -- "
+                                    "commit mutations after the finish "
+                                    "call, or route them through another "
+                                    "pool's state",
+                                )
+                            )
                         break
-            # (3) binding propagation (rebinding clears)
+            # (3) container flow: `window.append(entry)` merges the entry's
+            # pool sources into the window binding (the windowed shape)
+            for sub in ast.walk(st):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("append", "extend")
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.args
+                ):
+                    srcs = frozenset().union(
+                        frozenset(), *(expr_sources(a) for a in sub.args)
+                    )
+                    if srcs:
+                        key = sub.func.value.id
+                        bindings[key] = bindings.get(key, frozenset()) | srcs
+            # (4) binding propagation (rebinding clears)
             if isinstance(st, ast.Assign) and st.value is not None:
-                srcs: frozenset = frozenset()
+                srcs = frozenset()
                 val = st.value
                 if isinstance(val, ast.Call):
                     last = _dotted(val.func).rsplit(".", 1)[-1]
@@ -1295,19 +1354,132 @@ def _pool_dispatch_mutation(src: Source):
                         srcs = frozenset(
                             {(_POOL_STATE_FACTORIES[last], key)}
                         )
-                    elif last == "dispatch_round_on_device":
+                    elif last in _DISPATCH_OPENERS:
+                        opened = expr_sources(val)
                         for tgt in st.targets:
                             if isinstance(tgt, ast.Name):
-                                open_dispatch[tgt.id] = expr_sources(val)
+                                open_dispatch[tgt.id] = opened
+                            elif (
+                                isinstance(tgt, ast.Tuple)
+                                and tgt.elts
+                                and isinstance(tgt.elts[0], ast.Name)
+                            ):
+                                # `finishes, stacked, ... = dispatch_pool_
+                                # rounds(specs, cfg)`: the handle list is
+                                # the first element by API contract
+                                open_dispatch[tgt.elts[0].id] = opened
                         srcs = frozenset()
                     else:
                         srcs = expr_sources(val)
                 else:
                     srcs = expr_sources(val)
-                for tgt in st.targets:
-                    for sub in ast.walk(tgt):
-                        if isinstance(sub, ast.Name):
-                            bindings[sub.id] = srcs
+                if not (
+                    isinstance(val, ast.Call)
+                    and _dotted(val.func).rsplit(".", 1)[-1] in _DISPATCH_OPENERS
+                ):
+                    for tgt in st.targets:
+                        for sub in ast.walk(tgt):
+                            if isinstance(sub, ast.Name):
+                                bindings[sub.id] = srcs
+            # (5) inline calls to nested local defs with SHARED state: the
+            # windowed flush helper dispatches/fetches over the enclosing
+            # scope's window list
+            for sub in ast.walk(st):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id in local_defs
+                    and sub.func.id not in inline_stack
+                ):
+                    callee = local_defs[sub.func.id]
+                    params = [a.arg for a in callee.args.args]
+                    for p, a in zip(params, sub.args):
+                        bindings[p] = expr_sources(a)
+                    for cst in _pool_fn_stmts(callee):
+                        step(cst, inline_stack | {sub.func.id})
+
+        for st in _pool_fn_stmts(fn):
+            step(st, frozenset())
+        yield from findings
+
+
+# -- v3 re-homing: value-flow provenance across helper/module boundaries ----
+# The ingest rules below track their own domain tags (shard owners, shard
+# indices, record fields).  When a binding's value is a call to a PROJECT
+# helper (module-local or imported), dataflow.helper_flow_args tells us
+# which argument expressions actually flow into the return, so the rules
+# union their tags over THOSE instead of losing provenance (or smearing it
+# over every name in the call).
+
+
+def _flow_exprs(ma, val) -> Optional[list]:
+    """Call-site argument expressions flowing into a project helper call's
+    return, or None when `val` is not a resolvable helper call -- callers
+    fall back to their conservative all-names union."""
+    if not isinstance(val, ast.Call):
+        return None
+    return _df.helper_flow_args(ma, val)
+
+
+def _helper_poll_arg(ma, call: ast.Call) -> Optional[ast.AST]:
+    """For `raw = poll_shard(shard, n)` where the project helper's body
+    polls off one of its own parameters (`s.consumer.poll()` /
+    `s.poll_raw(...)`), the call-site argument expression standing for
+    that parameter: the wrapped-poll shape keeps its shard provenance."""
+    fname = _dotted(call.func)
+    if not fname:
+        return None
+    target = ma.module_defs.get(fname)
+    if target is None:
+        ent = ma.imported_def(fname)
+        if ent is None:
+            return None
+        _, target = ent
+    params = [a.arg for a in target.args.args]
+    owner_param = None
+    for sub in ast.walk(target):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("poll_raw", "_poll_raw", "poll")
+        ):
+            owner = sub.func.value
+            if isinstance(owner, ast.Attribute) and owner.attr in (
+                "consumer",
+                "_consumer",
+            ):
+                owner = owner.value
+            if isinstance(owner, ast.Name) and owner.id in params:
+                owner_param = owner.id
+                break
+    if owner_param is None:
+        return None
+    pos = params.index(owner_param)
+    if pos < len(call.args):
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == owner_param:
+            return kw.value
+    return None
+
+
+def _is_row_maker(ma, call: ast.Call, ctors: tuple) -> bool:
+    """True when `call` targets a project helper whose body constructs a
+    DLQ row (DeadLetter/make_dead_letter): `row = build_row(rec, exc)`
+    anchors as a row even though the ctor sits behind the helper."""
+    fname = _dotted(call.func)
+    if not fname:
+        return False
+    target = ma.module_defs.get(fname)
+    if target is None:
+        ent = ma.imported_def(fname)
+        if ent is None:
+            return False
+        _, target = ent
+    return any(
+        isinstance(c, ast.Call) and _dotted(c.func).rsplit(".", 1)[-1] in ctors
+        for c in ast.walk(target)
+    )
 
 
 @rule(
@@ -1330,7 +1502,7 @@ def _shard_foreign_cursor(src: Source):
     # violation, it is the inline single-shard shape.
     if "next_positions" not in src.text or ".store" not in src.text:
         return
-    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+    ma = _df.of(src)  # share the module's one dataflow pass (memoized per Source)
 
     def _owner_key(expr: ast.AST) -> Optional[str]:
         """The shard expression a poll/store hangs off: for
@@ -1378,7 +1550,10 @@ def _shard_foreign_cursor(src: Source):
                             "SAME transaction as their shard's data -- "
                             "ack through the shard that polled them",
                         )
-            # (2) binding propagation: poll results carry their shard tag
+            # (2) binding propagation: poll results carry their shard tag;
+            # project-helper calls keep provenance across the boundary
+            # (wrapped polls tag the call-site shard arg, transforms union
+            # only the args that FLOW into the return)
             if isinstance(st, ast.Assign) and st.value is not None:
                 tags: frozenset = frozenset()
                 val = st.value
@@ -1398,6 +1573,18 @@ def _shard_foreign_cursor(src: Source):
                         tags = frozenset({_owner_key(owner)})
                     else:
                         tags = expr_tags(val)
+                elif isinstance(val, ast.Call):
+                    parg = _helper_poll_arg(ma, val)
+                    if parg is not None:
+                        tags = frozenset({_owner_key(parg)}) | expr_tags(parg)
+                    else:
+                        flow = _flow_exprs(ma, val)
+                        if flow is None:
+                            tags = expr_tags(val)
+                        else:
+                            tags = frozenset().union(
+                                frozenset(), *(expr_tags(a) for a in flow)
+                            )
                 else:
                     tags = expr_tags(val)
                 for tgt in st.targets:
@@ -1427,7 +1614,7 @@ def _store_shard_foreign_write(src: Source):
     # violation, it is the single-store shape.
     if "shard_sink" not in src.text and "shard_store" not in src.text:
         return
-    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+    ma = _df.of(src)  # share the module's one dataflow pass (memoized per Source)
 
     def _key(expr: ast.AST) -> str:
         return ast.dump(expr, annotate_fields=False, include_attributes=False)
@@ -1516,6 +1703,21 @@ def _store_shard_foreign_write(src: Source):
                     continue
                 if isinstance(val, ast.Subscript):
                     tags = frozenset({_key(val.slice)})
+                elif isinstance(val, ast.Call):
+                    # project-helper transforms keep the index tag across
+                    # the boundary: union over the args that FLOW into the
+                    # return, with a flowing per-shard subscript
+                    # (`render(plans[k])`) contributing its index key
+                    flow = _flow_exprs(ma, val)
+                    if flow is None:
+                        tags = data_tags(val)
+                    else:
+                        out: set = set()
+                        for a in flow:
+                            if isinstance(a, ast.Subscript):
+                                out.add(_key(a.slice))
+                            out |= data_tags(a)
+                        tags = frozenset(out)
                 else:
                     tags = data_tags(val)
                 for tgt in st.targets:
@@ -1548,7 +1750,7 @@ def _dlq_cursor_same_txn(src: Source):
     # unknown is not a violation.
     if "store_dead_letters" not in src.text:
         return
-    _df.of(src)  # share the module's one dataflow pass (memoized per Source)
+    ma = _df.of(src)  # share the module's one dataflow pass (memoized per Source)
 
     _ROW_CTORS = ("DeadLetter", "make_dead_letter")
 
@@ -1624,7 +1826,10 @@ def _dlq_cursor_same_txn(src: Source):
                         "commits in this transaction",
                     )
             # (2) binding propagation: row constructions carry their
-            # record-field tags; everything else unions its names' tags
+            # record-field tags (a project helper whose body calls the
+            # ctor anchors as a row too -- v3 boundary crossing, with the
+            # tag set narrowed to the args that FLOW into the return);
+            # everything else unions its names' tags
             if isinstance(st, ast.Assign) and st.value is not None:
                 val = st.value
                 is_row = any(
@@ -1632,7 +1837,25 @@ def _dlq_cursor_same_txn(src: Source):
                     and _dotted(c.func).rsplit(".", 1)[-1] in _ROW_CTORS
                     for c in ast.walk(val)
                 )
+                helper_row = None
+                if not is_row:
+                    helper_row = next(
+                        (
+                            c
+                            for c in ast.walk(val)
+                            if isinstance(c, ast.Call)
+                            and _is_row_maker(ma, c, _ROW_CTORS)
+                        ),
+                        None,
+                    )
+                    is_row = helper_row is not None
                 t = tags(val)
+                if helper_row is not None:
+                    flow = _flow_exprs(ma, helper_row)
+                    if flow is not None:
+                        t = frozenset().union(
+                            frozenset(), *(tags(a) for a in flow)
+                        )
                 rtag = t if is_row else row_tags(val)
                 for tgt in st.targets:
                     for s2 in ast.walk(tgt):
@@ -1642,6 +1865,207 @@ def _dlq_cursor_same_txn(src: Source):
                                 rowtags[s2.id] = rtag
                             else:
                                 rowtags.pop(s2.id, None)
+
+
+@rule(
+    "vectorized-accumulator-ordering",
+    "a reduction-produced value (jnp.sum/cumsum/dot -- any association-"
+    "sensitive reduce) feeding an ordering comparison against a carry "
+    "accumulator inside a kernel loop body: f32 addition is non-"
+    "associative, so a vectorized sum disagrees with the sequential "
+    "path's one-at-a-time association and flips cap/near-tie decisions "
+    "(round 15: accumulators feeding ordering comparisons MUST add "
+    "committed picks one at a time in rank order)",
+    scope=_KERNEL_DF,
+)
+def _vectorized_accumulator_ordering(src: Source):
+    if "while_loop" not in src.text and "fori_loop" not in src.text:
+        return
+    ma = _df.of(src)
+    seen: set = set()
+    _ORD = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+    for fa in _loop_body_analyses(ma):
+        fn = fa.fn
+        root = fn if not isinstance(fn, ast.Lambda) else fn.body
+        for cmp_node in ast.walk(root):
+            if not (
+                isinstance(cmp_node, ast.Compare)
+                and any(isinstance(op, _ORD) for op in cmp_node.ops)
+            ):
+                continue
+            for node in ast.walk(cmp_node):
+                if not (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.Add, ast.Sub))
+                ):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                lt, rt = fa.tags(node.left), fa.tags(node.right)
+                for red, acc in ((lt, rt), (rt, lt)):
+                    if (
+                        _df.REDUCED in red
+                        and _df.CARRY in acc
+                        and _df.REDUCED not in acc
+                    ):
+                        seen.add(key)
+                        yield _finding(
+                            src,
+                            "vectorized-accumulator-ordering",
+                            node,
+                            "a reduction-produced value is added to a "
+                            "carry accumulator inside an ordering "
+                            "comparison: f32 addition is non-associative, "
+                            "so the vectorized sum can flip near-ties "
+                            "against the sequential oracle -- accumulate "
+                            "committed picks one at a time in rank order "
+                            "(CLAUDE.md round-15 exactness lesson), or "
+                            "allow with a proof the operands are exact "
+                            "(integral resolution units)",
+                        )
+                        break
+
+
+# The scheduling-class identity fields (core/keys.class_signature): a
+# hashable combining >= _SIG_MIN of these reads off ONE object outside
+# core/keys is a second hand-rolled signature -- the r5 divergence
+# (IndexError into the compat matrix) in the making.
+_SIG_FIELDS = {
+    "resources",
+    "node_selector",
+    "tolerations",
+    "priority_class",
+    "priority",
+    "node_type_scores",
+}
+_SIG_MIN = 3
+
+
+def _sig_helper_reads(ma, call: ast.Call) -> frozenset:
+    """(root, field) pairs a project-helper call reads off its arguments:
+    `selector_items(job)` whose body touches `j.node_selector` yields
+    ("job", "node_selector") -- field-read provenance across the helper
+    boundary."""
+    fname = _dotted(call.func)
+    if not fname:
+        return frozenset()
+    target = ma.module_defs.get(fname)
+    if target is None:
+        ent = ma.imported_def(fname)
+        if ent is None:
+            return frozenset()
+        _, target = ent
+    params = [a.arg for a in target.args.args]
+    arg_root: dict = {}
+    for p, a in zip(params, call.args):
+        if isinstance(a, ast.Name):
+            arg_root[p] = a.id
+    for kw in call.keywords:
+        if kw.arg in params and isinstance(kw.value, ast.Name):
+            arg_root[kw.arg] = kw.value.id
+    out: set = set()
+    for sub in ast.walk(target):
+        if (
+            isinstance(sub, ast.Attribute)
+            and sub.attr in _SIG_FIELDS
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id in arg_root
+        ):
+            out.add((arg_root[sub.value.id], sub.attr))
+    return frozenset(out)
+
+
+@rule(
+    "class-signature-home",
+    "a hashable tuple built from the scheduling-class field-read set "
+    "(resources/node_selector/tolerations/priority_class/priority/"
+    "node_type_scores) outside core/keys: scheduling-class identity lives "
+    "in ONE place (core/keys.class_signature) -- a second hand-rolled "
+    "signature diverged on the excluded node-id label and crashed "
+    "validation with an IndexError into the compat matrix (round 5)",
+    scope=lambda p: p.startswith("armada_tpu/")
+    and p != "armada_tpu/core/keys.py",
+)
+def _class_signature_home(src: Source):
+    hits = sum(1 for f in _SIG_FIELDS if f in src.text)
+    if hits < _SIG_MIN:
+        return
+    ma = _df.of(src)
+
+    def direct_reads(node) -> frozenset:
+        out: set = set()
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr in _SIG_FIELDS
+                and isinstance(sub.value, ast.Name)
+            ):
+                out.add((sub.value.id, sub.attr))
+            elif isinstance(sub, ast.Call):
+                out |= _sig_helper_reads(ma, sub)
+        return frozenset(out)
+
+    seen: set = set()
+    for fn in (
+        n
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ):
+        bindings: dict = {}  # name -> frozenset of (root, field) pairs
+
+        def expr_reads(node) -> frozenset:
+            out = set(direct_reads(node))
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out |= bindings.get(sub.id, frozenset())
+            return frozenset(out)
+
+        for st in _pool_fn_stmts(fn):
+            # (1) hashable tuples combining the class field-read set
+            # (subscript INDEX tuples are array indexing, not identity)
+            idx_tuples = {
+                id(s.slice)
+                for s in ast.walk(st)
+                if isinstance(s, ast.Subscript)
+            }
+            for sub in ast.walk(st):
+                if not (
+                    isinstance(sub, ast.Tuple)
+                    and isinstance(getattr(sub, "ctx", None), ast.Load)
+                    and len(sub.elts) >= 2
+                    and id(sub) not in idx_tuples
+                    and not any(
+                        isinstance(e, ast.Slice) for e in sub.elts
+                    )
+                ):
+                    continue
+                key = (sub.lineno, sub.col_offset)
+                if key in seen:
+                    continue
+                per_root: dict = {}
+                for r, f in expr_reads(sub):
+                    per_root.setdefault(r, set()).add(f)
+                if any(len(fs) >= _SIG_MIN for fs in per_root.values()):
+                    seen.add(key)
+                    yield _finding(
+                        src,
+                        "class-signature-home",
+                        sub,
+                        "tuple combines >= 3 scheduling-class identity "
+                        "fields of one object: a second hand-rolled class "
+                        "signature WILL diverge from the gang-split/"
+                        "SubmitChecker identity -- call core/keys."
+                        "class_signature (or build the tuple there)",
+                    )
+                    break
+            # (2) binding propagation (rebinding clears)
+            if isinstance(st, ast.Assign) and st.value is not None:
+                reads = expr_reads(st.value)
+                for tgt in st.targets:
+                    for s2 in ast.walk(tgt):
+                        if isinstance(s2, ast.Name):
+                            bindings[s2.id] = reads
 
 
 _THREAD_SPAWNERS = {"threading.Thread", "Thread", "_thread.start_new_thread"}
@@ -1708,6 +2132,10 @@ def lint_source(text: str, relpath: str) -> list[Finding]:
                 f"file does not parse: {e.msg}",
             )
         ]
+    return _lint_src(src)
+
+
+def _lint_src(src: Source) -> list[Finding]:
     out: list[Finding] = []
     for line, rules in src.reasonless_allows:
         out.append(
@@ -1737,6 +2165,29 @@ def lint_file(path: str, root: str) -> list[Finding]:
     rel = os.path.relpath(path, root)
     with open(path, "r", encoding="utf-8") as fh:
         return lint_source(fh.read(), rel)
+
+
+def lint_file_deps(path: str, root: str) -> tuple[list[Finding], dict]:
+    """(findings, {relpath: content-hash}) for one file: the hash map
+    covers the file itself plus every project module its dataflow
+    analysis consulted (transitively via ModuleAnalysis.deps) -- the
+    invalidation key for `tools/lint.py --cache`.  A cached entry is
+    valid iff every hash in the map still matches."""
+    rel = os.path.relpath(path, root)
+    relp = rel.replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    deps = {relp: _df.content_hash(path)}
+    try:
+        src = Source(text, rel)
+    except SyntaxError:
+        return lint_source(text, rel), deps
+    findings = _lint_src(src)
+    ma = getattr(src, "_dataflow", None)
+    if ma is not None:
+        deps.update(_df.dep_hashes(ma))
+        deps[relp] = _df.content_hash(path)
+    return findings, deps
 
 
 # Walk exclusions: generated protobuf modules (not authored here), fixture

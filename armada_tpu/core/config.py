@@ -48,6 +48,9 @@ class GangDefinition:
 
     def __hash__(self):
         return hash(
+            # lint: allow(class-signature-home) -- hash of this frozen
+            # CONFIG dataclass's own declared fields (a market gang
+            # TEMPLATE), not a Job scheduling-class identity
             (
                 self.size,
                 self.priority_class,

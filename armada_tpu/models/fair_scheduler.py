@@ -1227,11 +1227,17 @@ def _make_place_iteration(
                 prev_pc_res = jnp.sum(
                     jnp.where(prev_pc[:, None], ex_reqs, 0.0), axis=0
                 )
+                # Replay gate checks over the already-committed prefix: a
+                # mis-associated near-tie can only FAIL a gate, and a gate
+                # trip truncates to the exact sequential head path (r15),
+                # so decisions stay bit-equal (parity-pinned at K in {1,8}).
                 ok &= ev_j | (
                     (r_count + 1 <= p.global_burst)
                     & jnp.all(r_res + req_j <= p.round_cap)
+                    # lint: allow(vectorized-accumulator-ordering) -- integer count sum (exact); gate-trip truncates to the head path
                     & (q_sched[qj] + prev_cnt + 1 <= p.perq_burst[qj])
                     & jnp.all(
+                        # lint: allow(vectorized-accumulator-ordering) -- gate-trip truncates to the exact head path
                         (q_alloc_pc[qj, pc_j] + prev_pc_res) + req_j
                         <= p.pc_queue_cap[pc_j]
                     )

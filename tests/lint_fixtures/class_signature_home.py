@@ -1,0 +1,28 @@
+# Fixture for rule `class-signature-home` (linted under armada_tpu/
+# scheduler/): scheduling-class identity lives in ONE place
+# (core/keys.class_signature) -- a second hand-rolled signature diverged
+# on the excluded node-id label and crashed validation (round 5).  The
+# rule anchors on FIELD-READ provenance, not textual cloning: the TP
+# tuple combines three class-identity fields of ONE job (one of them
+# through a project helper -- v3 field-read flow across the boundary);
+# the twin is syntactically IDENTICAL but splits its reads across two
+# objects, so no single root reaches the signature threshold.
+
+
+def selector_items(job):
+    return tuple(sorted(job.node_selector.items()))
+
+
+def index(jobs, others):
+    out = {}
+    for job, other in zip(jobs, others):
+        sel = selector_items(job)
+        tol = tuple(job.tolerations)
+        pc = job.priority_class
+        sel2 = selector_items(other)
+        tol2 = tuple(other.tolerations)
+        pc2 = job.priority_class
+        key = (sel, tol, pc)  # TP
+        alt = (sel2, tol2, pc2)  # twin
+        out[key] = alt
+    return out

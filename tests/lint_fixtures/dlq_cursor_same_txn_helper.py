@@ -1,0 +1,25 @@
+# v3 helper-boundary fixture for `dlq-cursor-same-txn` (linted under
+# armada_tpu/ingest/): a row built by a project helper whose BODY calls
+# the DeadLetter/make_dead_letter ctor still anchors as a row (the v2
+# engine only saw the ctor textually in the assign), and its record
+# provenance is narrowed to the arguments that FLOW into the helper's
+# return.  The twin line is syntactically IDENTICAL to the TP; only
+# which record's positions ride the quarantine txn separates them.
+
+
+def build_row(rec, exc):
+    return make_dead_letter(rec.raw, rec.partition, rec.offset, exc)
+
+
+def quarantine(store, rec, other, exc):
+    row = build_row(rec, exc)
+    nxt_other = {other.partition: other.offset + 1}
+    nxt_own = {rec.partition: rec.offset + 1}
+    store.store_dead_letters([row], next_positions=nxt_other)  # TP
+    store.store_dead_letters([row], next_positions=nxt_own)  # twin
+
+
+def delegate(store, rows, positions):
+    # near miss: untraced rows (parameters) are the delegation shape --
+    # provenance unknown is not a violation
+    store.store_dead_letters(rows, next_positions=positions)

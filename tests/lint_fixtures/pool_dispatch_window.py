@@ -1,0 +1,38 @@
+# v3 fixture for rule `pool-dispatch-mutation`, WINDOWED costume (linted
+# under armada_tpu/scheduler/): the dispatch_pool_rounds list-of-finishes
+# flow that defeated the v2 def-use -- pool sources ride the window list
+# (container flow through `window.append`), the dispatch happens inside a
+# nested local helper sharing the enclosing scope's window (inlined with
+# shared value-flow state), and the finishes are consumed by a zip loop.
+# The TP mutates a WINDOWED pool's builder between the dispatch and the
+# fetch loop; the twin is syntactically IDENTICAL but mutates a pool that
+# was never appended to the window.
+
+
+def dispatch_pool_rounds(specs, config):
+    return [s for s in specs], 0, 0, set()
+
+
+def windowed_cycle(feed, txn, pools, config, rows):
+    hot = feed.builder_for("cpu", txn)
+    cold = feed.builder_for("market", txn)
+    window = []
+
+    def flush():
+        entries = list(window)
+        specs = [e["spec"] for e in entries]
+        finishes, stacked, lanes, failed = dispatch_pool_rounds(
+            specs, config
+        )
+        hot.submit_many(rows)  # TP
+        cold.submit_many(rows)  # twin
+        for e, fin in zip(entries, finishes):
+            fin()
+        # near miss: after the fetch loop the window is drained -- the
+        # same mutation is the sanctioned post-finish commit
+        hot.submit_many(rows)
+
+    for pool in pools:
+        bundle, ctx = hot.assemble_delta()
+        window.append(dict(pool=pool, spec=dict(ctx=ctx, problem=bundle)))
+    flush()

@@ -1,0 +1,30 @@
+# v3 helper-boundary fixture for `shard-foreign-cursor` (linted under
+# armada_tpu/ingest/): provenance survives the project-helper hop
+# (dataflow.helper_flow_args).  A WRAPPED poll tags the call-site shard
+# argument; a positions TRANSFORM keeps only the tags of arguments that
+# actually FLOW into its return -- so the clock argument cannot smear a
+# shard tag onto unrelated values (the precision the conservative
+# all-names union lacked).  The twin line is syntactically IDENTICAL to
+# the TP; only which shard's wrapped poll fed the positions separates
+# them.
+
+
+def normalize(positions, clock):
+    return dict(positions)
+
+
+def poll_shard(shard, limit):
+    return shard.consumer.poll(limit)
+
+
+def drain(shard, sibling, consumer, clock):
+    raw = poll_shard(sibling, 64)
+    mine = poll_shard(shard, 64)
+    nxt = normalize(raw.positions, clock)
+    own = normalize(mine.positions, clock)
+    shard.sink.store(raw.records, consumer, next_positions=nxt)  # TP
+    shard.sink.store(mine.records, consumer, next_positions=own)  # twin
+    # near miss: only the FLOWING argument keeps its tag -- the sibling
+    # positions ride the dead clock parameter, so no provenance arrives
+    mixed = normalize(clock, raw.positions)
+    shard.sink.store(mine.records, consumer, next_positions=mixed)

@@ -1,0 +1,22 @@
+# v3 helper-boundary fixture for `store-shard-foreign-write` (linted
+# under armada_tpu/ingest/): the shard-index tag survives a project-
+# helper transform (dataflow.helper_flow_args maps the flowing argument
+# back to the call site, and a flowing per-shard SUBSCRIPT contributes
+# its index key).  The twin line is syntactically IDENTICAL to the TP;
+# only which shard's slice fed the rendered plan separates them.
+
+
+def render(plan):
+    return list(plan)
+
+
+def flush(store, plans, k, j):
+    sink = store.shard_sink(k, 4)
+    plan = render(plans[j])
+    own = render(plans[k])
+    sink.store_plan(plan)  # TP
+    sink.store_plan(own)  # twin
+    # near miss: an unresolvable callee keeps the conservative fallback
+    # (no tags from an external helper, provenance unknown stays clean)
+    blob = memoryview(plans[j])
+    sink.store_plan(blob)

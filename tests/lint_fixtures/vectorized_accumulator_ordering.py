@@ -1,0 +1,25 @@
+# Fixture for rule `vectorized-accumulator-ordering` (linted under
+# armada_tpu/models/): the r15 exactness lesson -- accumulators feeding
+# ordering comparisons MUST add committed picks one at a time in rank
+# order, because a vectorized jnp.sum changes the f32 association and
+# flips round-cap near-ties against the sequential oracle.  The twin line
+# is syntactically IDENTICAL after normalization (tests/test_lint.py
+# asserts it); only REDUCED provenance separates them: `step` comes from
+# an association-sensitive reduction, `walk` from an elementwise select.
+import jax
+import jax.numpy as jnp
+
+
+def run(p, carry0):
+    def body(c):
+        i, used, deltas, mask = c
+        step = jnp.sum(jnp.where(mask[:, None], deltas, 0.0), axis=0)
+        walk = jnp.where(mask[0], deltas[0], deltas[1])
+        ok = jnp.all(used + step <= p.round_cap)  # TP
+        ok2 = jnp.all(used + walk <= p.round_cap)  # twin
+        # near miss: a reduction compared DIRECTLY (no accumulator add) is
+        # the sanctioned cardinality-check shape (sum >= card)
+        done = jnp.sum(mask) >= p.quota
+        return (i + 1, used + deltas[0], deltas, mask & ok & ok2 & ~done)
+
+    return jax.lax.while_loop(lambda c: c[0] < 8, body, carry0)

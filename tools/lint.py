@@ -13,6 +13,17 @@ exit 1 = unsuppressed violations, printed one per line as
     python tools/lint.py --diff main    # ... vs an arbitrary git ref
     python tools/lint.py --stats        # suppression census (rule -> allows)
     python tools/lint.py --jobs 4       # parallel per-file analysis
+    python tools/lint.py --cache        # reuse .lint-cache.json entries
+
+``--diff [REF]`` is the pre-commit scope: files changed vs
+merge-base(REF, HEAD) plus untracked, with renames followed to their NEW
+path and deletions skipped (``git diff --name-status -M``).  ``--cache``
+keeps a content-hash-keyed summary cache at ``.lint-cache.json`` (git-
+ignored): an entry replays its recorded findings only while the linted
+file AND every project module its dataflow analysis consulted keep their
+recorded hashes, and the whole cache is dropped when the engine itself
+(lint.py/dataflow.py) changes.  Combine ``--cache --jobs N`` for the
+fastest warm full-tree walk.
 
 The fast test tier runs this via tests/test_lint.py (the self-hosting
 gate), so a new violation fails CI the same cycle it lands.
@@ -22,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import hashlib
 import json
 import os
 import subprocess
@@ -29,7 +41,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from armada_tpu.analysis import dataflow as _df  # noqa: E402
 from armada_tpu.analysis import lint  # noqa: E402
+
+CACHE_NAME = ".lint-cache.json"
+_ENGINE_FILES = (
+    "armada_tpu/analysis/lint.py",
+    "armada_tpu/analysis/dataflow.py",
+)
 
 
 def _walk_paths(root: str) -> list[str]:
@@ -53,13 +72,25 @@ def _diff_paths(root: str, ref: str) -> list[str]:
             f"armada-lint: --diff {ref}: {mb.stderr.strip() or 'git merge-base failed'}"
         )
     base = mb.stdout.strip()
-    changed = subprocess.run(
-        ["git", "diff", "--name-only", base, "--", "*.py"],
+    # --name-status -M: a rename surfaces as `R<score>\told\tnew` -- lint
+    # the NEW path (name-only would list the old one, which may be gone);
+    # a deletion is `D\tpath` -- nothing on disk to lint, skip it rather
+    # than crash on the read.
+    status_rows = subprocess.run(
+        ["git", "diff", "--name-status", "-M", base, "--", "*.py"],
         capture_output=True,
         text=True,
         cwd=root,
         check=True,
     ).stdout.splitlines()
+    changed = []
+    for row in status_rows:
+        parts = row.rstrip("\n").split("\t")
+        if len(parts) < 2 or not parts[0]:
+            continue
+        if parts[0].startswith("D"):
+            continue
+        changed.append(parts[-1])
     untracked = subprocess.run(
         ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
         capture_output=True,
@@ -91,6 +122,85 @@ def _lint_paths(paths: list[str], root: str, jobs: int) -> list:
         findings = []
         for p in paths:
             findings.extend(lint.lint_file(p, root))
+    return findings
+
+
+def _engine_hash(root: str) -> str:
+    """One key for the analysis engine itself: any lint.py/dataflow.py
+    edit invalidates the WHOLE cache (rules and lattice both change what
+    a file's findings mean, independent of the file's own content)."""
+    h = hashlib.sha256()
+    for rel in _ENGINE_FILES:
+        with open(os.path.join(root, rel), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
+def _load_cache(root: str, engine: str) -> dict:
+    try:
+        with open(os.path.join(root, CACHE_NAME), "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("engine") != engine:
+        return {}
+    files = doc.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _lint_paths_cached(paths: list[str], root: str, jobs: int) -> list:
+    """Cache-aware walk: serve findings for files whose recorded hash map
+    (the file + every dataflow dep, transitively) still matches; lint
+    only the misses (through the deps-returning worker so their entries
+    can be recorded); rewrite the cache."""
+    engine = _engine_hash(root)
+    cached = _load_cache(root, engine)
+    cur: dict = {}
+
+    def cur_hash(rel: str):
+        if rel not in cur:
+            try:
+                cur[rel] = _df.content_hash(os.path.join(root, rel))
+            except OSError:
+                cur[rel] = None  # a recorded dep vanished: stale
+        return cur[rel]
+
+    findings: list = []
+    fresh: dict = {}
+    misses: list[str] = []
+    for p in paths:
+        rel = os.path.relpath(p, root).replace(os.sep, "/")
+        ent = cached.get(rel)
+        deps = ent.get("deps") if isinstance(ent, dict) else None
+        if deps and all(cur_hash(d) == h for d, h in deps.items()):
+            findings.extend(lint.Finding(**d) for d in ent.get("findings", []))
+            fresh[rel] = ent
+            continue
+        misses.append(p)
+
+    if misses:
+        worker = functools.partial(lint.lint_file_deps, root=root)
+        if jobs > 1 and len(misses) > 1:
+            import multiprocessing
+
+            with multiprocessing.Pool(jobs) as pool:
+                results = pool.map(worker, misses, chunksize=8)
+        else:
+            results = [worker(p) for p in misses]
+        for p, (fs, deps) in zip(misses, results):
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            findings.extend(fs)
+            fresh[rel] = {"deps": deps, "findings": [f.as_dict() for f in fs]}
+
+    # Entries for files outside this run (e.g. a --diff scope) survive
+    # untouched; their own hash maps keep them honest next time.
+    for rel, ent in cached.items():
+        fresh.setdefault(rel, ent)
+    try:
+        with open(os.path.join(root, CACHE_NAME), "w", encoding="utf-8") as fh:
+            json.dump({"engine": engine, "files": fresh}, fh)
+    except OSError:
+        pass  # a read-only checkout still lints, just never warms
     return findings
 
 
@@ -141,6 +251,12 @@ def main(argv=None) -> int:
         metavar="N",
         help="parallel per-file analysis processes (default 1)",
     )
+    ap.add_argument(
+        "--cache",
+        action="store_true",
+        help="reuse .lint-cache.json entries whose file+dep content "
+        "hashes are unchanged (engine edits drop the whole cache)",
+    )
     args = ap.parse_args(argv)
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -161,7 +277,10 @@ def main(argv=None) -> int:
     else:
         paths = _walk_paths(root)
     n = len(paths)
-    findings = _lint_paths(paths, root, args.jobs)
+    if args.cache:
+        findings = _lint_paths_cached(paths, root, args.jobs)
+    else:
+        findings = _lint_paths(paths, root, args.jobs)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.json:
